@@ -1,0 +1,32 @@
+"""Core API: the 5 stage interfaces + the columnar DataFrame.
+
+Reference: flink-ml-core/.../api/ (Stage, Estimator, Model, Transformer, AlgoOperator)
+and flink-ml-servable-core/.../servable/api/ (DataFrame, Row) + servable/types.
+"""
+
+from flink_ml_tpu.api.core import AlgoOperator, Estimator, Model, Stage, Transformer
+from flink_ml_tpu.api.dataframe import DataFrame, Row
+from flink_ml_tpu.api.types import (
+    BasicType,
+    DataType,
+    DataTypes,
+    MatrixType,
+    ScalarType,
+    VectorType,
+)
+
+__all__ = [
+    "AlgoOperator",
+    "BasicType",
+    "DataFrame",
+    "DataType",
+    "DataTypes",
+    "Estimator",
+    "MatrixType",
+    "Model",
+    "Row",
+    "ScalarType",
+    "Stage",
+    "Transformer",
+    "VectorType",
+]

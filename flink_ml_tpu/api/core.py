@@ -1,0 +1,77 @@
+"""The five core stage interfaces.
+
+Reference: flink-ml-core/src/main/java/org/apache/flink/ml/api/
+  - ``Stage``        <- Stage.java:44   (WithParams + save(path) + static load(path))
+  - ``Estimator``    <- Estimator.java:31,38  (fit(DataFrame...) -> Model)
+  - ``AlgoOperator`` <- AlgoOperator.java:31  (transform(DataFrame...) -> DataFrame[])
+  - ``Transformer``  <- Transformer.java:39   (marker for feature-engineering transforms)
+  - ``Model``        <- Model.java:31,38,48   (Transformer + set/get_model_data)
+
+Contract notes kept from the reference:
+  - ``fit``/``transform`` take and return *lists* conceptually; for ergonomics the
+    Python API accepts varargs and single-output stages return the single DataFrame
+    (like the pyflink wrappers do, pyflink/ml/wrapper.py:221).
+  - Model data is itself a DataFrame (the reference's model-data Table), so it can be
+    inspected, streamed, and transferred between training and serving.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.params.param import WithParams
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["Stage", "Estimator", "AlgoOperator", "Transformer", "Model"]
+
+
+class Stage(WithParams):
+    """Base of all pipeline nodes; must be serializable via save/load. Ref Stage.java:44."""
+
+    def save(self, path: str) -> None:
+        rw.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Stage":
+        metadata = rw.load_metadata(path, rw.stage_class_name(cls))
+        stage = cls()
+        stage.load_param_map_from_json(metadata["paramMap"])
+        return stage
+
+    def __repr__(self) -> str:
+        shown = {p.name: v for p, v in self._param_map.items() if v != p.default_value}
+        return f"{type(self).__name__}({shown})"
+
+
+class AlgoOperator(Stage):
+    """Computes outputs from inputs; the relational-algebra node. Ref AlgoOperator.java:31."""
+
+    def transform(self, *inputs: DataFrame):
+        raise NotImplementedError
+
+
+class Transformer(AlgoOperator):
+    """Marker: an AlgoOperator whose semantics is record-wise feature transformation.
+    Ref Transformer.java:39."""
+
+
+class Model(Transformer):
+    """A Transformer with model data. Ref Model.java:31.
+
+    ``set_model_data``/``get_model_data`` exchange model state as DataFrames
+    (the reference's model-data Tables, Model.java:38,48), which is what makes
+    online model streams and train/serve separation possible.
+    """
+
+    def set_model_data(self, *model_data: DataFrame) -> "Model":
+        raise NotImplementedError
+
+    def get_model_data(self) -> List[DataFrame]:
+        raise NotImplementedError
+
+
+class Estimator(Stage):
+    """Trains a Model from data. Ref Estimator.java:31."""
+
+    def fit(self, *inputs: DataFrame) -> Model:
+        raise NotImplementedError

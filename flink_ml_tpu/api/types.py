@@ -1,0 +1,60 @@
+"""Minimal data-type system for DataFrame schemas.
+
+Reference: flink-ml-servable-core/.../servable/types/ (DataTypes.java, BasicType.java,
+ScalarType.java, VectorType.java, MatrixType.java).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+__all__ = ["BasicType", "DataType", "ScalarType", "VectorType", "MatrixType", "DataTypes"]
+
+
+class BasicType(Enum):
+    BOOLEAN = "boolean"
+    BYTE = "byte"
+    SHORT = "short"
+    INT = "int"
+    LONG = "long"
+    FLOAT = "float"
+    DOUBLE = "double"
+    STRING = "string"
+
+
+class DataType:
+    pass
+
+
+@dataclass(frozen=True)
+class ScalarType(DataType):
+    element_type: BasicType
+
+
+@dataclass(frozen=True)
+class VectorType(DataType):
+    element_type: BasicType
+
+
+@dataclass(frozen=True)
+class MatrixType(DataType):
+    element_type: BasicType
+
+
+class DataTypes:
+    """Ref DataTypes.java constants/factories."""
+
+    BOOLEAN = ScalarType(BasicType.BOOLEAN)
+    INT = ScalarType(BasicType.INT)
+    LONG = ScalarType(BasicType.LONG)
+    FLOAT = ScalarType(BasicType.FLOAT)
+    DOUBLE = ScalarType(BasicType.DOUBLE)
+    STRING = ScalarType(BasicType.STRING)
+
+    @staticmethod
+    def vector(element_type: BasicType = BasicType.DOUBLE) -> VectorType:
+        return VectorType(element_type)
+
+    @staticmethod
+    def matrix(element_type: BasicType = BasicType.DOUBLE) -> MatrixType:
+        return MatrixType(element_type)

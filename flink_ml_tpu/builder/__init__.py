@@ -1,0 +1,8 @@
+"""Stage composition: Pipeline, PipelineModel, Graph, GraphBuilder, GraphModel.
+
+Reference: flink-ml-core/src/main/java/org/apache/flink/ml/builder/.
+"""
+
+from flink_ml_tpu.builder.pipeline import Pipeline, PipelineModel
+
+__all__ = ["Pipeline", "PipelineModel"]

@@ -1,0 +1,117 @@
+"""Pipeline / PipelineModel.
+
+Reference: flink-ml-core/.../builder/Pipeline.java:45 and PipelineModel.java:47.
+Semantics preserved exactly:
+  - ``Pipeline.fit`` (Pipeline.java:79) trains stages sequentially; each Estimator is
+    fit on the *current* intermediate table and replaced by the Model it produces; the
+    intermediate table is then that stage's transform output (Pipeline.java:96).
+  - ``PipelineModel.transform`` (PipelineModel.java:66) chains transforms.
+  - save/load store each stage in a numbered subdirectory ("stages/<idx>") plus a
+    pipeline-level metadata file (ReadWriteUtils.savePipeline:121).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Sequence
+
+from flink_ml_tpu.api.core import AlgoOperator, Estimator, Model, Stage, Transformer
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["Pipeline", "PipelineModel"]
+
+_STAGES_DIR = "stages"
+
+
+def _save_stages(stages: Sequence[Stage], path: str) -> None:
+    for i, stage in enumerate(stages):
+        stage.save(os.path.join(path, _STAGES_DIR, f"{i:08d}"))
+
+
+def _load_stages(path: str) -> List[Stage]:
+    stages_dir = os.path.join(path, _STAGES_DIR)
+    out = []
+    for name in sorted(os.listdir(stages_dir)):
+        out.append(rw.load_stage(os.path.join(stages_dir, name)))
+    return out
+
+
+class Pipeline(Estimator):
+    """An Estimator composed of a sequence of stages. Ref Pipeline.java:45."""
+
+    def __init__(self, stages: Sequence[Stage] = ()):  # noqa: D401
+        super().__init__()
+        self.stages: List[Stage] = list(stages)
+
+    def fit(self, *inputs: DataFrame) -> "PipelineModel":
+        """Ref Pipeline.fit:79 — sequential train, feeding transformed output forward.
+
+        As in the reference (Pipeline.java:88-98), stages at or after the last
+        Estimator are not transformed during fit — their outputs would be discarded.
+        """
+        last_estimator_idx = -1
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, Estimator):
+                last_estimator_idx = i
+        last_inputs = list(inputs)
+        model_stages: List[Stage] = []
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, Estimator):
+                fitted: Stage = stage.fit(*last_inputs)
+            else:
+                fitted = stage
+            model_stages.append(fitted)
+            if i < last_estimator_idx and isinstance(fitted, AlgoOperator):
+                out = fitted.transform(*last_inputs)
+                last_inputs = list(out) if isinstance(out, (list, tuple)) else [out]
+        return PipelineModel(model_stages)
+
+    def save(self, path: str) -> None:
+        rw.save_metadata(self, path, {"numStages": len(self.stages)})
+        _save_stages(self.stages, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Pipeline":
+        rw.load_metadata(path, rw.stage_class_name(cls))
+        return cls(_load_stages(path))
+
+
+class PipelineModel(Model):
+    """A Model chaining the transforms of its stages. Ref PipelineModel.java:47."""
+
+    def __init__(self, stages: Sequence[Stage] = ()):
+        super().__init__()
+        self.stages: List[Stage] = list(stages)
+
+    def transform(self, *inputs: DataFrame):
+        """Ref PipelineModel.transform:66."""
+        last_inputs = list(inputs)
+        for stage in self.stages:
+            out = stage.transform(*last_inputs)
+            last_inputs = list(out) if isinstance(out, (list, tuple)) else [out]
+        return last_inputs[0] if len(last_inputs) == 1 else last_inputs
+
+    def set_model_data(self, *model_data: DataFrame) -> "PipelineModel":
+        i = 0
+        for stage in self.stages:
+            if isinstance(stage, Model):
+                n = len(stage.get_model_data())
+                stage.set_model_data(*model_data[i : i + n])
+                i += n
+        return self
+
+    def get_model_data(self) -> List[DataFrame]:
+        out: List[DataFrame] = []
+        for stage in self.stages:
+            if isinstance(stage, Model):
+                out.extend(stage.get_model_data())
+        return out
+
+    def save(self, path: str) -> None:
+        rw.save_metadata(self, path, {"numStages": len(self.stages)})
+        _save_stages(self.stages, path)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineModel":
+        rw.load_metadata(path, rw.stage_class_name(cls))
+        return cls(_load_stages(path))

@@ -1,0 +1,31 @@
+"""Runtime-free linear algebra: dense/sparse vectors, dense matrix, BLAS-style kernels.
+
+Reference: flink-ml-servable-core/src/main/java/org/apache/flink/ml/linalg/
+(Vector.java, DenseVector.java, SparseVector.java, DenseMatrix.java, BLAS.java:30-179,
+Vectors.java, VectorWithNorm.java).
+
+TPU-first design departure: the reference's per-object Java loops become XLA ops over
+*batched* arrays. The ``DenseVector``/``SparseVector`` classes here are thin host-side
+containers used at the DataFrame/API boundary; all hot-path compute takes raw
+``jax.numpy`` arrays (see ``blas.py``) so it can be jit-fused and tiled onto the MXU.
+"""
+
+from flink_ml_tpu.linalg import blas
+from flink_ml_tpu.linalg.matrix import DenseMatrix
+from flink_ml_tpu.linalg.vectors import (
+    DenseVector,
+    SparseVector,
+    Vector,
+    VectorWithNorm,
+    Vectors,
+)
+
+__all__ = [
+    "DenseMatrix",
+    "DenseVector",
+    "SparseVector",
+    "Vector",
+    "VectorWithNorm",
+    "Vectors",
+    "blas",
+]

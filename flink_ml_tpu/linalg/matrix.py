@@ -1,0 +1,67 @@
+"""Dense matrix. Ref flink-ml-servable-core/.../linalg/DenseMatrix.java.
+
+The reference stores column-major doubles; here the backing store is a row-major
+float64 numpy array (the natural layout for XLA), while the (row, col) accessor API
+is preserved so code written against the reference's semantics reads identically.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import numpy as np
+
+__all__ = ["DenseMatrix"]
+
+
+class DenseMatrix:
+    __slots__ = ("values",)
+
+    def __init__(
+        self,
+        num_rows: int = None,
+        num_cols: int = None,
+        values: Union[Sequence[float], np.ndarray] = None,
+    ):
+        if values is not None and num_rows is None and num_cols is None:
+            self.values = np.asarray(values, dtype=np.float64)
+            if self.values.ndim != 2:
+                raise ValueError("2-D array required")
+        else:
+            if values is None:
+                self.values = np.zeros((num_rows, num_cols), dtype=np.float64)
+            else:
+                arr = np.asarray(values, dtype=np.float64)
+                if arr.ndim == 1:
+                    # Reference semantics: flat values are column-major.
+                    arr = arr.reshape((num_cols, num_rows)).T
+                self.values = np.ascontiguousarray(arr)
+                if self.values.shape != (num_rows, num_cols):
+                    raise ValueError(
+                        f"shape mismatch: got {self.values.shape}, want ({num_rows}, {num_cols})"
+                    )
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.values.shape[0])
+
+    @property
+    def num_cols(self) -> int:
+        return int(self.values.shape[1])
+
+    def get(self, i: int, j: int) -> float:
+        return float(self.values[i, j])
+
+    def set(self, i: int, j: int, value: float) -> None:
+        self.values[i, j] = value
+
+    def to_array(self) -> np.ndarray:
+        return self.values
+
+    def clone(self) -> "DenseMatrix":
+        return DenseMatrix(values=self.values.copy())
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, DenseMatrix) and np.array_equal(self.values, other.values)
+
+    def __repr__(self) -> str:
+        return f"DenseMatrix({self.values.tolist()})"

@@ -1,0 +1,5 @@
+"""Compute building blocks: losses, optimizers, distance measures, quantiles, windows.
+
+Reference: flink-ml-lib/.../common/ (lossfunc, optimizer, util) and
+flink-ml-core/.../common/window + flink-ml-servable-core distance measures.
+"""

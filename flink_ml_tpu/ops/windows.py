@@ -1,0 +1,127 @@
+"""Serializable windowing-strategy descriptors.
+
+Reference: flink-ml-core/src/main/java/org/apache/flink/ml/common/window/Windows.java
+(GlobalWindows, CountTumblingWindows, EventTimeTumblingWindows, EventTimeSessionWindows,
+ProcessingTimeTumblingWindows, ProcessingTimeSessionWindows) — value objects describing
+how an unbounded stream is sliced into mini-batches.
+
+TPU-first semantics: a window descriptor configures the ``flink_ml_tpu.iteration.stream``
+mini-batch iterator — each produced window becomes one device step (the SURVEY section 5.7
+"window = microbatch" mapping). Time-based windows operate on a timestamp column.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = [
+    "Windows",
+    "GlobalWindows",
+    "CountTumblingWindows",
+    "EventTimeTumblingWindows",
+    "ProcessingTimeTumblingWindows",
+    "EventTimeSessionWindows",
+    "ProcessingTimeSessionWindows",
+]
+
+
+class Windows:
+    """Base descriptor; JSON round-trip used by the param system."""
+
+    def to_json_dict(self) -> dict:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_json_dict(payload: dict):
+        kind = payload.get("__type__")
+        table = {
+            "GlobalWindows": lambda p: GlobalWindows(),
+            "CountTumblingWindows": lambda p: CountTumblingWindows(p["size"]),
+            "EventTimeTumblingWindows": lambda p: EventTimeTumblingWindows(p["sizeMs"]),
+            "ProcessingTimeTumblingWindows": lambda p: ProcessingTimeTumblingWindows(p["sizeMs"]),
+            "EventTimeSessionWindows": lambda p: EventTimeSessionWindows(p["gapMs"]),
+            "ProcessingTimeSessionWindows": lambda p: ProcessingTimeSessionWindows(p["gapMs"]),
+        }
+        if kind in table:
+            return table[kind](payload)
+        return None
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_json_dict() == other.to_json_dict()
+
+    def __hash__(self):
+        return hash(tuple(sorted(self.to_json_dict().items())))
+
+
+@dataclass(frozen=True, eq=False)
+class GlobalWindows(Windows):
+    """All input in one window that fires at end-of-stream. Ref GlobalWindows.java /
+    EndOfStreamWindows.java:36."""
+
+    def to_json_dict(self):
+        return {"__type__": "GlobalWindows"}
+
+    @staticmethod
+    def get_instance() -> "GlobalWindows":
+        return GlobalWindows()
+
+
+@dataclass(frozen=True, eq=False)
+class CountTumblingWindows(Windows):
+    """Fixed-count tumbling windows. Ref CountTumblingWindows.java."""
+
+    size: int
+
+    def to_json_dict(self):
+        return {"__type__": "CountTumblingWindows", "size": self.size}
+
+    @staticmethod
+    def of(size: int) -> "CountTumblingWindows":
+        return CountTumblingWindows(size)
+
+
+@dataclass(frozen=True, eq=False)
+class EventTimeTumblingWindows(Windows):
+    size_ms: int
+
+    def to_json_dict(self):
+        return {"__type__": "EventTimeTumblingWindows", "sizeMs": self.size_ms}
+
+    @staticmethod
+    def of(size_ms: int) -> "EventTimeTumblingWindows":
+        return EventTimeTumblingWindows(size_ms)
+
+
+@dataclass(frozen=True, eq=False)
+class ProcessingTimeTumblingWindows(Windows):
+    size_ms: int
+
+    def to_json_dict(self):
+        return {"__type__": "ProcessingTimeTumblingWindows", "sizeMs": self.size_ms}
+
+    @staticmethod
+    def of(size_ms: int) -> "ProcessingTimeTumblingWindows":
+        return ProcessingTimeTumblingWindows(size_ms)
+
+
+@dataclass(frozen=True, eq=False)
+class EventTimeSessionWindows(Windows):
+    gap_ms: int
+
+    def to_json_dict(self):
+        return {"__type__": "EventTimeSessionWindows", "gapMs": self.gap_ms}
+
+    @staticmethod
+    def with_gap(gap_ms: int) -> "EventTimeSessionWindows":
+        return EventTimeSessionWindows(gap_ms)
+
+
+@dataclass(frozen=True, eq=False)
+class ProcessingTimeSessionWindows(Windows):
+    gap_ms: int
+
+    def to_json_dict(self):
+        return {"__type__": "ProcessingTimeSessionWindows", "gapMs": self.gap_ms}
+
+    @staticmethod
+    def with_gap(gap_ms: int) -> "ProcessingTimeSessionWindows":
+        return ProcessingTimeSessionWindows(gap_ms)

@@ -1,0 +1,38 @@
+"""Typed parameter system with validation and JSON round-trip.
+
+Reference: flink-ml-servable-core/src/main/java/org/apache/flink/ml/param/
+(Param.java, WithParams.java, ParamValidators.java, 18 typed Param subclasses) and the
+shared ``HasXxx`` mixin interfaces under flink-ml-servable-lib/.../common/param/.
+"""
+
+from flink_ml_tpu.params.param import (
+    ArrayParam,
+    BoolParam,
+    FloatArrayParam,
+    FloatParam,
+    IntArrayParam,
+    IntParam,
+    Param,
+    ParamValidators,
+    StringArrayParam,
+    StringParam,
+    VectorParam,
+    WithParams,
+)
+from flink_ml_tpu.params import shared
+
+__all__ = [
+    "ArrayParam",
+    "BoolParam",
+    "FloatArrayParam",
+    "FloatParam",
+    "IntArrayParam",
+    "IntParam",
+    "Param",
+    "ParamValidators",
+    "StringArrayParam",
+    "StringParam",
+    "VectorParam",
+    "WithParams",
+    "shared",
+]

@@ -1,0 +1,1 @@
+"""Utilities: persistence, registry, metrics."""

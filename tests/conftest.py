@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The analogue of the reference's Flink MiniCluster (SURVEY.md section 4): an in-process
+multi-device "cluster" so DP/allreduce semantics are unit-testable without TPUs.
+
+The container boots every interpreter through an axon sitecustomize that registers a
+TPU-tunnel PJRT plugin and sets ``JAX_PLATFORMS=axon``. JAX backend *initialization* is
+lazy, though — so overriding the platform + XLA flags here, before the first device
+lookup, is sufficient to put the whole test run on 8 virtual CPU devices.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8, (
+    "tests require the 8-device virtual CPU mesh; got " + repr(jax.devices())
+)

@@ -1,0 +1,65 @@
+"""Hand-written toy stages that lock the Stage/Pipeline contract.
+
+Mirrors flink-ml-core/src/test/.../api/ExampleStages.java (SumEstimator/SumModel used
+by PipelineTest/GraphTest).
+"""
+import numpy as np
+
+from flink_ml_tpu.api import DataFrame, DataTypes
+from flink_ml_tpu.api.core import AlgoOperator, Estimator, Model, Transformer
+from flink_ml_tpu.params.param import StringParam
+from flink_ml_tpu.utils import read_write as rw
+
+
+class SumModel(Model):
+    """Adds a learned delta to the input column. Ref ExampleStages.SumModel."""
+
+    INPUT_COL = StringParam("inputCol", "Input column.", "input")
+
+    def __init__(self, delta: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.delta = float(delta)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        col = self.get(self.INPUT_COL)
+        return df.with_column(col, df.scalars(col) + self.delta)
+
+    def set_model_data(self, model_data: DataFrame) -> "SumModel":
+        self.delta = float(model_data.scalars("delta")[0])
+        return self
+
+    def get_model_data(self):
+        return [DataFrame.from_dict({"delta": np.array([self.delta])})]
+
+    def save(self, path: str) -> None:
+        rw.save_metadata(self, path)
+        rw.save_model_arrays(path, {"delta": np.array([self.delta])})
+
+    @classmethod
+    def load(cls, path: str) -> "SumModel":
+        metadata = rw.load_metadata(path, rw.stage_class_name(cls))
+        arrays = rw.load_model_arrays(path)
+        model = cls(delta=float(arrays["delta"][0]))
+        model.load_param_map_from_json(metadata["paramMap"])
+        return model
+
+
+class SumEstimator(Estimator):
+    """Learns delta = sum of the input column. Ref ExampleStages.SumEstimator."""
+
+    INPUT_COL = StringParam("inputCol", "Input column.", "input")
+
+    def fit(self, df: DataFrame) -> SumModel:
+        model = SumModel(delta=float(df.scalars(self.get(self.INPUT_COL)).sum()))
+        model.set(SumModel.INPUT_COL, self.get(self.INPUT_COL))
+        return model
+
+
+class DoubleTransformer(Transformer):
+    """Stateless transformer that doubles the input column."""
+
+    INPUT_COL = StringParam("inputCol", "Input column.", "input")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        col = self.get(self.INPUT_COL)
+        return df.with_column(col, df.scalars(col) * 2.0)

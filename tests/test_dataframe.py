@@ -1,0 +1,84 @@
+"""DataFrame tests mirroring DataFrameTest semantics
+(flink-ml-servable-core/src/test/.../servable/api/)."""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api import DataFrame, DataTypes, Row
+from flink_ml_tpu.linalg import DenseVector, Vectors
+
+
+def make_df():
+    return DataFrame.from_rows(
+        ["id", "features", "label", "name"],
+        [
+            [0, Vectors.dense(1.0, 2.0), 1.0, "a"],
+            [1, Vectors.dense(3.0, 4.0), 0.0, "b"],
+            [2, Vectors.dense(5.0, 6.0), 1.0, "c"],
+        ],
+    )
+
+
+class TestDataFrame:
+    def test_schema(self):
+        df = make_df()
+        assert df.get_column_names() == ["id", "features", "label", "name"]
+        assert df.get_index("label") == 2
+        assert df.num_rows == 3
+
+    def test_columnar_storage(self):
+        df = make_df()
+        feats = df.vectors("features")
+        assert feats.shape == (3, 2)
+        assert df.scalars("label").tolist() == [1.0, 0.0, 1.0]
+
+    def test_collect_rows(self):
+        rows = make_df().collect()
+        assert len(rows) == 3
+        assert rows[0].get(0) == 0
+        assert rows[1].get(1) == DenseVector([3.0, 4.0])
+        assert rows[2].get(3) == "c"
+
+    def test_add_column(self):
+        df = make_df()
+        df.add_column("pred", DataTypes.DOUBLE, np.array([0.1, 0.2, 0.3]))
+        assert "pred" in df.get_column_names()
+        assert df.scalars("pred").tolist() == [0.1, 0.2, 0.3]
+
+    def test_add_column_length_mismatch(self):
+        with pytest.raises(ValueError):
+            make_df().add_column("bad", DataTypes.DOUBLE, np.array([1.0]))
+
+    def test_with_column_functional(self):
+        df = make_df()
+        df2 = df.with_column("pred", np.array([1.0, 2.0, 3.0]))
+        assert "pred" not in df.get_column_names()
+        assert "pred" in df2.get_column_names()
+
+    def test_select_drop_take(self):
+        df = make_df()
+        assert df.select(["id", "label"]).get_column_names() == ["id", "label"]
+        assert df.drop("name").get_column_names() == ["id", "features", "label"]
+        sub = df.take([2, 0])
+        assert sub.scalars("id", np.int64).tolist() == [2, 0]
+        assert sub.collect()[0].get(3) == "c"
+
+    def test_from_dict(self):
+        df = DataFrame.from_dict({"x": np.arange(4), "y": ["a", "b", "c", "d"]})
+        assert df.num_rows == 4
+        assert df.column("y") == ["a", "b", "c", "d"]
+
+    def test_sparse_column_stays_ragged(self):
+        df = DataFrame.from_rows(
+            ["v"], [[Vectors.sparse(4, [0], [1.0])], [Vectors.sparse(4, [1], [2.0])]]
+        )
+        dense = df.vectors("v")
+        assert dense.shape == (2, 4)
+        assert dense[1].tolist() == [0.0, 2.0, 0.0, 0.0]
+
+    def test_inconsistent_lengths_rejected(self):
+        with pytest.raises(ValueError):
+            DataFrame(["a", "b"], None, [np.arange(3), np.arange(4)])
+
+    def test_row_equality(self):
+        assert Row([1, "a"]) == Row([1, "a"])
+        assert Row([1]) != Row([2])

@@ -1,0 +1,122 @@
+"""Linalg tests mirroring the reference's BLASTest / DenseVectorTest / SparseVectorTest
+semantics (flink-ml-servable-core/src/test/.../linalg/)."""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.linalg import DenseMatrix, DenseVector, SparseVector, Vectors, blas
+
+
+class TestDenseVector:
+    def test_basic(self):
+        v = Vectors.dense(1.0, 2.0, 3.0)
+        assert v.size() == 3
+        assert v.get(1) == 2.0
+        v.set(1, 5.0)
+        assert v.get(1) == 5.0
+        assert np.array_equal(v.to_array(), [1.0, 5.0, 3.0])
+
+    def test_clone_independent(self):
+        v = Vectors.dense(1.0, 2.0)
+        c = v.clone()
+        c.set(0, 9.0)
+        assert v.get(0) == 1.0
+
+    def test_to_sparse(self):
+        v = Vectors.dense(0.0, 2.0, 0.0, 3.0)
+        s = v.to_sparse()
+        assert s.indices.tolist() == [1, 3]
+        assert s.values.tolist() == [2.0, 3.0]
+        assert s.size() == 4
+
+    def test_equality_and_iter(self):
+        assert Vectors.dense(1.0, 2.0) == Vectors.dense(1.0, 2.0)
+        assert list(Vectors.dense(1.0, 2.0)) == [1.0, 2.0]
+
+
+class TestSparseVector:
+    def test_sorted_invariant(self):
+        s = Vectors.sparse(5, [3, 1], [30.0, 10.0])
+        assert s.indices.tolist() == [1, 3]
+        assert s.values.tolist() == [10.0, 30.0]
+
+    def test_duplicate_index_rejected(self):
+        with pytest.raises(ValueError):
+            SparseVector(5, [1, 1], [1.0, 2.0])
+
+    def test_out_of_range_rejected(self):
+        with pytest.raises(ValueError):
+            SparseVector(3, [3], [1.0])
+
+    def test_get_set(self):
+        s = Vectors.sparse(5, [1, 3], [10.0, 30.0])
+        assert s.get(1) == 10.0
+        assert s.get(2) == 0.0
+        s.set(2, 20.0)
+        assert s.get(2) == 20.0
+        assert s.indices.tolist() == [1, 2, 3]
+
+    def test_to_dense_roundtrip(self):
+        s = Vectors.sparse(4, [0, 2], [1.0, 3.0])
+        assert np.array_equal(s.to_array(), [1.0, 0.0, 3.0, 0.0])
+        assert s.to_dense().to_sparse() == s
+
+
+class TestDenseMatrix:
+    def test_zeros(self):
+        m = DenseMatrix(2, 3)
+        assert m.num_rows == 2 and m.num_cols == 3
+        assert m.get(1, 2) == 0.0
+
+    def test_column_major_flat_values(self):
+        # Ref DenseMatrix.java: flat values are column-major.
+        m = DenseMatrix(2, 2, [1.0, 2.0, 3.0, 4.0])
+        assert m.get(0, 0) == 1.0
+        assert m.get(1, 0) == 2.0
+        assert m.get(0, 1) == 3.0
+        assert m.get(1, 1) == 4.0
+
+
+class TestBLAS:
+    """Values mirror BLASTest.java expectations."""
+
+    def setup_method(self):
+        self.x = DenseVector([1.0, -2.0, 3.0, 4.0])
+        self.y = DenseVector([2.0, 2.0, 2.0, 2.0])
+
+    def test_asum(self):
+        assert float(blas.asum(self.x)) == pytest.approx(10.0)
+
+    def test_axpy(self):
+        r = np.asarray(blas.axpy(2.0, self.x, self.y))
+        assert r.tolist() == [4.0, -2.0, 8.0, 10.0]
+
+    def test_dot(self):
+        assert float(blas.dot(self.x, self.y)) == pytest.approx(12.0)
+
+    def test_hdot(self):
+        r = np.asarray(blas.hdot(self.x, self.y))
+        assert r.tolist() == [2.0, -4.0, 6.0, 8.0]
+
+    def test_norm2(self):
+        assert float(blas.norm2(self.x)) == pytest.approx(np.sqrt(30.0))
+
+    def test_norm_inf(self):
+        assert float(blas.norm(self.x, float("inf"))) == pytest.approx(4.0)
+
+    def test_scal(self):
+        r = np.asarray(blas.scal(2.0, self.x))
+        assert r.tolist() == [2.0, -4.0, 6.0, 8.0]
+
+    def test_gemv(self):
+        m = DenseMatrix(values=[[1.0, 2.0, 3.0, 4.0], [5.0, 6.0, 7.0, 8.0]])
+        y = DenseVector([1.0, 1.0])
+        r = np.asarray(blas.gemv(1.0, m, False, self.x, 0.5, y))
+        # M @ x = [1-4+9+16, 5-12+21+32] = [22, 46]; + 0.5*y
+        assert r.tolist() == [22.5, 46.5]
+
+    def test_sq_dist_batch(self):
+        xs = np.array([[0.0, 0.0], [1.0, 1.0]])
+        cs = np.array([[0.0, 0.0], [3.0, 4.0]])
+        d = np.asarray(blas.sq_dist_batch(xs, cs))
+        assert d[0].tolist() == [0.0, 25.0]
+        assert d[1].tolist() == pytest.approx([2.0, 13.0])

@@ -1,0 +1,99 @@
+"""Param-system tests mirroring StageTest.java's param semantics
+(flink-ml-core/src/test/.../api/StageTest.java)."""
+import pytest
+
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.params import (
+    FloatParam,
+    IntParam,
+    ParamValidators,
+    StringParam,
+    VectorParam,
+    WithParams,
+)
+from flink_ml_tpu.params.shared import HasFeaturesCol, HasMaxIter
+
+
+class MyStage(HasFeaturesCol, HasMaxIter):
+    ALPHA = FloatParam("alpha", "test float", 0.5, ParamValidators.in_range(0.0, 1.0))
+    NAME = StringParam("name", "test string", "default")
+    VEC = VectorParam("vec", "test vector", None)
+
+
+class TestWithParams:
+    def test_defaults(self):
+        s = MyStage()
+        assert s.get(MyStage.ALPHA) == 0.5
+        assert s.get_features_col() == "features"
+        assert s.get_max_iter() == 20
+
+    def test_set_get(self):
+        s = MyStage()
+        s.set(MyStage.ALPHA, 0.9).set_max_iter(7)
+        assert s.get(MyStage.ALPHA) == 0.9
+        assert s.get_max_iter() == 7
+
+    def test_kwargs_ctor(self):
+        s = MyStage(alpha=0.1, maxIter=3)
+        assert s.get(MyStage.ALPHA) == 0.1
+        assert s.get_max_iter() == 3
+
+    def test_validator_rejects(self):
+        s = MyStage()
+        with pytest.raises(ValueError):
+            s.set(MyStage.ALPHA, 2.0)
+        with pytest.raises(ValueError):
+            s.set_max_iter(0)
+
+    def test_invalid_default_rejected(self):
+        with pytest.raises(ValueError):
+            IntParam("bad", "x", -1, ParamValidators.gt(0))
+
+    def test_unknown_param_rejected(self):
+        s = MyStage()
+        other = IntParam("other", "not on stage", 1)
+        with pytest.raises(KeyError):
+            s.set(other, 2)
+        with pytest.raises(KeyError):
+            s.get(other)
+
+    def test_get_param_by_name(self):
+        s = MyStage()
+        assert s.get_param("alpha") is MyStage.ALPHA
+
+    def test_param_map_discovery_across_mro(self):
+        names = {p.name for p in MyStage()._declared_params()}
+        assert {"alpha", "name", "vec", "featuresCol", "maxIter"} <= names
+
+    def test_json_roundtrip(self):
+        s = MyStage()
+        s.set(MyStage.ALPHA, 0.25)
+        s.set(MyStage.VEC, Vectors.dense(1.0, 2.0))
+        s.set(MyStage.NAME, "hello")
+        payload = s.param_map_to_json()
+        s2 = MyStage()
+        s2.load_param_map_from_json(payload)
+        assert s2.get(MyStage.ALPHA) == 0.25
+        assert s2.get(MyStage.VEC) == Vectors.dense(1.0, 2.0)
+        assert s2.get(MyStage.NAME) == "hello"
+
+    def test_sparse_vector_json_roundtrip(self):
+        s = MyStage()
+        s.set(MyStage.VEC, Vectors.sparse(5, [1, 3], [1.0, 2.0]))
+        s2 = MyStage()
+        s2.load_param_map_from_json(s.param_map_to_json())
+        assert s2.get(MyStage.VEC) == Vectors.sparse(5, [1, 3], [1.0, 2.0])
+
+
+class TestValidators:
+    def test_in_array(self):
+        v = ParamValidators.in_array(["a", "b"])
+        assert v("a") and not v("c")
+
+    def test_is_sub_set(self):
+        v = ParamValidators.is_sub_set(["a", "b", "c"])
+        assert v(["a", "c"]) and not v(["a", "d"])
+
+    def test_range_exclusive(self):
+        v = ParamValidators.in_range(0, 1, lower_inclusive=False, upper_inclusive=False)
+        assert v(0.5) and not v(0.0) and not v(1.0)

@@ -1,0 +1,80 @@
+"""Pipeline/PipelineModel contract tests, mirroring PipelineTest.java
+(flink-ml-core/src/test/.../api/PipelineTest.java)."""
+import numpy as np
+
+from flink_ml_tpu.api import DataFrame
+from flink_ml_tpu.builder import Pipeline, PipelineModel
+from flink_ml_tpu.utils import read_write as rw
+
+from tests.example_stages import DoubleTransformer, SumEstimator, SumModel
+
+
+def data(values):
+    return DataFrame.from_dict({"input": np.asarray(values, dtype=np.float64)})
+
+
+class TestPipeline:
+    def test_fit_chains_stages(self):
+        # Ref PipelineTest: estimator trained on previous stage's transformed output.
+        pipeline = Pipeline([DoubleTransformer(), SumEstimator()])
+        model = pipeline.fit(data([1.0, 2.0, 3.0]))
+        assert isinstance(model, PipelineModel)
+        # doubled: [2,4,6]; SumEstimator delta = 12
+        sum_model = model.stages[1]
+        assert isinstance(sum_model, SumModel)
+        assert sum_model.delta == 12.0
+
+    def test_model_transform_chains(self):
+        model = PipelineModel([DoubleTransformer(), SumModel(delta=10.0)])
+        out = model.transform(data([1.0, 2.0]))
+        assert out.scalars("input").tolist() == [12.0, 14.0]
+
+    def test_pipeline_with_trailing_estimator_output(self):
+        pipeline = Pipeline([SumEstimator()])
+        model = pipeline.fit(data([1.0, 2.0]))
+        out = model.transform(data([0.0]))
+        assert out.scalars("input").tolist() == [3.0]
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = PipelineModel([DoubleTransformer(), SumModel(delta=5.0)])
+        p = str(tmp_path / "pm")
+        model.save(p)
+        loaded = PipelineModel.load(p)
+        out = loaded.transform(data([1.0]))
+        assert out.scalars("input").tolist() == [7.0]
+
+    def test_pipeline_save_load(self, tmp_path):
+        pipeline = Pipeline([DoubleTransformer(), SumEstimator()])
+        p = str(tmp_path / "pl")
+        pipeline.save(p)
+        loaded = Pipeline.load(p)
+        assert len(loaded.stages) == 2
+        model = loaded.fit(data([1.0, 2.0, 3.0]))
+        assert model.stages[1].delta == 12.0
+
+    def test_generic_load_stage_dispatch(self, tmp_path):
+        # Ref ReadWriteUtils.loadStage:268 className dispatch.
+        m = SumModel(delta=3.0)
+        p = str(tmp_path / "m")
+        m.save(p)
+        loaded = rw.load_stage(p)
+        assert isinstance(loaded, SumModel)
+        assert loaded.delta == 3.0
+
+    def test_get_set_model_data(self):
+        model = PipelineModel([DoubleTransformer(), SumModel(delta=5.0)])
+        md = model.get_model_data()
+        assert len(md) == 1
+        model2 = PipelineModel([DoubleTransformer(), SumModel(delta=0.0)])
+        model2.set_model_data(*md)
+        assert model2.stages[1].delta == 5.0
+
+    def test_double_save_rejected(self, tmp_path):
+        m = SumModel(delta=1.0)
+        p = str(tmp_path / "m")
+        m.save(p)
+        try:
+            m.save(p)
+            assert False, "expected IOError"
+        except IOError:
+            pass
