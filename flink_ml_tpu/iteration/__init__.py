@@ -1,0 +1,51 @@
+"""L2 — the iteration runtime.
+
+Reference: ``flink-ml-iteration`` (~19.6k LoC, SURVEY.md §2.3) — epoch-tracked feedback
+edges grafted onto a streaming DAG: head/tail operators, a JobManager-side
+SharedProgressAligner, per-operator epoch-watermark trackers, wrapped operators, draft
+graph compilation, feedback-channel checkpointing.
+
+TPU-native collapse (SURVEY.md §7.3): a single-controller host loop driving jit-compiled
+SPMD programs **is already globally aligned** — every device finishes epoch N before the
+controller starts epoch N+1, so the entire alignment/watermark/coordinator machinery
+reduces to a ``for`` loop. What survives, because it is real semantics rather than
+plumbing:
+
+  - ``IterationBody`` / ``IterationBodyResult`` — the user contract (feedback variables,
+    outputs, termination criteria).
+  - ``IterationListener`` — per-epoch / termination callbacks (epoch watermarks).
+  - ``iterate_bounded_until_termination`` / ``iterate_unbounded`` — the two public
+    entry points (Iterations.java:123,149).
+  - Replay semantics (``ReplayableDataStreamList``) — whether the body sees the data
+    every epoch or only at epoch 0.
+  - The feedback edge — device arrays handed from one epoch to the next **without
+    leaving HBM** (the statefun FeedbackChannel becomes a variable rebind; zero-copy).
+  - Termination helpers ``TerminateOnMaxIter`` / ``TerminateOnMaxIterOrTol``.
+  - Checkpointing of iteration state (epoch counter + variables) for resume.
+"""
+from flink_ml_tpu.iteration.iteration import (
+    IterationBodyResult,
+    IterationConfig,
+    IterationListener,
+    Iterations,
+    iterate_bounded_until_termination,
+    iterate_unbounded,
+)
+from flink_ml_tpu.iteration.termination import (
+    TerminateOnMaxIter,
+    TerminateOnMaxIterOrTol,
+)
+from flink_ml_tpu.iteration.datacache import DeviceDataCache, HostDataCache
+
+__all__ = [
+    "IterationBodyResult",
+    "IterationConfig",
+    "IterationListener",
+    "Iterations",
+    "iterate_bounded_until_termination",
+    "iterate_unbounded",
+    "TerminateOnMaxIter",
+    "TerminateOnMaxIterOrTol",
+    "DeviceDataCache",
+    "HostDataCache",
+]

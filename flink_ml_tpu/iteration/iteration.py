@@ -1,0 +1,227 @@
+"""Iteration drivers: bounded-until-termination and unbounded.
+
+Reference: ``Iterations.java:109-526`` builds the cyclic graph (input/head/tail/output/
+replay operators, co-located head+tail per feedback edge, criteria stream); the runtime
+then aligns epochs across subtasks via SubtaskAlignedEvent → SharedProgressAligner →
+GloballyAlignedEvent (HeadOperator.java:325-357, SharedProgressAligner.java:127).
+
+Here the controller is the aligner. An epoch is one turn of the host loop; the feedback
+edge is the rebinding of ``variables`` to the body's returned feedback (device arrays
+stay in HBM — the analogue of the co-located in-memory FeedbackChannel,
+TailOperator.java:81-87); termination mirrors SharedProgressAligner.decide: stop when
+the criteria is exhausted or when the body produces no feedback.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+
+__all__ = [
+    "OperatorLifeCycle",
+    "IterationConfig",
+    "IterationBodyResult",
+    "IterationListener",
+    "Iterations",
+    "iterate_bounded_until_termination",
+    "iterate_unbounded",
+]
+
+
+class OperatorLifeCycle(enum.Enum):
+    """Ref IterationConfig.OperatorLifeCycle — ALL_ROUND keeps one operator instance
+    across epochs; PER_ROUND builds fresh per epoch (forEachRound). In the host-loop
+    world ALL_ROUND = state carried in ``variables``/closures, PER_ROUND = pure body."""
+
+    ALL_ROUND = "ALL_ROUND"
+    PER_ROUND = "PER_ROUND"
+
+
+@dataclasses.dataclass
+class IterationConfig:
+    """Ref IterationConfig.java."""
+
+    operator_life_cycle: OperatorLifeCycle = OperatorLifeCycle.ALL_ROUND
+    max_epochs: Optional[int] = None  # hard safety bound on top of criteria
+    checkpoint_interval: int = 0  # epochs between state snapshots; 0 = off
+    checkpoint_manager: Any = None  # flink_ml_tpu.checkpoint.CheckpointManager
+
+
+class _NoCriteria:
+    """Sentinel: the body declared no criteria stream."""
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return "NO_CRITERIA"
+
+
+NO_CRITERIA = _NoCriteria()
+
+
+@dataclasses.dataclass
+class IterationBodyResult:
+    """Ref IterationBodyResult.java — feedback streams + output streams + criteria.
+
+    ``feedback_variables``: new values for the iteration variables (same structure as
+    the body's input variables); ``None`` means "no feedback produced" which, like an
+    empty feedback stream in the reference, terminates the iteration.
+
+    ``termination_criteria``: anything truthy continues the iteration, anything falsy
+    stops it; leave at the default ``NO_CRITERIA`` for "no criteria stream" (terminate
+    only on empty feedback / max_epochs). A device scalar is allowed and fetched
+    lazily by the driver.
+    """
+
+    feedback_variables: Optional[Sequence[Any]]
+    outputs: Sequence[Any] = ()
+    termination_criteria: Any = NO_CRITERIA
+
+    @property
+    def has_criteria(self) -> bool:
+        return self.termination_criteria is not NO_CRITERIA
+
+
+class IterationListener:
+    """Ref IterationListener.java — epoch watermark callbacks.
+
+    Subclasses override either hook. ``epoch_watermark`` is the epoch that just
+    completed globally (0-based, same numbering as the reference's epoch watermarks).
+    """
+
+    def on_epoch_watermark_incremented(self, epoch_watermark: int, context: "IterationContext") -> None:
+        pass
+
+    def on_iteration_terminated(self, context: "IterationContext") -> None:
+        pass
+
+
+class IterationContext:
+    """Collector handed to listeners; ``output`` appends to the iteration outputs."""
+
+    def __init__(self):
+        self.collected: List[Any] = []
+
+    def output(self, value: Any) -> None:
+        self.collected.append(value)
+
+
+def _criteria_continues(criteria: Any) -> bool:
+    """Evaluate a termination criteria 'stream': truthy = keep iterating."""
+    if criteria is None:
+        return False
+    if isinstance(criteria, jax.Array):
+        criteria = jax.device_get(criteria)
+    return bool(criteria)
+
+
+def iterate_bounded_until_termination(
+    initial_variables: Sequence[Any],
+    body: Callable[..., IterationBodyResult],
+    config: Optional[IterationConfig] = None,
+    listeners: Sequence[IterationListener] = (),
+) -> List[Any]:
+    """Run ``body`` until termination; returns the final outputs.
+
+    Ref ``Iterations.iterateBoundedStreamsUntilTermination`` (Iterations.java:149):
+    terminates when the criteria stream is empty for an epoch, when no feedback is
+    produced, or at ``config.max_epochs``.
+
+    ``body(variables, epoch) -> IterationBodyResult``. Variables are pytrees (usually
+    device arrays); the driver rebinds them each epoch without copying off-device.
+    """
+    config = config or IterationConfig()
+    context = IterationContext()
+    variables = list(initial_variables)
+    outputs: List[Any] = []
+    epoch = 0
+
+    restored = _maybe_restore(config)
+    if restored is not None:
+        epoch, variables = restored
+
+    while True:
+        if config.max_epochs is not None and epoch >= config.max_epochs:
+            break
+        result = body(variables, epoch)
+        if result.outputs:
+            outputs = list(result.outputs)
+        for listener in listeners:
+            listener.on_epoch_watermark_incremented(epoch, context)
+        epoch += 1
+        if result.feedback_variables is None:
+            break
+        variables = list(result.feedback_variables)
+        if result.has_criteria and not _criteria_continues(result.termination_criteria):
+            break
+        _maybe_checkpoint(config, epoch, variables)
+
+    for listener in listeners:
+        listener.on_iteration_terminated(context)
+    return outputs + context.collected if context.collected else outputs
+
+
+def iterate_unbounded(
+    initial_variables: Sequence[Any],
+    stream,
+    body: Callable[..., IterationBodyResult],
+    config: Optional[IterationConfig] = None,
+    listeners: Sequence[IterationListener] = (),
+):
+    """Unbounded iteration: one epoch per arriving mini-batch, yielding outputs.
+
+    Ref ``Iterations.iterateUnboundedStreams`` (Iterations.java:123) — no termination
+    criteria; the iteration lives as long as the input stream. ``stream`` is any
+    iterator of batches (see ``flink_ml_tpu.iteration.stream``); ``body(variables,
+    batch, epoch)`` returns feedback + outputs, and outputs are yielded per epoch —
+    the model-as-stream semantics online algorithms need (OnlineLogisticRegression's
+    versioned model stream).
+    """
+    config = config or IterationConfig()
+    context = IterationContext()
+    variables = list(initial_variables)
+    epoch = 0
+    restored = _maybe_restore(config)
+    if restored is not None:
+        epoch, variables = restored
+
+    for batch in stream:
+        result = body(variables, batch, epoch)
+        for listener in listeners:
+            listener.on_epoch_watermark_incremented(epoch, context)
+        epoch += 1
+        for out in result.outputs:
+            yield out
+        while context.collected:
+            yield context.collected.pop(0)
+        if result.feedback_variables is None:
+            break
+        variables = list(result.feedback_variables)
+        _maybe_checkpoint(config, epoch, variables)
+
+    for listener in listeners:
+        listener.on_iteration_terminated(context)
+    while context.collected:
+        yield context.collected.pop(0)
+
+
+def _maybe_checkpoint(config: IterationConfig, epoch: int, variables) -> None:
+    mgr = config.checkpoint_manager
+    if mgr is None or not config.checkpoint_interval:
+        return
+    if epoch % config.checkpoint_interval == 0:
+        mgr.save(epoch, variables)
+
+
+def _maybe_restore(config: IterationConfig):
+    mgr = config.checkpoint_manager
+    if mgr is None:
+        return None
+    return mgr.restore_latest()
+
+
+class Iterations:
+    """Namespace parity with ``Iterations.java`` static API."""
+
+    iterate_bounded_streams_until_termination = staticmethod(iterate_bounded_until_termination)
+    iterate_unbounded_streams = staticmethod(iterate_unbounded)

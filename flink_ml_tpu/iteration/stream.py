@@ -1,0 +1,201 @@
+"""Mini-batch streams: the unbounded-input surface.
+
+Reference: ``DataStreamUtils.generateBatchData:734`` (online minibatching: each subtask
+collects globalBatchSize/parallelism records then emits a batch) and the
+``HasWindows``/``Windows`` descriptors (``common/window/Windows.java``) that slice an
+unbounded stream into training windows; ``EndOfStreamWindows.java:36`` = one window.
+
+TPU mapping (SURVEY.md §5.7): **a window is one device step.** A ``BatchStream`` is any
+iterator of columnar batches (dict name → host array). ``window_stream`` applies a
+``Windows`` descriptor to a source iterator; ``batch_stream_from_dataframe`` adapts a
+bounded DataFrame. Online estimators consume these through ``iterate_unbounded``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.ops.windows import (
+    CountTumblingWindows,
+    EventTimeSessionWindows,
+    EventTimeTumblingWindows,
+    GlobalWindows,
+    ProcessingTimeSessionWindows,
+    ProcessingTimeTumblingWindows,
+    Windows,
+)
+
+__all__ = [
+    "Batch",
+    "batch_stream_from_dataframe",
+    "window_stream",
+    "rebatch",
+]
+
+Batch = Dict[str, np.ndarray]
+
+
+def _df_to_columns(df: DataFrame, columns: Optional[Sequence[str]] = None) -> Batch:
+    names = columns if columns is not None else df.get_column_names()
+    out: Batch = {}
+    for n in names:
+        col = df.column(n)
+        out[n] = col if isinstance(col, np.ndarray) else np.asarray(col, dtype=object)
+    return out
+
+
+def _batch_len(batch: Batch) -> int:
+    return next(iter(batch.values())).shape[0]
+
+
+def _slice(batch: Batch, lo: int, hi: int) -> Batch:
+    return {k: v[lo:hi] for k, v in batch.items()}
+
+
+def batch_stream_from_dataframe(
+    df: DataFrame,
+    batch_size: Optional[int] = None,
+    columns: Optional[Sequence[str]] = None,
+) -> Iterator[Batch]:
+    """Bounded DataFrame → stream of columnar batches (whole frame if no size)."""
+    cols = _df_to_columns(df, columns)
+    n = _batch_len(cols) if cols else 0
+    if batch_size is None or batch_size >= n:
+        if n:
+            yield cols
+        return
+    for lo in range(0, n, batch_size):
+        yield _slice(cols, lo, min(lo + batch_size, n))
+
+
+def rebatch(stream: Iterable[Batch], batch_size: int, drop_last: bool = False) -> Iterator[Batch]:
+    """Re-chunk an arbitrary batch stream to fixed ``batch_size`` rows.
+
+    The ``generateBatchData:734`` analogue: accumulate until a full global batch is
+    available, then emit exactly one window.
+    """
+    pending: List[Batch] = []
+    pending_rows = 0
+    for batch in stream:
+        pending.append(batch)
+        pending_rows += _batch_len(batch)
+        while pending_rows >= batch_size:
+            taken: Dict[str, List[np.ndarray]] = {}
+            need = batch_size
+            rest: List[Batch] = []
+            for chunk in pending:
+                n = _batch_len(chunk)
+                if need == 0:
+                    rest.append(chunk)
+                    continue
+                use = min(need, n)
+                for k, v in chunk.items():
+                    taken.setdefault(k, []).append(v[:use])
+                if use < n:
+                    rest.append(_slice(chunk, use, n))
+                need -= use
+            pending = rest
+            pending_rows -= batch_size
+            yield {k: np.concatenate(v) if len(v) > 1 else v[0] for k, v in taken.items()}
+    if pending_rows and not drop_last:
+        taken = {}
+        for chunk in pending:
+            for k, v in chunk.items():
+                taken.setdefault(k, []).append(v)
+        yield {k: np.concatenate(v) if len(v) > 1 else v[0] for k, v in taken.items()}
+
+
+def window_stream(
+    stream: Iterable[Batch],
+    windows: Windows,
+    timestamp_column: Optional[str] = None,
+    now: Optional[Callable[[], float]] = None,
+) -> Iterator[Batch]:
+    """Apply a ``Windows`` descriptor to a batch stream.
+
+    - GlobalWindows: one window at end of stream.
+    - CountTumblingWindows(size): ``rebatch(stream, size, drop_last=True)`` — the
+      reference's count window drops the trailing partial window.
+    - EventTimeTumblingWindows(size_ms): group rows by timestamp_column // size_ms;
+      windows emit in order as their boundary passes (stream assumed time-ordered,
+      as the reference assumes watermarked order).
+    - ProcessingTime windows: same mechanics using arrival time (``now()``).
+    - Session windows: a gap > gap_ms between consecutive timestamps closes a window.
+    """
+    if isinstance(windows, GlobalWindows):
+        chunks: List[Batch] = [b for b in stream if _batch_len(b)]
+        if chunks:
+            keys = chunks[0].keys()
+            yield {k: np.concatenate([c[k] for c in chunks]) for k in keys}
+        return
+
+    if isinstance(windows, CountTumblingWindows):
+        yield from rebatch(stream, windows.size, drop_last=True)
+        return
+
+    if isinstance(windows, (EventTimeTumblingWindows, ProcessingTimeTumblingWindows)):
+        size = windows.size_ms
+        get_ts = _timestamp_getter(windows, timestamp_column, now)
+        current_id: Optional[int] = None
+        pending: List[Batch] = []
+        for batch in stream:
+            ts = get_ts(batch)
+            ids = (ts // size).astype(np.int64)
+            for wid in np.unique(ids):
+                sel = ids == wid
+                part = {k: v[sel] for k, v in batch.items()}
+                if current_id is None:
+                    current_id = int(wid)
+                if int(wid) != current_id:
+                    yield _concat(pending)
+                    pending = []
+                    current_id = int(wid)
+                pending.append(part)
+        if pending:
+            yield _concat(pending)
+        return
+
+    if isinstance(windows, (EventTimeSessionWindows, ProcessingTimeSessionWindows)):
+        gap = windows.gap_ms
+        get_ts = _timestamp_getter(windows, timestamp_column, now)
+        pending = []
+        last_ts: Optional[float] = None
+        for batch in stream:
+            ts = get_ts(batch)
+            start = 0
+            for i in range(len(ts)):
+                if last_ts is not None and ts[i] - last_ts > gap:
+                    part = _slice(batch, start, i)
+                    if _batch_len(part):
+                        pending.append(part)
+                    if pending:
+                        yield _concat(pending)
+                    pending = []
+                    start = i
+                last_ts = float(ts[i])
+            part = _slice(batch, start, len(ts))
+            if _batch_len(part):
+                pending.append(part)
+        if pending:
+            yield _concat(pending)
+        return
+
+    raise ValueError(f"Unsupported windows descriptor: {windows!r}")
+
+
+def _timestamp_getter(windows, timestamp_column, now):
+    if isinstance(windows, (EventTimeTumblingWindows, EventTimeSessionWindows)):
+        if not timestamp_column:
+            raise ValueError("event-time windows need a timestamp_column")
+        return lambda batch: np.asarray(batch[timestamp_column], np.float64)
+    import time as _time
+
+    clock = now or (lambda: _time.time() * 1000.0)
+    return lambda batch: np.full(_batch_len(batch), clock(), np.float64)
+
+
+def _concat(chunks: List[Batch]) -> Batch:
+    keys = chunks[0].keys()
+    return {k: np.concatenate([c[k] for c in chunks]) for k in keys}

@@ -1,0 +1,53 @@
+"""Termination-criteria helpers.
+
+Reference: ``flink-ml-core/.../common/iteration/`` — ``TerminateOnMaxIter.java:34``
+(emit a record for rounds 0..maxIter-1; empty stream thereafter terminates),
+``TerminateOnMaxIterOrTol.java:34`` (also stop when loss < tol),
+``ForwardInputsOfLastRound.java:34`` (buffer inputs, emit at termination — in the
+host-loop world this is simply "return the final variables as outputs", so it needs no
+class here).
+
+These are callables producing the ``termination_criteria`` value for an
+``IterationBodyResult``: truthy = continue.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["TerminateOnMaxIter", "TerminateOnMaxIterOrTol"]
+
+
+class TerminateOnMaxIter:
+    """Continue while ``epoch + 1 < max_iter`` (reference emits for rounds < maxIter;
+    the round that consumes the last record is the final one)."""
+
+    def __init__(self, max_iter: int):
+        self.max_iter = max_iter
+
+    def __call__(self, epoch: int, loss: Any = None) -> bool:
+        return epoch + 1 < self.max_iter
+
+
+class TerminateOnMaxIterOrTol:
+    """Continue while epoch budget remains AND loss >= tol.
+
+    ``loss`` may be a device scalar; it is fetched only when tol is finite so the
+    fast path (tol = -inf/None) never synchronizes the device pipeline.
+    """
+
+    def __init__(self, max_iter: Optional[int] = None, tol: Optional[float] = None):
+        self.max_iter = math.inf if max_iter is None else max_iter
+        self.tol = -math.inf if tol is None else tol
+
+    def __call__(self, epoch: int, loss: Any = None) -> bool:
+        if epoch + 1 >= self.max_iter:
+            return False
+        if loss is not None and self.tol > -math.inf:
+            if isinstance(loss, jax.Array):
+                loss = float(jax.device_get(loss))
+            if loss < self.tol:
+                return False
+        return True

@@ -1,0 +1,34 @@
+"""Distributed substrate: device mesh, shardings, collectives.
+
+This package replaces the reference's entire communication stack (SURVEY.md §2.9/§5.8):
+Flink's Netty network shuffles + ``AllReduceImpl``'s 3-stage chunked dataflow become XLA
+collectives over the ICI mesh, and the broadcast-variable machinery becomes replicated
+shardings. There is no hand-written transport: the XLA runtime is the native backend.
+"""
+from flink_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshContext,
+    get_mesh_context,
+    set_mesh_context,
+    mesh_context,
+)
+from flink_ml_tpu.parallel.collectives import (
+    all_reduce_sum,
+    all_reduce_mean,
+    psum_tree,
+    shard_batch_spec,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "MeshContext",
+    "get_mesh_context",
+    "set_mesh_context",
+    "mesh_context",
+    "all_reduce_sum",
+    "all_reduce_mean",
+    "psum_tree",
+    "shard_batch_spec",
+]

@@ -1,0 +1,153 @@
+"""Device-mesh management: the framework's "cluster".
+
+Reference mapping (SURVEY.md §2.9): a Flink cluster is JobManager + TaskManager slots and
+the parallelism of a job is its slot count; here the "cluster" is a
+``jax.sharding.Mesh`` over TPU chips and the parallelism is the mesh's ``data`` axis
+size. The single-controller Python process plays the JobManager role (globally aligned
+by construction — the whole SharedProgressAligner/epoch-watermark machinery of
+``flink-ml-iteration`` collapses, see SURVEY.md §7.3); SPMD programs under ``jit`` play
+the TaskManager role.
+
+Axes:
+  - ``data``  — batch (data-parallel) axis; every algorithm shards its input batch here.
+    The analogue of ``rebalance()`` partitioning in the reference (SGD.java:90).
+  - ``model`` — optional second axis for sharding very wide coefficient vectors /
+    expert dims (tensor parallelism). Size 1 by default.
+
+The mesh is process-global state (like the reference's StreamExecutionEnvironment),
+managed via ``set_mesh_context``/``get_mesh_context`` or the ``mesh_context`` context
+manager. Multi-host: construct with ``jax.devices()`` spanning hosts and identical code
+runs SPMD over ICI/DCN — collectives are inserted by XLA from the sharding annotations.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "MeshContext",
+    "get_mesh_context",
+    "set_mesh_context",
+    "mesh_context",
+]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_lock = threading.Lock()
+_current: Optional["MeshContext"] = None
+
+
+class MeshContext:
+    """A device mesh plus the sharding vocabulary every algorithm uses.
+
+    ``n_data`` × ``n_model`` device grid. All helpers return ``NamedSharding``s bound to
+    this mesh, so jit'd programs get their collectives from XLA's SPMD partitioner.
+    """
+
+    def __init__(
+        self,
+        devices: Optional[Sequence[Any]] = None,
+        n_data: Optional[int] = None,
+        n_model: int = 1,
+    ):
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        if n_data is None:
+            n_data = len(devices) // n_model
+        if n_data * n_model > len(devices):
+            raise ValueError(
+                f"mesh {n_data}x{n_model} needs {n_data * n_model} devices, "
+                f"got {len(devices)}"
+            )
+        grid = np.asarray(devices[: n_data * n_model]).reshape(n_data, n_model)
+        self.mesh = Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+        self.n_data = n_data
+        self.n_model = n_model
+
+    # --- sharding vocabulary -------------------------------------------------
+    @property
+    def replicated(self) -> NamedSharding:
+        """Model/broadcast sharding — every device holds a full copy.
+
+        The analogue of ``.broadcast()`` + BroadcastUtils variables (SGD.java:89,
+        KMeans.java:154): instead of shipping the model over the network each epoch,
+        it is laid out replicated and XLA keeps the copies coherent."""
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def batch(self) -> NamedSharding:
+        """Leading-dim sharded over ``data`` — for [n, ...] batches."""
+        return NamedSharding(self.mesh, P(DATA_AXIS))
+
+    @property
+    def model_dim(self) -> NamedSharding:
+        """Leading-dim sharded over ``model`` — for very wide coefficients (TP)."""
+        return NamedSharding(self.mesh, P(MODEL_AXIS))
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    # --- data placement ------------------------------------------------------
+    def pad_batch(self, n: int) -> int:
+        """Rows of padding needed to make ``n`` divisible by the data-axis size."""
+        r = n % self.n_data
+        return 0 if r == 0 else self.n_data - r
+
+    def shard_batch(self, array, pad_value=0.0) -> Tuple[jax.Array, int]:
+        """Place a host [n, ...] array onto the mesh sharded over ``data``.
+
+        Pads the batch to a multiple of the data-axis size (XLA requires even
+        shards); returns (device_array, n_valid). Callers carry ``n_valid`` (or a
+        weight column zeroed on padding) so padded rows never affect results — the
+        moral equivalent of the reference's per-partition record counts.
+        """
+        array = np.asarray(array)
+        pad = self.pad_batch(array.shape[0])
+        if pad:
+            array = np.concatenate(
+                [array, np.full((pad,) + array.shape[1:], pad_value, array.dtype)]
+            )
+        return jax.device_put(array, self.batch), array.shape[0] - pad
+
+    def replicate(self, array) -> jax.Array:
+        return jax.device_put(array, self.replicated)
+
+    def __repr__(self) -> str:
+        return f"MeshContext(data={self.n_data}, model={self.n_model})"
+
+
+def get_mesh_context() -> MeshContext:
+    """The process-global mesh; lazily created over all visible devices."""
+    global _current
+    with _lock:
+        if _current is None:
+            _current = MeshContext()
+        return _current
+
+
+def set_mesh_context(ctx: Optional[MeshContext]) -> None:
+    global _current
+    with _lock:
+        _current = ctx
+
+
+@contextlib.contextmanager
+def mesh_context(ctx: MeshContext):
+    """Temporarily install ``ctx`` as the global mesh (tests, multi-mesh programs)."""
+    global _current
+    with _lock:
+        prev, _current = _current, ctx
+    try:
+        yield ctx
+    finally:
+        with _lock:
+            _current = prev

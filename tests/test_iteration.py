@@ -1,0 +1,167 @@
+"""Iteration-runtime tests.
+
+Parity targets (SURVEY.md §4): ``BoundedAllRoundStreamIterationITCase`` /
+``UnboundedStreamIterationITCase`` semantics — epoch counting, criteria-driven
+termination, listener callbacks, feedback of device arrays — plus datacache tests
+(``DataCacheWriter``/``DataCacheSnapshot``) and window/stream slicing.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.iteration import (
+    DeviceDataCache,
+    HostDataCache,
+    IterationBodyResult,
+    IterationConfig,
+    IterationListener,
+    TerminateOnMaxIter,
+    TerminateOnMaxIterOrTol,
+    iterate_bounded_until_termination,
+    iterate_unbounded,
+)
+from flink_ml_tpu.iteration.stream import rebatch, window_stream
+from flink_ml_tpu.ops.windows import CountTumblingWindows, EventTimeTumblingWindows, GlobalWindows
+from flink_ml_tpu.parallel import MeshContext
+
+
+class _EpochRecorder(IterationListener):
+    def __init__(self):
+        self.epochs = []
+        self.terminated = False
+
+    def on_epoch_watermark_incremented(self, epoch, context):
+        self.epochs.append(epoch)
+
+    def on_iteration_terminated(self, context):
+        self.terminated = True
+
+
+def test_bounded_iteration_max_iter_criteria():
+    """x <- x + 1 for exactly max_iter epochs (TerminateOnMaxIter semantics)."""
+    crit = TerminateOnMaxIter(5)
+    rec = _EpochRecorder()
+
+    def body(variables, epoch):
+        (x,) = variables
+        x = x + 1.0
+        return IterationBodyResult([x], outputs=[x], termination_criteria=crit(epoch))
+
+    outs = iterate_bounded_until_termination([jnp.zeros(())], body, listeners=[rec])
+    assert rec.epochs == [0, 1, 2, 3, 4]
+    assert rec.terminated
+    assert float(outs[0]) == 5.0
+
+
+def test_bounded_iteration_tol_criteria():
+    """Terminates early when loss drops below tol (TerminateOnMaxIterOrTol.java:34)."""
+    crit = TerminateOnMaxIterOrTol(max_iter=100, tol=0.1)
+
+    def body(variables, epoch):
+        (x,) = variables
+        x = x * 0.5
+        return IterationBodyResult(
+            [x], outputs=[x], termination_criteria=crit(epoch, loss=x)
+        )
+
+    outs = iterate_bounded_until_termination([jnp.asarray(1.0)], body)
+    assert float(outs[0]) < 0.1
+    # 1.0 * 0.5^4 = 0.0625 is the first value < 0.1
+    assert float(outs[0]) == 0.0625
+
+
+def test_bounded_iteration_empty_feedback_terminates():
+    def body(variables, epoch):
+        if epoch >= 2:
+            return IterationBodyResult(None, outputs=[epoch])
+        return IterationBodyResult([variables[0]], outputs=[epoch])
+
+    outs = iterate_bounded_until_termination([0], body)
+    assert outs == [2]
+
+
+def test_bounded_iteration_max_epochs_safety_bound():
+    def body(variables, epoch):
+        return IterationBodyResult([variables[0] + 1])
+
+    config = IterationConfig(max_epochs=3)
+    iterate_bounded_until_termination([0], body, config=config)  # must not hang
+
+
+def test_unbounded_iteration_yields_per_batch():
+    """Model-as-stream: one output per arriving window (UnboundedStreamIterationITCase)."""
+    batches = [{"x": np.full(4, float(i))} for i in range(3)]
+
+    def body(variables, batch, epoch):
+        (total,) = variables
+        total = total + batch["x"].sum()
+        return IterationBodyResult([total], outputs=[float(total)])
+
+    outs = list(iterate_unbounded([0.0], iter(batches), body))
+    assert outs == [0.0, 4.0, 12.0]
+
+
+# --- data caches -------------------------------------------------------------
+
+
+def test_device_data_cache_shards_and_masks():
+    ctx = MeshContext(n_data=8)
+    cache = DeviceDataCache({"x": np.arange(10.0)[:, None]}, ctx=ctx)
+    assert cache.n_valid == 10
+    assert cache.n_padded == 16
+    assert cache.local_rows == 2
+    mask = np.asarray(cache.mask)
+    assert mask.sum() == 10.0
+
+
+def test_host_data_cache_rebatch_and_snapshot(tmp_path):
+    cache = HostDataCache(memory_budget_bytes=200, spill_dir=str(tmp_path / "spill"))
+    for i in range(5):
+        cache.append({"x": np.full(7, i, np.float64), "y": np.arange(7.0) + i})
+    cache.finish()
+    assert cache.num_rows == 35
+    batches = list(cache.iter_minibatches(batch_size=10))
+    assert [len(b["x"]) for b in batches] == [10, 10, 10, 5]
+    np.testing.assert_array_equal(
+        np.concatenate([b["x"] for b in batches]),
+        np.concatenate([np.full(7, i) for i in range(5)]),
+    )
+    # snapshot round-trip (DataCacheSnapshot.writeTo/recover)
+    snap = str(tmp_path / "snap")
+    cache.snapshot(snap)
+    recovered = HostDataCache.recover(snap)
+    assert recovered.num_rows == 35
+    np.testing.assert_array_equal(
+        np.concatenate([b["y"] for b in recovered.iter_minibatches(35)]),
+        np.concatenate([b["y"] for b in cache.iter_minibatches(35)]),
+    )
+
+
+# --- streams / windows -------------------------------------------------------
+
+
+def test_rebatch_exact_sizes():
+    stream = [{"x": np.arange(i, i + 3, dtype=np.float64)} for i in range(0, 12, 3)]
+    out = list(rebatch(iter(stream), 5))
+    assert [len(b["x"]) for b in out] == [5, 5, 2]
+    np.testing.assert_array_equal(
+        np.concatenate([b["x"] for b in out]),
+        np.concatenate([b["x"] for b in stream]),
+    )
+
+
+def test_count_tumbling_windows_drop_partial():
+    stream = [{"x": np.arange(10.0)}]
+    out = list(window_stream(iter(stream), CountTumblingWindows.of(4)))
+    assert [len(b["x"]) for b in out] == [4, 4]
+
+
+def test_global_windows_single_window():
+    stream = [{"x": np.arange(3.0)}, {"x": np.arange(2.0)}]
+    out = list(window_stream(iter(stream), GlobalWindows.get_instance()))
+    assert len(out) == 1 and len(out[0]["x"]) == 5
+
+
+def test_event_time_tumbling_windows():
+    stream = [{"t": np.array([0, 5, 10, 15, 25], np.float64), "x": np.arange(5.0)}]
+    out = list(window_stream(iter(stream), EventTimeTumblingWindows.of(10), timestamp_column="t"))
+    assert [list(b["x"]) for b in out] == [[0.0, 1.0], [2.0, 3.0], [4.0]]
