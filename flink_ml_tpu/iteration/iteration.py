@@ -41,12 +41,23 @@ class OperatorLifeCycle(enum.Enum):
 
 @dataclasses.dataclass
 class IterationConfig:
-    """Ref IterationConfig.java."""
+    """Ref IterationConfig.java.
+
+    ``pipeline_depth``: max epochs dispatched to the devices ahead of completion.
+    ``None`` = auto: 1 on the CPU backend, 8 otherwise; ``0`` = unbounded (no
+    throttling). On TPU, dispatching ahead
+    keeps the device busy while the host runs the next loop turn; on the
+    virtual-device CPU backend, concurrently in-flight programs that contain
+    collectives starve each other's all-reduce rendezvous (XLA CPU runs one
+    thread per virtual device from a shared pool — observed 40s rendezvous
+    timeout aborts with 8 devices on 1 core), so dispatch must be serialized.
+    """
 
     operator_life_cycle: OperatorLifeCycle = OperatorLifeCycle.ALL_ROUND
     max_epochs: Optional[int] = None  # hard safety bound on top of criteria
     checkpoint_interval: int = 0  # epochs between state snapshots; 0 = off
     checkpoint_manager: Any = None  # flink_ml_tpu.checkpoint.CheckpointManager
+    pipeline_depth: Optional[int] = None
 
 
 class _NoCriteria:
@@ -106,6 +117,23 @@ class IterationContext:
         self.collected.append(value)
 
 
+class _PipelineThrottle:
+    """Bounds the number of epochs in flight on the devices (see IterationConfig)."""
+
+    def __init__(self, depth: Optional[int]):
+        if depth is None:
+            depth = 1 if jax.default_backend() == "cpu" else 8
+        self.depth = depth  # 0 = unbounded
+        self._inflight: List[Any] = []
+
+    def admit(self, variables) -> None:
+        if self.depth <= 0:
+            return
+        self._inflight.append(variables)
+        if len(self._inflight) >= self.depth:
+            jax.block_until_ready(self._inflight.pop(0))
+
+
 def _criteria_continues(criteria: Any) -> bool:
     """Evaluate a termination criteria 'stream': truthy = keep iterating."""
     if criteria is None:
@@ -132,6 +160,7 @@ def iterate_bounded_until_termination(
     """
     config = config or IterationConfig()
     context = IterationContext()
+    throttle = _PipelineThrottle(config.pipeline_depth)
     variables = list(initial_variables)
     outputs: List[Any] = []
     epoch = 0
@@ -152,6 +181,7 @@ def iterate_bounded_until_termination(
         if result.feedback_variables is None:
             break
         variables = list(result.feedback_variables)
+        throttle.admit(variables)
         if result.has_criteria and not _criteria_continues(result.termination_criteria):
             break
         _maybe_checkpoint(config, epoch, variables)
@@ -179,6 +209,7 @@ def iterate_unbounded(
     """
     config = config or IterationConfig()
     context = IterationContext()
+    throttle = _PipelineThrottle(config.pipeline_depth)
     variables = list(initial_variables)
     epoch = 0
     restored = _maybe_restore(config)
@@ -197,6 +228,7 @@ def iterate_unbounded(
         if result.feedback_variables is None:
             break
         variables = list(result.feedback_variables)
+        throttle.admit(variables)
         _maybe_checkpoint(config, epoch, variables)
 
     for listener in listeners:

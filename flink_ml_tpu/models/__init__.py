@@ -1,0 +1,32 @@
+"""L4 — the algorithm library.
+
+Reference: ``flink-ml-lib`` (48 Stage implementations, SURVEY.md §2.5). Mirrors the
+reference's package-per-group layout: ``classification``, ``clustering``, ``feature``,
+``regression``, ``evaluation``, ``stats``, ``recommendation``.
+
+``STAGE_REGISTRY`` maps public stage name → dotted class path. It is the single
+source of truth for persistence dispatch and for the completeness test (the analogue
+of the reference's ``test_ml_lib_completeness.py:31``): every stage the framework
+claims must be importable from here.
+"""
+import importlib
+
+STAGE_REGISTRY = {
+    # classification
+    "LogisticRegression": "flink_ml_tpu.models.classification.logistic_regression.LogisticRegression",
+    "LogisticRegressionModel": "flink_ml_tpu.models.classification.logistic_regression.LogisticRegressionModel",
+    "LinearSVC": "flink_ml_tpu.models.classification.linearsvc.LinearSVC",
+    "LinearSVCModel": "flink_ml_tpu.models.classification.linearsvc.LinearSVCModel",
+    # clustering
+    "KMeans": "flink_ml_tpu.models.clustering.kmeans.KMeans",
+    "KMeansModel": "flink_ml_tpu.models.clustering.kmeans.KMeansModel",
+    # regression
+    "LinearRegression": "flink_ml_tpu.models.regression.linear_regression.LinearRegression",
+    "LinearRegressionModel": "flink_ml_tpu.models.regression.linear_regression.LinearRegressionModel",
+}
+
+
+def get_stage_class(name: str):
+    dotted = STAGE_REGISTRY[name]
+    module_name, _, cls_name = dotted.rpartition(".")
+    return getattr(importlib.import_module(module_name), cls_name)

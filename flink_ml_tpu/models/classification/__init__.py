@@ -1,0 +1,1 @@
+"""Classification algorithms. Ref flink-ml-lib/.../ml/classification/."""

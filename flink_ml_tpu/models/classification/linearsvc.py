@@ -1,0 +1,84 @@
+"""Linear support vector classifier.
+
+Reference: ``flink-ml-lib/.../classification/linearsvc/`` — ``LinearSVC.java`` (fit =
+SGD + HingeLoss), ``LinearSVCModel.java:177-180`` (prediction = dot ≥ threshold,
+rawPrediction = [dot, −dot]), ``LinearSVCModelParams`` (threshold, default 0.0).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.models.linear import LinearEstimatorBase, LinearModelBase
+from flink_ml_tpu.ops.lossfunc import HingeLoss
+from flink_ml_tpu.params.param import FloatParam, WithParams
+from flink_ml_tpu.params.shared import HasRawPredictionCol
+
+__all__ = ["LinearSVC", "LinearSVCModel"]
+
+
+class HasThreshold(WithParams):
+    """Ref LinearSVCModelParams.THRESHOLD."""
+
+    THRESHOLD = FloatParam(
+        "threshold",
+        "Threshold in binary classification applied to the raw prediction.",
+        0.0,
+    )
+
+    def get_threshold(self) -> float:
+        return self.get(self.THRESHOLD)
+
+    def set_threshold(self, value: float):
+        return self.set(self.THRESHOLD, value)
+
+
+@functools.cache
+def _predict_kernel():
+    @jax.jit
+    def kernel(X, coef, threshold):
+        dots = X @ coef
+        pred = (dots >= threshold).astype(dots.dtype)
+        raw = jnp.stack([dots, -dots], axis=1)
+        return pred, raw
+
+    return kernel
+
+
+class LinearSVCModel(LinearModelBase, HasRawPredictionCol, HasThreshold):
+    """Ref LinearSVCModel.java."""
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float32)
+        pred, raw = _predict_kernel()(
+            X,
+            jnp.asarray(self.coefficient, jnp.float32),
+            jnp.asarray(self.get_threshold(), jnp.float32),
+        )
+        out = df.clone()
+        out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))
+        out.add_column(
+            self.get_raw_prediction_col(),
+            DataTypes.vector(BasicType.DOUBLE),
+            np.asarray(raw, np.float64),
+        )
+        return out
+
+
+class LinearSVC(LinearEstimatorBase, HasRawPredictionCol, HasThreshold):
+    """Ref LinearSVC.java."""
+
+    _LOSS = HingeLoss.INSTANCE
+    _MODEL_CLASS = LinearSVCModel
+
+    def _validate_labels(self, labels: np.ndarray) -> None:
+        uniques = np.unique(labels)
+        if not np.all(np.isin(uniques, [0.0, 1.0])):
+            raise ValueError(
+                f"LinearSVC requires binary labels in {{0, 1}}, got {uniques[:10]}"
+            )

@@ -1,0 +1,70 @@
+"""Logistic regression.
+
+Reference: ``flink-ml-lib/.../classification/logisticregression/`` —
+``LogisticRegression.java:60-124`` (fit = SGD + BinaryLogisticLoss),
+``LogisticRegressionModel.java`` / ``LogisticRegressionModelServable.java:62``
+(prediction = dot ≥ 0, rawPrediction = [1−p, p] with p = sigmoid(dot)),
+``LogisticRegressionModelData`` (one coefficient vector).
+
+Labels must be {0, 1} (binomial; the reference's ``multiClass`` param only supports
+"auto"/"binomial" in practice). Training runs the distributed SGD of
+``ops/optimizer.py``; inference is one jit'd matmul + sigmoid over the whole batch.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.models.linear import LinearEstimatorBase, LinearModelBase
+from flink_ml_tpu.ops.lossfunc import BinaryLogisticLoss
+from flink_ml_tpu.params.shared import HasMultiClass, HasRawPredictionCol
+
+__all__ = ["LogisticRegression", "LogisticRegressionModel"]
+
+
+@functools.cache
+def _predict_kernel():
+    @jax.jit
+    def kernel(X, coef):
+        dots = X @ coef
+        prob = jax.nn.sigmoid(dots)
+        pred = (dots >= 0).astype(dots.dtype)
+        raw = jnp.stack([1.0 - prob, prob], axis=1)
+        return pred, raw
+
+    return kernel
+
+
+class LogisticRegressionModel(LinearModelBase, HasRawPredictionCol, HasMultiClass):
+    """Ref LogisticRegressionModel.java."""
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float32)
+        pred, raw = _predict_kernel()(X, jnp.asarray(self.coefficient, jnp.float32))
+        out = df.clone()
+        out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))
+        out.add_column(
+            self.get_raw_prediction_col(),
+            DataTypes.vector(BasicType.DOUBLE),
+            np.asarray(raw, np.float64),
+        )
+        return out
+
+
+class LogisticRegression(LinearEstimatorBase, HasRawPredictionCol, HasMultiClass):
+    """Ref LogisticRegression.java:106-115."""
+
+    _LOSS = BinaryLogisticLoss.INSTANCE
+    _MODEL_CLASS = LogisticRegressionModel
+
+    def _validate_labels(self, labels: np.ndarray) -> None:
+        uniques = np.unique(labels)
+        if not np.all(np.isin(uniques, [0.0, 1.0])):
+            raise ValueError(
+                f"LogisticRegression requires binary labels in {{0, 1}}, got {uniques[:10]}"
+            )

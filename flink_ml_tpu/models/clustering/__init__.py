@@ -1,0 +1,1 @@
+"""Clustering algorithms. Ref flink-ml-lib/.../ml/clustering/."""

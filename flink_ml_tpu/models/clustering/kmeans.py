@@ -1,0 +1,160 @@
+"""K-means clustering.
+
+Reference: ``flink-ml-lib/.../clustering/kmeans/KMeans.java:87-183`` — random-sample
+init (:96), per epoch broadcast centroids → per-partition assign + partial sums
+(``CentroidsUpdateAccumulator:214``, points cached in ListStateWithCache:224) →
+``countWindowAll(p).reduce`` (:168) → new centroids = sum/count with per-centroid
+counts as model weights (``ModelDataGenerator``), ``TerminateOnMaxIter``;
+``KMeansModelData`` = centroids[] + weights; ``KMeansModel`` predicts the closest
+centroid index.
+
+TPU-native: points live sharded in HBM (DeviceDataCache), centroids replicated; one
+epoch is one jit'd SPMD program — pairwise distances ([n,d]×[d,k] MXU matmul for
+euclidean/cosine), argmin assignment, and the partial-sum reduce expressed as
+``one_hot(assign).T @ points``, another matmul whose cross-shard sum XLA turns into
+the psum that replaces the reference's countWindowAll shuffle.
+
+Deviation: a centroid with zero assigned points keeps its previous position (the
+reference divides by zero yielding non-finite centroids; keeping the centroid is the
+standard fix and never changes results when all clusters stay populated).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.iteration import (
+    DeviceDataCache,
+    IterationBodyResult,
+    TerminateOnMaxIter,
+    iterate_bounded_until_termination,
+)
+from flink_ml_tpu.models.common import ModelArraysMixin
+from flink_ml_tpu.ops.distance import DistanceMeasure
+from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam, WithParams, update_existing_params
+from flink_ml_tpu.params.shared import (
+    HasDistanceMeasure,
+    HasFeaturesCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasSeed,
+)
+from flink_ml_tpu.parallel.mesh import get_mesh_context
+
+__all__ = ["KMeans", "KMeansModel"]
+
+
+class HasK(WithParams):
+    """Ref KMeansModelParams.K — number of clusters, default 2."""
+
+    K = IntParam("k", "The max number of clusters to create.", 2, ParamValidators.gt(1))
+
+    def get_k(self) -> int:
+        return self.get(self.K)
+
+    def set_k(self, value: int):
+        return self.set(self.K, value)
+
+
+@functools.cache
+def _train_step(measure_name: str, k: int):
+    measure = DistanceMeasure.get_instance(measure_name)
+
+    @jax.jit
+    def step(centroids, X, mask):
+        assign = measure.find_closest(X, centroids)
+        hot = jax.nn.one_hot(assign, k, dtype=X.dtype) * mask[:, None]
+        sums = hot.T @ X  # [k, d]; cross-shard reduce inserted by XLA
+        counts = jnp.sum(hot, axis=0)  # [k]
+        safe = jnp.maximum(counts, 1.0)[:, None]
+        new_centroids = jnp.where(counts[:, None] > 0, sums / safe, centroids)
+        return new_centroids, counts
+
+    return step
+
+
+@functools.cache
+def _predict_step(measure_name: str):
+    measure = DistanceMeasure.get_instance(measure_name)
+    return jax.jit(lambda X, centroids: measure.find_closest(X, centroids))
+
+
+class KMeansModel(ModelArraysMixin, Model, HasFeaturesCol, HasPredictionCol, HasDistanceMeasure, HasK):
+    """Ref KMeansModel.java — prediction = index of closest centroid."""
+
+    _MODEL_ARRAY_NAMES = ("centroids", "weights")
+
+    def __init__(self):
+        super().__init__()
+        self.centroids = None  # [k, d]
+        self.weights = None  # [k]
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float32)
+        pred = _predict_step(self.get_distance_measure())(
+            X, jnp.asarray(self.centroids, jnp.float32)
+        )
+        out = df.clone()
+        out.add_column(
+            self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64)
+        )
+        return out
+
+
+class KMeans(
+    Estimator, HasFeaturesCol, HasPredictionCol, HasDistanceMeasure, HasK, HasSeed, HasMaxIter
+):
+    """Ref KMeans.java."""
+
+    INIT_MODE = StringParam(
+        "initMode",
+        "The initialization algorithm. Supported options: 'random'.",
+        "random",
+        ParamValidators.in_array(["random"]),
+    )
+
+    def get_init_mode(self) -> str:
+        return self.get(self.INIT_MODE)
+
+    def set_init_mode(self, value: str):
+        return self.set(self.INIT_MODE, value)
+
+    def fit(self, *inputs) -> KMeansModel:
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float32)
+        k = self.get_k()
+        if X.shape[0] < k:
+            raise ValueError(f"KMeans needs at least k={k} points, got {X.shape[0]}")
+        # Random-sample init (ref KMeans.selectRandomCentroids:96 / DataStreamUtils.sample)
+        rng = np.random.default_rng(self.get_seed())
+        init = X[rng.choice(X.shape[0], size=k, replace=False)]
+
+        ctx = get_mesh_context()
+        cache = DeviceDataCache({"x": X}, ctx=ctx)
+        step = _train_step(self.get_distance_measure(), k)
+        criteria = TerminateOnMaxIter(self.get_max_iter())
+
+        def body(variables, epoch):
+            centroids, _ = variables
+            new_centroids, counts = step(centroids, cache["x"], cache.mask)
+            return IterationBodyResult(
+                [new_centroids, counts],
+                outputs=[(new_centroids, counts)],
+                termination_criteria=criteria(epoch),
+            )
+
+        outputs = iterate_bounded_until_termination(
+            [ctx.replicate(init), ctx.replicate(np.zeros(k, np.float32))], body
+        )
+        centroids, counts = outputs[0]
+        model = KMeansModel()
+        update_existing_params(model, self)
+        model.centroids = np.asarray(jax.device_get(centroids), np.float64)
+        model.weights = np.asarray(jax.device_get(counts), np.float64)
+        return model

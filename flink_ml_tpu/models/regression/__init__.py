@@ -1,0 +1,1 @@
+"""Regression algorithms. Ref flink-ml-lib/.../ml/regression/."""

@@ -1,0 +1,42 @@
+"""Linear regression.
+
+Reference: ``flink-ml-lib/.../regression/linearregression/`` — ``LinearRegression.java``
+(fit = SGD + LeastSquareLoss), ``LinearRegressionModel.java`` (prediction = dot).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.models.linear import LinearEstimatorBase, LinearModelBase
+from flink_ml_tpu.ops.lossfunc import LeastSquareLoss
+
+__all__ = ["LinearRegression", "LinearRegressionModel"]
+
+
+@functools.cache
+def _predict_kernel():
+    return jax.jit(lambda X, coef: X @ coef)
+
+
+class LinearRegressionModel(LinearModelBase):
+    """Ref LinearRegressionModel.java."""
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float32)
+        pred = _predict_kernel()(X, jnp.asarray(self.coefficient, jnp.float32))
+        out = df.clone()
+        out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))
+        return out
+
+
+class LinearRegression(LinearEstimatorBase):
+    """Ref LinearRegression.java."""
+
+    _LOSS = LeastSquareLoss.INSTANCE
+    _MODEL_CLASS = LinearRegressionModel

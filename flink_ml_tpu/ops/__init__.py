@@ -3,3 +3,30 @@
 Reference: flink-ml-lib/.../common/ (lossfunc, optimizer, util) and
 flink-ml-core/.../common/window + flink-ml-servable-core distance measures.
 """
+from flink_ml_tpu.ops.distance import (
+    CosineDistance,
+    DistanceMeasure,
+    EuclideanDistance,
+    ManhattanDistance,
+)
+from flink_ml_tpu.ops.lossfunc import (
+    BinaryLogisticLoss,
+    HingeLoss,
+    LeastSquareLoss,
+    LossFunc,
+)
+from flink_ml_tpu.ops.optimizer import SGD, Optimizer, regularize
+
+__all__ = [
+    "CosineDistance",
+    "DistanceMeasure",
+    "EuclideanDistance",
+    "ManhattanDistance",
+    "BinaryLogisticLoss",
+    "HingeLoss",
+    "LeastSquareLoss",
+    "LossFunc",
+    "SGD",
+    "Optimizer",
+    "regularize",
+]

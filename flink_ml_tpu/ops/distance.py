@@ -1,0 +1,84 @@
+"""Distance measures, batched for the MXU.
+
+Reference: ``flink-ml-servable-core/.../common/distance/`` — ``DistanceMeasure.java``
+(``getInstance`` name dispatch, ``distance``, ``findClosest``),
+``EuclideanDistanceMeasure.java`` (distance² = |a|² + |b|² − 2a·b, clamped at 0),
+``CosineDistanceMeasure.java`` (1 − a·b/|a||b|), ``ManhattanDistanceMeasure.java``.
+
+TPU-first departure: the reference computes point-vs-centroid distances one pair at a
+time in Java loops; here the unit of work is ``pairwise(points[n,d], centroids[k,d]) →
+[n,k]``, which XLA lowers to a single [n,d]×[d,k] matmul on the MXU for
+euclidean/cosine. ``find_closest`` is an argmin over that matrix — the reference's
+triangle-inequality pruning (EuclideanDistanceMeasure.findClosest) is a scalar-loop
+optimization that would *hurt* on a systolic array, so it is intentionally absent.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["DistanceMeasure", "EuclideanDistance", "ManhattanDistance", "CosineDistance"]
+
+
+class DistanceMeasure:
+    """Pluggable metric; subclasses define batched ``pairwise``."""
+
+    NAME = ""
+
+    _REGISTRY = {}
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.NAME:
+            DistanceMeasure._REGISTRY[cls.NAME] = cls
+
+    @staticmethod
+    def get_instance(name: str) -> "DistanceMeasure":
+        """Ref DistanceMeasure.getInstance — name dispatch with the same error."""
+        try:
+            return DistanceMeasure._REGISTRY[name]()
+        except KeyError:
+            raise ValueError(
+                f"distanceMeasure {name} is not recognized. Supported options: "
+                f"'euclidean, manhattan, cosine'."
+            )
+
+    def pairwise(self, points, centroids):
+        """[n, d] × [k, d] → [n, k] distances."""
+        raise NotImplementedError
+
+    def distance(self, a, b):
+        """Single-pair parity API (DistanceMeasure.distance)."""
+        return self.pairwise(jnp.asarray(a)[None, :], jnp.asarray(b)[None, :])[0, 0]
+
+    def find_closest(self, points, centroids):
+        """[n, d] × [k, d] → [n] argmin indices (first minimum, like the reference's
+        strict-< scan)."""
+        return jnp.argmin(self.pairwise(points, centroids), axis=1)
+
+
+class EuclideanDistance(DistanceMeasure):
+    NAME = "euclidean"
+
+    def pairwise(self, points, centroids):
+        # |a|^2 + |b|^2 - 2 a.b as one matmul; clamp at 0 like the reference's
+        # Math.max guard against accuracy loss.
+        p2 = jnp.sum(points * points, axis=1, keepdims=True)
+        c2 = jnp.sum(centroids * centroids, axis=1)[None, :]
+        sq = jnp.maximum(p2 + c2 - 2.0 * points @ centroids.T, 0.0)
+        return jnp.sqrt(sq)
+
+
+class ManhattanDistance(DistanceMeasure):
+    NAME = "manhattan"
+
+    def pairwise(self, points, centroids):
+        return jnp.sum(jnp.abs(points[:, None, :] - centroids[None, :, :]), axis=-1)
+
+
+class CosineDistance(DistanceMeasure):
+    NAME = "cosine"
+
+    def pairwise(self, points, centroids):
+        pn = jnp.linalg.norm(points, axis=1, keepdims=True)
+        cn = jnp.linalg.norm(centroids, axis=1)[None, :]
+        return 1.0 - (points @ centroids.T) / pn / cn

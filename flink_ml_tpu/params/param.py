@@ -260,3 +260,16 @@ class WithParams:
         for name, encoded in payload.items():
             p = self._param_by_name(name)
             self._param_map[p] = p.json_decode(encoded)
+
+
+def update_existing_params(target: WithParams, source: WithParams) -> None:
+    """Copy every param value from ``source`` that ``target`` also declares.
+
+    Ref ParamUtils.updateExistingParams — how an Estimator pushes its params onto the
+    Model it produces (e.g. KMeans.fit → KMeansModel). Goes through ``target.set``
+    so the target's validators run, and deep-copies so mutable values (arrays,
+    vectors) are never aliased between source and target."""
+    by_name = {p.name: v for p, v in source.get_param_map().items()}
+    for p in list(target.get_param_map()):
+        if p.name in by_name:
+            target.set(p, copy.deepcopy(by_name[p.name]))

@@ -1,0 +1,110 @@
+"""Checkpoint/resume tests.
+
+Parity target (SURVEY.md §4): ``BoundedAllRoundCheckpointITCase`` — a job that fails
+mid-training (FailingMap after N records), restarts from the last checkpoint, and
+must converge to the identical result. Here the "job" is the iteration driver /
+SGD, the fault is a listener that raises at a chosen epoch, and restart = rerunning
+with the same CheckpointManager.
+"""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.checkpoint import CheckpointManager
+from flink_ml_tpu.iteration import (
+    IterationBodyResult,
+    IterationConfig,
+    IterationListener,
+    TerminateOnMaxIter,
+    iterate_bounded_until_termination,
+)
+from flink_ml_tpu.ops import SGD, LeastSquareLoss
+
+
+class FailAtEpoch(IterationListener):
+    """The FailingMap analogue: blow up once a given epoch is reached."""
+
+    def __init__(self, epoch: int):
+        self.fail_epoch = epoch
+
+    def on_epoch_watermark_incremented(self, epoch, context):
+        if epoch == self.fail_epoch:
+            raise RuntimeError(f"injected failure at epoch {epoch}")
+
+
+def test_manager_round_trip_and_prune(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    assert mgr.restore_latest() is None
+    state = [np.arange(4.0), {"nested": np.ones((2, 2)), "n": np.asarray(3)}]
+    for step in (1, 2, 3):
+        mgr.save(step, state)
+    assert mgr.all_steps() == [2, 3]  # pruned to max_to_keep
+    step, restored = mgr.restore_latest()
+    assert step == 3
+    np.testing.assert_array_equal(restored[0], state[0])
+    np.testing.assert_array_equal(restored[1]["nested"], state[1]["nested"])
+    assert int(restored[1]["n"]) == 3
+
+
+def test_driver_kill_and_resume(tmp_path):
+    """x += epoch for 10 epochs, killed at epoch 6, resumed: same result."""
+
+    crit = TerminateOnMaxIter(10)
+
+    def body(variables, epoch):
+        (x,) = variables
+        x = x + float(epoch)
+        return IterationBodyResult([x], outputs=[x], termination_criteria=crit(epoch))
+
+    clean = iterate_bounded_until_termination([np.asarray(0.0)], body)
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    config = IterationConfig(checkpoint_interval=1, checkpoint_manager=mgr)
+    with pytest.raises(RuntimeError, match="injected failure"):
+        iterate_bounded_until_termination(
+            [np.asarray(0.0)], body, config=config, listeners=[FailAtEpoch(6)]
+        )
+    assert mgr.all_steps()  # something was snapshotted before the crash
+    resumed = iterate_bounded_until_termination([np.asarray(0.0)], body, config=config)
+    assert float(resumed[0]) == float(clean[0]) == sum(range(10))
+
+
+def test_sgd_kill_and_resume_identical_result(tmp_path):
+    """The BoundedAllRoundCheckpointITCase contract: restart-from-checkpoint training
+    lands on the identical coefficients as the uninterrupted run."""
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(128, 3)).astype(np.float32)
+    y = X @ np.asarray([1.0, -2.0, 0.5], np.float32)
+    data = {"features": X, "labels": y}
+
+    def make_sgd(**kw):
+        return SGD(
+            max_iter=30, learning_rate=0.05, global_batch_size=32, tol=0.0, **kw
+        )
+
+    coef_clean = make_sgd().optimize(np.zeros(3), data, LeastSquareLoss.INSTANCE)
+
+    mgr = CheckpointManager(str(tmp_path / "sgd_ck"), max_to_keep=2)
+    with pytest.raises(RuntimeError, match="injected failure"):
+        make_sgd(
+            checkpoint_manager=mgr, checkpoint_interval=5, listeners=[FailAtEpoch(17)]
+        ).optimize(np.zeros(3), data, LeastSquareLoss.INSTANCE)
+
+    coef_resumed = make_sgd(
+        checkpoint_manager=mgr, checkpoint_interval=5
+    ).optimize(np.zeros(3), data, LeastSquareLoss.INSTANCE)
+    np.testing.assert_array_equal(coef_resumed, coef_clean)
+
+
+def test_save_is_atomic_against_partial_state(tmp_path):
+    """A leftover .tmp dir from a killed save is ignored and overwritten."""
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    mgr.save(1, [np.ones(2)])
+    # simulate a kill mid-save: stale tmp dir for step 2
+    import os
+
+    os.makedirs(str(tmp_path / "ckpt-2.tmp"))
+    assert mgr.all_steps() == [1]
+    step, state = mgr.restore_latest()
+    assert step == 1
+    mgr.save(2, [np.zeros(2)])
+    assert mgr.all_steps() == [1, 2]

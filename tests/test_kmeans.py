@@ -1,0 +1,92 @@
+"""KMeans tests — parity with the reference's KMeansTest shape (param defaults,
+fit+transform, save/load, getModelData)."""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.clustering.kmeans import KMeans, KMeansModel
+
+RNG = np.random.default_rng(5)
+
+
+def _blobs(k=3, per=40, d=2, spread=0.05):
+    centers = np.asarray([[0.0, 0.0], [5.0, 5.0], [-5.0, 5.0]])[:k]
+    pts = np.concatenate([RNG.normal(c, spread, (per, d)) for c in centers])
+    return DataFrame.from_dict({"features": pts}), centers
+
+
+def test_kmeans_param_defaults():
+    km = KMeans()
+    assert km.get_k() == 2
+    assert km.get_max_iter() == 20
+    assert km.get_distance_measure() == "euclidean"
+    assert km.get_init_mode() == "random"
+    assert km.get_features_col() == "features"
+    assert km.get_prediction_col() == "prediction"
+
+
+def test_kmeans_fit_recovers_blob_centers():
+    df, centers = _blobs()
+    model = KMeans().set_k(3).set_max_iter(20).set_seed(2).fit(df)
+    got = model.centroids[np.argsort(model.centroids[:, 0])]
+    want = centers[np.argsort(centers[:, 0])]
+    np.testing.assert_allclose(got, want, atol=0.2)
+    np.testing.assert_allclose(sorted(model.weights), [40.0, 40.0, 40.0])
+
+
+def test_kmeans_transform_assigns_consistently():
+    df, _ = _blobs()
+    model = KMeans().set_k(3).set_seed(0).fit(df)
+    pred = model.transform(df)["prediction"]
+    # each blob maps to exactly one cluster id and ids are distinct
+    groups = [set(pred[i * 40 : (i + 1) * 40]) for i in range(3)]
+    assert all(len(g) == 1 for g in groups)
+    assert len(set().union(*groups)) == 3
+
+
+@pytest.mark.parametrize("measure", ["euclidean", "manhattan", "cosine"])
+def test_kmeans_distance_measures(measure):
+    # Blobs separated in both position and direction (cosine only sees direction,
+    # so neither blob may sit at the origin).
+    pts = np.concatenate(
+        [RNG.normal([5.0, 0.0], 0.05, (40, 2)), RNG.normal([0.0, 5.0], 0.05, (40, 2))]
+    )
+    df = DataFrame.from_dict({"features": pts})
+    model = KMeans().set_k(2).set_distance_measure(measure).set_seed(1).fit(df)
+    pred = model.transform(df)["prediction"]
+    assert len(set(pred[:40])) == 1 and len(set(pred[40:])) == 1 and pred[0] != pred[-1]
+
+
+def test_kmeans_save_load(tmp_path):
+    df, _ = _blobs(k=2)
+    model = KMeans().set_k(2).set_seed(4).fit(df)
+    path = str(tmp_path / "km")
+    model.save(path)
+    loaded = KMeansModel.load(path)
+    np.testing.assert_allclose(loaded.centroids, model.centroids)
+    np.testing.assert_allclose(loaded.weights, model.weights)
+    np.testing.assert_array_equal(
+        loaded.transform(df)["prediction"], model.transform(df)["prediction"]
+    )
+
+
+def test_kmeans_model_data_round_trip():
+    df, _ = _blobs(k=2)
+    model = KMeans().set_k(2).set_seed(4).fit(df)
+    (md,) = model.get_model_data()
+    fresh = KMeansModel()
+    fresh.set_model_data(md)
+    np.testing.assert_allclose(fresh.centroids, model.centroids)
+
+
+def test_kmeans_requires_enough_points():
+    df = DataFrame.from_dict({"features": RNG.normal(size=(2, 2))})
+    with pytest.raises(ValueError, match="at least"):
+        KMeans().set_k(3).fit(df)
+
+
+def test_kmeans_seed_reproducible():
+    df, _ = _blobs()
+    m1 = KMeans().set_k(3).set_seed(9).fit(df)
+    m2 = KMeans().set_k(3).set_seed(9).fit(df)
+    np.testing.assert_allclose(m1.centroids, m2.centroids)

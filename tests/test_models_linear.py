@@ -1,0 +1,161 @@
+"""Tests for LogisticRegression / LinearSVC / LinearRegression.
+
+Mirrors the reference's per-algorithm test shape (SURVEY.md §4: param defaults,
+fit+transform correctness, save/load round-trip, getModelData contents) from
+``LogisticRegressionTest`` / ``LinearSVCTest`` / ``LinearRegressionTest``.
+"""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.classification.linearsvc import LinearSVC, LinearSVCModel
+from flink_ml_tpu.models.classification.logistic_regression import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+from flink_ml_tpu.models.regression.linear_regression import (
+    LinearRegression,
+    LinearRegressionModel,
+)
+from flink_ml_tpu.utils import read_write as rw
+
+RNG = np.random.default_rng(11)
+
+
+def _binary_df(n=256, d=4):
+    X = RNG.normal(size=(n, d))
+    w_true = np.linspace(1.0, -1.0, d)
+    y = (X @ w_true > 0).astype(np.float64)
+    return DataFrame.from_dict({"features": X, "label": y}), y
+
+
+def test_logistic_regression_param_defaults():
+    lr = LogisticRegression()
+    assert lr.get_features_col() == "features"
+    assert lr.get_label_col() == "label"
+    assert lr.get_prediction_col() == "prediction"
+    assert lr.get_raw_prediction_col() == "rawPrediction"
+    assert lr.get_max_iter() == 20
+    assert lr.get_learning_rate() == 0.1
+    assert lr.get_global_batch_size() == 32
+    assert lr.get_tol() == 1e-6
+    assert lr.get_reg() == 0.0
+    assert lr.get_elastic_net() == 0.0
+
+
+def test_logistic_regression_fit_transform():
+    df, y = _binary_df()
+    model = (
+        LogisticRegression()
+        .set_max_iter(60)
+        .set_global_batch_size(256)
+        .set_learning_rate(0.5)
+        .fit(df)
+    )
+    out = model.transform(df)
+    acc = (out["prediction"] == y).mean()
+    assert acc > 0.95
+    raw = out["rawPrediction"]
+    np.testing.assert_allclose(raw.sum(axis=1), 1.0, atol=1e-5)  # [1-p, p]
+    # prediction consistent with probability threshold
+    np.testing.assert_array_equal(out["prediction"], (raw[:, 1] >= 0.5).astype(np.float64))
+
+
+def test_logistic_regression_rejects_nonbinary_labels():
+    df = DataFrame.from_dict(
+        {"features": RNG.normal(size=(10, 2)), "label": np.arange(10.0)}
+    )
+    with pytest.raises(ValueError, match="binary labels"):
+        LogisticRegression().fit(df)
+
+
+def test_logistic_regression_save_load_round_trip(tmp_path):
+    df, y = _binary_df(64)
+    model = LogisticRegression().set_max_iter(10).fit(df)
+    path = str(tmp_path / "lr_model")
+    model.save(path)
+    loaded = LogisticRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.coefficient, model.coefficient)
+    out0, out1 = model.transform(df), loaded.transform(df)
+    np.testing.assert_array_equal(out0["prediction"], out1["prediction"])
+    # generic registry dispatch (ReadWriteUtils.loadStage:268 analogue)
+    loaded2 = rw.load_stage(path)
+    assert isinstance(loaded2, LogisticRegressionModel)
+
+
+def test_logistic_regression_get_set_model_data():
+    df, _ = _binary_df(64)
+    model = LogisticRegression().set_max_iter(5).fit(df)
+    (md,) = model.get_model_data()
+    assert md.get_column_names() == ["coefficient"]
+    fresh = LogisticRegressionModel().set_features_col("features")
+    fresh.set_model_data(md)
+    np.testing.assert_allclose(fresh.coefficient, model.coefficient)
+
+
+def test_estimator_save_load(tmp_path):
+    est = LogisticRegression().set_max_iter(7).set_reg(0.1)
+    path = str(tmp_path / "lr_est")
+    est.save(path)
+    loaded = LogisticRegression.load(path)
+    assert loaded.get_max_iter() == 7
+    assert loaded.get_reg() == 0.1
+
+
+def test_linearsvc_fit_transform_and_threshold():
+    df, y = _binary_df()
+    svc = LinearSVC().set_max_iter(60).set_global_batch_size(256).set_learning_rate(0.2)
+    model = svc.fit(df)
+    out = model.transform(df)
+    assert (out["prediction"] == y).mean() > 0.95
+    raw = out["rawPrediction"]
+    np.testing.assert_allclose(raw[:, 0], -raw[:, 1], atol=1e-6)  # [dot, -dot]
+    # threshold moves predictions (LinearSVCModel.predictOneDataPoint:177-180)
+    model.set_threshold(1e9)
+    out_hi = model.transform(df)
+    assert (out_hi["prediction"] == 0.0).all()
+
+
+def test_linearsvc_defaults():
+    svc = LinearSVC()
+    assert svc.get_threshold() == 0.0
+    assert svc.get_max_iter() == 20
+
+
+def test_linear_regression_fit_transform():
+    X = RNG.normal(size=(256, 3))
+    w_true = np.asarray([2.0, -1.0, 0.5])
+    y = X @ w_true
+    df = DataFrame.from_dict({"features": X, "label": y})
+    model = (
+        LinearRegression()
+        .set_max_iter(200)
+        .set_global_batch_size(256)
+        .set_learning_rate(0.1)
+        .set_tol(0.0)
+        .fit(df)
+    )
+    np.testing.assert_allclose(model.coefficient, w_true, atol=5e-2)
+    out = model.transform(df)
+    np.testing.assert_allclose(out["prediction"], y, atol=0.2)
+
+
+def test_linear_regression_save_load(tmp_path):
+    X = RNG.normal(size=(32, 2))
+    df = DataFrame.from_dict({"features": X, "label": X @ np.ones(2)})
+    model = LinearRegression().set_max_iter(5).fit(df)
+    path = str(tmp_path / "linreg")
+    model.save(path)
+    loaded = LinearRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.coefficient, model.coefficient)
+
+
+def test_weight_col_used():
+    """Weighted fit differs from unweighted when weights are informative."""
+    X = np.vstack([np.eye(2), np.eye(2)])
+    y = np.asarray([1.0, 0.0, 0.0, 1.0])
+    w = np.asarray([10.0, 10.0, 0.1, 0.1])
+    df = DataFrame.from_dict({"features": X, "label": y, "w": w})
+    m_w = LogisticRegression().set_weight_col("w").set_max_iter(30).fit(df)
+    m_u = LogisticRegression().set_max_iter(30).fit(df)
+    assert not np.allclose(m_w.coefficient, m_u.coefficient)

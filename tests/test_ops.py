@@ -1,0 +1,194 @@
+"""Tests for losses, regularization, SGD, and distance measures.
+
+Parity targets: the loss formulas of ``BinaryLogisticLoss/HingeLoss/LeastSquareLoss``
+(flink-ml-lib common/lossfunc), ``RegularizationUtils.regularize:47`` coefficient
+updates, SGD convergence semantics (SGD.java), and the three DistanceMeasures
+(flink-ml-servable-core common/distance).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_ml_tpu.ops import (
+    SGD,
+    BinaryLogisticLoss,
+    CosineDistance,
+    DistanceMeasure,
+    EuclideanDistance,
+    HingeLoss,
+    LeastSquareLoss,
+    ManhattanDistance,
+    regularize,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def _batch(n=16, d=5, binary=True):
+    X = jnp.asarray(RNG.normal(size=(n, d)), jnp.float32)
+    y = jnp.asarray(
+        (RNG.random(n) > 0.5).astype(np.float32) if binary else RNG.normal(size=n),
+        jnp.float32,
+    )
+    w = jnp.asarray(RNG.random(n).astype(np.float32) + 0.5)
+    coef = jnp.asarray(RNG.normal(size=d), jnp.float32)
+    return coef, X, y, w
+
+
+@pytest.mark.parametrize("loss", [BinaryLogisticLoss.INSTANCE, HingeLoss.INSTANCE, LeastSquareLoss.INSTANCE])
+def test_analytic_grad_matches_autograd(loss):
+    coef, X, y, w = _batch(binary=not isinstance(loss, LeastSquareLoss))
+    l_analytic, g_analytic = loss.loss_and_grad_sum(coef, X, y, w)
+    l_auto, g_auto = jax.value_and_grad(loss.batch_loss_sum)(coef, X, y, w)
+    np.testing.assert_allclose(l_analytic, l_auto, rtol=1e-5)
+    np.testing.assert_allclose(g_analytic, g_auto, rtol=1e-4, atol=1e-5)
+
+
+def test_logistic_loss_single_sample_formula():
+    """w * log(1 + exp(-dot * (2y-1))) — BinaryLogisticLoss.java:50-56."""
+    coef = jnp.asarray([1.0, -1.0])
+    X = jnp.asarray([[2.0, 0.5]])
+    w = jnp.asarray([1.5])
+    dot = 2.0 - 0.5
+    for y, ys in [(0.0, -1.0), (1.0, 1.0)]:
+        got = float(BinaryLogisticLoss.INSTANCE.batch_loss_sum(coef, X, jnp.asarray([y]), w))
+        np.testing.assert_allclose(got, 1.5 * np.log1p(np.exp(-dot * ys)), rtol=1e-6)
+
+
+def test_hinge_loss_single_sample_formula():
+    """w * max(0, 1 - ys*dot) — HingeLoss.java:48-53."""
+    coef = jnp.asarray([1.0, 0.0])
+    X = jnp.asarray([[0.3, 9.9]])
+    w = jnp.asarray([2.0])
+    got1 = float(HingeLoss.INSTANCE.batch_loss_sum(coef, X, jnp.asarray([1.0]), w))
+    np.testing.assert_allclose(got1, 2.0 * (1 - 0.3), rtol=1e-6)
+    got0 = float(HingeLoss.INSTANCE.batch_loss_sum(coef, X, jnp.asarray([0.0]), w))
+    np.testing.assert_allclose(got0, 2.0 * (1 + 0.3), rtol=1e-6)
+
+
+def test_least_square_loss_single_sample_formula():
+    """w * 0.5 * (dot - y)^2 — LeastSquareLoss.java:47-50."""
+    coef = jnp.asarray([2.0])
+    X = jnp.asarray([[3.0]])
+    got = float(LeastSquareLoss.INSTANCE.batch_loss_sum(coef, X, jnp.asarray([1.0]), jnp.asarray([0.5])))
+    np.testing.assert_allclose(got, 0.5 * 0.5 * (6.0 - 1.0) ** 2, rtol=1e-6)
+
+
+# --- regularization (RegularizationUtils.regularize:47) ----------------------
+
+
+def test_regularize_l2_update():
+    coef = jnp.asarray([1.0, -2.0])
+    new, _ = regularize(coef, reg=0.1, elastic_net=0.0, learning_rate=0.5)
+    np.testing.assert_allclose(new, coef * (1 - 0.5 * 0.1), rtol=1e-6)
+
+
+def test_regularize_l1_update():
+    coef = jnp.asarray([1.0, -2.0, 0.0])
+    new, _ = regularize(coef, reg=0.1, elastic_net=1.0, learning_rate=0.5)
+    np.testing.assert_allclose(new, coef - 0.5 * 0.1 * np.sign(coef), rtol=1e-6)
+
+
+def test_regularize_elastic_net_update():
+    coef = jnp.asarray([1.0, -2.0])
+    reg, en, lr = 0.2, 0.3, 0.5
+    new, _ = regularize(coef, reg=reg, elastic_net=en, learning_rate=lr)
+    expected = coef - lr * (en * reg * np.sign(coef) + (1 - en) * reg * np.asarray(coef))
+    np.testing.assert_allclose(new, expected, rtol=1e-6)
+
+
+def test_regularize_zero_reg_identity():
+    coef = jnp.asarray([1.0, -2.0])
+    new, loss = regularize(coef, 0.0, 0.5, 0.1)
+    np.testing.assert_array_equal(new, coef)
+    assert float(loss) == 0.0
+
+
+# --- SGD ---------------------------------------------------------------------
+
+
+def test_sgd_linear_regression_converges_to_truth():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(512, 3)).astype(np.float32)
+    w_true = np.asarray([2.0, -1.0, 0.5], np.float32)
+    y = X @ w_true
+    sgd = SGD(max_iter=300, learning_rate=0.05, global_batch_size=512, tol=0.0)
+    coef = sgd.optimize(np.zeros(3), {"features": X, "labels": y}, LeastSquareLoss.INSTANCE)
+    np.testing.assert_allclose(coef, w_true, atol=2e-2)
+
+
+def test_sgd_tol_early_termination():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(64, 2)).astype(np.float32)
+    y = X @ np.asarray([1.0, 1.0], np.float32)
+    sgd = SGD(max_iter=5000, learning_rate=0.1, global_batch_size=64, tol=1e-4)
+    sgd.optimize(np.zeros(2), {"features": X, "labels": y}, LeastSquareLoss.INSTANCE)
+    assert 0 < len(sgd.loss_history) < 5000
+    assert sgd.loss_history[-1] < 1e-4
+
+
+def test_sgd_sample_weights_respected():
+    """Duplicating a sample == doubling its weight (weighted-update semantics)."""
+    X = np.asarray([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    y = np.asarray([1.0, 3.0], np.float32)
+    w = np.asarray([2.0, 1.0], np.float32)
+    sgd_w = SGD(max_iter=40, learning_rate=0.3, global_batch_size=8, tol=0.0)
+    coef_weighted = sgd_w.optimize(
+        np.zeros(2), {"features": X, "labels": y, "weights": w}, LeastSquareLoss.INSTANCE
+    )
+    X2 = np.asarray([[1.0, 0.0], [1.0, 0.0], [0.0, 1.0]], np.float32)
+    y2 = np.asarray([1.0, 1.0, 3.0], np.float32)
+    sgd_d = SGD(max_iter=40, learning_rate=0.3, global_batch_size=8, tol=0.0)
+    coef_dup = sgd_d.optimize(np.zeros(2), {"features": X2, "labels": y2}, LeastSquareLoss.INSTANCE)
+    np.testing.assert_allclose(coef_weighted, coef_dup, atol=1e-5)
+
+
+def test_sgd_minibatch_offset_cycles():
+    """global_batch < n: training still converges while cycling minibatches."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(100, 2)).astype(np.float32)
+    y = X @ np.asarray([1.5, -0.5], np.float32)
+    sgd = SGD(max_iter=400, learning_rate=0.05, global_batch_size=16, tol=0.0)
+    coef = sgd.optimize(np.zeros(2), {"features": X, "labels": y}, LeastSquareLoss.INSTANCE)
+    np.testing.assert_allclose(coef, [1.5, -0.5], atol=5e-2)
+
+
+# --- distance measures -------------------------------------------------------
+
+
+def test_euclidean_pairwise():
+    pts = np.asarray([[0.0, 0.0], [3.0, 4.0]])
+    cts = np.asarray([[0.0, 0.0], [6.0, 8.0]])
+    d = np.asarray(EuclideanDistance().pairwise(jnp.asarray(pts), jnp.asarray(cts)))
+    np.testing.assert_allclose(d, [[0.0, 10.0], [5.0, 5.0]], atol=1e-6)
+
+
+def test_manhattan_pairwise():
+    pts = np.asarray([[1.0, 2.0]])
+    cts = np.asarray([[4.0, -2.0]])
+    d = np.asarray(ManhattanDistance().pairwise(jnp.asarray(pts), jnp.asarray(cts)))
+    np.testing.assert_allclose(d, [[7.0]], atol=1e-6)
+
+
+def test_cosine_pairwise():
+    pts = np.asarray([[1.0, 0.0]])
+    cts = np.asarray([[0.0, 2.0], [3.0, 0.0]])
+    d = np.asarray(CosineDistance().pairwise(jnp.asarray(pts), jnp.asarray(cts)))
+    np.testing.assert_allclose(d, [[1.0, 0.0]], atol=1e-6)
+
+
+def test_find_closest_first_minimum():
+    """Ties resolve to the first index, like the reference's strict-< loop."""
+    m = EuclideanDistance()
+    pts = jnp.asarray([[1.0, 0.0]])
+    cts = jnp.asarray([[0.0, 0.0], [2.0, 0.0]])  # equidistant
+    assert int(m.find_closest(pts, cts)[0]) == 0
+
+
+def test_get_instance_dispatch_and_error():
+    assert isinstance(DistanceMeasure.get_instance("euclidean"), EuclideanDistance)
+    assert isinstance(DistanceMeasure.get_instance("manhattan"), ManhattanDistance)
+    assert isinstance(DistanceMeasure.get_instance("cosine"), CosineDistance)
+    with pytest.raises(ValueError, match="not recognized"):
+        DistanceMeasure.get_instance("chebyshev")
