@@ -1,0 +1,57 @@
+"""ML observability metrics.
+
+Reference: ``flink-ml-servable-core/.../MLMetrics.java`` — the metric-name constants
+(``ml.model.timestamp``, ``ml.model.version``) that online models register as gauges
+(OnlineStandardScalerModel.java:206-211, OnlineKMeansModel), scraped in tests via
+Flink's InMemoryReporter (OnlineKMeansTest.java:152-156).
+
+Here: a process-local registry of named gauges, grouped per stage instance. Tests
+scrape ``MetricsRegistry`` exactly like InMemoryReporter; production wiring can
+mirror the gauges to any sink.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = ["MLMetrics", "MetricsRegistry", "metrics"]
+
+
+class MLMetrics:
+    """Ref MLMetrics.java constants."""
+
+    ML_GROUP = "ml"
+    ML_MODEL_GROUP = "ml.model"
+    TIMESTAMP = "ml.model.timestamp"
+    VERSION = "ml.model.version"
+
+
+class MetricsRegistry:
+    """Named gauges per scope (scope ≈ the operator's metric group)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gauges: Dict[str, Dict[str, Any]] = {}
+
+    def gauge(self, scope: str, name: str, value: Any) -> None:
+        with self._lock:
+            self._gauges.setdefault(scope, {})[name] = value
+
+    def get(self, scope: str, name: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._gauges.get(scope, {}).get(name, default)
+
+    def scope(self, scope: str) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._gauges.get(scope, {}))
+
+    def scopes(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._gauges.items()}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._gauges.clear()
+
+
+metrics = MetricsRegistry()

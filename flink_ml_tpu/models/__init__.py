@@ -17,9 +17,18 @@ STAGE_REGISTRY = {
     "LogisticRegressionModel": "flink_ml_tpu.models.classification.logistic_regression.LogisticRegressionModel",
     "LinearSVC": "flink_ml_tpu.models.classification.linearsvc.LinearSVC",
     "LinearSVCModel": "flink_ml_tpu.models.classification.linearsvc.LinearSVCModel",
+    "OnlineLogisticRegression": "flink_ml_tpu.models.classification.online_logistic_regression.OnlineLogisticRegression",
+    "OnlineLogisticRegressionModel": "flink_ml_tpu.models.classification.online_logistic_regression.OnlineLogisticRegressionModel",
     # clustering
     "KMeans": "flink_ml_tpu.models.clustering.kmeans.KMeans",
     "KMeansModel": "flink_ml_tpu.models.clustering.kmeans.KMeansModel",
+    "OnlineKMeans": "flink_ml_tpu.models.clustering.online_kmeans.OnlineKMeans",
+    "OnlineKMeansModel": "flink_ml_tpu.models.clustering.online_kmeans.OnlineKMeansModel",
+    # feature
+    "StandardScaler": "flink_ml_tpu.models.feature.standard_scaler.StandardScaler",
+    "StandardScalerModel": "flink_ml_tpu.models.feature.standard_scaler.StandardScalerModel",
+    "OnlineStandardScaler": "flink_ml_tpu.models.feature.standard_scaler.OnlineStandardScaler",
+    "OnlineStandardScalerModel": "flink_ml_tpu.models.feature.standard_scaler.OnlineStandardScalerModel",
     # regression
     "LinearRegression": "flink_ml_tpu.models.regression.linear_regression.LinearRegression",
     "LinearRegressionModel": "flink_ml_tpu.models.regression.linear_regression.LinearRegressionModel",

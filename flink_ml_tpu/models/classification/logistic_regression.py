@@ -20,27 +20,26 @@ import numpy as np
 
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.models.linear import LinearEstimatorBase, LinearModelBase
+from flink_ml_tpu.ops.kernels import logistic_predict_kernel
 from flink_ml_tpu.ops.lossfunc import BinaryLogisticLoss
 from flink_ml_tpu.params.shared import HasMultiClass, HasRawPredictionCol
 
 __all__ = ["LogisticRegression", "LogisticRegressionModel"]
 
 
-@functools.cache
-def _predict_kernel():
-    @jax.jit
-    def kernel(X, coef):
-        dots = X @ coef
-        prob = jax.nn.sigmoid(dots)
-        pred = (dots >= 0).astype(dots.dtype)
-        raw = jnp.stack([1.0 - prob, prob], axis=1)
-        return pred, raw
-
-    return kernel
+_predict_kernel = logistic_predict_kernel
 
 
 class LogisticRegressionModel(LinearModelBase, HasRawPredictionCol, HasMultiClass):
     """Ref LogisticRegressionModel.java."""
+
+    @classmethod
+    def load_servable(cls, path: str):
+        """Runtime-free replica from this model's save dir (ref
+        LogisticRegressionModel → LogisticRegressionModelServable pairing)."""
+        from flink_ml_tpu.servable.lib import LogisticRegressionModelServable
+
+        return LogisticRegressionModelServable.load_servable(path)
 
     def transform(self, *inputs):
         (df,) = inputs

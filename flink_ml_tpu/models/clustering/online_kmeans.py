@@ -1,0 +1,155 @@
+"""Online k-means with decayed centroid updates.
+
+Reference: ``flink-ml-lib/.../clustering/kmeans/OnlineKMeans.java`` — per global
+batch (``ModelDataLocalUpdater.alignAndComputeModelData:200-254``): assign points to
+the closest current centroid; decay previous weights by ``decayFactor`` (the
+reference scales by decayFactor/parallelism per worker, then the global reducer
+weight-averages — globally equivalent to one decay); for each non-empty cluster
+    weight_i ← weight_i·decay + count_i
+    centroid_i ← (1 − λ)·centroid_i + λ·mean(points_i),  λ = count_i / weight_i
+Empty clusters keep their centroid (and decayed weight). ``OnlineKMeansModel``
+serves closest-centroid predictions with the latest arrived model version and
+exports the model-version gauge (OnlineKMeansModel.java:165).
+
+The per-batch update is one jit program: one-hot matmuls for counts/sums (the same
+MXU shape as batch KMeans) plus the fused decay/blend elementwise update.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.models.clustering.kmeans import HasK, _predict_step
+from flink_ml_tpu.models.online import OnlineModelBase, SnapshotDriver, as_batch_stream
+from flink_ml_tpu.ops.distance import DistanceMeasure
+from flink_ml_tpu.params.param import update_existing_params
+from flink_ml_tpu.params.shared import (
+    HasBatchStrategy,
+    HasDecayFactor,
+    HasDistanceMeasure,
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasPredictionCol,
+    HasSeed,
+)
+
+__all__ = ["OnlineKMeans", "OnlineKMeansModel"]
+
+
+@functools.cache
+def _update_step(measure_name: str, k: int, decay: float):
+    measure = DistanceMeasure.get_instance(measure_name)
+
+    @jax.jit
+    def step(centroids, weights, X):
+        assign = measure.find_closest(X, centroids)
+        hot = jax.nn.one_hot(assign, k, dtype=X.dtype)
+        counts = jnp.sum(hot, axis=0)  # [k]
+        sums = hot.T @ X  # [k, d]
+        decayed = weights * decay
+        new_weights = decayed + counts
+        lam = jnp.where(new_weights > 0, counts / jnp.maximum(new_weights, 1e-16), 0.0)
+        means = sums / jnp.maximum(counts, 1.0)[:, None]
+        blended = (1.0 - lam[:, None]) * centroids + lam[:, None] * means
+        new_centroids = jnp.where(counts[:, None] > 0, blended, centroids)
+        return new_centroids, new_weights
+
+    return step
+
+
+class OnlineKMeansModel(
+    OnlineModelBase, HasFeaturesCol, HasPredictionCol, HasDistanceMeasure, HasK
+):
+    """Ref OnlineKMeansModel.java."""
+
+    _MODEL_ARRAY_NAMES = ("centroids", "weights")
+
+    def __init__(self):
+        super().__init__()
+        self.centroids: Optional[np.ndarray] = None
+        self.weights: Optional[np.ndarray] = None
+
+    def _apply_snapshot(self, payload) -> None:
+        self.centroids, self.weights = (np.asarray(a) for a in payload)
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        if self.centroids is None:
+            raise RuntimeError("no model version has arrived yet; advance() the model")
+        X = df.vectors(self.get_features_col()).astype(np.float32)
+        pred = _predict_step(self.get_distance_measure())(
+            X, jnp.asarray(self.centroids, jnp.float32)
+        )
+        out = df.clone()
+        out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))
+        return out
+
+
+class OnlineKMeans(
+    Estimator,
+    HasFeaturesCol,
+    HasPredictionCol,
+    HasDistanceMeasure,
+    HasK,
+    HasSeed,
+    HasDecayFactor,
+    HasGlobalBatchSize,
+    HasBatchStrategy,
+):
+    """Ref OnlineKMeans.java — requires an initial model (random or from batch KMeans)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._initial_model: Optional[tuple] = None
+
+    def set_initial_model_data(self, model_data: DataFrame) -> "OnlineKMeans":
+        centroids = np.asarray(model_data.column("centroids")[0], np.float64)
+        weights = np.asarray(model_data.column("weights")[0], np.float64)
+        self._initial_model = (centroids, weights)
+        return self
+
+    def set_random_initial_model_data(self, dim: int) -> "OnlineKMeans":
+        """Ref KMeansModelData.generateRandomModelData — random init centroids with
+        weight 0."""
+        rng = np.random.default_rng(self.get_seed())
+        k = self.get_k()
+        self._initial_model = (rng.normal(size=(k, dim)), np.zeros(k))
+        return self
+
+    def fit(self, *inputs) -> OnlineKMeansModel:
+        (data,) = inputs
+        if self._initial_model is None:
+            raise RuntimeError("OnlineKMeans requires initial model data")
+        k = self.get_k()
+        centroids0, weights0 = self._initial_model
+        if centroids0.shape[0] != k:
+            raise ValueError(f"initial model has {centroids0.shape[0]} centroids, k={k}")
+        step = _update_step(self.get_distance_measure(), k, self.get_decay_factor())
+        features_col = self.get_features_col()
+        stream, bounded = as_batch_stream(data, self.get_global_batch_size())
+
+        def train_step(state, batch):
+            centroids, weights = state
+            X = jnp.asarray(np.asarray(batch[features_col], np.float32))
+            centroids, weights = step(centroids, weights, X)
+            return (centroids, weights), (np.asarray(centroids), np.asarray(weights))
+
+        driver = SnapshotDriver(
+            stream,
+            train_step,
+            (jnp.asarray(centroids0, jnp.float32), jnp.asarray(weights0, jnp.float32)),
+        )
+        model = OnlineKMeansModel()
+        update_existing_params(model, self)
+        model._apply_snapshot((centroids0, weights0))
+        model._attach_stream(driver)
+        if bounded:
+            model.advance()
+        return model

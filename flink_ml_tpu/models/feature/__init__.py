@@ -1,0 +1,1 @@
+"""Feature engineering stages. Ref flink-ml-lib/.../ml/feature/ (33 stages)."""

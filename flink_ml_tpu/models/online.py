@@ -1,0 +1,185 @@
+"""Shared infrastructure for online (unbounded-stream) estimators and models.
+
+Reference pattern (SURVEY.md §2.5 online algos, §5.7): an online Estimator's fit
+wires an ``iterateUnboundedStreams`` dataflow that emits a *stream of versioned model
+data*; the Model holds that model-data stream and serves predictions with whatever
+version has arrived, exporting ``ml.model.version`` gauges.
+
+Single-controller mapping: the fitted model owns a Python generator of model
+snapshots. ``advance(n)`` pulls up to n snapshots (= n training windows) and applies
+them — the explicit handle on "how far has training consumed the stream" that the
+reference leaves to Flink's scheduler. Bounded inputs are trained eagerly in fit()
+(the batch-user experience); unbounded inputs (any iterator of batches, e.g.
+``QueueBatchStream``) stay lazy so tests and services can interleave feeding,
+training, and serving — the InMemorySourceFunction workflow.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.iteration.stream import Batch, batch_stream_from_dataframe, rebatch
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.models.common import ModelArraysMixin
+
+__all__ = ["QueueBatchStream", "OnlineModelBase", "SnapshotDriver", "as_batch_stream"]
+
+
+class QueueBatchStream:
+    """An in-memory feedable batch stream — the InMemorySourceFunction analogue.
+
+    Tests/services ``add`` columnar batches (or DataFrames) and the training side
+    pulls them; iteration ends when ``close()`` has been called and the queue is
+    drained. Pulling from an empty-but-open stream raises ``StreamDry`` rather than
+    blocking, so a single-threaded test can interleave add/advance deterministically.
+    """
+
+    class StreamDry(Exception):
+        pass
+
+    def __init__(self):
+        self._queue: deque = deque()
+        self._closed = False
+
+    def add(self, batch) -> "QueueBatchStream":
+        if self._closed:
+            raise RuntimeError("stream is closed")
+        self._queue.append(batch)
+        return self
+
+    def close(self) -> "QueueBatchStream":
+        self._closed = True
+        return self
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while self._queue:
+            item = self._queue.popleft()
+            if isinstance(item, DataFrame):
+                if item.num_rows == 0:
+                    continue  # empty frames are not end-of-stream
+                item = next(batch_stream_from_dataframe(item))
+            elif item and next(iter(item.values())).shape[0] == 0:
+                continue
+            return item
+        if self._closed:
+            raise StopIteration
+        raise QueueBatchStream.StreamDry(
+            "no batch available; add() more data or close() the stream"
+        )
+
+
+def as_batch_stream(data, batch_size: Optional[int] = None) -> Tuple[Iterator[Batch], bool]:
+    """Normalize fit() input → (batch iterator, is_bounded).
+
+    Note for unbounded feedable streams: ``rebatch`` (a generator) would be killed
+    permanently by a propagating StreamDry, so re-chunking is only applied to
+    bounded inputs; a QueueBatchStream's batches are consumed as added.
+    """
+    if isinstance(data, DataFrame):
+        return batch_stream_from_dataframe(data, batch_size), True
+    if isinstance(data, QueueBatchStream):
+        return data, False
+    it = iter(data)
+    if batch_size is not None:
+        it = rebatch(it, batch_size, drop_last=False)
+    return it, False
+
+
+class SnapshotDriver:
+    """Resumable iterator of (version, payload) model snapshots.
+
+    One ``__next__`` = pull one batch from the input stream, run ``step_fn`` on it,
+    emit the new snapshot. Implemented as a plain object (not a generator) so a
+    ``StreamDry`` from a feedable stream propagates to the caller WITHOUT
+    terminating training state — Python generators die on any raised exception.
+    """
+
+    def __init__(self, stream: Iterator[Batch], step_fn, state: Any):
+        self._stream = stream
+        self._step = step_fn
+        self.state = state
+        self.version = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Tuple[int, Any]:
+        batch = next(self._stream)  # may raise StopIteration or StreamDry
+        self.state, payload = self._step(self.state, batch)
+        self.version += 1
+        return self.version, payload
+
+
+class OnlineModelBase(ModelArraysMixin, Model):
+    """A Model fed by a stream of versioned snapshots.
+
+    Subclasses implement ``_apply_snapshot(payload)`` to install one model version.
+    The estimator attaches the training generator via ``_attach_stream``.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self.model_version: int = 0
+        self._snapshots: Iterator[Tuple[int, Any]] = iter(())
+        self.version_history: List[int] = []
+
+    # -- wiring ---------------------------------------------------------------
+    def _attach_stream(self, snapshots: Iterator[Tuple[int, Any]]) -> None:
+        self._snapshots = snapshots
+
+    def _metric_scope(self) -> str:
+        return f"{type(self).__name__}@{id(self):x}"
+
+    def _apply_snapshot(self, payload: Any) -> None:
+        raise NotImplementedError
+
+    # -- persistence: model version travels with the model data ---------------
+    # (the reference's model-data records carry modelVersion, e.g.
+    # LogisticRegressionModelData(coefficient, modelVersion))
+    def save(self, path: str) -> None:
+        from flink_ml_tpu.utils import read_write as rw
+
+        rw.save_metadata(self, path, {"modelVersion": self.model_version})
+        rw.save_model_arrays(path, self._model_arrays())
+
+    @classmethod
+    def load(cls, path: str):
+        from flink_ml_tpu.utils import read_write as rw
+
+        metadata = rw.load_metadata(path, rw.stage_class_name(cls))
+        model = cls()
+        model.load_param_map_from_json(metadata["paramMap"])
+        model._set_model_arrays(rw.load_model_arrays(path))
+        model.model_version = metadata.get("modelVersion", 0)
+        return model
+
+    # -- the public online surface -------------------------------------------
+    def advance(self, n: Optional[int] = None) -> int:
+        """Consume up to ``n`` model snapshots (None = until the stream ends);
+        returns how many were applied. Each applied snapshot bumps
+        ``ml.model.version`` / ``ml.model.timestamp`` gauges."""
+        import time
+
+        applied = 0
+        while n is None or applied < n:
+            try:
+                version, payload = next(self._snapshots)
+            except StopIteration:
+                break
+            except QueueBatchStream.StreamDry:
+                break
+            self._apply_snapshot(payload)
+            self.model_version = version
+            self.version_history.append(version)
+            scope = self._metric_scope()
+            metrics.gauge(scope, MLMetrics.VERSION, version)
+            metrics.gauge(scope, MLMetrics.TIMESTAMP, int(time.time() * 1000))
+            applied += 1
+        return applied

@@ -1,0 +1,32 @@
+"""Shared jit'd inference kernels.
+
+Single source for kernels used by several surfaces (training-side model, online
+model, runtime-free servable) so prediction semantics cannot diverge and each
+kernel has one jit cache entry.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["logistic_predict_kernel"]
+
+
+@functools.cache
+def logistic_predict_kernel():
+    """prediction = dot ≥ 0, rawPrediction = [1−p, p] with p = sigmoid(dot).
+
+    Ref LogisticRegressionModelServable.java:62 (shared by
+    LogisticRegressionModel, OnlineLogisticRegressionModel and the servable).
+    """
+
+    @jax.jit
+    def kernel(X, coef):
+        dots = X @ coef
+        prob = jax.nn.sigmoid(dots)
+        pred = (dots >= 0).astype(dots.dtype)
+        return pred, jnp.stack([1.0 - prob, prob], axis=1)
+
+    return kernel

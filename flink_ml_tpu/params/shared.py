@@ -41,6 +41,7 @@ __all__ = [
     "HasBatchStrategy",
     "HasMultiClass",
     "HasCategoricalCols",
+    "HasDecayFactor",
     "HasModelVersionCol",
     "HasMaxAllowedModelDelayMs",
     "HasWindows",
@@ -281,6 +282,21 @@ class HasCategoricalCols(WithParams):
 
     def set_categorical_cols(self, *value: str):
         return self.set(self.CATEGORICAL_COLS, list(value))
+
+
+class HasDecayFactor(WithParams):
+    DECAY_FACTOR = FloatParam(
+        "decayFactor",
+        "The forgetfulness of the previous centroids.",
+        0.0,
+        ParamValidators.in_range(0, 1),
+    )
+
+    def get_decay_factor(self) -> float:
+        return self.get(self.DECAY_FACTOR)
+
+    def set_decay_factor(self, value: float):
+        return self.set(self.DECAY_FACTOR, value)
 
 
 class HasModelVersionCol(WithParams):

@@ -1,0 +1,19 @@
+"""Serving layer — runtime-free inference.
+
+Reference: ``flink-ml-servable-core/.../servable/`` (``TransformerServable.java:38``,
+``ModelServable.java:32``, ``PipelineModelServable.java:40``) and
+``flink-ml-servable-lib`` (``LogisticRegressionModelServable.java``). "Runtime-free"
+in the reference means deployable without Flink; here it means no mesh, no iteration
+driver, no training deps — a servable is parameters + small model arrays + a cached
+single-device jit executable (SURVEY.md §7.6), loadable in any Python service.
+"""
+from flink_ml_tpu.servable.api import ModelServable, TransformerServable
+from flink_ml_tpu.servable.builder import PipelineModelServable
+from flink_ml_tpu.servable.lib import LogisticRegressionModelServable
+
+__all__ = [
+    "TransformerServable",
+    "ModelServable",
+    "PipelineModelServable",
+    "LogisticRegressionModelServable",
+]

@@ -1,0 +1,84 @@
+"""Servable interfaces.
+
+Reference: ``TransformerServable.java:38`` (``transform(DataFrame) -> DataFrame``),
+``ModelServable.java:32`` (``setModelData(InputStream...)``), and
+``ServableReadWriteUtils.loadServable`` (dispatch: read className from stage
+metadata, invoke the class's static ``loadServable(path)``).
+
+Model data travels as npz streams (the framework's model-data encoding, see
+utils/read_write.py) so a servable can be fed from a file, an object store, or a
+live training job's latest snapshot without the training stack.
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import BinaryIO, Dict
+
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.params.param import WithParams
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["TransformerServable", "ModelServable", "load_servable"]
+
+
+class TransformerServable(WithParams):
+    """Ref TransformerServable.java:38."""
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        raise NotImplementedError
+
+    # --- persistence (ServableReadWriteUtils.loadServableParam) -------------
+    @classmethod
+    def load_servable(cls, path: str) -> "TransformerServable":
+        """Ref ServableReadWriteUtils.loadServableParam — restore the params this
+        servable declares, ignoring training-only params in the stage's metadata
+        (the saved stage is usually the full training-side Model)."""
+        metadata = rw.load_metadata(path)
+        servable = cls()
+        known = {p.name for p in servable.get_param_map()}
+        servable.load_param_map_from_json(
+            {k: v for k, v in metadata["paramMap"].items() if k in known}
+        )
+        return servable
+
+
+class ModelServable(TransformerServable):
+    """Ref ModelServable.java:32 — a TransformerServable with model data."""
+
+    _MODEL_ARRAY_NAMES = ()
+
+    def set_model_data(self, *model_data_inputs: BinaryIO) -> "ModelServable":
+        """Read model arrays from npz byte stream(s)."""
+        if len(model_data_inputs) != 1:
+            raise ValueError(f"expected 1 model data stream, got {len(model_data_inputs)}")
+        with np.load(io.BytesIO(model_data_inputs[0].read())) as z:
+            arrays = {k: z[k] for k in z.files}
+        return self._apply_model_arrays(arrays)
+
+    def _apply_model_arrays(self, arrays: Dict[str, np.ndarray]) -> "ModelServable":
+        for name in self._MODEL_ARRAY_NAMES:
+            setattr(self, name, np.asarray(arrays[name]))
+        return self
+
+    @classmethod
+    def load_servable(cls, path: str) -> "ModelServable":
+        servable = super().load_servable(path)
+        servable._apply_model_arrays(rw.load_model_arrays(path))
+        return servable
+
+
+def load_servable(path: str) -> TransformerServable:
+    """Ref ServableReadWriteUtils.loadServable — className dispatch to the stage
+    class's ``load_servable``; the stage may return a different (servable) class."""
+    metadata = rw.load_metadata(path)
+    cls = rw._resolve_class(metadata["className"])
+    loader = getattr(cls, "load_servable", None)
+    if loader is None:
+        raise RuntimeError(
+            f"Failed to load servable because {metadata['className']}.load_servable(path) "
+            "is not implemented."
+        )
+    return loader(path)

@@ -1,0 +1,49 @@
+"""PipelineModelServable — chain servables loaded from a saved PipelineModel.
+
+Reference: ``servable/builder/PipelineModelServable.java:40`` (sequential
+``transform``:52-54, static ``load``), ``ServableReadWriteUtils.loadPipeline``
+(numStages from metadata, per-stage className → static loadServable dispatch).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Sequence
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.servable.api import TransformerServable, load_servable
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["PipelineModelServable"]
+
+
+class PipelineModelServable(TransformerServable):
+    """Sequentially applies its servables. Ref PipelineModelServable.java:40."""
+
+    def __init__(self, servables: Sequence[TransformerServable] = ()):
+        super().__init__()
+        self.servables: List[TransformerServable] = list(servables)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        for servable in self.servables:
+            df = servable.transform(df)
+        return df
+
+    def set_model_data(self, *model_data_inputs) -> "PipelineModelServable":
+        i = 0
+        for servable in self.servables:
+            if hasattr(servable, "set_model_data") and servable._MODEL_ARRAY_NAMES:
+                servable.set_model_data(model_data_inputs[i])
+                i += 1
+        return self
+
+    @staticmethod
+    def load(path: str) -> "PipelineModelServable":
+        """Load from a directory written by ``PipelineModel.save`` (numbered stage
+        subdirs; each stage class must implement ``load_servable``)."""
+        metadata = rw.load_metadata(path)
+        num_stages = metadata["numStages"]
+        stages_dir = os.path.join(path, "stages")
+        servables = [
+            load_servable(os.path.join(stages_dir, f"{i:08d}")) for i in range(num_stages)
+        ]
+        return PipelineModelServable(servables)

@@ -1,0 +1,56 @@
+"""Concrete servables.
+
+Reference: ``flink-ml-servable-lib/.../LogisticRegressionModelServable.java:44`` —
+``transform:62`` (dot + sigmoid per row), ``setModelData(InputStream):81``,
+``load:89``. The reference ships exactly one servable-lib model; the pattern is
+that any Model can have a runtime-free replica (SURVEY.md §2.6).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.params.shared import (
+    HasFeaturesCol,
+    HasPredictionCol,
+    HasRawPredictionCol,
+)
+from flink_ml_tpu.ops.kernels import logistic_predict_kernel
+from flink_ml_tpu.servable.api import ModelServable
+
+__all__ = ["LogisticRegressionModelServable"]
+
+
+_kernel = logistic_predict_kernel
+
+
+class LogisticRegressionModelServable(
+    ModelServable, HasFeaturesCol, HasPredictionCol, HasRawPredictionCol
+):
+    """Ref LogisticRegressionModelServable.java:44."""
+
+    _MODEL_ARRAY_NAMES = ("coefficient",)
+
+    def __init__(self):
+        super().__init__()
+        self.coefficient = None
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        """Ref transform:62 — prediction = dot ≥ 0, rawPrediction = [1−p, p]."""
+        if self.coefficient is None:
+            raise RuntimeError("set_model_data must be called before transform")
+        X = df.vectors(self.get_features_col()).astype(np.float32)
+        pred, raw = _kernel()(X, jnp.asarray(self.coefficient, jnp.float32))
+        out = df.clone()
+        out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))
+        out.add_column(
+            self.get_raw_prediction_col(),
+            DataTypes.vector(BasicType.DOUBLE),
+            np.asarray(raw, np.float64),
+        )
+        return out

@@ -1,0 +1,269 @@
+"""Online-training tests.
+
+Parity targets (SURVEY.md §4 online-algo tests): stepwise minibatch feeding via an
+in-memory source (InMemorySourceFunction analogue), per-model-version output
+assertions, and model-version metric gauges scraped like InMemoryReporter
+(OnlineKMeansTest.java:142-161, OnlineLogisticRegressionTest,
+OnlineStandardScalerTest).
+"""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.models.classification.online_logistic_regression import (
+    OnlineLogisticRegression,
+    OnlineLogisticRegressionModel,
+)
+from flink_ml_tpu.models.clustering.online_kmeans import OnlineKMeans, OnlineKMeansModel
+from flink_ml_tpu.models.feature.standard_scaler import (
+    OnlineStandardScaler,
+    StandardScaler,
+)
+from flink_ml_tpu.models.online import QueueBatchStream
+from flink_ml_tpu.ops.windows import CountTumblingWindows
+
+RNG = np.random.default_rng(33)
+
+
+def _lr_batch(n=64, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    y = (X @ np.linspace(1, -1, d) > 0).astype(np.float64)
+    return {"features": X.astype(np.float64), "label": y}
+
+
+def _init_lr_model_data(d=4):
+    from flink_ml_tpu.linalg.vectors import DenseVector
+
+    return DataFrame(["coefficient"], None, [[DenseVector(np.zeros(d))]])
+
+
+class TestOnlineLogisticRegression:
+    def test_param_defaults(self):
+        olr = OnlineLogisticRegression()
+        assert olr.get_alpha() == 0.1
+        assert olr.get_beta() == 0.1
+        assert olr.get_batch_strategy() == "count"
+        assert olr.get_global_batch_size() == 32
+
+    def test_stepwise_training_versions_and_gauges(self):
+        stream = QueueBatchStream()
+        olr = (
+            OnlineLogisticRegression()
+            .set_initial_model_data(_init_lr_model_data())
+            .set_global_batch_size(64)
+        )
+        model = olr.fit(stream)
+        assert model.model_version == 0  # init model only
+
+        stream.add(_lr_batch(seed=1))
+        assert model.advance() == 1
+        assert model.model_version == 1
+        coef_v1 = model.coefficient.copy()
+        assert not np.allclose(coef_v1, 0.0)
+
+        stream.add(_lr_batch(seed=2))
+        stream.add(_lr_batch(seed=3))
+        assert model.advance() == 2
+        assert model.model_version == 3
+        # gauges exported per version (InMemoryReporter parity)
+        scope = model._metric_scope()
+        assert metrics.get(scope, MLMetrics.VERSION) == 3
+        assert metrics.get(scope, MLMetrics.TIMESTAMP) is not None
+
+    def test_converges_with_batches(self):
+        stream = QueueBatchStream()
+        model = (
+            OnlineLogisticRegression()
+            .set_initial_model_data(_init_lr_model_data())
+            .set_alpha(0.5)
+            .fit(stream)
+        )
+        for i in range(30):
+            stream.add(_lr_batch(n=128, seed=i))
+        model.advance()
+        test = _lr_batch(n=256, seed=99)
+        df = DataFrame.from_dict(test)
+        out = model.transform(df)
+        acc = (out["prediction"] == test["label"]).mean()
+        assert acc > 0.9, acc
+        assert (out["version"] == model.model_version).all()
+
+    def test_bounded_input_trains_eagerly(self):
+        df = DataFrame.from_dict(_lr_batch(n=256, seed=7))
+        model = (
+            OnlineLogisticRegression()
+            .set_initial_model_data(_init_lr_model_data())
+            .set_global_batch_size(64)
+            .fit(df)
+        )
+        assert model.model_version == 4  # 256/64 batches consumed eagerly
+
+    def test_empty_batch_is_not_end_of_stream(self):
+        stream = QueueBatchStream()
+        model = (
+            OnlineLogisticRegression()
+            .set_initial_model_data(_init_lr_model_data())
+            .fit(stream)
+        )
+        stream.add(DataFrame.from_dict({"features": np.zeros((0, 4)), "label": np.zeros(0)}))
+        stream.add(_lr_batch(seed=1))
+        assert model.advance() == 1  # empty frame skipped, real batch trained
+        assert model.model_version == 1
+
+    def test_save_load_preserves_model_version(self, tmp_path):
+        stream = QueueBatchStream()
+        model = (
+            OnlineLogisticRegression()
+            .set_initial_model_data(_init_lr_model_data())
+            .fit(stream)
+        )
+        stream.add(_lr_batch(seed=1))
+        stream.add(_lr_batch(seed=2))
+        model.advance()
+        path = str(tmp_path / "olr")
+        model.save(path)
+        loaded = OnlineLogisticRegressionModel.load(path)
+        assert loaded.model_version == model.model_version == 2
+        np.testing.assert_allclose(loaded.coefficient, model.coefficient)
+
+    def test_ftrl_l1_produces_sparsity(self):
+        stream = QueueBatchStream()
+        model = (
+            OnlineLogisticRegression()
+            .set_initial_model_data(_init_lr_model_data())
+            .set_reg(1.0)
+            .set_elastic_net(1.0)
+            .fit(stream)
+        )
+        stream.add(_lr_batch(seed=1))
+        model.advance()
+        assert np.count_nonzero(model.coefficient) < model.coefficient.size
+
+
+class TestOnlineKMeans:
+    def test_stepwise_updates_move_centroids(self):
+        stream = QueueBatchStream()
+        okm = (
+            OnlineKMeans()
+            .set_k(2)
+            .set_seed(1)
+            .set_decay_factor(0.5)
+            .set_random_initial_model_data(dim=2)
+        )
+        model = okm.fit(stream)
+        c0 = model.centroids.copy()
+
+        pts = np.concatenate(
+            [RNG.normal([0, 0], 0.1, (32, 2)), RNG.normal([5, 5], 0.1, (32, 2))]
+        )
+        stream.add({"features": pts})
+        assert model.advance() == 1
+        assert not np.allclose(model.centroids, c0)
+        assert model.weights.sum() > 0
+
+        # more batches refine towards the true blob centers
+        for seed in range(8):
+            rng = np.random.default_rng(seed)
+            pts = np.concatenate(
+                [rng.normal([0, 0], 0.1, (32, 2)), rng.normal([5, 5], 0.1, (32, 2))]
+            )
+            stream.add({"features": pts})
+        model.advance()
+        got = model.centroids[np.argsort(model.centroids[:, 0])]
+        np.testing.assert_allclose(got, [[0, 0], [5, 5]], atol=0.5)
+
+    def test_transform_uses_latest_version(self):
+        stream = QueueBatchStream()
+        model = (
+            OnlineKMeans().set_k(2).set_seed(3).set_random_initial_model_data(dim=2).fit(stream)
+        )
+        pts = np.concatenate(
+            [RNG.normal([0, 0], 0.1, (16, 2)), RNG.normal([5, 5], 0.1, (16, 2))]
+        )
+        stream.add({"features": pts})
+        model.advance()
+        pred = model.transform(DataFrame.from_dict({"features": pts}))["prediction"]
+        assert len(set(pred[:16])) == 1 and len(set(pred[16:])) == 1
+
+    def test_requires_initial_model(self):
+        with pytest.raises(RuntimeError, match="initial model"):
+            OnlineKMeans().fit(QueueBatchStream())
+
+
+class TestOnlineStandardScaler:
+    def test_versions_per_window_and_cumulative_stats(self):
+        df = DataFrame.from_dict({"input": np.arange(12.0)[:, None]})
+        scaler = OnlineStandardScaler().set_windows(CountTumblingWindows.of(4))
+        model = scaler.fit(df)
+        # 12 rows / window=4 → 3 windows, versions 0,1,2 (0-based like the reference)
+        assert model.version_history == [0, 1, 2]
+        assert model.model_version == 2
+        # cumulative stats equal the batch scaler on all 12 rows
+        batch_model = StandardScaler().set_input_col("input").fit(
+            DataFrame.from_dict({"input": np.arange(12.0)[:, None]})
+        )
+        np.testing.assert_allclose(model.mean, batch_model.mean, atol=1e-6)
+        np.testing.assert_allclose(model.std, batch_model.std, atol=1e-6)
+
+    def test_stepwise_feed_each_batch_is_window(self):
+        stream = QueueBatchStream()
+        model = OnlineStandardScaler().fit(stream)
+        stream.add({"input": np.asarray([[1.0], [3.0]])})
+        assert model.advance() == 1
+        assert model.model_version == 0
+        np.testing.assert_allclose(model.mean, [2.0])
+        stream.add({"input": np.asarray([[5.0], [7.0]])})
+        model.advance()
+        assert model.model_version == 1
+        np.testing.assert_allclose(model.mean, [4.0])  # cumulative over 4 rows
+
+    def test_transform_appends_version_column(self):
+        df = DataFrame.from_dict({"input": RNG.normal(size=(8, 3))})
+        model = OnlineStandardScaler().fit(df)
+        out = model.transform(df)
+        assert (out["version"] == model.model_version).all()
+        scaled = out["output"]
+        np.testing.assert_allclose(scaled.std(axis=0, ddof=1), 1.0, atol=1e-4)
+
+
+class TestBatchStandardScaler:
+    def test_fit_transform_defaults(self):
+        X = RNG.normal(2.0, 3.0, size=(100, 4))
+        df = DataFrame.from_dict({"input": X})
+        model = StandardScaler().fit(df)
+        out = model.transform(df)["output"]
+        # withStd only (default): scaled by sample std, mean NOT removed
+        np.testing.assert_allclose(out.std(axis=0, ddof=1), 1.0, atol=1e-4)
+        assert abs(out.mean()) > 0.1
+
+    def test_with_mean_with_std(self):
+        X = RNG.normal(5.0, 2.0, size=(50, 2))
+        df = DataFrame.from_dict({"input": X})
+        model = StandardScaler().set_with_mean(True).fit(df)
+        out = model.transform(df)["output"]
+        np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(out.std(axis=0, ddof=1), 1.0, atol=1e-4)
+
+    def test_zero_std_maps_to_zero(self):
+        X = np.ones((5, 2))
+        model = StandardScaler().fit(DataFrame.from_dict({"input": X}))
+        out = model.transform(DataFrame.from_dict({"input": X}))["output"]
+        np.testing.assert_array_equal(out, 0.0)
+
+    def test_empty_input_raises(self):
+        df = DataFrame(["input"], None, [np.zeros((0, 2))])
+        with pytest.raises(RuntimeError, match="training set is empty"):
+            StandardScaler().fit(df)
+
+    def test_save_load(self, tmp_path):
+        X = RNG.normal(size=(20, 3))
+        model = StandardScaler().fit(DataFrame.from_dict({"input": X}))
+        path = str(tmp_path / "ss")
+        model.save(path)
+        from flink_ml_tpu.models.feature.standard_scaler import StandardScalerModel
+
+        loaded = StandardScalerModel.load(path)
+        np.testing.assert_allclose(loaded.mean, model.mean)
+        np.testing.assert_allclose(loaded.std, model.std)

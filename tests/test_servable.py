@@ -1,0 +1,78 @@
+"""Serving-layer tests — parity with ``PipelineModelServableTest`` and the
+LogisticRegressionModelServable round-trip (SURVEY.md §3.4: the serving path must
+work with no training runtime involved)."""
+import io
+import os
+
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.builder.pipeline import PipelineModel
+from flink_ml_tpu.models.classification.logistic_regression import LogisticRegression
+from flink_ml_tpu.servable import (
+    LogisticRegressionModelServable,
+    PipelineModelServable,
+)
+
+RNG = np.random.default_rng(21)
+
+
+def _fit_lr(n=128, d=3):
+    X = RNG.normal(size=(n, d))
+    y = (X @ np.arange(1.0, d + 1.0) > 0).astype(np.float64)
+    df = DataFrame.from_dict({"features": X, "label": y})
+    model = LogisticRegression().set_max_iter(30).set_global_batch_size(n).fit(df)
+    return model, df, y
+
+
+def test_servable_from_saved_model(tmp_path):
+    model, df, y = _fit_lr()
+    path = str(tmp_path / "lr")
+    model.save(path)
+    servable = LogisticRegressionModelServable.load_servable(path)
+    out = servable.transform(df)
+    np.testing.assert_array_equal(out["prediction"], model.transform(df)["prediction"])
+
+
+def test_servable_set_model_data_stream(tmp_path):
+    """Model data fed as a byte stream (ModelServable.setModelData:81 analogue)."""
+    model, df, _ = _fit_lr()
+    buf = io.BytesIO()
+    np.savez(buf, coefficient=model.coefficient)
+    buf.seek(0)
+    servable = LogisticRegressionModelServable()
+    servable.set_model_data(buf)
+    np.testing.assert_allclose(servable.coefficient, model.coefficient)
+    out = servable.transform(df)
+    raw = out["rawPrediction"]
+    np.testing.assert_allclose(raw.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_pipeline_model_servable_load_and_transform(tmp_path):
+    """PipelineModel.save → PipelineModelServable.load → identical predictions
+    (PipelineModelServable.java:40-54)."""
+    model, df, _ = _fit_lr()
+    pipeline_model = PipelineModel([model])
+    path = str(tmp_path / "pipe")
+    pipeline_model.save(path)
+    servable = PipelineModelServable.load(path)
+    assert len(servable.servables) == 1
+    assert isinstance(servable.servables[0], LogisticRegressionModelServable)
+    out = servable.transform(df)
+    np.testing.assert_array_equal(
+        out["prediction"], pipeline_model.transform(df)["prediction"]
+    )
+
+
+def test_load_servable_missing_method_errors(tmp_path):
+    """Stages without load_servable fail with the reference's error shape."""
+    from flink_ml_tpu.models.clustering.kmeans import KMeans
+    import pytest
+
+    est = KMeans()
+    path = str(tmp_path / "km")
+    est.save(path)
+    from flink_ml_tpu.servable.api import load_servable
+
+    with pytest.raises(RuntimeError, match="load_servable"):
+        load_servable(path)
