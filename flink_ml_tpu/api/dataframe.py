@@ -96,6 +96,16 @@ def _normalize_column(col: Any) -> Column:
     if isinstance(col, np.ndarray):
         return col
     col = list(col)
+    if col and isinstance(col[0], (list, tuple)):
+        # Numeric lists of equal length densify to a [n, d] array; true ragged data
+        # (token lists, strings, varying lengths) stays a Python list.
+        try:
+            arr = np.asarray(col)
+            if arr.dtype.kind in "biufc" and arr.ndim == 2:
+                return arr
+        except (ValueError, TypeError):
+            pass
+        return col
     if col and isinstance(col[0], DenseVector):
         dims = {v.size() for v in col if v is not None}
         if len(dims) == 1 and not any(v is None for v in col):

@@ -24,7 +24,52 @@ STAGE_REGISTRY = {
     "KMeansModel": "flink_ml_tpu.models.clustering.kmeans.KMeansModel",
     "OnlineKMeans": "flink_ml_tpu.models.clustering.online_kmeans.OnlineKMeans",
     "OnlineKMeansModel": "flink_ml_tpu.models.clustering.online_kmeans.OnlineKMeansModel",
-    # feature
+    # feature (stateless)
+    "Binarizer": "flink_ml_tpu.models.feature.binarizer.Binarizer",
+    "Bucketizer": "flink_ml_tpu.models.feature.bucketizer.Bucketizer",
+    "DCT": "flink_ml_tpu.models.feature.dct.DCT",
+    "ElementwiseProduct": "flink_ml_tpu.models.feature.elementwise_product.ElementwiseProduct",
+    "FeatureHasher": "flink_ml_tpu.models.feature.feature_hasher.FeatureHasher",
+    "HashingTF": "flink_ml_tpu.models.feature.hashing_tf.HashingTF",
+    "Interaction": "flink_ml_tpu.models.feature.interaction.Interaction",
+    "NGram": "flink_ml_tpu.models.feature.ngram.NGram",
+    "Normalizer": "flink_ml_tpu.models.feature.normalizer.Normalizer",
+    "PolynomialExpansion": "flink_ml_tpu.models.feature.polynomial_expansion.PolynomialExpansion",
+    "RandomSplitter": "flink_ml_tpu.models.feature.random_splitter.RandomSplitter",
+    "RegexTokenizer": "flink_ml_tpu.models.feature.tokenizer.RegexTokenizer",
+    "SQLTransformer": "flink_ml_tpu.models.feature.sql_transformer.SQLTransformer",
+    "StopWordsRemover": "flink_ml_tpu.models.feature.stop_words_remover.StopWordsRemover",
+    "Tokenizer": "flink_ml_tpu.models.feature.tokenizer.Tokenizer",
+    "VectorAssembler": "flink_ml_tpu.models.feature.vector_assembler.VectorAssembler",
+    "VectorSlicer": "flink_ml_tpu.models.feature.vector_slicer.VectorSlicer",
+    # feature (fitted)
+    "CountVectorizer": "flink_ml_tpu.models.feature.count_vectorizer.CountVectorizer",
+    "CountVectorizerModel": "flink_ml_tpu.models.feature.count_vectorizer.CountVectorizerModel",
+    "IDF": "flink_ml_tpu.models.feature.idf.IDF",
+    "IDFModel": "flink_ml_tpu.models.feature.idf.IDFModel",
+    "Imputer": "flink_ml_tpu.models.feature.imputer.Imputer",
+    "ImputerModel": "flink_ml_tpu.models.feature.imputer.ImputerModel",
+    "IndexToStringModel": "flink_ml_tpu.models.feature.string_indexer.IndexToStringModel",
+    "KBinsDiscretizer": "flink_ml_tpu.models.feature.kbins_discretizer.KBinsDiscretizer",
+    "KBinsDiscretizerModel": "flink_ml_tpu.models.feature.kbins_discretizer.KBinsDiscretizerModel",
+    "MaxAbsScaler": "flink_ml_tpu.models.feature.scalers.MaxAbsScaler",
+    "MaxAbsScalerModel": "flink_ml_tpu.models.feature.scalers.MaxAbsScalerModel",
+    "MinHashLSH": "flink_ml_tpu.models.feature.lsh.MinHashLSH",
+    "MinHashLSHModel": "flink_ml_tpu.models.feature.lsh.MinHashLSHModel",
+    "MinMaxScaler": "flink_ml_tpu.models.feature.scalers.MinMaxScaler",
+    "MinMaxScalerModel": "flink_ml_tpu.models.feature.scalers.MinMaxScalerModel",
+    "OneHotEncoder": "flink_ml_tpu.models.feature.one_hot_encoder.OneHotEncoder",
+    "OneHotEncoderModel": "flink_ml_tpu.models.feature.one_hot_encoder.OneHotEncoderModel",
+    "RobustScaler": "flink_ml_tpu.models.feature.scalers.RobustScaler",
+    "RobustScalerModel": "flink_ml_tpu.models.feature.scalers.RobustScalerModel",
+    "StringIndexer": "flink_ml_tpu.models.feature.string_indexer.StringIndexer",
+    "StringIndexerModel": "flink_ml_tpu.models.feature.string_indexer.StringIndexerModel",
+    "UnivariateFeatureSelector": "flink_ml_tpu.models.feature.univariate_feature_selector.UnivariateFeatureSelector",
+    "UnivariateFeatureSelectorModel": "flink_ml_tpu.models.feature.univariate_feature_selector.UnivariateFeatureSelectorModel",
+    "VarianceThresholdSelector": "flink_ml_tpu.models.feature.variance_threshold_selector.VarianceThresholdSelector",
+    "VarianceThresholdSelectorModel": "flink_ml_tpu.models.feature.variance_threshold_selector.VarianceThresholdSelectorModel",
+    "VectorIndexer": "flink_ml_tpu.models.feature.vector_indexer.VectorIndexer",
+    "VectorIndexerModel": "flink_ml_tpu.models.feature.vector_indexer.VectorIndexerModel",
     "StandardScaler": "flink_ml_tpu.models.feature.standard_scaler.StandardScaler",
     "StandardScalerModel": "flink_ml_tpu.models.feature.standard_scaler.StandardScalerModel",
     "OnlineStandardScaler": "flink_ml_tpu.models.feature.standard_scaler.OnlineStandardScaler",

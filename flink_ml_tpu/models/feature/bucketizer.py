@@ -1,0 +1,81 @@
+"""Bucketizer.
+
+Reference: ``flink-ml-lib/.../feature/bucketizer/Bucketizer.java`` — multi-column:
+value in [splits[j], splits[j+1]) → bucket j (last bucket right-inclusive);
+values outside the splits or NaN are invalid, handled per ``handleInvalid``:
+'error' raises, 'skip' drops the row, 'keep' maps to the extra bucket numSplits-1.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.params.param import Param, ParamValidators
+from flink_ml_tpu.params.shared import HasHandleInvalid, HasInputCols, HasOutputCols
+
+__all__ = ["Bucketizer"]
+
+
+def _splits_valid(splits_array) -> bool:
+    if not splits_array:
+        return False
+    for splits in splits_array:
+        if len(splits) < 3:
+            return False
+        if any(splits[i] >= splits[i + 1] for i in range(len(splits) - 1)):
+            return False
+    return True
+
+
+class Bucketizer(Transformer, HasInputCols, HasOutputCols, HasHandleInvalid):
+    """Ref Bucketizer.java."""
+
+    SPLITS_ARRAY = Param(
+        "splitsArray",
+        "Array of split points for mapping continuous features into buckets.",
+        None,
+        lambda v: v is not None and _splits_valid(v),
+    )
+
+    def get_splits_array(self):
+        return self.get(self.SPLITS_ARRAY)
+
+    def set_splits_array(self, value):
+        return self.set(self.SPLITS_ARRAY, [list(s) for s in value])
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        in_cols, out_cols = self.get_input_cols(), self.get_output_cols()
+        splits_array = self.get_splits_array()
+        handle = self.get_handle_invalid()
+        if len(in_cols) != len(splits_array):
+            raise ValueError("Bucketizer: one splits array per input column required")
+
+        n = len(df)
+        keep_mask = np.ones(n, bool)
+        buckets = []
+        for name, splits in zip(in_cols, splits_array):
+            x = df.scalars(name)
+            splits = np.asarray(splits, np.float64)
+            # bucket j for [splits[j], splits[j+1]); last bucket right-inclusive
+            idx = np.searchsorted(splits, x, side="right") - 1
+            idx = np.where(x == splits[-1], len(splits) - 2, idx)
+            invalid = (x < splits[0]) | (x > splits[-1]) | np.isnan(x)
+            if handle == "error" and invalid.any():
+                raise ValueError(
+                    f"The input contains invalid value {x[invalid][0]} for column {name}. "
+                    "See Bucketizer handleInvalid."
+                )
+            if handle == "keep":
+                idx = np.where(invalid, len(splits) - 1, idx)
+            else:  # skip
+                keep_mask &= ~invalid
+            buckets.append(idx.astype(np.float64))
+
+        out = df.clone()
+        for out_name, idx in zip(out_cols, buckets):
+            out.add_column(out_name, DataTypes.DOUBLE, idx)
+        if handle == "skip" and not keep_mask.all():
+            out = out.take(np.nonzero(keep_mask)[0])
+        return out

@@ -1,0 +1,158 @@
+"""CountVectorizer.
+
+Reference: ``flink-ml-lib/.../feature/countvectorizer/`` — learn a vocabulary
+from token lists (document frequency filtered by ``minDF``/``maxDF``, absolute
+when ≥ 1 else fraction of documents; kept terms ordered by frequency descending,
+capped at ``vocabularySize``) and transform documents into term-count sparse
+vectors (``minTF`` per-document filter, absolute or fraction of the document's
+token count; ``binary`` maps all counts to 1).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.params.param import BoolParam, FloatParam, IntParam, ParamValidators, update_existing_params
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["CountVectorizer", "CountVectorizerModel"]
+
+
+class _CvParams(HasInputCol, HasOutputCol):
+    VOCABULARY_SIZE = IntParam(
+        "vocabularySize", "Max size of the vocabulary.", 1 << 18, ParamValidators.gt(0)
+    )
+    MIN_DF = FloatParam(
+        "minDF",
+        "Minimum number (>=1) or fraction (<1) of documents a term must appear in.",
+        1.0,
+        ParamValidators.gt_eq(0),
+    )
+    MAX_DF = FloatParam(
+        "maxDF",
+        "Maximum number (>=1) or fraction (<1) of documents a term may appear in.",
+        float(2**63 - 1),
+        ParamValidators.gt_eq(0),
+    )
+    MIN_TF = FloatParam(
+        "minTF",
+        "Minimum count (>=1) or fraction of the document's token count (<1) to include a term.",
+        1.0,
+        ParamValidators.gt_eq(0),
+    )
+    BINARY = BoolParam("binary", "Binary toggle for the output counts.", False)
+
+    def get_vocabulary_size(self) -> int:
+        return self.get(self.VOCABULARY_SIZE)
+
+    def set_vocabulary_size(self, value: int):
+        return self.set(self.VOCABULARY_SIZE, value)
+
+    def get_min_df(self) -> float:
+        return self.get(self.MIN_DF)
+
+    def set_min_df(self, value: float):
+        return self.set(self.MIN_DF, value)
+
+    def get_max_df(self) -> float:
+        return self.get(self.MAX_DF)
+
+    def set_max_df(self, value: float):
+        return self.set(self.MAX_DF, value)
+
+    def get_min_tf(self) -> float:
+        return self.get(self.MIN_TF)
+
+    def set_min_tf(self, value: float):
+        return self.set(self.MIN_TF, value)
+
+    def get_binary(self) -> bool:
+        return self.get(self.BINARY)
+
+    def set_binary(self, value: bool):
+        return self.set(self.BINARY, value)
+
+
+class CountVectorizerModel(Model, _CvParams):
+    """Ref CountVectorizerModel.java — vocabulary-indexed term counts."""
+
+    def __init__(self):
+        super().__init__()
+        self.vocabulary: Optional[List[str]] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        vocab = {term: i for i, term in enumerate(self.vocabulary)}
+        min_tf = self.get_min_tf()
+        binary = self.get_binary()
+        vectors = []
+        for tokens in df.column(self.get_input_col()):
+            counts = {}
+            for t in tokens:
+                if t in vocab:
+                    counts[vocab[t]] = counts.get(vocab[t], 0) + 1
+            threshold = min_tf if min_tf >= 1.0 else min_tf * len(tokens)
+            items = [(i, c) for i, c in sorted(counts.items()) if c >= threshold]
+            indices = np.asarray([i for i, _ in items], np.int64)
+            values = np.asarray([1.0 if binary else float(c) for _, c in items])
+            vectors.append(SparseVector(len(vocab), indices, values))
+        out = df.clone()
+        out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), vectors)
+        return out
+
+    # model data = the ordered vocabulary
+    def get_model_data(self):
+        return [DataFrame(["vocabulary"], None, [[list(self.vocabulary)]])]
+
+    def set_model_data(self, *model_data: DataFrame):
+        self.vocabulary = list(model_data[0].column("vocabulary")[0])
+        return self
+
+    def save(self, path: str) -> None:
+        rw.save_metadata(self, path)
+        rw.save_model_arrays(path, {"vocabulary": np.asarray(self.vocabulary, dtype=str)})
+
+    @classmethod
+    def load(cls, path: str):
+        metadata = rw.load_metadata(path, rw.stage_class_name(cls))
+        model = cls()
+        model.load_param_map_from_json(metadata["paramMap"])
+        model.vocabulary = [str(s) for s in rw.load_model_arrays(path)["vocabulary"]]
+        return model
+
+
+class CountVectorizer(Estimator, _CvParams):
+    """Ref CountVectorizer.java."""
+
+    def fit(self, *inputs) -> CountVectorizerModel:
+        (df,) = inputs
+        col = df.column(self.get_input_col())
+        num_docs = len(col)
+        doc_freq = {}
+        term_count = {}
+        for tokens in col:
+            for t in set(tokens):
+                doc_freq[t] = doc_freq.get(t, 0) + 1
+            for t in tokens:
+                term_count[t] = term_count.get(t, 0) + 1
+        min_df = self.get_min_df()
+        max_df = self.get_max_df()
+        lo = min_df if min_df >= 1.0 else min_df * num_docs
+        hi = max_df if max_df >= 1.0 else max_df * num_docs
+        if lo > hi:
+            raise ValueError("maxDF must be >= minDF")
+        kept = [t for t, dfreq in doc_freq.items() if lo <= dfreq <= hi]
+        kept.sort(key=lambda t: (-term_count[t], t))
+        vocab = kept[: self.get_vocabulary_size()]
+        if not vocab:
+            raise RuntimeError("The vocabulary is empty; check minDF/maxDF settings.")
+        model = CountVectorizerModel()
+        update_existing_params(model, self)
+        model.vocabulary = vocab
+        return model

@@ -1,0 +1,55 @@
+"""ElementwiseProduct.
+
+Reference: ``flink-ml-lib/.../feature/elementwiseproduct/ElementwiseProduct.java`` —
+Hadamard product of each input vector with the ``scalingVec`` param.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.linalg.vectors import SparseVector, Vector
+from flink_ml_tpu.params.param import ParamValidators, VectorParam
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+
+__all__ = ["ElementwiseProduct"]
+
+
+class ElementwiseProduct(Transformer, HasInputCol, HasOutputCol):
+    """Ref ElementwiseProduct.java."""
+
+    SCALING_VEC = VectorParam(
+        "scalingVec",
+        "The scaling vector to multiply with input vectors using hadamard product.",
+        None,
+        ParamValidators.not_null(),
+    )
+
+    def get_scaling_vec(self):
+        return self.get(self.SCALING_VEC)
+
+    def set_scaling_vec(self, value):
+        return self.set(self.SCALING_VEC, value)
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        scaling = self.get_scaling_vec()
+        s = scaling.to_array() if isinstance(scaling, Vector) else np.asarray(scaling)
+        col = df.column(self.get_input_col())
+        out = df.clone()
+        if isinstance(col, np.ndarray):
+            out.add_column(
+                self.get_output_col(),
+                DataTypes.vector(BasicType.DOUBLE),
+                col.astype(np.float64) * s[None, :],
+            )
+        else:  # sparse vectors stay sparse (product with stored values only)
+            new_col = [
+                SparseVector(v.size(), v.indices, v.values * s[v.indices])
+                if isinstance(v, SparseVector)
+                else v.to_array() * s
+                for v in col
+            ]
+            out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), new_col)
+        return out

@@ -1,0 +1,83 @@
+"""FeatureHasher.
+
+Reference: ``flink-ml-lib/.../feature/featurehasher/FeatureHasher.java`` — project
+numeric and categorical columns into a ``numFeatures``-dim sparse vector:
+numeric col → index hash(colName), value x; categorical col → index
+hash("col=value"), value 1.0; index = Math.abs(murmur3_32(0).hashUnencodedChars(s))
+% numFeatures (FeatureHasher.java:185-190); collisions accumulate.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.ops import hashing
+from flink_ml_tpu.params.param import IntParam, ParamValidators
+from flink_ml_tpu.params.shared import HasCategoricalCols, HasInputCols, HasOutputCol
+
+__all__ = ["FeatureHasher"]
+
+
+def _index(s: str, num_features: int) -> int:
+    return hashing.java_abs(hashing.hash_unencoded_chars(s)) % num_features
+
+
+class FeatureHasher(Transformer, HasInputCols, HasOutputCol, HasCategoricalCols):
+    """Ref FeatureHasher.java."""
+
+    NUM_FEATURES = IntParam(
+        "numFeatures", "The number of features.", 1 << 18, ParamValidators.gt(0)
+    )
+
+    def get_num_features(self) -> int:
+        return self.get(self.NUM_FEATURES)
+
+    def set_num_features(self, value: int):
+        return self.set(self.NUM_FEATURES, value)
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        num_features = self.get_num_features()
+        in_cols = list(self.get_input_cols())
+        cat_cols = list(self.get_categorical_cols())
+        if cat_cols and not set(cat_cols) <= set(in_cols):
+            raise ValueError("CategoricalCols must be included in inputCols!")
+        # Non-declared string/bool columns are treated as categorical like the
+        # reference's schema inspection (FeatureHasher.generateCategoricalCols).
+        num_cols = []
+        for name in in_cols:
+            if name in cat_cols:
+                continue
+            col = df.column(name)
+            if isinstance(col, np.ndarray) and np.issubdtype(col.dtype, np.number):
+                num_cols.append(name)
+            else:
+                cat_cols.append(name)
+
+        n = len(df)
+        vectors = []
+        columns = {name: df.column(name) for name in in_cols}
+        for i in range(n):
+            feature = {}
+            for name in num_cols:
+                v = columns[name][i]
+                if v is None:
+                    continue
+                idx = _index(name, num_features)
+                feature[idx] = feature.get(idx, 0.0) + float(v)
+            for name in cat_cols:
+                v = columns[name][i]
+                if v is None:
+                    continue
+                if isinstance(v, (bool, np.bool_)):
+                    v = "true" if v else "false"  # Java String.valueOf(boolean)
+                idx = _index(f"{name}={v}", num_features)
+                feature[idx] = feature.get(idx, 0.0) + 1.0
+            indices = np.asarray(sorted(feature), np.int64)
+            values = np.asarray([feature[j] for j in indices], np.float64)
+            vectors.append(SparseVector(num_features, indices, values))
+        out = df.clone()
+        out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), vectors)
+        return out

@@ -1,0 +1,80 @@
+"""HashingTF.
+
+Reference: ``flink-ml-lib/.../feature/hashingtf/HashingTF.java`` — map a list of
+terms to a sparse term-frequency vector of ``numFeatures`` dims using the hashing
+trick: index = nonNegativeMod(murmur3_32(0)(term)) (HashingTF.java:137-138,
+161-193); counts, or 1s when ``binary``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.ops import hashing
+from flink_ml_tpu.params.param import BoolParam, IntParam, ParamValidators
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+
+__all__ = ["HashingTF"]
+
+
+def _hash(obj) -> int:
+    """Ref HashingTF.hash:161 — type-dispatched guava murmur3_32(0)."""
+    if obj is None:
+        return 0
+    if isinstance(obj, bool):
+        return hashing.hash_int(1 if obj else 0)
+    if isinstance(obj, (int, np.integer)):
+        v = int(obj)
+        if -(1 << 31) <= v < (1 << 31):
+            return hashing.hash_int(v)
+        return hashing.hash_long(v)
+    if isinstance(obj, (float, np.floating)):
+        return hashing.hash_long(
+            int.from_bytes(np.float64(obj).tobytes(), "little", signed=False)
+        )
+    if isinstance(obj, str):
+        return hashing.hash_unencoded_chars(obj)
+    raise TypeError(f"HashingTF does not support type {type(obj).__name__} of input data.")
+
+
+class HashingTF(Transformer, HasInputCol, HasOutputCol):
+    """Ref HashingTF.java."""
+
+    BINARY = BoolParam(
+        "binary", "Whether each dimension of the output vector is binary or not.", False
+    )
+    NUM_FEATURES = IntParam(
+        "numFeatures", "The number of features.", 1 << 18, ParamValidators.gt(0)
+    )
+
+    def get_binary(self) -> bool:
+        return self.get(self.BINARY)
+
+    def set_binary(self, value: bool):
+        return self.set(self.BINARY, value)
+
+    def get_num_features(self) -> int:
+        return self.get(self.NUM_FEATURES)
+
+    def set_num_features(self, value: int):
+        return self.set(self.NUM_FEATURES, value)
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        num_features = self.get_num_features()
+        binary = self.get_binary()
+        col = df.column(self.get_input_col())
+        vectors = []
+        for terms in col:
+            counts = {}
+            for term in terms:
+                idx = hashing.non_negative_mod(_hash(term), num_features)
+                counts[idx] = 1 if (binary or idx not in counts) else counts[idx] + 1
+            indices = np.asarray(sorted(counts), np.int64)
+            values = np.asarray([counts[i] for i in indices], np.float64)
+            vectors.append(SparseVector(num_features, indices, values))
+        out = df.clone()
+        out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), vectors)
+        return out

@@ -1,0 +1,98 @@
+"""IDF.
+
+Reference: ``flink-ml-lib/.../feature/idf/IDF.java`` — fit: document frequency per
+term dimension; idf[i] = log((numDocs + 1)/(df[i] + 1)), dims with df < minDocFreq
+get idf 0; transform multiplies term-frequency vectors elementwise by idf.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.linalg.vectors import SparseVector, Vector
+from flink_ml_tpu.models.common import ModelArraysMixin
+from flink_ml_tpu.params.param import IntParam, ParamValidators, update_existing_params
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+
+__all__ = ["IDF", "IDFModel"]
+
+
+class _IDFParams(HasInputCol, HasOutputCol):
+    MIN_DOC_FREQ = IntParam(
+        "minDocFreq",
+        "Minimum number of documents that a term should appear for filtering.",
+        0,
+        ParamValidators.gt_eq(0),
+    )
+
+    def get_min_doc_freq(self) -> int:
+        return self.get(self.MIN_DOC_FREQ)
+
+    def set_min_doc_freq(self, value: int):
+        return self.set(self.MIN_DOC_FREQ, value)
+
+
+class IDFModel(ModelArraysMixin, Model, _IDFParams):
+    """Ref IDFModel.java."""
+
+    _MODEL_ARRAY_NAMES = ("idf", "doc_freq", "num_docs")
+
+    def __init__(self):
+        super().__init__()
+        self.idf: Optional[np.ndarray] = None
+        self.doc_freq: Optional[np.ndarray] = None
+        self.num_docs: Optional[np.ndarray] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        col = df.column(self.get_input_col())
+        out = df.clone()
+        if isinstance(col, np.ndarray):
+            out.add_column(
+                self.get_output_col(),
+                DataTypes.vector(BasicType.DOUBLE),
+                col.astype(np.float64) * self.idf[None, :],
+            )
+        else:
+            new_col = [
+                SparseVector(v.size(), v.indices, v.values * self.idf[v.indices])
+                if isinstance(v, SparseVector)
+                else v.to_array() * self.idf
+                for v in col
+            ]
+            out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), new_col)
+        return out
+
+
+class IDF(Estimator, _IDFParams):
+    """Ref IDF.java."""
+
+    def fit(self, *inputs) -> IDFModel:
+        (df,) = inputs
+        col = df.column(self.get_input_col())
+        if isinstance(col, np.ndarray):
+            docs = col.astype(np.float64)
+            doc_freq = (docs != 0).sum(axis=0).astype(np.float64)
+            num_docs = docs.shape[0]
+        else:
+            dim = col[0].size() if isinstance(col[0], Vector) else len(col[0])
+            doc_freq = np.zeros(dim)
+            for v in col:
+                if isinstance(v, SparseVector):
+                    doc_freq[v.indices[v.values != 0]] += 1
+                else:
+                    doc_freq[np.asarray(v.to_array()) != 0] += 1
+            num_docs = len(col)
+        min_df = self.get_min_doc_freq()
+        idf = np.where(
+            doc_freq >= min_df, np.log((num_docs + 1.0) / (doc_freq + 1.0)), 0.0
+        )
+        model = IDFModel()
+        update_existing_params(model, self)
+        model.idf = idf
+        model.doc_freq = doc_freq
+        model.num_docs = np.asarray([num_docs])
+        return model

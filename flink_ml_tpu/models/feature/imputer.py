@@ -1,0 +1,97 @@
+"""Imputer.
+
+Reference: ``flink-ml-lib/.../feature/imputer/Imputer.java`` — multi-column
+completion of missing values (``missingValue``, default NaN) with the column's
+mean / median / most_frequent surrogate computed over non-missing entries.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.models.common import ModelArraysMixin
+from flink_ml_tpu.params.param import FloatParam, ParamValidators, StringParam, update_existing_params
+from flink_ml_tpu.params.shared import HasInputCols, HasOutputCols, HasRelativeError
+
+__all__ = ["Imputer", "ImputerModel"]
+
+
+class _ImputerParams(HasInputCols, HasOutputCols, HasRelativeError):
+    MEAN, MEDIAN, MOST_FREQUENT = "mean", "median", "most_frequent"
+
+    STRATEGY = StringParam(
+        "strategy",
+        "The imputation strategy.",
+        "mean",
+        ParamValidators.in_array(["mean", "median", "most_frequent"]),
+    )
+    MISSING_VALUE = FloatParam(
+        "missingValue", "The placeholder for the missing values.", float("nan")
+    )
+
+    def get_strategy(self) -> str:
+        return self.get(self.STRATEGY)
+
+    def set_strategy(self, value: str):
+        return self.set(self.STRATEGY, value)
+
+    def get_missing_value(self) -> float:
+        return self.get(self.MISSING_VALUE)
+
+    def set_missing_value(self, value: float):
+        return self.set(self.MISSING_VALUE, value)
+
+
+def _is_missing(x: np.ndarray, missing: float) -> np.ndarray:
+    return np.isnan(x) if np.isnan(missing) else (x == missing)
+
+
+class ImputerModel(ModelArraysMixin, Model, _ImputerParams):
+    """Ref ImputerModel.java — surrogate per input column."""
+
+    _MODEL_ARRAY_NAMES = ("surrogates",)
+
+    def __init__(self):
+        super().__init__()
+        self.surrogates: Optional[np.ndarray] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        missing = self.get_missing_value()
+        out = df.clone()
+        for i, (in_name, out_name) in enumerate(
+            zip(self.get_input_cols(), self.get_output_cols())
+        ):
+            x = df.scalars(in_name)
+            filled = np.where(_is_missing(x, missing), self.surrogates[i], x)
+            out.add_column(out_name, DataTypes.DOUBLE, filled)
+        return out
+
+
+class Imputer(Estimator, _ImputerParams):
+    """Ref Imputer.java."""
+
+    def fit(self, *inputs) -> ImputerModel:
+        (df,) = inputs
+        strategy = self.get_strategy()
+        missing = self.get_missing_value()
+        surrogates = []
+        for name in self.get_input_cols():
+            x = df.scalars(name)
+            valid = x[~_is_missing(x, missing) & ~np.isnan(x)]
+            if valid.size == 0:
+                raise RuntimeError(f"Imputer: column {name} has no valid values to fit.")
+            if strategy == self.MEAN:
+                surrogates.append(valid.mean())
+            elif strategy == self.MEDIAN:
+                surrogates.append(np.median(valid))
+            else:  # most_frequent: smallest among the modes, like the reference's map
+                vals, counts = np.unique(valid, return_counts=True)
+                surrogates.append(vals[np.argmax(counts)])
+        model = ImputerModel()
+        update_existing_params(model, self)
+        model.surrogates = np.asarray(surrogates)
+        return model

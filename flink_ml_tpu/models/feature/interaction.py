@@ -1,0 +1,56 @@
+"""Interaction.
+
+Reference: ``flink-ml-lib/.../feature/interaction/Interaction.java`` — output
+vector of all cross-products across the input columns (numeric columns act as
+1-dim vectors): out[i,j,...] = col1[i]·col2[j]·…  The first column's index varies
+slowest (row-major over columns left to right).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.params.shared import HasInputCols, HasOutputCol
+
+__all__ = ["Interaction"]
+
+
+@functools.cache
+def _kernel(dims: tuple):
+    @jax.jit
+    def interact(*cols):
+        # batched outer product across columns: [n, d1] x [n, d2] ... -> [n, d1*d2*...]
+        acc = cols[0]
+        for c in cols[1:]:
+            acc = acc[:, :, None] * c[:, None, :]
+            acc = acc.reshape(acc.shape[0], -1)
+        return acc
+
+    return interact
+
+
+class Interaction(Transformer, HasInputCols, HasOutputCol):
+    """Ref Interaction.java."""
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        mats = []
+        for name in self.get_input_cols():
+            col = df.column(name)
+            if isinstance(col, np.ndarray) and col.ndim == 2:
+                mats.append(col.astype(np.float64))
+            else:
+                mats.append(df.vectors(name).astype(np.float64))
+        vals = _kernel(tuple(m.shape[1] for m in mats))(*mats)
+        out = df.clone()
+        out.add_column(
+            self.get_output_col(),
+            DataTypes.vector(BasicType.DOUBLE),
+            np.asarray(vals, np.float64),
+        )
+        return out

@@ -1,0 +1,150 @@
+"""KBinsDiscretizer.
+
+Reference: ``flink-ml-lib/.../feature/kbinsdiscretizer/`` — bin each dimension of
+the input vector into integer bin ids. Strategies (KBinsDiscretizerParams):
+'uniform' (equal widths min..max), 'quantile' (equal counts; duplicate edges
+collapsed, which may yield fewer bins), 'kmeans' (1D k-means; edges are midpoints
+between sorted centroids). Transform clamps out-of-range values into the first /
+last bin (KBinsDiscretizerModel's binary search with clipping).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam, update_existing_params
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["KBinsDiscretizer", "KBinsDiscretizerModel"]
+
+UNIFORM, QUANTILE, KMEANS = "uniform", "quantile", "kmeans"
+
+
+class _KbdParams(HasInputCol, HasOutputCol):
+    STRATEGY = StringParam(
+        "strategy",
+        "Strategy used to define the width of the bin.",
+        QUANTILE,
+        ParamValidators.in_array([UNIFORM, QUANTILE, KMEANS]),
+    )
+    NUM_BINS = IntParam("numBins", "Number of bins to produce.", 5, ParamValidators.gt_eq(2))
+    SUB_SAMPLES = IntParam(
+        "subSamples",
+        "Maximum number of samples used to fit the model.",
+        200_000,
+        ParamValidators.gt_eq(2),
+    )
+
+    def get_strategy(self) -> str:
+        return self.get(self.STRATEGY)
+
+    def set_strategy(self, value: str):
+        return self.set(self.STRATEGY, value)
+
+    def get_num_bins(self) -> int:
+        return self.get(self.NUM_BINS)
+
+    def set_num_bins(self, value: int):
+        return self.set(self.NUM_BINS, value)
+
+    def get_sub_samples(self) -> int:
+        return self.get(self.SUB_SAMPLES)
+
+    def set_sub_samples(self, value: int):
+        return self.set(self.SUB_SAMPLES, value)
+
+
+class KBinsDiscretizerModel(Model, _KbdParams):
+    """Ref KBinsDiscretizerModel.java — per-dim bin edges."""
+
+    def __init__(self):
+        super().__init__()
+        self.bin_edges: Optional[List[np.ndarray]] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_input_col()).astype(np.float64)
+        out_vals = np.zeros_like(X)
+        for d, edges in enumerate(self.bin_edges):
+            idx = np.searchsorted(edges, X[:, d], side="right") - 1
+            out_vals[:, d] = np.clip(idx, 0, len(edges) - 2)
+        out = df.clone()
+        out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), out_vals)
+        return out
+
+    def get_model_data(self):
+        from flink_ml_tpu.api.dataframe import DataFrame
+
+        return [DataFrame(["binEdges"], None, [[list(map(np.asarray, self.bin_edges))]])]
+
+    def set_model_data(self, *model_data):
+        self.bin_edges = [np.asarray(e) for e in model_data[0].column("binEdges")[0]]
+        return self
+
+    def save(self, path: str) -> None:
+        rw.save_metadata(self, path)
+        arrays = {f"dim{i}": np.asarray(e) for i, e in enumerate(self.bin_edges)}
+        arrays["__num_dims__"] = np.asarray([len(self.bin_edges)])
+        rw.save_model_arrays(path, arrays)
+
+    @classmethod
+    def load(cls, path: str):
+        metadata = rw.load_metadata(path, rw.stage_class_name(cls))
+        model = cls()
+        model.load_param_map_from_json(metadata["paramMap"])
+        arrays = rw.load_model_arrays(path)
+        model.bin_edges = [
+            arrays[f"dim{i}"] for i in range(int(arrays["__num_dims__"][0]))
+        ]
+        return model
+
+
+def _kmeans_1d(x: np.ndarray, k: int, iters: int = 30) -> np.ndarray:
+    centers = np.quantile(x, np.linspace(0, 1, k))
+    for _ in range(iters):
+        assign = np.argmin(np.abs(x[:, None] - centers[None, :]), axis=1)
+        for j in range(k):
+            sel = x[assign == j]
+            if sel.size:
+                centers[j] = sel.mean()
+    return np.sort(centers)
+
+
+class KBinsDiscretizer(Estimator, _KbdParams):
+    """Ref KBinsDiscretizer.java."""
+
+    def fit(self, *inputs) -> KBinsDiscretizerModel:
+        (df,) = inputs
+        X = df.vectors(self.get_input_col()).astype(np.float64)
+        if len(X) == 0:
+            raise RuntimeError("The training set is empty.")
+        if len(X) > self.get_sub_samples():
+            X = X[np.random.default_rng(0).choice(len(X), self.get_sub_samples(), replace=False)]
+        k = self.get_num_bins()
+        strategy = self.get_strategy()
+        edges_per_dim: List[np.ndarray] = []
+        for d in range(X.shape[1]):
+            x = X[:, d]
+            if strategy == UNIFORM:
+                edges = np.linspace(x.min(), x.max(), k + 1)
+            elif strategy == QUANTILE:
+                edges = np.quantile(x, np.linspace(0, 1, k + 1))
+            else:
+                centers = _kmeans_1d(x, k)
+                mids = (centers[:-1] + centers[1:]) / 2.0
+                edges = np.concatenate([[x.min()], mids, [x.max()]])
+            # Collapse duplicate edges for every strategy (constant dims would
+            # otherwise bin into the LAST bucket; ref KBinsDiscretizer.java:192-196
+            # maps them to a single bin 0).
+            edges = np.unique(edges)
+            if len(edges) < 2:
+                edges = np.asarray([x.min(), x.max() + 1e-12])
+            edges_per_dim.append(edges)
+        model = KBinsDiscretizerModel()
+        update_existing_params(model, self)
+        model.bin_edges = edges_per_dim
+        return model

@@ -1,0 +1,207 @@
+"""MinHashLSH — locality-sensitive hashing for Jaccard similarity.
+
+Reference: ``flink-ml-lib/.../feature/lsh/`` — ``MinHashLSHModelData`` (random
+affine hash family over the prime 2038074743, coefficients drawn from
+``java.util.Random(seed)`` — reproduced bit-exactly here; hash value per function
+= min over non-zero indices of ((1+idx)·a + b) mod PRIME,
+MinHashLSHModelData.java:125-143), ``LSHModel`` (transform appends the per-table
+hash vectors; ``approxNearestNeighbors`` prunes candidates sharing a hash-table
+bucket with the key then ranks by exact ``keyDistance`` = 1 − Jaccard;
+``approxSimilarityJoin`` joins pairs sharing a bucket below a distance threshold,
+LSHModel.java:334-482).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.linalg.vectors import SparseVector, Vector
+from flink_ml_tpu.models.common import ModelArraysMixin
+from flink_ml_tpu.params.param import IntParam, ParamValidators, update_existing_params
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol, HasSeed
+
+__all__ = ["MinHashLSH", "MinHashLSHModel"]
+
+HASH_PRIME = 2038074743
+
+
+class JavaRandom:
+    """java.util.Random's 48-bit LCG — needed for coefficient parity."""
+
+    def __init__(self, seed: int):
+        self._seed = (seed ^ 0x5DEECE66D) & ((1 << 48) - 1)
+
+    def _next(self, bits: int) -> int:
+        self._seed = (self._seed * 0x5DEECE66D + 0xB) & ((1 << 48) - 1)
+        return self._seed >> (48 - bits)
+
+    def next_int(self, bound: int) -> int:
+        if bound <= 0:
+            raise ValueError("bound must be positive")
+        if (bound & -bound) == bound:  # power of two
+            return (bound * self._next(31)) >> 31
+        while True:
+            bits = self._next(31)
+            val = bits % bound
+            if bits - val + (bound - 1) < (1 << 31):  # no int overflow
+                return val
+
+
+def _to_indices(v) -> np.ndarray:
+    if isinstance(v, SparseVector):
+        return np.asarray(v.indices, np.int64)
+    arr = v.to_array() if isinstance(v, Vector) else np.asarray(v)
+    return np.nonzero(arr)[0]
+
+
+class _LshParams(HasInputCol, HasOutputCol, HasSeed):
+    NUM_HASH_TABLES = IntParam(
+        "numHashTables", "Number of hash tables.", 1, ParamValidators.gt_eq(1)
+    )
+    NUM_HASH_FUNCTIONS_PER_TABLE = IntParam(
+        "numHashFunctionsPerTable",
+        "Number of hash functions per hash table.",
+        1,
+        ParamValidators.gt_eq(1),
+    )
+
+    def get_num_hash_tables(self) -> int:
+        return self.get(self.NUM_HASH_TABLES)
+
+    def set_num_hash_tables(self, value: int):
+        return self.set(self.NUM_HASH_TABLES, value)
+
+    def get_num_hash_functions_per_table(self) -> int:
+        return self.get(self.NUM_HASH_FUNCTIONS_PER_TABLE)
+
+    def set_num_hash_functions_per_table(self, value: int):
+        return self.set(self.NUM_HASH_FUNCTIONS_PER_TABLE, value)
+
+
+class MinHashLSHModel(ModelArraysMixin, Model, _LshParams):
+    """Ref MinHashLSHModel.java / LSHModel.java."""
+
+    _MODEL_ARRAY_NAMES = ("coeff_a", "coeff_b")
+
+    def __init__(self):
+        super().__init__()
+        self.coeff_a: Optional[np.ndarray] = None
+        self.coeff_b: Optional[np.ndarray] = None
+
+    # --- hash family ---------------------------------------------------------
+    def hash_function(self, v) -> np.ndarray:
+        """[numHashTables, numHashFunctionsPerTable] minhash values.
+        Ref MinHashLSHModelData.hashFunction:125."""
+        indices = _to_indices(v)
+        if indices.size == 0:
+            raise ValueError("Must have at least 1 non zero entry.")
+        vals = ((1 + indices[:, None]) * self.coeff_a[None, :] + self.coeff_b[None, :]) % HASH_PRIME
+        mins = vals.min(axis=0).astype(np.float64)
+        return mins.reshape(self.get_num_hash_tables(), self.get_num_hash_functions_per_table())
+
+    @staticmethod
+    def key_distance(x, y) -> float:
+        """1 − Jaccard over non-zero index sets. Ref keyDistance:146."""
+        xi, yi = set(_to_indices(x).tolist()), set(_to_indices(y).tolist())
+        if not xi and not yi:
+            raise ValueError("The union of two input sets must have at least 1 elements")
+        return 1.0 - len(xi & yi) / len(xi | yi)
+
+    # --- Model API -----------------------------------------------------------
+    def transform(self, *inputs):
+        (df,) = inputs
+        col = df.column(self.get_input_col())
+        hashes = [self.hash_function(v) for v in col]
+        out = df.clone()
+        out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), hashes)
+        return out
+
+    def approx_nearest_neighbors(
+        self, dataset: DataFrame, key, k: int, dist_col: str = "distCol"
+    ) -> DataFrame:
+        """Top-k rows of ``dataset`` closest to ``key``, pruned by shared hash-table
+        buckets (OR-amplification). Ref LSHModel.approxNearestNeighbors:334-380."""
+        key_hash = self.hash_function(key)
+        col = dataset.column(self.get_input_col())
+        candidates = []
+        for i, v in enumerate(col):
+            h = self.hash_function(v)
+            if (h == key_hash).all(axis=1).any():  # shares at least one full bucket
+                candidates.append(i)
+        dists = [(i, self.key_distance(key, col[i])) for i in candidates]
+        dists.sort(key=lambda t: t[1])
+        top = dists[:k]
+        subset = dataset.take(np.asarray([i for i, _ in top], np.int64))
+        subset.add_column(dist_col, DataTypes.DOUBLE, np.asarray([d for _, d in top]))
+        return subset
+
+    def approx_similarity_join(
+        self,
+        dataset_a: DataFrame,
+        dataset_b: DataFrame,
+        threshold: float,
+        id_col: str,
+        dist_col: str = "distCol",
+    ) -> DataFrame:
+        """Pairs (idA, idB, distance) with distance < threshold among bucket-sharing
+        pairs. Ref LSHModel.approxSimilarityJoin:430-482."""
+        in_col = self.get_input_col()
+
+        def explode(df):
+            buckets = {}
+            for i, v in enumerate(df.column(in_col)):
+                for t, row in enumerate(self.hash_function(v)):
+                    buckets.setdefault((t, tuple(row.tolist())), []).append(i)
+            return buckets
+
+        buckets_a, buckets_b = explode(dataset_a), explode(dataset_b)
+        ids_a, ids_b = dataset_a.column(id_col), dataset_b.column(id_col)
+        col_a, col_b = dataset_a.column(in_col), dataset_b.column(in_col)
+        seen = set()
+        rows = []
+        for bucket, a_rows in buckets_a.items():
+            for ia in a_rows:
+                for ib in buckets_b.get(bucket, ()):
+                    pair = (ia, ib)
+                    if pair in seen:
+                        continue
+                    seen.add(pair)
+                    dist = self.key_distance(col_a[ia], col_b[ib])
+                    if dist < threshold:
+                        rows.append((ids_a[ia], ids_b[ib], dist))
+        return DataFrame(
+            [f"{id_col}A", f"{id_col}B", dist_col],
+            None,
+            [
+                [r[0] for r in rows],
+                [r[1] for r in rows],
+                np.asarray([r[2] for r in rows], np.float64),
+            ],
+        )
+
+
+class MinHashLSH(Estimator, _LshParams):
+    """Ref MinHashLSH.java — fit draws the hash family from java.util.Random(seed)."""
+
+    def fit(self, *inputs) -> MinHashLSHModel:
+        (df,) = inputs
+        col = df.column(self.get_input_col())
+        first = col[0]
+        dim = first.size() if isinstance(first, Vector) else np.asarray(first).shape[0]
+        if dim > HASH_PRIME:
+            raise ValueError(
+                f"The input vector dimension {dim} exceeds the threshold {HASH_PRIME}."
+            )
+        rng = JavaRandom(self.get_seed())
+        n = self.get_num_hash_tables() * self.get_num_hash_functions_per_table()
+        coeff_a = np.asarray([1 + rng.next_int(HASH_PRIME - 1) for _ in range(n)], np.int64)
+        coeff_b = np.asarray([rng.next_int(HASH_PRIME - 1) for _ in range(n)], np.int64)
+        model = MinHashLSHModel()
+        update_existing_params(model, self)
+        model.coeff_a = coeff_a
+        model.coeff_b = coeff_b
+        return model

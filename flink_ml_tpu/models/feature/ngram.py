@@ -1,0 +1,37 @@
+"""NGram.
+
+Reference: ``flink-ml-lib/.../feature/ngram/NGram.java`` — convert a token list
+into n-grams joined by spaces; fewer than n tokens → empty output.
+"""
+from __future__ import annotations
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.params.param import IntParam, ParamValidators
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+
+__all__ = ["NGram"]
+
+
+class NGram(Transformer, HasInputCol, HasOutputCol):
+    """Ref NGram.java."""
+
+    N = IntParam("n", "Number of elements per n-gram (>=1).", 2, ParamValidators.gt_eq(1))
+
+    def get_n(self) -> int:
+        return self.get(self.N)
+
+    def set_n(self, value: int):
+        return self.set(self.N, value)
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        n = self.get_n()
+        col = df.column(self.get_input_col())
+        grams = [
+            [" ".join(tokens[i : i + n]) for i in range(len(tokens) - n + 1)]
+            for tokens in col
+        ]
+        out = df.clone()
+        out.add_column(self.get_output_col(), DataTypes.STRING, grams)
+        return out

@@ -1,0 +1,53 @@
+"""Normalizer.
+
+Reference: ``flink-ml-lib/.../feature/normalizer/Normalizer.java`` — scale each
+vector to unit p-norm (p ≥ 1, default 2).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.params.param import FloatParam, ParamValidators
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+
+__all__ = ["Normalizer"]
+
+
+@functools.cache
+def _kernel(p: float):
+    @jax.jit
+    def normalize(X):
+        norm = jnp.sum(jnp.abs(X) ** p, axis=1, keepdims=True) ** (1.0 / p)
+        return X / jnp.where(norm == 0.0, 1.0, norm)
+
+    return normalize
+
+
+class Normalizer(Transformer, HasInputCol, HasOutputCol):
+    """Ref Normalizer.java."""
+
+    P = FloatParam("p", "The p norm value.", 2.0, ParamValidators.gt_eq(1.0))
+
+    def get_p(self) -> float:
+        return self.get(self.P)
+
+    def set_p(self, value: float):
+        return self.set(self.P, value)
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_input_col()).astype(np.float64)
+        vals = _kernel(self.get_p())(X)
+        out = df.clone()
+        out.add_column(
+            self.get_output_col(),
+            DataTypes.vector(BasicType.DOUBLE),
+            np.asarray(vals, np.float64),
+        )
+        return out

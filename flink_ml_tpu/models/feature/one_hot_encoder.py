@@ -1,0 +1,93 @@
+"""OneHotEncoder.
+
+Reference: ``flink-ml-lib/.../feature/onehotencoder/`` — multi-column encoding of
+non-negative integer indices into sparse binary vectors; model data = max index
+per column; ``dropLast`` (default true) drops the last category (its index maps
+to the all-zeros vector); with handleInvalid 'keep' an extra category is added
+(OneHotEncoderModel.java:166-169), 'error' raises on out-of-range values.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.models.common import ModelArraysMixin
+from flink_ml_tpu.params.param import BoolParam, update_existing_params
+from flink_ml_tpu.params.shared import HasHandleInvalid, HasInputCols, HasOutputCols
+
+__all__ = ["OneHotEncoder", "OneHotEncoderModel"]
+
+
+class _OheParams(HasInputCols, HasOutputCols, HasHandleInvalid):
+    DROP_LAST = BoolParam("dropLast", "Whether to drop the last category.", True)
+
+    def get_drop_last(self) -> bool:
+        return self.get(self.DROP_LAST)
+
+    def set_drop_last(self, value: bool):
+        return self.set(self.DROP_LAST, value)
+
+
+class OneHotEncoderModel(ModelArraysMixin, Model, _OheParams):
+    """Ref OneHotEncoderModel.java."""
+
+    _MODEL_ARRAY_NAMES = ("category_sizes",)
+
+    def __init__(self):
+        super().__init__()
+        self.category_sizes: Optional[np.ndarray] = None  # num categories per column
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        drop_last = self.get_drop_last()
+        handle = self.get_handle_invalid()
+        n = len(df)
+        keep_mask = np.ones(n, bool)
+        out = df.clone()
+        new_cols = []
+        for i, name in enumerate(self.get_input_cols()):
+            idx = df.scalars(name)
+            size = int(self.category_sizes[i]) + (1 if handle == "keep" else 0)
+            vec_len = size - 1 if drop_last else size
+            invalid = (idx < 0) | (idx != np.floor(idx)) | (idx >= size)
+            if handle == "error" and invalid.any():
+                raise ValueError(
+                    f"The input contains invalid index {idx[invalid][0]} for column {name}."
+                )
+            if handle == "keep":
+                idx = np.where(invalid, size - 1, idx)
+            else:
+                keep_mask &= ~invalid
+            vectors = [
+                SparseVector(vec_len, np.asarray([], np.int64), np.asarray([]))
+                if int(j) >= vec_len
+                else SparseVector(vec_len, np.asarray([int(j)]), np.asarray([1.0]))
+                for j in idx
+            ]
+            new_cols.append(vectors)
+        for out_name, vectors in zip(self.get_output_cols(), new_cols):
+            out.add_column(out_name, DataTypes.vector(BasicType.DOUBLE), vectors)
+        if not keep_mask.all():
+            out = out.take(np.nonzero(keep_mask)[0])
+        return out
+
+
+class OneHotEncoder(Estimator, _OheParams):
+    """Ref OneHotEncoder.java — model data is maxIndex+1 per column."""
+
+    def fit(self, *inputs) -> OneHotEncoderModel:
+        (df,) = inputs
+        sizes = []
+        for name in self.get_input_cols():
+            idx = df.scalars(name)
+            if (idx < 0).any() or (idx != np.floor(idx)).any():
+                raise ValueError(f"Column {name} must contain non-negative integers.")
+            sizes.append(int(idx.max()) + 1)
+        model = OneHotEncoderModel()
+        update_existing_params(model, self)
+        model.category_sizes = np.asarray(sizes, np.int64)
+        return model

@@ -1,0 +1,42 @@
+"""RandomSplitter.
+
+Reference: ``flink-ml-lib/.../feature/randomsplitter/RandomSplitter.java`` — an
+AlgoOperator splitting the input into N output tables with the given weight
+proportions, row membership drawn independently per row from the seeded RNG.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.api.core import AlgoOperator
+from flink_ml_tpu.params.param import FloatArrayParam
+from flink_ml_tpu.params.shared import HasSeed
+
+__all__ = ["RandomSplitter"]
+
+
+class RandomSplitter(AlgoOperator, HasSeed):
+    """Ref RandomSplitter.java."""
+
+    WEIGHTS = FloatArrayParam(
+        "weights",
+        "The weights of the output tables; rows are assigned proportionally.",
+        [1.0, 1.0],
+        lambda v: v is not None and len(v) >= 2 and all(w > 0 for w in v),
+    )
+
+    def get_weights(self):
+        return self.get(self.WEIGHTS)
+
+    def set_weights(self, *values: float):
+        return self.set(self.WEIGHTS, list(values))
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        weights = np.asarray(self.get_weights(), np.float64)
+        bounds = np.cumsum(weights / weights.sum())
+        rng = np.random.default_rng(self.get_seed())
+        draws = rng.random(len(df))
+        assignment = np.searchsorted(bounds, draws, side="right")
+        assignment = np.minimum(assignment, len(weights) - 1)
+        return [df.take(np.nonzero(assignment == i)[0]) for i in range(len(weights))]

@@ -1,0 +1,212 @@
+"""MaxAbsScaler, MinMaxScaler, RobustScaler.
+
+Reference: ``flink-ml-lib/.../feature/maxabsscaler/`` (model = per-dim max |x|;
+transform x / maxAbs, dims with maxAbs 0 untouched), ``minmaxscaler/`` (model =
+per-dim min/max; transform x·scale + offset with scale = (max'−min')/(eMax−eMin),
+constant dims (|eMin−eMax| < 1e-5) map to the range midpoint —
+MinMaxScalerModel.java:97-108), ``robustscaler/`` (model = per-dim quantiles at
+``lower``/``upper`` (default quartiles) + median; transform optionally centers by
+median and scales by 1/IQR, zero-range dims map to 0).
+
+Fit statistics (min/max/|max|/quantiles) are single-pass host reductions — these
+are ingestion-time O(n·d) scans dominated by data movement, not FLOPs, so there
+is nothing for the MXU to win; transforms are affine maps applied columnar.
+Quantiles are exact (the reference approximates with Greenwald-Khanna sketches,
+QuantileSummary.java:42, because it must merge streamed partitions).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.models.common import ModelArraysMixin
+from flink_ml_tpu.params.param import BoolParam, FloatParam, ParamValidators, update_existing_params
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol, HasRelativeError
+
+__all__ = [
+    "MaxAbsScaler",
+    "MaxAbsScalerModel",
+    "MinMaxScaler",
+    "MinMaxScalerModel",
+    "RobustScaler",
+    "RobustScalerModel",
+]
+
+
+def _apply_affine(df, input_col, output_col, scale, offset):
+    X = df.vectors(input_col).astype(np.float64)
+    vals = X * scale[None, :] + offset[None, :]
+    out = df.clone()
+    out.add_column(output_col, DataTypes.vector(BasicType.DOUBLE), vals)
+    return out
+
+
+# --- MaxAbsScaler ------------------------------------------------------------
+
+
+class MaxAbsScalerModel(ModelArraysMixin, Model, HasInputCol, HasOutputCol):
+    """Ref MaxAbsScalerModel.java."""
+
+    _MODEL_ARRAY_NAMES = ("max_abs",)
+
+    def __init__(self):
+        super().__init__()
+        self.max_abs: Optional[np.ndarray] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        scale = np.where(self.max_abs == 0.0, 1.0, 1.0 / np.where(self.max_abs == 0, 1, self.max_abs))
+        return _apply_affine(
+            df, self.get_input_col(), self.get_output_col(), scale, np.zeros_like(scale)
+        )
+
+
+class MaxAbsScaler(Estimator, HasInputCol, HasOutputCol):
+    """Ref MaxAbsScaler.java."""
+
+    def fit(self, *inputs) -> MaxAbsScalerModel:
+        (df,) = inputs
+        X = df.vectors(self.get_input_col()).astype(np.float64)
+        model = MaxAbsScalerModel()
+        update_existing_params(model, self)
+        model.max_abs = np.abs(X).max(axis=0) if len(X) else np.zeros(X.shape[1])
+        return model
+
+
+# --- MinMaxScaler ------------------------------------------------------------
+
+
+class _MinMaxParams(HasInputCol, HasOutputCol):
+    MIN = FloatParam("min", "Lower bound of the output feature range.", 0.0)
+    MAX = FloatParam("max", "Upper bound of the output feature range.", 1.0)
+
+    def get_min(self) -> float:
+        return self.get(self.MIN)
+
+    def set_min(self, value: float):
+        return self.set(self.MIN, value)
+
+    def get_max(self) -> float:
+        return self.get(self.MAX)
+
+    def set_max(self, value: float):
+        return self.set(self.MAX, value)
+
+
+class MinMaxScalerModel(ModelArraysMixin, Model, _MinMaxParams):
+    """Ref MinMaxScalerModel.java:97-108."""
+
+    _MODEL_ARRAY_NAMES = ("e_min", "e_max")
+
+    def __init__(self):
+        super().__init__()
+        self.e_min: Optional[np.ndarray] = None
+        self.e_max: Optional[np.ndarray] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        lo, hi = self.get_min(), self.get_max()
+        if hi <= lo:
+            raise ValueError(f"MinMaxScaler requires min < max, got [{lo}, {hi}]")
+        span = self.e_max - self.e_min
+        constant = np.abs(span) < 1e-5
+        scale = np.where(constant, 0.0, (hi - lo) / np.where(constant, 1.0, span))
+        offset = np.where(constant, (hi + lo) / 2.0, lo - self.e_min * scale)
+        return _apply_affine(df, self.get_input_col(), self.get_output_col(), scale, offset)
+
+
+class MinMaxScaler(Estimator, _MinMaxParams):
+    """Ref MinMaxScaler.java."""
+
+    def fit(self, *inputs) -> MinMaxScalerModel:
+        (df,) = inputs
+        X = df.vectors(self.get_input_col()).astype(np.float64)
+        if len(X) == 0:
+            raise RuntimeError("The training set is empty.")
+        model = MinMaxScalerModel()
+        update_existing_params(model, self)
+        model.e_min = X.min(axis=0)
+        model.e_max = X.max(axis=0)
+        return model
+
+
+# --- RobustScaler ------------------------------------------------------------
+
+
+class _RobustParams(HasInputCol, HasOutputCol, HasRelativeError):
+    LOWER = FloatParam(
+        "lower", "Lower quantile to calculate quantile range.", 0.25, ParamValidators.in_range(0, 1, False, False)
+    )
+    UPPER = FloatParam(
+        "upper", "Upper quantile to calculate quantile range.", 0.75, ParamValidators.in_range(0, 1, False, False)
+    )
+    WITH_CENTERING = BoolParam(
+        "withCentering", "Whether to center the data with median before scaling.", False
+    )
+    WITH_SCALING = BoolParam("withScaling", "Whether to scale the data to quantile range.", True)
+
+    def get_lower(self) -> float:
+        return self.get(self.LOWER)
+
+    def set_lower(self, value: float):
+        return self.set(self.LOWER, value)
+
+    def get_upper(self) -> float:
+        return self.get(self.UPPER)
+
+    def set_upper(self, value: float):
+        return self.set(self.UPPER, value)
+
+    def get_with_centering(self) -> bool:
+        return self.get(self.WITH_CENTERING)
+
+    def set_with_centering(self, value: bool):
+        return self.set(self.WITH_CENTERING, value)
+
+    def get_with_scaling(self) -> bool:
+        return self.get(self.WITH_SCALING)
+
+    def set_with_scaling(self, value: bool):
+        return self.set(self.WITH_SCALING, value)
+
+
+class RobustScalerModel(ModelArraysMixin, Model, _RobustParams):
+    """Ref RobustScalerModel.java."""
+
+    _MODEL_ARRAY_NAMES = ("medians", "ranges")
+
+    def __init__(self):
+        super().__init__()
+        self.medians: Optional[np.ndarray] = None
+        self.ranges: Optional[np.ndarray] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        scale = (
+            np.where(self.ranges == 0.0, 0.0, 1.0 / np.where(self.ranges == 0, 1, self.ranges))
+            if self.get_with_scaling()
+            else np.ones_like(self.ranges)
+        )
+        offset = -self.medians * scale if self.get_with_centering() else np.zeros_like(scale)
+        return _apply_affine(df, self.get_input_col(), self.get_output_col(), scale, offset)
+
+
+class RobustScaler(Estimator, _RobustParams):
+    """Ref RobustScaler.java — quantiles computed exactly by device sort instead of
+    the reference's Greenwald-Khanna sketch (QuantileSummary.java:42)."""
+
+    def fit(self, *inputs) -> RobustScalerModel:
+        (df,) = inputs
+        X = df.vectors(self.get_input_col()).astype(np.float64)
+        if len(X) == 0:
+            raise RuntimeError("The training set is empty.")
+        lo, hi = self.get_lower(), self.get_upper()
+        q = np.quantile(X, [lo, 0.5, hi], axis=0)
+        model = RobustScalerModel()
+        update_existing_params(model, self)
+        model.medians = q[1]
+        model.ranges = q[2] - q[0]
+        return model

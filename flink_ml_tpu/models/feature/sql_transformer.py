@@ -1,0 +1,166 @@
+"""SQLTransformer.
+
+Reference: ``flink-ml-lib/.../feature/sqltransformer/SQLTransformer.java`` —
+executes a SQL statement against the input table; ``__THIS__`` is the placeholder
+for the input (e.g. ``SELECT *, (v1 + v2) AS v3 FROM __THIS__``).
+
+The reference delegates to Flink's full SQL planner. Here a documented subset is
+evaluated columnar over numpy:
+  SELECT <expr> [AS alias][, ...] FROM __THIS__ [WHERE <cond>]
+with ``*`` expansion, arithmetic/comparison/boolean operators (SQL ``=``, AND, OR,
+NOT), and the scalar functions ABS, SQRT, EXP, LOG, POW, MIN, MAX. Aggregations,
+joins, and window clauses are not supported and raise ValueError.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.params.param import ParamValidators, StringParam
+
+__all__ = ["SQLTransformer"]
+
+_FUNCS = {
+    "ABS": np.abs,
+    "SQRT": np.sqrt,
+    "EXP": np.exp,
+    "LOG": np.log,
+    "POW": np.power,
+    "MIN": np.minimum,
+    "MAX": np.maximum,
+}
+
+
+def _split_top_level_commas(s: str) -> List[str]:
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur).strip())
+    return parts
+
+
+def _split_top_level_keyword(s: str, keyword: str) -> List[str]:
+    """Split on a keyword at paren depth 0 (case-insensitive, word-bounded)."""
+    pattern = re.compile(rf"\b{keyword}\b", re.I)
+    parts, depth, last, i = [], 0, 0, 0
+    while i < len(s):
+        ch = s[i]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif depth == 0:
+            m = pattern.match(s, i)
+            if m:
+                parts.append(s[last : i])
+                i = m.end()
+                last = i
+                continue
+        i += 1
+    parts.append(s[last:])
+    return parts
+
+
+def _sql_to_python(expr: str) -> str:
+    """SQL boolean expression → numpy-evaluable Python, preserving SQL precedence
+    (OR < AND < NOT < comparison) by parenthesizing each operand — numpy's &/| bind
+    tighter than comparisons, so bare substitution would mis-parse."""
+    or_parts = _split_top_level_keyword(expr, "OR")
+    if len(or_parts) > 1:
+        return " | ".join(f"({_sql_to_python(p.strip())})" for p in or_parts)
+    and_parts = _split_top_level_keyword(expr, "AND")
+    if len(and_parts) > 1:
+        return " & ".join(f"({_sql_to_python(p.strip())})" for p in and_parts)
+    stripped = expr.strip()
+    m = re.match(r"NOT\b(.*)$", stripped, re.I | re.S)
+    if m:
+        return f"~({_sql_to_python(m.group(1).strip())})"
+    return re.sub(r"(?<![<>!=])=(?!=)", "==", stripped)
+
+
+def _check_safe(expr: str, allowed_names) -> None:
+    """Reject anything outside the documented subset BEFORE eval: attribute access,
+    indexing, double underscores, lambda/comprehension keywords, and identifiers
+    that are neither columns nor whitelisted functions."""
+    if re.search(r"\.\s*[A-Za-z_]", expr):
+        raise ValueError(f"SQLTransformer: attribute access is not supported: {expr!r}")
+    if "__" in expr or "[" in expr or "]" in expr or "{" in expr or ":" in expr:
+        raise ValueError(f"SQLTransformer: unsupported construct in {expr!r}")
+    for ident in re.findall(r"[A-Za-z_]\w*", expr):
+        if ident.upper() in ("AND", "OR", "NOT", "AS"):
+            continue
+        if ident not in allowed_names and ident.upper() not in _FUNCS:
+            raise ValueError(f"SQLTransformer: unknown identifier {ident!r} in {expr!r}")
+
+
+class SQLTransformer(Transformer):
+    """Ref SQLTransformer.java."""
+
+    STATEMENT = StringParam(
+        "statement", "SQL statement with __THIS__ as the input table.", None, ParamValidators.not_null()
+    )
+
+    def get_statement(self) -> str:
+        return self.get(self.STATEMENT)
+
+    def set_statement(self, value: str):
+        return self.set(self.STATEMENT, value)
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        stmt = self.get_statement().strip().rstrip(";")
+        m = re.match(
+            r"SELECT\s+(?P<select>.+?)\s+FROM\s+__THIS__(?:\s+WHERE\s+(?P<where>.+))?$",
+            stmt,
+            re.I | re.S,
+        )
+        if not m:
+            raise ValueError(
+                "SQLTransformer supports 'SELECT ... FROM __THIS__ [WHERE ...]'; got: "
+                + stmt
+            )
+        namespace: Dict[str, object] = dict(_FUNCS)
+        namespace.update({k.lower(): v for k, v in _FUNCS.items()})
+        for name in df.get_column_names():
+            namespace[name] = df.column(name)
+        allowed = set(df.get_column_names())
+
+        base = df
+        if m.group("where"):
+            _check_safe(m.group("where"), allowed)
+            cond = eval(_sql_to_python(m.group("where")), {"__builtins__": {}}, namespace)
+            base = df.take(np.nonzero(np.asarray(cond))[0])
+            for name in base.get_column_names():
+                namespace[name] = base.column(name)
+
+        out_names: List[str] = []
+        out_cols = []
+        for item in _split_top_level_commas(m.group("select")):
+            if item == "*":
+                for name in base.get_column_names():
+                    out_names.append(name)
+                    out_cols.append(base.column(name))
+                continue
+            alias_match = re.match(r"(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)$", item, re.I)
+            expr = alias_match.group("expr") if alias_match else item
+            name = alias_match.group("alias") if alias_match else expr.strip()
+            _check_safe(expr, allowed)
+            value = eval(_sql_to_python(expr), {"__builtins__": {}}, namespace)
+            if np.isscalar(value):
+                value = np.full(base.num_rows, value)
+            out_names.append(name)
+            out_cols.append(value)
+        return DataFrame(out_names, None, out_cols)

@@ -1,0 +1,92 @@
+"""StopWordsRemover.
+
+Reference: ``flink-ml-lib/.../feature/stopwordsremover/StopWordsRemover.java`` —
+multi-column token-list filter; ``stopWords`` defaults to the bundled English list
+(``loadDefaultStopWords``), ``caseSensitive`` false (locale-aware lowercase
+matching), snowball stop-word lists bundled per language (same public-domain data
+files as the reference's resources).
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.params.param import BoolParam, StringArrayParam, StringParam
+from flink_ml_tpu.params.shared import HasInputCols, HasOutputCols
+
+__all__ = ["StopWordsRemover"]
+
+_STOPWORDS_DIR = os.path.join(os.path.dirname(__file__), "stopwords")
+
+
+def _available_languages() -> List[str]:
+    return sorted(f[:-4] for f in os.listdir(_STOPWORDS_DIR) if f.endswith(".txt"))
+
+
+def load_default_stop_words(language: str) -> List[str]:
+    """Ref StopWordsRemover.loadDefaultStopWords."""
+    path = os.path.join(_STOPWORDS_DIR, f"{language}.txt")
+    if not os.path.exists(path):
+        raise ValueError(
+            f"{language} is not in the supported language list: {_available_languages()}."
+        )
+    with open(path, encoding="utf-8") as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
+    """Ref StopWordsRemover.java."""
+
+    STOP_WORDS = StringArrayParam(
+        "stopWords", "The words to be filtered out.", load_default_stop_words("english")
+    )
+    CASE_SENSITIVE = BoolParam(
+        "caseSensitive", "Whether to do a case-sensitive comparison over the stop words.", False
+    )
+    LOCALE = StringParam(
+        "locale",
+        "Locale of the input for case insensitive matching. Ignored when caseSensitive is true.",
+        "en_US",
+    )
+
+    load_default_stop_words = staticmethod(load_default_stop_words)
+    get_available_locales = staticmethod(_available_languages)
+
+    def get_stop_words(self):
+        return self.get(self.STOP_WORDS)
+
+    def set_stop_words(self, *values: str):
+        return self.set(self.STOP_WORDS, list(values))
+
+    def get_case_sensitive(self) -> bool:
+        return self.get(self.CASE_SENSITIVE)
+
+    def set_case_sensitive(self, value: bool):
+        return self.set(self.CASE_SENSITIVE, value)
+
+    def get_locale(self) -> str:
+        return self.get(self.LOCALE)
+
+    def set_locale(self, value: str):
+        return self.set(self.LOCALE, value)
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        case_sensitive = self.get_case_sensitive()
+        stop = set(self.get_stop_words())
+        if not case_sensitive:
+            stop = {w.lower() for w in stop}
+
+        def keep(token: str) -> bool:
+            t = token if case_sensitive else token.lower()
+            return t not in stop
+
+        out = df.clone()
+        for in_name, out_name in zip(self.get_input_cols(), self.get_output_cols()):
+            col = df.column(in_name)
+            out.add_column(
+                out_name, DataTypes.STRING, [[t for t in tokens if keep(t)] for tokens in col]
+            )
+        return out

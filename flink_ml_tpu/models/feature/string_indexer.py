@@ -1,0 +1,170 @@
+"""StringIndexer / StringIndexerModel / IndexToStringModel.
+
+Reference: ``flink-ml-lib/.../feature/stringindexer/`` — multi-column mapping of
+string (or numeric) values to double indices. ``stringOrderType``: arbitrary
+(default), frequencyDesc/Asc, alphabetDesc/Asc (first label after ordering gets
+index 0, StringIndexerParams.java); ``handleInvalid``: error raises on unseen
+values, skip drops the row, keep maps them to numDistinct. ``IndexToStringModel``
+reverses the mapping using the same model data.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.params.param import StringParam, ParamValidators, update_existing_params
+from flink_ml_tpu.params.shared import HasHandleInvalid, HasInputCols, HasOutputCols
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["StringIndexer", "StringIndexerModel", "IndexToStringModel"]
+
+ARBITRARY_ORDER = "arbitrary"
+FREQUENCY_DESC_ORDER = "frequencyDesc"
+FREQUENCY_ASC_ORDER = "frequencyAsc"
+ALPHABET_DESC_ORDER = "alphabetDesc"
+ALPHABET_ASC_ORDER = "alphabetAsc"
+
+
+class _IndexerModelBase(Model, HasInputCols, HasOutputCols, HasHandleInvalid):
+    """Shared save/load for models whose data is per-column string lists."""
+
+    def __init__(self):
+        super().__init__()
+        self.string_arrays: Optional[List[List[str]]] = None
+
+    # model data = one column of per-input-column label lists
+    def get_model_data(self):
+        return [DataFrame(["stringArrays"], None, [[list(a) for a in self.string_arrays]])]
+
+    def set_model_data(self, *model_data: DataFrame):
+        df = model_data[0]
+        self.string_arrays = [list(a) for a in df.column("stringArrays")[0]]
+        return self
+
+    def save(self, path: str) -> None:
+        rw.save_metadata(self, path)
+        arrays = {
+            f"col{i}": np.asarray(a, dtype=str) for i, a in enumerate(self.string_arrays)
+        }
+        arrays["__num_cols__"] = np.asarray([len(self.string_arrays)])
+        rw.save_model_arrays(path, arrays)
+
+    @classmethod
+    def load(cls, path: str):
+        metadata = rw.load_metadata(path, rw.stage_class_name(cls))
+        model = cls()
+        model.load_param_map_from_json(metadata["paramMap"])
+        arrays = rw.load_model_arrays(path)
+        n = int(arrays["__num_cols__"][0])
+        model.string_arrays = [[str(s) for s in arrays[f"col{i}"]] for i in range(n)]
+        return model
+
+
+class StringIndexerModel(_IndexerModelBase):
+    """Ref StringIndexerModel.java — value → index."""
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        handle = self.get_handle_invalid()
+        n = len(df)
+        keep_mask = np.ones(n, bool)
+        out_cols = []
+        for i, name in enumerate(self.get_input_cols()):
+            mapping = {v: j for j, v in enumerate(self.string_arrays[i])}
+            col = df.column(name)
+            values = np.empty(n, np.float64)
+            for r in range(n):
+                v = col[r]
+                key = str(v) if not isinstance(v, str) else v
+                if key in mapping:
+                    values[r] = mapping[key]
+                elif handle == "error":
+                    raise ValueError(
+                        f"The input contains unseen string: {v!r}. See handleInvalid."
+                    )
+                elif handle == "keep":
+                    values[r] = len(mapping)
+                else:
+                    keep_mask[r] = False
+            out_cols.append(values)
+        out = df.clone()
+        for out_name, values in zip(self.get_output_cols(), out_cols):
+            out.add_column(out_name, DataTypes.DOUBLE, values)
+        if not keep_mask.all():
+            out = out.take(np.nonzero(keep_mask)[0])
+        return out
+
+
+class IndexToStringModel(_IndexerModelBase):
+    """Ref IndexToStringModel.java — index → original string."""
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        out = df.clone()
+        for i, (in_name, out_name) in enumerate(
+            zip(self.get_input_cols(), self.get_output_cols())
+        ):
+            labels = self.string_arrays[i]
+            idx = df.scalars(in_name, np.int64)
+            if (idx < 0).any() or (idx >= len(labels)).any():
+                bad = idx[(idx < 0) | (idx >= len(labels))][0]
+                raise ValueError(
+                    f"The input contains index {bad} out of the model's range."
+                )
+            out.add_column(out_name, DataTypes.STRING, [labels[j] for j in idx])
+        return out
+
+
+class StringIndexer(Estimator, HasInputCols, HasOutputCols, HasHandleInvalid):
+    """Ref StringIndexer.java."""
+
+    STRING_ORDER_TYPE = StringParam(
+        "stringOrderType",
+        "How to order strings of each column.",
+        ARBITRARY_ORDER,
+        ParamValidators.in_array(
+            [
+                ARBITRARY_ORDER,
+                FREQUENCY_DESC_ORDER,
+                FREQUENCY_ASC_ORDER,
+                ALPHABET_DESC_ORDER,
+                ALPHABET_ASC_ORDER,
+            ]
+        ),
+    )
+
+    def get_string_order_type(self) -> str:
+        return self.get(self.STRING_ORDER_TYPE)
+
+    def set_string_order_type(self, value: str):
+        return self.set(self.STRING_ORDER_TYPE, value)
+
+    def fit(self, *inputs) -> StringIndexerModel:
+        (df,) = inputs
+        order = self.get_string_order_type()
+        string_arrays = []
+        for name in self.get_input_cols():
+            col = df.column(name)
+            counts = {}
+            for v in col:
+                key = str(v) if not isinstance(v, str) else v
+                counts[key] = counts.get(key, 0) + 1
+            if order == FREQUENCY_DESC_ORDER:
+                labels = sorted(counts, key=lambda k: (-counts[k], k))
+            elif order == FREQUENCY_ASC_ORDER:
+                labels = sorted(counts, key=lambda k: (counts[k], k))
+            elif order == ALPHABET_DESC_ORDER:
+                labels = sorted(counts, reverse=True)
+            elif order == ALPHABET_ASC_ORDER:
+                labels = sorted(counts)
+            else:  # arbitrary: first-seen order
+                labels = list(counts)
+            string_arrays.append(labels)
+        model = StringIndexerModel()
+        update_existing_params(model, self)
+        model.string_arrays = string_arrays
+        return model

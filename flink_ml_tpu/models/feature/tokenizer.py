@@ -1,0 +1,87 @@
+"""Tokenizer and RegexTokenizer.
+
+Reference: ``flink-ml-lib/.../feature/tokenizer/Tokenizer.java`` (lowercase, split
+on ``\\s``) and ``feature/regextokenizer/RegexTokenizer.java`` (pattern default
+``\\s+``, ``gaps`` default true — pattern matches separators; false — pattern
+matches tokens; ``minTokenLength`` default 1; ``toLowercase`` default true).
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.params.param import BoolParam, IntParam, ParamValidators, StringParam
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+
+__all__ = ["Tokenizer", "RegexTokenizer"]
+
+
+class Tokenizer(Transformer, HasInputCol, HasOutputCol):
+    """Ref Tokenizer.java — lowercase then split on whitespace."""
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        col = df.column(self.get_input_col())
+        tokens = [s.lower().split() for s in col]
+        out = df.clone()
+        out.add_column(self.get_output_col(), DataTypes.STRING, tokens)
+        return out
+
+
+class RegexTokenizer(Transformer, HasInputCol, HasOutputCol):
+    """Ref RegexTokenizer.java."""
+
+    PATTERN = StringParam("pattern", "Regex pattern used for tokenizing.", r"\s+")
+    GAPS = BoolParam(
+        "gaps", "Whether the pattern matches gaps (true) or tokens (false).", True
+    )
+    MIN_TOKEN_LENGTH = IntParam(
+        "minTokenLength", "Minimum token length.", 1, ParamValidators.gt_eq(0)
+    )
+    TO_LOWERCASE = BoolParam(
+        "toLowercase", "Whether to convert all characters to lowercase before tokenizing.", True
+    )
+
+    def get_pattern(self) -> str:
+        return self.get(self.PATTERN)
+
+    def set_pattern(self, value: str):
+        return self.set(self.PATTERN, value)
+
+    def get_gaps(self) -> bool:
+        return self.get(self.GAPS)
+
+    def set_gaps(self, value: bool):
+        return self.set(self.GAPS, value)
+
+    def get_min_token_length(self) -> int:
+        return self.get(self.MIN_TOKEN_LENGTH)
+
+    def set_min_token_length(self, value: int):
+        return self.set(self.MIN_TOKEN_LENGTH, value)
+
+    def get_to_lowercase(self) -> bool:
+        return self.get(self.TO_LOWERCASE)
+
+    def set_to_lowercase(self, value: bool):
+        return self.set(self.TO_LOWERCASE, value)
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        pattern = re.compile(self.get_pattern())
+        gaps = self.get_gaps()
+        min_len = self.get_min_token_length()
+        lower = self.get_to_lowercase()
+        col = df.column(self.get_input_col())
+        tokens = []
+        for s in col:
+            if lower:
+                s = s.lower()
+            toks = pattern.split(s) if gaps else pattern.findall(s)
+            tokens.append([t for t in toks if len(t) >= min_len])
+        out = df.clone()
+        out.add_column(self.get_output_col(), DataTypes.STRING, tokens)
+        return out

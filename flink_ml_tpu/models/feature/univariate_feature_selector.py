@@ -1,0 +1,146 @@
+"""UnivariateFeatureSelector.
+
+Reference: ``flink-ml-lib/.../feature/univariatefeatureselector/`` — select
+features by univariate statistical tests against the label: chi-square
+(categorical/categorical), ANOVA F (continuous features / categorical label),
+F-regression (continuous/continuous). Selection modes
+(UnivariateFeatureSelectorParams): numTopFeatures (default threshold 50),
+percentile (0.1), fpr / fdr / fwe (0.05; fdr = Benjamini-Hochberg, fwe =
+Bonferroni p < t/numFeatures).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.models.common import ModelArraysMixin
+from flink_ml_tpu.ops.stats import anova_f_classification, chi_square_test, f_regression
+from flink_ml_tpu.params.param import FloatParam, ParamValidators, StringParam, update_existing_params
+from flink_ml_tpu.params.shared import HasFeaturesCol, HasLabelCol, HasOutputCol
+
+__all__ = ["UnivariateFeatureSelector", "UnivariateFeatureSelectorModel"]
+
+CATEGORICAL, CONTINUOUS = "categorical", "continuous"
+NUM_TOP_FEATURES, PERCENTILE, FPR, FDR, FWE = (
+    "numTopFeatures",
+    "percentile",
+    "fpr",
+    "fdr",
+    "fwe",
+)
+_DEFAULT_THRESHOLDS = {NUM_TOP_FEATURES: 50.0, PERCENTILE: 0.1, FPR: 0.05, FDR: 0.05, FWE: 0.05}
+
+
+class _UfsParams(HasFeaturesCol, HasLabelCol, HasOutputCol):
+    FEATURE_TYPE = StringParam(
+        "featureType", "The feature type.", None, ParamValidators.in_array([CATEGORICAL, CONTINUOUS])
+    )
+    LABEL_TYPE = StringParam(
+        "labelType", "The label type.", None, ParamValidators.in_array([CATEGORICAL, CONTINUOUS])
+    )
+    SELECTION_MODE = StringParam(
+        "selectionMode",
+        "The feature selection mode.",
+        NUM_TOP_FEATURES,
+        ParamValidators.in_array([NUM_TOP_FEATURES, PERCENTILE, FPR, FDR, FWE]),
+    )
+    SELECTION_THRESHOLD = FloatParam(
+        "selectionThreshold", "The upper bound of the features the selector will select.", None
+    )
+
+    def get_feature_type(self) -> str:
+        return self.get(self.FEATURE_TYPE)
+
+    def set_feature_type(self, value: str):
+        return self.set(self.FEATURE_TYPE, value)
+
+    def get_label_type(self) -> str:
+        return self.get(self.LABEL_TYPE)
+
+    def set_label_type(self, value: str):
+        return self.set(self.LABEL_TYPE, value)
+
+    def get_selection_mode(self) -> str:
+        return self.get(self.SELECTION_MODE)
+
+    def set_selection_mode(self, value: str):
+        return self.set(self.SELECTION_MODE, value)
+
+    def get_selection_threshold(self):
+        return self.get(self.SELECTION_THRESHOLD)
+
+    def set_selection_threshold(self, value: float):
+        return self.set(self.SELECTION_THRESHOLD, value)
+
+
+class UnivariateFeatureSelectorModel(ModelArraysMixin, Model, _UfsParams):
+    """Ref UnivariateFeatureSelectorModel.java — keeps ``indices``."""
+
+    _MODEL_ARRAY_NAMES = ("indices",)
+
+    def __init__(self):
+        super().__init__()
+        self.indices: Optional[np.ndarray] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float64)
+        out = df.clone()
+        out.add_column(
+            self.get_output_col(),
+            DataTypes.vector(BasicType.DOUBLE),
+            X[:, np.sort(self.indices.astype(np.int64))],
+        )
+        return out
+
+
+class UnivariateFeatureSelector(Estimator, _UfsParams):
+    """Ref UnivariateFeatureSelector.java."""
+
+    def fit(self, *inputs) -> UnivariateFeatureSelectorModel:
+        (df,) = inputs
+        feature_type, label_type = self.get_feature_type(), self.get_label_type()
+        if feature_type is None or label_type is None:
+            raise ValueError("featureType and labelType must be set.")
+        X = df.vectors(self.get_features_col()).astype(np.float64)
+        y = df.scalars(self.get_label_col())
+
+        if feature_type == CATEGORICAL and label_type == CATEGORICAL:
+            p_values = np.asarray(
+                [chi_square_test(X[:, d], y)[2] for d in range(X.shape[1])]
+            )
+        elif feature_type == CONTINUOUS and label_type == CATEGORICAL:
+            _, p_values = anova_f_classification(X, y)
+        elif feature_type == CONTINUOUS and label_type == CONTINUOUS:
+            _, p_values = f_regression(X, y)
+        else:
+            raise ValueError(
+                f"Unsupported combination: featureType={feature_type}, labelType={label_type}."
+            )
+
+        mode = self.get_selection_mode()
+        threshold = self.get_selection_threshold()
+        if threshold is None:
+            threshold = _DEFAULT_THRESHOLDS[mode]
+        d = X.shape[1]
+        order = np.argsort(p_values, kind="stable")
+        if mode == NUM_TOP_FEATURES:
+            indices = order[: int(threshold)]
+        elif mode == PERCENTILE:
+            indices = order[: int(d * threshold)]
+        elif mode == FPR:
+            indices = np.nonzero(p_values < threshold)[0]
+        elif mode == FDR:  # Benjamini-Hochberg
+            sorted_p = p_values[order]
+            below = np.nonzero(sorted_p <= threshold * (np.arange(1, d + 1) / d))[0]
+            indices = order[: below[-1] + 1] if below.size else np.asarray([], np.int64)
+        else:  # FWE (Bonferroni)
+            indices = np.nonzero(p_values < threshold / d)[0]
+
+        model = UnivariateFeatureSelectorModel()
+        update_existing_params(model, self)
+        model.indices = np.sort(np.asarray(indices, np.int64))
+        return model

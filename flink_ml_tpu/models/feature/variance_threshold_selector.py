@@ -1,0 +1,72 @@
+"""VarianceThresholdSelector.
+
+Reference: ``flink-ml-lib/.../feature/variancethresholdselector/`` — remove
+features whose sample variance is not greater than ``varianceThreshold``
+(default 0: keep only non-constant features).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.models.common import ModelArraysMixin
+from flink_ml_tpu.params.param import FloatParam, ParamValidators, update_existing_params
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+
+__all__ = ["VarianceThresholdSelector", "VarianceThresholdSelectorModel"]
+
+
+class _VtsParams(HasInputCol, HasOutputCol):
+    VARIANCE_THRESHOLD = FloatParam(
+        "varianceThreshold",
+        "Features with a variance not greater than this threshold will be removed.",
+        0.0,
+        ParamValidators.gt_eq(0),
+    )
+
+    def get_variance_threshold(self) -> float:
+        return self.get(self.VARIANCE_THRESHOLD)
+
+    def set_variance_threshold(self, value: float):
+        return self.set(self.VARIANCE_THRESHOLD, value)
+
+
+class VarianceThresholdSelectorModel(ModelArraysMixin, Model, _VtsParams):
+    """Ref VarianceThresholdSelectorModel.java — keeps ``indices``."""
+
+    _MODEL_ARRAY_NAMES = ("indices",)
+
+    def __init__(self):
+        super().__init__()
+        self.indices: Optional[np.ndarray] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_input_col()).astype(np.float64)
+        out = df.clone()
+        out.add_column(
+            self.get_output_col(),
+            DataTypes.vector(BasicType.DOUBLE),
+            X[:, self.indices.astype(np.int64)],
+        )
+        return out
+
+
+class VarianceThresholdSelector(Estimator, _VtsParams):
+    """Ref VarianceThresholdSelector.java."""
+
+    def fit(self, *inputs) -> VarianceThresholdSelectorModel:
+        (df,) = inputs
+        X = df.vectors(self.get_input_col()).astype(np.float64)
+        if len(X) == 0:
+            raise RuntimeError("The training set is empty.")
+        variance = X.var(axis=0, ddof=1) if len(X) > 1 else np.zeros(X.shape[1])
+        model = VarianceThresholdSelectorModel()
+        update_existing_params(model, self)
+        model.indices = np.nonzero(variance > self.get_variance_threshold())[0].astype(
+            np.int64
+        )
+        return model

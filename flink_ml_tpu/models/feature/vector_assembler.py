@@ -1,0 +1,89 @@
+"""VectorAssembler.
+
+Reference: ``flink-ml-lib/.../feature/vectorassembler/VectorAssembler.java`` —
+concatenate numeric and vector input columns into one vector; ``inputSizes``
+declares each column's width (used to fill nulls); handleInvalid: 'error' raises
+on null/NaN/size mismatch, 'skip' drops the row, 'keep' fills nulls with NaN.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.linalg.vectors import Vector
+from flink_ml_tpu.params.param import IntArrayParam, ParamValidators
+from flink_ml_tpu.params.shared import HasHandleInvalid, HasInputCols, HasOutputCol
+
+__all__ = ["VectorAssembler"]
+
+
+class VectorAssembler(Transformer, HasInputCols, HasOutputCol, HasHandleInvalid):
+    """Ref VectorAssembler.java."""
+
+    INPUT_SIZES = IntArrayParam(
+        "inputSizes",
+        "Sizes of the input elements to be assembled (one per input column).",
+        None,
+        lambda v: v is not None and all(int(s) > 0 for s in v),
+    )
+
+    def get_input_sizes(self):
+        return self.get(self.INPUT_SIZES)
+
+    def set_input_sizes(self, *values: int):
+        return self.set(self.INPUT_SIZES, list(values))
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        in_cols = self.get_input_cols()
+        sizes = [int(s) for s in self.get_input_sizes()]
+        handle = self.get_handle_invalid()
+        if len(sizes) != len(in_cols):
+            raise ValueError("VectorAssembler: one input size per input column required")
+        n = len(df)
+        total = sum(sizes)
+        assembled = np.zeros((n, total), np.float64)
+        invalid = np.zeros(n, bool)
+
+        offset = 0
+        for name, size in zip(in_cols, sizes):
+            col = df.column(name)
+            block = np.full((n, size), np.nan)
+            if isinstance(col, np.ndarray):
+                vals = col if col.ndim == 2 else col[:, None].astype(np.float64)
+                if vals.shape[1] != size:
+                    raise ValueError(
+                        f"Input column {name} has size {vals.shape[1]} but expected {size}."
+                    )
+                block = vals.astype(np.float64)
+            else:
+                for i, v in enumerate(col):
+                    if v is None:
+                        invalid[i] = True
+                        continue
+                    arr = v.to_array() if isinstance(v, Vector) else np.asarray([v], np.float64)
+                    if arr.shape[0] != size:
+                        raise ValueError(
+                            f"Input column {name} has size {arr.shape[0]} but expected {size}."
+                        )
+                    block[i] = arr
+            assembled[:, offset : offset + size] = block
+            offset += size
+
+        nan_rows = np.isnan(assembled).any(axis=1)
+        if handle == "error":
+            if invalid.any() or nan_rows.any():
+                raise ValueError(
+                    "Vector assembler failed: encountered null/NaN with handleInvalid = "
+                    "'error'. Consider handleInvalid = 'keep' or 'skip'."
+                )
+        elif handle == "skip":
+            keep = ~(invalid | nan_rows)
+            df = df.take(np.nonzero(keep)[0])
+            assembled = assembled[keep]
+        out = df.clone()
+        out.add_column(
+            self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), assembled
+        )
+        return out

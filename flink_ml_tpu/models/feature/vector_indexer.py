@@ -1,0 +1,131 @@
+"""VectorIndexer.
+
+Reference: ``flink-ml-lib/.../feature/vectorindexer/VectorIndexer.java`` — decide
+per input-vector dimension whether it is categorical (≤ ``maxCategories``
+distinct values); categorical dims get their values mapped to indices over the
+sorted distinct values with 0.0 (if present) forced to index 0
+(VectorIndexer.ModelGenerator); continuous dims pass through. ``handleInvalid``
+applies to unseen values of categorical dims at transform ('keep' maps them to
+mapSize).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.params.param import IntParam, ParamValidators, update_existing_params
+from flink_ml_tpu.params.shared import HasHandleInvalid, HasInputCol, HasOutputCol
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["VectorIndexer", "VectorIndexerModel"]
+
+
+class _ViParams(HasInputCol, HasOutputCol, HasHandleInvalid):
+    MAX_CATEGORIES = IntParam(
+        "maxCategories",
+        "Threshold for the number of values a categorical feature can take.",
+        20,
+        ParamValidators.gt(1),
+    )
+
+    def get_max_categories(self) -> int:
+        return self.get(self.MAX_CATEGORIES)
+
+    def set_max_categories(self, value: int):
+        return self.set(self.MAX_CATEGORIES, value)
+
+
+class VectorIndexerModel(Model, _ViParams):
+    """Ref VectorIndexerModel.java — categoryMaps: dim → {value → index}."""
+
+    def __init__(self):
+        super().__init__()
+        self.category_maps: Optional[Dict[int, Dict[float, int]]] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_input_col()).astype(np.float64)
+        handle = self.get_handle_invalid()
+        out_vals = X.copy()
+        keep_mask = np.ones(len(X), bool)
+        for d, mapping in self.category_maps.items():
+            col = X[:, d]
+            mapped = np.full(len(col), -1.0)
+            for value, idx in mapping.items():
+                mapped[col == value] = idx
+            unseen = mapped < 0
+            if unseen.any():
+                if handle == "error":
+                    raise ValueError(
+                        f"The input contains unseen value {col[unseen][0]} in dim {d}."
+                    )
+                if handle == "keep":
+                    mapped[unseen] = len(mapping)
+                else:
+                    keep_mask &= ~unseen
+            out_vals[:, d] = mapped
+        out = df.clone()
+        out.add_column(
+            self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), out_vals
+        )
+        if not keep_mask.all():
+            out = out.take(np.nonzero(keep_mask)[0])
+        return out
+
+    def get_model_data(self):
+        from flink_ml_tpu.api.dataframe import DataFrame
+
+        return [DataFrame(["categoryMaps"], None, [[self.category_maps]])]
+
+    def set_model_data(self, *model_data):
+        self.category_maps = model_data[0].column("categoryMaps")[0]
+        return self
+
+    def save(self, path: str) -> None:
+        rw.save_metadata(
+            self,
+            path,
+            {
+                "categoryMaps": {
+                    str(d): {repr(v): i for v, i in m.items()}
+                    for d, m in self.category_maps.items()
+                }
+            },
+        )
+
+    @classmethod
+    def load(cls, path: str):
+        metadata = rw.load_metadata(path, rw.stage_class_name(cls))
+        model = cls()
+        model.load_param_map_from_json(metadata["paramMap"])
+        model.category_maps = {
+            int(d): {float(v): int(i) for v, i in m.items()}
+            for d, m in metadata["categoryMaps"].items()
+        }
+        return model
+
+
+class VectorIndexer(Estimator, _ViParams):
+    """Ref VectorIndexer.java."""
+
+    def fit(self, *inputs) -> VectorIndexerModel:
+        (df,) = inputs
+        X = df.vectors(self.get_input_col()).astype(np.float64)
+        max_cat = self.get_max_categories()
+        category_maps: Dict[int, Dict[float, int]] = {}
+        for d in range(X.shape[1]):
+            distinct = np.unique(X[:, d])
+            if len(distinct) <= max_cat:
+                values = sorted(distinct.tolist())
+                if 0.0 in values:  # 0 is forced to index 0 (sparse-friendly)
+                    values.remove(0.0)
+                    values = [0.0] + values
+                category_maps[d] = {v: i for i, v in enumerate(values)}
+        model = VectorIndexerModel()
+        update_existing_params(model, self)
+        model.category_maps = category_maps
+        return model

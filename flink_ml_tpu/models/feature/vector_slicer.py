@@ -1,0 +1,76 @@
+"""VectorSlicer.
+
+Reference: ``flink-ml-lib/.../feature/vectorslicer/VectorSlicer.java`` — select the
+given indices (in order, duplicates disallowed) from each input vector.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.linalg.vectors import SparseVector, Vector
+from flink_ml_tpu.params.param import IntArrayParam
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+
+__all__ = ["VectorSlicer"]
+
+
+def _indices_valid(v) -> bool:
+    return (
+        v is not None
+        and len(v) > 0
+        and all(int(i) >= 0 for i in v)
+        and len(set(v)) == len(v)
+    )
+
+
+class VectorSlicer(Transformer, HasInputCol, HasOutputCol):
+    """Ref VectorSlicer.java."""
+
+    INDICES = IntArrayParam(
+        "indices",
+        "An array of indices to select features from a vector column.",
+        None,
+        _indices_valid,
+    )
+
+    def get_indices(self):
+        return self.get(self.INDICES)
+
+    def set_indices(self, *values: int):
+        return self.set(self.INDICES, list(values))
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        idx = np.asarray([int(i) for i in self.get_indices()])
+        col = df.column(self.get_input_col())
+        out = df.clone()
+        if isinstance(col, np.ndarray):
+            if idx.max() >= col.shape[1]:
+                raise ValueError(
+                    f"Index {idx.max()} out of bounds for vector of size {col.shape[1]}"
+                )
+            out.add_column(
+                self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), col[:, idx]
+            )
+        else:
+            new_col = []
+            pos = {int(i): j for j, i in enumerate(idx)}
+            for v in col:
+                if isinstance(v, SparseVector):
+                    keep = [j for j, i in enumerate(v.indices) if int(i) in pos]
+                    new_idx = np.asarray([pos[int(v.indices[j])] for j in keep])
+                    order = np.argsort(new_idx) if len(new_idx) else new_idx
+                    new_col.append(
+                        SparseVector(
+                            len(idx),
+                            new_idx[order] if len(new_idx) else new_idx,
+                            v.values[keep][order] if len(keep) else np.zeros(0),
+                        )
+                    )
+                else:
+                    arr = v.to_array() if isinstance(v, Vector) else np.asarray(v)
+                    new_col.append(arr[idx])
+            out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), new_col)
+        return out

@@ -1,0 +1,94 @@
+"""Statistical primitives: chi-square / F-test machinery.
+
+Backing for ``stats/ChiSqTest``, ``stats/ANOVATest``, ``stats/FValueTest`` and
+``feature/UnivariateFeatureSelector`` (SURVEY.md §2.5). Distribution tails come
+from ``jax.scipy.special`` (regularized incomplete gamma/beta) — no SciPy needed.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+import numpy as np
+
+__all__ = ["chi2_sf", "f_sf", "chi_square_test", "anova_f_classification", "f_regression"]
+
+
+def chi2_sf(x, df):
+    """P[Chi2(df) > x] = Q(df/2, x/2)."""
+    x = jnp.asarray(x, jnp.float64 if jnp.float64 == jnp.result_type(x) else jnp.float32)
+    return np.asarray(jsp.gammaincc(jnp.asarray(df) / 2.0, x / 2.0))
+
+
+def f_sf(x, dfn, dfd):
+    """P[F(dfn, dfd) > x] via the regularized incomplete beta."""
+    x = np.asarray(x, np.float64)
+    dfn = np.asarray(dfn, np.float64)
+    dfd = np.asarray(dfd, np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        z = dfd / (dfd + dfn * x)
+    out = np.asarray(jsp.betainc(dfd / 2.0, dfn / 2.0, np.clip(z, 0.0, 1.0)))
+    return np.where(x <= 0, 1.0, out)
+
+
+def chi_square_test(values: np.ndarray, labels: np.ndarray) -> Tuple[float, int, float]:
+    """Pearson chi-square independence test of one discrete feature vs labels.
+
+    Returns (statistic, degrees_of_freedom, p_value). Mirrors the reference's
+    contingency-table aggregation (stats/chisqtest/ChiSqTest.java).
+    """
+    cats_v, inv_v = np.unique(values, return_inverse=True)
+    cats_l, inv_l = np.unique(labels, return_inverse=True)
+    table = np.zeros((len(cats_v), len(cats_l)))
+    np.add.at(table, (inv_v, inv_l), 1.0)
+    n = table.sum()
+    expected = table.sum(axis=1, keepdims=True) * table.sum(axis=0, keepdims=True) / n
+    with np.errstate(divide="ignore", invalid="ignore"):
+        stat = np.where(expected > 0, (table - expected) ** 2 / expected, 0.0).sum()
+    dof = (len(cats_v) - 1) * (len(cats_l) - 1)
+    p = float(chi2_sf(stat, dof)) if dof > 0 else 1.0
+    return float(stat), int(dof), p
+
+
+def anova_f_classification(X: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """One-way ANOVA F per feature against class labels → (f_stats, p_values).
+
+    Mirrors stats/anovatest/ANOVATest.java's between/within variance ratio.
+    """
+    classes = np.unique(y)
+    n, d = X.shape
+    overall_mean = X.mean(axis=0)
+    ss_between = np.zeros(d)
+    ss_within = np.zeros(d)
+    for c in classes:
+        Xc = X[y == c]
+        nc = Xc.shape[0]
+        mc = Xc.mean(axis=0)
+        ss_between += nc * (mc - overall_mean) ** 2
+        ss_within += ((Xc - mc) ** 2).sum(axis=0)
+    dfn = len(classes) - 1
+    dfd = n - len(classes)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        f = (ss_between / dfn) / (ss_within / dfd)
+    f = np.nan_to_num(f, nan=0.0, posinf=np.inf)
+    p = f_sf(f, dfn, dfd)
+    return f, np.asarray(p)
+
+
+def f_regression(X: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """F-test of each continuous feature vs a continuous label → (f, p).
+
+    Mirrors stats/fvaluetest/FValueTest.java: F = r²/(1−r²)·(n−2) with r the
+    Pearson correlation.
+    """
+    n = X.shape[0]
+    xm = X - X.mean(axis=0)
+    ym = y - y.mean()
+    denom = np.sqrt((xm**2).sum(axis=0) * (ym**2).sum())
+    with np.errstate(divide="ignore", invalid="ignore"):
+        r = np.where(denom > 0, xm.T @ ym / denom, 0.0)
+        f = r**2 / (1 - r**2) * (n - 2)
+    f = np.nan_to_num(f, nan=0.0, posinf=np.inf)
+    p = f_sf(f, 1, n - 2)
+    return f, np.asarray(p)

@@ -31,7 +31,7 @@ class PipelineModelServable(TransformerServable):
     def set_model_data(self, *model_data_inputs) -> "PipelineModelServable":
         i = 0
         for servable in self.servables:
-            if hasattr(servable, "set_model_data") and servable._MODEL_ARRAY_NAMES:
+            if getattr(servable, "_MODEL_ARRAY_NAMES", ()):
                 servable.set_model_data(model_data_inputs[i])
                 i += 1
         return self

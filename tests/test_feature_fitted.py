@@ -1,0 +1,307 @@
+"""Tests for the fitted feature stages (reference test shape: defaults,
+fit+transform vs hand-computed values, save/load, model data)."""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.linalg.vectors import Vectors
+from flink_ml_tpu.models.feature.count_vectorizer import CountVectorizer, CountVectorizerModel
+from flink_ml_tpu.models.feature.idf import IDF, IDFModel
+from flink_ml_tpu.models.feature.imputer import Imputer, ImputerModel
+from flink_ml_tpu.models.feature.kbins_discretizer import KBinsDiscretizer
+from flink_ml_tpu.models.feature.lsh import JavaRandom, MinHashLSH
+from flink_ml_tpu.models.feature.one_hot_encoder import OneHotEncoder
+from flink_ml_tpu.models.feature.scalers import (
+    MaxAbsScaler,
+    MinMaxScaler,
+    MinMaxScalerModel,
+    RobustScaler,
+)
+from flink_ml_tpu.models.feature.string_indexer import (
+    IndexToStringModel,
+    StringIndexer,
+    StringIndexerModel,
+)
+from flink_ml_tpu.models.feature.univariate_feature_selector import UnivariateFeatureSelector
+from flink_ml_tpu.models.feature.variance_threshold_selector import VarianceThresholdSelector
+from flink_ml_tpu.models.feature.vector_indexer import VectorIndexer, VectorIndexerModel
+
+RNG = np.random.default_rng(44)
+
+
+def test_max_abs_scaler():
+    X = np.asarray([[2.0, -4.0], [-1.0, 2.0]])
+    model = MaxAbsScaler().fit(DataFrame.from_dict({"input": X}))
+    np.testing.assert_array_equal(model.max_abs, [2.0, 4.0])
+    out = model.transform(DataFrame.from_dict({"input": X}))["output"]
+    np.testing.assert_allclose(out, [[1.0, -1.0], [-0.5, 0.5]])
+
+
+def test_min_max_scaler_with_constant_dim():
+    X = np.asarray([[0.0, 7.0], [5.0, 7.0], [10.0, 7.0]])
+    model = MinMaxScaler().fit(DataFrame.from_dict({"input": X}))
+    out = model.transform(DataFrame.from_dict({"input": X}))["output"]
+    np.testing.assert_allclose(out[:, 0], [0.0, 0.5, 1.0])
+    np.testing.assert_allclose(out[:, 1], 0.5)  # constant dim → midpoint
+    # custom range
+    model2 = MinMaxScaler().set_min(-1.0).set_max(1.0).fit(DataFrame.from_dict({"input": X}))
+    out2 = model2.transform(DataFrame.from_dict({"input": X}))["output"]
+    np.testing.assert_allclose(out2[:, 0], [-1.0, 0.0, 1.0])
+
+
+def test_min_max_scaler_save_load(tmp_path):
+    X = RNG.normal(size=(10, 2))
+    model = MinMaxScaler().fit(DataFrame.from_dict({"input": X}))
+    model.save(str(tmp_path / "mms"))
+    loaded = MinMaxScalerModel.load(str(tmp_path / "mms"))
+    np.testing.assert_allclose(loaded.e_min, model.e_min)
+
+
+def test_robust_scaler_iqr():
+    x = np.arange(1.0, 101.0)[:, None]  # 1..100
+    model = RobustScaler().fit(DataFrame.from_dict({"input": x}))
+    out = model.transform(DataFrame.from_dict({"input": x}))["output"]
+    iqr = np.quantile(x, 0.75) - np.quantile(x, 0.25)
+    np.testing.assert_allclose(out[:, 0], x[:, 0] / iqr)
+    model_c = RobustScaler().set_with_centering(True).fit(DataFrame.from_dict({"input": x}))
+    out_c = model_c.transform(DataFrame.from_dict({"input": x}))["output"]
+    np.testing.assert_allclose(out_c[:, 0], (x[:, 0] - np.median(x)) / iqr)
+
+
+def test_imputer_strategies(tmp_path):
+    x = np.asarray([1.0, 2.0, np.nan, 3.0, 2.0])
+    df = DataFrame.from_dict({"a": x})
+    for strategy, expected in [("mean", 2.0), ("median", 2.0), ("most_frequent", 2.0)]:
+        model = (
+            Imputer()
+            .set_input_cols("a")
+            .set_output_cols("out")
+            .set_strategy(strategy)
+            .fit(df)
+        )
+        out = model.transform(df)["out"]
+        assert out[2] == expected, strategy
+        assert not np.isnan(out).any()
+    # custom missing value
+    df2 = DataFrame.from_dict({"a": np.asarray([1.0, -1.0, 5.0])})
+    m = (
+        Imputer()
+        .set_input_cols("a")
+        .set_output_cols("out")
+        .set_missing_value(-1.0)
+        .fit(df2)
+    )
+    np.testing.assert_array_equal(m.transform(df2)["out"], [1.0, 3.0, 5.0])
+    m.save(str(tmp_path / "imp"))
+    loaded = ImputerModel.load(str(tmp_path / "imp"))
+    np.testing.assert_array_equal(loaded.surrogates, m.surrogates)
+
+
+def test_idf_formula():
+    X = np.asarray([[1.0, 0.0], [1.0, 1.0]])
+    df = DataFrame.from_dict({"input": X})
+    model = IDF().fit(df)
+    # idf = log((n+1)/(df+1)): dim0 df=2 -> log(3/3)=0; dim1 df=1 -> log(3/2)
+    np.testing.assert_allclose(model.idf, [0.0, np.log(1.5)], atol=1e-9)
+    out = model.transform(df)["output"]
+    np.testing.assert_allclose(out[:, 1], [0.0, np.log(1.5)])
+    # minDocFreq filters dims
+    model2 = IDF().set_min_doc_freq(2).fit(df)
+    assert model2.idf[1] == 0.0
+
+
+def test_count_vectorizer():
+    docs = [["a", "b", "c"], ["a", "b", "b", "c"], ["a", "b"]]
+    df = DataFrame(["input"], None, [docs])
+    model = CountVectorizer().fit(df)
+    assert model.vocabulary[0] == "b"  # most frequent first (b: 4, a: 3, c: 2)
+    out = model.transform(df)["output"]
+    v1 = out[1]
+    assert v1.size() == 3
+    np.testing.assert_array_equal(sorted(v1.values.tolist()), [1.0, 1.0, 2.0])
+    # minDF as absolute count
+    model2 = CountVectorizer().set_min_df(3.0).fit(df)
+    assert set(model2.vocabulary) == {"a", "b"}
+    # binary + minTF
+    model3 = CountVectorizer().set_binary(True).fit(df)
+    outb = model3.transform(df)["output"]
+    assert set(outb[1].values.tolist()) == {1.0}
+
+
+def test_count_vectorizer_save_load(tmp_path):
+    docs = [["x", "y"], ["y"]]
+    model = CountVectorizer().fit(DataFrame(["input"], None, [docs]))
+    model.save(str(tmp_path / "cv"))
+    loaded = CountVectorizerModel.load(str(tmp_path / "cv"))
+    assert loaded.vocabulary == model.vocabulary
+
+
+def test_string_indexer_orders_and_handle_invalid(tmp_path):
+    df = DataFrame(["s"], None, [["b", "a", "b", "c", "b", "a"]])
+    si = StringIndexer().set_input_cols("s").set_output_cols("idx")
+    m = si.set_string_order_type("frequencyDesc").fit(df)
+    assert m.string_arrays[0] == ["b", "a", "c"]
+    np.testing.assert_array_equal(m.transform(df)["idx"], [0, 1, 0, 2, 0, 1])
+    m2 = si.set_string_order_type("alphabetAsc").fit(df)
+    assert m2.string_arrays[0] == ["a", "b", "c"]
+    # handleInvalid on unseen
+    df_new = DataFrame(["s"], None, [["a", "zzz"]])
+    with pytest.raises(ValueError, match="unseen"):
+        m2.transform(df_new)
+    np.testing.assert_array_equal(
+        m2.set_handle_invalid("keep").transform(df_new)["idx"], [0.0, 3.0]
+    )
+    assert len(m2.set_handle_invalid("skip").transform(df_new)) == 1
+    # save/load + IndexToString inverse
+    m2.save(str(tmp_path / "si"))
+    loaded = StringIndexerModel.load(str(tmp_path / "si"))
+    assert loaded.string_arrays == m2.string_arrays
+    its = IndexToStringModel().set_input_cols("idx").set_output_cols("s2")
+    its.string_arrays = m2.string_arrays
+    round_trip = its.transform(
+        DataFrame.from_dict({"idx": np.asarray([0.0, 1.0, 2.0])})
+    )["s2"]
+    assert round_trip == ["a", "b", "c"]
+
+
+def test_one_hot_encoder():
+    df = DataFrame.from_dict({"c": np.asarray([0.0, 1.0, 2.0])})
+    model = OneHotEncoder().set_input_cols("c").set_output_cols("vec").fit(df)
+    np.testing.assert_array_equal(model.category_sizes, [3])
+    out = model.transform(df)["vec"]
+    np.testing.assert_array_equal(out[0].to_array(), [1.0, 0.0])  # dropLast: len 2
+    np.testing.assert_array_equal(out[2].to_array(), [0.0, 0.0])  # last → all zeros
+    model.set_drop_last(False)
+    out2 = model.transform(df)["vec"]
+    np.testing.assert_array_equal(out2[2].to_array(), [0.0, 0.0, 1.0])
+    # unseen index
+    df_bad = DataFrame.from_dict({"c": np.asarray([5.0])})
+    with pytest.raises(ValueError, match="invalid index"):
+        model.transform(df_bad)
+    kept = model.set_handle_invalid("keep").transform(df_bad)["vec"]
+    assert kept[0].size() == 4  # 3 categories + 1 invalid bucket
+
+
+def test_kbins_strategies():
+    x = np.concatenate([np.arange(10.0), [100.0]])[:, None]
+    df = DataFrame.from_dict({"input": x})
+    uni = KBinsDiscretizer().set_strategy("uniform").set_num_bins(2).fit(df)
+    out_u = uni.transform(df)["output"][:, 0]
+    assert out_u[:-1].max() == 0.0 and out_u[-1] == 1.0  # wide uniform bins
+    qua = KBinsDiscretizer().set_strategy("quantile").set_num_bins(2).fit(df)
+    out_q = qua.transform(df)["output"][:, 0]
+    assert (out_q[:5] == 0.0).all() and (out_q[-3:] == 1.0).all()
+    km = KBinsDiscretizer().set_strategy("kmeans").set_num_bins(2).fit(df)
+    out_k = km.transform(df)["output"][:, 0]
+    assert out_k[-1] == out_k.max() and out_k[0] == 0.0
+    # out-of-range values clamp into edge bins
+    out_clamp = uni.transform(DataFrame.from_dict({"input": np.asarray([[-99.0]])}))
+    assert out_clamp["output"][0, 0] == 0.0
+
+
+def test_kbins_constant_dimension_bins_to_zero():
+    df = DataFrame.from_dict({"input": np.full((6, 1), 5.0)})
+    for strategy in ("uniform", "quantile", "kmeans"):
+        model = KBinsDiscretizer().set_strategy(strategy).set_num_bins(4).fit(df)
+        out = model.transform(df)["output"]
+        np.testing.assert_array_equal(out, 0.0), strategy
+
+
+def test_variance_threshold_selector():
+    X = np.stack([np.ones(10), np.arange(10.0), np.arange(10.0) * 5], axis=1)
+    df = DataFrame.from_dict({"input": X})
+    model = VarianceThresholdSelector().fit(df)
+    np.testing.assert_array_equal(model.indices, [1, 2])  # constant dim dropped
+    model2 = VarianceThresholdSelector().set_variance_threshold(50.0).fit(df)
+    np.testing.assert_array_equal(model2.indices, [2])
+    out = model2.transform(df)["output"]
+    np.testing.assert_array_equal(out[:, 0], X[:, 2])
+
+
+def test_vector_indexer():
+    X = np.asarray([[0.0, 1.5], [2.0, 2.5], [0.0, 3.5], [2.0, 4.5], [1.0, 5.5]])
+    df = DataFrame.from_dict({"input": X})
+    model = VectorIndexer().set_max_categories(3).fit(df)
+    assert 0 in model.category_maps and 1 not in model.category_maps
+    assert model.category_maps[0] == {0.0: 0, 1.0: 1, 2.0: 2}
+    out = model.transform(df)["output"]
+    np.testing.assert_array_equal(out[:, 0], [0, 2, 0, 2, 1])
+    np.testing.assert_array_equal(out[:, 1], X[:, 1])  # continuous untouched
+    # unseen categorical value
+    df_bad = DataFrame.from_dict({"input": np.asarray([[7.0, 1.0]])})
+    with pytest.raises(ValueError, match="unseen"):
+        model.transform(df_bad)
+    kept = model.set_handle_invalid("keep").transform(df_bad)["output"]
+    assert kept[0, 0] == 3.0
+
+
+def test_vector_indexer_save_load(tmp_path):
+    X = np.asarray([[0.0], [1.0], [0.0]])
+    model = VectorIndexer().fit(DataFrame.from_dict({"input": X}))
+    model.save(str(tmp_path / "vi"))
+    loaded = VectorIndexerModel.load(str(tmp_path / "vi"))
+    assert loaded.category_maps == model.category_maps
+
+
+def test_univariate_feature_selector_modes():
+    rng = np.random.default_rng(0)
+    n = 200
+    y = rng.integers(0, 2, n).astype(np.float64)
+    informative = y * 2.0 + rng.normal(0, 0.1, n)
+    noise = rng.normal(size=(n, 3))
+    X = np.column_stack([informative, noise])
+    df = DataFrame.from_dict({"features": X, "label": y})
+    sel = (
+        UnivariateFeatureSelector()
+        .set_feature_type("continuous")
+        .set_label_type("categorical")
+        .set_selection_threshold(1)
+    )
+    model = sel.fit(df)
+    np.testing.assert_array_equal(model.indices, [0])
+    out = model.transform(df)["output"]
+    np.testing.assert_allclose(out[:, 0], informative)
+    # fpr mode keeps only significant features
+    sel_fpr = (
+        UnivariateFeatureSelector()
+        .set_feature_type("continuous")
+        .set_label_type("categorical")
+        .set_selection_mode("fpr")
+        .set_selection_threshold(0.01)
+    )
+    assert 0 in sel_fpr.fit(df).indices.tolist()
+
+
+def test_java_random_parity():
+    """Raw 32-bit draws match java.util.Random's documented outputs."""
+
+    def next_int(seed):
+        r = JavaRandom(seed)
+        v = r._next(32)
+        return v - (1 << 32) if v >= (1 << 31) else v
+
+    assert next_int(42) == -1170105035  # new Random(42).nextInt()
+    assert next_int(0) == -1155484576  # new Random(0).nextInt()
+
+
+def test_minhash_lsh_jaccard_and_neighbors():
+    a = Vectors.sparse(10, [0, 1, 2], [1.0, 1.0, 1.0])
+    b = Vectors.sparse(10, [1, 2, 3], [1.0, 1.0, 1.0])
+    c = Vectors.sparse(10, [7, 8, 9], [1.0, 1.0, 1.0])
+    df = DataFrame(["vec", "id"], None, [[a, b, c], [0, 1, 2]])
+    lsh = (
+        MinHashLSH()
+        .set_input_col("vec")
+        .set_output_col("hashes")
+        .set_num_hash_tables(10)
+        .set_seed(2022)
+    )
+    model = lsh.fit(df)
+    assert model.key_distance(a, b) == pytest.approx(1 - 2 / 4)
+    out = model.transform(df)
+    assert out["hashes"][0].shape == (10, 1)
+    nn = model.approx_nearest_neighbors(df, a, k=2)
+    assert list(nn["id"]) == [0, 1]  # exact self-match then the overlapping set
+    join = model.approx_similarity_join(df, df, threshold=0.6, id_col="id")
+    pairs = {(int(x), int(y)) for x, y in zip(join["idA"], join["idB"])}
+    assert (0, 1) in pairs and (0, 0) in pairs and (0, 2) not in pairs

@@ -1,0 +1,282 @@
+"""Tests for the 17 stateless feature transformers (reference test shape: defaults,
+transform correctness vs hand-computed values, save/load)."""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.linalg.vectors import DenseVector, SparseVector, Vectors
+from flink_ml_tpu.models import STAGE_REGISTRY, get_stage_class
+from flink_ml_tpu.models.feature.binarizer import Binarizer
+from flink_ml_tpu.models.feature.bucketizer import Bucketizer
+from flink_ml_tpu.models.feature.dct import DCT
+from flink_ml_tpu.models.feature.elementwise_product import ElementwiseProduct
+from flink_ml_tpu.models.feature.feature_hasher import FeatureHasher
+from flink_ml_tpu.models.feature.hashing_tf import HashingTF
+from flink_ml_tpu.models.feature.interaction import Interaction
+from flink_ml_tpu.models.feature.ngram import NGram
+from flink_ml_tpu.models.feature.normalizer import Normalizer
+from flink_ml_tpu.models.feature.polynomial_expansion import PolynomialExpansion
+from flink_ml_tpu.models.feature.random_splitter import RandomSplitter
+from flink_ml_tpu.models.feature.sql_transformer import SQLTransformer
+from flink_ml_tpu.models.feature.stop_words_remover import StopWordsRemover
+from flink_ml_tpu.models.feature.tokenizer import RegexTokenizer, Tokenizer
+from flink_ml_tpu.models.feature.vector_assembler import VectorAssembler
+from flink_ml_tpu.models.feature.vector_slicer import VectorSlicer
+
+
+def test_binarizer_scalar_and_vector():
+    df = DataFrame.from_dict(
+        {"a": np.asarray([0.5, 2.0]), "v": np.asarray([[1.0, 3.0], [2.0, 0.0]])}
+    )
+    out = (
+        Binarizer()
+        .set_input_cols("a", "v")
+        .set_output_cols("ab", "vb")
+        .set_thresholds(1.0, 1.5)
+        .transform(df)
+    )
+    np.testing.assert_array_equal(out["ab"], [0.0, 1.0])
+    np.testing.assert_array_equal(out["vb"], [[0.0, 1.0], [1.0, 0.0]])
+
+
+def test_bucketizer_modes():
+    df = DataFrame.from_dict({"x": np.asarray([-1.0, 0.5, 1.5, 99.0])})
+    b = Bucketizer().set_input_cols("x").set_output_cols("b").set_splits_array([[0.0, 1.0, 2.0]])
+    with pytest.raises(ValueError, match="invalid value"):
+        b.transform(df)
+    out_keep = b.set_handle_invalid("keep").transform(df)
+    np.testing.assert_array_equal(out_keep["b"], [2.0, 0.0, 1.0, 2.0])
+    out_skip = b.set_handle_invalid("skip").transform(df)
+    np.testing.assert_array_equal(out_skip["b"], [0.0, 1.0])
+    # right edge of last bucket is inclusive
+    df2 = DataFrame.from_dict({"x": np.asarray([2.0])})
+    np.testing.assert_array_equal(
+        b.set_handle_invalid("error").transform(df2)["b"], [1.0]
+    )
+
+
+def test_dct_forward_inverse_round_trip():
+    X = np.random.default_rng(0).normal(size=(4, 8))
+    df = DataFrame.from_dict({"input": X})
+    fwd = DCT().transform(df)
+    # Parseval: orthonormal transform preserves norms (float32 compute on device)
+    np.testing.assert_allclose(
+        np.linalg.norm(fwd["output"], axis=1), np.linalg.norm(X, axis=1), atol=1e-5
+    )
+    back = DCT().set_inverse(True).set_input_col("output").set_output_col("rec").transform(fwd)
+    np.testing.assert_allclose(back["rec"], X, atol=1e-5)
+
+
+def test_elementwise_product_dense_and_sparse():
+    df = DataFrame.from_dict({"input": np.asarray([[1.0, 2.0, 3.0]])})
+    out = ElementwiseProduct().set_scaling_vec(DenseVector([2.0, 0.0, -1.0])).transform(df)
+    np.testing.assert_array_equal(out["output"], [[2.0, 0.0, -3.0]])
+    sv = Vectors.sparse(3, [0, 2], [5.0, 7.0])
+    df2 = DataFrame(["input"], None, [[sv]])
+    out2 = ElementwiseProduct().set_scaling_vec(DenseVector([2.0, 0.0, -1.0])).transform(df2)
+    got = out2["input" if False else "output"][0]
+    np.testing.assert_array_equal(got.to_array(), [10.0, 0.0, -7.0])
+
+
+def test_feature_hasher_accumulates_and_is_stable():
+    df = DataFrame.from_dict({"num": np.asarray([1.5]), "cat": ["red"]})
+    fh = FeatureHasher().set_input_cols("num", "cat").set_num_features(16)
+    out1 = fh.transform(df)["output"][0]
+    out2 = fh.transform(df)["output"][0]
+    assert out1.size() == 16
+    np.testing.assert_array_equal(out1.to_array(), out2.to_array())
+    assert out1.to_array().sum() == pytest.approx(2.5)  # 1.5 numeric + 1.0 categorical
+
+
+def test_hashing_tf_counts_and_binary():
+    df = DataFrame(["terms"], None, [[["a", "b", "a"]]])
+    tf = HashingTF().set_input_col("terms").set_num_features(32)
+    v = tf.transform(df)["output"][0]
+    assert sorted(v.values.tolist()) == [1.0, 2.0]
+    vb = tf.set_binary(True).transform(df)["output"][0]
+    assert sorted(vb.values.tolist()) == [1.0, 1.0]
+
+
+def test_interaction_cross_products():
+    df = DataFrame.from_dict(
+        {"a": np.asarray([2.0]), "v": np.asarray([[1.0, 3.0]]), "w": np.asarray([[5.0, 7.0]])}
+    )
+    out = Interaction().set_input_cols("a", "v", "w").transform(df)
+    np.testing.assert_array_equal(out["output"], [[10.0, 14.0, 30.0, 42.0]])
+
+
+def test_ngram():
+    df = DataFrame(["terms"], None, [[["a", "b", "c", "d"], ["x"]]])
+    out = NGram().set_input_col("terms").transform(df)
+    assert out["output"][0] == ["a b", "b c", "c d"]
+    assert out["output"][1] == []
+
+
+def test_normalizer_p_norms():
+    df = DataFrame.from_dict({"input": np.asarray([[3.0, 4.0]])})
+    out2 = Normalizer().transform(df)
+    np.testing.assert_allclose(out2["output"], [[0.6, 0.8]], atol=1e-7)
+    out1 = Normalizer().set_p(1.0).transform(df)
+    np.testing.assert_allclose(out1["output"], [[3 / 7, 4 / 7]], atol=1e-7)
+
+
+def test_polynomial_expansion_degree2():
+    df = DataFrame.from_dict({"input": np.asarray([[2.0, 3.0]])})
+    out = PolynomialExpansion().transform(df)
+    # combos: x, y, x^2, xy, y^2
+    np.testing.assert_array_equal(out["output"], [[2.0, 3.0, 4.0, 6.0, 9.0]])
+
+
+def test_random_splitter_proportions_and_disjoint():
+    df = DataFrame.from_dict({"x": np.arange(10000.0)})
+    parts = RandomSplitter().set_weights(4.0, 6.0).set_seed(7).transform(df)
+    assert len(parts) == 2
+    n0, n1 = len(parts[0]), len(parts[1])
+    assert n0 + n1 == 10000
+    assert abs(n0 / 10000 - 0.4) < 0.02
+    assert not set(parts[0]["x"]) & set(parts[1]["x"])
+
+
+def test_sql_transformer_select_where():
+    df = DataFrame.from_dict({"v1": np.asarray([1.0, 4.0]), "v2": np.asarray([2.0, 5.0])})
+    out = (
+        SQLTransformer()
+        .set_statement("SELECT *, (v1 + v2) AS v3 FROM __THIS__")
+        .transform(df)
+    )
+    assert out.get_column_names() == ["v1", "v2", "v3"]
+    np.testing.assert_array_equal(out["v3"], [3.0, 9.0])
+    out2 = (
+        SQLTransformer()
+        .set_statement("SELECT v1 FROM __THIS__ WHERE v2 = 5.0")
+        .transform(df)
+    )
+    np.testing.assert_array_equal(out2["v1"], [4.0])
+
+
+def test_stop_words_remover_default_english():
+    df = DataFrame(["tokens"], None, [[["The", "quick", "fox"], ["a", "b"]]])
+    out = StopWordsRemover().set_input_cols("tokens").set_output_cols("filtered").transform(df)
+    assert out["filtered"][0] == ["quick", "fox"]
+    assert out["filtered"][1] == ["b"]
+    # case sensitive keeps "The"
+    out_cs = (
+        StopWordsRemover()
+        .set_input_cols("tokens")
+        .set_output_cols("filtered")
+        .set_case_sensitive(True)
+        .transform(df)
+    )
+    assert out_cs["filtered"][0] == ["The", "quick", "fox"]
+
+
+def test_tokenizers():
+    df = DataFrame(["s"], None, [["Hello  World", "Foo-Bar baz"]])
+    out = Tokenizer().set_input_col("s").set_output_col("t").transform(df)
+    assert out["t"][0] == ["hello", "world"]
+    rt = (
+        RegexTokenizer()
+        .set_input_col("s")
+        .set_output_col("t")
+        .set_pattern(r"[\s\-]+")
+        .transform(df)
+    )
+    assert rt["t"][1] == ["foo", "bar", "baz"]
+    # gaps=False: pattern matches tokens
+    rt2 = (
+        RegexTokenizer()
+        .set_input_col("s")
+        .set_output_col("t")
+        .set_pattern(r"\w+")
+        .set_gaps(False)
+        .transform(df)
+    )
+    assert rt2["t"][0] == ["hello", "world"]
+
+
+def test_vector_assembler_modes():
+    df = DataFrame(
+        ["a", "v"],
+        None,
+        [np.asarray([1.0, np.nan]), np.asarray([[2.0, 3.0], [4.0, 5.0]])],
+    )
+    va = VectorAssembler().set_input_cols("a", "v").set_input_sizes(1, 2)
+    with pytest.raises(ValueError, match="handleInvalid"):
+        va.transform(df)
+    out_keep = va.set_handle_invalid("keep").transform(df)
+    np.testing.assert_array_equal(out_keep["output"][0], [1.0, 2.0, 3.0])
+    assert np.isnan(out_keep["output"][1][0])
+    out_skip = va.set_handle_invalid("skip").transform(df)
+    assert len(out_skip) == 1
+
+
+def test_vector_slicer_dense_and_sparse():
+    df = DataFrame.from_dict({"input": np.asarray([[1.0, 2.0, 3.0, 4.0]])})
+    out = VectorSlicer().set_indices(3, 0).transform(df)
+    np.testing.assert_array_equal(out["output"], [[4.0, 1.0]])
+    sv = Vectors.sparse(4, [1, 3], [5.0, 6.0])
+    df2 = DataFrame(["input"], None, [[sv]])
+    out2 = VectorSlicer().set_indices(3, 1).transform(df2)
+    np.testing.assert_array_equal(out2["output"][0].to_array(), [6.0, 5.0])
+
+
+def test_sql_transformer_compound_conditions_and_sandbox():
+    df = DataFrame.from_dict({"v1": np.asarray([0.0, 2.0, 5.0]), "v2": np.asarray([9.0, 5.0, 1.0])})
+    out = (
+        SQLTransformer()
+        .set_statement("SELECT v1 FROM __THIS__ WHERE v1 > 1 AND v2 < 6")
+        .transform(df)
+    )
+    np.testing.assert_array_equal(out["v1"], [2.0, 5.0])
+    out_or = (
+        SQLTransformer()
+        .set_statement("SELECT v1 FROM __THIS__ WHERE v1 > 4 OR v2 > 8")
+        .transform(df)
+    )
+    np.testing.assert_array_equal(out_or["v1"], [0.0, 5.0])
+    out_not = (
+        SQLTransformer()
+        .set_statement("SELECT v1 FROM __THIS__ WHERE NOT v1 = 2.0")
+        .transform(df)
+    )
+    np.testing.assert_array_equal(out_not["v1"], [0.0, 5.0])
+    # sandbox: attribute access / unknown identifiers rejected before eval
+    for stmt in [
+        "SELECT v1.__class__ FROM __THIS__",
+        "SELECT open FROM __THIS__",
+        "SELECT v1 FROM __THIS__ WHERE v1.__gt__(1)",
+    ]:
+        with pytest.raises(ValueError):
+            SQLTransformer().set_statement(stmt).transform(df)
+
+
+def test_numeric_list_columns_densify():
+    """List-of-numeric-lists columns behave like 2-D vector columns."""
+    df = DataFrame.from_dict({"v": [[1.0, 3.0], [2.0, 0.0]]})
+    out = (
+        Binarizer().set_input_cols("v").set_output_cols("b").set_thresholds(1.5).transform(df)
+    )
+    np.testing.assert_array_equal(out["b"], [[0.0, 1.0], [1.0, 0.0]])
+
+
+def test_stateless_stages_save_load(tmp_path):
+    """Every stateless stage round-trips its params through save/load."""
+    stages = {
+        "Binarizer": Binarizer().set_input_cols("a").set_output_cols("b").set_thresholds(0.5),
+        "Normalizer": Normalizer().set_p(3.0),
+        "NGram": NGram().set_n(4),
+        "HashingTF": HashingTF().set_num_features(64),
+        "SQLTransformer": SQLTransformer().set_statement("SELECT * FROM __THIS__"),
+        "RegexTokenizer": RegexTokenizer().set_pattern("x+"),
+    }
+    for name, stage in stages.items():
+        path = str(tmp_path / name)
+        stage.save(path)
+        loaded = type(stage).load(path)
+        assert loaded.param_map_to_json() == stage.param_map_to_json(), name
+
+
+def test_registry_resolves_all_stages():
+    for name in STAGE_REGISTRY:
+        cls = get_stage_class(name)
+        assert cls.__name__ == name
