@@ -17,6 +17,10 @@ STAGE_REGISTRY = {
     "LogisticRegressionModel": "flink_ml_tpu.models.classification.logistic_regression.LogisticRegressionModel",
     "LinearSVC": "flink_ml_tpu.models.classification.linearsvc.LinearSVC",
     "LinearSVCModel": "flink_ml_tpu.models.classification.linearsvc.LinearSVCModel",
+    "NaiveBayes": "flink_ml_tpu.models.classification.naive_bayes.NaiveBayes",
+    "NaiveBayesModel": "flink_ml_tpu.models.classification.naive_bayes.NaiveBayesModel",
+    "Knn": "flink_ml_tpu.models.classification.knn.Knn",
+    "KnnModel": "flink_ml_tpu.models.classification.knn.KnnModel",
     "OnlineLogisticRegression": "flink_ml_tpu.models.classification.online_logistic_regression.OnlineLogisticRegression",
     "OnlineLogisticRegressionModel": "flink_ml_tpu.models.classification.online_logistic_regression.OnlineLogisticRegressionModel",
     # clustering
@@ -24,6 +28,13 @@ STAGE_REGISTRY = {
     "KMeansModel": "flink_ml_tpu.models.clustering.kmeans.KMeansModel",
     "OnlineKMeans": "flink_ml_tpu.models.clustering.online_kmeans.OnlineKMeans",
     "OnlineKMeansModel": "flink_ml_tpu.models.clustering.online_kmeans.OnlineKMeansModel",
+    "AgglomerativeClustering": "flink_ml_tpu.models.clustering.agglomerative_clustering.AgglomerativeClustering",
+    # evaluation / stats / recommendation
+    "BinaryClassificationEvaluator": "flink_ml_tpu.models.evaluation.binary_classification_evaluator.BinaryClassificationEvaluator",
+    "ChiSqTest": "flink_ml_tpu.models.stats.tests.ChiSqTest",
+    "ANOVATest": "flink_ml_tpu.models.stats.tests.ANOVATest",
+    "FValueTest": "flink_ml_tpu.models.stats.tests.FValueTest",
+    "Swing": "flink_ml_tpu.models.recommendation.swing.Swing",
     # feature (stateless)
     "Binarizer": "flink_ml_tpu.models.feature.binarizer.Binarizer",
     "Bucketizer": "flink_ml_tpu.models.feature.bucketizer.Bucketizer",

@@ -1,0 +1,91 @@
+"""K-nearest-neighbors classifier.
+
+Reference: ``flink-ml-lib/.../classification/knn/`` — the model IS the dataset
+(features + labels + cached norms, KnnModelData); prediction broadcasts the model
+(KnnModel.java:87) and for each query finds the k nearest by euclidean distance
+(|a|²+|b|²−2ab with cached norm squares) and takes the majority label
+(KnnModel.java:133-180). ``k`` default 5.
+
+TPU-native: the whole query batch against the whole model is one [n,d]×[d,m]
+matmul + top-k — the per-row PriorityQueue disappears into ``lax.top_k``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.models.common import ModelArraysMixin, extract_labeled_data
+from flink_ml_tpu.params.param import IntParam, ParamValidators, update_existing_params
+from flink_ml_tpu.params.shared import HasFeaturesCol, HasLabelCol, HasPredictionCol
+
+__all__ = ["Knn", "KnnModel"]
+
+
+class _KnnParams(HasFeaturesCol, HasPredictionCol):
+    K = IntParam("k", "The number of nearest neighbors.", 5, ParamValidators.gt(0))
+
+    def get_k(self) -> int:
+        return self.get(self.K)
+
+    def set_k(self, value: int):
+        return self.set(self.K, value)
+
+
+@functools.cache
+def _neighbors_kernel(k: int):
+    @jax.jit
+    def nearest(X, model_x, model_norm2):
+        d2 = jnp.sum(X * X, axis=1, keepdims=True) + model_norm2[None, :] - 2.0 * X @ model_x.T
+        neg_dist, idx = jax.lax.top_k(-d2, k)
+        return idx
+
+    return nearest
+
+
+class KnnModel(ModelArraysMixin, Model, _KnnParams):
+    """Ref KnnModel.java."""
+
+    _MODEL_ARRAY_NAMES = ("model_features", "model_labels")
+
+    def __init__(self):
+        super().__init__()
+        self.model_features: Optional[np.ndarray] = None
+        self.model_labels: Optional[np.ndarray] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float32)
+        mx = np.asarray(self.model_features, np.float32)
+        k = min(self.get_k(), mx.shape[0])
+        idx = np.asarray(
+            _neighbors_kernel(k)(X, mx, (mx * mx).sum(axis=1).astype(np.float32))
+        )
+        neighbor_labels = self.model_labels[idx]  # [n, k]
+        pred = np.empty(len(X))
+        for i, row in enumerate(neighbor_labels):
+            vals, counts = np.unique(row, return_counts=True)
+            pred[i] = vals[np.argmax(counts)]
+        out = df.clone()
+        out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, pred)
+        return out
+
+
+class Knn(Estimator, _KnnParams, HasLabelCol):
+    """Ref Knn.java — fit materializes the dataset as model data."""
+
+    def fit(self, *inputs) -> KnnModel:
+        (df,) = inputs
+        data = extract_labeled_data(
+            df, self.get_features_col(), self.get_label_col(), None, dtype=np.float64
+        )
+        model = KnnModel()
+        update_existing_params(model, self)
+        model.model_features = data["features"]
+        model.model_labels = data["labels"]
+        return model

@@ -1,0 +1,179 @@
+"""Naive Bayes (multinomial over categorical feature values).
+
+Reference: ``flink-ml-lib/.../classification/naivebayes/`` — each feature
+dimension is treated as categorical: theta[label][dim] maps feature value →
+log((count + smoothing) / (count_label + smoothing·|values_dim|));
+pi[label] = log(count_label·d + smoothing) − log(n·d + numLabels·smoothing)
+(GenerateModelFunction, NaiveBayes.java:253-322); prediction = argmax of
+pi + Σ_dim theta lookup (NaiveBayesModel.calculateProb:126-137). ``smoothing``
+default 1.0; ``modelType`` only "multinomial".
+
+Deviation: a feature value unseen for a label scores the smoothed floor
+log(smoothing) − log(count_label + smoothing·|values|); the reference NPEs on
+values absent from ALL labels (theta map lookup returns null).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.params.param import FloatParam, ParamValidators, StringParam, update_existing_params
+from flink_ml_tpu.params.shared import HasFeaturesCol, HasLabelCol, HasPredictionCol
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["NaiveBayes", "NaiveBayesModel"]
+
+
+class _NbParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
+    MODEL_TYPE = StringParam(
+        "modelType",
+        "The model type.",
+        "multinomial",
+        ParamValidators.in_array(["multinomial"]),
+    )
+    SMOOTHING = FloatParam(
+        "smoothing", "The smoothing parameter.", 1.0, ParamValidators.gt_eq(0)
+    )
+
+    def get_model_type(self) -> str:
+        return self.get(self.MODEL_TYPE)
+
+    def set_model_type(self, value: str):
+        return self.set(self.MODEL_TYPE, value)
+
+    def get_smoothing(self) -> float:
+        return self.get(self.SMOOTHING)
+
+    def set_smoothing(self, value: float):
+        return self.set(self.SMOOTHING, value)
+
+
+class NaiveBayesModel(Model, _NbParams):
+    """Ref NaiveBayesModel.java."""
+
+    def __init__(self):
+        super().__init__()
+        self.labels: Optional[np.ndarray] = None  # [L]
+        self.pi: Optional[np.ndarray] = None  # [L]
+        self.theta: Optional[List[List[Dict[float, float]]]] = None  # [L][d] value→logp
+        self.default_log: Optional[np.ndarray] = None  # [L, d] unseen-value floor
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float64)
+        n, d = X.shape
+        L = len(self.labels)
+        scores = np.tile(self.pi[None, :], (n, 1))
+        for li in range(L):
+            for j in range(d):
+                table = self.theta[li][j]
+                col = X[:, j]
+                scores[:, li] += np.asarray(
+                    [table.get(v, self.default_log[li, j]) for v in col]
+                )
+        pred = self.labels[np.argmax(scores, axis=1)]
+        out = df.clone()
+        out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, pred)
+        return out
+
+    # --- persistence (theta maps serialized as JSON) --------------------------
+    def save(self, path: str) -> None:
+        theta_json = [
+            [{repr(k): v for k, v in table.items()} for table in row] for row in self.theta
+        ]
+        rw.save_metadata(self, path, {"theta": theta_json})
+        rw.save_model_arrays(
+            path, {"labels": self.labels, "pi": self.pi, "default_log": self.default_log}
+        )
+
+    @classmethod
+    def load(cls, path: str):
+        metadata = rw.load_metadata(path, rw.stage_class_name(cls))
+        model = cls()
+        model.load_param_map_from_json(metadata["paramMap"])
+        arrays = rw.load_model_arrays(path)
+        model.labels, model.pi = arrays["labels"], arrays["pi"]
+        model.default_log = arrays["default_log"]
+        model.theta = [
+            [{float(k): v for k, v in table.items()} for table in row]
+            for row in metadata["theta"]
+        ]
+        return model
+
+    def get_model_data(self):
+        from flink_ml_tpu.api.dataframe import DataFrame
+
+        return [
+            DataFrame(
+                ["theta", "piArray", "labels"],
+                None,
+                [[self.theta], [self.pi], [self.labels]],
+            )
+        ]
+
+    def set_model_data(self, *model_data):
+        df = model_data[0]
+        self.theta = df.column("theta")[0]
+        self.pi = np.asarray(df.column("piArray")[0])
+        self.labels = np.asarray(df.column("labels")[0])
+        L, d = len(self.theta), len(self.theta[0])
+        # Unseen-value floor approximated by the smallest smoothed log-prob in each
+        # (label, dim) table (exact default_log is persisted by save/load).
+        self.default_log = np.asarray(
+            [
+                [min(t.values()) if t else -np.inf for t in row]
+                for row in self.theta
+            ]
+        )
+        return self
+
+
+class NaiveBayes(Estimator, _NbParams):
+    """Ref NaiveBayes.java."""
+
+    def fit(self, *inputs) -> NaiveBayesModel:
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float64)
+        y = df.scalars(self.get_label_col())
+        if not np.all(y == np.floor(y)):
+            raise ValueError("Label value should be indexed number.")
+        smoothing = self.get_smoothing()
+        n, d = X.shape
+        labels = np.unique(y)
+        L = len(labels)
+
+        value_sets = [np.unique(X[:, j]) for j in range(d)]
+        theta: List[List[Dict[float, float]]] = []
+        pi = np.zeros(L)
+        default_log = np.zeros((L, d))
+        pi_log = np.log(n * d + L * smoothing)
+        for li, label in enumerate(labels):
+            Xl = X[y == label]
+            count_l = Xl.shape[0]
+            row = []
+            for j in range(d):
+                vals, counts = np.unique(Xl[:, j], return_counts=True)
+                count_map = dict(zip(vals, counts))
+                theta_log = np.log(count_l + smoothing * len(value_sets[j]))
+                row.append(
+                    {
+                        float(v): float(np.log(count_map.get(v, 0.0) + smoothing) - theta_log)
+                        for v in value_sets[j]
+                    }
+                )
+                with np.errstate(divide="ignore"):
+                    default_log[li, j] = np.log(smoothing) - theta_log
+            theta.append(row)
+            pi[li] = np.log(count_l * d + smoothing) - pi_log
+
+        model = NaiveBayesModel()
+        update_existing_params(model, self)
+        model.labels = labels
+        model.pi = pi
+        model.theta = theta
+        model.default_log = default_log
+        return model

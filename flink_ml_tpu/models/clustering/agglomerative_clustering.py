@@ -1,0 +1,191 @@
+"""Agglomerative (hierarchical) clustering.
+
+Reference: ``flink-ml-lib/.../clustering/agglomerativeclustering/
+AgglomerativeClustering.java`` — an AlgoOperator (single-node computation over a
+window of points): bottom-up merging under ``linkage`` ∈ {ward (default),
+complete, single, average} with the chosen ``distanceMeasure``; stop at
+``numClusters`` (default 2) or ``distanceThreshold`` (mutually exclusive);
+outputs the input with a cluster-id column plus a second table of merge records
+(clusterId1, clusterId2, distance, sizeOfMergedCluster) when
+``computeFullTree``.
+
+Implementation: Lance-Williams updates over a dense distance matrix — O(n³)
+like the reference's in-memory HAC; fine for the windowed single-node scope.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.api.core import AlgoOperator
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.ops.distance import DistanceMeasure
+from flink_ml_tpu.params.param import BoolParam, FloatParam, IntParam, ParamValidators, StringParam
+from flink_ml_tpu.params.shared import (
+    HasDistanceMeasure,
+    HasFeaturesCol,
+    HasPredictionCol,
+    HasWindows,
+)
+
+__all__ = ["AgglomerativeClustering"]
+
+LINKAGE_WARD = "ward"
+LINKAGE_COMPLETE = "complete"
+LINKAGE_SINGLE = "single"
+LINKAGE_AVERAGE = "average"
+
+
+class AgglomerativeClustering(
+    AlgoOperator, HasFeaturesCol, HasPredictionCol, HasDistanceMeasure, HasWindows
+):
+    """Ref AgglomerativeClustering.java."""
+
+    NUM_CLUSTERS = IntParam("numClusters", "The max number of clusters to create.", 2)
+    DISTANCE_THRESHOLD = FloatParam(
+        "distanceThreshold",
+        "Threshold above which clusters will not be merged.",
+        None,
+    )
+    LINKAGE = StringParam(
+        "linkage",
+        "Criterion for computing distance between two clusters.",
+        LINKAGE_WARD,
+        ParamValidators.in_array(
+            [LINKAGE_WARD, LINKAGE_COMPLETE, LINKAGE_AVERAGE, LINKAGE_SINGLE]
+        ),
+    )
+    COMPUTE_FULL_TREE = BoolParam(
+        "computeFullTree", "Whether to compute the full merge tree.", False
+    )
+
+    def get_num_clusters(self):
+        return self.get(self.NUM_CLUSTERS)
+
+    def set_num_clusters(self, value: int):
+        return self.set(self.NUM_CLUSTERS, value)
+
+    def get_distance_threshold(self):
+        return self.get(self.DISTANCE_THRESHOLD)
+
+    def set_distance_threshold(self, value: float):
+        return self.set(self.DISTANCE_THRESHOLD, value)
+
+    def get_linkage(self) -> str:
+        return self.get(self.LINKAGE)
+
+    def set_linkage(self, value: str):
+        return self.set(self.LINKAGE, value)
+
+    def get_compute_full_tree(self) -> bool:
+        return self.get(self.COMPUTE_FULL_TREE)
+
+    def set_compute_full_tree(self, value: bool):
+        return self.set(self.COMPUTE_FULL_TREE, value)
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        num_clusters = self.get_num_clusters()
+        threshold = self.get_distance_threshold()
+        if (num_clusters is None) == (threshold is None):
+            raise ValueError(
+                "Exactly one of numClusters and distanceThreshold must be set."
+            )
+        X = df.vectors(self.get_features_col()).astype(np.float64)
+        n = len(X)
+        linkage = self.get_linkage()
+        measure = DistanceMeasure.get_instance(self.get_distance_measure())
+        if linkage == LINKAGE_WARD and self.get_distance_measure() != "euclidean":
+            raise ValueError("Ward linkage requires the euclidean distance measure.")
+
+        D = np.asarray(measure.pairwise(X, X), np.float64)
+        np.fill_diagonal(D, np.inf)
+        if linkage == LINKAGE_WARD:
+            # initial ward distance between singletons = sqrt(2)*d/√2 ≡ d; use
+            # squared form internally via Lance-Williams on d²
+            D = D**2
+
+        active = list(range(n))
+        sizes = {i: 1 for i in range(n)}
+        members = {i: [i] for i in range(n)}
+        merges: List[Tuple[int, int, float, int]] = []
+        next_id = n
+        stop_at = num_clusters if num_clusters is not None else 1
+        full_tree = self.get_compute_full_tree() or threshold is not None
+
+        labels_when_stopped: Optional[dict] = None
+        while len(active) > 1:
+            sub = D[np.ix_(active, active)]
+            flat = np.argmin(sub)
+            ai, bi = divmod(flat, len(active))
+            if ai == bi:
+                break
+            a, b = active[ai], active[bi]
+            dist = sub[ai, bi]
+            out_dist = np.sqrt(dist) if linkage == LINKAGE_WARD else dist
+            if threshold is not None and out_dist > threshold and labels_when_stopped is None:
+                labels_when_stopped = {c: list(members[c]) for c in active}
+                if not self.get_compute_full_tree():
+                    break
+            if num_clusters is not None and len(active) <= stop_at and not full_tree:
+                break
+
+            # Lance-Williams update of distances to the merged cluster
+            new_row = np.empty(len(active))
+            for ci, c in enumerate(active):
+                if c in (a, b):
+                    new_row[ci] = np.inf
+                    continue
+                dac, dbc = D[a, c], D[b, c]
+                if linkage == LINKAGE_SINGLE:
+                    new_d = min(dac, dbc)
+                elif linkage == LINKAGE_COMPLETE:
+                    new_d = max(dac, dbc)
+                elif linkage == LINKAGE_AVERAGE:
+                    new_d = (sizes[a] * dac + sizes[b] * dbc) / (sizes[a] + sizes[b])
+                else:  # ward on squared distances
+                    sa, sb, sc = sizes[a], sizes[b], sizes[c]
+                    tot = sa + sb + sc
+                    new_d = (
+                        (sa + sc) * dac + (sb + sc) * dbc - sc * D[a, b]
+                    ) / tot
+                new_row[ci] = new_d
+
+            merged_size = sizes[a] + sizes[b]
+            merges.append((a, b, float(out_dist), merged_size))
+            D = np.pad(D, ((0, 1), (0, 1)), constant_values=np.inf)
+            for ci, c in enumerate(active):
+                D[next_id, c] = D[c, next_id] = new_row[ci]
+            sizes[next_id] = merged_size
+            members[next_id] = members.pop(a) + members.pop(b)
+            active.remove(a)
+            active.remove(b)
+            active.append(next_id)
+            next_id += 1
+
+            if num_clusters is not None and len(active) == stop_at:
+                labels_when_stopped = {c: list(members[c]) for c in active}
+                if not self.get_compute_full_tree():
+                    break
+
+        if labels_when_stopped is None:
+            labels_when_stopped = {c: list(members[c]) for c in active}
+
+        labels = np.zeros(n)
+        for cluster_idx, (_, pts) in enumerate(sorted(labels_when_stopped.items())):
+            labels[pts] = cluster_idx
+        out = df.clone()
+        out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, labels)
+        merge_df = DataFrame(
+            ["clusterId1", "clusterId2", "distance", "sizeOfMergedCluster"],
+            None,
+            [
+                np.asarray([m[0] for m in merges], np.int64),
+                np.asarray([m[1] for m in merges], np.int64),
+                np.asarray([m[2] for m in merges]),
+                np.asarray([m[3] for m in merges], np.int64),
+            ],
+        )
+        return [out, merge_df]

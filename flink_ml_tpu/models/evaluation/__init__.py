@@ -1,0 +1,1 @@
+"""Evaluation operators. Ref flink-ml-lib/.../ml/evaluation/."""

@@ -1,0 +1,98 @@
+"""Binary-classification evaluator.
+
+Reference: ``flink-ml-lib/.../evaluation/binaryclassification/
+BinaryClassificationEvaluator.java:76`` — an AlgoOperator computing, over
+(label, rawPrediction[, weight]) rows sorted globally by score: areaUnderROC,
+areaUnderPR, ks, areaUnderLorenz (the reference distributes the sort and merges
+partition summaries; here the sort is a single device/host sort, SURVEY.md §7's
+"sort-based primitives" note). Output: one row with the requested metrics
+(default [areaUnderROC, areaUnderPR]).
+
+Metric definitions (matching the reference's accumulation):
+  - ROC AUC via the rank-sum (trapezoid over TPR/FPR with score ties grouped);
+  - PR AUC via trapezoid over (recall, precision);
+  - KS = max |TPR − FPR|;
+  - areaUnderLorenz = trapezoid of the Lorenz curve (cumulative positive rate
+    vs cumulative population rate, descending score order).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.api.core import AlgoOperator
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.linalg.vectors import Vector
+from flink_ml_tpu.params.param import StringArrayParam, ParamValidators
+from flink_ml_tpu.params.shared import HasLabelCol, HasRawPredictionCol, HasWeightCol
+
+__all__ = ["BinaryClassificationEvaluator"]
+
+AREA_UNDER_ROC = "areaUnderROC"
+AREA_UNDER_PR = "areaUnderPR"
+AREA_UNDER_LORENZ = "areaUnderLorenz"
+KS = "ks"
+
+
+class BinaryClassificationEvaluator(
+    AlgoOperator, HasLabelCol, HasRawPredictionCol, HasWeightCol
+):
+    """Ref BinaryClassificationEvaluator.java:76."""
+
+    METRICS_NAMES = StringArrayParam(
+        "metricsNames",
+        "Names of the output metrics.",
+        [AREA_UNDER_ROC, AREA_UNDER_PR],
+        ParamValidators.is_sub_set([AREA_UNDER_ROC, AREA_UNDER_PR, KS, AREA_UNDER_LORENZ]),
+    )
+
+    def get_metrics_names(self):
+        return self.get(self.METRICS_NAMES)
+
+    def set_metrics_names(self, *values: str):
+        return self.set(self.METRICS_NAMES, list(values))
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        y = df.scalars(self.get_label_col())
+        raw = df.column(self.get_raw_prediction_col())
+        if isinstance(raw, np.ndarray) and raw.ndim == 2:
+            scores = raw[:, -1].astype(np.float64)  # P(positive) column
+        elif isinstance(raw, np.ndarray):
+            scores = raw.astype(np.float64)
+        else:
+            scores = np.asarray(
+                [v.to_array()[-1] if isinstance(v, Vector) else float(v) for v in raw]
+            )
+        w = (
+            df.scalars(self.get_weight_col())
+            if self.get_weight_col()
+            else np.ones(len(y))
+        )
+
+        order = np.argsort(-scores, kind="stable")
+        y_s, w_s, s_s = y[order], w[order], scores[order]
+        pos = np.sum(w_s * (y_s == 1.0))
+        neg = np.sum(w_s * (y_s != 1.0))
+        if pos == 0 or neg == 0:
+            raise ValueError("Both positive and negative samples are required.")
+
+        # group score ties: evaluate curve only at group boundaries
+        boundary = np.nonzero(np.diff(s_s))[0]
+        cut = np.concatenate([boundary, [len(s_s) - 1]])
+        tp = np.cumsum(w_s * (y_s == 1.0))[cut]
+        fp = np.cumsum(w_s * (y_s != 1.0))[cut]
+        tot = np.cumsum(w_s)[cut]
+        tpr = np.concatenate([[0.0], tp / pos])
+        fpr = np.concatenate([[0.0], fp / neg])
+        recall = tpr
+        precision = np.concatenate([[1.0], tp / (tp + fp)])
+        pop = np.concatenate([[0.0], tot / (pos + neg)])
+
+        values = {
+            AREA_UNDER_ROC: float(np.trapezoid(tpr, fpr)),
+            AREA_UNDER_PR: float(np.trapezoid(precision, recall)),
+            KS: float(np.max(np.abs(tpr - fpr))),
+            AREA_UNDER_LORENZ: float(np.trapezoid(tpr, pop)),
+        }
+        names = list(self.get_metrics_names())
+        return DataFrame(names, None, [np.asarray([values[n]]) for n in names])

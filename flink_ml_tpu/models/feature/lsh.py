@@ -198,8 +198,13 @@ class MinHashLSH(Estimator, _LshParams):
             )
         rng = JavaRandom(self.get_seed())
         n = self.get_num_hash_tables() * self.get_num_hash_functions_per_table()
-        coeff_a = np.asarray([1 + rng.next_int(HASH_PRIME - 1) for _ in range(n)], np.int64)
-        coeff_b = np.asarray([rng.next_int(HASH_PRIME - 1) for _ in range(n)], np.int64)
+        # a[i], b[i] are drawn interleaved from one Random stream
+        # (MinHashLSHModelData.generateModelData:81-84) — order matters for parity.
+        coeff_a = np.empty(n, np.int64)
+        coeff_b = np.empty(n, np.int64)
+        for i in range(n):
+            coeff_a[i] = 1 + rng.next_int(HASH_PRIME - 1)
+            coeff_b[i] = rng.next_int(HASH_PRIME - 1)
         model = MinHashLSHModel()
         update_existing_params(model, self)
         model.coeff_a = coeff_a

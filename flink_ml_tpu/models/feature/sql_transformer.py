@@ -99,7 +99,9 @@ def _check_safe(expr: str, allowed_names) -> None:
         raise ValueError(f"SQLTransformer: attribute access is not supported: {expr!r}")
     if "__" in expr or "[" in expr or "]" in expr or "{" in expr or ":" in expr:
         raise ValueError(f"SQLTransformer: unsupported construct in {expr!r}")
-    for ident in re.findall(r"[A-Za-z_]\w*", expr):
+    # (?<![\w.]) keeps exponents of numeric literals (1e5, 1e-3) from being
+    # mistaken for identifiers.
+    for ident in re.findall(r"(?<![\w.])[A-Za-z_]\w*", expr):
         if ident.upper() in ("AND", "OR", "NOT", "AS"):
             continue
         if ident not in allowed_names and ident.upper() not in _FUNCS:

@@ -36,6 +36,20 @@ def load_default_stop_words(language: str) -> List[str]:
         return [line.strip() for line in f if line.strip()]
 
 
+def _locale_lower(locale: str):
+    """Locale-aware lowercasing for case-insensitive matching (the reference uses
+    java.util.Locale). Turkish/Azerbaijani dotted/dotless-i rules are handled
+    explicitly; other locales use str.lower() (full ICU tailoring needs an ICU
+    dependency this image doesn't ship)."""
+    lang = locale.split("_")[0].lower()
+    if lang in ("tr", "az"):
+        def lower(s: str) -> str:
+            return s.replace("İ", "i").replace("I", "ı").lower()
+
+        return lower
+    return str.lower
+
+
 class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
     """Ref StopWordsRemover.java."""
 
@@ -75,12 +89,13 @@ class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
     def transform(self, *inputs):
         (df,) = inputs
         case_sensitive = self.get_case_sensitive()
+        lower = _locale_lower(self.get_locale())
         stop = set(self.get_stop_words())
         if not case_sensitive:
-            stop = {w.lower() for w in stop}
+            stop = {lower(w) for w in stop}
 
         def keep(token: str) -> bool:
-            t = token if case_sensitive else token.lower()
+            t = token if case_sensitive else lower(token)
             return t not in stop
 
         out = df.clone()

@@ -20,12 +20,19 @@ __all__ = ["Tokenizer", "RegexTokenizer"]
 
 
 class Tokenizer(Transformer, HasInputCol, HasOutputCol):
-    """Ref Tokenizer.java — lowercase then split on whitespace."""
+    """Ref Tokenizer.java — lowercase then ``split("\\s")``: consecutive whitespace
+    produces interior empty-string tokens (Java's split drops only trailing
+    empties), which downstream HashingTF/CountVectorizer see as terms."""
 
     def transform(self, *inputs):
         (df,) = inputs
         col = df.column(self.get_input_col())
-        tokens = [s.lower().split() for s in col]
+        tokens = []
+        for s in col:
+            toks = re.split(r"\s", s.lower())
+            while toks and toks[-1] == "":
+                toks.pop()
+            tokens.append(toks)
         out = df.clone()
         out.add_column(self.get_output_col(), DataTypes.STRING, tokens)
         return out

@@ -46,6 +46,10 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol, HasHandleInvalid)
         assembled = np.zeros((n, total), np.float64)
         invalid = np.zeros(n, bool)
 
+        # Size-mismatch semantics (VectorAssembler.java:120-126, 183-186): 'error'
+        # raises, 'skip' drops the row, 'keep' keeps it (the reference then emits a
+        # ragged output vector; the columnar layout here fills NaN instead — the
+        # one documented deviation).
         offset = 0
         for name, size in zip(in_cols, sizes):
             col = df.column(name)
@@ -53,10 +57,14 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol, HasHandleInvalid)
             if isinstance(col, np.ndarray):
                 vals = col if col.ndim == 2 else col[:, None].astype(np.float64)
                 if vals.shape[1] != size:
-                    raise ValueError(
-                        f"Input column {name} has size {vals.shape[1]} but expected {size}."
-                    )
-                block = vals.astype(np.float64)
+                    if handle == "error":
+                        raise ValueError(
+                            f"Input column {name} has size {vals.shape[1]} but "
+                            f"expected {size}."
+                        )
+                    invalid[:] = True
+                else:
+                    block = vals.astype(np.float64)
             else:
                 for i, v in enumerate(col):
                     if v is None:
@@ -64,9 +72,13 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol, HasHandleInvalid)
                         continue
                     arr = v.to_array() if isinstance(v, Vector) else np.asarray([v], np.float64)
                     if arr.shape[0] != size:
-                        raise ValueError(
-                            f"Input column {name} has size {arr.shape[0]} but expected {size}."
-                        )
+                        if handle == "error":
+                            raise ValueError(
+                                f"Input column {name} has size {arr.shape[0]} but "
+                                f"expected {size}."
+                            )
+                        invalid[i] = True
+                        continue
                     block[i] = arr
             assembled[:, offset : offset + size] = block
             offset += size

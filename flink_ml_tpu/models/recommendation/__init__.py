@@ -1,0 +1,1 @@
+"""Recommendation algorithms. Ref flink-ml-lib/.../ml/recommendation/."""

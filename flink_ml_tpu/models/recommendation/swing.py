@@ -1,0 +1,172 @@
+"""Swing item-item similarity.
+
+Reference: ``flink-ml-lib/.../recommendation/swing/Swing.java`` — for each item i,
+over every pair of its purchasers (u, v):
+    sim(i, j) += w_u · w_v / (alpha2 + |I_u ∩ I_v|)   for each j ≠ i in I_u ∩ I_v
+with user weight w_u = 1/(alpha1 + |I_u|)^beta (Swing.java:367-369). Users with
+fewer than ``minUserBehavior`` or more than ``maxUserBehavior`` items are
+dropped; each item's purchaser list is reservoir-sampled down to
+``maxUserNumPerItem``. Output row per item: (itemCol: long,
+outputCol: "item,score;item,score;…" for the top ``k``) — same string encoding
+(Swing.java:344-361). Defaults: k=100, maxUserNumPerItem=1000,
+minUserBehavior=10, maxUserBehavior=1000, alpha1=15, alpha2=0, beta=0.3.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from flink_ml_tpu.api.core import AlgoOperator
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.params.param import FloatParam, IntParam, ParamValidators, StringParam
+from flink_ml_tpu.params.shared import HasOutputCol, HasSeed
+
+__all__ = ["Swing"]
+
+
+class Swing(AlgoOperator, HasOutputCol, HasSeed):
+    """Ref Swing.java."""
+
+    USER_COL = StringParam("userCol", "User column name.", "user", ParamValidators.not_null())
+    ITEM_COL = StringParam("itemCol", "Item column name.", "item", ParamValidators.not_null())
+    MAX_USER_NUM_PER_ITEM = IntParam(
+        "maxUserNumPerItem",
+        "The max number of users (purchasers) sampled per item.",
+        1000,
+        ParamValidators.gt(0),
+    )
+    K = IntParam(
+        "k", "The max number of similar items to output for each item.", 100, ParamValidators.gt(0)
+    )
+    MIN_USER_BEHAVIOR = IntParam(
+        "minUserBehavior",
+        "The min number of items that a user purchases to be included.",
+        10,
+        ParamValidators.gt(0),
+    )
+    MAX_USER_BEHAVIOR = IntParam(
+        "maxUserBehavior",
+        "The max number of items that a user purchases to be included.",
+        1000,
+        ParamValidators.gt(0),
+    )
+    ALPHA1 = IntParam(
+        "alpha1", "Smooth factor for the user weight.", 15, ParamValidators.gt_eq(0)
+    )
+    ALPHA2 = IntParam(
+        "alpha2", "Smooth factor for the common-item count.", 0, ParamValidators.gt_eq(0)
+    )
+    BETA = FloatParam(
+        "beta", "Decay factor for the user weight.", 0.3, ParamValidators.gt_eq(0)
+    )
+
+    def get_user_col(self) -> str:
+        return self.get(self.USER_COL)
+
+    def set_user_col(self, value: str):
+        return self.set(self.USER_COL, value)
+
+    def get_item_col(self) -> str:
+        return self.get(self.ITEM_COL)
+
+    def set_item_col(self, value: str):
+        return self.set(self.ITEM_COL, value)
+
+    def get_max_user_num_per_item(self) -> int:
+        return self.get(self.MAX_USER_NUM_PER_ITEM)
+
+    def set_max_user_num_per_item(self, value: int):
+        return self.set(self.MAX_USER_NUM_PER_ITEM, value)
+
+    def get_k(self) -> int:
+        return self.get(self.K)
+
+    def set_k(self, value: int):
+        return self.set(self.K, value)
+
+    def get_min_user_behavior(self) -> int:
+        return self.get(self.MIN_USER_BEHAVIOR)
+
+    def set_min_user_behavior(self, value: int):
+        return self.set(self.MIN_USER_BEHAVIOR, value)
+
+    def get_max_user_behavior(self) -> int:
+        return self.get(self.MAX_USER_BEHAVIOR)
+
+    def set_max_user_behavior(self, value: int):
+        return self.set(self.MAX_USER_BEHAVIOR, value)
+
+    def get_alpha1(self) -> int:
+        return self.get(self.ALPHA1)
+
+    def set_alpha1(self, value: int):
+        return self.set(self.ALPHA1, value)
+
+    def get_alpha2(self) -> int:
+        return self.get(self.ALPHA2)
+
+    def set_alpha2(self, value: int):
+        return self.set(self.ALPHA2, value)
+
+    def get_beta(self) -> float:
+        return self.get(self.BETA)
+
+    def set_beta(self, value: float):
+        return self.set(self.BETA, value)
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        if self.get_max_user_behavior() < self.get_min_user_behavior():
+            raise ValueError(
+                "The maxUserBehavior must be greater than or equal to minUserBehavior."
+            )
+        users = np.asarray(df.column(self.get_user_col()), np.int64)
+        items = np.asarray(df.column(self.get_item_col()), np.int64)
+
+        # user → sorted unique purchased items, filtered by behavior bounds
+        user_items: Dict[int, np.ndarray] = {}
+        for u in np.unique(users):
+            its = np.unique(items[users == u])
+            if self.get_min_user_behavior() <= len(its) <= self.get_max_user_behavior():
+                user_items[int(u)] = its
+        alpha1, alpha2, beta = self.get_alpha1(), self.get_alpha2(), self.get_beta()
+        weights = {u: 1.0 / (alpha1 + len(its)) ** beta for u, its in user_items.items()}
+
+        # item → purchasers (only retained users), reservoir-capped
+        rng = np.random.default_rng(self.get_seed())
+        item_users: Dict[int, List[int]] = {}
+        for u, its in user_items.items():
+            for i in its:
+                item_users.setdefault(int(i), []).append(u)
+        cap = self.get_max_user_num_per_item()
+        for i, us in item_users.items():
+            if len(us) > cap:
+                item_users[i] = list(rng.choice(us, cap, replace=False))
+
+        k = self.get_k()
+        out_items: List[int] = []
+        out_strs: List[str] = []
+        for item, purchasers in item_users.items():
+            scores: Dict[int, float] = {}
+            for a in range(len(purchasers)):
+                u = purchasers[a]
+                for b in range(a + 1, len(purchasers)):
+                    v = purchasers[b]
+                    common = np.intersect1d(user_items[u], user_items[v], assume_unique=True)
+                    if len(common) == 0:
+                        continue
+                    sim = weights[u] * weights[v] / (alpha2 + len(common))
+                    for j in common:
+                        if int(j) != item:
+                            scores[int(j)] = scores.get(int(j), 0.0) + sim
+            if not scores:
+                continue
+            top = sorted(scores.items(), key=lambda t: -t[1])[:k]
+            out_items.append(item)
+            out_strs.append(";".join(f"{j},{s}" for j, s in top))
+        return DataFrame(
+            [self.get_item_col(), self.get_output_col()],
+            None,
+            [np.asarray(out_items, np.int64), out_strs],
+        )

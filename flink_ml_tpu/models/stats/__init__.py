@@ -1,0 +1,1 @@
+"""Statistical test operators. Ref flink-ml-lib/.../ml/stats/."""

@@ -1,0 +1,98 @@
+"""ChiSqTest, ANOVATest, FValueTest.
+
+Reference: ``flink-ml-lib/.../stats/`` — AlgoOperators testing each feature
+dimension against the label column:
+  - ``chisqtest/ChiSqTest.java``: Pearson chi-square independence
+    (contingency-table aggregation); output flattened rows
+    (featureIndex, pValue, degreeOfFreedom, statistic) or one row
+    (pValues, degreesOfFreedom, statistics).
+  - ``anovatest/ANOVATest.java``: one-way ANOVA F vs a categorical label;
+    columns (featureIndex, pValue, degreeOfFreedom, fValue) / (pValues,
+    degreesOfFreedom, fValues).
+  - ``fvaluetest/FValueTest.java``: F = r²/(1−r²)·(n−2) vs a continuous label;
+    same output shape as ANOVATest.
+The distribution tails come from ops/stats.py (jax.scipy.special).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.api.core import AlgoOperator
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.linalg.vectors import DenseVector
+from flink_ml_tpu.ops.stats import anova_f_classification, chi_square_test, f_regression
+from flink_ml_tpu.params.param import BoolParam
+from flink_ml_tpu.params.shared import HasFeaturesCol, HasLabelCol
+
+__all__ = ["ChiSqTest", "ANOVATest", "FValueTest"]
+
+
+class _TestParams(HasFeaturesCol, HasLabelCol):
+    FLATTEN = BoolParam(
+        "flatten",
+        "If false, one row with vector results; if true, one row per feature.",
+        False,
+    )
+
+    def get_flatten(self) -> bool:
+        return self.get(self.FLATTEN)
+
+    def set_flatten(self, value: bool):
+        return self.set(self.FLATTEN, value)
+
+
+def _format(flatten: bool, p, dof, stat, stat_name: str) -> DataFrame:
+    p, dof, stat = np.asarray(p), np.asarray(dof), np.asarray(stat)
+    if flatten:
+        return DataFrame(
+            ["featureIndex", "pValue", "degreeOfFreedom", stat_name],
+            None,
+            [np.arange(len(p)), p, dof, stat],
+        )
+    plural = stat_name + "s" if not stat_name.endswith("s") else stat_name
+    return DataFrame(
+        ["pValues", "degreesOfFreedom", plural],
+        None,
+        [[DenseVector(p)], [dof], [DenseVector(stat)]],
+    )
+
+
+class ChiSqTest(AlgoOperator, _TestParams):
+    """Ref ChiSqTest.java."""
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float64)
+        y = df.scalars(self.get_label_col())
+        stats, dofs, ps = [], [], []
+        for j in range(X.shape[1]):
+            s, dof, p = chi_square_test(X[:, j], y)
+            stats.append(s)
+            dofs.append(dof)
+            ps.append(p)
+        return _format(self.get_flatten(), ps, dofs, stats, "statistic")
+
+
+class ANOVATest(AlgoOperator, _TestParams):
+    """Ref ANOVATest.java."""
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float64)
+        y = df.scalars(self.get_label_col())
+        f, p = anova_f_classification(X, y)
+        n, classes = X.shape[0], len(np.unique(y))
+        dof = np.full(X.shape[1], n - classes, np.int64)
+        return _format(self.get_flatten(), p, dof, f, "fValue")
+
+
+class FValueTest(AlgoOperator, _TestParams):
+    """Ref FValueTest.java."""
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float64)
+        y = df.scalars(self.get_label_col())
+        f, p = f_regression(X, y)
+        dof = np.full(X.shape[1], X.shape[0] - 2, np.int64)
+        return _format(self.get_flatten(), p, dof, f, "fValue")

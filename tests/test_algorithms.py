@@ -1,0 +1,257 @@
+"""Tests for NaiveBayes, Knn, BinaryClassificationEvaluator, stats tests, Swing,
+AgglomerativeClustering (reference test shape per SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.classification.knn import Knn, KnnModel
+from flink_ml_tpu.models.classification.naive_bayes import NaiveBayes, NaiveBayesModel
+from flink_ml_tpu.models.clustering.agglomerative_clustering import AgglomerativeClustering
+from flink_ml_tpu.models.evaluation.binary_classification_evaluator import (
+    BinaryClassificationEvaluator,
+)
+from flink_ml_tpu.models.recommendation.swing import Swing
+from flink_ml_tpu.models.stats.tests import ANOVATest, ChiSqTest, FValueTest
+
+RNG = np.random.default_rng(55)
+
+
+class TestNaiveBayes:
+    def _df(self):
+        X = np.asarray(
+            [[0.0, 0.0], [0.0, 1.0], [1.0, 0.0], [1.0, 1.0], [1.0, 1.0], [1.0, 0.0]]
+        )
+        y = np.asarray([0.0, 0.0, 1.0, 1.0, 1.0, 1.0])
+        return DataFrame.from_dict({"features": X, "label": y}), X, y
+
+    def test_defaults(self):
+        nb = NaiveBayes()
+        assert nb.get_smoothing() == 1.0
+        assert nb.get_model_type() == "multinomial"
+
+    def test_fit_predict_training_data(self):
+        df, X, y = self._df()
+        model = NaiveBayes().fit(df)
+        pred = model.transform(df)["prediction"]
+        assert (pred == y).mean() >= 5 / 6  # overlapping row [0,1]/[1,1] may flip
+
+    def test_pi_formula(self):
+        df, X, y = self._df()
+        model = NaiveBayes().set_smoothing(1.0).fit(df)
+        n, d, L = 6, 2, 2
+        pi_log = np.log(n * d + L * 1.0)
+        np.testing.assert_allclose(
+            model.pi, [np.log(2 * d + 1) - pi_log, np.log(4 * d + 1) - pi_log]
+        )
+
+    def test_save_load(self, tmp_path):
+        df, X, y = self._df()
+        model = NaiveBayes().fit(df)
+        model.save(str(tmp_path / "nb"))
+        loaded = NaiveBayesModel.load(str(tmp_path / "nb"))
+        np.testing.assert_array_equal(
+            loaded.transform(df)["prediction"], model.transform(df)["prediction"]
+        )
+
+    def test_non_integer_label_rejected(self):
+        df = DataFrame.from_dict(
+            {"features": np.zeros((2, 2)), "label": np.asarray([0.5, 1.0])}
+        )
+        with pytest.raises(ValueError, match="indexed number"):
+            NaiveBayes().fit(df)
+
+
+class TestKnn:
+    def test_fit_predict(self):
+        X = np.concatenate([RNG.normal(0, 0.3, (30, 2)), RNG.normal(5, 0.3, (30, 2))])
+        y = np.concatenate([np.zeros(30), np.ones(30)])
+        df = DataFrame.from_dict({"features": X, "label": y})
+        model = Knn().fit(df)
+        assert model.get_k() == 5
+        pred = model.transform(df)["prediction"]
+        np.testing.assert_array_equal(pred, y)
+        # far-away query follows its blob
+        q = DataFrame.from_dict({"features": np.asarray([[5.2, 4.9]])})
+        assert model.transform(q)["prediction"][0] == 1.0
+
+    def test_save_load(self, tmp_path):
+        X = RNG.normal(size=(10, 2))
+        y = (np.arange(10) % 2).astype(np.float64)
+        model = Knn().set_k(3).fit(DataFrame.from_dict({"features": X, "label": y}))
+        model.save(str(tmp_path / "knn"))
+        loaded = KnnModel.load(str(tmp_path / "knn"))
+        df = DataFrame.from_dict({"features": X})
+        np.testing.assert_array_equal(
+            loaded.transform(df)["prediction"], model.transform(df)["prediction"]
+        )
+
+
+class TestBinaryClassificationEvaluator:
+    def test_perfect_classifier(self):
+        y = np.asarray([0.0, 0.0, 1.0, 1.0])
+        raw = np.asarray([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]])
+        df = DataFrame.from_dict({"label": y, "rawPrediction": raw})
+        out = BinaryClassificationEvaluator().set_metrics_names(
+            "areaUnderROC", "areaUnderPR", "ks"
+        ).transform(df)
+        assert out["areaUnderROC"][0] == 1.0
+        assert out["areaUnderPR"][0] == 1.0
+        assert out["ks"][0] == 1.0
+
+    def test_random_scores_auc_half(self):
+        n = 4000
+        y = (RNG.random(n) > 0.5).astype(np.float64)
+        scores = RNG.random(n)
+        df = DataFrame.from_dict({"label": y, "rawPrediction": scores})
+        out = BinaryClassificationEvaluator().transform(df)
+        assert abs(out["areaUnderROC"][0] - 0.5) < 0.05
+
+    def test_known_auc(self):
+        """Hand-computable: scores [.1 .4 .35 .8], labels [0 0 1 1] → AUC 0.75."""
+        df = DataFrame.from_dict(
+            {
+                "label": np.asarray([0.0, 0.0, 1.0, 1.0]),
+                "rawPrediction": np.asarray([0.1, 0.4, 0.35, 0.8]),
+            }
+        )
+        out = BinaryClassificationEvaluator().transform(df)
+        np.testing.assert_allclose(out["areaUnderROC"][0], 0.75)
+
+    def test_single_class_rejected(self):
+        df = DataFrame.from_dict(
+            {"label": np.ones(4), "rawPrediction": RNG.random(4)}
+        )
+        with pytest.raises(ValueError):
+            BinaryClassificationEvaluator().transform(df)
+
+
+class TestStatsTests:
+    def test_chi_sq_independent_and_dependent(self):
+        n = 300
+        label = RNG.integers(0, 2, n).astype(np.float64)
+        dependent = label.copy()  # perfectly dependent
+        independent = RNG.integers(0, 2, n).astype(np.float64)
+        df = DataFrame.from_dict(
+            {"features": np.column_stack([dependent, independent]), "label": label}
+        )
+        out = ChiSqTest().transform(df)
+        p = np.asarray(out["pValues"][0])
+        assert p[0] < 1e-6 and p[1] > 0.01
+        flat = ChiSqTest().set_flatten(True).transform(df)
+        assert flat.get_column_names() == [
+            "featureIndex",
+            "pValue",
+            "degreeOfFreedom",
+            "statistic",
+        ]
+        assert len(flat) == 2
+
+    def test_anova_test(self):
+        n = 150
+        label = RNG.integers(0, 3, n).astype(np.float64)
+        informative = label * 2 + RNG.normal(0, 0.1, n)
+        noise = RNG.normal(size=n)
+        df = DataFrame.from_dict(
+            {"features": np.column_stack([informative, noise]), "label": label}
+        )
+        out = ANOVATest().transform(df)
+        p = np.asarray(out["pValues"][0])
+        assert p[0] < 1e-8 and p[1] > 0.01
+        assert out["degreesOfFreedom"][0][0] == n - 3
+
+    def test_fvalue_test(self):
+        n = 200
+        y = RNG.normal(size=n)
+        informative = y * 3 + RNG.normal(0, 0.1, n)
+        noise = RNG.normal(size=n)
+        df = DataFrame.from_dict(
+            {"features": np.column_stack([informative, noise]), "label": y}
+        )
+        out = FValueTest().transform(df)
+        p = np.asarray(out["pValues"][0])
+        assert p[0] < 1e-8 and p[1] > 0.01
+
+
+class TestSwing:
+    def test_similarity_output(self):
+        # users 0..5 all buy items 10 and 11 → strong 10↔11 similarity
+        users, items = [], []
+        for u in range(6):
+            for i in (10, 11):
+                users.append(u)
+                items.append(i)
+        # one extra item bought by user 0 only
+        users.append(0)
+        items.append(12)
+        df = DataFrame.from_dict(
+            {"user": np.asarray(users, np.int64), "item": np.asarray(items, np.int64)}
+        )
+        swing = Swing().set_min_user_behavior(1).set_max_user_behavior(10)
+        out = swing.transform(df)
+        by_item = dict(zip(out["item"], out["output"]))
+        assert 10 in by_item and 11 in by_item
+        top10 = by_item[10].split(";")[0]
+        assert top10.split(",")[0] == "11"
+        # output format "item,score"
+        float(top10.split(",")[1])
+
+    def test_behavior_bounds_filtering(self):
+        df = DataFrame.from_dict(
+            {
+                "user": np.asarray([0, 0, 1], np.int64),
+                "item": np.asarray([1, 2, 1], np.int64),
+            }
+        )
+        # minUserBehavior=2 drops user 1; no co-purchases remain → empty output
+        out = Swing().set_min_user_behavior(2).transform(df)
+        assert len(out) <= 2
+
+    def test_invalid_bounds(self):
+        with pytest.raises(ValueError, match="maxUserBehavior"):
+            Swing().set_min_user_behavior(5).set_max_user_behavior(2).transform(
+                DataFrame.from_dict(
+                    {"user": np.asarray([0], np.int64), "item": np.asarray([1], np.int64)}
+                )
+            )
+
+
+class TestAgglomerativeClustering:
+    def _blobs(self):
+        return np.concatenate(
+            [RNG.normal(0, 0.2, (15, 2)), RNG.normal(6, 0.2, (15, 2))]
+        )
+
+    @pytest.mark.parametrize("linkage", ["ward", "complete", "average", "single"])
+    def test_two_blobs(self, linkage):
+        X = self._blobs()
+        df = DataFrame.from_dict({"features": X})
+        ac = AgglomerativeClustering().set_linkage(linkage)
+        out, merges = ac.transform(df)
+        pred = out["prediction"]
+        assert len(set(pred[:15])) == 1 and len(set(pred[15:])) == 1
+        assert pred[0] != pred[-1]
+
+    def test_distance_threshold(self):
+        X = self._blobs()
+        df = DataFrame.from_dict({"features": X})
+        ac = (
+            AgglomerativeClustering()
+            .set_num_clusters(None)
+            .set_distance_threshold(3.0)
+            .set_linkage("single")
+        )
+        out, merges = ac.transform(df)
+        assert len(set(out["prediction"])) == 2
+
+    def test_full_tree_merges(self):
+        X = self._blobs()
+        df = DataFrame.from_dict({"features": X})
+        ac = AgglomerativeClustering().set_compute_full_tree(True)
+        out, merges = ac.transform(df)
+        assert len(merges) == len(X) - 1  # full dendrogram
+        assert merges["sizeOfMergedCluster"][-1] == len(X)
+
+    def test_mutually_exclusive_params(self):
+        df = DataFrame.from_dict({"features": self._blobs()})
+        with pytest.raises(ValueError, match="Exactly one"):
+            AgglomerativeClustering().set_distance_threshold(1.0).transform(df)

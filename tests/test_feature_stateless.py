@@ -173,7 +173,9 @@ def test_stop_words_remover_default_english():
 def test_tokenizers():
     df = DataFrame(["s"], None, [["Hello  World", "Foo-Bar baz"]])
     out = Tokenizer().set_input_col("s").set_output_col("t").transform(df)
-    assert out["t"][0] == ["hello", "world"]
+    # Java split("\\s") keeps interior empty tokens from consecutive whitespace
+    assert out["t"][0] == ["hello", "", "world"]
+    assert out["t"][1] == ["foo-bar", "baz"]
     rt = (
         RegexTokenizer()
         .set_input_col("s")
