@@ -1,0 +1,13 @@
+"""JSON-config benchmark harness.
+
+Reference: ``flink-ml-benchmark`` (SURVEY.md §2.8) — ``Benchmark.java:41`` (CLI:
+config JSON in, results JSON out), ``BenchmarkUtils.runBenchmark:75``
+(reflection-instantiate stage + input generator from className/paramMap, run,
+measure ``totalTimeMs`` / ``inputThroughput`` = records·1000/ms), data
+generators under ``datagenerator/``. The same config schema is accepted here,
+including the reference's Java class names (mapped by simple name through the
+stage registry).
+"""
+from flink_ml_tpu.benchmark.benchmark import main, run_benchmark, run_config
+
+__all__ = ["main", "run_benchmark", "run_config"]

@@ -1,0 +1,205 @@
+"""Benchmark input-data generators.
+
+Reference: ``flink-ml-benchmark/.../datagenerator/`` — ``InputDataGenerator``
+(numValues, colNames, seed), ``DenseVectorGenerator`` (uniform [0,1) vectors),
+``DenseVectorArrayGenerator``, ``DoubleGenerator`` (arity: 0 = continuous,
+n = uniform ints < n), ``LabeledPointWithWeightGenerator`` (featureArity /
+labelArity; weight ~ U[0,1)), ``RandomStringGenerator``,
+``KMeansModelDataGenerator`` (arraySize centroids of vectorDim).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.params.param import IntParam, Param, ParamValidators, WithParams
+from flink_ml_tpu.params.shared import HasSeed
+
+__all__ = [
+    "DenseVectorGenerator",
+    "DenseVectorArrayGenerator",
+    "DoubleGenerator",
+    "LabeledPointWithWeightGenerator",
+    "RandomStringGenerator",
+    "KMeansModelDataGenerator",
+    "GENERATOR_REGISTRY",
+]
+
+
+class InputDataGenerator(HasSeed):
+    """Ref InputDataGenerator.java."""
+
+    NUM_VALUES = IntParam("numValues", "Number of data rows to generate.", 100, ParamValidators.gt(0))
+    COL_NAMES = Param("colNames", "Column names of the generated tables.", None)
+
+    def get_num_values(self) -> int:
+        return self.get(self.NUM_VALUES)
+
+    def set_num_values(self, value: int):
+        return self.set(self.NUM_VALUES, value)
+
+    def get_col_names(self):
+        return self.get(self.COL_NAMES)
+
+    def set_col_names(self, value):
+        return self.set(self.COL_NAMES, value)
+
+    def _rng(self) -> np.random.Generator:
+        return np.random.default_rng(self.get_seed())
+
+    def generate(self) -> DataFrame:
+        raise NotImplementedError
+
+
+class _VectorDimMixin(WithParams):
+    VECTOR_DIM = IntParam("vectorDim", "Dimension of generated vectors.", 1, ParamValidators.gt(0))
+
+    def get_vector_dim(self) -> int:
+        return self.get(self.VECTOR_DIM)
+
+    def set_vector_dim(self, value: int):
+        return self.set(self.VECTOR_DIM, value)
+
+
+class DenseVectorGenerator(InputDataGenerator, _VectorDimMixin):
+    """Ref DenseVectorGenerator.java — one column of uniform [0,1) dense vectors."""
+
+    def generate(self) -> DataFrame:
+        (names,) = self.get_col_names()
+        X = self._rng().random((self.get_num_values(), self.get_vector_dim()))
+        return DataFrame(list(names), None, [X])
+
+
+class DenseVectorArrayGenerator(InputDataGenerator, _VectorDimMixin):
+    """Ref DenseVectorArrayGenerator.java — column of arrays of dense vectors."""
+
+    ARRAY_SIZE = IntParam("arraySize", "Number of vectors per array.", 1, ParamValidators.gt(0))
+
+    def get_array_size(self) -> int:
+        return self.get(self.ARRAY_SIZE)
+
+    def set_array_size(self, value: int):
+        return self.set(self.ARRAY_SIZE, value)
+
+    def generate(self) -> DataFrame:
+        (names,) = self.get_col_names()
+        rng = self._rng()
+        col = [
+            rng.random((self.get_array_size(), self.get_vector_dim()))
+            for _ in range(self.get_num_values())
+        ]
+        return DataFrame(list(names), None, [col])
+
+
+class DoubleGenerator(InputDataGenerator):
+    """Ref DoubleGenerator.java — arity 0: U[0,1); arity n: uniform ints < n."""
+
+    ARITY = IntParam("arity", "Arity of the generated doubles.", 0, ParamValidators.gt_eq(0))
+
+    def get_arity(self) -> int:
+        return self.get(self.ARITY)
+
+    def set_arity(self, value: int):
+        return self.set(self.ARITY, value)
+
+    def generate(self) -> DataFrame:
+        (names,) = self.get_col_names()
+        rng = self._rng()
+        n = self.get_num_values()
+        arity = self.get_arity()
+        vals = rng.random(n) if arity == 0 else rng.integers(0, arity, n).astype(np.float64)
+        return DataFrame(list(names), None, [vals])
+
+
+class LabeledPointWithWeightGenerator(InputDataGenerator, _VectorDimMixin):
+    """Ref LabeledPointWithWeightGenerator.java — (features, label, weight)."""
+
+    FEATURE_ARITY = IntParam(
+        "featureArity",
+        "Arity of feature values (0 = continuous U[0,1)).",
+        2,
+        ParamValidators.gt_eq(0),
+    )
+    LABEL_ARITY = IntParam(
+        "labelArity",
+        "Arity of label values (0 = continuous U[0,1)).",
+        2,
+        ParamValidators.gt_eq(0),
+    )
+
+    def get_feature_arity(self) -> int:
+        return self.get(self.FEATURE_ARITY)
+
+    def set_feature_arity(self, value: int):
+        return self.set(self.FEATURE_ARITY, value)
+
+    def get_label_arity(self) -> int:
+        return self.get(self.LABEL_ARITY)
+
+    def set_label_arity(self, value: int):
+        return self.set(self.LABEL_ARITY, value)
+
+    def generate(self) -> DataFrame:
+        (names,) = self.get_col_names()
+        rng = self._rng()
+        n, d = self.get_num_values(), self.get_vector_dim()
+
+        def values(arity, shape):
+            if arity == 0:
+                return rng.random(shape)
+            return rng.integers(0, arity, shape).astype(np.float64)
+
+        X = values(self.get_feature_arity(), (n, d))
+        y = values(self.get_label_arity(), n)
+        w = rng.random(n)
+        return DataFrame(list(names), None, [X, y, w])
+
+
+class RandomStringGenerator(InputDataGenerator):
+    """Ref RandomStringGenerator.java — columns of random numeric strings."""
+
+    NUM_DISTINCT_VALUES = IntParam(
+        "numDistinctValues", "Number of distinct string values.", 10, ParamValidators.gt(0)
+    )
+
+    def get_num_distinct_values(self) -> int:
+        return self.get(self.NUM_DISTINCT_VALUES)
+
+    def set_num_distinct_values(self, value: int):
+        return self.set(self.NUM_DISTINCT_VALUES, value)
+
+    def generate(self) -> DataFrame:
+        (names,) = self.get_col_names()
+        rng = self._rng()
+        n, k = self.get_num_values(), self.get_num_distinct_values()
+        cols = [[str(v) for v in rng.integers(0, k, n)] for _ in names]
+        return DataFrame(list(names), None, cols)
+
+
+class KMeansModelDataGenerator(HasSeed, _VectorDimMixin):
+    """Ref KMeansModelDataGenerator.java — model data: arraySize random centroids."""
+
+    ARRAY_SIZE = IntParam("arraySize", "Number of centroids.", 2, ParamValidators.gt(0))
+
+    def get_array_size(self) -> int:
+        return self.get(self.ARRAY_SIZE)
+
+    def set_array_size(self, value: int):
+        return self.set(self.ARRAY_SIZE, value)
+
+    def generate(self) -> DataFrame:
+        rng = np.random.default_rng(self.get_seed())
+        k, d = self.get_array_size(), self.get_vector_dim()
+        centroids = rng.random((k, d))
+        weights = np.ones(k)
+        return DataFrame(["centroids", "weights"], None, [[centroids], [weights]])
+
+
+GENERATOR_REGISTRY = {
+    "DenseVectorGenerator": DenseVectorGenerator,
+    "DenseVectorArrayGenerator": DenseVectorArrayGenerator,
+    "DoubleGenerator": DoubleGenerator,
+    "LabeledPointWithWeightGenerator": LabeledPointWithWeightGenerator,
+    "RandomStringGenerator": RandomStringGenerator,
+    "KMeansModelDataGenerator": KMeansModelDataGenerator,
+}

@@ -1,0 +1,385 @@
+"""Graph / GraphBuilder / GraphModel — DAG composition of stages.
+
+Reference: ``flink-ml-core/.../builder/`` — ``GraphBuilder.java:39`` (wire stages
+with ``TableId`` handles: ``addAlgoOperator:98``, ``addEstimator:124``,
+``setModelDataOnEstimator:169``, ``getModelDataFromEstimator:226``,
+``buildEstimator:286`` / ``buildAlgoOperator:359`` / ``buildModel:376``),
+``Graph.java:54`` (an Estimator over the DAG: fit walks nodes in ready order,
+fitting estimator nodes and transforming with the fitted models),
+``GraphModel.java:50`` (transform-only walk), ``GraphNode.java`` /
+``GraphData.java`` (JSON-serializable structure), executed by
+``GraphExecutionHelper`` (ready-node scheduling).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from flink_ml_tpu.api.core import AlgoOperator, Estimator, Model, Stage
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["TableId", "GraphNode", "GraphBuilder", "Graph", "GraphModel"]
+
+
+class TableId:
+    """Ref TableId.java — a placeholder for a DataFrame flowing through the DAG."""
+
+    def __init__(self, table_id: int):
+        self.id = table_id
+
+    def __repr__(self):
+        return f"TableId({self.id})"
+
+    def __eq__(self, other):
+        return isinstance(other, TableId) and other.id == self.id
+
+    def __hash__(self):
+        return hash(("TableId", self.id))
+
+
+class GraphNode:
+    """Ref GraphNode.java."""
+
+    ESTIMATOR = "ESTIMATOR"
+    ALGO_OPERATOR = "ALGO_OPERATOR"
+
+    def __init__(
+        self,
+        node_id: int,
+        stage: Stage,
+        stage_type: str,
+        estimator_input_ids: Optional[List[TableId]],
+        algo_op_input_ids: List[TableId],
+        output_ids: List[TableId],
+    ):
+        self.node_id = node_id
+        self.stage = stage
+        self.stage_type = stage_type
+        self.estimator_input_ids = estimator_input_ids
+        self.algo_op_input_ids = algo_op_input_ids
+        self.output_ids = output_ids
+        self.input_model_data_ids: Optional[List[TableId]] = None
+        self.output_model_data_ids: Optional[List[TableId]] = None
+
+
+class GraphBuilder:
+    """Ref GraphBuilder.java:39."""
+
+    def __init__(self):
+        self._next_table_id = 0
+        self._next_node_id = 0
+        self._max_output_num = 20
+        self.nodes: List[GraphNode] = []
+        self._stage_to_node: Dict[int, GraphNode] = {}
+
+    def set_max_output_table_num(self, value: int) -> "GraphBuilder":
+        self._max_output_num = value
+        return self
+
+    def create_table_id(self) -> TableId:
+        tid = TableId(self._next_table_id)
+        self._next_table_id += 1
+        return tid
+
+    def _outputs(self, n: int) -> List[TableId]:
+        return [self.create_table_id() for _ in range(n)]
+
+    def _check_not_added(self, stage: Stage) -> None:
+        if id(stage) in self._stage_to_node:
+            raise ValueError(
+                f"The stage {type(stage).__name__} has already been added to the graph."
+            )
+
+    def add_algo_operator(self, algo_op: AlgoOperator, *inputs: TableId) -> List[TableId]:
+        """Ref addAlgoOperator:98 — returns maxOutputTableNum ids; index [0] for
+        single-output stages (the reference allocates maxOutputLength=20 too)."""
+        self._check_not_added(algo_op)
+        node = GraphNode(
+            self._next_node_id,
+            algo_op,
+            GraphNode.ALGO_OPERATOR,
+            None,
+            list(inputs),
+            self._outputs(self._max_output_num),
+        )
+        self._next_node_id += 1
+        self.nodes.append(node)
+        self._stage_to_node[id(algo_op)] = node
+        return node.output_ids
+
+    def add_estimator(self, estimator: Estimator, *args) -> List[TableId]:
+        """Ref addEstimator:124/:152 — two call forms:
+        ``add_estimator(est, t1, t2, ...)`` (same inputs for fit and transform) or
+        ``add_estimator(est, [fit_ids], [transform_ids])``."""
+        if (
+            len(args) == 2
+            and isinstance(args[0], (list, tuple))
+            and isinstance(args[1], (list, tuple))
+        ):
+            estimator_inputs, algo_op_inputs = list(args[0]), list(args[1])
+        else:
+            flat: List[TableId] = []
+            for a in args:
+                flat.extend(a) if isinstance(a, (list, tuple)) else flat.append(a)
+            estimator_inputs = algo_op_inputs = flat
+        self._check_not_added(estimator)
+        node = GraphNode(
+            self._next_node_id,
+            estimator,
+            GraphNode.ESTIMATOR,
+            list(estimator_inputs),
+            list(algo_op_inputs),
+            self._outputs(self._max_output_num),
+        )
+        self._next_node_id += 1
+        self.nodes.append(node)
+        self._stage_to_node[id(estimator)] = node
+        return node.output_ids
+
+    def set_model_data_on_estimator(self, estimator: Estimator, *inputs: TableId) -> None:
+        """Ref setModelDataOnEstimator:169 — the fitted model gets this model data."""
+        self._stage_to_node[id(estimator)].input_model_data_ids = list(inputs)
+
+    def set_model_data_on_model(self, model: Model, *inputs: TableId) -> None:
+        """Ref setModelDataOnModel:195."""
+        self._stage_to_node[id(model)].input_model_data_ids = list(inputs)
+
+    def get_model_data_from_estimator(self, estimator: Estimator) -> List[TableId]:
+        """Ref getModelDataFromEstimator:226."""
+        node = self._stage_to_node[id(estimator)]
+        node.output_model_data_ids = [self.create_table_id()]
+        return node.output_model_data_ids
+
+    def get_model_data_from_model(self, model: Model) -> List[TableId]:
+        """Ref getModelDataFromModel:257."""
+        node = self._stage_to_node[id(model)]
+        node.output_model_data_ids = [self.create_table_id()]
+        return node.output_model_data_ids
+
+    # --- builders ------------------------------------------------------------
+    def build_estimator(
+        self, inputs: Sequence[TableId], outputs: Sequence[TableId]
+    ) -> "Graph":
+        """Ref buildEstimator:286."""
+        return Graph(self.nodes, list(inputs), list(inputs), list(outputs), None, None)
+
+    def build_algo_operator(
+        self, inputs: Sequence[TableId], outputs: Sequence[TableId]
+    ) -> "GraphModel":
+        """Ref buildAlgoOperator:359 — transform-only DAG."""
+        return GraphModel(self.nodes, list(inputs), list(outputs), None, None)
+
+    def build_model(
+        self, inputs: Sequence[TableId], outputs: Sequence[TableId]
+    ) -> "GraphModel":
+        """Ref buildModel:376."""
+        return GraphModel(self.nodes, list(inputs), list(outputs), None, None)
+
+
+def _execute(
+    nodes: List[GraphNode],
+    env: Dict[TableId, DataFrame],
+    fit_mode: bool,
+) -> List[Stage]:
+    """Ready-node scheduling (GraphExecutionHelper): run every node whose inputs
+    are materialized until all have run."""
+    pending = list(nodes)
+    fitted: Dict[int, Stage] = {}
+    while pending:
+        progressed = False
+        for node in list(pending):
+            needed = list(node.algo_op_input_ids)
+            if fit_mode and node.stage_type == GraphNode.ESTIMATOR:
+                needed += node.estimator_input_ids
+            if node.input_model_data_ids:
+                needed += node.input_model_data_ids
+            if not all(t in env for t in needed):
+                continue
+            pending.remove(node)
+            progressed = True
+
+            stage = node.stage
+            if fit_mode and node.stage_type == GraphNode.ESTIMATOR:
+                model = stage.fit(*[env[t] for t in node.estimator_input_ids])
+                if node.input_model_data_ids:
+                    model.set_model_data(*[env[t] for t in node.input_model_data_ids])
+                run_stage: Stage = model
+            else:
+                run_stage = stage
+                if node.input_model_data_ids and isinstance(stage, Model):
+                    stage.set_model_data(*[env[t] for t in node.input_model_data_ids])
+            fitted[node.node_id] = run_stage
+
+            out = run_stage.transform(*[env[t] for t in node.algo_op_input_ids])
+            out_list = list(out) if isinstance(out, (list, tuple)) else [out]
+            for tid, frame in zip(node.output_ids, out_list):
+                env[tid] = frame
+            if node.output_model_data_ids and isinstance(run_stage, Model):
+                model_data = run_stage.get_model_data()
+                for tid, frame in zip(node.output_model_data_ids, model_data):
+                    env[tid] = frame
+        if not progressed:
+            raise RuntimeError(
+                "Graph has unreachable nodes or a cycle: "
+                + str([n.node_id for n in pending])
+            )
+    return [fitted[n.node_id] for n in nodes]
+
+
+class Graph(Estimator):
+    """Ref Graph.java:54 — an Estimator over the node DAG."""
+
+    def __init__(
+        self,
+        nodes: List[GraphNode],
+        estimator_input_ids: List[TableId],
+        algo_op_input_ids: List[TableId],
+        output_ids: List[TableId],
+        input_model_data_ids,
+        output_model_data_ids,
+    ):
+        super().__init__()
+        self.nodes = nodes
+        self.estimator_input_ids = estimator_input_ids
+        self.algo_op_input_ids = algo_op_input_ids
+        self.output_ids = output_ids
+
+    def fit(self, *inputs: DataFrame) -> "GraphModel":
+        env: Dict[TableId, DataFrame] = dict(zip(self.estimator_input_ids, inputs))
+        fitted = _execute(self.nodes, env, fit_mode=True)
+        model_nodes = []
+        for node, stage in zip(self.nodes, fitted):
+            new_node = GraphNode(
+                node.node_id,
+                stage,
+                GraphNode.ALGO_OPERATOR,
+                None,
+                node.algo_op_input_ids,
+                node.output_ids,
+            )
+            new_node.output_model_data_ids = node.output_model_data_ids
+            model_nodes.append(new_node)
+        return GraphModel(
+            model_nodes, self.algo_op_input_ids, self.output_ids, None, None
+        )
+
+    # --- persistence ---------------------------------------------------------
+    def save(self, path: str) -> None:
+        _save_graph(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Graph":
+        nodes, inputs, outputs = _load_graph(path)
+        return cls(nodes, inputs, inputs, outputs, None, None)
+
+
+class GraphModel(Model):
+    """Ref GraphModel.java:50."""
+
+    def __init__(
+        self,
+        nodes: List[GraphNode],
+        input_ids: List[TableId],
+        output_ids: List[TableId],
+        input_model_data_ids,
+        output_model_data_ids,
+    ):
+        super().__init__()
+        self.nodes = nodes
+        self.input_ids = input_ids
+        self.output_ids = output_ids
+
+    def transform(self, *inputs: DataFrame):
+        env: Dict[TableId, DataFrame] = dict(zip(self.input_ids, inputs))
+        _execute(self.nodes, env, fit_mode=False)
+        outs = [env[t] for t in self.output_ids]
+        return outs[0] if len(outs) == 1 else outs
+
+    def get_model_data(self) -> List[DataFrame]:
+        out: List[DataFrame] = []
+        for node in self.nodes:
+            if isinstance(node.stage, Model):
+                out.extend(node.stage.get_model_data())
+        return out
+
+    def set_model_data(self, *model_data: DataFrame) -> "GraphModel":
+        i = 0
+        for node in self.nodes:
+            if isinstance(node.stage, Model):
+                n = len(node.stage.get_model_data())
+                node.stage.set_model_data(*model_data[i : i + n])
+                i += n
+        return self
+
+    def save(self, path: str) -> None:
+        _save_graph(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "GraphModel":
+        nodes, inputs, outputs = _load_graph(path)
+        return cls(nodes, inputs, outputs, None, None)
+
+
+def _save_graph(graph, path: str) -> None:
+    """GraphData JSON + per-node stage dirs (ReadWriteUtils.saveGraph:168)."""
+    rw.save_metadata(graph, path)
+    nodes_payload = []
+    for node in graph.nodes:
+        node.stage.save(os.path.join(path, "stages", f"{node.node_id:08d}"))
+        nodes_payload.append(
+            {
+                "nodeId": node.node_id,
+                "stageType": node.stage_type,
+                "estimatorInputIds": [t.id for t in node.estimator_input_ids]
+                if node.estimator_input_ids
+                else None,
+                "algoOpInputIds": [t.id for t in node.algo_op_input_ids],
+                "outputIds": [t.id for t in node.output_ids],
+                "inputModelDataIds": [t.id for t in node.input_model_data_ids]
+                if node.input_model_data_ids
+                else None,
+                "outputModelDataIds": [t.id for t in node.output_model_data_ids]
+                if node.output_model_data_ids
+                else None,
+            }
+        )
+    input_ids = (
+        graph.estimator_input_ids
+        if hasattr(graph, "estimator_input_ids")
+        else graph.input_ids
+    )
+    payload = {
+        "nodes": nodes_payload,
+        "inputIds": [t.id for t in input_ids],
+        "outputIds": [t.id for t in graph.output_ids],
+    }
+    with open(os.path.join(path, "graph.json"), "w") as f:
+        json.dump(payload, f, indent=2)
+
+
+def _load_graph(path: str):
+    with open(os.path.join(path, "graph.json")) as f:
+        payload = json.load(f)
+    nodes = []
+    for np_ in payload["nodes"]:
+        stage = rw.load_stage(os.path.join(path, "stages", f"{np_['nodeId']:08d}"))
+        node = GraphNode(
+            np_["nodeId"],
+            stage,
+            np_["stageType"],
+            [TableId(i) for i in np_["estimatorInputIds"]]
+            if np_["estimatorInputIds"]
+            else None,
+            [TableId(i) for i in np_["algoOpInputIds"]],
+            [TableId(i) for i in np_["outputIds"]],
+        )
+        if np_["inputModelDataIds"]:
+            node.input_model_data_ids = [TableId(i) for i in np_["inputModelDataIds"]]
+        if np_["outputModelDataIds"]:
+            node.output_model_data_ids = [TableId(i) for i in np_["outputModelDataIds"]]
+        nodes.append(node)
+    inputs = [TableId(i) for i in payload["inputIds"]]
+    outputs = [TableId(i) for i in payload["outputIds"]]
+    return nodes, inputs, outputs

@@ -81,8 +81,9 @@ class ANOVATest(AlgoOperator, _TestParams):
         X = df.vectors(self.get_features_col()).astype(np.float64)
         y = df.scalars(self.get_label_col())
         f, p = anova_f_classification(X, y)
-        n, classes = X.shape[0], len(np.unique(y))
-        dof = np.full(X.shape[1], n - classes, np.int64)
+        # Ref ANOVATest.java: degreeOfFreedom = dfBetween + dfWithin
+        # = (numClasses − 1) + (n − numClasses) = n − 1.
+        dof = np.full(X.shape[1], X.shape[0] - 1, np.int64)
         return _format(self.get_flatten(), p, dof, f, "fValue")
 
 

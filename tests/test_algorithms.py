@@ -157,7 +157,7 @@ class TestStatsTests:
         out = ANOVATest().transform(df)
         p = np.asarray(out["pValues"][0])
         assert p[0] < 1e-8 and p[1] > 0.01
-        assert out["degreesOfFreedom"][0][0] == n - 3
+        assert out["degreesOfFreedom"][0][0] == n - 1  # dfBetween + dfWithin
 
     def test_fvalue_test(self):
         n = 200

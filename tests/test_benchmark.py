@@ -1,0 +1,183 @@
+"""Benchmark harness tests (BenchmarkTest/DataGeneratorTest parity) and the
+stage-completeness test (test_ml_lib_completeness.py:31 analogue): every stage in
+the reference's library inventory must be present in the registry."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.benchmark.benchmark import main, run_benchmark, run_config
+from flink_ml_tpu.benchmark.datagenerator import (
+    DenseVectorGenerator,
+    DoubleGenerator,
+    KMeansModelDataGenerator,
+    LabeledPointWithWeightGenerator,
+    RandomStringGenerator,
+)
+from flink_ml_tpu.models import STAGE_REGISTRY
+
+DEMO_CONFIG = os.path.join(
+    os.path.dirname(__file__), "..", "flink_ml_tpu", "benchmark", "benchmark-demo.json"
+)
+
+
+def test_dense_vector_generator_reproducible():
+    gen = DenseVectorGenerator().set_col_names([["features"]]).set_num_values(50).set_vector_dim(3)
+    gen.set_seed(2)
+    df1, df2 = gen.generate(), gen.generate()
+    assert df1.get_column_names() == ["features"]
+    assert df1["features"].shape == (50, 3)
+    np.testing.assert_array_equal(df1["features"], df2["features"])
+
+
+def test_labeled_point_generator_arity():
+    gen = (
+        LabeledPointWithWeightGenerator()
+        .set_col_names([["features", "label", "weight"]])
+        .set_num_values(100)
+        .set_vector_dim(4)
+        .set_feature_arity(0)
+        .set_label_arity(2)
+    )
+    df = gen.generate()
+    assert set(np.unique(df["label"])) <= {0.0, 1.0}
+    assert df["features"].min() >= 0.0 and df["features"].max() < 1.0
+    assert df["weight"].shape == (100,)
+
+
+def test_double_and_string_generators():
+    d = DoubleGenerator().set_col_names([["x"]]).set_num_values(20).set_arity(3).generate()
+    assert set(np.unique(d["x"])) <= {0.0, 1.0, 2.0}
+    s = (
+        RandomStringGenerator()
+        .set_col_names([["s"]])
+        .set_num_values(30)
+        .set_num_distinct_values(5)
+        .generate()
+    )
+    assert len(set(s["s"])) <= 5
+
+
+def test_run_benchmark_kmeans_entry():
+    entry = {
+        "stage": {"className": "KMeans", "paramMap": {"k": 2, "maxIter": 3}},
+        "inputData": {
+            "className": "DenseVectorGenerator",
+            "paramMap": {"seed": 2, "colNames": [["features"]], "numValues": 500, "vectorDim": 5},
+        },
+    }
+    result = run_benchmark("KMeans-mini", entry)
+    assert result["inputRecordNum"] == 500
+    assert result["totalTimeMs"] > 0
+    assert result["inputThroughput"] == pytest.approx(
+        500 * 1000 / result["totalTimeMs"], rel=1e-3
+    )
+
+
+def test_run_benchmark_model_data_entry():
+    entry = {
+        "stage": {
+            "className": "org.apache.flink.ml.clustering.kmeans.KMeansModel",
+            "paramMap": {"k": 2},
+        },
+        "modelData": {
+            "className": "KMeansModelDataGenerator",
+            "paramMap": {"seed": 1, "arraySize": 2, "vectorDim": 5},
+        },
+        "inputData": {
+            "className": "DenseVectorGenerator",
+            "paramMap": {"seed": 2, "colNames": [["features"]], "numValues": 200, "vectorDim": 5},
+        },
+    }
+    result = run_benchmark("KMeansModel-mini", entry)
+    assert result["outputRecordNum"] == 200
+
+
+def test_cli_output_file(tmp_path, capsys):
+    out_file = str(tmp_path / "results.json")
+    config = {
+        "version": 1,
+        "b1": {
+            "stage": {"className": "StringIndexer", "paramMap": {"inputCols": ["s"], "outputCols": ["o"]}},
+            "inputData": {
+                "className": "RandomStringGenerator",
+                "paramMap": {"seed": 1, "colNames": [["s"]], "numValues": 100},
+            },
+        },
+    }
+    cfg_path = str(tmp_path / "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(config, f)
+    assert main([cfg_path, "--output-file", out_file]) == 0
+    with open(out_file) as f:
+        results = json.load(f)
+    assert results[0]["name"] == "b1" and "totalTimeMs" in results[0]
+
+
+def test_bad_entry_reports_error(tmp_path):
+    cfg = {
+        "version": 1,
+        "broken": {
+            "stage": {"className": "KMeans", "paramMap": {"nonexistentParam": 1}},
+            "inputData": {
+                "className": "DenseVectorGenerator",
+                "paramMap": {"colNames": [["features"]], "numValues": 10, "vectorDim": 2},
+            },
+        },
+    }
+    p = str(tmp_path / "c.json")
+    with open(p, "w") as f:
+        json.dump(cfg, f)
+    results = run_config(p)
+    assert "error" in results[0]
+
+
+def test_demo_config_parses():
+    results = run_config(DEMO_CONFIG)
+    assert {r["name"] for r in results} >= {"KMeans-1", "KMeansModel-1"}
+    for r in results:
+        assert "error" not in r, r
+
+
+# --- completeness (mirrors pyflink test_ml_lib_completeness.py:31) ------------
+
+REFERENCE_STAGES = [
+    # classification
+    "LogisticRegression", "LogisticRegressionModel",
+    "OnlineLogisticRegression", "OnlineLogisticRegressionModel",
+    "LinearSVC", "LinearSVCModel",
+    "NaiveBayes", "NaiveBayesModel",
+    "Knn", "KnnModel",
+    # clustering
+    "KMeans", "KMeansModel", "OnlineKMeans", "OnlineKMeansModel",
+    "AgglomerativeClustering",
+    # regression
+    "LinearRegression", "LinearRegressionModel",
+    # evaluation
+    "BinaryClassificationEvaluator",
+    # stats
+    "ChiSqTest", "ANOVATest", "FValueTest",
+    # recommendation
+    "Swing",
+    # feature
+    "Binarizer", "Bucketizer", "CountVectorizer", "CountVectorizerModel", "DCT",
+    "ElementwiseProduct", "FeatureHasher", "HashingTF", "IDF", "IDFModel",
+    "Imputer", "ImputerModel", "IndexToStringModel", "Interaction",
+    "KBinsDiscretizer", "KBinsDiscretizerModel", "MaxAbsScaler",
+    "MaxAbsScalerModel", "MinHashLSH", "MinHashLSHModel", "MinMaxScaler",
+    "MinMaxScalerModel", "NGram", "Normalizer", "OneHotEncoder",
+    "OneHotEncoderModel", "PolynomialExpansion", "RandomSplitter",
+    "RegexTokenizer", "RobustScaler", "RobustScalerModel", "SQLTransformer",
+    "StandardScaler", "StandardScalerModel", "OnlineStandardScaler",
+    "OnlineStandardScalerModel", "StopWordsRemover", "StringIndexer",
+    "StringIndexerModel", "Tokenizer", "UnivariateFeatureSelector",
+    "UnivariateFeatureSelectorModel", "VarianceThresholdSelector",
+    "VarianceThresholdSelectorModel", "VectorAssembler", "VectorIndexer",
+    "VectorIndexerModel", "VectorSlicer",
+]
+
+
+def test_registry_covers_reference_inventory():
+    missing = [s for s in REFERENCE_STAGES if s not in STAGE_REGISTRY]
+    assert not missing, f"stages missing from the registry: {missing}"

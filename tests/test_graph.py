@@ -1,0 +1,121 @@
+"""Graph API tests — parity with GraphTest semantics (SURVEY.md §4 API contract
+tests): DAG wiring, fit/transform execution order, model-data wiring, save/load."""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.builder.graph import Graph, GraphBuilder, GraphModel
+from flink_ml_tpu.models.classification.logistic_regression import LogisticRegression
+from flink_ml_tpu.models.feature.standard_scaler import StandardScaler
+from flink_ml_tpu.models.feature.sql_transformer import SQLTransformer
+
+RNG = np.random.default_rng(66)
+
+
+def _data(n=128, d=3):
+    X = RNG.normal(size=(n, d))
+    y = (X @ np.arange(1.0, d + 1.0) > 0).astype(np.float64)
+    return DataFrame.from_dict({"features": X, "label": y}), y
+
+
+def test_graph_chained_estimators():
+    """scaler -> LR built as one Estimator via GraphBuilder (buildEstimator:286)."""
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    scaler = StandardScaler().set_input_col("features").set_output_col("features")
+    scaled = builder.add_estimator(scaler, source)
+    lr = LogisticRegression().set_max_iter(30).set_global_batch_size(128)
+    predicted = builder.add_estimator(lr, scaled[0])
+    graph = builder.build_estimator([source], predicted[:1])
+
+    df, y = _data()
+    model = graph.fit(df)
+    assert isinstance(model, GraphModel)
+    out = model.transform(df)
+    assert (out["prediction"] == y).mean() > 0.9
+
+
+def test_graph_algo_operator_dag():
+    """Pure transform DAG (buildAlgoOperator:359) with a fan-out node."""
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    double_it = SQLTransformer().set_statement("SELECT v * 2 AS v FROM __THIS__")
+    add_one = SQLTransformer().set_statement("SELECT v + 1 AS v FROM __THIS__")
+    mid = builder.add_algo_operator(double_it, source)
+    out_id = builder.add_algo_operator(add_one, mid[0])
+    dag = builder.build_algo_operator([source], out_id[:1])
+    df = DataFrame.from_dict({"v": np.asarray([1.0, 2.0])})
+    out = dag.transform(df)
+    np.testing.assert_array_equal(out["v"], [3.0, 5.0])
+
+
+def test_graph_model_data_wiring():
+    """getModelDataFromEstimator → setModelDataOnModel across the DAG."""
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    lr = LogisticRegression().set_max_iter(10)
+    predicted = builder.add_estimator(lr, source)
+    model_data = builder.get_model_data_from_estimator(lr)
+    graph = builder.build_estimator([source], predicted[:1] + model_data)
+    df, y = _data(64)
+    model = graph.fit(df)
+    pred_df, md_df = model.transform(df)
+    assert "coefficient" in md_df.get_column_names()
+
+
+def test_graph_save_load(tmp_path):
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    scaler = StandardScaler().set_input_col("features").set_output_col("features")
+    scaled = builder.add_estimator(scaler, source)
+    lr = LogisticRegression().set_max_iter(20).set_global_batch_size(64)
+    predicted = builder.add_estimator(lr, scaled[0])
+    graph = builder.build_estimator([source], predicted[:1])
+
+    path = str(tmp_path / "graph")
+    graph.save(path)
+    loaded = Graph.load(path)
+    df, y = _data(64)
+    out = loaded.fit(df).transform(df)
+    assert (out["prediction"] == y).mean() > 0.85
+
+
+def test_graph_model_save_load(tmp_path):
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    lr = LogisticRegression().set_max_iter(20).set_global_batch_size(64)
+    predicted = builder.add_estimator(lr, source)
+    graph = builder.build_estimator([source], predicted[:1])
+    df, y = _data(64)
+    model = graph.fit(df)
+    out1 = model.transform(df)
+    path = str(tmp_path / "gm")
+    model.save(path)
+    loaded = GraphModel.load(path)
+    out2 = loaded.transform(df)
+    np.testing.assert_array_equal(out1["prediction"], out2["prediction"])
+
+
+def test_graph_duplicate_stage_rejected():
+    import pytest
+    from flink_ml_tpu.models.feature.sql_transformer import SQLTransformer
+
+    builder = GraphBuilder()
+    t = builder.create_table_id()
+    op = SQLTransformer().set_statement("SELECT * FROM __THIS__")
+    builder.add_algo_operator(op, t)
+    with pytest.raises(ValueError, match="already been added"):
+        builder.add_algo_operator(op, t)
+
+
+def test_graph_multi_output_stage():
+    """Multi-output stages get enough TableIds (maxOutputTableNum allocation)."""
+    from flink_ml_tpu.models.clustering.agglomerative_clustering import AgglomerativeClustering
+
+    builder = GraphBuilder()
+    t = builder.create_table_id()
+    outs = builder.add_algo_operator(AgglomerativeClustering().set_linkage("single"), t)
+    dag = builder.build_algo_operator([t], outs[:2])
+    pts = np.concatenate([RNG.normal(0, 0.1, (8, 2)), RNG.normal(5, 0.1, (8, 2))])
+    clustered, merges = dag.transform(DataFrame.from_dict({"features": pts}))
+    assert len(set(clustered["prediction"])) == 2
+    assert "distance" in merges.get_column_names()
