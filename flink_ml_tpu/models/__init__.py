@@ -17,6 +17,8 @@ STAGE_REGISTRY = {
     "LogisticRegressionModel": "flink_ml_tpu.models.classification.logistic_regression.LogisticRegressionModel",
     "LinearSVC": "flink_ml_tpu.models.classification.linearsvc.LinearSVC",
     "LinearSVCModel": "flink_ml_tpu.models.classification.linearsvc.LinearSVCModel",
+    "MLPClassifier": "flink_ml_tpu.models.classification.mlp_classifier.MLPClassifier",
+    "MLPClassifierModel": "flink_ml_tpu.models.classification.mlp_classifier.MLPClassifierModel",
     "NaiveBayes": "flink_ml_tpu.models.classification.naive_bayes.NaiveBayes",
     "NaiveBayesModel": "flink_ml_tpu.models.classification.naive_bayes.NaiveBayesModel",
     "Knn": "flink_ml_tpu.models.classification.knn.Knn",

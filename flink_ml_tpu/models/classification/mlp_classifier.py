@@ -1,0 +1,256 @@
+"""MLP classifier — the framework's deep-model flagship.
+
+Not present in the reference (its models are single coefficient vectors;
+SURVEY.md §2.9: no deep nets anywhere in the tree). This is the "new
+flink-ml-lib algo; JAX-native" called for by BASELINE.json's config list: a
+fully-connected relu network with softmax cross-entropy, trained data-parallel
+over the mesh with the same Stage/Estimator contract as every other algorithm.
+
+TPU mapping: one epoch = one jit'd SPMD step (shard_map) — minibatch gather on
+the local shard, forward/backward as bf16-friendly matmuls on the MXU, a single
+psum over the summed gradients, identical replicated adam update (optax) on
+every device. The feedback edge carries the (params, opt_state, offset) pytree
+in HBM.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from flink_ml_tpu.api.core import Estimator, Model
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.iteration import (
+    DeviceDataCache,
+    IterationBodyResult,
+    TerminateOnMaxIterOrTol,
+    iterate_bounded_until_termination,
+)
+from flink_ml_tpu.models.common import extract_labeled_data
+from flink_ml_tpu.params.param import IntArrayParam, ParamValidators, update_existing_params
+from flink_ml_tpu.params.shared import (
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRawPredictionCol,
+    HasSeed,
+    HasTol,
+)
+from flink_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext, get_mesh_context
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["MLPClassifier", "MLPClassifierModel"]
+
+
+class _MlpParams(
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    HasRawPredictionCol,
+    HasMaxIter,
+    HasLearningRate,
+    HasGlobalBatchSize,
+    HasTol,
+    HasSeed,
+):
+    HIDDEN_LAYERS = IntArrayParam(
+        "hiddenLayers",
+        "Sizes of the hidden layers.",
+        [64],
+        ParamValidators.non_empty_array(),
+    )
+
+    def get_hidden_layers(self):
+        return self.get(self.HIDDEN_LAYERS)
+
+    def set_hidden_layers(self, *values: int):
+        return self.set(self.HIDDEN_LAYERS, list(values))
+
+
+def _init_params(rng: np.random.Generator, dims: List[int]) -> List[Tuple[np.ndarray, np.ndarray]]:
+    params = []
+    for d_in, d_out in zip(dims[:-1], dims[1:]):
+        scale = np.sqrt(2.0 / d_in)
+        params.append(
+            (
+                (rng.normal(size=(d_in, d_out)) * scale).astype(np.float32),
+                np.zeros(d_out, np.float32),
+            )
+        )
+    return params
+
+
+def _forward(params, X):
+    h = X
+    for W, b in params[:-1]:
+        h = jax.nn.relu(h @ W + b)
+    W, b = params[-1]
+    return h @ W + b  # logits
+
+
+@functools.cache
+def _predict_kernel():
+    @jax.jit
+    def kernel(params, X):
+        logits = _forward(params, X)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.argmax(logits, axis=-1).astype(jnp.float32), probs
+
+    return kernel
+
+
+class MLPClassifierModel(Model, _MlpParams):
+    """Serving side: one jit'd forward pass; prediction = argmax class index."""
+
+    def __init__(self):
+        super().__init__()
+        self.params: Optional[list] = None
+        self.labels: Optional[np.ndarray] = None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        X = df.vectors(self.get_features_col()).astype(np.float32)
+        pred_idx, probs = _predict_kernel()(
+            [tuple(jnp.asarray(x) for x in layer) for layer in self.params], X
+        )
+        pred = self.labels[np.asarray(pred_idx, np.int64)]
+        out = df.clone()
+        out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))
+        out.add_column(
+            self.get_raw_prediction_col(),
+            DataTypes.vector(BasicType.DOUBLE),
+            np.asarray(probs, np.float64),
+        )
+        return out
+
+    # --- persistence ---------------------------------------------------------
+    def save(self, path: str) -> None:
+        rw.save_metadata(self, path, {"numLayers": len(self.params)})
+        arrays = {"labels": self.labels}
+        for i, (W, b) in enumerate(self.params):
+            arrays[f"W{i}"] = np.asarray(W)
+            arrays[f"b{i}"] = np.asarray(b)
+        rw.save_model_arrays(path, arrays)
+
+    @classmethod
+    def load(cls, path: str):
+        metadata = rw.load_metadata(path, rw.stage_class_name(cls))
+        model = cls()
+        model.load_param_map_from_json(metadata["paramMap"])
+        arrays = rw.load_model_arrays(path)
+        model.labels = arrays["labels"]
+        model.params = [
+            (arrays[f"W{i}"], arrays[f"b{i}"]) for i in range(metadata["numLayers"])
+        ]
+        return model
+
+    def get_model_data(self):
+        from flink_ml_tpu.api.dataframe import DataFrame
+
+        return [DataFrame(["params", "labels"], None, [[self.params], [self.labels]])]
+
+    def set_model_data(self, *model_data):
+        df = model_data[0]
+        self.params = df.column("params")[0]
+        self.labels = np.asarray(df.column("labels")[0])
+        return self
+
+
+class MLPClassifier(Estimator, _MlpParams):
+    """Data-parallel minibatch adam training of the MLP over the mesh."""
+
+    def _build_step(self, ctx: MeshContext, optimizer, num_classes: int, local_batch: int):
+        def per_shard(params, opt_state, offset, X, y, w):
+            m = X.shape[0]
+            idx = offset + jnp.arange(local_batch)
+            in_range = (idx < m).astype(jnp.float32)
+            idx = jnp.minimum(idx, m - 1)
+            Xb, yb = X[idx], y[idx]
+            wb = w[idx] * in_range
+
+            def loss_sum(p):
+                logits = _forward(p, Xb)
+                losses = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yb.astype(jnp.int32)
+                )
+                return jnp.sum(losses * wb)
+
+            loss, grads = jax.value_and_grad(loss_sum)(params)
+            packed = jax.lax.psum(
+                (grads, jnp.stack([jnp.sum(wb), loss])), DATA_AXIS
+            )
+            grads, stats = packed
+            weight_sum, loss_sum_v = stats[0], stats[1]
+            safe_w = jnp.maximum(weight_sum, 1e-30)
+            grads = jax.tree_util.tree_map(lambda g: g / safe_w, grads)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            mean_loss = loss_sum_v / safe_w
+            next_offset = jnp.where(offset + local_batch >= m, 0, offset + local_batch)
+            return params, opt_state, next_offset, mean_loss
+
+        return jax.jit(
+            jax.shard_map(
+                per_shard,
+                mesh=ctx.mesh,
+                in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(P(), P(), P(), P()),
+            ),
+            donate_argnums=(0, 1),
+        )
+
+    def fit(self, *inputs) -> MLPClassifierModel:
+        (df,) = inputs
+        data = extract_labeled_data(
+            df, self.get_features_col(), self.get_label_col(), None
+        )
+        labels = np.unique(data["labels"])
+        label_to_idx = {v: i for i, v in enumerate(labels)}
+        y_idx = np.asarray([label_to_idx[v] for v in data["labels"]], np.float32)
+        ctx = get_mesh_context()
+        cache = DeviceDataCache(
+            {"x": data["features"], "y": y_idx, "w": data["weights"]}, ctx=ctx
+        )
+        dims = [data["features"].shape[1], *[int(h) for h in self.get_hidden_layers()], len(labels)]
+        rng = np.random.default_rng(self.get_seed())
+        params = [tuple(jnp.asarray(a) for a in layer) for layer in _init_params(rng, dims)]
+        optimizer = optax.adam(self.get_learning_rate())
+        opt_state = optimizer.init(params)
+
+        local_batch = max(1, -(-self.get_global_batch_size() // ctx.n_data))
+        local_batch = min(local_batch, cache.local_rows)
+        step = self._build_step(ctx, optimizer, len(labels), local_batch)
+        criteria = TerminateOnMaxIterOrTol(self.get_max_iter(), self.get_tol())
+        check_loss = np.isfinite(self.get_tol()) and self.get_tol() > 0
+        mask = cache.mask
+
+        def body(variables, epoch):
+            params, opt_state, offset = variables
+            params, opt_state, offset, mean_loss = step(
+                params, opt_state, offset, cache["x"], cache["y"], cache["w"] * mask
+            )
+            loss_val = float(jax.device_get(mean_loss)) if check_loss else None
+            return IterationBodyResult(
+                [params, opt_state, offset],
+                outputs=[params],
+                termination_criteria=criteria(epoch, loss_val),
+            )
+
+        outputs = iterate_bounded_until_termination(
+            [params, opt_state, ctx.replicate(np.asarray(0, np.int32))], body
+        )
+        model = MLPClassifierModel()
+        update_existing_params(model, self)
+        model.params = [
+            tuple(np.asarray(jax.device_get(a)) for a in layer) for layer in outputs[0]
+        ]
+        model.labels = labels.astype(np.float64)
+        return model

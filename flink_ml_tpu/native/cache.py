@@ -1,0 +1,119 @@
+"""NativeDataCache — the HostDataCache API over the C++ chunk store.
+
+Same contract as ``flink_ml_tpu.iteration.datacache.HostDataCache`` (append
+columnar chunks / iterate minibatches / snapshot-recover, append order
+preserved), with the payload bytes owned by the native store (resident up to the
+budget, spilled to files past it). Snapshot files use the same npz+manifest
+format as the Python tier, so the two caches are interchangeable on disk.
+
+Chunk encoding: 8-byte little-endian header length, a JSON header
+{name: [dtype, shape]}, then each column's raw buffer in header order.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from flink_ml_tpu.native import NativeChunkStore
+
+__all__ = ["NativeDataCache"]
+
+
+def _pack(chunk: Dict[str, np.ndarray]) -> bytes:
+    header = {}
+    buffers = []
+    for name, arr in chunk.items():
+        arr = np.ascontiguousarray(arr)
+        header[name] = [arr.dtype.str, list(arr.shape)]
+        buffers.append(arr.tobytes())
+    header_bytes = json.dumps(header).encode()
+    return struct.pack("<Q", len(header_bytes)) + header_bytes + b"".join(buffers)
+
+
+def _unpack(data: bytes) -> Dict[str, np.ndarray]:
+    (header_len,) = struct.unpack_from("<Q", data, 0)
+    header = json.loads(data[8 : 8 + header_len].decode())
+    out = {}
+    offset = 8 + header_len
+    for name, (dtype_str, shape) in header.items():
+        dtype = np.dtype(dtype_str)
+        nbytes = dtype.itemsize * int(np.prod(shape)) if shape else dtype.itemsize
+        out[name] = np.frombuffer(data, dtype, count=int(np.prod(shape)), offset=offset).reshape(shape)
+        offset += nbytes
+    return out
+
+
+class NativeDataCache:
+    """Drop-in for HostDataCache backed by the native chunk store."""
+
+    def __init__(self, memory_budget_bytes: int = 1 << 30, spill_dir: Optional[str] = None):
+        self._store = NativeChunkStore(memory_budget_bytes, spill_dir)
+        self._n_rows = 0
+        self._finished = False
+
+    # --- write side ----------------------------------------------------------
+    def append(self, chunk: Dict[str, np.ndarray]) -> None:
+        if self._finished:
+            raise RuntimeError("cache already finished")
+        chunk = {k: np.asarray(v) for k, v in chunk.items()}
+        lengths = {v.shape[0] for v in chunk.values()}
+        if len(lengths) != 1:
+            raise ValueError(f"inconsistent column lengths {lengths}")
+        self._store.append(_pack(chunk))
+        self._n_rows += next(iter(lengths))
+
+    def finish(self) -> None:
+        self._finished = True
+
+    @property
+    def num_rows(self) -> int:
+        return self._n_rows
+
+    @property
+    def memory_bytes(self) -> int:
+        return self._store.memory_bytes
+
+    @property
+    def spilled_chunks(self) -> int:
+        return self._store.spilled_chunks
+
+    # --- read side -----------------------------------------------------------
+    def _chunks(self) -> Iterator[Dict[str, np.ndarray]]:
+        for i in range(len(self._store)):
+            yield _unpack(self._store.read(i))
+
+    def iter_rows(self) -> Iterator[Dict[str, np.ndarray]]:
+        yield from self._chunks()
+
+    def iter_minibatches(self, batch_size: int, drop_last: bool = False):
+        from flink_ml_tpu.iteration.stream import rebatch
+
+        yield from rebatch(self._chunks(), batch_size, drop_last=drop_last)
+
+    # --- snapshot (same on-disk format as HostDataCache) ---------------------
+    def snapshot(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        count = 0
+        for i, chunk in enumerate(self._chunks()):
+            np.savez(os.path.join(path, f"chunk{i}.npz"), **chunk)
+            count = i + 1
+        with open(os.path.join(path, "MANIFEST.json"), "w") as f:
+            json.dump({"num_chunks": count, "num_rows": self._n_rows}, f)
+
+    @classmethod
+    def recover(cls, path: str, **kwargs) -> "NativeDataCache":
+        cache = cls(**kwargs)
+        with open(os.path.join(path, "MANIFEST.json")) as f:
+            manifest = json.load(f)
+        for i in range(manifest["num_chunks"]):
+            with np.load(os.path.join(path, f"chunk{i}.npz")) as z:
+                cache.append({k: z[k] for k in z.files})
+        cache.finish()
+        return cache
+
+    def close(self) -> None:
+        self._store.close()

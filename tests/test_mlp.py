@@ -1,0 +1,79 @@
+"""MLPClassifier tests — the JAX-native deep-model flagship (no reference
+counterpart; standard quartet: defaults, fit/transform, save/load, model data)."""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.classification.mlp_classifier import (
+    MLPClassifier,
+    MLPClassifierModel,
+)
+
+RNG = np.random.default_rng(77)
+
+
+def _xor(n=512):
+    X = RNG.normal(size=(n, 2))
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float64)
+    return DataFrame.from_dict({"features": X, "label": y}), y
+
+
+def _fit(df, **kw):
+    m = (
+        MLPClassifier()
+        .set_hidden_layers(32, 32)
+        .set_max_iter(kw.pop("max_iter", 300))
+        .set_learning_rate(0.01)
+        .set_global_batch_size(512)
+        .set_tol(0.0)
+        .set_seed(1)
+    )
+    return m.fit(df)
+
+
+def test_defaults():
+    m = MLPClassifier()
+    assert m.get_hidden_layers() == [64]
+    assert m.get_max_iter() == 20
+    assert m.get_learning_rate() == 0.1
+
+
+def test_solves_nonlinear_problem():
+    df, y = _xor()
+    model = _fit(df)
+    out = model.transform(df)
+    assert (out["prediction"] == y).mean() > 0.95
+    probs = out["rawPrediction"]
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_multiclass_labels_preserved():
+    n = 300
+    X = RNG.normal(size=(n, 2))
+    # three wedges by angle; labels are non-contiguous values
+    angle = np.arctan2(X[:, 1], X[:, 0])
+    y = np.select([angle < -1.0, angle < 1.0], [10.0, 20.0], 30.0)
+    df = DataFrame.from_dict({"features": X, "label": y})
+    model = _fit(df, max_iter=400)
+    out = model.transform(df)
+    assert set(np.unique(out["prediction"])) <= {10.0, 20.0, 30.0}
+    assert (out["prediction"] == y).mean() > 0.9
+    assert out["rawPrediction"].shape[1] == 3
+
+
+def test_save_load_round_trip(tmp_path):
+    df, y = _xor(128)
+    model = _fit(df, max_iter=50)
+    path = str(tmp_path / "mlp")
+    model.save(path)
+    loaded = MLPClassifierModel.load(path)
+    out1, out2 = model.transform(df), loaded.transform(df)
+    np.testing.assert_array_equal(out1["prediction"], out2["prediction"])
+    np.testing.assert_allclose(out1["rawPrediction"], out2["rawPrediction"], atol=1e-6)
+
+
+def test_seed_reproducible():
+    df, _ = _xor(128)
+    m1, m2 = _fit(df, max_iter=20), _fit(df, max_iter=20)
+    for (w1, b1), (w2, b2) in zip(m1.params, m2.params):
+        np.testing.assert_array_equal(w1, w2)

@@ -1,0 +1,90 @@
+"""Native (C++) chunk-store tests: build, spill behavior, parity with the Python
+HostDataCache, and on-disk snapshot interchange."""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.native import NativeChunkStore, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain available"
+)
+
+
+def test_chunk_store_round_trip(tmp_path):
+    store = NativeChunkStore(memory_budget_bytes=1 << 20, spill_dir=str(tmp_path))
+    payloads = [bytes([i]) * (100 + i) for i in range(5)]
+    for p in payloads:
+        store.append(p)
+    assert len(store) == 5
+    for i, p in enumerate(payloads):
+        assert store.read(i) == p
+    assert store.spilled_chunks == 0
+    store.close()
+
+
+def test_chunk_store_spills_over_budget(tmp_path):
+    store = NativeChunkStore(memory_budget_bytes=300, spill_dir=str(tmp_path / "spill"))
+    big = b"x" * 200
+    store.append(big)  # resident (200 <= 300)
+    store.append(big)  # over budget → spilled
+    store.append(b"y" * 50)  # fits again (200 + 50 <= 300)
+    assert store.spilled_chunks == 1
+    assert store.memory_bytes == 250
+    # spilled chunk reads back identically, order preserved
+    assert store.read(0) == big and store.read(1) == big and store.read(2) == b"y" * 50
+    store.close()
+
+
+def test_chunk_store_out_of_range(tmp_path):
+    store = NativeChunkStore(1 << 20)
+    store.append(b"abc")
+    with pytest.raises(IndexError):
+        store.read(7)
+    store.close()
+
+
+def test_native_cache_matches_python_cache(tmp_path):
+    from flink_ml_tpu.iteration.datacache import HostDataCache
+    from flink_ml_tpu.native.cache import NativeDataCache
+
+    rng = np.random.default_rng(0)
+    chunks = [
+        {"x": rng.normal(size=(7, 3)), "y": rng.integers(0, 5, 7)} for _ in range(4)
+    ]
+    native = NativeDataCache(memory_budget_bytes=500, spill_dir=str(tmp_path / "n"))
+    python = HostDataCache(memory_budget_bytes=500, spill_dir=str(tmp_path / "p"))
+    for c in chunks:
+        native.append(c)
+        python.append(c)
+    native.finish()
+    python.finish()
+    assert native.num_rows == python.num_rows == 28
+    assert native.spilled_chunks > 0  # budget forces the native tier to spill
+    for nb, pb in zip(native.iter_minibatches(10), python.iter_minibatches(10)):
+        np.testing.assert_array_equal(nb["x"], pb["x"])
+        np.testing.assert_array_equal(nb["y"], pb["y"])
+    native.close()
+
+
+def test_native_snapshot_interchanges_with_python(tmp_path):
+    """A native snapshot restores into the Python cache and vice versa."""
+    from flink_ml_tpu.iteration.datacache import HostDataCache
+    from flink_ml_tpu.native.cache import NativeDataCache
+
+    native = NativeDataCache()
+    native.append({"x": np.arange(6.0)})
+    native.finish()
+    snap = str(tmp_path / "snap")
+    native.snapshot(snap)
+    recovered = HostDataCache.recover(snap)
+    np.testing.assert_array_equal(
+        next(recovered.iter_minibatches(6))["x"], np.arange(6.0)
+    )
+    snap2 = str(tmp_path / "snap2")
+    recovered.snapshot(snap2)
+    native2 = NativeDataCache.recover(snap2)
+    np.testing.assert_array_equal(
+        next(native2.iter_minibatches(6))["x"], np.arange(6.0)
+    )
+    native.close()
+    native2.close()
