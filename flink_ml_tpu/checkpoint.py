@@ -34,12 +34,31 @@ _STEP_PREFIX = "ckpt-"
 
 
 class CheckpointManager:
-    """Numbered atomic snapshots of a pytree of arrays under ``directory``."""
+    """Numbered atomic snapshots of a pytree of arrays under ``directory``.
 
-    def __init__(self, directory: str, max_to_keep: int = 2):
+    ``fingerprint`` is a run/config identity string (hash of hyperparameters +
+    data shape, typically set by the algorithm via ``set_fingerprint``). It is
+    recorded in each snapshot's META.json; ``restore_latest`` refuses a snapshot
+    whose fingerprint differs — pointing a *different* job at an existing
+    directory raises instead of silently resuming stale state.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 2, fingerprint: Optional[str] = None):
         self.directory = directory
         self.max_to_keep = max_to_keep
+        self.fingerprint = fingerprint
+        self._user_pinned = fingerprint is not None
         os.makedirs(directory, exist_ok=True)
+
+    def set_fingerprint(self, fingerprint: str) -> None:
+        """Install the run identity computed by an algorithm.
+
+        A fingerprint pinned explicitly at construction wins; an auto-installed
+        one is *overwritten* on each call, so reusing one manager across
+        differently-configured runs still trips the stale-resume guard.
+        """
+        if not self._user_pinned:
+            self.fingerprint = fingerprint
 
     # --- write ---------------------------------------------------------------
     def save(self, step: int, state: Any) -> str:
@@ -63,7 +82,14 @@ class CheckpointManager:
         with open(os.path.join(tmp_dir, "treedef.pkl"), "wb") as f:
             pickle.dump(treedef, f)
         with open(os.path.join(tmp_dir, "META.json"), "w") as f:
-            json.dump({"step": step, "num_leaves": len(host_leaves)}, f)
+            json.dump(
+                {
+                    "step": step,
+                    "num_leaves": len(host_leaves),
+                    "fingerprint": self.fingerprint,
+                },
+                f,
+            )
         if os.path.exists(final_dir):
             shutil.rmtree(final_dir)
         os.rename(tmp_dir, final_dir)
@@ -95,7 +121,17 @@ class CheckpointManager:
         steps = self.all_steps()
         if not steps:
             return None
-        return steps[-1], self.restore(steps[-1])
+        step = steps[-1]
+        with open(os.path.join(self.directory, f"{_STEP_PREFIX}{step}", "META.json")) as f:
+            meta = json.load(f)
+        saved = meta.get("fingerprint")
+        if saved is not None and self.fingerprint is not None and saved != self.fingerprint:
+            raise ValueError(
+                f"checkpoint directory {self.directory!r} holds snapshots of a different "
+                f"run (fingerprint {saved!r} != {self.fingerprint!r}); point this job at "
+                "a fresh directory or delete the stale checkpoints"
+            )
+        return step, self.restore(step)
 
     def _prune(self) -> None:
         steps = self.all_steps()

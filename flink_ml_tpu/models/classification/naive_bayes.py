@@ -107,11 +107,15 @@ class NaiveBayesModel(Model, _NbParams):
     def get_model_data(self):
         from flink_ml_tpu.api.dataframe import DataFrame
 
+        # "defaultLog" extends the reference's (theta, piArray, labels) tuple: the
+        # unseen-value floor log(smoothing) − log(count_l + smoothing·|values|) is
+        # not derivable from a theta table alone (Σ exp(theta) = 1 for every table),
+        # so it rides along to keep every construction path scoring identically.
         return [
             DataFrame(
-                ["theta", "piArray", "labels"],
+                ["theta", "piArray", "labels", "defaultLog"],
                 None,
-                [[self.theta], [self.pi], [self.labels]],
+                [[self.theta], [self.pi], [self.labels], [self.default_log]],
             )
         ]
 
@@ -120,15 +124,15 @@ class NaiveBayesModel(Model, _NbParams):
         self.theta = df.column("theta")[0]
         self.pi = np.asarray(df.column("piArray")[0])
         self.labels = np.asarray(df.column("labels")[0])
-        L, d = len(self.theta), len(self.theta[0])
-        # Unseen-value floor approximated by the smallest smoothed log-prob in each
-        # (label, dim) table (exact default_log is persisted by save/load).
-        self.default_log = np.asarray(
-            [
-                [min(t.values()) if t else -np.inf for t in row]
-                for row in self.theta
-            ]
-        )
+        if "defaultLog" in df.column_names:
+            self.default_log = np.asarray(df.column("defaultLog")[0])
+        else:
+            # Legacy 3-column model data: approximate the floor by the smallest
+            # smoothed log-prob per (label, dim) table — exact whenever some value
+            # has zero count for that label.
+            self.default_log = np.asarray(
+                [[min(t.values()) if t else -np.inf for t in row] for row in self.theta]
+            )
         return self
 
 

@@ -23,7 +23,7 @@ def _hash(obj) -> int:
     """Ref HashingTF.hash:161 — type-dispatched guava murmur3_32(0)."""
     if obj is None:
         return 0
-    if isinstance(obj, bool):
+    if isinstance(obj, (bool, np.bool_)):
         return hashing.hash_int(1 if obj else 0)
     if isinstance(obj, (int, np.integer)):
         v = int(obj)

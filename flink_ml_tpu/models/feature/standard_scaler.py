@@ -83,10 +83,20 @@ def _mean_std(sum_: np.ndarray, sq_sum: np.ndarray, n: float):
 
 @functools.cache
 def _stats_kernel():
+    """Masked mean + *centered* second moment in one program.
+
+    The reference finalizes var = (sqSum − n·mean²)/(n−1) in Java doubles
+    (StandardScaler.java BuildModelOperator); in f32 (TPU has no f64) that
+    formula cancels catastrophically when |mean| ≫ std, so the kernel centers
+    before squaring: var = Σ mask·(x−mean)² / (n−1). Same answer, stable.
+    """
+
     @jax.jit
     def stats(X, mask):
-        xm = X * mask[:, None]
-        return jnp.sum(xm, axis=0), jnp.sum(xm * X, axis=0), jnp.sum(mask)
+        n = jnp.sum(mask)
+        mean = jnp.sum(X * mask[:, None], axis=0) / jnp.maximum(n, 1.0)
+        c = (X - mean[None, :]) * mask[:, None]
+        return mean, jnp.sum(c * c, axis=0), n
 
     return stats
 
@@ -154,10 +164,13 @@ class StandardScaler(Estimator, _ScalerParams):
         X = df.vectors(self.get_input_col()).astype(np.float32)
         ctx = get_mesh_context()
         cache = DeviceDataCache({"x": X}, ctx=ctx)
-        s, sq, n = _stats_kernel()(cache["x"], cache.mask)
-        mean, std = _mean_std(
-            np.asarray(s, np.float64), np.asarray(sq, np.float64), float(n)
-        )
+        mean, sq_c, n = _stats_kernel()(cache["x"], cache.mask)
+        mean = np.asarray(mean, np.float64)
+        n = float(n)
+        if n > 1:
+            std = np.sqrt(np.maximum(np.asarray(sq_c, np.float64) / (n - 1), 0.0))
+        else:
+            std = np.zeros_like(mean)
         model = StandardScalerModel()
         update_existing_params(model, self)
         model.mean, model.std = mean, std

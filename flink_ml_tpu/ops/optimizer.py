@@ -180,6 +180,30 @@ class SGD(Optimizer):
         local_batch = min(local_batch, train_data.local_rows)
         step = self._build_step(ctx, loss_func, local_batch)
 
+        if self.checkpoint_manager is not None:
+            # Run identity: a different config/data shape pointed at the same
+            # checkpoint directory must not silently resume stale state.
+            import hashlib
+            import json as _json
+
+            sig = _json.dumps(
+                {
+                    "loss": type(loss_func).__name__,
+                    "max_iter": self.max_iter,
+                    "lr": self.learning_rate,
+                    "batch": self.global_batch_size,
+                    "tol": self.tol,
+                    "reg": self.reg,
+                    "elastic_net": self.elastic_net,
+                    "rows": int(train_data.n_valid),
+                    "dim": int(np.shape(X)[1]),
+                },
+                sort_keys=True,
+            )
+            self.checkpoint_manager.set_fingerprint(
+                hashlib.sha256(sig.encode()).hexdigest()[:16]
+            )
+
         coef = ctx.replicate(np.asarray(init_model, self.dtype))
         offset = ctx.replicate(np.asarray(0, np.int32))
         criteria = TerminateOnMaxIterOrTol(self.max_iter, self.tol)

@@ -53,6 +53,22 @@ class TestNaiveBayes:
             loaded.transform(df)["prediction"], model.transform(df)["prediction"]
         )
 
+    def test_set_model_data_unseen_value_floor(self):
+        # default_log must ride through get/set_model_data so a model built via
+        # set_model_data scores unseen feature values exactly like fit/save-load.
+        df, X, y = self._df()
+        model = NaiveBayes().fit(df)
+        (md,) = model.get_model_data()
+        fresh = NaiveBayesModel()
+        for p in model.get_param_map():
+            fresh.set(p, model.get(p))
+        fresh.set_model_data(md)
+        np.testing.assert_allclose(fresh.default_log, model.default_log)
+        unseen = DataFrame.from_dict({"features": np.asarray([[7.0, 9.0]])})
+        np.testing.assert_array_equal(
+            fresh.transform(unseen)["prediction"], model.transform(unseen)["prediction"]
+        )
+
     def test_non_integer_label_rejected(self):
         df = DataFrame.from_dict(
             {"features": np.zeros((2, 2)), "label": np.asarray([0.5, 1.0])}

@@ -108,3 +108,55 @@ def test_save_is_atomic_against_partial_state(tmp_path):
     assert step == 1
     mgr.save(2, [np.zeros(2)])
     assert mgr.all_steps() == [1, 2]
+
+
+def test_fingerprint_mismatch_refuses_restore(tmp_path):
+    # A different run/config pointed at an existing directory must fail loudly
+    # instead of silently resuming stale state.
+    mgr_a = CheckpointManager(str(tmp_path), fingerprint="run-a")
+    mgr_a.save(5, [np.arange(3.0)])
+    same = CheckpointManager(str(tmp_path), fingerprint="run-a")
+    step, _ = same.restore_latest()
+    assert step == 5
+    other = CheckpointManager(str(tmp_path), fingerprint="run-b")
+    with pytest.raises(ValueError, match="different\\s+run"):
+        other.restore_latest()
+    # Managers with no fingerprint keep the permissive legacy behavior.
+    legacy = CheckpointManager(str(tmp_path))
+    assert legacy.restore_latest()[0] == 5
+
+
+def test_sgd_installs_config_fingerprint(tmp_path):
+    from flink_ml_tpu.ops import SGD, BinaryLogisticLoss
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (rng.random(64) > 0.5).astype(np.float32)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    sgd = SGD(max_iter=3, global_batch_size=32, checkpoint_manager=mgr, checkpoint_interval=1)
+    sgd.optimize(np.zeros(4), {"features": X, "labels": y}, BinaryLogisticLoss.INSTANCE)
+    fp = mgr.fingerprint
+    assert fp is not None
+    # A config change yields a different fingerprint, so resume is refused.
+    mgr2 = CheckpointManager(str(tmp_path / "ck"))
+    sgd2 = SGD(max_iter=9, global_batch_size=32, checkpoint_manager=mgr2, checkpoint_interval=1)
+    with pytest.raises(ValueError, match="different\\s+run"):
+        sgd2.optimize(np.zeros(4), {"features": X, "labels": y}, BinaryLogisticLoss.INSTANCE)
+
+
+def test_reused_manager_across_configs_refuses(tmp_path):
+    # One manager instance reused for two differently-configured runs: the
+    # second run's auto fingerprint must overwrite the first and trip the guard.
+    from flink_ml_tpu.ops import SGD, BinaryLogisticLoss
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (rng.random(64) > 0.5).astype(np.float32)
+    mgr = CheckpointManager(str(tmp_path))
+    SGD(max_iter=3, global_batch_size=32, checkpoint_manager=mgr, checkpoint_interval=1).optimize(
+        np.zeros(4), {"features": X, "labels": y}, BinaryLogisticLoss.INSTANCE
+    )
+    with pytest.raises(ValueError, match="different\\s+run"):
+        SGD(
+            max_iter=9, global_batch_size=32, checkpoint_manager=mgr, checkpoint_interval=1
+        ).optimize(np.zeros(4), {"features": X, "labels": y}, BinaryLogisticLoss.INSTANCE)

@@ -305,3 +305,19 @@ def test_minhash_lsh_jaccard_and_neighbors():
     join = model.approx_similarity_join(df, df, threshold=0.6, id_col="id")
     pairs = {(int(x), int(y)) for x, y in zip(join["idA"], join["idB"])}
     assert (0, 1) in pairs and (0, 0) in pairs and (0, 2) not in pairs
+
+
+def test_standard_scaler_large_mean_numerical_stability():
+    # Regression: the naive (sqSum - n*mean^2)/(n-1) finalization cancels
+    # catastrophically in f32 when |mean| >> std; the centered kernel must not.
+    from flink_ml_tpu.models.feature.standard_scaler import StandardScaler
+
+    rng = np.random.default_rng(7)
+    X = (1.0e6 + rng.normal(0.0, 1.0, size=(4096, 3))).astype(np.float64)
+    model = StandardScaler().set_input_col("input").set_with_mean(True).fit(
+        DataFrame.from_dict({"input": X})
+    )
+    np.testing.assert_allclose(model.std, X.std(axis=0, ddof=1), rtol=0.05)
+    np.testing.assert_allclose(model.mean, X.mean(axis=0), rtol=1e-6)
+    out = model.transform(DataFrame.from_dict({"input": X}))["output"]
+    assert abs(np.std(out, ddof=1) - 1.0) < 0.1

@@ -282,3 +282,15 @@ def test_registry_resolves_all_stages():
     for name in STAGE_REGISTRY:
         cls = get_stage_class(name)
         assert cls.__name__ == name
+
+
+def test_hashing_tf_numpy_bool_terms():
+    # np.bool_ is neither bool nor np.integer; it must hash like the Java Boolean
+    # branch (guava hashInt(1/0)), identically to a Python bool.
+    df_np = DataFrame(["terms"], None, [[[np.bool_(True), np.bool_(False)]]])
+    df_py = DataFrame(["terms"], None, [[[True, False]]])
+    tf = HashingTF().set_input_col("terms").set_num_features(64)
+    v_np = tf.transform(df_np)["output"][0]
+    v_py = tf.transform(df_py)["output"][0]
+    np.testing.assert_array_equal(v_np.indices, v_py.indices)
+    np.testing.assert_array_equal(v_np.values, v_py.values)
