@@ -25,12 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.types import BasicType, DataTypes
-from flink_ml_tpu.iteration import (
-    DeviceDataCache,
-    IterationBodyResult,
-    TerminateOnMaxIterOrTol,
-    iterate_bounded_until_termination,
-)
+from flink_ml_tpu.iteration import DeviceDataCache
 from flink_ml_tpu.models.common import extract_labeled_data
 from flink_ml_tpu.params.param import IntArrayParam, ParamValidators, update_existing_params
 from flink_ml_tpu.params.shared import (
@@ -164,10 +159,80 @@ class MLPClassifierModel(Model, _MlpParams):
         return self
 
 
+_MLP_FUSED_CACHE: dict = {}
+
+
 class MLPClassifier(Estimator, _MlpParams):
     """Data-parallel minibatch adam training of the MLP over the mesh."""
 
-    def _build_step(self, ctx: MeshContext, optimizer, num_classes: int, local_batch: int):
+    def _build_fused(self, ctx: MeshContext, optimizer, local_batch: int, n_epochs: int, tol):
+        """Whole-run training as ONE program: ``lax.scan`` over epochs when the
+        criteria is maxIter only, ``lax.while_loop`` with the on-device tol check
+        otherwise (the psum'd loss is replicated, so every shard branches alike).
+
+        Programs are cached per (mesh, learning rate, batch, epochs, tol);
+        jit re-specializes per parameter/data shapes on its own, so layer dims
+        need not be part of the key."""
+        key = (ctx.mesh, self.get_learning_rate(), local_batch, n_epochs, tol)
+        cached = _MLP_FUSED_CACHE.get(key)
+        if cached is not None:
+            return cached
+        epoch = self._epoch_math(optimizer, local_batch)
+
+        if tol is None:
+
+            def per_shard(params, opt_state, offset, X, y, w):
+                def body(carry, _):
+                    p, s, o = carry
+                    p, s, o, mean_loss = epoch(p, s, o, X, y, w)
+                    return (p, s, o), mean_loss
+
+                (params, opt_state, offset), _ = jax.lax.scan(
+                    body, (params, opt_state, offset), None, length=n_epochs
+                )
+                return params, opt_state, offset, jnp.asarray(0.0, jnp.float32)
+
+        else:
+
+            def per_shard(params, opt_state, offset, X, y, w):
+                def cond(carry):
+                    n, _p, _s, _o, last = carry
+                    # ~(last < tol), not (last >= tol): continue on NaN like the
+                    # host criteria (TerminateOnMaxIterOrTol stops iff loss < tol).
+                    return (n < n_epochs) & ((n == 0) | ~(last < tol))
+
+                def body(carry):
+                    n, p, s, o, _last = carry
+                    p, s, o, mean_loss = epoch(p, s, o, X, y, w)
+                    return n + 1, p, s, o, mean_loss
+
+                _n, params, opt_state, offset, last = jax.lax.while_loop(
+                    cond,
+                    body,
+                    (
+                        jnp.asarray(0, jnp.int32),
+                        params,
+                        opt_state,
+                        offset,
+                        jnp.asarray(jnp.inf, jnp.float32),
+                    ),
+                )
+                return params, opt_state, offset, last
+
+        program = jax.jit(
+            jax.shard_map(
+                per_shard,
+                mesh=ctx.mesh,
+                in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(P(), P(), P(), P()),
+            ),
+            donate_argnums=(0, 1),
+        )
+        _MLP_FUSED_CACHE[key] = program
+        return program
+
+    @staticmethod
+    def _epoch_math(optimizer, local_batch: int):
         def per_shard(params, opt_state, offset, X, y, w):
             m = X.shape[0]
             idx = offset + jnp.arange(local_batch)
@@ -197,15 +262,7 @@ class MLPClassifier(Estimator, _MlpParams):
             next_offset = jnp.where(offset + local_batch >= m, 0, offset + local_batch)
             return params, opt_state, next_offset, mean_loss
 
-        return jax.jit(
-            jax.shard_map(
-                per_shard,
-                mesh=ctx.mesh,
-                in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-                out_specs=(P(), P(), P(), P()),
-            ),
-            donate_argnums=(0, 1),
-        )
+        return per_shard
 
     def fit(self, *inputs) -> MLPClassifierModel:
         (df,) = inputs
@@ -227,30 +284,31 @@ class MLPClassifier(Estimator, _MlpParams):
 
         local_batch = max(1, -(-self.get_global_batch_size() // ctx.n_data))
         local_batch = min(local_batch, cache.local_rows)
-        step = self._build_step(ctx, optimizer, len(labels), local_batch)
-        criteria = TerminateOnMaxIterOrTol(self.get_max_iter(), self.get_tol())
         check_loss = np.isfinite(self.get_tol()) and self.get_tol() > 0
         mask = cache.mask
 
-        def body(variables, epoch):
-            params, opt_state, offset = variables
-            params, opt_state, offset, mean_loss = step(
-                params, opt_state, offset, cache["x"], cache["y"], cache["w"] * mask
-            )
-            loss_val = float(jax.device_get(mean_loss)) if check_loss else None
-            return IterationBodyResult(
-                [params, opt_state, offset],
-                outputs=[params],
-                termination_criteria=criteria(epoch, loss_val),
-            )
-
-        outputs = iterate_bounded_until_termination(
-            [params, opt_state, ctx.replicate(np.asarray(0, np.int32))], body
+        # Whole-run fusion: no checkpoint/listener hooks on MLP fit, so all epochs
+        # always run inside one XLA program (scan for maxIter-only, while_loop for
+        # the tol criteria evaluated on device).
+        fused = self._build_fused(
+            ctx,
+            optimizer,
+            local_batch,
+            self.get_max_iter(),
+            self.get_tol() if check_loss else None,
+        )
+        final_params, _opt_state, _offset, _loss = fused(
+            params,
+            opt_state,
+            ctx.replicate(np.asarray(0, np.int32)),
+            cache["x"],
+            cache["y"],
+            cache["w"] * mask,
         )
         model = MLPClassifierModel()
         update_existing_params(model, self)
         model.params = [
-            tuple(np.asarray(jax.device_get(a)) for a in layer) for layer in outputs[0]
+            tuple(np.asarray(jax.device_get(a)) for a in layer) for layer in final_params
         ]
         model.labels = labels.astype(np.float64)
         return model

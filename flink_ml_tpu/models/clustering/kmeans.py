@@ -28,12 +28,7 @@ import numpy as np
 
 from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.types import DataTypes
-from flink_ml_tpu.iteration import (
-    DeviceDataCache,
-    IterationBodyResult,
-    TerminateOnMaxIter,
-    iterate_bounded_until_termination,
-)
+from flink_ml_tpu.iteration import DeviceDataCache
 from flink_ml_tpu.models.common import ModelArraysMixin
 from flink_ml_tpu.ops.distance import DistanceMeasure
 from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam, WithParams, update_existing_params
@@ -61,21 +56,44 @@ class HasK(WithParams):
         return self.set(self.K, value)
 
 
+def _epoch_update(measure, k: int, centroids, X, mask):
+    """One KMeans epoch: assign + one-hot matmul partial sums + centroid update.
+    Shared by the single-step program (multi-chip dryrun) and the fused loop."""
+    assign = measure.find_closest(X, centroids)
+    hot = jax.nn.one_hot(assign, k, dtype=X.dtype) * mask[:, None]
+    sums = hot.T @ X  # [k, d]; cross-shard reduce inserted by XLA
+    counts = jnp.sum(hot, axis=0)  # [k]
+    safe = jnp.maximum(counts, 1.0)[:, None]
+    new_centroids = jnp.where(counts[:, None] > 0, sums / safe, centroids)
+    return new_centroids, counts
+
+
 @functools.cache
 def _train_step(measure_name: str, k: int):
     measure = DistanceMeasure.get_instance(measure_name)
+    return jax.jit(lambda centroids, X, mask: _epoch_update(measure, k, centroids, X, mask))
+
+
+@functools.cache
+def _train_loop(measure_name: str, k: int, n_epochs: int):
+    """All ``n_epochs`` epochs fused into ONE XLA program via ``lax.scan``.
+
+    KMeans' only criteria is maxIter (TerminateOnMaxIter — a pure epoch count), so
+    nothing needs the host between epochs: one dispatch per fit instead of one per
+    epoch, which removes the host dispatch latency that dominated small steps."""
+    measure = DistanceMeasure.get_instance(measure_name)
 
     @jax.jit
-    def step(centroids, X, mask):
-        assign = measure.find_closest(X, centroids)
-        hot = jax.nn.one_hot(assign, k, dtype=X.dtype) * mask[:, None]
-        sums = hot.T @ X  # [k, d]; cross-shard reduce inserted by XLA
-        counts = jnp.sum(hot, axis=0)  # [k]
-        safe = jnp.maximum(counts, 1.0)[:, None]
-        new_centroids = jnp.where(counts[:, None] > 0, sums / safe, centroids)
-        return new_centroids, counts
+    def loop(centroids, X, mask):
+        def epoch(carry, _):
+            c, _counts = carry
+            return _epoch_update(measure, k, c, X, mask), None
 
-    return step
+        init = (centroids, jnp.zeros((k,), X.dtype))
+        (c, counts), _ = jax.lax.scan(epoch, init, None, length=n_epochs)
+        return c, counts
+
+    return loop
 
 
 @functools.cache
@@ -137,22 +155,11 @@ class KMeans(
 
         ctx = get_mesh_context()
         cache = DeviceDataCache({"x": X}, ctx=ctx)
-        step = _train_step(self.get_distance_measure(), k)
-        criteria = TerminateOnMaxIter(self.get_max_iter())
-
-        def body(variables, epoch):
-            centroids, _ = variables
-            new_centroids, counts = step(centroids, cache["x"], cache.mask)
-            return IterationBodyResult(
-                [new_centroids, counts],
-                outputs=[(new_centroids, counts)],
-                termination_criteria=criteria(epoch),
-            )
-
-        outputs = iterate_bounded_until_termination(
-            [ctx.replicate(init), ctx.replicate(np.zeros(k, np.float32))], body
-        )
-        centroids, counts = outputs[0]
+        # TerminateOnMaxIter is a pure epoch count, so the whole loop fuses into
+        # one scan program — the host-loop driver (iterate_bounded_until_termination)
+        # is only needed when a criteria requires a host scalar between epochs.
+        loop = _train_loop(self.get_distance_measure(), k, self.get_max_iter())
+        centroids, counts = loop(ctx.replicate(init), cache["x"], cache.mask)
         model = KMeansModel()
         update_existing_params(model, self)
         model.centroids = np.asarray(jax.device_get(centroids), np.float64)

@@ -15,9 +15,16 @@ mesh axis (DeviceDataCache); one epoch is ONE jit'd SPMD step — minibatch gath
 two-matmul loss/grad, a single ``lax.psum`` replacing the reference's 3-stage
 AllReduce, and the model update computed redundantly (and identically) on every
 device. The feedback edge is the (coef, offset) device arrays handed to the next
-epoch; nothing leaves HBM during training. The loss scalar is only fetched to the
-host when ``tol`` is finite (the criteria check), so the maxIter-only path runs
-fully pipelined.
+epoch; nothing leaves HBM during training.
+
+Whole-run fusion: when no checkpointing or listeners are attached, ALL epochs run
+inside one XLA program — ``lax.scan`` over epochs for the maxIter-only path, and
+``lax.while_loop`` with the tol criteria evaluated *on device* otherwise (the psum'd
+loss is replicated across shards, so every device takes the same branch — the
+single-controller analogue of SharedProgressAligner deciding termination). One
+dispatch per fit instead of one per epoch removes the host dispatch overhead that
+dominates small steps. The host loop remains for checkpoint/listener runs, where
+the driver must observe state between epochs.
 
 Deviations from the reference, deliberate:
   - regularization *loss* terms use the standard elastic-net form (L1 = reg·Σ|c|);
@@ -81,6 +88,126 @@ class Optimizer:
         raise NotImplementedError
 
 
+def _sgd_epoch_math(coef, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype):
+    """One epoch of the per-shard SGD update (shared by the host-loop step and the
+    fused whole-run programs). Returns (new_coef, next_offset, mean_loss)."""
+    m = X.shape[0]
+    # Next local_batch rows of this shard's cache, clipped at the cache end
+    # (reference takes a short batch at the tail, then wraps: SGD.java:265-268).
+    idx = offset + jnp.arange(local_batch)
+    in_range = (idx < m).astype(dtype)
+    idx = jnp.minimum(idx, m - 1)
+    Xb = X[idx]
+    yb = y[idx]
+    wb = w[idx] * mask[idx] * in_range
+    loss_sum, grad_sum = loss_func.loss_and_grad_sum(coef, Xb, yb, wb)
+    packed = jnp.concatenate(
+        [grad_sum, jnp.stack([jnp.sum(wb), loss_sum]).astype(grad_sum.dtype)]
+    )
+    packed = jax.lax.psum(packed, DATA_AXIS)  # the whole AllReduceImpl
+    grad, weight_sum, loss_sum = packed[:-2], packed[-2], packed[-1]
+    safe_w = jnp.maximum(weight_sum, 1e-30)
+    new_coef = jnp.where(weight_sum > 0, coef - (lr / safe_w) * grad, coef)
+    new_coef, _reg_loss = regularize(new_coef, reg, elastic_net, lr)
+    # Criteria uses the un-regularized batch loss mean, like the reference's
+    # loss/totalWeight map over the feedback stream (SGD.java:137-143).
+    mean_loss = jnp.where(weight_sum > 0, loss_sum / safe_w, jnp.inf)
+    next_offset = jnp.where(offset + local_batch >= m, 0, offset + local_batch)
+    return new_coef, next_offset, mean_loss
+
+
+_FUSED_CACHE: Dict[tuple, object] = {}
+
+
+def _fused_sgd_program(
+    ctx: MeshContext,
+    loss_func: LossFunc,
+    local_batch: int,
+    max_iter: int,
+    lr: float,
+    reg: float,
+    elastic_net: float,
+    tol: Optional[float],
+    dtype,
+):
+    """Whole-run SGD as ONE jit'd SPMD program.
+
+    ``tol is None`` → ``lax.scan`` over exactly ``max_iter`` epochs.
+    ``tol`` set → ``lax.while_loop``; the continue predicate replays
+    ``TerminateOnMaxIterOrTol`` on device: after epoch e, continue iff
+    e+1 < max_iter and loss_e >= tol.
+
+    Returns a callable ``(coef, offset, X, y, w, mask) -> (coef, losses, n_epochs)``
+    with ``losses`` a [max_iter] buffer (entries past ``n_epochs`` are +inf).
+    Programs are cached per (mesh, loss type, shapes, hyperparameters) so repeated
+    fits skip retracing.
+    """
+    key = (
+        ctx.mesh,
+        loss_func,  # the instance: custom losses may carry parameters (e.g. Huber delta)
+        local_batch,
+        max_iter,
+        lr,
+        reg,
+        elastic_net,
+        tol,
+        jnp.dtype(dtype).name,
+    )
+    cached = _FUSED_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    def epoch(coef, offset, X, y, w, mask):
+        return _sgd_epoch_math(
+            coef, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
+        )
+
+    if tol is None:
+
+        def per_shard(coef, offset, X, y, w, mask):
+            def body(carry, _):
+                c, o = carry
+                new_c, new_o, mean_loss = epoch(c, o, X, y, w, mask)
+                return (new_c, new_o), mean_loss
+
+            (coef, offset), losses = jax.lax.scan(body, (coef, offset), None, length=max_iter)
+            return coef, losses, jnp.asarray(max_iter, jnp.int32)
+
+    else:
+
+        def per_shard(coef, offset, X, y, w, mask):
+            losses0 = jnp.full((max_iter,), jnp.inf, dtype)
+
+            def cond(carry):
+                n, _c, _o, _losses, last = carry
+                # ~(last < tol), NOT (last >= tol): the two differ on NaN, and the
+                # host criteria (TerminateOnMaxIterOrTol: stop iff loss < tol)
+                # continues on NaN — the fused path must take the same branch.
+                return (n < max_iter) & ((n == 0) | ~(last < tol))
+
+            def body(carry):
+                n, c, o, losses, _last = carry
+                new_c, new_o, mean_loss = epoch(c, o, X, y, w, mask)
+                return n + 1, new_c, new_o, losses.at[n].set(mean_loss), mean_loss
+
+            n, coef, _offset, losses, _ = jax.lax.while_loop(
+                cond, body, (jnp.asarray(0, jnp.int32), coef, offset, losses0, jnp.asarray(jnp.inf, dtype))
+            )
+            return coef, losses, n
+
+    program = jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=ctx.mesh,
+            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P(), P()),
+        ),
+        donate_argnums=(0,),
+    )
+    _FUSED_CACHE[key] = program
+    return program
+
+
 class SGD(Optimizer):
     """Distributed minibatch SGD over the data-parallel mesh."""
 
@@ -118,29 +245,9 @@ class SGD(Optimizer):
         dtype = self.dtype
 
         def per_shard(coef, offset, X, y, w, mask):
-            m = X.shape[0]
-            # Next local_batch rows of this shard's cache, clipped at the cache end
-            # (reference takes a short batch at the tail, then wraps: SGD.java:265-268).
-            idx = offset + jnp.arange(local_batch)
-            in_range = (idx < m).astype(dtype)
-            idx = jnp.minimum(idx, m - 1)
-            Xb = X[idx]
-            yb = y[idx]
-            wb = w[idx] * mask[idx] * in_range
-            loss_sum, grad_sum = loss_func.loss_and_grad_sum(coef, Xb, yb, wb)
-            packed = jnp.concatenate(
-                [grad_sum, jnp.stack([jnp.sum(wb), loss_sum]).astype(grad_sum.dtype)]
+            return _sgd_epoch_math(
+                coef, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
             )
-            packed = jax.lax.psum(packed, DATA_AXIS)  # the whole AllReduceImpl
-            grad, weight_sum, loss_sum = packed[:-2], packed[-2], packed[-1]
-            safe_w = jnp.maximum(weight_sum, 1e-30)
-            new_coef = jnp.where(weight_sum > 0, coef - (lr / safe_w) * grad, coef)
-            new_coef, _reg_loss = regularize(new_coef, reg, elastic_net, lr)
-            # Criteria uses the un-regularized batch loss mean, like the reference's
-            # loss/totalWeight map over the feedback stream (SGD.java:137-143).
-            mean_loss = jnp.where(weight_sum > 0, loss_sum / safe_w, jnp.inf)
-            next_offset = jnp.where(offset + local_batch >= m, 0, offset + local_batch)
-            return new_coef, next_offset, mean_loss
 
         return jax.jit(
             jax.shard_map(
@@ -178,6 +285,35 @@ class SGD(Optimizer):
 
         local_batch = -(-self.global_batch_size // ctx.n_data)  # ceil
         local_batch = min(local_batch, train_data.local_rows)
+        check_loss = np.isfinite(self.tol) and self.tol > 0
+
+        fused = (
+            self.checkpoint_manager is None
+            and not self.checkpoint_interval
+            and not self.listeners
+        )
+        if fused:
+            program = _fused_sgd_program(
+                ctx,
+                loss_func,
+                local_batch,
+                self.max_iter,
+                self.learning_rate,
+                self.reg,
+                self.elastic_net,
+                self.tol if check_loss else None,
+                self.dtype,
+            )
+            coef = ctx.replicate(np.asarray(init_model, self.dtype))
+            offset = ctx.replicate(np.asarray(0, np.int32))
+            final_coef, losses, n_epochs = program(coef, offset, X, y, w, mask)
+            if check_loss:
+                losses = np.asarray(jax.device_get(losses), np.float64)
+                self.loss_history = [float(x) for x in losses[: int(jax.device_get(n_epochs))]]
+            else:
+                self.loss_history = []
+            return np.asarray(jax.device_get(final_coef))
+
         step = self._build_step(ctx, loss_func, local_batch)
 
         if self.checkpoint_manager is not None:
@@ -207,7 +343,6 @@ class SGD(Optimizer):
         coef = ctx.replicate(np.asarray(init_model, self.dtype))
         offset = ctx.replicate(np.asarray(0, np.int32))
         criteria = TerminateOnMaxIterOrTol(self.max_iter, self.tol)
-        check_loss = np.isfinite(self.tol) and self.tol > 0
         self.loss_history = []
 
         def body(variables, epoch):

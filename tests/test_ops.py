@@ -192,3 +192,27 @@ def test_get_instance_dispatch_and_error():
     assert isinstance(DistanceMeasure.get_instance("cosine"), CosineDistance)
     with pytest.raises(ValueError, match="not recognized"):
         DistanceMeasure.get_instance("chebyshev")
+
+
+def test_sgd_fused_matches_host_loop():
+    # The fused whole-run program (scan/while_loop) must produce exactly the same
+    # trajectory as the per-epoch host loop (forced here via a no-op listener).
+    from flink_ml_tpu.iteration import IterationListener
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(96, 5)).astype(np.float32)
+    y = (rng.random(96) > 0.5).astype(np.float32)
+    data = {"features": X, "labels": y}
+
+    for tol in (0.0, 1e-3):
+        fused = SGD(max_iter=25, global_batch_size=32, tol=tol, reg=0.05, elastic_net=0.3)
+        coef_fused = fused.optimize(np.zeros(5), data, BinaryLogisticLoss.INSTANCE)
+        host = SGD(
+            max_iter=25, global_batch_size=32, tol=tol, reg=0.05, elastic_net=0.3,
+            listeners=[IterationListener()],
+        )
+        coef_host = host.optimize(np.zeros(5), data, BinaryLogisticLoss.INSTANCE)
+        np.testing.assert_allclose(coef_fused, coef_host, rtol=1e-6)
+        if tol > 0:
+            assert len(fused.loss_history) == len(host.loss_history)
+            np.testing.assert_allclose(fused.loss_history, host.loss_history, rtol=1e-5)
