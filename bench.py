@@ -1,16 +1,35 @@
-"""Benchmark entry point — prints ONE JSON line.
+"""Benchmark entry point — prints ONE JSON line (headline) and writes
+``BENCH_DETAIL.json`` with the full suite.
 
-Workload: the reference's flagship benchmark config (`flink-ml-benchmark/src/main/
-resources/benchmark-demo.json` "KMeans-1"): KMeans.fit on 10,000 random dense vectors
-of dim 10 with default params (k=2, maxIter=20, euclidean). The reference's
-illustrative output for this exact config is totalTimeMs=7148 → inputThroughput
-≈ 1399 rows/s on a local CPU Flink cluster (flink-ml-benchmark/README.md:86-113);
-that is the ``vs_baseline`` denominator.
+Headline: the BASELINE.json north-star — LogisticRegression steady-state
+training throughput (rows consumed by the fused SGD loop per second once the
+dataset is HBM-resident) vs a same-semantics single-host CPU numpy baseline
+measured in-process (the stand-in for the reference's CPU-TaskManager
+cluster; the reference publishes no absolute LR numbers, BASELINE.md).
 
-Methodology: one warm-up fit triggers XLA compilation (the analogue of the reference
-paying JVM/job-graph startup inside netRuntime would unfairly charge one-time
-compilation to a steady-state metric); the reported number is the median of 3 timed
-fits, full pipeline included (host data → device → train → model data back to host).
+Suite (all on the real chip, reference harness semantics — wall-clock
+throughput like ``BenchmarkUtils.java:132-143``):
+
+- ``logreg``: a Criteo-class dense shape (250k x 256 f32) resident in HBM
+  (DeviceDataCache), SGD driven directly. Steady-state rows/s comes from
+  differencing two iteration counts — (t(I2) - t(I1)) / (I2 - I1) isolates
+  the per-step cost, exactly how per-row cost amortizes over a 1B-row
+  stream. One end-to-end Estimator.fit (including ingest) is also recorded.
+  The CPU baseline is measured the same marginal way (data already in RAM).
+- ``kmeans``: the reference demo config at 10x shape (100k x 10, k=2;
+  ``benchmark-demo.json`` KMeans-1 is 10k). Per-iteration time via the same
+  differencing; ``vs_reference_cpu`` anchors end-to-end rows/s against the
+  reference's illustrative 1,399 rows/s CPU output for the 10k config
+  (flink-ml-benchmark/README.md:86-113) — the only reference-anchored number
+  that exists.
+- ``mlp``: MXU-bound MLP forward inference at serving shapes (batch 4096,
+  256-512-512-8, bf16), timed with pipelined dispatch (issue all steps, block
+  once) so the tunnel's completion latency is amortized as it would be in a
+  serving loop.
+
+Methodology: every workload warms up once so XLA compilation (the analogue
+of the reference's one-time JVM/job-graph startup) never lands in a
+steady-state metric; timed numbers are medians of 3 runs.
 """
 import json
 import sys
@@ -18,33 +37,190 @@ import time
 
 import numpy as np
 
+_PEAK_FLOPS = {
+    # bf16 dense peak per chip; used for MFU. f32 workloads are reported
+    # against the same number (conservative).
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+}
 
-def main() -> None:
+
+def _median_time(fn, repeats=3):
+    fn()  # warm-up: XLA compile
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def bench_logreg(peak_flops):
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.iteration import DeviceDataCache
+    from flink_ml_tpu.models.classification.logistic_regression import LogisticRegression
+    from flink_ml_tpu.ops import SGD, BinaryLogisticLoss
+    from flink_ml_tpu.parallel.mesh import get_mesh_context
+
+    n, d = 250_000, 256
+    batch = 65_536
+    i1, i2 = 100, 2100
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal(size=(n, d), dtype=np.float32)
+    w_true = rng.standard_normal(size=d, dtype=np.float32)
+    y = (X @ w_true + 0.5 * rng.standard_normal(size=n, dtype=np.float32) > 0).astype(
+        np.float32
+    )
+
+    # Steady state: dataset resident in HBM (DeviceDataCache), optimizer driven
+    # directly; differencing two iteration counts isolates the per-step cost.
+    ctx = get_mesh_context()
+    cache = DeviceDataCache(
+        {"features": X, "labels": y, "weights": np.ones(n, np.float32)}, ctx=ctx
+    )
+
+    def steps(iters):
+        SGD(max_iter=iters, global_batch_size=batch, tol=0.0).optimize(
+            np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
+        )
+
+    t1 = _median_time(lambda: steps(i1))
+    t2 = _median_time(lambda: steps(i2))
+    step_s = max((t2 - t1) / (i2 - i1), 1e-9)
+    flops_per_step = 4.0 * batch * d  # fwd X@coef (2BD) + grad X.T@mult (2BD)
+
+    # End-to-end: one Estimator.fit including host->device ingest. On this
+    # dev box the TPU sits behind a network tunnel (~25 MB/s for random data),
+    # so ingest dominates; recorded for honesty, not used as the headline.
+    df = DataFrame.from_dict({"features": X, "label": y.astype(np.float64)})
+    t0 = time.perf_counter()
+    LogisticRegression().set_max_iter(i1).set_global_batch_size(batch).set_tol(0.0).fit(df)
+    e2e = time.perf_counter() - t0
+
+    out = {
+        "name": "logreg_fit_250k_d256_b65536",
+        "steady_rows_per_sec": round(batch / step_s, 1),
+        "step_time_us": round(step_s * 1e6, 1),
+        "achieved_gflops": round(flops_per_step / step_s / 1e9, 1),
+        "e2e_fit_time_s_100_iters": round(e2e, 3),
+        "e2e_note": "includes host->device ingest over the dev tunnel (~25 MB/s)",
+    }
+    if peak_flops:
+        out["mfu"] = round(flops_per_step / step_s / peak_flops, 6)
+    return out, (X, y)
+
+
+def bench_logreg_cpu_baseline(X, y, batch=65_536, step_cap=30):
+    """Same minibatch-SGD semantics in numpy on the host CPU (the stand-in for
+    the reference's CPU TaskManager), measured marginally like the TPU number
+    (the same dataset, already resident in RAM)."""
+    n, d = X.shape
+    coef = np.zeros(d, np.float32)
+    offset = 0
+
+    def steps(k):
+        nonlocal coef, offset
+        for _ in range(k):
+            Xb, yb = X[offset : offset + batch], y[offset : offset + batch]
+            ys = 2.0 * yb - 1.0
+            z = (Xb @ coef) * ys
+            mult = -ys / (1.0 + np.exp(z))
+            grad = Xb.T @ mult
+            coef = coef - 0.1 / len(Xb) * grad
+            offset = 0 if offset + batch >= n else offset + batch
+
+    steps(3)  # warm caches
+    t0 = time.perf_counter()
+    steps(step_cap)
+    return step_cap * batch / (time.perf_counter() - t0)
+
+
+def bench_kmeans():
     from flink_ml_tpu.api.dataframe import DataFrame
     from flink_ml_tpu.models.clustering.kmeans import KMeans
 
-    num_rows, dim = 10_000, 10
+    num_rows, dim = 100_000, 10
+    i1, i2 = 20, 1020
     rng = np.random.default_rng(2)
     df = DataFrame.from_dict({"features": rng.random((num_rows, dim))})
 
-    def run():
-        t0 = time.perf_counter()
-        KMeans().set_seed(2).fit(df)
-        return time.perf_counter() - t0
+    def fit(iters):
+        KMeans().set_seed(2).set_max_iter(iters).fit(df)
 
-    run()  # warm-up: XLA compile
-    times = sorted(run() for _ in range(3))
-    elapsed = times[1]
-    rows_per_sec = num_rows / elapsed
+    t1 = _median_time(lambda: fit(i1))
+    t2 = _median_time(lambda: fit(i2))
+    iter_s = max((t2 - t1) / (i2 - i1), 1e-9)
+    return {
+        "name": "kmeans_fit_100k_d10_k2",
+        "iter_time_us": round(iter_s * 1e6, 1),
+        "e2e_rows_per_sec_20_iters": round(num_rows / t1, 1),
+        "fit_time_s_20_iters": round(t1, 3),
+        # reference illustrative CPU output for the 10k config (rows/s)
+        "reference_cpu_rows_per_sec": 1399.0,
+        "vs_reference_cpu": round(num_rows / t1 / 1399.0, 2),
+    }
 
-    baseline = 1399.0  # rows/s, reference KMeans-1 demo output
+
+def bench_mlp_forward(peak_flops):
+    import jax
+    import jax.numpy as jnp
+
+    import __graft_entry__
+
+    fn, (params, X) = __graft_entry__.entry()
+    params = [(jnp.asarray(W, jnp.bfloat16), jnp.asarray(b, jnp.bfloat16)) for W, b in params]
+    X = jnp.asarray(X, jnp.bfloat16)
+    step = jax.jit(fn)
+
+    jax.block_until_ready(step(params, X))
+    reps = 100
+    t0 = time.perf_counter()
+    outs = [step(params, X) for _ in range(reps)]  # pipelined async dispatch
+    np.asarray(outs[-1][0])  # forces the whole dependency chain to finish
+    elapsed = (time.perf_counter() - t0) / reps
+    batch = X.shape[0]
+    flops = 2.0 * batch * sum(int(W.shape[0]) * int(W.shape[1]) for W, _ in params)
+    achieved = flops / elapsed
+    return {
+        "name": "mlp_forward_bf16_b4096_256_512_512_8",
+        "rows_per_sec": round(batch / elapsed, 1),
+        "step_time_us": round(elapsed * 1e6, 1),
+        "achieved_gflops": round(achieved / 1e9, 1),
+        "mfu": round(achieved / peak_flops, 4) if peak_flops else None,
+    }
+
+
+def main() -> None:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    peak = _PEAK_FLOPS.get(kind)
+
+    logreg, (X, y) = bench_logreg(peak)
+    cpu_rows = bench_logreg_cpu_baseline(X, y)
+    logreg["cpu_baseline_rows_per_sec"] = round(cpu_rows, 1)
+    logreg["vs_cpu_baseline"] = round(logreg["steady_rows_per_sec"] / cpu_rows, 2)
+    kmeans = bench_kmeans()
+    mlp = bench_mlp_forward(peak)
+
+    detail = {
+        "device_kind": kind,
+        "peak_bf16_flops": peak,
+        "workloads": [logreg, kmeans, mlp],
+    }
+    with open("BENCH_DETAIL.json", "w") as f:
+        json.dump(detail, f, indent=2)
+
     print(
         json.dumps(
             {
-                "metric": "kmeans_fit_throughput_10k_d10_k2",
-                "value": round(rows_per_sec, 1),
+                "metric": "logreg_steady_train_rows_per_sec_d256",
+                "value": logreg["steady_rows_per_sec"],
                 "unit": "rows/s",
-                "vs_baseline": round(rows_per_sec / baseline, 2),
+                "vs_baseline": logreg["vs_cpu_baseline"],
+                "detail": detail,
             }
         )
     )

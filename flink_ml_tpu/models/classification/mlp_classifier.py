@@ -27,6 +27,7 @@ from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.iteration import DeviceDataCache
 from flink_ml_tpu.models.common import extract_labeled_data
+from flink_ml_tpu.ops.optimizer import offset_schedule
 from flink_ml_tpu.params.param import IntArrayParam, ParamValidators, update_existing_params
 from flink_ml_tpu.params.shared import (
     HasFeaturesCol,
@@ -165,81 +166,72 @@ _MLP_FUSED_CACHE: dict = {}
 class MLPClassifier(Estimator, _MlpParams):
     """Data-parallel minibatch adam training of the MLP over the mesh."""
 
-    def _build_fused(self, ctx: MeshContext, optimizer, local_batch: int, n_epochs: int, tol):
-        """Whole-run training as ONE program: ``lax.scan`` over epochs when the
-        criteria is maxIter only, ``lax.while_loop`` with the on-device tol check
-        otherwise (the psum'd loss is replicated, so every shard branches alike).
+    def _build_fused(
+        self, ctx: MeshContext, optimizer, local_batch: int, chunk_len: int, tol
+    ):
+        """A chunk of ``chunk_len`` training epochs as ONE program: ``lax.scan``
+        over a per-epoch (start, offset, active) schedule passed as *arguments*
+        (see ``ops.optimizer.offset_schedule`` — a slice start carried through
+        the loop makes XLA's loop optimizer blow up at compile time), with a
+        carried ``done`` flag replaying the tol criteria on device. The host
+        observes ``done`` between chunks, so early convergence wastes at most
+        chunk_len - 1 epochs.
 
-        Programs are cached per (mesh, learning rate, batch, epochs, tol);
+        Programs are cached per (mesh, learning rate, batch, chunk, tol);
         jit re-specializes per parameter/data shapes on its own, so layer dims
         need not be part of the key."""
-        key = (ctx.mesh, self.get_learning_rate(), local_batch, n_epochs, tol)
+        key = (ctx.mesh, self.get_learning_rate(), local_batch, chunk_len, tol)
         cached = _MLP_FUSED_CACHE.get(key)
         if cached is not None:
             return cached
         epoch = self._epoch_math(optimizer, local_batch)
 
-        if tol is None:
-
-            def per_shard(params, opt_state, offset, X, y, w):
-                def body(carry, _):
-                    p, s, o = carry
-                    p, s, o, mean_loss = epoch(p, s, o, X, y, w)
-                    return (p, s, o), mean_loss
-
-                (params, opt_state, offset), _ = jax.lax.scan(
-                    body, (params, opt_state, offset), None, length=n_epochs
+        def per_shard(params, opt_state, done, starts, offsets, active, X, y, w):
+            def body(carry, schedule):
+                p, s, done = carry
+                start, offset, act = schedule
+                new_p, new_s, mean_loss = epoch(p, s, start, offset, X, y, w)
+                executed = ~done & act
+                keep = lambda old, new: jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(executed, b, a), old, new
                 )
-                return params, opt_state, offset, jnp.asarray(0.0, jnp.float32)
+                if tol is not None:
+                    # stop iff loss < tol (NaN continues, like the host criteria)
+                    done = done | (executed & (mean_loss < tol))
+                return (keep(p, new_p), keep(s, new_s), done), executed
 
-        else:
-
-            def per_shard(params, opt_state, offset, X, y, w):
-                def cond(carry):
-                    n, _p, _s, _o, last = carry
-                    # ~(last < tol), not (last >= tol): continue on NaN like the
-                    # host criteria (TerminateOnMaxIterOrTol stops iff loss < tol).
-                    return (n < n_epochs) & ((n == 0) | ~(last < tol))
-
-                def body(carry):
-                    n, p, s, o, _last = carry
-                    p, s, o, mean_loss = epoch(p, s, o, X, y, w)
-                    return n + 1, p, s, o, mean_loss
-
-                _n, params, opt_state, offset, last = jax.lax.while_loop(
-                    cond,
-                    body,
-                    (
-                        jnp.asarray(0, jnp.int32),
-                        params,
-                        opt_state,
-                        offset,
-                        jnp.asarray(jnp.inf, jnp.float32),
-                    ),
-                )
-                return params, opt_state, offset, last
+            (params, opt_state, done), executed = jax.lax.scan(
+                body, (params, opt_state, done), (starts, offsets, active)
+            )
+            return params, opt_state, done, jnp.sum(executed.astype(jnp.int32))
 
         program = jax.jit(
             jax.shard_map(
                 per_shard,
                 mesh=ctx.mesh,
-                in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                in_specs=(
+                    P(), P(), P(), P(), P(), P(),
+                    P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                ),
                 out_specs=(P(), P(), P(), P()),
             ),
-            donate_argnums=(0, 1),
+            donate_argnums=(0, 1, 2),
         )
+        if len(_MLP_FUSED_CACHE) >= 32:
+            _MLP_FUSED_CACHE.pop(next(iter(_MLP_FUSED_CACHE)))
         _MLP_FUSED_CACHE[key] = program
         return program
 
     @staticmethod
     def _epoch_math(optimizer, local_batch: int):
-        def per_shard(params, opt_state, offset, X, y, w):
-            m = X.shape[0]
-            idx = offset + jnp.arange(local_batch)
-            in_range = (idx < m).astype(jnp.float32)
-            idx = jnp.minimum(idx, m - 1)
-            Xb, yb = X[idx], y[idx]
-            wb = w[idx] * in_range
+        def per_shard(params, opt_state, start, offset, X, y, w):
+            # Contiguous minibatch window via dynamic_slice (cheap on TPU) with the
+            # clamped tail zero-weighted — same scheme as _sgd_epoch_math; start
+            # and offset arrive from the precomputed schedule.
+            Xb = jax.lax.dynamic_slice_in_dim(X, start, local_batch)
+            yb = jax.lax.dynamic_slice_in_dim(y, start, local_batch)
+            tail_valid = (start + jnp.arange(local_batch) >= offset).astype(jnp.float32)
+            wb = jax.lax.dynamic_slice_in_dim(w, start, local_batch) * tail_valid
 
             def loss_sum(p):
                 logits = _forward(p, Xb)
@@ -259,8 +251,7 @@ class MLPClassifier(Estimator, _MlpParams):
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             mean_loss = loss_sum_v / safe_w
-            next_offset = jnp.where(offset + local_batch >= m, 0, offset + local_batch)
-            return params, opt_state, next_offset, mean_loss
+            return params, opt_state, mean_loss
 
         return per_shard
 
@@ -290,21 +281,32 @@ class MLPClassifier(Estimator, _MlpParams):
         # Whole-run fusion: no checkpoint/listener hooks on MLP fit, so all epochs
         # always run inside one XLA program (scan for maxIter-only, while_loop for
         # the tol criteria evaluated on device).
+        max_iter = self.get_max_iter()
+        chunk = min(max_iter, 64) if check_loss else max_iter
         fused = self._build_fused(
             ctx,
             optimizer,
             local_batch,
-            self.get_max_iter(),
+            chunk,
             self.get_tol() if check_loss else None,
         )
-        final_params, _opt_state, _offset, _loss = fused(
-            params,
-            opt_state,
-            ctx.replicate(np.asarray(0, np.int32)),
-            cache["x"],
-            cache["y"],
-            cache["w"] * mask,
-        )
+        starts, offsets = offset_schedule(cache.local_rows, local_batch, max_iter)
+        done = ctx.replicate(np.asarray(False))
+        opt_params, opt_st = params, opt_state
+        w_col = cache["w"] * mask
+        for c0 in range(0, max_iter, chunk):
+            pad = max(0, c0 + chunk - max_iter)
+            sl = slice(c0, c0 + chunk - pad)
+            starts_c = np.concatenate([starts[sl], np.zeros(pad, np.int32)])
+            offsets_c = np.concatenate([offsets[sl], np.zeros(pad, np.int32)])
+            active_c = np.concatenate([np.ones(chunk - pad, bool), np.zeros(pad, bool)])
+            opt_params, opt_st, done, n_exec = fused(
+                opt_params, opt_st, done, starts_c, offsets_c, active_c,
+                cache["x"], cache["y"], w_col,
+            )
+            if check_loss and int(jax.device_get(n_exec)) < chunk - pad:
+                break  # done flipped mid-chunk
+        final_params = opt_params
         model = MLPClassifierModel()
         update_existing_params(model, self)
         model.params = [

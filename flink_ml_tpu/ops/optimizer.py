@@ -17,14 +17,16 @@ AllReduce, and the model update computed redundantly (and identically) on every
 device. The feedback edge is the (coef, offset) device arrays handed to the next
 epoch; nothing leaves HBM during training.
 
-Whole-run fusion: when no checkpointing or listeners are attached, ALL epochs run
-inside one XLA program — ``lax.scan`` over epochs for the maxIter-only path, and
-``lax.while_loop`` with the tol criteria evaluated *on device* otherwise (the psum'd
-loss is replicated across shards, so every device takes the same branch — the
-single-controller analogue of SharedProgressAligner deciding termination). One
-dispatch per fit instead of one per epoch removes the host dispatch overhead that
-dominates small steps. The host loop remains for checkpoint/listener runs, where
-the driver must observe state between epochs.
+Whole-run fusion: when no checkpointing or listeners are attached, epochs run in
+fused chunks — ``lax.scan`` over a host-precomputed minibatch schedule, ONE
+full-length chunk for the maxIter-only path (zero host syncs), and
+_TOL_CHUNK-epoch chunks when a tol criteria is active, with the criteria replayed
+*on device* via a carried ``done`` flag (the psum'd loss is replicated across
+shards, so every device takes the same branch — the single-controller analogue of
+SharedProgressAligner deciding termination) and observed on the host between
+chunks. One dispatch per chunk instead of one per epoch removes the host dispatch
+overhead that dominates small steps. The host loop remains for
+checkpoint/listener runs, where the driver must observe state between epochs.
 
 Deviations from the reference, deliberate:
   - regularization *loss* terms use the standard elastic-net form (L1 = reg·Σ|c|);
@@ -88,18 +90,27 @@ class Optimizer:
         raise NotImplementedError
 
 
-def _sgd_epoch_math(coef, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype):
+def _sgd_epoch_math(
+    coef, start, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
+):
     """One epoch of the per-shard SGD update (shared by the host-loop step and the
-    fused whole-run programs). Returns (new_coef, next_offset, mean_loss)."""
-    m = X.shape[0]
-    # Next local_batch rows of this shard's cache, clipped at the cache end
-    # (reference takes a short batch at the tail, then wraps: SGD.java:265-268).
-    idx = offset + jnp.arange(local_batch)
-    in_range = (idx < m).astype(dtype)
-    idx = jnp.minimum(idx, m - 1)
-    Xb = X[idx]
-    yb = y[idx]
-    wb = w[idx] * mask[idx] * in_range
+    fused whole-run program). ``start`` is the clamped slice start and ``offset``
+    the logical batch offset (start == min(offset, m - local_batch)); both are
+    supplied by the caller so the fused path can feed a *precomputed* schedule.
+    Returns (new_coef, mean_loss)."""
+    # The minibatch is a *contiguous* window, so a dynamic_slice (cheap on TPU)
+    # instead of a row gather (slow scatter/gather path). At the cache tail the
+    # slice start clamps to m - local_batch; rows before ``offset`` in the clamped
+    # window are re-reads and get zero weight, reproducing the reference's short
+    # tail batch (SGD.java:265-268) exactly.
+    Xb = jax.lax.dynamic_slice_in_dim(X, start, local_batch)
+    yb = jax.lax.dynamic_slice_in_dim(y, start, local_batch)
+    tail_valid = (start + jnp.arange(local_batch) >= offset).astype(dtype)
+    wb = (
+        jax.lax.dynamic_slice_in_dim(w, start, local_batch)
+        * jax.lax.dynamic_slice_in_dim(mask, start, local_batch)
+        * tail_valid
+    )
     loss_sum, grad_sum = loss_func.loss_and_grad_sum(coef, Xb, yb, wb)
     packed = jnp.concatenate(
         [grad_sum, jnp.stack([jnp.sum(wb), loss_sum]).astype(grad_sum.dtype)]
@@ -112,41 +123,77 @@ def _sgd_epoch_math(coef, offset, X, y, w, mask, loss_func, local_batch, lr, reg
     # Criteria uses the un-regularized batch loss mean, like the reference's
     # loss/totalWeight map over the feedback stream (SGD.java:137-143).
     mean_loss = jnp.where(weight_sum > 0, loss_sum / safe_w, jnp.inf)
-    next_offset = jnp.where(offset + local_batch >= m, 0, offset + local_batch)
-    return new_coef, next_offset, mean_loss
+    return new_coef, mean_loss
 
+
+def offset_schedule(m: int, local_batch: int, n_epochs: int):
+    """Per-epoch (start, offset) slice schedule for a cache of ``m`` local rows.
+
+    The reference's nextBatchOffset cycling (SGD.java:265-268) is a pure function
+    of the epoch index, so the whole schedule is computed on the host and fed to
+    the fused program as scan ``xs``. This matters for compile time: a slice start
+    carried through the loop (or looked up from a carried counter) makes XLA's
+    loop optimizer blow up — minutes of compile for what executes in milliseconds;
+    starts arriving via scan xs compile in about a second.
+    """
+    starts = np.empty(n_epochs, np.int32)
+    offsets = np.empty(n_epochs, np.int32)
+    off = 0
+    for e in range(n_epochs):
+        offsets[e] = off
+        starts[e] = min(off, m - local_batch)
+        off = 0 if off + local_batch >= m else off + local_batch
+    return starts, offsets
+
+
+_TOL_CHUNK = 64  # epochs per dispatch when a tol criteria is active
 
 _FUSED_CACHE: Dict[tuple, object] = {}
+_FUSED_CACHE_MAX = 32  # FIFO-bounded: hyperparameter sweeps must not leak executables
+
+
+def _cache_put(cache: Dict[tuple, object], key: tuple, value) -> None:
+    if len(cache) >= _FUSED_CACHE_MAX:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
 
 
 def _fused_sgd_program(
     ctx: MeshContext,
     loss_func: LossFunc,
     local_batch: int,
-    max_iter: int,
+    chunk_len: int,
     lr: float,
     reg: float,
     elastic_net: float,
     tol: Optional[float],
     dtype,
 ):
-    """Whole-run SGD as ONE jit'd SPMD program.
+    """A chunk of ``chunk_len`` SGD epochs as ONE jit'd SPMD program.
 
-    ``tol is None`` → ``lax.scan`` over exactly ``max_iter`` epochs.
-    ``tol`` set → ``lax.while_loop``; the continue predicate replays
-    ``TerminateOnMaxIterOrTol`` on device: after epoch e, continue iff
-    e+1 < max_iter and loss_e >= tol.
+    ``lax.scan`` consumes a per-epoch schedule passed as *arguments* —
+    (starts, offsets, active) int/bool[chunk_len] — so one compiled executable
+    serves every chunk of a run (and every run with the same hyperparameters;
+    see ``offset_schedule`` for why the schedule must not be loop-carried).
 
-    Returns a callable ``(coef, offset, X, y, w, mask) -> (coef, losses, n_epochs)``
-    with ``losses`` a [max_iter] buffer (entries past ``n_epochs`` are +inf).
-    Programs are cached per (mesh, loss type, shapes, hyperparameters) so repeated
-    fits skip retracing.
+    The carried ``done`` flag replays ``TerminateOnMaxIterOrTol`` on device:
+    after epoch e, done once loss_e < tol (NaN keeps going, like the host
+    criteria). Once done — or on ``active=False`` padding epochs — updates
+    freeze and the epoch is a no-op, so the caller wastes at most chunk_len - 1
+    epochs before observing ``done`` on the host and stopping. The psum'd loss
+    is replicated across shards, so every device flips ``done`` on the same
+    epoch.
+
+    Returns a callable ``(coef, done, starts, offsets, active, X, y, w, mask)
+    -> (coef, done, losses, n_executed)`` with ``losses`` a [chunk_len] buffer
+    (non-executed entries +inf). Programs are FIFO-cached per (mesh, loss,
+    shapes, hyperparameters) so repeated fits skip retracing.
     """
     key = (
         ctx.mesh,
         loss_func,  # the instance: custom losses may carry parameters (e.g. Huber delta)
         local_batch,
-        max_iter,
+        chunk_len,
         lr,
         reg,
         elastic_net,
@@ -157,54 +204,39 @@ def _fused_sgd_program(
     if cached is not None:
         return cached
 
-    def epoch(coef, offset, X, y, w, mask):
-        return _sgd_epoch_math(
-            coef, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
-        )
-
-    if tol is None:
-
-        def per_shard(coef, offset, X, y, w, mask):
-            def body(carry, _):
-                c, o = carry
-                new_c, new_o, mean_loss = epoch(c, o, X, y, w, mask)
-                return (new_c, new_o), mean_loss
-
-            (coef, offset), losses = jax.lax.scan(body, (coef, offset), None, length=max_iter)
-            return coef, losses, jnp.asarray(max_iter, jnp.int32)
-
-    else:
-
-        def per_shard(coef, offset, X, y, w, mask):
-            losses0 = jnp.full((max_iter,), jnp.inf, dtype)
-
-            def cond(carry):
-                n, _c, _o, _losses, last = carry
-                # ~(last < tol), NOT (last >= tol): the two differ on NaN, and the
-                # host criteria (TerminateOnMaxIterOrTol: stop iff loss < tol)
-                # continues on NaN — the fused path must take the same branch.
-                return (n < max_iter) & ((n == 0) | ~(last < tol))
-
-            def body(carry):
-                n, c, o, losses, _last = carry
-                new_c, new_o, mean_loss = epoch(c, o, X, y, w, mask)
-                return n + 1, new_c, new_o, losses.at[n].set(mean_loss), mean_loss
-
-            n, coef, _offset, losses, _ = jax.lax.while_loop(
-                cond, body, (jnp.asarray(0, jnp.int32), coef, offset, losses0, jnp.asarray(jnp.inf, dtype))
+    def per_shard(coef, done, starts, offsets, active, X, y, w, mask):
+        def body(carry, schedule):
+            c, done = carry
+            start, offset, act = schedule
+            new_c, mean_loss = _sgd_epoch_math(
+                c, start, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
             )
-            return coef, losses, n
+            executed = ~done & act
+            new_c = jnp.where(executed, new_c, c)
+            recorded = jnp.where(executed, mean_loss, jnp.inf)
+            if tol is not None:
+                # stop iff loss < tol (NaN continues, like the host criteria)
+                done = done | (executed & (mean_loss < tol))
+            return (new_c, done), (recorded, executed)
+
+        (coef, done), (losses, executed) = jax.lax.scan(
+            body, (coef, done), (starts, offsets, active)
+        )
+        return coef, done, losses, jnp.sum(executed.astype(jnp.int32))
 
     program = jax.jit(
         jax.shard_map(
             per_shard,
             mesh=ctx.mesh,
-            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(), P(), P()),
+            in_specs=(
+                P(), P(), P(), P(), P(),
+                P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            ),
+            out_specs=(P(), P(), P(), P()),
         ),
-        donate_argnums=(0,),
+        donate_argnums=(0, 1),
     )
-    _FUSED_CACHE[key] = program
+    _cache_put(_FUSED_CACHE, key, program)
     return program
 
 
@@ -245,9 +277,13 @@ class SGD(Optimizer):
         dtype = self.dtype
 
         def per_shard(coef, offset, X, y, w, mask):
-            return _sgd_epoch_math(
-                coef, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
+            m = X.shape[0]
+            start = jnp.minimum(offset, m - local_batch)
+            new_coef, mean_loss = _sgd_epoch_math(
+                coef, start, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
             )
+            next_offset = jnp.where(offset + local_batch >= m, 0, offset + local_batch)
+            return new_coef, next_offset, mean_loss
 
         return jax.jit(
             jax.shard_map(
@@ -293,26 +329,44 @@ class SGD(Optimizer):
             and not self.listeners
         )
         if fused:
+            # One program runs a chunk of epochs; the host observes the on-device
+            # ``done`` flag between chunks. maxIter-only runs use one full-length
+            # chunk (zero host syncs); tol runs sync every _TOL_CHUNK epochs, so
+            # early convergence wastes at most _TOL_CHUNK - 1 cheap epochs.
+            chunk = min(self.max_iter, _TOL_CHUNK) if check_loss else self.max_iter
             program = _fused_sgd_program(
                 ctx,
                 loss_func,
                 local_batch,
-                self.max_iter,
+                chunk,
                 self.learning_rate,
                 self.reg,
                 self.elastic_net,
                 self.tol if check_loss else None,
                 self.dtype,
             )
+            starts, offsets = offset_schedule(train_data.local_rows, local_batch, self.max_iter)
             coef = ctx.replicate(np.asarray(init_model, self.dtype))
-            offset = ctx.replicate(np.asarray(0, np.int32))
-            final_coef, losses, n_epochs = program(coef, offset, X, y, w, mask)
-            if check_loss:
-                losses = np.asarray(jax.device_get(losses), np.float64)
-                self.loss_history = [float(x) for x in losses[: int(jax.device_get(n_epochs))]]
-            else:
-                self.loss_history = []
-            return np.asarray(jax.device_get(final_coef))
+            done = ctx.replicate(np.asarray(False))
+            self.loss_history = []
+            for c0 in range(0, self.max_iter, chunk):
+                pad = max(0, c0 + chunk - self.max_iter)
+                sl = slice(c0, c0 + chunk - pad)
+                starts_c = np.concatenate([starts[sl], np.zeros(pad, np.int32)])
+                offsets_c = np.concatenate([offsets[sl], np.zeros(pad, np.int32)])
+                active_c = np.concatenate(
+                    [np.ones(chunk - pad, bool), np.zeros(pad, bool)]
+                )
+                coef, done, losses, n_exec = program(
+                    coef, done, starts_c, offsets_c, active_c, X, y, w, mask
+                )
+                if check_loss:
+                    n = int(jax.device_get(n_exec))
+                    chunk_losses = np.asarray(jax.device_get(losses), np.float64)
+                    self.loss_history.extend(float(x) for x in chunk_losses[:n])
+                    if n < chunk - pad:  # done flipped mid-chunk
+                        break
+            return np.asarray(jax.device_get(coef))
 
         step = self._build_step(ctx, loss_func, local_batch)
 

@@ -216,3 +216,17 @@ def test_sgd_fused_matches_host_loop():
         if tol > 0:
             assert len(fused.loss_history) == len(host.loss_history)
             np.testing.assert_allclose(fused.loss_history, host.loss_history, rtol=1e-5)
+
+
+def test_sgd_fused_tol_stops_early_in_chunks():
+    # A generous max_iter with a loose tol must not execute the full epoch
+    # budget: the chunked fused path observes the on-device done flag between
+    # chunks and stops, with loss_history ending at the first loss < tol.
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(128, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    sgd = SGD(max_iter=5000, global_batch_size=64, tol=0.5, learning_rate=0.5)
+    sgd.optimize(np.zeros(4), {"features": X, "labels": y}, BinaryLogisticLoss.INSTANCE)
+    assert 0 < len(sgd.loss_history) < 5000
+    assert sgd.loss_history[-1] < 0.5
+    assert all(loss >= 0.5 for loss in sgd.loss_history[:-1])
