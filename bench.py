@@ -141,9 +141,9 @@ def bench_kmeans():
     from flink_ml_tpu.api.dataframe import DataFrame
     from flink_ml_tpu.models.clustering.kmeans import KMeans
 
+    rng = np.random.default_rng(2)
     num_rows, dim = 100_000, 10
     i1, i2 = 20, 1020
-    rng = np.random.default_rng(2)
     df = DataFrame.from_dict({"features": rng.random((num_rows, dim))})
 
     def fit(iters):
@@ -152,14 +152,21 @@ def bench_kmeans():
     t1 = _median_time(lambda: fit(i1))
     t2 = _median_time(lambda: fit(i2))
     iter_s = max((t2 - t1) / (i2 - i1), 1e-9)
+
+    # The reference's own config (10k rows) for the apples-to-apples anchor —
+    # rows/s is not shape-invariant, so the 1,399 rows/s comparison uses the
+    # exact shape it was measured on.
+    df10k = DataFrame.from_dict({"features": rng.random((10_000, dim))})
+    t10k = _median_time(lambda: KMeans().set_seed(2).set_max_iter(i1).fit(df10k))
     return {
-        "name": "kmeans_fit_100k_d10_k2",
-        "iter_time_us": round(iter_s * 1e6, 1),
-        "e2e_rows_per_sec_20_iters": round(num_rows / t1, 1),
-        "fit_time_s_20_iters": round(t1, 3),
-        # reference illustrative CPU output for the 10k config (rows/s)
+        "name": "kmeans_fit_d10_k2",
+        "iter_time_us_100k": round(iter_s * 1e6, 1),
+        "e2e_rows_per_sec_100k_20_iters": round(num_rows / t1, 1),
+        "fit_time_s_100k_20_iters": round(t1, 3),
+        "e2e_rows_per_sec_10k_20_iters": round(10_000 / t10k, 1),
+        # reference illustrative CPU output for this exact 10k config (rows/s)
         "reference_cpu_rows_per_sec": 1399.0,
-        "vs_reference_cpu": round(num_rows / t1 / 1399.0, 2),
+        "vs_reference_cpu_10k": round(10_000 / t10k / 1399.0, 2),
     }
 
 

@@ -27,7 +27,7 @@ from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.iteration import DeviceDataCache
 from flink_ml_tpu.models.common import extract_labeled_data
-from flink_ml_tpu.ops.optimizer import offset_schedule
+from flink_ml_tpu.ops.optimizer import _TOL_CHUNK, _cache_put, chunked_schedule, offset_schedule
 from flink_ml_tpu.params.param import IntArrayParam, ParamValidators, update_existing_params
 from flink_ml_tpu.params.shared import (
     HasFeaturesCol,
@@ -217,9 +217,7 @@ class MLPClassifier(Estimator, _MlpParams):
             ),
             donate_argnums=(0, 1, 2),
         )
-        if len(_MLP_FUSED_CACHE) >= 32:
-            _MLP_FUSED_CACHE.pop(next(iter(_MLP_FUSED_CACHE)))
-        _MLP_FUSED_CACHE[key] = program
+        _cache_put(_MLP_FUSED_CACHE, key, program)
         return program
 
     @staticmethod
@@ -282,7 +280,7 @@ class MLPClassifier(Estimator, _MlpParams):
         # always run inside one XLA program (scan for maxIter-only, while_loop for
         # the tol criteria evaluated on device).
         max_iter = self.get_max_iter()
-        chunk = min(max_iter, 64) if check_loss else max_iter
+        chunk = min(max_iter, _TOL_CHUNK) if check_loss else max_iter
         fused = self._build_fused(
             ctx,
             optimizer,
@@ -294,17 +292,14 @@ class MLPClassifier(Estimator, _MlpParams):
         done = ctx.replicate(np.asarray(False))
         opt_params, opt_st = params, opt_state
         w_col = cache["w"] * mask
-        for c0 in range(0, max_iter, chunk):
-            pad = max(0, c0 + chunk - max_iter)
-            sl = slice(c0, c0 + chunk - pad)
-            starts_c = np.concatenate([starts[sl], np.zeros(pad, np.int32)])
-            offsets_c = np.concatenate([offsets[sl], np.zeros(pad, np.int32)])
-            active_c = np.concatenate([np.ones(chunk - pad, bool), np.zeros(pad, bool)])
+        for starts_c, offsets_c, active_c, n_active in chunked_schedule(
+            starts, offsets, max_iter, chunk
+        ):
             opt_params, opt_st, done, n_exec = fused(
                 opt_params, opt_st, done, starts_c, offsets_c, active_c,
                 cache["x"], cache["y"], w_col,
             )
-            if check_loss and int(jax.device_get(n_exec)) < chunk - pad:
+            if check_loss and int(jax.device_get(n_exec)) < n_active:
                 break  # done flipped mid-chunk
         final_params = opt_params
         model = MLPClassifierModel()

@@ -3,10 +3,15 @@
 Reference: ``flink-ml-lib/.../evaluation/binaryclassification/
 BinaryClassificationEvaluator.java:76`` — an AlgoOperator computing, over
 (label, rawPrediction[, weight]) rows sorted globally by score: areaUnderROC,
-areaUnderPR, ks, areaUnderLorenz (the reference distributes the sort and merges
-partition summaries; here the sort is a single device/host sort, SURVEY.md §7's
-"sort-based primitives" note). Output: one row with the requested metrics
+areaUnderPR, ks, areaUnderLorenz. Output: one row with the requested metrics
 (default [areaUnderROC, areaUnderPR]).
+
+Distribution mirrors the reference (sort :178, partition summaries :178, merge
+:226): ``parallel.distributed_sort`` range-partitions rows by score into
+per-shard buckets (ties confined to one bucket) and sorts every bucket in one
+device program; each bucket then contributes a (positive, negative, total)
+summary, an exclusive prefix over the summaries aligns the buckets' cumulative
+curves, and the per-bucket partial curves concatenate into the global one.
 
 Metric definitions (matching the reference's accumulation):
   - ROC AUC via the rank-sum (trapezoid over TPR/FPR with score ties grouped);
@@ -69,19 +74,45 @@ class BinaryClassificationEvaluator(
             else np.ones(len(y))
         )
 
-        order = np.argsort(-scores, kind="stable")
-        y_s, w_s, s_s = y[order], w[order], scores[order]
-        pos = np.sum(w_s * (y_s == 1.0))
-        neg = np.sum(w_s * (y_s != 1.0))
-        if pos == 0 or neg == 0:
+        from flink_ml_tpu.parallel.datastream_utils import distributed_sort
+
+        # Range-partitioned global sort, descending by score; ties share a bucket.
+        buckets = distributed_sort(scores, {"y": y, "w": w}, descending=True)
+        buckets = [b for b in buckets if len(b["__key__"])]
+        if not buckets:
             raise ValueError("Both positive and negative samples are required.")
 
-        # group score ties: evaluate curve only at group boundaries
-        boundary = np.nonzero(np.diff(s_s))[0]
-        cut = np.concatenate([boundary, [len(s_s) - 1]])
-        tp = np.cumsum(w_s * (y_s == 1.0))[cut]
-        fp = np.cumsum(w_s * (y_s != 1.0))[cut]
-        tot = np.cumsum(w_s)[cut]
+        # Per-bucket summaries (ref partition summaries :178) and their
+        # exclusive prefix (ref merge :226) align each bucket's local curve.
+        sums = np.asarray(
+            [
+                [
+                    np.sum(b["w"] * (b["y"] == 1.0)),
+                    np.sum(b["w"] * (b["y"] != 1.0)),
+                    np.sum(b["w"]),
+                ]
+                for b in buckets
+            ]
+        )
+        pos, neg = float(sums[:, 0].sum()), float(sums[:, 1].sum())
+        if pos == 0 or neg == 0:
+            raise ValueError("Both positive and negative samples are required.")
+        prefix = np.concatenate([np.zeros((1, 3)), np.cumsum(sums, axis=0)[:-1]])
+
+        # Per-bucket cumulative curves at tie-group boundaries, offset by the
+        # prefix; concatenation yields the global boundary curve (ties never
+        # span buckets, so bucket edges are always group boundaries).
+        tp_parts, fp_parts, tot_parts = [], [], []
+        for b, off in zip(buckets, prefix):
+            s_b = b["__key__"]
+            boundary = np.nonzero(np.diff(s_b))[0]
+            cut = np.concatenate([boundary, [len(s_b) - 1]])
+            tp_parts.append(off[0] + np.cumsum(b["w"] * (b["y"] == 1.0))[cut])
+            fp_parts.append(off[1] + np.cumsum(b["w"] * (b["y"] != 1.0))[cut])
+            tot_parts.append(off[2] + np.cumsum(b["w"])[cut])
+        tp = np.concatenate(tp_parts)
+        fp = np.concatenate(fp_parts)
+        tot = np.concatenate(tot_parts)
         tpr = np.concatenate([[0.0], tp / pos])
         fpr = np.concatenate([[0.0], fp / neg])
         recall = tpr

@@ -123,16 +123,25 @@ class KBinsDiscretizer(Estimator, _KbdParams):
         if len(X) == 0:
             raise RuntimeError("The training set is empty.")
         if len(X) > self.get_sub_samples():
-            X = X[np.random.default_rng(0).choice(len(X), self.get_sub_samples(), replace=False)]
+            # Ref KBinsDiscretizer.java:117 — DataStreamUtils.sample (reservoir).
+            from flink_ml_tpu.parallel.datastream_utils import sample
+
+            X = sample({"x": X}, self.get_sub_samples(), seed=0)["x"]
         k = self.get_num_bins()
         strategy = self.get_strategy()
+        quantile_edges = None
+        if strategy == QUANTILE:
+            # Distributed GK sketches per dim (exact below the compress threshold).
+            from flink_ml_tpu.parallel.datastream_utils import distributed_quantiles
+
+            quantile_edges = distributed_quantiles(X, np.linspace(0, 1, k + 1))
         edges_per_dim: List[np.ndarray] = []
         for d in range(X.shape[1]):
             x = X[:, d]
             if strategy == UNIFORM:
                 edges = np.linspace(x.min(), x.max(), k + 1)
             elif strategy == QUANTILE:
-                edges = np.quantile(x, np.linspace(0, 1, k + 1))
+                edges = quantile_edges[:, d]
             else:
                 centers = _kmeans_1d(x, k)
                 mids = (centers[:-1] + centers[1:]) / 2.0

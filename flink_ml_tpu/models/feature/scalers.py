@@ -195,16 +195,21 @@ class RobustScalerModel(ModelArraysMixin, Model, _RobustParams):
 
 
 class RobustScaler(Estimator, _RobustParams):
-    """Ref RobustScaler.java — quantiles computed exactly by device sort instead of
-    the reference's Greenwald-Khanna sketch (QuantileSummary.java:42)."""
+    """Ref RobustScaler.java — per-dim quantiles via the distributed
+    Greenwald-Khanna sketch (QuantileSummary.java:42): every partition sketches
+    independently, the sketches merge (parallel/quantile.py), so the fit scales
+    to streams that never fit one host. On inputs below the sketch's compress
+    threshold the result is exact."""
 
     def fit(self, *inputs) -> RobustScalerModel:
         (df,) = inputs
         X = df.vectors(self.get_input_col()).astype(np.float64)
         if len(X) == 0:
             raise RuntimeError("The training set is empty.")
+        from flink_ml_tpu.parallel.datastream_utils import distributed_quantiles
+
         lo, hi = self.get_lower(), self.get_upper()
-        q = np.quantile(X, [lo, 0.5, hi], axis=0)
+        q = distributed_quantiles(X, [lo, 0.5, hi])
         model = RobustScalerModel()
         update_existing_params(model, self)
         model.medians = q[1]

@@ -146,6 +146,21 @@ def offset_schedule(m: int, local_batch: int, n_epochs: int):
     return starts, offsets
 
 
+def chunked_schedule(starts: np.ndarray, offsets: np.ndarray, max_iter: int, chunk: int):
+    """Yield per-chunk (starts, offsets, active, n_active) views of an epoch
+    schedule, padding the last chunk to the fixed program width with inactive
+    epochs. Shared by every chunked fused trainer (SGD, MLPClassifier)."""
+    for c0 in range(0, max_iter, chunk):
+        pad = max(0, c0 + chunk - max_iter)
+        sl = slice(c0, c0 + chunk - pad)
+        yield (
+            np.concatenate([starts[sl], np.zeros(pad, np.int32)]),
+            np.concatenate([offsets[sl], np.zeros(pad, np.int32)]),
+            np.concatenate([np.ones(chunk - pad, bool), np.zeros(pad, bool)]),
+            chunk - pad,
+        )
+
+
 _TOL_CHUNK = 64  # epochs per dispatch when a tol criteria is active
 
 _FUSED_CACHE: Dict[tuple, object] = {}
@@ -349,14 +364,9 @@ class SGD(Optimizer):
             coef = ctx.replicate(np.asarray(init_model, self.dtype))
             done = ctx.replicate(np.asarray(False))
             self.loss_history = []
-            for c0 in range(0, self.max_iter, chunk):
-                pad = max(0, c0 + chunk - self.max_iter)
-                sl = slice(c0, c0 + chunk - pad)
-                starts_c = np.concatenate([starts[sl], np.zeros(pad, np.int32)])
-                offsets_c = np.concatenate([offsets[sl], np.zeros(pad, np.int32)])
-                active_c = np.concatenate(
-                    [np.ones(chunk - pad, bool), np.zeros(pad, bool)]
-                )
+            for starts_c, offsets_c, active_c, n_active in chunked_schedule(
+                starts, offsets, self.max_iter, chunk
+            ):
                 coef, done, losses, n_exec = program(
                     coef, done, starts_c, offsets_c, active_c, X, y, w, mask
                 )
@@ -364,7 +374,7 @@ class SGD(Optimizer):
                     n = int(jax.device_get(n_exec))
                     chunk_losses = np.asarray(jax.device_get(losses), np.float64)
                     self.loss_history.extend(float(x) for x in chunk_losses[:n])
-                    if n < chunk - pad:  # done flipped mid-chunk
+                    if n < n_active:  # done flipped mid-chunk
                         break
             return np.asarray(jax.device_get(coef))
 

@@ -19,6 +19,16 @@ from flink_ml_tpu.parallel.collectives import (
     psum_tree,
     shard_batch_spec,
 )
+from flink_ml_tpu.parallel.quantile import QuantileSummary
+from flink_ml_tpu.parallel.datastream_utils import (
+    aggregate,
+    co_group,
+    distributed_quantiles,
+    distributed_sort,
+    map_partition,
+    reduce,
+    sample,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -31,4 +41,12 @@ __all__ = [
     "all_reduce_mean",
     "psum_tree",
     "shard_batch_spec",
+    "QuantileSummary",
+    "aggregate",
+    "co_group",
+    "distributed_quantiles",
+    "distributed_sort",
+    "map_partition",
+    "reduce",
+    "sample",
 ]

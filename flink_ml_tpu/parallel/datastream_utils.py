@@ -1,0 +1,250 @@
+"""The distributed-compute utility belt.
+
+Reference: ``flink-ml-core/.../common/datastream/DataStreamUtils.java`` —
+``sample:298`` (distributed reservoir), ``mapPartition:118``, ``reduce:153``
+(two-stage partial → final), ``aggregate:236`` (createAccumulator/add/merge/
+getResult), ``coGroup:409`` (sort-merge join with managed memory), plus the
+global sort the evaluator builds on (BinaryClassificationEvaluator.java:178).
+
+TPU-build shape: a "partition" is a contiguous row range of a columnar batch —
+the slice a mesh shard owns (MeshContext splits batches the same way). Heavy
+per-element work runs vectorized; the big sort runs on the device
+(``jnp.sort`` over the [P, m] shard matrix — every shard sorted in one SPMD
+program); the between-stage glue (splitters, bucket exchange, prefix merges)
+is single-controller host code, the analogue of the reference's
+parallelism-1 merge operators.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.parallel.mesh import MeshContext, get_mesh_context
+from flink_ml_tpu.parallel.quantile import QuantileSummary
+
+__all__ = [
+    "map_partition",
+    "aggregate",
+    "reduce",
+    "sample",
+    "co_group",
+    "distributed_sort",
+    "distributed_quantiles",
+]
+
+Columns = Dict[str, np.ndarray]
+
+
+def _num_rows(columns: Columns) -> int:
+    return int(next(iter(columns.values())).shape[0])
+
+
+def _partition_slices(n: int, p: int) -> List[slice]:
+    """Contiguous row ranges, one per "subtask" — the reference's rebalance()."""
+    bounds = np.linspace(0, n, p + 1).astype(int)
+    return [slice(int(a), int(b)) for a, b in zip(bounds[:-1], bounds[1:])]
+
+
+def map_partition(
+    columns: Columns,
+    fn: Callable[[Columns], object],
+    ctx: Optional[MeshContext] = None,
+) -> List[object]:
+    """Apply ``fn`` once per partition (ref DataStreamUtils.mapPartition:118).
+
+    ``fn`` receives a dict of row-range views; returns the list of per-partition
+    results in partition order.
+    """
+    ctx = ctx or get_mesh_context()
+    n = _num_rows(columns)
+    return [
+        fn({k: v[sl] for k, v in columns.items()})
+        for sl in _partition_slices(n, ctx.n_data)
+    ]
+
+
+def aggregate(
+    columns: Columns,
+    create_accumulator: Callable[[], object],
+    add: Callable[[object, Columns], object],
+    merge: Callable[[object, object], object],
+    get_result: Callable[[object], object] = lambda acc: acc,
+    ctx: Optional[MeshContext] = None,
+):
+    """Two-stage aggregation (ref DataStreamUtils.aggregate:236): every
+    partition folds its rows into an accumulator, a final single-controller
+    stage merges the partials."""
+    partials = map_partition(
+        columns, lambda part: add(create_accumulator(), part), ctx=ctx
+    )
+    acc = partials[0]
+    for other in partials[1:]:
+        acc = merge(acc, other)
+    return get_result(acc)
+
+
+def reduce(
+    columns: Columns,
+    fn: Callable[[Columns, Columns], Columns],
+    ctx: Optional[MeshContext] = None,
+) -> Columns:
+    """Two-stage reduce (ref DataStreamUtils.reduce:153): partial reduce per
+    partition (here: the partition slice itself), then a parallelism-1 final
+    reduce over the partials."""
+    parts = map_partition(columns, lambda part: part, ctx=ctx)
+    acc = parts[0]
+    for other in parts[1:]:
+        acc = fn(acc, other)
+    return acc
+
+
+def sample(
+    columns: Columns,
+    num_samples: int,
+    seed: int = 0,
+    chunk_rows: int = 1 << 16,
+) -> Columns:
+    """Uniform reservoir sample of ``num_samples`` rows (ref
+    DataStreamUtils.sample:298, Algorithm R over the stream).
+
+    Chunk-vectorized: per chunk, row i (globally) survives with probability
+    num_samples/(i+1) into a uniformly random slot; numpy assignment applies
+    duplicate slot writes in order, which reproduces the sequential algorithm.
+    """
+    n = _num_rows(columns)
+    if num_samples >= n:
+        return {k: v.copy() for k, v in columns.items()}
+    rng = np.random.default_rng(seed)
+    reservoir_idx = np.arange(num_samples)
+    for lo in range(num_samples, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        gidx = np.arange(lo, hi)
+        accept = rng.random(hi - lo) < num_samples / (gidx + 1.0)
+        taken = gidx[accept]
+        slots = rng.integers(0, num_samples, size=taken.size)
+        reservoir_idx[slots] = taken  # later writes win, like sequential R
+    return {k: v[reservoir_idx] for k, v in columns.items()}
+
+
+def co_group(
+    left_keys: np.ndarray,
+    right_keys: np.ndarray,
+) -> Iterator[Tuple[object, np.ndarray, np.ndarray]]:
+    """Sort-merge co-group (ref DataStreamUtils.coGroup:409): yields
+    ``(key, left_row_indices, right_row_indices)`` for every key present on
+    either side, in key order. The reference sorts both inputs with managed
+    memory and walks them together; here both sides argsort once and the walk
+    is a vectorized boundary computation."""
+    left_keys = np.asarray(left_keys)
+    right_keys = np.asarray(right_keys)
+    lo = np.argsort(left_keys, kind="stable")
+    ro = np.argsort(right_keys, kind="stable")
+    ls, rs = left_keys[lo], right_keys[ro]
+    keys = np.union1d(ls, rs)
+    l_start = np.searchsorted(ls, keys, side="left")
+    l_end = np.searchsorted(ls, keys, side="right")
+    r_start = np.searchsorted(rs, keys, side="left")
+    r_end = np.searchsorted(rs, keys, side="right")
+    for i, key in enumerate(keys):
+        yield key, lo[l_start[i] : l_end[i]], ro[r_start[i] : r_end[i]]
+
+
+def distributed_sort(
+    keys: np.ndarray,
+    values: Optional[Columns] = None,
+    descending: bool = False,
+    ctx: Optional[MeshContext] = None,
+) -> List[Columns]:
+    """Global sort by ``keys``, returned as ordered per-shard buckets.
+
+    The reference's evaluator sorts globally by score via range partitioning
+    (BinaryClassificationEvaluator.java:178). Stages here:
+
+    1. splitter selection: p-1 quantiles of a strided key sample (host; the
+       splitters only affect bucket *balance*, never correctness);
+    2. bucket exchange: vectorized ``searchsorted`` routes each row to the
+       bucket owning its key range — ``side='right'`` keeps all ties of a
+       splitter value in one bucket, which is what lets callers group tied
+       keys without cross-bucket fixups;
+    3. one device program sorts every bucket in parallel: buckets pad to a
+       common width with +inf and ``jnp.argsort`` runs row-wise over the
+       [P, m] matrix (the sort is stable, so pad entries trail real entries).
+
+    Returns ``n_data`` dicts, each with key ``"__key__"`` plus the value
+    columns, globally ordered: every key in bucket b <= every key in b+1
+    (reversed when descending). NaN keys are not supported.
+    """
+    ctx = ctx or get_mesh_context()
+    keys = np.asarray(keys)
+    values = values or {}
+    n = keys.shape[0]
+    p = ctx.n_data
+    if n == 0:
+        return [{"__key__": keys[:0], **{k: v[:0] for k, v in values.items()}}]
+
+    # 1. splitters from a strided sample.
+    if p > 1:
+        stride = max(1, n // (p * 64))
+        splitters = np.quantile(keys[::stride], np.linspace(0, 1, p + 1)[1:-1])
+    else:
+        splitters = np.empty(0, np.float64)
+
+    # 2. bucket routing.
+    bucket = np.searchsorted(splitters, keys, side="right")
+    order = np.argsort(bucket, kind="stable")
+    bounds = np.searchsorted(bucket[order], np.arange(p + 1))
+    sizes = np.diff(bounds)
+
+    # 3. all buckets sorted in ONE device program.
+    width = int(sizes.max())
+    mat = np.full((p, max(width, 1)), np.inf, np.float64)
+    for b in range(p):
+        mat[b, : sizes[b]] = keys[order[bounds[b] : bounds[b + 1]]]
+    perm = np.asarray(jnp.argsort(jnp.asarray(mat), axis=1))
+
+    out: List[Columns] = []
+    for b in range(p):
+        rows = order[bounds[b] : bounds[b + 1]][perm[b, : sizes[b]]]
+        if descending:
+            rows = rows[::-1]
+        out.append({"__key__": keys[rows], **{k: v[rows] for k, v in values.items()}})
+    return out[::-1] if descending else out
+
+
+def distributed_quantiles(
+    X: np.ndarray,
+    probs: Sequence[float],
+    relative_error: float = 0.001,
+    ctx: Optional[MeshContext] = None,
+) -> np.ndarray:
+    """Per-column quantiles of ``X [n, d]`` via mergeable GK sketches.
+
+    Every partition sketches its rows independently (``QuantileSummary`` per
+    column), the host merges the sketches — the exact layout of the reference's
+    RobustScaler/KBinsDiscretizer fit (per-subtask QuantileSummary + the
+    parallelism-1 merge). Error is ``relative_error`` in *rank*, so results on
+    small inputs (sketch below its compress threshold) are exact.
+    """
+    X = np.asarray(X, np.float64)
+    if X.ndim == 1:
+        X = X[:, None]
+    d = X.shape[1]
+
+    def sketch_partition(part: Columns) -> List[QuantileSummary]:
+        block = part["x"]
+        sketches = []
+        for j in range(d):
+            s = QuantileSummary(relative_error)
+            s.insert_all(block[:, j])
+            s.compress()
+            sketches.append(s)
+        return sketches
+
+    partials = map_partition({"x": X}, sketch_partition, ctx=ctx)
+    merged = partials[0]
+    for other in partials[1:]:
+        merged = [a.merge(b) for a, b in zip(merged, other)]
+    probs = np.asarray(probs, np.float64)
+    return np.stack([np.atleast_1d(s.query(probs)) for s in merged], axis=1)

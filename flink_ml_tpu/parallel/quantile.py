@@ -1,0 +1,234 @@
+"""Greenwald-Khanna approximate quantile summary (mergeable sketch).
+
+Reference: ``flink-ml-lib/.../common/util/QuantileSummary.java:42`` — the GK01
+"Space-efficient Online Computation of Quantile Summaries" sketch used by
+RobustScaler and KBinsDiscretizer. Each summary holds tuples (value, g, delta)
+where g is the gap in min-rank to the previous tuple and delta the max-rank
+slack; inserts buffer into a head buffer, compression merges adjacent tuples
+while g_i + g_{i+1} + delta_{i+1} stays under 2·eps·count, and two summaries
+merge by interleaving with delta inflation — making the sketch associative, the
+property that lets every mesh shard sketch its rows independently and a single
+host-side merge produce the global quantiles (the reference does the same per
+Flink subtask and merges in a parallelism-1 operator).
+
+TPU-build deviations (shape, not semantics):
+  - storage is flat numpy arrays (values[], g[], delta[]) instead of per-tuple
+    objects, and inserts are whole-chunk vectorized merges — one ``insert_all``
+    of a million-row column costs two sorts, not a million list appends;
+  - the structure is mutated in place (the reference returns fresh copies).
+"""
+from __future__ import annotations
+
+import math
+from typing import Iterable, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = ["QuantileSummary"]
+
+_DEFAULT_HEAD_SIZE = 50_000
+_DEFAULT_COMPRESS_THRESHOLD = 10_000
+
+
+class QuantileSummary:
+    """GK sketch over a stream of doubles; query error is ``relative_error`` ranks."""
+
+    def __init__(
+        self,
+        relative_error: float = 0.001,
+        compress_threshold: int = _DEFAULT_COMPRESS_THRESHOLD,
+    ):
+        if not 0 <= relative_error <= 1:
+            raise ValueError("An appropriate relative error must be in the range [0, 1].")
+        if compress_threshold <= 0:
+            raise ValueError("A compress threshold must be greater than 0.")
+        self.relative_error = relative_error
+        self.compress_threshold = compress_threshold
+        self.count = 0
+        self.values = np.empty(0, np.float64)
+        self.g = np.empty(0, np.int64)
+        self.delta = np.empty(0, np.int64)
+        self._head: list = []  # list of numpy chunks, concatenated at flush
+        self._head_n = 0
+
+    # --- write side ----------------------------------------------------------
+    def insert(self, item: float) -> "QuantileSummary":
+        """Ref QuantileSummary.insert — buffered single insert."""
+        return self.insert_all(np.asarray([item], np.float64))
+
+    def insert_all(self, items: Union[np.ndarray, Iterable[float]]) -> "QuantileSummary":
+        """Vectorized chunk insert (the TPU-build batch path): chunks stay numpy
+        arrays in the head buffer and concatenate once at flush — no per-item
+        boxing."""
+        arr = np.asarray(items if isinstance(items, np.ndarray) else list(items), np.float64).ravel()
+        if arr.size == 0:
+            return self
+        self._head.append(arr)
+        self._head_n += arr.size
+        if self._head_n >= _DEFAULT_HEAD_SIZE:
+            self._flush_head()
+            if len(self.values) >= self.compress_threshold:
+                self.compress()
+        return self
+
+    def _flush_head(self) -> None:
+        """Ref insertHeadBuffer — merge the sorted head buffer into the sampled
+        tuples. New items get delta = floor(2·eps·count_before_flush), except an
+        item placed at the very front or the very back of the summary (delta 0).
+        """
+        if not self._head:
+            return
+        chunk = np.sort(np.concatenate(self._head))
+        self._head = []
+        self._head_n = 0
+        old_n = len(self.values)
+        m = chunk.size
+        # Position of each new item among existing tuples: existing tuples with
+        # value <= item precede it (ref: `sampled[cursor].value <= sorted[i]`).
+        pos = np.searchsorted(self.values, chunk, side="right")
+
+        delta_new = np.full(m, math.floor(2.0 * self.relative_error * self.count), np.int64)
+        # First new item that lands before every existing tuple starts the summary.
+        if m and (old_n == 0 or pos[0] == 0):
+            delta_new[0] = 0
+        # Last new item that lands after every existing tuple ends the summary.
+        if m and pos[-1] == old_n:
+            delta_new[-1] = 0
+
+        # Interleave old tuples and the chunk by final position.
+        total = old_n + m
+        new_idx = pos + np.arange(m)  # final slots of the chunk items
+        values = np.empty(total, np.float64)
+        g = np.empty(total, np.int64)
+        delta = np.empty(total, np.int64)
+        old_mask = np.ones(total, bool)
+        old_mask[new_idx] = False
+        values[new_idx] = chunk
+        g[new_idx] = 1
+        delta[new_idx] = delta_new
+        values[old_mask] = self.values
+        g[old_mask] = self.g
+        delta[old_mask] = self.delta
+
+        self.values, self.g, self.delta = values, g, delta
+        self.count += m
+
+    # --- compression ---------------------------------------------------------
+    def compress(self) -> "QuantileSummary":
+        """Ref QuantileSummary.compress — flush then COMPRESS with threshold
+        2·eps·count."""
+        self._flush_head()
+        self._compress_internal(2.0 * self.relative_error * self.count)
+        return self
+
+    def _compress_internal(self, merge_threshold: float) -> None:
+        """Ref compressInternal — right-to-left greedy merge of adjacent tuples
+        while g_i + g_head + delta_head < threshold. The scan is inherently
+        sequential; the sampled buffer is bounded by the compress threshold, so
+        the host loop is cheap."""
+        n = len(self.values)
+        if n == 0:
+            return
+        keep = []
+        head = n - 1
+        head_g = int(self.g[head])
+        for i in range(n - 2, 0, -1):
+            if self.g[i] + head_g + self.delta[head] < merge_threshold:
+                head_g += int(self.g[i])
+            else:
+                keep.append((head, head_g))
+                head, head_g = i, int(self.g[i])
+        keep.append((head, head_g))
+        keep.reverse()
+        idx = np.asarray([k[0] for k in keep], np.int64)
+        gs = np.asarray([k[1] for k in keep], np.int64)
+        if self.values[0] <= self.values[idx[0]] and n > 1:
+            idx = np.concatenate([[0], idx])
+            gs = np.concatenate([[self.g[0]], gs])
+        self.values = self.values[idx]
+        self.delta = self.delta[idx]
+        self.g = gs
+
+    # --- merge ---------------------------------------------------------------
+    def merge(self, other: "QuantileSummary") -> "QuantileSummary":
+        """Ref QuantileSummary.merge — interleave two compressed summaries,
+        inflating deltas of interior tuples by the other side's error budget,
+        then compress at the merged threshold. Returns self (mutated)."""
+        if self._head or other._head:
+            raise ValueError("Both summaries must be compressed before merge.")
+        if other.count == 0:
+            return self
+        if self.count == 0:
+            self.relative_error = other.relative_error
+            self.count = other.count
+            self.values = other.values.copy()
+            self.g = other.g.copy()
+            self.delta = other.delta.copy()
+            return self
+
+        add_self = math.floor(2.0 * other.relative_error * other.count)
+        add_other = math.floor(2.0 * self.relative_error * self.count)
+        # Merge order: on ties the other side's tuple goes first
+        # (ref: `if (selfSample.value < otherSample.value)` else take other).
+        # A self tuple is preceded by >=1 other tuple iff some other value <= it;
+        # an other tuple is preceded by >=1 self tuple iff some self value < it.
+        n_other_before_self = np.searchsorted(other.values, self.values, side="right")
+        n_self_before_other = np.searchsorted(self.values, other.values, side="left")
+        delta_self = self.delta + np.where(n_other_before_self > 0, add_self, 0)
+        delta_other = other.delta + np.where(n_self_before_other > 0, add_other, 0)
+
+        pos_self = n_other_before_self + np.arange(len(self.values))
+        total = len(self.values) + len(other.values)
+        values = np.empty(total, np.float64)
+        g = np.empty(total, np.int64)
+        delta = np.empty(total, np.int64)
+        self_mask = np.zeros(total, bool)
+        self_mask[pos_self] = True
+        values[self_mask] = self.values
+        g[self_mask] = self.g
+        delta[self_mask] = delta_self
+        values[~self_mask] = other.values
+        g[~self_mask] = other.g
+        delta[~self_mask] = delta_other
+
+        self.relative_error = max(self.relative_error, other.relative_error)
+        self.count += other.count
+        self.values, self.g, self.delta = values, g, delta
+        self._compress_internal(2.0 * self.relative_error * self.count)
+        return self
+
+    # --- query ---------------------------------------------------------------
+    def query(self, percentiles: Union[float, Sequence[float]]) -> Union[float, np.ndarray]:
+        """Ref QuantileSummary.query — approximate quantiles at the given
+        percentiles (requires a compressed summary)."""
+        scalar = np.isscalar(percentiles)
+        ps = np.atleast_1d(np.asarray(percentiles, np.float64))
+        if np.any((ps < 0) | (ps > 1)):
+            raise ValueError("percentile should be in the range [0.0, 1.0].")
+        if self._head:
+            raise ValueError("Cannot operate on an uncompressed summary, call compress() first.")
+        if len(self.values) == 0:
+            raise ValueError("Cannot query percentiles without any records inserted.")
+
+        min_rank = np.cumsum(self.g)
+        max_rank = min_rank + self.delta
+        target_error = float(np.max(self.delta + self.g)) / 2.0
+
+        out = np.empty(len(ps), np.float64)
+        for i, p in enumerate(ps):
+            if p <= self.relative_error:
+                out[i] = self.values[0]
+            elif p >= 1.0 - self.relative_error:
+                out[i] = self.values[-1]
+            else:
+                rank = math.ceil(p * self.count)
+                ok = (max_rank - target_error < rank) & (rank <= min_rank + target_error)
+                # Ref findApproximateQuantile: first satisfying tuple among all
+                # but the last; default to the last value.
+                ok = ok[:-1]
+                out[i] = self.values[int(np.argmax(ok))] if ok.any() else self.values[-1]
+        return float(out[0]) if scalar else out
+
+    @property
+    def is_empty(self) -> bool:
+        return not self._head and len(self.values) == 0

@@ -271,3 +271,9 @@ class TestAgglomerativeClustering:
         df = DataFrame.from_dict({"features": self._blobs()})
         with pytest.raises(ValueError, match="Exactly one"):
             AgglomerativeClustering().set_distance_threshold(1.0).transform(df)
+
+
+def test_evaluator_empty_input_raises():
+    df = DataFrame.from_dict({"label": np.empty(0), "rawPrediction": np.empty(0)})
+    with pytest.raises(ValueError, match="positive and negative"):
+        BinaryClassificationEvaluator().transform(df)

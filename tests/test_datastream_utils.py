@@ -1,0 +1,211 @@
+"""Tests for the DataStreamUtils belt (parallel/datastream_utils.py) and the
+GK QuantileSummary (parallel/quantile.py), on the 8-device mesh."""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.parallel import (
+    QuantileSummary,
+    aggregate,
+    co_group,
+    distributed_quantiles,
+    distributed_sort,
+    map_partition,
+    reduce,
+    sample,
+)
+
+RNG = np.random.default_rng(77)
+
+
+class TestQuantileSummary:
+    def test_exact_below_compress_threshold(self):
+        x = RNG.normal(size=2000)
+        s = QuantileSummary(relative_error=0.001)
+        s.insert_all(x).compress()
+        for p in (0.25, 0.5, 0.75):
+            # exact rank within 1 of numpy's nearest-rank quantile
+            got = s.query(p)
+            rank = np.searchsorted(np.sort(x), got)
+            assert abs(rank - p * len(x)) <= 2
+
+    def test_relative_error_bound_large(self):
+        x = RNG.normal(size=200_000)
+        eps = 0.01
+        s = QuantileSummary(relative_error=eps)
+        # feed in chunks like a stream
+        for chunk in np.array_split(x, 7):
+            s.insert_all(chunk)
+        s.compress()
+        xs = np.sort(x)
+        for p in (0.1, 0.5, 0.9):
+            got = s.query(p)
+            rank = np.searchsorted(xs, got) / len(x)
+            assert abs(rank - p) <= 2 * eps, (p, rank)
+
+    def test_merge_matches_single_sketch_error(self):
+        x = RNG.normal(size=50_000)
+        eps = 0.01
+        parts = np.array_split(x, 8)
+        sketches = [QuantileSummary(eps).insert_all(part).compress() for part in parts]
+        merged = sketches[0]
+        for other in sketches[1:]:
+            merged = merged.merge(other)
+        assert merged.count == len(x)
+        xs = np.sort(x)
+        for p in (0.25, 0.5, 0.75):
+            rank = np.searchsorted(xs, merged.query(p)) / len(x)
+            assert abs(rank - p) <= 2 * eps
+
+    def test_single_insert_and_scalar_query(self):
+        s = QuantileSummary(0.001)
+        for v in [5.0, 1.0, 3.0]:
+            s.insert(v)
+        s.compress()
+        assert s.query(0.5) == 3.0
+        assert s.query(0.0) == 1.0
+        assert s.query(1.0) == 5.0
+
+    def test_query_uncompressed_raises(self):
+        s = QuantileSummary(0.001)
+        s.insert(1.0)
+        with pytest.raises(ValueError, match="compress"):
+            s.query(0.5)
+        with pytest.raises(ValueError, match="without any records"):
+            QuantileSummary(0.001).query(0.5)
+
+    def test_invalid_params(self):
+        with pytest.raises(ValueError, match="relative error"):
+            QuantileSummary(1.5)
+        s = QuantileSummary(0.001).insert(1.0).compress()
+        with pytest.raises(ValueError, match="range"):
+            s.query(1.5)
+
+
+class TestDistributedSort:
+    def test_parity_with_np_sort(self):
+        keys = RNG.normal(size=10_001)
+        vals = {"v": np.arange(10_001, dtype=np.float64)}
+        buckets = distributed_sort(keys, vals)
+        got = np.concatenate([b["__key__"] for b in buckets])
+        np.testing.assert_array_equal(got, np.sort(keys))
+        # values travel with their keys
+        got_v = np.concatenate([b["v"] for b in buckets])
+        np.testing.assert_array_equal(keys[got_v.astype(int)], got)
+
+    def test_descending_and_ties_confined(self):
+        keys = RNG.integers(0, 20, size=5000).astype(np.float64)  # heavy ties
+        buckets = distributed_sort(keys, descending=True)
+        got = np.concatenate([b["__key__"] for b in buckets])
+        np.testing.assert_array_equal(got, np.sort(keys)[::-1])
+        seen = set()
+        for b in buckets:
+            uniq = set(np.unique(b["__key__"]).tolist())
+            assert not (uniq & seen), "tie group split across buckets"
+            seen |= uniq
+
+    def test_empty(self):
+        out = distributed_sort(np.empty(0))
+        assert sum(len(b["__key__"]) for b in out) == 0
+
+
+class TestBeltPrimitives:
+    def test_map_partition_covers_all_rows(self):
+        cols = {"x": np.arange(100.0)}
+        parts = map_partition(cols, lambda p: p["x"].sum())
+        assert len(parts) == 8
+        assert sum(parts) == cols["x"].sum()
+
+    def test_aggregate_two_stage(self):
+        cols = {"x": RNG.normal(size=1000)}
+        mean = aggregate(
+            cols,
+            create_accumulator=lambda: (0.0, 0),
+            add=lambda acc, part: (acc[0] + part["x"].sum(), acc[1] + len(part["x"])),
+            merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+            get_result=lambda acc: acc[0] / acc[1],
+        )
+        np.testing.assert_allclose(mean, cols["x"].mean())
+
+    def test_reduce(self):
+        cols = {"x": np.arange(32.0)}
+        out = reduce(cols, lambda a, b: {"x": np.concatenate([a["x"], b["x"]])})
+        np.testing.assert_array_equal(np.sort(out["x"]), cols["x"])
+
+    def test_sample_uniformity_and_determinism(self):
+        cols = {"x": np.arange(100_000.0)}
+        s1 = sample(cols, 1000, seed=3)
+        s2 = sample(cols, 1000, seed=3)
+        np.testing.assert_array_equal(s1["x"], s2["x"])
+        assert len(np.unique(s1["x"])) == 1000
+        # uniform: mean of sampled indices near the population mean
+        assert abs(s1["x"].mean() - 50_000) < 5_000
+
+    def test_sample_small_input_returns_all(self):
+        cols = {"x": np.arange(5.0)}
+        assert len(sample(cols, 10)["x"]) == 5
+
+    def test_co_group_parity_with_dict_join(self):
+        lk = RNG.integers(0, 30, size=200)
+        rk = RNG.integers(10, 40, size=150)
+        got = {k: (set(li.tolist()), set(ri.tolist())) for k, li, ri in co_group(lk, rk)}
+        for key in np.union1d(lk, rk):
+            li, ri = got[key]
+            assert li == set(np.nonzero(lk == key)[0].tolist())
+            assert ri == set(np.nonzero(rk == key)[0].tolist())
+
+
+class TestDistributedQuantiles:
+    def test_matches_numpy_on_small_input(self):
+        X = RNG.normal(size=(3000, 4))
+        q = distributed_quantiles(X, [0.25, 0.5, 0.75])
+        # GK is exact (rank-wise) below the compress threshold; nearest-rank vs
+        # numpy linear interpolation differ by at most one order statistic.
+        expected = np.quantile(X, [0.25, 0.5, 0.75], axis=0)
+        np.testing.assert_allclose(q, expected, atol=np.ptp(X) / 100)
+
+    def test_rewired_robust_scaler_matches_exact(self):
+        from flink_ml_tpu.models.feature.scalers import RobustScaler
+
+        X = RNG.normal(size=(4000, 3)) * 5 + 2
+        model = RobustScaler().set_input_col("input").fit(DataFrame.from_dict({"input": X}))
+        exact = np.quantile(X, [0.25, 0.5, 0.75], axis=0)
+        np.testing.assert_allclose(model.medians, exact[1], atol=np.ptp(X) / 200)
+        np.testing.assert_allclose(model.ranges, exact[2] - exact[0], atol=np.ptp(X) / 100)
+
+    def test_rewired_evaluator_matches_host_argsort(self):
+        from flink_ml_tpu.models.evaluation.binary_classification_evaluator import (
+            BinaryClassificationEvaluator,
+        )
+
+        n = 5000
+        y = (RNG.random(n) > 0.4).astype(np.float64)
+        # quantized scores force heavy ties across shard boundaries
+        scores = np.round(RNG.random(n) * 50) / 50 * 0.8 + y * 0.1
+        w = RNG.random(n) + 0.5
+        df = DataFrame.from_dict({"label": y, "rawPrediction": scores, "weight": w})
+        ev = (
+            BinaryClassificationEvaluator()
+            .set_weight_col("weight")
+            .set_metrics_names("areaUnderROC", "areaUnderPR", "ks", "areaUnderLorenz")
+        )
+        out = ev.transform(df)
+
+        # reference single-sort computation
+        order = np.argsort(-scores, kind="stable")
+        y_s, w_s, s_s = y[order], w[order], scores[order]
+        pos = np.sum(w_s * (y_s == 1.0))
+        neg = np.sum(w_s * (y_s != 1.0))
+        boundary = np.nonzero(np.diff(s_s))[0]
+        cut = np.concatenate([boundary, [n - 1]])
+        tp = np.cumsum(w_s * (y_s == 1.0))[cut]
+        fp = np.cumsum(w_s * (y_s != 1.0))[cut]
+        tot = np.cumsum(w_s)[cut]
+        tpr = np.concatenate([[0.0], tp / pos])
+        fpr = np.concatenate([[0.0], fp / neg])
+        precision = np.concatenate([[1.0], tp / (tp + fp)])
+        pop = np.concatenate([[0.0], tot / (pos + neg)])
+        np.testing.assert_allclose(out["areaUnderROC"][0], np.trapezoid(tpr, fpr), rtol=1e-12)
+        np.testing.assert_allclose(out["areaUnderPR"][0], np.trapezoid(precision, tpr), rtol=1e-12)
+        np.testing.assert_allclose(out["ks"][0], np.max(np.abs(tpr - fpr)), rtol=1e-12)
+        np.testing.assert_allclose(out["areaUnderLorenz"][0], np.trapezoid(tpr, pop), rtol=1e-12)

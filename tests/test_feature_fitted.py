@@ -58,14 +58,17 @@ def test_min_max_scaler_save_load(tmp_path):
 
 
 def test_robust_scaler_iqr():
+    # The GK sketch (like the reference's QuantileSummary) returns order
+    # statistics at rank ceil(p*n): for 1..100 that is q25=25, q50=50, q75=75 —
+    # NOT numpy's linearly interpolated 25.75/50.5/75.25.
     x = np.arange(1.0, 101.0)[:, None]  # 1..100
     model = RobustScaler().fit(DataFrame.from_dict({"input": x}))
     out = model.transform(DataFrame.from_dict({"input": x}))["output"]
-    iqr = np.quantile(x, 0.75) - np.quantile(x, 0.25)
+    iqr = 75.0 - 25.0
     np.testing.assert_allclose(out[:, 0], x[:, 0] / iqr)
     model_c = RobustScaler().set_with_centering(True).fit(DataFrame.from_dict({"input": x}))
     out_c = model_c.transform(DataFrame.from_dict({"input": x}))["output"]
-    np.testing.assert_allclose(out_c[:, 0], (x[:, 0] - np.median(x)) / iqr)
+    np.testing.assert_allclose(out_c[:, 0], (x[:, 0] - 50.0) / iqr)
 
 
 def test_imputer_strategies(tmp_path):
