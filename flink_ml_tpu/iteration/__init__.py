@@ -36,6 +36,7 @@ from flink_ml_tpu.iteration.termination import (
     TerminateOnMaxIterOrTol,
 )
 from flink_ml_tpu.iteration.datacache import DeviceDataCache, HostDataCache
+from flink_ml_tpu.iteration.streaming import WindowedStream, WindowSchedule
 
 __all__ = [
     "IterationBodyResult",
@@ -48,4 +49,6 @@ __all__ = [
     "TerminateOnMaxIterOrTol",
     "DeviceDataCache",
     "HostDataCache",
+    "WindowedStream",
+    "WindowSchedule",
 ]

@@ -33,6 +33,38 @@ from flink_ml_tpu.parallel.mesh import MeshContext, get_mesh_context
 __all__ = ["DeviceDataCache", "HostDataCache"]
 
 
+def _gather_rows(chunk_rows, chunk_at, start: int, stop: int) -> Dict[str, np.ndarray]:
+    """Concatenate rows [start, stop) out of an append-ordered chunk log.
+
+    Shared by the Python and native cache tiers; ``chunk_at(i)`` materializes
+    (or memory-maps) chunk ``i``'s columns.
+    """
+    total = sum(chunk_rows)
+    if not 0 <= start <= stop <= total:
+        raise IndexError(f"rows [{start}, {stop}) out of range [0, {total})")
+    parts: List[Dict[str, np.ndarray]] = []
+    pos = 0
+    for i, n in enumerate(chunk_rows):
+        if pos >= stop:
+            break
+        end = pos + n
+        if end > start:
+            a, b = max(start - pos, 0), min(stop - pos, n)
+            chunk = chunk_at(i)
+            parts.append({k: np.asarray(v[a:b]) for k, v in chunk.items()})
+        pos = end
+    if not parts:  # empty range: zero-row arrays with the right dtypes/shapes
+        if not chunk_rows:
+            return {}
+        proto = chunk_at(0)
+        return {k: np.asarray(v[:0]) for k, v in proto.items()}
+    if len(parts) == 1:
+        # Copy so the caller never holds a live (or read-only) view into cache
+        # internals — multi-chunk ranges copy via concatenate anyway.
+        return {k: np.array(v) for k, v in parts[0].items()}
+    return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+
+
 class DeviceDataCache:
     """Columnar dataset resident in HBM, sharded over the mesh's data axis.
 
@@ -89,6 +121,7 @@ class HostDataCache:
         self.spill_dir = spill_dir
         # Append-ordered log; each entry is either {"mem": chunk} or {"files": paths}.
         self._log: List[Dict[str, object]] = []
+        self._chunk_rows: List[int] = []
         self._mem_bytes = 0
         self._n_rows = 0
         self._spill_count = 0
@@ -116,6 +149,7 @@ class HostDataCache:
         else:
             self._log.append({"mem": chunk})
             self._mem_bytes += nbytes
+        self._chunk_rows.append(n)
         self._n_rows += n
 
     def finish(self) -> None:
@@ -128,17 +162,30 @@ class HostDataCache:
     # --- read side (DataCacheReader) -----------------------------------------
     def _chunks(self) -> Iterator[Dict[str, np.ndarray]]:
         """Chunks in append order (memory and spilled tiers interleaved as written)."""
-        for entry in self._log:
-            if "mem" in entry:
-                yield entry["mem"]  # type: ignore[misc]
-            else:
-                yield {
-                    k: np.load(path, mmap_mode="r")
-                    for k, path in entry["files"].items()  # type: ignore[union-attr]
-                }
+        for i in range(len(self._log)):
+            yield self._chunk_at(i)
 
     def iter_rows(self) -> Iterator[Dict[str, np.ndarray]]:
         yield from self._chunks()
+
+    def _chunk_at(self, idx: int) -> Dict[str, np.ndarray]:
+        entry = self._log[idx]
+        if "mem" in entry:
+            return entry["mem"]  # type: ignore[return-value]
+        return {
+            k: np.load(path, mmap_mode="r")
+            for k, path in entry["files"].items()  # type: ignore[union-attr]
+        }
+
+    def rows(self, start: int, stop: int) -> Dict[str, np.ndarray]:
+        """Random-access gather of rows ``[start, stop)`` across the chunk log.
+
+        Spilled chunks are memory-mapped and sliced, so only the requested rows
+        materialize — this is what lets training stream HBM-sized windows out of
+        a larger-than-memory cache (the ``DataCacheReader`` random-access role).
+        Requires ``0 <= start <= stop <= num_rows``.
+        """
+        return _gather_rows(self._chunk_rows, self._chunk_at, start, stop)
 
     def iter_minibatches(
         self, batch_size: int, drop_last: bool = False

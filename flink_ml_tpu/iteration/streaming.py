@@ -1,0 +1,206 @@
+"""Streamed (larger-than-HBM) training windows over host-tier caches.
+
+Reference: ``ListStateWithCache.java:43`` — each SGD/KMeans subtask caches its
+training partition in managed memory segments spilling to disk
+(``DataCacheWriter.java:37``) and re-reads it through a serializer every epoch.
+
+TPU-native: the capacity tier (``HostDataCache`` / ``NativeDataCache``) holds
+the dataset on the host (RAM + spill files); training streams fixed-size
+per-shard *windows* into HBM, runs every minibatch epoch that falls inside the
+resident window as ONE fused device program, and prefetches the next window
+while the device computes (jax async dispatch provides the overlap — the
+program on window j is enqueued, then the host gathers and device_puts window
+j+1 before blocking on j's results).
+
+Window layout reproduces the resident ``DeviceDataCache`` sharding exactly:
+with ``m = ceil(n / n_data)`` rows per shard, shard ``k``'s window ``j`` holds
+global rows ``[k*m + j*W, k*m + min((j+1)*W, m))`` padded to ``W`` with
+zero-mask rows. Streamed training therefore follows the same per-shard
+batch-offset cycling as the resident path (SGD.java:246-285): when the local
+batch divides the shard evenly every epoch consumes exactly the resident rows
+and weights (equal results up to XLA fusion-order ULPs); at a ragged tail the
+contributing rows and weights are still identical — the short tail batch is
+realized by masking the window padding instead of the resident path's clamped
+re-read, same weighted sums in a different summation order.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from flink_ml_tpu.parallel.mesh import MeshContext
+
+__all__ = ["WindowSchedule", "WindowedStream", "is_host_cache", "plan_windows", "run_windows"]
+
+
+def is_host_cache(obj) -> bool:
+    """Duck-typed check for the capacity-tier cache contract (HostDataCache /
+    NativeDataCache / anything exposing num_rows + rows(start, stop))."""
+    return hasattr(obj, "num_rows") and hasattr(obj, "rows")
+
+
+class WindowSchedule:
+    """Epoch → window assignment for per-shard batch-offset cycling.
+
+    ``runs`` is a list of ``(window_idx, local_starts)`` with ``local_starts``
+    the slice starts *relative to the window*; consecutive epochs that fall in
+    the same window form one run (capped at ``chunk_len = window // batch``
+    epochs so every run fits one fixed-width fused program).
+    """
+
+    def __init__(self, local_rows: int, local_batch: int, window_rows: int, max_iter: int):
+        # The cycling rule is offset_schedule's — the single source of truth the
+        # resident fused path also consumes, so the two paths cannot drift.
+        from flink_ml_tpu.ops.optimizer import offset_schedule
+
+        b = local_batch
+        W = max(b, min(int(window_rows), local_rows))
+        W = -(-W // b) * b  # round up to a whole number of batches
+        self.window = W
+        self.n_windows = -(-local_rows // W)
+        self.chunk_len = W // b
+        _, offsets = offset_schedule(local_rows, b, max_iter)
+        runs: List[Tuple[int, List[int]]] = []
+        for off in offsets:
+            j = int(off) // W
+            if runs and runs[-1][0] == j and len(runs[-1][1]) < self.chunk_len:
+                runs[-1][1].append(int(off) - j * W)
+            else:
+                runs.append((j, [int(off) - j * W]))
+        self.runs = [(j, np.asarray(starts, np.int32)) for j, starts in runs]
+
+    def padded(self, starts: np.ndarray) -> Tuple[np.ndarray, np.ndarray, int]:
+        """(starts, active, n_active) padded to the fixed chunk width — the
+        same padding contract as every chunked fused trainer."""
+        from flink_ml_tpu.ops.optimizer import chunked_schedule
+
+        starts_c, _, active, n_active = next(
+            chunked_schedule(starts, starts, len(starts), self.chunk_len)
+        )
+        return starts_c, active, n_active
+
+
+class WindowedStream:
+    """Loads per-shard windows of a host-tier cache onto the mesh.
+
+    ``columns`` maps output name → cache column name; every loaded window is a
+    dict of device arrays ``[n_data * W, ...]`` sharded over the data axis,
+    plus ``"__mask__"`` (1.0 on real rows, 0.0 on window/global padding).
+    Missing cache columns (e.g. an optional ``weights``) fill with ones.
+
+    ``window`` must be the batch-aligned width from the matching
+    ``WindowSchedule`` — construct both through ``plan_windows`` so they cannot
+    drift apart.
+    """
+
+    def __init__(
+        self,
+        cache,
+        columns: Dict[str, str],
+        ctx: MeshContext,
+        window: int,
+        dtype=np.float32,
+        transforms: Optional[Dict[str, object]] = None,
+    ):
+        self.cache = cache
+        self.columns = columns
+        self.ctx = ctx
+        self.dtype = np.dtype(dtype)
+        self.transforms = transforms or {}
+        self.n = int(cache.num_rows)
+        if self.n == 0:
+            raise ValueError("cannot stream an empty cache")
+        self.m = -(-self.n // ctx.n_data)  # per-shard rows (same as shard_batch pad)
+        self.window = int(window)
+        peek = cache.rows(0, 1)
+        self._shapes = {}
+        self._present = {}
+        for out, col in columns.items():
+            self._present[out] = col in peek
+            self._shapes[out] = peek[col].shape[1:] if col in peek else ()
+
+    def load(self, j: int) -> Dict[str, jax.Array]:
+        """Assemble window ``j`` for every shard and place it on the mesh."""
+        W, m, n, nd = self.window, self.m, self.n, self.ctx.n_data
+        host: Dict[str, np.ndarray] = {
+            out: np.zeros((nd * W,) + self._shapes[out], self.dtype)
+            for out in self.columns
+        }
+        mask = np.zeros(nd * W, self.dtype)
+        for k in range(nd):
+            lo = k * m + j * W
+            hi = min(k * m + min((j + 1) * W, m), n)
+            if hi <= lo:
+                continue
+            got = self.cache.rows(lo, hi)
+            sl = slice(k * W, k * W + (hi - lo))
+            for out, col in self.columns.items():
+                if self._present[out]:
+                    val = got[col]
+                    tf = self.transforms.get(out)
+                    if tf is not None:
+                        val = tf(np.asarray(val))
+                    host[out][sl] = np.asarray(val, self.dtype)
+                else:
+                    host[out][sl] = 1.0
+            mask[sl] = 1.0
+        out = {
+            name: jax.device_put(arr, self.ctx.batch) for name, arr in host.items()
+        }
+        out["__mask__"] = jax.device_put(mask, self.ctx.batch)
+        return out
+
+
+def plan_windows(
+    cache,
+    columns: Dict[str, str],
+    ctx: MeshContext,
+    window_rows: int,
+    local_batch: int,
+    max_iter: int,
+    dtype=np.float32,
+    transforms: Optional[Dict[str, object]] = None,
+) -> Tuple["WindowedStream", "WindowSchedule"]:
+    """Build a (stream, schedule) pair with a consistent batch-aligned width."""
+    n = int(cache.num_rows)
+    if n == 0:
+        raise ValueError("cannot stream an empty cache")
+    m = -(-n // ctx.n_data)
+    sched = WindowSchedule(m, local_batch, window_rows, max_iter)
+    stream = WindowedStream(cache, columns, ctx, sched.window, dtype, transforms)
+    return stream, sched
+
+
+def run_windows(
+    stream: "WindowedStream", sched: "WindowSchedule", dispatch, start_run: int = 0
+) -> None:
+    """Drive the window runs with one-ahead prefetch and lazy eviction.
+
+    ``dispatch(run_index, window_buffers, starts, active, n_active)`` must
+    *enqueue* the device program (async) and may return an ``observe``
+    callable; the driver calls it **after** prefetching the next window, so the
+    host gather + device_put overlaps the device compute, and stops the run
+    loop when it returns True (the streamed analogue of the host loop's
+    termination-criteria check). A window revisited by the very next run stays
+    resident; buffers are evicted as soon as the run sequence leaves them, so
+    at most two windows occupy HBM.
+    """
+    runs = sched.runs
+    if start_run >= len(runs):
+        return
+    bufs: Dict[int, Dict[str, jax.Array]] = {
+        runs[start_run][0]: stream.load(runs[start_run][0])
+    }
+    for i in range(start_run, len(runs)):
+        j, starts_local = runs[i]
+        starts_c, active_c, n_active = sched.padded(starts_local)
+        observe = dispatch(i, bufs[j], starts_c, active_c, n_active)
+        next_j = runs[i + 1][0] if i + 1 < len(runs) else None
+        if next_j is not None and next_j not in bufs:
+            bufs[next_j] = stream.load(next_j)  # overlaps the async dispatch
+        if next_j != j:
+            bufs.pop(j, None)
+        if observe is not None and observe():
+            break

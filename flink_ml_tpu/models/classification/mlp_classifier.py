@@ -309,3 +309,84 @@ class MLPClassifier(Estimator, _MlpParams):
         ]
         model.labels = labels.astype(np.float64)
         return model
+
+    def fit_stream(self, cache, classes=None, window_rows: int = 65_536) -> MLPClassifierModel:
+        """Train out of a host-tier cache larger than HBM.
+
+        ``cache`` is a HostDataCache/NativeDataCache with columns ``features``
+        [n, d], ``labels`` [n] (class values) and optional ``weights`` [n].
+        Per-shard HBM windows stream through the same fused chunk program as
+        ``fit`` with one-ahead prefetch (``iteration/streaming.py`` — the
+        ``ListStateWithCache.java:43`` role); with batch-aligned shards every
+        epoch consumes exactly the rows the in-HBM fit would (equal results up
+        to XLA fusion-order ULPs).
+        """
+        from flink_ml_tpu.iteration.streaming import plan_windows, run_windows
+
+        ctx = get_mesh_context()
+        if classes is None:
+            uniq: set = set()
+            for chunk in cache.iter_rows():
+                uniq.update(np.unique(np.asarray(chunk["labels"])).tolist())
+            classes = sorted(uniq)
+        classes = np.sort(np.asarray(classes, np.float64))
+
+        def to_index(a):
+            a64 = a.astype(np.float64)
+            idx = np.searchsorted(classes, a64)
+            bad = (idx >= len(classes)) | (classes[np.minimum(idx, len(classes) - 1)] != a64)
+            if bad.any():  # a silent mis-map would train on wrong targets
+                raise ValueError(
+                    f"labels {np.unique(a64[bad])} not in classes {classes}"
+                )
+            return idx
+
+        local_batch = max(1, -(-self.get_global_batch_size() // ctx.n_data))
+        local_batch = min(local_batch, -(-int(cache.num_rows) // ctx.n_data))
+        max_iter = self.get_max_iter()
+        stream, sched = plan_windows(
+            cache,
+            {"x": "features", "y": "labels", "w": "weights"},
+            ctx,
+            window_rows,
+            local_batch,
+            max_iter,
+            transforms={"y": to_index},
+        )
+        d = int(stream._shapes["x"][0])
+        dims = [d, *[int(h) for h in self.get_hidden_layers()], len(classes)]
+        rng = np.random.default_rng(self.get_seed())
+        params = [tuple(jnp.asarray(a) for a in layer) for layer in _init_params(rng, dims)]
+        optimizer = optax.adam(self.get_learning_rate())
+        check_loss = np.isfinite(self.get_tol()) and self.get_tol() > 0
+        fused = self._build_fused(
+            ctx, optimizer, local_batch, sched.chunk_len,
+            self.get_tol() if check_loss else None,
+        )
+        state = {
+            "params": params,
+            "opt_state": optimizer.init(params),
+            "done": ctx.replicate(np.asarray(False)),
+        }
+
+        def dispatch(i, win, starts_c, active_c, n_active):
+            w_col = win["w"] * win["__mask__"]
+            # starts double as offsets: window zero-mask padding realizes the
+            # short tail batch instead of the resident path's clamped re-read.
+            state["params"], state["opt_state"], state["done"], n_exec = fused(
+                state["params"], state["opt_state"], state["done"],
+                starts_c, starts_c, active_c, win["x"], win["y"], w_col,
+            )
+            if not check_loss:
+                return None
+            return lambda: int(jax.device_get(n_exec)) < n_active  # done mid-chunk
+
+        run_windows(stream, sched, dispatch)
+        model = MLPClassifierModel()
+        update_existing_params(model, self)
+        model.params = [
+            tuple(np.asarray(jax.device_get(a)) for a in layer)
+            for layer in state["params"]
+        ]
+        model.labels = classes
+        return model

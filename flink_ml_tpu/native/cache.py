@@ -52,6 +52,7 @@ class NativeDataCache:
 
     def __init__(self, memory_budget_bytes: int = 1 << 30, spill_dir: Optional[str] = None):
         self._store = NativeChunkStore(memory_budget_bytes, spill_dir)
+        self._chunk_rows: list = []
         self._n_rows = 0
         self._finished = False
 
@@ -64,6 +65,7 @@ class NativeDataCache:
         if len(lengths) != 1:
             raise ValueError(f"inconsistent column lengths {lengths}")
         self._store.append(_pack(chunk))
+        self._chunk_rows.append(next(iter(lengths)))
         self._n_rows += next(iter(lengths))
 
     def finish(self) -> None:
@@ -88,6 +90,14 @@ class NativeDataCache:
 
     def iter_rows(self) -> Iterator[Dict[str, np.ndarray]]:
         yield from self._chunks()
+
+    def rows(self, start: int, stop: int) -> Dict[str, np.ndarray]:
+        """Random-access gather of rows [start, stop) (see HostDataCache.rows)."""
+        from flink_ml_tpu.iteration.datacache import _gather_rows
+
+        return _gather_rows(
+            self._chunk_rows, lambda i: _unpack(self._store.read(i)), start, stop
+        )
 
     def iter_minibatches(self, batch_size: int, drop_last: bool = False):
         from flink_ml_tpu.iteration.stream import rebatch

@@ -271,6 +271,7 @@ class SGD(Optimizer):
         checkpoint_manager=None,
         checkpoint_interval: int = 0,
         listeners=(),
+        stream_window_rows: int = 65_536,
     ):
         self.max_iter = max_iter
         self.learning_rate = learning_rate
@@ -280,6 +281,7 @@ class SGD(Optimizer):
         self.elastic_net = elastic_net
         self.dtype = dtype
         self.ctx = ctx
+        self.stream_window_rows = stream_window_rows
         self.checkpoint_manager = checkpoint_manager
         self.checkpoint_interval = checkpoint_interval
         self.listeners = list(listeners)
@@ -322,6 +324,10 @@ class SGD(Optimizer):
         [n, d], ``labels`` [n] and optional ``weights`` [n].
         """
         ctx = self.ctx or get_mesh_context()
+        from flink_ml_tpu.iteration.streaming import is_host_cache
+
+        if is_host_cache(train_data):
+            return self._optimize_streaming(init_model, train_data, loss_func, ctx)
         if not isinstance(train_data, DeviceDataCache):
             cols = dict(train_data)
             if "weights" not in cols:
@@ -429,3 +435,134 @@ class SGD(Optimizer):
             [coef, offset], body, config=config, listeners=self.listeners
         )
         return np.asarray(jax.device_get(outputs[0]))
+
+    def _optimize_streaming(self, init_model, cache, loss_func: LossFunc, ctx) -> np.ndarray:
+        """Train out of a host-tier cache larger than HBM.
+
+        Streams per-shard windows (``iteration/streaming.py``) through the same
+        fused chunk program as the resident path: every epoch whose minibatch
+        falls inside the HBM-resident window runs in one dispatch, and the next
+        window is gathered + device_put while the device computes. With
+        batch-aligned shards every epoch consumes exactly the rows and weights
+        the DeviceDataCache path would (equal up to XLA fusion-order ULPs).
+
+        Checkpoints are taken at run (window-visit) boundaries — the coarsest
+        grain at which the coefficient exists on the host side — whenever at
+        least ``checkpoint_interval`` epochs have elapsed since the last one;
+        restore resumes at the saved run index. Per-epoch listeners need the
+        host loop and are rejected loudly rather than silently dropped.
+        """
+        from flink_ml_tpu.iteration.streaming import plan_windows, run_windows
+
+        if self.listeners:
+            raise ValueError(
+                "per-epoch listeners are not supported on the streamed "
+                "(larger-than-HBM) path; train from a DeviceDataCache instead"
+            )
+        local_batch = -(-self.global_batch_size // ctx.n_data)  # ceil
+        n_rows = int(cache.num_rows)
+        local_batch = min(local_batch, -(-n_rows // ctx.n_data))
+        stream, sched = plan_windows(
+            cache,
+            {"features": "features", "labels": "labels", "weights": "weights"},
+            ctx,
+            self.stream_window_rows,
+            local_batch,
+            self.max_iter,
+            dtype=self.dtype,
+        )
+        check_loss = np.isfinite(self.tol) and self.tol > 0
+        program = _fused_sgd_program(
+            ctx,
+            loss_func,
+            local_batch,
+            sched.chunk_len,
+            self.learning_rate,
+            self.reg,
+            self.elastic_net,
+            self.tol if check_loss else None,
+            self.dtype,
+        )
+        mgr = self.checkpoint_manager
+        start_run = 0
+        coef_host = np.asarray(init_model, self.dtype)
+        done_host = np.asarray(False)
+        self.loss_history = []
+        if mgr is not None:
+            import hashlib
+            import json as _json
+
+            sig = _json.dumps(
+                {
+                    "loss": type(loss_func).__name__,
+                    "max_iter": self.max_iter,
+                    "lr": self.learning_rate,
+                    "batch": self.global_batch_size,
+                    "tol": self.tol,
+                    "reg": self.reg,
+                    "elastic_net": self.elastic_net,
+                    "rows": n_rows,
+                    "dim": int(np.asarray(init_model).shape[0]),
+                    "window": sched.window,
+                    "streamed": True,
+                },
+                sort_keys=True,
+            )
+            mgr.set_fingerprint(hashlib.sha256(sig.encode()).hexdigest()[:16])
+            restored = mgr.restore_latest()
+            if restored is not None:
+                _, state = restored
+                start_run = int(state["next_run"])
+                coef_host = state["coef"]
+                done_host = np.asarray(bool(state["done"]))
+                self.loss_history = [float(x) for x in state["loss_history"]]
+
+        state = {
+            "coef": ctx.replicate(coef_host),
+            "done": ctx.replicate(done_host),
+            "epochs": sum(len(s) for _, s in sched.runs[:start_run]),
+            "last_saved": None,
+        }
+
+        def dispatch(i, win, starts_c, active_c, n_active):
+            # starts double as offsets: no clamped re-read in the streamed path —
+            # the window's zero-mask padding realizes the short tail batch.
+            state["coef"], state["done"], losses, n_exec = program(
+                state["coef"],
+                state["done"],
+                starts_c,
+                starts_c,
+                active_c,
+                win["features"],
+                win["labels"],
+                win["weights"],
+                win["__mask__"],
+            )
+            state["epochs"] += n_active
+
+            def observe():
+                stop = False
+                if check_loss:
+                    n = int(jax.device_get(n_exec))
+                    chunk_losses = np.asarray(jax.device_get(losses), np.float64)
+                    self.loss_history.extend(float(x) for x in chunk_losses[:n])
+                    stop = n < n_active  # done flipped mid-chunk
+                if mgr is not None and self.checkpoint_interval > 0:
+                    last = state["last_saved"]
+                    if last is None or state["epochs"] - last >= self.checkpoint_interval:
+                        mgr.save(
+                            state["epochs"],
+                            {
+                                "next_run": i + 1,
+                                "coef": state["coef"],
+                                "done": state["done"],
+                                "loss_history": np.asarray(self.loss_history, np.float64),
+                            },
+                        )
+                        state["last_saved"] = state["epochs"]
+                return stop
+
+            return observe
+
+        run_windows(stream, sched, dispatch, start_run=start_run)
+        return np.asarray(jax.device_get(state["coef"]))
