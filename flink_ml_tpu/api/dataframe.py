@@ -201,13 +201,31 @@ class DataFrame:
         return self.column(name)
 
     def vectors(self, name: str) -> np.ndarray:
-        """Column as a dense [n, d] float array (sparse vectors densified)."""
+        """Column as a dense [n, d] float array (sparse vectors densified —
+        use ``is_sparse``/``sparse_batch`` first when width matters)."""
         col = self.column(name)
         if isinstance(col, np.ndarray):
             if col.ndim == 1:
                 return col.astype(np.float64)[:, None]
             return col
         return np.stack([v.to_array() if isinstance(v, Vector) else np.asarray(v) for v in col])
+
+    def is_sparse(self, name: str) -> bool:
+        """Whether the column holds SparseVectors (the wide-features layout)."""
+        col = self.column(name)
+        return isinstance(col, list) and bool(col) and isinstance(col[0], SparseVector)
+
+    def sparse_batch(self, name: str):
+        """Column as a padded-CSR SparseBatch (linalg/sparse_batch.py) — the
+        layout that keeps Criteo-width features off the dense path entirely."""
+        from flink_ml_tpu.linalg.sparse_batch import SparseBatch
+
+        col = self.column(name)
+        if not (
+            isinstance(col, list) and col and all(isinstance(v, SparseVector) for v in col)
+        ):
+            raise TypeError(f"column {name!r} is not a SparseVector column")
+        return SparseBatch.from_vectors(col)
 
     def scalars(self, name: str, dtype=np.float64) -> np.ndarray:
         col = self.column(name)

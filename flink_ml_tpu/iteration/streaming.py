@@ -60,7 +60,9 @@ class WindowSchedule:
         W = -(-W // b) * b  # round up to a whole number of batches
         self.window = W
         self.n_windows = -(-local_rows // W)
-        self.chunk_len = W // b
+        # Capped by max_iter: a short training over a large window must not pad
+        # its one dispatch to a mostly-inactive full-width scan.
+        self.chunk_len = max(1, min(W // b, max_iter))
         _, offsets = offset_schedule(local_rows, b, max_iter)
         runs: List[Tuple[int, List[int]]] = []
         for off in offsets:
@@ -88,7 +90,11 @@ class WindowedStream:
     ``columns`` maps output name → cache column name; every loaded window is a
     dict of device arrays ``[n_data * W, ...]`` sharded over the data axis,
     plus ``"__mask__"`` (1.0 on real rows, 0.0 on window/global padding).
-    Missing cache columns (e.g. an optional ``weights``) fill with ones.
+    A column named in ``optional`` may be absent from the cache and fills with
+    ones (the weights default); any other missing column raises at construction
+    — a misnamed labels column must not silently train on constant targets.
+    ``dtypes`` overrides the default dtype per output column (e.g. int32 for
+    padded-CSR ``indices``, which must not round-trip through float).
 
     ``window`` must be the batch-aligned width from the matching
     ``WindowSchedule`` — construct both through ``plan_windows`` so they cannot
@@ -103,18 +109,31 @@ class WindowedStream:
         window: int,
         dtype=np.float32,
         transforms: Optional[Dict[str, object]] = None,
+        dtypes: Optional[Dict[str, object]] = None,
+        optional: Sequence[str] = ("weights", "w"),
     ):
         self.cache = cache
         self.columns = columns
         self.ctx = ctx
         self.dtype = np.dtype(dtype)
+        self.dtypes = {k: np.dtype(v) for k, v in (dtypes or {}).items()}
         self.transforms = transforms or {}
+        self.optional = set(optional)
         self.n = int(cache.num_rows)
         if self.n == 0:
             raise ValueError("cannot stream an empty cache")
         self.m = -(-self.n // ctx.n_data)  # per-shard rows (same as shard_batch pad)
         self.window = int(window)
         peek = cache.rows(0, 1)
+        missing = [
+            col
+            for out, col in columns.items()
+            if col not in peek and out not in self.optional
+        ]
+        if missing:
+            raise KeyError(
+                f"cache columns {missing} not found (cache has {sorted(peek)})"
+            )
         self._shapes = {}
         self._present = {}
         for out, col in columns.items():
@@ -125,7 +144,7 @@ class WindowedStream:
         """Assemble window ``j`` for every shard and place it on the mesh."""
         W, m, n, nd = self.window, self.m, self.n, self.ctx.n_data
         host: Dict[str, np.ndarray] = {
-            out: np.zeros((nd * W,) + self._shapes[out], self.dtype)
+            out: np.zeros((nd * W,) + self._shapes[out], self.dtypes.get(out, self.dtype))
             for out in self.columns
         }
         mask = np.zeros(nd * W, self.dtype)
@@ -142,7 +161,7 @@ class WindowedStream:
                     tf = self.transforms.get(out)
                     if tf is not None:
                         val = tf(np.asarray(val))
-                    host[out][sl] = np.asarray(val, self.dtype)
+                    host[out][sl] = np.asarray(val, self.dtypes.get(out, self.dtype))
                 else:
                     host[out][sl] = 1.0
             mask[sl] = 1.0
@@ -162,6 +181,7 @@ def plan_windows(
     max_iter: int,
     dtype=np.float32,
     transforms: Optional[Dict[str, object]] = None,
+    dtypes: Optional[Dict[str, object]] = None,
 ) -> Tuple["WindowedStream", "WindowSchedule"]:
     """Build a (stream, schedule) pair with a consistent batch-aligned width."""
     n = int(cache.num_rows)
@@ -169,7 +189,7 @@ def plan_windows(
         raise ValueError("cannot stream an empty cache")
     m = -(-n // ctx.n_data)
     sched = WindowSchedule(m, local_batch, window_rows, max_iter)
-    stream = WindowedStream(cache, columns, ctx, sched.window, dtype, transforms)
+    stream = WindowedStream(cache, columns, ctx, sched.window, dtype, transforms, dtypes)
     return stream, sched
 
 

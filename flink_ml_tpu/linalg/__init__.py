@@ -12,6 +12,7 @@ containers used at the DataFrame/API boundary; all hot-path compute takes raw
 
 from flink_ml_tpu.linalg import blas
 from flink_ml_tpu.linalg.matrix import DenseMatrix
+from flink_ml_tpu.linalg.sparse_batch import SparseBatch
 from flink_ml_tpu.linalg.vectors import (
     DenseVector,
     SparseVector,
@@ -27,5 +28,6 @@ __all__ = [
     "Vector",
     "VectorWithNorm",
     "Vectors",
+    "SparseBatch",
     "blas",
 ]

@@ -38,10 +38,9 @@ class HasThreshold(WithParams):
 
 
 @functools.cache
-def _predict_kernel():
+def _from_dots_kernel():
     @jax.jit
-    def kernel(X, coef, threshold):
-        dots = X @ coef
+    def kernel(dots, threshold):
         pred = (dots >= threshold).astype(dots.dtype)
         raw = jnp.stack([dots, -dots], axis=1)
         return pred, raw
@@ -50,15 +49,16 @@ def _predict_kernel():
 
 
 class LinearSVCModel(LinearModelBase, HasRawPredictionCol, HasThreshold):
-    """Ref LinearSVCModel.java."""
+    """Ref LinearSVCModel.java:177-180; margins via the shared dense/sparse
+    ``compute_dots`` so padded-CSR input never densifies."""
 
     def transform(self, *inputs):
+        from flink_ml_tpu.models.linear import compute_dots
+
         (df,) = inputs
-        X = df.vectors(self.get_features_col()).astype(np.float32)
-        pred, raw = _predict_kernel()(
-            X,
-            jnp.asarray(self.coefficient, jnp.float32),
-            jnp.asarray(self.get_threshold(), jnp.float32),
+        dots = compute_dots(df, self.get_features_col(), self.coefficient)
+        pred, raw = _from_dots_kernel()(
+            dots, jnp.asarray(self.get_threshold(), jnp.float32)
         )
         out = df.clone()
         out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))

@@ -12,22 +12,15 @@ Labels must be {0, 1} (binomial; the reference's ``multiClass`` param only suppo
 """
 from __future__ import annotations
 
-import functools
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.models.linear import LinearEstimatorBase, LinearModelBase
-from flink_ml_tpu.ops.kernels import logistic_predict_kernel
 from flink_ml_tpu.ops.lossfunc import BinaryLogisticLoss
 from flink_ml_tpu.params.shared import HasMultiClass, HasRawPredictionCol
 
 __all__ = ["LogisticRegression", "LogisticRegressionModel"]
-
-
-_predict_kernel = logistic_predict_kernel
 
 
 class LogisticRegressionModel(LinearModelBase, HasRawPredictionCol, HasMultiClass):
@@ -42,9 +35,12 @@ class LogisticRegressionModel(LinearModelBase, HasRawPredictionCol, HasMultiClas
         return LogisticRegressionModelServable.load_servable(path)
 
     def transform(self, *inputs):
+        from flink_ml_tpu.models.linear import compute_dots
+        from flink_ml_tpu.ops.kernels import logistic_from_dots_kernel
+
         (df,) = inputs
-        X = df.vectors(self.get_features_col()).astype(np.float32)
-        pred, raw = _predict_kernel()(X, jnp.asarray(self.coefficient, jnp.float32))
+        dots = compute_dots(df, self.get_features_col(), self.coefficient)
+        pred, raw = logistic_from_dots_kernel()(dots)
         out = df.clone()
         out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))
         out.add_column(

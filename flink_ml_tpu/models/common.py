@@ -25,17 +25,31 @@ def extract_labeled_data(
     label_col: Optional[str],
     weight_col: Optional[str],
     dtype=np.float32,
+    allow_sparse: bool = False,
 ) -> Dict[str, np.ndarray]:
     """DataFrame → columnar {features [n,d], labels [n], weights [n]} host batch.
 
     The analogue of the reference's ``tEnv.toDataStream(...).map(new
     LabeledPointWithWeight(...))`` boundary (LogisticRegression.java:60-80), minus the
     per-row object: columns come out as whole arrays.
+
+    With ``allow_sparse`` and a SparseVector column, features come out in the
+    padded-CSR layout instead — ``indices``/``values`` [n, K] plus ``dim`` —
+    so wide sparse training (the SparseVector.java path) never densifies.
     """
-    out = {"features": df.vectors(features_col).astype(dtype)}
+    if allow_sparse and df.is_sparse(features_col):
+        batch = df.sparse_batch(features_col)
+        out = {
+            "indices": batch.indices,
+            "values": batch.values.astype(dtype),
+            "dim": batch.dim,
+        }
+        n = batch.n
+    else:
+        out = {"features": df.vectors(features_col).astype(dtype)}
+        n = out["features"].shape[0]
     if label_col:
         out["labels"] = df.scalars(label_col, dtype)
-    n = out["features"].shape[0]
     out["weights"] = (
         df.scalars(weight_col, dtype) if weight_col else np.ones(n, dtype)
     )

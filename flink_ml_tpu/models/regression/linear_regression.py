@@ -5,10 +5,7 @@ Reference: ``flink-ml-lib/.../regression/linearregression/`` — ``LinearRegress
 """
 from __future__ import annotations
 
-import functools
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from flink_ml_tpu.api.types import DataTypes
@@ -18,18 +15,15 @@ from flink_ml_tpu.ops.lossfunc import LeastSquareLoss
 __all__ = ["LinearRegression", "LinearRegressionModel"]
 
 
-@functools.cache
-def _predict_kernel():
-    return jax.jit(lambda X, coef: X @ coef)
-
-
 class LinearRegressionModel(LinearModelBase):
-    """Ref LinearRegressionModel.java."""
+    """Ref LinearRegressionModel.java — prediction is the margin itself,
+    computed via the shared dense/sparse ``compute_dots``."""
 
     def transform(self, *inputs):
+        from flink_ml_tpu.models.linear import compute_dots
+
         (df,) = inputs
-        X = df.vectors(self.get_features_col()).astype(np.float32)
-        pred = _predict_kernel()(X, jnp.asarray(self.coefficient, jnp.float32))
+        pred = compute_dots(df, self.get_features_col(), self.coefficient)
         out = df.clone()
         out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))
         return out

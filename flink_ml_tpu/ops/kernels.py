@@ -11,22 +11,61 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["logistic_predict_kernel"]
+__all__ = [
+    "dot_kernel",
+    "sparse_dot_kernel",
+    "logistic_from_dots_kernel",
+    "logistic_predict_kernel",
+]
+
+
+@functools.cache
+def dot_kernel():
+    """Dense margins: one MXU matmul (the BLAS.java dot loop, batched)."""
+
+    @jax.jit
+    def kernel(X, coef):
+        return X @ coef
+
+    return kernel
+
+
+@functools.cache
+def sparse_dot_kernel():
+    """Padded-CSR margins: gather + row-sum (the BLAS.java sparse-dot branch,
+    batched; padding slots are index 0 / value 0 and contribute nothing)."""
+
+    @jax.jit
+    def kernel(indices, values, coef):
+        return jnp.sum(values * coef[indices], axis=1)
+
+    return kernel
+
+
+@functools.cache
+def logistic_from_dots_kernel():
+    """prediction = dot ≥ 0, rawPrediction = [1−p, p] with p = sigmoid(dot).
+
+    Ref LogisticRegressionModelServable.java:62 (shared by
+    LogisticRegressionModel, OnlineLogisticRegressionModel and the servable,
+    for both dense and sparse margins).
+    """
+
+    @jax.jit
+    def kernel(dots):
+        prob = jax.nn.sigmoid(dots)
+        pred = (dots >= 0).astype(dots.dtype)
+        return pred, jnp.stack([1.0 - prob, prob], axis=1)
+
+    return kernel
 
 
 @functools.cache
 def logistic_predict_kernel():
-    """prediction = dot ≥ 0, rawPrediction = [1−p, p] with p = sigmoid(dot).
-
-    Ref LogisticRegressionModelServable.java:62 (shared by
-    LogisticRegressionModel, OnlineLogisticRegressionModel and the servable).
-    """
+    """Dense-input convenience wrapper over ``logistic_from_dots_kernel``."""
 
     @jax.jit
     def kernel(X, coef):
-        dots = X @ coef
-        prob = jax.nn.sigmoid(dots)
-        pred = (dots >= 0).astype(dots.dtype)
-        return pred, jnp.stack([1.0 - prob, prob], axis=1)
+        return logistic_from_dots_kernel()(X @ coef)
 
     return kernel

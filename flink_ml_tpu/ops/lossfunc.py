@@ -36,6 +36,21 @@ class LossFunc:
         loss, grad = jax.value_and_grad(self.batch_loss_sum)(coef, X, y, w)
         return loss, grad
 
+    def loss_and_mult(self, dot, y, w):
+        """(Σ loss, per-row ∂loss/∂dot) from the margins ``dot = X @ coef``.
+
+        The dot-level primitive both feature layouts share: the dense path
+        turns ``mult`` into a gradient with ``X.T @ mult``, the padded-CSR
+        sparse path with a scatter-add of ``values * mult`` (optimizer.py).
+        All three reference losses are functions of the margin, so this is
+        exactly the reference's per-sample multiplier (e.g.
+        BinaryLogisticLoss.java computeGradient coefficient).
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement loss_and_mult; required "
+            "for sparse (padded-CSR) training"
+        )
+
 
 class BinaryLogisticLoss(LossFunc):
     """Ref BinaryLogisticLoss.java: loss = w·log(1 + exp(−dot·ys));
@@ -50,12 +65,15 @@ class BinaryLogisticLoss(LossFunc):
         return jnp.sum(w * jax.nn.softplus(-dot * ys))
 
     def loss_and_grad_sum(self, coef, X, y, w):
+        loss, multiplier = self.loss_and_mult(X @ coef, y, w)
+        return loss, X.T @ multiplier
+
+    def loss_and_mult(self, dot, y, w):
         ys = 2.0 * y - 1.0
-        z = (X @ coef) * ys
+        z = dot * ys
         loss = jnp.sum(w * jax.nn.softplus(-z))
         # -ys/(exp(z)+1) = -ys * sigmoid(-z)
-        multiplier = w * (-ys * jax.nn.sigmoid(-z))
-        return loss, X.T @ multiplier
+        return loss, w * (-ys * jax.nn.sigmoid(-z))
 
 
 class HingeLoss(LossFunc):
@@ -70,11 +88,14 @@ class HingeLoss(LossFunc):
         return jnp.sum(w * jnp.maximum(margin, 0.0))
 
     def loss_and_grad_sum(self, coef, X, y, w):
-        ys = 2.0 * y - 1.0
-        margin = 1.0 - ys * (X @ coef)
-        loss = jnp.sum(w * jnp.maximum(margin, 0.0))
-        multiplier = jnp.where(margin > 0.0, -ys * w, 0.0)
+        loss, multiplier = self.loss_and_mult(X @ coef, y, w)
         return loss, X.T @ multiplier
+
+    def loss_and_mult(self, dot, y, w):
+        ys = 2.0 * y - 1.0
+        margin = 1.0 - ys * dot
+        loss = jnp.sum(w * jnp.maximum(margin, 0.0))
+        return loss, jnp.where(margin > 0.0, -ys * w, 0.0)
 
 
 class LeastSquareLoss(LossFunc):
@@ -88,9 +109,13 @@ class LeastSquareLoss(LossFunc):
         return jnp.sum(w * 0.5 * err * err)
 
     def loss_and_grad_sum(self, coef, X, y, w):
-        err = X @ coef - y
+        loss, multiplier = self.loss_and_mult(X @ coef, y, w)
+        return loss, X.T @ multiplier
+
+    def loss_and_mult(self, dot, y, w):
+        err = dot - y
         loss = jnp.sum(w * 0.5 * err * err)
-        return loss, X.T @ (w * err)
+        return loss, w * err
 
 
 BinaryLogisticLoss.INSTANCE = BinaryLogisticLoss()

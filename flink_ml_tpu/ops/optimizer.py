@@ -91,19 +91,20 @@ class Optimizer:
 
 
 def _sgd_epoch_math(
-    coef, start, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
+    coef, start, offset, feats, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
 ):
     """One epoch of the per-shard SGD update (shared by the host-loop step and the
     fused whole-run program). ``start`` is the clamped slice start and ``offset``
     the logical batch offset (start == min(offset, m - local_batch)); both are
     supplied by the caller so the fused path can feed a *precomputed* schedule.
+    ``feats`` is either a dense [m, d] array or a padded-CSR
+    ``(indices [m, K], values [m, K])`` pair (linalg/sparse_batch.py).
     Returns (new_coef, mean_loss)."""
     # The minibatch is a *contiguous* window, so a dynamic_slice (cheap on TPU)
     # instead of a row gather (slow scatter/gather path). At the cache tail the
     # slice start clamps to m - local_batch; rows before ``offset`` in the clamped
     # window are re-reads and get zero weight, reproducing the reference's short
     # tail batch (SGD.java:265-268) exactly.
-    Xb = jax.lax.dynamic_slice_in_dim(X, start, local_batch)
     yb = jax.lax.dynamic_slice_in_dim(y, start, local_batch)
     tail_valid = (start + jnp.arange(local_batch) >= offset).astype(dtype)
     wb = (
@@ -111,7 +112,17 @@ def _sgd_epoch_math(
         * jax.lax.dynamic_slice_in_dim(mask, start, local_batch)
         * tail_valid
     )
-    loss_sum, grad_sum = loss_func.loss_and_grad_sum(coef, Xb, yb, wb)
+    if isinstance(feats, tuple):
+        # Sparse: dot = gather + row-sum, grad = scatter-add — both static-shaped.
+        # Padding slots (index 0 / value 0) and zero-weight rows contribute 0.
+        ib = jax.lax.dynamic_slice_in_dim(feats[0], start, local_batch)
+        vb = jax.lax.dynamic_slice_in_dim(feats[1], start, local_batch)
+        dot = jnp.sum(vb * coef[ib], axis=1)
+        loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
+        grad_sum = jnp.zeros_like(coef).at[ib.ravel()].add((vb * mult[:, None]).ravel())
+    else:
+        Xb = jax.lax.dynamic_slice_in_dim(feats, start, local_batch)
+        loss_sum, grad_sum = loss_func.loss_and_grad_sum(coef, Xb, yb, wb)
     packed = jnp.concatenate(
         [grad_sum, jnp.stack([jnp.sum(wb), loss_sum]).astype(grad_sum.dtype)]
     )
@@ -183,6 +194,7 @@ def _fused_sgd_program(
     elastic_net: float,
     tol: Optional[float],
     dtype,
+    sparse: bool = False,
 ):
     """A chunk of ``chunk_len`` SGD epochs as ONE jit'd SPMD program.
 
@@ -199,10 +211,11 @@ def _fused_sgd_program(
     is replicated across shards, so every device flips ``done`` on the same
     epoch.
 
-    Returns a callable ``(coef, done, starts, offsets, active, X, y, w, mask)
-    -> (coef, done, losses, n_executed)`` with ``losses`` a [chunk_len] buffer
-    (non-executed entries +inf). Programs are FIFO-cached per (mesh, loss,
-    shapes, hyperparameters) so repeated fits skip retracing.
+    Returns a callable ``(coef, done, starts, offsets, active, *data)
+    -> (coef, done, losses, n_executed)`` where ``data`` is ``(X, y, w, mask)``
+    dense or ``(indices, values, y, w, mask)`` sparse, and ``losses`` a
+    [chunk_len] buffer (non-executed entries +inf). Programs are FIFO-cached
+    per (mesh, loss, shapes, hyperparameters) so repeated fits skip retracing.
     """
     key = (
         ctx.mesh,
@@ -214,17 +227,21 @@ def _fused_sgd_program(
         elastic_net,
         tol,
         jnp.dtype(dtype).name,
+        sparse,
     )
     cached = _FUSED_CACHE.get(key)
     if cached is not None:
         return cached
 
-    def per_shard(coef, done, starts, offsets, active, X, y, w, mask):
+    def per_shard(coef, done, starts, offsets, active, *data):
+        feats = (data[0], data[1]) if sparse else data[0]
+        y, w, mask = data[-3:]
+
         def body(carry, schedule):
             c, done = carry
             start, offset, act = schedule
             new_c, mean_loss = _sgd_epoch_math(
-                c, start, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
+                c, start, offset, feats, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
             )
             executed = ~done & act
             new_c = jnp.where(executed, new_c, c)
@@ -239,14 +256,12 @@ def _fused_sgd_program(
         )
         return coef, done, losses, jnp.sum(executed.astype(jnp.int32))
 
+    n_data_args = 5 if sparse else 4
     program = jax.jit(
         jax.shard_map(
             per_shard,
             mesh=ctx.mesh,
-            in_specs=(
-                P(), P(), P(), P(), P(),
-                P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-            ),
+            in_specs=(P(), P(), P(), P(), P()) + (P(DATA_AXIS),) * n_data_args,
             out_specs=(P(), P(), P(), P()),
         ),
         donate_argnums=(0, 1),
@@ -287,26 +302,54 @@ class SGD(Optimizer):
         self.listeners = list(listeners)
         self.loss_history: List[float] = []
 
+    def _run_fingerprint(self, loss_func, rows: int, dim: int, extra=None) -> str:
+        """Run/config identity recorded with checkpoints: a different job
+        pointed at the same directory must fail loudly, not resume stale state.
+        Single source for both the host-loop and streamed paths."""
+        import hashlib
+        import json as _json
+
+        sig = {
+            "loss": type(loss_func).__name__,
+            "max_iter": self.max_iter,
+            "lr": self.learning_rate,
+            "batch": self.global_batch_size,
+            "tol": self.tol,
+            "reg": self.reg,
+            "elastic_net": self.elastic_net,
+            "rows": rows,
+            "dim": dim,
+        }
+        sig.update(extra or {})
+        return hashlib.sha256(
+            _json.dumps(sig, sort_keys=True).encode()
+        ).hexdigest()[:16]
+
     # -- the one SPMD program -------------------------------------------------
-    def _build_step(self, ctx: MeshContext, loss_func: LossFunc, local_batch: int):
+    def _build_step(
+        self, ctx: MeshContext, loss_func: LossFunc, local_batch: int, sparse: bool = False
+    ):
         lr = self.learning_rate
         reg, elastic_net = self.reg, self.elastic_net
         dtype = self.dtype
 
-        def per_shard(coef, offset, X, y, w, mask):
-            m = X.shape[0]
+        def per_shard(coef, offset, *data):
+            feats = (data[0], data[1]) if sparse else data[0]
+            y, w, mask = data[-3:]
+            m = y.shape[0]
             start = jnp.minimum(offset, m - local_batch)
             new_coef, mean_loss = _sgd_epoch_math(
-                coef, start, offset, X, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
+                coef, start, offset, feats, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
             )
             next_offset = jnp.where(offset + local_batch >= m, 0, offset + local_batch)
             return new_coef, next_offset, mean_loss
 
+        n_data_args = 5 if sparse else 4
         return jax.jit(
             jax.shard_map(
                 per_shard,
                 mesh=ctx.mesh,
-                in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                in_specs=(P(), P()) + (P(DATA_AXIS),) * n_data_args,
                 out_specs=(P(), P(), P()),
             ),
             donate_argnums=(0,),
@@ -320,8 +363,10 @@ class SGD(Optimizer):
     ) -> np.ndarray:
         """Train and return the final coefficient (host array).
 
-        ``train_data``: DeviceDataCache (or dict of host columns) with ``features``
-        [n, d], ``labels`` [n] and optional ``weights`` [n].
+        ``train_data``: DeviceDataCache (or dict of host columns) with ``labels``
+        [n], optional ``weights`` [n], and either dense ``features`` [n, d] or
+        padded-CSR ``indices``/``values`` [n, K] (SparseBatch layout — the
+        SparseVector.java training path without densifying).
         """
         ctx = self.ctx or get_mesh_context()
         from flink_ml_tpu.iteration.streaming import is_host_cache
@@ -333,12 +378,20 @@ class SGD(Optimizer):
             if "weights" not in cols:
                 cols["weights"] = np.ones(np.asarray(cols["labels"]).shape[0])
             train_data = DeviceDataCache(
-                {k: np.asarray(v, self.dtype) for k, v in cols.items()}, ctx=ctx
+                {
+                    k: np.asarray(v, np.int32 if k == "indices" else self.dtype)
+                    for k, v in cols.items()
+                },
+                ctx=ctx,
             )
-        X = train_data["features"]
+        sparse = "indices" in train_data.arrays
         y = train_data["labels"]
         w = train_data["weights"]
         mask = train_data.mask.astype(self.dtype)
+        if sparse:
+            data_args = (train_data["indices"], train_data["values"], y, w, mask)
+        else:
+            data_args = (train_data["features"], y, w, mask)
 
         local_batch = -(-self.global_batch_size // ctx.n_data)  # ceil
         local_batch = min(local_batch, train_data.local_rows)
@@ -365,6 +418,7 @@ class SGD(Optimizer):
                 self.elastic_net,
                 self.tol if check_loss else None,
                 self.dtype,
+                sparse=sparse,
             )
             starts, offsets = offset_schedule(train_data.local_rows, local_batch, self.max_iter)
             coef = ctx.replicate(np.asarray(init_model, self.dtype))
@@ -374,7 +428,7 @@ class SGD(Optimizer):
                 starts, offsets, self.max_iter, chunk
             ):
                 coef, done, losses, n_exec = program(
-                    coef, done, starts_c, offsets_c, active_c, X, y, w, mask
+                    coef, done, starts_c, offsets_c, active_c, *data_args
                 )
                 if check_loss:
                     n = int(jax.device_get(n_exec))
@@ -384,30 +438,15 @@ class SGD(Optimizer):
                         break
             return np.asarray(jax.device_get(coef))
 
-        step = self._build_step(ctx, loss_func, local_batch)
+        step = self._build_step(ctx, loss_func, local_batch, sparse=sparse)
 
         if self.checkpoint_manager is not None:
-            # Run identity: a different config/data shape pointed at the same
-            # checkpoint directory must not silently resume stale state.
-            import hashlib
-            import json as _json
-
-            sig = _json.dumps(
-                {
-                    "loss": type(loss_func).__name__,
-                    "max_iter": self.max_iter,
-                    "lr": self.learning_rate,
-                    "batch": self.global_batch_size,
-                    "tol": self.tol,
-                    "reg": self.reg,
-                    "elastic_net": self.elastic_net,
-                    "rows": int(train_data.n_valid),
-                    "dim": int(np.shape(X)[1]),
-                },
-                sort_keys=True,
-            )
             self.checkpoint_manager.set_fingerprint(
-                hashlib.sha256(sig.encode()).hexdigest()[:16]
+                self._run_fingerprint(
+                    loss_func,
+                    int(train_data.n_valid),
+                    int(np.asarray(init_model).shape[0]),
+                )
             )
 
         coef = ctx.replicate(np.asarray(init_model, self.dtype))
@@ -417,7 +456,7 @@ class SGD(Optimizer):
 
         def body(variables, epoch):
             cur_coef, cur_offset = variables
-            new_coef, new_offset, mean_loss = step(cur_coef, cur_offset, X, y, w, mask)
+            new_coef, new_offset, mean_loss = step(cur_coef, cur_offset, *data_args)
             if check_loss:
                 self.loss_history.append(float(jax.device_get(mean_loss)))
                 cont = criteria(epoch, self.loss_history[-1])
@@ -462,14 +501,27 @@ class SGD(Optimizer):
         local_batch = -(-self.global_batch_size // ctx.n_data)  # ceil
         n_rows = int(cache.num_rows)
         local_batch = min(local_batch, -(-n_rows // ctx.n_data))
+        sparse = "indices" in cache.rows(0, 1)
+        if sparse:
+            columns = {
+                "indices": "indices",
+                "values": "values",
+                "labels": "labels",
+                "weights": "weights",
+            }
+            feat_keys = ("indices", "values")
+        else:
+            columns = {"features": "features", "labels": "labels", "weights": "weights"}
+            feat_keys = ("features",)
         stream, sched = plan_windows(
             cache,
-            {"features": "features", "labels": "labels", "weights": "weights"},
+            columns,
             ctx,
             self.stream_window_rows,
             local_batch,
             self.max_iter,
             dtype=self.dtype,
+            dtypes={"indices": np.int32} if sparse else None,
         )
         check_loss = np.isfinite(self.tol) and self.tol > 0
         program = _fused_sgd_program(
@@ -482,6 +534,7 @@ class SGD(Optimizer):
             self.elastic_net,
             self.tol if check_loss else None,
             self.dtype,
+            sparse=sparse,
         )
         mgr = self.checkpoint_manager
         start_run = 0
@@ -489,26 +542,14 @@ class SGD(Optimizer):
         done_host = np.asarray(False)
         self.loss_history = []
         if mgr is not None:
-            import hashlib
-            import json as _json
-
-            sig = _json.dumps(
-                {
-                    "loss": type(loss_func).__name__,
-                    "max_iter": self.max_iter,
-                    "lr": self.learning_rate,
-                    "batch": self.global_batch_size,
-                    "tol": self.tol,
-                    "reg": self.reg,
-                    "elastic_net": self.elastic_net,
-                    "rows": n_rows,
-                    "dim": int(np.asarray(init_model).shape[0]),
-                    "window": sched.window,
-                    "streamed": True,
-                },
-                sort_keys=True,
+            mgr.set_fingerprint(
+                self._run_fingerprint(
+                    loss_func,
+                    n_rows,
+                    int(np.asarray(init_model).shape[0]),
+                    extra={"window": sched.window, "streamed": True},
+                )
             )
-            mgr.set_fingerprint(hashlib.sha256(sig.encode()).hexdigest()[:16])
             restored = mgr.restore_latest()
             if restored is not None:
                 _, state = restored
@@ -533,7 +574,7 @@ class SGD(Optimizer):
                 starts_c,
                 starts_c,
                 active_c,
-                win["features"],
+                *[win[k] for k in feat_keys],
                 win["labels"],
                 win["weights"],
                 win["__mask__"],

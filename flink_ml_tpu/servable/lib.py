@@ -20,13 +20,10 @@ from flink_ml_tpu.params.shared import (
     HasPredictionCol,
     HasRawPredictionCol,
 )
-from flink_ml_tpu.ops.kernels import logistic_predict_kernel
 from flink_ml_tpu.servable.api import ModelServable
 
 __all__ = ["LogisticRegressionModelServable"]
 
-
-_kernel = logistic_predict_kernel
 
 
 class LogisticRegressionModelServable(
@@ -44,8 +41,11 @@ class LogisticRegressionModelServable(
         """Ref transform:62 — prediction = dot ≥ 0, rawPrediction = [1−p, p]."""
         if self.coefficient is None:
             raise RuntimeError("set_model_data must be called before transform")
-        X = df.vectors(self.get_features_col()).astype(np.float32)
-        pred, raw = _kernel()(X, jnp.asarray(self.coefficient, jnp.float32))
+        from flink_ml_tpu.models.linear import compute_dots
+        from flink_ml_tpu.ops.kernels import logistic_from_dots_kernel
+
+        dots = compute_dots(df, self.get_features_col(), self.coefficient)
+        pred, raw = logistic_from_dots_kernel()(dots)
         out = df.clone()
         out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))
         out.add_column(

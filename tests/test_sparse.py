@@ -1,0 +1,204 @@
+"""Sparse (padded-CSR) training and inference.
+
+Ref SparseVector.java + BLAS.java:30-179 sparse branches: the reference's
+linear models consume SparseVector end-to-end. Here the contract under test is
+(a) sparse training/inference agrees with the densified path on narrow data,
+and (b) Criteo-width data (d = 2^20) trains and serves without ever
+materializing an [n, d] array.
+"""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.iteration import IterationListener
+from flink_ml_tpu.linalg.sparse_batch import SparseBatch
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.ops import SGD, BinaryLogisticLoss, HingeLoss, LeastSquareLoss
+
+
+def _to_sparse_rows(X):
+    rows = []
+    for r in X:
+        nz = np.nonzero(r)[0]
+        rows.append(SparseVector(X.shape[1], nz, r[nz]))
+    return rows
+
+
+def _sparse_data(n, d, nnz, seed=0):
+    """Random sparse rows; labels from a sparse ground-truth coefficient."""
+    rng = np.random.default_rng(seed)
+    idx = np.stack([rng.choice(d, nnz, replace=False) for _ in range(n)]).astype(np.int32)
+    vals = rng.standard_normal((n, nnz)).astype(np.float32)
+    w_true = np.zeros(d, np.float32)
+    hot = rng.choice(d, 64, replace=False)
+    w_true[hot] = rng.standard_normal(64)
+    dots = np.sum(vals * w_true[idx], axis=1)
+    y = (dots > 0).astype(np.float32)
+    return idx, vals, y
+
+
+class TestSparseBatch:
+    def test_from_vectors_pads_and_round_trips(self):
+        vecs = [
+            SparseVector(10, [1, 7], [2.0, -1.0]),
+            SparseVector(10, [0], [3.0]),
+            SparseVector(10, [], []),
+        ]
+        batch = SparseBatch.from_vectors(vecs)
+        assert batch.dim == 10 and batch.n == 3 and batch.width == 8  # padded to lane
+        np.testing.assert_array_equal(batch.densify(), np.stack([v.to_array() for v in vecs]))
+        got = batch.row(0)
+        np.testing.assert_array_equal(got.indices, [1, 7])
+        np.testing.assert_array_equal(got.values, [2.0, -1.0])
+
+    def test_inconsistent_dims_rejected(self):
+        with pytest.raises(ValueError, match="sizes"):
+            SparseBatch.from_vectors([SparseVector(5, [0], [1.0]), SparseVector(6, [0], [1.0])])
+
+
+class TestLossAndMult:
+    @pytest.mark.parametrize(
+        "loss", [BinaryLogisticLoss.INSTANCE, HingeLoss.INSTANCE, LeastSquareLoss.INSTANCE]
+    )
+    def test_mult_reproduces_gradient(self, loss):
+        """X.T @ mult (the dot-level primitive) must equal loss_and_grad_sum."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        X = rng.standard_normal((32, 6)).astype(np.float32)
+        y = rng.integers(0, 2, 32).astype(np.float32)
+        w = rng.uniform(0.5, 2.0, 32).astype(np.float32)
+        coef = rng.standard_normal(6).astype(np.float32)
+        want_loss, want_grad = loss.loss_and_grad_sum(
+            jnp.asarray(coef), jnp.asarray(X), jnp.asarray(y), jnp.asarray(w)
+        )
+        got_loss, mult = loss.loss_and_mult(jnp.asarray(X @ coef), jnp.asarray(y), jnp.asarray(w))
+        np.testing.assert_allclose(got_loss, want_loss, rtol=1e-6)
+        np.testing.assert_allclose(X.T @ np.asarray(mult), np.asarray(want_grad), rtol=1e-5, atol=1e-6)
+
+
+class TestSparseSGD:
+    def _narrow(self, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.standard_normal((96, 12)).astype(np.float32)
+        X[rng.random(X.shape) < 0.6] = 0.0  # sparsify
+        y = (X @ rng.standard_normal(12) > 0).astype(np.float32)
+        return X, y
+
+    @pytest.mark.parametrize("tol", [0.0, 0.3])
+    def test_sparse_matches_dense_fused(self, tol):
+        X, y = self._narrow()
+        batch = SparseBatch.from_vectors(_to_sparse_rows(X))
+        kwargs = dict(max_iter=25, global_batch_size=32, tol=tol, learning_rate=0.4,
+                      reg=0.01, elastic_net=0.5)
+        dense = SGD(**kwargs).optimize(
+            np.zeros(12, np.float32), {"features": X, "labels": y}, BinaryLogisticLoss.INSTANCE
+        )
+        sparse = SGD(**kwargs).optimize(
+            np.zeros(12, np.float32),
+            {"indices": batch.indices, "values": batch.values, "labels": y},
+            BinaryLogisticLoss.INSTANCE,
+        )
+        np.testing.assert_allclose(sparse, dense, rtol=1e-4, atol=1e-6)
+
+    def test_sparse_host_loop_matches_fused(self):
+        X, y = self._narrow(seed=5)
+        batch = SparseBatch.from_vectors(_to_sparse_rows(X))
+        cols = {"indices": batch.indices, "values": batch.values, "labels": y}
+        kwargs = dict(max_iter=10, global_batch_size=32, tol=0.0, learning_rate=0.4)
+        fused = SGD(**kwargs).optimize(np.zeros(12, np.float32), cols, BinaryLogisticLoss.INSTANCE)
+        # A listener forces the per-epoch host loop; same math, same result.
+        host = SGD(listeners=[IterationListener()], **kwargs).optimize(
+            np.zeros(12, np.float32), cols, BinaryLogisticLoss.INSTANCE
+        )
+        np.testing.assert_allclose(host, fused, rtol=1e-5, atol=1e-6)
+
+    def test_sparse_streamed_matches_resident(self, tmp_path):
+        from flink_ml_tpu.iteration import HostDataCache
+
+        idx, vals, y = _sparse_data(n=128, d=512, nnz=8, seed=2)
+        cols = {"indices": idx, "values": vals, "labels": y}
+        kwargs = dict(max_iter=13, global_batch_size=32, tol=0.0, learning_rate=0.3)
+        want = SGD(**kwargs).optimize(np.zeros(512, np.float32), cols, BinaryLogisticLoss.INSTANCE)
+        cache = HostDataCache(memory_budget_bytes=2000, spill_dir=str(tmp_path))
+        for a in range(0, 128, 24):
+            cache.append({k: v[a : a + 24] for k, v in cols.items()})
+        cache.finish()
+        assert any("files" in e for e in cache._log), "budget should force spill"
+        got = SGD(stream_window_rows=8, **kwargs).optimize(
+            np.zeros(512, np.float32), cache, BinaryLogisticLoss.INSTANCE
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestSparseLinearModels:
+    def test_logistic_regression_sparse_end_to_end_wide(self):
+        """Criteo-shaped: d = 2^20 would be ~2 GB densified at n=512; the sparse
+        path trains and serves it without ever building [n, d]."""
+        from flink_ml_tpu.models.classification.logistic_regression import LogisticRegression
+
+        d = 1 << 20
+        idx, vals, y = _sparse_data(n=512, d=d, nnz=8, seed=4)
+        rows = [SparseVector(d, np.sort(r), v[np.argsort(r)]) for r, v in zip(idx, vals)]
+        df = DataFrame.from_dict({"features": rows, "label": y.astype(np.float64)})
+        est = (
+            LogisticRegression()
+            .set_max_iter(60)
+            .set_global_batch_size(256)
+            .set_learning_rate(1.0)
+            .set_tol(0.0)
+        )
+        model = est.fit(df)
+        assert model.coefficient.shape == (d,)
+        out = model.transform(df)
+        acc = np.mean(out.column("prediction") == y)
+        assert acc > 0.8, f"sparse LR failed to learn: acc={acc}"
+        raw = out.column("rawPrediction")
+        assert raw.shape == (512, 2)
+
+    def test_sparse_dense_transform_parity(self):
+        """The same model must produce identical margins for a sparse column and
+        its densified twin (LinearSVC + LinearRegression + LR servable)."""
+        from flink_ml_tpu.models.classification.linearsvc import LinearSVCModel
+        from flink_ml_tpu.models.regression.linear_regression import LinearRegressionModel
+
+        rng = np.random.default_rng(9)
+        X = rng.standard_normal((40, 16)).astype(np.float32)
+        X[rng.random(X.shape) < 0.5] = 0.0
+        coef = rng.standard_normal(16).astype(np.float32)
+        df_dense = DataFrame.from_dict({"features": X})
+        df_sparse = DataFrame.from_dict({"features": _to_sparse_rows(X)})
+        assert df_sparse.is_sparse("features") and not df_dense.is_sparse("features")
+
+        svc = LinearSVCModel()
+        svc.coefficient = coef
+        np.testing.assert_allclose(
+            svc.transform(df_sparse).column("rawPrediction"),
+            svc.transform(df_dense).column("rawPrediction"),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+        lin = LinearRegressionModel()
+        lin.coefficient = coef
+        np.testing.assert_allclose(
+            lin.transform(df_sparse).column("prediction"),
+            lin.transform(df_dense).column("prediction"),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_lr_sparse_fit_matches_dense_fit(self):
+        from flink_ml_tpu.models.classification.logistic_regression import LogisticRegression
+
+        rng = np.random.default_rng(11)
+        X = rng.standard_normal((64, 10)).astype(np.float32)
+        X[rng.random(X.shape) < 0.5] = 0.0
+        y = (X @ rng.standard_normal(10) > 0).astype(np.float64)
+        est = LogisticRegression().set_max_iter(15).set_global_batch_size(32).set_tol(0.0)
+        dense_model = est.fit(DataFrame.from_dict({"features": X, "label": y}))
+        sparse_model = est.fit(
+            DataFrame.from_dict({"features": _to_sparse_rows(X), "label": y})
+        )
+        np.testing.assert_allclose(
+            sparse_model.coefficient, dense_model.coefficient, rtol=1e-4, atol=1e-6
+        )

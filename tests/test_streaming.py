@@ -45,6 +45,27 @@ def test_rows_random_access_across_spill(tmp_path):
         cache.rows(90, 101)
 
 
+def test_misnamed_required_column_raises(tmp_path):
+    # A cache built with 'label' (singular) must fail loudly, not silently
+    # train against all-ones targets.
+    X, y = _make_data(32, 3)
+    cache = HostDataCache()
+    cache.append({"features": X, "label": y})
+    cache.finish()
+    with pytest.raises(KeyError, match="labels"):
+        SGD(stream_window_rows=8, max_iter=2, tol=0.0).optimize(
+            np.zeros(3, np.float32), cache, BinaryLogisticLoss.INSTANCE
+        )
+
+
+def test_chunk_len_capped_by_max_iter():
+    # A short training over a huge window must not pad its dispatch to a
+    # mostly-inactive full-width scan.
+    sched = WindowSchedule(local_rows=65_536, local_batch=64, window_rows=65_536, max_iter=5)
+    assert sched.chunk_len == 5
+    assert [len(s) for _, s in sched.runs] == [5]
+
+
 def test_window_schedule_covers_all_epochs():
     sched = WindowSchedule(local_rows=10, local_batch=2, window_rows=4, max_iter=13)
     assert sched.window == 4 and sched.chunk_len == 2
