@@ -217,15 +217,16 @@ class DataFrame:
 
     def sparse_batch(self, name: str):
         """Column as a padded-CSR SparseBatch (linalg/sparse_batch.py) — the
-        layout that keeps Criteo-width features off the dense path entirely."""
+        layout that keeps Criteo-width features off the dense path entirely.
+        A mixed column's occasional DenseVectors are converted row-wise, so
+        anything ``is_sparse`` says yes to packs without error."""
         from flink_ml_tpu.linalg.sparse_batch import SparseBatch
 
         col = self.column(name)
-        if not (
-            isinstance(col, list) and col and all(isinstance(v, SparseVector) for v in col)
-        ):
-            raise TypeError(f"column {name!r} is not a SparseVector column")
-        return SparseBatch.from_vectors(col)
+        if not (isinstance(col, list) and col and all(isinstance(v, Vector) for v in col)):
+            raise TypeError(f"column {name!r} is not a vector column")
+        vecs = [v if isinstance(v, SparseVector) else v.to_sparse() for v in col]
+        return SparseBatch.from_vectors(vecs)
 
     def scalars(self, name: str, dtype=np.float64) -> np.ndarray:
         col = self.column(name)

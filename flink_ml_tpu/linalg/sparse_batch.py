@@ -36,9 +36,15 @@ class SparseBatch:
     zero-index/zero-value padding.
     """
 
-    __slots__ = ("dim", "indices", "values")
+    __slots__ = ("dim", "indices", "values", "nnz")
 
-    def __init__(self, dim: int, indices: np.ndarray, values: np.ndarray):
+    def __init__(
+        self,
+        dim: int,
+        indices: np.ndarray,
+        values: np.ndarray,
+        nnz: Optional[np.ndarray] = None,
+    ):
         indices = np.asarray(indices, np.int32)
         values = np.asarray(values, np.float32)
         if indices.shape != values.shape or indices.ndim != 2:
@@ -49,6 +55,9 @@ class SparseBatch:
         self.dim = int(dim)
         self.indices = indices
         self.values = values
+        # Per-row stored-entry counts: lets row() round-trip explicit zeros
+        # (which are indistinguishable from padding by value alone).
+        self.nnz = None if nnz is None else np.asarray(nnz, np.int32)
 
     @property
     def n(self) -> int:
@@ -77,13 +86,18 @@ class SparseBatch:
         n = len(vectors)
         indices = np.zeros((n, K), np.int32)
         values = np.zeros((n, K), np.float32)
+        nnz = np.zeros(n, np.int32)
         for i, v in enumerate(vectors):
             k = len(v.indices)
             indices[i, :k] = v.indices
             values[i, :k] = v.values
-        return cls(dim, indices, values)
+            nnz[i] = k
+        return cls(dim, indices, values, nnz=nnz)
 
     def row(self, i: int) -> SparseVector:
+        if self.nnz is not None:  # exact round-trip, explicit zeros included
+            k = int(self.nnz[i])
+            return SparseVector(self.dim, self.indices[i, :k], self.values[i, :k])
         nz = self.values[i] != 0.0
         return SparseVector(self.dim, self.indices[i][nz], self.values[i][nz])
 

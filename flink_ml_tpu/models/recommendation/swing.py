@@ -10,10 +10,26 @@ dropped; each item's purchaser list is reservoir-sampled down to
 outputCol: "item,score;item,score;…" for the top ``k``) — same string encoding
 (Swing.java:344-361). Defaults: k=100, maxUserNumPerItem=1000,
 minUserBehavior=10, maxUserBehavior=1000, alpha1=15, alpha2=0, beta=0.3.
+
+TPU mapping — the reference's per-item purchaser-pair loops (keyed
+co-occurrence over a shuffled stream) become batched linear algebra. With
+``B`` the {0,1} user×item incidence of the retained users and
+``M_uv = w_u·w_v / (alpha2 + (B·Bᵀ)_uv)`` the pair-weight matrix (zero
+diagonal, zero where no common item), the whole inner loop nest is
+
+    sim(i, j) = ½ Σ_{u,v ∈ purchasers(i)} M_uv · B_uj · B_vj
+              = ½ · colsum( B_i ⊙ (M_i @ B_i) )_j
+
+i.e. one [P,P]@[P,I] matmul + an elementwise reduce per item, where ``B_i``,
+``M_i`` gather the (capped) purchaser rows. Items are sharded over the mesh's
+data axis (shard_map) and scored with ``lax.map`` + ``lax.top_k`` inside one
+jit program; host work is only the O(interactions) grouping/capping and the
+final string formatting. Padding uses a sentinel user row with zero
+weight/incidence so every shape is static.
 """
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import List
 
 import numpy as np
 
@@ -23,6 +39,69 @@ from flink_ml_tpu.params.param import FloatParam, IntParam, ParamValidators, Str
 from flink_ml_tpu.params.shared import HasOutputCol, HasSeed
 
 __all__ = ["Swing"]
+
+
+_SWING_CACHE: dict = {}
+
+
+def _swing_program(ctx, alpha2: float, k: int):
+    """The jit'd item-sharded scoring program, FIFO-cached per (mesh, alpha2, k)
+    like the optimizer's fused programs (jit re-specializes on shapes itself).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from flink_ml_tpu.ops.optimizer import _cache_put
+    from flink_ml_tpu.parallel.mesh import DATA_AXIS
+
+    key = (ctx.mesh, alpha2, k)
+    cached = _SWING_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    def per_shard(idx_s, item_ids_s, B, w):
+        def one(args):
+            idx_i, item_i = args
+            Bi = B[idx_i]  # [P, I] the capped purchasers' full item rows
+            wi = w[idx_i]  # [P]   their weights (sentinel rows 0)
+            # Pair weights among this item's purchasers only — [P, P] keeps
+            # memory independent of the total user count. Ci counts common
+            # items; pairs with none contribute nothing (the reference skips
+            # them — this also guards the 0/0 when alpha2 == 0), and u == v
+            # is not a pair.
+            Ci = Bi @ Bi.T
+            Mi = jnp.where(Ci > 0, (wi[:, None] * wi[None, :]) / (alpha2 + Ci), 0.0)
+            Mi = Mi * (1.0 - jnp.eye(Mi.shape[0], dtype=Mi.dtype))
+            S = 0.5 * jnp.sum(Bi * (Mi @ Bi), axis=0)  # [I]
+            S = S.at[item_i].set(0.0)  # j != i
+            top_vals, top_inds = jax.lax.top_k(S, k)
+            return top_vals, top_inds
+
+        vals, inds = jax.lax.map(one, (idx_s, item_ids_s))
+        return vals, inds
+
+    program = jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=ctx.mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        )
+    )
+    _cache_put(_SWING_CACHE, key, program)
+    return program
+
+
+def _swing_scores(idx, item_ids, B, w, alpha2: float, k: int, ctx):
+    """Top-k swing scores for every item, sharded over the mesh's data axis.
+
+    ``idx [n_items_padded, P]`` — purchaser row indices into ``B`` (sentinel =
+    last row, all-zero); ``item_ids`` — each row's own column index (for the
+    j ≠ i exclusion); ``B [U+1, I]`` incidence, ``w [U+1]`` user weights
+    (sentinel 0). Returns (values, indices) [n_items_padded, k].
+    """
+    return _swing_program(ctx, alpha2, k)(idx, item_ids, B, w)
 
 
 class Swing(AlgoOperator, HasOutputCol, HasSeed):
@@ -116,6 +195,8 @@ class Swing(AlgoOperator, HasOutputCol, HasSeed):
         return self.set(self.BETA, value)
 
     def transform(self, *inputs):
+        from flink_ml_tpu.parallel.mesh import get_mesh_context
+
         (df,) = inputs
         if self.get_max_user_behavior() < self.get_min_user_behavior():
             raise ValueError(
@@ -123,48 +204,74 @@ class Swing(AlgoOperator, HasOutputCol, HasSeed):
             )
         users = np.asarray(df.column(self.get_user_col()), np.int64)
         items = np.asarray(df.column(self.get_item_col()), np.int64)
+        empty = DataFrame(
+            [self.get_item_col(), self.get_output_col()],
+            None,
+            [np.asarray([], np.int64), []],
+        )
+        if users.size == 0:
+            return empty
 
-        # user → sorted unique purchased items, filtered by behavior bounds
-        user_items: Dict[int, np.ndarray] = {}
-        for u in np.unique(users):
-            its = np.unique(items[users == u])
-            if self.get_min_user_behavior() <= len(its) <= self.get_max_user_behavior():
-                user_items[int(u)] = its
+        # --- host: dedup, behavior-bound filter, cap (O(interactions)) --------
+        pairs = np.unique(np.stack([users, items], axis=1), axis=0)
+        u_ids, u_inv = np.unique(pairs[:, 0], return_inverse=True)
+        i_ids, i_inv = np.unique(pairs[:, 1], return_inverse=True)
+        deg = np.bincount(u_inv)
+        keep = (deg >= self.get_min_user_behavior()) & (deg <= self.get_max_user_behavior())
+        kept_rows = keep[u_inv]
+        if not np.any(kept_rows):
+            return empty
+        # dense re-index of retained users; sentinel row U for padding
+        new_uid = np.full(len(u_ids), -1, np.int64)
+        new_uid[keep] = np.arange(int(keep.sum()))
+        ku = new_uid[u_inv[kept_rows]]
+        ki = i_inv[kept_rows]
+        U, I = int(keep.sum()), len(i_ids)
+
         alpha1, alpha2, beta = self.get_alpha1(), self.get_alpha2(), self.get_beta()
-        weights = {u: 1.0 / (alpha1 + len(its)) ** beta for u, its in user_items.items()}
+        B = np.zeros((U + 1, I), np.float32)
+        B[ku, ki] = 1.0
+        w = np.zeros(U + 1, np.float32)
+        w[:U] = 1.0 / (alpha1 + deg[keep].astype(np.float64)) ** beta
 
-        # item → purchasers (only retained users), reservoir-capped
+        # item → capped purchaser lists, padded to a static width with the
+        # sentinel user (zero weight/incidence ⇒ contributes nothing)
         rng = np.random.default_rng(self.get_seed())
-        item_users: Dict[int, List[int]] = {}
-        for u, its in user_items.items():
-            for i in its:
-                item_users.setdefault(int(i), []).append(u)
         cap = self.get_max_user_num_per_item()
-        for i, us in item_users.items():
+        order = np.argsort(ki, kind="stable")
+        bounds = np.searchsorted(ki[order], np.arange(I + 1))
+        purchasers: List[np.ndarray] = []
+        for i in range(I):
+            us = ku[order[bounds[i] : bounds[i + 1]]]
             if len(us) > cap:
-                item_users[i] = list(rng.choice(us, cap, replace=False))
+                us = rng.choice(us, cap, replace=False)
+            purchasers.append(us)
+        P_max = max(1, max(len(p) for p in purchasers))
+        idx = np.full((I, P_max), U, np.int32)
+        for i, p in enumerate(purchasers):
+            idx[i, : len(p)] = p
 
-        k = self.get_k()
+        # --- device: score all items, sharded over the data axis --------------
+        ctx = get_mesh_context()
+        k = min(self.get_k(), I)
+        pad_items = ctx.pad_batch(I)
+        idx_padded = np.concatenate([idx, np.full((pad_items, P_max), U, np.int32)])
+        item_ids = np.concatenate([np.arange(I, dtype=np.int32), np.zeros(pad_items, np.int32)])
+        vals, inds = _swing_scores(idx_padded, item_ids, B, w, float(alpha2), k, ctx)
+        vals = np.asarray(vals, np.float64)[:I]
+        inds = np.asarray(inds)[:I]
+
+        # --- host: decode + format (Swing.java:344-361 string encoding) -------
         out_items: List[int] = []
         out_strs: List[str] = []
-        for item, purchasers in item_users.items():
-            scores: Dict[int, float] = {}
-            for a in range(len(purchasers)):
-                u = purchasers[a]
-                for b in range(a + 1, len(purchasers)):
-                    v = purchasers[b]
-                    common = np.intersect1d(user_items[u], user_items[v], assume_unique=True)
-                    if len(common) == 0:
-                        continue
-                    sim = weights[u] * weights[v] / (alpha2 + len(common))
-                    for j in common:
-                        if int(j) != item:
-                            scores[int(j)] = scores.get(int(j), 0.0) + sim
-            if not scores:
-                continue
-            top = sorted(scores.items(), key=lambda t: -t[1])[:k]
-            out_items.append(item)
-            out_strs.append(";".join(f"{j},{s}" for j, s in top))
+        for i in range(I):
+            pos = vals[i] > 0.0
+            if not np.any(pos):
+                continue  # reference omits items with no scored neighbor
+            out_items.append(int(i_ids[i]))
+            out_strs.append(
+                ";".join(f"{int(i_ids[j])},{s}" for j, s in zip(inds[i][pos], vals[i][pos]))
+            )
         return DataFrame(
             [self.get_item_col(), self.get_output_col()],
             None,

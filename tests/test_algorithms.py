@@ -230,6 +230,84 @@ class TestSwing:
                 )
             )
 
+    @staticmethod
+    def _brute_force_scores(users, items, min_b, max_b, alpha1, alpha2, beta):
+        """The Swing.java pair loops, literally (the semantics the device
+        matmul formulation must reproduce)."""
+        user_items = {}
+        for u in np.unique(users):
+            its = np.unique(items[users == u])
+            if min_b <= len(its) <= max_b:
+                user_items[int(u)] = its
+        weights = {u: 1.0 / (alpha1 + len(its)) ** beta for u, its in user_items.items()}
+        item_users = {}
+        for u, its in user_items.items():
+            for i in its:
+                item_users.setdefault(int(i), []).append(u)
+        all_scores = {}
+        for item, purchasers in item_users.items():
+            scores = {}
+            for a in range(len(purchasers)):
+                for b in range(a + 1, len(purchasers)):
+                    u, v = purchasers[a], purchasers[b]
+                    common = np.intersect1d(user_items[u], user_items[v], assume_unique=True)
+                    if len(common) == 0:
+                        continue
+                    sim = weights[u] * weights[v] / (alpha2 + len(common))
+                    for j in common:
+                        if int(j) != item:
+                            scores[int(j)] = scores.get(int(j), 0.0) + sim
+            if scores:
+                all_scores[item] = scores
+        return all_scores
+
+    def test_device_scores_match_pair_loops(self):
+        rng = np.random.default_rng(17)
+        n = 400
+        users = rng.integers(0, 25, n).astype(np.int64)
+        items = rng.integers(0, 12, n).astype(np.int64)
+        args = dict(min_b=2, max_b=50, alpha1=15, alpha2=0, beta=0.3)
+        want = self._brute_force_scores(users, items, **args)
+        out = (
+            Swing()
+            .set_min_user_behavior(2)
+            .set_max_user_behavior(50)
+            .set_k(12)
+            .transform(DataFrame.from_dict({"user": users, "item": items}))
+        )
+        got = {}
+        for item, s in zip(out["item"], out["output"]):
+            got[int(item)] = {
+                int(t.split(",")[0]): float(t.split(",")[1]) for t in s.split(";")
+            }
+        assert set(got) == set(want)
+        for item in want:
+            assert set(got[item]) == set(want[item])
+            for j, score in want[item].items():
+                np.testing.assert_allclose(got[item][j], score, rtol=1e-5)
+
+    def test_scale_100k_interactions(self):
+        import time
+
+        rng = np.random.default_rng(3)
+        n = 100_000
+        users = rng.integers(0, 2000, n).astype(np.int64)
+        items = rng.integers(0, 800, n).astype(np.int64)
+        df = DataFrame.from_dict({"user": users, "item": items})
+        t0 = time.perf_counter()
+        out = (
+            Swing()
+            .set_min_user_behavior(1)
+            .set_max_user_behavior(2000)
+            .set_k(10)
+            .transform(df)
+        )
+        elapsed = time.perf_counter() - t0
+        assert len(out) == 800, "every item should have scored neighbors at this density"
+        assert elapsed < 60, f"100k-interaction Swing took {elapsed:.1f}s"
+        top = out["output"][0].split(";")
+        assert len(top) == 10 and all("," in t for t in top)
+
 
 class TestAgglomerativeClustering:
     def _blobs(self):

@@ -55,6 +55,24 @@ class TestSparseBatch:
         with pytest.raises(ValueError, match="sizes"):
             SparseBatch.from_vectors([SparseVector(5, [0], [1.0]), SparseVector(6, [0], [1.0])])
 
+    def test_explicit_zero_entries_round_trip(self):
+        batch = SparseBatch.from_vectors([SparseVector(10, [3, 5], [0.0, 2.0])])
+        got = batch.row(0)
+        np.testing.assert_array_equal(got.indices, [3, 5])
+        np.testing.assert_array_equal(got.values, [0.0, 2.0])
+
+    def test_mixed_dense_sparse_column_packs(self):
+        from flink_ml_tpu.linalg.vectors import DenseVector
+
+        df = DataFrame.from_dict(
+            {"features": [SparseVector(4, [0], [1.0]), DenseVector([0.0, 1.0, 0.0, 2.0])]}
+        )
+        assert df.is_sparse("features")
+        batch = df.sparse_batch("features")
+        np.testing.assert_array_equal(
+            batch.densify(), [[1.0, 0, 0, 0], [0, 1.0, 0, 2.0]]
+        )
+
 
 class TestLossAndMult:
     @pytest.mark.parametrize(
