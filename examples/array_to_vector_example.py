@@ -1,0 +1,21 @@
+"""Converts array columns to vector objects at the row boundary.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/ArrayToVectorExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows — no execution environment or Table plumbing needed).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+
+
+def main():
+    # Columnar storage IS the vector layout: a [n, d] array column serves as
+    # the vector column directly; collect() materializes DenseVector cells.
+    df = DataFrame.from_dict({"array": np.asarray([[0.0, 0.0], [0.5, 0.3]])})
+    for row in df.collect():
+        print("array column as vector:", row[0])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Trains a Knn model and uses it for classification.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/classification/KnnExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows — no execution environment or Table plumbing needed).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.classification.knn import Knn
+
+
+def main():
+    rng = np.random.default_rng(0)
+    X = np.concatenate([rng.normal(0, 0.3, (20, 2)), rng.normal(5, 0.3, (20, 2))])
+    y = np.concatenate([np.zeros(20), np.ones(20)])
+    train = DataFrame.from_dict({"features": X, "label": y})
+
+    model = Knn().set_k(3).fit(train)
+    queries = np.asarray([[0.1, -0.2], [4.9, 5.2]])
+    output = model.transform(DataFrame.from_dict({"features": queries}))
+    for features, pred in zip(queries, output["prediction"]):
+        print(f"Features: {features}\tPrediction: {pred}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Trains a LinearSVC model and uses it for classification.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/classification/LinearSVCExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows — no execution environment or Table plumbing needed).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.classification.linearsvc import LinearSVC
+
+
+def main():
+    X = np.asarray([[1.0, 2.0], [2.0, 2.0], [3.0, 2.0], [11.0, 3.0], [12.0, 4.0], [13.0, 2.0]])
+    y = np.asarray([0.0, 0.0, 0.0, 1.0, 1.0, 1.0])
+    train = DataFrame.from_dict({"features": X, "label": y})
+
+    model = LinearSVC().set_max_iter(50).fit(train)
+    output = model.transform(train)
+    for features, label, pred in zip(X, y, output["prediction"]):
+        print(f"Features: {features}\tExpected: {label}\tPrediction: {pred}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Trains a LogisticRegression model and uses it for classification.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/classification/LogisticRegressionExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows — no execution environment or Table plumbing needed).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.classification.logistic_regression import LogisticRegression
+
+
+def main():
+    X = np.asarray([[1.0, 2.0], [2.0, 2.0], [3.0, 2.0], [11.0, 3.0], [12.0, 4.0], [13.0, 2.0]])
+    y = np.asarray([0.0, 0.0, 0.0, 1.0, 1.0, 1.0])
+    train = DataFrame.from_dict({"features": X, "label": y, "weight": np.ones(6)})
+
+    lr = LogisticRegression().set_weight_col("weight")
+    model = lr.fit(train)
+    output = model.transform(train)
+    for features, label, w, pred, raw in zip(X, y, np.ones(6), output["prediction"], output["rawPrediction"]):
+        print(f"Features: {features}\tExpected: {label}\tPrediction: {pred}\tRaw: {raw}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Trains LogisticRegression on wide sparse features (padded-CSR layout).
+
+Parity: the reference's SparseVector training path (SparseVector.java +
+BLAS.java sparse branches); here the whole batch stays in [n, K]
+index/value arrays so a 2^18-dim model never materializes densified.
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.models.classification.logistic_regression import LogisticRegression
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, d, nnz = 512, 1 << 18, 8
+    idx = np.stack([rng.choice(d, nnz, replace=False) for _ in range(n)])
+    vals = rng.standard_normal((n, nnz)).astype(np.float32)
+    w_true = np.zeros(d, np.float32)
+    hot = rng.choice(d, 64, replace=False)
+    w_true[hot] = rng.standard_normal(64)
+    y = (np.sum(vals * w_true[idx], axis=1) > 0).astype(np.float64)
+    rows = [SparseVector(d, np.sort(r), v[np.argsort(r)]) for r, v in zip(idx, vals)]
+    train = DataFrame.from_dict({"features": rows, "label": y})
+
+    model = (
+        LogisticRegression()
+        .set_max_iter(100)
+        .set_global_batch_size(256)
+        .set_learning_rate(1.0)
+        .set_tol(0.0)
+        .fit(train)
+    )
+    out = model.transform(train)
+    acc = float(np.mean(out["prediction"] == y))
+    print(f"coefficient dim: {model.coefficient.shape[0]}, train accuracy: {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

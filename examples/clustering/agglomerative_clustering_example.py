@@ -1,0 +1,25 @@
+"""Runs AgglomerativeClustering and prints the merge hierarchy result.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/clustering/AgglomerativeClusteringExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows — no execution environment or Table plumbing needed).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.clustering.agglomerative_clustering import (
+    AgglomerativeClustering,
+)
+
+
+def main():
+    X = np.asarray([[1.0, 1.0], [1.0, 4.0], [1.0, 0.0], [4.0, 1.5], [4.0, 4.0], [4.0, 0.0]])
+    df = DataFrame.from_dict({"features": X})
+    outputs = AgglomerativeClustering().set_num_clusters(2).transform(df)
+    clusters = outputs[0]
+    for features, cluster in zip(X, clusters["prediction"]):
+        print(f"Features: {features}\tCluster ID: {int(cluster)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Trains a KMeans model and uses it for clustering.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/clustering/KMeansExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows — no execution environment or Table plumbing needed).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.clustering.kmeans import KMeans
+
+
+def main():
+    X = np.asarray(
+        [[0.0, 0.0], [0.0, 0.3], [0.3, 0.0], [9.0, 0.0], [9.0, 0.6], [9.6, 0.0]]
+    )
+    df = DataFrame.from_dict({"features": X})
+
+    model = KMeans().set_k(2).set_seed(1).fit(df)
+    output = model.transform(df)
+    for features, cluster in zip(X, output["prediction"]):
+        print(f"Features: {features}\tCluster ID: {int(cluster)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Trains an OnlineKMeans model on a stream of batches.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/clustering/OnlineKMeansExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows — no execution environment or Table plumbing needed).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.clustering.online_kmeans import OnlineKMeans
+from flink_ml_tpu.models.online import QueueBatchStream
+
+
+def main():
+    rng = np.random.default_rng(0)
+    stream = QueueBatchStream()
+    model = (
+        OnlineKMeans()
+        .set_k(2)
+        .set_seed(1)
+        .set_decay_factor(0.5)
+        .set_random_initial_model_data(dim=2)
+        .fit(stream)
+    )
+    for step in range(3):
+        pts = np.concatenate(
+            [rng.normal([0, 0], 0.1, (16, 2)), rng.normal([5, 5], 0.1, (16, 2))]
+        )
+        stream.add({"features": pts})
+        model.advance()
+        print(f"after batch {step}: centroids =\n{model.centroids}")
+
+    queries = np.asarray([[0.1, 0.0], [5.2, 4.9]])
+    out = model.transform(DataFrame.from_dict({"features": queries}))
+    for features, cluster in zip(queries, out["prediction"]):
+        print(f"Features: {features}\tCluster ID: {int(cluster)}")
+
+
+if __name__ == "__main__":
+    main()

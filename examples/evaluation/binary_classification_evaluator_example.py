@@ -1,0 +1,29 @@
+"""Evaluates binary classification results with AUC/AUPR/KS.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/evaluation/BinaryClassificationEvaluatorExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows — no execution environment or Table plumbing needed).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.evaluation.binary_classification_evaluator import (
+    BinaryClassificationEvaluator,
+)
+
+
+def main():
+    y = np.asarray([1.0, 1.0, 1.0, 0.0, 0.0, 0.0])
+    raw = np.asarray([[0.1, 0.9], [0.2, 0.8], [0.7, 0.3], [0.8, 0.2], [0.4, 0.6], [0.9, 0.1]])
+    df = DataFrame.from_dict({"label": y, "rawPrediction": raw})
+    out = (
+        BinaryClassificationEvaluator()
+        .set_metrics_names("areaUnderROC", "areaUnderPR", "ks")
+        .transform(df)
+    )
+    for name in ("areaUnderROC", "areaUnderPR", "ks"):
+        print(f"{name}: {out[name][0]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Binarizes columns against per-column thresholds.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/BinarizerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.binarizer import Binarizer
+
+
+def main():
+    df = DataFrame.from_dict(
+        {"f0": np.asarray([1.0, 2.0, 3.0]), "f1": np.asarray([[1.0, 2.0], [2.0, 1.0], [0.0, 3.0]])}
+    )
+    out = (
+        Binarizer()
+        .set_input_cols("f0", "f1")
+        .set_output_cols("of0", "of1")
+        .set_thresholds(1.5, 1.5)
+        .transform(df)
+    )
+    for a, b in zip(out["of0"], out["of1"]):
+        print(f"scalar -> {a}\tvector -> {b}")
+
+
+if __name__ == "__main__":
+    main()

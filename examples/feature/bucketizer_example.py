@@ -1,0 +1,27 @@
+"""Maps continuous columns into buckets by split points.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/BucketizerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.bucketizer import Bucketizer
+
+
+def main():
+    df = DataFrame.from_dict({"f0": np.asarray([-0.5, 0.3, 1.5, 2.5])})
+    out = (
+        Bucketizer()
+        .set_input_cols("f0")
+        .set_output_cols("b0")
+        .set_splits_array([[-1.0, 0.0, 1.0, 2.0, 3.0]])
+        .transform(df)
+    )
+    for x, b in zip(df["f0"], out["b0"]):
+        print(f"value {x} -> bucket {int(b)}")
+
+
+if __name__ == "__main__":
+    main()

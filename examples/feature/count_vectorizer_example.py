@@ -1,0 +1,22 @@
+"""Fits a vocabulary and vectorizes token documents.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/CountVectorizerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.count_vectorizer import CountVectorizer
+
+
+def main():
+    docs = [["a", "c", "b", "c"], ["c", "d", "e"], ["a", "b", "c"], ["e", "f"], ["a", "c", "a"]]
+    df = DataFrame(["input"], None, [docs])
+    model = CountVectorizer().fit(df)
+    print("vocabulary:", model.vocabulary)
+    out = model.transform(df)
+    for doc, vec in zip(docs, out["output"]):
+        print(f"{doc} -> {vec}")
+
+
+if __name__ == "__main__":
+    main()

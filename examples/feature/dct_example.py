@@ -1,0 +1,21 @@
+"""Applies the discrete cosine transform to vectors.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/DCTExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.dct import DCT
+
+
+def main():
+    df = DataFrame.from_dict({"input": np.asarray([[1.0, 1.0, 1.0, 1.0], [1.0, 0.0, -1.0, 0.0]])})
+    out = DCT().transform(df)
+    for x, y in zip(df["input"], out["output"]):
+        print(f"{x} -> {np.round(y, 4)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Multiplies vectors elementwise by a scaling vector.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/ElementwiseProductExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.linalg.vectors import DenseVector
+from flink_ml_tpu.models.feature.elementwise_product import ElementwiseProduct
+
+
+def main():
+    df = DataFrame.from_dict({"input": np.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])})
+    out = ElementwiseProduct().set_scaling_vec(DenseVector([1.1, 1.1, 1.1])).transform(df)
+    for x, y in zip(df["input"], out["output"]):
+        print(f"{x} -> {y}")
+
+
+if __name__ == "__main__":
+    main()

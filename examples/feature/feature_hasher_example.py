@@ -1,0 +1,27 @@
+"""Hashes mixed categorical/numeric columns into one feature vector.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/FeatureHasherExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.feature_hasher import FeatureHasher
+
+
+def main():
+    df = DataFrame(["id", "c0", "c1", "c2"], None, [[0, 1], ["a", "b"], [1.1, 0.0], [True, False]])
+    out = (
+        FeatureHasher()
+        .set_input_cols("c0", "c1", "c2")
+        .set_categorical_cols("c0", "c2")
+        .set_num_features(1000)
+        .transform(df)
+    )
+    for i, vec in zip(df["id"], out["output"]):
+        print(f"row {i}: {vec}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Maps token sequences to term-frequency vectors by hashing.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/HashingTFExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.hashing_tf import HashingTF
+
+
+def main():
+    docs = [
+        ["HashingTFTest", "Hashing", "Term", "Frequency", "Test"],
+        ["HashingTFTest", "Hashing", "Hashing", "Test", "Test"],
+    ]
+    df = DataFrame(["input"], None, [docs])
+    out = HashingTF().set_num_features(128).transform(df)
+    for doc, vec in zip(docs, out["output"]):
+        print(f"{doc} -> {vec}")
+
+
+if __name__ == "__main__":
+    main()

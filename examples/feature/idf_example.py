@@ -1,0 +1,24 @@
+"""Fits inverse document frequency weights and rescales vectors.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/IDFExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.idf import IDF
+
+
+def main():
+    X = np.asarray([[0.0, 1.0, 0.0, 2.0], [0.0, 1.0, 2.0, 3.0], [0.0, 1.0, 4.0, 4.0]])
+    df = DataFrame.from_dict({"input": X})
+    model = IDF().fit(df)
+    print("idf:", np.round(model.idf, 4))
+    out = model.transform(df)
+    for x, y in zip(X, out["output"]):
+        print(f"{x} -> {np.round(y, 4)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Fills missing values with a fitted surrogate per column.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/ImputerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.imputer import Imputer
+
+
+def main():
+    df = DataFrame.from_dict(
+        {"f1": np.asarray([np.nan, 1.0, 3.0, 4.0]), "f2": np.asarray([9.0, 8.0, np.nan, 7.0])}
+    )
+    model = (
+        Imputer().set_input_cols("f1", "f2").set_output_cols("o1", "o2").set_strategy("mean").fit(df)
+    )
+    out = model.transform(df)
+    for a, b in zip(out["o1"], out["o2"]):
+        print(f"imputed row: {a}, {b}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Maps indices back to their original strings.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/IndexToStringModelExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.string_indexer import IndexToStringModel
+
+
+def main():
+    model = IndexToStringModel().set_input_cols("idx").set_output_cols("s")
+    model.string_arrays = [["a", "b", "c"]]
+    df = DataFrame.from_dict({"idx": np.asarray([0.0, 2.0, 1.0])})
+    out = model.transform(df)
+    for i, s in zip(df["idx"], out["s"]):
+        print(f"{int(i)} -> {s}")
+
+
+if __name__ == "__main__":
+    main()

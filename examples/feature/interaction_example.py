@@ -1,0 +1,23 @@
+"""Takes cross products of scalar and vector columns.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/InteractionExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.interaction import Interaction
+
+
+def main():
+    df = DataFrame.from_dict(
+        {"f0": np.asarray([1.0, 2.0]), "f1": np.asarray([[1.0, 2.0], [3.0, 4.0]])}
+    )
+    out = Interaction().set_input_cols("f0", "f1").transform(df)
+    for a, v, o in zip(df["f0"], df["f1"], out["output"]):
+        print(f"{a} x {v} -> {o}")
+
+
+if __name__ == "__main__":
+    main()

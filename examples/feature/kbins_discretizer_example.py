@@ -1,0 +1,23 @@
+"""Discretizes continuous features into k bins.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/KBinsDiscretizerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.kbins_discretizer import KBinsDiscretizer
+
+
+def main():
+    X = np.asarray([[1.0], [2.0], [3.0], [4.0], [100.0], [101.0]])
+    df = DataFrame.from_dict({"input": X})
+    model = KBinsDiscretizer().set_num_bins(3).set_strategy("quantile").fit(df)
+    out = model.transform(df)
+    for x, b in zip(X, out["output"]):
+        print(f"{x[0]} -> bin {int(b[0])}")
+
+
+if __name__ == "__main__":
+    main()

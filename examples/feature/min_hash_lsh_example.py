@@ -1,0 +1,33 @@
+"""MinHash LSH: hashing, nearest neighbors and similarity join.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/MinHashLSHExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.linalg.vectors import Vectors
+from flink_ml_tpu.models.feature.lsh import MinHashLSH
+
+
+def main():
+    a = Vectors.sparse(10, [0, 1, 2], [1.0, 1.0, 1.0])
+    b = Vectors.sparse(10, [1, 2, 3], [1.0, 1.0, 1.0])
+    c = Vectors.sparse(10, [7, 8, 9], [1.0, 1.0, 1.0])
+    df = DataFrame(["vec", "id"], None, [[a, b, c], [0, 1, 2]])
+    model = (
+        MinHashLSH()
+        .set_input_col("vec")
+        .set_output_col("hashes")
+        .set_num_hash_tables(5)
+        .set_seed(2022)
+        .fit(df)
+    )
+    print("hash table shape for row 0:", model.transform(df)["hashes"][0].shape)
+    nn = model.approx_nearest_neighbors(df, a, k=2)
+    print("neighbors of a:", list(nn["id"]))
+    join = model.approx_similarity_join(df, df, threshold=0.6, id_col="id")
+    print("similar pairs:", sorted({(int(x), int(y)) for x, y in zip(join["idA"], join["idB"])}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Rescales each dimension to the [min, max] output range.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/MinMaxScalerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.scalers import MinMaxScaler
+
+
+def main():
+    X = np.asarray([[0.0, 3.0], [2.1, 0.0], [4.1, 5.1], [6.1, 8.1], [200.0, 400.0]])
+    df = DataFrame.from_dict({"input": X})
+    model = MinMaxScaler().fit(df)
+    out = model.transform(df)
+    for x, y in zip(X, out["output"]):
+        print(f"{x} -> {np.round(y, 4)}")
+
+
+if __name__ == "__main__":
+    main()

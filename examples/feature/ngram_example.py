@@ -1,0 +1,20 @@
+"""Builds n-grams from token sequences.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/NGramExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.ngram import NGram
+
+
+def main():
+    docs = [[], ["a", "b", "c"], ["a", "b", "c", "d"]]
+    df = DataFrame(["input"], None, [docs])
+    out = NGram().set_n(2).transform(df)
+    for doc, grams in zip(docs, out["output"]):
+        print(f"{doc} -> {grams}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Normalizes vectors to unit p-norm.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/NormalizerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.normalizer import Normalizer
+
+
+def main():
+    df = DataFrame.from_dict(
+        {"input": np.asarray([[2.1, 3.1, 1.2, 2.1], [1.1, 3.3, 4.4, 3.2]])}
+    )
+    out = Normalizer().set_p(1.5).transform(df)
+    for x, y in zip(df["input"], out["output"]):
+        print(f"{x} -> {np.round(y, 4)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""One-hot encodes categorical index columns.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/OneHotEncoderExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.one_hot_encoder import OneHotEncoder
+
+
+def main():
+    train = DataFrame.from_dict({"input": np.asarray([0.0, 1.0, 2.0, 0.0])})
+    model = OneHotEncoder().set_input_cols("input").set_output_cols("output").fit(train)
+    out = model.transform(train)
+    for x, v in zip(train["input"], out["output"]):
+        print(f"category {x} -> {v}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Standardizes a stream window-by-window with versioned models.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/OnlineStandardScalerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.standard_scaler import OnlineStandardScaler
+from flink_ml_tpu.ops.windows import CountTumblingWindows
+
+
+def main():
+    df = DataFrame.from_dict({"input": np.arange(12.0)[:, None]})
+    model = OnlineStandardScaler().set_windows(CountTumblingWindows.of(4)).fit(df)
+    print("model versions produced:", model.version_history)
+    out = model.transform(df)
+    for x, y, v in zip(df["input"], out["output"], out["version"]):
+        print(f"{x[0]:5.1f} -> {y[0]:8.4f} (model version {int(v)})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Expands vectors into polynomial feature space.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/PolynomialExpansionExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.polynomial_expansion import PolynomialExpansion
+
+
+def main():
+    df = DataFrame.from_dict({"input": np.asarray([[1.0, 2.0], [2.0, 3.0]])})
+    out = PolynomialExpansion().set_degree(2).transform(df)
+    for x, y in zip(df["input"], out["output"]):
+        print(f"{x} -> {y}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Splits a table into weighted random partitions.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/RandomSplitterExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.random_splitter import RandomSplitter
+
+
+def main():
+    df = DataFrame.from_dict({"x": np.arange(100.0)})
+    train, test = RandomSplitter().set_weights(8.0, 2.0).set_seed(0).transform(df)
+    print(f"train rows: {len(train)}, test rows: {len(test)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""Tokenizes strings by a regex pattern.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/RegexTokenizerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.tokenizer import RegexTokenizer
+
+
+def main():
+    df = DataFrame(["input"], None, [["Test for tokenization.", "Te,st. punct"]])
+    out = RegexTokenizer().set_input_col("input").set_pattern(r"\w+").set_gaps(False).transform(df)
+    for s, toks in zip(df["input"], out["output"]):
+        print(f"{s!r} -> {toks}")
+
+
+if __name__ == "__main__":
+    main()

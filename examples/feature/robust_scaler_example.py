@@ -1,0 +1,23 @@
+"""Scales features by the interquartile range (distributed GK sketch).
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/RobustScalerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.scalers import RobustScaler
+
+
+def main():
+    X = np.arange(1.0, 21.0)[:, None]
+    df = DataFrame.from_dict({"input": X})
+    model = RobustScaler().set_with_centering(True).fit(df)
+    out = model.transform(df)
+    for x, y in zip(X, out["output"]):
+        print(f"{x[0]:5.1f} -> {y[0]:8.4f}")
+
+
+if __name__ == "__main__":
+    main()

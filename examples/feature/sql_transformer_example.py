@@ -1,0 +1,26 @@
+"""Transforms a table with a SELECT statement.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/SQLTransformerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.sql_transformer import SQLTransformer
+
+
+def main():
+    df = DataFrame.from_dict({"v1": np.asarray([1.0, 4.0]), "v2": np.asarray([2.0, 5.0])})
+    out = (
+        SQLTransformer()
+        .set_statement("SELECT *, (v1 + v2) AS v3, (v1 * v2) AS v4 FROM __THIS__")
+        .transform(df)
+    )
+    print("columns:", out.get_column_names())
+    for row in out.collect():
+        print(row)
+
+
+if __name__ == "__main__":
+    main()

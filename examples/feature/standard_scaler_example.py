@@ -1,0 +1,23 @@
+"""Standardizes features to zero mean / unit variance.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/StandardScalerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.standard_scaler import StandardScaler
+
+
+def main():
+    X = np.asarray([[-2.5, 9.0, 1.0], [1.4, -1.0, 1.0], [2.0, -3.0, 1.0]])
+    df = DataFrame.from_dict({"input": X})
+    model = StandardScaler().set_with_mean(True).fit(df)
+    out = model.transform(df)
+    for x, y in zip(X, out["output"]):
+        print(f"{x} -> {np.round(y, 4)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Removes stop words from token sequences.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/StopWordsRemoverExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.stop_words_remover import StopWordsRemover
+
+
+def main():
+    docs = [["test", "test"], ["a", "b", "c", "d"], ["a", "the", "an"], ["A", "The", "AN"]]
+    df = DataFrame(["input"], None, [docs])
+    out = StopWordsRemover().set_input_cols("input").set_output_cols("output").transform(df)
+    for doc, kept in zip(docs, out["output"]):
+        print(f"{doc} -> {kept}")
+
+
+if __name__ == "__main__":
+    main()

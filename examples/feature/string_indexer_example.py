@@ -1,0 +1,27 @@
+"""Indexes string columns by frequency or alphabet order.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/StringIndexerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.string_indexer import StringIndexer
+
+
+def main():
+    train = DataFrame(["input"], None, [["a", "b", "b", "c", "b", "a"]])
+    model = (
+        StringIndexer()
+        .set_input_cols("input")
+        .set_output_cols("output")
+        .set_string_order_type("frequencyDesc")
+        .fit(train)
+    )
+    print("ordered strings:", model.string_arrays[0])
+    out = model.transform(train)
+    for s, i in zip(train["input"], out["output"]):
+        print(f"{s!r} -> {int(i)}")
+
+
+if __name__ == "__main__":
+    main()

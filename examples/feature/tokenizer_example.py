@@ -1,0 +1,19 @@
+"""Lower-cases and whitespace-splits strings into tokens.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/TokenizerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.tokenizer import Tokenizer
+
+
+def main():
+    df = DataFrame(["input"], None, [["Test for tokenization.", "Te,st. punct"]])
+    out = Tokenizer().set_input_col("input").transform(df)
+    for s, toks in zip(df["input"], out["output"]):
+        print(f"{s!r} -> {toks}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Selects features by univariate statistical tests against the label.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/UnivariateFeatureSelectorExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.univariate_feature_selector import (
+    UnivariateFeatureSelector,
+)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n = 200
+    y = rng.integers(0, 2, n).astype(np.float64)
+    informative = y * 2.0 + rng.normal(0, 0.1, n)
+    X = np.column_stack([informative, rng.normal(size=(n, 3))])
+    df = DataFrame.from_dict({"features": X, "label": y})
+    model = (
+        UnivariateFeatureSelector()
+        .set_feature_type("continuous")
+        .set_label_type("categorical")
+        .set_selection_threshold(1)
+        .fit(df)
+    )
+    print("selected feature indices:", model.indices)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Drops features whose variance is below a threshold.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/VarianceThresholdSelectorExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.variance_threshold_selector import (
+    VarianceThresholdSelector,
+)
+
+
+def main():
+    X = np.stack([np.ones(8), np.arange(8.0), np.arange(8.0) * 3], axis=1)
+    df = DataFrame.from_dict({"input": X})
+    model = VarianceThresholdSelector().set_variance_threshold(8.0).fit(df)
+    print("kept feature indices:", model.indices)
+    out = model.transform(df)
+    print("first transformed row:", out["output"][0])
+
+
+if __name__ == "__main__":
+    main()

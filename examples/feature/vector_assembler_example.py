@@ -1,0 +1,23 @@
+"""Concatenates scalar and vector columns into one feature vector.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/VectorAssemblerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.vector_assembler import VectorAssembler
+
+
+def main():
+    df = DataFrame.from_dict(
+        {"f0": np.asarray([1.0, 2.0]), "f1": np.asarray([[2.0, 3.0], [4.0, 5.0]])}
+    )
+    out = VectorAssembler().set_input_cols("f0", "f1").set_input_sizes(1, 2).transform(df)
+    for a, v, o in zip(df["f0"], df["f1"], out["output"]):
+        print(f"({a}, {v}) -> {o}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Indexes categorical dimensions of vectors, leaving continuous ones.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/VectorIndexerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.vector_indexer import VectorIndexer
+
+
+def main():
+    X = np.asarray([[0.0, 1.5], [2.0, 2.5], [0.0, 3.5], [2.0, 4.5], [1.0, 5.5]])
+    df = DataFrame.from_dict({"input": X})
+    model = VectorIndexer().set_max_categories(3).fit(df)
+    print("categorical dim maps:", model.category_maps)
+    out = model.transform(df)
+    for x, y in zip(X, out["output"]):
+        print(f"{x} -> {y}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Slices vectors down to selected indices.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/feature/VectorSlicerExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.feature.vector_slicer import VectorSlicer
+
+
+def main():
+    df = DataFrame.from_dict({"input": np.asarray([[1.0, 2.0, 3.0, 4.0], [5.0, 6.0, 7.0, 8.0]])})
+    out = VectorSlicer().set_indices(0, 2).transform(df)
+    for x, y in zip(df["input"], out["output"]):
+        print(f"{x} -> {y}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Trains a LinearRegression model and uses it for regression.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/regression/LinearRegressionExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows — no execution environment or Table plumbing needed).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.regression.linear_regression import LinearRegression
+
+
+def main():
+    X = np.asarray([[1.0, 1.0], [2.0, 1.0], [3.0, 1.0], [4.0, 1.0]])
+    y = X @ np.asarray([2.0, 1.0])
+    train = DataFrame.from_dict({"features": X, "label": y})
+
+    model = LinearRegression().set_max_iter(200).set_learning_rate(0.05).fit(train)
+    output = model.transform(train)
+    for features, label, pred in zip(X, y, output["prediction"]):
+        print(f"Features: {features}\tExpected: {label}\tPrediction: {pred:.3f}")
+
+
+if __name__ == "__main__":
+    main()

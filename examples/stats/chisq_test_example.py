@@ -1,0 +1,29 @@
+"""Runs the chi-square independence test between features and label.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/stats/ChiSqTestExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows — no execution environment or Table plumbing needed).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.stats.tests import ChiSqTest
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n = 200
+    label = rng.integers(0, 2, n).astype(np.float64)
+    dependent = label * 2.0 + rng.integers(0, 2, n)  # depends on label
+    independent = rng.integers(0, 3, n).astype(np.float64)
+    df = DataFrame.from_dict(
+        {"features": np.column_stack([dependent, independent]), "label": label}
+    )
+    out = ChiSqTest().transform(df)
+    print("pValues:", np.asarray(out["pValues"][0]))
+    print("degreesOfFreedom:", np.asarray(out["degreesOfFreedom"][0]))
+    print("statistics:", np.asarray(out["statistics"][0]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Converts vector cells back to plain arrays.
+
+Parity: flink-ml-examples/src/main/java/org/apache/flink/ml/examples/VectorToArrayExample.java
+(re-designed for the TPU-native API: columnar DataFrame in, stage out,
+print rows — no execution environment or Table plumbing needed).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.linalg.vectors import Vectors
+
+
+def main():
+    df = DataFrame(["vector"], None, [[Vectors.dense([0.0, 0.0]), Vectors.dense([0.5, 0.3])]])
+    arrays = df.vectors("vector")  # [n, d] numpy array
+    print("vectors as arrays:")
+    print(np.asarray(arrays))
+
+
+if __name__ == "__main__":
+    main()

@@ -57,7 +57,17 @@ class SparseBatch:
         self.values = values
         # Per-row stored-entry counts: lets row() round-trip explicit zeros
         # (which are indistinguishable from padding by value alone).
-        self.nnz = None if nnz is None else np.asarray(nnz, np.int32)
+        if nnz is not None:
+            nnz = np.asarray(nnz, np.int32)
+            if nnz.shape != (indices.shape[0],):
+                raise ValueError(
+                    f"nnz must be [n={indices.shape[0]}], got {nnz.shape}"
+                )
+            if nnz.size and (nnz.min() < 0 or nnz.max() > indices.shape[1]):
+                raise ValueError(
+                    f"nnz entries must be in [0, K={indices.shape[1]}]"
+                )
+        self.nnz = nnz
 
     @property
     def n(self) -> int:

@@ -13,19 +13,23 @@ minUserBehavior=10, maxUserBehavior=1000, alpha1=15, alpha2=0, beta=0.3.
 
 TPU mapping — the reference's per-item purchaser-pair loops (keyed
 co-occurrence over a shuffled stream) become batched linear algebra. With
-``B`` the {0,1} user×item incidence of the retained users and
-``M_uv = w_u·w_v / (alpha2 + (B·Bᵀ)_uv)`` the pair-weight matrix (zero
-diagonal, zero where no common item), the whole inner loop nest is
+``B_i`` the {0,1} purchaser×item incidence of item ``i``'s (capped)
+purchasers and ``M_i = w·wᵀ / (alpha2 + B_i·B_iᵀ)`` their pair-weight matrix
+(zero diagonal, zero where no common item), the whole inner loop nest is
 
     sim(i, j) = ½ Σ_{u,v ∈ purchasers(i)} M_uv · B_uj · B_vj
               = ½ · colsum( B_i ⊙ (M_i @ B_i) )_j
 
-i.e. one [P,P]@[P,I] matmul + an elementwise reduce per item, where ``B_i``,
-``M_i`` gather the (capped) purchaser rows. Items are sharded over the mesh's
-data axis (shard_map) and scored with ``lax.map`` + ``lax.top_k`` inside one
-jit program; host work is only the O(interactions) grouping/capping and the
-final string formatting. Padding uses a sentinel user row with zero
-weight/incidence so every shape is static.
+i.e. one one-hot scatter + two [P,P]/[P,I] matmuls + an elementwise reduce
+per item. ``B_i`` is built *on device* from the padded per-user item lists
+(an ELL layout, O(interactions) host memory) — no global user×item dense
+matrix ever exists. Items are bucketed by purchaser count into power-of-two
+widths so a heavy-tailed catalog doesn't pay the most popular item's [P,P]
+cost everywhere, and each bucket is sharded over the mesh's data axis
+(shard_map) and scored with ``lax.map`` + ``lax.top_k`` inside one cached
+jit program. Host work is only the O(interactions) grouping/capping and the
+final string formatting; padding uses a sentinel user (zero weight, empty
+item list) so every shape is static.
 """
 from __future__ import annotations
 
@@ -44,10 +48,10 @@ __all__ = ["Swing"]
 _SWING_CACHE: dict = {}
 
 
-def _swing_program(ctx, alpha2: float, k: int):
-    """The jit'd item-sharded scoring program, FIFO-cached per (mesh, alpha2, k)
-    like the optimizer's fused programs (jit re-specializes on shapes itself).
-    """
+def _swing_program(ctx, alpha2: float, k: int, n_items: int):
+    """The jit'd item-sharded scoring program, FIFO-cached per
+    (mesh, alpha2, k, n_items) like the optimizer's fused programs (jit
+    re-specializes on the bucket width / shard shapes itself)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -55,24 +59,32 @@ def _swing_program(ctx, alpha2: float, k: int):
     from flink_ml_tpu.ops.optimizer import _cache_put
     from flink_ml_tpu.parallel.mesh import DATA_AXIS
 
-    key = (ctx.mesh, alpha2, k)
+    key = (ctx.mesh, alpha2, k, n_items)
     cached = _SWING_CACHE.get(key)
     if cached is not None:
         return cached
 
-    def per_shard(idx_s, item_ids_s, B, w):
+    def per_shard(idx_s, item_ids_s, L, w):
         def one(args):
             idx_i, item_i = args
-            Bi = B[idx_i]  # [P, I] the capped purchasers' full item rows
-            wi = w[idx_i]  # [P]   their weights (sentinel rows 0)
-            # Pair weights among this item's purchasers only — [P, P] keeps
-            # memory independent of the total user count. Ci counts common
-            # items; pairs with none contribute nothing (the reference skips
-            # them — this also guards the 0/0 when alpha2 == 0), and u == v
-            # is not a pair.
+            P_w = idx_i.shape[0]
+            Li = L[idx_i]  # [P, D] the capped purchasers' item lists
+            wi = w[idx_i]  # [P]    their weights (sentinel rows 0)
+            # One-hot scatter builds this item's purchaser×item incidence on
+            # device (sentinel item id = n_items lands in the dropped column),
+            # so no global user×item dense matrix ever exists.
+            Bi = (
+                jnp.zeros((P_w, n_items + 1), jnp.float32)
+                .at[jnp.arange(P_w)[:, None], Li]
+                .add(1.0)[:, :n_items]
+            )
+            # Pair weights among this item's purchasers only — [P, P]. Ci
+            # counts common items; pairs with none contribute nothing (the
+            # reference skips them — this also guards the 0/0 when
+            # alpha2 == 0), and u == v is not a pair.
             Ci = Bi @ Bi.T
             Mi = jnp.where(Ci > 0, (wi[:, None] * wi[None, :]) / (alpha2 + Ci), 0.0)
-            Mi = Mi * (1.0 - jnp.eye(Mi.shape[0], dtype=Mi.dtype))
+            Mi = Mi * (1.0 - jnp.eye(P_w, dtype=Mi.dtype))
             S = 0.5 * jnp.sum(Bi * (Mi @ Bi), axis=0)  # [I]
             S = S.at[item_i].set(0.0)  # j != i
             top_vals, top_inds = jax.lax.top_k(S, k)
@@ -91,17 +103,6 @@ def _swing_program(ctx, alpha2: float, k: int):
     )
     _cache_put(_SWING_CACHE, key, program)
     return program
-
-
-def _swing_scores(idx, item_ids, B, w, alpha2: float, k: int, ctx):
-    """Top-k swing scores for every item, sharded over the mesh's data axis.
-
-    ``idx [n_items_padded, P]`` — purchaser row indices into ``B`` (sentinel =
-    last row, all-zero); ``item_ids`` — each row's own column index (for the
-    j ≠ i exclusion); ``B [U+1, I]`` incidence, ``w [U+1]`` user weights
-    (sentinel 0). Returns (values, indices) [n_items_padded, k].
-    """
-    return _swing_program(ctx, alpha2, k)(idx, item_ids, B, w)
 
 
 class Swing(AlgoOperator, HasOutputCol, HasSeed):
@@ -229,13 +230,21 @@ class Swing(AlgoOperator, HasOutputCol, HasSeed):
         U, I = int(keep.sum()), len(i_ids)
 
         alpha1, alpha2, beta = self.get_alpha1(), self.get_alpha2(), self.get_beta()
-        B = np.zeros((U + 1, I), np.float32)
-        B[ku, ki] = 1.0
         w = np.zeros(U + 1, np.float32)
         w[:U] = 1.0 / (alpha1 + deg[keep].astype(np.float64)) ** beta
 
-        # item → capped purchaser lists, padded to a static width with the
-        # sentinel user (zero weight/incidence ⇒ contributes nothing)
+        # Padded per-user item lists (ELL, O(interactions) memory) — the
+        # device scatters these into per-item incidence; sentinel item id = I.
+        u_order = np.argsort(ku, kind="stable")
+        u_bounds = np.searchsorted(ku[u_order], np.arange(U + 1))
+        D_max = max(1, int(np.max(u_bounds[1:] - u_bounds[:-1])))
+        L = np.full((U + 1, D_max), I, np.int32)
+        for u in range(U):
+            its = ki[u_order[u_bounds[u] : u_bounds[u + 1]]]
+            L[u, : len(its)] = its
+
+        # item → capped purchaser lists (sentinel user U pads: zero weight,
+        # empty item list ⇒ contributes nothing)
         rng = np.random.default_rng(self.get_seed())
         cap = self.get_max_user_num_per_item()
         order = np.argsort(ki, kind="stable")
@@ -246,20 +255,27 @@ class Swing(AlgoOperator, HasOutputCol, HasSeed):
             if len(us) > cap:
                 us = rng.choice(us, cap, replace=False)
             purchasers.append(us)
-        P_max = max(1, max(len(p) for p in purchasers))
-        idx = np.full((I, P_max), U, np.int32)
-        for i, p in enumerate(purchasers):
-            idx[i, : len(p)] = p
 
-        # --- device: score all items, sharded over the data axis --------------
+        # --- device: score items bucketed by purchaser count ------------------
+        # Power-of-two width buckets: a heavy-tailed catalog must not pay the
+        # most popular item's [P, P] pair cost for every item.
         ctx = get_mesh_context()
         k = min(self.get_k(), I)
-        pad_items = ctx.pad_batch(I)
-        idx_padded = np.concatenate([idx, np.full((pad_items, P_max), U, np.int32)])
-        item_ids = np.concatenate([np.arange(I, dtype=np.int32), np.zeros(pad_items, np.int32)])
-        vals, inds = _swing_scores(idx_padded, item_ids, B, w, float(alpha2), k, ctx)
-        vals = np.asarray(vals, np.float64)[:I]
-        inds = np.asarray(inds)[:I]
+        widths = [max(8, 1 << int(np.ceil(np.log2(max(1, len(p)))))) for p in purchasers]
+        vals = np.zeros((I, k), np.float64)
+        inds = np.zeros((I, k), np.int64)
+        for width in sorted(set(widths)):
+            members = [i for i in range(I) if widths[i] == width]
+            idx_b = np.full((len(members), width), U, np.int32)
+            for r, i in enumerate(members):
+                idx_b[r, : len(purchasers[i])] = purchasers[i]
+            idx_dev, _ = ctx.shard_batch(idx_b, pad_value=U)
+            ids_dev, _ = ctx.shard_batch(np.asarray(members, np.int32))
+            b_vals, b_inds = _swing_program(ctx, float(alpha2), k, I)(
+                idx_dev, ids_dev, L, w
+            )
+            vals[members] = np.asarray(b_vals, np.float64)[: len(members)]
+            inds[members] = np.asarray(b_inds)[: len(members)]
 
         # --- host: decode + format (Swing.java:344-361 string encoding) -------
         out_items: List[int] = []

@@ -1,0 +1,33 @@
+"""Executes every example script (ref ExamplesTest.java — each example must
+run end-to-end and produce output)."""
+import io
+import pathlib
+import runpy
+from contextlib import redirect_stdout
+
+import pytest
+
+EXAMPLES_DIR = pathlib.Path(__file__).resolve().parent.parent / "examples"
+EXAMPLES = sorted(EXAMPLES_DIR.rglob("*_example.py"))
+
+
+def test_examples_cover_every_family():
+    families = {p.parent.name for p in EXAMPLES}
+    assert {
+        "classification",
+        "clustering",
+        "evaluation",
+        "feature",
+        "recommendation",
+        "regression",
+        "stats",
+    } <= families
+    assert len(EXAMPLES) >= 45
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: str(p.relative_to(EXAMPLES_DIR)))
+def test_example_runs(path):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        runpy.run_path(str(path), run_name="__main__")
+    assert buf.getvalue().strip(), f"{path.name} produced no output"
