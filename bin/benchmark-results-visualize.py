@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Visualize benchmark results (ref flink-ml-dist benchmark-results-visualize.py).
+
+Reads one or more results JSON files produced by ``bin/benchmark-run
+--output-file`` and renders grouped horizontal bars of the chosen metric per
+benchmark — multiple files overlay for before/after comparison.
+
+    bin/benchmark-results-visualize.py results_a.json results_b.json \
+        --metric inputThroughput --output comparison.png
+"""
+import argparse
+import json
+import os
+import sys
+
+
+def load(path):
+    with open(path) as f:
+        results = json.load(f)
+    return {
+        r["name"]: r for r in results if isinstance(r, dict) and "name" in r
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("files", nargs="+", help="results JSON files")
+    parser.add_argument(
+        "--metric",
+        default="inputThroughput",
+        help="result field to plot (default inputThroughput, rows/s)",
+    )
+    parser.add_argument("--output", default="benchmark-results.png")
+    args = parser.parse_args(argv)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    # label by basename, falling back to the full path on collision
+    # (before/results.json vs after/results.json must not silently merge)
+    basenames = [os.path.basename(p) for p in args.files]
+    labels = [
+        b if basenames.count(b) == 1 else p for b, p in zip(basenames, args.files)
+    ]
+    runs = {label: load(p) for label, p in zip(labels, args.files)}
+    names = sorted({n for r in runs.values() for n in r})
+    if not names:
+        print("no benchmark entries found", file=sys.stderr)
+        return 1
+
+    y = np.arange(len(names), dtype=float)
+    height = 0.8 / len(runs)
+    fig, ax = plt.subplots(figsize=(9, max(2.5, 0.5 * len(names) + 1)))
+    for i, (label, results) in enumerate(runs.items()):
+        vals = [float(results.get(n, {}).get(args.metric, 0.0) or 0.0) for n in names]
+        bars = ax.barh(y + i * height, vals, height=height, label=label)
+        ax.bar_label(bars, fmt="%.0f", padding=2, fontsize=8)
+        for n in names:
+            if "error" in results.get(n, {}):
+                print(f"note: {label}:{n} errored: {results[n]['error']}", file=sys.stderr)
+
+    ax.set_yticks(y + 0.4 - height / 2, names)
+    ax.invert_yaxis()
+    ax.set_xlabel(args.metric)
+    ax.set_title("flink-ml-tpu benchmark results")
+    if len(runs) > 1:
+        ax.legend()
+    fig.tight_layout()
+    fig.savefig(args.output, dpi=120)
+    print(f"wrote {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

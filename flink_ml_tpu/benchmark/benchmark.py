@@ -61,8 +61,17 @@ def _instantiate(cls, param_map: Dict[str, Any]):
     return obj
 
 
-def run_benchmark(name: str, config: Dict[str, Any]) -> Dict[str, Any]:
-    """Ref BenchmarkUtils.runBenchmark:75."""
+def run_benchmark(
+    name: str, config: Dict[str, Any], profile_dir: str = None
+) -> Dict[str, Any]:
+    """Ref BenchmarkUtils.runBenchmark:75.
+
+    With ``profile_dir`` set, the run executes under ``jax.profiler.trace``
+    (one subdirectory per benchmark, loadable in TensorBoard/XProf/Perfetto —
+    SURVEY §5.1's tracing role) and the result carries the trace path.
+    """
+    import contextlib
+
     stage = _instantiate(
         _resolve_stage_class(config["stage"]["className"]),
         config["stage"].get("paramMap", {}),
@@ -78,27 +87,52 @@ def run_benchmark(name: str, config: Dict[str, Any]) -> Dict[str, Any]:
             config["modelData"].get("paramMap", {}),
         ).generate()
 
-    start = time.perf_counter()
-    if isinstance(stage, Estimator):
-        out = stage.fit(input_df).transform(input_df)
-    else:
-        if model_df is not None and isinstance(stage, Model):
-            stage.set_model_data(model_df)
-        out = stage.transform(input_df)
-    if isinstance(out, (list, tuple)):
-        out = out[0]
-    output_num = len(out)
-    elapsed_ms = (time.perf_counter() - start) * 1000.0
+    trace = contextlib.nullcontext()
+    trace_path = None
+    if profile_dir:
+        import os
+
+        import jax
+
+        trace_path = os.path.join(profile_dir, name)
+        trace = jax.profiler.trace(trace_path)
+
+    fit_ms = 0.0
+    with trace:
+        start = time.perf_counter()
+        if isinstance(stage, Estimator):
+            model = stage.fit(input_df)
+            fit_ms = (time.perf_counter() - start) * 1000.0
+            out = model.transform(input_df)
+        else:
+            if model_df is not None and isinstance(stage, Model):
+                stage.set_model_data(model_df)
+            out = stage.transform(input_df)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        output_num = len(out)
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
 
     input_num = len(input_df)
-    return {
+    result = {
         "name": name,
         "totalTimeMs": round(elapsed_ms, 3),
+        "fitTimeMs": round(fit_ms, 3),
+        "transformTimeMs": round(elapsed_ms - fit_ms, 3),
         "inputRecordNum": input_num,
         "inputThroughput": round(input_num * 1000.0 / elapsed_ms, 3),
         "outputRecordNum": output_num,
         "outputThroughput": round(output_num * 1000.0 / elapsed_ms, 3),
     }
+    # Per-epoch observability: stages that train through the shared loss
+    # machinery expose their per-epoch loss curve.
+    history = getattr(stage, "loss_history", None)
+    if history:
+        result["numEpochs"] = len(history)
+        result["finalLoss"] = round(float(history[-1]), 6)
+    if trace_path:
+        result["profileTrace"] = trace_path
+    return result
 
 
 def _load_config(path: str) -> Dict[str, Any]:
@@ -110,14 +144,14 @@ def _load_config(path: str) -> Dict[str, Any]:
     return json.loads(text)
 
 
-def run_config(path: str) -> List[Dict[str, Any]]:
+def run_config(path: str, profile_dir: str = None) -> List[Dict[str, Any]]:
     config = _load_config(path)
     results = []
     for name, entry in config.items():
         if name == "version":
             continue
         try:
-            results.append(run_benchmark(name, entry))
+            results.append(run_benchmark(name, entry, profile_dir=profile_dir))
         except Exception as e:  # mirror the reference's per-benchmark failure logs
             results.append({"name": name, "error": f"{type(e).__name__}: {e}"})
     return results
@@ -128,13 +162,23 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="flink-ml-tpu benchmark runner")
     parser.add_argument("config", help="benchmark config JSON file")
     parser.add_argument("--output-file", help="write results JSON here")
+    parser.add_argument(
+        "--profile",
+        metavar="DIR",
+        help="emit a jax.profiler trace per benchmark under DIR "
+        "(view with TensorBoard/XProf or Perfetto)",
+    )
     args = parser.parse_args(argv)
-    results = run_config(args.config)
+    results = run_config(args.config, profile_dir=args.profile)
     payload = json.dumps(results, indent=2)
     if args.output_file:
         with open(args.output_file, "w") as f:
             f.write(payload)
     print(payload)
+    failed = [r["name"] for r in results if "error" in r]
+    if failed:  # a smoke/CI caller must see benchmark breakage as a failure
+        print(f"benchmarks failed: {', '.join(failed)}", file=sys.stderr)
+        return 1
     return 0
 
 

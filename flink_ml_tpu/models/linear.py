@@ -109,9 +109,10 @@ class LinearEstimatorBase(
         )
         self._validate_labels(data["labels"])
         dim = data.pop("dim", None) or data["features"].shape[1]
-        coefficient = self._make_optimizer().optimize(
-            np.zeros(dim, np.float32), data, self._LOSS
-        )
+        optimizer = self._make_optimizer()
+        coefficient = optimizer.optimize(np.zeros(dim, np.float32), data, self._LOSS)
+        # per-epoch observability for the benchmark harness / callers
+        self.loss_history = list(optimizer.loss_history)
         model = self._MODEL_CLASS()
         update_existing_params(model, self)
         model.coefficient = np.asarray(coefficient)

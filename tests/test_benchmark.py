@@ -181,3 +181,76 @@ REFERENCE_STAGES = [
 def test_registry_covers_reference_inventory():
     missing = [s for s in REFERENCE_STAGES if s not in STAGE_REGISTRY]
     assert not missing, f"stages missing from the registry: {missing}"
+
+
+def test_cli_profile_flag_writes_trace(tmp_path):
+    """--profile emits a jax.profiler trace dir and records it per benchmark."""
+    import json
+    import os
+
+    from flink_ml_tpu.benchmark.benchmark import main as bench_main
+
+    config = {
+        "version": 1,
+        "KMeans-prof": {
+            "stage": {
+                "className": "KMeans",
+                "paramMap": {"k": 2, "maxIter": 3, "seed": 1},
+            },
+            "inputData": {
+                "className": "DenseVectorGenerator",
+                "paramMap": {
+                    "seed": 1,
+                    "colNames": [["features"]],
+                    "numValues": 200,
+                    "vectorDim": 4,
+                },
+            },
+        },
+    }
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps(config))
+    out = tmp_path / "results.json"
+    prof = tmp_path / "prof"
+    rc = bench_main([str(cfg), "--output-file", str(out), "--profile", str(prof)])
+    assert rc == 0
+    (result,) = json.loads(out.read_text())
+    assert "error" not in result, result
+    assert result["fitTimeMs"] > 0 and result["transformTimeMs"] >= 0
+    assert result["profileTrace"] == str(prof / "KMeans-prof")
+    # the trace dir must contain an actual xplane dump
+    found = [
+        f for _, _, files in os.walk(prof) for f in files if f.endswith(".xplane.pb")
+    ]
+    assert found, "no profiler trace written"
+
+
+def test_visualizer_renders_results(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    results = [
+        {"name": "A", "inputThroughput": 100.0, "totalTimeMs": 10.0},
+        {"name": "B", "inputThroughput": 250.0, "totalTimeMs": 4.0},
+    ]
+    rf = tmp_path / "r.json"
+    rf.write_text(json.dumps(results))
+    png = tmp_path / "out.png"
+    import pathlib
+
+    repo_root = pathlib.Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(repo_root / "bin" / "benchmark-results-visualize.py"),
+            str(rf),
+            "--output",
+            str(png),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=str(repo_root),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert png.exists() and png.stat().st_size > 1000

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# CI test controller (ref tools/ci/java_test_controller.sh): runs the whole
+# verification surface on the 8-device virtual CPU mesh.
+set -euo pipefail
+
+ci_path="$(cd -- "$(dirname "$0")" >/dev/null 2>&1; pwd -P)"
+root_path="$(cd "${ci_path}/../.."; pwd -P)"
+cd "$root_path"
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+export PYTHONPATH="${root_path}${PYTHONPATH:+:$PYTHONPATH}"
+
+echo "=== unit + integration tests (8-device virtual mesh) ==="
+python -m pytest tests/ -q
+
+echo "=== multi-chip dryrun compile check ==="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "=== benchmark smoke (demo config) ==="
+python -m flink_ml_tpu.benchmark.benchmark \
+    flink_ml_tpu/benchmark/benchmark-demo.json \
+    --output-file /tmp/ci-bench-results.json
+python bin/benchmark-results-visualize.py /tmp/ci-bench-results.json \
+    --output /tmp/ci-bench-results.png
+
+echo "CI OK"
