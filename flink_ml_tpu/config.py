@@ -1,0 +1,137 @@
+"""Runtime configuration tier — typed flags beyond per-stage params.
+
+Reference: Flink's ``ConfigOption`` system as used by
+``iteration/config/IterationOptions.java`` (``iteration.data-cache.path``) and
+the cluster-level options stage params never cover (parallelism, temp dirs).
+Stage hyperparameters stay in ``params/``; this tier holds *runtime* knobs —
+spill locations, memory budgets, mesh shape, streaming window size.
+
+Resolution order per option: programmatic ``set()`` > environment variable >
+default. The env name is derived from the key
+(``datacache.spill.dir`` → ``FLINK_ML_TPU_DATACACHE_SPILL_DIR``), so
+deployments configure the runtime without code changes — the role Flink's
+``flink-conf.yaml`` plays.
+
+    from flink_ml_tpu.config import config, Options
+    config.set(Options.DATACACHE_SPILL_DIR, "/mnt/ssd/spill")
+    ...
+    cache = HostDataCache()   # spills under /mnt/ssd/spill
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["ConfigOption", "Configuration", "Options", "config"]
+
+
+class ConfigOption:
+    """A typed runtime option (ref ConfigOptions.key(...).xxxType())."""
+
+    def __init__(self, key: str, type_: Callable, default, description: str):
+        self.key = key
+        self.type = type_
+        self.default = default
+        self.description = description
+
+    @property
+    def env_var(self) -> str:
+        return "FLINK_ML_TPU_" + self.key.upper().replace(".", "_").replace("-", "_")
+
+    def __repr__(self) -> str:
+        return f"ConfigOption({self.key!r}, default={self.default!r})"
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+class Options:
+    """The framework's runtime options (one place, like IterationOptions)."""
+
+    DATACACHE_SPILL_DIR = ConfigOption(
+        "datacache.spill.dir",
+        str,
+        None,
+        "Base path for capacity-tier cache spill files "
+        "(ref iteration.data-cache.path). Default: none — past-budget chunks "
+        "stay in host RAM unless a spill dir is configured.",
+    )
+    DATACACHE_MEMORY_BUDGET_BYTES = ConfigOption(
+        "datacache.memory.budget.bytes",
+        int,
+        1 << 30,
+        "Host RAM a capacity-tier cache may hold before spilling to disk "
+        "(the managed-memory fraction role of the reference's MemorySegment pool).",
+    )
+    TRAIN_STREAM_WINDOW_ROWS = ConfigOption(
+        "train.stream.window.rows",
+        int,
+        65_536,
+        "Per-shard HBM window size (rows) for streamed larger-than-HBM training.",
+    )
+    MESH_DATA_AXIS_SIZE = ConfigOption(
+        "mesh.data.axis.size",
+        int,
+        None,
+        "Data-parallel axis size of the default mesh (the job-parallelism "
+        "role). Default: all visible devices / model axis size.",
+    )
+    MESH_MODEL_AXIS_SIZE = ConfigOption(
+        "mesh.model.axis.size",
+        int,
+        1,
+        "Model-parallel axis size of the default mesh.",
+    )
+    NATIVE_DATACACHE_ENABLED = ConfigOption(
+        "native.datacache.enabled",
+        _parse_bool,
+        True,
+        "Whether HostDataCache construction through the config tier may use "
+        "the C++ chunk store when the native toolchain is available.",
+    )
+
+    @classmethod
+    def all(cls) -> Dict[str, ConfigOption]:
+        return {
+            v.key: v
+            for v in vars(cls).values()
+            if isinstance(v, ConfigOption)
+        }
+
+
+class Configuration:
+    """Resolved option values: set() > environment > default."""
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def set(self, option: ConfigOption, value) -> "Configuration":
+        with self._lock:
+            self._values[option.key] = None if value is None else option.type(value)
+        return self
+
+    def unset(self, option: ConfigOption) -> "Configuration":
+        with self._lock:
+            self._values.pop(option.key, None)
+        return self
+
+    def get(self, option: ConfigOption):
+        with self._lock:
+            if option.key in self._values:
+                return self._values[option.key]
+        env = os.environ.get(option.env_var)
+        if env is not None:
+            return option.type(env)
+        return option.default
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Every known option's resolved value (for logging/debugging)."""
+        return {key: self.get(opt) for key, opt in Options.all().items()}
+
+
+config = Configuration()

@@ -35,7 +35,11 @@ from flink_ml_tpu.iteration.termination import (
     TerminateOnMaxIter,
     TerminateOnMaxIterOrTol,
 )
-from flink_ml_tpu.iteration.datacache import DeviceDataCache, HostDataCache
+from flink_ml_tpu.iteration.datacache import (
+    DeviceDataCache,
+    HostDataCache,
+    create_capacity_cache,
+)
 from flink_ml_tpu.iteration.streaming import WindowedStream, WindowSchedule
 
 __all__ = [
@@ -49,6 +53,7 @@ __all__ = [
     "TerminateOnMaxIterOrTol",
     "DeviceDataCache",
     "HostDataCache",
+    "create_capacity_cache",
     "WindowedStream",
     "WindowSchedule",
 ]

@@ -30,7 +30,27 @@ import numpy as np
 
 from flink_ml_tpu.parallel.mesh import MeshContext, get_mesh_context
 
-__all__ = ["DeviceDataCache", "HostDataCache"]
+__all__ = ["DeviceDataCache", "HostDataCache", "create_capacity_cache"]
+
+
+def create_capacity_cache(memory_budget_bytes=None, spill_dir=None):
+    """Capacity-tier cache factory honoring the runtime config tier.
+
+    Returns the C++-backed ``NativeDataCache`` when
+    ``native.datacache.enabled`` is set and the toolchain builds, else the
+    pure-Python ``HostDataCache`` (identical contract; snapshots are
+    interchangeable on disk).
+    """
+    from flink_ml_tpu.config import Options, config
+
+    if config.get(Options.NATIVE_DATACACHE_ENABLED):
+        from flink_ml_tpu.native import native_available
+
+        if native_available():
+            from flink_ml_tpu.native.cache import NativeDataCache
+
+            return NativeDataCache(memory_budget_bytes, spill_dir)
+    return HostDataCache(memory_budget_bytes, spill_dir)
 
 
 def _gather_rows(chunk_rows, chunk_at, start: int, stop: int) -> Dict[str, np.ndarray]:
@@ -114,9 +134,18 @@ class HostDataCache:
 
     def __init__(
         self,
-        memory_budget_bytes: int = 1 << 30,
+        memory_budget_bytes: Optional[int] = None,
         spill_dir: Optional[str] = None,
     ):
+        # Constructor args win; otherwise the runtime config tier decides
+        # (ref iteration.data-cache.path — deployments set spill locations
+        # without code changes).
+        from flink_ml_tpu.config import Options, config
+
+        if memory_budget_bytes is None:
+            memory_budget_bytes = config.get(Options.DATACACHE_MEMORY_BUDGET_BYTES)
+        if spill_dir is None:
+            spill_dir = config.get(Options.DATACACHE_SPILL_DIR)
         self.memory_budget = memory_budget_bytes
         self.spill_dir = spill_dir
         # Append-ordered log; each entry is either {"mem": chunk} or {"files": paths}.

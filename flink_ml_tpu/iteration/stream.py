@@ -136,22 +136,17 @@ def window_stream(
         return
 
     if isinstance(windows, (EventTimeTumblingWindows, ProcessingTimeTumblingWindows)):
-        size = windows.size_ms
         get_ts = _timestamp_getter(windows, timestamp_column, now)
         current_id: Optional[int] = None
         pending: List[Batch] = []
         for batch in stream:
-            ts = get_ts(batch)
-            ids = (ts // size).astype(np.int64)
-            for wid in np.unique(ids):
-                sel = ids == wid
-                part = {k: v[sel] for k, v in batch.items()}
+            for wid, part in split_by_tumbling_window(batch, windows.size_ms, get_ts(batch)):
                 if current_id is None:
-                    current_id = int(wid)
-                if int(wid) != current_id:
+                    current_id = wid
+                if wid != current_id:
                     yield _concat(pending)
                     pending = []
-                    current_id = int(wid)
+                    current_id = wid
                 pending.append(part)
         if pending:
             yield _concat(pending)
@@ -183,6 +178,16 @@ def window_stream(
         return
 
     raise ValueError(f"Unsupported windows descriptor: {windows!r}")
+
+
+def split_by_tumbling_window(batch: Batch, size_ms: float, ts) -> Iterator[tuple]:
+    """Yield ``(window_id, sub-batch)`` per tumbling window present in one
+    batch, in window order — the single source for window-id assignment
+    (used by ``window_stream`` and the online estimators' batch splitters)."""
+    ids = (np.asarray(ts) // size_ms).astype(np.int64)
+    for wid in np.unique(ids):
+        sel = ids == wid
+        yield int(wid), {k: np.asarray(v)[sel] for k, v in batch.items()}
 
 
 def _timestamp_getter(windows, timestamp_column, now):

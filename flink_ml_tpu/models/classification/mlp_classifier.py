@@ -310,7 +310,7 @@ class MLPClassifier(Estimator, _MlpParams):
         model.labels = labels.astype(np.float64)
         return model
 
-    def fit_stream(self, cache, classes=None, window_rows: int = 65_536) -> MLPClassifierModel:
+    def fit_stream(self, cache, classes=None, window_rows=None) -> MLPClassifierModel:
         """Train out of a host-tier cache larger than HBM.
 
         ``cache`` is a HostDataCache/NativeDataCache with columns ``features``
@@ -323,6 +323,10 @@ class MLPClassifier(Estimator, _MlpParams):
         """
         from flink_ml_tpu.iteration.streaming import plan_windows, run_windows
 
+        if window_rows is None:  # runtime config tier decides
+            from flink_ml_tpu.config import Options, config
+
+            window_rows = config.get(Options.TRAIN_STREAM_WINDOW_ROWS)
         ctx = get_mesh_context()
         if classes is None:
             uniq: set = set()

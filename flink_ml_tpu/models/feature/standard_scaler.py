@@ -12,9 +12,14 @@ per window, version starting at 0; event-time window max timestamp recorded);
 
 TPU-native: the fit statistics are one jit'd masked reduction over the
 mesh-sharded dataset (psum inserted by XLA); transform is a fused elementwise
-kernel. Deviation: the online model serves with the latest arrived version (the
-reference joins rows to versions by event time when event-time windows are used;
-max-allowed-model-delay gating is recorded but not enforced row-wise).
+kernel. Model-delay semantics (OnlineStandardScalerModel.processElement1): a
+row with event time ``t`` may only be served by a model whose training-window
+timestamp satisfies ``t - maxAllowedModelDelayMs <= modelTimestamp``; too-new
+rows are buffered until a fresh-enough version arrives. The single-controller
+collapse of that two-input operator: ``transform`` serves each row with the
+*earliest* fresh-enough version, pulling further versions from the training
+stream on demand, and parks still-unservable rows in ``pending`` (the
+``bufferedPointsState`` role) for a later ``serve_pending()``.
 """
 from __future__ import annotations
 
@@ -177,10 +182,30 @@ class StandardScaler(Estimator, _ScalerParams):
         return model
 
 
+def _concat_frames(frames):
+    """Row-concatenate DataFrames with identical schemas."""
+    first = frames[0]
+    if len(frames) == 1:
+        return first
+    names = first.get_column_names()
+    cols = []
+    for name in names:
+        parts = [f.column(name) for f in frames]
+        if isinstance(parts[0], np.ndarray):
+            cols.append(np.concatenate(parts))
+        else:
+            merged: list = []
+            for p in parts:
+                merged.extend(p)
+            cols.append(merged)
+    return DataFrame(names, first.get_data_types(), cols)
+
+
 class OnlineStandardScalerModel(
     OnlineModelBase, _ScalerTransformMixin, HasModelVersionCol, HasMaxAllowedModelDelayMs
 ):
-    """Ref OnlineStandardScalerModel.java — versioned serving with gauges."""
+    """Ref OnlineStandardScalerModel.java — versioned serving with gauges and
+    row-wise max-allowed-model-delay gating against event timestamps."""
 
     _MODEL_ARRAY_NAMES = ("mean", "std")
 
@@ -188,14 +213,16 @@ class OnlineStandardScalerModel(
         super().__init__()
         self.mean: Optional[np.ndarray] = None
         self.std: Optional[np.ndarray] = None
+        self.model_timestamp: float = float("-inf")
+        self._pending: list = []  # the bufferedPointsState role
 
     def _apply_snapshot(self, payload) -> None:
-        self.mean, self.std = (np.asarray(a) for a in payload)
+        mean, std, ts = payload
+        self.mean = np.asarray(mean)
+        self.std = np.asarray(std)
+        self.model_timestamp = float(ts)
 
-    def transform(self, *inputs):
-        (df,) = inputs
-        if self.mean is None:
-            raise RuntimeError("no model version has arrived yet; advance() the model")
+    def _serve(self, df: DataFrame) -> DataFrame:
         out = self._transform_df(df)
         out.add_column(
             self.get_model_version_col(),
@@ -203,6 +230,52 @@ class OnlineStandardScalerModel(
             np.full(len(df), self.model_version, np.int64),
         )
         return out
+
+    @property
+    def pending_rows(self) -> int:
+        """Rows buffered because no fresh-enough model version has arrived."""
+        return sum(len(f) for f in self._pending)
+
+    def serve_pending(self) -> Optional[DataFrame]:
+        """Try to serve buffered rows (after new versions arrived); returns the
+        served rows, or None if nothing became servable."""
+        if not self._pending:
+            return None
+        buffered, self._pending = self._pending, []
+        outs = [self.transform(f) for f in buffered]
+        outs = [o for o in outs if len(o)]
+        return _concat_frames(outs) if outs else None
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        if self.mean is None:
+            raise RuntimeError("no model version has arrived yet; advance() the model")
+        if TIMESTAMP_COL not in df.get_column_names():
+            return self._serve(df)  # no event time -> no gating (ref: timestamps
+            # only exist on event-time streams)
+        delay = float(self.get_max_allowed_model_delay_ms())
+        ts = df.scalars(TIMESTAMP_COL)
+        remaining = np.arange(len(df))
+        parts = []
+        while remaining.size:
+            servable = ts[remaining] - delay <= self.model_timestamp
+            if servable.any():
+                idx = remaining[servable]
+                parts.append((idx, self._serve(df.take(idx))))
+                remaining = remaining[~servable]
+            if not remaining.size:
+                break
+            if self.advance(1) == 0:
+                # training stream dry/ended: buffer the too-new rows
+                self._pending.append(df.take(remaining))
+                break
+        if not parts:  # nothing servable yet: empty output, right schema
+            return self._serve(df.take(np.asarray([], np.int64)))
+        order = np.argsort(np.concatenate([idx for idx, _ in parts]), kind="stable")
+        return _concat_frames([out for _, out in parts]).take(order)
+
+
+TIMESTAMP_COL = "__timestamp__"  # event-time column (windows + delay gating)
 
 
 class OnlineStandardScaler(
@@ -212,7 +285,7 @@ class OnlineStandardScaler(
     statistics. Versions start at 0 on the first window (the reference emits the
     model computed *including* the window, versioned before increment)."""
 
-    TIMESTAMP_COL = "__timestamp__"  # column consulted by event-time windows
+    TIMESTAMP_COL = TIMESTAMP_COL
 
     def fit(self, *inputs) -> OnlineStandardScalerModel:
         (data,) = inputs
@@ -223,10 +296,19 @@ class OnlineStandardScaler(
         if bounded:
             windowed = window_stream(stream, windows, timestamp_column=self.TIMESTAMP_COL)
         else:
-            # Feedable unbounded stream: each arriving batch is one training window
-            # (window_stream is a generator and would be killed by a propagating
-            # StreamDry; stepwise feeding already defines the window boundaries).
-            windowed = stream
+            # Feedable unbounded stream: window_stream is a generator and would
+            # be killed by a propagating StreamDry, so event-time batches are
+            # split window-by-window with a StreamDry-safe iterator; other
+            # window kinds (count, processing-time, global) treat each arriving
+            # batch as one training window — stepwise feeding defines the
+            # processing-time boundaries, so splitting by the event-time column
+            # would be the wrong time domain.
+            from flink_ml_tpu.ops.windows import EventTimeTumblingWindows
+
+            if isinstance(windows, EventTimeTumblingWindows):
+                windowed = _BatchWindowSplitter(stream, windows.size_ms, self.TIMESTAMP_COL)
+            else:
+                windowed = stream
 
         def train_step(state, batch):
             s, sq, n = state
@@ -240,7 +322,14 @@ class OnlineStandardScaler(
             sq = sq + (X * X).sum(axis=0)
             n = n + X.shape[0]
             mean, std = _mean_std(s, sq, n)
-            return (s, sq, n), (mean, std)
+            # Model timestamp = the training window's max event time
+            # (StandardScalerModelData.timestamp); without event time the
+            # model is always "fresh" (no gating possible or needed).
+            ts_col = batch.get(TIMESTAMP_COL)
+            w_ts = (
+                float(np.max(ts_col)) if ts_col is not None and len(ts_col) else float("inf")
+            )
+            return (s, sq, n), (mean, std, w_ts)
 
         driver = SnapshotDriver(windowed, train_step, (None, None, 0))
         model = OnlineStandardScalerModel()
@@ -250,6 +339,39 @@ class OnlineStandardScaler(
         if bounded:
             model.advance()
         return model
+
+
+class _BatchWindowSplitter:
+    """Split each arriving batch into per-tumbling-window sub-batches.
+
+    A plain object (not a generator) so a ``StreamDry`` from the feedable
+    stream propagates without killing iteration state. Windows inside one
+    added batch emit in timestamp order; windows never merge across added
+    batches (each add is assumed watermark-complete, the stepwise analogue of
+    the reference's event-time window firing).
+    """
+
+    def __init__(self, stream, size_ms: float, ts_col: str):
+        self._stream = stream
+        self._size = size_ms
+        self._ts_col = ts_col
+        self._queue: list = []
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from flink_ml_tpu.iteration.stream import split_by_tumbling_window
+
+        while not self._queue:
+            batch = next(self._stream)  # may raise StopIteration / StreamDry
+            ts = batch.get(self._ts_col)
+            if ts is None:
+                return batch
+            self._queue.extend(
+                part for _, part in split_by_tumbling_window(batch, self._size, ts)
+            )
+        return self._queue.pop(0)
 
 
 class _VersionFromZero:

@@ -146,7 +146,14 @@ class OnlineModelBase(ModelArraysMixin, Model):
     def save(self, path: str) -> None:
         from flink_ml_tpu.utils import read_write as rw
 
-        rw.save_metadata(self, path, {"modelVersion": self.model_version})
+        extra = {"modelVersion": self.model_version}
+        # Models gated on event time must keep their freshness across
+        # save/load — a loaded model with -inf timestamp would buffer every
+        # timestamped row forever. ±inf survives json (Python emits Infinity).
+        ts = getattr(self, "model_timestamp", None)
+        if ts is not None:
+            extra["modelTimestamp"] = float(ts)
+        rw.save_metadata(self, path, extra)
         rw.save_model_arrays(path, self._model_arrays())
 
     @classmethod
@@ -158,6 +165,8 @@ class OnlineModelBase(ModelArraysMixin, Model):
         model.load_param_map_from_json(metadata["paramMap"])
         model._set_model_arrays(rw.load_model_arrays(path))
         model.model_version = metadata.get("modelVersion", 0)
+        if "modelTimestamp" in metadata:
+            model.model_timestamp = float(metadata["modelTimestamp"])
         return model
 
     # -- the public online surface -------------------------------------------

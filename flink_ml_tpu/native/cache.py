@@ -50,7 +50,15 @@ def _unpack(data: bytes) -> Dict[str, np.ndarray]:
 class NativeDataCache:
     """Drop-in for HostDataCache backed by the native chunk store."""
 
-    def __init__(self, memory_budget_bytes: int = 1 << 30, spill_dir: Optional[str] = None):
+    def __init__(
+        self, memory_budget_bytes: Optional[int] = None, spill_dir: Optional[str] = None
+    ):
+        from flink_ml_tpu.config import Options, config
+
+        if memory_budget_bytes is None:
+            memory_budget_bytes = config.get(Options.DATACACHE_MEMORY_BUDGET_BYTES)
+        if spill_dir is None:
+            spill_dir = config.get(Options.DATACACHE_SPILL_DIR)
         self._store = NativeChunkStore(memory_budget_bytes, spill_dir)
         self._chunk_rows: list = []
         self._n_rows = 0

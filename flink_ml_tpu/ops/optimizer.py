@@ -286,7 +286,7 @@ class SGD(Optimizer):
         checkpoint_manager=None,
         checkpoint_interval: int = 0,
         listeners=(),
-        stream_window_rows: int = 65_536,
+        stream_window_rows: Optional[int] = None,
     ):
         self.max_iter = max_iter
         self.learning_rate = learning_rate
@@ -296,6 +296,10 @@ class SGD(Optimizer):
         self.elastic_net = elastic_net
         self.dtype = dtype
         self.ctx = ctx
+        if stream_window_rows is None:  # runtime config tier decides
+            from flink_ml_tpu.config import Options, config
+
+            stream_window_rows = config.get(Options.TRAIN_STREAM_WINDOW_ROWS)
         self.stream_window_rows = stream_window_rows
         self.checkpoint_manager = checkpoint_manager
         self.checkpoint_interval = checkpoint_interval

@@ -56,11 +56,19 @@ class MeshContext:
         self,
         devices: Optional[Sequence[Any]] = None,
         n_data: Optional[int] = None,
-        n_model: int = 1,
+        n_model: Optional[int] = None,
     ):
+        # Unspecified axis sizes come from the runtime config tier (the
+        # job-parallelism role of the reference's cluster config).
+        from flink_ml_tpu.config import Options, config
+
+        if n_model is None:
+            n_model = config.get(Options.MESH_MODEL_AXIS_SIZE)
         if devices is None:
             devices = jax.devices()
         devices = list(devices)
+        if n_data is None:
+            n_data = config.get(Options.MESH_DATA_AXIS_SIZE)
         if n_data is None:
             n_data = len(devices) // n_model
         if n_data * n_model > len(devices):

@@ -1,0 +1,94 @@
+"""Runtime configuration tier (config.py) — the IterationOptions analogue:
+set() > environment > default resolution, and consumption by the caches,
+mesh, and streamed trainer."""
+import os
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.config import ConfigOption, Configuration, Options, config
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+    yield
+    for opt in Options.all().values():
+        config.unset(opt)
+
+
+def test_resolution_order(monkeypatch):
+    opt = Options.TRAIN_STREAM_WINDOW_ROWS
+    assert config.get(opt) == 65_536  # default
+    monkeypatch.setenv(opt.env_var, "1234")
+    assert config.get(opt) == 1234  # env beats default
+    config.set(opt, 99)
+    assert config.get(opt) == 99  # set beats env
+    config.unset(opt)
+    assert config.get(opt) == 1234
+
+
+def test_env_var_naming_and_typing(monkeypatch):
+    assert Options.DATACACHE_SPILL_DIR.env_var == "FLINK_ML_TPU_DATACACHE_SPILL_DIR"
+    monkeypatch.setenv("FLINK_ML_TPU_DATACACHE_MEMORY_BUDGET_BYTES", "2048")
+    assert config.get(Options.DATACACHE_MEMORY_BUDGET_BYTES) == 2048
+    monkeypatch.setenv("FLINK_ML_TPU_NATIVE_DATACACHE_ENABLED", "false")
+    assert config.get(Options.NATIVE_DATACACHE_ENABLED) is False
+
+
+def test_host_cache_consumes_config(tmp_path):
+    from flink_ml_tpu.iteration import HostDataCache
+
+    config.set(Options.DATACACHE_SPILL_DIR, str(tmp_path / "spill"))
+    config.set(Options.DATACACHE_MEMORY_BUDGET_BYTES, 100)
+    cache = HostDataCache()  # no constructor args: config decides
+    assert cache.spill_dir == str(tmp_path / "spill")
+    assert cache.memory_budget == 100
+    cache.append({"x": np.arange(100.0)})
+    cache.append({"x": np.arange(100.0)})
+    cache.finish()
+    assert any("files" in e for e in cache._log), "configured budget should spill"
+    # explicit constructor args still win
+    explicit = HostDataCache(memory_budget_bytes=1 << 20, spill_dir=str(tmp_path / "o"))
+    assert explicit.memory_budget == 1 << 20
+
+
+def test_streamed_sgd_consumes_window_config():
+    from flink_ml_tpu.ops import SGD
+
+    config.set(Options.TRAIN_STREAM_WINDOW_ROWS, 4)
+    assert SGD().stream_window_rows == 4
+    assert SGD(stream_window_rows=16).stream_window_rows == 16
+
+
+def test_mesh_consumes_axis_config():
+    from flink_ml_tpu.parallel.mesh import MeshContext
+
+    config.set(Options.MESH_DATA_AXIS_SIZE, 2)
+    config.set(Options.MESH_MODEL_AXIS_SIZE, 2)
+    ctx = MeshContext()
+    assert ctx.n_data == 2 and ctx.n_model == 2
+    # explicit args still win
+    ctx2 = MeshContext(n_data=4, n_model=1)
+    assert ctx2.n_data == 4 and ctx2.n_model == 1
+
+
+def test_capacity_cache_factory_respects_toggle():
+    from flink_ml_tpu.iteration import HostDataCache, create_capacity_cache
+
+    config.set(Options.NATIVE_DATACACHE_ENABLED, False)
+    assert isinstance(create_capacity_cache(), HostDataCache)
+    config.set(Options.NATIVE_DATACACHE_ENABLED, True)
+    cache = create_capacity_cache()
+    from flink_ml_tpu.native import native_available
+
+    if native_available():
+        from flink_ml_tpu.native.cache import NativeDataCache
+
+        assert isinstance(cache, NativeDataCache)
+    else:
+        assert isinstance(cache, HostDataCache)
+
+
+def test_to_dict_lists_every_option():
+    d = config.to_dict()
+    assert set(d) == set(Options.all())

@@ -267,3 +267,119 @@ class TestBatchStandardScaler:
         loaded = StandardScalerModel.load(path)
         np.testing.assert_allclose(loaded.mean, model.mean)
         np.testing.assert_allclose(loaded.std, model.std)
+
+
+class TestModelDelayGating:
+    """Row-wise max-allowed-model-delay enforcement
+    (OnlineStandardScalerModel.processElement1: serve iff
+    rowTs - maxAllowedModelDelayMs <= modelTs, else buffer)."""
+
+    def _fit_event_time(self, delay_ms):
+        from flink_ml_tpu.models.feature.standard_scaler import TIMESTAMP_COL
+        from flink_ml_tpu.ops.windows import EventTimeTumblingWindows
+
+        # 3 windows of 100ms: rows at t=0..99 -> v0, 100..199 -> v1, 200..299 -> v2
+        ts = np.asarray([10.0, 50.0, 110.0, 150.0, 210.0, 250.0])
+        df = DataFrame.from_dict({"input": np.arange(6.0)[:, None], TIMESTAMP_COL: ts})
+        stream = QueueBatchStream()
+        stream.add(df)
+        model = (
+            OnlineStandardScaler()
+            .set_windows(EventTimeTumblingWindows.of(100))
+            .set_max_allowed_model_delay_ms(delay_ms)
+            .fit(stream)
+        )
+        return model, stream
+
+    def test_rows_join_earliest_fresh_enough_version(self):
+        from flink_ml_tpu.models.feature.standard_scaler import TIMESTAMP_COL
+
+        model, stream = self._fit_event_time(delay_ms=100)
+        model.advance(1)  # v0 arrives (window max ts = 50)
+        assert model.model_version == 0 and model.model_timestamp == 50.0
+
+        # rows at t: 100 (needs modelTs >= 0 -> v0 ok), 200 (needs >= 100 -> v1),
+        # 260 (needs >= 160 -> v2)
+        q = DataFrame.from_dict(
+            {"input": np.asarray([[1.0], [2.0], [3.0]]), TIMESTAMP_COL: np.asarray([100.0, 200.0, 260.0])}
+        )
+        out = model.transform(q)
+        assert len(out) == 3, "all rows servable after auto-advancing"
+        np.testing.assert_array_equal(out["version"], [0, 1, 2])
+        # original row order preserved
+        np.testing.assert_array_equal([v[0] for v in out["input"]], [1.0, 2.0, 3.0])
+
+    def test_too_new_rows_buffer_until_version_arrives(self):
+        from flink_ml_tpu.models.feature.standard_scaler import TIMESTAMP_COL
+
+        model, stream = self._fit_event_time(delay_ms=0)
+        model.advance(1)  # v0 (ts=50); windows for v1/v2 still pending in stream
+        # consume the rest of the already-added data so the stream is dry
+        model.advance()
+        assert model.model_version == 2 and model.model_timestamp == 250.0
+
+        q = DataFrame.from_dict(
+            {"input": np.asarray([[1.0], [2.0]]), TIMESTAMP_COL: np.asarray([240.0, 400.0])}
+        )
+        out = model.transform(q)
+        assert len(out) == 1  # t=240 servable by v2; t=400 too new
+        np.testing.assert_array_equal(out["version"], [2])
+        assert model.pending_rows == 1
+
+        # a fresher window arrives -> buffered row becomes servable
+        stream.add(
+            DataFrame.from_dict(
+                {"input": np.asarray([[9.0], [9.5]]), TIMESTAMP_COL: np.asarray([410.0, 450.0])}
+            )
+        )
+        served = model.serve_pending()
+        assert served is not None and len(served) == 1
+        assert model.pending_rows == 0
+        assert served["version"][0] == model.model_version
+
+    def test_no_timestamp_column_serves_everything(self):
+        model, _ = self._fit_event_time(delay_ms=0)
+        model.advance(1)
+        out = model.transform(DataFrame.from_dict({"input": np.asarray([[1.0], [2.0]])}))
+        assert len(out) == 2
+
+    def test_model_timestamp_survives_save_load(self, tmp_path):
+        from flink_ml_tpu.models.feature.standard_scaler import (
+            TIMESTAMP_COL,
+            OnlineStandardScalerModel,
+        )
+
+        model, _ = self._fit_event_time(delay_ms=0)
+        model.advance()  # all 3 windows; model ts = 250
+        model.save(str(tmp_path / "oss"))
+        loaded = OnlineStandardScalerModel.load(str(tmp_path / "oss"))
+        assert loaded.model_timestamp == 250.0
+        assert loaded.model_version == 2
+        q = DataFrame.from_dict(
+            {"input": np.asarray([[1.0]]), TIMESTAMP_COL: np.asarray([200.0])}
+        )
+        out = loaded.transform(q)  # must serve, not buffer forever
+        assert len(out) == 1 and loaded.pending_rows == 0
+
+    def test_processing_time_windows_one_version_per_added_batch(self):
+        from flink_ml_tpu.models.feature.standard_scaler import TIMESTAMP_COL
+        from flink_ml_tpu.ops.windows import ProcessingTimeTumblingWindows
+
+        # Even with an event-time column spanning many window widths, a
+        # processing-time window on a feedable stream fires per added batch —
+        # event timestamps are the wrong time domain for it.
+        stream = QueueBatchStream()
+        model = (
+            OnlineStandardScaler()
+            .set_windows(ProcessingTimeTumblingWindows.of(1))
+            .fit(stream)
+        )
+        stream.add(
+            DataFrame.from_dict(
+                {
+                    "input": np.arange(4.0)[:, None],
+                    TIMESTAMP_COL: np.asarray([0.0, 5000.0, 10000.0, 15000.0]),
+                }
+            )
+        )
+        assert model.advance() == 1, "one version per added batch"
