@@ -111,8 +111,10 @@ class Configuration:
         self._lock = threading.Lock()
 
     def set(self, option: ConfigOption, value) -> "Configuration":
+        if value is None:  # setting None means "no override" — same as unset
+            return self.unset(option)
         with self._lock:
-            self._values[option.key] = None if value is None else option.type(value)
+            self._values[option.key] = option.type(value)
         return self
 
     def unset(self, option: ConfigOption) -> "Configuration":

@@ -236,6 +236,30 @@ class OnlineStandardScalerModel(
         """Rows buffered because no fresh-enough model version has arrived."""
         return sum(len(f) for f in self._pending)
 
+    # -- persistence: buffered rows are state (bufferedPointsState is part of
+    # the reference operator's checkpoint) and must survive save/load --------
+    def save(self, path: str) -> None:
+        import os
+
+        super().save(path)
+        for i, frame in enumerate(self._pending):
+            cols = {name: np.asarray(frame.column(name)) for name in frame.get_column_names()}
+            np.savez(os.path.join(path, f"pending{i}.npz"), **cols)
+
+    @classmethod
+    def load(cls, path: str):
+        import os
+
+        model = super().load(path)
+        i = 0
+        while os.path.exists(os.path.join(path, f"pending{i}.npz")):
+            with np.load(os.path.join(path, f"pending{i}.npz")) as z:
+                model._pending.append(
+                    DataFrame(list(z.files), None, [z[k] for k in z.files])
+                )
+            i += 1
+        return model
+
     def serve_pending(self) -> Optional[DataFrame]:
         """Try to serve buffered rows (after new versions arrived); returns the
         served rows, or None if nothing became servable."""

@@ -165,8 +165,10 @@ class OnlineModelBase(ModelArraysMixin, Model):
         model.load_param_map_from_json(metadata["paramMap"])
         model._set_model_arrays(rw.load_model_arrays(path))
         model.model_version = metadata.get("modelVersion", 0)
-        if "modelTimestamp" in metadata:
-            model.model_timestamp = float(metadata["modelTimestamp"])
+        if hasattr(model, "model_timestamp"):
+            # Legacy checkpoints lack the field: default to +inf (ungated) —
+            # a -inf default would silently buffer every timestamped row.
+            model.model_timestamp = float(metadata.get("modelTimestamp", float("inf")))
         return model
 
     # -- the public online surface -------------------------------------------

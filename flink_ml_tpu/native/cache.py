@@ -53,12 +53,11 @@ class NativeDataCache:
     def __init__(
         self, memory_budget_bytes: Optional[int] = None, spill_dir: Optional[str] = None
     ):
-        from flink_ml_tpu.config import Options, config
+        from flink_ml_tpu.iteration.datacache import resolve_cache_config
 
-        if memory_budget_bytes is None:
-            memory_budget_bytes = config.get(Options.DATACACHE_MEMORY_BUDGET_BYTES)
-        if spill_dir is None:
-            spill_dir = config.get(Options.DATACACHE_SPILL_DIR)
+        memory_budget_bytes, spill_dir = resolve_cache_config(
+            memory_budget_bytes, spill_dir
+        )
         self._store = NativeChunkStore(memory_budget_bytes, spill_dir)
         self._chunk_rows: list = []
         self._n_rows = 0

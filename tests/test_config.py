@@ -92,3 +92,12 @@ def test_capacity_cache_factory_respects_toggle():
 def test_to_dict_lists_every_option():
     d = config.to_dict()
     assert set(d) == set(Options.all())
+
+
+def test_set_none_behaves_like_unset(monkeypatch):
+    opt = Options.DATACACHE_MEMORY_BUDGET_BYTES
+    monkeypatch.setenv(opt.env_var, "123")
+    config.set(opt, 555)
+    assert config.get(opt) == 555
+    config.set(opt, None)  # no override: env (then default) shows through
+    assert config.get(opt) == 123

@@ -383,3 +383,45 @@ class TestModelDelayGating:
             )
         )
         assert model.advance() == 1, "one version per added batch"
+
+    def test_legacy_checkpoint_without_timestamp_loads_ungated(self, tmp_path):
+        import json, os
+        from flink_ml_tpu.models.feature.standard_scaler import (
+            TIMESTAMP_COL,
+            OnlineStandardScalerModel,
+        )
+
+        model, _ = self._fit_event_time(delay_ms=0)
+        model.advance()
+        path = str(tmp_path / "legacy")
+        model.save(path)
+        meta_path = os.path.join(path, "metadata")
+        meta = json.load(open(meta_path))
+        del meta["modelTimestamp"]  # simulate a pre-gating checkpoint
+        json.dump(meta, open(meta_path, "w"))
+        loaded = OnlineStandardScalerModel.load(path)
+        assert loaded.model_timestamp == float("inf")
+        q = DataFrame.from_dict(
+            {"input": np.asarray([[1.0]]), TIMESTAMP_COL: np.asarray([1e12])}
+        )
+        assert len(loaded.transform(q)) == 1  # ungated, never buffered forever
+
+    def test_pending_rows_survive_save_load(self, tmp_path):
+        from flink_ml_tpu.models.feature.standard_scaler import (
+            TIMESTAMP_COL,
+            OnlineStandardScalerModel,
+        )
+
+        model, stream = self._fit_event_time(delay_ms=0)
+        model.advance()
+        q = DataFrame.from_dict(
+            {"input": np.asarray([[7.0]]), TIMESTAMP_COL: np.asarray([400.0])}
+        )
+        model.transform(q)
+        assert model.pending_rows == 1
+        path = str(tmp_path / "with-pending")
+        model.save(path)
+        loaded = OnlineStandardScalerModel.load(path)
+        assert loaded.pending_rows == 1
+        pending = loaded._pending[0]
+        np.testing.assert_array_equal(pending.column(TIMESTAMP_COL), [400.0])
