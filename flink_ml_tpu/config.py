@@ -23,7 +23,13 @@ import os
 import threading
 from typing import Any, Callable, Dict, Optional
 
-__all__ = ["ConfigOption", "Configuration", "Options", "config"]
+__all__ = [
+    "ConfigOption",
+    "Configuration",
+    "Options",
+    "config",
+    "resolve_cache_config",
+]
 
 
 class ConfigOption:
@@ -137,3 +143,14 @@ class Configuration:
 
 
 config = Configuration()
+
+
+def resolve_cache_config(memory_budget_bytes, spill_dir):
+    """Resolve capacity-cache construction args against the config tier —
+    lives here (not in iteration/) so the dependency-light native tier can
+    use it without importing the jax/mesh stack."""
+    if memory_budget_bytes is None:
+        memory_budget_bytes = config.get(Options.DATACACHE_MEMORY_BUDGET_BYTES)
+    if spill_dir is None:
+        spill_dir = config.get(Options.DATACACHE_SPILL_DIR)
+    return memory_budget_bytes, spill_dir

@@ -33,18 +33,6 @@ from flink_ml_tpu.parallel.mesh import MeshContext, get_mesh_context
 __all__ = ["DeviceDataCache", "HostDataCache", "create_capacity_cache"]
 
 
-def resolve_cache_config(memory_budget_bytes, spill_dir):
-    """Resolve capacity-cache construction args against the runtime config
-    tier (single source for both the Python and native tiers)."""
-    from flink_ml_tpu.config import Options, config
-
-    if memory_budget_bytes is None:
-        memory_budget_bytes = config.get(Options.DATACACHE_MEMORY_BUDGET_BYTES)
-    if spill_dir is None:
-        spill_dir = config.get(Options.DATACACHE_SPILL_DIR)
-    return memory_budget_bytes, spill_dir
-
-
 def create_capacity_cache(memory_budget_bytes=None, spill_dir=None):
     """Capacity-tier cache factory honoring the runtime config tier.
 
@@ -152,6 +140,8 @@ class HostDataCache:
         # Constructor args win; otherwise the runtime config tier decides
         # (ref iteration.data-cache.path — deployments set spill locations
         # without code changes).
+        from flink_ml_tpu.config import resolve_cache_config
+
         self.memory_budget, self.spill_dir = resolve_cache_config(
             memory_budget_bytes, spill_dir
         )

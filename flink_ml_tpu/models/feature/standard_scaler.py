@@ -243,7 +243,16 @@ class OnlineStandardScalerModel(
 
         super().save(path)
         for i, frame in enumerate(self._pending):
-            cols = {name: np.asarray(frame.column(name)) for name in frame.get_column_names()}
+            cols = {}
+            for name in frame.get_column_names():
+                col = frame.column(name)
+                if isinstance(col, np.ndarray):
+                    cols[name] = col
+                else:  # ragged/list column (e.g. SparseVector cells): keep the
+                    # objects — np.asarray would densify via the sequence protocol
+                    arr = np.empty(len(col), dtype=object)
+                    arr[:] = col
+                    cols[name] = arr
             np.savez(os.path.join(path, f"pending{i}.npz"), **cols)
 
     @classmethod
@@ -253,10 +262,13 @@ class OnlineStandardScalerModel(
         model = super().load(path)
         i = 0
         while os.path.exists(os.path.join(path, f"pending{i}.npz")):
-            with np.load(os.path.join(path, f"pending{i}.npz")) as z:
-                model._pending.append(
-                    DataFrame(list(z.files), None, [z[k] for k in z.files])
-                )
+            # allow_pickle: object columns (e.g. SparseVector cells) round-trip
+            # through our own checkpoint files; lists rehydrate as list columns.
+            with np.load(os.path.join(path, f"pending{i}.npz"), allow_pickle=True) as z:
+                cols = [
+                    list(z[k]) if z[k].dtype == object else z[k] for k in z.files
+                ]
+                model._pending.append(DataFrame(list(z.files), None, cols))
             i += 1
         return model
 

@@ -53,7 +53,7 @@ class NativeDataCache:
     def __init__(
         self, memory_budget_bytes: Optional[int] = None, spill_dir: Optional[str] = None
     ):
-        from flink_ml_tpu.iteration.datacache import resolve_cache_config
+        from flink_ml_tpu.config import resolve_cache_config
 
         memory_budget_bytes, spill_dir = resolve_cache_config(
             memory_budget_bytes, spill_dir

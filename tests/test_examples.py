@@ -31,3 +31,15 @@ def test_example_runs(path):
     with redirect_stdout(buf):
         runpy.run_path(str(path), run_name="__main__")
     assert buf.getvalue().strip(), f"{path.name} produced no output"
+
+
+def test_docs_internal_links_resolve():
+    """Every relative link in docs/*.md and the README points at a real file."""
+    import re
+
+    root = EXAMPLES_DIR.parent
+    for md in [root / "README.md", *sorted((root / "docs").glob("*.md"))]:
+        text = md.read_text()
+        for target in re.findall(r"\]\((?!https?://|#)([^)]+)\)", text):
+            resolved = (md.parent / target).resolve()
+            assert resolved.exists(), f"{md.name} links to missing {target}"

@@ -425,3 +425,26 @@ class TestModelDelayGating:
         assert loaded.pending_rows == 1
         pending = loaded._pending[0]
         np.testing.assert_array_equal(pending.column(TIMESTAMP_COL), [400.0])
+
+    def test_pending_sparse_rows_survive_save_load(self, tmp_path):
+        from flink_ml_tpu.linalg.vectors import SparseVector
+        from flink_ml_tpu.models.feature.standard_scaler import (
+            TIMESTAMP_COL,
+            OnlineStandardScalerModel,
+        )
+
+        model, _ = self._fit_event_time(delay_ms=0)
+        model.advance()
+        q = DataFrame(
+            ["input", TIMESTAMP_COL],
+            None,
+            [[SparseVector(1, [0], [7.0])], np.asarray([400.0])],
+        )
+        model.transform(q)
+        assert model.pending_rows == 1
+        path = str(tmp_path / "sparse-pending")
+        model.save(path)
+        loaded = OnlineStandardScalerModel.load(path)  # must not crash on pickle
+        assert loaded.pending_rows == 1
+        cell = loaded._pending[0].column("input")[0]
+        np.testing.assert_array_equal(cell.to_array(), [7.0])
