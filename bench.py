@@ -137,13 +137,98 @@ def bench_logreg_cpu_baseline(X, y, batch=65_536, step_cap=30):
     return step_cap * batch / (time.perf_counter() - t0)
 
 
+def bench_logreg_sparse(peak_flops):
+    """The actual Criteo shape: wide sparse features in padded-CSR layout.
+
+    2^22-dim coefficient, 39 nnz/row (Criteo has 39 feature fields) — a batch
+    that would be 1 TB/step densified streams as [B, 40] index/value pairs.
+    Steady-state rows/s via the same two-point differencing as the dense
+    benchmark.
+    """
+    from flink_ml_tpu.iteration import DeviceDataCache
+    from flink_ml_tpu.ops import SGD, BinaryLogisticLoss
+    from flink_ml_tpu.parallel.mesh import get_mesh_context
+
+    n, d, nnz = 250_000, 1 << 22, 39
+    K = 40  # lane-padded row width
+    batch = 65_536
+    i1, i2 = 50, 550
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, d, size=(n, K), dtype=np.int32)  # hash-style indices
+    vals = np.ones((n, K), np.float32)
+    vals[:, nnz:] = 0.0  # padding slots
+    w_true = (rng.random(d) < 0.001) * rng.standard_normal(d).astype(np.float32)
+    y = (np.sum(vals * w_true[idx], axis=1) > 0).astype(np.float32)
+
+    ctx = get_mesh_context()
+    cache = DeviceDataCache(
+        {"indices": idx, "values": vals, "labels": y, "weights": np.ones(n, np.float32)},
+        ctx=ctx,
+    )
+
+    def steps(iters):
+        SGD(max_iter=iters, global_batch_size=batch, tol=0.0, learning_rate=0.5).optimize(
+            np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
+        )
+
+    t1 = _median_time(lambda: steps(i1))
+    t2 = _median_time(lambda: steps(i2))
+    step_s = max((t2 - t1) / (i2 - i1), 1e-9)
+    # fwd gather-dot (2*B*K) + grad scatter (2*B*K), counting madds like dense
+    flops_per_step = 4.0 * batch * K
+
+    # Same-semantics CPU step (gather-dot, np.add.at scatter, full coefficient
+    # update, batch-offset cycling), marginal like the TPU number. The step is
+    # scatter-bound on both sides (~10 ns/update through XLA's serialized TPU
+    # scatter), so the gap is structural — a SparseCore/Pallas segment-sum
+    # path is the known next lever.
+    coef = np.zeros(d, np.float32)
+    offset = 0
+
+    def cpu_step():
+        nonlocal coef, offset
+        Xb_i, Xb_v, yb = (
+            idx[offset : offset + batch],
+            vals[offset : offset + batch],
+            y[offset : offset + batch],
+        )
+        ys = 2.0 * yb - 1.0
+        z = np.sum(Xb_v * coef[Xb_i], axis=1) * ys
+        mult = -ys / (1.0 + np.exp(z))
+        grad = np.zeros(d, np.float32)
+        np.add.at(grad, Xb_i.ravel(), (Xb_v * mult[:, None]).ravel())
+        coef = coef - (0.5 / len(yb)) * grad
+        offset = 0 if offset + batch >= n else offset + batch
+
+    cpu_step()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        cpu_step()
+    cpu_step_s = (time.perf_counter() - t0) / 3
+
+    out = {
+        "name": "logreg_sparse_fit_250k_d4M_nnz39_b65536",
+        "steady_rows_per_sec": round(batch / step_s, 1),
+        "step_time_us": round(step_s * 1e6, 1),
+        "achieved_gflops": round(flops_per_step / step_s / 1e9, 2),
+        "cpu_baseline_rows_per_sec": round(batch / cpu_step_s, 1),
+        "vs_cpu_baseline": round(cpu_step_s / step_s, 2),
+        "note": "padded-CSR; densified this batch would be ~1 TB/step",
+    }
+    if peak_flops:
+        out["mfu"] = round(flops_per_step / step_s / peak_flops, 8)
+    return out
+
+
 def bench_kmeans():
     from flink_ml_tpu.api.dataframe import DataFrame
     from flink_ml_tpu.models.clustering.kmeans import KMeans
 
     rng = np.random.default_rng(2)
     num_rows, dim = 100_000, 10
-    i1, i2 = 20, 1020
+    # wide spread: the per-iteration delta must clear the tunnel's multi-ms
+    # dispatch jitter (epochs are ~20 us each once fused)
+    i1, i2 = 20, 10_020
     df = DataFrame.from_dict({"features": rng.random((num_rows, dim))})
 
     def fit(iters):
@@ -151,7 +236,9 @@ def bench_kmeans():
 
     t1 = _median_time(lambda: fit(i1))
     t2 = _median_time(lambda: fit(i2))
-    iter_s = max((t2 - t1) / (i2 - i1), 1e-9)
+    # A non-positive delta means jitter swamped the measurement — report null
+    # rather than a fabricated clamp value.
+    iter_s = (t2 - t1) / (i2 - i1) if t2 > t1 else None
 
     # The reference's own config (10k rows) for the apples-to-apples anchor —
     # rows/s is not shape-invariant, so the 1,399 rows/s comparison uses the
@@ -160,7 +247,7 @@ def bench_kmeans():
     t10k = _median_time(lambda: KMeans().set_seed(2).set_max_iter(i1).fit(df10k))
     return {
         "name": "kmeans_fit_d10_k2",
-        "iter_time_us_100k": round(iter_s * 1e6, 1),
+        "iter_time_us_100k": None if iter_s is None else round(iter_s * 1e6, 1),
         "e2e_rows_per_sec_100k_20_iters": round(num_rows / t1, 1),
         "fit_time_s_100k_20_iters": round(t1, 3),
         "e2e_rows_per_sec_10k_20_iters": round(10_000 / t10k, 1),
@@ -209,13 +296,15 @@ def main() -> None:
     cpu_rows = bench_logreg_cpu_baseline(X, y)
     logreg["cpu_baseline_rows_per_sec"] = round(cpu_rows, 1)
     logreg["vs_cpu_baseline"] = round(logreg["steady_rows_per_sec"] / cpu_rows, 2)
+    del X, y
+    sparse = bench_logreg_sparse(peak)
     kmeans = bench_kmeans()
     mlp = bench_mlp_forward(peak)
 
     detail = {
         "device_kind": kind,
         "peak_bf16_flops": peak,
-        "workloads": [logreg, kmeans, mlp],
+        "workloads": [logreg, sparse, kmeans, mlp],
     }
     with open("BENCH_DETAIL.json", "w") as f:
         json.dump(detail, f, indent=2)

@@ -28,6 +28,7 @@ from flink_ml_tpu.iteration.iteration import (
     IterationConfig,
     IterationListener,
     Iterations,
+    ReplayableDataStreamList,
     iterate_bounded_until_termination,
     iterate_unbounded,
 )
@@ -47,6 +48,7 @@ __all__ = [
     "IterationConfig",
     "IterationListener",
     "Iterations",
+    "ReplayableDataStreamList",
     "iterate_bounded_until_termination",
     "iterate_unbounded",
     "TerminateOnMaxIter",

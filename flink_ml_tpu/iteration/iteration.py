@@ -60,6 +60,60 @@ class IterationConfig:
     pipeline_depth: Optional[int] = None
 
 
+class ReplayableDataStreamList:
+    """Ref ``ReplayableDataStreamList.java`` — which data streams the iteration
+    body re-reads every epoch vs sees only in epoch 0.
+
+    A *replayed* source is re-materialized per round (the reference replays it
+    from the data cache through the Replayer operator): here each epoch gets a
+    fresh iterator — from a zero-arg factory, a capacity-tier cache
+    (``iter_rows`` re-reads RAM + spill files), or an in-memory
+    DataFrame/array (trivially rewindable). A *non-replayed* source delivers
+    its data in epoch 0 and is empty afterwards, exactly the reference's
+    semantics for un-replayed bounded inputs.
+
+        data = ReplayableDataStreamList(
+            replay={"train": cache}, no_replay={"init": init_df})
+        iterate_bounded_until_termination(vars, body, data=data)
+        # body(variables, epoch, streams): streams["train"] -> fresh iterator
+    """
+
+    def __init__(self, replay: Optional[dict] = None, no_replay: Optional[dict] = None):
+        self._replay = dict(replay or {})
+        self._no_replay = dict(no_replay or {})
+        overlap = set(self._replay) & set(self._no_replay)
+        if overlap:
+            raise ValueError(f"streams marked both replay and no_replay: {overlap}")
+
+    @staticmethod
+    def _fresh_iterator(source):
+        if callable(source):
+            return source()
+        if hasattr(source, "iter_rows"):  # capacity-tier caches
+            return source.iter_rows()
+        if hasattr(source, "collect") and hasattr(source, "column"):  # DataFrame
+            cols = {n: source.column(n) for n in source.get_column_names()}
+            return iter([cols])
+        if hasattr(source, "__next__"):
+            # A raw iterator/generator cannot be re-materialized per epoch —
+            # accepting it would silently violate the replay contract (empty
+            # from epoch 1 on). Demand a rewindable source.
+            raise TypeError(
+                "a one-shot iterator/generator is not replayable; pass a "
+                "zero-arg factory, a capacity-tier cache, or a DataFrame"
+            )
+        if isinstance(source, (list, tuple)):  # rewindable sequence of chunks
+            return iter(source)
+        return iter([source])  # a plain array/batch: one-chunk stream
+
+    def epoch_view(self, epoch: int) -> dict:
+        """name → iterator for this epoch (non-replayed: empty past epoch 0)."""
+        view = {name: self._fresh_iterator(src) for name, src in self._replay.items()}
+        for name, src in self._no_replay.items():
+            view[name] = self._fresh_iterator(src) if epoch == 0 else iter(())
+        return view
+
+
 class _NoCriteria:
     """Sentinel: the body declared no criteria stream."""
 
@@ -148,6 +202,7 @@ def iterate_bounded_until_termination(
     body: Callable[..., IterationBodyResult],
     config: Optional[IterationConfig] = None,
     listeners: Sequence[IterationListener] = (),
+    data: Optional[ReplayableDataStreamList] = None,
 ) -> List[Any]:
     """Run ``body`` until termination; returns the final outputs.
 
@@ -157,6 +212,9 @@ def iterate_bounded_until_termination(
 
     ``body(variables, epoch) -> IterationBodyResult``. Variables are pytrees (usually
     device arrays); the driver rebinds them each epoch without copying off-device.
+    With ``data`` (a ReplayableDataStreamList) the body is called as
+    ``body(variables, epoch, streams)`` where replayed streams re-materialize
+    per epoch and non-replayed ones are empty after epoch 0.
     """
     config = config or IterationConfig()
     context = IterationContext()
@@ -172,7 +230,10 @@ def iterate_bounded_until_termination(
     while True:
         if config.max_epochs is not None and epoch >= config.max_epochs:
             break
-        result = body(variables, epoch)
+        if data is not None:
+            result = body(variables, epoch, data.epoch_view(epoch))
+        else:
+            result = body(variables, epoch)
         if result.outputs:
             outputs = list(result.outputs)
         for listener in listeners:

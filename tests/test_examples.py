@@ -41,5 +41,5 @@ def test_docs_internal_links_resolve():
     for md in [root / "README.md", *sorted((root / "docs").glob("*.md"))]:
         text = md.read_text()
         for target in re.findall(r"\]\((?!https?://|#)([^)]+)\)", text):
-            resolved = (md.parent / target).resolve()
+            resolved = (md.parent / target.split("#")[0]).resolve()
             assert resolved.exists(), f"{md.name} links to missing {target}"

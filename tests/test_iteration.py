@@ -165,3 +165,101 @@ def test_event_time_tumbling_windows():
     stream = [{"t": np.array([0, 5, 10, 15, 25], np.float64), "x": np.arange(5.0)}]
     out = list(window_stream(iter(stream), EventTimeTumblingWindows.of(10), timestamp_column="t"))
     assert [list(b["x"]) for b in out] == [[0.0, 1.0], [2.0, 3.0], [4.0]]
+
+
+class TestReplayableDataStreams:
+    """Ref ReplayableDataStreamList semantics: replayed sources re-materialize
+    every epoch (from the cache, incl. disk spill); non-replayed sources are
+    empty after epoch 0."""
+
+    def test_replay_from_spilling_cache_every_epoch(self, tmp_path):
+        from flink_ml_tpu.iteration import (
+            HostDataCache,
+            IterationBodyResult,
+            IterationConfig,
+            ReplayableDataStreamList,
+            iterate_bounded_until_termination,
+        )
+
+        cache = HostDataCache(memory_budget_bytes=200, spill_dir=str(tmp_path))
+        for a in range(0, 40, 10):
+            cache.append({"x": np.arange(a, a + 10, dtype=np.float64)})
+        cache.finish()
+        assert any("files" in e for e in cache._log), "budget should force spill"
+
+        data = ReplayableDataStreamList(
+            replay={"train": cache},
+            no_replay={"init": {"x": np.asarray([100.0])}},
+        )
+        per_epoch_sums = []
+        init_seen = []
+
+        def body(variables, epoch, streams):
+            total = sum(float(np.sum(c["x"])) for c in streams["train"])
+            per_epoch_sums.append(total)
+            init_seen.append(sum(float(np.sum(c["x"])) for c in streams["init"]))
+            (acc,) = variables
+            return IterationBodyResult([acc + total], outputs=[acc + total])
+
+        (out,) = iterate_bounded_until_termination(
+            [0.0], body, config=IterationConfig(max_epochs=3), data=data
+        )
+        assert per_epoch_sums == [780.0, 780.0, 780.0]  # sum(0..39) each epoch
+        assert init_seen == [100.0, 0.0, 0.0]  # non-replayed: epoch 0 only
+        assert out == 3 * 780.0
+
+    def test_replay_factory_and_dataframe_sources(self):
+        from flink_ml_tpu.api.dataframe import DataFrame
+        from flink_ml_tpu.iteration import (
+            IterationBodyResult,
+            IterationConfig,
+            ReplayableDataStreamList,
+            iterate_bounded_until_termination,
+        )
+
+        calls = []
+
+        def factory():
+            calls.append(1)
+            return iter([{"x": np.asarray([1.0, 2.0])}])
+
+        df = DataFrame.from_dict({"y": np.asarray([5.0, 7.0])})
+        data = ReplayableDataStreamList(replay={"f": factory, "df": df})
+
+        def body(variables, epoch, streams):
+            sx = sum(float(np.sum(c["x"])) for c in streams["f"])
+            sy = sum(float(np.sum(c["y"])) for c in streams["df"])
+            assert (sx, sy) == (3.0, 12.0)
+            return IterationBodyResult(variables)
+
+        iterate_bounded_until_termination(
+            [0.0], body, config=IterationConfig(max_epochs=2), data=data
+        )
+        assert len(calls) == 2, "factory re-invoked per epoch"
+
+    def test_overlapping_names_rejected(self):
+        import pytest
+
+        from flink_ml_tpu.iteration import ReplayableDataStreamList
+
+        with pytest.raises(ValueError, match="both replay"):
+            ReplayableDataStreamList(replay={"a": 1}, no_replay={"a": 2})
+
+    def test_one_shot_iterator_rejected_loudly(self):
+        import pytest
+
+        from flink_ml_tpu.iteration import ReplayableDataStreamList
+
+        data = ReplayableDataStreamList(replay={"g": iter([{"x": np.zeros(1)}])})
+        with pytest.raises(TypeError, match="not replayable"):
+            data.epoch_view(0)
+
+    def test_list_of_chunks_replays(self):
+        from flink_ml_tpu.iteration import ReplayableDataStreamList
+
+        data = ReplayableDataStreamList(
+            replay={"train": [{"x": np.asarray([1.0])}, {"x": np.asarray([2.0])}]}
+        )
+        for epoch in range(2):
+            chunks = list(data.epoch_view(epoch)["train"])
+            assert [float(c["x"][0]) for c in chunks] == [1.0, 2.0]
