@@ -18,7 +18,7 @@ Layer map (mirrors the reference's Maven layering, reference SURVEY.md section 1
   - ``benchmark``   : JSON-config benchmark harness (ref flink-ml-benchmark)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from flink_ml_tpu.api.core import AlgoOperator, Estimator, Model, Stage, Transformer
 from flink_ml_tpu.api.dataframe import DataFrame, Row

@@ -86,7 +86,7 @@ class ReplayableDataStreamList:
             raise ValueError(f"streams marked both replay and no_replay: {overlap}")
 
     @staticmethod
-    def _fresh_iterator(source):
+    def _fresh_iterator(source, replayed: bool = True):
         if callable(source):
             return source()
         if hasattr(source, "iter_rows"):  # capacity-tier caches
@@ -95,13 +95,15 @@ class ReplayableDataStreamList:
             cols = {n: source.column(n) for n in source.get_column_names()}
             return iter([cols])
         if hasattr(source, "__next__"):
-            # A raw iterator/generator cannot be re-materialized per epoch —
-            # accepting it would silently violate the replay contract (empty
-            # from epoch 1 on). Demand a rewindable source.
-            raise TypeError(
-                "a one-shot iterator/generator is not replayable; pass a "
-                "zero-arg factory, a capacity-tier cache, or a DataFrame"
-            )
+            if replayed:
+                # A raw iterator/generator cannot be re-materialized per epoch
+                # — accepting it would silently violate the replay contract
+                # (empty from epoch 1 on). Demand a rewindable source.
+                raise TypeError(
+                    "a one-shot iterator/generator is not replayable; pass a "
+                    "zero-arg factory, a capacity-tier cache, or a DataFrame"
+                )
+            return source  # non-replayed: consumed once in epoch 0 — fine
         if isinstance(source, (list, tuple)):  # rewindable sequence of chunks
             return iter(source)
         return iter([source])  # a plain array/batch: one-chunk stream
@@ -110,7 +112,9 @@ class ReplayableDataStreamList:
         """name → iterator for this epoch (non-replayed: empty past epoch 0)."""
         view = {name: self._fresh_iterator(src) for name, src in self._replay.items()}
         for name, src in self._no_replay.items():
-            view[name] = self._fresh_iterator(src) if epoch == 0 else iter(())
+            view[name] = (
+                self._fresh_iterator(src, replayed=False) if epoch == 0 else iter(())
+            )
         return view
 
 

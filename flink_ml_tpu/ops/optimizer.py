@@ -55,7 +55,7 @@ from flink_ml_tpu.iteration import (
     iterate_bounded_until_termination,
 )
 from flink_ml_tpu.ops.lossfunc import LossFunc
-from flink_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext, get_mesh_context
+from flink_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, MeshContext, get_mesh_context
 
 __all__ = ["Optimizer", "SGD", "regularize"]
 
@@ -91,7 +91,20 @@ class Optimizer:
 
 
 def _sgd_epoch_math(
-    coef, start, offset, feats, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
+    coef,
+    start,
+    offset,
+    feats,
+    y,
+    w,
+    mask,
+    loss_func,
+    local_batch,
+    lr,
+    reg,
+    elastic_net,
+    dtype,
+    model_sharded: bool = False,
 ):
     """One epoch of the per-shard SGD update (shared by the host-loop step and the
     fused whole-run program). ``start`` is the clamped slice start and ``offset``
@@ -117,17 +130,46 @@ def _sgd_epoch_math(
         # Padding slots (index 0 / value 0) and zero-weight rows contribute 0.
         ib = jax.lax.dynamic_slice_in_dim(feats[0], start, local_batch)
         vb = jax.lax.dynamic_slice_in_dim(feats[1], start, local_batch)
-        dot = jnp.sum(vb * coef[ib], axis=1)
-        loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
-        grad_sum = jnp.zeros_like(coef).at[ib.ravel()].add((vb * mult[:, None]).ravel())
+        if model_sharded:
+            # Tensor-parallel coefficient: this shard owns the index range
+            # [lo, lo + |coef_local|). Each shard gathers/scatters only its
+            # range (dividing the serialized scatter cost across the model
+            # axis) and the full margin assembles with one psum over it.
+            local_d = coef.shape[0]
+            lo = jax.lax.axis_index(MODEL_AXIS) * local_d
+            local_idx = ib - lo
+            in_range = (local_idx >= 0) & (local_idx < local_d)
+            safe_idx = jnp.where(in_range, local_idx, 0)
+            vb_local = jnp.where(in_range, vb, 0.0)
+            dot = jax.lax.psum(
+                jnp.sum(vb_local * coef[safe_idx], axis=1), MODEL_AXIS
+            )
+            loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
+            grad_sum = (
+                jnp.zeros_like(coef)
+                .at[safe_idx.ravel()]
+                .add((vb_local * mult[:, None]).ravel())
+            )
+        else:
+            dot = jnp.sum(vb * coef[ib], axis=1)
+            loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
+            grad_sum = jnp.zeros_like(coef).at[ib.ravel()].add((vb * mult[:, None]).ravel())
     else:
         Xb = jax.lax.dynamic_slice_in_dim(feats, start, local_batch)
         loss_sum, grad_sum = loss_func.loss_and_grad_sum(coef, Xb, yb, wb)
-    packed = jnp.concatenate(
-        [grad_sum, jnp.stack([jnp.sum(wb), loss_sum]).astype(grad_sum.dtype)]
-    )
-    packed = jax.lax.psum(packed, DATA_AXIS)  # the whole AllReduceImpl
-    grad, weight_sum, loss_sum = packed[:-2], packed[-2], packed[-1]
+    if model_sharded:
+        # The grad shard varies over the model axis while the scalar stats are
+        # replicated across it — keep their psums separate so the replication
+        # stays statically visible to shard_map (and the loss/done plumbing).
+        grad = jax.lax.psum(grad_sum, DATA_AXIS)
+        stats = jax.lax.psum(jnp.stack([jnp.sum(wb), loss_sum]), DATA_AXIS)
+        weight_sum, loss_sum = stats[0], stats[1]
+    else:
+        packed = jnp.concatenate(
+            [grad_sum, jnp.stack([jnp.sum(wb), loss_sum]).astype(grad_sum.dtype)]
+        )
+        packed = jax.lax.psum(packed, DATA_AXIS)  # the whole AllReduceImpl
+        grad, weight_sum, loss_sum = packed[:-2], packed[-2], packed[-1]
     safe_w = jnp.maximum(weight_sum, 1e-30)
     new_coef = jnp.where(weight_sum > 0, coef - (lr / safe_w) * grad, coef)
     new_coef, _reg_loss = regularize(new_coef, reg, elastic_net, lr)
@@ -195,6 +237,7 @@ def _fused_sgd_program(
     tol: Optional[float],
     dtype,
     sparse: bool = False,
+    model_sharded: bool = False,
 ):
     """A chunk of ``chunk_len`` SGD epochs as ONE jit'd SPMD program.
 
@@ -216,7 +259,15 @@ def _fused_sgd_program(
     dense or ``(indices, values, y, w, mask)`` sparse, and ``losses`` a
     [chunk_len] buffer (non-executed entries +inf). Programs are FIFO-cached
     per (mesh, loss, shapes, hyperparameters) so repeated fits skip retracing.
+
+    With ``model_sharded`` (sparse only) the coefficient is sharded over the
+    mesh's ``model`` axis — tensor parallelism for wide models: each shard
+    gathers/scatters only its index range (dividing the serialized-scatter
+    cost), margins assemble with a psum over the model axis, and the returned
+    coefficient stays model-sharded.
     """
+    if model_sharded and not sparse:
+        raise ValueError("model-axis sharding is implemented for the sparse layout")
     key = (
         ctx.mesh,
         loss_func,  # the instance: custom losses may carry parameters (e.g. Huber delta)
@@ -228,6 +279,7 @@ def _fused_sgd_program(
         tol,
         jnp.dtype(dtype).name,
         sparse,
+        model_sharded,
     )
     cached = _FUSED_CACHE.get(key)
     if cached is not None:
@@ -241,7 +293,8 @@ def _fused_sgd_program(
             c, done = carry
             start, offset, act = schedule
             new_c, mean_loss = _sgd_epoch_math(
-                c, start, offset, feats, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
+                c, start, offset, feats, y, w, mask, loss_func, local_batch, lr,
+                reg, elastic_net, dtype, model_sharded=model_sharded,
             )
             executed = ~done & act
             new_c = jnp.where(executed, new_c, c)
@@ -257,12 +310,13 @@ def _fused_sgd_program(
         return coef, done, losses, jnp.sum(executed.astype(jnp.int32))
 
     n_data_args = 5 if sparse else 4
+    coef_spec = P(MODEL_AXIS) if model_sharded else P()
     program = jax.jit(
         jax.shard_map(
             per_shard,
             mesh=ctx.mesh,
-            in_specs=(P(), P(), P(), P(), P()) + (P(DATA_AXIS),) * n_data_args,
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(coef_spec, P(), P(), P(), P()) + (P(DATA_AXIS),) * n_data_args,
+            out_specs=(coef_spec, P(), P(), P()),
         ),
         donate_argnums=(0, 1),
     )
@@ -389,6 +443,9 @@ class SGD(Optimizer):
                 ctx=ctx,
             )
         sparse = "indices" in train_data.arrays
+        # Wide sparse models shard the coefficient over the model axis when
+        # the mesh has one (tensor parallelism; scatter cost divides by n_model).
+        model_sharded = sparse and ctx.n_model > 1
         y = train_data["labels"]
         w = train_data["weights"]
         mask = train_data.mask.astype(self.dtype)
@@ -423,9 +480,18 @@ class SGD(Optimizer):
                 self.tol if check_loss else None,
                 self.dtype,
                 sparse=sparse,
+                model_sharded=model_sharded,
             )
             starts, offsets = offset_schedule(train_data.local_rows, local_batch, self.max_iter)
-            coef = ctx.replicate(np.asarray(init_model, self.dtype))
+            dim = int(np.asarray(init_model).shape[0])
+            if model_sharded:
+                pad = (-dim) % ctx.n_model
+                coef_host = np.concatenate(
+                    [np.asarray(init_model, self.dtype), np.zeros(pad, self.dtype)]
+                )
+                coef = jax.device_put(coef_host, ctx.model_dim)
+            else:
+                coef = ctx.replicate(np.asarray(init_model, self.dtype))
             done = ctx.replicate(np.asarray(False))
             self.loss_history = []
             for starts_c, offsets_c, active_c, n_active in chunked_schedule(
@@ -440,8 +506,15 @@ class SGD(Optimizer):
                     self.loss_history.extend(float(x) for x in chunk_losses[:n])
                     if n < n_active:  # done flipped mid-chunk
                         break
-            return np.asarray(jax.device_get(coef))
+            final = np.asarray(jax.device_get(coef))
+            return final[:dim] if model_sharded else final
 
+        if model_sharded:
+            raise ValueError(
+                "model-axis-sharded sparse training runs through the fused "
+                "path; checkpoint managers / listeners are not supported with "
+                "n_model > 1 yet"
+            )
         step = self._build_step(ctx, loss_func, local_batch, sparse=sparse)
 
         if self.checkpoint_manager is not None:
@@ -528,6 +601,10 @@ class SGD(Optimizer):
             dtypes={"indices": np.int32} if sparse else None,
         )
         check_loss = np.isfinite(self.tol) and self.tol > 0
+        # Same model-axis sharding as the resident path: a wide streamed
+        # coefficient divides its scatter cost across n_model shards too.
+        model_sharded = sparse and ctx.n_model > 1
+        dim = int(np.asarray(init_model).shape[0])
         program = _fused_sgd_program(
             ctx,
             loss_func,
@@ -539,7 +616,18 @@ class SGD(Optimizer):
             self.tol if check_loss else None,
             self.dtype,
             sparse=sparse,
+            model_sharded=model_sharded,
         )
+
+        def place_coef(host_coef):
+            host_coef = np.asarray(host_coef, self.dtype)
+            if not model_sharded:
+                return ctx.replicate(host_coef)
+            pad = (-host_coef.shape[0]) % ctx.n_model
+            if pad:
+                host_coef = np.concatenate([host_coef, np.zeros(pad, self.dtype)])
+            return jax.device_put(host_coef, ctx.model_dim)
+
         mgr = self.checkpoint_manager
         start_run = 0
         coef_host = np.asarray(init_model, self.dtype)
@@ -563,7 +651,7 @@ class SGD(Optimizer):
                 self.loss_history = [float(x) for x in state["loss_history"]]
 
         state = {
-            "coef": ctx.replicate(coef_host),
+            "coef": place_coef(coef_host),
             "done": ctx.replicate(done_host),
             "epochs": sum(len(s) for _, s in sched.runs[:start_run]),
             "last_saved": None,
@@ -610,4 +698,5 @@ class SGD(Optimizer):
             return observe
 
         run_windows(stream, sched, dispatch, start_run=start_run)
-        return np.asarray(jax.device_get(state["coef"]))
+        final = np.asarray(jax.device_get(state["coef"]))
+        return final[:dim] if model_sharded else final

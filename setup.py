@@ -18,16 +18,15 @@ class BuildWithNative(build_py):
     def run(self):
         super().run()
         src = Path(__file__).parent / "flink_ml_tpu" / "native" / "datacache.cpp"
-        for base in [Path(self.build_lib)]:
-            out = base / "flink_ml_tpu" / "native" / "_datacache.so"
-            if not out.parent.exists():
-                continue
-            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", str(src), "-o", str(out)]
-            try:
-                subprocess.run(cmd, check=True, capture_output=True, timeout=300)
-                print(f"built native datacache -> {out}")
-            except Exception as e:  # toolchain-less host: lazy build remains
-                print(f"skipping native datacache prebuild ({e})")
+        out = Path(self.build_lib) / "flink_ml_tpu" / "native" / "_datacache.so"
+        if not out.parent.exists():
+            return
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", str(src), "-o", str(out)]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+            print(f"built native datacache -> {out}")
+        except Exception as e:  # toolchain-less host: lazy build remains
+            print(f"skipping native datacache prebuild ({e})")
 
 
 setup(cmdclass={"build_py": BuildWithNative})

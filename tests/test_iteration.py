@@ -263,3 +263,13 @@ class TestReplayableDataStreams:
         for epoch in range(2):
             chunks = list(data.epoch_view(epoch)["train"])
             assert [float(c["x"][0]) for c in chunks] == [1.0, 2.0]
+
+    def test_no_replay_accepts_one_shot_iterator(self):
+        from flink_ml_tpu.iteration import ReplayableDataStreamList
+
+        data = ReplayableDataStreamList(
+            no_replay={"init": iter([{"x": np.asarray([3.0])}])}
+        )
+        chunks = list(data.epoch_view(0)["init"])
+        assert [float(c["x"][0]) for c in chunks] == [3.0]
+        assert list(data.epoch_view(1)["init"]) == []

@@ -220,3 +220,102 @@ class TestSparseLinearModels:
         np.testing.assert_allclose(
             sparse_model.coefficient, dense_model.coefficient, rtol=1e-4, atol=1e-6
         )
+
+
+class TestModelAxisSharding:
+    """Tensor-parallel sparse SGD: coefficient sharded over the mesh's model
+    axis, per-shard range-masked gather/scatter, margins psum'd over the model
+    axis. Must match the replicated-coefficient result on the same data axis."""
+
+    def _data(self, n=96, d=100, nnz=6, seed=13):
+        rng = np.random.default_rng(seed)
+        idx = np.stack([rng.choice(d, nnz, replace=False) for _ in range(n)]).astype(np.int32)
+        vals = rng.standard_normal((n, nnz)).astype(np.float32)
+        y = (np.sum(vals * rng.standard_normal(d).astype(np.float32)[idx], axis=1) > 0).astype(
+            np.float32
+        )
+        return idx, vals, y
+
+    @pytest.mark.parametrize("n_model", [2, 4])
+    def test_tp_matches_replicated(self, n_model):
+        import jax
+
+        from flink_ml_tpu.parallel.mesh import MeshContext, mesh_context
+
+        d = 100  # deliberately NOT divisible by n_model: exercises coef padding
+        idx, vals, y = self._data(d=d)
+        cols = {"indices": idx, "values": vals, "labels": y}
+        kwargs = dict(max_iter=15, global_batch_size=32, tol=0.0, learning_rate=0.4,
+                      reg=0.01, elastic_net=0.5)
+        n_data = 8 // n_model
+        devices = jax.devices()[:8]
+
+        with mesh_context(MeshContext(devices=devices[:n_data], n_data=n_data)) as ctx:
+            want = SGD(ctx=ctx, **kwargs).optimize(
+                np.zeros(d, np.float32), cols, BinaryLogisticLoss.INSTANCE
+            )
+        with mesh_context(
+            MeshContext(devices=devices, n_data=n_data, n_model=n_model)
+        ) as ctx:
+            got = SGD(ctx=ctx, **kwargs).optimize(
+                np.zeros(d, np.float32), cols, BinaryLogisticLoss.INSTANCE
+            )
+        assert got.shape == (d,)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_tp_with_tol_early_stop(self):
+        import jax
+
+        from flink_ml_tpu.parallel.mesh import MeshContext, mesh_context
+
+        idx, vals, y = self._data(d=64, seed=21)
+        cols = {"indices": idx, "values": vals, "labels": y}
+        kwargs = dict(max_iter=300, global_batch_size=96, tol=0.45, learning_rate=0.5)
+        with mesh_context(MeshContext(devices=jax.devices()[:8], n_data=4, n_model=2)) as ctx:
+            sgd = SGD(ctx=ctx, **kwargs)
+            coef = sgd.optimize(np.zeros(64, np.float32), cols, BinaryLogisticLoss.INSTANCE)
+        assert len(sgd.loss_history) < 300, "tol should stop early on the TP path"
+        assert np.all(np.isfinite(coef))
+
+    def test_tp_rejects_host_loop_features(self):
+        import jax
+
+        from flink_ml_tpu.iteration import IterationListener
+        from flink_ml_tpu.parallel.mesh import MeshContext, mesh_context
+
+        idx, vals, y = self._data(d=64)
+        with mesh_context(MeshContext(devices=jax.devices()[:8], n_data=4, n_model=2)) as ctx:
+            with pytest.raises(ValueError, match="n_model"):
+                SGD(ctx=ctx, listeners=[IterationListener()], max_iter=2, tol=0.0).optimize(
+                    np.zeros(64, np.float32),
+                    {"indices": idx, "values": vals, "labels": y},
+                    BinaryLogisticLoss.INSTANCE,
+                )
+
+    def test_tp_streamed_matches_dp_streamed(self, tmp_path):
+        import jax
+
+        from flink_ml_tpu.iteration import HostDataCache
+        from flink_ml_tpu.parallel.mesh import MeshContext, mesh_context
+
+        d = 100  # not divisible by n_model: exercises streamed coef padding
+        idx, vals, y = self._data(d=d, seed=31)
+        cache = HostDataCache(memory_budget_bytes=2000, spill_dir=str(tmp_path))
+        for a in range(0, len(y), 24):
+            cache.append(
+                {"indices": idx[a : a + 24], "values": vals[a : a + 24], "labels": y[a : a + 24]}
+            )
+        cache.finish()
+        kwargs = dict(max_iter=11, global_batch_size=32, tol=0.0, learning_rate=0.3,
+                      stream_window_rows=8)
+        devices = jax.devices()[:8]
+        with mesh_context(MeshContext(devices=devices[:4], n_data=4)) as ctx:
+            want = SGD(ctx=ctx, **kwargs).optimize(
+                np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
+            )
+        with mesh_context(MeshContext(devices=devices, n_data=4, n_model=2)) as ctx:
+            got = SGD(ctx=ctx, **kwargs).optimize(
+                np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
+            )
+        assert got.shape == (d,)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
