@@ -7,7 +7,12 @@ Reference: ``flink-ml-lib/.../classification/knn/`` — the model IS the dataset
 (KnnModel.java:133-180). ``k`` default 5.
 
 TPU-native: the whole query batch against the whole model is one [n,d]×[d,m]
-matmul + top-k — the per-row PriorityQueue disappears into ``lax.top_k``.
+matmul + top-k — the per-row PriorityQueue disappears into ``lax.top_k``. For
+reference sets large enough that the [q, m] distance matrix would not fit
+(m > _BLOCK_ROWS), a streaming variant scans the model in blocks carrying a
+running top-k per query — O(q·(k + block)) memory, same results. The
+majority vote is a vectorized one-hot count (ties break to the smallest
+label, like the reference's sorted-unique argmax).
 """
 from __future__ import annotations
 
@@ -37,6 +42,9 @@ class _KnnParams(HasFeaturesCol, HasPredictionCol):
         return self.set(self.K, value)
 
 
+_BLOCK_ROWS = 8192  # reference rows per streamed block (and the switch point)
+
+
 @functools.cache
 def _neighbors_kernel(k: int):
     @jax.jit
@@ -46,6 +54,55 @@ def _neighbors_kernel(k: int):
         return idx
 
     return nearest
+
+
+@functools.cache
+def _blockwise_neighbors_kernel(k: int, block: int):
+    """Streaming top-k: scan the reference set block-by-block, merging each
+    block's distances into a running per-query top-k — never materializes the
+    [q, m] distance matrix. ``model_norm2`` must be +inf on padding rows (they
+    then sort behind every real neighbor)."""
+
+    @jax.jit
+    def nearest(X, model_x, model_norm2):
+        q = X.shape[0]
+        n_blocks = model_x.shape[0] // block
+        xnorm = jnp.sum(X * X, axis=1, keepdims=True)
+
+        def body(carry, i):
+            best_d, best_i = carry
+            mx = jax.lax.dynamic_slice_in_dim(model_x, i * block, block)
+            mn = jax.lax.dynamic_slice_in_dim(model_norm2, i * block, block)
+            d2 = xnorm + mn[None, :] - 2.0 * X @ mx.T
+            cand_d = jnp.concatenate([best_d, -d2], axis=1)
+            cand_i = jnp.concatenate(
+                [best_i, jnp.broadcast_to(i * block + jnp.arange(block), (q, block))],
+                axis=1,
+            )
+            nd, pos = jax.lax.top_k(cand_d, k)
+            ni = jnp.take_along_axis(cand_i, pos, axis=1)
+            return (nd, ni), None
+
+        init = (
+            jnp.full((q, k), -jnp.inf, jnp.float32),
+            jnp.zeros((q, k), jnp.int32),
+        )
+        (best_d, best_i), _ = jax.lax.scan(body, init, jnp.arange(n_blocks))
+        return best_i
+
+    return nearest
+
+
+def _nearest_indices(X: np.ndarray, mx: np.ndarray, k: int) -> np.ndarray:
+    norm2 = (mx * mx).sum(axis=1).astype(np.float32)
+    m = mx.shape[0]
+    if m <= _BLOCK_ROWS:
+        return np.asarray(_neighbors_kernel(k)(X, mx, norm2))
+    pad = (-m) % _BLOCK_ROWS
+    if pad:
+        mx = np.concatenate([mx, np.zeros((pad, mx.shape[1]), np.float32)])
+        norm2 = np.concatenate([norm2, np.full(pad, np.inf, np.float32)])
+    return np.asarray(_blockwise_neighbors_kernel(k, _BLOCK_ROWS)(X, mx, norm2))
 
 
 class KnnModel(ModelArraysMixin, Model, _KnnParams):
@@ -63,14 +120,15 @@ class KnnModel(ModelArraysMixin, Model, _KnnParams):
         X = df.vectors(self.get_features_col()).astype(np.float32)
         mx = np.asarray(self.model_features, np.float32)
         k = min(self.get_k(), mx.shape[0])
-        idx = np.asarray(
-            _neighbors_kernel(k)(X, mx, (mx * mx).sum(axis=1).astype(np.float32))
-        )
+        idx = _nearest_indices(X, mx, k)
         neighbor_labels = self.model_labels[idx]  # [n, k]
-        pred = np.empty(len(X))
-        for i, row in enumerate(neighbor_labels):
-            vals, counts = np.unique(row, return_counts=True)
-            pred[i] = vals[np.argmax(counts)]
+        # Vectorized majority vote; argmax over sorted classes breaks ties to
+        # the smallest label, matching the per-row sorted-unique argmax.
+        classes = np.unique(self.model_labels)
+        codes = np.searchsorted(classes, neighbor_labels)
+        counts = np.zeros((len(X), len(classes)), np.int32)
+        np.add.at(counts, (np.arange(len(X))[:, None], codes), 1)
+        pred = classes[counts.argmax(axis=1)].astype(np.float64)
         out = df.clone()
         out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, pred)
         return out

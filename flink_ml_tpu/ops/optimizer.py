@@ -360,10 +360,12 @@ class SGD(Optimizer):
         self.listeners = list(listeners)
         self.loss_history: List[float] = []
 
-    def _run_fingerprint(self, loss_func, rows: int, dim: int, extra=None) -> str:
+    def _run_fingerprint(self, loss_func, ctx, rows: int, dim: int, extra=None) -> str:
         """Run/config identity recorded with checkpoints: a different job
         pointed at the same directory must fail loudly, not resume stale state.
-        Single source for both the host-loop and streamed paths."""
+        Single source for both the host-loop and streamed paths. The mesh
+        shape is part of the identity — per-shard batch cycling depends on
+        n_data, and coefficient sharding on n_model."""
         import hashlib
         import json as _json
 
@@ -377,11 +379,26 @@ class SGD(Optimizer):
             "elastic_net": self.elastic_net,
             "rows": rows,
             "dim": dim,
+            "n_data": ctx.n_data,
+            "n_model": ctx.n_model,
         }
         sig.update(extra or {})
         return hashlib.sha256(
             _json.dumps(sig, sort_keys=True).encode()
         ).hexdigest()[:16]
+
+    @staticmethod
+    def _place_coef(ctx, host_coef, dtype, model_sharded: bool):
+        """Place an unpadded host coefficient on the mesh — replicated, or
+        padded to the model-axis size and sharded over it. The single source
+        for both the resident and streamed paths."""
+        host_coef = np.asarray(host_coef, dtype)
+        if not model_sharded:
+            return ctx.replicate(host_coef)
+        pad = (-host_coef.shape[0]) % ctx.n_model
+        if pad:
+            host_coef = np.concatenate([host_coef, np.zeros(pad, dtype)])
+        return jax.device_put(host_coef, ctx.model_dim)
 
     # -- the one SPMD program -------------------------------------------------
     def _build_step(
@@ -484,14 +501,7 @@ class SGD(Optimizer):
             )
             starts, offsets = offset_schedule(train_data.local_rows, local_batch, self.max_iter)
             dim = int(np.asarray(init_model).shape[0])
-            if model_sharded:
-                pad = (-dim) % ctx.n_model
-                coef_host = np.concatenate(
-                    [np.asarray(init_model, self.dtype), np.zeros(pad, self.dtype)]
-                )
-                coef = jax.device_put(coef_host, ctx.model_dim)
-            else:
-                coef = ctx.replicate(np.asarray(init_model, self.dtype))
+            coef = self._place_coef(ctx, init_model, self.dtype, model_sharded)
             done = ctx.replicate(np.asarray(False))
             self.loss_history = []
             for starts_c, offsets_c, active_c, n_active in chunked_schedule(
@@ -521,6 +531,7 @@ class SGD(Optimizer):
             self.checkpoint_manager.set_fingerprint(
                 self._run_fingerprint(
                     loss_func,
+                    ctx,
                     int(train_data.n_valid),
                     int(np.asarray(init_model).shape[0]),
                 )
@@ -619,15 +630,6 @@ class SGD(Optimizer):
             model_sharded=model_sharded,
         )
 
-        def place_coef(host_coef):
-            host_coef = np.asarray(host_coef, self.dtype)
-            if not model_sharded:
-                return ctx.replicate(host_coef)
-            pad = (-host_coef.shape[0]) % ctx.n_model
-            if pad:
-                host_coef = np.concatenate([host_coef, np.zeros(pad, self.dtype)])
-            return jax.device_put(host_coef, ctx.model_dim)
-
         mgr = self.checkpoint_manager
         start_run = 0
         coef_host = np.asarray(init_model, self.dtype)
@@ -637,8 +639,9 @@ class SGD(Optimizer):
             mgr.set_fingerprint(
                 self._run_fingerprint(
                     loss_func,
+                    ctx,
                     n_rows,
-                    int(np.asarray(init_model).shape[0]),
+                    dim,
                     extra={"window": sched.window, "streamed": True},
                 )
             )
@@ -651,7 +654,7 @@ class SGD(Optimizer):
                 self.loss_history = [float(x) for x in state["loss_history"]]
 
         state = {
-            "coef": place_coef(coef_host),
+            "coef": self._place_coef(ctx, np.asarray(coef_host)[:dim], self.dtype, model_sharded),
             "done": ctx.replicate(done_host),
             "epochs": sum(len(s) for _, s in sched.runs[:start_run]),
             "last_saved": None,
@@ -687,7 +690,9 @@ class SGD(Optimizer):
                             state["epochs"],
                             {
                                 "next_run": i + 1,
-                                "coef": state["coef"],
+                                # store the logical (unpadded) coefficient so
+                                # a restore never leaks model-axis padding
+                                "coef": np.asarray(jax.device_get(state["coef"]))[:dim],
                                 "done": state["done"],
                                 "loss_history": np.asarray(self.loss_history, np.float64),
                             },

@@ -249,3 +249,48 @@ def test_mlp_fit_stream_matches_fit(tmp_path):
     for (W1, b1), (W2, b2) in zip(got.params, want.params):
         np.testing.assert_allclose(W1, W2, rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(b1, b2, rtol=1e-5, atol=1e-6)
+
+
+def test_tp_streamed_checkpoint_resume_keeps_logical_dim(tmp_path):
+    """A streamed TP checkpoint must store the unpadded coefficient, carry the
+    mesh shape in its fingerprint, and resume to the right length."""
+    import jax
+
+    from flink_ml_tpu.checkpoint import CheckpointManager
+    from flink_ml_tpu.parallel.mesh import MeshContext, mesh_context
+
+    rng = np.random.default_rng(3)
+    n, d, nnz = 96, 102, 6  # d not divisible by n_model
+    idx = np.stack([rng.choice(d, nnz, replace=False) for _ in range(n)]).astype(np.int32)
+    vals = rng.standard_normal((n, nnz)).astype(np.float32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    cache = HostDataCache()
+    cache.append({"indices": idx, "values": vals, "labels": y})
+    cache.finish()
+    kwargs = dict(max_iter=8, global_batch_size=32, tol=0.0, learning_rate=0.3,
+                  stream_window_rows=8)
+    ckdir = str(tmp_path / "tp-ck")
+    devices = jax.devices()[:8]
+    with mesh_context(MeshContext(devices=devices, n_data=4, n_model=2)) as ctx:
+        got = SGD(
+            ctx=ctx,
+            checkpoint_manager=CheckpointManager(ckdir),
+            checkpoint_interval=2,
+            **kwargs,
+        ).optimize(np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+    assert got.shape == (d,)
+
+    mgr = CheckpointManager(ckdir)
+    steps = mgr.all_steps()
+    _, st = mgr.restore_latest() if False else (None, mgr.restore(steps[-1]))
+    assert np.asarray(st["coef"]).shape == (d,), "checkpoint must be unpadded"
+
+    # A different mesh shape is a different job: the fingerprint must refuse.
+    with mesh_context(MeshContext(devices=devices[:4], n_data=4)) as ctx:
+        with pytest.raises(Exception, match="fingerprint|different"):
+            SGD(
+                ctx=ctx,
+                checkpoint_manager=CheckpointManager(ckdir),
+                checkpoint_interval=2,
+                **kwargs,
+            ).optimize(np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE)
