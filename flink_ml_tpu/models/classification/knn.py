@@ -122,13 +122,14 @@ class KnnModel(ModelArraysMixin, Model, _KnnParams):
         k = min(self.get_k(), mx.shape[0])
         idx = _nearest_indices(X, mx, k)
         neighbor_labels = self.model_labels[idx]  # [n, k]
-        # Vectorized majority vote; argmax over sorted classes breaks ties to
-        # the smallest label, matching the per-row sorted-unique argmax.
-        classes = np.unique(self.model_labels)
-        codes = np.searchsorted(classes, neighbor_labels)
-        counts = np.zeros((len(X), len(classes)), np.int32)
-        np.add.at(counts, (np.arange(len(X))[:, None], codes), 1)
-        pred = classes[counts.argmax(axis=1)].astype(np.float64)
+        # Vectorized k-bounded majority vote (each row has only k candidate
+        # labels, so memory stays O(n·k²) regardless of global label
+        # cardinality); first argmax over the sorted row breaks ties to the
+        # smallest label, matching the per-row sorted-unique argmax.
+        sorted_lab = np.sort(neighbor_labels, axis=1)
+        votes = (sorted_lab[:, :, None] == sorted_lab[:, None, :]).sum(axis=2)
+        best = votes.argmax(axis=1)
+        pred = sorted_lab[np.arange(len(X)), best].astype(np.float64)
         out = df.clone()
         out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, pred)
         return out

@@ -20,6 +20,7 @@ from flink_ml_tpu.parallel.collectives import (
     shard_batch_spec,
 )
 from flink_ml_tpu.parallel.quantile import QuantileSummary
+from flink_ml_tpu.parallel.ring import ring_attention, ring_attention_sharded
 from flink_ml_tpu.parallel.datastream_utils import (
     aggregate,
     co_group,
@@ -31,6 +32,8 @@ from flink_ml_tpu.parallel.datastream_utils import (
 )
 
 __all__ = [
+    "ring_attention",
+    "ring_attention_sharded",
     "DATA_AXIS",
     "MODEL_AXIS",
     "MeshContext",

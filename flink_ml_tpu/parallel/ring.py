@@ -1,0 +1,124 @@
+"""Ring attention — sequence-parallel attention for long contexts.
+
+No analogue exists in the reference (its long-data story is windows and
+streaming, `ops/windows.py`); this is the TPU-native primitive for the
+sequence lengths a single chip cannot hold. The design is the standard ring
+schedule (blockwise attention with a streaming softmax, KV blocks rotating
+around the device ring via ``ppermute`` so compute overlaps the ICI
+transfer):
+
+- the sequence axis is sharded over the mesh; each shard holds its Q block
+  permanently and starts with its own KV block;
+- at every one of ``n_shards`` steps, each shard attends its Q against the
+  currently resident KV block, folding the scores into a running
+  (max, normalizer, weighted-value) accumulator — the numerically stable
+  streaming softmax, so no [T, T] score matrix ever exists;
+- the KV block then moves to the next shard on the ring (one ``ppermute``
+  per step — neighbor traffic that rides ICI, never all-to-all).
+
+Peak memory per shard is O(T_local · d) instead of O(T²); attention FLOPs
+stay on the MXU as [T_local, d] x [d, T_local] matmuls.
+
+``ring_attention`` is the collective-style function used *inside* a
+``shard_map`` (axis name = the sequence axis); ``ring_attention_sharded``
+is the convenience wrapper that shards [B, T, H, D] inputs over the mesh's
+data axis and jits the whole thing.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from flink_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext, get_mesh_context
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Attention for sequence-sharded q/k/v, inside a ``shard_map``.
+
+    ``q, k, v``: [B, T_local, H, D] — this shard's slice of the sequence.
+    Returns [B, T_local, H, D]. With ``causal``, positions attend only to
+    global positions <= their own (global position = shard index · T_local +
+    local offset; shards are assumed to hold contiguous sequence slices in
+    axis order, which is how ``NamedSharding`` lays them out).
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    q_pos = my_idx * T + jnp.arange(T)  # global positions of this shard's Q
+
+    def fold(m, l, acc, kb, vb, step_idx):
+        """Fold the resident KV block into the streaming-softmax accumulator.
+        The block resident at step s started at shard (my_idx - s) mod n."""
+        src = (my_idx - step_idx) % n
+        # scores: [B, H, Tq, Tk] via one MXU matmul per (B, H)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kb) * scale
+        if causal:
+            k_pos = src * T + jnp.arange(T)
+            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+            s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+        # flash-attention-style streaming softmax
+        block_max = jnp.max(s, axis=-1)  # [B, H, Tq]
+        new_m = jnp.maximum(m, block_max)
+        # -inf rows (nothing attendable yet) must not produce NaNs
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        p = jnp.exp(s - safe_m[..., None])  # [B, H, Tq, Tk]
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        correction = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l = l * correction + jnp.sum(p, axis=-1)
+        acc = acc * correction[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vb)
+        return new_m, l, acc
+
+    def step(carry, step_idx):
+        kb, vb, m, l, acc = carry
+        m, l, acc = fold(m, l, acc, kb, vb, step_idx)
+        # rotate KV to the next shard on the ring
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (kb, vb, m, l, acc), None
+
+    # pcast-to-varying: the accumulators are per-shard state (varying over the
+    # sequence axis) — shard_map's scan requires the carry variance to match.
+    m0 = jax.lax.pcast(jnp.full((B, H, T), -jnp.inf, q.dtype), axis_name, to="varying")
+    l0 = jax.lax.pcast(jnp.zeros((B, H, T), q.dtype), axis_name, to="varying")
+    acc0 = jax.lax.pcast(jnp.zeros((B, H, T, D), q.dtype), axis_name, to="varying")
+    # n-1 rotations suffice: the last resident block folds without being
+    # rotated back to its origin (that final exchange would be dead traffic).
+    (kb, vb, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n - 1)
+    )
+    m, l, acc = fold(m, l, acc, kb, vb, n - 1)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, H, Tq, D]
+    return jnp.transpose(out, (0, 2, 1, 3))  # [B, Tq, H, D]
+
+
+@functools.cache
+def _sharded_program(mesh, causal: bool):
+    def per_shard(q, k, v):
+        return ring_attention(q, k, v, DATA_AXIS, causal=causal)
+
+    spec = P(None, DATA_AXIS)  # [B, T, H, D] sharded over the sequence dim
+    return jax.jit(
+        jax.shard_map(
+            per_shard, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
+    )
+
+
+def ring_attention_sharded(q, k, v, causal: bool = False, ctx: MeshContext = None):
+    """Full-sequence attention with [B, T, H, D] inputs sharded over the
+    mesh's data axis as the sequence axis. T must divide evenly by the axis
+    size (pad the sequence; causal masking keeps padding out of real rows
+    as long as padding sits at the tail)."""
+    ctx = ctx or get_mesh_context()
+    T = np.shape(q)[1]
+    if T % ctx.n_data:
+        raise ValueError(f"sequence length {T} not divisible by mesh axis {ctx.n_data}")
+    return _sharded_program(ctx.mesh, causal)(q, k, v)

@@ -282,7 +282,7 @@ def test_tp_streamed_checkpoint_resume_keeps_logical_dim(tmp_path):
 
     mgr = CheckpointManager(ckdir)
     steps = mgr.all_steps()
-    _, st = mgr.restore_latest() if False else (None, mgr.restore(steps[-1]))
+    st = mgr.restore(steps[-1])
     assert np.asarray(st["coef"]).shape == (d,), "checkpoint must be unpadded"
 
     # A different mesh shape is a different job: the fingerprint must refuse.
