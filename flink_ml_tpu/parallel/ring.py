@@ -38,14 +38,17 @@ from flink_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext, get_mesh_context
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+def ring_attention(q, k, v, axis_name: str, causal: bool = False, n_valid: int = None):
     """Attention for sequence-sharded q/k/v, inside a ``shard_map``.
 
     ``q, k, v``: [B, T_local, H, D] — this shard's slice of the sequence.
     Returns [B, T_local, H, D]. With ``causal``, positions attend only to
     global positions <= their own (global position = shard index · T_local +
     local offset; shards are assumed to hold contiguous sequence slices in
-    axis order, which is how ``NamedSharding`` lays them out).
+    axis order, which is how ``NamedSharding`` lays them out). ``n_valid``
+    masks out key positions >= it — REQUIRED when the sequence was padded
+    and ``causal`` is off, or padded keys would receive softmax weight in
+    every real row.
     """
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -59,9 +62,13 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
         src = (my_idx - step_idx) % n
         # scores: [B, H, Tq, Tk] via one MXU matmul per (B, H)
         s = jnp.einsum("bqhd,bkhd->bhqk", q, kb) * scale
-        if causal:
+        if causal or n_valid is not None:
             k_pos = src * T + jnp.arange(T)
-            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+            mask = jnp.ones((T, T), bool)
+            if causal:
+                mask &= q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+            if n_valid is not None:
+                mask &= (k_pos < n_valid)[None, :]
             s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
         # flash-attention-style streaming softmax
         block_max = jnp.max(s, axis=-1)  # [B, H, Tq]
@@ -100,9 +107,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
 
 
 @functools.cache
-def _sharded_program(mesh, causal: bool):
+def _sharded_program(mesh, causal: bool, n_valid):
     def per_shard(q, k, v):
-        return ring_attention(q, k, v, DATA_AXIS, causal=causal)
+        return ring_attention(q, k, v, DATA_AXIS, causal=causal, n_valid=n_valid)
 
     spec = P(None, DATA_AXIS)  # [B, T, H, D] sharded over the sequence dim
     return jax.jit(
@@ -112,13 +119,20 @@ def _sharded_program(mesh, causal: bool):
     )
 
 
-def ring_attention_sharded(q, k, v, causal: bool = False, ctx: MeshContext = None):
+def ring_attention_sharded(
+    q, k, v, causal: bool = False, ctx: MeshContext = None, n_valid: int = None
+):
     """Full-sequence attention with [B, T, H, D] inputs sharded over the
     mesh's data axis as the sequence axis. T must divide evenly by the axis
-    size (pad the sequence; causal masking keeps padding out of real rows
-    as long as padding sits at the tail)."""
+    size; for an uneven sequence, pad q/k/v at the tail and pass the real
+    length as ``n_valid`` — padded keys are then masked out of every row
+    (without it, tail padding is only safe under ``causal``, where real
+    rows never attend forward into it)."""
     ctx = ctx or get_mesh_context()
     T = np.shape(q)[1]
     if T % ctx.n_data:
-        raise ValueError(f"sequence length {T} not divisible by mesh axis {ctx.n_data}")
-    return _sharded_program(ctx.mesh, causal)(q, k, v)
+        raise ValueError(
+            f"sequence length {T} not divisible by mesh axis {ctx.n_data}; "
+            "pad the sequence and pass n_valid"
+        )
+    return _sharded_program(ctx.mesh, causal, n_valid)(q, k, v)

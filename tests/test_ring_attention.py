@@ -48,3 +48,20 @@ def test_uneven_sequence_rejected():
     q = np.zeros((1, 10, 1, 4), np.float32)
     with pytest.raises(ValueError, match="divisible"):
         ring_attention_sharded(q, q, q)
+
+
+def test_padded_sequence_with_n_valid_matches_dense():
+    rng = np.random.default_rng(2)
+    B, T_real, H, D = 1, 50, 2, 8
+    T_pad = 56  # next multiple of the 8-way mesh
+    q = rng.standard_normal((B, T_real, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, T_real, H, D)).astype(np.float32)
+    v = rng.standard_normal((B, T_real, H, D)).astype(np.float32)
+    pad = ((0, 0), (0, T_pad - T_real), (0, 0), (0, 0))
+    got = np.asarray(
+        ring_attention_sharded(
+            np.pad(q, pad), np.pad(k, pad), np.pad(v, pad), n_valid=T_real
+        )
+    )[:, :T_real]
+    want = _dense_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
