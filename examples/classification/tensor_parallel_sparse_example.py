@@ -5,7 +5,7 @@ parallelism when the mesh has no second axis.
 import numpy as np
 
 from flink_ml_tpu.ops import SGD, BinaryLogisticLoss
-from flink_ml_tpu.parallel.mesh import MeshContext, get_mesh_context, mesh_context
+from flink_ml_tpu.parallel.mesh import MeshContext, mesh_context
 
 
 def main():

@@ -56,13 +56,20 @@ class HasK(WithParams):
         return self.set(self.K, value)
 
 
-def _epoch_update(measure, k: int, centroids, X, mask):
-    """One KMeans epoch: assign + one-hot matmul partial sums + centroid update.
-    Shared by the single-step program (multi-chip dryrun) and the fused loop."""
+def _assign_partials(measure, k: int, centroids, X, mask):
+    """assign + one-hot matmul partial sums — the single source for both the
+    whole-epoch update and the streamed per-chunk accumulator."""
     assign = measure.find_closest(X, centroids)
     hot = jax.nn.one_hot(assign, k, dtype=X.dtype) * mask[:, None]
     sums = hot.T @ X  # [k, d]; cross-shard reduce inserted by XLA
     counts = jnp.sum(hot, axis=0)  # [k]
+    return sums, counts
+
+
+def _epoch_update(measure, k: int, centroids, X, mask):
+    """One KMeans epoch: partial sums + centroid update. Shared by the
+    single-step program (multi-chip dryrun) and the fused loop."""
+    sums, counts = _assign_partials(measure, k, centroids, X, mask)
     safe = jnp.maximum(counts, 1.0)[:, None]
     new_centroids = jnp.where(counts[:, None] > 0, sums / safe, centroids)
     return new_centroids, counts
@@ -72,6 +79,17 @@ def _epoch_update(measure, k: int, centroids, X, mask):
 def _train_step(measure_name: str, k: int):
     measure = DistanceMeasure.get_instance(measure_name)
     return jax.jit(lambda centroids, X, mask: _epoch_update(measure, k, centroids, X, mask))
+
+
+@functools.cache
+def _partial_step(measure_name: str, k: int):
+    """Per-chunk partial (sums [k, d], counts [k]) for streamed training —
+    the CentroidsUpdateAccumulator role; chunks combine on the host like the
+    reference's countWindowAll reduce."""
+    measure = DistanceMeasure.get_instance(measure_name)
+    return jax.jit(
+        lambda centroids, X, mask: _assign_partials(measure, k, centroids, X, mask)
+    )
 
 
 @functools.cache
@@ -164,4 +182,79 @@ class KMeans(
         update_existing_params(model, self)
         model.centroids = np.asarray(jax.device_get(centroids), np.float64)
         model.weights = np.asarray(jax.device_get(counts), np.float64)
+        return model
+
+    def fit_stream(self, cache, chunk_rows: int = 65_536) -> KMeansModel:
+        """Larger-than-HBM KMeans: the point set replays from a capacity-tier
+        cache (column ``features``) every epoch through the iteration driver's
+        ``ReplayableDataStreamList`` — the ``ListStateWithCache:224`` role.
+        Each epoch streams device-sized chunks through the partial-sum kernel
+        and combines them on the host (the countWindowAll reduce). Same seed
+        ⇒ same random-sample init as the in-HBM ``fit``, and matching results
+        up to chunked summation order.
+        """
+        from flink_ml_tpu.iteration import (
+            IterationBodyResult,
+            IterationConfig,
+            ReplayableDataStreamList,
+            iterate_bounded_until_termination,
+        )
+        from flink_ml_tpu.iteration.stream import rebatch
+
+        ctx = get_mesh_context()
+        k = self.get_k()
+        n = int(cache.num_rows)
+        if n < k:
+            raise ValueError(f"KMeans needs at least k={k} points, got {n}")
+        rng = np.random.default_rng(self.get_seed())
+        pick = rng.choice(n, size=k, replace=False)
+        init = np.concatenate(
+            [np.asarray(cache.rows(int(i), int(i) + 1)["features"], np.float32) for i in pick]
+        )
+        partial = _partial_step(self.get_distance_measure(), k)
+        data = ReplayableDataStreamList(replay={"points": cache})
+        final_counts = np.zeros(k, np.float32)
+
+        def body(variables, epoch, streams):
+            nonlocal final_counts
+            (centroids,) = variables
+            c_dev = ctx.replicate(np.asarray(centroids, np.float32))
+            sums = np.zeros((k, init.shape[1]), np.float64)
+            counts = np.zeros(k, np.float64)
+            # One-ahead pipelining: enqueue the chunk's (async) partials, stage
+            # the NEXT chunk onto the device, and only then block on the
+            # partials — H2D transfer overlaps the kernel. (The window-schedule
+            # machinery in iteration/streaming.py drives minibatch trainers,
+            # not full-pass accumulators, so it does not fit here.)
+            pending = None
+            for chunk in rebatch(streams["points"], chunk_rows):
+                window = DeviceDataCache(
+                    {"x": np.asarray(chunk["features"], np.float32)}, ctx=ctx
+                )
+                issued = partial(c_dev, window["x"], window.mask)
+                if pending is not None:
+                    sums += np.asarray(jax.device_get(pending[0]), np.float64)
+                    counts += np.asarray(jax.device_get(pending[1]), np.float64)
+                pending = issued
+            if pending is not None:
+                sums += np.asarray(jax.device_get(pending[0]), np.float64)
+                counts += np.asarray(jax.device_get(pending[1]), np.float64)
+            new = np.where(
+                counts[:, None] > 0,
+                sums / np.maximum(counts, 1.0)[:, None],
+                centroids,
+            ).astype(np.float32)
+            final_counts = counts
+            return IterationBodyResult([new], outputs=[new])
+
+        (centroids,) = iterate_bounded_until_termination(
+            [init],
+            body,
+            config=IterationConfig(max_epochs=self.get_max_iter()),
+            data=data,
+        )
+        model = KMeansModel()
+        update_existing_params(model, self)
+        model.centroids = np.asarray(centroids, np.float64)
+        model.weights = np.asarray(final_counts, np.float64)
         return model

@@ -68,7 +68,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, n_valid: int =
             if causal:
                 mask &= q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
             if n_valid is not None:
-                mask &= (k_pos < n_valid)[None, :]
+                # n_valid may be a traced scalar: one compiled program serves
+                # every real length of a padded-sequence workload
+                mask &= (k_pos < jnp.asarray(n_valid))[None, :]
             s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
         # flash-attention-style streaming softmax
         block_max = jnp.max(s, axis=-1)  # [B, H, Tq]
@@ -107,11 +109,23 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, n_valid: int =
 
 
 @functools.cache
-def _sharded_program(mesh, causal: bool, n_valid):
-    def per_shard(q, k, v):
-        return ring_attention(q, k, v, DATA_AXIS, causal=causal, n_valid=n_valid)
-
+def _sharded_program(mesh, causal: bool, masked: bool):
     spec = P(None, DATA_AXIS)  # [B, T, H, D] sharded over the sequence dim
+    if masked:
+        # n_valid arrives as a traced replicated scalar, so ONE compiled
+        # program serves every real length of a padded-sequence workload.
+        def per_shard(q, k, v, n_valid):
+            return ring_attention(q, k, v, DATA_AXIS, causal=causal, n_valid=n_valid)
+
+        return jax.jit(
+            jax.shard_map(
+                per_shard, mesh=mesh, in_specs=(spec, spec, spec, P()), out_specs=spec
+            )
+        )
+
+    def per_shard(q, k, v):
+        return ring_attention(q, k, v, DATA_AXIS, causal=causal)
+
     return jax.jit(
         jax.shard_map(
             per_shard, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
@@ -135,4 +149,8 @@ def ring_attention_sharded(
             f"sequence length {T} not divisible by mesh axis {ctx.n_data}; "
             "pad the sequence and pass n_valid"
         )
-    return _sharded_program(ctx.mesh, causal, n_valid)(q, k, v)
+    if n_valid is None:
+        return _sharded_program(ctx.mesh, causal, False)(q, k, v)
+    return _sharded_program(ctx.mesh, causal, True)(
+        q, k, v, jnp.asarray(n_valid, jnp.int32)
+    )

@@ -90,3 +90,43 @@ def test_kmeans_seed_reproducible():
     m1 = KMeans().set_k(3).set_seed(9).fit(df)
     m2 = KMeans().set_k(3).set_seed(9).fit(df)
     np.testing.assert_allclose(m1.centroids, m2.centroids)
+
+
+class TestKMeansStreamed:
+    """Larger-than-HBM KMeans: points replay from a spilling capacity-tier
+    cache each epoch (ReplayableDataStreamList consumer); same seed gives the
+    same init as the in-HBM fit and matching centroids."""
+
+    def test_fit_stream_matches_fit(self, tmp_path):
+        from flink_ml_tpu.iteration import HostDataCache
+
+        rng = np.random.default_rng(7)
+        X = np.concatenate(
+            [rng.normal([0, 0], 0.4, (60, 2)), rng.normal([6, 6], 0.4, (60, 2))]
+        ).astype(np.float64)
+        rng.shuffle(X)
+        df = DataFrame.from_dict({"features": X})
+        want = KMeans().set_k(2).set_seed(3).set_max_iter(15).fit(df)
+
+        cache = HostDataCache(memory_budget_bytes=500, spill_dir=str(tmp_path))
+        for a in range(0, len(X), 17):
+            cache.append({"features": X[a : a + 17].astype(np.float32)})
+        cache.finish()
+        assert any("files" in e for e in cache._log), "budget should force spill"
+        got = KMeans().set_k(2).set_seed(3).set_max_iter(15).fit_stream(
+            cache, chunk_rows=16
+        )
+        np.testing.assert_allclose(got.centroids, want.centroids, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got.weights, want.weights)
+        # the streamed model serves like any other
+        pred = got.transform(df)["prediction"]
+        assert len(set(pred)) == 2
+
+    def test_fit_stream_rejects_too_few_points(self):
+        from flink_ml_tpu.iteration import HostDataCache
+
+        cache = HostDataCache()
+        cache.append({"features": np.zeros((1, 2), np.float32)})
+        cache.finish()
+        with pytest.raises(ValueError, match="at least k"):
+            KMeans().set_k(2).fit_stream(cache)
