@@ -273,9 +273,17 @@ class DataFrame:
         return self.select(keep)
 
     def take(self, indices) -> "DataFrame":
-        """Row subset / reorder by integer indices."""
+        """Row subset / reorder by integer indices or a boolean mask."""
         indices = np.asarray(indices)
-        if indices.dtype != np.bool_:
+        if indices.dtype == np.bool_:
+            if indices.size != self.num_rows:
+                raise IndexError(
+                    f"boolean mask has {indices.size} entries for {self.num_rows} rows"
+                )
+            # normalize to positions so list (ragged) columns index correctly —
+            # a raw bool mask would be treated as ints 0/1 by the list path
+            indices = np.flatnonzero(indices)
+        else:
             indices = indices.astype(np.int64)
         cols = [
             c[indices] if isinstance(c, np.ndarray) else [c[int(i)] for i in indices]

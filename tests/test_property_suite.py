@@ -1,0 +1,435 @@
+"""Property/adversarial tests across the distributed primitives, sketches,
+windows, persistence, and loss machinery — the depth tier of the reference's
+per-class test files (DataStreamUtilsTest, QuantileSummaryTest,
+WindowsTest, ReadWriteUtilsTest semantics)."""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.parallel.datastream_utils import (
+    aggregate,
+    co_group,
+    distributed_quantiles,
+    distributed_sort,
+    map_partition,
+    reduce,
+    sample,
+)
+from flink_ml_tpu.parallel.quantile import QuantileSummary
+
+RNG = np.random.default_rng(2024)
+
+
+# --------------------------------------------------------------------------- #
+# distributed_sort
+# --------------------------------------------------------------------------- #
+class TestDistributedSort:
+    @pytest.mark.parametrize(
+        "keys",
+        [
+            RNG.standard_normal(1000),
+            np.sort(RNG.standard_normal(500)),  # already sorted
+            np.sort(RNG.standard_normal(500))[::-1].copy(),  # reversed
+            RNG.integers(0, 5, 700).astype(np.float64),  # duplicate-heavy
+            np.asarray([3.0]),  # single element
+            np.full(64, 7.0),  # all equal
+        ],
+        ids=["random", "sorted", "reversed", "dup-heavy", "single", "constant"],
+    )
+    def test_global_order_matches_np_sort(self, keys):
+        buckets = distributed_sort(keys)
+        merged = np.concatenate([b["__key__"] for b in buckets])
+        np.testing.assert_array_equal(merged, np.sort(keys))
+
+    def test_descending(self):
+        keys = RNG.standard_normal(300)
+        buckets = distributed_sort(keys, descending=True)
+        merged = np.concatenate([b["__key__"] for b in buckets])
+        np.testing.assert_array_equal(merged, np.sort(keys)[::-1])
+
+    def test_values_travel_with_keys(self):
+        keys = RNG.standard_normal(400)
+        payload = np.arange(400.0)
+        buckets = distributed_sort(keys, values={"row": payload})
+        for b in buckets:
+            # each carried value must still identify its original key
+            np.testing.assert_array_equal(keys[b["row"].astype(int)], b["__key__"])
+
+    def test_ties_confined_to_one_bucket(self):
+        keys = RNG.integers(0, 8, 2000).astype(np.float64)
+        buckets = distributed_sort(keys)
+        owner = {}
+        for i, b in enumerate(buckets):
+            for k in np.unique(b["__key__"]):
+                assert owner.setdefault(float(k), i) == i, (
+                    f"key {k} split across buckets {owner[float(k)]} and {i}"
+                )
+
+    def test_empty_input(self):
+        buckets = distributed_sort(np.empty(0))
+        assert sum(len(b["__key__"]) for b in buckets) == 0
+
+
+# --------------------------------------------------------------------------- #
+# reservoir sample
+# --------------------------------------------------------------------------- #
+class TestReservoirSample:
+    def test_small_input_returned_whole(self):
+        cols = {"x": np.arange(5.0)}
+        out = sample(cols, 10)
+        np.testing.assert_array_equal(np.sort(out["x"]), cols["x"])
+
+    def test_sample_is_subset_without_replacement(self):
+        cols = {"x": np.arange(10_000.0)}
+        out = sample(cols, 100, seed=1)
+        assert len(out["x"]) == 100
+        assert len(np.unique(out["x"])) == 100
+        assert np.isin(out["x"], cols["x"]).all()
+
+    def test_deterministic_per_seed(self):
+        cols = {"x": np.arange(1000.0)}
+        a = sample(cols, 50, seed=7)["x"]
+        b = sample(cols, 50, seed=7)["x"]
+        np.testing.assert_array_equal(a, b)
+        c = sample(cols, 50, seed=8)["x"]
+        assert not np.array_equal(a, c)
+
+    def test_roughly_uniform_inclusion(self):
+        # every row should appear with probability ~ num_samples/n across seeds
+        n, m, trials = 400, 40, 200
+        counts = np.zeros(n)
+        for seed in range(trials):
+            idx = sample({"x": np.arange(float(n))}, m, seed=seed)["x"].astype(int)
+            counts[idx] += 1
+        freq = counts / trials
+        # expected 0.1; tolerate generous sampling noise but catch bias such as
+        # never sampling the head/tail of the stream
+        assert freq.min() > 0.02 and freq.max() < 0.25
+        assert abs(freq.mean() - m / n) < 0.01
+
+
+# --------------------------------------------------------------------------- #
+# co_group / aggregate / reduce / map_partition
+# --------------------------------------------------------------------------- #
+class TestCoGroupAndFriends:
+    def test_co_group_matches_bruteforce(self):
+        left = RNG.integers(0, 10, 60)
+        right = RNG.integers(5, 15, 40)
+        got = {k: (set(li.tolist()), set(ri.tolist())) for k, li, ri in co_group(left, right)}
+        for key in set(left) | set(right):
+            li, ri = got[key]
+            assert li == set(np.nonzero(left == key)[0].tolist())
+            assert ri == set(np.nonzero(right == key)[0].tolist())
+        # keys emitted in sorted order
+        assert list(got) == sorted(got)
+
+    def test_co_group_one_sided_keys(self):
+        left = np.asarray([1, 1, 2])
+        right = np.asarray([3])
+        rows = list(co_group(left, right))
+        by_key = {k: (li, ri) for k, li, ri in rows}
+        assert len(by_key[1][0]) == 2 and len(by_key[1][1]) == 0
+        assert len(by_key[3][0]) == 0 and len(by_key[3][1]) == 1
+
+    def test_co_group_empty_sides(self):
+        assert list(co_group(np.empty(0), np.empty(0))) == []
+
+    def test_aggregate_matches_numpy(self):
+        x = RNG.standard_normal(1001)  # deliberately not divisible by 8
+        total = aggregate(
+            {"x": x},
+            create_accumulator=lambda: 0.0,
+            add=lambda acc, part: acc + float(part["x"].sum()),
+            merge=lambda a, b: a + b,
+        )
+        np.testing.assert_allclose(total, x.sum(), rtol=1e-12)
+
+    def test_reduce_concatenates_all_rows(self):
+        x = np.arange(37.0)
+        out = reduce(
+            {"x": x}, lambda a, b: {"x": np.concatenate([a["x"], b["x"]])}
+        )
+        np.testing.assert_array_equal(np.sort(out["x"]), x)
+
+    def test_map_partition_covers_every_row_once(self):
+        x = np.arange(101.0)
+        parts = map_partition({"x": x}, lambda p: p["x"])
+        np.testing.assert_array_equal(np.concatenate(parts), x)
+
+
+# --------------------------------------------------------------------------- #
+# GK quantile sketch
+# --------------------------------------------------------------------------- #
+class TestQuantileSummaryProperties:
+    def _rank_error(self, data, s, probs):
+        """Max |rank(answer) - target rank| over the probe quantiles."""
+        n = len(data)
+        data_sorted = np.sort(data)
+        errs = []
+        for p in probs:
+            q = s.query(p)
+            # rank of the returned value within the true data
+            r_lo = np.searchsorted(data_sorted, q, side="left")
+            r_hi = np.searchsorted(data_sorted, q, side="right")
+            target = p * n
+            errs.append(min(abs(r_lo - target), abs(r_hi - target)))
+        return max(errs)
+
+    @pytest.mark.parametrize("dist", ["normal", "uniform", "heavy-dup", "sorted"])
+    def test_rank_error_bound(self, dist):
+        n, eps = 20_000, 0.01
+        rng = np.random.default_rng(3)
+        if dist == "normal":
+            data = rng.standard_normal(n)
+        elif dist == "uniform":
+            data = rng.random(n)
+        elif dist == "heavy-dup":
+            data = rng.integers(0, 50, n).astype(np.float64)
+        else:
+            data = np.sort(rng.standard_normal(n))
+        s = QuantileSummary(relative_error=eps)
+        s.insert_all(data)
+        s.compress()
+        probs = [0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99]
+        assert self._rank_error(data, s, probs) <= 2 * eps * n + 1
+
+    def test_merged_shard_sketches_hold_the_bound(self):
+        n, eps, shards = 24_000, 0.01, 8
+        rng = np.random.default_rng(4)
+        data = rng.standard_normal(n)
+        parts = np.array_split(data, shards)
+        sketches = []
+        for part in parts:
+            s = QuantileSummary(relative_error=eps)
+            s.insert_all(part)
+            s.compress()
+            sketches.append(s)
+        merged = sketches[0]
+        for other in sketches[1:]:
+            merged = merged.merge(other)
+        merged.compress()
+        probs = [0.05, 0.5, 0.95]
+        assert self._rank_error(data, merged, probs) <= 4 * eps * n + 1
+
+    def test_distributed_quantiles_multi_column(self):
+        rng = np.random.default_rng(5)
+        X = np.column_stack([rng.standard_normal(5000), rng.random(5000) * 100])
+        q = distributed_quantiles(X, [0.25, 0.5, 0.75], relative_error=0.001)
+        want = np.quantile(X, [0.25, 0.5, 0.75], axis=0)
+        np.testing.assert_allclose(q, want, atol=np.ptp(X, axis=0).max() * 0.02)
+
+
+# --------------------------------------------------------------------------- #
+# window descriptors
+# --------------------------------------------------------------------------- #
+class TestWindows:
+    def test_event_time_session_windows_split_on_gap(self):
+        from flink_ml_tpu.iteration.stream import window_stream
+        from flink_ml_tpu.ops.windows import EventTimeSessionWindows
+
+        ts = np.asarray([0.0, 10.0, 20.0, 500.0, 510.0, 2000.0])
+        stream = iter([{"t": ts, "x": np.arange(6.0)}])
+        wins = list(
+            window_stream(
+                stream, EventTimeSessionWindows.with_gap(100), timestamp_column="t"
+            )
+        )
+        assert [w["x"].tolist() for w in wins] == [[0, 1, 2], [3, 4], [5]]
+
+    def test_processing_time_windows_use_clock(self):
+        from flink_ml_tpu.iteration.stream import window_stream
+        from flink_ml_tpu.ops.windows import ProcessingTimeTumblingWindows
+
+        clock = iter([0.0, 0.0, 5000.0, 5000.0]).__next__
+        batches = [{"x": np.asarray([float(i)])} for i in range(4)]
+        wins = list(
+            window_stream(
+                iter(batches), ProcessingTimeTumblingWindows.of(1000), now=clock
+            )
+        )
+        assert [w["x"].tolist() for w in wins] == [[0.0, 1.0], [2.0, 3.0]]
+
+    def test_windows_json_round_trip(self):
+        from flink_ml_tpu.ops.windows import (
+            CountTumblingWindows,
+            EventTimeSessionWindows,
+            EventTimeTumblingWindows,
+            GlobalWindows,
+            Windows,
+        )
+
+        for w in [
+            GlobalWindows.get_instance(),
+            CountTumblingWindows.of(7),
+            EventTimeTumblingWindows.of(2500),
+            EventTimeSessionWindows.with_gap(42),
+        ]:
+            back = Windows.from_json_dict(w.to_json_dict())
+            assert type(back) is type(w)
+            assert back.to_json_dict() == w.to_json_dict()
+
+
+# --------------------------------------------------------------------------- #
+# checkpoint manager
+# --------------------------------------------------------------------------- #
+class TestCheckpointManager:
+    def test_max_to_keep_prunes_oldest(self, tmp_path):
+        from flink_ml_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+        for step in (1, 2, 3, 4):
+            mgr.save(step, {"w": np.full(3, float(step))})
+        assert mgr.all_steps() == [3, 4]
+        step, state = mgr.restore_latest()
+        assert step == 4
+        np.testing.assert_array_equal(state["w"], [4.0, 4.0, 4.0])
+
+    def test_pinned_fingerprint_wins_over_auto(self, tmp_path):
+        from flink_ml_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), fingerprint="pinned")
+        mgr.set_fingerprint("auto-computed")  # must not override the pin
+        assert mgr.fingerprint == "pinned"
+        mgr2 = CheckpointManager(str(tmp_path))
+        mgr2.set_fingerprint("a")
+        mgr2.set_fingerprint("b")  # auto fingerprints do replace each other
+        assert mgr2.fingerprint == "b"
+
+    def test_fingerprint_mismatch_refuses_restore(self, tmp_path):
+        from flink_ml_tpu.checkpoint import CheckpointManager
+
+        CheckpointManager(str(tmp_path), fingerprint="job-a").save(1, {"w": np.ones(2)})
+        mgr = CheckpointManager(str(tmp_path), fingerprint="job-b")
+        with pytest.raises(Exception):
+            mgr.restore_latest()
+
+    def test_restore_latest_none_when_empty(self, tmp_path):
+        from flink_ml_tpu.checkpoint import CheckpointManager
+
+        assert CheckpointManager(str(tmp_path)).restore_latest() is None
+
+
+# --------------------------------------------------------------------------- #
+# losses: analytic overrides vs the autograd default
+# --------------------------------------------------------------------------- #
+class TestLossAutogradParity:
+    @pytest.mark.parametrize("name", ["BinaryLogisticLoss", "HingeLoss", "LeastSquareLoss"])
+    def test_analytic_equals_autograd(self, name):
+        import jax.numpy as jnp
+
+        from flink_ml_tpu.ops import lossfunc
+
+        loss = getattr(lossfunc, name).INSTANCE
+        rng = np.random.default_rng(11)
+        X = jnp.asarray(rng.standard_normal((24, 5)).astype(np.float32))
+        y = jnp.asarray(rng.integers(0, 2, 24).astype(np.float32))
+        w = jnp.asarray(rng.uniform(0.5, 2.0, 24).astype(np.float32))
+        coef = jnp.asarray(rng.standard_normal(5).astype(np.float32))
+        want_l, want_g = lossfunc.LossFunc.loss_and_grad_sum(loss, coef, X, y, w)
+        got_l, got_g = loss.loss_and_grad_sum(coef, X, y, w)
+        np.testing.assert_allclose(got_l, want_l, rtol=1e-5)
+        np.testing.assert_allclose(got_g, want_g, rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# evaluator: weighted KS and Lorenz hand-checks
+# --------------------------------------------------------------------------- #
+class TestEvaluatorMoreMetrics:
+    def test_perfect_separation_lorenz(self):
+        from flink_ml_tpu.api.dataframe import DataFrame
+        from flink_ml_tpu.models.evaluation.binary_classification_evaluator import (
+            BinaryClassificationEvaluator,
+        )
+
+        y = np.asarray([0.0, 0.0, 1.0, 1.0])
+        score = np.asarray([0.1, 0.2, 0.8, 0.9])
+        out = (
+            BinaryClassificationEvaluator()
+            .set_metrics_names("areaUnderLorenz", "ks")
+            .transform(DataFrame.from_dict({"label": y, "rawPrediction": score}))
+        )
+        assert out["ks"][0] == 1.0
+        assert 0.0 <= out["areaUnderLorenz"][0] <= 1.0
+
+    def test_weighted_ks_changes_with_weights(self):
+        from flink_ml_tpu.api.dataframe import DataFrame
+        from flink_ml_tpu.models.evaluation.binary_classification_evaluator import (
+            BinaryClassificationEvaluator,
+        )
+
+        y = np.asarray([0.0, 1.0, 0.0, 1.0])
+        score = np.asarray([0.2, 0.4, 0.6, 0.8])
+        base = (
+            BinaryClassificationEvaluator()
+            .set_metrics_names("ks")
+            .transform(DataFrame.from_dict({"label": y, "rawPrediction": score}))
+        )["ks"][0]
+        weighted = (
+            BinaryClassificationEvaluator()
+            .set_metrics_names("ks")
+            .set_weight_col("w")
+            .transform(
+                DataFrame.from_dict(
+                    {
+                        "label": y,
+                        "rawPrediction": score,
+                        "w": np.asarray([5.0, 1.0, 1.0, 1.0]),
+                    }
+                )
+            )
+        )["ks"][0]
+        assert weighted != base
+
+
+# --------------------------------------------------------------------------- #
+# DataFrame boundary behaviors
+# --------------------------------------------------------------------------- #
+class TestDataFrameBoundary:
+    def test_from_rows_collect_round_trip_with_vectors(self):
+        from flink_ml_tpu.api.dataframe import DataFrame, Row
+        from flink_ml_tpu.linalg.vectors import DenseVector, SparseVector
+
+        rows = [
+            Row([1.0, DenseVector([1.0, 2.0]), "a"]),
+            Row([2.0, DenseVector([3.0, 4.0]), "b"]),
+        ]
+        df = DataFrame.from_rows(["s", "v", "t"], rows)
+        back = df.collect()
+        assert back == rows
+
+        sv_rows = [Row([SparseVector(4, [1], [9.0])]), Row([SparseVector(4, [0], [1.0])])]
+        df2 = DataFrame.from_rows(["v"], sv_rows)
+        assert df2.collect() == sv_rows
+
+    def test_take_with_boolean_mask_and_reorder(self):
+        from flink_ml_tpu.api.dataframe import DataFrame
+
+        df = DataFrame.from_dict({"x": np.arange(5.0), "s": list("abcde")})
+        picked = df.take(np.asarray([True, False, False, True, False]))
+        np.testing.assert_array_equal(picked["x"], [0.0, 3.0])
+        assert picked["s"] == ["a", "d"]
+        reordered = df.take(np.asarray([4, 0]))
+        np.testing.assert_array_equal(reordered["x"], [4.0, 0.0])
+
+    def test_add_column_length_mismatch_raises(self):
+        from flink_ml_tpu.api.dataframe import DataFrame
+        from flink_ml_tpu.api.types import DataTypes
+
+        df = DataFrame.from_dict({"x": np.arange(3.0)})
+        with pytest.raises(ValueError, match="rows"):
+            df.add_column("y", DataTypes.DOUBLE, np.arange(4.0))
+
+    def test_select_drop_preserve_types(self):
+        from flink_ml_tpu.api.dataframe import DataFrame
+
+        df = DataFrame.from_dict({"a": np.arange(3.0), "b": list("xyz"), "c": np.ones(3)})
+        sel = df.select(["c", "a"])
+        assert sel.get_column_names() == ["c", "a"]
+        assert df.drop("b").get_column_names() == ["a", "c"]
+        assert df.get_data_type("a") == sel.get_data_type("a")
+
+    def test_take_mask_length_mismatch_raises(self):
+        from flink_ml_tpu.api.dataframe import DataFrame
+
+        df = DataFrame.from_dict({"x": np.arange(5.0)})
+        with pytest.raises(IndexError, match="mask"):
+            df.take(np.asarray([True, False, True]))
