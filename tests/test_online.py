@@ -448,3 +448,64 @@ class TestModelDelayGating:
         assert loaded.pending_rows == 1
         cell = loaded._pending[0].column("input")[0]
         np.testing.assert_array_equal(cell.to_array(), [7.0])
+
+
+class TestOnlineModelPersistence:
+    """Every online model's versioned state must survive save/load and keep
+    serving identically (the model-data records carry modelVersion in the
+    reference, e.g. LogisticRegressionModelData)."""
+
+    def test_online_kmeans_save_load(self, tmp_path):
+        stream = QueueBatchStream()
+        model = (
+            OnlineKMeans().set_k(2).set_seed(1).set_random_initial_model_data(dim=2).fit(stream)
+        )
+        pts = np.concatenate(
+            [RNG.normal([0, 0], 0.1, (16, 2)), RNG.normal([5, 5], 0.1, (16, 2))]
+        )
+        stream.add({"features": pts})
+        model.advance()
+        path = str(tmp_path / "okm")
+        model.save(path)
+        loaded = OnlineKMeansModel.load(path)
+        assert loaded.model_version == model.model_version
+        np.testing.assert_allclose(loaded.centroids, model.centroids)
+        df = DataFrame.from_dict({"features": pts})
+        np.testing.assert_array_equal(
+            loaded.transform(df)["prediction"], model.transform(df)["prediction"]
+        )
+
+    def test_online_lr_loaded_model_serves_identically(self, tmp_path):
+        # (version/coefficient round-trip is covered by
+        # test_save_load_preserves_model_version; this pins the serving path)
+        stream = QueueBatchStream()
+        model = (
+            OnlineLogisticRegression()
+            .set_initial_model_data(_init_lr_model_data())
+            .set_global_batch_size(64)
+            .fit(stream)
+        )
+        stream.add(_lr_batch(seed=2))
+        model.advance()
+        model.save(str(tmp_path / "olr"))
+        loaded = OnlineLogisticRegressionModel.load(str(tmp_path / "olr"))
+        X = _lr_batch(seed=3)["features"]
+        df = DataFrame.from_dict({"features": X})
+        np.testing.assert_array_equal(
+            loaded.transform(df)["prediction"], model.transform(df)["prediction"]
+        )
+
+    def test_loaded_model_keeps_serving_without_stream(self, tmp_path):
+        # A loaded model has no attached training stream: advance() is a no-op
+        # and transform must not crash.
+        stream = QueueBatchStream()
+        model = (
+            OnlineKMeans().set_k(2).set_seed(5).set_random_initial_model_data(dim=2).fit(stream)
+        )
+        stream.add({"features": RNG.normal(size=(8, 2))})
+        model.advance()
+        model.save(str(tmp_path / "m"))
+        loaded = OnlineKMeansModel.load(str(tmp_path / "m"))
+        assert loaded.advance() == 0
+        out = loaded.transform(DataFrame.from_dict({"features": RNG.normal(size=(4, 2))}))
+        assert len(out) == 4

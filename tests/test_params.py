@@ -97,3 +97,32 @@ class TestValidators:
     def test_range_exclusive(self):
         v = ParamValidators.in_range(0, 1, lower_inclusive=False, upper_inclusive=False)
         assert v(0.5) and not v(0.0) and not v(1.0)
+
+
+class TestParamValidators:
+    """Validator battery (ref ParamValidatorsTest): every bound type accepts
+    and rejects at its edge, and invalid sets fail loudly at set() time."""
+
+    def test_bounds(self):
+        # (in_array / exclusive in_range covered by TestValidators above)
+        assert ParamValidators.gt(0)(1) and not ParamValidators.gt(0)(0)
+        assert ParamValidators.gt_eq(0)(0) and not ParamValidators.gt_eq(0)(-1)
+        assert ParamValidators.lt(5)(4) and not ParamValidators.lt(5)(5)
+        assert ParamValidators.lt_eq(5)(5) and not ParamValidators.lt_eq(5)(6)
+        rng_inc = ParamValidators.in_range(0, 1)
+        assert rng_inc(0.0) and rng_inc(1.0)
+        assert not ParamValidators.not_null()(None) and ParamValidators.not_null()(0)
+
+    def test_set_invalid_value_raises(self):
+        from flink_ml_tpu.models.clustering.kmeans import KMeans
+
+        with pytest.raises(ValueError):
+            KMeans().set_k(1)  # k must be > 1
+        with pytest.raises(ValueError):
+            KMeans().set_max_iter(0)
+
+    def test_none_rejected_by_validated_params(self):
+        from flink_ml_tpu.models.recommendation.swing import Swing
+
+        with pytest.raises(ValueError):
+            Swing().set_user_col(None)
