@@ -1,0 +1,41 @@
+"""Text classification as one Pipeline: Tokenizer -> HashingTF -> sparse
+LogisticRegression (the features column crosses string -> tokens ->
+SparseVector, and training runs the padded-CSR path end-to-end).
+"""
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.builder.pipeline import Pipeline
+from flink_ml_tpu.models.classification.logistic_regression import LogisticRegression
+from flink_ml_tpu.models.feature.hashing_tf import HashingTF
+from flink_ml_tpu.models.feature.tokenizer import Tokenizer
+
+
+def main():
+    rng = np.random.default_rng(0)
+    sports = "game team score win goal match play season league cup".split()
+    cooking = "bake oven recipe flour sugar stir dough taste dish salt".split()
+    texts, labels = [], []
+    for words, label in ((sports, 0.0), (cooking, 1.0)):
+        for _ in range(40):
+            texts.append(" ".join(rng.choice(words, 6)))
+            labels.append(label)
+    train = DataFrame(["text", "label"], None, [texts, np.asarray(labels)])
+
+    pipeline = Pipeline([
+        Tokenizer().set_input_col("text").set_output_col("tokens"),
+        HashingTF().set_input_col("tokens").set_output_col("features").set_num_features(1 << 16),
+        LogisticRegression().set_features_col("features").set_max_iter(60)
+        .set_learning_rate(1.0).set_global_batch_size(32).set_tol(0.0),
+    ])
+    model = pipeline.fit(train)
+
+    queries = DataFrame(["text"], None, [[
+        "the team won the match", "stir the flour and sugar",
+    ]])
+    for text, pred in zip(queries["text"], model.transform(queries)["prediction"]):
+        print(f"{text!r} -> {'sports' if pred == 0.0 else 'cooking'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""End-to-end composition tests: multi-stage Pipelines whose intermediate
+columns cross representation boundaries (strings → tokens → SparseVector →
+sparse training), plus save/load of the whole fitted chain — the
+PipelineTest/GraphTest integration tier of the reference."""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.builder.pipeline import Pipeline
+from flink_ml_tpu.utils.read_write import load_stage
+
+
+def _text_data(n_per_class=40, seed=0):
+    """Two topics with overlapping vocabulary; labels follow the topic."""
+    rng = np.random.default_rng(seed)
+    sports = "game team score win goal match play season league cup".split()
+    cooking = "bake oven recipe flour sugar stir dough taste dish salt".split()
+    common = "the a and with for very really today".split()
+    texts, labels = [], []
+    for words, label in ((sports, 0.0), (cooking, 1.0)):
+        for _ in range(n_per_class):
+            picks = list(rng.choice(words, 5)) + list(rng.choice(common, 3))
+            rng.shuffle(picks)
+            texts.append(" ".join(picks))
+            labels.append(label)
+    order = rng.permutation(len(texts))
+    return [texts[i] for i in order], np.asarray(labels)[order]
+
+
+class TestTextClassificationPipeline:
+    def _build(self):
+        from flink_ml_tpu.models.classification.logistic_regression import (
+            LogisticRegression,
+        )
+        from flink_ml_tpu.models.feature.hashing_tf import HashingTF
+        from flink_ml_tpu.models.feature.tokenizer import Tokenizer
+
+        return Pipeline(
+            [
+                Tokenizer().set_input_col("text").set_output_col("tokens"),
+                HashingTF()
+                .set_input_col("tokens")
+                .set_output_col("features")
+                .set_num_features(1 << 16),
+                LogisticRegression()
+                .set_features_col("features")
+                .set_max_iter(60)
+                .set_learning_rate(1.0)
+                .set_global_batch_size(32)
+                .set_tol(0.0),
+            ]
+        )
+
+    def test_fit_predict_and_save_load(self, tmp_path):
+        texts, labels = _text_data()
+        df = DataFrame(["text", "label"], None, [texts, labels])
+        model = self._build().fit(df)
+
+        # HashingTF emits SparseVector columns, so training went through the
+        # padded-CSR path with a 2^16-dim coefficient — never densified.
+        lr_model = model.stages[-1]
+        assert lr_model.coefficient.shape == (1 << 16,)
+
+        scored = model.transform(df)
+        acc = float(np.mean(scored["prediction"] == labels))
+        assert acc > 0.95, f"text pipeline failed to learn: {acc}"
+
+        # whole-chain persistence: load_stage gives back a PipelineModel that
+        # scores raw text identically
+        path = str(tmp_path / "text-pipe")
+        model.save(path)
+        reloaded = load_stage(path)
+        again = reloaded.transform(df)
+        np.testing.assert_array_equal(again["prediction"], scored["prediction"])
+
+    def test_unseen_text_generalizes(self):
+        texts, labels = _text_data(seed=1)
+        df = DataFrame(["text", "label"], None, [texts, labels])
+        model = self._build().fit(df)
+        queries = DataFrame(
+            ["text"],
+            None,
+            [["the team won the big match today", "stir the flour and sugar in the dish"]],
+        )
+        pred = model.transform(queries)["prediction"]
+        np.testing.assert_array_equal(pred, [0.0, 1.0])
+
+
+class TestNumericPipeline:
+    def test_scaler_into_kmeans(self, tmp_path):
+        from flink_ml_tpu.models.clustering.kmeans import KMeans
+        from flink_ml_tpu.models.feature.standard_scaler import StandardScaler
+
+        rng = np.random.default_rng(3)
+        # one dimension dominates unscaled distances; scaling must fix that
+        X = np.concatenate(
+            [
+                np.column_stack([rng.normal(0, 1, 50), rng.normal(0.0, 800, 50)]),
+                np.column_stack([rng.normal(6, 1, 50), rng.normal(0.0, 800, 50)]),
+            ]
+        )
+        df = DataFrame.from_dict({"features": X})
+        pipe = Pipeline(
+            [
+                StandardScaler()
+                .set_input_col("features")
+                .set_output_col("scaled")
+                .set_with_mean(True),
+                KMeans().set_features_col("scaled").set_k(2).set_seed(0).set_max_iter(20),
+            ]
+        )
+        model = pipe.fit(df)
+        pred = model.transform(df)["prediction"]
+        # scaling makes the blobs separable along dim 0 (a couple of boundary
+        # points may flip): majorities must differ with high purity
+        maj_a = np.round(np.mean(pred[:50]))
+        maj_b = np.round(np.mean(pred[50:]))
+        assert maj_a != maj_b
+        assert np.mean(pred[:50] == maj_a) > 0.9
+        assert np.mean(pred[50:] == maj_b) > 0.9
+
+        model.save(str(tmp_path / "numeric-pipe"))
+        reloaded = load_stage(str(tmp_path / "numeric-pipe"))
+        np.testing.assert_array_equal(reloaded.transform(df)["prediction"], pred)
+
+    def test_pipeline_of_pipelines(self):
+        """A Pipeline is itself a Stage, so pipelines nest (ref Pipeline being
+        an Estimator in PipelineTest)."""
+        from flink_ml_tpu.models.feature.scalers import MinMaxScaler
+        from flink_ml_tpu.models.feature.standard_scaler import StandardScaler
+
+        rng = np.random.default_rng(4)
+        X = rng.normal(5.0, 3.0, size=(40, 2))
+        df = DataFrame.from_dict({"features": X})
+        inner = Pipeline(
+            [
+                StandardScaler()
+                .set_input_col("features")
+                .set_output_col("std")
+                .set_with_mean(True)
+            ]
+        )
+        outer = Pipeline(
+            [inner, MinMaxScaler().set_input_col("std").set_output_col("out")]
+        )
+        out = outer.fit(df).transform(df)["out"]
+        assert out.min() >= -1e-7 and out.max() <= 1.0 + 1e-7

@@ -98,13 +98,8 @@ class TestValidators:
         v = ParamValidators.in_range(0, 1, lower_inclusive=False, upper_inclusive=False)
         assert v(0.5) and not v(0.0) and not v(1.0)
 
-
-class TestParamValidators:
-    """Validator battery (ref ParamValidatorsTest): every bound type accepts
-    and rejects at its edge, and invalid sets fail loudly at set() time."""
-
+    # -- every bound type at its edge; invalid set() calls fail loudly --------
     def test_bounds(self):
-        # (in_array / exclusive in_range covered by TestValidators above)
         assert ParamValidators.gt(0)(1) and not ParamValidators.gt(0)(0)
         assert ParamValidators.gt_eq(0)(0) and not ParamValidators.gt_eq(0)(-1)
         assert ParamValidators.lt(5)(4) and not ParamValidators.lt(5)(5)
