@@ -120,3 +120,84 @@ class TestBLAS:
         d = np.asarray(blas.sq_dist_batch(xs, cs))
         assert d[0].tolist() == [0.0, 25.0]
         assert d[1].tolist() == pytest.approx([2.0, 13.0])
+
+
+class TestBlasAgainstNumpy:
+    """Every BLAS kernel against its numpy definition (ref BLASTest values)."""
+
+    def test_kernels(self):
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal(16)
+        y = rng.standard_normal(16)
+        np.testing.assert_allclose(blas.asum(x), np.abs(x).sum(), rtol=1e-6)
+        np.testing.assert_allclose(blas.dot(x, y), x @ y, rtol=1e-6)
+        np.testing.assert_allclose(blas.hdot(x, y), x * y, rtol=1e-6)
+        np.testing.assert_allclose(blas.norm2(x), np.linalg.norm(x), rtol=1e-6)
+        np.testing.assert_allclose(blas.norm(x, 1.0), np.abs(x).sum(), rtol=1e-6)
+        np.testing.assert_allclose(blas.norm(x, np.inf), np.abs(x).max(), rtol=1e-6)
+        np.testing.assert_allclose(blas.scal(2.5, x), 2.5 * x, rtol=1e-6)
+        np.testing.assert_allclose(blas.axpy(0.5, x, y), 0.5 * x + y, rtol=1e-6)
+
+    def test_gemv_both_orientations(self):
+        rng = np.random.default_rng(10)
+        A = rng.standard_normal((4, 6))
+        x6, x4 = rng.standard_normal(6), rng.standard_normal(4)
+        y4, y6 = rng.standard_normal(4), rng.standard_normal(6)
+        np.testing.assert_allclose(
+            blas.gemv(2.0, A, False, x6, 0.5, y4), 2.0 * A @ x6 + 0.5 * y4, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            blas.gemv(1.0, A, True, x4, 0.0, y6), A.T @ x4, rtol=1e-5, atol=1e-6
+        )
+
+    def test_batched_kernels(self):
+        rng = np.random.default_rng(11)
+        X = rng.standard_normal((8, 5))
+        y = rng.standard_normal(5)
+        C = rng.standard_normal((3, 5))
+        np.testing.assert_allclose(blas.dots_batch(X, y), X @ y, rtol=1e-5)
+        want = ((X[:, None, :] - C[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(blas.sq_dist_batch(X, C), want, rtol=1e-4, atol=1e-4)
+
+
+class TestVectorInvariants:
+    def test_sparse_rejects_bad_indices(self):
+        with pytest.raises(ValueError):
+            SparseVector(3, [0, 3], [1.0, 2.0])  # out of range
+        with pytest.raises(ValueError):
+            SparseVector(3, [1, 1], [1.0, 2.0])  # duplicate
+        with pytest.raises(ValueError):
+            SparseVector(3, [0], [1.0, 2.0])  # shape mismatch
+
+    def test_sparse_constructor_sorts_pairs(self):
+        v = SparseVector(5, [4, 0, 2], [40.0, 0.5, 20.0])
+        np.testing.assert_array_equal(v.indices, [0, 2, 4])
+        np.testing.assert_array_equal(v.values, [0.5, 20.0, 40.0])
+        assert v.get(2) == 20.0 and v.get(1) == 0.0
+
+    def test_sparse_set_inserts_and_updates(self):
+        v = SparseVector(5, [1], [1.0])
+        v.set(3, 9.0)  # insert keeps sorted order
+        np.testing.assert_array_equal(v.indices, [1, 3])
+        v.set(1, 5.0)  # update in place
+        assert v.get(1) == 5.0
+        with pytest.raises(IndexError):
+            v.set(5, 1.0)
+
+    def test_dense_sparse_round_trip(self):
+        d = DenseVector([0.0, 3.0, 0.0, 4.0])
+        s = d.to_sparse()
+        np.testing.assert_array_equal(s.indices, [1, 3])
+        np.testing.assert_array_equal(s.to_dense().values, d.values)
+
+
+class TestDenseMatrix:
+    def test_get_set_clone_eq(self):
+        from flink_ml_tpu.linalg import DenseMatrix
+
+        m = DenseMatrix(2, 3, np.arange(6.0))
+        assert (m.num_rows, m.num_cols) == (2, 3)
+        m2 = m.clone()
+        m2.set(1, 2, 99.0)
+        assert m.get(1, 2) != 99.0 and m2.get(1, 2) == 99.0
+        assert m == m.clone() and m != m2
