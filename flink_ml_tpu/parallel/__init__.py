@@ -21,6 +21,7 @@ from flink_ml_tpu.parallel.collectives import (
 )
 from flink_ml_tpu.parallel.quantile import QuantileSummary
 from flink_ml_tpu.parallel.ring import ring_attention, ring_attention_sharded
+from flink_ml_tpu.parallel.moe import moe_ffn, moe_ffn_sharded
 from flink_ml_tpu.parallel.datastream_utils import (
     aggregate,
     co_group,
@@ -32,6 +33,8 @@ from flink_ml_tpu.parallel.datastream_utils import (
 )
 
 __all__ = [
+    "moe_ffn",
+    "moe_ffn_sharded",
     "ring_attention",
     "ring_attention_sharded",
     "DATA_AXIS",

@@ -1,0 +1,124 @@
+"""Expert-parallel mixture-of-experts dispatch — the ``ep`` axis primitive.
+
+No analogue exists in the reference (its models are single coefficient
+vectors); this completes the framework's parallelism vocabulary alongside
+data (dp), model/tensor (tp), and sequence (sp, ``parallel/ring.py``)
+sharding. The design is the standard switch-routing schedule:
+
+- experts shard over the mesh axis (each shard owns ``E / n_shards``
+  expert FFNs), tokens shard over the same axis;
+- each shard routes its tokens top-1 (router logits → expert, gate prob),
+  packs them into fixed-capacity per-expert slots (static shapes — tokens
+  past an expert's capacity are dropped, the Switch-Transformer overflow
+  rule, and their output contribution is zero);
+- ONE ``all_to_all`` carries every slot to the shard owning its expert,
+  the owner runs its experts' FFNs as one batched matmul pair, and the
+  reverse ``all_to_all`` returns outputs to the token's home shard, where
+  they combine scaled by the gate probability.
+
+Per-step traffic is two all-to-alls of the capacity buffers — the exact
+collective the task's "all-to-all" parallelism calls for — and every shape
+is static, so the whole thing jits into one SPMD program.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from flink_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext, get_mesh_context
+
+__all__ = ["moe_ffn", "moe_ffn_sharded"]
+
+
+def moe_ffn(x, router, w1, w2, axis_name: str, capacity: int):
+    """Top-1 expert-parallel FFN inside a ``shard_map``.
+
+    ``x [t, d]`` — this shard's tokens; ``router [d, E]`` replicated;
+    ``w1 [e_local, d, h]`` / ``w2 [e_local, h, d]`` — this shard's experts
+    (``E = e_local · n_shards``; expert ``e`` lives on shard ``e // e_local``).
+    ``capacity`` — max tokens any (shard → expert) pair may send per step.
+    Returns ``[t, d]`` with dropped-overflow tokens contributing zero.
+    """
+    n = jax.lax.psum(1, axis_name)
+    t, d = x.shape
+    e_local = w1.shape[0]
+    E = e_local * n
+
+    logits = x @ router  # [t, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [t] top-1
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]  # [t]
+
+    # Position of each token within its expert's send queue (stable order);
+    # tokens at position >= capacity overflow and are dropped.
+    one_hot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [t, E]
+    pos = jnp.cumsum(one_hot, axis=0) - 1  # position among same-expert tokens
+    slot = jnp.sum(pos * one_hot, axis=1)  # [t]
+    keep = slot < capacity
+
+    # Pack: buffers [E, capacity, d] (+ a validity mask), then reshape the
+    # leading axis to [n, e_local·capacity] rows for the all_to_all.
+    # Overflowing tokens write to the out-of-range slot ``capacity`` so
+    # mode="drop" discards them — routing them to slot 0 would race with the
+    # legitimate occupant of slot 0.
+    safe_slot = jnp.where(keep, slot, capacity)
+    buf = jnp.zeros((E, capacity, d), x.dtype)
+    buf = buf.at[expert, safe_slot].set(x, mode="drop")
+
+    # all_to_all: split the expert axis across shards; shard s receives, from
+    # every peer, the slots destined for ITS experts.
+    recv = jax.lax.all_to_all(
+        buf.reshape(n, e_local, capacity, d), axis_name, split_axis=0, concat_axis=0
+    )  # [n (source shard), e_local, capacity, d]
+    recv_tokens = recv.transpose(1, 0, 2, 3).reshape(e_local, n * capacity, d)
+
+    # Each local expert processes all its received slots as one matmul pair.
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", recv_tokens, w1))
+    out_tokens = jnp.einsum("ech,ehd->ecd", h, w2)  # [e_local, n·capacity, d]
+
+    # Reverse all_to_all: route outputs back to each token's home shard.
+    back = out_tokens.reshape(e_local, n, capacity, d).transpose(1, 0, 2, 3)
+    returned = jax.lax.all_to_all(back, axis_name, split_axis=0, concat_axis=0)
+    returned = returned.reshape(E, capacity, d)  # [E, capacity, d], home slots
+
+    # Unpack: each kept token reads its slot and scales by its gate; slot
+    # occupancy is shard-local, so ``keep`` alone decides who was served.
+    gathered = returned[expert, jnp.where(keep, slot, 0)]  # [t, d]
+    return jnp.where(keep[:, None], gathered * gate[:, None], 0.0)
+
+
+@functools.cache
+def _sharded_program(mesh, capacity: int):
+    def per_shard(x, router, w1, w2):
+        return moe_ffn(x, router, w1, w2, DATA_AXIS, capacity)
+
+    tok = P(DATA_AXIS)
+    exp = P(DATA_AXIS)
+    return jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(tok, P(), exp, exp),
+            out_specs=tok,
+        )
+    )
+
+
+def moe_ffn_sharded(x, router, w1, w2, capacity: int, ctx: MeshContext = None):
+    """Expert-parallel FFN over the mesh: ``x [T, d]`` sharded over tokens,
+    ``w1 [E, d, h]`` / ``w2 [E, h, d]`` sharded over experts (both on the data
+    axis; ``T`` and ``E`` must divide by its size), ``router [d, E]``
+    replicated. ``capacity`` bounds tokens per (shard, expert) pair per step.
+    """
+    ctx = ctx or get_mesh_context()
+    T, E = np.shape(x)[0], np.shape(w1)[0]
+    if T % ctx.n_data or E % ctx.n_data:
+        raise ValueError(
+            f"tokens ({T}) and experts ({E}) must divide by the mesh axis "
+            f"({ctx.n_data})"
+        )
+    return _sharded_program(ctx.mesh, capacity)(x, router, w1, w2)
