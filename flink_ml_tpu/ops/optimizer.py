@@ -510,12 +510,15 @@ class SGD(Optimizer):
                 coef, done, losses, n_exec = program(
                     coef, done, starts_c, offsets_c, active_c, *data_args
                 )
-                if check_loss:
-                    n = int(jax.device_get(n_exec))
-                    chunk_losses = np.asarray(jax.device_get(losses), np.float64)
-                    self.loss_history.extend(float(x) for x in chunk_losses[:n])
-                    if n < n_active:  # done flipped mid-chunk
-                        break
+                # Loss history is recorded unconditionally — the reference always
+                # streams loss through the feedback edge (SGD.java:137-143), tol
+                # or not. The losses buffer already comes back with the chunk, so
+                # a maxIter-only run pays one fetch at its single chunk boundary.
+                n = int(jax.device_get(n_exec))
+                chunk_losses = np.asarray(jax.device_get(losses), np.float64)
+                self.loss_history.extend(float(x) for x in chunk_losses[:n])
+                if check_loss and n < n_active:  # done flipped mid-chunk
+                    break
             final = np.asarray(jax.device_get(coef))
             return final[:dim] if model_sharded else final
 
@@ -546,9 +549,13 @@ class SGD(Optimizer):
             cur_coef, cur_offset = variables
             new_coef, new_offset, mean_loss = step(cur_coef, cur_offset, *data_args)
             if check_loss:
+                # The criteria needs the value now; fetch (and sync) per epoch.
                 self.loss_history.append(float(jax.device_get(mean_loss)))
                 cont = criteria(epoch, self.loss_history[-1])
             else:
+                # Record the device scalar without blocking — dispatch stays
+                # pipelined; the epilogue below fetches the whole history once.
+                self.loss_history.append(mean_loss)
                 cont = criteria(epoch, None)
             return IterationBodyResult(
                 [new_coef, new_offset], outputs=[new_coef], termination_criteria=cont
@@ -561,6 +568,10 @@ class SGD(Optimizer):
         outputs = iterate_bounded_until_termination(
             [coef, offset], body, config=config, listeners=self.listeners
         )
+        if not check_loss:  # resolve the deferred device scalars in one sync
+            self.loss_history = [
+                float(x) for x in jax.device_get(self.loss_history)
+            ]
         return np.asarray(jax.device_get(outputs[0]))
 
     def _optimize_streaming(self, init_model, cache, loss_func: LossFunc, ctx) -> np.ndarray:
@@ -659,6 +670,10 @@ class SGD(Optimizer):
             "epochs": sum(len(s) for _, s in sched.runs[:start_run]),
             "last_saved": None,
         }
+        # Without a tol criteria the loss values are not needed until the run
+        # ends; keep the (losses, n_exec) device buffers pending so window-run
+        # boundaries never stall the host, and resolve them in one sync below.
+        pending_losses: List[tuple] = []
 
         def dispatch(i, win, starts_c, active_c, n_active):
             # starts double as offsets: no clamped re-read in the streamed path —
@@ -683,6 +698,8 @@ class SGD(Optimizer):
                     chunk_losses = np.asarray(jax.device_get(losses), np.float64)
                     self.loss_history.extend(float(x) for x in chunk_losses[:n])
                     stop = n < n_active  # done flipped mid-chunk
+                else:
+                    pending_losses.append((losses, n_exec))
                 if mgr is not None and self.checkpoint_interval > 0:
                     last = state["last_saved"]
                     if last is None or state["epochs"] - last >= self.checkpoint_interval:
@@ -703,5 +720,12 @@ class SGD(Optimizer):
             return observe
 
         run_windows(stream, sched, dispatch, start_run=start_run)
+        for losses, n_exec in pending_losses:
+            # One sync over already-finished buffers: the reference always
+            # streams loss through the feedback edge (SGD.java:137-143), so
+            # maxIter-only runs get a full history too.
+            n = int(jax.device_get(n_exec))
+            chunk_losses = np.asarray(jax.device_get(losses), np.float64)
+            self.loss_history.extend(float(x) for x in chunk_losses[:n])
         final = np.asarray(jax.device_get(state["coef"]))
         return final[:dim] if model_sharded else final
