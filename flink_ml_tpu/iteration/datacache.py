@@ -94,16 +94,52 @@ class DeviceDataCache:
     padding — the analogue of the reference's per-subtask record counts.
     """
 
-    def __init__(self, columns: Dict[str, np.ndarray], ctx: Optional[MeshContext] = None):
+    def __init__(
+        self,
+        columns: Dict[str, np.ndarray],
+        ctx: Optional[MeshContext] = None,
+        column_specs: Optional[Dict[str, tuple]] = None,
+    ):
+        """``column_specs`` optionally maps a column name to a PartitionSpec
+        tuple (e.g. ``("data", "model")``) so wide columns land on the mesh in
+        their training layout at ingest — dense tensor parallelism shards the
+        feature matrix over both axes this way and never holds a row-only
+        duplicate in HBM. Trailing dims named by a mesh axis are zero-padded
+        to that axis size."""
         self.ctx = ctx or get_mesh_context()
+        column_specs = column_specs or {}
         lengths = {np.asarray(c).shape[0] for c in columns.values()}
         if len(lengths) != 1:
             raise ValueError(f"inconsistent column lengths {lengths}")
         (n,) = lengths
         self.n_valid = n
         self.arrays: Dict[str, jax.Array] = {}
+        # Host references are kept for the sparse columns only (zero-copy for
+        # ndarray inputs): the transposed sparse-gradient layout
+        # (linalg/sparse_grad.py) transposes them once per dataset without a
+        # device->host round trip. Dense columns are not retained — nothing
+        # reads them back, and pinning e.g. a 250k x 256 feature matrix would
+        # waste a quarter GB of host RAM.
+        self.host_columns: Dict[str, np.ndarray] = {}
+        from flink_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        axis_sizes = {DATA_AXIS: self.ctx.n_data, MODEL_AXIS: self.ctx.n_model}
         for name, col in columns.items():
-            arr, _ = self.ctx.shard_batch(np.asarray(col))
+            col = np.asarray(col)
+            if name in ("indices", "values"):
+                self.host_columns[name] = col
+            spec = column_specs.get(name)
+            if spec is None:
+                arr, _ = self.ctx.shard_batch(col)
+            else:
+                pads = [(0, self.ctx.pad_batch(col.shape[0]))]
+                for d, axis in enumerate(spec[1:], start=1):
+                    size = axis_sizes.get(axis, 1) if axis else 1
+                    pads.append((0, (-col.shape[d]) % size))
+                pads += [(0, 0)] * (col.ndim - len(pads))
+                if any(p for _, p in pads):
+                    col = np.pad(col, pads)
+                arr = jax.device_put(col, self.ctx.sharding(*spec))
             self.arrays[name] = arr
         mask = np.ones(n, np.float32)
         self.arrays["__mask__"], _ = self.ctx.shard_batch(mask)

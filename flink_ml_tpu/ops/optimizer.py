@@ -105,6 +105,7 @@ def _sgd_epoch_math(
     elastic_net,
     dtype,
     model_sharded: bool = False,
+    grad_layout=None,
 ):
     """One epoch of the per-shard SGD update (shared by the host-loop step and the
     fused whole-run program). ``start`` is the clamped slice start and ``offset``
@@ -112,6 +113,9 @@ def _sgd_epoch_math(
     supplied by the caller so the fused path can feed a *precomputed* schedule.
     ``feats`` is either a dense [m, d] array or a padded-CSR
     ``(indices [m, K], values [m, K])`` pair (linalg/sparse_batch.py).
+    ``grad_layout`` — optional ``(class_meta, flat_rows, flat_vals, inv_map)``
+    transposed layout (linalg/sparse_grad.py) replacing the sparse gradient's
+    serialized scatter-add with gathers + dense reductions.
     Returns (new_coef, mean_loss)."""
     # The minibatch is a *contiguous* window, so a dynamic_slice (cheap on TPU)
     # instead of a row gather (slow scatter/gather path). At the cache tail the
@@ -141,9 +145,10 @@ def _sgd_epoch_math(
             in_range = (local_idx >= 0) & (local_idx < local_d)
             safe_idx = jnp.where(in_range, local_idx, 0)
             vb_local = jnp.where(in_range, vb, 0.0)
-            dot = jax.lax.psum(
-                jnp.sum(vb_local * coef[safe_idx], axis=1), MODEL_AXIS
-            )
+            # flat 1-D gather: 2-D index tensors at this size send the XLA
+            # TPU backend into minutes of compilation (sparse_grad.py note)
+            gathered = coef[safe_idx.reshape(-1)].reshape(safe_idx.shape)
+            dot = jax.lax.psum(jnp.sum(vb_local * gathered, axis=1), MODEL_AXIS)
             loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
             grad_sum = (
                 jnp.zeros_like(coef)
@@ -151,12 +156,39 @@ def _sgd_epoch_math(
                 .add((vb_local * mult[:, None]).ravel())
             )
         else:
-            dot = jnp.sum(vb * coef[ib], axis=1)
+            # flat 1-D gather (see sparse_grad.py: 2-D index gathers of this
+            # size cost minutes of XLA TPU compile time; flat is ~1 s)
+            dot = jnp.sum(vb * coef[ib.reshape(-1)].reshape(ib.shape), axis=1)
             loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
-            grad_sum = jnp.zeros_like(coef).at[ib.ravel()].add((vb * mult[:, None]).ravel())
+            if grad_layout is not None:
+                # Scatter-free: the batch multiplier lands in a zeros-[m]
+                # vector with one contiguous write (rows outside the window
+                # carry mult 0 via wb), and the transposed layout turns the
+                # gradient into gathers + dense reductions.
+                from flink_ml_tpu.linalg.sparse_grad import grad_from_layout
+
+                class_meta, fr, fv, inv = grad_layout
+                mult_full = jax.lax.dynamic_update_slice(
+                    jnp.zeros(y.shape[0], mult.dtype), mult, (start,)
+                )
+                grad_sum = grad_from_layout(fr, fv, inv, class_meta, mult_full)
+            else:
+                grad_sum = (
+                    jnp.zeros_like(coef).at[ib.ravel()].add((vb * mult[:, None]).ravel())
+                )
     else:
         Xb = jax.lax.dynamic_slice_in_dim(feats, start, local_batch)
-        loss_sum, grad_sum = loss_func.loss_and_grad_sum(coef, Xb, yb, wb)
+        if model_sharded:
+            # Dense tensor parallelism: this shard holds a column slice of X
+            # and the matching coefficient slice. Partial margins assemble
+            # with one psum over the model axis; the gradient slice
+            # Xbᵀ·mult is local by construction (mult is replicated across
+            # the model axis once dot is).
+            dot = jax.lax.psum(Xb @ coef, MODEL_AXIS)
+            loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
+            grad_sum = Xb.T @ mult
+        else:
+            loss_sum, grad_sum = loss_func.loss_and_grad_sum(coef, Xb, yb, wb)
     if model_sharded:
         # The grad shard varies over the model axis while the scalar stats are
         # replicated across it — keep their psums separate so the replication
@@ -238,6 +270,7 @@ def _fused_sgd_program(
     dtype,
     sparse: bool = False,
     model_sharded: bool = False,
+    layout_meta=None,
 ):
     """A chunk of ``chunk_len`` SGD epochs as ONE jit'd SPMD program.
 
@@ -265,9 +298,16 @@ def _fused_sgd_program(
     gathers/scatters only its index range (dividing the serialized-scatter
     cost), margins assemble with a psum over the model axis, and the returned
     coefficient stays model-sharded.
+
+    With ``layout_meta`` (sparse, non-model-sharded) the data args carry three
+    trailing arrays — per-shard ``flat_rows``/``flat_vals`` and the replicated
+    ``inv_map`` of a transposed gradient layout (linalg/sparse_grad.py) — and
+    the gradient runs scatter-free.
+
+    Dense + ``model_sharded``: the features arrive 2D-sharded
+    ``P(data, model)`` (column slices per model shard) and the margin
+    assembles with a psum over the model axis.
     """
-    if model_sharded and not sparse:
-        raise ValueError("model-axis sharding is implemented for the sparse layout")
     key = (
         ctx.mesh,
         loss_func,  # the instance: custom losses may carry parameters (e.g. Huber delta)
@@ -280,6 +320,7 @@ def _fused_sgd_program(
         jnp.dtype(dtype).name,
         sparse,
         model_sharded,
+        layout_meta,
     )
     cached = _FUSED_CACHE.get(key)
     if cached is not None:
@@ -287,7 +328,11 @@ def _fused_sgd_program(
 
     def per_shard(coef, done, starts, offsets, active, *data):
         feats = (data[0], data[1]) if sparse else data[0]
-        y, w, mask = data[-3:]
+        y, w, mask = data[2:5] if sparse else data[1:4]
+        grad_layout = None
+        if layout_meta is not None:
+            # flat arrays arrive [1, N] (leading data-axis shard dim)
+            grad_layout = (layout_meta, data[5][0], data[6][0], data[7])
 
         def body(carry, schedule):
             c, done = carry
@@ -295,6 +340,7 @@ def _fused_sgd_program(
             new_c, mean_loss = _sgd_epoch_math(
                 c, start, offset, feats, y, w, mask, loss_func, local_batch, lr,
                 reg, elastic_net, dtype, model_sharded=model_sharded,
+                grad_layout=grad_layout,
             )
             executed = ~done & act
             new_c = jnp.where(executed, new_c, c)
@@ -310,12 +356,18 @@ def _fused_sgd_program(
         return coef, done, losses, jnp.sum(executed.astype(jnp.int32))
 
     n_data_args = 5 if sparse else 4
+    data_specs = (P(DATA_AXIS),) * n_data_args
+    if model_sharded and not sparse:
+        # dense TP: features are column-sliced over the model axis too
+        data_specs = (P(DATA_AXIS, MODEL_AXIS),) + data_specs[1:]
+    if layout_meta is not None:
+        data_specs += (P(DATA_AXIS), P(DATA_AXIS), P())  # flat_rows, flat_vals, inv_map
     coef_spec = P(MODEL_AXIS) if model_sharded else P()
     program = jax.jit(
         jax.shard_map(
             per_shard,
             mesh=ctx.mesh,
-            in_specs=(coef_spec, P(), P(), P(), P()) + (P(DATA_AXIS),) * n_data_args,
+            in_specs=(coef_spec, P(), P(), P(), P()) + data_specs,
             out_specs=(coef_spec, P(), P(), P()),
         ),
         donate_argnums=(0, 1),
@@ -388,6 +440,57 @@ class SGD(Optimizer):
         ).hexdigest()[:16]
 
     @staticmethod
+    def _sparse_layout(train_data: DeviceDataCache, ctx: MeshContext, dim: int):
+        """Build (once per cache) the transposed scatter-free gradient layout.
+
+        Returns ``(class_meta, (flat_rows, flat_vals, inv_map))`` with the
+        arrays already placed on the mesh, or ``(None, ())`` when the cache
+        carries no host copies to transpose. Memoized on the cache object —
+        repeated fits (hyperparameter sweeps, benchmarks) pay the host-side
+        transpose and the device transfer once.
+        """
+        host = getattr(train_data, "host_columns", None)
+        if host is None or "indices" not in host:
+            return None, ()
+        memo = getattr(train_data, "_grad_layout", None)
+        if memo is not None and memo[0] == (ctx.n_data, dim):
+            return memo[1], memo[2]
+        from flink_ml_tpu.linalg.sparse_grad import SparseGradLayout
+
+        lay = SparseGradLayout.build(host["indices"], host["values"], dim, ctx.n_data)
+        dev = (
+            jax.device_put(lay.flat_rows, ctx.sharding(DATA_AXIS)),
+            jax.device_put(lay.flat_vals, ctx.sharding(DATA_AXIS)),
+            ctx.replicate(lay.inv_map),
+        )
+        train_data._grad_layout = ((ctx.n_data, dim), lay.class_meta, dev)
+        return lay.class_meta, dev
+
+    @staticmethod
+    def _tp_features(train_data: DeviceDataCache, ctx: MeshContext):
+        """The dense feature matrix column-padded to the model-axis size and
+        sharded ``P(data, model)`` for dense tensor parallelism. Padded
+        columns are zero, so they produce zero margins and zero gradients
+        (and the matching padded coefficient entries stay zero under
+        regularization: sign(0) = 0).
+
+        If the cache already holds the column in that layout (``optimize``'s
+        dict path ingests it TP-sharded directly when the mesh has a model
+        axis) it is used as-is — no second copy ever exists in HBM. Only a
+        cache built elsewhere with row-only sharding pays a transient
+        per-fit reshard; that duplicate is deliberately NOT memoized so it
+        dies with the fit instead of doubling resident memory for the
+        largest array in the job."""
+        X = train_data["features"]
+        tp_sharding = ctx.sharding(DATA_AXIS, MODEL_AXIS)
+        if X.shape[1] % ctx.n_model == 0 and X.sharding == tp_sharding:
+            return X
+        pad = (-X.shape[1]) % ctx.n_model
+        if pad:
+            X = jnp.pad(X, ((0, 0), (0, pad)))
+        return jax.device_put(X, tp_sharding)
+
+    @staticmethod
     def _place_coef(ctx, host_coef, dtype, model_sharded: bool):
         """Place an unpadded host coefficient on the mesh — replicated, or
         padded to the model-axis size and sharded over it. The single source
@@ -402,7 +505,13 @@ class SGD(Optimizer):
 
     # -- the one SPMD program -------------------------------------------------
     def _build_step(
-        self, ctx: MeshContext, loss_func: LossFunc, local_batch: int, sparse: bool = False
+        self,
+        ctx: MeshContext,
+        loss_func: LossFunc,
+        local_batch: int,
+        sparse: bool = False,
+        layout_meta=None,
+        model_sharded: bool = False,
     ):
         lr = self.learning_rate
         reg, elastic_net = self.reg, self.elastic_net
@@ -410,22 +519,33 @@ class SGD(Optimizer):
 
         def per_shard(coef, offset, *data):
             feats = (data[0], data[1]) if sparse else data[0]
-            y, w, mask = data[-3:]
+            y, w, mask = data[2:5] if sparse else data[1:4]
+            grad_layout = None
+            if layout_meta is not None:
+                grad_layout = (layout_meta, data[5][0], data[6][0], data[7])
             m = y.shape[0]
             start = jnp.minimum(offset, m - local_batch)
             new_coef, mean_loss = _sgd_epoch_math(
-                coef, start, offset, feats, y, w, mask, loss_func, local_batch, lr, reg, elastic_net, dtype
+                coef, start, offset, feats, y, w, mask, loss_func, local_batch,
+                lr, reg, elastic_net, dtype, model_sharded=model_sharded,
+                grad_layout=grad_layout,
             )
             next_offset = jnp.where(offset + local_batch >= m, 0, offset + local_batch)
             return new_coef, next_offset, mean_loss
 
         n_data_args = 5 if sparse else 4
+        data_specs = (P(DATA_AXIS),) * n_data_args
+        if model_sharded and not sparse:
+            data_specs = (P(DATA_AXIS, MODEL_AXIS),) + data_specs[1:]
+        if layout_meta is not None:
+            data_specs += (P(DATA_AXIS), P(DATA_AXIS), P())
+        coef_spec = P(MODEL_AXIS) if model_sharded else P()
         return jax.jit(
             jax.shard_map(
                 per_shard,
                 mesh=ctx.mesh,
-                in_specs=(P(), P()) + (P(DATA_AXIS),) * n_data_args,
-                out_specs=(P(), P(), P()),
+                in_specs=(coef_spec, P()) + data_specs,
+                out_specs=(coef_spec, P(), P()),
             ),
             donate_argnums=(0,),
         )
@@ -452,24 +572,43 @@ class SGD(Optimizer):
             cols = dict(train_data)
             if "weights" not in cols:
                 cols["weights"] = np.ones(np.asarray(cols["labels"]).shape[0])
+            # On a TP mesh, dense features ingest directly in their training
+            # layout P(data, model) — no row-only duplicate ever lands in HBM.
+            specs = (
+                {"features": (DATA_AXIS, MODEL_AXIS)}
+                if "features" in cols and ctx.n_model > 1
+                else None
+            )
             train_data = DeviceDataCache(
                 {
                     k: np.asarray(v, np.int32 if k == "indices" else self.dtype)
                     for k, v in cols.items()
                 },
                 ctx=ctx,
+                column_specs=specs,
             )
         sparse = "indices" in train_data.arrays
-        # Wide sparse models shard the coefficient over the model axis when
-        # the mesh has one (tensor parallelism; scatter cost divides by n_model).
-        model_sharded = sparse and ctx.n_model > 1
+        # Wide models shard the coefficient over the model axis when the mesh
+        # has one (tensor parallelism): sparse shards the index range, dense
+        # column-slices the feature matrix.
+        model_sharded = ctx.n_model > 1
+        dim = int(np.asarray(init_model).shape[0])
         y = train_data["labels"]
         w = train_data["weights"]
         mask = train_data.mask.astype(self.dtype)
+        layout_meta = None
         if sparse:
             data_args = (train_data["indices"], train_data["values"], y, w, mask)
+            if not model_sharded:
+                # The transposed layout replaces the gradient's serialized
+                # scatter with gathers + dense reductions (sparse_grad.py).
+                layout_meta, layout_args = self._sparse_layout(train_data, ctx, dim)
+                data_args += layout_args
         else:
-            data_args = (train_data["features"], y, w, mask)
+            feats_dev = train_data["features"]
+            if model_sharded:
+                feats_dev = self._tp_features(train_data, ctx)
+            data_args = (feats_dev, y, w, mask)
 
         local_batch = -(-self.global_batch_size // ctx.n_data)  # ceil
         local_batch = min(local_batch, train_data.local_rows)
@@ -498,9 +637,9 @@ class SGD(Optimizer):
                 self.dtype,
                 sparse=sparse,
                 model_sharded=model_sharded,
+                layout_meta=layout_meta,
             )
             starts, offsets = offset_schedule(train_data.local_rows, local_batch, self.max_iter)
-            dim = int(np.asarray(init_model).shape[0])
             coef = self._place_coef(ctx, init_model, self.dtype, model_sharded)
             done = ctx.replicate(np.asarray(False))
             self.loss_history = []
@@ -522,13 +661,10 @@ class SGD(Optimizer):
             final = np.asarray(jax.device_get(coef))
             return final[:dim] if model_sharded else final
 
-        if model_sharded:
-            raise ValueError(
-                "model-axis-sharded sparse training runs through the fused "
-                "path; checkpoint managers / listeners are not supported with "
-                "n_model > 1 yet"
-            )
-        step = self._build_step(ctx, loss_func, local_batch, sparse=sparse)
+        step = self._build_step(
+            ctx, loss_func, local_batch, sparse=sparse, layout_meta=layout_meta,
+            model_sharded=model_sharded,
+        )
 
         if self.checkpoint_manager is not None:
             self.checkpoint_manager.set_fingerprint(
@@ -540,7 +676,7 @@ class SGD(Optimizer):
                 )
             )
 
-        coef = ctx.replicate(np.asarray(init_model, self.dtype))
+        coef = self._place_coef(ctx, init_model, self.dtype, model_sharded)
         offset = ctx.replicate(np.asarray(0, np.int32))
         criteria = TerminateOnMaxIterOrTol(self.max_iter, self.tol)
         self.loss_history = []
@@ -572,7 +708,10 @@ class SGD(Optimizer):
             self.loss_history = [
                 float(x) for x in jax.device_get(self.loss_history)
             ]
-        return np.asarray(jax.device_get(outputs[0]))
+        final = np.asarray(jax.device_get(outputs[0]))
+        # A model-sharded coefficient fetches as the padded [d_pad] vector;
+        # checkpoints store the same padded form, so restore round-trips.
+        return final[:dim] if model_sharded else final
 
     def _optimize_streaming(self, init_model, cache, loss_func: LossFunc, ctx) -> np.ndarray:
         """Train out of a host-tier cache larger than HBM.
@@ -623,8 +762,11 @@ class SGD(Optimizer):
             dtypes={"indices": np.int32} if sparse else None,
         )
         check_loss = np.isfinite(self.tol) and self.tol > 0
-        # Same model-axis sharding as the resident path: a wide streamed
-        # coefficient divides its scatter cost across n_model shards too.
+        # Model-axis sharding on the streamed path covers the sparse layout
+        # only (a wide streamed coefficient divides its scatter cost across
+        # n_model shards); streamed *dense* features keep a replicated
+        # coefficient — windows are ingested row-sharded, and resharding
+        # every window over the model axis would serialize the stream.
         model_sharded = sparse and ctx.n_model > 1
         dim = int(np.asarray(init_model).shape[0])
         program = _fused_sgd_program(

@@ -233,3 +233,31 @@ def test_sgd_fused_tol_stops_early_in_chunks():
     assert 0 < len(sgd.loss_history) < 5000
     assert sgd.loss_history[-1] < 0.5
     assert all(loss >= 0.5 for loss in sgd.loss_history[:-1])
+
+
+def test_dense_tp_matches_replicated():
+    # Dense tensor parallelism (features column-sliced P(data, model), margin
+    # psum over the model axis) must reproduce the replicated-coefficient
+    # result on the same data axis.
+    import jax
+
+    from flink_ml_tpu.parallel.mesh import MeshContext, mesh_context
+
+    rng = np.random.default_rng(9)
+    d = 5  # not divisible by n_model=2: exercises column padding
+    X = rng.normal(size=(96, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    data = {"features": X, "labels": y}
+    kwargs = dict(max_iter=15, global_batch_size=32, tol=0.0, learning_rate=0.3,
+                  reg=0.01, elastic_net=0.5)
+    devices = jax.devices()[:8]
+    with mesh_context(MeshContext(devices=devices[:4], n_data=4)) as ctx:
+        want = SGD(ctx=ctx, **kwargs).optimize(
+            np.zeros(d), data, BinaryLogisticLoss.INSTANCE
+        )
+    with mesh_context(MeshContext(devices=devices, n_data=4, n_model=2)) as ctx:
+        got = SGD(ctx=ctx, **kwargs).optimize(
+            np.zeros(d), data, BinaryLogisticLoss.INSTANCE
+        )
+    assert got.shape == (d,)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -277,20 +277,27 @@ class TestModelAxisSharding:
         assert len(sgd.loss_history) < 300, "tol should stop early on the TP path"
         assert np.all(np.isfinite(coef))
 
-    def test_tp_rejects_host_loop_features(self):
+    def test_tp_host_loop_matches_fused(self):
+        # Listeners force the host loop; under n_model > 1 it must produce the
+        # fused TP path's exact trajectory (same epoch math, same psums) —
+        # the reference checkpoints/observes every training path (SGD.java:308).
         import jax
 
         from flink_ml_tpu.iteration import IterationListener
         from flink_ml_tpu.parallel.mesh import MeshContext, mesh_context
 
         idx, vals, y = self._data(d=64)
+        cols = {"indices": idx, "values": vals, "labels": y}
+        kwargs = dict(max_iter=12, global_batch_size=32, tol=0.0, learning_rate=0.4)
         with mesh_context(MeshContext(devices=jax.devices()[:8], n_data=4, n_model=2)) as ctx:
-            with pytest.raises(ValueError, match="n_model"):
-                SGD(ctx=ctx, listeners=[IterationListener()], max_iter=2, tol=0.0).optimize(
-                    np.zeros(64, np.float32),
-                    {"indices": idx, "values": vals, "labels": y},
-                    BinaryLogisticLoss.INSTANCE,
-                )
+            fused = SGD(ctx=ctx, **kwargs).optimize(
+                np.zeros(64, np.float32), cols, BinaryLogisticLoss.INSTANCE
+            )
+            host = SGD(ctx=ctx, listeners=[IterationListener()], **kwargs).optimize(
+                np.zeros(64, np.float32), cols, BinaryLogisticLoss.INSTANCE
+            )
+        assert host.shape == (64,)
+        np.testing.assert_allclose(host, fused, rtol=1e-5, atol=1e-7)
 
     def test_tp_streamed_matches_dp_streamed(self, tmp_path):
         import jax
