@@ -127,3 +127,88 @@ class BinaryClassificationEvaluator(
         }
         names = list(self.get_metrics_names())
         return DataFrame(names, None, [np.asarray([values[n]]) for n in names])
+
+    def evaluate_stream(
+        self, cache, bucket_rows: int = 1 << 20, spill_dir=None
+    ) -> DataFrame:
+        """The same metrics over a host-tier cache larger than RAM.
+
+        Mirrors the reference's streamed shape (sort spilled via managed
+        memory ``DataStreamUtils.java:409``; partition summaries :178 merged
+        :226): one streaming pass computes the global (pos, neg, total)
+        summary, ``distributed_sort_cache`` range-partitions and sorts by
+        score out of core, and the curve trapezoids accumulate bucket by
+        bucket with O(bucket) memory — the carried state is just the last
+        boundary point. Result is identical to ``transform`` on the same rows
+        (the curve's tie-group boundary points are bucketing-invariant).
+
+        ``cache`` columns: the label / rawPrediction / (optional) weight
+        columns named by this stage's params; rawPrediction may be [n] scores
+        or [n, c] probabilities (last column used, like ``transform``).
+        """
+        from flink_ml_tpu.parallel.datastream_utils import distributed_sort_cache
+
+        label_col = self.get_label_col()
+        score_col = self.get_raw_prediction_col()
+        weight_col = self.get_weight_col()
+
+        def row_weights(chunk, m):
+            if weight_col:
+                return np.asarray(chunk[weight_col], np.float64).ravel()
+            return np.ones(m, np.float64)
+
+        # Pass A (unsorted — totals are order-free): global summary.
+        pos = neg = 0.0
+        for chunk in cache.iter_rows():
+            y = np.asarray(chunk[label_col], np.float64).ravel()
+            w = row_weights(chunk, len(y))
+            pos += float(np.sum(w * (y == 1.0)))
+            neg += float(np.sum(w * (y != 1.0)))
+        if pos == 0 or neg == 0:
+            raise ValueError("Both positive and negative samples are required.")
+        tot = pos + neg
+
+        value_cols = [label_col] + ([weight_col] if weight_col else [])
+        sorted_buckets = distributed_sort_cache(
+            cache,
+            score_col,
+            value_cols,
+            descending=True,
+            bucket_rows=bucket_rows,
+            spill_dir=spill_dir,
+            key_fn=lambda a: a[:, -1] if a.ndim == 2 else a,
+        )
+
+        # Carried state: raw cumulative sums and the last emitted curve point
+        # (origin conventions match transform: tpr/fpr/pop 0, precision 1).
+        tp_run = fp_run = ct_run = 0.0
+        tpr_l, fpr_l, prec_l, pop_l = 0.0, 0.0, 1.0, 0.0
+        auc_roc = auc_pr = lorenz = ks = 0.0
+        for b in sorted_buckets:
+            s_b = b["__key__"]
+            y_b = np.asarray(b[label_col], np.float64).ravel()
+            w_b = row_weights(b, len(y_b))
+            boundary = np.nonzero(np.diff(s_b))[0]
+            cut = np.concatenate([boundary, [len(s_b) - 1]])
+            tp = tp_run + np.cumsum(w_b * (y_b == 1.0))[cut]
+            fp = fp_run + np.cumsum(w_b * (y_b != 1.0))[cut]
+            ct = ct_run + np.cumsum(w_b)[cut]
+            tpr = np.concatenate([[tpr_l], tp / pos])
+            fpr = np.concatenate([[fpr_l], fp / neg])
+            prec = np.concatenate([[prec_l], tp / (tp + fp)])
+            pop = np.concatenate([[pop_l], ct / tot])
+            auc_roc += float(np.trapezoid(tpr, fpr))
+            auc_pr += float(np.trapezoid(prec, tpr))
+            lorenz += float(np.trapezoid(tpr, pop))
+            ks = max(ks, float(np.max(np.abs(tpr - fpr))))
+            tp_run, fp_run, ct_run = float(tp[-1]), float(fp[-1]), float(ct[-1])
+            tpr_l, fpr_l, prec_l, pop_l = tpr[-1], fpr[-1], prec[-1], pop[-1]
+
+        values = {
+            AREA_UNDER_ROC: auc_roc,
+            AREA_UNDER_PR: auc_pr,
+            KS: ks,
+            AREA_UNDER_LORENZ: lorenz,
+        }
+        names = list(self.get_metrics_names())
+        return DataFrame(names, None, [np.asarray([values[n]]) for n in names])

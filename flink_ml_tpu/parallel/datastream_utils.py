@@ -16,6 +16,7 @@ parallelism-1 merge operators.
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -31,6 +32,7 @@ __all__ = [
     "sample",
     "co_group",
     "distributed_sort",
+    "distributed_sort_cache",
     "distributed_quantiles",
 ]
 
@@ -211,6 +213,106 @@ def distributed_sort(
             rows = rows[::-1]
         out.append({"__key__": keys[rows], **{k: v[rows] for k, v in values.items()}})
     return out[::-1] if descending else out
+
+
+def distributed_sort_cache(
+    cache,
+    key_col: str,
+    value_cols: Sequence[str] = (),
+    descending: bool = False,
+    bucket_rows: int = 1 << 20,
+    spill_dir: Optional[str] = None,
+    key_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+) -> Iterator[Columns]:
+    """Out-of-core global sort over a host-tier cache — the external analogue
+    of ``distributed_sort`` for datasets larger than host RAM.
+
+    The reference sorts via managed memory with disk spill
+    (``DataStreamUtils.java:409`` + the ``sort/`` package); here the same job
+    is three streaming passes over a ``HostDataCache``:
+
+    1. a mergeable GK sketch of the keys picks ``ceil(n / bucket_rows) - 1``
+       range splitters (rank error only moves bucket *boundaries*, never
+       ordering — same contract as the in-RAM splitter sample);
+    2. every chunk routes its rows by ``searchsorted(side='right')`` into
+       per-bucket spill caches (``memory_budget_bytes=0`` — the capacity tier
+       holds them on disk; ties of one key always share a bucket);
+    3. buckets load one at a time (the only thing ever resident is one
+       ``bucket_rows``-sized bucket), sort on device, and yield in global
+       order.
+
+    Yields ``Columns`` dicts with ``"__key__"`` plus ``value_cols``, ordered
+    like ``distributed_sort``'s bucket list. ``key_fn`` optionally derives
+    the scalar sort key from the raw key column (e.g. the last column of a
+    [n, c] rawPrediction). A heavily tied key can oversize its bucket (ties
+    are indivisible under range partitioning — reference behavior too).
+    NaN keys are not supported.
+    """
+    import shutil
+    import tempfile
+
+    from flink_ml_tpu.config import resolve_cache_config
+    from flink_ml_tpu.iteration.datacache import HostDataCache
+
+    n = int(cache.num_rows)
+    if n == 0:
+        return
+    extract = key_fn or (lambda a: a)
+
+    def chunk_keys(chunk: Columns) -> np.ndarray:
+        return np.asarray(extract(np.asarray(chunk[key_col])), np.float64).ravel()
+
+    n_buckets = max(1, -(-n // bucket_rows))
+    if n_buckets > 1:
+        sketch = QuantileSummary(0.001)
+        for chunk in cache.iter_rows():
+            sketch.insert_all(chunk_keys(chunk))
+            sketch.compress()
+        probs = np.linspace(0.0, 1.0, n_buckets + 1)[1:-1]
+        splitters = np.unique(np.atleast_1d(sketch.query(probs)))
+    else:
+        splitters = np.empty(0, np.float64)
+    n_buckets = len(splitters) + 1  # duplicate splitters merge buckets
+
+    _, base_spill = resolve_cache_config(None, spill_dir)
+    if base_spill is not None:
+        os.makedirs(base_spill, exist_ok=True)
+    own_dir = tempfile.mkdtemp(prefix="flinkml_sort_", dir=base_spill)
+    try:
+        buckets = [
+            HostDataCache(memory_budget_bytes=0, spill_dir=f"{own_dir}/b{b}")
+            for b in range(n_buckets)
+        ]
+        for chunk in cache.iter_rows():
+            keys = chunk_keys(chunk)
+            route = np.searchsorted(splitters, keys, side="right")
+            order = np.argsort(route, kind="stable")
+            bounds = np.searchsorted(route[order], np.arange(n_buckets + 1))
+            for b in range(n_buckets):
+                rows = order[bounds[b] : bounds[b + 1]]
+                if rows.size:
+                    buckets[b].append(
+                        {
+                            "__key__": keys[rows],
+                            **{k: np.asarray(chunk[k])[rows] for k in value_cols},
+                        }
+                    )
+
+        for b in reversed(range(n_buckets)) if descending else range(n_buckets):
+            nb = int(buckets[b].num_rows)
+            if nb == 0:
+                continue
+            cols = buckets[b].rows(0, nb)
+            keys = np.asarray(cols["__key__"], np.float64)
+            perm = np.asarray(jnp.argsort(jnp.asarray(keys)))
+            if descending:
+                perm = perm[::-1]
+            yield {
+                "__key__": keys[perm],
+                **{k: np.asarray(cols[k])[perm] for k in value_cols},
+            }
+    finally:
+        shutil.rmtree(own_dir, ignore_errors=True)
 
 
 def distributed_quantiles(
