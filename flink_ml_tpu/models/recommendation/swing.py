@@ -235,40 +235,47 @@ class Swing(AlgoOperator, HasOutputCol, HasSeed):
 
         # Padded per-user item lists (ELL, O(interactions) memory) — the
         # device scatters these into per-item incidence; sentinel item id = I.
+        # Built with the sorted-rank trick (no per-user loop): sort by user,
+        # rank each interaction within its user group, fancy-index once.
+        from flink_ml_tpu.utils.arrays import group_ranks, next_pow2
+
         u_order = np.argsort(ku, kind="stable")
-        u_bounds = np.searchsorted(ku[u_order], np.arange(U + 1))
-        D_max = max(1, int(np.max(u_bounds[1:] - u_bounds[:-1])))
+        sku = ku[u_order]
+        rank_u = group_ranks(sku)
+        D_max = max(1, int(rank_u.max()) + 1) if sku.size else 1
         L = np.full((U + 1, D_max), I, np.int32)
-        for u in range(U):
-            its = ki[u_order[u_bounds[u] : u_bounds[u + 1]]]
-            L[u, : len(its)] = its
+        L[sku, rank_u] = ki[u_order]
 
         # item → capped purchaser lists (sentinel user U pads: zero weight,
-        # empty item list ⇒ contributes nothing)
+        # empty item list ⇒ contributes nothing). The reference reservoir-
+        # samples each item's purchasers down to the cap (Swing.java:176-184);
+        # ordering interactions by (item, random key) and keeping each item's
+        # first ``cap`` is the same uniform without-replacement sample, done
+        # for every item in one sort.
         rng = np.random.default_rng(self.get_seed())
         cap = self.get_max_user_num_per_item()
-        order = np.argsort(ki, kind="stable")
-        bounds = np.searchsorted(ki[order], np.arange(I + 1))
-        purchasers: List[np.ndarray] = []
-        for i in range(I):
-            us = ku[order[bounds[i] : bounds[i + 1]]]
-            if len(us) > cap:
-                us = rng.choice(us, cap, replace=False)
-            purchasers.append(us)
+        i_order = np.lexsort((rng.random(ki.size), ki))
+        ski = ki[i_order]
+        rank_i = group_ranks(ski)
+        capped = rank_i < cap
+        ski, cap_users, rank_i = ski[capped], ku[i_order][capped], rank_i[capped]
+        counts = np.bincount(ski, minlength=I)
 
         # --- device: score items bucketed by purchaser count ------------------
         # Power-of-two width buckets: a heavy-tailed catalog must not pay the
         # most popular item's [P, P] pair cost for every item.
         ctx = get_mesh_context()
         k = min(self.get_k(), I)
-        widths = [max(8, 1 << int(np.ceil(np.log2(max(1, len(p)))))) for p in purchasers]
+        widths = np.maximum(8, next_pow2(counts))
         vals = np.zeros((I, k), np.float64)
         inds = np.zeros((I, k), np.int64)
-        for width in sorted(set(widths)):
-            members = [i for i in range(I) if widths[i] == width]
-            idx_b = np.full((len(members), width), U, np.int32)
-            for r, i in enumerate(members):
-                idx_b[r, : len(purchasers[i])] = purchasers[i]
+        member_row = np.empty(I, np.int64)
+        for width in np.unique(widths):
+            members = np.flatnonzero(widths == width)
+            member_row[members] = np.arange(members.size)
+            sel = widths[ski] == width
+            idx_b = np.full((members.size, width), U, np.int32)
+            idx_b[member_row[ski[sel]], rank_i[sel]] = cap_users[sel]
             idx_dev, _ = ctx.shard_batch(idx_b, pad_value=U)
             ids_dev, _ = ctx.shard_batch(np.asarray(members, np.int32))
             b_vals, b_inds = _swing_program(ctx, float(alpha2), k, I)(

@@ -286,25 +286,29 @@ class TestSwing:
             for j, score in want[item].items():
                 np.testing.assert_allclose(got[item][j], score, rtol=1e-5)
 
-    def test_scale_100k_interactions(self):
+    def test_scale_1m_interactions(self):
+        # 1M interactions through the fully-vectorized host prep (sorted-rank
+        # ELL build + one-sort cap sampling — no per-user/per-item Python
+        # loops) with an active purchaser cap, under a wall-clock budget.
         import time
 
         rng = np.random.default_rng(3)
-        n = 100_000
-        users = rng.integers(0, 2000, n).astype(np.int64)
-        items = rng.integers(0, 800, n).astype(np.int64)
+        n = 1_000_000
+        users = rng.integers(0, 20_000, n).astype(np.int64)
+        items = rng.integers(0, 2_000, n).astype(np.int64)
         df = DataFrame.from_dict({"user": users, "item": items})
         t0 = time.perf_counter()
         out = (
             Swing()
             .set_min_user_behavior(1)
-            .set_max_user_behavior(2000)
+            .set_max_user_behavior(20_000)
+            .set_max_user_num_per_item(64)  # the cap path, at scale
             .set_k(10)
             .transform(df)
         )
         elapsed = time.perf_counter() - t0
-        assert len(out) == 800, "every item should have scored neighbors at this density"
-        assert elapsed < 60, f"100k-interaction Swing took {elapsed:.1f}s"
+        assert len(out) == 2_000, "every item should have scored neighbors at this density"
+        assert elapsed < 60, f"1M-interaction Swing took {elapsed:.1f}s"
         top = out["output"][0].split(";")
         assert len(top) == 10 and all("," in t for t in top)
 
@@ -390,3 +394,63 @@ class TestKnnBlockwise:
         # same neighbor sets (order may differ on exact distance ties)
         for a, b in zip(full, blocked):
             assert set(a.tolist()) == set(b.tolist())
+
+
+class TestEvaluatorStream:
+    def test_streamed_auc_identical_to_in_ram(self, tmp_path):
+        # The north-star contract: metrics from the out-of-core path (tiny
+        # memory budget, many sort buckets, spilled inputs) match transform's
+        # in-RAM result on the same rows.
+        from flink_ml_tpu.iteration import HostDataCache
+        from flink_ml_tpu.models.evaluation.binary_classification_evaluator import (
+            BinaryClassificationEvaluator,
+        )
+
+        rng = np.random.default_rng(11)
+        n = 30_000
+        y = (rng.random(n) > 0.5).astype(np.float64)
+        # correlated scores with deliberate ties (quantized)
+        scores = np.round((y * 0.6 + rng.random(n)) * 50) / 50
+        w = rng.random(n) + 0.5
+
+        ev = BinaryClassificationEvaluator().set_weight_col("weight").set_metrics_names(
+            "areaUnderROC", "areaUnderPR", "ks", "areaUnderLorenz"
+        )
+        want = ev.transform(
+            DataFrame.from_dict(
+                {"label": y, "rawPrediction": scores, "weight": w}
+            )
+        )
+
+        # input cache: 120 KB budget for ~720 KB of columns -> mostly spilled
+        cache = HostDataCache(
+            memory_budget_bytes=120_000, spill_dir=str(tmp_path / "in")
+        )
+        for a in range(0, n, 1111):
+            cache.append(
+                {
+                    "label": y[a : a + 1111],
+                    "rawPrediction": scores[a : a + 1111],
+                    "weight": w[a : a + 1111],
+                }
+            )
+        cache.finish()
+        got = ev.evaluate_stream(
+            cache, bucket_rows=2048, spill_dir=str(tmp_path / "sort")
+        )
+        for name in ("areaUnderROC", "areaUnderPR", "ks", "areaUnderLorenz"):
+            np.testing.assert_allclose(
+                got[name][0], want[name][0], rtol=1e-9, atol=1e-12
+            )
+
+    def test_streamed_single_class_raises(self, tmp_path):
+        from flink_ml_tpu.iteration import HostDataCache
+        from flink_ml_tpu.models.evaluation.binary_classification_evaluator import (
+            BinaryClassificationEvaluator,
+        )
+
+        cache = HostDataCache(memory_budget_bytes=1024, spill_dir=str(tmp_path))
+        cache.append({"label": np.ones(50), "rawPrediction": np.random.default_rng(0).random(50)})
+        cache.finish()
+        with pytest.raises(ValueError, match="positive and negative"):
+            BinaryClassificationEvaluator().evaluate_stream(cache)
