@@ -25,6 +25,8 @@ STAGE_REGISTRY = {
     "KnnModel": "flink_ml_tpu.models.classification.knn.KnnModel",
     "OnlineLogisticRegression": "flink_ml_tpu.models.classification.online_logistic_regression.OnlineLogisticRegression",
     "OnlineLogisticRegressionModel": "flink_ml_tpu.models.classification.online_logistic_regression.OnlineLogisticRegressionModel",
+    "SelfAttentionClassifier": "flink_ml_tpu.models.classification.attention_classifier.SelfAttentionClassifier",
+    "SelfAttentionClassifierModel": "flink_ml_tpu.models.classification.attention_classifier.SelfAttentionClassifierModel",
     # clustering
     "KMeans": "flink_ml_tpu.models.clustering.kmeans.KMeans",
     "KMeansModel": "flink_ml_tpu.models.clustering.kmeans.KMeansModel",

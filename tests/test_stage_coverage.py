@@ -95,6 +95,15 @@ ESTIMATOR_CASES = {
         lambda: DataFrame.from_dict({"c": np.asarray([0.0, 1.0, 2.0, 1.0])}),
     ),
     "RobustScaler": (lambda c: c(), _vec_df),
+    "SelfAttentionClassifier": (
+        lambda c: c().set_max_iter(2).set_embedding_dim(8).set_num_heads(2).set_seed(1),
+        lambda: DataFrame.from_dict(
+            {
+                "features": RNG.integers(0, 6, size=(8, 16)).astype(np.float64),
+                "label": RNG.integers(0, 2, 8).astype(np.float64),
+            }
+        ),
+    ),
     "StandardScaler": (lambda c: c().set_with_mean(True), _vec_df),
     "StringIndexer": (
         lambda c: c().set_input_cols("s").set_output_cols("idx"),
