@@ -28,7 +28,12 @@ from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.iteration import DeviceDataCache
 from flink_ml_tpu.models.common import extract_labeled_data
 from flink_ml_tpu.ops.optimizer import _TOL_CHUNK, _cache_put, chunked_schedule, offset_schedule
-from flink_ml_tpu.params.param import IntArrayParam, ParamValidators, update_existing_params
+from flink_ml_tpu.params.param import (
+    IntArrayParam,
+    ParamValidators,
+    StringParam,
+    update_existing_params,
+)
 from flink_ml_tpu.params.shared import (
     HasFeaturesCol,
     HasGlobalBatchSize,
@@ -63,12 +68,29 @@ class _MlpParams(
         [64],
         ParamValidators.non_empty_array(),
     )
+    COMPUTE_TYPE = StringParam(
+        "computeType",
+        "Matmul compute dtype: 'bfloat16' runs forward/backward matmuls on "
+        "the MXU's native bf16 path (params, optimizer state and loss stay "
+        "float32 — standard mixed precision); 'float32' is exact.",
+        "float32",
+        ParamValidators.in_array(["float32", "bfloat16"]),
+    )
 
     def get_hidden_layers(self):
         return self.get(self.HIDDEN_LAYERS)
 
     def set_hidden_layers(self, *values: int):
         return self.set(self.HIDDEN_LAYERS, list(values))
+
+    def get_compute_type(self) -> str:
+        return self.get(self.COMPUTE_TYPE)
+
+    def set_compute_type(self, value: str):
+        return self.set(self.COMPUTE_TYPE, value)
+
+    def _compute_dtype(self):
+        return jnp.bfloat16 if self.get_compute_type() == "bfloat16" else None
 
 
 def _init_params(rng: np.random.Generator, dims: List[int]) -> List[Tuple[np.ndarray, np.ndarray]]:
@@ -84,19 +106,25 @@ def _init_params(rng: np.random.Generator, dims: List[int]) -> List[Tuple[np.nda
     return params
 
 
-def _forward(params, X):
-    h = X
+def _forward(params, X, compute_dtype=None):
+    """Logits. With ``compute_dtype`` (mixed precision) inputs and weights are
+    cast per-matmul so the MXU runs its native low-precision path; the casts
+    are differentiable, so gradients come back in the params' float32."""
+    cast = (lambda a: a.astype(compute_dtype)) if compute_dtype is not None else (lambda a: a)
+    h = cast(X)
     for W, b in params[:-1]:
-        h = jax.nn.relu(h @ W + b)
+        h = jax.nn.relu(h @ cast(W) + cast(b))
     W, b = params[-1]
-    return h @ W + b  # logits
+    return h @ cast(W) + cast(b)  # logits
 
 
 @functools.cache
-def _predict_kernel():
+def _predict_kernel(compute_type: str = "float32"):
+    compute_dtype = jnp.bfloat16 if compute_type == "bfloat16" else None
+
     @jax.jit
     def kernel(params, X):
-        logits = _forward(params, X)
+        logits = _forward(params, X, compute_dtype).astype(jnp.float32)
         probs = jax.nn.softmax(logits, axis=-1)
         return jnp.argmax(logits, axis=-1).astype(jnp.float32), probs
 
@@ -114,7 +142,7 @@ class MLPClassifierModel(Model, _MlpParams):
     def transform(self, *inputs):
         (df,) = inputs
         X = df.vectors(self.get_features_col()).astype(np.float32)
-        pred_idx, probs = _predict_kernel()(
+        pred_idx, probs = _predict_kernel(self.get_compute_type())(
             [tuple(jnp.asarray(x) for x in layer) for layer in self.params], X
         )
         pred = self.labels[np.asarray(pred_idx, np.int64)]
@@ -177,14 +205,17 @@ class MLPClassifier(Estimator, _MlpParams):
         observes ``done`` between chunks, so early convergence wastes at most
         chunk_len - 1 epochs.
 
-        Programs are cached per (mesh, learning rate, batch, chunk, tol);
-        jit re-specializes per parameter/data shapes on its own, so layer dims
-        need not be part of the key."""
-        key = (ctx.mesh, self.get_learning_rate(), local_batch, chunk_len, tol)
+        Programs are cached per (mesh, learning rate, batch, chunk, tol,
+        compute type); jit re-specializes per parameter/data shapes on its
+        own, so layer dims need not be part of the key."""
+        key = (
+            ctx.mesh, self.get_learning_rate(), local_batch, chunk_len, tol,
+            self.get_compute_type(),
+        )
         cached = _MLP_FUSED_CACHE.get(key)
         if cached is not None:
             return cached
-        epoch = self._epoch_math(optimizer, local_batch)
+        epoch = self._epoch_math(optimizer, local_batch, self._compute_dtype())
 
         def per_shard(params, opt_state, done, starts, offsets, active, X, y, w):
             def body(carry, schedule):
@@ -221,7 +252,7 @@ class MLPClassifier(Estimator, _MlpParams):
         return program
 
     @staticmethod
-    def _epoch_math(optimizer, local_batch: int):
+    def _epoch_math(optimizer, local_batch: int, compute_dtype=None):
         def per_shard(params, opt_state, start, offset, X, y, w):
             # Contiguous minibatch window via dynamic_slice (cheap on TPU) with the
             # clamped tail zero-weighted — same scheme as _sgd_epoch_math; start
@@ -232,7 +263,8 @@ class MLPClassifier(Estimator, _MlpParams):
             wb = jax.lax.dynamic_slice_in_dim(w, start, local_batch) * tail_valid
 
             def loss_sum(p):
-                logits = _forward(p, Xb)
+                # Mixed precision: matmuls in compute_dtype, loss in float32.
+                logits = _forward(p, Xb, compute_dtype).astype(jnp.float32)
                 losses = optax.softmax_cross_entropy_with_integer_labels(
                     logits, yb.astype(jnp.int32)
                 )

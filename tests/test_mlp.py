@@ -77,3 +77,30 @@ def test_seed_reproducible():
     m1, m2 = _fit(df, max_iter=20), _fit(df, max_iter=20)
     for (w1, b1), (w2, b2) in zip(m1.params, m2.params):
         np.testing.assert_array_equal(w1, w2)
+
+
+def test_bfloat16_compute_type_trains():
+    # Mixed precision (bf16 matmuls, f32 params/loss) must still solve the
+    # nonlinear problem and keep the default path exact-f32.
+    df, y = _xor()
+    m = (
+        MLPClassifier()
+        .set_hidden_layers(32, 32)
+        .set_max_iter(300)
+        .set_learning_rate(0.01)
+        .set_global_batch_size(512)
+        .set_tol(0.0)
+        .set_seed(1)
+        .set_compute_type("bfloat16")
+    )
+    assert m.get_compute_type() == "bfloat16"
+    model = m.fit(df)
+    out = model.transform(df)
+    assert (out["prediction"] == y).mean() > 0.95
+    # params stay float32 (mixed precision, not a bf16 model)
+    assert all(np.asarray(W).dtype == np.float32 for W, _ in model.params)
+
+
+def test_compute_type_validation():
+    with pytest.raises(ValueError):
+        MLPClassifier().set_compute_type("float16")
