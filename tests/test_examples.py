@@ -38,7 +38,7 @@ def test_docs_internal_links_resolve():
     import re
 
     root = EXAMPLES_DIR.parent
-    for md in [root / "README.md", *sorted((root / "docs").glob("*.md"))]:
+    for md in [root / "README.md", *sorted((root / "docs").rglob("*.md"))]:
         text = md.read_text()
         for target in re.findall(r"\]\((?!https?://|#)([^)]+)\)", text):
             resolved = (md.parent / target.split("#")[0]).resolve()
