@@ -53,16 +53,17 @@ class WindowSchedule:
     def __init__(self, local_rows: int, local_batch: int, window_rows: int, max_iter: int):
         # The cycling rule is offset_schedule's — the single source of truth the
         # resident fused path also consumes, so the two paths cannot drift.
-        from flink_ml_tpu.ops.optimizer import offset_schedule
+        from flink_ml_tpu.ops.optimizer import fused_chunk_len, offset_schedule
 
         b = local_batch
         W = max(b, min(int(window_rows), local_rows))
         W = -(-W // b) * b  # round up to a whole number of batches
         self.window = W
         self.n_windows = -(-local_rows // W)
-        # Capped by max_iter: a short training over a large window must not pad
-        # its one dispatch to a mostly-inactive full-width scan.
-        self.chunk_len = max(1, min(W // b, max_iter))
+        # Capped by max_iter (a short training over a large window must not pad
+        # its one dispatch to a mostly-inactive full-width scan) and by the
+        # dispatch-length watchdog bound shared with the resident trainers.
+        self.chunk_len = min(max(1, W // b), fused_chunk_len(max_iter, False))
         _, offsets = offset_schedule(local_rows, b, max_iter)
         runs: List[Tuple[int, List[int]]] = []
         for off in offsets:

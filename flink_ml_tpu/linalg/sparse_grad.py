@@ -173,20 +173,30 @@ def grad_from_layout(
     window), ``inv_map`` the [dim] position map. Returns the [dim] gradient
     in original feature order.
 
-    The whole layout gathers in ONE flat 1-D lookup — ``mult_full[flat_rows]``
-    — and only the per-class *reductions* reshape to [F_c, c]. This is
-    deliberate: a gather with 2-D index tensors of this size sends the XLA
-    TPU backend into minutes of compilation (measured: 58 s for one
-    [1M, 2]-index gather vs 0.8 s for the same 4M indices flat), while the
-    flat form compiles in about a second and executes at HBM bandwidth
-    (~0.03 ms per million-row block on v5e).
+    Everything stays strictly 1-D. Two XLA TPU compile-time pathologies were
+    measured at this scale (250k rows, 4M features, 11.5M nonzeros) and are
+    deliberately designed around:
+
+    - a gather with 2-D index tensors takes minutes to compile (58 s for one
+      [1M, 2]-index gather) while the same indices flattened compile in
+      ~1 s — so the layout gathers in ONE flat lookup ``mult_full[flat_rows]``;
+    - a [F, c] reduce over a tiny minor dimension likewise stalls the
+      compiler for minutes — so each class block reduces by ``log2(c)``
+      pairwise halvings (``a[0::2] + a[1::2]``: strided 1-D slices + adds,
+      ~20 ops even for a 2^18-wide class), which is also why class widths
+      are powers of two.
+
+    Summation order within a feature is a balanced tree instead of the
+    scatter path's sequential order — equal up to float associativity.
     """
     dtype = mult_full.dtype
     prod = flat_vals.astype(dtype) * mult_full[flat_rows]  # one 1-D gather
     parts = []
     for f_c, c, off in class_meta:  # static: unrolled at trace time (~20 blocks)
-        parts.append(
-            jnp.sum(jax.lax.slice_in_dim(prod, off, off + f_c * c).reshape(f_c, c), axis=1)
-        )
+        block = jax.lax.slice_in_dim(prod, off, off + f_c * c)
+        while c > 1:  # pairwise-halving tree sum, all 1-D strided ops
+            block = block[0::2] + block[1::2]
+            c //= 2
+        parts.append(block)
     parts.append(jnp.zeros((1,), dtype))  # the unseen-feature slot
     return jnp.concatenate(parts)[inv_map]

@@ -27,7 +27,12 @@ from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.iteration import DeviceDataCache
 from flink_ml_tpu.models.common import extract_labeled_data
-from flink_ml_tpu.ops.optimizer import _TOL_CHUNK, _cache_put, chunked_schedule, offset_schedule
+from flink_ml_tpu.ops.optimizer import (
+    _cache_put,
+    chunked_schedule,
+    fused_chunk_len,
+    offset_schedule,
+)
 from flink_ml_tpu.params.param import (
     IntArrayParam,
     ParamValidators,
@@ -312,7 +317,7 @@ class MLPClassifier(Estimator, _MlpParams):
         # always run inside one XLA program (scan for maxIter-only, while_loop for
         # the tol criteria evaluated on device).
         max_iter = self.get_max_iter()
-        chunk = min(max_iter, _TOL_CHUNK) if check_loss else max_iter
+        chunk = fused_chunk_len(max_iter, check_loss)
         fused = self._build_fused(
             ctx,
             optimizer,

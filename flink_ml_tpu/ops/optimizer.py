@@ -18,8 +18,9 @@ device. The feedback edge is the (coef, offset) device arrays handed to the next
 epoch; nothing leaves HBM during training.
 
 Whole-run fusion: when no checkpointing or listeners are attached, epochs run in
-fused chunks — ``lax.scan`` over a host-precomputed minibatch schedule, ONE
-full-length chunk for the maxIter-only path (zero host syncs), and
+fused chunks — ``lax.scan`` over a host-precomputed minibatch schedule,
+_MAX_CHUNK-epoch dispatches for the maxIter-only path (one cheap host sync per
+chunk; see ``fused_chunk_len``), and
 _TOL_CHUNK-epoch chunks when a tol criteria is active, with the criteria replayed
 *on device* via a carried ``done`` flag (the psum'd loss is replicated across
 shards, so every device takes the same branch — the single-controller analogue of
@@ -247,6 +248,20 @@ def chunked_schedule(starts: np.ndarray, offsets: np.ndarray, max_iter: int, chu
 
 
 _TOL_CHUNK = 64  # epochs per dispatch when a tol criteria is active
+# Upper bound on epochs per dispatch even without a criteria: a single
+# arbitrarily-long fused scan risks runtime watchdogs (observed: a 250-epoch
+# scan over the Criteo-shape sparse program crashes the TPU worker behind
+# the axon tunnel, while 50- and 64-epoch dispatches run fine), and the cost
+# of chunking is one host sync per chunk.
+_MAX_CHUNK = 64
+
+
+def fused_chunk_len(max_iter: int, check_loss: bool) -> int:
+    """Epochs per dispatch for every fused trainer (SGD, MLPClassifier):
+    tol runs sync every ``_TOL_CHUNK`` epochs so early convergence wastes at
+    most a chunk of cheap epochs; maxIter-only runs are still capped at
+    ``_MAX_CHUNK`` per dispatch (watchdog bound, see above)."""
+    return max(1, min(max_iter, _TOL_CHUNK if check_loss else _MAX_CHUNK))
 
 _FUSED_CACHE: Dict[tuple, object] = {}
 _FUSED_CACHE_MAX = 32  # FIFO-bounded: hyperparameter sweeps must not leak executables
@@ -621,10 +636,8 @@ class SGD(Optimizer):
         )
         if fused:
             # One program runs a chunk of epochs; the host observes the on-device
-            # ``done`` flag between chunks. maxIter-only runs use one full-length
-            # chunk (zero host syncs); tol runs sync every _TOL_CHUNK epochs, so
-            # early convergence wastes at most _TOL_CHUNK - 1 cheap epochs.
-            chunk = min(self.max_iter, _TOL_CHUNK) if check_loss else self.max_iter
+            # ``done`` flag between chunks (see fused_chunk_len for the policy).
+            chunk = fused_chunk_len(self.max_iter, check_loss)
             program = _fused_sgd_program(
                 ctx,
                 loss_func,
@@ -652,7 +665,7 @@ class SGD(Optimizer):
                 # Loss history is recorded unconditionally — the reference always
                 # streams loss through the feedback edge (SGD.java:137-143), tol
                 # or not. The losses buffer already comes back with the chunk, so
-                # a maxIter-only run pays one fetch at its single chunk boundary.
+                # this costs one fetch per chunk boundary.
                 n = int(jax.device_get(n_exec))
                 chunk_losses = np.asarray(jax.device_get(losses), np.float64)
                 self.loss_history.extend(float(x) for x in chunk_losses[:n])
