@@ -46,8 +46,9 @@ class WindowSchedule:
 
     ``runs`` is a list of ``(window_idx, local_starts)`` with ``local_starts``
     the slice starts *relative to the window*; consecutive epochs that fall in
-    the same window form one run (capped at ``chunk_len = window // batch``
-    epochs so every run fits one fixed-width fused program).
+    the same window form one run, capped at ``chunk_len`` epochs — the lesser
+    of ``window // batch`` and the ``fused_chunk_len`` dispatch-length
+    watchdog — so every run fits one fixed-width fused program.
     """
 
     def __init__(self, local_rows: int, local_batch: int, window_rows: int, max_iter: int):

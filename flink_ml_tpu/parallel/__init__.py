@@ -25,11 +25,14 @@ from flink_ml_tpu.parallel.moe import moe_ffn, moe_ffn_sharded
 from flink_ml_tpu.parallel.datastream_utils import (
     aggregate,
     co_group,
+    co_group_cache,
     distributed_quantiles,
     distributed_sort,
+    distributed_sort_cache,
     map_partition,
     reduce,
     sample,
+    sample_cache,
 )
 
 __all__ = [
@@ -50,9 +53,12 @@ __all__ = [
     "QuantileSummary",
     "aggregate",
     "co_group",
+    "co_group_cache",
     "distributed_quantiles",
     "distributed_sort",
+    "distributed_sort_cache",
     "map_partition",
     "reduce",
     "sample",
+    "sample_cache",
 ]

@@ -30,7 +30,9 @@ __all__ = [
     "aggregate",
     "reduce",
     "sample",
+    "sample_cache",
     "co_group",
+    "co_group_cache",
     "distributed_sort",
     "distributed_sort_cache",
     "distributed_quantiles",
@@ -130,6 +132,54 @@ def sample(
     return {k: v[reservoir_idx] for k, v in columns.items()}
 
 
+def sample_cache(
+    cache,
+    num_samples: int,
+    seed: int = 0,
+) -> Columns:
+    """``sample`` over a host-tier cache: one streaming pass of Algorithm R.
+
+    The reservoir (``num_samples`` rows) is the only thing resident — the
+    dataset streams chunk-by-chunk out of the capacity tier, so sampling a
+    dataset far beyond host RAM costs one pass of disk reads. Same
+    chunk-vectorized survival/slot trick as the in-RAM ``sample`` (ref
+    DataStreamUtils.sample:298); results are a uniform ``num_samples``-subset
+    regardless of how the cache happens to be chunked.
+    """
+    rng = np.random.default_rng(seed)
+    reservoir: Columns = {}
+    filled = 0  # rows 0..filled-1 of the reservoir are real
+    seen = 0  # rows consumed from the stream so far
+
+    for chunk in cache.iter_rows():
+        chunk = {k: np.asarray(v) for k, v in chunk.items()}
+        m = _num_rows(chunk)
+        if not reservoir:
+            reservoir = {
+                k: np.empty((num_samples,) + v.shape[1:], v.dtype)
+                for k, v in chunk.items()
+            }
+        lo = 0
+        if filled < num_samples:  # fill phase
+            take = min(num_samples - filled, m)
+            for k, v in chunk.items():
+                reservoir[k][filled : filled + take] = v[:take]
+            filled += take
+            seen += take
+            lo = take
+        if lo < m:  # replacement phase, chunk-vectorized
+            gidx = np.arange(seen, seen + (m - lo))
+            accept = rng.random(m - lo) < num_samples / (gidx + 1.0)
+            taken = np.flatnonzero(accept) + lo
+            slots = rng.integers(0, num_samples, size=taken.size)
+            for k, v in chunk.items():
+                reservoir[k][slots] = v[taken]  # later writes win, like sequential R
+            seen += m - lo
+    if filled < num_samples:
+        return {k: v[:filled] for k, v in reservoir.items()}
+    return reservoir
+
+
 def co_group(
     left_keys: np.ndarray,
     right_keys: np.ndarray,
@@ -151,6 +201,142 @@ def co_group(
     r_end = np.searchsorted(rs, keys, side="right")
     for i, key in enumerate(keys):
         yield key, lo[l_start[i] : l_end[i]], ro[r_start[i] : r_end[i]]
+
+
+def _sketch_splitters(caches, key_of_chunk, n_buckets: int) -> np.ndarray:
+    """Range splitters for ``n_buckets`` buckets: one GK sketch streamed over
+    every cache's chunks (rank error only moves bucket *boundaries*, never
+    ordering). Duplicate splitters collapse, merging their buckets."""
+    if n_buckets <= 1:
+        return np.empty(0, np.float64)
+    sketch = QuantileSummary(0.001)
+    for cache in caches:
+        for chunk in cache.iter_rows():
+            sketch.insert_all(key_of_chunk(chunk))
+            sketch.compress()
+    probs = np.linspace(0.0, 1.0, n_buckets + 1)[1:-1]
+    return np.unique(np.atleast_1d(sketch.query(probs)))
+
+
+def _spill_by_range(cache, key_of_chunk, value_cols, splitters, spill_prefix):
+    """Route a cache's chunks into per-bucket spill caches by key range.
+
+    ``side='right'`` keeps all ties of a splitter value in one bucket — the
+    invariant both the external sort and the co-group lean on. Returns the
+    bucket list plus the observed (dtype, trailing-shape) of each value
+    column, so callers can manufacture dtype-consistent empties.
+    """
+    from flink_ml_tpu.iteration.datacache import HostDataCache
+
+    n_buckets = len(splitters) + 1
+    buckets = [
+        HostDataCache(memory_budget_bytes=0, spill_dir=f"{spill_prefix}{b}")
+        for b in range(n_buckets)
+    ]
+    col_specs: Dict[str, Tuple] = {}
+    for chunk in cache.iter_rows():
+        keys = key_of_chunk(chunk)
+        route = np.searchsorted(splitters, keys, side="right")
+        order = np.argsort(route, kind="stable")
+        bounds = np.searchsorted(route[order], np.arange(n_buckets + 1))
+        for k in value_cols:
+            v = np.asarray(chunk[k])
+            col_specs.setdefault(k, (v.dtype, v.shape[1:]))
+        for b in range(n_buckets):
+            rows = order[bounds[b] : bounds[b + 1]]
+            if rows.size:
+                buckets[b].append(
+                    {
+                        "__key__": keys[rows],
+                        **{k: np.asarray(chunk[k])[rows] for k in value_cols},
+                    }
+                )
+    return buckets, col_specs
+
+
+def co_group_cache(
+    left_cache,
+    right_cache,
+    key_col: str,
+    left_value_cols: Sequence[str] = (),
+    right_value_cols: Sequence[str] = (),
+    bucket_rows: int = 1 << 20,
+    spill_dir: Optional[str] = None,
+) -> Iterator[Tuple[object, Columns, Columns]]:
+    """Out-of-core sort-merge co-group over two host-tier caches.
+
+    The reference's ``coGroup`` (DataStreamUtils.java:409) sorts both inputs
+    through managed memory and walks them together; here both sides range-
+    partition by *shared* splitters (a GK sketch over the union of keys), each
+    bucket pair loads one at a time, and the in-RAM ``co_group`` walks the
+    pair. Ties of one key always share a bucket (``side='right'`` routing), so
+    no key group ever straddles buckets; the only resident state is one bucket
+    from each side.
+
+    Yields ``(key, left_rows, right_rows)`` in global key order, where the
+    row dicts carry the requested value columns (empty-length arrays when a
+    key is absent from one side).
+
+    Keys share ``distributed_sort_cache``'s contract: treated as float64
+    range-partition keys (NaN unsupported; integer keys above 2^53 can
+    collide under the cast — unlike the in-RAM ``co_group``, which compares
+    keys in their own dtype). A side whose cache holds zero rows has no
+    observable column dtypes, so its value columns degrade to 1-D float64
+    empties.
+    """
+    import shutil
+    import tempfile
+
+    from flink_ml_tpu.config import resolve_cache_config
+
+    n_total = int(left_cache.num_rows) + int(right_cache.num_rows)
+    if n_total == 0:
+        return
+
+    def key_of(chunk: Columns) -> np.ndarray:
+        return np.asarray(chunk[key_col], np.float64).ravel()
+
+    splitters = _sketch_splitters(
+        (left_cache, right_cache), key_of, max(1, -(-n_total // bucket_rows))
+    )
+    n_buckets = len(splitters) + 1
+
+    _, base_spill = resolve_cache_config(None, spill_dir)
+    if base_spill is not None:
+        os.makedirs(base_spill, exist_ok=True)
+    own_dir = tempfile.mkdtemp(prefix="flinkml_cogroup_", dir=base_spill)
+    try:
+        sides = [
+            _spill_by_range(cache, key_of, cols, splitters, f"{own_dir}/{tag}")
+            for tag, cache, cols in (
+                ("l", left_cache, left_value_cols),
+                ("r", right_cache, right_value_cols),
+            )
+        ]
+
+        def _load(buckets, specs, cols, b):
+            nb = int(buckets[b].num_rows)
+            if nb:
+                return buckets[b].rows(0, nb)
+            return {
+                "__key__": np.empty(0, np.float64),
+                **{
+                    k: np.empty((0,) + specs[k][1], specs[k][0]) if k in specs else np.empty(0)
+                    for k in cols
+                },
+            }
+
+        for b in range(n_buckets):
+            lcols = _load(sides[0][0], sides[0][1], left_value_cols, b)
+            rcols = _load(sides[1][0], sides[1][1], right_value_cols, b)
+            for key, lidx, ridx in co_group(lcols["__key__"], rcols["__key__"]):
+                yield (
+                    key,
+                    {k: np.asarray(lcols[k])[lidx] for k in left_value_cols},
+                    {k: np.asarray(rcols[k])[ridx] for k in right_value_cols},
+                )
+    finally:
+        shutil.rmtree(own_dir, ignore_errors=True)
 
 
 def distributed_sort(
@@ -252,7 +438,6 @@ def distributed_sort_cache(
     import tempfile
 
     from flink_ml_tpu.config import resolve_cache_config
-    from flink_ml_tpu.iteration.datacache import HostDataCache
 
     n = int(cache.num_rows)
     if n == 0:
@@ -262,16 +447,7 @@ def distributed_sort_cache(
     def chunk_keys(chunk: Columns) -> np.ndarray:
         return np.asarray(extract(np.asarray(chunk[key_col])), np.float64).ravel()
 
-    n_buckets = max(1, -(-n // bucket_rows))
-    if n_buckets > 1:
-        sketch = QuantileSummary(0.001)
-        for chunk in cache.iter_rows():
-            sketch.insert_all(chunk_keys(chunk))
-            sketch.compress()
-        probs = np.linspace(0.0, 1.0, n_buckets + 1)[1:-1]
-        splitters = np.unique(np.atleast_1d(sketch.query(probs)))
-    else:
-        splitters = np.empty(0, np.float64)
+    splitters = _sketch_splitters((cache,), chunk_keys, max(1, -(-n // bucket_rows)))
     n_buckets = len(splitters) + 1  # duplicate splitters merge buckets
 
     _, base_spill = resolve_cache_config(None, spill_dir)
@@ -279,24 +455,7 @@ def distributed_sort_cache(
         os.makedirs(base_spill, exist_ok=True)
     own_dir = tempfile.mkdtemp(prefix="flinkml_sort_", dir=base_spill)
     try:
-        buckets = [
-            HostDataCache(memory_budget_bytes=0, spill_dir=f"{own_dir}/b{b}")
-            for b in range(n_buckets)
-        ]
-        for chunk in cache.iter_rows():
-            keys = chunk_keys(chunk)
-            route = np.searchsorted(splitters, keys, side="right")
-            order = np.argsort(route, kind="stable")
-            bounds = np.searchsorted(route[order], np.arange(n_buckets + 1))
-            for b in range(n_buckets):
-                rows = order[bounds[b] : bounds[b + 1]]
-                if rows.size:
-                    buckets[b].append(
-                        {
-                            "__key__": keys[rows],
-                            **{k: np.asarray(chunk[k])[rows] for k in value_cols},
-                        }
-                    )
+        buckets, _ = _spill_by_range(cache, chunk_keys, value_cols, splitters, f"{own_dir}/b")
 
         for b in reversed(range(n_buckets)) if descending else range(n_buckets):
             nb = int(buckets[b].num_rows)

@@ -273,3 +273,97 @@ class TestDistributedSortCache:
         cache = HostDataCache(memory_budget_bytes=1024, spill_dir=str(tmp_path))
         cache.finish()
         assert list(distributed_sort_cache(cache, "k")) == []
+
+
+class TestCacheStreamingBelt:
+    """sample/co_group over the capacity tier — the out-of-core analogues."""
+
+    @staticmethod
+    def _fill(cache, cols, chunk=97):
+        n = len(next(iter(cols.values())))
+        for a in range(0, n, chunk):
+            cache.append({k: v[a : a + chunk] for k, v in cols.items()})
+        cache.finish()
+        return cache
+
+    def test_sample_cache_uniform_and_distinct(self, tmp_path):
+        from flink_ml_tpu.iteration import HostDataCache
+        from flink_ml_tpu.parallel import sample_cache
+
+        n = 40_000
+        cache = self._fill(
+            HostDataCache(memory_budget_bytes=4096, spill_dir=str(tmp_path / "s")),
+            {"x": np.arange(float(n)), "y": np.arange(n, dtype=np.int64) * 2},
+        )
+        got = sample_cache(cache, 500, seed=3)
+        assert len(got["x"]) == 500
+        assert len(np.unique(got["x"])) == 500  # reservoir rows are distinct
+        np.testing.assert_array_equal(got["y"], got["x"].astype(np.int64) * 2)  # rows stay aligned
+        assert abs(got["x"].mean() - n / 2) < n / 10  # uniform over the stream
+
+    def test_sample_cache_small_input_returns_all(self, tmp_path):
+        from flink_ml_tpu.iteration import HostDataCache
+        from flink_ml_tpu.parallel import sample_cache
+
+        cache = self._fill(
+            HostDataCache(memory_budget_bytes=0, spill_dir=str(tmp_path / "s")),
+            {"x": np.arange(7.0)},
+        )
+        got = sample_cache(cache, 100, seed=0)
+        np.testing.assert_array_equal(np.sort(got["x"]), np.arange(7.0))
+
+    def test_co_group_cache_parity_with_in_ram(self, tmp_path):
+        from flink_ml_tpu.iteration import HostDataCache
+        from flink_ml_tpu.parallel import co_group, co_group_cache
+
+        rng = np.random.default_rng(11)
+        lk = rng.integers(0, 50, size=1200).astype(np.float64)
+        rk = rng.integers(25, 75, size=900).astype(np.float64)
+        lv = np.arange(1200, dtype=np.int64)
+        rv = np.arange(900, dtype=np.int64)
+        left = self._fill(
+            HostDataCache(memory_budget_bytes=2048, spill_dir=str(tmp_path / "l")),
+            {"k": lk, "v": lv},
+        )
+        right = self._fill(
+            HostDataCache(memory_budget_bytes=2048, spill_dir=str(tmp_path / "r")),
+            {"k": rk, "v": rv},
+        )
+        # tiny buckets force the multi-bucket path
+        got = {
+            k: (set(lrows["v"].tolist()), set(rrows["v"].tolist()))
+            for k, lrows, rrows in co_group_cache(
+                left, right, "k", ["v"], ["v"],
+                bucket_rows=256, spill_dir=str(tmp_path / "cg"),
+            )
+        }
+        want = {
+            k: (set(lv[li].tolist()), set(rv[ri].tolist()))
+            for k, li, ri in co_group(lk, rk)
+        }
+        assert got == want
+        assert list(got) == sorted(got)  # global key order
+
+    def test_co_group_cache_empty_side_keeps_dtype(self, tmp_path):
+        from flink_ml_tpu.iteration import HostDataCache
+        from flink_ml_tpu.parallel import co_group_cache
+
+        # right keys all land in the upper bucket, so the lower bucket's right
+        # side is entirely empty — its yielded empties must still carry the
+        # column's real dtype, not a float64 placeholder.
+        left = self._fill(
+            HostDataCache(memory_budget_bytes=0, spill_dir=str(tmp_path / "l")),
+            {"k": np.arange(600, dtype=np.float64), "v": np.arange(600, dtype=np.int64)},
+        )
+        right = self._fill(
+            HostDataCache(memory_budget_bytes=0, spill_dir=str(tmp_path / "r")),
+            {"k": np.full(300, 599.0), "v": np.arange(300, dtype=np.int64)},
+        )
+        dtypes = {
+            rrows["v"].dtype
+            for _, lrows, rrows in co_group_cache(
+                left, right, "k", ["v"], ["v"],
+                bucket_rows=256, spill_dir=str(tmp_path / "cg"),
+            )
+        }
+        assert dtypes == {np.dtype(np.int64)}
