@@ -243,10 +243,8 @@ def bench_logreg_sparse_streamed():
 
     Row count is scaled to the dev tunnel (~25 MB/s host->device): the
     machinery is what's under test; per-row cost is shape-invariant. The
-    ingest/compute split measures the *scatter-path* step the streamed
-    program actually runs (the streamed path keeps the scatter gradient —
-    windows change every visit, so the resident path's precomputed
-    transposed layout doesn't apply), on a window-sized resident cache.
+    ingest/compute split measures the scatter-gradient step the streamed
+    program runs, on a window-sized resident cache.
     """
     import tempfile
 
@@ -301,7 +299,6 @@ def bench_logreg_sparse_streamed():
             "weights": np.ones(window, np.float32),
         }
     )
-    wcache.host_columns = {}  # forces the scatter path, like the streamed program
 
     def wsteps(iters):
         SGD(max_iter=iters, global_batch_size=batch, tol=0.0, learning_rate=0.5).optimize(

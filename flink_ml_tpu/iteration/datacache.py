@@ -115,8 +115,8 @@ class DeviceDataCache:
         self.n_valid = n
         self.arrays: Dict[str, jax.Array] = {}
         # Host references are kept for the sparse columns only (zero-copy for
-        # ndarray inputs): the transposed sparse-gradient layout
-        # (linalg/sparse_grad.py) transposes them once per dataset without a
+        # ndarray inputs): host-side sparse layout construction (bucketing the
+        # static sparsity pattern once per dataset) reads them back without a
         # device->host round trip. Dense columns are not retained — nothing
         # reads them back, and pinning e.g. a 250k x 256 feature matrix would
         # waste a quarter GB of host RAM.

@@ -51,7 +51,16 @@ class WindowSchedule:
     watchdog — so every run fits one fixed-width fused program.
     """
 
-    def __init__(self, local_rows: int, local_batch: int, window_rows: int, max_iter: int):
+    def __init__(
+        self,
+        local_rows: int,
+        local_batch: int,
+        window_rows: int,
+        max_iter: int,
+        serial_elems_per_epoch: int = 0,
+        check_loss: bool = False,
+        flops_per_epoch: float = 0.0,
+    ):
         # The cycling rule is offset_schedule's — the single source of truth the
         # resident fused path also consumes, so the two paths cannot drift.
         from flink_ml_tpu.ops.optimizer import fused_chunk_len, offset_schedule
@@ -63,8 +72,12 @@ class WindowSchedule:
         self.n_windows = -(-local_rows // W)
         # Capped by max_iter (a short training over a large window must not pad
         # its one dispatch to a mostly-inactive full-width scan) and by the
-        # dispatch-length watchdog bound shared with the resident trainers.
-        self.chunk_len = min(max(1, W // b), fused_chunk_len(max_iter, False))
+        # dispatch-length policy shared with the resident trainers (watchdog
+        # budgets + the tol sync cadence).
+        self.chunk_len = min(
+            max(1, W // b),
+            fused_chunk_len(max_iter, check_loss, serial_elems_per_epoch, flops_per_epoch),
+        )
         _, offsets = offset_schedule(local_rows, b, max_iter)
         runs: List[Tuple[int, List[int]]] = []
         for off in offsets:
@@ -184,13 +197,19 @@ def plan_windows(
     dtype=np.float32,
     transforms: Optional[Dict[str, object]] = None,
     dtypes: Optional[Dict[str, object]] = None,
+    serial_elems_per_epoch: int = 0,
+    check_loss: bool = False,
+    flops_per_epoch: float = 0.0,
 ) -> Tuple["WindowedStream", "WindowSchedule"]:
     """Build a (stream, schedule) pair with a consistent batch-aligned width."""
     n = int(cache.num_rows)
     if n == 0:
         raise ValueError("cannot stream an empty cache")
     m = -(-n // ctx.n_data)
-    sched = WindowSchedule(m, local_batch, window_rows, max_iter)
+    sched = WindowSchedule(
+        m, local_batch, window_rows, max_iter,
+        serial_elems_per_epoch, check_loss, flops_per_epoch,
+    )
     stream = WindowedStream(cache, columns, ctx, sched.window, dtype, transforms, dtypes)
     return stream, sched
 

@@ -317,7 +317,14 @@ class MLPClassifier(Estimator, _MlpParams):
         # always run inside one XLA program (scan for maxIter-only, while_loop for
         # the tol criteria evaluated on device).
         max_iter = self.get_max_iter()
-        chunk = fused_chunk_len(max_iter, check_loss)
+        # fwd 2 + bwd 4 madd-flops per weight per row bounds the dispatch length
+        flops_per_epoch = (
+            6.0
+            * local_batch
+            * ctx.n_data
+            * sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+        )
+        chunk = fused_chunk_len(max_iter, check_loss, flops_per_epoch=flops_per_epoch)
         fused = self._build_fused(
             ctx,
             optimizer,
@@ -385,6 +392,9 @@ class MLPClassifier(Estimator, _MlpParams):
         local_batch = max(1, -(-self.get_global_batch_size() // ctx.n_data))
         local_batch = min(local_batch, -(-int(cache.num_rows) // ctx.n_data))
         max_iter = self.get_max_iter()
+        d = int(np.asarray(cache.rows(0, 1)["features"]).shape[-1])
+        dims = [d, *[int(h) for h in self.get_hidden_layers()], len(classes)]
+        check_loss = np.isfinite(self.get_tol()) and self.get_tol() > 0
         stream, sched = plan_windows(
             cache,
             {"x": "features", "y": "labels", "w": "weights"},
@@ -393,13 +403,15 @@ class MLPClassifier(Estimator, _MlpParams):
             local_batch,
             max_iter,
             transforms={"y": to_index},
+            check_loss=check_loss,
+            flops_per_epoch=6.0
+            * local_batch
+            * ctx.n_data
+            * sum(a * b for a, b in zip(dims[:-1], dims[1:])),
         )
-        d = int(stream._shapes["x"][0])
-        dims = [d, *[int(h) for h in self.get_hidden_layers()], len(classes)]
         rng = np.random.default_rng(self.get_seed())
         params = [tuple(jnp.asarray(a) for a in layer) for layer in _init_params(rng, dims)]
         optimizer = optax.adam(self.get_learning_rate())
-        check_loss = np.isfinite(self.get_tol()) and self.get_tol() > 0
         fused = self._build_fused(
             ctx, optimizer, local_batch, sched.chunk_len,
             self.get_tol() if check_loss else None,

@@ -19,7 +19,7 @@ epoch; nothing leaves HBM during training.
 
 Whole-run fusion: when no checkpointing or listeners are attached, epochs run in
 fused chunks — ``lax.scan`` over a host-precomputed minibatch schedule,
-_MAX_CHUNK-epoch dispatches for the maxIter-only path (one cheap host sync per
+budget-capped dispatches for the maxIter-only path (one cheap host sync per
 chunk; see ``fused_chunk_len``), and
 _TOL_CHUNK-epoch chunks when a tol criteria is active, with the criteria replayed
 *on device* via a carried ``done`` flag (the psum'd loss is replicated across
@@ -106,7 +106,6 @@ def _sgd_epoch_math(
     elastic_net,
     dtype,
     model_sharded: bool = False,
-    grad_layout=None,
 ):
     """One epoch of the per-shard SGD update (shared by the host-loop step and the
     fused whole-run program). ``start`` is the clamped slice start and ``offset``
@@ -114,9 +113,6 @@ def _sgd_epoch_math(
     supplied by the caller so the fused path can feed a *precomputed* schedule.
     ``feats`` is either a dense [m, d] array or a padded-CSR
     ``(indices [m, K], values [m, K])`` pair (linalg/sparse_batch.py).
-    ``grad_layout`` — optional ``(class_meta, flat_rows, flat_vals, inv_map)``
-    transposed layout (linalg/sparse_grad.py) replacing the sparse gradient's
-    serialized scatter-add with gathers + dense reductions.
     Returns (new_coef, mean_loss)."""
     # The minibatch is a *contiguous* window, so a dynamic_slice (cheap on TPU)
     # instead of a row gather (slow scatter/gather path). At the cache tail the
@@ -161,22 +157,9 @@ def _sgd_epoch_math(
             # size cost minutes of XLA TPU compile time; flat is ~1 s)
             dot = jnp.sum(vb * coef[ib.reshape(-1)].reshape(ib.shape), axis=1)
             loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
-            if grad_layout is not None:
-                # Scatter-free: the batch multiplier lands in a zeros-[m]
-                # vector with one contiguous write (rows outside the window
-                # carry mult 0 via wb), and the transposed layout turns the
-                # gradient into gathers + dense reductions.
-                from flink_ml_tpu.linalg.sparse_grad import grad_from_layout
-
-                class_meta, fr, fv, inv = grad_layout
-                mult_full = jax.lax.dynamic_update_slice(
-                    jnp.zeros(y.shape[0], mult.dtype), mult, (start,)
-                )
-                grad_sum = grad_from_layout(fr, fv, inv, class_meta, mult_full)
-            else:
-                grad_sum = (
-                    jnp.zeros_like(coef).at[ib.ravel()].add((vb * mult[:, None]).ravel())
-                )
+            grad_sum = (
+                jnp.zeros_like(coef).at[ib.ravel()].add((vb * mult[:, None]).ravel())
+            )
     else:
         Xb = jax.lax.dynamic_slice_in_dim(feats, start, local_batch)
         if model_sharded:
@@ -248,20 +231,47 @@ def chunked_schedule(starts: np.ndarray, offsets: np.ndarray, max_iter: int, chu
 
 
 _TOL_CHUNK = 64  # epochs per dispatch when a tol criteria is active
-# Upper bound on epochs per dispatch even without a criteria: a single
-# arbitrarily-long fused scan risks runtime watchdogs (observed: a 250-epoch
-# scan over the Criteo-shape sparse program crashes the TPU worker behind
-# the axon tunnel, while 50- and 64-epoch dispatches run fine), and the cost
-# of chunking is one host sync per chunk.
-_MAX_CHUNK = 64
+# Upper bound on epochs per dispatch without a criteria. Two regimes,
+# both measured on chip:
+#
+# - Epochs built from dense matmuls run microseconds each; a multi-thousand-
+#   epoch scan is a sub-second dispatch and chunking it only buys host-sync
+#   round-trips (over the dev tunnel each sync costs milliseconds — chunking
+#   dense at 64 cost an 18x steady-state throughput regression).
+# - Epochs containing serialized gather/scatter instructions run ~7-10 ns per
+#   element; a 250-epoch scan over the Criteo-shape sparse program (~5M
+#   serialized elements/epoch) crashes the TPU worker's watchdog, while
+#   dispatches under ~3e8 total elements run fine.
+#
+# So the cap is budget-based: callers report the per-epoch serialized-element
+# count (and, for matmul-heavy epochs like the MLP's, a FLOP estimate) and the
+# chunk length keeps each dispatch under both budgets.
+_MAX_CHUNK_DENSE = 4096
+_SERIAL_BUDGET = 300_000_000
+_FLOP_BUDGET = 5e14  # ~3-5 s of MXU work per dispatch at realistic MFU
 
 
-def fused_chunk_len(max_iter: int, check_loss: bool) -> int:
+def fused_chunk_len(
+    max_iter: int,
+    check_loss: bool,
+    serial_elems_per_epoch: int = 0,
+    flops_per_epoch: float = 0.0,
+) -> int:
     """Epochs per dispatch for every fused trainer (SGD, MLPClassifier):
     tol runs sync every ``_TOL_CHUNK`` epochs so early convergence wastes at
-    most a chunk of cheap epochs; maxIter-only runs are still capped at
-    ``_MAX_CHUNK`` per dispatch (watchdog bound, see above)."""
-    return max(1, min(max_iter, _TOL_CHUNK if check_loss else _MAX_CHUNK))
+    most a chunk of cheap epochs; maxIter-only runs are capped so one dispatch
+    stays under the serialized-op watchdog budget (see above), with
+    ``serial_elems_per_epoch`` the caller's count of gather/scatter elements
+    one epoch executes (0 for purely dense epochs) and ``flops_per_epoch``
+    its matmul FLOP estimate (bounds wide-MLP dispatches to seconds)."""
+    cap = _MAX_CHUNK_DENSE
+    if serial_elems_per_epoch > 0:
+        cap = min(cap, max(1, _SERIAL_BUDGET // int(serial_elems_per_epoch)))
+    if flops_per_epoch > 0:
+        cap = min(cap, max(1, int(_FLOP_BUDGET / flops_per_epoch)))
+    if check_loss:
+        cap = min(cap, _TOL_CHUNK)
+    return max(1, min(max_iter, cap))
 
 _FUSED_CACHE: Dict[tuple, object] = {}
 _FUSED_CACHE_MAX = 32  # FIFO-bounded: hyperparameter sweeps must not leak executables
@@ -285,7 +295,6 @@ def _fused_sgd_program(
     dtype,
     sparse: bool = False,
     model_sharded: bool = False,
-    layout_meta=None,
 ):
     """A chunk of ``chunk_len`` SGD epochs as ONE jit'd SPMD program.
 
@@ -314,11 +323,6 @@ def _fused_sgd_program(
     cost), margins assemble with a psum over the model axis, and the returned
     coefficient stays model-sharded.
 
-    With ``layout_meta`` (sparse, non-model-sharded) the data args carry three
-    trailing arrays — per-shard ``flat_rows``/``flat_vals`` and the replicated
-    ``inv_map`` of a transposed gradient layout (linalg/sparse_grad.py) — and
-    the gradient runs scatter-free.
-
     Dense + ``model_sharded``: the features arrive 2D-sharded
     ``P(data, model)`` (column slices per model shard) and the margin
     assembles with a psum over the model axis.
@@ -335,7 +339,6 @@ def _fused_sgd_program(
         jnp.dtype(dtype).name,
         sparse,
         model_sharded,
-        layout_meta,
     )
     cached = _FUSED_CACHE.get(key)
     if cached is not None:
@@ -344,10 +347,6 @@ def _fused_sgd_program(
     def per_shard(coef, done, starts, offsets, active, *data):
         feats = (data[0], data[1]) if sparse else data[0]
         y, w, mask = data[2:5] if sparse else data[1:4]
-        grad_layout = None
-        if layout_meta is not None:
-            # flat arrays arrive [1, N] (leading data-axis shard dim)
-            grad_layout = (layout_meta, data[5][0], data[6][0], data[7])
 
         def body(carry, schedule):
             c, done = carry
@@ -355,7 +354,6 @@ def _fused_sgd_program(
             new_c, mean_loss = _sgd_epoch_math(
                 c, start, offset, feats, y, w, mask, loss_func, local_batch, lr,
                 reg, elastic_net, dtype, model_sharded=model_sharded,
-                grad_layout=grad_layout,
             )
             executed = ~done & act
             new_c = jnp.where(executed, new_c, c)
@@ -375,8 +373,6 @@ def _fused_sgd_program(
     if model_sharded and not sparse:
         # dense TP: features are column-sliced over the model axis too
         data_specs = (P(DATA_AXIS, MODEL_AXIS),) + data_specs[1:]
-    if layout_meta is not None:
-        data_specs += (P(DATA_AXIS), P(DATA_AXIS), P())  # flat_rows, flat_vals, inv_map
     coef_spec = P(MODEL_AXIS) if model_sharded else P()
     program = jax.jit(
         jax.shard_map(
@@ -455,33 +451,6 @@ class SGD(Optimizer):
         ).hexdigest()[:16]
 
     @staticmethod
-    def _sparse_layout(train_data: DeviceDataCache, ctx: MeshContext, dim: int):
-        """Build (once per cache) the transposed scatter-free gradient layout.
-
-        Returns ``(class_meta, (flat_rows, flat_vals, inv_map))`` with the
-        arrays already placed on the mesh, or ``(None, ())`` when the cache
-        carries no host copies to transpose. Memoized on the cache object —
-        repeated fits (hyperparameter sweeps, benchmarks) pay the host-side
-        transpose and the device transfer once.
-        """
-        host = getattr(train_data, "host_columns", None)
-        if host is None or "indices" not in host:
-            return None, ()
-        memo = getattr(train_data, "_grad_layout", None)
-        if memo is not None and memo[0] == (ctx.n_data, dim):
-            return memo[1], memo[2]
-        from flink_ml_tpu.linalg.sparse_grad import SparseGradLayout
-
-        lay = SparseGradLayout.build(host["indices"], host["values"], dim, ctx.n_data)
-        dev = (
-            jax.device_put(lay.flat_rows, ctx.sharding(DATA_AXIS)),
-            jax.device_put(lay.flat_vals, ctx.sharding(DATA_AXIS)),
-            ctx.replicate(lay.inv_map),
-        )
-        train_data._grad_layout = ((ctx.n_data, dim), lay.class_meta, dev)
-        return lay.class_meta, dev
-
-    @staticmethod
     def _tp_features(train_data: DeviceDataCache, ctx: MeshContext):
         """The dense feature matrix column-padded to the model-axis size and
         sharded ``P(data, model)`` for dense tensor parallelism. Padded
@@ -525,7 +494,6 @@ class SGD(Optimizer):
         loss_func: LossFunc,
         local_batch: int,
         sparse: bool = False,
-        layout_meta=None,
         model_sharded: bool = False,
     ):
         lr = self.learning_rate
@@ -535,15 +503,11 @@ class SGD(Optimizer):
         def per_shard(coef, offset, *data):
             feats = (data[0], data[1]) if sparse else data[0]
             y, w, mask = data[2:5] if sparse else data[1:4]
-            grad_layout = None
-            if layout_meta is not None:
-                grad_layout = (layout_meta, data[5][0], data[6][0], data[7])
             m = y.shape[0]
             start = jnp.minimum(offset, m - local_batch)
             new_coef, mean_loss = _sgd_epoch_math(
                 coef, start, offset, feats, y, w, mask, loss_func, local_batch,
                 lr, reg, elastic_net, dtype, model_sharded=model_sharded,
-                grad_layout=grad_layout,
             )
             next_offset = jnp.where(offset + local_batch >= m, 0, offset + local_batch)
             return new_coef, next_offset, mean_loss
@@ -552,8 +516,6 @@ class SGD(Optimizer):
         data_specs = (P(DATA_AXIS),) * n_data_args
         if model_sharded and not sparse:
             data_specs = (P(DATA_AXIS, MODEL_AXIS),) + data_specs[1:]
-        if layout_meta is not None:
-            data_specs += (P(DATA_AXIS), P(DATA_AXIS), P())
         coef_spec = P(MODEL_AXIS) if model_sharded else P()
         return jax.jit(
             jax.shard_map(
@@ -611,14 +573,16 @@ class SGD(Optimizer):
         y = train_data["labels"]
         w = train_data["weights"]
         mask = train_data.mask.astype(self.dtype)
-        layout_meta = None
         if sparse:
             data_args = (train_data["indices"], train_data["values"], y, w, mask)
-            if not model_sharded:
-                # The transposed layout replaces the gradient's serialized
-                # scatter with gathers + dense reductions (sparse_grad.py).
-                layout_meta, layout_args = self._sparse_layout(train_data, ctx, dim)
-                data_args += layout_args
+            # The gradient stays a batch-sized scatter-add. The transposed
+            # dataset-level layout (sparse_grad.py) was measured on chip at
+            # ~6x WORSE than the scatter it replaced (271 ms vs 44 ms per
+            # Criteo-shape step): its per-epoch cost scales with the whole
+            # dataset's nonzeros (~20M gathered slots) while the scatter
+            # touches only the batch (~2.6M), and XLA's in-loop gathers are
+            # just as serialized as its scatters (~7-10 ns/element either
+            # way). docs/benchmarks.md carries the probe data.
         else:
             feats_dev = train_data["features"]
             if model_sharded:
@@ -637,7 +601,9 @@ class SGD(Optimizer):
         if fused:
             # One program runs a chunk of epochs; the host observes the on-device
             # ``done`` flag between chunks (see fused_chunk_len for the policy).
-            chunk = fused_chunk_len(self.max_iter, check_loss)
+            # sparse epochs: the forward gather + the gradient scatter
+            serial = 2 * local_batch * int(np.asarray(train_data["indices"]).shape[-1]) if sparse else 0
+            chunk = fused_chunk_len(self.max_iter, check_loss, serial)
             program = _fused_sgd_program(
                 ctx,
                 loss_func,
@@ -650,7 +616,6 @@ class SGD(Optimizer):
                 self.dtype,
                 sparse=sparse,
                 model_sharded=model_sharded,
-                layout_meta=layout_meta,
             )
             starts, offsets = offset_schedule(train_data.local_rows, local_batch, self.max_iter)
             coef = self._place_coef(ctx, init_model, self.dtype, model_sharded)
@@ -675,8 +640,7 @@ class SGD(Optimizer):
             return final[:dim] if model_sharded else final
 
         step = self._build_step(
-            ctx, loss_func, local_batch, sparse=sparse, layout_meta=layout_meta,
-            model_sharded=model_sharded,
+            ctx, loss_func, local_batch, sparse=sparse, model_sharded=model_sharded,
         )
 
         if self.checkpoint_manager is not None:
@@ -752,7 +716,8 @@ class SGD(Optimizer):
         local_batch = -(-self.global_batch_size // ctx.n_data)  # ceil
         n_rows = int(cache.num_rows)
         local_batch = min(local_batch, -(-n_rows // ctx.n_data))
-        sparse = "indices" in cache.rows(0, 1)
+        row0 = cache.rows(0, 1)
+        sparse = "indices" in row0
         if sparse:
             columns = {
                 "indices": "indices",
@@ -764,6 +729,8 @@ class SGD(Optimizer):
         else:
             columns = {"features": "features", "labels": "labels", "weights": "weights"}
             feat_keys = ("features",)
+        K = int(np.asarray(row0["indices"]).shape[-1]) if sparse else 0
+        check_loss = np.isfinite(self.tol) and self.tol > 0
         stream, sched = plan_windows(
             cache,
             columns,
@@ -773,8 +740,10 @@ class SGD(Optimizer):
             self.max_iter,
             dtype=self.dtype,
             dtypes={"indices": np.int32} if sparse else None,
+            # the streamed sparse epoch keeps the gather + scatter gradient
+            serial_elems_per_epoch=2 * local_batch * K,
+            check_loss=check_loss,
         )
-        check_loss = np.isfinite(self.tol) and self.tol > 0
         # Model-axis sharding on the streamed path covers the sparse layout
         # only (a wide streamed coefficient divides its scatter cost across
         # n_model shards); streamed *dense* features keep a replicated

@@ -75,55 +75,3 @@ def test_layout_index_out_of_range_raises():
     val = np.ones((1, 2), np.float32)
     with pytest.raises(ValueError, match="out of range"):
         SparseGradLayout.build(idx, val, 5, n_shards=1)
-
-
-def test_sgd_layout_path_matches_scatter_path():
-    # End-to-end: the fused sparse fit with the layout must reproduce the
-    # scatter path's trajectory exactly (the gradient psum is identical).
-    rng = np.random.default_rng(2)
-    n, d, K = 384, 600, 8
-    idx = rng.integers(0, d, size=(n, K)).astype(np.int32)
-    val = rng.normal(size=(n, K)).astype(np.float32)
-    y = (rng.random(n) > 0.5).astype(np.float32)
-    cols = {"indices": idx, "values": val, "labels": y, "weights": np.ones(n, np.float32)}
-
-    with mesh_context(MeshContext(n_data=4, n_model=1)) as ctx:
-        with_layout = DeviceDataCache(cols, ctx=ctx)
-        assert "indices" in with_layout.host_columns
-        without = DeviceDataCache(cols, ctx=ctx)
-        without.host_columns = {}  # forces the scatter fallback
-
-        def fit(cache):
-            sgd = SGD(max_iter=40, global_batch_size=128, tol=0.0, learning_rate=0.3,
-                      reg=0.01, elastic_net=0.5, ctx=ctx)
-            coef = sgd.optimize(np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE)
-            return coef, sgd.loss_history
-
-        coef_lay, hist_lay = fit(with_layout)
-        coef_sc, hist_sc = fit(without)
-        np.testing.assert_allclose(coef_lay, coef_sc, rtol=1e-5, atol=1e-6)
-        np.testing.assert_allclose(hist_lay, hist_sc, rtol=1e-5)
-        # and the layout was actually built + memoized on the cache
-        assert getattr(with_layout, "_grad_layout", None) is not None
-        assert getattr(without, "_grad_layout", None) is None
-
-
-def test_layout_memoized_across_fits():
-    rng = np.random.default_rng(3)
-    n, d, K = 128, 200, 4
-    cols = {
-        "indices": rng.integers(0, d, size=(n, K)).astype(np.int32),
-        "values": np.ones((n, K), np.float32),
-        "labels": (rng.random(n) > 0.5).astype(np.float32),
-        "weights": np.ones(n, np.float32),
-    }
-    with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
-        cache = DeviceDataCache(cols, ctx=ctx)
-        SGD(max_iter=3, global_batch_size=64, ctx=ctx).optimize(
-            np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
-        )
-        memo = cache._grad_layout
-        SGD(max_iter=3, global_batch_size=64, ctx=ctx).optimize(
-            np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
-        )
-        assert cache._grad_layout is memo  # same object: built once
