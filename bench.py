@@ -194,10 +194,10 @@ def bench_logreg_sparse(peak_flops):
     flops_per_step = 4.0 * batch * K
 
     # Same-semantics CPU step (gather-dot, np.add.at scatter, full coefficient
-    # update, batch-offset cycling), marginal like the TPU number. The step is
-    # scatter-bound on both sides (~10 ns/update through XLA's serialized TPU
-    # scatter), so the gap is structural — a SparseCore/Pallas segment-sum
-    # path is the known next lever.
+    # update, batch-offset cycling), marginal like the TPU number. The TPU
+    # side auto-selects the one-hot matmul path (linalg/onehot_sparse.py,
+    # Pallas crossings) — the step is crossing-bound; docs/benchmarks.md has
+    # the roofline and the multi-chip scaling argument.
     coef = np.zeros(d, np.float32)
     offset = 0
 
@@ -301,9 +301,12 @@ def bench_logreg_sparse_streamed():
     )
 
     def wsteps(iters):
-        SGD(max_iter=iters, global_batch_size=batch, tol=0.0, learning_rate=0.5).optimize(
-            np.zeros(d, np.float32), wcache, BinaryLogisticLoss.INSTANCE
-        )
+        # sparse_kernel="scatter": the streamed program this proxies keeps the
+        # scatter gradient (windows change every visit — no static layout)
+        SGD(
+            max_iter=iters, global_batch_size=batch, tol=0.0, learning_rate=0.5,
+            sparse_kernel="scatter",
+        ).optimize(np.zeros(d, np.float32), wcache, BinaryLogisticLoss.INSTANCE)
 
     t1 = _median_time(lambda: wsteps(10))
     t2 = _median_time(lambda: wsteps(40))

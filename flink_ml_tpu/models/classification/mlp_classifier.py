@@ -98,6 +98,14 @@ class _MlpParams(
         return jnp.bfloat16 if self.get_compute_type() == "bfloat16" else None
 
 
+
+def _mlp_flops_per_epoch(dims, local_batch, n_data):
+    """Matmul FLOPs of one global minibatch epoch (fwd 2 + bwd 4 madds per
+    weight per row) — the dispatch-length cost model shared by the resident
+    and streamed fits."""
+    return 6.0 * local_batch * n_data * sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+
+
 def _init_params(rng: np.random.Generator, dims: List[int]) -> List[Tuple[np.ndarray, np.ndarray]]:
     params = []
     for d_in, d_out in zip(dims[:-1], dims[1:]):
@@ -317,14 +325,10 @@ class MLPClassifier(Estimator, _MlpParams):
         # always run inside one XLA program (scan for maxIter-only, while_loop for
         # the tol criteria evaluated on device).
         max_iter = self.get_max_iter()
-        # fwd 2 + bwd 4 madd-flops per weight per row bounds the dispatch length
-        flops_per_epoch = (
-            6.0
-            * local_batch
-            * ctx.n_data
-            * sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+        chunk = fused_chunk_len(
+            max_iter, check_loss,
+            flops_per_epoch=_mlp_flops_per_epoch(dims, local_batch, ctx.n_data),
         )
-        chunk = fused_chunk_len(max_iter, check_loss, flops_per_epoch=flops_per_epoch)
         fused = self._build_fused(
             ctx,
             optimizer,
@@ -404,10 +408,7 @@ class MLPClassifier(Estimator, _MlpParams):
             max_iter,
             transforms={"y": to_index},
             check_loss=check_loss,
-            flops_per_epoch=6.0
-            * local_batch
-            * ctx.n_data
-            * sum(a * b for a, b in zip(dims[:-1], dims[1:])),
+            flops_per_epoch=_mlp_flops_per_epoch(dims, local_batch, ctx.n_data),
         )
         rng = np.random.default_rng(self.get_seed())
         params = [tuple(jnp.asarray(a) for a in layer) for layer in _init_params(rng, dims)]

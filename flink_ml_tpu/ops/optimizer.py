@@ -143,7 +143,7 @@ def _sgd_epoch_math(
             safe_idx = jnp.where(in_range, local_idx, 0)
             vb_local = jnp.where(in_range, vb, 0.0)
             # flat 1-D gather: 2-D index tensors at this size send the XLA
-            # TPU backend into minutes of compilation (sparse_grad.py note)
+            # TPU backend into minutes of compilation
             gathered = coef[safe_idx.reshape(-1)].reshape(safe_idx.shape)
             dot = jax.lax.psum(jnp.sum(vb_local * gathered, axis=1), MODEL_AXIS)
             loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
@@ -153,8 +153,8 @@ def _sgd_epoch_math(
                 .add((vb_local * mult[:, None]).ravel())
             )
         else:
-            # flat 1-D gather (see sparse_grad.py: 2-D index gathers of this
-            # size cost minutes of XLA TPU compile time; flat is ~1 s)
+            # flat 1-D gather (2-D index gathers of this size cost minutes
+            # of XLA TPU compile time; flat is ~1 s)
             dot = jnp.sum(vb * coef[ib.reshape(-1)].reshape(ib.shape), axis=1)
             loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
             grad_sum = (
@@ -387,6 +387,102 @@ def _fused_sgd_program(
     return program
 
 
+def _fused_onehot_program(
+    ctx: MeshContext,
+    loss_func: LossFunc,
+    layout,
+    chunk_len: int,
+    lr: float,
+    reg: float,
+    elastic_net: float,
+    tol: Optional[float],
+    use_pallas: bool,
+):
+    """A chunk of sparse SGD epochs on the one-hot matmul path — the same
+    scan/done/losses contract as ``_fused_sgd_program``, but the coefficient
+    is carried *permuted* (``OneHotSparseLayout`` class-major blocks) and
+    every per-element gather/scatter is replaced by dense one-hot algebra
+    (linalg/onehot_sparse.py). Per-epoch xs are ``(win_idx, offsets,
+    active)``: the window index selects that minibatch's static layout
+    slice, and ``offsets`` drives the reference's tail-batch gating exactly
+    like the scatter path.
+    """
+    from flink_ml_tpu.linalg.onehot_sparse import onehot_batch_step
+
+    key = (
+        ctx.mesh, loss_func, "onehot", layout.class_meta, layout.n_flat,
+        layout.n_sub, layout.nblk, layout.sub_batch, layout.local_batch,
+        tuple(layout.window_starts), chunk_len, lr, reg, elastic_net, tol,
+        use_pallas,
+    )
+    cached = _FUSED_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    lb = layout.local_batch
+    sub = layout.sub_batch
+    padded_b = layout.n_sub * sub
+    win_starts = jnp.asarray(layout.window_starts, jnp.int32)
+    nblk = layout.nblk
+    class_meta, row_hi = layout.class_meta, layout.row_hi
+
+    def per_shard(coef_perm, done, win_idx, offsets, active, lidx, rhi, rlo, lvals, y, w, mask):
+        lidx, rhi, rlo, lvals = lidx[0], rhi[0], rlo[0], lvals[0]
+
+        def body(carry, sched):
+            cp, done = carry
+            wi, offset, act = sched
+            start = win_starts[wi]
+            sel = lambda a: jax.lax.dynamic_index_in_dim(a, wi, 0, keepdims=False)
+            yb = jax.lax.dynamic_slice_in_dim(y, start, lb)
+            tail_valid = (start + jnp.arange(lb) >= offset).astype(jnp.float32)
+            wb = (
+                jax.lax.dynamic_slice_in_dim(w, start, lb)
+                * jax.lax.dynamic_slice_in_dim(mask, start, lb)
+                * tail_valid
+            )
+            if padded_b > lb:
+                yb = jnp.pad(yb, (0, padded_b - lb))
+                wb = jnp.pad(wb, (0, padded_b - lb))
+            grad, loss_sum, wsum = onehot_batch_step(
+                cp, sel(lidx), sel(rhi), sel(rlo), sel(lvals), yb, wb,
+                loss_func, class_meta, nblk, sub, row_hi, use_pallas,
+            )
+            packed = jnp.concatenate(
+                [grad, jnp.stack([wsum, loss_sum]).astype(grad.dtype)]
+            )
+            packed = jax.lax.psum(packed, DATA_AXIS)
+            grad, weight_sum, loss_sum = packed[:-2], packed[-2], packed[-1]
+            safe_w = jnp.maximum(weight_sum, 1e-30)
+            new_cp = jnp.where(weight_sum > 0, cp - (lr / safe_w) * grad, cp)
+            new_cp, _reg_loss = regularize(new_cp, reg, elastic_net, lr)
+            mean_loss = jnp.where(weight_sum > 0, loss_sum / safe_w, jnp.inf)
+            executed = ~done & act
+            new_cp = jnp.where(executed, new_cp, cp)
+            recorded = jnp.where(executed, mean_loss, jnp.inf)
+            if tol is not None:
+                done = done | (executed & (mean_loss < tol))
+            return (new_cp, done), (recorded, executed)
+
+        (coef_perm, done), (losses, executed) = jax.lax.scan(
+            body, (coef_perm, done), (win_idx, offsets, active)
+        )
+        return coef_perm, done, losses, jnp.sum(executed.astype(jnp.int32))
+
+    data_spec = (P(DATA_AXIS),) * 7  # 4 layout stacks + y/w/mask
+    program = jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=ctx.mesh,
+            in_specs=(P(), P(), P(), P(), P()) + data_spec,
+            out_specs=(P(), P(), P(), P()),
+        ),
+        donate_argnums=(0, 1),
+    )
+    _cache_put(_FUSED_CACHE, key, program)
+    return program
+
+
 class SGD(Optimizer):
     """Distributed minibatch SGD over the data-parallel mesh."""
 
@@ -404,7 +500,13 @@ class SGD(Optimizer):
         checkpoint_interval: int = 0,
         listeners=(),
         stream_window_rows: Optional[int] = None,
+        sparse_kernel: str = "auto",
     ):
+        if sparse_kernel not in ("auto", "onehot", "scatter"):
+            raise ValueError(
+                f"sparse_kernel must be 'auto', 'onehot' or 'scatter', got {sparse_kernel!r}"
+            )
+        self.sparse_kernel = sparse_kernel
         self.max_iter = max_iter
         self.learning_rate = learning_rate
         self.global_batch_size = global_batch_size
@@ -575,14 +677,9 @@ class SGD(Optimizer):
         mask = train_data.mask.astype(self.dtype)
         if sparse:
             data_args = (train_data["indices"], train_data["values"], y, w, mask)
-            # The gradient stays a batch-sized scatter-add. The transposed
-            # dataset-level layout (sparse_grad.py) was measured on chip at
-            # ~6x WORSE than the scatter it replaced (271 ms vs 44 ms per
-            # Criteo-shape step): its per-epoch cost scales with the whole
-            # dataset's nonzeros (~20M gathered slots) while the scatter
-            # touches only the batch (~2.6M), and XLA's in-loop gathers are
-            # just as serialized as its scatters (~7-10 ns/element either
-            # way). docs/benchmarks.md carries the probe data.
+            # Wide coefficients route to the one-hot matmul path above;
+            # this scatter-add remains for narrow models, non-f32 dtypes,
+            # and the model-sharded (TP) layout.
         else:
             feats_dev = train_data["features"]
             if model_sharded:
@@ -599,10 +696,14 @@ class SGD(Optimizer):
             and not self.listeners
         )
         if fused:
+            if self._pick_onehot(sparse, model_sharded, train_data, local_batch, dim):
+                return self._optimize_onehot(
+                    init_model, train_data, loss_func, ctx, local_batch, check_loss, dim
+                )
             # One program runs a chunk of epochs; the host observes the on-device
             # ``done`` flag between chunks (see fused_chunk_len for the policy).
             # sparse epochs: the forward gather + the gradient scatter
-            serial = 2 * local_batch * int(np.asarray(train_data["indices"]).shape[-1]) if sparse else 0
+            serial = 2 * local_batch * int(train_data["indices"].shape[-1]) if sparse else 0
             chunk = fused_chunk_len(self.max_iter, check_loss, serial)
             program = _fused_sgd_program(
                 ctx,
@@ -639,9 +740,132 @@ class SGD(Optimizer):
             final = np.asarray(jax.device_get(coef))
             return final[:dim] if model_sharded else final
 
+        if sparse and self.sparse_kernel == "onehot":
+            raise ValueError(
+                "sparse_kernel='onehot' runs only on the fused path; remove "
+                "checkpoint managers/listeners or use 'auto'"
+            )
         step = self._build_step(
             ctx, loss_func, local_batch, sparse=sparse, model_sharded=model_sharded,
         )
+        return self._optimize_host_loop(
+            init_model, train_data, loss_func, ctx, step, local_batch,
+            check_loss, dim, sparse, model_sharded, data_args,
+        )
+
+    # -- one-hot matmul sparse path ------------------------------------------
+
+    _ONEHOT_MIN_DIM = 1 << 14
+    _ONEHOT_MAX_WINDOWS = 64
+
+    def _pick_onehot(self, sparse, model_sharded, train_data, local_batch, dim) -> bool:
+        """Whether the fused sparse fit runs on the one-hot matmul path
+        (linalg/onehot_sparse.py) instead of gather/scatter instructions.
+
+        ``sparse_kernel='onehot'`` forces it (tests), ``'scatter'`` forbids
+        it; ``'auto'`` picks it for wide coefficients — where XLA's
+        serialized ~7-10 ns/element scatter dominates — with a bounded
+        window set (the static layout is built per distinct minibatch) and
+        host-readable sparse columns to transpose. f32 only: the MXU path
+        carries values as split-bf16 pairs, which reconstruct f32-grade
+        precision but not f64.
+        """
+        if not sparse or self.sparse_kernel == "scatter":
+            return False
+        host = getattr(train_data, "host_columns", None)
+        feasible = (
+            not model_sharded
+            and bool(host)
+            and "indices" in host
+            and jnp.dtype(self.dtype) == jnp.dtype(jnp.float32)
+        )
+        if self.sparse_kernel == "onehot":
+            if not feasible:
+                raise ValueError(
+                    "sparse_kernel='onehot' requires a fused f32 fit on a "
+                    "non-model-sharded mesh with host-readable sparse columns; "
+                    "use 'auto' or 'scatter' for this configuration"
+                )
+            return True
+        n_windows = -(-train_data.local_rows // local_batch)
+        return (
+            feasible
+            and int(train_data["indices"].size) >= 1 << 16
+            and n_windows <= self._ONEHOT_MAX_WINDOWS
+            and dim >= self._ONEHOT_MIN_DIM
+        )
+
+    def _onehot_layout(self, train_data, ctx, dim, local_batch):
+        """Build (once per cache/config) the blocked one-hot layout and its
+        device-resident stacks, memoized like the data itself."""
+        from flink_ml_tpu.linalg.onehot_sparse import OneHotSparseLayout
+
+        key = (ctx.n_data, dim, local_batch)
+        memo = getattr(train_data, "_onehot_memo", None)
+        if memo is not None and memo[0] == key:
+            return memo[1], memo[2]
+        host = train_data.host_columns
+        lay = OneHotSparseLayout.build(
+            host["indices"], host["values"], dim, ctx.n_data, local_batch
+        )
+        sh = ctx.sharding(DATA_AXIS)
+        dev = (
+            jax.device_put(lay.lidx, sh),
+            jax.device_put(lay.rhi, sh),
+            jax.device_put(lay.rlo, sh),
+            jax.device_put(np.asarray(lay.lvals, np.float32), sh),
+        )
+        train_data._onehot_memo = (key, lay, dev)
+        return lay, dev
+
+    def _optimize_onehot(
+        self, init_model, train_data, loss_func, ctx, local_batch, check_loss, dim
+    ):
+        from flink_ml_tpu.linalg.onehot_sparse import BLOCK
+
+        lay, stacks = self._onehot_layout(train_data, ctx, dim, local_batch)
+        use_pallas = all(
+            "TPU" in getattr(d, "device_kind", "") for d in ctx.mesh.devices.flat
+        )
+        # Crossing MACs bound the dispatch length (split-bf16 doubles them).
+        flops = 4.0 * lay.n_sub * lay.n_flat * (lay.sub_batch + 2 * BLOCK)
+        chunk = fused_chunk_len(self.max_iter, check_loss, 0, flops)
+        program = _fused_onehot_program(
+            ctx, loss_func, lay, chunk, self.learning_rate, self.reg,
+            self.elastic_net, self.tol if check_loss else None, use_pallas,
+        )
+        starts, offsets = offset_schedule(
+            train_data.local_rows, local_batch, self.max_iter
+        )
+        win_of = {s: i for i, s in enumerate(lay.window_starts)}
+        win_idx = np.asarray([win_of[int(s)] for s in starts], np.int32)
+        coef = ctx.replicate(
+            lay.permute_coef(np.asarray(init_model, np.float32))
+        )
+        done = ctx.replicate(np.asarray(False))
+        y = train_data["labels"]
+        w = train_data["weights"]
+        mask = train_data.mask.astype(jnp.float32)
+        self.loss_history = []
+        for win_c, offsets_c, active_c, n_active in chunked_schedule(
+            win_idx, offsets, self.max_iter, chunk
+        ):
+            coef, done, losses, n_exec = program(
+                coef, done, win_c, offsets_c, active_c, *stacks, y, w, mask
+            )
+            n = int(jax.device_get(n_exec))
+            chunk_losses = np.asarray(jax.device_get(losses), np.float64)
+            self.loss_history.extend(float(x) for x in chunk_losses[:n])
+            if check_loss and n < n_active:
+                break
+        return lay.unpermute_coef(np.asarray(jax.device_get(coef))).astype(
+            np.asarray(init_model).dtype, copy=False
+        )
+
+    def _optimize_host_loop(
+        self, init_model, train_data, loss_func, ctx, step, local_batch,
+        check_loss, dim, sparse, model_sharded, data_args,
+    ):
 
         if self.checkpoint_manager is not None:
             self.checkpoint_manager.set_fingerprint(
@@ -718,6 +942,12 @@ class SGD(Optimizer):
         local_batch = min(local_batch, -(-n_rows // ctx.n_data))
         row0 = cache.rows(0, 1)
         sparse = "indices" in row0
+        if sparse and self.sparse_kernel == "onehot":
+            raise ValueError(
+                "sparse_kernel='onehot' is not available on the streamed "
+                "(larger-than-HBM) path — windows change every visit, so no "
+                "static layout applies; use 'auto' or 'scatter'"
+            )
         if sparse:
             columns = {
                 "indices": "indices",

@@ -1,7 +1,7 @@
 """Small shared host-side (numpy) array idioms.
 
 These show up wherever a host prep stage builds padded device layouts —
-the sparse-gradient transpose (linalg/sparse_grad.py) and Swing's
+the one-hot sparse transpose (linalg/onehot_sparse.py) and Swing's
 interaction grouping (models/recommendation/swing.py) both bucket by
 power-of-two occupancy and rank elements within sorted groups.
 """
