@@ -113,7 +113,7 @@ def _init_params(rng: np.random.Generator, vocab: int, emb: int, n_classes: int)
     }
 
 
-def _forward(params, tok, n_valid, n_heads: int):
+def _forward(params, tok, n_valid, n_heads: int, flash: bool = False):
     """Logits for token sequences ``tok [B, T_pad]`` with real length
     ``n_valid``. The attention is sequence-sharded: the surrounding shard_map
     splits T over the mesh's data axis, and ``ring_attention`` rotates KV
@@ -125,7 +125,9 @@ def _forward(params, tok, n_valid, n_heads: int):
     q = (h @ params["wq"]).reshape(B, T, n_heads, E // n_heads)
     k = (h @ params["wk"]).reshape(B, T, n_heads, E // n_heads)
     v = (h @ params["wv"]).reshape(B, T, n_heads, E // n_heads)
-    attn = ring_attention(q, k, v, DATA_AXIS, causal=False, n_valid=n_valid)
+    attn = ring_attention(
+        q, k, v, DATA_AXIS, causal=False, n_valid=n_valid, flash=flash
+    )
     a = attn.reshape(B, T, E) @ params["wo"] + h  # residual
     # masked mean-pool over real positions (global position = shard offset +
     # local index, exactly ring_attention's convention)
@@ -138,13 +140,13 @@ def _forward(params, tok, n_valid, n_heads: int):
 
 
 @functools.cache
-def _train_step(mesh, n_heads: int, lr: float):
+def _train_step(mesh, n_heads: int, lr: float, flash: bool = False):
     optimizer = optax.adam(lr)
     seq = P(None, DATA_AXIS)
 
     def per_shard(params, opt_state, tok, y, w, n_valid):
         def loss_fn(p):
-            logits = _forward(p, tok, n_valid, n_heads)
+            logits = _forward(p, tok, n_valid, n_heads, flash)
             losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
             # w zero-weights clamped tail re-reads (the SGD.java:265 short
             # tail batch, same scheme as _sgd_epoch_math's tail_valid)
@@ -171,17 +173,27 @@ def _train_step(mesh, n_heads: int, lr: float):
 
 
 @functools.cache
-def _predict_step(mesh, n_heads: int):
+def _predict_step(mesh, n_heads: int, flash: bool = False):
     seq = P(None, DATA_AXIS)
 
     def per_shard(params, tok, n_valid):
-        logits = _forward(params, tok, n_valid, n_heads)
+        logits = _forward(params, tok, n_valid, n_heads, flash)
         return logits, jax.nn.softmax(logits, axis=-1)
 
     return jax.jit(
         jax.shard_map(
             per_shard, mesh=mesh, in_specs=(P(), seq, P()), out_specs=(P(), P())
         )
+    )
+
+
+def _use_flash(ctx: MeshContext, tok: np.ndarray, emb: int, n_heads: int) -> bool:
+    """Fused-fold gate for this (mesh, sequence) shape — the activations on
+    this path are f32, so only the tiling/VMEM/device conditions apply."""
+    from flink_ml_tpu.parallel.flash import flash_available
+
+    return flash_available(
+        tok.shape[1] // ctx.n_data, emb // n_heads, list(ctx.mesh.devices.flat)
     )
 
 
@@ -210,7 +222,11 @@ class SelfAttentionClassifierModel(Model, _AttnParams):
         tok = np.asarray(df.vectors(self.get_features_col()), np.int32)
         tok, t_real = _pad_tokens(tok, ctx)
         params = {k: jnp.asarray(v) for k, v in self.params.items()}
-        logits, probs = _predict_step(ctx.mesh, self.get_num_heads())(
+        n_heads = self.get_num_heads()
+        emb = int(self.params["emb"].shape[1])
+        logits, probs = _predict_step(
+            ctx.mesh, n_heads, _use_flash(ctx, tok, emb, n_heads)
+        )(
             params, jax.device_put(tok, ctx.sharding(None, DATA_AXIS)),
             jnp.asarray(t_real, jnp.int32),
         )
@@ -278,7 +294,9 @@ class SelfAttentionClassifier(Estimator, _AttnParams):
         params = jax.tree_util.tree_map(
             jnp.asarray, _init_params(rng, vocab, emb, len(labels))
         )
-        optimizer, step = _train_step(ctx.mesh, n_heads, self.get_learning_rate())
+        optimizer, step = _train_step(
+            ctx.mesh, n_heads, self.get_learning_rate(), _use_flash(ctx, tok, emb, n_heads)
+        )
         opt_state = optimizer.init(params)
 
         n = tok.shape[0]

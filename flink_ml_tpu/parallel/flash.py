@@ -1,0 +1,220 @@
+"""Fused (flash-style) local attention block for ring attention.
+
+The ring schedule's hot op is the per-step fold: this shard's queries
+against the currently resident KV block, folded into the streaming-softmax
+accumulator (``parallel/ring.py``). The jnp form materializes the
+``[B, H, Tq, Tk]`` score and probability tensors through HBM every step —
+at long local sequence lengths that traffic, not the matmuls, bounds the
+step.
+
+This module fuses one fold into a Pallas kernel: per ``(batch·head,
+Q-tile)`` grid cell, the scores for the whole resident KV block live only
+in VMEM — matmul, mask, streaming-softmax rescale and the ``p @ v``
+accumulation happen in one pass, and only the ``O(T·D)`` accumulator
+state touches HBM. The numerics replicate the jnp fold exactly: running
+max with ``-inf`` hygiene (rows with nothing attendable yet must not
+produce NaNs), masked positions dropped before the exponential, and the
+same correction factors.
+
+Gradients: ``fused_fold`` carries a ``jax.custom_vjp`` whose backward
+recomputes through the reference jnp fold, so ``jax.grad`` through ring
+attention stays exact while the primal path takes the fused kernel. (The
+backward therefore still materializes scores — a fused backward kernel is
+a further optimization, not a correctness requirement.)
+
+Availability: TPU compiled, or any backend under ``interpret=True``. The
+caller (``ring.py``) falls back to the jnp fold when the local length does
+not tile or the devices have no Mosaic backend.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_fold", "flash_available", "reference_fold", "TQ_TILE"]
+
+TQ_TILE = 256  # Q rows per grid cell
+
+
+_KV_VMEM_BUDGET = 1 << 20  # Tk*D f32 elements the kernel may stage per head
+
+
+def flash_available(T: int, D: int, devices=None) -> bool:
+    """Whether the fused fold applies: Q tiles must divide the local length,
+    one head's KV block must fit the kernel's VMEM staging (the fold brings
+    the whole resident block on-chip; past the budget the jnp fold's
+    streamed HBM form is the right tool), and the devices must be TPUs
+    (Mosaic target)."""
+    if T % TQ_TILE or T * D > _KV_VMEM_BUDGET:
+        return False
+    devs = devices if devices is not None else jax.devices()
+    return bool(devs) and all(
+        "TPU" in getattr(d, "device_kind", "") for d in devs
+    )
+
+
+def reference_fold(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid, scale):
+    """The jnp fold in [B, H, ...] layout (ring.py numerics) — the source of
+    truth the kernel is tested against and the backward recomputes through.
+
+    ``q`` [B, H, Tq, D]; ``kb``/``vb`` [B, H, Tk, D]; ``m``/``l`` [B, H, Tq];
+    ``acc`` [B, H, Tq, D]. ``q_pos0``/``k_pos0`` are the global positions of
+    query/key 0 (traced scalars); ``n_valid`` masks keys at global positions
+    >= it (None = unmasked).
+    """
+    Tq, Tk = q.shape[2], kb.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kb) * scale
+    if causal or n_valid is not None:
+        q_pos = q_pos0 + jnp.arange(Tq)
+        k_pos = k_pos0 + jnp.arange(Tk)
+        mask = jnp.ones((Tq, Tk), bool)
+        if causal:
+            mask &= q_pos[:, None] >= k_pos[None, :]
+        if n_valid is not None:
+            mask &= (k_pos < jnp.asarray(n_valid))[None, :]
+        s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+    block_max = jnp.max(s, axis=-1)
+    new_m = jnp.maximum(m, block_max)
+    safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isneginf(s), 0.0, p)
+    correction = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    new_acc = acc * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+    return new_m, new_l, new_acc
+
+
+def _vma_of(x):
+    try:
+        return jax.typeof(x).vma or None
+    except Exception:
+        return None
+
+
+def _fold_pallas(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid, scale,
+                 interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = kb.shape[2]
+    BH = B * H
+    masked = n_valid is not None
+
+    def kernel(scalars_ref, q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+               mo_ref, lo_ref, ao_ref):
+        j = pl.program_id(1)
+        qt = q_ref[0]  # [TQ, D]
+        s = jax.lax.dot_general(
+            qt, k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [TQ, Tk]
+        if causal or masked:
+            q_pos = (
+                scalars_ref[0] + j * TQ_TILE
+                + jax.lax.broadcasted_iota(jnp.int32, (TQ_TILE, Tk), 0)
+            )
+            k_pos = scalars_ref[1] + jax.lax.broadcasted_iota(
+                jnp.int32, (TQ_TILE, Tk), 1
+            )
+            keep = jnp.ones((TQ_TILE, Tk), bool)
+            if causal:
+                keep &= q_pos >= k_pos
+            if masked:
+                keep &= k_pos < scalars_ref[2]
+            s = jnp.where(keep, s, -jnp.inf)
+        # m/l ride as [TQ, 1] columns (Mosaic wants >= 2-D tiles with an
+        # aligned or full trailing dim); all the math stays 2-D.
+        mcol = m_ref[0]  # [TQ, 1]
+        new_m = jnp.maximum(mcol, jnp.max(s, axis=1, keepdims=True))
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        p = jnp.exp(s - safe_m)
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        correction = jnp.where(jnp.isneginf(mcol), 0.0, jnp.exp(mcol - safe_m))
+        mo_ref[0] = new_m
+        lo_ref[0] = l_ref[0] * correction + jnp.sum(p, axis=1, keepdims=True)
+        ao_ref[0] = acc_ref[0] * correction + jnp.dot(
+            p, v_ref[0], preferred_element_type=jnp.float32
+        )
+
+    scalars = jnp.stack(
+        [
+            jnp.asarray(q_pos0, jnp.int32),
+            jnp.asarray(k_pos0, jnp.int32),
+            jnp.asarray(0 if n_valid is None else n_valid, jnp.int32),
+        ]
+    )
+    tile2 = pl.BlockSpec(
+        (1, TQ_TILE, 1), lambda i, j, *_: (i, j, 0), memory_space=pltpu.VMEM
+    )
+    tile3 = pl.BlockSpec(
+        (1, TQ_TILE, D), lambda i, j, *_: (i, j, 0), memory_space=pltpu.VMEM
+    )
+    full3 = pl.BlockSpec((1, Tk, D), lambda i, j, *_: (i, 0, 0), memory_space=pltpu.VMEM)
+    vma = _vma_of(q)
+    mo, lo, ao = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, Tq // TQ_TILE),
+            in_specs=[tile3, full3, full3, tile2, tile2, tile3],
+            out_specs=[tile2, tile2, tile3],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((BH, Tq, D), jnp.float32, vma=vma),
+        ],
+        interpret=interpret,
+    )(
+        scalars,
+        q.reshape(BH, Tq, D),
+        kb.reshape(BH, Tk, D),
+        vb.reshape(BH, Tk, D),
+        m.reshape(BH, Tq, 1),
+        l.reshape(BH, Tq, 1),
+        acc.reshape(BH, Tq, D),
+    )
+    return mo.reshape(B, H, Tq), lo.reshape(B, H, Tq), ao.reshape(B, H, Tq, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 11, 12))
+def fused_fold(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, has_n_valid,
+               n_valid, scale, interpret=False):
+    """One ring-attention fold, fused. Same contract as ``reference_fold``
+    (``n_valid`` is a traced scalar consumed only when ``has_n_valid``);
+    the primal runs the Pallas kernel, gradients recompute through the jnp
+    fold. ``causal``/``has_n_valid``/``scale``/``interpret`` are static.
+    """
+    return _fold_pallas(
+        q, kb, vb, m, l, acc, q_pos0, k_pos0, causal,
+        n_valid if has_n_valid else None, scale, interpret=interpret,
+    )
+
+
+def _fused_fold_fwd(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, has_n_valid,
+                    n_valid, scale, interpret=False):
+    out = fused_fold(
+        q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, has_n_valid, n_valid,
+        scale, interpret,
+    )
+    return out, (q, kb, vb, m, l, acc, q_pos0, k_pos0, n_valid)
+
+
+def _fused_fold_bwd(causal, has_n_valid, scale, interpret, res, g):
+    q, kb, vb, m, l, acc, q_pos0, k_pos0, n_valid = res
+    _, vjp = jax.vjp(
+        lambda q_, kb_, vb_, m_, l_, acc_: reference_fold(
+            q_, kb_, vb_, m_, l_, acc_, q_pos0, k_pos0, causal,
+            n_valid if has_n_valid else None, scale,
+        ),
+        q, kb, vb, m, l, acc,
+    )
+    dq, dkb, dvb, dm, dl, dacc = vjp(g)
+    # integer position/count args carry no cotangent
+    return dq, dkb, dvb, dm, dl, dacc, None, None, None
+
+
+fused_fold.defvjp(_fused_fold_fwd, _fused_fold_bwd)

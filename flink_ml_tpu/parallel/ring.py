@@ -38,7 +38,10 @@ from flink_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext, get_mesh_context
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False, n_valid: int = None):
+def ring_attention(
+    q, k, v, axis_name: str, causal: bool = False, n_valid: int = None,
+    flash: bool = False,
+):
     """Attention for sequence-sharded q/k/v, inside a ``shard_map``.
 
     ``q, k, v``: [B, T_local, H, D] — this shard's slice of the sequence.
@@ -49,40 +52,69 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, n_valid: int =
     masks out key positions >= it — REQUIRED when the sequence was padded
     and ``causal`` is off, or padded keys would receive softmax weight in
     every real row.
+
+    With ``flash`` the per-step fold runs as the fused Pallas kernel
+    (``parallel/flash.py``): scores never touch HBM on the primal path,
+    gradients recompute through the jnp fold. Callers should gate it with
+    ``flash_available`` (tiling + TPU backend).
     """
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, T, H, D = q.shape
     scale = 1.0 / np.sqrt(D)
-    q_pos = my_idx * T + jnp.arange(T)  # global positions of this shard's Q
 
-    def fold(m, l, acc, kb, vb, step_idx):
-        """Fold the resident KV block into the streaming-softmax accumulator.
-        The block resident at step s started at shard (my_idx - s) mod n."""
-        src = (my_idx - step_idx) % n
-        # scores: [B, H, Tq, Tk] via one MXU matmul per (B, H)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kb) * scale
-        if causal or n_valid is not None:
-            k_pos = src * T + jnp.arange(T)
-            mask = jnp.ones((T, T), bool)
-            if causal:
-                mask &= q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
-            if n_valid is not None:
-                # n_valid may be a traced scalar: one compiled program serves
-                # every real length of a padded-sequence workload
-                mask &= (k_pos < jnp.asarray(n_valid))[None, :]
-            s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
-        # flash-attention-style streaming softmax
-        block_max = jnp.max(s, axis=-1)  # [B, H, Tq]
-        new_m = jnp.maximum(m, block_max)
-        # -inf rows (nothing attendable yet) must not produce NaNs
-        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
-        p = jnp.exp(s - safe_m[..., None])  # [B, H, Tq, Tk]
-        p = jnp.where(jnp.isneginf(s), 0.0, p)
-        correction = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
-        l = l * correction + jnp.sum(p, axis=-1)
-        acc = acc * correction[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vb)
-        return new_m, l, acc
+    if flash:
+        # Tensors ride the ring in [B, H, T, D] layout (one transpose in,
+        # one out) so every fold is a straight kernel call.
+        from flink_ml_tpu.parallel.flash import fused_fold
+
+        q_t = jnp.transpose(q, (0, 2, 1, 3))
+        k_c = jnp.transpose(k, (0, 2, 1, 3))
+        v_c = jnp.transpose(v, (0, 2, 1, 3))
+        has_nv = n_valid is not None
+        nv = jnp.asarray(0 if n_valid is None else n_valid, jnp.int32)
+
+        def fold(m, l, acc, kb, vb, step_idx):
+            src = (my_idx - step_idx) % n
+            return fused_fold(
+                q_t, kb, vb, m, l, acc, my_idx * T, src * T, causal, has_nv,
+                nv, scale,
+            )
+
+    else:
+        k_c, v_c = k, v  # [B, Tk, H, D] — the einsum consumes them directly
+        q_pos = my_idx * T + jnp.arange(T)  # global positions of this shard's Q
+
+        def fold(m, l, acc, kb, vb, step_idx):
+            """Fold the resident KV block into the streaming-softmax
+            accumulator. The block resident at step s started at shard
+            (my_idx - s) mod n."""
+            src = (my_idx - step_idx) % n
+            # scores: [B, H, Tq, Tk] via one MXU matmul per (B, H)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, kb) * scale
+            if causal or n_valid is not None:
+                k_pos = src * T + jnp.arange(T)
+                mask = jnp.ones((T, T), bool)
+                if causal:
+                    mask &= q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+                if n_valid is not None:
+                    # n_valid may be a traced scalar: one compiled program
+                    # serves every real length of a padded-sequence workload
+                    mask &= (k_pos < jnp.asarray(n_valid))[None, :]
+                s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+            # flash-attention-style streaming softmax
+            block_max = jnp.max(s, axis=-1)  # [B, H, Tq]
+            new_m = jnp.maximum(m, block_max)
+            # -inf rows (nothing attendable yet) must not produce NaNs
+            safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+            p = jnp.exp(s - safe_m[..., None])  # [B, H, Tq, Tk]
+            p = jnp.where(jnp.isneginf(s), 0.0, p)
+            correction = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+            l = l * correction + jnp.sum(p, axis=-1)
+            acc = acc * correction[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, vb
+            )
+            return new_m, l, acc
 
     def step(carry, step_idx):
         kb, vb, m, l, acc = carry
@@ -101,7 +133,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, n_valid: int =
     # n-1 rotations suffice: the last resident block folds without being
     # rotated back to its origin (that final exchange would be dead traffic).
     (kb, vb, m, l, acc), _ = jax.lax.scan(
-        step, (k, v, m0, l0, acc0), jnp.arange(n - 1)
+        step, (k_c, v_c, m0, l0, acc0), jnp.arange(n - 1)
     )
     m, l, acc = fold(m, l, acc, kb, vb, n - 1)
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, H, Tq, D]
@@ -109,13 +141,15 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, n_valid: int =
 
 
 @functools.cache
-def _sharded_program(mesh, causal: bool, masked: bool):
+def _sharded_program(mesh, causal: bool, masked: bool, flash: bool = False):
     spec = P(None, DATA_AXIS)  # [B, T, H, D] sharded over the sequence dim
     if masked:
         # n_valid arrives as a traced replicated scalar, so ONE compiled
         # program serves every real length of a padded-sequence workload.
         def per_shard(q, k, v, n_valid):
-            return ring_attention(q, k, v, DATA_AXIS, causal=causal, n_valid=n_valid)
+            return ring_attention(
+                q, k, v, DATA_AXIS, causal=causal, n_valid=n_valid, flash=flash
+            )
 
         return jax.jit(
             jax.shard_map(
@@ -124,7 +158,7 @@ def _sharded_program(mesh, causal: bool, masked: bool):
         )
 
     def per_shard(q, k, v):
-        return ring_attention(q, k, v, DATA_AXIS, causal=causal)
+        return ring_attention(q, k, v, DATA_AXIS, causal=causal, flash=flash)
 
     return jax.jit(
         jax.shard_map(
@@ -149,8 +183,15 @@ def ring_attention_sharded(
             f"sequence length {T} not divisible by mesh axis {ctx.n_data}; "
             "pad the sequence and pass n_valid"
         )
+    from flink_ml_tpu.parallel.flash import flash_available
+
+    # f32 only: the fused fold's accumulators are f32 (the jnp path keeps
+    # the input dtype), so other dtypes stay on the jnp fold.
+    flash = flash_available(
+        T // ctx.n_data, int(np.shape(q)[3]), list(ctx.mesh.devices.flat)
+    ) and np.dtype(getattr(q, "dtype", np.float32)) == np.dtype(np.float32)
     if n_valid is None:
-        return _sharded_program(ctx.mesh, causal, False)(q, k, v)
-    return _sharded_program(ctx.mesh, causal, True)(
+        return _sharded_program(ctx.mesh, causal, False, flash)(q, k, v)
+    return _sharded_program(ctx.mesh, causal, True, flash)(
         q, k, v, jnp.asarray(n_valid, jnp.int32)
     )
