@@ -423,7 +423,7 @@ def bench_attention(peak_flops):
     v = jax.device_put(rng.standard_normal((B, T, H, D)).astype(np.float32))
 
     def timed(flash):
-        prog = _sharded_program(ctx.mesh, True, False, flash=flash)
+        prog = _sharded_program(ctx.mesh, True, False, flash)
         float(prog(q, k, v)[0, 0, 0, 0])  # warm-up (scalar fetch = barrier)
 
         def total(reps):

@@ -34,15 +34,17 @@ be replaced by dense one-hot algebra the MXU/VPU execute at full width:
   sub-gradients before the single update — bit-for-bit the same SGD step,
   with the crossing width (and its one-hot bytes) shrunk by
   ``batch / SUB_ROWS``. The sub size balances per-entry crossing cost
-  (~sqrt of the sub's row space) against per-invocation floors.
+  (~sqrt of the sub's row space) against padding (fewer rows per sub means
+  sparser blocks and more pow2 padding); 16384 measured best of
+  {8192, 16384, 32768} at the Criteo shape.
 
 The crossings run two ways: a pure-XLA form (works on any backend;
 one-hots are materialized through HBM) and Pallas kernels (TPU only;
 one-hots are built tile-by-tile in VMEM and never touch HBM), selected by
 ``use_pallas``. Measured on one v5e chip at the Criteo shape (2^22
-features, 39 nnz/row, batch 65536): 27.9 ms/step — 1.8x the scatter path
-it replaces; the remaining cost is crossing-bound (see docs/benchmarks.md
-for the roofline and the multi-chip scaling argument).
+features, 39 nnz/row, batch 65536): 27-32 ms/step across runs — ~1.8x the
+scatter path it replaces; the remaining cost is crossing-bound (see
+docs/benchmarks.md for the roofline and the multi-chip scaling argument).
 """
 from __future__ import annotations
 
@@ -232,7 +234,8 @@ def _lane_onehot(ids, width, dtype=jnp.bfloat16):
 
 
 def gather_round(coef_perm, lidx, class_meta):
-    """Per-entry coefficient read, g[e] = coef_perm[block(e)*BLOCK + lidx[e]].
+    """Per-entry coefficient read, g[e] = coef_perm[block(e)*BLOCK + lidx[e]],
+    for every sub-batch at once (``lidx`` [n_sub, n_flat] -> [n_sub, n_flat]).
 
     Per occupancy class: a 128-lane one-hot times the class's contiguous
     coefficient rows (a static slice — the class-major permutation exists
@@ -243,25 +246,36 @@ def gather_round(coef_perm, lidx, class_meta):
     """
     parts = []
     c2 = coef_perm.reshape(-1, BLOCK)
+    n_sub = lidx.shape[0]
     for f_c, wdt, off, b0 in class_meta:
         rows = jax.lax.slice_in_dim(c2, b0, b0 + f_c)  # [f_c, BLOCK]
-        ids = jax.lax.slice_in_dim(lidx, off, off + f_c * wdt).reshape(f_c, wdt)
-        oh = _lane_onehot(ids, BLOCK, jnp.float32)
-        parts.append(jnp.sum(oh * rows[:, None, :], axis=2).reshape(-1))
-    return jnp.concatenate(parts)
+        ids = jax.lax.slice_in_dim(lidx, off, off + f_c * wdt, axis=1).reshape(
+            n_sub, f_c, wdt
+        )
+        oh = _lane_onehot(ids, BLOCK, jnp.float32)  # [n_sub, f_c, wdt, BLOCK]
+        parts.append(
+            jnp.sum(oh * rows[None, :, None, :], axis=3).reshape(n_sub, -1)
+        )
+    return jnp.concatenate(parts, axis=1)
 
 
 def scatter_round(u, lidx, class_meta, nblk):
     """Transposed gather_round: per-entry values summed into the permuted
-    gradient, grad_perm[block*BLOCK + lane] = sum of that lane's entries —
-    the same exact-f32 VPU broadcast-sum form, reduced over the width dim."""
+    gradient across every sub-batch (``u``/``lidx`` [n_sub, n_flat] ->
+    [nblk * BLOCK]) — the same exact-f32 VPU broadcast-sum form, reduced
+    over the sub and width dims (the gradient accumulation)."""
     c2 = jnp.zeros((nblk, BLOCK), jnp.float32)
+    n_sub = u.shape[0]
     for f_c, wdt, off, b0 in class_meta:
-        ids = jax.lax.slice_in_dim(lidx, off, off + f_c * wdt).reshape(f_c, wdt)
-        vals = jax.lax.slice_in_dim(u, off, off + f_c * wdt).reshape(f_c, wdt)
+        ids = jax.lax.slice_in_dim(lidx, off, off + f_c * wdt, axis=1).reshape(
+            n_sub, f_c, wdt
+        )
+        vals = jax.lax.slice_in_dim(u, off, off + f_c * wdt, axis=1).reshape(
+            n_sub, f_c, wdt
+        )
         oh = _lane_onehot(ids, BLOCK, jnp.float32)
         c2 = jax.lax.dynamic_update_slice(
-            c2, jnp.sum(oh * vals[..., None], axis=1), (b0, 0)
+            c2, jnp.sum(oh * vals[..., None], axis=(0, 2)), (b0, 0)
         )
     return c2.reshape(-1)
 
@@ -273,27 +287,32 @@ def _row_onehots(rhi, rlo, row_hi, dtype=jnp.bfloat16):
 
 
 def dot_crossing_xla(q, rhi, rlo, row_hi):
-    """Row sums: dot2d[h, l] = sum of q over entries with row (h, l)."""
+    """Row sums per sub-batch: dot3[s, h, l] = sum of q[s] over entries with
+    row (h, l). ``q/rhi/rlo`` [n_sub, n] -> [n_sub, row_hi, 128]."""
     oh_hi, oh_lo = _row_onehots(rhi, rlo, row_hi)
     q_hi, q_lo = _split_bf16(q)
-    dims = (((0,), (0,)), ((), ()))
+    dims = (((1,), (1,)), ((0,), (0,)))  # contract entries, batch subs
     # The halves MUST ride separate matmuls: summing bf16 rhs terms first
     # would round the low half away and forfeit the split's precision.
     return jax.lax.dot_general(
-        oh_hi, oh_lo * q_hi[:, None], dims, preferred_element_type=jnp.float32
+        oh_hi, oh_lo * q_hi[..., None], dims, preferred_element_type=jnp.float32
     ) + jax.lax.dot_general(
-        oh_hi, oh_lo * q_lo[:, None], dims, preferred_element_type=jnp.float32
-    )  # [row_hi, 128]
+        oh_hi, oh_lo * q_lo[..., None], dims, preferred_element_type=jnp.float32
+    )  # [n_sub, row_hi, 128]
 
 
-def mult_crossing_xla(mult2d, rhi, rlo, row_hi):
-    """Per-entry row broadcast: u[e] = mult2d[rhi[e], rlo[e]]."""
+def mult_crossing_xla(mult3, rhi, rlo, row_hi):
+    """Per-entry row broadcast per sub-batch: u[s, e] = mult3[s, rhi, rlo].
+    ``mult3`` [n_sub, row_hi, 128]; ``rhi/rlo`` [n_sub, n] -> [n_sub, n]."""
     oh_hi, oh_lo = _row_onehots(rhi, rlo, row_hi)
-    m_hi, m_lo = _split_bf16(mult2d)
-    rowvecs = jnp.dot(
-        oh_hi, m_hi, preferred_element_type=jnp.float32
-    ) + jnp.dot(oh_hi, m_lo, preferred_element_type=jnp.float32)  # [N, 128]
-    return jnp.sum(rowvecs * oh_lo.astype(jnp.float32), axis=1)
+    m_hi, m_lo = _split_bf16(mult3)
+    dims = (((2,), (1,)), ((0,), (0,)))  # contract row_hi, batch subs
+    rowvecs = jax.lax.dot_general(
+        oh_hi, m_hi, dims, preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        oh_hi, m_lo, dims, preferred_element_type=jnp.float32
+    )  # [n_sub, n, 128]
+    return jnp.sum(rowvecs * oh_lo.astype(jnp.float32), axis=2)
 
 
 # ---------------------------------------------------------------------------
@@ -316,13 +335,13 @@ def dot_crossing_pallas(q, rhi, rlo, row_hi, interpret: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    n = q.shape[0]
+    n_sub, n = q.shape
     tile = min(_CROSS_TILE, n)
     if n % tile:  # pad to a whole number of tiles (q=0 contributes nothing)
         pad = tile - n % tile
-        q = jnp.pad(q, (0, pad))
-        rhi = jnp.pad(rhi, (0, pad))
-        rlo = jnp.pad(rlo, (0, pad))
+        q = jnp.pad(q, ((0, 0), (0, pad)))
+        rhi = jnp.pad(rhi, ((0, 0), (0, pad)))
+        rlo = jnp.pad(rlo, ((0, 0), (0, pad)))
         n += pad
 
     def kernel(hi_ref, lo_ref, q_ref, o_ref):
@@ -342,44 +361,51 @@ def dot_crossing_pallas(q, rhi, rlo, row_hi, interpret: bool = False):
         dims = (((0,), (0,)), ((), ()))
         # separate matmuls per split half (summing bf16 rhs first would
         # round the low half away)
-        o_ref[0] = jax.lax.dot_general(
+        o_ref[0, 0] = jax.lax.dot_general(
             oh_hi, oh_lo * q_hi, dims, preferred_element_type=jnp.float32
         ) + jax.lax.dot_general(
             oh_hi, oh_lo * q_lo, dims, preferred_element_type=jnp.float32
         )
 
+    # Inputs ride flat 1-D (Mosaic's tiling rules reject (1, tile) blocks);
+    # the 2-D grid recovers the sub index through the index map arithmetic.
+    ntiles = n // tile
+    row = pl.BlockSpec(
+        (tile,), lambda i, k: (i * ntiles + k,), memory_space=pltpu.VMEM
+    )
     parts = pl.pallas_call(
         kernel,
-        grid=(n // tile,),
-        in_specs=[pl.BlockSpec((tile,), lambda k: (k,), memory_space=pltpu.VMEM)] * 3,
+        grid=(n_sub, ntiles),
+        in_specs=[row, row, row],
         out_specs=pl.BlockSpec(
-            (1, row_hi, _ROW_LO), lambda k: (k, 0, 0), memory_space=pltpu.VMEM
+            (1, 1, row_hi, _ROW_LO), lambda i, k: (i, k, 0, 0),
+            memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (n // tile, row_hi, _ROW_LO), jnp.float32, vma=_vma_of(q)
+            (n_sub, ntiles, row_hi, _ROW_LO), jnp.float32, vma=_vma_of(q)
         ),
         interpret=interpret,
-    )(rhi, rlo, q)
-    return jnp.sum(parts, axis=0)
+    )(rhi.reshape(-1), rlo.reshape(-1), q.reshape(-1))
+    return jnp.sum(parts, axis=1)
 
 
-def mult_crossing_pallas(mult2d, rhi, rlo, row_hi, interpret: bool = False):
+def mult_crossing_pallas(mult3, rhi, rlo, row_hi, interpret: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    n = rhi.shape[0]
+    n_sub, n = rhi.shape
     tile = min(_CROSS_TILE, n)
     pad = (tile - n % tile) % tile
     if pad:
-        rhi = jnp.pad(rhi, (0, pad))
-        rlo = jnp.pad(rlo, (0, pad))
+        rhi = jnp.pad(rhi, ((0, 0), (0, pad)))
+        rlo = jnp.pad(rlo, ((0, 0), (0, pad)))
 
     def kernel(m_ref, hi_ref, lo_ref, o_ref):
         oh_hi = (
             hi_ref[:][:, None]
             == jax.lax.broadcasted_iota(jnp.int32, (tile, row_hi), 1)
         ).astype(jnp.bfloat16)
-        m2 = m_ref[:]
+        m2 = m_ref[0]
         m_hi = m2.astype(jnp.bfloat16)
         m_lo = (m2 - m_hi.astype(jnp.float32)).astype(jnp.bfloat16)
         rowvecs = jnp.dot(
@@ -391,19 +417,29 @@ def mult_crossing_pallas(mult2d, rhi, rlo, row_hi, interpret: bool = False):
         ).astype(jnp.float32)
         o_ref[:] = jnp.sum(rowvecs * oh_lo, axis=1)
 
+    # flat 1-D entry arrays + 2-D grid (see dot_crossing_pallas)
+    ntiles = (n + pad) // tile
+    row = pl.BlockSpec(
+        (tile,), lambda i, k: (i * ntiles + k,), memory_space=pltpu.VMEM
+    )
     out = pl.pallas_call(
         kernel,
-        grid=((n + pad) // tile,),
+        grid=(n_sub, ntiles),
         in_specs=[
-            pl.BlockSpec((row_hi, _ROW_LO), lambda k: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile,), lambda k: (k,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile,), lambda k: (k,), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, row_hi, _ROW_LO), lambda i, k: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            row,
+            row,
         ],
-        out_specs=pl.BlockSpec((tile,), lambda k: (k,), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n + pad,), jnp.float32, vma=_vma_of(rhi)),
+        out_specs=row,
+        out_shape=jax.ShapeDtypeStruct(
+            (n_sub * (n + pad),), jnp.float32, vma=_vma_of(rhi)
+        ),
         interpret=interpret,
-    )(mult2d, rhi, rlo)
-    return out[:n]
+    )(mult3, rhi.reshape(-1), rlo.reshape(-1))
+    return out.reshape(n_sub, n + pad)[:, :n]
 
 
 def onehot_batch_step(
@@ -433,20 +469,18 @@ def onehot_batch_step(
     dot_cross = dot_crossing_pallas if use_pallas else dot_crossing_xla
     mult_cross = mult_crossing_pallas if use_pallas else mult_crossing_xla
     n_sub = lidx_w.shape[0]
-    grad = jnp.zeros(nblk * BLOCK, jnp.float32)
-    loss_sum = jnp.asarray(0.0, jnp.float32)
-    for s in range(n_sub):  # unrolled: all sub-batches fuse into one program
-        li, hi, lo, lv = lidx_w[s], rhi_w[s], rlo_w[s], lvals_w[s]
-        g = gather_round(coef_perm, li, class_meta)
-        q = lv * g
-        dot2d = dot_cross(q, hi, lo, row_hi)
-        y_s = jax.lax.dynamic_slice_in_dim(yb, s * sub_batch, sub_batch)
-        w_s = jax.lax.dynamic_slice_in_dim(wb, s * sub_batch, sub_batch)
-        l_s, mult = loss_func.loss_and_mult(
-            dot2d.reshape(-1)[:sub_batch], y_s, w_s
-        )
-        m2 = jnp.pad(mult, (0, row_hi * _ROW_LO - sub_batch)).reshape(row_hi, _ROW_LO)
-        u = lv * mult_cross(m2, hi, lo, row_hi)
-        grad = grad + scatter_round(u, li, class_meta, nblk)
-        loss_sum = loss_sum + l_s
+    # Every stage processes ALL sub-batches in one invocation (the sub axis
+    # is just a leading batch dim) — per-invocation floors, not per-entry
+    # work, dominated the per-sub form (measured).
+    g = gather_round(coef_perm, lidx_w, class_meta)  # [n_sub, n_flat]
+    q = lvals_w * g
+    dot3 = dot_cross(q, rhi_w, rlo_w, row_hi)  # [n_sub, row_hi, 128]
+    dot = dot3.reshape(n_sub, row_hi * _ROW_LO)[:, :sub_batch].reshape(-1)
+    loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
+    mult3 = jnp.pad(
+        mult.reshape(n_sub, sub_batch),
+        ((0, 0), (0, row_hi * _ROW_LO - sub_batch)),
+    ).reshape(n_sub, row_hi, _ROW_LO)
+    u = lvals_w * mult_cross(mult3, rhi_w, rlo_w, row_hi)
+    grad = scatter_round(u, lidx_w, class_meta, nblk)
     return grad, loss_sum, jnp.sum(wb)

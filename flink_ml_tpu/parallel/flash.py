@@ -39,15 +39,16 @@ TQ_TILE = 256  # Q rows per grid cell
 
 
 _KV_VMEM_BUDGET = 1 << 20  # Tk*D f32 elements the kernel may stage per head
+_TK_MAX = 16384  # score/probability buffers are [TQ_TILE, Tk] f32 in VMEM
 
 
 def flash_available(T: int, D: int, devices=None) -> bool:
     """Whether the fused fold applies: Q tiles must divide the local length,
-    one head's KV block must fit the kernel's VMEM staging (the fold brings
-    the whole resident block on-chip; past the budget the jnp fold's
-    streamed HBM form is the right tool), and the devices must be TPUs
-    (Mosaic target)."""
-    if T % TQ_TILE or T * D > _KV_VMEM_BUDGET:
+    one head's KV block AND the [TQ_TILE, Tk] score/probability buffers must
+    fit the kernel's VMEM staging (the fold brings the whole resident block
+    on-chip; past either budget the jnp fold's streamed HBM form is the
+    right tool), and the devices must be TPUs (Mosaic target)."""
+    if T % TQ_TILE or T * D > _KV_VMEM_BUDGET or T > _TK_MAX:
         return False
     devs = devices if devices is not None else jax.devices()
     return bool(devs) and all(

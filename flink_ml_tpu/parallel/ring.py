@@ -63,17 +63,19 @@ def ring_attention(
     B, T, H, D = q.shape
     scale = 1.0 / np.sqrt(D)
 
+    from flink_ml_tpu.parallel.flash import fused_fold, reference_fold
+
+    # Tensors ride the ring in [B, H, T, D] layout (one transpose in, one
+    # out); both folds share reference_fold's contract, so the jnp numerics
+    # have a single source of truth (flash recomputes its gradients through
+    # the same function).
+    q_t = jnp.transpose(q, (0, 2, 1, 3))
+    k_c = jnp.transpose(k, (0, 2, 1, 3))
+    v_c = jnp.transpose(v, (0, 2, 1, 3))
+    has_nv = n_valid is not None
+    nv = jnp.asarray(0 if n_valid is None else n_valid, jnp.int32)
+
     if flash:
-        # Tensors ride the ring in [B, H, T, D] layout (one transpose in,
-        # one out) so every fold is a straight kernel call.
-        from flink_ml_tpu.parallel.flash import fused_fold
-
-        q_t = jnp.transpose(q, (0, 2, 1, 3))
-        k_c = jnp.transpose(k, (0, 2, 1, 3))
-        v_c = jnp.transpose(v, (0, 2, 1, 3))
-        has_nv = n_valid is not None
-        nv = jnp.asarray(0 if n_valid is None else n_valid, jnp.int32)
-
         def fold(m, l, acc, kb, vb, step_idx):
             src = (my_idx - step_idx) % n
             return fused_fold(
@@ -82,39 +84,12 @@ def ring_attention(
             )
 
     else:
-        k_c, v_c = k, v  # [B, Tk, H, D] — the einsum consumes them directly
-        q_pos = my_idx * T + jnp.arange(T)  # global positions of this shard's Q
-
         def fold(m, l, acc, kb, vb, step_idx):
-            """Fold the resident KV block into the streaming-softmax
-            accumulator. The block resident at step s started at shard
-            (my_idx - s) mod n."""
             src = (my_idx - step_idx) % n
-            # scores: [B, H, Tq, Tk] via one MXU matmul per (B, H)
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, kb) * scale
-            if causal or n_valid is not None:
-                k_pos = src * T + jnp.arange(T)
-                mask = jnp.ones((T, T), bool)
-                if causal:
-                    mask &= q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
-                if n_valid is not None:
-                    # n_valid may be a traced scalar: one compiled program
-                    # serves every real length of a padded-sequence workload
-                    mask &= (k_pos < jnp.asarray(n_valid))[None, :]
-                s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
-            # flash-attention-style streaming softmax
-            block_max = jnp.max(s, axis=-1)  # [B, H, Tq]
-            new_m = jnp.maximum(m, block_max)
-            # -inf rows (nothing attendable yet) must not produce NaNs
-            safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
-            p = jnp.exp(s - safe_m[..., None])  # [B, H, Tq, Tk]
-            p = jnp.where(jnp.isneginf(s), 0.0, p)
-            correction = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
-            l = l * correction + jnp.sum(p, axis=-1)
-            acc = acc * correction[..., None] + jnp.einsum(
-                "bhqk,bkhd->bhqd", p, vb
+            return reference_fold(
+                q_t, kb, vb, m, l, acc, my_idx * T, src * T, causal,
+                nv if has_nv else None, scale,
             )
-            return new_m, l, acc
 
     def step(carry, step_idx):
         kb, vb, m, l, acc = carry
@@ -141,7 +116,7 @@ def ring_attention(
 
 
 @functools.cache
-def _sharded_program(mesh, causal: bool, masked: bool, flash: bool = False):
+def _sharded_program(mesh, causal: bool, masked: bool, flash: bool):
     spec = P(None, DATA_AXIS)  # [B, T, H, D] sharded over the sequence dim
     if masked:
         # n_valid arrives as a traced replicated scalar, so ONE compiled

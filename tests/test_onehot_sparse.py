@@ -105,19 +105,19 @@ class TestBatchStep:
 class TestCrossings:
     def test_pallas_interpret_matches_xla(self):
         rng = np.random.default_rng(3)
-        n, row_hi = 5000, 4  # 512-row space
-        rhi = jnp.asarray(rng.integers(0, row_hi, n, dtype=np.int32))
-        rlo = jnp.asarray(rng.integers(0, 128, n, dtype=np.int32))
-        q = jnp.asarray(rng.normal(size=n).astype(np.float32))
-        m2 = jnp.asarray(rng.normal(size=(row_hi, 128)).astype(np.float32))
+        n_sub, n, row_hi = 3, 5000, 4  # 512-row space per sub-batch
+        rhi = jnp.asarray(rng.integers(0, row_hi, (n_sub, n), dtype=np.int32))
+        rlo = jnp.asarray(rng.integers(0, 128, (n_sub, n), dtype=np.int32))
+        q = jnp.asarray(rng.normal(size=(n_sub, n)).astype(np.float32))
+        m3 = jnp.asarray(rng.normal(size=(n_sub, row_hi, 128)).astype(np.float32))
         np.testing.assert_allclose(
             np.asarray(dot_crossing_pallas(q, rhi, rlo, row_hi, interpret=True)),
             np.asarray(dot_crossing_xla(q, rhi, rlo, row_hi)),
             rtol=1e-5, atol=1e-5,
         )
         np.testing.assert_allclose(
-            np.asarray(mult_crossing_pallas(m2, rhi, rlo, row_hi, interpret=True)),
-            np.asarray(mult_crossing_xla(m2, rhi, rlo, row_hi)),
+            np.asarray(mult_crossing_pallas(m3, rhi, rlo, row_hi, interpret=True)),
+            np.asarray(mult_crossing_xla(m3, rhi, rlo, row_hi)),
             rtol=1e-5, atol=1e-5,
         )
 

@@ -83,7 +83,7 @@ def test_gradients_match_dense_attention():
     k = jnp.asarray(rng.standard_normal((B, T, H, D)).astype(np.float32))
     v = jnp.asarray(rng.standard_normal((B, T, H, D)).astype(np.float32))
     ctx = get_mesh_context()
-    program = _sharded_program(ctx.mesh, True, False)
+    program = _sharded_program(ctx.mesh, True, False, False)
 
     def ring_loss(q, k, v):
         return jnp.sum(program(q, k, v) ** 2)
@@ -123,7 +123,7 @@ class TestFlashFold:
         v = rng.standard_normal((B, T, H, D)).astype(np.float32)
         with pltpu.force_tpu_interpret_mode():
             got = np.asarray(
-                _sharded_program(ctx.mesh, True, False, flash=True)(q, k, v)
+                _sharded_program(ctx.mesh, True, False, True)(q, k, v)
             )
         want = _dense_attention(q, k, v, causal=True)
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
@@ -145,7 +145,7 @@ class TestFlashFold:
         v = rng.standard_normal((B, T, H, D)).astype(np.float32)
         with pltpu.force_tpu_interpret_mode():
             got = np.asarray(
-                _sharded_program(ctx.mesh, False, True, flash=True)(
+                _sharded_program(ctx.mesh, False, True, True)(
                     q, k, v, jnp.asarray(n_real, jnp.int32)
                 )
             )
