@@ -53,7 +53,9 @@ def _instantiate(cls, param_map: Dict[str, Any]):
     known = {p.name: p for p in obj.get_param_map()}
     for name, value in (param_map or {}).items():
         if name in known:
-            obj.set(known[name], value)
+            # values arrive as raw JSON — route through the param's decoder
+            # (vector params in reference configs are {"values": [...]} dicts)
+            obj.set(known[name], known[name].json_decode(value))
         else:
             raise ValueError(
                 f"Unknown parameter {name} for {cls.__name__}"

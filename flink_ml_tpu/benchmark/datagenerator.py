@@ -20,6 +20,7 @@ __all__ = [
     "DenseVectorArrayGenerator",
     "DoubleGenerator",
     "LabeledPointWithWeightGenerator",
+    "RandomStringArrayGenerator",
     "RandomStringGenerator",
     "KMeansModelDataGenerator",
     "GENERATOR_REGISTRY",
@@ -107,8 +108,11 @@ class DoubleGenerator(InputDataGenerator):
         rng = self._rng()
         n = self.get_num_values()
         arity = self.get_arity()
-        vals = rng.random(n) if arity == 0 else rng.integers(0, arity, n).astype(np.float64)
-        return DataFrame(list(names), None, [vals])
+        cols = [
+            rng.random(n) if arity == 0 else rng.integers(0, arity, n).astype(np.float64)
+            for _ in names
+        ]
+        return DataFrame(list(names), None, cols)
 
 
 class LabeledPointWithWeightGenerator(InputDataGenerator, _VectorDimMixin):
@@ -176,6 +180,40 @@ class RandomStringGenerator(InputDataGenerator):
         return DataFrame(list(names), None, cols)
 
 
+class RandomStringArrayGenerator(InputDataGenerator):
+    """Ref RandomStringArrayGenerator.java — columns of random string arrays
+    (``arraySize`` strings per row, drawn from ``numDistinctValues``)."""
+
+    NUM_DISTINCT_VALUES = IntParam(
+        "numDistinctValues", "Number of distinct string values.", 10, ParamValidators.gt(0)
+    )
+    ARRAY_SIZE = IntParam(
+        "arraySize", "Strings per generated array.", 10, ParamValidators.gt(0)
+    )
+
+    def get_num_distinct_values(self) -> int:
+        return self.get(self.NUM_DISTINCT_VALUES)
+
+    def set_num_distinct_values(self, value: int):
+        return self.set(self.NUM_DISTINCT_VALUES, value)
+
+    def get_array_size(self) -> int:
+        return self.get(self.ARRAY_SIZE)
+
+    def set_array_size(self, value: int):
+        return self.set(self.ARRAY_SIZE, value)
+
+    def generate(self) -> DataFrame:
+        (names,) = self.get_col_names()
+        rng = self._rng()
+        n, k, m = self.get_num_values(), self.get_num_distinct_values(), self.get_array_size()
+        cols = [
+            [[str(v) for v in row] for row in rng.integers(0, k, (n, m))]
+            for _ in names
+        ]
+        return DataFrame(list(names), None, cols)
+
+
 class KMeansModelDataGenerator(HasSeed, _VectorDimMixin):
     """Ref KMeansModelDataGenerator.java — model data: arraySize random centroids."""
 
@@ -196,6 +234,7 @@ class KMeansModelDataGenerator(HasSeed, _VectorDimMixin):
 
 
 GENERATOR_REGISTRY = {
+    "RandomStringArrayGenerator": RandomStringArrayGenerator,
     "DenseVectorGenerator": DenseVectorGenerator,
     "DenseVectorArrayGenerator": DenseVectorArrayGenerator,
     "DoubleGenerator": DoubleGenerator,

@@ -37,7 +37,22 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol, HasHandleInvalid)
     def transform(self, *inputs):
         (df,) = inputs
         in_cols = self.get_input_cols()
-        sizes = [int(s) for s in self.get_input_sizes()]
+        declared = self.get_input_sizes()
+        if declared is None:
+            # The reference's inputSizes defaults to null — sizes are then
+            # taken from the data itself (scalars are width 1).
+            sizes = []
+            for name in in_cols:
+                col = df.column(name)
+                if isinstance(col, np.ndarray) and col.ndim == 2:
+                    sizes.append(int(col.shape[1]))
+                elif isinstance(col, np.ndarray):
+                    sizes.append(1)
+                else:
+                    first = next((v for v in col if v is not None), None)
+                    sizes.append(int(first.size()) if isinstance(first, Vector) else 1)
+        else:
+            sizes = [int(s) for s in declared]
         handle = self.get_handle_invalid()
         if len(sizes) != len(in_cols):
             raise ValueError("VectorAssembler: one input size per input column required")

@@ -155,7 +155,18 @@ class StringArrayParam(ArrayParam):
 
 
 class VectorParam(Param[Vector]):
-    pass
+    def json_decode(self, payload):
+        # Accept the reference's jackson shapes too: a bare {"values": ...}
+        # ({"size", "indices", "values"} for sparse) or a plain list — its
+        # benchmark configs carry vector params that way.
+        if isinstance(payload, dict) and "__type__" not in payload:
+            if "indices" in payload:
+                return SparseVector(payload["size"], payload["indices"], payload["values"])
+            if "values" in payload:
+                return DenseVector(payload["values"])
+        if isinstance(payload, (list, tuple)):
+            return DenseVector(payload)
+        return _json_decode_value(payload)
 
 
 def _json_encode_value(value: Any) -> Any:

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Generate the per-stage benchmark config suite.
+
+Reference: ``flink-ml-benchmark/src/main/resources/*-benchmark.json`` — one
+JSON config per stage (34 beyond the demo), each pairing the stage with a
+data generator. This script emits the same suite for this framework into
+``flink_ml_tpu/benchmark/configs/`` using the identical schema and the
+reference's fully-qualified Java class names (they resolve through the
+stage/generator registries — config compatibility is the point).
+
+Row counts are scaled to ``ROW_CAP`` (the reference's 10M-100M rows target
+multi-TaskManager clusters; these configs must run on one chip / the CI
+mesh), with the stage-relevant shape parameters (vector dims, arities,
+array sizes, splits) kept verbatim. ``tests/test_benchmark_configs.py``
+regenerates and diffs on every CI run so the suite cannot drift from this
+table, and executes each config end-to-end at further-reduced row counts.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+ROW_CAP = 100_000
+
+_F5 = ["f0", "f1", "f2", "f3", "f4"]
+_F15 = [f"f{i}" for i in range(15)]
+_OUT5 = [f"outputCol{i}" for i in range(5)]
+_OUT15 = [f"outputCol{i}" for i in range(15)]
+
+# (config name, entry name, stage className suffix, stage paramMap,
+#  generator className suffix, generator paramMap) — mirrors the reference
+# suite's pairings (flink-ml-benchmark/src/main/resources).
+TABLE = [
+    ("agglomerativeclustering", "AgglomerativeClustering",
+     "clustering.agglomerativeclustering.AgglomerativeClustering",
+     {"distanceMeasure": "euclidean", "numClusters": 10, "linkage": "ward"},
+     "DenseVectorGenerator",
+     {"seed": 2, "colNames": [["features"]], "numValues": 1000, "vectorDim": 100}),
+    ("binarizer", "Binarizer", "feature.binarizer.Binarizer",
+     {"inputCols": _F5, "outputCols": _OUT5, "thresholds": [0.5, 0.3, 0.3, 0.6, 0.8]},
+     "DoubleGenerator", {"colNames": [_F5], "seed": 2, "numValues": ROW_CAP}),
+    ("bucketizer", "Bucketizer", "feature.bucketizer.Bucketizer",
+     {"outputCols": ["outputCol0"], "handleInvalid": "skip", "inputCols": ["col0"],
+      "splitsArray": [[-1.0, 0.0, 0.5, 1.0, 2.0]]},
+     "DoubleGenerator", {"colNames": [["col0"]], "seed": 2, "numValues": ROW_CAP}),
+    ("countvectorizer", "CountVectorizer", "feature.countvectorizer.CountVectorizer",
+     {},
+     "RandomStringArrayGenerator",
+     {"colNames": [["input"]], "seed": 2, "numValues": 20_000, "arraySize": 100,
+      "numDistinctValues": 100}),
+    ("dct", "DCT", "feature.dct.DCT", {},
+     "DenseVectorGenerator",
+     {"colNames": [["input"]], "seed": 2, "numValues": ROW_CAP, "vectorDim": 100}),
+    ("elementwiseproduct", "ElementwiseProduct",
+     "feature.elementwiseproduct.ElementwiseProduct",
+     {"scalingVec": {"values": [1.0, 2.0, 3.0, 4.0, 5.0]}},
+     "DenseVectorGenerator",
+     {"vectorDim": 5, "colNames": [["input"]], "seed": 2, "numValues": ROW_CAP}),
+    ("featurehasher", "FeatureHasher", "feature.featurehasher.FeatureHasher",
+     {"inputCols": _F5, "categoricalCols": ["f0", "f1", "f2"], "numFeatures": 1000},
+     "DoubleGenerator", {"colNames": [_F5], "seed": 2, "numValues": ROW_CAP}),
+    ("hashingtf", "HashingTF", "feature.hashingtf.HashingTF", {"binary": False},
+     "RandomStringArrayGenerator",
+     {"seed": 2, "arraySize": 10, "colNames": [["input"]], "numValues": ROW_CAP}),
+    ("idf", "IDF", "feature.idf.IDF", {"minDocFreq": 0},
+     "DenseVectorGenerator",
+     {"seed": 2, "colNames": [["input"]], "numValues": ROW_CAP, "vectorDim": 10}),
+    ("imputer", "Imputer", "feature.imputer.Imputer",
+     {"inputCols": _F15, "outputCols": _OUT15},
+     "DoubleGenerator",
+     {"colNames": [_F15], "seed": 2, "arity": 100, "numValues": ROW_CAP}),
+    ("interaction", "Interaction", "feature.interaction.Interaction",
+     {"inputCols": _F5},
+     "DoubleGenerator", {"colNames": [_F5], "seed": 2, "numValues": ROW_CAP}),
+    ("kbinsdiscretizer", "KBinsDiscretizer", "feature.kbinsdiscretizer.KBinsDiscretizer",
+     {"strategy": "uniform", "numBins": 5},
+     "DenseVectorGenerator",
+     {"seed": 2, "colNames": [["input"]], "numValues": ROW_CAP, "vectorDim": 10}),
+    ("kmeans", "KMeans", "clustering.kmeans.KMeans", {"maxIter": 10, "k": 10},
+     "DenseVectorGenerator",
+     {"seed": 2, "colNames": [["features"]], "numValues": ROW_CAP, "vectorDim": 100}),
+    ("linearregression", "LinearRegression",
+     "regression.linearregression.LinearRegression",
+     {"maxIter": 20, "reg": 0.0, "elasticNet": 0.0, "learningRate": 0.1,
+      "globalBatchSize": ROW_CAP, "tol": 1e-06},
+     "LabeledPointWithWeightGenerator",
+     {"colNames": [["features", "label", "weight"]], "featureArity": 0,
+      "labelArity": 10, "numValues": ROW_CAP, "vectorDim": 100}),
+    ("linearsvc", "LinearSVC", "classification.linearsvc.LinearSVC",
+     {"maxIter": 20, "reg": 0.0, "elasticNet": 0.0, "learningRate": 0.1,
+      "globalBatchSize": ROW_CAP, "tol": 1e-06},
+     "LabeledPointWithWeightGenerator",
+     {"colNames": [["features", "label", "weight"]], "featureArity": 0,
+      "labelArity": 2, "numValues": ROW_CAP, "vectorDim": 100}),
+    ("logisticregression", "LogisticRegression",
+     "classification.logisticregression.LogisticRegression",
+     {"maxIter": 20, "reg": 0.0, "elasticNet": 0.0, "learningRate": 0.1,
+      "globalBatchSize": ROW_CAP, "tol": 1e-06},
+     "LabeledPointWithWeightGenerator",
+     {"colNames": [["features", "label", "weight"]], "featureArity": 0,
+      "labelArity": 2, "numValues": ROW_CAP, "vectorDim": 100}),
+    ("maxabsscaler", "MaxAbsScaler", "feature.maxabsscaler.MaxAbsScaler", {},
+     "DenseVectorGenerator",
+     {"vectorDim": 100, "colNames": [["input"]], "seed": 2, "numValues": ROW_CAP}),
+    ("minmaxscaler", "MinMaxScaler", "feature.minmaxscaler.MinMaxScaler", {},
+     "DenseVectorGenerator",
+     {"vectorDim": 100, "colNames": [["input"]], "seed": 2, "numValues": ROW_CAP}),
+    ("naivebayes", "NaiveBayes", "classification.naivebayes.NaiveBayes", {},
+     "LabeledPointWithWeightGenerator",
+     {"colNames": [["features", "label", "weight"]], "featureArity": 20,
+      "labelArity": 10, "numValues": ROW_CAP, "vectorDim": 100}),
+    ("ngram", "NGram", "feature.ngram.NGram", {},
+     "RandomStringArrayGenerator",
+     {"seed": 2, "arraySize": 10, "colNames": [["input"]], "numValues": ROW_CAP}),
+    ("normalizer", "Normalizer", "feature.normalizer.Normalizer", {"p": 2.0},
+     "DenseVectorGenerator",
+     {"vectorDim": 5, "colNames": [["input"]], "seed": 2, "numValues": ROW_CAP}),
+    ("onehotencoder", "OneHotEncoder", "feature.onehotencoder.OneHotEncoder",
+     {"inputCols": ["input"], "outputCols": ["output"]},
+     "DoubleGenerator",
+     {"colNames": [["input"]], "arity": 10, "numValues": ROW_CAP}),
+    ("polynomialexpansion", "PolynomialExpansion",
+     "feature.polynomialexpansion.PolynomialExpansion", {"degree": 2},
+     "DenseVectorGenerator",
+     {"vectorDim": 5, "colNames": [["input"]], "seed": 2, "numValues": ROW_CAP}),
+    ("regextokenizer", "RegexTokenizer", "feature.regextokenizer.RegexTokenizer",
+     {"pattern": "1+"},
+     "RandomStringGenerator",
+     {"seed": 2, "numDistinctValues": 100, "colNames": [["input"]],
+      "numValues": ROW_CAP}),
+    ("robustscaler", "RobustScaler", "feature.robustscaler.RobustScaler",
+     {"withCentering": True, "withScaling": True},
+     "DenseVectorGenerator",
+     {"vectorDim": 100, "colNames": [["input"]], "seed": 2, "numValues": ROW_CAP}),
+    ("sqltransformer", "SQLTransformer", "feature.sqltransformer.SQLTransformer",
+     {"statement": "SELECT *, ABS(v1) AS v2 FROM __THIS__"},
+     "DoubleGenerator", {"colNames": [["v1"]], "seed": 2, "numValues": ROW_CAP}),
+    ("standardscaler", "StandardScaler", "feature.standardscaler.StandardScaler",
+     {"withMean": True, "withStd": True},
+     "DenseVectorGenerator",
+     {"vectorDim": 100, "colNames": [["input"]], "seed": 2, "numValues": ROW_CAP}),
+    ("stopwordsremover", "StopWordsRemover", "feature.stopwordsremover.StopWordsRemover",
+     {"inputCols": ["input"], "outputCols": ["output"]},
+     "RandomStringArrayGenerator",
+     {"colNames": [["input"]], "seed": 2, "numValues": 20_000,
+      "numDistinctValues": 100, "arraySize": 100}),
+    ("stringindexer", "StringIndexer", "feature.stringindexer.StringIndexer",
+     {"outputCols": ["outputCol0"], "handleInvalid": "skip", "inputCols": ["col0"],
+      "stringOrderType": "arbitrary"},
+     "RandomStringGenerator",
+     {"colNames": [["col0"]], "seed": 2, "numValues": ROW_CAP,
+      "numDistinctValues": 100}),
+    ("tokenizer", "Tokenizer", "feature.tokenizer.Tokenizer", {},
+     "RandomStringGenerator",
+     {"seed": 2, "numDistinctValues": 100, "colNames": [["input"]],
+      "numValues": ROW_CAP}),
+    ("univariatefeatureselector", "UnivariateFeatureSelector",
+     "feature.univariatefeatureselector.UnivariateFeatureSelector",
+     {"featuresCol": "features", "labelCol": "label", "featureType": "continuous",
+      "labelType": "categorical"},
+     "LabeledPointWithWeightGenerator",
+     {"colNames": [["features", "label", "weight"]], "labelArity": 10,
+      "numValues": ROW_CAP, "vectorDim": 100}),
+    ("variancethresholdselector", "VarianceThresholdSelector",
+     "feature.variancethresholdselector.VarianceThresholdSelector", {},
+     "DenseVectorGenerator",
+     {"vectorDim": 100, "colNames": [["input"]], "seed": 2, "numValues": ROW_CAP}),
+    ("vectorassembler", "VectorAssembler", "feature.vectorassembler.VectorAssembler",
+     {"outputCol": "outputCol", "inputCols": _F15},
+     "DoubleGenerator", {"colNames": [_F15], "seed": 2, "numValues": ROW_CAP}),
+    ("vectorindexer", "VectorIndexer", "feature.vectorindexer.VectorIndexer",
+     {"maxCategories": 20, "handleInvalid": "skip"},
+     "DenseVectorGenerator",
+     {"seed": 2, "colNames": [["input"]], "numValues": ROW_CAP, "vectorDim": 10}),
+    ("vectorslicer", "VectorSlicer", "feature.vectorslicer.VectorSlicer",
+     {"indices": [1, 3, 5, 7]},
+     "DenseVectorGenerator",
+     {"vectorDim": 10, "colNames": [["input"]], "seed": 2, "numValues": ROW_CAP}),
+]
+
+_PREFIX = "org.apache.flink.ml."
+_GEN_PREFIX = "org.apache.flink.ml.benchmark.datagenerator.common."
+
+
+def build_configs() -> dict:
+    """{file name: config dict} for the whole suite."""
+    out = {}
+    for fname, entry, stage_cls, stage_params, gen_cls, gen_params in TABLE:
+        config = {"version": 1, entry: {
+            "stage": {"className": _PREFIX + stage_cls},
+            "inputData": {
+                "className": _GEN_PREFIX + gen_cls,
+                "paramMap": gen_params,
+            },
+        }}
+        if stage_params:
+            config[entry]["stage"]["paramMap"] = stage_params
+        out[f"{fname}-benchmark.json"] = config
+    return out
+
+
+def main(out_dir: str) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    for fname, config in build_configs().items():
+        with open(os.path.join(out_dir, fname), "w") as f:
+            json.dump(config, f, indent=2)
+            f.write("\n")
+    print(f"wrote {len(TABLE)} configs to {out_dir}")
+
+
+if __name__ == "__main__":
+    main(
+        sys.argv[1]
+        if len(sys.argv) > 1
+        else os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "flink_ml_tpu", "benchmark", "configs",
+        )
+    )
