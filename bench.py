@@ -415,9 +415,16 @@ def bench_attention(peak_flops):
     from flink_ml_tpu.parallel.mesh import get_mesh_context
     from flink_ml_tpu.parallel.ring import _sharded_program
 
+    from flink_ml_tpu.parallel.flash import flash_available
+
     rng = np.random.default_rng(3)
     ctx = get_mesh_context()
     B, T, H, D = 1, 8192, 4, 128
+    if not flash_available(T // ctx.n_data, D, list(ctx.mesh.devices.flat)):
+        return {
+            "name": "ring_attention_causal_T8192_h4_d128",
+            "note": "flash fold unavailable on this backend/shape; skipped",
+        }
     q = jax.device_put(rng.standard_normal((B, T, H, D)).astype(np.float32))
     k = jax.device_put(rng.standard_normal((B, T, H, D)).astype(np.float32))
     v = jax.device_put(rng.standard_normal((B, T, H, D)).astype(np.float32))

@@ -114,12 +114,13 @@ class DeviceDataCache:
         (n,) = lengths
         self.n_valid = n
         self.arrays: Dict[str, jax.Array] = {}
-        # Host references are kept for the sparse columns only (zero-copy for
-        # ndarray inputs): host-side sparse layout construction (bucketing the
-        # static sparsity pattern once per dataset) reads them back without a
-        # device->host round trip. Dense columns are not retained — nothing
-        # reads them back, and pinning e.g. a 250k x 256 feature matrix would
-        # waste a quarter GB of host RAM.
+        # Host references are kept for the sparse columns only — zero-copy
+        # for ndarray inputs (the caller's arrays would stay alive anyway):
+        # host-side sparse layout construction (bucketing the static sparsity
+        # pattern once per dataset, rebuilt per batch size in sweeps) reads
+        # them back without a device->host round trip. Dense columns are not
+        # retained — nothing reads them back, and pinning e.g. a 250k x 256
+        # feature matrix would waste a quarter GB of host RAM.
         self.host_columns: Dict[str, np.ndarray] = {}
         from flink_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 

@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flink_ml_tpu.parallel.mesh import vma_of as _vma_of_shared
 from flink_ml_tpu.utils.arrays import group_ranks, next_pow2
 
 __all__ = ["OneHotSparseLayout", "onehot_batch_step", "SUB_ROWS", "BLOCK"]
@@ -142,7 +143,8 @@ class OneHotSparseLayout:
                     units.append((rows_rel, blocks, lanes, val_u[nz]))
 
         occ = next_pow2(np.maximum(max_count, 0))
-        occ[max_count == 0] = 0  # empty blocks: zero slots, trail the order
+        occ[max_count == 0] = 0  # empty blocks: zero slots (argsort puts
+        # them first in the class-major order; they own no flat range)
         order = np.argsort(occ, kind="stable")
         perm = order.astype(np.int32)  # class position -> original block id
         inv_perm = np.empty(nblk, np.int32)
@@ -322,15 +324,6 @@ def mult_crossing_xla(mult3, rhi, rlo, row_hi):
 _CROSS_TILE = 8192
 
 
-def _vma_of(x):
-    """Varying-mesh-axes of a traced value (shard_map tracks these; pallas
-    outputs must declare them explicitly), or None outside shard_map."""
-    try:
-        return jax.typeof(x).vma or None
-    except Exception:
-        return None
-
-
 def dot_crossing_pallas(q, rhi, rlo, row_hi, interpret: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -382,7 +375,7 @@ def dot_crossing_pallas(q, rhi, rlo, row_hi, interpret: bool = False):
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (n_sub, ntiles, row_hi, _ROW_LO), jnp.float32, vma=_vma_of(q)
+            (n_sub, ntiles, row_hi, _ROW_LO), jnp.float32, vma=_vma_of_shared(q)
         ),
         interpret=interpret,
     )(rhi.reshape(-1), rlo.reshape(-1), q.reshape(-1))
@@ -435,7 +428,7 @@ def mult_crossing_pallas(mult3, rhi, rlo, row_hi, interpret: bool = False):
         ],
         out_specs=row,
         out_shape=jax.ShapeDtypeStruct(
-            (n_sub * (n + pad),), jnp.float32, vma=_vma_of(rhi)
+            (n_sub * (n + pad),), jnp.float32, vma=_vma_of_shared(rhi)
         ),
         interpret=interpret,
     )(mult3, rhi.reshape(-1), rlo.reshape(-1))

@@ -220,6 +220,14 @@ class SelfAttentionClassifierModel(Model, _AttnParams):
         (df,) = inputs
         ctx = get_mesh_context()
         tok = np.asarray(df.vectors(self.get_features_col()), np.int32)
+        vocab = int(self.params["emb"].shape[0])
+        if tok.size and (tok.min() < 0 or tok.max() >= vocab):
+            # without this, out-of-range ids would silently clamp through
+            # JAX's out-of-bounds gather and predict from the wrong embedding
+            raise ValueError(
+                f"token ids must be in [0, {vocab}); got "
+                f"[{tok.min()}, {tok.max()}]"
+            )
         tok, t_real = _pad_tokens(tok, ctx)
         params = {k: jnp.asarray(v) for k, v in self.params.items()}
         n_heads = self.get_num_heads()
@@ -307,18 +315,26 @@ class SelfAttentionClassifier(Estimator, _AttnParams):
         y_dev = ctx.replicate(y_idx.astype(np.int32))
         nv = jnp.asarray(t_real, jnp.int32)
         offset = 0
+        windows = {}  # (lo, offset) -> device tensors; the cycle is short
         for _ in range(self.get_max_iter()):
             # contiguous example window per epoch, cycling like SGD.java:265;
             # at the clamped tail, rows before the logical offset are re-reads
-            # and get zero weight (the reference's short tail batch).
+            # and get zero weight (the reference's short tail batch). Window
+            # tensors are built once per distinct (lo, offset) — at most
+            # ceil(n/batch) of them — so steady-state epochs do no host work.
             lo = min(offset, n - batch)
-            w_epoch = (np.arange(batch) + lo >= offset).astype(np.float32)
+            key = (lo, offset)
+            if key not in windows:
+                windows[key] = (
+                    jax.lax.slice_in_dim(tok_dev, lo, lo + batch, axis=0),
+                    jax.lax.slice_in_dim(y_dev, lo, lo + batch, axis=0),
+                    ctx.replicate(
+                        (np.arange(batch) + lo >= offset).astype(np.float32)
+                    ),
+                )
+            tok_w, y_w, w_w = windows[key]
             params, opt_state, _loss = step(
-                params, opt_state,
-                jax.lax.slice_in_dim(tok_dev, lo, lo + batch, axis=0),
-                jax.lax.slice_in_dim(y_dev, lo, lo + batch, axis=0),
-                ctx.replicate(w_epoch),
-                nv,
+                params, opt_state, tok_w, y_w, w_w, nv
             )
             offset = 0 if offset + batch >= n else offset + batch
 

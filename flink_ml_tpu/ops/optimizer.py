@@ -770,7 +770,14 @@ class SGD(Optimizer):
         carries values as split-bf16 pairs, which reconstruct f32-grade
         precision but not f64.
         """
-        if not sparse or self.sparse_kernel == "scatter":
+        if not sparse:
+            if self.sparse_kernel == "onehot":
+                raise ValueError(
+                    "sparse_kernel='onehot' applies to sparse (indices/values) "
+                    "training data; this fit has dense features"
+                )
+            return False
+        if self.sparse_kernel == "scatter":
             return False
         host = getattr(train_data, "host_columns", None)
         feasible = (
@@ -823,10 +830,10 @@ class SGD(Optimizer):
     ):
         from flink_ml_tpu.linalg.onehot_sparse import BLOCK
 
+        from flink_ml_tpu.parallel.mesh import is_tpu_backend
+
         lay, stacks = self._onehot_layout(train_data, ctx, dim, local_batch)
-        use_pallas = all(
-            "TPU" in getattr(d, "device_kind", "") for d in ctx.mesh.devices.flat
-        )
+        use_pallas = is_tpu_backend(ctx.mesh.devices.flat)
         # Crossing MACs bound the dispatch length (split-bf16 doubles them).
         flops = 4.0 * lay.n_sub * lay.n_flat * (lay.sub_batch + 2 * BLOCK)
         chunk = fused_chunk_len(self.max_iter, check_loss, 0, flops)

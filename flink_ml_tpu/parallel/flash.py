@@ -48,12 +48,11 @@ def flash_available(T: int, D: int, devices=None) -> bool:
     fit the kernel's VMEM staging (the fold brings the whole resident block
     on-chip; past either budget the jnp fold's streamed HBM form is the
     right tool), and the devices must be TPUs (Mosaic target)."""
+    from flink_ml_tpu.parallel.mesh import is_tpu_backend
+
     if T % TQ_TILE or T * D > _KV_VMEM_BUDGET or T > _TK_MAX:
         return False
-    devs = devices if devices is not None else jax.devices()
-    return bool(devs) and all(
-        "TPU" in getattr(d, "device_kind", "") for d in devs
-    )
+    return is_tpu_backend(devices if devices is not None else jax.devices())
 
 
 def reference_fold(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid, scale):
@@ -85,13 +84,6 @@ def reference_fold(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid, scale)
     new_l = l * correction + jnp.sum(p, axis=-1)
     new_acc = acc * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
     return new_m, new_l, new_acc
-
-
-def _vma_of(x):
-    try:
-        return jax.typeof(x).vma or None
-    except Exception:
-        return None
 
 
 def _fold_pallas(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid, scale,
@@ -154,7 +146,9 @@ def _fold_pallas(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid, scale,
         (1, TQ_TILE, D), lambda i, j, *_: (i, j, 0), memory_space=pltpu.VMEM
     )
     full3 = pl.BlockSpec((1, Tk, D), lambda i, j, *_: (i, 0, 0), memory_space=pltpu.VMEM)
-    vma = _vma_of(q)
+    from flink_ml_tpu.parallel.mesh import vma_of
+
+    vma = vma_of(q)
     mo, lo, ao = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(

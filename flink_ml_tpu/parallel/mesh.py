@@ -133,6 +133,25 @@ class MeshContext:
         return f"MeshContext(data={self.n_data}, model={self.n_model})"
 
 
+def is_tpu_backend(devices) -> bool:
+    """Whether every device is a TPU (the Mosaic/Pallas compile target)."""
+    devices = list(devices)
+    return bool(devices) and all(
+        "TPU" in getattr(d, "device_kind", "") for d in devices
+    )
+
+
+def vma_of(x):
+    """Varying-mesh-axes of a traced value (shard_map tracks these; Pallas
+    out_shapes must declare them explicitly), or None outside shard_map."""
+    import jax
+
+    try:
+        return jax.typeof(x).vma or None
+    except Exception:
+        return None
+
+
 def get_mesh_context() -> MeshContext:
     """The process-global mesh; lazily created over all visible devices."""
     global _current

@@ -161,6 +161,12 @@ class VectorParam(Param[Vector]):
         # benchmark configs carry vector params that way.
         if isinstance(payload, dict) and "__type__" not in payload:
             if "indices" in payload:
+                missing = {"size", "indices", "values"} - payload.keys()
+                if missing:
+                    raise ValueError(
+                        f"sparse vector param {self.name!r} needs keys "
+                        f"size/indices/values; missing {sorted(missing)}"
+                    )
                 return SparseVector(payload["size"], payload["indices"], payload["values"])
             if "values" in payload:
                 return DenseVector(payload["values"])
