@@ -48,7 +48,7 @@ docs/benchmarks.md for the roofline and the multi-chip scaling argument).
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -40,8 +40,7 @@ Deviations from the reference, deliberate:
 """
 from __future__ import annotations
 
-import functools
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
