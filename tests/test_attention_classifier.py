@@ -134,3 +134,21 @@ def test_seed_reproducible():
     b = _fit(df, max_iter=3)
     for key in a.params:
         np.testing.assert_array_equal(a.params[key], b.params[key])
+
+
+def test_transform_rejects_unseen_token_ids():
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, 6, size=(8, 8)).astype(np.float64)
+    y = rng.integers(0, 2, 8).astype(np.float64)
+    model = (
+        SelfAttentionClassifier()
+        .set_embedding_dim(8)
+        .set_num_heads(2)
+        .set_max_iter(1)
+        .set_global_batch_size(8)
+        .fit(DataFrame.from_dict({"features": tok, "label": y}))
+    )
+    bad = tok.copy()
+    bad[0, 0] = 99  # beyond the trained vocab: must error, not clamp
+    with pytest.raises(ValueError, match="token ids"):
+        model.transform(DataFrame.from_dict({"features": bad}))

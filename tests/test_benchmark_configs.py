@@ -79,3 +79,14 @@ def test_vector_assembler_infers_sizes_from_vector_lists():
     np.testing.assert_allclose(
         np.asarray(out.column("out")), [[1.0, 0.0, 2.0, 7.0], [0.0, 5.0, 0.0, 8.0]]
     )
+
+
+def test_malformed_sparse_vector_param_names_missing_keys():
+    from flink_ml_tpu.models.feature.elementwise_product import ElementwiseProduct
+
+    stage = ElementwiseProduct()
+    with pytest.raises(ValueError, match="missing \\['size'\\]"):
+        stage.set(
+            stage.SCALING_VEC,
+            stage.SCALING_VEC.json_decode({"indices": [0], "values": [1.0]}),
+        )

@@ -224,3 +224,28 @@ class TestSgdIntegration:
                     DeviceDataCache(cols, ctx=tp_ctx),
                     BinaryLogisticLoss.INSTANCE,
                 )
+
+    def test_auto_gate_picks_onehot_for_wide_models(self):
+        rng = np.random.default_rng(9)
+        n, d, K = 1 << 14, 1 << 15, 8  # wide coef, >= 2^16 nnz, few windows
+        cols = self._cols(rng, n, d, K)
+        with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
+            cache = DeviceDataCache(cols, ctx=ctx)
+            SGD(max_iter=2, global_batch_size=n, ctx=ctx).optimize(
+                np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
+            )
+            assert getattr(cache, "_onehot_memo", None) is not None  # auto engaged
+
+    def test_forced_onehot_on_dense_data_raises(self):
+        rng = np.random.default_rng(10)
+        X = rng.normal(size=(64, 8)).astype(np.float32)
+        y = (rng.random(64) > 0.5).astype(np.float32)
+        with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
+            with pytest.raises(ValueError, match="dense"):
+                SGD(
+                    max_iter=2, global_batch_size=32, ctx=ctx, sparse_kernel="onehot"
+                ).optimize(
+                    np.zeros(8, np.float32),
+                    {"features": X, "labels": y},
+                    BinaryLogisticLoss.INSTANCE,
+                )
