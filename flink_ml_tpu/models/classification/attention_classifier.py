@@ -302,11 +302,9 @@ class SelfAttentionClassifier(Estimator, _AttnParams):
         params = jax.tree_util.tree_map(
             jnp.asarray, _init_params(rng, vocab, emb, len(labels))
         )
-        # Training stays on the jnp fold: the fused kernel's backward is a
-        # full recompute through the reference fold, which erases (slightly
-        # inverts) its forward win — measured 15.2 vs 13.5 ms per step at
-        # T=8192. Serving (no backward) takes the ~5x fused path (transform).
-        optimizer, step = _train_step(ctx.mesh, n_heads, self.get_learning_rate(), False)
+        optimizer, step = _train_step(
+            ctx.mesh, n_heads, self.get_learning_rate(), _use_flash(ctx, tok, emb, n_heads)
+        )
         opt_state = optimizer.init(params)
 
         n = tok.shape[0]

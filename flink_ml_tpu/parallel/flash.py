@@ -16,11 +16,13 @@ max with ``-inf`` hygiene (rows with nothing attendable yet must not
 produce NaNs), masked positions dropped before the exponential, and the
 same correction factors.
 
-Gradients: ``fused_fold`` carries a ``jax.custom_vjp`` whose backward
-recomputes through the reference jnp fold, so ``jax.grad`` through ring
-attention stays exact while the primal path takes the fused kernel. (The
-backward therefore still materializes scores — a fused backward kernel is
-a further optimization, not a correctness requirement.)
+Gradients: ``fused_fold`` carries a ``jax.custom_vjp`` whose backward is
+fused too — a hand-derived fold VJP (``reference_fold_bwd``, pinned
+against jax AD including the ``-inf`` first-fold, masked-row and max-tie
+edges) run as two Pallas kernels: a dq-kernel owning full score rows
+(which also emits the row-level max/tie quantities) and a dkv-kernel
+owning score columns with Q-axis grid accumulation. ``jax.grad`` through
+ring attention is therefore exact and never materializes scores in HBM.
 
 Availability: TPU compiled, or any backend under ``interpret=True``. The
 caller (``ring.py``) falls back to the jnp fold when the local length does
@@ -180,8 +182,9 @@ def fused_fold(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, has_n_valid,
                n_valid, scale, interpret=False):
     """One ring-attention fold, fused. Same contract as ``reference_fold``
     (``n_valid`` is a traced scalar consumed only when ``has_n_valid``);
-    the primal runs the Pallas kernel, gradients recompute through the jnp
-    fold. ``causal``/``has_n_valid``/``scale``/``interpret`` are static.
+    the primal runs the Pallas forward kernel and gradients run the fused
+    backward kernels (``_fold_bwd_pallas``, AD-exact).
+    ``causal``/``has_n_valid``/``scale``/``interpret`` are static.
     """
     return _fold_pallas(
         q, kb, vb, m, l, acc, q_pos0, k_pos0, causal,
@@ -200,16 +203,280 @@ def _fused_fold_fwd(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, has_n_valid,
 
 def _fused_fold_bwd(causal, has_n_valid, scale, interpret, res, g):
     q, kb, vb, m, l, acc, q_pos0, k_pos0, n_valid = res
-    _, vjp = jax.vjp(
-        lambda q_, kb_, vb_, m_, l_, acc_: reference_fold(
-            q_, kb_, vb_, m_, l_, acc_, q_pos0, k_pos0, causal,
-            n_valid if has_n_valid else None, scale,
-        ),
-        q, kb, vb, m, l, acc,
+    dm, dl, dacc = g
+    dq, dkb, dvb, dm_in, dl_in, dacc_in = _fold_bwd_pallas(
+        q, kb, vb, m, l, acc, q_pos0, k_pos0, causal,
+        n_valid if has_n_valid else None, scale, dm, dl, dacc,
+        interpret=interpret,
     )
-    dq, dkb, dvb, dm, dl, dacc = vjp(g)
     # integer position/count args carry no cotangent
-    return dq, dkb, dvb, dm, dl, dacc, None, None, None
+    return dq, dkb, dvb, dm_in, dl_in, dacc_in, None, None, None
 
 
 fused_fold.defvjp(_fused_fold_fwd, _fused_fold_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused backward: the fold's hand-derived VJP (pinned against jax.vjp of
+# reference_fold, including the -inf first-fold and masked-row edges) run as
+# two Pallas kernels. The dq-kernel owns full score rows, so it computes the
+# row-level quantities (safe max, block max, tie coefficient) once and hands
+# them to the dkv-kernel, whose cells own score columns.
+# ---------------------------------------------------------------------------
+
+_TQ_BWD = 64  # Q rows per dq-kernel cell (3 [TQ, Tk] f32 buffers live at once)
+_TK_BWD = 256  # K rows per dkv-kernel cell
+_TQ_DKV = 2048  # Q rows per dkv accumulation step (third grid dim)
+
+
+def reference_fold_bwd(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid,
+                       scale, dm, dl, dacc):
+    """Hand-derived VJP of ``reference_fold`` — AD-equivalent (max ties split
+    0.5/0.5 like ``jnp.maximum``; reduce-max ties spread evenly). The jnp
+    source of truth the Pallas backward kernels are tested against."""
+    Tq, Tk = q.shape[2], kb.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kb) * scale
+    if causal or n_valid is not None:
+        q_pos = q_pos0 + jnp.arange(Tq)
+        k_pos = k_pos0 + jnp.arange(Tk)
+        keep = jnp.ones((Tq, Tk), bool)
+        if causal:
+            keep &= q_pos[:, None] >= k_pos[None, :]
+        if n_valid is not None:
+            keep &= (k_pos < jnp.asarray(n_valid))[None, :]
+        s = jnp.where(keep[None, None], s, -jnp.inf)
+    B = jnp.max(s, axis=-1)
+    new_m = jnp.maximum(m, B)
+    safe = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+    P = jnp.exp(s - safe[..., None])
+    P = jnp.where(jnp.isneginf(s), 0.0, P)
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe))
+
+    dP = dl[..., None] + jnp.einsum("bhqd,bhkd->bhqk", dacc, vb)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", P, dacc)
+    dcorr = dl * l + jnp.sum(dacc * acc, axis=-1)
+    dl_in = dl * corr
+    dacc_in = dacc * corr[..., None]
+    ds = dP * P
+    dsafe = -jnp.sum(dP * P, axis=-1) - dcorr * corr
+    dm_in = jnp.where(jnp.isneginf(m), 0.0, dcorr * corr)
+    dnew_m = dm + jnp.where(jnp.isneginf(new_m), 0.0, dsafe)
+    take_m = jnp.where(m > B, 1.0, jnp.where(m == B, 0.5, 0.0))
+    dm_in = dm_in + dnew_m * take_m
+    dB = dnew_m * (1.0 - take_m)
+    is_max = (s == B[..., None]) & ~jnp.isneginf(s)
+    cnt = jnp.maximum(jnp.sum(is_max, axis=-1), 1)
+    ds = ds + is_max * (dB / cnt)[..., None]
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kb) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q) * scale
+    return dq, dk, dv, dm_in, dl_in, dacc_in
+
+
+def _fold_bwd_pallas(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid,
+                     scale, dm, dl, dacc, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from flink_ml_tpu.parallel.mesh import vma_of
+
+    B_, H, Tq, D = q.shape
+    Tk = kb.shape[2]
+    BH = B_ * H
+    masked = n_valid is not None
+    # tiles clamp to the largest 256-aligned divisor of the actual dims
+    # (flash_available guarantees T % 256 == 0, so these always divide)
+    tq_bwd = min(_TQ_BWD, Tq)
+    tk_bwd = min(_TK_BWD, Tk)
+    tq_dkv = next(c for c in (_TQ_DKV, 1024, 512, 256, Tq) if Tq % c == 0)
+
+    def mask_of(q_pos, k_pos):
+        keep = jnp.ones(q_pos.shape, bool)
+        if causal:
+            keep &= q_pos >= k_pos
+        return keep
+
+    def dq_kernel(scalars_ref, q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                  dm_ref, dl_ref, dacc_ref,
+                  dqo_ref, dmo_ref, dlo_ref, dao_ref, safe_ref, b_ref, dbc_ref):
+        j = pl.program_id(1)
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [TQ, Tk]
+        if causal or masked:
+            q_pos = (
+                scalars_ref[0] + j * tq_bwd
+                + jax.lax.broadcasted_iota(jnp.int32, (tq_bwd, Tk), 0)
+            )
+            k_pos = scalars_ref[1] + jax.lax.broadcasted_iota(
+                jnp.int32, (tq_bwd, Tk), 1
+            )
+            keep = mask_of(q_pos, k_pos)
+            if masked:
+                keep &= k_pos < scalars_ref[2]
+            s = jnp.where(keep, s, -jnp.inf)
+        mcol = m_ref[0]  # [TQ, 1]
+        Bcol = jnp.max(s, axis=1, keepdims=True)
+        new_m = jnp.maximum(mcol, Bcol)
+        safe = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        P = jnp.exp(s - safe)
+        P = jnp.where(jnp.isneginf(s), 0.0, P)
+        corr = jnp.where(jnp.isneginf(mcol), 0.0, jnp.exp(mcol - safe))
+
+        dlc = dl_ref[0]  # [TQ, 1]
+        dP = dlc + jax.lax.dot_general(
+            dacc_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [TQ, Tk]
+        dPP = dP * P
+        dcorr = dlc * l_ref[0] + jnp.sum(
+            dacc_ref[0] * acc_ref[0], axis=1, keepdims=True
+        )
+        dsafe = -jnp.sum(dPP, axis=1, keepdims=True) - dcorr * corr
+        dnew_m = dm_ref[0] + jnp.where(jnp.isneginf(new_m), 0.0, dsafe)
+        take_m = jnp.where(mcol > Bcol, 1.0, jnp.where(mcol == Bcol, 0.5, 0.0))
+        dB = dnew_m * (1.0 - take_m)
+        is_max = (s == Bcol) & ~jnp.isneginf(s)
+        cnt = jnp.maximum(jnp.sum(is_max.astype(jnp.float32), axis=1, keepdims=True), 1.0)
+        dbc = dB / cnt
+        ds = dPP + is_max.astype(jnp.float32) * dbc
+        dqo_ref[0] = jax.lax.dot_general(
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        dmo_ref[0] = jnp.where(jnp.isneginf(mcol), 0.0, dcorr * corr) + dnew_m * take_m
+        dlo_ref[0] = dlc * corr
+        dao_ref[0] = dacc_ref[0] * corr
+        safe_ref[0] = safe
+        b_ref[0] = Bcol
+        dbc_ref[0] = dbc
+
+    def dkv_kernel(scalars_ref, k_ref, v_ref, q_ref, dacc_ref, dl_ref,
+                   safe_ref, b_ref, dbc_ref, dko_ref, dvo_ref):
+        # grid (BH, ktiles, qtiles): the q axis is the innermost accumulation
+        # dim — dk/dv blocks are revisited across it and accumulated in VMEM.
+        jk = pl.program_id(1)
+        jq = pl.program_id(2)
+        s_col = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [TQ_DKV, TK]
+        if causal or masked:
+            q_pos = (
+                scalars_ref[0] + jq * tq_dkv
+                + jax.lax.broadcasted_iota(jnp.int32, (tq_dkv, tk_bwd), 0)
+            )
+            k_pos = (
+                scalars_ref[1] + jk * tk_bwd
+                + jax.lax.broadcasted_iota(jnp.int32, (tq_dkv, tk_bwd), 1)
+            )
+            keep = mask_of(q_pos, k_pos)
+            if masked:
+                keep &= k_pos < scalars_ref[2]
+            s_col = jnp.where(keep, s_col, -jnp.inf)
+        P_col = jnp.exp(s_col - safe_ref[0])
+        P_col = jnp.where(jnp.isneginf(s_col), 0.0, P_col)
+        dP_col = dl_ref[0] + jax.lax.dot_general(
+            dacc_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        is_max = (s_col == b_ref[0]) & ~jnp.isneginf(s_col)
+        ds_col = dP_col * P_col + is_max.astype(jnp.float32) * dbc_ref[0]
+        dk_part = jax.lax.dot_general(
+            ds_col, q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        dv_part = jax.lax.dot_general(
+            P_col, dacc_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+        @pl.when(jq == 0)
+        def _():
+            dko_ref[0] = jnp.zeros_like(dko_ref[0])
+            dvo_ref[0] = jnp.zeros_like(dvo_ref[0])
+
+        dko_ref[0] += dk_part
+        dvo_ref[0] += dv_part
+
+    scalars = jnp.stack(
+        [
+            jnp.asarray(q_pos0, jnp.int32),
+            jnp.asarray(k_pos0, jnp.int32),
+            jnp.asarray(0 if n_valid is None else n_valid, jnp.int32),
+        ]
+    )
+    vma = vma_of(q)
+
+    def col(tile):
+        return pl.BlockSpec((1, tile, 1), lambda i, j, *_: (i, j, 0), memory_space=pltpu.VMEM)
+
+    def mat(tile):
+        return pl.BlockSpec((1, tile, D), lambda i, j, *_: (i, j, 0), memory_space=pltpu.VMEM)
+
+    fullk_mat = pl.BlockSpec((1, Tk, D), lambda i, j, *_: (i, 0, 0), memory_space=pltpu.VMEM)
+
+    def sds(shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
+
+    q4 = q.reshape(BH, Tq, D)
+    k4 = kb.reshape(BH, Tk, D)
+    v4 = vb.reshape(BH, Tk, D)
+    dacc4 = dacc.reshape(BH, Tq, D)
+    dl4 = dl.reshape(BH, Tq, 1)
+    dq_o, dm_o, dl_o, dacc_o, safe_r, b_r, dbc_r = pl.pallas_call(
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, Tq // tq_bwd),
+            in_specs=[
+                mat(tq_bwd), fullk_mat, fullk_mat,
+                col(tq_bwd), col(tq_bwd), mat(tq_bwd),
+                col(tq_bwd), col(tq_bwd), mat(tq_bwd),
+            ],
+            out_specs=[
+                mat(tq_bwd), col(tq_bwd), col(tq_bwd), mat(tq_bwd),
+                col(tq_bwd), col(tq_bwd), col(tq_bwd),
+            ],
+        ),
+        out_shape=[
+            sds((BH, Tq, D)), sds((BH, Tq, 1)), sds((BH, Tq, 1)),
+            sds((BH, Tq, D)), sds((BH, Tq, 1)), sds((BH, Tq, 1)),
+            sds((BH, Tq, 1)),
+        ],
+        interpret=interpret,
+    )(
+        scalars, q4, k4, v4,
+        m.reshape(BH, Tq, 1), l.reshape(BH, Tq, 1), acc.reshape(BH, Tq, D),
+        dm.reshape(BH, Tq, 1), dl4, dacc4,
+    )
+
+    kmat = pl.BlockSpec(
+        (1, tk_bwd, D), lambda i, jk, jq, *_: (i, jk, 0), memory_space=pltpu.VMEM
+    )
+    qmat = pl.BlockSpec(
+        (1, tq_dkv, D), lambda i, jk, jq, *_: (i, jq, 0), memory_space=pltpu.VMEM
+    )
+    qcol = pl.BlockSpec(
+        (1, tq_dkv, 1), lambda i, jk, jq, *_: (i, jq, 0), memory_space=pltpu.VMEM
+    )
+    dk_o, dv_o = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, Tk // tk_bwd, Tq // tq_dkv),
+            in_specs=[kmat, kmat, qmat, qmat, qcol, qcol, qcol, qcol],
+            out_specs=[kmat, kmat],
+        ),
+        out_shape=[sds((BH, Tk, D)), sds((BH, Tk, D))],
+        interpret=interpret,
+    )(scalars, k4, v4, q4, dacc4, dl4, safe_r, b_r, dbc_r)
+
+    return (
+        dq_o.reshape(B_, H, Tq, D),
+        dk_o.reshape(B_, H, Tk, D),
+        dv_o.reshape(B_, H, Tk, D),
+        dm_o.reshape(B_, H, Tq),
+        dl_o.reshape(B_, H, Tq),
+        dacc_o.reshape(B_, H, Tq, D),
+    )

@@ -53,10 +53,10 @@ def ring_attention(
     and ``causal`` is off, or padded keys would receive softmax weight in
     every real row.
 
-    With ``flash`` the per-step fold runs as the fused Pallas kernel
-    (``parallel/flash.py``): scores never touch HBM on the primal path,
-    gradients recompute through the jnp fold. Callers should gate it with
-    ``flash_available`` (tiling + TPU backend).
+    With ``flash`` the per-step fold runs as the fused Pallas kernels
+    (``parallel/flash.py``): scores never touch HBM on the forward OR the
+    backward (the fold's VJP is fused too, pinned AD-exact). Callers should
+    gate it with ``flash_available`` (tiling + VMEM + TPU backend).
     """
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -66,9 +66,9 @@ def ring_attention(
     from flink_ml_tpu.parallel.flash import fused_fold, reference_fold
 
     # Tensors ride the ring in [B, H, T, D] layout (one transpose in, one
-    # out); both folds share reference_fold's contract, so the jnp numerics
-    # have a single source of truth (flash recomputes its gradients through
-    # the same function).
+    # out); both folds share reference_fold's contract — the jnp numerics
+    # are the single source of truth the fused kernels (forward and
+    # backward) are pinned against in tests.
     q_t = jnp.transpose(q, (0, 2, 1, 3))
     k_c = jnp.transpose(k, (0, 2, 1, 3))
     v_c = jnp.transpose(v, (0, 2, 1, 3))

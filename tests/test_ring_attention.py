@@ -189,3 +189,60 @@ class TestFlashFold:
             np.testing.assert_allclose(
                 np.asarray(a_), np.asarray(b_), rtol=1e-5, atol=1e-5
             )
+
+    @pytest.mark.parametrize(
+        "case", ["first-fold", "mid-fold", "masked", "fully-masked", "plain"]
+    )
+    def test_hand_derived_fold_bwd_matches_ad(self, case):
+        import jax
+        import jax.numpy as jnp
+
+        from flink_ml_tpu.parallel.flash import (
+            _fold_bwd_pallas,
+            reference_fold,
+            reference_fold_bwd,
+        )
+
+        rng = np.random.default_rng(7)
+        B, H, Tq, Tk, D = 1, 2, 256, 256, 8
+        scale = 1.0 / np.sqrt(D)
+        r = lambda *sh: jnp.asarray(rng.normal(size=sh).astype(np.float32))
+        causal, nv, qp, kp = {
+            "first-fold": (True, None, 0, 0),
+            "mid-fold": (True, None, 512, 256),
+            "masked": (False, 300, 0, 256),  # keys 256-299 valid, rest masked
+            "fully-masked": (False, 10, 0, 128),  # rows with nothing attendable
+            "plain": (False, None, 0, 0),
+        }[case]
+        q, kb, vb = r(B, H, Tq, D), r(B, H, Tk, D), r(B, H, Tk, D)
+        if case in ("first-fold", "fully-masked"):
+            m = jnp.full((B, H, Tq), -jnp.inf)
+            l = jnp.zeros((B, H, Tq))
+            acc = jnp.zeros((B, H, Tq, D))
+        else:
+            m, l, acc = r(B, H, Tq) * 0.5, jnp.abs(r(B, H, Tq)) + 0.5, r(B, H, Tq, D)
+        dm, dl, dacc = r(B, H, Tq), r(B, H, Tq), r(B, H, Tq, D)
+
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_, m_, l_, a_: reference_fold(
+                q_, k_, v_, m_, l_, a_, qp, kp, causal, nv, scale
+            ),
+            q, kb, vb, m, l, acc,
+        )
+        want = vjp((dm, dl, dacc))
+        got_ref = reference_fold_bwd(
+            q, kb, vb, m, l, acc, qp, kp, causal, nv, scale, dm, dl, dacc
+        )
+        got_pl = _fold_bwd_pallas(
+            q, kb, vb, m, l, acc, qp, kp, causal, nv, scale, dm, dl, dacc,
+            interpret=True,
+        )
+        for w, gr, gp, name in zip(want, got_ref, got_pl, ["dq", "dk", "dv", "dm", "dl", "dacc"]):
+            np.testing.assert_allclose(
+                np.asarray(gr), np.asarray(w), rtol=2e-5, atol=2e-5,
+                err_msg=f"{case}/{name} reference_fold_bwd",
+            )
+            np.testing.assert_allclose(
+                np.asarray(gp), np.asarray(w), rtol=2e-5, atol=2e-5,
+                err_msg=f"{case}/{name} pallas bwd",
+            )
