@@ -1,5 +1,9 @@
 """Sequence-parallel ring attention over the device mesh (no reference
 analogue — the TPU-native long-context primitive; see docs/distributed.md).
+
+On TPU, when the local block tiles and fits VMEM, both the per-step fold
+and its backward run as fused Pallas kernels automatically
+(docs/kernels.md) — nothing to opt into here.
 """
 import numpy as np
 
