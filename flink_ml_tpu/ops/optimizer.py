@@ -272,6 +272,21 @@ def fused_chunk_len(
         cap = min(cap, _TOL_CHUNK)
     return max(1, min(max_iter, cap))
 
+def _hbm_bytes_limit() -> int:
+    """Best-effort per-device accelerator memory budget. TPUs report
+    ``bytes_limit`` through memory_stats(); backends that don't (virtual CPU
+    meshes, where host RAM is not the scarce resource) get a conservative
+    16 GiB stand-in — the v5e-class HBM size the layouts are designed for."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit
+    except Exception:
+        pass
+    return 16 << 30
+
+
 _FUSED_CACHE: Dict[tuple, object] = {}
 _FUSED_CACHE_MAX = 32  # FIFO-bounded: hyperparameter sweeps must not leak executables
 
@@ -648,6 +663,13 @@ class SGD(Optimizer):
             return self._optimize_streaming(init_model, train_data, loss_func, ctx)
         if not isinstance(train_data, DeviceDataCache):
             cols = dict(train_data)
+            if "indices" not in cols and self.sparse_kernel == "onehot":
+                # fail before ingestion — the misconfigured fit must not pay
+                # a full device upload of the dense matrix first
+                raise ValueError(
+                    "sparse_kernel='onehot' applies to sparse (indices/values) "
+                    "training data; this fit has dense features"
+                )
             if "weights" not in cols:
                 cols["weights"] = np.ones(np.asarray(cols["labels"]).shape[0])
             # On a TP mesh, dense features ingest directly in their training
@@ -666,6 +688,14 @@ class SGD(Optimizer):
                 column_specs=specs,
             )
         sparse = "indices" in train_data.arrays
+        # A forced kernel that cannot apply to this data must fail loudly on
+        # every path (fused, host-loop, listeners) — not just where the kernel
+        # choice happens to be consulted.
+        if not sparse and self.sparse_kernel == "onehot":
+            raise ValueError(
+                "sparse_kernel='onehot' applies to sparse (indices/values) "
+                "training data; this fit has dense features"
+            )
         # Wide models shard the coefficient over the model axis when the mesh
         # has one (tensor parallelism): sparse shards the index range, dense
         # column-slices the feature matrix.
@@ -696,9 +726,12 @@ class SGD(Optimizer):
         )
         if fused:
             if self._pick_onehot(sparse, model_sharded, train_data, local_batch, dim):
-                return self._optimize_onehot(
+                result = self._optimize_onehot(
                     init_model, train_data, loss_func, ctx, local_batch, check_loss, dim
                 )
+                if result is not None:
+                    return result
+                # auto-picked layout would not fit HBM; fall through to scatter
             # One program runs a chunk of epochs; the host observes the on-device
             # ``done`` flag between chunks (see fused_chunk_len for the policy).
             # sparse epochs: the forward gather + the gradient scatter
@@ -769,12 +802,7 @@ class SGD(Optimizer):
         carries values as split-bf16 pairs, which reconstruct f32-grade
         precision but not f64.
         """
-        if not sparse:
-            if self.sparse_kernel == "onehot":
-                raise ValueError(
-                    "sparse_kernel='onehot' applies to sparse (indices/values) "
-                    "training data; this fit has dense features"
-                )
+        if not sparse:  # dense + forced 'onehot' already raised in optimize()
             return False
         if self.sparse_kernel == "scatter":
             return False
@@ -801,19 +829,37 @@ class SGD(Optimizer):
             and dim >= self._ONEHOT_MIN_DIM
         )
 
-    def _onehot_layout(self, train_data, ctx, dim, local_batch):
+    # Fraction of reported HBM the one-hot stacks may claim under 'auto':
+    # the CSR columns, labels/weights, coefficient and program workspace share
+    # the rest, and the stacks cost ~16 B per padded slot (3 int32 + 1 f32)
+    # vs the CSR data's 8 B per slot — a dataset near HBM capacity that
+    # trains fine on the scatter path must not OOM by auto-switching.
+    _ONEHOT_HBM_FRACTION = 0.35
+
+    def _onehot_layout(self, train_data, ctx, dim, local_batch, force: bool):
         """Build (once per cache/config) the blocked one-hot layout and its
-        device-resident stacks, memoized like the data itself."""
+        device-resident stacks, memoized like the data itself. Returns
+        ``(layout, stacks)``; stacks is None when ``force`` is False and the
+        stacks would overrun the auto path's HBM budget (the caller then
+        falls back to the scatter kernel)."""
         from flink_ml_tpu.linalg.onehot_sparse import OneHotSparseLayout
 
         key = (ctx.n_data, dim, local_batch)
         memo = getattr(train_data, "_onehot_memo", None)
-        if memo is not None and memo[0] == key:
+        if memo is not None and memo[0] == key and (memo[2] is not None or not force):
             return memo[1], memo[2]
         host = train_data.host_columns
         lay = OneHotSparseLayout.build(
             host["indices"], host["values"], dim, ctx.n_data, local_batch
         )
+        # Stacks shard over the data axis — each device holds 1/n_shards of
+        # the 16 B/slot (3 int32 + 1 f32) total; budget the per-device slice.
+        per_shard_bytes = 16 * lay.lidx.size // max(1, lay.n_shards)
+        if not force and per_shard_bytes > self._ONEHOT_HBM_FRACTION * _hbm_bytes_limit():
+            # Record the decision only — keeping the rejected host stacks
+            # alive in the memo would double host RAM for the largest fits.
+            train_data._onehot_memo = (key, None, None)
+            return None, None
         sh = ctx.sharding(DATA_AXIS)
         dev = (
             jax.device_put(lay.lidx, sh),
@@ -831,7 +877,11 @@ class SGD(Optimizer):
 
         from flink_ml_tpu.parallel.mesh import is_tpu_backend
 
-        lay, stacks = self._onehot_layout(train_data, ctx, dim, local_batch)
+        lay, stacks = self._onehot_layout(
+            train_data, ctx, dim, local_batch, force=self.sparse_kernel == "onehot"
+        )
+        if stacks is None:
+            return None  # auto: stacks would overrun HBM — scatter instead
         use_pallas = is_tpu_backend(ctx.mesh.devices.flat)
         # Crossing MACs bound the dispatch length (split-bf16 doubles them).
         flops = 4.0 * lay.n_sub * lay.n_flat * (lay.sub_batch + 2 * BLOCK)
@@ -864,9 +914,10 @@ class SGD(Optimizer):
             self.loss_history.extend(float(x) for x in chunk_losses[:n])
             if check_loss and n < n_active:
                 break
-        return lay.unpermute_coef(np.asarray(jax.device_get(coef))).astype(
-            np.asarray(init_model).dtype, copy=False
-        )
+        # Same caller-visible dtype as the scatter fused path (self.dtype —
+        # f32 here, the only dtype this kernel admits): auto-selection must
+        # not change the output dtype for a float64 init_model.
+        return lay.unpermute_coef(np.asarray(jax.device_get(coef)))
 
     def _optimize_host_loop(
         self, init_model, train_data, loss_func, ctx, step, local_batch,
@@ -948,6 +999,11 @@ class SGD(Optimizer):
         local_batch = min(local_batch, -(-n_rows // ctx.n_data))
         row0 = cache.rows(0, 1)
         sparse = "indices" in row0
+        if not sparse and self.sparse_kernel == "onehot":
+            raise ValueError(
+                "sparse_kernel='onehot' applies to sparse (indices/values) "
+                "training data; this fit has dense features"
+            )
         if sparse and self.sparse_kernel == "onehot":
             raise ValueError(
                 "sparse_kernel='onehot' is not available on the streamed "

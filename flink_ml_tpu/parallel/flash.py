@@ -41,7 +41,13 @@ TQ_TILE = 256  # Q rows per grid cell
 
 
 _KV_VMEM_BUDGET = 1 << 20  # Tk*D f32 elements the kernel may stage per head
-_TK_MAX = 16384  # score/probability buffers are [TQ_TILE, Tk] f32 in VMEM
+# T=8192 (with D=128, so T*D == _KV_VMEM_BUDGET) is the largest shape whose
+# Mosaic compilation is verified on hardware; every admitted (T, D) then has
+# score-buffer and KV footprints <= that shape's in all three kernels. 16384
+# admitted shapes (e.g. T=16384, D=64) stage [TQ_TILE, 16384] f32 scores plus
+# full KV — past the scoped-VMEM limit on paper and never compile-checked on
+# chip, so they are rejected until verified.
+_TK_MAX = 8192
 
 
 def flash_available(T: int, D: int, devices=None) -> bool:

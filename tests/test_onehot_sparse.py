@@ -249,3 +249,77 @@ class TestSgdIntegration:
                     {"features": X, "labels": y},
                     BinaryLogisticLoss.INSTANCE,
                 )
+
+    def test_forced_onehot_on_dense_data_raises_on_streamed_path(self):
+        from flink_ml_tpu.iteration import HostDataCache
+
+        rng = np.random.default_rng(14)
+        cache = HostDataCache()
+        cache.append({
+            "features": rng.normal(size=(64, 8)).astype(np.float32),
+            "labels": (rng.random(64) > 0.5).astype(np.float32),
+        })
+        with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
+            with pytest.raises(ValueError, match="dense"):
+                SGD(
+                    max_iter=2, global_batch_size=32, ctx=ctx, sparse_kernel="onehot"
+                ).optimize(np.zeros(8, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+
+    def test_forced_onehot_on_dense_data_raises_with_listeners(self):
+        # The misconfiguration must fail on the host-loop path too, not just
+        # where the fused path consults the kernel choice.
+        from flink_ml_tpu.iteration import IterationListener
+
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(64, 8)).astype(np.float32)
+        y = (rng.random(64) > 0.5).astype(np.float32)
+        with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
+            with pytest.raises(ValueError, match="dense"):
+                SGD(
+                    max_iter=2, global_batch_size=32, ctx=ctx,
+                    sparse_kernel="onehot", listeners=[IterationListener()],
+                ).optimize(
+                    np.zeros(8, np.float32),
+                    {"features": X, "labels": y},
+                    BinaryLogisticLoss.INSTANCE,
+                )
+
+    def test_auto_gate_falls_back_when_stacks_exceed_hbm(self, monkeypatch):
+        # A dataset whose one-hot stacks (~16 B/slot) would overrun HBM must
+        # stay on the scatter path under 'auto' instead of OOMing.
+        import flink_ml_tpu.ops.optimizer as opt_mod
+
+        rng = np.random.default_rng(12)
+        n, d, K = 1 << 14, 1 << 15, 8
+        cols = self._cols(rng, n, d, K)
+        monkeypatch.setattr(opt_mod, "_hbm_bytes_limit", lambda: 1 << 20)
+        with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
+            cache = DeviceDataCache(cols, ctx=ctx)
+            coef = SGD(max_iter=2, global_batch_size=n, ctx=ctx).optimize(
+                np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
+            )
+            memo = getattr(cache, "_onehot_memo", None)
+            assert memo is not None and memo[2] is None  # layout judged, stacks skipped
+            assert np.all(np.isfinite(coef))  # scatter fallback trained
+            # forcing 'onehot' overrides the budget (caller takes the risk)
+            SGD(
+                max_iter=2, global_batch_size=n, ctx=ctx, sparse_kernel="onehot"
+            ).optimize(np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+            assert cache._onehot_memo[2] is not None
+
+    def test_onehot_output_dtype_matches_scatter_for_f64_init(self):
+        # Auto-selection must not change the caller-visible dtype: both sparse
+        # kernels return self.dtype (f32) for a float64 init_model.
+        rng = np.random.default_rng(13)
+        cols = self._cols(rng, 256, 600, 4)
+        with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
+            dtypes = {}
+            for kernel in ("onehot", "scatter"):
+                cache = DeviceDataCache(cols, ctx=ctx)
+                coef = SGD(
+                    max_iter=2, global_batch_size=64, ctx=ctx, sparse_kernel=kernel
+                ).optimize(
+                    np.zeros(600, np.float64), cache, BinaryLogisticLoss.INSTANCE
+                )
+                dtypes[kernel] = coef.dtype
+            assert dtypes["onehot"] == dtypes["scatter"] == np.float32

@@ -50,6 +50,23 @@ def test_uneven_sequence_rejected():
         ring_attention_sharded(q, q, q)
 
 
+def test_flash_gate_rejects_unverified_boundary_shapes():
+    # Shapes past T_local=8192 stage VMEM the scoped limit does not cover
+    # (e.g. T=16384, D=64: a [256, 16384] f32 score buffer plus full KV) and
+    # were never compile-verified on chip — the gate must refuse them so the
+    # caller falls back to the jnp fold rather than fail Mosaic compilation.
+    from flink_ml_tpu.parallel.flash import TQ_TILE, flash_available
+
+    class FakeTpu:
+        device_kind = "TPU v5 lite"
+
+    devs = [FakeTpu()]
+    assert flash_available(8192, 128, devs)  # the hardware-measured shape
+    assert not flash_available(16384, 64, devs)  # boundary: rejected
+    assert not flash_available(8192, 256, devs)  # KV budget still enforced
+    assert not flash_available(TQ_TILE - 1, 64, devs)  # tiling still enforced
+
+
 def test_padded_sequence_with_n_valid_matches_dense():
     rng = np.random.default_rng(2)
     B, T_real, H, D = 1, 50, 2, 8
