@@ -66,6 +66,51 @@ def _median_time(fn, repeats=3):
     return sorted(times)[len(times) // 2]
 
 
+def cpu_env() -> dict:
+    """The baseline environment record: which CPU, how many cores, how loaded.
+    The reference fixes its measurement procedure (BenchmarkUtils.java:132-143);
+    this pins the other half — what the baseline actually ran on."""
+    model = "unknown"
+    try:
+        for line in open("/proc/cpuinfo"):
+            if line.startswith("model name"):
+                model = line.split(":", 1)[1].strip()
+                break
+    except OSError:
+        pass
+    try:
+        load1 = float(open("/proc/loadavg").read().split()[0])
+    except (OSError, ValueError):
+        load1 = None
+    import os
+
+    return {"cpu_model": model, "cpu_cores": os.cpu_count(), "loadavg_1m": load1}
+
+
+def pinned_baseline(step_fn, rows_per_call: int, n_runs: int = 5, calls_per_run: int = 3):
+    """Best-of-N CPU-baseline protocol: ``n_runs`` independent measurements
+    of ``calls_per_run`` steps each on a shared, noisy box; the HEADLINE
+    divides by the STRONGEST run (the most conservative ratio for us), and
+    the spread is recorded so readers see the noise instead of guessing.
+    Returns (best_rows_per_sec, spread_dict)."""
+    step_fn()  # warm caches
+    rates = []
+    for _ in range(n_runs):
+        t0 = time.perf_counter()
+        for _ in range(calls_per_run):
+            step_fn()
+        rates.append(calls_per_run * rows_per_call / (time.perf_counter() - t0))
+    best = max(rates)
+    spread = {
+        "best_rows_per_sec": round(best, 1),
+        "min_rows_per_sec": round(min(rates), 1),
+        "median_rows_per_sec": round(sorted(rates)[len(rates) // 2], 1),
+        "n_runs": n_runs,
+        "env": cpu_env(),
+    }
+    return best, spread
+
+
 def bench_logreg(peak_flops, peak_gbps):
     from flink_ml_tpu.api.dataframe import DataFrame
     from flink_ml_tpu.iteration import DeviceDataCache
@@ -128,29 +173,25 @@ def bench_logreg(peak_flops, peak_gbps):
     return out, (X, y)
 
 
-def bench_logreg_cpu_baseline(X, y, batch=65_536, step_cap=30):
+def bench_logreg_cpu_baseline(X, y, batch=65_536):
     """Same minibatch-SGD semantics in numpy on the host CPU (the stand-in for
-    the reference's CPU TaskManager), measured marginally like the TPU number
-    (the same dataset, already resident in RAM)."""
+    the reference's CPU TaskManager), measured with the pinned best-of-N
+    protocol (the same dataset, already resident in RAM)."""
     n, d = X.shape
     coef = np.zeros(d, np.float32)
     offset = 0
 
-    def steps(k):
+    def step():
         nonlocal coef, offset
-        for _ in range(k):
-            Xb, yb = X[offset : offset + batch], y[offset : offset + batch]
-            ys = 2.0 * yb - 1.0
-            z = (Xb @ coef) * ys
-            mult = -ys / (1.0 + np.exp(z))
-            grad = Xb.T @ mult
-            coef = coef - 0.1 / len(Xb) * grad
-            offset = 0 if offset + batch >= n else offset + batch
+        Xb, yb = X[offset : offset + batch], y[offset : offset + batch]
+        ys = 2.0 * yb - 1.0
+        z = (Xb @ coef) * ys
+        mult = -ys / (1.0 + np.exp(z))
+        grad = Xb.T @ mult
+        coef = coef - 0.1 / len(Xb) * grad
+        offset = 0 if offset + batch >= n else offset + batch
 
-    steps(3)  # warm caches
-    t0 = time.perf_counter()
-    steps(step_cap)
-    return step_cap * batch / (time.perf_counter() - t0)
+    return pinned_baseline(step, batch, n_runs=5, calls_per_run=10)
 
 
 def bench_logreg_sparse(peak_flops):
@@ -194,10 +235,11 @@ def bench_logreg_sparse(peak_flops):
     flops_per_step = 4.0 * batch * K
 
     # Same-semantics CPU step (gather-dot, np.add.at scatter, full coefficient
-    # update, batch-offset cycling), marginal like the TPU number. The TPU
-    # side auto-selects the one-hot matmul path (linalg/onehot_sparse.py,
-    # Pallas crossings) — the step is crossing-bound; docs/benchmarks.md has
-    # the roofline and the multi-chip scaling argument.
+    # update, batch-offset cycling), measured with the pinned best-of-N
+    # protocol. The TPU side auto-selects the one-hot matmul path
+    # (linalg/onehot_sparse.py, Pallas crossings) — the step is
+    # crossing-bound; docs/benchmarks.md has the roofline and the multi-chip
+    # scaling artifact.
     coef = np.zeros(d, np.float32)
     offset = 0
 
@@ -216,20 +258,18 @@ def bench_logreg_sparse(peak_flops):
         coef = coef - (0.5 / len(yb)) * grad
         offset = 0 if offset + batch >= n else offset + batch
 
-    cpu_step()
-    t0 = time.perf_counter()
-    for _ in range(3):
-        cpu_step()
-    cpu_step_s = (time.perf_counter() - t0) / 3
+    cpu_best, cpu_spread = pinned_baseline(cpu_step, batch, n_runs=5, calls_per_run=3)
 
     out = {
         "name": "logreg_sparse_fit_250k_d4M_nnz39_b65536",
         "steady_rows_per_sec": round(batch / step_s, 1),
         "step_time_us": round(step_s * 1e6, 1),
         "achieved_gflops": round(flops_per_step / step_s / 1e9, 2),
-        "cpu_baseline_rows_per_sec": round(batch / cpu_step_s, 1),
-        "vs_cpu_baseline": round(cpu_step_s / step_s, 2),
-        "note": "padded-CSR; densified this batch would be ~1 TB/step",
+        "cpu_baseline_rows_per_sec": round(cpu_best, 1),
+        "cpu_baseline_spread": cpu_spread,
+        "vs_cpu_baseline": round((batch / step_s) / cpu_best, 2),
+        "note": "padded-CSR; densified this batch would be ~1 TB/step; "
+        "ratio divides by the STRONGEST of 5 baseline runs",
     }
     if peak_flops:
         out["mfu"] = round(flops_per_step / step_s / peak_flops, 8)
@@ -239,12 +279,16 @@ def bench_logreg_sparse(peak_flops):
 def bench_logreg_sparse_streamed():
     """The north-star rehearsal: every Criteo ingredient run TOGETHER —
     streamed (larger-than-HBM windows out of a spilling host cache) + sparse
-    (padded-CSR) + fused (chunked scan per window) — on the real chip.
+    (padded-CSR) + fused — now on the ONE-HOT matmul kernel (the streamed
+    path auto-selects it since round 4; windows share one compiled program
+    through the global OneHotSparsePlan).
 
     Row count is scaled to the dev tunnel (~25 MB/s host->device): the
-    machinery is what's under test; per-row cost is shape-invariant. The
-    ingest/compute split measures the scatter-gradient step the streamed
-    program runs, on a window-sized resident cache.
+    machinery is what's under test; per-row cost is shape-invariant. Three
+    numbers matter: the streamed one-hot step time (must be comparable to
+    the resident path's), the scatter step it replaced, and the overlap
+    efficiency — the fraction of compute the prefetch actually hides behind
+    ingest (wall ≈ ingest when overlap is perfect and ingest dominates).
     """
     import tempfile
 
@@ -274,19 +318,63 @@ def bench_logreg_sparse_streamed():
             )
         cache.finish()
 
-        sgd = SGD(
-            max_iter=epochs,
-            global_batch_size=batch,
-            tol=0.0,
-            learning_rate=0.5,
-            stream_window_rows=window,
-        )
-        t0 = time.perf_counter()
-        sgd.optimize(np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE)
-        wall = time.perf_counter() - t0
+        def streamed_fit(kernel):
+            sgd = SGD(
+                max_iter=epochs,
+                global_batch_size=batch,
+                tol=0.0,
+                learning_rate=0.5,
+                stream_window_rows=window,
+                sparse_kernel=kernel,
+            )
+            t0 = time.perf_counter()
+            sgd.optimize(np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+            return time.perf_counter() - t0
 
-    # The compute half, measured directly: the same scatter-gradient program
-    # the streamed dispatch runs, on one window-sized resident cache.
+        streamed_fit("scatter")  # warm-up: program compile
+        wall_scatter = streamed_fit("scatter")
+        streamed_fit("onehot")  # warm-up: plan + program compile
+        wall = streamed_fit("onehot")
+
+        # Pure-ingest time: load the windows the run actually loads (dedup
+        # consecutive same-window runs — run_windows keeps those resident),
+        # no compute. The counting pass the fit repeats is timed separately
+        # and removed from wall for the overlap accounting — it is neither
+        # ingest nor compute, and it runs before any window exists.
+        from flink_ml_tpu.iteration.streaming import WindowSchedule
+        from flink_ml_tpu.linalg.onehot_sparse import BLOCK, SUB_ROWS
+        from flink_ml_tpu.ops.optimizer import _OneHotWindowStream, streamed_onehot_plan
+        from flink_ml_tpu.parallel.mesh import get_mesh_context
+
+        ctx = get_mesh_context()
+        m_shard = -(-n // ctx.n_data)
+        b_local = -(-batch // ctx.n_data)
+        sub = min(SUB_ROWS, b_local)
+        W = WindowSchedule(m_shard, b_local, window, epochs).window
+        t0 = time.perf_counter()
+        plan = streamed_onehot_plan(cache, n, ctx.n_data, W, b_local, d)
+        plan_s = time.perf_counter() - t0
+        n_sub = -(-b_local // sub)
+        flops = 4.0 * n_sub * plan.n_flat * (sub + 2 * BLOCK)
+        sched = WindowSchedule(
+            m_shard, b_local, window, epochs, flops_per_epoch=flops
+        )
+        stream = _OneHotWindowStream(
+            cache, ctx, plan, sched.window, b_local, n_sub, m_shard, n,
+        )
+        visited = [j for j, _ in sched.runs]
+        loads = [j for i, j in enumerate(visited) if i == 0 or j != visited[i - 1]]
+        t0 = time.perf_counter()
+        for j in loads:
+            import jax
+
+            buf = stream.load(j)
+            jax.block_until_ready(buf["labels"])
+        ingest_s = time.perf_counter() - t0
+
+    # The compute half, measured directly: the one-hot program on a
+    # window-sized resident cache — the VERDICT's "comparable to the
+    # resident path" criterion, plus the scatter step it replaced.
     rng2 = np.random.default_rng(8)
     widx = rng2.integers(0, d, size=(window, K), dtype=np.int32)
     wvals = np.ones((window, K), np.float32)
@@ -300,32 +388,75 @@ def bench_logreg_sparse_streamed():
         }
     )
 
-    def wsteps(iters):
-        # sparse_kernel="scatter": the streamed program this proxies keeps the
-        # scatter gradient (windows change every visit — no static layout)
+    def wsteps(kernel, iters):
         SGD(
             max_iter=iters, global_batch_size=batch, tol=0.0, learning_rate=0.5,
-            sparse_kernel="scatter",
+            sparse_kernel=kernel,
         ).optimize(np.zeros(d, np.float32), wcache, BinaryLogisticLoss.INSTANCE)
 
-    t1 = _median_time(lambda: wsteps(10))
-    t2 = _median_time(lambda: wsteps(40))
-    scatter_step_s = max((t2 - t1) / 30, 1e-9)
+    step_us = {}
+    for kernel in ("onehot", "scatter"):
+        t1 = _median_time(lambda: wsteps(kernel, 10))
+        t2 = _median_time(lambda: wsteps(kernel, 40))
+        step_us[kernel] = max((t2 - t1) / 30, 1e-9) * 1e6
 
+    compute_s = epochs * step_us["onehot"] / 1e6
+    wall_train = max(wall - plan_s, 1e-9)  # windows-phase wall: counting pass excluded
+    overlap = (compute_s + ingest_s - wall_train) / max(min(compute_s, ingest_s), 1e-9)
     rows_consumed = epochs * batch
-    compute_s = epochs * scatter_step_s
     return {
         "name": "logreg_sparse_streamed_250k_d4M_w125k",
         "wall_time_s": round(wall, 2),
+        "wall_time_s_scatter": round(wall_scatter, 2),
+        "plan_pass_s": round(plan_s, 2),
         "epochs": epochs,
         "window_rows": window,
         "e2e_rows_per_sec": round(rows_consumed / wall, 1),
-        "scatter_step_us": round(scatter_step_s * 1e6, 1),
-        "compute_share": round(compute_s / wall, 4),
-        "ingest_share": round(1.0 - compute_s / wall, 4),
-        "note": "streamed+sparse+fused together; windows re-cross the dev "
-        "tunnel every epoch (~25 MB/s), so this is ingest-bound here",
+        "onehot_step_us": round(step_us["onehot"], 1),
+        "scatter_step_us": round(step_us["scatter"], 1),
+        "onehot_vs_scatter_step": round(step_us["scatter"] / step_us["onehot"], 2),
+        "ingest_s": round(ingest_s, 2),
+        "compute_s": round(compute_s, 2),
+        "compute_share": round(compute_s / wall_train, 4),
+        "ingest_share": round(ingest_s / wall_train, 4),
+        "overlap_efficiency": round(min(max(overlap, 0.0), 1.0), 3),
+        "note": "streamed+sparse+fused on the one-hot kernel; windows re-cross "
+        "the dev tunnel every epoch (~25 MB/s) so wall is ingest-bound here — "
+        "overlap_efficiency is the fraction of compute hidden behind ingest; "
+        "see streamed_overlap_cpu_mesh for the tunnel-free overlap artifact",
     }
+
+
+def bench_streamed_overlap_cpu_mesh():
+    """Run tools/bench_streamed_overlap.py in a tunnel-free subprocess on the
+    8-device virtual CPU mesh (see that module's docstring — the dev tunnel
+    makes overlap unmeasurable on the real chip from this box)."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": (
+                env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+            ).strip(),
+            "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+        }
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "tools/bench_streamed_overlap.py"],
+            capture_output=True, text=True, timeout=1200, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # never sink the whole bench for the side artifact
+        return {
+            "name": "streamed_overlap_cpu_mesh_196k_d256k",
+            "error": f"{type(e).__name__}: {e}",
+        }
 
 
 def bench_mlp_train(peak_flops):
@@ -547,12 +678,14 @@ def main() -> None:
     peak_bw = _PEAK_HBM_GBPS.get(kind)
 
     logreg, (X, y) = bench_logreg(peak, peak_bw)
-    cpu_rows = bench_logreg_cpu_baseline(X, y)
+    cpu_rows, cpu_spread = bench_logreg_cpu_baseline(X, y)
     logreg["cpu_baseline_rows_per_sec"] = round(cpu_rows, 1)
+    logreg["cpu_baseline_spread"] = cpu_spread
     logreg["vs_cpu_baseline"] = round(logreg["steady_rows_per_sec"] / cpu_rows, 2)
     del X, y
     sparse = bench_logreg_sparse(peak)
     sparse_streamed = bench_logreg_sparse_streamed()
+    overlap = bench_streamed_overlap_cpu_mesh()
     kmeans = bench_kmeans(peak_bw)
     mlp = bench_mlp_forward(peak)
     mlp_train = bench_mlp_train(peak)
@@ -562,7 +695,9 @@ def main() -> None:
         "device_kind": kind,
         "peak_bf16_flops": peak,
         "peak_hbm_gbps": peak_bw,
-        "workloads": [logreg, sparse, sparse_streamed, kmeans, mlp, mlp_train, attention],
+        "workloads": [
+            logreg, sparse, sparse_streamed, overlap, kmeans, mlp, mlp_train, attention
+        ],
     }
     with open("BENCH_DETAIL.json", "w") as f:
         json.dump(detail, f, indent=2)

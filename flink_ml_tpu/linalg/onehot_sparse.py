@@ -48,7 +48,7 @@ docs/benchmarks.md for the roofline and the multi-chip scaling argument).
 """
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,27 +57,49 @@ import numpy as np
 from flink_ml_tpu.parallel.mesh import vma_of as _vma_of_shared
 from flink_ml_tpu.utils.arrays import group_ranks, next_pow2
 
-__all__ = ["OneHotSparseLayout", "onehot_batch_step", "SUB_ROWS", "BLOCK"]
+__all__ = [
+    "OneHotSparseLayout", "OneHotSparsePlan", "onehot_batch_step",
+    "block_counts", "validate_indices", "SUB_ROWS", "BLOCK",
+]
 
 BLOCK = 128  # feature-block width: the VPU lane count
 SUB_ROWS = 16384  # sub-batch rows per crossing (gradient accumulation grain)
 _ROW_LO = 128  # row-id split minor width
 
 
-class OneHotSparseLayout:
-    """Static host-built layout for one dataset + minibatch schedule.
+def validate_indices(indices: np.ndarray, dim: int) -> None:
+    if indices.size and (np.any(indices < 0) or np.any(indices >= dim)):
+        bad_lo, bad_hi = indices.min(), indices.max()
+        raise ValueError(f"feature index out of range [0, {dim}): [{bad_lo}, {bad_hi}]")
+
+
+def block_counts(indices: np.ndarray, values: np.ndarray, nblk: int) -> np.ndarray:
+    """Per-feature-block nonzero-entry counts for one sub-batch unit
+    (``[rows, K]`` padded-CSR slices; value 0 = padding)."""
+    blocks = np.asarray(indices, np.int64)[np.asarray(values) != 0.0] // BLOCK
+    return np.bincount(blocks, minlength=nblk)
+
+
+class OneHotSparsePlan:
+    """The global static class structure one compiled program is keyed on.
+
+    Built from *per-block maximum entry counts over every sub-batch unit the
+    plan will ever serve* — the resident path's units, or every
+    (shard, window, minibatch, sub) unit of a streamed run. Because the
+    class metadata depends only on those maxima, any unit whose counts fit
+    the plan can be transposed into stacks later (``fill_unit``) and
+    executed by the same program: this is the window-stable layout contract
+    that lets the streamed (larger-than-HBM) path run the one-hot kernel
+    with ONE compilation serving every window.
 
     ``class_meta``: tuple of ``(n_blocks, width, flat_offset, block_offset)``
-    per occupancy class — shared by every (shard, window, sub-batch) so one
-    compiled program serves them all. ``lidx/rhi/rlo/lvals`` are
-    ``[n_shards, n_windows, n_sub, n_flat]`` stacks (int32/f32); ``perm`` /
-    ``inv_perm`` map block ids between original and class-major order.
+    per pow2 occupancy class; ``perm``/``inv_perm`` map block ids between
+    original and class-major order.
     """
 
     __slots__ = (
-        "dim", "n_shards", "n_windows", "n_sub", "n_flat", "nblk",
-        "class_meta", "perm", "inv_perm", "lidx", "rhi", "rlo", "lvals",
-        "window_starts", "local_batch", "sub_batch",
+        "dim", "nblk", "sub_batch", "n_flat", "class_meta",
+        "perm", "inv_perm", "base_of_block", "width_of_pos",
     )
 
     def __init__(self, **kw):
@@ -85,66 +107,13 @@ class OneHotSparseLayout:
             setattr(self, k, kw[k])
 
     @classmethod
-    def build(
-        cls,
-        indices: np.ndarray,
-        values: np.ndarray,
-        dim: int,
-        n_shards: int,
-        local_batch: int,
-        sub_rows: int = SUB_ROWS,
-    ) -> "OneHotSparseLayout":
-        """Transpose a padded-CSR batch ([n, K] indices/values, value 0 =
-        padding) into per-(shard, window, sub-batch) class-major block
-        layouts. Windows are the distinct minibatch slice starts of
-        ``offset_schedule`` (contiguous ``local_batch`` rows, tail clamped).
-        """
-        from flink_ml_tpu.ops.optimizer import offset_schedule
-
-        indices = np.asarray(indices, np.int64)
-        values = np.asarray(values)
-        n = indices.shape[0]
-        m = -(-n // n_shards)  # local rows per shard (cache pads to this)
-        local_batch = min(local_batch, m)
-        sub = min(sub_rows, local_batch)
-        n_sub = -(-local_batch // sub)
-
-        # Distinct windows, in first-visit order, from the canonical schedule.
-        starts, _ = offset_schedule(m, local_batch, max(1, -(-m // local_batch)))
-        window_starts = list(dict.fromkeys(int(s) for s in starts))
-        n_windows = len(window_starts)
-
+    def from_max_counts(
+        cls, max_count: np.ndarray, dim: int, sub_batch: int
+    ) -> "OneHotSparsePlan":
         nblk = -(-dim // BLOCK)
-        if np.any(indices < 0) or np.any(indices >= dim):
-            bad_lo, bad_hi = indices.min(), indices.max()
-            raise ValueError(f"feature index out of range [0, {dim}): [{bad_lo}, {bad_hi}]")
-
-        # Pass 1: per-block max entry count over every (shard, window, sub).
-        max_count = np.zeros(nblk, np.int64)
-        units = []  # (shard, window, sub) -> (rows_rel, blocks, lanes, vals)
-        for s in range(n_shards):
-            lo_s = s * m
-            for w0 in window_starts:
-                for b0 in range(0, local_batch, sub):
-                    r0 = lo_s + w0 + b0
-                    r1 = min(r0 + sub, lo_s + min(w0 + local_batch, m), n)
-                    idx_u = indices[r0:r1]
-                    val_u = values[r0:r1]
-                    nz = val_u != 0.0
-                    rows_rel = np.repeat(
-                        np.arange(r1 - r0, dtype=np.int64), idx_u.shape[1]
-                    ).reshape(idx_u.shape)[nz]
-                    feats = idx_u[nz]
-                    blocks = feats // BLOCK
-                    lanes = (feats % BLOCK).astype(np.int32)
-                    np.maximum(
-                        max_count, np.bincount(blocks, minlength=nblk), out=max_count
-                    )
-                    units.append((rows_rel, blocks, lanes, val_u[nz]))
-
-        occ = next_pow2(np.maximum(max_count, 0))
-        occ[max_count == 0] = 0  # empty blocks: zero slots (argsort puts
-        # them first in the class-major order; they own no flat range)
+        occ = next_pow2(np.maximum(np.asarray(max_count, np.int64), 0))
+        occ[np.asarray(max_count) == 0] = 0  # empty blocks: zero slots
+        # (argsort puts them first in class-major order; they own no range)
         order = np.argsort(occ, kind="stable")
         perm = order.astype(np.int32)  # class position -> original block id
         inv_perm = np.empty(nblk, np.int32)
@@ -165,34 +134,167 @@ class OneHotSparseLayout:
             flat_off += f_c * int(wdt)
         if flat_off == 0:
             raise ValueError("no nonzero entries; nothing to train on")
-        n_flat = flat_off
+        return cls(
+            dim=int(dim), nblk=nblk, sub_batch=int(sub_batch), n_flat=flat_off,
+            class_meta=tuple(class_meta), perm=perm, inv_perm=inv_perm,
+            base_of_block=base_of_block, width_of_pos=occ_sorted.astype(np.int64),
+        )
 
-        shape = (n_shards, n_windows, n_sub, n_flat)
+    @property
+    def row_hi(self) -> int:
+        """Row-space major width of one sub-batch (minor is ``_ROW_LO``)."""
+        return -(-self.sub_batch // _ROW_LO)
+
+    def stack_bytes(self, n_units: int) -> int:
+        """Host/HBM bytes of ``n_units`` sub-batch units' stacks
+        (3 int32 + 1 f32 per flat slot)."""
+        return 16 * n_units * self.n_flat
+
+    def fill_unit(self, idx_u, val_u, out_lidx, out_rhi, out_rlo, out_lvals) -> None:
+        """Transpose one sub-batch unit ([rows <= sub_batch, K] padded-CSR)
+        into its class-major [n_flat] stack slices (preallocated, zeroed).
+        Raises if any block's entry count exceeds its planned class width —
+        a unit outside the plan's counting pass must fail loudly, never
+        corrupt a neighbouring block's slots."""
+        idx_u = np.asarray(idx_u, np.int64)
+        val_u = np.asarray(val_u)
+        nz = val_u != 0.0
+        rows_rel = np.repeat(
+            np.arange(idx_u.shape[0], dtype=np.int64), idx_u.shape[1]
+        ).reshape(idx_u.shape)[nz]
+        feats = idx_u[nz]
+        lanes = (feats % BLOCK).astype(np.int32)
+        pos = self.inv_perm[feats // BLOCK].astype(np.int64)
+        o2 = np.argsort(pos, kind="stable")
+        sp = pos[o2]
+        ranks = group_ranks(sp)
+        if sp.size and int(np.max(ranks - self.width_of_pos[sp])) >= 0:
+            raise ValueError(
+                "sub-batch unit exceeds the plan's per-block occupancy — the "
+                "plan was built from a counting pass that did not cover this data"
+            )
+        slot = self.base_of_block[sp] + ranks
+        out_lidx[slot] = lanes[o2]
+        rr = rows_rel[o2]
+        out_rhi[slot] = (rr // _ROW_LO).astype(np.int32)
+        out_rlo[slot] = (rr % _ROW_LO).astype(np.int32)
+        out_lvals[slot] = val_u[nz][o2]
+
+    def permute_coef(self, coef: np.ndarray) -> np.ndarray:
+        """Original [dim] coefficient -> class-major padded [nblk * BLOCK]."""
+        c = np.zeros(self.nblk * BLOCK, np.asarray(coef).dtype)
+        c[: self.dim] = np.asarray(coef)
+        return c.reshape(self.nblk, BLOCK)[self.perm].reshape(-1)
+
+    def unpermute_coef(self, coef_perm: np.ndarray) -> np.ndarray:
+        """Class-major padded coefficient -> original [dim]."""
+        c = np.asarray(coef_perm).reshape(self.nblk, BLOCK)[self.inv_perm]
+        return c.reshape(-1)[: self.dim]
+
+    def program_key(self) -> tuple:
+        """The plan identity a compiled program depends on."""
+        return (self.dim, self.nblk, self.sub_batch, self.n_flat, self.class_meta)
+
+    def __repr__(self) -> str:
+        return (
+            f"OneHotSparsePlan(dim={self.dim}, sub={self.sub_batch}, "
+            f"flat={self.n_flat}, classes={[(f, w) for f, w, _, _ in self.class_meta]})"
+        )
+
+
+class OneHotSparseLayout:
+    """Static host-built layout for one resident dataset + minibatch schedule:
+    an ``OneHotSparsePlan`` plus the filled ``[n_shards, n_windows, n_sub,
+    n_flat]`` stacks. Windows are the distinct minibatch slice starts of
+    ``offset_schedule`` (contiguous ``local_batch`` rows, tail clamped)."""
+
+    __slots__ = (
+        "plan", "dim", "n_shards", "n_windows", "n_sub", "n_flat", "nblk",
+        "class_meta", "perm", "inv_perm", "lidx", "rhi", "rlo", "lvals",
+        "window_starts", "local_batch", "sub_batch",
+    )
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw[k])
+
+    @classmethod
+    def build(
+        cls,
+        indices: np.ndarray,
+        values: np.ndarray,
+        dim: int,
+        n_shards: int,
+        local_batch: int,
+        sub_rows: int = SUB_ROWS,
+        max_stack_bytes: Optional[int] = None,
+    ) -> Optional["OneHotSparseLayout"]:
+        """Transpose a padded-CSR batch ([n, K] indices/values, value 0 =
+        padding) into per-(shard, window, sub-batch) class-major block
+        layouts. With ``max_stack_bytes``, returns None instead of
+        materializing stacks that would exceed it (the size is known after
+        the counting pass, before any stack allocation)."""
+        from flink_ml_tpu.ops.optimizer import offset_schedule
+
+        indices = np.asarray(indices, np.int64)
+        values = np.asarray(values)
+        n = indices.shape[0]
+        m = -(-n // n_shards)  # local rows per shard (cache pads to this)
+        local_batch = min(local_batch, m)
+        sub = min(sub_rows, local_batch)
+        n_sub = -(-local_batch // sub)
+
+        # Distinct windows, in first-visit order, from the canonical schedule.
+        starts, _ = offset_schedule(m, local_batch, max(1, -(-m // local_batch)))
+        window_starts = list(dict.fromkeys(int(s) for s in starts))
+        n_windows = len(window_starts)
+
+        nblk = -(-dim // BLOCK)
+        validate_indices(indices, dim)
+
+        # Pass 1 (counting): per-block max entry count over every unit.
+        max_count = np.zeros(nblk, np.int64)
+        bounds = []  # unit -> (r0, r1) row range
+        for s in range(n_shards):
+            lo_s = s * m
+            for w0 in window_starts:
+                for b0 in range(0, local_batch, sub):
+                    r0 = lo_s + w0 + b0
+                    r1 = min(r0 + sub, lo_s + min(w0 + local_batch, m), n)
+                    np.maximum(
+                        max_count,
+                        block_counts(indices[r0:r1], values[r0:r1], nblk),
+                        out=max_count,
+                    )
+                    bounds.append((r0, r1))
+
+        plan = OneHotSparsePlan.from_max_counts(max_count, dim, sub)
+        n_units = n_shards * n_windows * n_sub
+        if max_stack_bytes is not None and plan.stack_bytes(n_units) > max_stack_bytes:
+            return None
+
+        shape = (n_shards, n_windows, n_sub, plan.n_flat)
         lidx = np.zeros(shape, np.int32)
         rhi = np.zeros(shape, np.int32)
         rlo = np.zeros(shape, np.int32)
-        lvals = np.zeros(shape, values.dtype)
-        unit_iter = iter(units)
+        lvals = np.zeros(shape, np.float32 if values.dtype.kind == "f" else values.dtype)
+        unit_iter = iter(bounds)
         for s in range(n_shards):
             for wi in range(n_windows):
                 for bi in range(n_sub):
-                    rows_rel, blocks, lanes, vals = next(unit_iter)
-                    pos = inv_perm[blocks].astype(np.int64)
-                    o2 = np.argsort(pos, kind="stable")
-                    sp = pos[o2]
-                    slot = base_of_block[sp] + group_ranks(sp)
-                    lidx[s, wi, bi, slot] = lanes[o2]
-                    rr = rows_rel[o2]
-                    rhi[s, wi, bi, slot] = (rr // _ROW_LO).astype(np.int32)
-                    rlo[s, wi, bi, slot] = (rr % _ROW_LO).astype(np.int32)
-                    lvals[s, wi, bi, slot] = vals[o2]
+                    r0, r1 = next(unit_iter)
+                    plan.fill_unit(
+                        indices[r0:r1], values[r0:r1],
+                        lidx[s, wi, bi], rhi[s, wi, bi],
+                        rlo[s, wi, bi], lvals[s, wi, bi],
+                    )
 
         return cls(
-            dim=int(dim), n_shards=n_shards, n_windows=n_windows, n_sub=n_sub,
-            n_flat=n_flat, nblk=nblk, class_meta=tuple(class_meta),
-            perm=perm, inv_perm=inv_perm, lidx=lidx, rhi=rhi, rlo=rlo,
-            lvals=lvals, window_starts=window_starts, local_batch=local_batch,
-            sub_batch=sub,
+            plan=plan, dim=int(dim), n_shards=n_shards, n_windows=n_windows,
+            n_sub=n_sub, n_flat=plan.n_flat, nblk=nblk,
+            class_meta=plan.class_meta, perm=plan.perm, inv_perm=plan.inv_perm,
+            lidx=lidx, rhi=rhi, rlo=rlo, lvals=lvals,
+            window_starts=window_starts, local_batch=local_batch, sub_batch=sub,
         )
 
     @property
@@ -205,15 +307,10 @@ class OneHotSparseLayout:
         return float(self.lvals.size) / max(nnz, 1.0)
 
     def permute_coef(self, coef: np.ndarray) -> np.ndarray:
-        """Original [dim] coefficient -> class-major padded [nblk * BLOCK]."""
-        c = np.zeros(self.nblk * BLOCK, np.asarray(coef).dtype)
-        c[: self.dim] = np.asarray(coef)
-        return c.reshape(self.nblk, BLOCK)[self.perm].reshape(-1)
+        return self.plan.permute_coef(coef)
 
     def unpermute_coef(self, coef_perm: np.ndarray) -> np.ndarray:
-        """Class-major padded coefficient -> original [dim]."""
-        c = np.asarray(coef_perm).reshape(self.nblk, BLOCK)[self.inv_perm]
-        return c.reshape(-1)[: self.dim]
+        return self.plan.unpermute_coef(coef_perm)
 
     def __repr__(self) -> str:
         return (

@@ -55,7 +55,13 @@ from flink_ml_tpu.iteration import (
     iterate_bounded_until_termination,
 )
 from flink_ml_tpu.ops.lossfunc import LossFunc
-from flink_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, MeshContext, get_mesh_context
+from flink_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshContext,
+    get_mesh_context,
+    is_tpu_backend,
+)
 
 __all__ = ["Optimizer", "SGD", "regularize"]
 
@@ -497,6 +503,164 @@ def _fused_onehot_program(
     return program
 
 
+def streamed_onehot_plan(cache, n_rows, n_data, window, local_batch, dim):
+    """One counting pass over a host-tier cache → the window-stable
+    ``OneHotSparsePlan`` serving every (shard, window, minibatch, sub) unit
+    of a streamed run. ``window`` must be the batch-aligned width the
+    matching ``WindowSchedule`` computes. Reads the cache once, one
+    minibatch at a time. Shared by ``SGD._optimize_streaming_onehot`` and
+    the benchmark probes (a plan built from less than the full cache would
+    reject units loudly at fill time)."""
+    from flink_ml_tpu.linalg.onehot_sparse import (
+        BLOCK,
+        SUB_ROWS,
+        OneHotSparsePlan,
+        block_counts,
+        validate_indices,
+    )
+
+    m = -(-n_rows // n_data)
+    b = local_batch
+    sub = min(SUB_ROWS, b)
+    nblk = -(-dim // BLOCK)
+    max_count = np.zeros(nblk, np.int64)
+    for k in range(n_data):
+        lo_s = k * m
+        hi_s = min(lo_s + m, n_rows)
+        for w0 in range(0, m, window):
+            for b0 in range(w0, min(w0 + window, m), b):
+                r0 = lo_s + b0
+                r1 = min(lo_s + b0 + b, hi_s)
+                if r1 <= r0:
+                    continue
+                got = cache.rows(r0, r1)
+                idx_mb = np.asarray(got["indices"], np.int64)
+                val_mb = np.asarray(got["values"])
+                validate_indices(idx_mb, dim)
+                for s0 in range(0, r1 - r0, sub):
+                    np.maximum(
+                        max_count,
+                        block_counts(
+                            idx_mb[s0 : s0 + sub], val_mb[s0 : s0 + sub], nblk
+                        ),
+                        out=max_count,
+                    )
+    return OneHotSparsePlan.from_max_counts(max_count, dim, sub)
+
+
+class _StreamedOnehotLayout:
+    """The layout identity `_fused_onehot_program` is keyed on, for the
+    streamed path: an ``OneHotSparsePlan`` plus this run's minibatch grid.
+    Within one resident window the minibatches play the resident layout's
+    window role (``window_starts[i] = i * local_batch``)."""
+
+    __slots__ = ("plan", "n_sub", "local_batch", "window_starts")
+
+    def __init__(self, plan, n_sub, local_batch, window_starts):
+        self.plan = plan
+        self.n_sub = n_sub
+        self.local_batch = local_batch
+        self.window_starts = window_starts
+
+    @property
+    def class_meta(self):
+        return self.plan.class_meta
+
+    @property
+    def n_flat(self):
+        return self.plan.n_flat
+
+    @property
+    def nblk(self):
+        return self.plan.nblk
+
+    @property
+    def sub_batch(self):
+        return self.plan.sub_batch
+
+    @property
+    def row_hi(self):
+        return self.plan.row_hi
+
+
+class _OneHotWindowStream:
+    """Streamed-window loader for the one-hot kernel: reads a host-cache
+    window, transposes every minibatch into plan-conformant stacks (on the
+    host, inside ``run_windows``'s prefetch gap — overlapping the device
+    compute of the previous window), and places stacks + labels/weights/mask
+    on the mesh. Drop-in for ``WindowedStream`` in ``run_windows``."""
+
+    def __init__(self, cache, ctx, plan, window, local_batch, n_sub, m, n):
+        self.cache = cache
+        self.ctx = ctx
+        self.plan = plan
+        self.window = int(window)
+        self.local_batch = int(local_batch)
+        self.n_sub = int(n_sub)
+        self.m = int(m)  # per-shard logical rows
+        self.n = int(n)
+
+    def load(self, j: int):
+        nd = self.ctx.n_data
+        W, b, m, n = self.window, self.local_batch, self.m, self.n
+        n_mb = -(-min(W, m) // b)
+        nf = self.plan.n_flat
+        lidx = np.zeros((nd, n_mb, self.n_sub, nf), np.int32)
+        rhi = np.zeros((nd, n_mb, self.n_sub, nf), np.int32)
+        rlo = np.zeros((nd, n_mb, self.n_sub, nf), np.int32)
+        lvals = np.zeros((nd, n_mb, self.n_sub, nf), np.float32)
+        y = np.zeros(nd * W, np.float32)
+        w = np.zeros(nd * W, np.float32)
+        mask = np.zeros(nd * W, np.float32)
+        for k in range(nd):
+            lo = k * m + j * W
+            hi = min(k * m + min((j + 1) * W, m), n)
+            if hi <= lo:
+                continue
+            got = self.cache.rows(lo, hi)
+            rows = hi - lo
+            sl = slice(k * W, k * W + rows)
+            y[sl] = np.asarray(got["labels"], np.float32)
+            w[sl] = (
+                np.asarray(got["weights"], np.float32)
+                if "weights" in got
+                else 1.0
+            )
+            mask[sl] = 1.0
+            idx_w = np.asarray(got["indices"])
+            val_w = np.asarray(got["values"])
+            sub = self.plan.sub_batch
+            for mb in range(n_mb):
+                r0 = mb * b
+                if r0 >= rows:
+                    break
+                r1 = min(r0 + b, rows)
+                # fill the preallocated window arrays in place (no per-
+                # minibatch staging copies on the prefetch-gap ingest path)
+                for bi in range(self.n_sub):
+                    s0 = r0 + bi * sub
+                    if s0 >= r1:
+                        break
+                    s1 = min(s0 + sub, r1)
+                    self.plan.fill_unit(
+                        idx_w[s0:s1], val_w[s0:s1],
+                        lidx[k, mb, bi], rhi[k, mb, bi],
+                        rlo[k, mb, bi], lvals[k, mb, bi],
+                    )
+        sh = self.ctx.sharding(DATA_AXIS)
+        return {
+            "stacks": (
+                jax.device_put(lidx, sh),
+                jax.device_put(rhi, sh),
+                jax.device_put(rlo, sh),
+                jax.device_put(lvals, sh),
+            ),
+            "labels": jax.device_put(y, self.ctx.batch),
+            "weights": jax.device_put(w, self.ctx.batch),
+            "__mask__": jax.device_put(mask, self.ctx.batch),
+        }
+
+
 class SGD(Optimizer):
     """Distributed minibatch SGD over the data-parallel mesh."""
 
@@ -849,15 +1013,21 @@ class SGD(Optimizer):
         if memo is not None and memo[0] == key and (memo[2] is not None or not force):
             return memo[1], memo[2]
         host = train_data.host_columns
-        lay = OneHotSparseLayout.build(
-            host["indices"], host["values"], dim, ctx.n_data, local_batch
-        )
         # Stacks shard over the data axis — each device holds 1/n_shards of
         # the 16 B/slot (3 int32 + 1 f32) total; budget the per-device slice.
-        per_shard_bytes = 16 * lay.lidx.size // max(1, lay.n_shards)
-        if not force and per_shard_bytes > self._ONEHOT_HBM_FRACTION * _hbm_bytes_limit():
-            # Record the decision only — keeping the rejected host stacks
-            # alive in the memo would double host RAM for the largest fits.
+        # The bound is applied inside build() right after the counting pass,
+        # BEFORE any stack materializes — an oversized layout must not cost
+        # a multi-GiB transient host allocation just to be rejected.
+        budget = (
+            None
+            if force
+            else int(self._ONEHOT_HBM_FRACTION * _hbm_bytes_limit()) * ctx.n_data
+        )
+        lay = OneHotSparseLayout.build(
+            host["indices"], host["values"], dim, ctx.n_data, local_batch,
+            max_stack_bytes=budget,
+        )
+        if lay is None:
             train_data._onehot_memo = (key, None, None)
             return None, None
         sh = ctx.sharding(DATA_AXIS)
@@ -918,6 +1088,162 @@ class SGD(Optimizer):
         # f32 here, the only dtype this kernel admits): auto-selection must
         # not change the output dtype for a float64 init_model.
         return lay.unpermute_coef(np.asarray(jax.device_get(coef)))
+
+    def _pick_onehot_streamed(self, model_sharded, n_rows, K, dim) -> bool:
+        """Whether a streamed sparse fit runs the one-hot matmul kernel.
+
+        The streamed layout contract is an ``OneHotSparsePlan`` built from a
+        counting pass over the whole cache, so one compiled program serves
+        every window (see OneHotSparsePlan). Same feasibility rules as the
+        resident gate: f32 only, no model sharding (yet)."""
+        if self.sparse_kernel == "scatter":
+            return False
+        feasible = (
+            not model_sharded
+            and jnp.dtype(self.dtype) == jnp.dtype(jnp.float32)
+        )
+        if self.sparse_kernel == "onehot":
+            if not feasible:
+                raise ValueError(
+                    "sparse_kernel='onehot' on the streamed path requires an "
+                    "f32 fit on a non-model-sharded mesh; use 'auto' or "
+                    "'scatter' for this configuration"
+                )
+            return True
+        return feasible and n_rows * K >= 1 << 16 and dim >= self._ONEHOT_MIN_DIM
+
+    def _optimize_streaming_onehot(
+        self, init_model, cache, loss_func, ctx, local_batch, dim, check_loss, n_rows
+    ):
+        """The north-star combination: larger-than-HBM streamed sparse SGD on
+        the one-hot matmul kernel.
+
+        One counting pass over the cache sizes a global ``OneHotSparsePlan``
+        (per-block max entry count over every (shard, window, minibatch, sub)
+        unit); every window's stacks are then host-built against that plan —
+        during the prefetch gap, overlapping device compute — and executed by
+        ONE compiled program (`_fused_onehot_program` keyed on the plan, with
+        the window's minibatches playing the resident path's window role).
+        Returns None when 'auto' finds the resident per-window stacks would
+        overrun HBM (the caller falls back to the scatter kernel).
+
+        Ref: SGD.java:157-364 caches + replays per-partition data for every
+        training config; BASELINE.json's north star is exactly this shape.
+        """
+        from flink_ml_tpu.iteration.streaming import WindowSchedule, run_windows
+        from flink_ml_tpu.linalg.onehot_sparse import BLOCK, SUB_ROWS
+
+        nd = ctx.n_data
+        m = -(-n_rows // nd)
+        b = local_batch
+        # Window width: the same batch-aligned rule WindowSchedule applies.
+        W = max(b, min(int(self.stream_window_rows), m))
+        W = -(-W // b) * b
+        n_mb = -(-min(W, m) // b)
+        sub = min(SUB_ROWS, b)
+        n_sub = -(-b // sub)
+        plan = streamed_onehot_plan(cache, n_rows, nd, W, b, dim)
+
+        # Two windows of stacks are HBM-resident at once (prefetch overlap).
+        if self.sparse_kernel != "onehot":
+            per_dev = 2 * plan.stack_bytes(n_mb * n_sub)
+            if per_dev > self._ONEHOT_HBM_FRACTION * _hbm_bytes_limit():
+                return None
+
+        flops = 4.0 * n_sub * plan.n_flat * (sub + 2 * BLOCK)
+        sched = WindowSchedule(
+            m, b, self.stream_window_rows, self.max_iter,
+            check_loss=check_loss, flops_per_epoch=flops,
+        )
+        assert sched.window == W, (sched.window, W)
+        # Within one resident window, the minibatches ARE the program's
+        # "windows": start of minibatch i is i*b, selected by win_idx = start//b.
+        layout_view = _StreamedOnehotLayout(
+            plan=plan, n_sub=n_sub, local_batch=b,
+            window_starts=tuple(i * b for i in range(n_mb)),
+        )
+        program = _fused_onehot_program(
+            ctx, loss_func, layout_view, sched.chunk_len, self.learning_rate,
+            self.reg, self.elastic_net, self.tol if check_loss else None,
+            use_pallas=is_tpu_backend(ctx.mesh.devices.flat),
+        )
+        stream = _OneHotWindowStream(cache, ctx, plan, W, b, n_sub, m, n_rows)
+
+        mgr = self.checkpoint_manager
+        start_run = 0
+        coef_host = np.asarray(init_model, np.float32)[:dim]
+        done_host = np.asarray(False)
+        self.loss_history = []
+        if mgr is not None:
+            mgr.set_fingerprint(
+                self._run_fingerprint(
+                    loss_func, ctx, n_rows, dim,
+                    extra={"window": W, "streamed": True, "kernel": "onehot"},
+                )
+            )
+            restored = mgr.restore_latest()
+            if restored is not None:
+                _, st = restored
+                start_run = int(st["next_run"])
+                coef_host = np.asarray(st["coef"], np.float32)
+                done_host = np.asarray(bool(st["done"]))
+                self.loss_history = [float(x) for x in st["loss_history"]]
+
+        state = {
+            "coef": ctx.replicate(plan.permute_coef(coef_host)),
+            "done": ctx.replicate(done_host),
+            "epochs": sum(len(s) for _, s in sched.runs[:start_run]),
+            "last_saved": None,
+        }
+        pending_losses: List[tuple] = []
+
+        def dispatch(i, win, starts_c, active_c, n_active):
+            win_idx_c = (starts_c // b).astype(np.int32)
+            # starts double as offsets, like the scatter streamed path: the
+            # window's zero-mask padding realizes the short tail batch.
+            state["coef"], state["done"], losses, n_exec = program(
+                state["coef"], state["done"], win_idx_c, starts_c, active_c,
+                *win["stacks"], win["labels"], win["weights"], win["__mask__"],
+            )
+            state["epochs"] += n_active
+
+            def observe():
+                stop = False
+                if check_loss:
+                    n = int(jax.device_get(n_exec))
+                    chunk_losses = np.asarray(jax.device_get(losses), np.float64)
+                    self.loss_history.extend(float(x) for x in chunk_losses[:n])
+                    stop = n < n_active
+                else:
+                    pending_losses.append((losses, n_exec))
+                if mgr is not None and self.checkpoint_interval > 0:
+                    last = state["last_saved"]
+                    if last is None or state["epochs"] - last >= self.checkpoint_interval:
+                        mgr.save(
+                            state["epochs"],
+                            {
+                                "next_run": i + 1,
+                                # store the logical (unpermuted, unpadded)
+                                # coefficient: restores must not depend on a
+                                # particular plan's block permutation
+                                "coef": plan.unpermute_coef(
+                                    np.asarray(jax.device_get(state["coef"]))
+                                ),
+                                "done": state["done"],
+                                "loss_history": np.asarray(self.loss_history, np.float64),
+                            },
+                        )
+                        state["last_saved"] = state["epochs"]
+                return stop
+
+            return observe
+
+        run_windows(stream, sched, dispatch, start_run=start_run)
+        for losses, n_exec in pending_losses:
+            n = int(jax.device_get(n_exec))
+            chunk_losses = np.asarray(jax.device_get(losses), np.float64)
+            self.loss_history.extend(float(x) for x in chunk_losses[:n])
+        return plan.unpermute_coef(np.asarray(jax.device_get(state["coef"])))
 
     def _optimize_host_loop(
         self, init_model, train_data, loss_func, ctx, step, local_batch,
@@ -1004,12 +1330,24 @@ class SGD(Optimizer):
                 "sparse_kernel='onehot' applies to sparse (indices/values) "
                 "training data; this fit has dense features"
             )
-        if sparse and self.sparse_kernel == "onehot":
-            raise ValueError(
-                "sparse_kernel='onehot' is not available on the streamed "
-                "(larger-than-HBM) path — windows change every visit, so no "
-                "static layout applies; use 'auto' or 'scatter'"
-            )
+        dim = int(np.asarray(init_model).shape[0])
+        check_loss = np.isfinite(self.tol) and self.tol > 0
+        # Model-axis sharding on the streamed path covers the sparse layout
+        # only (a wide streamed coefficient divides its scatter cost across
+        # n_model shards); streamed *dense* features keep a replicated
+        # coefficient — windows are ingested row-sharded, and resharding
+        # every window over the model axis would serialize the stream.
+        model_sharded = sparse and ctx.n_model > 1
+        if sparse:
+            K0 = int(np.asarray(row0["indices"]).shape[-1])
+            if self._pick_onehot_streamed(model_sharded, n_rows, K0, dim):
+                result = self._optimize_streaming_onehot(
+                    init_model, cache, loss_func, ctx, local_batch, dim,
+                    check_loss, n_rows,
+                )
+                if result is not None:
+                    return result
+                # auto: per-window stacks would overrun HBM — scatter instead
         if sparse:
             columns = {
                 "indices": "indices",
@@ -1022,7 +1360,6 @@ class SGD(Optimizer):
             columns = {"features": "features", "labels": "labels", "weights": "weights"}
             feat_keys = ("features",)
         K = int(np.asarray(row0["indices"]).shape[-1]) if sparse else 0
-        check_loss = np.isfinite(self.tol) and self.tol > 0
         stream, sched = plan_windows(
             cache,
             columns,
@@ -1036,13 +1373,6 @@ class SGD(Optimizer):
             serial_elems_per_epoch=2 * local_batch * K,
             check_loss=check_loss,
         )
-        # Model-axis sharding on the streamed path covers the sparse layout
-        # only (a wide streamed coefficient divides its scatter cost across
-        # n_model shards); streamed *dense* features keep a replicated
-        # coefficient — windows are ingested row-sharded, and resharding
-        # every window over the model axis would serialize the stream.
-        model_sharded = sparse and ctx.n_model > 1
-        dim = int(np.asarray(init_model).shape[0])
         program = _fused_sgd_program(
             ctx,
             loss_func,

@@ -1,0 +1,197 @@
+"""Streamed (larger-than-HBM) sparse SGD on the one-hot matmul kernel.
+
+The north-star combination (BASELINE.json): Criteo-shape sparse LR streamed
+from a host-tier cache, running the fast one-hot kernel instead of serialized
+scatter/gather. The contract: a global ``OneHotSparsePlan`` built from one
+counting pass serves every window with ONE compiled program, and the result
+matches both the resident one-hot path and the streamed scatter path.
+"""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.iteration import DeviceDataCache, HostDataCache
+from flink_ml_tpu.ops import SGD, BinaryLogisticLoss
+from flink_ml_tpu.parallel.mesh import MeshContext, mesh_context
+
+
+def _sparse_data(n, d, K, seed=0):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, d, size=(n, K)).astype(np.int32)
+    val = rng.normal(size=(n, K)).astype(np.float32)
+    val[rng.random((n, K)) < 0.15] = 0.0  # padding slots
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    return {"indices": idx, "values": val, "labels": y}
+
+
+def _fill(cache, cols, chunk=40):
+    n = len(cols["labels"])
+    for a in range(0, n, chunk):
+        cache.append({k: v[a : a + chunk] for k, v in cols.items()})
+    cache.finish()
+    return cache
+
+
+KW = dict(max_iter=12, global_batch_size=128, tol=0.0, learning_rate=0.3)
+
+
+def test_streamed_onehot_matches_streamed_scatter(tmp_path):
+    cols = _sparse_data(512, 2000, 6, seed=1)
+    cache = _fill(
+        HostDataCache(memory_budget_bytes=2000, spill_dir=str(tmp_path)), cols
+    )
+    assert any("files" in e for e in cache._log), "budget should force spill"
+    coefs, hists = {}, {}
+    for kernel in ("onehot", "scatter"):
+        sgd = SGD(stream_window_rows=32, sparse_kernel=kernel, **KW)
+        coefs[kernel] = sgd.optimize(
+            np.zeros(2000, np.float32), cache, BinaryLogisticLoss.INSTANCE
+        )
+        hists[kernel] = sgd.loss_history
+    np.testing.assert_allclose(coefs["onehot"], coefs["scatter"], rtol=1e-3, atol=1e-5)
+    assert len(hists["onehot"]) == len(hists["scatter"]) == KW["max_iter"]
+    np.testing.assert_allclose(hists["onehot"], hists["scatter"], rtol=1e-3)
+
+
+def test_streamed_onehot_matches_resident_onehot():
+    # 512 rows / 8 devices -> m=64; local batch 16 divides m evenly, so the
+    # streamed epochs consume exactly the resident rows and weights.
+    cols = _sparse_data(512, 2000, 6, seed=2)
+    resident = SGD(sparse_kernel="onehot", **KW)
+    want = resident.optimize(
+        np.zeros(2000, np.float32), dict(cols), BinaryLogisticLoss.INSTANCE
+    )
+    cache = _fill(HostDataCache(), cols)
+    streamed = SGD(stream_window_rows=32, sparse_kernel="onehot", **KW)
+    got = streamed.optimize(
+        np.zeros(2000, np.float32), cache, BinaryLogisticLoss.INSTANCE
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        streamed.loss_history, resident.loss_history, rtol=1e-4
+    )
+
+
+def test_streamed_onehot_ragged_tail_matches_scatter(tmp_path):
+    # 400 rows -> m=50 per shard with global padding; batch 16 does not
+    # divide evenly, exercising the masked short-tail epochs.
+    cols = _sparse_data(400, 1500, 5, seed=3)
+    cache = _fill(
+        HostDataCache(memory_budget_bytes=1500, spill_dir=str(tmp_path)), cols
+    )
+    coefs = {}
+    for kernel in ("onehot", "scatter"):
+        coefs[kernel] = SGD(
+            stream_window_rows=20, sparse_kernel=kernel, **KW
+        ).optimize(np.zeros(1500, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+    np.testing.assert_allclose(coefs["onehot"], coefs["scatter"], rtol=1e-3, atol=1e-5)
+
+
+def test_streamed_onehot_tol_stops_like_scatter():
+    cols = _sparse_data(512, 2000, 6, seed=4)
+    cache = _fill(HostDataCache(), cols)
+    hists = {}
+    for kernel in ("onehot", "scatter"):
+        sgd = SGD(
+            stream_window_rows=32, sparse_kernel=kernel,
+            max_iter=300, global_batch_size=512, tol=0.5, learning_rate=0.5,
+        )
+        sgd.optimize(np.zeros(2000, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+        hists[kernel] = sgd.loss_history
+    assert len(hists["onehot"]) < 300, "tol should stop early"
+    assert len(hists["onehot"]) == len(hists["scatter"])
+    np.testing.assert_allclose(hists["onehot"], hists["scatter"], rtol=1e-3)
+
+
+def test_streamed_onehot_checkpoint_resume(tmp_path):
+    from flink_ml_tpu.checkpoint import CheckpointManager
+
+    cols = _sparse_data(512, 2000, 6, seed=5)
+    cache = _fill(HostDataCache(), cols)
+    want = SGD(stream_window_rows=32, sparse_kernel="onehot", **KW).optimize(
+        np.zeros(2000, np.float32), cache, BinaryLogisticLoss.INSTANCE
+    )
+
+    ckdir = str(tmp_path / "ck")
+    got = SGD(
+        stream_window_rows=32, sparse_kernel="onehot",
+        checkpoint_manager=CheckpointManager(ckdir), checkpoint_interval=2, **KW
+    ).optimize(np.zeros(2000, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+    np.testing.assert_array_equal(got, want)
+
+    mgr = CheckpointManager(ckdir)
+    steps = mgr.all_steps()
+    assert len(steps) >= 2, "expected multiple checkpoints"
+    import shutil
+
+    shutil.rmtree(f"{ckdir}/ckpt-{steps[-1]}")
+    resumed = SGD(
+        stream_window_rows=32, sparse_kernel="onehot",
+        checkpoint_manager=CheckpointManager(ckdir), checkpoint_interval=2, **KW
+    ).optimize(np.zeros(2000, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+    np.testing.assert_array_equal(resumed, want)
+
+
+def test_streamed_auto_picks_onehot_for_wide_models(monkeypatch):
+    import flink_ml_tpu.ops.optimizer as om
+
+    calls = []
+    orig = om.SGD._optimize_streaming_onehot
+
+    def spy(self, *a, **k):
+        calls.append(1)
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(om.SGD, "_optimize_streaming_onehot", spy)
+    n, d, K = 2048, 1 << 15, 32  # n*K = 2^16, d >= 2^14
+    cols = _sparse_data(n, d, K, seed=6)
+    cache = _fill(HostDataCache(), cols, chunk=256)
+    coef = SGD(stream_window_rows=256, max_iter=3, global_batch_size=512, tol=0.0).optimize(
+        np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
+    )
+    assert calls, "auto should engage the one-hot kernel on the streamed path"
+    assert np.all(np.isfinite(coef))
+
+
+def test_streamed_auto_narrow_stays_on_scatter(monkeypatch):
+    import flink_ml_tpu.ops.optimizer as om
+
+    calls = []
+    monkeypatch.setattr(
+        om.SGD, "_optimize_streaming_onehot",
+        lambda self, *a, **k: calls.append(1) or None,
+    )
+    cols = _sparse_data(256, 500, 4, seed=7)  # narrow: scatter territory
+    cache = _fill(HostDataCache(), cols)
+    SGD(stream_window_rows=16, max_iter=2, global_batch_size=64, tol=0.0).optimize(
+        np.zeros(500, np.float32), cache, BinaryLogisticLoss.INSTANCE
+    )
+    assert not calls
+
+
+def test_streamed_auto_falls_back_when_stacks_exceed_hbm(monkeypatch):
+    import flink_ml_tpu.ops.optimizer as om
+
+    monkeypatch.setattr(om, "_hbm_bytes_limit", lambda: 1 << 16)
+    n, d, K = 2048, 1 << 15, 32
+    cols = _sparse_data(n, d, K, seed=8)
+    cache = _fill(HostDataCache(), cols, chunk=256)
+    coef = SGD(stream_window_rows=256, max_iter=2, global_batch_size=512, tol=0.0).optimize(
+        np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
+    )
+    assert np.all(np.isfinite(coef))  # scatter fallback trained
+
+
+def test_forced_streamed_onehot_infeasible_raises():
+    cols = _sparse_data(256, 500, 4, seed=9)
+    cache = _fill(HostDataCache(), cols)
+    # f64 fit: the MXU split-bf16 crossings reconstruct f32, not f64
+    with pytest.raises(ValueError, match="f32"):
+        SGD(
+            stream_window_rows=16, sparse_kernel="onehot", dtype=np.float64, **KW
+        ).optimize(np.zeros(500, np.float64), cache, BinaryLogisticLoss.INSTANCE)
+    # model-sharded (TP) streamed coefficient: not composed with one-hot yet
+    with mesh_context(MeshContext(n_data=4, n_model=2)) as ctx:
+        with pytest.raises(ValueError, match="model-sharded"):
+            SGD(
+                stream_window_rows=16, sparse_kernel="onehot", ctx=ctx, **KW
+            ).optimize(np.zeros(500, np.float32), cache, BinaryLogisticLoss.INSTANCE)
