@@ -92,14 +92,25 @@ class OneHotSparsePlan:
     that lets the streamed (larger-than-HBM) path run the one-hot kernel
     with ONE compilation serving every window.
 
-    ``class_meta``: tuple of ``(n_blocks, width, flat_offset, block_offset)``
-    per pow2 occupancy class; ``perm``/``inv_perm`` map block ids between
-    original and class-major order.
+    **Tensor parallelism** (``n_model > 1``): each occupancy class's block
+    count is padded to a multiple of ``n_model`` and its blocks dealt
+    round-robin to model shards, so every shard carries the SAME local
+    ``class_meta`` (shard_map traces one program for all shards) and owns a
+    contiguous local slice per class. ``class_meta``/``n_flat`` then
+    describe ONE shard's local layout; the coefficient lives shard-major
+    (``[n_model, nblk_local * BLOCK]`` flattened) and the row-crossing dot
+    assembles with a psum over the model axis (the gradient stays
+    block-local by construction).
+
+    ``class_meta``: tuple of ``(n_blocks_local, width, flat_offset,
+    block_offset)`` per pow2 occupancy class; ``perm``/``inv_perm`` map
+    block ids between original and class-major order.
     """
 
     __slots__ = (
-        "dim", "nblk", "sub_batch", "n_flat", "class_meta",
-        "perm", "inv_perm", "base_of_block", "width_of_pos",
+        "dim", "nblk", "nblk_local", "n_model", "sub_batch", "n_flat",
+        "class_meta", "perm", "inv_perm", "width_of_pos",
+        "owner_of_pos", "base_of_pos", "local_block_of_pos",
     )
 
     def __init__(self, **kw):
@@ -108,7 +119,7 @@ class OneHotSparsePlan:
 
     @classmethod
     def from_max_counts(
-        cls, max_count: np.ndarray, dim: int, sub_batch: int
+        cls, max_count: np.ndarray, dim: int, sub_batch: int, n_model: int = 1
     ) -> "OneHotSparsePlan":
         nblk = -(-dim // BLOCK)
         occ = next_pow2(np.maximum(np.asarray(max_count, np.int64), 0))
@@ -121,23 +132,42 @@ class OneHotSparsePlan:
         occ_sorted = occ[order]
 
         class_meta: List[Tuple[int, int, int, int]] = []
-        base_of_block = np.zeros(nblk, np.int64)  # flat slot of block's first entry
-        flat_off = 0
+        # Per class-major position p: which model shard owns the block, the
+        # shard-local flat slot of its first entry, and its shard-local
+        # block index. Round-robin within the class keeps every shard's
+        # local class slice contiguous AND identically sized (after pad).
+        owner_of_pos = np.zeros(nblk, np.int32)
+        base_of_pos = np.zeros(nblk, np.int64)
+        local_block_of_pos = np.zeros(nblk, np.int64)
+        flat_off = 0  # shard-LOCAL flat offset
+        block_off = 0  # shard-LOCAL block offset
         widths, first = np.unique(occ_sorted, return_index=True)
         ends = np.append(first[1:], nblk)
         for wdt, p0, p1 in zip(widths, first, ends):
-            if wdt == 0:
-                continue  # empty blocks own no slots
             f_c = int(p1 - p0)
-            class_meta.append((f_c, int(wdt), flat_off, int(p0)))
-            base_of_block[p0:p1] = flat_off + np.arange(f_c, dtype=np.int64) * int(wdt)
-            flat_off += f_c * int(wdt)
+            local_f = -(-f_c // n_model)  # padded: same local count per shard
+            rel = np.arange(f_c, dtype=np.int64)
+            owner_of_pos[p0:p1] = (rel % n_model).astype(np.int32)
+            local_block_of_pos[p0:p1] = block_off + rel // n_model
+            if wdt > 0:
+                # Empty (zero-width) classes own coefficient blocks but no
+                # flat slots and no class_meta round: their coefficients
+                # still live on the mesh (round-trip + regularization apply
+                # to never-observed features exactly like the scatter path)
+                # while gather/scatter rounds never touch them.
+                base_of_pos[p0:p1] = flat_off + (rel // n_model) * int(wdt)
+                class_meta.append((local_f, int(wdt), flat_off, block_off))
+                flat_off += local_f * int(wdt)
+            block_off += local_f
         if flat_off == 0:
             raise ValueError("no nonzero entries; nothing to train on")
         return cls(
-            dim=int(dim), nblk=nblk, sub_batch=int(sub_batch), n_flat=flat_off,
+            dim=int(dim), nblk=nblk, nblk_local=block_off, n_model=int(n_model),
+            sub_batch=int(sub_batch), n_flat=flat_off,
             class_meta=tuple(class_meta), perm=perm, inv_perm=inv_perm,
-            base_of_block=base_of_block, width_of_pos=occ_sorted.astype(np.int64),
+            width_of_pos=occ_sorted.astype(np.int64),
+            owner_of_pos=owner_of_pos, base_of_pos=base_of_pos,
+            local_block_of_pos=local_block_of_pos,
         )
 
     @property
@@ -146,16 +176,16 @@ class OneHotSparsePlan:
         return -(-self.sub_batch // _ROW_LO)
 
     def stack_bytes(self, n_units: int) -> int:
-        """Host/HBM bytes of ``n_units`` sub-batch units' stacks
-        (3 int32 + 1 f32 per flat slot)."""
-        return 16 * n_units * self.n_flat
+        """Host/HBM bytes of ``n_units`` sub-batch units' stacks across all
+        model shards (3 int32 + 1 f32 per flat slot)."""
+        return 16 * n_units * self.n_model * self.n_flat
 
     def fill_unit(self, idx_u, val_u, out_lidx, out_rhi, out_rlo, out_lvals) -> None:
         """Transpose one sub-batch unit ([rows <= sub_batch, K] padded-CSR)
-        into its class-major [n_flat] stack slices (preallocated, zeroed).
-        Raises if any block's entry count exceeds its planned class width —
-        a unit outside the plan's counting pass must fail loudly, never
-        corrupt a neighbouring block's slots."""
+        into its per-model-shard class-major stack slices (preallocated,
+        zeroed, shape [n_model, n_flat]). Raises if any block's entry count
+        exceeds its planned class width — a unit outside the plan's counting
+        pass must fail loudly, never corrupt a neighbouring block's slots."""
         idx_u = np.asarray(idx_u, np.int64)
         val_u = np.asarray(val_u)
         nz = val_u != 0.0
@@ -173,32 +203,46 @@ class OneHotSparsePlan:
                 "sub-batch unit exceeds the plan's per-block occupancy — the "
                 "plan was built from a counting pass that did not cover this data"
             )
-        slot = self.base_of_block[sp] + ranks
-        out_lidx[slot] = lanes[o2]
+        owner = self.owner_of_pos[sp]
+        slot = self.base_of_pos[sp] + ranks
+        out_lidx[owner, slot] = lanes[o2]
         rr = rows_rel[o2]
-        out_rhi[slot] = (rr // _ROW_LO).astype(np.int32)
-        out_rlo[slot] = (rr % _ROW_LO).astype(np.int32)
-        out_lvals[slot] = val_u[nz][o2]
+        out_rhi[owner, slot] = (rr // _ROW_LO).astype(np.int32)
+        out_rlo[owner, slot] = (rr % _ROW_LO).astype(np.int32)
+        out_lvals[owner, slot] = val_u[nz][o2]
 
     def permute_coef(self, coef: np.ndarray) -> np.ndarray:
-        """Original [dim] coefficient -> class-major padded [nblk * BLOCK]."""
-        c = np.zeros(self.nblk * BLOCK, np.asarray(coef).dtype)
-        c[: self.dim] = np.asarray(coef)
-        return c.reshape(self.nblk, BLOCK)[self.perm].reshape(-1)
+        """Original [dim] coefficient -> shard-major class-major padded
+        ``[n_model * nblk_local * BLOCK]`` (for n_model == 1 this is the
+        plain class-major permutation)."""
+        coef = np.asarray(coef)
+        c = np.zeros((self.nblk, BLOCK), coef.dtype)
+        c.reshape(-1)[: self.dim] = coef
+        out = np.zeros((self.n_model, self.nblk_local, BLOCK), coef.dtype)
+        pos = np.arange(self.nblk)
+        out[self.owner_of_pos[pos], self.local_block_of_pos[pos]] = c[self.perm]
+        return out.reshape(-1)
 
     def unpermute_coef(self, coef_perm: np.ndarray) -> np.ndarray:
-        """Class-major padded coefficient -> original [dim]."""
-        c = np.asarray(coef_perm).reshape(self.nblk, BLOCK)[self.inv_perm]
-        return c.reshape(-1)[: self.dim]
+        """Shard-major padded coefficient -> original [dim]."""
+        c = np.asarray(coef_perm).reshape(self.n_model, self.nblk_local, BLOCK)
+        pos = np.arange(self.nblk)
+        orig = np.zeros((self.nblk, BLOCK), c.dtype)
+        orig[self.perm] = c[self.owner_of_pos[pos], self.local_block_of_pos[pos]]
+        return orig.reshape(-1)[: self.dim]
 
     def program_key(self) -> tuple:
         """The plan identity a compiled program depends on."""
-        return (self.dim, self.nblk, self.sub_batch, self.n_flat, self.class_meta)
+        return (
+            self.dim, self.nblk, self.n_model, self.sub_batch, self.n_flat,
+            self.class_meta,
+        )
 
     def __repr__(self) -> str:
         return (
             f"OneHotSparsePlan(dim={self.dim}, sub={self.sub_batch}, "
-            f"flat={self.n_flat}, classes={[(f, w) for f, w, _, _ in self.class_meta]})"
+            f"flat={self.n_flat}, n_model={self.n_model}, "
+            f"classes={[(f, w) for f, w, _, _ in self.class_meta]})"
         )
 
 
@@ -210,8 +254,8 @@ class OneHotSparseLayout:
 
     __slots__ = (
         "plan", "dim", "n_shards", "n_windows", "n_sub", "n_flat", "nblk",
-        "class_meta", "perm", "inv_perm", "lidx", "rhi", "rlo", "lvals",
-        "window_starts", "local_batch", "sub_batch",
+        "n_model", "class_meta", "perm", "inv_perm", "lidx", "rhi", "rlo",
+        "lvals", "window_starts", "local_batch", "sub_batch",
     )
 
     def __init__(self, **kw):
@@ -228,10 +272,12 @@ class OneHotSparseLayout:
         local_batch: int,
         sub_rows: int = SUB_ROWS,
         max_stack_bytes: Optional[int] = None,
+        n_model: int = 1,
     ) -> Optional["OneHotSparseLayout"]:
         """Transpose a padded-CSR batch ([n, K] indices/values, value 0 =
-        padding) into per-(shard, window, sub-batch) class-major block
-        layouts. With ``max_stack_bytes``, returns None instead of
+        padding) into per-(data shard, model shard, window, sub-batch)
+        class-major block layouts (stacks [n_shards, n_model, n_windows,
+        n_sub, n_flat]). With ``max_stack_bytes``, returns None instead of
         materializing stacks that would exceed it (the size is known after
         the counting pass, before any stack allocation)."""
         from flink_ml_tpu.ops.optimizer import offset_schedule
@@ -268,12 +314,12 @@ class OneHotSparseLayout:
                     )
                     bounds.append((r0, r1))
 
-        plan = OneHotSparsePlan.from_max_counts(max_count, dim, sub)
+        plan = OneHotSparsePlan.from_max_counts(max_count, dim, sub, n_model)
         n_units = n_shards * n_windows * n_sub
         if max_stack_bytes is not None and plan.stack_bytes(n_units) > max_stack_bytes:
             return None
 
-        shape = (n_shards, n_windows, n_sub, plan.n_flat)
+        shape = (n_shards, n_model, n_windows, n_sub, plan.n_flat)
         lidx = np.zeros(shape, np.int32)
         rhi = np.zeros(shape, np.int32)
         rlo = np.zeros(shape, np.int32)
@@ -285,13 +331,13 @@ class OneHotSparseLayout:
                     r0, r1 = next(unit_iter)
                     plan.fill_unit(
                         indices[r0:r1], values[r0:r1],
-                        lidx[s, wi, bi], rhi[s, wi, bi],
-                        rlo[s, wi, bi], lvals[s, wi, bi],
+                        lidx[s, :, wi, bi], rhi[s, :, wi, bi],
+                        rlo[s, :, wi, bi], lvals[s, :, wi, bi],
                     )
 
         return cls(
             plan=plan, dim=int(dim), n_shards=n_shards, n_windows=n_windows,
-            n_sub=n_sub, n_flat=plan.n_flat, nblk=nblk,
+            n_sub=n_sub, n_flat=plan.n_flat, nblk=nblk, n_model=n_model,
             class_meta=plan.class_meta, perm=plan.perm, inv_perm=plan.inv_perm,
             lidx=lidx, rhi=rhi, rlo=rlo, lvals=lvals,
             window_starts=window_starts, local_batch=local_batch, sub_batch=sub,
@@ -301,6 +347,11 @@ class OneHotSparseLayout:
     def row_hi(self) -> int:
         """Row-space major width of one sub-batch (minor is ``_ROW_LO``)."""
         return -(-self.sub_batch // _ROW_LO)
+
+    @property
+    def nblk_local(self) -> int:
+        """One model shard's block count (== nblk padded when n_model == 1)."""
+        return self.plan.nblk_local
 
     def padding_ratio(self) -> float:
         nnz = float(np.count_nonzero(self.lvals))
@@ -546,16 +597,21 @@ def onehot_batch_step(
     sub_batch: int,
     row_hi: int,
     use_pallas: bool,
+    model_axis=None,
 ):
     """One full minibatch: per-sub-batch forward + crossing + backward,
     gradients accumulated, returning ``(grad_perm, loss_sum, weight_sum)``
     with exactly the scatter path's batch semantics.
 
-    ``lidx_w/rhi_w/rlo_w/lvals_w``: this window's ``[n_sub, n_flat]`` slices.
-    ``yb/wb``: the window's label/weight rows ``[local_batch]`` (wb already
-    carries the mask and tail gating — padded rows weigh 0, so their entries
-    contribute nothing, and padded entries carry value 0 on top).
-    """
+    ``lidx_w/rhi_w/rlo_w/lvals_w``: this window's ``[n_sub, n_flat]`` slices
+    (this model shard's, under TP). ``yb/wb``: the window's label/weight
+    rows ``[local_batch]`` (wb already carries the mask and tail gating —
+    padded rows weigh 0, so their entries contribute nothing, and padded
+    entries carry value 0 on top). ``nblk`` is the model shard's LOCAL
+    block count; ``model_axis`` names the mesh axis the partial row dots
+    assemble over (each shard's entries cover only its feature blocks —
+    one psum completes the margin, after which the loss multiplier is
+    replicated across the axis and the gradient is block-local)."""
     dot_cross = dot_crossing_pallas if use_pallas else dot_crossing_xla
     mult_cross = mult_crossing_pallas if use_pallas else mult_crossing_xla
     n_sub = lidx_w.shape[0]
@@ -565,6 +621,8 @@ def onehot_batch_step(
     g = gather_round(coef_perm, lidx_w, class_meta)  # [n_sub, n_flat]
     q = lvals_w * g
     dot3 = dot_cross(q, rhi_w, rlo_w, row_hi)  # [n_sub, row_hi, 128]
+    if model_axis is not None:
+        dot3 = jax.lax.psum(dot3, model_axis)
     dot = dot3.reshape(n_sub, row_hi * _ROW_LO)[:, :sub_batch].reshape(-1)
     loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
     mult3 = jnp.pad(

@@ -42,7 +42,38 @@ from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.params.param import FloatParam, IntParam, ParamValidators, StringParam
 from flink_ml_tpu.params.shared import HasOutputCol, HasSeed
 
-__all__ = ["Swing"]
+__all__ = ["Swing", "encode_topk"]
+
+
+def encode_topk(i_ids: np.ndarray, vals: np.ndarray, inds: np.ndarray):
+    """Vectorized Swing output encoding (ref Swing.java:344-361):
+    ``"id,score;id,score;..."`` per item, items with no positive-scored
+    neighbor omitted.
+
+    The per-pair formatting runs as numpy string kernels (int/float ->
+    unicode casts + ``np.char.add``) instead of a Python f-string per pair —
+    at a 1M-item catalog that is the difference between seconds and minutes
+    of host time. Float formatting matches ``str(np.float64)`` (the shortest
+    round-trip repr), which is what the f-string produced.
+
+    ``i_ids [I]``: item ids; ``vals/inds [I, k]``: top-k scores and item-row
+    indices from the device scoring. Returns ``(items [M] int64, strs
+    list[str])``.
+    """
+    pos = vals > 0.0
+    rows = np.flatnonzero(pos.any(axis=1))
+    if rows.size == 0:
+        return np.empty(0, np.int64), []
+    # one "id,score" token per positive pair, built columnar
+    neigh_ids = np.asarray(i_ids, np.int64)[inds[pos]].astype("U20")
+    scores = vals[pos].astype("U32")
+    pair = np.char.add(np.char.add(neigh_ids, ","), scores)
+    counts = pos.sum(axis=1)[rows]
+    bounds = np.concatenate([[0], np.cumsum(counts)])
+    strs = [
+        ";".join(pair[a:b]) for a, b in zip(bounds[:-1], bounds[1:])
+    ]
+    return np.asarray(i_ids, np.int64)[rows], strs
 
 
 _SWING_CACHE: dict = {}
@@ -285,18 +316,9 @@ class Swing(AlgoOperator, HasOutputCol, HasSeed):
             inds[members] = np.asarray(b_inds)[: len(members)]
 
         # --- host: decode + format (Swing.java:344-361 string encoding) -------
-        out_items: List[int] = []
-        out_strs: List[str] = []
-        for i in range(I):
-            pos = vals[i] > 0.0
-            if not np.any(pos):
-                continue  # reference omits items with no scored neighbor
-            out_items.append(int(i_ids[i]))
-            out_strs.append(
-                ";".join(f"{int(i_ids[j])},{s}" for j, s in zip(inds[i][pos], vals[i][pos]))
-            )
+        out_items, out_strs = encode_topk(i_ids, vals, inds)
         return DataFrame(
             [self.get_item_col(), self.get_output_col()],
             None,
-            [np.asarray(out_items, np.int64), out_strs],
+            [out_items, out_strs],
         )

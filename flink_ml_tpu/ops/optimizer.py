@@ -426,14 +426,22 @@ def _fused_onehot_program(
     active)``: the window index selects that minibatch's static layout
     slice, and ``offsets`` drives the reference's tail-batch gating exactly
     like the scatter path.
+
+    With ``layout.n_model > 1`` (tensor parallelism) the coefficient and
+    the layout stacks are sharded over the model axis (each shard owns the
+    same-shaped slice of every occupancy class — OneHotSparsePlan deals
+    blocks round-robin), the row-crossing dot assembles with a psum over
+    ``model`` inside ``onehot_batch_step``, and the gradient stays
+    block-local.
     """
     from flink_ml_tpu.linalg.onehot_sparse import onehot_batch_step
 
+    model_sharded = layout.n_model > 1
     key = (
         ctx.mesh, loss_func, "onehot", layout.class_meta, layout.n_flat,
-        layout.n_sub, layout.nblk, layout.sub_batch, layout.local_batch,
-        tuple(layout.window_starts), chunk_len, lr, reg, elastic_net, tol,
-        use_pallas,
+        layout.n_sub, layout.nblk_local, layout.n_model, layout.sub_batch,
+        layout.local_batch, tuple(layout.window_starts), chunk_len, lr, reg,
+        elastic_net, tol, use_pallas,
     )
     cached = _FUSED_CACHE.get(key)
     if cached is not None:
@@ -443,11 +451,13 @@ def _fused_onehot_program(
     sub = layout.sub_batch
     padded_b = layout.n_sub * sub
     win_starts = jnp.asarray(layout.window_starts, jnp.int32)
-    nblk = layout.nblk
+    nblk_local = layout.nblk_local
     class_meta, row_hi = layout.class_meta, layout.row_hi
+    model_axis = MODEL_AXIS if model_sharded else None
 
     def per_shard(coef_perm, done, win_idx, offsets, active, lidx, rhi, rlo, lvals, y, w, mask):
-        lidx, rhi, rlo, lvals = lidx[0], rhi[0], rlo[0], lvals[0]
+        # stacks arrive [1, 1, n_windows, n_sub, n_flat] per (data, model) shard
+        lidx, rhi, rlo, lvals = lidx[0, 0], rhi[0, 0], rlo[0, 0], lvals[0, 0]
 
         def body(carry, sched):
             cp, done = carry
@@ -466,13 +476,23 @@ def _fused_onehot_program(
                 wb = jnp.pad(wb, (0, padded_b - lb))
             grad, loss_sum, wsum = onehot_batch_step(
                 cp, sel(lidx), sel(rhi), sel(rlo), sel(lvals), yb, wb,
-                loss_func, class_meta, nblk, sub, row_hi, use_pallas,
+                loss_func, class_meta, nblk_local, sub, row_hi, use_pallas,
+                model_axis=model_axis,
             )
-            packed = jnp.concatenate(
-                [grad, jnp.stack([wsum, loss_sum]).astype(grad.dtype)]
-            )
-            packed = jax.lax.psum(packed, DATA_AXIS)
-            grad, weight_sum, loss_sum = packed[:-2], packed[-2], packed[-1]
+            if model_sharded:
+                # The grad shard varies over the model axis while the scalar
+                # stats are replicated across it (computed from the
+                # model-psum'd dot) — keep their psums separate so the
+                # replication stays statically visible to shard_map.
+                grad = jax.lax.psum(grad, DATA_AXIS)
+                stats = jax.lax.psum(jnp.stack([wsum, loss_sum]), DATA_AXIS)
+                weight_sum, loss_sum = stats[0], stats[1]
+            else:
+                packed = jnp.concatenate(
+                    [grad, jnp.stack([wsum, loss_sum]).astype(grad.dtype)]
+                )
+                packed = jax.lax.psum(packed, DATA_AXIS)
+                grad, weight_sum, loss_sum = packed[:-2], packed[-2], packed[-1]
             safe_w = jnp.maximum(weight_sum, 1e-30)
             new_cp = jnp.where(weight_sum > 0, cp - (lr / safe_w) * grad, cp)
             new_cp, _reg_loss = regularize(new_cp, reg, elastic_net, lr)
@@ -489,13 +509,20 @@ def _fused_onehot_program(
         )
         return coef_perm, done, losses, jnp.sum(executed.astype(jnp.int32))
 
-    data_spec = (P(DATA_AXIS),) * 7  # 4 layout stacks + y/w/mask
+    # On a model-less mesh the stacks ride P(data) only — marking the size-1
+    # model dim would tag every downstream value varying-over-model and trip
+    # shard_map's carry typing for the replicated coefficient.
+    stack_spec = (
+        (P(DATA_AXIS, MODEL_AXIS),) if model_sharded else (P(DATA_AXIS),)
+    ) * 4
+    row_spec = (P(DATA_AXIS),) * 3  # y/w/mask
+    coef_spec = P(MODEL_AXIS) if model_sharded else P()
     program = jax.jit(
         jax.shard_map(
             per_shard,
             mesh=ctx.mesh,
-            in_specs=(P(), P(), P(), P(), P()) + data_spec,
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(coef_spec, P(), P(), P(), P()) + stack_spec + row_spec,
+            out_specs=(coef_spec, P(), P(), P()),
         ),
         donate_argnums=(0, 1),
     )
@@ -503,7 +530,7 @@ def _fused_onehot_program(
     return program
 
 
-def streamed_onehot_plan(cache, n_rows, n_data, window, local_batch, dim):
+def streamed_onehot_plan(cache, n_rows, n_data, window, local_batch, dim, n_model=1):
     """One counting pass over a host-tier cache → the window-stable
     ``OneHotSparsePlan`` serving every (shard, window, minibatch, sub) unit
     of a streamed run. ``window`` must be the batch-aligned width the
@@ -545,7 +572,7 @@ def streamed_onehot_plan(cache, n_rows, n_data, window, local_batch, dim):
                         ),
                         out=max_count,
                     )
-    return OneHotSparsePlan.from_max_counts(max_count, dim, sub)
+    return OneHotSparsePlan.from_max_counts(max_count, dim, sub, n_model)
 
 
 class _StreamedOnehotLayout:
@@ -575,6 +602,14 @@ class _StreamedOnehotLayout:
         return self.plan.nblk
 
     @property
+    def nblk_local(self):
+        return self.plan.nblk_local
+
+    @property
+    def n_model(self):
+        return self.plan.n_model
+
+    @property
     def sub_batch(self):
         return self.plan.sub_batch
 
@@ -602,13 +637,15 @@ class _OneHotWindowStream:
 
     def load(self, j: int):
         nd = self.ctx.n_data
+        nm = self.plan.n_model
         W, b, m, n = self.window, self.local_batch, self.m, self.n
         n_mb = -(-min(W, m) // b)
         nf = self.plan.n_flat
-        lidx = np.zeros((nd, n_mb, self.n_sub, nf), np.int32)
-        rhi = np.zeros((nd, n_mb, self.n_sub, nf), np.int32)
-        rlo = np.zeros((nd, n_mb, self.n_sub, nf), np.int32)
-        lvals = np.zeros((nd, n_mb, self.n_sub, nf), np.float32)
+        shape = (nd, nm, n_mb, self.n_sub, nf)
+        lidx = np.zeros(shape, np.int32)
+        rhi = np.zeros(shape, np.int32)
+        rlo = np.zeros(shape, np.int32)
+        lvals = np.zeros(shape, np.float32)
         y = np.zeros(nd * W, np.float32)
         w = np.zeros(nd * W, np.float32)
         mask = np.zeros(nd * W, np.float32)
@@ -644,10 +681,10 @@ class _OneHotWindowStream:
                     s1 = min(s0 + sub, r1)
                     self.plan.fill_unit(
                         idx_w[s0:s1], val_w[s0:s1],
-                        lidx[k, mb, bi], rhi[k, mb, bi],
-                        rlo[k, mb, bi], lvals[k, mb, bi],
+                        lidx[k, :, mb, bi], rhi[k, :, mb, bi],
+                        rlo[k, :, mb, bi], lvals[k, :, mb, bi],
                     )
-        sh = self.ctx.sharding(DATA_AXIS)
+        sh = self.ctx.sharding(DATA_AXIS, MODEL_AXIS)
         return {
             "stacks": (
                 jax.device_put(lidx, sh),
@@ -889,7 +926,7 @@ class SGD(Optimizer):
             and not self.listeners
         )
         if fused:
-            if self._pick_onehot(sparse, model_sharded, train_data, local_batch, dim):
+            if self._pick_onehot(sparse, train_data, local_batch, dim):
                 result = self._optimize_onehot(
                     init_model, train_data, loss_func, ctx, local_batch, check_loss, dim
                 )
@@ -954,7 +991,7 @@ class SGD(Optimizer):
     _ONEHOT_MIN_DIM = 1 << 14
     _ONEHOT_MAX_WINDOWS = 64
 
-    def _pick_onehot(self, sparse, model_sharded, train_data, local_batch, dim) -> bool:
+    def _pick_onehot(self, sparse, train_data, local_batch, dim) -> bool:
         """Whether the fused sparse fit runs on the one-hot matmul path
         (linalg/onehot_sparse.py) instead of gather/scatter instructions.
 
@@ -964,7 +1001,10 @@ class SGD(Optimizer):
         window set (the static layout is built per distinct minibatch) and
         host-readable sparse columns to transpose. f32 only: the MXU path
         carries values as split-bf16 pairs, which reconstruct f32-grade
-        precision but not f64.
+        precision but not f64. Composes with tensor parallelism: on a TP
+        mesh the occupancy-class blocks shard over the model axis
+        (OneHotSparsePlan round-robin deal) and the crossing dot psums
+        over it.
         """
         if not sparse:  # dense + forced 'onehot' already raised in optimize()
             return False
@@ -972,17 +1012,16 @@ class SGD(Optimizer):
             return False
         host = getattr(train_data, "host_columns", None)
         feasible = (
-            not model_sharded
-            and bool(host)
+            bool(host)
             and "indices" in host
             and jnp.dtype(self.dtype) == jnp.dtype(jnp.float32)
         )
         if self.sparse_kernel == "onehot":
             if not feasible:
                 raise ValueError(
-                    "sparse_kernel='onehot' requires a fused f32 fit on a "
-                    "non-model-sharded mesh with host-readable sparse columns; "
-                    "use 'auto' or 'scatter' for this configuration"
+                    "sparse_kernel='onehot' requires a fused f32 fit with "
+                    "host-readable sparse columns; use 'auto' or 'scatter' "
+                    "for this configuration"
                 )
             return True
         n_windows = -(-train_data.local_rows // local_batch)
@@ -1008,29 +1047,31 @@ class SGD(Optimizer):
         falls back to the scatter kernel)."""
         from flink_ml_tpu.linalg.onehot_sparse import OneHotSparseLayout
 
-        key = (ctx.n_data, dim, local_batch)
+        key = (ctx.n_data, ctx.n_model, dim, local_batch)
         memo = getattr(train_data, "_onehot_memo", None)
         if memo is not None and memo[0] == key and (memo[2] is not None or not force):
             return memo[1], memo[2]
         host = train_data.host_columns
-        # Stacks shard over the data axis — each device holds 1/n_shards of
-        # the 16 B/slot (3 int32 + 1 f32) total; budget the per-device slice.
-        # The bound is applied inside build() right after the counting pass,
-        # BEFORE any stack materializes — an oversized layout must not cost
-        # a multi-GiB transient host allocation just to be rejected.
+        # Stacks shard over the (data, model) axes — each device holds
+        # 1/(n_data*n_model) of the 16 B/slot (3 int32 + 1 f32) total;
+        # budget the per-device slice. The bound is applied inside build()
+        # right after the counting pass, BEFORE any stack materializes — an
+        # oversized layout must not cost a multi-GiB transient host
+        # allocation just to be rejected.
         budget = (
             None
             if force
-            else int(self._ONEHOT_HBM_FRACTION * _hbm_bytes_limit()) * ctx.n_data
+            else int(self._ONEHOT_HBM_FRACTION * _hbm_bytes_limit())
+            * ctx.n_data * ctx.n_model
         )
         lay = OneHotSparseLayout.build(
             host["indices"], host["values"], dim, ctx.n_data, local_batch,
-            max_stack_bytes=budget,
+            max_stack_bytes=budget, n_model=ctx.n_model,
         )
         if lay is None:
             train_data._onehot_memo = (key, None, None)
             return None, None
-        sh = ctx.sharding(DATA_AXIS)
+        sh = ctx.sharding(DATA_AXIS, MODEL_AXIS)
         dev = (
             jax.device_put(lay.lidx, sh),
             jax.device_put(lay.rhi, sh),
@@ -1065,8 +1106,11 @@ class SGD(Optimizer):
         )
         win_of = {s: i for i, s in enumerate(lay.window_starts)}
         win_idx = np.asarray([win_of[int(s)] for s in starts], np.int32)
-        coef = ctx.replicate(
-            lay.permute_coef(np.asarray(init_model, np.float32))
+        coef_host = lay.permute_coef(np.asarray(init_model, np.float32))
+        coef = (
+            jax.device_put(coef_host, ctx.model_dim)
+            if ctx.n_model > 1
+            else ctx.replicate(coef_host)
         )
         done = ctx.replicate(np.asarray(False))
         y = train_data["labels"]
@@ -1089,25 +1133,21 @@ class SGD(Optimizer):
         # not change the output dtype for a float64 init_model.
         return lay.unpermute_coef(np.asarray(jax.device_get(coef)))
 
-    def _pick_onehot_streamed(self, model_sharded, n_rows, K, dim) -> bool:
+    def _pick_onehot_streamed(self, n_rows, K, dim) -> bool:
         """Whether a streamed sparse fit runs the one-hot matmul kernel.
 
         The streamed layout contract is an ``OneHotSparsePlan`` built from a
         counting pass over the whole cache, so one compiled program serves
         every window (see OneHotSparsePlan). Same feasibility rules as the
-        resident gate: f32 only, no model sharding (yet)."""
+        resident gate: f32 only; composes with TP like the resident path."""
         if self.sparse_kernel == "scatter":
             return False
-        feasible = (
-            not model_sharded
-            and jnp.dtype(self.dtype) == jnp.dtype(jnp.float32)
-        )
+        feasible = jnp.dtype(self.dtype) == jnp.dtype(jnp.float32)
         if self.sparse_kernel == "onehot":
             if not feasible:
                 raise ValueError(
                     "sparse_kernel='onehot' on the streamed path requires an "
-                    "f32 fit on a non-model-sharded mesh; use 'auto' or "
-                    "'scatter' for this configuration"
+                    "f32 fit; use 'auto' or 'scatter' for this configuration"
                 )
             return True
         return feasible and n_rows * K >= 1 << 16 and dim >= self._ONEHOT_MIN_DIM
@@ -1142,11 +1182,13 @@ class SGD(Optimizer):
         n_mb = -(-min(W, m) // b)
         sub = min(SUB_ROWS, b)
         n_sub = -(-b // sub)
-        plan = streamed_onehot_plan(cache, n_rows, nd, W, b, dim)
+        plan = streamed_onehot_plan(cache, n_rows, nd, W, b, dim, ctx.n_model)
 
-        # Two windows of stacks are HBM-resident at once (prefetch overlap).
+        # Two windows of stacks are HBM-resident at once (prefetch overlap);
+        # stack_bytes counts all model shards, so divide by n_model for the
+        # per-device slice.
         if self.sparse_kernel != "onehot":
-            per_dev = 2 * plan.stack_bytes(n_mb * n_sub)
+            per_dev = 2 * plan.stack_bytes(n_mb * n_sub) // max(1, ctx.n_model)
             if per_dev > self._ONEHOT_HBM_FRACTION * _hbm_bytes_limit():
                 return None
 
@@ -1190,7 +1232,11 @@ class SGD(Optimizer):
                 self.loss_history = [float(x) for x in st["loss_history"]]
 
         state = {
-            "coef": ctx.replicate(plan.permute_coef(coef_host)),
+            "coef": (
+                jax.device_put(plan.permute_coef(coef_host), ctx.model_dim)
+                if ctx.n_model > 1
+                else ctx.replicate(plan.permute_coef(coef_host))
+            ),
             "done": ctx.replicate(done_host),
             "epochs": sum(len(s) for _, s in sched.runs[:start_run]),
             "last_saved": None,
@@ -1340,7 +1386,7 @@ class SGD(Optimizer):
         model_sharded = sparse and ctx.n_model > 1
         if sparse:
             K0 = int(np.asarray(row0["indices"]).shape[-1])
-            if self._pick_onehot_streamed(model_sharded, n_rows, K0, dim):
+            if self._pick_onehot_streamed(n_rows, K0, dim):
                 result = self._optimize_streaming_onehot(
                     init_model, cache, loss_func, ctx, local_batch, dim,
                     check_loss, n_rows,

@@ -55,18 +55,35 @@ def map_partition(
     columns: Columns,
     fn: Callable[[Columns], object],
     ctx: Optional[MeshContext] = None,
+    parallel: Optional[bool] = None,
 ) -> List[object]:
     """Apply ``fn`` once per partition (ref DataStreamUtils.mapPartition:118).
 
     ``fn`` receives a dict of row-range views; returns the list of per-partition
-    results in partition order.
-    """
+    results in partition order. ``parallel`` runs partitions on a thread pool
+    — the analogue of the reference's per-subtask parallelism
+    (DataStreamUtils.java:236): numpy-heavy ``fn``s (sketching, sorting,
+    bincounts) release the GIL and scale with host cores. Default (None):
+    threads when the host has more than one core and there is more than one
+    partition; a single-core host or single partition stays in-line (a pool
+    would only add overhead)."""
     ctx = ctx or get_mesh_context()
     n = _num_rows(columns)
-    return [
-        fn({k: v[sl] for k, v in columns.items()})
-        for sl in _partition_slices(n, ctx.n_data)
-    ]
+    slices = _partition_slices(n, ctx.n_data)
+    if parallel is None:
+        parallel = len(slices) > 1 and (os.cpu_count() or 1) > 1
+    if not parallel:
+        return [fn({k: v[sl] for k, v in columns.items()}) for sl in slices]
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(
+        max_workers=min(len(slices), os.cpu_count() or 1)
+    ) as pool:
+        futures = [
+            pool.submit(fn, {k: v[sl] for k, v in columns.items()})
+            for sl in slices
+        ]
+        return [f.result() for f in futures]  # partition order preserved
 
 
 def aggregate(

@@ -230,6 +230,48 @@ class TestSwing:
                 )
             )
 
+    def test_encode_topk_matches_f_string_loop(self):
+        from flink_ml_tpu.models.recommendation.swing import encode_topk
+
+        rng = np.random.default_rng(5)
+        I, k = 200, 8
+        i_ids = rng.choice(10_000, I, replace=False).astype(np.int64)
+        vals = np.round(rng.random((I, k)) - 0.3, 6)  # some rows all-negative
+        vals[vals < 0] = 0.0
+        inds = rng.integers(0, I, size=(I, k))
+        items, strs = encode_topk(i_ids, vals, inds)
+        want_items, want_strs = [], []
+        for i in range(I):
+            pos = vals[i] > 0.0
+            if not np.any(pos):
+                continue
+            want_items.append(int(i_ids[i]))
+            want_strs.append(
+                ";".join(
+                    f"{int(i_ids[j])},{s}" for j, s in zip(inds[i][pos], vals[i][pos])
+                )
+            )
+        np.testing.assert_array_equal(items, want_items)
+        assert strs == want_strs
+
+    def test_encode_topk_million_items_within_budget(self):
+        import time
+
+        from flink_ml_tpu.models.recommendation.swing import encode_topk
+
+        rng = np.random.default_rng(6)
+        I, k = 1_000_000, 10
+        i_ids = np.arange(I, dtype=np.int64)
+        vals = rng.random((I, k))
+        inds = rng.integers(0, I, size=(I, k))
+        t0 = time.perf_counter()
+        items, strs = encode_topk(i_ids, vals, inds)
+        elapsed = time.perf_counter() - t0
+        assert len(items) == I and len(strs) == I
+        # numpy string kernels: ~35s unloaded on the 1-core box (the f-string
+        # loop was many minutes); ceiling leaves room for shared-box load
+        assert elapsed < 120.0, f"1M-item encode took {elapsed:.1f}s"
+
     @staticmethod
     def _brute_force_scores(users, items, min_b, max_b, alpha1, alpha2, beta):
         """The Swing.java pair loops, literally (the semantics the device

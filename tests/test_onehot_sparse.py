@@ -45,6 +45,32 @@ class TestLayout:
         coef = rng.normal(size=500).astype(np.float32)
         np.testing.assert_array_equal(lay.unpermute_coef(lay.permute_coef(coef)), coef)
 
+    def test_coef_permute_round_trip_tp(self):
+        # Shard-major TP layout: round-trip through every model width.
+        rng = np.random.default_rng(30)
+        idx = rng.integers(0, 500, size=(64, 4)).astype(np.int32)
+        val = np.ones((64, 4), np.float32)
+        coef = rng.normal(size=500).astype(np.float32)
+        for nm in (1, 2, 4):
+            lay = OneHotSparseLayout.build(idx, val, 500, 1, 32, n_model=nm)
+            assert lay.plan.n_model == nm
+            np.testing.assert_array_equal(
+                lay.unpermute_coef(lay.permute_coef(coef)), coef
+            )
+
+    def test_tp_shards_carry_identical_class_meta_and_all_entries(self):
+        # Round-robin deal: every model shard gets the same local meta; the
+        # union of shards' stacks carries every nonzero entry exactly once.
+        rng = np.random.default_rng(31)
+        idx = rng.integers(0, 2000, size=(128, 6)).astype(np.int32)
+        val = rng.normal(size=(128, 6)).astype(np.float32)
+        lay1 = OneHotSparseLayout.build(idx, val, 2000, 1, 128, n_model=1)
+        lay2 = OneHotSparseLayout.build(idx, val, 2000, 1, 128, n_model=2)
+        total1 = np.sort(lay1.lvals[lay1.lvals != 0.0])
+        total2 = np.sort(lay2.lvals[lay2.lvals != 0.0])
+        np.testing.assert_array_equal(total1, total2)
+        assert lay2.lvals.shape[1] == 2  # model-shard axis
+
     def test_padding_bounded_by_pow2_classes(self):
         rng = np.random.default_rng(1)
         idx = rng.integers(0, 4096, size=(512, 8)).astype(np.int32)
@@ -85,11 +111,11 @@ class TestBatchStep:
             rows = slice(w0, w0 + lay.local_batch)
             grad_p, ls, ws = onehot_batch_step(
                 cp,
-                jnp.asarray(lay.lidx[0, wi]), jnp.asarray(lay.rhi[0, wi]),
-                jnp.asarray(lay.rlo[0, wi]), jnp.asarray(lay.lvals[0, wi]),
+                jnp.asarray(lay.lidx[0, 0, wi]), jnp.asarray(lay.rhi[0, 0, wi]),
+                jnp.asarray(lay.rlo[0, 0, wi]), jnp.asarray(lay.lvals[0, 0, wi]),
                 jnp.asarray(np.pad(y[rows], (0, pad))),
                 jnp.asarray(np.pad(w[rows], (0, pad))),
-                BinaryLogisticLoss.INSTANCE, lay.class_meta, lay.nblk,
+                BinaryLogisticLoss.INSTANCE, lay.class_meta, lay.nblk_local,
                 lay.sub_batch, lay.row_hi, use_pallas=False,
             )
             ref_grad, ref_loss = _scatter_reference(
@@ -214,16 +240,71 @@ class TestSgdIntegration:
                 SGD(
                     max_iter=2, global_batch_size=64, ctx=ctx, sparse_kernel="onehot"
                 ).optimize(np.zeros(300, np.float32), cache, BinaryLogisticLoss.INSTANCE)
-        # TP meshes shard the coefficient -- the one-hot layout does not apply
-        with mesh_context(MeshContext(n_data=2, n_model=2)) as tp_ctx:
-            with pytest.raises(ValueError, match="onehot"):
+        # f64: the split-bf16 crossings reconstruct f32, not f64
+        with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
+            with pytest.raises(ValueError, match="f32"):
                 SGD(
-                    max_iter=2, global_batch_size=64, ctx=tp_ctx, sparse_kernel="onehot"
+                    max_iter=2, global_batch_size=64, ctx=ctx,
+                    sparse_kernel="onehot", dtype=np.float64,
                 ).optimize(
-                    np.zeros(300, np.float32),
-                    DeviceDataCache(cols, ctx=tp_ctx),
+                    np.zeros(300, np.float64),
+                    DeviceDataCache(
+                        {
+                            **{k: v for k, v in cols.items() if k != "values"},
+                            "values": np.asarray(cols["values"], np.float64),
+                        },
+                        ctx=ctx,
+                    ),
                     BinaryLogisticLoss.INSTANCE,
                 )
+
+    def test_onehot_tp_matches_scatter_tp(self):
+        # The round-4 composition: one-hot kernel on a (data x model) mesh.
+        # Occupancy-class blocks deal round-robin over the model axis and the
+        # crossing dot psums over it; result must match the scatter-TP path.
+        rng = np.random.default_rng(20)
+        n, d, K = 512, 800, 8
+        cols = self._cols(rng, n, d, K)
+        with mesh_context(MeshContext(n_data=4, n_model=2)) as ctx:
+            def fit(kernel):
+                sgd = SGD(
+                    max_iter=25, global_batch_size=128, tol=0.0,
+                    learning_rate=0.3, reg=0.01, elastic_net=0.5,
+                    ctx=ctx, sparse_kernel=kernel,
+                )
+                coef = sgd.optimize(
+                    np.zeros(d, np.float32),
+                    DeviceDataCache(cols, ctx=ctx),
+                    BinaryLogisticLoss.INSTANCE,
+                )
+                return coef, sgd.loss_history
+
+            coef_oh, hist_oh = fit("onehot")
+            coef_sc, hist_sc = fit("scatter")
+            np.testing.assert_allclose(coef_oh, coef_sc, rtol=1e-3, atol=1e-4)
+            np.testing.assert_allclose(hist_oh, hist_sc, rtol=1e-3)
+
+    def test_onehot_tp_invariant_in_model_width(self):
+        # Widening the model axis must not change the result (the data axis
+        # legitimately changes minibatch composition via per-shard cycling,
+        # so n_data is held fixed).
+        rng = np.random.default_rng(21)
+        cols = self._cols(rng, 256, 600, 4)
+        results = {}
+        for nd, nm in [(2, 1), (2, 2), (2, 4)]:
+            with mesh_context(MeshContext(n_data=nd, n_model=nm)) as ctx:
+                results[(nd, nm)] = SGD(
+                    max_iter=10, global_batch_size=64, tol=0.0,
+                    learning_rate=0.4, ctx=ctx, sparse_kernel="onehot",
+                ).optimize(
+                    np.zeros(600, np.float32),
+                    DeviceDataCache(cols, ctx=ctx),
+                    BinaryLogisticLoss.INSTANCE,
+                )
+        for key, coef in results.items():
+            np.testing.assert_allclose(
+                coef, results[(2, 1)], rtol=2e-3, atol=1e-4, err_msg=str(key)
+            )
 
     def test_auto_gate_picks_onehot_for_wide_models(self):
         rng = np.random.default_rng(9)

@@ -189,9 +189,19 @@ def test_forced_streamed_onehot_infeasible_raises():
         SGD(
             stream_window_rows=16, sparse_kernel="onehot", dtype=np.float64, **KW
         ).optimize(np.zeros(500, np.float64), cache, BinaryLogisticLoss.INSTANCE)
-    # model-sharded (TP) streamed coefficient: not composed with one-hot yet
+
+
+def test_streamed_onehot_tp_matches_streamed_scatter_tp():
+    # The full composition: streamed + one-hot + tensor parallelism on a
+    # (4 data x 2 model) mesh, vs the streamed scatter-TP path.
+    cols = _sparse_data(512, 2000, 6, seed=10)
+    cache = _fill(HostDataCache(), cols)
     with mesh_context(MeshContext(n_data=4, n_model=2)) as ctx:
-        with pytest.raises(ValueError, match="model-sharded"):
-            SGD(
-                stream_window_rows=16, sparse_kernel="onehot", ctx=ctx, **KW
-            ).optimize(np.zeros(500, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+        coefs = {}
+        for kernel in ("onehot", "scatter"):
+            coefs[kernel] = SGD(
+                stream_window_rows=32, sparse_kernel=kernel, ctx=ctx, **KW
+            ).optimize(np.zeros(2000, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+        np.testing.assert_allclose(
+            coefs["onehot"], coefs["scatter"], rtol=1e-3, atol=1e-5
+        )

@@ -42,7 +42,12 @@ def measure_scaling(p_list, global_batch, dim, nnz, K, seed=0):
     from flink_ml_tpu.linalg.onehot_sparse import OneHotSparseLayout
     from flink_ml_tpu.ops import BinaryLogisticLoss
     from flink_ml_tpu.ops.optimizer import _fused_onehot_program
-    from flink_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext, mesh_context
+    from flink_ml_tpu.parallel.mesh import (
+        DATA_AXIS,
+        MODEL_AXIS,
+        MeshContext,
+        mesh_context,
+    )
 
     rng = np.random.default_rng(seed)
     n = global_batch  # one window: the dataset IS one global minibatch
@@ -65,7 +70,7 @@ def measure_scaling(p_list, global_batch, dim, nnz, K, seed=0):
                 ctx, BinaryLogisticLoss.INSTANCE, lay, 1, 0.1, 0.0, 0.0, None,
                 use_pallas=False,
             )
-            sh = ctx.sharding(DATA_AXIS)
+            sh = ctx.sharding(DATA_AXIS, MODEL_AXIS)
             stacks = (
                 jax.device_put(lay.lidx, sh),
                 jax.device_put(lay.rhi, sh),
