@@ -232,10 +232,13 @@ class OneHotSparsePlan:
         return orig.reshape(-1)[: self.dim]
 
     def program_key(self) -> tuple:
-        """The plan identity a compiled program depends on."""
+        """The plan identity a compiled program depends on. ``nblk_local``
+        is NOT derivable from the other members (zero-width classes add
+        coefficient blocks but no class_meta entry), so it must ride along —
+        it sets the coef/grad array lengths."""
         return (
-            self.dim, self.nblk, self.n_model, self.sub_batch, self.n_flat,
-            self.class_meta,
+            self.dim, self.nblk, self.nblk_local, self.n_model,
+            self.sub_batch, self.n_flat, self.class_meta,
         )
 
     def __repr__(self) -> str:

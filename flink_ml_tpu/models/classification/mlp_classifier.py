@@ -228,7 +228,9 @@ class MLPClassifier(Estimator, _MlpParams):
         cached = _MLP_FUSED_CACHE.get(key)
         if cached is not None:
             return cached
-        epoch = self._epoch_math(optimizer, local_batch, self._compute_dtype())
+        epoch = self._epoch_math(
+            optimizer, local_batch, self._compute_dtype(), data_axes=ctx.data_axes
+        )
 
         def per_shard(params, opt_state, done, starts, offsets, active, X, y, w):
             def body(carry, schedule):
@@ -255,7 +257,7 @@ class MLPClassifier(Estimator, _MlpParams):
                 mesh=ctx.mesh,
                 in_specs=(
                     P(), P(), P(), P(), P(), P(),
-                    P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                    P(ctx.data_axes), P(ctx.data_axes), P(ctx.data_axes),
                 ),
                 out_specs=(P(), P(), P(), P()),
             ),
@@ -265,7 +267,7 @@ class MLPClassifier(Estimator, _MlpParams):
         return program
 
     @staticmethod
-    def _epoch_math(optimizer, local_batch: int, compute_dtype=None):
+    def _epoch_math(optimizer, local_batch: int, compute_dtype=None, data_axes=DATA_AXIS):
         def per_shard(params, opt_state, start, offset, X, y, w):
             # Contiguous minibatch window via dynamic_slice (cheap on TPU) with the
             # clamped tail zero-weighted — same scheme as _sgd_epoch_math; start
@@ -284,8 +286,10 @@ class MLPClassifier(Estimator, _MlpParams):
                 return jnp.sum(losses * wb)
 
             loss, grads = jax.value_and_grad(loss_sum)(params)
+            # On a multi-slice mesh this is the one DCN-crossing collective:
+            # XLA reduces over ICI within each slice, then across slices.
             packed = jax.lax.psum(
-                (grads, jnp.stack([jnp.sum(wb), loss])), DATA_AXIS
+                (grads, jnp.stack([jnp.sum(wb), loss])), data_axes
             )
             grads, stats = packed
             weight_sum, loss_sum_v = stats[0], stats[1]

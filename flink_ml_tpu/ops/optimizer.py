@@ -111,6 +111,7 @@ def _sgd_epoch_math(
     elastic_net,
     dtype,
     model_sharded: bool = False,
+    data_axes=DATA_AXIS,
 ):
     """One epoch of the per-shard SGD update (shared by the host-loop step and the
     fused whole-run program). ``start`` is the clamped slice start and ``offset``
@@ -182,14 +183,17 @@ def _sgd_epoch_math(
         # The grad shard varies over the model axis while the scalar stats are
         # replicated across it — keep their psums separate so the replication
         # stays statically visible to shard_map (and the loss/done plumbing).
-        grad = jax.lax.psum(grad_sum, DATA_AXIS)
-        stats = jax.lax.psum(jnp.stack([jnp.sum(wb), loss_sum]), DATA_AXIS)
+        grad = jax.lax.psum(grad_sum, data_axes)
+        stats = jax.lax.psum(jnp.stack([jnp.sum(wb), loss_sum]), data_axes)
         weight_sum, loss_sum = stats[0], stats[1]
     else:
         packed = jnp.concatenate(
             [grad_sum, jnp.stack([jnp.sum(wb), loss_sum]).astype(grad_sum.dtype)]
         )
-        packed = jax.lax.psum(packed, DATA_AXIS)  # the whole AllReduceImpl
+        # The whole AllReduceImpl; on a multi-slice mesh data_axes is
+        # ("slice", "data") and XLA lowers the reduction hierarchically —
+        # ICI within each slice, one slice-count exchange over DCN.
+        packed = jax.lax.psum(packed, data_axes)
         grad, weight_sum, loss_sum = packed[:-2], packed[-2], packed[-1]
     safe_w = jnp.maximum(weight_sum, 1e-30)
     new_coef = jnp.where(weight_sum > 0, coef - (lr / safe_w) * grad, coef)
@@ -364,6 +368,8 @@ def _fused_sgd_program(
     if cached is not None:
         return cached
 
+    data_axes = ctx.data_axes
+
     def per_shard(coef, done, starts, offsets, active, *data):
         feats = (data[0], data[1]) if sparse else data[0]
         y, w, mask = data[2:5] if sparse else data[1:4]
@@ -374,6 +380,7 @@ def _fused_sgd_program(
             new_c, mean_loss = _sgd_epoch_math(
                 c, start, offset, feats, y, w, mask, loss_func, local_batch, lr,
                 reg, elastic_net, dtype, model_sharded=model_sharded,
+                data_axes=data_axes,
             )
             executed = ~done & act
             new_c = jnp.where(executed, new_c, c)
@@ -389,10 +396,10 @@ def _fused_sgd_program(
         return coef, done, losses, jnp.sum(executed.astype(jnp.int32))
 
     n_data_args = 5 if sparse else 4
-    data_specs = (P(DATA_AXIS),) * n_data_args
+    data_specs = (P(data_axes),) * n_data_args
     if model_sharded and not sparse:
         # dense TP: features are column-sliced over the model axis too
-        data_specs = (P(DATA_AXIS, MODEL_AXIS),) + data_specs[1:]
+        data_specs = (P(data_axes, MODEL_AXIS),) + data_specs[1:]
     coef_spec = P(MODEL_AXIS) if model_sharded else P()
     program = jax.jit(
         jax.shard_map(
@@ -783,7 +790,7 @@ class SGD(Optimizer):
         dies with the fit instead of doubling resident memory for the
         largest array in the job."""
         X = train_data["features"]
-        tp_sharding = ctx.sharding(DATA_AXIS, MODEL_AXIS)
+        tp_sharding = ctx.sharding(ctx.data_axes, MODEL_AXIS)
         if X.shape[1] % ctx.n_model == 0 and X.sharding == tp_sharding:
             return X
         pad = (-X.shape[1]) % ctx.n_model
@@ -816,6 +823,7 @@ class SGD(Optimizer):
         lr = self.learning_rate
         reg, elastic_net = self.reg, self.elastic_net
         dtype = self.dtype
+        data_axes = ctx.data_axes
 
         def per_shard(coef, offset, *data):
             feats = (data[0], data[1]) if sparse else data[0]
@@ -825,14 +833,15 @@ class SGD(Optimizer):
             new_coef, mean_loss = _sgd_epoch_math(
                 coef, start, offset, feats, y, w, mask, loss_func, local_batch,
                 lr, reg, elastic_net, dtype, model_sharded=model_sharded,
+                data_axes=data_axes,
             )
             next_offset = jnp.where(offset + local_batch >= m, 0, offset + local_batch)
             return new_coef, next_offset, mean_loss
 
         n_data_args = 5 if sparse else 4
-        data_specs = (P(DATA_AXIS),) * n_data_args
+        data_specs = (P(data_axes),) * n_data_args
         if model_sharded and not sparse:
-            data_specs = (P(DATA_AXIS, MODEL_AXIS),) + data_specs[1:]
+            data_specs = (P(data_axes, MODEL_AXIS),) + data_specs[1:]
         coef_spec = P(MODEL_AXIS) if model_sharded else P()
         return jax.jit(
             jax.shard_map(
@@ -876,7 +885,7 @@ class SGD(Optimizer):
             # On a TP mesh, dense features ingest directly in their training
             # layout P(data, model) — no row-only duplicate ever lands in HBM.
             specs = (
-                {"features": (DATA_AXIS, MODEL_AXIS)}
+                {"features": (ctx.data_axes, MODEL_AXIS)}
                 if "features" in cols and ctx.n_model > 1
                 else None
             )
@@ -1011,17 +1020,21 @@ class SGD(Optimizer):
         if self.sparse_kernel == "scatter":
             return False
         host = getattr(train_data, "host_columns", None)
+        ctx = self.ctx or get_mesh_context()
         feasible = (
             bool(host)
             and "indices" in host
             and jnp.dtype(self.dtype) == jnp.dtype(jnp.float32)
+            # one-hot stacks/crossings are laid out intra-slice; multi-slice
+            # meshes run the scatter kernel (its psum is slice-hierarchical)
+            and ctx.n_slices == 1
         )
         if self.sparse_kernel == "onehot":
             if not feasible:
                 raise ValueError(
                     "sparse_kernel='onehot' requires a fused f32 fit with "
-                    "host-readable sparse columns; use 'auto' or 'scatter' "
-                    "for this configuration"
+                    "host-readable sparse columns on a single-slice mesh; "
+                    "use 'auto' or 'scatter' for this configuration"
                 )
             return True
         n_windows = -(-train_data.local_rows // local_batch)
@@ -1142,12 +1155,17 @@ class SGD(Optimizer):
         resident gate: f32 only; composes with TP like the resident path."""
         if self.sparse_kernel == "scatter":
             return False
-        feasible = jnp.dtype(self.dtype) == jnp.dtype(jnp.float32)
+        ctx = self.ctx or get_mesh_context()
+        feasible = (
+            jnp.dtype(self.dtype) == jnp.dtype(jnp.float32)
+            and ctx.n_slices == 1  # see _pick_onehot
+        )
         if self.sparse_kernel == "onehot":
             if not feasible:
                 raise ValueError(
                     "sparse_kernel='onehot' on the streamed path requires an "
-                    "f32 fit; use 'auto' or 'scatter' for this configuration"
+                    "f32 fit on a single-slice mesh; use 'auto' or 'scatter' "
+                    "for this configuration"
                 )
             return True
         return feasible and n_rows * K >= 1 << 16 and dim >= self._ONEHOT_MIN_DIM

@@ -9,10 +9,25 @@ by construction — the whole SharedProgressAligner/epoch-watermark machinery of
 the TaskManager role.
 
 Axes:
+  - ``slice`` — optional outermost axis modelling multi-slice (DCN-connected)
+    topologies: devices within a slice talk over ICI, across slices over DCN.
+    Size 1 by default (single slice; the axis then never appears in specs).
   - ``data``  — batch (data-parallel) axis; every algorithm shards its input batch here.
     The analogue of ``rebalance()`` partitioning in the reference (SGD.java:90).
-  - ``model`` — optional second axis for sharding very wide coefficient vectors /
+  - ``model`` — optional axis for sharding very wide coefficient vectors /
     expert dims (tensor parallelism). Size 1 by default.
+
+Multi-slice placement rules (SURVEY §2.9 comm backend): the batch shards over
+``(slice, data)`` jointly (``data_axes``), so the ONLY per-step collective
+that crosses DCN is the gradient/stat psum's slice-level reduction stage —
+XLA lowers ``psum(x, ("slice", "data"))`` hierarchically: reduce-scatter/
+all-reduce over ICI within each slice, then the slice-count-sized exchange
+over DCN, then broadcast back over ICI. Model-axis collectives (TP margins,
+one-hot crossings) and minibatch compute never leave a slice — the model
+axis is always innermost. Programs that ignore the slice axis (specs naming
+only ``data``/``model``) still run correctly on a multi-slice mesh: shard_map
+replicates their inputs across slices and every slice computes identically —
+correct, just redundant; the flagship trainers (SGD, MLP) scale across it.
 
 The mesh is process-global state (like the reference's StreamExecutionEnvironment),
 managed via ``set_mesh_context``/``get_mesh_context`` or the ``mesh_context`` context
@@ -32,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "SLICE_AXIS",
     "MeshContext",
     "get_mesh_context",
     "set_mesh_context",
@@ -40,6 +56,7 @@ __all__ = [
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SLICE_AXIS = "slice"
 
 _lock = threading.Lock()
 _current: Optional["MeshContext"] = None
@@ -57,6 +74,7 @@ class MeshContext:
         devices: Optional[Sequence[Any]] = None,
         n_data: Optional[int] = None,
         n_model: Optional[int] = None,
+        n_slices: int = 1,
     ):
         # Unspecified axis sizes come from the runtime config tier (the
         # job-parallelism role of the reference's cluster config).
@@ -70,15 +88,22 @@ class MeshContext:
         if n_data is None:
             n_data = config.get(Options.MESH_DATA_AXIS_SIZE)
         if n_data is None:
-            n_data = len(devices) // n_model
-        if n_data * n_model > len(devices):
+            n_data = len(devices) // (n_model * n_slices)
+        # ``n_data`` is the PER-SLICE data width; devices must arrive
+        # slice-major (jax.devices() orders multi-slice topologies that way),
+        # so contiguity along the trailing axes stays intra-slice ICI.
+        need = n_slices * n_data * n_model
+        if need > len(devices):
             raise ValueError(
-                f"mesh {n_data}x{n_model} needs {n_data * n_model} devices, "
+                f"mesh {n_slices}x{n_data}x{n_model} needs {need} devices, "
                 f"got {len(devices)}"
             )
-        grid = np.asarray(devices[: n_data * n_model]).reshape(n_data, n_model)
-        self.mesh = Mesh(grid, (DATA_AXIS, MODEL_AXIS))
-        self.n_data = n_data
+        grid = np.asarray(devices[:need]).reshape(n_slices, n_data, n_model)
+        self.mesh = Mesh(grid, (SLICE_AXIS, DATA_AXIS, MODEL_AXIS))
+        self.n_slices = n_slices
+        # Total data-parallel shard count: row partitioning, local batches and
+        # cache layouts all see slices as extra data shards.
+        self.n_data = n_slices * n_data
         self.n_model = n_model
 
     # --- sharding vocabulary -------------------------------------------------
@@ -92,9 +117,17 @@ class MeshContext:
         return NamedSharding(self.mesh, P())
 
     @property
+    def data_axes(self):
+        """The mesh axes a batch dim shards over — ``("slice", "data")`` on a
+        multi-slice mesh, plain ``"data"`` otherwise. Programs that scale
+        across slices use this in their specs and gradient psums; XLA then
+        lowers the reduction hierarchically (ICI within a slice, DCN across)."""
+        return (SLICE_AXIS, DATA_AXIS) if self.n_slices > 1 else DATA_AXIS
+
+    @property
     def batch(self) -> NamedSharding:
-        """Leading-dim sharded over ``data`` — for [n, ...] batches."""
-        return NamedSharding(self.mesh, P(DATA_AXIS))
+        """Leading-dim sharded over the data axes — for [n, ...] batches."""
+        return NamedSharding(self.mesh, P(self.data_axes))
 
     @property
     def model_dim(self) -> NamedSharding:
@@ -130,7 +163,8 @@ class MeshContext:
         return jax.device_put(array, self.replicated)
 
     def __repr__(self) -> str:
-        return f"MeshContext(data={self.n_data}, model={self.n_model})"
+        extra = f", slices={self.n_slices}" if self.n_slices > 1 else ""
+        return f"MeshContext(data={self.n_data}, model={self.n_model}{extra})"
 
 
 def is_tpu_backend(devices) -> bool:

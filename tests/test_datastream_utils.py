@@ -140,14 +140,20 @@ class TestQuantileSummary:
         with pytest.raises(RuntimeError, match="partition failed"):
             map_partition(cols, boom, parallel=parallel)
 
-    def test_aggregate_parallel_quantiles_match(self):
-        # distributed_quantiles through the (auto-parallel) belt equals the
-        # forced-sequential result bit for bit: same sketches, same merge order.
+    def test_aggregate_parallel_quantiles_match(self, monkeypatch):
+        # distributed_quantiles through the FORCED-parallel belt equals the
+        # forced-sequential result bit for bit: same sketches, same merge
+        # order. cpu_count is monkeypatched so the thread-pool branch
+        # genuinely runs even on a 1-core host.
+        import flink_ml_tpu.parallel.datastream_utils as dsu
+
         rng = np.random.default_rng(13)
         X = rng.normal(size=(50_000, 2))
-        a = distributed_quantiles(X, [0.25, 0.5, 0.75])
-        b = distributed_quantiles(X, [0.25, 0.5, 0.75])
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        monkeypatch.setattr(dsu.os, "cpu_count", lambda: 1)
+        seq = distributed_quantiles(X, [0.25, 0.5, 0.75])
+        monkeypatch.setattr(dsu.os, "cpu_count", lambda: 4)
+        par = distributed_quantiles(X, [0.25, 0.5, 0.75])
+        np.testing.assert_array_equal(np.asarray(seq), np.asarray(par))
 
     def test_ten_million_row_quantiles_within_budget(self):
         # The compression rewrite makes 10M-row sketching a few seconds of

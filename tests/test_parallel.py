@@ -67,6 +67,68 @@ def test_mesh_too_many_requested():
         MeshContext(n_data=64, n_model=2)
 
 
+def test_multislice_mesh_axes_and_invariance():
+    # A (slice=2, data=4) mesh: n_data reports TOTAL data shards, batch
+    # shards over both axes, and SGD results are identical to the flat
+    # 8-way mesh — the slice hierarchy changes the collective schedule
+    # (ICI within a slice, DCN across), not the math.
+    import jax
+
+    from flink_ml_tpu.ops import SGD, BinaryLogisticLoss
+    from flink_ml_tpu.parallel.mesh import (
+        SLICE_AXIS,
+        MeshContext,
+        mesh_context,
+    )
+
+    devices = jax.devices()[:8]
+    sliced = MeshContext(devices=devices, n_data=4, n_model=1, n_slices=2)
+    assert sliced.n_slices == 2 and sliced.n_data == 8
+    assert sliced.mesh.axis_names == (SLICE_AXIS, "data", "model")
+    assert sliced.data_axes == (SLICE_AXIS, "data")
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 5)).astype(np.float32)
+    y = (rng.random(64) > 0.5).astype(np.float32)
+
+    def fit(ctx):
+        with mesh_context(ctx):
+            return SGD(max_iter=5, global_batch_size=16, tol=0.0, ctx=ctx).optimize(
+                np.zeros(5, np.float32),
+                {"features": X, "labels": y},
+                BinaryLogisticLoss.INSTANCE,
+            )
+
+    flat = fit(MeshContext(devices=devices, n_data=8, n_model=1))
+    hier = fit(sliced)
+    np.testing.assert_allclose(hier, flat, rtol=1e-6, atol=1e-7)
+
+
+def test_multislice_onehot_forced_raises():
+    import jax
+
+    from flink_ml_tpu.ops import SGD, BinaryLogisticLoss
+    from flink_ml_tpu.parallel.mesh import MeshContext, mesh_context
+
+    ctx = MeshContext(devices=jax.devices()[:8], n_data=4, n_model=1, n_slices=2)
+    rng = np.random.default_rng(1)
+    cols = {
+        "indices": rng.integers(0, 500, (64, 4)).astype(np.int32),
+        "values": rng.normal(size=(64, 4)).astype(np.float32),
+        "labels": (rng.random(64) > 0.5).astype(np.float32),
+    }
+    with mesh_context(ctx):
+        with pytest.raises(ValueError, match="single-slice"):
+            SGD(
+                max_iter=2, global_batch_size=32, ctx=ctx, sparse_kernel="onehot"
+            ).optimize(np.zeros(500, np.float32), cols, BinaryLogisticLoss.INSTANCE)
+        # auto falls back to the (slice-hierarchical) scatter kernel
+        coef = SGD(max_iter=2, global_batch_size=32, ctx=ctx).optimize(
+            np.zeros(500, np.float32), cols, BinaryLogisticLoss.INSTANCE
+        )
+        assert np.all(np.isfinite(coef))
+
+
 def test_replicate_places_full_copy():
     ctx = MeshContext(n_data=8)
     w = np.arange(6, dtype=np.float64)
