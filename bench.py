@@ -56,7 +56,11 @@ _PEAK_HBM_GBPS = {
 }
 
 
-def _median_time(fn, repeats=3):
+def _median_time(fn, repeats=5):
+    # median-of-5: the dev chip is time-shared behind the tunnel and single
+    # measurements swing 2-4x under contention (observed: a 36 ms-floor
+    # scatter step reading 10 ms); 5 samples keeps the median out of the
+    # spikes at a few seconds of extra wall per workload.
     fn()  # warm-up: XLA compile
     times = []
     for _ in range(repeats):
@@ -334,13 +338,14 @@ def bench_logreg_sparse_streamed():
         streamed_fit("scatter")  # warm-up: program compile
         wall_scatter = streamed_fit("scatter")
         streamed_fit("onehot")  # warm-up: plan + program compile
-        wall = streamed_fit("onehot")
 
         # Pure-ingest time: load the windows the run actually loads (dedup
         # consecutive same-window runs — run_windows keeps those resident),
-        # no compute. The counting pass the fit repeats is timed separately
-        # and removed from wall for the overlap accounting — it is neither
-        # ingest nor compute, and it runs before any window exists.
+        # no compute. Measured IMMEDIATELY BEFORE the timed fit — the tunnel
+        # drifts 20-40% between measurements, so probe and fit must be
+        # adjacent — and the counting pass the fit repeats is timed
+        # separately and removed from wall for the overlap accounting — it
+        # is neither ingest nor compute, and runs before any window exists.
         from flink_ml_tpu.iteration.streaming import WindowSchedule
         from flink_ml_tpu.linalg.onehot_sparse import BLOCK, SUB_ROWS
         from flink_ml_tpu.ops.optimizer import _OneHotWindowStream, streamed_onehot_plan
@@ -371,6 +376,9 @@ def bench_logreg_sparse_streamed():
             buf = stream.load(j)
             jax.block_until_ready(buf["labels"])
         ingest_s = time.perf_counter() - t0
+        del buf
+
+        wall = streamed_fit("onehot")
 
     # The compute half, measured directly: the one-hot program on a
     # window-sized resident cache — the VERDICT's "comparable to the
@@ -396,13 +404,34 @@ def bench_logreg_sparse_streamed():
 
     step_us = {}
     for kernel in ("onehot", "scatter"):
+        # 100-step differencing: the tunnel's fixed dispatch+fetch overhead
+        # is ~1 s with ±0.5 s jitter, so the step-time signal must be a
+        # multiple of that (30 steps of a ~22 ms step was not; observed
+        # extractions from 2.6 to 65 ms for the same kernel).
         t1 = _median_time(lambda: wsteps(kernel, 10))
-        t2 = _median_time(lambda: wsteps(kernel, 40))
-        step_us[kernel] = max((t2 - t1) / 30, 1e-9) * 1e6
+        t2 = _median_time(lambda: wsteps(kernel, 110))
+        step_us[kernel] = max((t2 - t1) / 100, 1e-9) * 1e6
 
     compute_s = epochs * step_us["onehot"] / 1e6
     wall_train = max(wall - plan_s, 1e-9)  # windows-phase wall: counting pass excluded
-    overlap = (compute_s + ingest_s - wall_train) / max(min(compute_s, ingest_s), 1e-9)
+    # The probe and the fit cross the tunnel minutes apart at ~25 MB/s with
+    # 20-40% drift, so the estimated shares are clamped into [0, 1] — the
+    # qualitative conclusion (ingest-bound; compute fully hidden) is robust,
+    # the third digit is not.
+    ingest_clamped = min(ingest_s, wall_train)
+    # Report overlap unmeasured (null) rather than fabricated when either
+    # input is outside the measurement's validity: compute below the
+    # tunnel's multi-second drift noise, or the probe's ingest exceeding the
+    # fit's whole wall (drift between the two runs — clamping it into the
+    # formula would emit a deterministic fake 1.0). The tunnel-free CPU-mesh
+    # artifact carries the real overlap demonstration.
+    if compute_s < 0.05 * wall_train or ingest_s > wall_train:
+        overlap = None
+    else:
+        overlap = (compute_s + ingest_clamped - wall_train) / max(
+            min(compute_s, ingest_clamped), 1e-9
+        )
+        overlap = round(min(max(overlap, 0.0), 1.0), 3)
     rows_consumed = epochs * batch
     return {
         "name": "logreg_sparse_streamed_250k_d4M_w125k",
@@ -417,13 +446,14 @@ def bench_logreg_sparse_streamed():
         "onehot_vs_scatter_step": round(step_us["scatter"] / step_us["onehot"], 2),
         "ingest_s": round(ingest_s, 2),
         "compute_s": round(compute_s, 2),
-        "compute_share": round(compute_s / wall_train, 4),
-        "ingest_share": round(ingest_s / wall_train, 4),
-        "overlap_efficiency": round(min(max(overlap, 0.0), 1.0), 3),
+        "compute_share": round(min(compute_s / wall_train, 1.0), 4),
+        "ingest_share": round(ingest_clamped / wall_train, 4),
+        "overlap_efficiency": overlap,
         "note": "streamed+sparse+fused on the one-hot kernel; windows re-cross "
         "the dev tunnel every epoch (~25 MB/s) so wall is ingest-bound here — "
-        "overlap_efficiency is the fraction of compute hidden behind ingest; "
-        "see streamed_overlap_cpu_mesh for the tunnel-free overlap artifact",
+        "overlap_efficiency (fraction of compute hidden behind ingest) is null "
+        "when compute sits below the tunnel's drift noise floor; see "
+        "streamed_overlap_cpu_mesh for the tunnel-free overlap artifact",
     }
 
 
@@ -667,6 +697,11 @@ def bench_mlp_forward(peak_flops):
         "step_time_us": round(elapsed * 1e6, 1),
         "achieved_gflops": round(achieved / 1e9, 1),
         "mfu": round(achieved / peak_flops, 4) if peak_flops else None,
+        "latency_target_us": 5000,
+        "note": "serving shape: bandwidth-bound by design (weights re-read per "
+        "call), so low MFU is expected — the quantified contract is the "
+        "latency target, met with ~4x headroom; for throughput, batch up "
+        "(mlp_train shows the same network at 78% MFU at batch 32k)",
     }
 
 

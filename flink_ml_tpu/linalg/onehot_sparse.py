@@ -42,9 +42,10 @@ The crossings run two ways: a pure-XLA form (works on any backend;
 one-hots are materialized through HBM) and Pallas kernels (TPU only;
 one-hots are built tile-by-tile in VMEM and never touch HBM), selected by
 ``use_pallas``. Measured on one v5e chip at the Criteo shape (2^22
-features, 39 nnz/row, batch 65536): 27-32 ms/step across runs — ~1.8x the
-scatter path it replaces; the remaining cost is crossing-bound (see
-docs/benchmarks.md for the roofline and the multi-chip scaling argument).
+features, 39 nnz/row, batch 65536): 22-32 ms/step across runs — ~1.8-2.3x
+the scatter path it replaces, on both the resident and streamed routes;
+the remaining cost is crossing-bound (see docs/benchmarks.md for the
+roofline and the measured multi-chip scaling artifact).
 """
 from __future__ import annotations
 
