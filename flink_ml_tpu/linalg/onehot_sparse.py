@@ -122,6 +122,12 @@ class OneHotSparsePlan:
     def from_max_counts(
         cls, max_count: np.ndarray, dim: int, sub_batch: int, n_model: int = 1
     ) -> "OneHotSparsePlan":
+        if sub_batch > np.iinfo(np.int16).max:
+            # the packed int16 rowid would wrap and silently drop entries
+            raise ValueError(
+                f"sub_batch {sub_batch} exceeds the packed rowid range "
+                f"({np.iinfo(np.int16).max}); use sub_rows <= 32767"
+            )
         nblk = -(-dim // BLOCK)
         occ = next_pow2(np.maximum(np.asarray(max_count, np.int64), 0))
         occ[np.asarray(max_count) == 0] = 0  # empty blocks: zero slots
@@ -178,15 +184,21 @@ class OneHotSparsePlan:
 
     def stack_bytes(self, n_units: int) -> int:
         """Host/HBM bytes of ``n_units`` sub-batch units' stacks across all
-        model shards (3 int32 + 1 f32 per flat slot)."""
-        return 16 * n_units * self.n_model * self.n_flat
+        model shards (int8 lane + int16 rowid + f32 value per flat slot)."""
+        return 7 * n_units * self.n_model * self.n_flat
 
-    def fill_unit(self, idx_u, val_u, out_lidx, out_rhi, out_rlo, out_lvals) -> None:
+    def fill_unit(self, idx_u, val_u, out_lidx, out_rowid, out_lvals) -> None:
         """Transpose one sub-batch unit ([rows <= sub_batch, K] padded-CSR)
         into its per-model-shard class-major stack slices (preallocated,
         zeroed, shape [n_model, n_flat]). Raises if any block's entry count
         exceeds its planned class width — a unit outside the plan's counting
-        pass must fail loudly, never corrupt a neighbouring block's slots."""
+        pass must fail loudly, never corrupt a neighbouring block's slots.
+
+        Stacks are packed for transfer/HBM (the streamed path ships them
+        every window): ``lidx`` int8 (lane < 128), ``rowid`` int16 (the
+        sub-batch-relative row, < SUB_ROWS = 16384); the program unpacks to
+        int32 (hi, lo) = (rowid // 128, rowid % 128) on device. 7 B/slot
+        vs the unpacked 16 — below even the padded-CSR 8 B/nnz."""
         idx_u = np.asarray(idx_u, np.int64)
         val_u = np.asarray(val_u)
         nz = val_u != 0.0
@@ -194,7 +206,7 @@ class OneHotSparsePlan:
             np.arange(idx_u.shape[0], dtype=np.int64), idx_u.shape[1]
         ).reshape(idx_u.shape)[nz]
         feats = idx_u[nz]
-        lanes = (feats % BLOCK).astype(np.int32)
+        lanes = (feats % BLOCK).astype(np.int8)
         pos = self.inv_perm[feats // BLOCK].astype(np.int64)
         o2 = np.argsort(pos, kind="stable")
         sp = pos[o2]
@@ -207,9 +219,7 @@ class OneHotSparsePlan:
         owner = self.owner_of_pos[sp]
         slot = self.base_of_pos[sp] + ranks
         out_lidx[owner, slot] = lanes[o2]
-        rr = rows_rel[o2]
-        out_rhi[owner, slot] = (rr // _ROW_LO).astype(np.int32)
-        out_rlo[owner, slot] = (rr % _ROW_LO).astype(np.int32)
+        out_rowid[owner, slot] = rows_rel[o2].astype(np.int16)
         out_lvals[owner, slot] = val_u[nz][o2]
 
     def permute_coef(self, coef: np.ndarray) -> np.ndarray:
@@ -258,7 +268,7 @@ class OneHotSparseLayout:
 
     __slots__ = (
         "plan", "dim", "n_shards", "n_windows", "n_sub", "n_flat", "nblk",
-        "n_model", "class_meta", "perm", "inv_perm", "lidx", "rhi", "rlo",
+        "n_model", "class_meta", "perm", "inv_perm", "lidx", "rowid",
         "lvals", "window_starts", "local_batch", "sub_batch",
     )
 
@@ -324,9 +334,8 @@ class OneHotSparseLayout:
             return None
 
         shape = (n_shards, n_model, n_windows, n_sub, plan.n_flat)
-        lidx = np.zeros(shape, np.int32)
-        rhi = np.zeros(shape, np.int32)
-        rlo = np.zeros(shape, np.int32)
+        lidx = np.zeros(shape, np.int8)
+        rowid = np.zeros(shape, np.int16)
         lvals = np.zeros(shape, np.float32 if values.dtype.kind == "f" else values.dtype)
         unit_iter = iter(bounds)
         for s in range(n_shards):
@@ -335,15 +344,15 @@ class OneHotSparseLayout:
                     r0, r1 = next(unit_iter)
                     plan.fill_unit(
                         indices[r0:r1], values[r0:r1],
-                        lidx[s, :, wi, bi], rhi[s, :, wi, bi],
-                        rlo[s, :, wi, bi], lvals[s, :, wi, bi],
+                        lidx[s, :, wi, bi], rowid[s, :, wi, bi],
+                        lvals[s, :, wi, bi],
                     )
 
         return cls(
             plan=plan, dim=int(dim), n_shards=n_shards, n_windows=n_windows,
             n_sub=n_sub, n_flat=plan.n_flat, nblk=nblk, n_model=n_model,
             class_meta=plan.class_meta, perm=plan.perm, inv_perm=plan.inv_perm,
-            lidx=lidx, rhi=rhi, rlo=rlo, lvals=lvals,
+            lidx=lidx, rowid=rowid, lvals=lvals,
             window_starts=window_starts, local_batch=local_batch, sub_batch=sub,
         )
 
@@ -590,8 +599,7 @@ def mult_crossing_pallas(mult3, rhi, rlo, row_hi, interpret: bool = False):
 def onehot_batch_step(
     coef_perm,
     lidx_w,
-    rhi_w,
-    rlo_w,
+    rowid_w,
     lvals_w,
     yb,
     wb,
@@ -607,18 +615,25 @@ def onehot_batch_step(
     gradients accumulated, returning ``(grad_perm, loss_sum, weight_sum)``
     with exactly the scatter path's batch semantics.
 
-    ``lidx_w/rhi_w/rlo_w/lvals_w``: this window's ``[n_sub, n_flat]`` slices
-    (this model shard's, under TP). ``yb/wb``: the window's label/weight
-    rows ``[local_batch]`` (wb already carries the mask and tail gating —
-    padded rows weigh 0, so their entries contribute nothing, and padded
-    entries carry value 0 on top). ``nblk`` is the model shard's LOCAL
-    block count; ``model_axis`` names the mesh axis the partial row dots
-    assemble over (each shard's entries cover only its feature blocks —
-    one psum completes the margin, after which the loss multiplier is
-    replicated across the axis and the gradient is block-local)."""
+    ``lidx_w/rowid_w/lvals_w``: this window's ``[n_sub, n_flat]`` packed
+    stack slices (this model shard's, under TP; int8 lane / int16 rowid —
+    unpacked to int32 here, transient through XLA fusion, so the 7 B/slot
+    packed form is what rides HBM and the host->device link). ``yb/wb``:
+    the window's label/weight rows ``[local_batch]`` (wb already carries
+    the mask and tail gating — padded rows weigh 0, so their entries
+    contribute nothing, and padded entries carry value 0 on top). ``nblk``
+    is the model shard's LOCAL block count; ``model_axis`` names the mesh
+    axis the partial row dots assemble over (each shard's entries cover
+    only its feature blocks — one psum completes the margin, after which
+    the loss multiplier is replicated across the axis and the gradient is
+    block-local)."""
     dot_cross = dot_crossing_pallas if use_pallas else dot_crossing_xla
     mult_cross = mult_crossing_pallas if use_pallas else mult_crossing_xla
     n_sub = lidx_w.shape[0]
+    lidx_w = lidx_w.astype(jnp.int32)
+    rid = rowid_w.astype(jnp.int32)
+    rhi_w = rid // _ROW_LO
+    rlo_w = rid % _ROW_LO
     # Every stage processes ALL sub-batches in one invocation (the sub axis
     # is just a leading batch dim) — per-invocation floors, not per-entry
     # work, dominated the per-sub form (measured).

@@ -462,9 +462,9 @@ def _fused_onehot_program(
     class_meta, row_hi = layout.class_meta, layout.row_hi
     model_axis = MODEL_AXIS if model_sharded else None
 
-    def per_shard(coef_perm, done, win_idx, offsets, active, lidx, rhi, rlo, lvals, y, w, mask):
+    def per_shard(coef_perm, done, win_idx, offsets, active, lidx, rowid, lvals, y, w, mask):
         # stacks arrive [1, 1, n_windows, n_sub, n_flat] per (data, model) shard
-        lidx, rhi, rlo, lvals = lidx[0, 0], rhi[0, 0], rlo[0, 0], lvals[0, 0]
+        lidx, rowid, lvals = lidx[0, 0], rowid[0, 0], lvals[0, 0]
 
         def body(carry, sched):
             cp, done = carry
@@ -482,7 +482,7 @@ def _fused_onehot_program(
                 yb = jnp.pad(yb, (0, padded_b - lb))
                 wb = jnp.pad(wb, (0, padded_b - lb))
             grad, loss_sum, wsum = onehot_batch_step(
-                cp, sel(lidx), sel(rhi), sel(rlo), sel(lvals), yb, wb,
+                cp, sel(lidx), sel(rowid), sel(lvals), yb, wb,
                 loss_func, class_meta, nblk_local, sub, row_hi, use_pallas,
                 model_axis=model_axis,
             )
@@ -521,7 +521,7 @@ def _fused_onehot_program(
     # shard_map's carry typing for the replicated coefficient.
     stack_spec = (
         (P(DATA_AXIS, MODEL_AXIS),) if model_sharded else (P(DATA_AXIS),)
-    ) * 4
+    ) * 3
     row_spec = (P(DATA_AXIS),) * 3  # y/w/mask
     coef_spec = P(MODEL_AXIS) if model_sharded else P()
     program = jax.jit(
@@ -649,9 +649,8 @@ class _OneHotWindowStream:
         n_mb = -(-min(W, m) // b)
         nf = self.plan.n_flat
         shape = (nd, nm, n_mb, self.n_sub, nf)
-        lidx = np.zeros(shape, np.int32)
-        rhi = np.zeros(shape, np.int32)
-        rlo = np.zeros(shape, np.int32)
+        lidx = np.zeros(shape, np.int8)
+        rowid = np.zeros(shape, np.int16)
         lvals = np.zeros(shape, np.float32)
         y = np.zeros(nd * W, np.float32)
         w = np.zeros(nd * W, np.float32)
@@ -688,15 +687,14 @@ class _OneHotWindowStream:
                     s1 = min(s0 + sub, r1)
                     self.plan.fill_unit(
                         idx_w[s0:s1], val_w[s0:s1],
-                        lidx[k, :, mb, bi], rhi[k, :, mb, bi],
-                        rlo[k, :, mb, bi], lvals[k, :, mb, bi],
+                        lidx[k, :, mb, bi], rowid[k, :, mb, bi],
+                        lvals[k, :, mb, bi],
                     )
         sh = self.ctx.sharding(DATA_AXIS, MODEL_AXIS)
         return {
             "stacks": (
                 jax.device_put(lidx, sh),
-                jax.device_put(rhi, sh),
-                jax.device_put(rlo, sh),
+                jax.device_put(rowid, sh),
                 jax.device_put(lvals, sh),
             ),
             "labels": jax.device_put(y, self.ctx.batch),
@@ -1047,9 +1045,10 @@ class SGD(Optimizer):
 
     # Fraction of reported HBM the one-hot stacks may claim under 'auto':
     # the CSR columns, labels/weights, coefficient and program workspace share
-    # the rest, and the stacks cost ~16 B per padded slot (3 int32 + 1 f32)
-    # vs the CSR data's 8 B per slot — a dataset near HBM capacity that
-    # trains fine on the scatter path must not OOM by auto-switching.
+    # the rest, and the packed stacks cost 7 B per padded slot (int8 lane +
+    # int16 rowid + f32 value) times the pow2 padding ratio — a dataset near
+    # HBM capacity that trains fine on the scatter path must not OOM by
+    # auto-switching.
     _ONEHOT_HBM_FRACTION = 0.35
 
     def _onehot_layout(self, train_data, ctx, dim, local_batch, force: bool):
@@ -1066,7 +1065,7 @@ class SGD(Optimizer):
             return memo[1], memo[2]
         host = train_data.host_columns
         # Stacks shard over the (data, model) axes — each device holds
-        # 1/(n_data*n_model) of the 16 B/slot (3 int32 + 1 f32) total;
+        # 1/(n_data*n_model) of the packed 7 B/slot total;
         # budget the per-device slice. The bound is applied inside build()
         # right after the counting pass, BEFORE any stack materializes — an
         # oversized layout must not cost a multi-GiB transient host
@@ -1087,8 +1086,7 @@ class SGD(Optimizer):
         sh = ctx.sharding(DATA_AXIS, MODEL_AXIS)
         dev = (
             jax.device_put(lay.lidx, sh),
-            jax.device_put(lay.rhi, sh),
-            jax.device_put(lay.rlo, sh),
+            jax.device_put(lay.rowid, sh),
             jax.device_put(np.asarray(lay.lvals, np.float32), sh),
         )
         train_data._onehot_memo = (key, lay, dev)

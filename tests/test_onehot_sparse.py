@@ -111,8 +111,8 @@ class TestBatchStep:
             rows = slice(w0, w0 + lay.local_batch)
             grad_p, ls, ws = onehot_batch_step(
                 cp,
-                jnp.asarray(lay.lidx[0, 0, wi]), jnp.asarray(lay.rhi[0, 0, wi]),
-                jnp.asarray(lay.rlo[0, 0, wi]), jnp.asarray(lay.lvals[0, 0, wi]),
+                jnp.asarray(lay.lidx[0, 0, wi]), jnp.asarray(lay.rowid[0, 0, wi]),
+                jnp.asarray(lay.lvals[0, 0, wi]),
                 jnp.asarray(np.pad(y[rows], (0, pad))),
                 jnp.asarray(np.pad(w[rows], (0, pad))),
                 BinaryLogisticLoss.INSTANCE, lay.class_meta, lay.nblk_local,
@@ -366,7 +366,7 @@ class TestSgdIntegration:
                 )
 
     def test_auto_gate_falls_back_when_stacks_exceed_hbm(self, monkeypatch):
-        # A dataset whose one-hot stacks (~16 B/slot) would overrun HBM must
+        # A dataset whose one-hot stacks (7 B/slot packed) would overrun HBM must
         # stay on the scatter path under 'auto' instead of OOMing.
         import flink_ml_tpu.ops.optimizer as opt_mod
 

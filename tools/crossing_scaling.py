@@ -73,8 +73,7 @@ def measure_scaling(p_list, global_batch, dim, nnz, K, seed=0):
             sh = ctx.sharding(DATA_AXIS, MODEL_AXIS)
             stacks = (
                 jax.device_put(lay.lidx, sh),
-                jax.device_put(lay.rhi, sh),
-                jax.device_put(lay.rlo, sh),
+                jax.device_put(lay.rowid, sh),
                 jax.device_put(np.asarray(lay.lvals, np.float32), sh),
             )
             args = (
