@@ -42,7 +42,7 @@ The crossings run two ways: a pure-XLA form (works on any backend;
 one-hots are materialized through HBM) and Pallas kernels (TPU only;
 one-hots are built tile-by-tile in VMEM and never touch HBM), selected by
 ``use_pallas``. Measured on one v5e chip at the Criteo shape (2^22
-features, 39 nnz/row, batch 65536): 22-32 ms/step across runs — ~1.8-2.3x
+features, 39 nnz/row, batch 65536): 17-32 ms/step across runs — ~1.8-2.9x
 the scatter path it replaces, on both the resident and streamed routes;
 the remaining cost is crossing-bound (see docs/benchmarks.md for the
 roofline and the measured multi-chip scaling artifact).
