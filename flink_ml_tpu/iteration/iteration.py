@@ -271,6 +271,14 @@ def iterate_unbounded(
     batch, epoch)`` returns feedback + outputs, and outputs are yielded per epoch —
     the model-as-stream semantics online algorithms need (OnlineLogisticRegression's
     versioned model stream).
+
+    Kill/resume: with a ``checkpoint_manager`` the snapshot is ``(epoch,
+    variables)`` where the epoch *is* the stream position (one batch per
+    epoch) — the analogue of the reference checkpointing source offsets with
+    operator state (Checkpoints.java:43-143, SGD.java:308-347). On restore the
+    driver skips the already-consumed prefix: via ``stream.skip(n)`` when the
+    source is seekable, else by discarding ``n`` batches. The resume contract
+    is therefore: pass a source that replays from the beginning (or seeks).
     """
     config = config or IterationConfig()
     context = IterationContext()
@@ -280,26 +288,56 @@ def iterate_unbounded(
     restored = _maybe_restore(config)
     if restored is not None:
         epoch, variables = restored
+        if epoch:
+            if hasattr(stream, "skip"):
+                stream.skip(epoch)
+            else:
+                stream = _drop_batches(stream, epoch)
 
     for batch in stream:
         result = body(variables, batch, epoch)
         for listener in listeners:
             listener.on_epoch_watermark_incremented(epoch, context)
         epoch += 1
+        # Snapshot BEFORE yielding this epoch's outputs: once the consumer has
+        # seen an epoch, a resume must never re-emit it (at interval=1 the
+        # re-execution window is exactly zero, matching SnapshotDriver).
+        done = result.feedback_variables is None
+        if not done:
+            variables = list(result.feedback_variables)
+            throttle.admit(variables)
+            _maybe_checkpoint(config, epoch, variables)
         for out in result.outputs:
             yield out
         while context.collected:
             yield context.collected.pop(0)
-        if result.feedback_variables is None:
+        if done:
             break
-        variables = list(result.feedback_variables)
-        throttle.admit(variables)
-        _maybe_checkpoint(config, epoch, variables)
 
     for listener in listeners:
         listener.on_iteration_terminated(context)
     while context.collected:
         yield context.collected.pop(0)
+
+
+def _drop_batches(stream, n: int):
+    """Fast-forward a replayed source past its already-consumed prefix.
+
+    A source that ends inside the consumed prefix violates the resume
+    contract (replay from the beginning); terminating silently there would be
+    indistinguishable from a clean run, so it raises instead.
+    """
+    it = iter(stream)
+    for i in range(n):
+        try:
+            next(it)
+        except StopIteration:
+            raise ValueError(
+                f"replayed source ended {n - i} batch(es) before the checkpointed "
+                f"offset {n}; on resume the source must replay the stream from "
+                "the beginning"
+            ) from None
+    return it
 
 
 def _maybe_checkpoint(config: IterationConfig, epoch: int, variables) -> None:

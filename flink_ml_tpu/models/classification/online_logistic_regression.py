@@ -30,7 +30,12 @@ import numpy as np
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.api.types import BasicType, DataTypes
-from flink_ml_tpu.models.online import OnlineModelBase, SnapshotDriver, as_batch_stream
+from flink_ml_tpu.models.online import (
+    HasCheckpointing,
+    OnlineModelBase,
+    array_digest,
+    as_batch_stream,
+)
 from flink_ml_tpu.ops.kernels import logistic_predict_kernel
 from flink_ml_tpu.params.param import FloatParam, ParamValidators, update_existing_params
 from flink_ml_tpu.params.shared import (
@@ -141,6 +146,7 @@ class OnlineLogisticRegression(
     HasPredictionCol,
     HasRawPredictionCol,
     _FtrlParams,
+    HasCheckpointing,
 ):
     """Ref OnlineLogisticRegression.java."""
 
@@ -185,12 +191,18 @@ class OnlineLogisticRegression(
             coef, n, z = step(coef, n, z, X, y, w)
             return (coef, n, z), np.asarray(coef)
 
-        driver = SnapshotDriver(
-            stream, train_step, (coef, jnp.zeros(dim), jnp.zeros(dim))
+        driver = self._snapshot_driver(
+            stream,
+            train_step,
+            (coef, jnp.zeros(dim), jnp.zeros(dim)),
+            payload_from_state=lambda s: np.asarray(s[0]),
+            dim=dim,
+            init=array_digest(self._initial_coefficient),
         )
         model = OnlineLogisticRegressionModel()
         update_existing_params(model, self)
         model._apply_snapshot(self._initial_coefficient)  # version 0 = init model
+        driver.resume_into(model)  # continue at the checkpointed version, if any
         model._attach_stream(driver)
         if bounded:
             model.advance()

