@@ -440,6 +440,14 @@ def _fused_onehot_program(
     blocks round-robin), the row-crossing dot assembles with a psum over
     ``model`` inside ``onehot_batch_step``, and the gradient stays
     block-local.
+
+    On a multi-slice mesh the batch (and with it the stacks) shards over
+    ``(slice, data)`` jointly, so stacks and crossings stay intra-slice —
+    the model axis is innermost and its crossing psum never leaves a
+    slice. The ONLY DCN-crossing collective is the final gradient/stats
+    psum over ``ctx.data_axes``, which XLA lowers hierarchically (ICI
+    within a slice, then the slice-count exchange over DCN) exactly like
+    the scatter path (cf. AllReduceImpl.java:54-102 serving every config).
     """
     from flink_ml_tpu.linalg.onehot_sparse import onehot_batch_step
 
@@ -461,6 +469,7 @@ def _fused_onehot_program(
     nblk_local = layout.nblk_local
     class_meta, row_hi = layout.class_meta, layout.row_hi
     model_axis = MODEL_AXIS if model_sharded else None
+    data_axes = ctx.data_axes  # ("slice", "data") on a multi-slice mesh
 
     def per_shard(coef_perm, done, win_idx, offsets, active, lidx, rowid, lvals, y, w, mask):
         # stacks arrive [1, 1, n_windows, n_sub, n_flat] per (data, model) shard
@@ -491,14 +500,14 @@ def _fused_onehot_program(
                 # stats are replicated across it (computed from the
                 # model-psum'd dot) — keep their psums separate so the
                 # replication stays statically visible to shard_map.
-                grad = jax.lax.psum(grad, DATA_AXIS)
-                stats = jax.lax.psum(jnp.stack([wsum, loss_sum]), DATA_AXIS)
+                grad = jax.lax.psum(grad, data_axes)
+                stats = jax.lax.psum(jnp.stack([wsum, loss_sum]), data_axes)
                 weight_sum, loss_sum = stats[0], stats[1]
             else:
                 packed = jnp.concatenate(
                     [grad, jnp.stack([wsum, loss_sum]).astype(grad.dtype)]
                 )
-                packed = jax.lax.psum(packed, DATA_AXIS)
+                packed = jax.lax.psum(packed, data_axes)
                 grad, weight_sum, loss_sum = packed[:-2], packed[-2], packed[-1]
             safe_w = jnp.maximum(weight_sum, 1e-30)
             new_cp = jnp.where(weight_sum > 0, cp - (lr / safe_w) * grad, cp)
@@ -520,9 +529,9 @@ def _fused_onehot_program(
     # model dim would tag every downstream value varying-over-model and trip
     # shard_map's carry typing for the replicated coefficient.
     stack_spec = (
-        (P(DATA_AXIS, MODEL_AXIS),) if model_sharded else (P(DATA_AXIS),)
+        (P(data_axes, MODEL_AXIS),) if model_sharded else (P(data_axes),)
     ) * 3
-    row_spec = (P(DATA_AXIS),) * 3  # y/w/mask
+    row_spec = (P(data_axes),) * 3  # y/w/mask
     coef_spec = P(MODEL_AXIS) if model_sharded else P()
     program = jax.jit(
         jax.shard_map(
@@ -690,7 +699,7 @@ class _OneHotWindowStream:
                         lidx[k, :, mb, bi], rowid[k, :, mb, bi],
                         lvals[k, :, mb, bi],
                     )
-        sh = self.ctx.sharding(DATA_AXIS, MODEL_AXIS)
+        sh = self.ctx.sharding(self.ctx.data_axes, MODEL_AXIS)
         return {
             "stacks": (
                 jax.device_put(lidx, sh),
@@ -1011,27 +1020,25 @@ class SGD(Optimizer):
         precision but not f64. Composes with tensor parallelism: on a TP
         mesh the occupancy-class blocks shard over the model axis
         (OneHotSparsePlan round-robin deal) and the crossing dot psums
-        over it.
+        over it. Composes with multi-slice: stacks/crossings stay
+        intra-slice and the final gradient psum reduces hierarchically
+        over ``ctx.data_axes`` (ICI then DCN).
         """
         if not sparse:  # dense + forced 'onehot' already raised in optimize()
             return False
         if self.sparse_kernel == "scatter":
             return False
         host = getattr(train_data, "host_columns", None)
-        ctx = self.ctx or get_mesh_context()
         feasible = (
             bool(host)
             and "indices" in host
             and jnp.dtype(self.dtype) == jnp.dtype(jnp.float32)
-            # one-hot stacks/crossings are laid out intra-slice; multi-slice
-            # meshes run the scatter kernel (its psum is slice-hierarchical)
-            and ctx.n_slices == 1
         )
         if self.sparse_kernel == "onehot":
             if not feasible:
                 raise ValueError(
                     "sparse_kernel='onehot' requires a fused f32 fit with "
-                    "host-readable sparse columns on a single-slice mesh; "
+                    "host-readable sparse columns; "
                     "use 'auto' or 'scatter' for this configuration"
                 )
             return True
@@ -1083,7 +1090,9 @@ class SGD(Optimizer):
         if lay is None:
             train_data._onehot_memo = (key, None, None)
             return None, None
-        sh = ctx.sharding(DATA_AXIS, MODEL_AXIS)
+        # Leading stack dim over (slice, data) jointly on multi-slice meshes:
+        # stacks never cross DCN.
+        sh = ctx.sharding(ctx.data_axes, MODEL_AXIS)
         dev = (
             jax.device_put(lay.lidx, sh),
             jax.device_put(lay.rowid, sh),
@@ -1150,20 +1159,16 @@ class SGD(Optimizer):
         The streamed layout contract is an ``OneHotSparsePlan`` built from a
         counting pass over the whole cache, so one compiled program serves
         every window (see OneHotSparsePlan). Same feasibility rules as the
-        resident gate: f32 only; composes with TP like the resident path."""
+        resident gate: f32 only; composes with TP and multi-slice like the
+        resident path."""
         if self.sparse_kernel == "scatter":
             return False
-        ctx = self.ctx or get_mesh_context()
-        feasible = (
-            jnp.dtype(self.dtype) == jnp.dtype(jnp.float32)
-            and ctx.n_slices == 1  # see _pick_onehot
-        )
+        feasible = jnp.dtype(self.dtype) == jnp.dtype(jnp.float32)
         if self.sparse_kernel == "onehot":
             if not feasible:
                 raise ValueError(
                     "sparse_kernel='onehot' on the streamed path requires an "
-                    "f32 fit on a single-slice mesh; use 'auto' or 'scatter' "
-                    "for this configuration"
+                    "f32 fit; use 'auto' or 'scatter' for this configuration"
                 )
             return True
         return feasible and n_rows * K >= 1 << 16 and dim >= self._ONEHOT_MIN_DIM

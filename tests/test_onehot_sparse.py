@@ -284,6 +284,58 @@ class TestSgdIntegration:
             np.testing.assert_allclose(coef_oh, coef_sc, rtol=1e-3, atol=1e-4)
             np.testing.assert_allclose(hist_oh, hist_sc, rtol=1e-3)
 
+    def test_onehot_multislice_matches_scatter(self):
+        # Round-5 composition (VERDICT r4 missing #3): the one-hot kernel on
+        # a (2 slices x 4 chips) mesh. Stacks/crossings stay intra-slice; the
+        # final gradient psum reduces hierarchically over (slice, data) —
+        # the result must match the scatter kernel on the same mesh.
+        rng = np.random.default_rng(22)
+        n, d, K = 512, 800, 8
+        cols = self._cols(rng, n, d, K)
+        with mesh_context(
+            MeshContext(devices=jax.devices()[:8], n_data=4, n_model=1, n_slices=2)
+        ) as ctx:
+            def fit(kernel):
+                sgd = SGD(
+                    max_iter=25, global_batch_size=128, tol=0.0,
+                    learning_rate=0.3, reg=0.01, elastic_net=0.5,
+                    ctx=ctx, sparse_kernel=kernel,
+                )
+                coef = sgd.optimize(
+                    np.zeros(d, np.float32),
+                    DeviceDataCache(cols, ctx=ctx),
+                    BinaryLogisticLoss.INSTANCE,
+                )
+                return coef, sgd.loss_history
+
+            coef_oh, hist_oh = fit("onehot")
+            coef_sc, hist_sc = fit("scatter")
+            np.testing.assert_allclose(coef_oh, coef_sc, rtol=1e-3, atol=1e-4)
+            np.testing.assert_allclose(hist_oh, hist_sc, rtol=1e-3)
+
+    def test_onehot_multislice_tp_matches_flat(self):
+        # The full composition: (slice=2, data=2, model=2). The model axis is
+        # innermost (its crossing psum never leaves a slice); results must
+        # match the flat (data=4, model=2) mesh.
+        rng = np.random.default_rng(23)
+        cols = self._cols(rng, 256, 600, 4)
+
+        def fit(ctx):
+            with mesh_context(ctx):
+                return SGD(
+                    max_iter=10, global_batch_size=64, tol=0.0,
+                    learning_rate=0.4, ctx=ctx, sparse_kernel="onehot",
+                ).optimize(
+                    np.zeros(600, np.float32),
+                    DeviceDataCache(cols, ctx=ctx),
+                    BinaryLogisticLoss.INSTANCE,
+                )
+
+        devices = jax.devices()[:8]
+        flat = fit(MeshContext(devices=devices, n_data=4, n_model=2))
+        hier = fit(MeshContext(devices=devices, n_data=2, n_model=2, n_slices=2))
+        np.testing.assert_allclose(hier, flat, rtol=1e-5, atol=1e-6)
+
     def test_onehot_tp_invariant_in_model_width(self):
         # Widening the model axis must not change the result (the data axis
         # legitimately changes minibatch composition via per-shard cycling,
