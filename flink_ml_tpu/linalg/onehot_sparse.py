@@ -293,7 +293,12 @@ class OneHotSparseLayout:
         class-major block layouts (stacks [n_shards, n_model, n_windows,
         n_sub, n_flat]). With ``max_stack_bytes``, returns None instead of
         materializing stacks that would exceed it (the size is known after
-        the counting pass, before any stack allocation)."""
+        the counting pass, before any stack allocation).
+
+        Float values pack into an f32 stack — float64 inputs are downcast
+        (the MXU crossing path carries values as split-bf16 pairs, which
+        reconstruct f32-grade precision, not f64; the SGD gate admits only
+        f32 fits, but direct callers lose f64 precision here)."""
         from flink_ml_tpu.ops.optimizer import offset_schedule
 
         indices = np.asarray(indices, np.int64)

@@ -282,18 +282,36 @@ def fused_chunk_len(
         cap = min(cap, _TOL_CHUNK)
     return max(1, min(max_iter, cap))
 
-def _hbm_bytes_limit() -> int:
-    """Best-effort per-device accelerator memory budget. TPUs report
-    ``bytes_limit`` through memory_stats(); backends that don't (virtual CPU
-    meshes, where host RAM is not the scarce resource) get a conservative
-    16 GiB stand-in — the v5e-class HBM size the layouts are designed for."""
+def _host_ram_bytes() -> int:
+    """MemTotal from /proc/meminfo, or 0 when unreadable (non-Linux)."""
     try:
-        stats = jax.devices()[0].memory_stats() or {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+def _hbm_bytes_limit(ctx: Optional[MeshContext] = None) -> int:
+    """Best-effort per-device accelerator memory budget for the mesh's
+    devices. TPUs report ``bytes_limit`` through memory_stats(); backends
+    that don't (virtual CPU meshes) get host RAM split across the mesh's
+    devices — they all share it, so a per-device 16 GiB stand-in times
+    n_devices could promise more memory than the host has — capped at the
+    16 GiB v5e-class HBM size the layouts are designed for."""
+    devices = list(ctx.mesh.devices.flat) if ctx is not None else jax.devices()
+    try:
+        stats = devices[0].memory_stats() or {}
         limit = int(stats.get("bytes_limit", 0))
         if limit > 0:
             return limit
     except Exception:
         pass
+    ram = _host_ram_bytes()
+    if ram:
+        return min(16 << 30, ram // max(1, len(devices)))
     return 16 << 30
 
 
@@ -1080,7 +1098,7 @@ class SGD(Optimizer):
         budget = (
             None
             if force
-            else int(self._ONEHOT_HBM_FRACTION * _hbm_bytes_limit())
+            else int(self._ONEHOT_HBM_FRACTION * _hbm_bytes_limit(ctx))
             * ctx.n_data * ctx.n_model
         )
         lay = OneHotSparseLayout.build(
@@ -1210,7 +1228,7 @@ class SGD(Optimizer):
         # per-device slice.
         if self.sparse_kernel != "onehot":
             per_dev = 2 * plan.stack_bytes(n_mb * n_sub) // max(1, ctx.n_model)
-            if per_dev > self._ONEHOT_HBM_FRACTION * _hbm_bytes_limit():
+            if per_dev > self._ONEHOT_HBM_FRACTION * _hbm_bytes_limit(ctx):
                 return None
 
         flops = 4.0 * n_sub * plan.n_flat * (sub + 2 * BLOCK)

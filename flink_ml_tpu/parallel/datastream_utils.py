@@ -66,7 +66,13 @@ def map_partition(
     bincounts) release the GIL and scale with host cores. Default (None):
     threads when the host has more than one core and there is more than one
     partition; a single-core host or single partition stays in-line (a pool
-    would only add overhead)."""
+    would only add overhead).
+
+    Thread-safety contract: under the threaded default ``fn`` may run
+    concurrently from multiple threads — exactly like a reference
+    ``mapPartition`` UDF runs on concurrent subtasks — so an ``fn`` that
+    mutates shared state must either synchronize it or be called with
+    ``parallel=False`` to pin the sequential order."""
     ctx = ctx or get_mesh_context()
     n = _num_rows(columns)
     slices = _partition_slices(n, ctx.n_data)

@@ -133,7 +133,10 @@ class QuantileSummary:
         host loop runs over *kept* tuples (bounded ~1/(2·eps)), not all n —
         the difference between O(n) Python iterations per flush and O(k·log n)
         at 10M-row fit scale. Merge decisions are integer-exact and identical
-        to the scalar scan's."""
+        to the scalar scan's: the integer LHS ``G[i] + delta[h] - G[h+1]`` is
+        compared against ``ceil(threshold)`` in int64 (for integer x and real
+        t, ``x < t`` iff ``x < ceil(t)``), so suffix sums near 2^63 — far past
+        float64's 2^53 integer range — cannot flip a decision."""
         n = len(self.values)
         if n == 0:
             return
@@ -142,8 +145,9 @@ class QuantileSummary:
         G[:n] = np.cumsum(self.g[::-1])[::-1]
         keep: list = []
         head = n - 1
+        int_threshold = math.ceil(merge_threshold)
         while head >= 1:
-            bound = merge_threshold - float(self.delta[head]) + float(G[head + 1])
+            bound = int_threshold - int(self.delta[head]) + int(G[head + 1])
             # tuples i in [1, head-1] merge while G[i] < bound; G[1:head] is
             # non-increasing, so the run ends at the LAST i with G[i] >= bound
             seg = G[1:head]

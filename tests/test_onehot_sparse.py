@@ -425,7 +425,7 @@ class TestSgdIntegration:
         rng = np.random.default_rng(12)
         n, d, K = 1 << 14, 1 << 15, 8
         cols = self._cols(rng, n, d, K)
-        monkeypatch.setattr(opt_mod, "_hbm_bytes_limit", lambda: 1 << 20)
+        monkeypatch.setattr(opt_mod, "_hbm_bytes_limit", lambda ctx=None: 1 << 20)
         with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
             cache = DeviceDataCache(cols, ctx=ctx)
             coef = SGD(max_iter=2, global_batch_size=n, ctx=ctx).optimize(

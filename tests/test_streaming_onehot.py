@@ -171,7 +171,7 @@ def test_streamed_auto_narrow_stays_on_scatter(monkeypatch):
 def test_streamed_auto_falls_back_when_stacks_exceed_hbm(monkeypatch):
     import flink_ml_tpu.ops.optimizer as om
 
-    monkeypatch.setattr(om, "_hbm_bytes_limit", lambda: 1 << 16)
+    monkeypatch.setattr(om, "_hbm_bytes_limit", lambda ctx=None: 1 << 16)
     n, d, K = 2048, 1 << 15, 32
     cols = _sparse_data(n, d, K, seed=8)
     cache = _fill(HostDataCache(), cols, chunk=256)
