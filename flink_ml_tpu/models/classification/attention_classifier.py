@@ -188,12 +188,32 @@ def _predict_step(mesh, n_heads: int, flash: bool = False):
 
 
 def _use_flash(ctx: MeshContext, tok: np.ndarray, emb: int, n_heads: int) -> bool:
-    """Fused-fold gate for this (mesh, sequence) shape — the activations on
-    this path are f32, so only the tiling/VMEM/device conditions apply."""
+    """Fused-fold gate for serving this (mesh, sequence) shape — the
+    activations on this path are f32, so only the tiling/VMEM/device
+    conditions apply."""
     from flink_ml_tpu.parallel.flash import flash_available
 
     return flash_available(
         tok.shape[1] // ctx.n_data, emb // n_heads, list(ctx.mesh.devices.flat)
+    )
+
+
+def _use_flash_train(
+    ctx: MeshContext, tok: np.ndarray, emb: int, n_heads: int, batch: int
+) -> bool:
+    """Fused-fold gate for the TRAINING step: the fused backward's pallas
+    outputs scale with batch*heads and hit the scoped-VMEM envelope before
+    the forward does (flash.flash_train_available); past it the step trains
+    on the jnp fold — identical numbers through HBM, never a compile
+    failure."""
+    from flink_ml_tpu.parallel.flash import flash_train_available
+
+    return flash_train_available(
+        tok.shape[1] // ctx.n_data,
+        emb // n_heads,
+        batch,
+        n_heads,
+        list(ctx.mesh.devices.flat),
     )
 
 
@@ -302,13 +322,15 @@ class SelfAttentionClassifier(Estimator, _AttnParams):
         params = jax.tree_util.tree_map(
             jnp.asarray, _init_params(rng, vocab, emb, len(labels))
         )
-        optimizer, step = _train_step(
-            ctx.mesh, n_heads, self.get_learning_rate(), _use_flash(ctx, tok, emb, n_heads)
-        )
-        opt_state = optimizer.init(params)
-
         n = tok.shape[0]
         batch = min(self.get_global_batch_size(), n)
+        optimizer, step = _train_step(
+            ctx.mesh,
+            n_heads,
+            self.get_learning_rate(),
+            _use_flash_train(ctx, tok, emb, n_heads, batch),
+        )
+        opt_state = optimizer.init(params)
         tok_dev = jax.device_put(tok, ctx.sharding(None, DATA_AXIS))
         y_dev = ctx.replicate(y_idx.astype(np.int32))
         nv = jnp.asarray(t_real, jnp.int32)

@@ -35,7 +35,13 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["fused_fold", "flash_available", "reference_fold", "TQ_TILE"]
+__all__ = [
+    "fused_fold",
+    "flash_available",
+    "flash_train_available",
+    "reference_fold",
+    "TQ_TILE",
+]
 
 TQ_TILE = 256  # Q rows per grid cell
 
@@ -61,6 +67,30 @@ def flash_available(T: int, D: int, devices=None) -> bool:
     if T % TQ_TILE or T * D > _KV_VMEM_BUDGET or T > _TK_MAX:
         return False
     return is_tpu_backend(devices if devices is not None else jax.devices())
+
+
+# Per-kernel-output VMEM envelope for the TRAINING (fwd+bwd) graph. Measured
+# on a v5e chip: when the backward pallas_call's [B*H, T, D]-shaped outputs
+# total near the 16 MB scoped-VMEM limit, XLA's latency optimizer places
+# them in VMEM (S(1)) and the compile fails with a scoped-vmem OOM —
+# observed failing at B*H*T*(D+2)*4 = 16.8-17.2 MB (B=1, T=8192, H=4,
+# D=128) and succeeding at 8.4 MB (B=1, T=4096); forward-only graphs place
+# the same outputs in HBM and compile fine up to flash_available's bounds.
+# 9 MB admits every shape verified good and rejects the untested band up to
+# the observed failures.
+_TRAIN_OUT_VMEM_BUDGET = 9 << 20
+
+
+def flash_train_available(T: int, D: int, batch: int, n_heads: int, devices=None) -> bool:
+    """Whether the fused fold may serve a TRAINING step (fwd + the fused
+    backward). Stricter than ``flash_available``: the backward graph's
+    [batch*heads, T, D] pallas outputs must stay under
+    ``_TRAIN_OUT_VMEM_BUDGET`` or XLA's VMEM output placement blows the
+    scoped limit (see note above). Past the budget the jnp fold trains the
+    same numbers through HBM — slower, never a compile failure."""
+    if not flash_available(T, D, devices):
+        return False
+    return batch * n_heads * T * (D + 2) * 4 <= _TRAIN_OUT_VMEM_BUDGET
 
 
 def reference_fold(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid, scale):

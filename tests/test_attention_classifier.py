@@ -152,3 +152,39 @@ def test_transform_rejects_unseen_token_ids():
     bad[0, 0] = 99  # beyond the trained vocab: must error, not clamp
     with pytest.raises(ValueError, match="token ids"):
         model.transform(DataFrame.from_dict({"features": bad}))
+
+
+class TestFlashTrainGate:
+    """The training-path fused-fold gate (round 5): the fused backward's
+    pallas outputs scale with batch*heads and hit the 16 MB scoped-VMEM
+    envelope before the forward does — measured on chip: B*H*T*(D+2)*4 of
+    16.8-17.2 MB fails to compile, 8.4 MB compiles. fit() must fall back to
+    the jnp fold past the envelope instead of handing XLA a program that
+    cannot compile."""
+
+    def test_envelope_arithmetic(self, monkeypatch):
+        from flink_ml_tpu.parallel import flash
+
+        monkeypatch.setattr(flash, "flash_available", lambda T, D, devices=None: True)
+        # the observed-good single-chip shapes
+        assert flash.flash_train_available(4096, 128, 1, 4)
+        assert flash.flash_train_available(2048, 128, 2, 4)
+        assert flash.flash_train_available(512, 128, 8, 4)
+        # the observed compile failures (and anything bigger)
+        assert not flash.flash_train_available(8192, 128, 1, 4)
+        assert not flash.flash_train_available(4096, 128, 2, 4)
+        assert not flash.flash_train_available(2048, 128, 16, 4)
+
+    def test_train_gate_stricter_than_serving(self, monkeypatch):
+        from flink_ml_tpu.parallel import flash
+
+        monkeypatch.setattr(flash, "flash_available", lambda T, D, devices=None: True)
+        # Serving admits T=8192 D=128 (measured on chip, r4); training must not.
+        assert not flash.flash_train_available(8192, 128, 1, 4)
+
+    def test_infeasible_flash_falls_through_gate(self):
+        from flink_ml_tpu.parallel.flash import flash_train_available
+
+        # CPU backend: gate is False (Mosaic target required) — fit() then
+        # trains on the jnp fold; covered end-to-end by the other tests here.
+        assert not flash_train_available(4096, 128, 1, 4)
