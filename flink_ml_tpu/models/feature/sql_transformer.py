@@ -8,8 +8,18 @@ The reference delegates to Flink's full SQL planner. Here a documented subset is
 evaluated columnar over numpy:
   SELECT <expr> [AS alias][, ...] FROM __THIS__ [WHERE <cond>]
 with ``*`` expansion, arithmetic/comparison/boolean operators (SQL ``=``, AND, OR,
-NOT), and the scalar functions ABS, SQRT, EXP, LOG, POW, MIN, MAX. Aggregations,
-joins, and window clauses are not supported and raise ValueError.
+NOT), and the scalar functions ABS, SQRT, EXP, LOG, POW, MIN, MAX (two-argument
+MIN/MAX are elementwise, like SQL LEAST/GREATEST).
+
+Global aggregates — COUNT(*), COUNT(expr), SUM, AVG, and single-argument
+MIN/MAX over the whole table (round 5) — are supported without GROUP BY:
+every select item must then be an expression of aggregates (the output is
+one row; per-row columns may appear only inside an aggregate), WHERE
+filters before aggregation (aggregates are not allowed inside WHERE — no
+HAVING), and aggregates compose with arithmetic (``SUM(v1) / COUNT(*)``).
+Over an empty (filtered) table: COUNT = 0, SUM = 0.0, and MIN/MAX/AVG =
+NaN (this subset has no NULL). GROUP BY, joins, and window clauses are not
+supported and raise ValueError.
 """
 from __future__ import annotations
 
@@ -74,6 +84,86 @@ def _split_top_level_keyword(s: str, keyword: str) -> List[str]:
     return parts
 
 
+_AGG_REDUCERS = {
+    "COUNT": len,
+    "SUM": np.sum,
+    "AVG": np.mean,
+    "MIN": np.min,
+    "MAX": np.max,
+}
+
+
+def _find_aggregate_calls(expr: str):
+    """Locate aggregate calls ``FN(...)`` with balanced parens. Returns
+    ``[(start, end, fn_name, inner)]``. Two-argument MIN/MAX are the
+    documented elementwise scalars (SQL LEAST/GREATEST), not aggregates."""
+    calls = []
+    for m in re.finditer(r"\b(COUNT|SUM|AVG|MIN|MAX)\s*\(", expr, re.I):
+        depth, i = 1, m.end()
+        while i < len(expr) and depth:
+            if expr[i] == "(":
+                depth += 1
+            elif expr[i] == ")":
+                depth -= 1
+            i += 1
+        if depth:
+            raise ValueError(f"SQLTransformer: unbalanced parens in {expr!r}")
+        inner = expr[m.end() : i - 1].strip()
+        fn = m.group(1).upper()
+        if fn in ("MIN", "MAX") and len(_split_top_level_commas(inner)) > 1:
+            continue  # elementwise two-argument form
+        calls.append((m.start(), i, fn, inner))
+    return calls
+
+
+def _eval_aggregate_item(expr: str, allowed, namespace, n_rows: int):
+    """Evaluate a select item that contains aggregate calls: each call is
+    reduced to a scalar, substituted for a temp name, and the remaining
+    expression (arithmetic of aggregates ONLY — a bare per-row column
+    outside an aggregate has no meaning in a one-row result and is
+    rejected, as in real SQL) is evaluated."""
+    calls = _find_aggregate_calls(expr)
+    rewritten, last = [], 0
+    local_ns = dict(namespace)
+    outer_allowed = set()  # temps only: no per-row columns in the outer expr
+    for j, (start, end, fn, inner) in enumerate(calls):
+        if _find_aggregate_calls(inner):
+            raise ValueError(
+                f"SQLTransformer: nested aggregates are not supported: {expr!r}"
+            )
+        temp = f"aggtmp{j}"
+        if fn == "COUNT":
+            if inner != "*":
+                # validate the expression, but COUNT counts rows — this
+                # subset has no NULL, so COUNT(expr) == COUNT(*), including
+                # the COUNT(1) idiom.
+                _check_safe(inner, allowed)
+                eval(_sql_to_python(inner), {"__builtins__": {}}, namespace)
+            value = n_rows
+        else:
+            _check_safe(inner, allowed)
+            col = np.atleast_1d(
+                np.asarray(
+                    eval(_sql_to_python(inner), {"__builtins__": {}}, namespace)
+                )
+            )
+            if col.size == 0:
+                # empty filtered table: SUM = 0.0, MIN/MAX/AVG = NaN (no
+                # NULL in this subset) — defined results, not numpy errors
+                value = 0.0 if fn == "SUM" else float("nan")
+            else:
+                value = _AGG_REDUCERS[fn](col)
+        local_ns[temp] = value
+        outer_allowed.add(temp)
+        rewritten.append(expr[last:start])
+        rewritten.append(temp)
+        last = end
+    rewritten.append(expr[last:])
+    outer = "".join(rewritten)
+    _check_safe(outer, outer_allowed)
+    return eval(_sql_to_python(outer), {"__builtins__": {}}, local_ns)
+
+
 def _sql_to_python(expr: str) -> str:
     """SQL boolean expression → numpy-evaluable Python, preserving SQL precedence
     (OR < AND < NOT < comparison) by parenthesizing each operand — numpy's &/| bind
@@ -124,6 +214,25 @@ class SQLTransformer(Transformer):
     def transform(self, *inputs):
         (df,) = inputs
         stmt = self.get_statement().strip().rstrip(";")
+        # Loud, specific rejections for SQL the subset will never parse —
+        # checked on the whole statement so a trailing clause after WHERE
+        # cannot be swallowed by the WHERE capture and surface as a
+        # misleading unknown-identifier error. These are SQL reserved words
+        # (plus OVER followed by a paren), so no legal column reference in
+        # the subset collides with them.
+        for pattern, name in (
+            (r"GROUP\s+BY", "GROUP BY"),
+            (r"ORDER\s+BY", "ORDER BY"),
+            (r"JOIN", "JOIN"),
+            (r"HAVING", "HAVING"),
+            (r"OVER\s*\(", "OVER (window)"),
+        ):
+            if re.search(rf"\b{pattern}", stmt, re.I):
+                raise ValueError(
+                    f"SQLTransformer: {name} is not supported (the subset is "
+                    "'SELECT ... FROM __THIS__ [WHERE ...]' with global "
+                    "aggregates; see the module docstring)"
+                )
         m = re.match(
             r"SELECT\s+(?P<select>.+?)\s+FROM\s+__THIS__(?:\s+WHERE\s+(?P<where>.+))?$",
             stmt,
@@ -142,15 +251,41 @@ class SQLTransformer(Transformer):
 
         base = df
         if m.group("where"):
+            if _find_aggregate_calls(m.group("where")):
+                raise ValueError(
+                    "SQLTransformer: aggregates are not allowed in WHERE "
+                    "(there is no HAVING in the subset)"
+                )
             _check_safe(m.group("where"), allowed)
             cond = eval(_sql_to_python(m.group("where")), {"__builtins__": {}}, namespace)
             base = df.take(np.nonzero(np.asarray(cond))[0])
             for name in base.get_column_names():
                 namespace[name] = base.column(name)
 
+        items = _split_top_level_commas(m.group("select"))
+        has_agg = [bool(_find_aggregate_calls(i)) for i in items]
+        if any(has_agg):
+            if not all(has_agg):
+                raise ValueError(
+                    "SQLTransformer: without GROUP BY every select item must "
+                    "be an aggregate expression (the output is one row); got "
+                    f"mixed items in {m.group('select')!r}"
+                )
+            out_names, out_cols = [], []
+            for item in items:
+                alias_match = re.match(
+                    r"(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)$", item, re.I
+                )
+                expr = alias_match.group("expr") if alias_match else item
+                name = alias_match.group("alias") if alias_match else expr.strip()
+                value = _eval_aggregate_item(expr, allowed, namespace, base.num_rows)
+                out_names.append(name)
+                out_cols.append(np.asarray([value]))
+            return DataFrame(out_names, None, out_cols)
+
         out_names: List[str] = []
         out_cols = []
-        for item in _split_top_level_commas(m.group("select")):
+        for item in items:
             if item == "*":
                 for name in base.get_column_names():
                     out_names.append(name)

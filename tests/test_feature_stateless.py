@@ -294,3 +294,125 @@ def test_hashing_tf_numpy_bool_terms():
     v_py = tf.transform(df_py)["output"][0]
     np.testing.assert_array_equal(v_np.indices, v_py.indices)
     np.testing.assert_array_equal(v_np.values, v_py.values)
+
+
+def test_sql_transformer_global_aggregates():
+    # Round-5 subset widening: COUNT/SUM/AVG/MIN/MAX over the whole table
+    # (no GROUP BY); WHERE filters before aggregation; aggregates compose
+    # with arithmetic; two-argument MIN/MAX stays elementwise.
+    df = DataFrame.from_dict(
+        {"v1": np.asarray([1.0, 4.0, 7.0]), "v2": np.asarray([2.0, 5.0, 8.0])}
+    )
+    out = (
+        SQLTransformer()
+        .set_statement(
+            "SELECT COUNT(*) AS n, SUM(v1) AS s, AVG(v2) AS a, "
+            "MIN(v1) AS lo, MAX(v2) AS hi FROM __THIS__"
+        )
+        .transform(df)
+    )
+    assert len(out) == 1
+    assert out["n"][0] == 3
+    np.testing.assert_allclose(out["s"], [12.0])
+    np.testing.assert_allclose(out["a"], [5.0])
+    np.testing.assert_allclose(out["lo"], [1.0])
+    np.testing.assert_allclose(out["hi"], [8.0])
+
+    # WHERE before aggregation + aggregate over an expression
+    out2 = (
+        SQLTransformer()
+        .set_statement("SELECT SUM(v1 + v2) AS s FROM __THIS__ WHERE v1 > 1")
+        .transform(df)
+    )
+    np.testing.assert_allclose(out2["s"], [24.0])
+
+    # arithmetic of aggregates (the mean, spelled out)
+    out3 = (
+        SQLTransformer()
+        .set_statement("SELECT SUM(v1) / COUNT(*) AS mean1 FROM __THIS__")
+        .transform(df)
+    )
+    np.testing.assert_allclose(out3["mean1"], [4.0])
+
+    # COUNT(expr) counts rows of the (filtered) table
+    out4 = (
+        SQLTransformer()
+        .set_statement("SELECT COUNT(v1) AS n FROM __THIS__ WHERE v2 > 2")
+        .transform(df)
+    )
+    assert out4["n"][0] == 2
+
+    # two-argument MIN/MAX keeps the elementwise (LEAST/GREATEST) meaning
+    out5 = (
+        SQLTransformer()
+        .set_statement("SELECT MIN(v1, v2) AS lo FROM __THIS__")
+        .transform(df)
+    )
+    np.testing.assert_array_equal(out5["lo"], [1.0, 4.0, 7.0])
+
+
+def test_sql_transformer_aggregate_errors():
+    df = DataFrame.from_dict({"v1": np.asarray([1.0, 2.0])})
+    # mixed aggregate and per-row items without GROUP BY
+    with pytest.raises(ValueError, match="aggregate"):
+        SQLTransformer().set_statement(
+            "SELECT v1, SUM(v1) FROM __THIS__"
+        ).transform(df)
+    # nested aggregates
+    with pytest.raises(ValueError, match="nested"):
+        SQLTransformer().set_statement(
+            "SELECT SUM(AVG(v1)) FROM __THIS__"
+        ).transform(df)
+    # GROUP BY / JOIN / OVER: loud, specific rejections
+    with pytest.raises(ValueError, match="GROUP BY"):
+        SQLTransformer().set_statement(
+            "SELECT SUM(v1) FROM __THIS__ GROUP BY v1"
+        ).transform(df)
+    with pytest.raises(ValueError, match="JOIN"):
+        SQLTransformer().set_statement(
+            "SELECT v1 FROM __THIS__ JOIN other ON x = y"
+        ).transform(df)
+    # aggregate inside WHERE is outside the subset (no HAVING)
+    with pytest.raises(ValueError):
+        SQLTransformer().set_statement(
+            "SELECT v1 FROM __THIS__ WHERE SUM(v1) > 1"
+        ).transform(df)
+
+
+def test_sql_transformer_aggregate_edge_cases():
+    df = DataFrame.from_dict(
+        {"v1": np.asarray([1.0, 4.0, 7.0]), "v2": np.asarray([2.0, 5.0, 8.0])}
+    )
+    # COUNT(1) idiom == COUNT(*) (no NULL in the subset)
+    out = SQLTransformer().set_statement(
+        "SELECT COUNT(1) AS n FROM __THIS__"
+    ).transform(df)
+    assert out["n"][0] == 3
+    # a bare per-row column outside an aggregate is rejected, like real SQL
+    with pytest.raises(ValueError, match="unknown identifier"):
+        SQLTransformer().set_statement(
+            "SELECT SUM(v1) + v2 AS x FROM __THIS__"
+        ).transform(df)
+    # empty filtered table: defined results, not numpy errors
+    out2 = SQLTransformer().set_statement(
+        "SELECT COUNT(*) AS n, SUM(v1) AS s, MIN(v1) AS lo, AVG(v1) AS a "
+        "FROM __THIS__ WHERE v1 > 100"
+    ).transform(df)
+    assert out2["n"][0] == 0 and out2["s"][0] == 0.0
+    assert np.isnan(out2["lo"][0]) and np.isnan(out2["a"][0])
+    # aggregates (incl. 1-arg MIN) in WHERE: clean ValueError, not TypeError
+    for stmt in (
+        "SELECT v1 FROM __THIS__ WHERE v1 > MIN(v1)",
+        "SELECT v1 FROM __THIS__ WHERE SUM(v1) > 1",
+    ):
+        with pytest.raises(ValueError, match="not allowed in WHERE"):
+            SQLTransformer().set_statement(stmt).transform(df)
+    # trailing clause after WHERE still gets the specific rejection
+    with pytest.raises(ValueError, match="GROUP BY"):
+        SQLTransformer().set_statement(
+            "SELECT SUM(v1) FROM __THIS__ WHERE v1 > 1 GROUP BY v2"
+        ).transform(df)
+    with pytest.raises(ValueError, match="ORDER BY"):
+        SQLTransformer().set_statement(
+            "SELECT v1 FROM __THIS__ ORDER BY v1"
+        ).transform(df)
