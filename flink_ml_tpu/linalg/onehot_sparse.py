@@ -495,7 +495,12 @@ def dot_crossing_pallas(q, rhi, rlo, row_hi, interpret: bool = False):
     from jax.experimental.pallas import tpu as pltpu
 
     n_sub, n = q.shape
-    tile = min(_CROSS_TILE, n)
+    # Below row_hi=64 the cell does NOT get cheaper — Mosaic pads the
+    # one-hot's minor dim to the 128-lane tile — and the full-size tile
+    # overruns the 16 MB scoped-VMEM limit by ~0.5 MB (measured on chip at
+    # row_hi 16/32: 16.4-16.6 MB). Halving the tile restores headroom;
+    # row_hi >= 64 compiles at full tile.
+    tile = min(_CROSS_TILE if row_hi >= 64 else _CROSS_TILE // 2, n)
     if n % tile:  # pad to a whole number of tiles (q=0 contributes nothing)
         pad = tile - n % tile
         q = jnp.pad(q, ((0, 0), (0, pad)))
@@ -553,6 +558,10 @@ def mult_crossing_pallas(mult3, rhi, rlo, row_hi, interpret: bool = False):
     from jax.experimental.pallas import tpu as pltpu
 
     n_sub, n = rhi.shape
+    # Unlike dot_crossing, the full tile fits at every row_hi here (probed
+    # on chip at row_hi 16/32/64/128): this cell carries one bf16 one-hot +
+    # two f32 [tile, 128] buffers vs the dot cell's three bf16 [tile, 128]
+    # products plus the matmul staging that overruns at small row_hi.
     tile = min(_CROSS_TILE, n)
     pad = (tile - n % tile) % tile
     if pad:
