@@ -239,14 +239,29 @@ def bench_logreg_sparse(peak_flops, peak_gbps=None):
         ctx=ctx,
     )
 
-    def steps(iters):
-        SGD(max_iter=iters, global_batch_size=batch, tol=0.0, learning_rate=0.5).optimize(
-            np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
+    def steps(iters, premat="auto"):
+        sgd = SGD(
+            max_iter=iters, global_batch_size=batch, tol=0.0,
+            learning_rate=0.5, onehot_premat=premat,
         )
+        sgd.optimize(np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+        return sgd
 
+    premat_active = steps(2).onehot_premat_active  # compile + gate decision
     t1 = _median_time(lambda: steps(i1))
     t2 = _median_time(lambda: steps(i2))
     step_s = max((t2 - t1) / (i2 - i1), 1e-9)
+    # The build-form (rebuild-one-hots-every-step) time, for the record:
+    # what the same fit costs when the premat one-hots don't fit HBM
+    # (many-window/streamed regime) — and the continuity column against
+    # rounds 3-4, which measured this form as the headline.
+    if premat_active:
+        steps(2, premat="off")
+        b1 = _median_time(lambda: steps(i1, premat="off"))
+        b2 = _median_time(lambda: steps(i2, premat="off"))
+        build_step_s = max((b2 - b1) / (i2 - i1), 1e-9)
+    else:
+        build_step_s = step_s
     # fwd gather-dot (2*B*K) + grad scatter (2*B*K), counting madds like dense
     flops_per_step = 4.0 * batch * K
 
@@ -281,11 +296,17 @@ def bench_logreg_sparse(peak_flops, peak_gbps=None):
         "steady_rows_per_sec": round(batch / step_s, 1),
         "step_time_us": round(step_s * 1e6, 1),
         "achieved_gflops": round(flops_per_step / step_s / 1e9, 2),
+        "onehot_premat_active": premat_active,
+        "build_form_step_time_us": round(build_step_s * 1e6, 1),
+        "vs_build_form": round(build_step_s / step_s, 2),
         "cpu_baseline_rows_per_sec": round(cpu_best, 1),
         "cpu_baseline_spread": cpu_spread,
         "vs_cpu_baseline": round((batch / step_s) / cpu_best, 2),
         "note": "padded-CSR; densified this batch would be ~1 TB/step; "
-        "ratio divides by the STRONGEST of 5 baseline runs",
+        "ratio divides by the STRONGEST of 5 baseline runs; the headline "
+        "step runs the premat (precomputed-one-hot) kernels when "
+        "onehot_premat_active, with build_form_step_time_us the "
+        "rebuild-every-step form rounds 3-4 measured",
     }
     if peak_flops:
         out["mfu"] = round(flops_per_step / step_s / peak_flops, 8)
@@ -299,12 +320,13 @@ def bench_logreg_sparse(peak_flops, peak_gbps=None):
             _crossing_roofline(
                 memo[1], out["step_time_us"], peak_flops, peak_gbps,
                 use_pallas=is_tpu_backend(ctx.mesh.devices.flat),
+                premat=premat_active,
             )
         )
     return out
 
 
-def _crossing_roofline(lay, step_us, peak_flops, peak_gbps, use_pallas=True):
+def _crossing_roofline(lay, step_us, peak_flops, peak_gbps, use_pallas=True, premat=False):
     """Quantified crossing roofline (VERDICT r4 next #3): measure the two
     crossing kernels ALONE at the step's exact unit shapes, and bound them
     by spec — MXU FLOPs at bf16 peak and HBM stream bytes at peak
@@ -322,9 +344,14 @@ def _crossing_roofline(lay, step_us, peak_flops, peak_gbps, use_pallas=True):
 
     from flink_ml_tpu.linalg.onehot_sparse import (
         dot_crossing_pallas,
+        dot_crossing_premat_pallas,
+        dot_crossing_premat_xla,
         dot_crossing_xla,
         mult_crossing_pallas,
+        mult_crossing_premat_pallas,
+        mult_crossing_premat_xla,
         mult_crossing_xla,
+        premat_row_onehots,
     )
 
     n_sub, n_flat, sub = lay.n_sub, lay.n_flat, lay.sub_batch
@@ -340,29 +367,64 @@ def _crossing_roofline(lay, step_us, peak_flops, peak_gbps, use_pallas=True):
     mult_fn = mult_crossing_pallas if use_pallas else mult_crossing_xla
 
     @jax.jit
-    def both():
+    def both(q, rhi, rlo, mult3):
         d3 = dot_fn(q, rhi, rlo, row_hi)
         u = mult_fn(mult3, rhi, rlo, row_hi)
         return d3, u
 
-    def total(reps):
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            d3, u = both()
-        float(d3[0, 0, 0]) + float(u[0, 0])  # scalar fetch barrier
-        return time.perf_counter() - t0
+    def _time_form(f, *args):
+        def total(reps):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                d3, u = f(*args)
+            float(d3[0, 0, 0]) + float(u.reshape(-1)[0])  # fetch barrier
+            return time.perf_counter() - t0
 
-    crossing_s = _marginal_time(total)
+        return _marginal_time(total)
+
+    build_s = _time_form(both, q, rhi, rlo, mult3)
+
+    # The premat form at the same unit shape (one window's one-hots,
+    # materialized once outside the timed region) — ONLY when the step's
+    # gate admitted the path: if it was rejected for not fitting HBM, the
+    # roofline must not allocate the very stacks the gate refused.
+    if premat:
+        rowid = (rhi * 128 + rlo).astype(jnp.int16)
+        oh_hi, oh_lo = jax.jit(premat_row_onehots, static_argnums=1)(rowid, row_hi)
+        pdot = dot_crossing_premat_pallas if use_pallas else dot_crossing_premat_xla
+        pmult = mult_crossing_premat_pallas if use_pallas else mult_crossing_premat_xla
+
+        @jax.jit
+        def both_premat(q, mult3, oh_hi, oh_lo):
+            return pdot(q, oh_hi, oh_lo), pmult(mult3, oh_hi, oh_lo)
+
+        premat_s = _time_form(both_premat, q, mult3, oh_hi, oh_lo)
+        crossing_s = premat_s
+    else:
+        premat_s = None
+        crossing_s = build_s
 
     # Each crossing: 2 split-bf16 halves x 2 flops/MAC over the
     # [n_flat x (row_hi*128=sub)] one-hot contraction, per sub-batch.
     crossing_flops = 8.0 * n_sub * n_flat * sub
-    # Pallas form HBM traffic: q/rhi/rlo in, u out (4 B x n_flat each);
-    # dot3 out + mult3 in are [row_hi, 128] f32 = sub*4 B each, small.
-    # One-hots never touch HBM.
-    crossing_bytes = n_sub * (4.0 * n_flat * 4 + 2.0 * sub * 4)
+    if premat:
+        # Premat form HBM traffic: each crossing re-streams the window's
+        # materialized one-hots ((row_hi + 128) bf16 per entry) plus
+        # q in / u out; dot3/mult3 are [row_hi, 128] f32 = sub*4 B, small.
+        n_pad = oh_hi.shape[-2]
+        crossing_bytes = n_sub * (
+            2.0 * n_pad * (row_hi + 128) * 2 + 2.0 * n_flat * 4 + 2.0 * sub * 4
+        )
+    else:
+        # Build-form HBM traffic: q/rhi/rlo in, u out (4 B x n_flat each);
+        # one-hots are built in VMEM and never touch HBM.
+        crossing_bytes = n_sub * (4.0 * n_flat * 4 + 2.0 * sub * 4)
     out = {
         "crossing_only_ms": round(crossing_s * 1e3, 2),
+        "crossing_build_form_ms": round(build_s * 1e3, 2),
+        "crossing_premat_ms": (
+            round(premat_s * 1e3, 2) if premat_s is not None else None
+        ),
         "crossing_mxu_bound_ms": (
             round(crossing_flops / peak_flops * 1e3, 2) if peak_flops else None
         ),
@@ -455,16 +517,18 @@ def _sweep_row(p, global_batch, d, nnz, K):
     )
 
     def steps(iters):
-        SGD(
+        sgd = SGD(
             max_iter=iters, global_batch_size=lb, tol=0.0,
             learning_rate=0.5, sparse_kernel="onehot",
-        ).optimize(np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+        )
+        sgd.optimize(np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+        return sgd
 
     # Pilot differencing to size the real delta: the marginal estimate
     # must itself be a difference (a single-point pilot is ~all fixed
     # ~1 s tunnel dispatch overhead at small shards). The final delta is
     # sized to ~3 s of pure step time, a multiple of that overhead.
-    steps(2)  # compile
+    premat_active = steps(2).onehot_premat_active  # compile + gate decision
     p1 = _median_time(lambda: steps(5), repeats=3)
     p2 = _median_time(lambda: steps(55), repeats=3)
     est_step = max((p2 - p1) / 50, 2e-4)
@@ -482,6 +546,7 @@ def _sweep_row(p, global_batch, d, nnz, K):
         "sub_batch": lay.sub_batch,
         "n_sub": lay.n_sub,
         "n_flat": lay.n_flat,
+        "onehot_premat_active": premat_active,
         "predicted_flops_per_chip": flops,
         "measured_step_ms": round(step_ms, 2),
     }

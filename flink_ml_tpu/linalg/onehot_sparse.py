@@ -61,6 +61,7 @@ from flink_ml_tpu.utils.arrays import group_ranks, next_pow2
 __all__ = [
     "OneHotSparseLayout", "OneHotSparsePlan", "onehot_batch_step",
     "block_counts", "validate_indices", "SUB_ROWS", "BLOCK",
+    "premat_row_onehots", "premat_bytes",
 ]
 
 BLOCK = 128  # feature-block width: the VPU lane count
@@ -610,6 +611,213 @@ def mult_crossing_pallas(mult3, rhi, rlo, row_hi, interpret: bool = False):
     return out.reshape(n_sub, n + pad)[:, :n]
 
 
+# ---------------------------------------------------------------------------
+# Precomputed one-hots: the same two crossings with the row one-hots
+# materialized ONCE (bf16, HBM) instead of rebuilt every minibatch step.
+#
+# The one-hots depend only on the rowid stacks, which are static across
+# epochs — the on-chip stripped-kernel decomposition (docs/benchmarks.md)
+# measured the in-kernel one-hot build at ~65% of the dot-crossing's time,
+# and streaming prebuilt one-hots into product+matmul-only kernels ran the
+# crossings 1.86x faster at the headline unit shape (bit-identical output).
+# The catch is storage: (row_hi + 128) * 2 B per entry ~= 73x the 7 B/slot
+# packed stacks — so this path serves the single-/few-window RESIDENT
+# regime only, gated on the HBM budget (ops/optimizer.py), and is never
+# offered to the streamed path (per-window host builds would multiply
+# ingest by the same 73x).
+# ---------------------------------------------------------------------------
+
+
+def _premat_tile(n: int, row_hi: int) -> int:
+    """One tile policy for BOTH premat kernels (the storage pad must divide
+    evenly for each) — mirrors dot_crossing_pallas' row_hi < 64 halving."""
+    return min(_CROSS_TILE if row_hi >= 64 else _CROSS_TILE // 2, max(n, 1))
+
+
+def _premat_pad(n: int, row_hi: int) -> int:
+    t = _premat_tile(n, row_hi)
+    return -(-n // t) * t
+
+
+def premat_bytes(n_units: int, n_flat: int, row_hi: int) -> int:
+    """HBM bytes of the materialized bf16 row one-hots for ``n_units``
+    sub-batch units of ``n_flat`` entries (the ~73x-the-stacks figure the
+    optimizer's premat gate budgets against)."""
+    return 2 * n_units * _premat_pad(n_flat, row_hi) * (row_hi + _ROW_LO)
+
+
+def premat_row_onehots(rowid, row_hi: int):
+    """Packed rowid stacks ``[..., n_flat]`` int16 -> materialized bf16 row
+    one-hots ``(oh_hi [..., n_pad, row_hi], oh_lo [..., n_pad, 128])``, the
+    entry axis padded to the premat crossing tile with all-zero oh rows
+    (padding contributes nothing to the dot crossing even if the caller's
+    padded q slots are garbage; the mult crossing's padded outputs are
+    sliced off). Built once per layout, outside the training scan."""
+    n = rowid.shape[-1]
+    pad = _premat_pad(n, row_hi) - n
+    rid = rowid.astype(jnp.int32)
+    oh_hi, oh_lo = _row_onehots(rid // _ROW_LO, rid % _ROW_LO, row_hi)
+    if pad:
+        width = [(0, 0)] * (rowid.ndim - 1)
+        oh_hi = jnp.pad(oh_hi, width + [(0, pad), (0, 0)])
+        oh_lo = jnp.pad(oh_lo, width + [(0, pad), (0, 0)])
+    return oh_hi, oh_lo
+
+
+def _premat_window(oh_hi, oh_lo, wi):
+    """Select window ``wi`` from (possibly windowed) one-hot stacks. XLA
+    form only — this materializes the window slice, which is fine on the
+    CPU/test backends the XLA form serves; the Pallas form indexes the
+    window inside the BlockSpec instead (no copy)."""
+    if oh_hi.ndim == 4:
+        oh_hi = jax.lax.dynamic_index_in_dim(oh_hi, wi, 0, keepdims=False)
+        oh_lo = jax.lax.dynamic_index_in_dim(oh_lo, wi, 0, keepdims=False)
+    return oh_hi, oh_lo
+
+
+def dot_crossing_premat_xla(q, oh_hi, oh_lo, wi=0):
+    """``dot_crossing_xla`` with the one-hots supplied instead of built.
+    ``q`` [n_sub, n] (n <= the one-hots' padded entry axis)."""
+    oh_hi, oh_lo = _premat_window(oh_hi, oh_lo, wi)
+    n_pad = oh_hi.shape[1]
+    if q.shape[1] < n_pad:  # zero q on padded slots: contributes nothing
+        q = jnp.pad(q, ((0, 0), (0, n_pad - q.shape[1])))
+    q_hi, q_lo = _split_bf16(q)
+    dims = (((1,), (1,)), ((0,), (0,)))
+    return jax.lax.dot_general(
+        oh_hi, oh_lo * q_hi[..., None], dims, preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        oh_hi, oh_lo * q_lo[..., None], dims, preferred_element_type=jnp.float32
+    )
+
+
+def mult_crossing_premat_xla(mult3, oh_hi, oh_lo, wi=0):
+    """``mult_crossing_xla`` with the one-hots supplied (returns the padded
+    entry axis; the caller slices to its n)."""
+    oh_hi, oh_lo = _premat_window(oh_hi, oh_lo, wi)
+    m_hi, m_lo = _split_bf16(mult3)
+    dims = (((2,), (1,)), ((0,), (0,)))
+    rowvecs = jax.lax.dot_general(
+        oh_hi, m_hi, dims, preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        oh_hi, m_lo, dims, preferred_element_type=jnp.float32
+    )
+    return jnp.sum(rowvecs * oh_lo.astype(jnp.float32), axis=2)
+
+
+def dot_crossing_premat_pallas(q, oh_hi, oh_lo, wi=0, interpret: bool = False):
+    """``dot_crossing_pallas`` minus the in-kernel one-hot build: tiles of
+    the materialized one-hots stream from HBM into product+matmul-only
+    cells. Same contraction, same split-bf16 halves.
+
+    ``oh_hi/oh_lo`` may carry a leading window axis
+    (``[n_windows, n_sub, n_pad, w]``); ``wi`` (traced scalar ok) selects
+    the window *inside the BlockSpec index map* via scalar prefetch, so the
+    kernel DMAs tiles straight out of the full stack — a
+    ``dynamic_index_in_dim`` outside would materialize a multi-GB window
+    copy every minibatch step (measured: it costs more than the build-form
+    kernels save)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if oh_hi.ndim == 3:
+        oh_hi, oh_lo = oh_hi[None], oh_lo[None]
+    n_windows, n_sub, n_pad, row_hi = oh_hi.shape
+    if q.shape[1] < n_pad:
+        q = jnp.pad(q, ((0, 0), (0, n_pad - q.shape[1])))
+    tile = _premat_tile(n_pad, row_hi)
+    ntiles = n_pad // tile
+
+    def kernel(wi_ref, hi_ref, lo_ref, q_ref, o_ref):
+        del wi_ref
+        oh_hi_t = hi_ref[0, 0]  # [tile, row_hi] bf16
+        oh_lo_t = lo_ref[0, 0]  # [tile, 128] bf16
+        q2 = q_ref[:][:, None]  # split AFTER the [T, 1] reshape (see build form)
+        q_hi = q2.astype(jnp.bfloat16)
+        q_lo = (q2 - q_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        dims = (((0,), (0,)), ((), ()))
+        o_ref[0, 0] = jax.lax.dot_general(
+            oh_hi_t, oh_lo_t * q_hi, dims, preferred_element_type=jnp.float32
+        ) + jax.lax.dot_general(
+            oh_hi_t, oh_lo_t * q_lo, dims, preferred_element_type=jnp.float32
+        )
+
+    oh_spec = lambda w: pl.BlockSpec(
+        (1, 1, tile, w), lambda i, k, wi_ref: (wi_ref[0], i, k, 0)
+    )
+    parts = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_sub, ntiles),
+            in_specs=[
+                oh_spec(row_hi),
+                oh_spec(_ROW_LO),
+                pl.BlockSpec((tile,), lambda i, k, wi_ref: (i * ntiles + k,)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, row_hi, _ROW_LO), lambda i, k, wi_ref: (i, k, 0, 0)
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_sub, ntiles, row_hi, _ROW_LO), jnp.float32, vma=_vma_of_shared(q)
+        ),
+        interpret=interpret,
+    )(jnp.asarray(wi, jnp.int32).reshape(1), oh_hi, oh_lo, q.reshape(-1))
+    return jnp.sum(parts, axis=1)
+
+
+def mult_crossing_premat_pallas(mult3, oh_hi, oh_lo, wi=0, interpret: bool = False):
+    """``mult_crossing_pallas`` minus the in-kernel build (returns the padded
+    entry axis; the caller slices to its n). Window selection as in
+    ``dot_crossing_premat_pallas``."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if oh_hi.ndim == 3:
+        oh_hi, oh_lo = oh_hi[None], oh_lo[None]
+    n_windows, n_sub, n_pad, row_hi = oh_hi.shape
+    tile = _premat_tile(n_pad, row_hi)
+    ntiles = n_pad // tile
+
+    def kernel(wi_ref, m_ref, hi_ref, lo_ref, o_ref):
+        del wi_ref
+        oh_hi_t = hi_ref[0, 0]
+        m2 = m_ref[0]
+        m_hi = m2.astype(jnp.bfloat16)
+        m_lo = (m2 - m_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        rowvecs = jnp.dot(
+            oh_hi_t, m_hi, preferred_element_type=jnp.float32
+        ) + jnp.dot(oh_hi_t, m_lo, preferred_element_type=jnp.float32)
+        o_ref[:] = jnp.sum(rowvecs * lo_ref[0, 0].astype(jnp.float32), axis=1)
+
+    oh_spec = lambda w: pl.BlockSpec(
+        (1, 1, tile, w), lambda i, k, wi_ref: (wi_ref[0], i, k, 0)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_sub, ntiles),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, row_hi, _ROW_LO), lambda i, k, wi_ref: (i, 0, 0)
+                ),
+                oh_spec(row_hi),
+                oh_spec(_ROW_LO),
+            ],
+            out_specs=pl.BlockSpec(
+                (tile,), lambda i, k, wi_ref: (i * ntiles + k,)
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_sub * n_pad,), jnp.float32, vma=_vma_of_shared(mult3)
+        ),
+        interpret=interpret,
+    )(jnp.asarray(wi, jnp.int32).reshape(1), mult3, oh_hi, oh_lo)
+    return out.reshape(n_sub, n_pad)
+
+
 def onehot_batch_step(
     coef_perm,
     lidx_w,
@@ -624,6 +832,7 @@ def onehot_batch_step(
     row_hi: int,
     use_pallas: bool,
     model_axis=None,
+    premat=None,
 ):
     """One full minibatch: per-sub-batch forward + crossing + backward,
     gradients accumulated, returning ``(grad_perm, loss_sum, weight_sum)``
@@ -640,20 +849,38 @@ def onehot_batch_step(
     axis the partial row dots assemble over (each shard's entries cover
     only its feature blocks — one psum completes the margin, after which
     the loss multiplier is replicated across the axis and the gradient is
-    block-local)."""
-    dot_cross = dot_crossing_pallas if use_pallas else dot_crossing_xla
-    mult_cross = mult_crossing_pallas if use_pallas else mult_crossing_xla
+    block-local).
+
+    ``premat``: the run's materialized row one-hots plus this minibatch's
+    window index, ``(oh_hi, oh_lo, wi)`` (``premat_row_onehots``; stacks
+    may be windowed ``[n_windows, n_sub, n_pad, .]``) — when given, the
+    crossings run the product+matmul-only premat kernels, selecting the
+    window via scalar-prefetch (Pallas) or a dynamic slice (XLA/test
+    form), and ``rowid_w`` is never unpacked (the resident fast path; see
+    the premat section above)."""
     n_sub = lidx_w.shape[0]
+    n_flat = lidx_w.shape[1]
     lidx_w = lidx_w.astype(jnp.int32)
-    rid = rowid_w.astype(jnp.int32)
-    rhi_w = rid // _ROW_LO
-    rlo_w = rid % _ROW_LO
+    if premat is None:
+        dot_cross = dot_crossing_pallas if use_pallas else dot_crossing_xla
+        mult_cross = mult_crossing_pallas if use_pallas else mult_crossing_xla
+        rid = rowid_w.astype(jnp.int32)
+        rhi_w = rid // _ROW_LO
+        rlo_w = rid % _ROW_LO
     # Every stage processes ALL sub-batches in one invocation (the sub axis
     # is just a leading batch dim) — per-invocation floors, not per-entry
     # work, dominated the per-sub form (measured).
     g = gather_round(coef_perm, lidx_w, class_meta)  # [n_sub, n_flat]
     q = lvals_w * g
-    dot3 = dot_cross(q, rhi_w, rlo_w, row_hi)  # [n_sub, row_hi, 128]
+    if premat is not None:
+        oh_hi_w, oh_lo_w, wi = premat
+        dot3 = (
+            dot_crossing_premat_pallas(q, oh_hi_w, oh_lo_w, wi)
+            if use_pallas
+            else dot_crossing_premat_xla(q, oh_hi_w, oh_lo_w, wi)
+        )
+    else:
+        dot3 = dot_cross(q, rhi_w, rlo_w, row_hi)  # [n_sub, row_hi, 128]
     if model_axis is not None:
         dot3 = jax.lax.psum(dot3, model_axis)
     dot = dot3.reshape(n_sub, row_hi * _ROW_LO)[:, :sub_batch].reshape(-1)
@@ -662,6 +889,14 @@ def onehot_batch_step(
         mult.reshape(n_sub, sub_batch),
         ((0, 0), (0, row_hi * _ROW_LO - sub_batch)),
     ).reshape(n_sub, row_hi, _ROW_LO)
-    u = lvals_w * mult_cross(mult3, rhi_w, rlo_w, row_hi)
+    if premat is not None:
+        back = (
+            mult_crossing_premat_pallas(mult3, oh_hi_w, oh_lo_w, wi)
+            if use_pallas
+            else mult_crossing_premat_xla(mult3, oh_hi_w, oh_lo_w, wi)
+        )[:, :n_flat]
+    else:
+        back = mult_cross(mult3, rhi_w, rlo_w, row_hi)
+    u = lvals_w * back
     grad = scatter_round(u, lidx_w, class_meta, nblk)
     return grad, loss_sum, jnp.sum(wb)

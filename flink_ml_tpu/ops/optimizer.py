@@ -442,6 +442,7 @@ def _fused_onehot_program(
     elastic_net: float,
     tol: Optional[float],
     use_pallas: bool,
+    premat: bool = False,
 ):
     """A chunk of sparse SGD epochs on the one-hot matmul path — the same
     scan/done/losses contract as ``_fused_sgd_program``, but the coefficient
@@ -466,6 +467,13 @@ def _fused_onehot_program(
     psum over ``ctx.data_axes``, which XLA lowers hierarchically (ICI
     within a slice, then the slice-count exchange over DCN) exactly like
     the scatter path (cf. AllReduceImpl.java:54-102 serving every config).
+
+    ``premat=True`` (resident fast path, HBM-gated by the caller): the
+    program takes two extra stack args — this run's materialized bf16 row
+    one-hots (``premat_row_onehots``), sharded like the packed stacks —
+    and the crossings run product+matmul-only kernels instead of
+    rebuilding the one-hots every minibatch (measured 1.86x on the
+    crossings at the headline unit shape; docs/benchmarks.md).
     """
     from flink_ml_tpu.linalg.onehot_sparse import onehot_batch_step
 
@@ -474,7 +482,7 @@ def _fused_onehot_program(
         ctx.mesh, loss_func, "onehot", layout.class_meta, layout.n_flat,
         layout.n_sub, layout.nblk_local, layout.n_model, layout.sub_batch,
         layout.local_batch, tuple(layout.window_starts), chunk_len, lr, reg,
-        elastic_net, tol, use_pallas,
+        elastic_net, tol, use_pallas, premat,
     )
     cached = _FUSED_CACHE.get(key)
     if cached is not None:
@@ -489,9 +497,14 @@ def _fused_onehot_program(
     model_axis = MODEL_AXIS if model_sharded else None
     data_axes = ctx.data_axes  # ("slice", "data") on a multi-slice mesh
 
-    def per_shard(coef_perm, done, win_idx, offsets, active, lidx, rowid, lvals, y, w, mask):
+    def per_shard(coef_perm, done, win_idx, offsets, active, lidx, rowid, lvals, *rest):
         # stacks arrive [1, 1, n_windows, n_sub, n_flat] per (data, model) shard
         lidx, rowid, lvals = lidx[0, 0], rowid[0, 0], lvals[0, 0]
+        if premat:
+            oh_hi, oh_lo, y, w, mask = rest
+            oh_hi, oh_lo = oh_hi[0, 0], oh_lo[0, 0]
+        else:
+            y, w, mask = rest
 
         def body(carry, sched):
             cp, done = carry
@@ -512,6 +525,10 @@ def _fused_onehot_program(
                 cp, sel(lidx), sel(rowid), sel(lvals), yb, wb,
                 loss_func, class_meta, nblk_local, sub, row_hi, use_pallas,
                 model_axis=model_axis,
+                # full stacks + wi: the window is selected inside the premat
+                # kernels (scalar-prefetch BlockSpec), never via a
+                # dynamic_index that would copy a multi-GB window per step
+                premat=(oh_hi, oh_lo, wi) if premat else None,
             )
             if model_sharded:
                 # The grad shard varies over the model axis while the scalar
@@ -548,7 +565,7 @@ def _fused_onehot_program(
     # shard_map's carry typing for the replicated coefficient.
     stack_spec = (
         (P(data_axes, MODEL_AXIS),) if model_sharded else (P(data_axes),)
-    ) * 3
+    ) * (5 if premat else 3)  # +2: the premat oh_hi/oh_lo stacks
     row_spec = (P(data_axes),) * 3  # y/w/mask
     coef_spec = P(MODEL_AXIS) if model_sharded else P()
     program = jax.jit(
@@ -748,12 +765,19 @@ class SGD(Optimizer):
         listeners=(),
         stream_window_rows: Optional[int] = None,
         sparse_kernel: str = "auto",
+        onehot_premat: str = "auto",
     ):
         if sparse_kernel not in ("auto", "onehot", "scatter"):
             raise ValueError(
                 f"sparse_kernel must be 'auto', 'onehot' or 'scatter', got {sparse_kernel!r}"
             )
+        if onehot_premat not in ("auto", "on", "off"):
+            raise ValueError(
+                f"onehot_premat must be 'auto', 'on' or 'off', got {onehot_premat!r}"
+            )
         self.sparse_kernel = sparse_kernel
+        self.onehot_premat = onehot_premat
+        self.onehot_premat_active = False  # set per fit; introspection/bench
         self.max_iter = max_iter
         self.learning_rate = learning_rate
         self.global_batch_size = global_batch_size
@@ -894,6 +918,7 @@ class SGD(Optimizer):
         ctx = self.ctx or get_mesh_context()
         from flink_ml_tpu.iteration.streaming import is_host_cache
 
+        self.onehot_premat_active = False  # set by _optimize_onehot when used
         if is_host_cache(train_data):
             return self._optimize_streaming(init_model, train_data, loss_func, ctx)
         if not isinstance(train_data, DeviceDataCache):
@@ -1076,6 +1101,57 @@ class SGD(Optimizer):
     # auto-switching.
     _ONEHOT_HBM_FRACTION = 0.35
 
+    # Fraction of reported HBM the materialized premat row one-hots plus the
+    # packed stacks may jointly claim under onehot_premat='auto'. The
+    # one-hots cost (row_hi + 128) * 2 B per packed slot — ~73x the 7 B/slot
+    # stacks — so only the resident regime ever fits: at the headline Criteo
+    # shape one 65536-row window is ~2.2 GB and its full 4-window run
+    # ~8.7 GB, which fits a 16 GiB v5e alongside the CSR columns and the
+    # coefficient with >40% headroom; a many-window run (the streamed
+    # regime's shape) does not and stays on the build-form kernels.
+    _ONEHOT_PREMAT_HBM_FRACTION = 0.55
+
+    def _premat_onehots(self, lay, stacks, ctx, train_data):
+        """Decide the premat fast path (onehot_premat 'on'/'off'/'auto' with
+        the HBM budget above) and materialize this run's row one-hots on
+        device from the already-resident rowid stacks — one elementwise
+        device pass, sharded exactly like the stacks, nothing rides the
+        host link. The multi-GB arrays are memoized on the cache next to
+        the stacks (same key) — a hyperparameter sweep over one cache must
+        materialize once, not per fit. They stay resident as long as the
+        cache lives (like the stacks); to release them without dropping
+        the cache, ``del train_data._onehot_premat_memo``. Returns
+        ``(premat, oh_stacks)`` with ``oh_stacks`` empty when the path is
+        off."""
+        from flink_ml_tpu.linalg.onehot_sparse import (
+            premat_bytes,
+            premat_row_onehots,
+        )
+
+        if self.onehot_premat == "off":
+            return False, ()
+        n_units = lay.n_windows * lay.n_sub
+        per_dev = premat_bytes(n_units, lay.n_flat, lay.row_hi) + 7 * n_units * lay.n_flat
+        if (
+            self.onehot_premat == "auto"
+            and per_dev > self._ONEHOT_PREMAT_HBM_FRACTION * _hbm_bytes_limit(ctx)
+        ):
+            return False, ()
+        key = (ctx.n_data, ctx.n_model, lay.dim, lay.local_batch, lay.row_hi)
+        memo = getattr(train_data, "_onehot_premat_memo", None)
+        if memo is not None and memo[0] == key:
+            return True, memo[1]
+        if memo is not None:  # free the stale config's one-hots BEFORE
+            train_data._onehot_premat_memo = None  # allocating the new ones
+        sh = ctx.sharding(ctx.data_axes, MODEL_AXIS)
+        oh_stacks = jax.jit(
+            premat_row_onehots,
+            static_argnums=1,
+            out_shardings=(sh, sh),
+        )(stacks[1], lay.row_hi)
+        train_data._onehot_premat_memo = (key, oh_stacks)
+        return True, oh_stacks
+
     def _onehot_layout(self, train_data, ctx, dim, local_batch, force: bool):
         """Build (once per cache/config) the blocked one-hot layout and its
         device-resident stacks, memoized like the data itself. Returns
@@ -1132,12 +1208,15 @@ class SGD(Optimizer):
         if stacks is None:
             return None  # auto: stacks would overrun HBM — scatter instead
         use_pallas = is_tpu_backend(ctx.mesh.devices.flat)
+        premat, oh_stacks = self._premat_onehots(lay, stacks, ctx, train_data)
+        self.onehot_premat_active = premat
         # Crossing MACs bound the dispatch length (split-bf16 doubles them).
         flops = 4.0 * lay.n_sub * lay.n_flat * (lay.sub_batch + 2 * BLOCK)
         chunk = fused_chunk_len(self.max_iter, check_loss, 0, flops)
         program = _fused_onehot_program(
             ctx, loss_func, lay, chunk, self.learning_rate, self.reg,
             self.elastic_net, self.tol if check_loss else None, use_pallas,
+            premat=premat,
         )
         starts, offsets = offset_schedule(
             train_data.local_rows, local_batch, self.max_iter
@@ -1159,7 +1238,8 @@ class SGD(Optimizer):
             win_idx, offsets, self.max_iter, chunk
         ):
             coef, done, losses, n_exec = program(
-                coef, done, win_c, offsets_c, active_c, *stacks, y, w, mask
+                coef, done, win_c, offsets_c, active_c, *stacks, *oh_stacks,
+                y, w, mask
             )
             n = int(jax.device_get(n_exec))
             chunk_losses = np.asarray(jax.device_get(losses), np.float64)

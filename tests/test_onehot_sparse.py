@@ -15,10 +15,16 @@ from flink_ml_tpu.linalg.onehot_sparse import (
     BLOCK,
     OneHotSparseLayout,
     dot_crossing_pallas,
+    dot_crossing_premat_pallas,
+    dot_crossing_premat_xla,
     dot_crossing_xla,
     mult_crossing_pallas,
+    mult_crossing_premat_pallas,
+    mult_crossing_premat_xla,
     mult_crossing_xla,
     onehot_batch_step,
+    premat_bytes,
+    premat_row_onehots,
 )
 from flink_ml_tpu.ops import SGD, BinaryLogisticLoss
 from flink_ml_tpu.parallel.mesh import MeshContext, mesh_context
@@ -146,6 +152,176 @@ class TestCrossings:
             np.asarray(mult_crossing_xla(m3, rhi, rlo, row_hi)),
             rtol=1e-5, atol=1e-5,
         )
+
+
+class TestPrematCrossings:
+    """The precomputed-one-hot (premat) crossing path: same contraction with
+    the row one-hots materialized once instead of rebuilt per minibatch —
+    output must match the build-form kernels (bit-identical on the XLA form
+    when no entry padding is involved)."""
+
+    def _ids(self, rng, n_sub, n, row_hi):
+        rhi = rng.integers(0, row_hi, (n_sub, n), dtype=np.int32)
+        rlo = rng.integers(0, 128, (n_sub, n), dtype=np.int32)
+        rowid = (rhi * 128 + rlo).astype(np.int16)
+        return jnp.asarray(rhi), jnp.asarray(rlo), jnp.asarray(rowid)
+
+    @pytest.mark.parametrize("n", [5000, 4096])  # padded and tile-exact
+    def test_premat_matches_build_xla(self, n):
+        rng = np.random.default_rng(40)
+        n_sub, row_hi = 3, 4
+        rhi, rlo, rowid = self._ids(rng, n_sub, n, row_hi)
+        q = jnp.asarray(rng.normal(size=(n_sub, n)).astype(np.float32))
+        m3 = jnp.asarray(rng.normal(size=(n_sub, row_hi, 128)).astype(np.float32))
+        oh_hi, oh_lo = premat_row_onehots(rowid, row_hi)
+        assert oh_hi.shape[1] % min(4096, n) == 0  # padded to the tile
+        np.testing.assert_allclose(
+            np.asarray(dot_crossing_premat_xla(q, oh_hi, oh_lo)),
+            np.asarray(dot_crossing_xla(q, rhi, rlo, row_hi)),
+            rtol=1e-6, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(mult_crossing_premat_xla(m3, oh_hi, oh_lo))[:, :n],
+            np.asarray(mult_crossing_xla(m3, rhi, rlo, row_hi)),
+            rtol=1e-6, atol=1e-6,
+        )
+
+    def test_premat_pallas_interpret_matches_xla(self):
+        rng = np.random.default_rng(41)
+        n_sub, n, row_hi = 2, 5000, 4
+        rhi, rlo, rowid = self._ids(rng, n_sub, n, row_hi)
+        q = jnp.asarray(rng.normal(size=(n_sub, n)).astype(np.float32))
+        m3 = jnp.asarray(rng.normal(size=(n_sub, row_hi, 128)).astype(np.float32))
+        oh_hi, oh_lo = premat_row_onehots(rowid, row_hi)
+        np.testing.assert_allclose(
+            np.asarray(dot_crossing_premat_pallas(q, oh_hi, oh_lo, interpret=True)),
+            np.asarray(dot_crossing_xla(q, rhi, rlo, row_hi)),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(
+                mult_crossing_premat_pallas(m3, oh_hi, oh_lo, interpret=True)
+            )[:, :n],
+            np.asarray(mult_crossing_xla(m3, rhi, rlo, row_hi)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_padded_entries_contribute_nothing(self):
+        # Padded oh rows are all-zero, so garbage q on the padded slots must
+        # not leak into the dot crossing.
+        rng = np.random.default_rng(42)
+        n_sub, n, row_hi = 1, 5000, 4
+        rhi, rlo, rowid = self._ids(rng, n_sub, n, row_hi)
+        oh_hi, oh_lo = premat_row_onehots(rowid, row_hi)
+        n_pad = oh_hi.shape[1]
+        q_pad = jnp.asarray(rng.normal(size=(n_sub, n_pad)).astype(np.float32))
+        ref = dot_crossing_xla(q_pad[:, :n], rhi, rlo, row_hi)
+        np.testing.assert_allclose(
+            np.asarray(dot_crossing_premat_xla(q_pad, oh_hi, oh_lo)),
+            np.asarray(ref), rtol=1e-6, atol=1e-6,
+        )
+
+    def test_premat_bytes_counts_padding(self):
+        assert premat_bytes(2, 4096, 4) == 2 * 2 * 4096 * (4 + 128)
+        assert premat_bytes(1, 5000, 4) == 2 * 8192 * (4 + 128)
+
+
+class TestPrematSgd:
+    def _cols(self, rng, n, d, K):
+        idx = rng.integers(0, d, size=(n, K)).astype(np.int32)
+        val = rng.normal(size=(n, K)).astype(np.float32)
+        y = (rng.random(n) > 0.5).astype(np.float32)
+        return {
+            "indices": idx, "values": val, "labels": y,
+            "weights": np.ones(n, np.float32),
+        }
+
+    def _fit(self, cols, d, ctx, premat, **kw):
+        sgd = SGD(
+            max_iter=8, global_batch_size=128, tol=0.0, learning_rate=0.3,
+            reg=0.01, elastic_net=0.5, ctx=ctx, sparse_kernel="onehot",
+            onehot_premat=premat, **kw,
+        )
+        coef = sgd.optimize(
+            np.zeros(d, np.float32),
+            DeviceDataCache(dict(cols), ctx=ctx),
+            BinaryLogisticLoss.INSTANCE,
+        )
+        return coef, sgd
+
+    def test_premat_on_off_identical(self):
+        # No entry padding at these shapes -> the XLA premat contraction is
+        # the build contraction with the one-hots hoisted: bit-identical.
+        rng = np.random.default_rng(43)
+        cols = self._cols(rng, 512, 800, 8)
+        with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
+            c_on, sgd_on = self._fit(cols, 800, ctx, "on")
+            c_off, sgd_off = self._fit(cols, 800, ctx, "off")
+            assert sgd_on.onehot_premat_active
+            assert not sgd_off.onehot_premat_active
+            np.testing.assert_array_equal(c_on, c_off)
+            np.testing.assert_array_equal(
+                sgd_on.loss_history, sgd_off.loss_history
+            )
+
+    def test_premat_composes_with_tp(self):
+        rng = np.random.default_rng(44)
+        cols = self._cols(rng, 512, 800, 8)
+        with mesh_context(MeshContext(n_data=4, n_model=2)) as ctx:
+            c_on, sgd_on = self._fit(cols, 800, ctx, "on")
+            c_off, _ = self._fit(cols, 800, ctx, "off")
+            assert sgd_on.onehot_premat_active
+            np.testing.assert_array_equal(c_on, c_off)
+
+    def test_premat_composes_with_multislice(self):
+        with mesh_context(
+            MeshContext(devices=jax.devices()[:8], n_data=4, n_model=1, n_slices=2)
+        ) as ctx:
+            rng = np.random.default_rng(45)
+            cols = self._cols(rng, 512, 800, 8)
+            c_on, sgd_on = self._fit(cols, 800, ctx, "on")
+            c_off, _ = self._fit(cols, 800, ctx, "off")
+            assert sgd_on.onehot_premat_active
+            np.testing.assert_array_equal(c_on, c_off)
+
+    def test_auto_gate_rejects_over_budget(self, monkeypatch):
+        import flink_ml_tpu.ops.optimizer as opt
+
+        monkeypatch.setattr(opt, "_hbm_bytes_limit", lambda ctx=None: 1024)
+        rng = np.random.default_rng(46)
+        cols = self._cols(rng, 256, 600, 4)
+        with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
+            _, sgd = self._fit(cols, 600, ctx, "auto")
+            assert not sgd.onehot_premat_active  # fell back to build form
+            # 'on' overrides the budget (tests, known-good shapes)
+            _, sgd_forced = self._fit(cols, 600, ctx, "on")
+            assert sgd_forced.onehot_premat_active
+
+    def test_streamed_path_never_premats(self):
+        # The streamed (larger-than-HBM) route must stay on build-form
+        # kernels: per-window host one-hot builds would multiply ingest ~73x.
+        from flink_ml_tpu.iteration import HostDataCache
+
+        rng = np.random.default_rng(47)
+        cols = self._cols(rng, 512, 1 << 16, 4)
+        with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
+            sgd = SGD(
+                max_iter=4, global_batch_size=128, tol=0.0, ctx=ctx,
+                sparse_kernel="onehot", onehot_premat="on",
+                stream_window_rows=256,
+            )
+            cache = HostDataCache()
+            for a in range(0, 512, 64):
+                cache.append({k: v[a : a + 64] for k, v in cols.items()})
+            cache.finish()
+            sgd.optimize(
+                np.zeros(1 << 16, np.float32), cache, BinaryLogisticLoss.INSTANCE
+            )
+            assert not sgd.onehot_premat_active
+
+    def test_invalid_param_raises(self):
+        with pytest.raises(ValueError, match="onehot_premat"):
+            SGD(onehot_premat="yes")
 
 
 class TestSgdIntegration:
