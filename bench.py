@@ -594,6 +594,8 @@ def bench_logreg_sparse_streamed():
             )
         cache.finish()
 
+        last_fit = {}
+
         def streamed_fit(kernel):
             sgd = SGD(
                 max_iter=epochs,
@@ -605,6 +607,7 @@ def bench_logreg_sparse_streamed():
             )
             t0 = time.perf_counter()
             sgd.optimize(np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE)
+            last_fit["premat"] = sgd.onehot_premat_active
             return time.perf_counter() - t0
 
         streamed_fit("scatter")  # warm-up: program compile
@@ -636,8 +639,13 @@ def bench_logreg_sparse_streamed():
         sched = WindowSchedule(
             m_shard, b_local, window, epochs, flops_per_epoch=flops
         )
+        # The probe must exercise the SAME load() path the fit uses — with
+        # premat engaged, load() also materializes the window's one-hots on
+        # device, and that cost belongs to the probe's ingest_s, not to the
+        # overlap formula's residual.
         stream = _OneHotWindowStream(
             cache, ctx, plan, sched.window, b_local, n_sub, m_shard, n,
+            premat=last_fit.get("premat", False),
         )
         visited = [j for j, _ in sched.runs]
         loads = [j for i, j in enumerate(visited) if i == 0 or j != visited[i - 1]]
@@ -646,7 +654,7 @@ def bench_logreg_sparse_streamed():
             import jax
 
             buf = stream.load(j)
-            jax.block_until_ready(buf["labels"])
+            jax.block_until_ready(buf.get("oh", buf["labels"]))
         ingest_s = time.perf_counter() - t0
         del buf
 
@@ -713,6 +721,7 @@ def bench_logreg_sparse_streamed():
         "epochs": epochs,
         "window_rows": window,
         "e2e_rows_per_sec": round(rows_consumed / wall, 1),
+        "onehot_premat_active": last_fit.get("premat", False),
         "onehot_step_us": round(step_us["onehot"], 1),
         "scatter_step_us": round(step_us["scatter"], 1),
         "onehot_vs_scatter_step": round(step_us["scatter"] / step_us["onehot"], 2),

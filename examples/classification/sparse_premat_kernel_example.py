@@ -1,0 +1,51 @@
+"""Controls the sparse SGD kernel strategy: one-hot vs scatter, and the
+premat (precomputed-one-hot) resident fast path.
+
+Parity: the reference trains SparseVector models one way (BLAS.java's
+per-nonzero axpy/dot); here the optimizer picks between a scatter kernel
+(narrow models), the one-hot matmul kernel (wide models), and — on the
+resident route, when the materialized row one-hots fit the HBM budget —
+the premat variant that streams precomputed one-hots into
+product+matmul-only kernels (measured 1.6-1.8x the build-form step at the
+Criteo shape, bit-identical coefficients; docs/benchmarks.md).
+"""
+import numpy as np
+
+from flink_ml_tpu.iteration import DeviceDataCache
+from flink_ml_tpu.ops import SGD, BinaryLogisticLoss
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, d, K = 1024, 1 << 16, 8
+    cols = {
+        "indices": rng.integers(0, d, size=(n, K)).astype(np.int32),
+        "values": rng.normal(size=(n, K)).astype(np.float32),
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+        "weights": np.ones(n, np.float32),
+    }
+    cache = DeviceDataCache(dict(cols))
+
+    coefs = {}
+    for premat in ("on", "off"):
+        sgd = SGD(
+            max_iter=5,
+            global_batch_size=256,
+            tol=0.0,
+            learning_rate=0.3,
+            sparse_kernel="onehot",  # 'auto' picks this for wide models
+            onehot_premat=premat,  # 'auto' gates on the HBM storage budget
+        )
+        coefs[premat] = sgd.optimize(
+            np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
+        )
+        print(f"onehot_premat={premat}: active={sgd.onehot_premat_active} "
+              f"final loss={sgd.loss_history[-1]:.6f}")
+
+    # The premat path is the same SGD step executed faster: identical result.
+    np.testing.assert_array_equal(coefs["on"], coefs["off"])
+    print("premat and build-form coefficients are identical")
+
+
+if __name__ == "__main__":
+    main()

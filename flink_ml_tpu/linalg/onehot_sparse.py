@@ -621,10 +621,11 @@ def mult_crossing_pallas(mult3, rhi, rlo, row_hi, interpret: bool = False):
 # and streaming prebuilt one-hots into product+matmul-only kernels ran the
 # crossings 1.86x faster at the headline unit shape (bit-identical output).
 # The catch is storage: (row_hi + 128) * 2 B per entry ~= 73x the 7 B/slot
-# packed stacks — so this path serves the single-/few-window RESIDENT
-# regime only, gated on the HBM budget (ops/optimizer.py), and is never
-# offered to the streamed path (per-window host builds would multiply
-# ingest by the same 73x).
+# packed stacks — so the path is HBM-gated (ops/optimizer.py). The resident
+# route materializes the whole run's one-hots once; the streamed route
+# never SHIPS one-hots (73x the ingest) — instead each window's one-hots
+# are materialized ON DEVICE from the just-landed rowid stacks in the
+# prefetch gap, bounding storage at the two prefetch-live windows.
 # ---------------------------------------------------------------------------
 
 

@@ -40,6 +40,7 @@ Deviations from the reference, deliberate:
 """
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional, Union
 
 import jax
@@ -313,6 +314,17 @@ def _hbm_bytes_limit(ctx: Optional[MeshContext] = None) -> int:
     if ram:
         return min(16 << 30, ram // max(1, len(devices)))
     return 16 << 30
+
+
+@functools.lru_cache(maxsize=8)
+def _premat_materialize_jit(sh):
+    """One jitted ``premat_row_onehots`` wrapper per output sharding — the
+    resident path AND every streamed window load share it, so the one-hot
+    materialization traces once per (sharding, shape) instead of
+    constructing (and re-tracing) a fresh jit wrapper per call."""
+    from flink_ml_tpu.linalg.onehot_sparse import premat_row_onehots
+
+    return jax.jit(premat_row_onehots, static_argnums=1, out_shardings=(sh, sh))
 
 
 _FUSED_CACHE: Dict[tuple, object] = {}
@@ -674,9 +686,18 @@ class _OneHotWindowStream:
     window, transposes every minibatch into plan-conformant stacks (on the
     host, inside ``run_windows``'s prefetch gap — overlapping the device
     compute of the previous window), and places stacks + labels/weights/mask
-    on the mesh. Drop-in for ``WindowedStream`` in ``run_windows``."""
+    on the mesh. Drop-in for ``WindowedStream`` in ``run_windows``.
 
-    def __init__(self, cache, ctx, plan, window, local_batch, n_sub, m, n):
+    With ``premat=True`` it additionally materializes the window's row
+    one-hots ON DEVICE from the just-landed rowid stacks (one elementwise
+    jit pass, queued in the prefetch gap so it hides behind the previous
+    window's compute). Nothing extra rides ingest — the host still ships
+    7 B/slot packed stacks; storage stays bounded at the two prefetch-live
+    windows regardless of dataset size. This is what lets the streamed
+    (larger-than-HBM) route run the premat product+matmul-only crossings."""
+
+    def __init__(self, cache, ctx, plan, window, local_batch, n_sub, m, n,
+                 premat: bool = False):
         self.cache = cache
         self.ctx = ctx
         self.plan = plan
@@ -685,6 +706,7 @@ class _OneHotWindowStream:
         self.n_sub = int(n_sub)
         self.m = int(m)  # per-shard logical rows
         self.n = int(n)
+        self.premat = bool(premat)
 
     def load(self, j: int):
         nd = self.ctx.n_data
@@ -735,16 +757,20 @@ class _OneHotWindowStream:
                         lvals[k, :, mb, bi],
                     )
         sh = self.ctx.sharding(self.ctx.data_axes, MODEL_AXIS)
-        return {
+        rowid_dev = jax.device_put(rowid, sh)
+        win = {
             "stacks": (
                 jax.device_put(lidx, sh),
-                jax.device_put(rowid, sh),
+                rowid_dev,
                 jax.device_put(lvals, sh),
             ),
             "labels": jax.device_put(y, self.ctx.batch),
             "weights": jax.device_put(w, self.ctx.batch),
             "__mask__": jax.device_put(mask, self.ctx.batch),
         }
+        if self.premat:
+            win["oh"] = _premat_materialize_jit(sh)(rowid_dev, self.plan.row_hi)
+        return win
 
 
 class SGD(Optimizer):
@@ -1107,8 +1133,10 @@ class SGD(Optimizer):
     # stacks — so only the resident regime ever fits: at the headline Criteo
     # shape one 65536-row window is ~2.2 GB and its full 4-window run
     # ~8.7 GB, which fits a 16 GiB v5e alongside the CSR columns and the
-    # coefficient with >40% headroom; a many-window run (the streamed
-    # regime's shape) does not and stays on the build-form kernels.
+    # coefficient with >40% headroom. A resident many-window run whose
+    # whole-run one-hots exceed the budget falls back to the build-form
+    # kernels; the STREAMED route materializes per window on device instead
+    # (`_premat_streamed` budgets the two prefetch-live windows).
     _ONEHOT_PREMAT_HBM_FRACTION = 0.55
 
     def _premat_onehots(self, lay, stacks, ctx, train_data):
@@ -1143,14 +1171,32 @@ class SGD(Optimizer):
             return True, memo[1]
         if memo is not None:  # free the stale config's one-hots BEFORE
             train_data._onehot_premat_memo = None  # allocating the new ones
-        sh = ctx.sharding(ctx.data_axes, MODEL_AXIS)
-        oh_stacks = jax.jit(
-            premat_row_onehots,
-            static_argnums=1,
-            out_shardings=(sh, sh),
+            memo = None  # the local ref would keep the buffers alive too
+        oh_stacks = _premat_materialize_jit(
+            ctx.sharding(ctx.data_axes, MODEL_AXIS)
         )(stacks[1], lay.row_hi)
         train_data._onehot_premat_memo = (key, oh_stacks)
         return True, oh_stacks
+
+    def _premat_streamed(self, plan, n_mb, n_sub, ctx) -> bool:
+        """The streamed route's premat decision. Unlike the resident gate,
+        nothing is memoized — each window's one-hots are materialized on
+        device by `_OneHotWindowStream.load` (inside the prefetch gap) and
+        freed when the window rotates out, so the budget covers the TWO
+        prefetch-live windows' one-hots plus their packed stacks. Ingest
+        is unchanged: the host still ships 7 B/slot stacks."""
+        from flink_ml_tpu.linalg.onehot_sparse import premat_bytes
+
+        if self.onehot_premat == "off":
+            return False
+        if self.onehot_premat == "on":
+            return True
+        n_units = n_mb * n_sub
+        per_dev = 2 * (
+            premat_bytes(n_units, plan.n_flat, plan.row_hi)
+            + 7 * n_units * plan.n_flat
+        )
+        return per_dev <= self._ONEHOT_PREMAT_HBM_FRACTION * _hbm_bytes_limit(ctx)
 
     def _onehot_layout(self, train_data, ctx, dim, local_batch, force: bool):
         """Build (once per cache/config) the blocked one-hot layout and its
@@ -1323,12 +1369,17 @@ class SGD(Optimizer):
             plan=plan, n_sub=n_sub, local_batch=b,
             window_starts=tuple(i * b for i in range(n_mb)),
         )
+        premat = self._premat_streamed(plan, n_mb, n_sub, ctx)
+        self.onehot_premat_active = premat
         program = _fused_onehot_program(
             ctx, loss_func, layout_view, sched.chunk_len, self.learning_rate,
             self.reg, self.elastic_net, self.tol if check_loss else None,
             use_pallas=is_tpu_backend(ctx.mesh.devices.flat),
+            premat=premat,
         )
-        stream = _OneHotWindowStream(cache, ctx, plan, W, b, n_sub, m, n_rows)
+        stream = _OneHotWindowStream(
+            cache, ctx, plan, W, b, n_sub, m, n_rows, premat=premat
+        )
 
         mgr = self.checkpoint_manager
         start_run = 0
@@ -1368,7 +1419,8 @@ class SGD(Optimizer):
             # window's zero-mask padding realizes the short tail batch.
             state["coef"], state["done"], losses, n_exec = program(
                 state["coef"], state["done"], win_idx_c, starts_c, active_c,
-                *win["stacks"], win["labels"], win["weights"], win["__mask__"],
+                *win["stacks"], *win.get("oh", ()),
+                win["labels"], win["weights"], win["__mask__"],
             )
             state["epochs"] += n_active
 

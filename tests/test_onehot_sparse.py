@@ -297,27 +297,55 @@ class TestPrematSgd:
             _, sgd_forced = self._fit(cols, 600, ctx, "on")
             assert sgd_forced.onehot_premat_active
 
-    def test_streamed_path_never_premats(self):
-        # The streamed (larger-than-HBM) route must stay on build-form
-        # kernels: per-window host one-hot builds would multiply ingest ~73x.
+    def _streamed_fit(self, cols, d, ctx, premat, window=256):
         from flink_ml_tpu.iteration import HostDataCache
 
+        sgd = SGD(
+            max_iter=4, global_batch_size=128, tol=0.0, learning_rate=0.3,
+            ctx=ctx, sparse_kernel="onehot", onehot_premat=premat,
+            stream_window_rows=window,
+        )
+        cache = HostDataCache()
+        n = len(cols["labels"])
+        for a in range(0, n, 64):
+            cache.append({k: v[a : a + 64] for k, v in cols.items()})
+        cache.finish()
+        coef = sgd.optimize(
+            np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
+        )
+        return coef, sgd
+
+    def test_streamed_premat_matches_build(self):
+        # The streamed (larger-than-HBM) route materializes each window's
+        # one-hots ON DEVICE from the shipped packed stacks (bounded at the
+        # two prefetch-live windows; ingest unchanged) — results must be
+        # bit-identical to the streamed build-form kernels.
         rng = np.random.default_rng(47)
         cols = self._cols(rng, 512, 1 << 16, 4)
         with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
-            sgd = SGD(
-                max_iter=4, global_batch_size=128, tol=0.0, ctx=ctx,
-                sparse_kernel="onehot", onehot_premat="on",
-                stream_window_rows=256,
+            c_on, sgd_on = self._streamed_fit(cols, 1 << 16, ctx, "on")
+            c_off, sgd_off = self._streamed_fit(cols, 1 << 16, ctx, "off")
+            assert sgd_on.onehot_premat_active
+            assert not sgd_off.onehot_premat_active
+            np.testing.assert_array_equal(c_on, c_off)
+            np.testing.assert_array_equal(
+                sgd_on.loss_history, sgd_off.loss_history
             )
-            cache = HostDataCache()
-            for a in range(0, 512, 64):
-                cache.append({k: v[a : a + 64] for k, v in cols.items()})
-            cache.finish()
-            sgd.optimize(
-                np.zeros(1 << 16, np.float32), cache, BinaryLogisticLoss.INSTANCE
-            )
+
+    def test_streamed_premat_auto_gates_on_budget(self, monkeypatch):
+        import flink_ml_tpu.ops.optimizer as opt
+
+        rng = np.random.default_rng(48)
+        cols = self._cols(rng, 512, 1 << 16, 4)
+        with mesh_context(MeshContext(n_data=2, n_model=1)) as ctx:
+            monkeypatch.setattr(opt, "_hbm_bytes_limit", lambda ctx=None: 1024)
+            _, sgd = self._streamed_fit(cols, 1 << 16, ctx, "auto")
             assert not sgd.onehot_premat_active
+            monkeypatch.setattr(
+                opt, "_hbm_bytes_limit", lambda ctx=None: 16 << 30
+            )
+            _, sgd2 = self._streamed_fit(cols, 1 << 16, ctx, "auto")
+            assert sgd2.onehot_premat_active
 
     def test_invalid_param_raises(self):
         with pytest.raises(ValueError, match="onehot_premat"):

@@ -64,6 +64,8 @@ def main():
         cache.finish()
         spilled = sum(1 for e in cache._log if "files" in e)
 
+        last_fit = {}
+
         def fit(mgr=None, interval=0):
             sgd = SGD(
                 max_iter=epochs, global_batch_size=batch, tol=0.0,
@@ -74,6 +76,7 @@ def main():
             coef = sgd.optimize(
                 np.zeros(d, np.float32), cache, BinaryLogisticLoss.INSTANCE
             )
+            last_fit["premat"] = sgd.onehot_premat_active
             return coef
 
         fit()  # warm-up: plan + program compile
@@ -100,15 +103,18 @@ def main():
         sched = WindowSchedule(
             m_shard, b_local, window, epochs, flops_per_epoch=flops
         )
+        # The probe must exercise the SAME load() path the fit used (with
+        # premat, load() also materializes the window's one-hots on device).
         stream = _OneHotWindowStream(
             cache, ctx, plan, sched.window, b_local, n_sub, m_shard, n,
+            premat=last_fit.get("premat", False),
         )
         visited = [j for j, _ in sched.runs]
         loads = [j for i, j in enumerate(visited) if i == 0 or j != visited[i - 1]]
         t0 = time.perf_counter()
         for j in loads:
             buf = stream.load(j)
-            jax.block_until_ready(buf["labels"])
+            jax.block_until_ready(buf.get("oh", buf["labels"]))
         ingest_s = time.perf_counter() - t0
 
         # Checkpoint + resume mid-run: identical coefficient required.
@@ -134,6 +140,7 @@ def main():
         "window_rows": window,
         "epochs": epochs,
         "spilled_chunks": spilled,
+        "onehot_premat_active": last_fit.get("premat", False),
         "wall_time_s": round(wall, 2),
         "plan_pass_s": round(plan_s, 2),
         "ingest_s": round(ingest_s, 2),
