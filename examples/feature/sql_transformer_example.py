@@ -21,6 +21,23 @@ def main():
     for row in out.collect():
         print(row)
 
+    # aggregates: global (one row) and per group (GROUP BY)
+    grouped = DataFrame.from_dict(
+        {
+            "cat": np.asarray(["a", "b", "a", "b"]),
+            "v": np.asarray([1.0, 2.0, 3.0, 4.0]),
+        }
+    )
+    agg = (
+        SQLTransformer()
+        .set_statement(
+            "SELECT cat, COUNT(*) AS n, AVG(v) AS mean_v FROM __THIS__ GROUP BY cat"
+        )
+        .transform(grouped)
+    )
+    for row in agg.collect():
+        print(row)
+
 
 if __name__ == "__main__":
     main()

@@ -6,20 +6,30 @@ for the input (e.g. ``SELECT *, (v1 + v2) AS v3 FROM __THIS__``).
 
 The reference delegates to Flink's full SQL planner. Here a documented subset is
 evaluated columnar over numpy:
-  SELECT <expr> [AS alias][, ...] FROM __THIS__ [WHERE <cond>]
+  SELECT <expr> [AS alias][, ...] FROM __THIS__ [WHERE <cond>] [GROUP BY col[, ...]]
 with ``*`` expansion, arithmetic/comparison/boolean operators (SQL ``=``, AND, OR,
 NOT), and the scalar functions ABS, SQRT, EXP, LOG, POW, MIN, MAX (two-argument
 MIN/MAX are elementwise, like SQL LEAST/GREATEST).
 
-Global aggregates — COUNT(*), COUNT(expr), SUM, AVG, and single-argument
-MIN/MAX over the whole table (round 5) — are supported without GROUP BY:
-every select item must then be an expression of aggregates (the output is
-one row; per-row columns may appear only inside an aggregate), WHERE
-filters before aggregation (aggregates are not allowed inside WHERE — no
-HAVING), and aggregates compose with arithmetic (``SUM(v1) / COUNT(*)``).
-Over an empty (filtered) table: COUNT = 0, SUM = 0.0, and MIN/MAX/AVG =
-NaN (this subset has no NULL). GROUP BY, joins, and window clauses are not
-supported and raise ValueError.
+Aggregates — COUNT(*), COUNT(expr), SUM, AVG, and single-argument MIN/MAX
+(round 5) — are supported two ways:
+
+- **Global** (no GROUP BY): every select item must be an expression of
+  aggregates (the output is one row; per-row columns may appear only
+  inside an aggregate). Over an empty (filtered) table: COUNT = 0,
+  SUM = 0.0, and MIN/MAX/AVG = NaN (this subset has no NULL).
+- **GROUP BY col[, col...]** (round 5, second pass): keys are bare column
+  names; each select item is either a group-key column (optionally
+  aliased) or an aggregate expression, evaluated per group — group keys
+  may also appear OUTSIDE aggregates within an aggregate item
+  (``SUM(v) + cat``), as in real SQL. Output rows follow the keys'
+  first-appearance order (deterministic; the reference's planner makes no
+  order promise either).
+
+In both forms WHERE filters before aggregation (aggregates are not
+allowed inside WHERE — no HAVING), and aggregates compose with arithmetic
+(``SUM(v1) / COUNT(*)``). Joins, ORDER BY, HAVING, and window clauses are
+not supported and raise ValueError.
 """
 from __future__ import annotations
 
@@ -116,16 +126,80 @@ def _find_aggregate_calls(expr: str):
     return calls
 
 
-def _eval_aggregate_item(expr: str, allowed, namespace, n_rows: int):
+class _GlobalReducer:
+    """Whole-table aggregation: scalars out (the one-row result)."""
+
+    def __init__(self, n_rows: int):
+        self.n_rows = n_rows
+
+    def count(self):
+        return self.n_rows
+
+    def reduce(self, fn: str, col: np.ndarray):
+        col = np.atleast_1d(col)
+        if col.size == 0:
+            # empty filtered table: SUM = 0.0, MIN/MAX/AVG = NaN (no NULL
+            # in this subset) — defined results, not numpy errors
+            return 0.0 if fn == "SUM" else float("nan")
+        return _AGG_REDUCERS[fn](col)
+
+
+class _GroupReducer:
+    """Per-group aggregation via sorted-order ``reduceat``: vectors of one
+    value per group, groups in key first-appearance order."""
+
+    def __init__(self, gid: np.ndarray, n_groups: int):
+        self.n_rows = gid.shape[0]
+        self.order = np.argsort(gid, kind="stable")
+        self.counts = np.bincount(gid, minlength=n_groups)
+        self.starts = (
+            np.concatenate(([0], np.cumsum(self.counts)[:-1]))
+            if n_groups
+            else np.zeros(0, np.int64)  # zero rows -> zero groups
+        )
+
+    def count(self):
+        return self.counts
+
+    def reduce(self, fn: str, col: np.ndarray):
+        col = np.asarray(col)
+        if col.ndim == 0:  # constant expression: broadcast over the rows
+            col = np.full(self.n_rows, col[()])
+        s = col[self.order]
+        if fn == "SUM":
+            return np.add.reduceat(s, self.starts)
+        if fn == "MIN":
+            return np.minimum.reduceat(s, self.starts)
+        if fn == "MAX":
+            return np.maximum.reduceat(s, self.starts)
+        return np.add.reduceat(np.asarray(s, np.float64), self.starts) / self.counts
+
+
+def _split_alias(item: str):
+    """``'expr AS alias'`` -> ``(expr, alias)``; bare item -> the stripped
+    expression doubling as the output name. One implementation for every
+    select branch so the alias grammar cannot drift between them."""
+    m = re.match(r"(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)$", item, re.I)
+    if m:
+        return m.group("expr").strip(), m.group("alias")
+    return item.strip(), item.strip()
+
+
+def _eval_aggregate_item(expr: str, allowed, namespace, reducer, outer_ns=None):
     """Evaluate a select item that contains aggregate calls: each call is
-    reduced to a scalar, substituted for a temp name, and the remaining
-    expression (arithmetic of aggregates ONLY — a bare per-row column
-    outside an aggregate has no meaning in a one-row result and is
-    rejected, as in real SQL) is evaluated."""
+    reduced (to a scalar globally, or a per-group vector under GROUP BY),
+    substituted for a temp name, and the remaining expression (arithmetic
+    of aggregates plus, under GROUP BY, the group keys' per-group values
+    via ``outer_ns`` — any other bare per-row column outside an aggregate
+    has no meaning in an aggregated result and is rejected, as in real
+    SQL) is evaluated."""
     calls = _find_aggregate_calls(expr)
     rewritten, last = [], 0
     local_ns = dict(namespace)
-    outer_allowed = set()  # temps only: no per-row columns in the outer expr
+    outer_allowed = set()  # temps + group keys only in the outer expr
+    if outer_ns:
+        local_ns.update(outer_ns)
+        outer_allowed.update(outer_ns)
     for j, (start, end, fn, inner) in enumerate(calls):
         if _find_aggregate_calls(inner):
             raise ValueError(
@@ -139,20 +213,13 @@ def _eval_aggregate_item(expr: str, allowed, namespace, n_rows: int):
                 # the COUNT(1) idiom.
                 _check_safe(inner, allowed)
                 eval(_sql_to_python(inner), {"__builtins__": {}}, namespace)
-            value = n_rows
+            value = reducer.count()
         else:
             _check_safe(inner, allowed)
-            col = np.atleast_1d(
-                np.asarray(
-                    eval(_sql_to_python(inner), {"__builtins__": {}}, namespace)
-                )
+            col = np.asarray(
+                eval(_sql_to_python(inner), {"__builtins__": {}}, namespace)
             )
-            if col.size == 0:
-                # empty filtered table: SUM = 0.0, MIN/MAX/AVG = NaN (no
-                # NULL in this subset) — defined results, not numpy errors
-                value = 0.0 if fn == "SUM" else float("nan")
-            else:
-                value = _AGG_REDUCERS[fn](col)
+            value = reducer.reduce(fn, col)
         local_ns[temp] = value
         outer_allowed.add(temp)
         rewritten.append(expr[last:start])
@@ -221,7 +288,6 @@ class SQLTransformer(Transformer):
         # (plus OVER followed by a paren), so no legal column reference in
         # the subset collides with them.
         for pattern, name in (
-            (r"GROUP\s+BY", "GROUP BY"),
             (r"ORDER\s+BY", "ORDER BY"),
             (r"JOIN", "JOIN"),
             (r"HAVING", "HAVING"),
@@ -230,18 +296,20 @@ class SQLTransformer(Transformer):
             if re.search(rf"\b{pattern}", stmt, re.I):
                 raise ValueError(
                     f"SQLTransformer: {name} is not supported (the subset is "
-                    "'SELECT ... FROM __THIS__ [WHERE ...]' with global "
-                    "aggregates; see the module docstring)"
+                    "'SELECT ... FROM __THIS__ [WHERE ...] [GROUP BY ...]' "
+                    "with aggregates; see the module docstring)"
                 )
         m = re.match(
-            r"SELECT\s+(?P<select>.+?)\s+FROM\s+__THIS__(?:\s+WHERE\s+(?P<where>.+))?$",
+            r"SELECT\s+(?P<select>.+?)\s+FROM\s+__THIS__"
+            r"(?:\s+WHERE\s+(?P<where>.+?))?"
+            r"(?:\s+GROUP\s+BY\s+(?P<groupby>.+))?$",
             stmt,
             re.I | re.S,
         )
         if not m:
             raise ValueError(
-                "SQLTransformer supports 'SELECT ... FROM __THIS__ [WHERE ...]'; got: "
-                + stmt
+                "SQLTransformer supports 'SELECT ... FROM __THIS__ [WHERE ...] "
+                "[GROUP BY ...]'; got: " + stmt
             )
         namespace: Dict[str, object] = dict(_FUNCS)
         namespace.update({k.lower(): v for k, v in _FUNCS.items()})
@@ -264,6 +332,12 @@ class SQLTransformer(Transformer):
 
         items = _split_top_level_commas(m.group("select"))
         has_agg = [bool(_find_aggregate_calls(i)) for i in items]
+
+        if m.group("groupby") is not None:
+            return self._transform_grouped(
+                m.group("groupby"), items, has_agg, base, allowed, namespace
+            )
+
         if any(has_agg):
             if not all(has_agg):
                 raise ValueError(
@@ -271,14 +345,11 @@ class SQLTransformer(Transformer):
                     "be an aggregate expression (the output is one row); got "
                     f"mixed items in {m.group('select')!r}"
                 )
+            reducer = _GlobalReducer(base.num_rows)
             out_names, out_cols = [], []
             for item in items:
-                alias_match = re.match(
-                    r"(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)$", item, re.I
-                )
-                expr = alias_match.group("expr") if alias_match else item
-                name = alias_match.group("alias") if alias_match else expr.strip()
-                value = _eval_aggregate_item(expr, allowed, namespace, base.num_rows)
+                expr, name = _split_alias(item)
+                value = _eval_aggregate_item(expr, allowed, namespace, reducer)
                 out_names.append(name)
                 out_cols.append(np.asarray([value]))
             return DataFrame(out_names, None, out_cols)
@@ -291,13 +362,74 @@ class SQLTransformer(Transformer):
                     out_names.append(name)
                     out_cols.append(base.column(name))
                 continue
-            alias_match = re.match(r"(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)$", item, re.I)
-            expr = alias_match.group("expr") if alias_match else item
-            name = alias_match.group("alias") if alias_match else expr.strip()
+            expr, name = _split_alias(item)
             _check_safe(expr, allowed)
             value = eval(_sql_to_python(expr), {"__builtins__": {}}, namespace)
             if np.isscalar(value):
                 value = np.full(base.num_rows, value)
+            out_names.append(name)
+            out_cols.append(value)
+        return DataFrame(out_names, None, out_cols)
+
+    def _transform_grouped(self, groupby, items, has_agg, base, allowed, namespace):
+        """The GROUP BY path: keys are bare input columns; every select item
+        is either a key (bare / aliased) or an aggregate expression. One
+        output row per distinct key tuple, in first-appearance order."""
+        keys = [k.strip() for k in _split_top_level_commas(groupby)]
+        for k in keys:
+            if not re.fullmatch(r"[A-Za-z_]\w*", k) or k not in allowed:
+                raise ValueError(
+                    "SQLTransformer: GROUP BY keys must be bare input column "
+                    f"names; got {k!r}"
+                )
+        key_cols = {k: np.asarray(base.column(k)) for k in keys}
+
+        # Classify select items before touching the data so errors do not
+        # depend on the table being non-empty.
+        plan = []  # ("key", name, key) | ("agg", name, expr)
+        for item, agg in zip(items, has_agg):
+            expr, name = _split_alias(item)
+            if agg:
+                plan.append(("agg", name, expr))
+            elif expr in key_cols:
+                plan.append(("key", name, expr))
+            else:
+                raise ValueError(
+                    "SQLTransformer: with GROUP BY every select item must be "
+                    f"a group key or an aggregate expression; got {item!r}"
+                )
+
+        # Group ids in key first-appearance order: factorize each key, then
+        # unique over the code tuples. Zero input rows flow through as zero
+        # groups — every output column comes out empty WITH its natural
+        # dtype (int counts, key dtypes preserved).
+        codes = np.stack(
+            [np.unique(c, return_inverse=True)[1].reshape(-1) for c in key_cols.values()],
+            axis=1,
+        )
+        _, first_idx, ginv = np.unique(
+            codes, axis=0, return_index=True, return_inverse=True
+        )
+        appear = np.argsort(first_idx, kind="stable")
+        rank = np.empty(appear.shape[0], np.int64)
+        rank[appear] = np.arange(appear.shape[0])
+        gid = rank[ginv.reshape(-1)]
+        reducer = _GroupReducer(gid, appear.shape[0])
+        first_row_of_group = np.asarray(first_idx)[appear]
+        # Group keys are legal OUTSIDE aggregates within an aggregate item
+        # (SUM(v) + cat), carrying their per-group value.
+        keys_per_group = {k: c[first_row_of_group] for k, c in key_cols.items()}
+
+        out_names, out_cols = [], []
+        for kind, name, ref in plan:
+            if kind == "key":
+                value = keys_per_group[ref]
+            else:
+                value = np.asarray(
+                    _eval_aggregate_item(
+                        ref, allowed, namespace, reducer, outer_ns=keys_per_group
+                    )
+                )
             out_names.append(name)
             out_cols.append(value)
         return DataFrame(out_names, None, out_cols)

@@ -363,11 +363,7 @@ def test_sql_transformer_aggregate_errors():
         SQLTransformer().set_statement(
             "SELECT SUM(AVG(v1)) FROM __THIS__"
         ).transform(df)
-    # GROUP BY / JOIN / OVER: loud, specific rejections
-    with pytest.raises(ValueError, match="GROUP BY"):
-        SQLTransformer().set_statement(
-            "SELECT SUM(v1) FROM __THIS__ GROUP BY v1"
-        ).transform(df)
+    # JOIN / OVER: loud, specific rejections
     with pytest.raises(ValueError, match="JOIN"):
         SQLTransformer().set_statement(
             "SELECT v1 FROM __THIS__ JOIN other ON x = y"
@@ -408,11 +404,88 @@ def test_sql_transformer_aggregate_edge_cases():
         with pytest.raises(ValueError, match="not allowed in WHERE"):
             SQLTransformer().set_statement(stmt).transform(df)
     # trailing clause after WHERE still gets the specific rejection
-    with pytest.raises(ValueError, match="GROUP BY"):
-        SQLTransformer().set_statement(
-            "SELECT SUM(v1) FROM __THIS__ WHERE v1 > 1 GROUP BY v2"
-        ).transform(df)
     with pytest.raises(ValueError, match="ORDER BY"):
         SQLTransformer().set_statement(
             "SELECT v1 FROM __THIS__ ORDER BY v1"
+        ).transform(df)
+
+
+def test_sql_transformer_group_by():
+    # Round-5 second pass: GROUP BY over bare key columns; one row per
+    # distinct key tuple, in key first-appearance order.
+    df = DataFrame.from_dict(
+        {
+            "cat": np.asarray(["a", "b", "a", "c", "b", "a"]),
+            "reg": np.asarray([1, 1, 2, 2, 1, 2]),
+            "v": np.asarray([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
+        }
+    )
+    out = (
+        SQLTransformer()
+        .set_statement(
+            "SELECT cat, COUNT(*) AS n, SUM(v) AS s, AVG(v) AS a, "
+            "MAX(v) - MIN(v) AS spread FROM __THIS__ GROUP BY cat"
+        )
+        .transform(df)
+    )
+    np.testing.assert_array_equal(out["cat"], ["a", "b", "c"])
+    np.testing.assert_array_equal(out["n"], [3, 2, 1])
+    np.testing.assert_allclose(out["s"], [10.0, 7.0, 4.0])
+    np.testing.assert_allclose(out["a"], [10.0 / 3, 3.5, 4.0])
+    np.testing.assert_allclose(out["spread"], [5.0, 3.0, 0.0])
+
+    # multi-key + WHERE before grouping + key aliasing + arithmetic of
+    # aggregates; appearance order is of the FILTERED table
+    out2 = (
+        SQLTransformer()
+        .set_statement(
+            "SELECT cat, reg AS region, SUM(v) / COUNT(*) AS mean_v "
+            "FROM __THIS__ WHERE v > 1 GROUP BY cat, reg"
+        )
+        .transform(df)
+    )
+    np.testing.assert_array_equal(out2["cat"], ["b", "a", "c"])
+    np.testing.assert_array_equal(out2["region"], [1, 2, 2])
+    np.testing.assert_allclose(out2["mean_v"], [3.5, 4.5, 4.0])
+
+    # empty filtered table: zero groups, zero rows, every column keeps its
+    # natural dtype (int counts, key dtypes) — schema must not depend on
+    # whether the filter matched anything
+    out3 = (
+        SQLTransformer()
+        .set_statement("SELECT cat, COUNT(*) AS n FROM __THIS__ WHERE v > 99 GROUP BY cat")
+        .transform(df)
+    )
+    assert len(np.asarray(out3["n"])) == 0
+    assert np.asarray(out3["n"]).dtype.kind == "i"
+    assert np.asarray(out3["cat"]).dtype == np.asarray(df["cat"]).dtype
+
+    # group keys are legal OUTSIDE aggregates within an aggregate item
+    # (real-SQL semantics): per-group key value rides the arithmetic
+    out4 = (
+        SQLTransformer()
+        .set_statement("SELECT reg, SUM(v) + reg AS s FROM __THIS__ GROUP BY reg")
+        .transform(df)
+    )
+    np.testing.assert_allclose(out4["s"], [1.0 + 2.0 + 5.0 + 1, 3.0 + 4.0 + 6.0 + 2])
+
+
+def test_sql_transformer_group_by_errors():
+    df = DataFrame.from_dict(
+        {"cat": np.asarray(["a", "b"]), "v": np.asarray([1.0, 2.0])}
+    )
+    # a non-key per-row item
+    with pytest.raises(ValueError, match="group key or an aggregate"):
+        SQLTransformer().set_statement(
+            "SELECT v, SUM(v) FROM __THIS__ GROUP BY cat"
+        ).transform(df)
+    # key expressions are outside the subset
+    with pytest.raises(ValueError, match="bare input column"):
+        SQLTransformer().set_statement(
+            "SELECT cat FROM __THIS__ GROUP BY cat + 1"
+        ).transform(df)
+    # HAVING stays rejected
+    with pytest.raises(ValueError, match="HAVING"):
+        SQLTransformer().set_statement(
+            "SELECT cat, SUM(v) FROM __THIS__ GROUP BY cat HAVING SUM(v) > 1"
         ).transform(df)
