@@ -751,7 +751,10 @@ def bench_streamed_overlap_cpu_mesh():
             "JAX_PLATFORMS": "cpu",
             "PALLAS_AXON_POOL_IPS": "",
             "XLA_FLAGS": (
-                env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+                env.get("XLA_FLAGS", "")
+                + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=30"
+                + " --xla_cpu_collective_call_terminate_timeout_seconds=120"
+                + " --xla_force_host_platform_device_count=8"
             ).strip(),
             "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
         }

@@ -13,12 +13,38 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
+    # XLA CPU's collective rendezvous hard-aborts the PROCESS when a
+    # participant misses it (8 SPMD participants on however few cores the
+    # box grants — CI observed nproc=1). The stall is a genuine runtime
+    # deadlock — raising the bound to 600 s only delayed the abort, and
+    # neither the (removed) legacy-runtime flag nor synchronous dispatch
+    # avoided it — so keep the bound moderate: transient starvation under
+    # 2 minutes survives, and a true deadlock aborts quickly enough for
+    # the isolated-retry harness (test_attention_isolated.py) to retry.
+    _flags += (
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=30"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=120"
+    )
+os.environ["XLA_FLAGS"] = _flags
+
+# The only place the deadlock has ever been observed (dozens of runs) is
+# test_attention_classifier.py's long collective fits — thousands of ring
+# ppermute rendezvous per fit, where every other test runs a handful.
+# Run the file in its own process on a 2-device mesh (see
+# test_attention_isolated.py): two rendezvous participants on one core
+# collapse the deadlock odds that eight have, the file tests STAGE
+# behavior (mesh-width SP semantics live in test_parallel/test_flash),
+# and an abort kills a retryable child instead of the whole suite.
+_ISOLATED = os.environ.get("FLINK_ML_TPU_ISOLATED", "") not in ("", "0", "false")
+collect_ignore = [] if _ISOLATED else ["test_attention_classifier.py"]
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8, (
-    "tests require the 8-device virtual CPU mesh; got " + repr(jax.devices())
+_MIN_DEVICES = 2 if _ISOLATED else 8
+assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= _MIN_DEVICES, (
+    "tests require the virtual CPU mesh; got " + repr(jax.devices())
 )

@@ -1,0 +1,69 @@
+"""Runs test_attention_classifier.py in its own process on a 2-device
+mesh, with retry.
+
+XLA CPU's collective rendezvous intermittently deadlocks and then
+hard-aborts the process (SIGABRT) on this box: N virtual SPMD
+participants must each get a thread through one core, and the attention
+classifier's fits run THOUSANDS of ring-ppermute rendezvous per test
+where every other test runs a handful — observed killing ~1-in-2 full
+suite runs at 8 devices, surviving neither a 600 s timeout, the legacy
+runtime flag (a no-op now), nor synchronous dispatch. Mitigation, in
+order of effect: a 2-participant mesh (the deadlock odds collapse; the
+file tests STAGE behavior — mesh-width SP semantics live in
+test_parallel/test_flash), process isolation (an abort kills a
+retryable child, not the suite), and retries. A real test failure
+reproduces deterministically in the child and is reported with its
+output. ``conftest.collect_ignore`` keeps the file out of the
+in-process run; the env var lets the child collect it normally and
+relaxes conftest's 8-device assertion.
+"""
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+_RETRIES = 3
+
+
+def test_attention_classifier_suite_isolated():
+    here = os.path.dirname(os.path.abspath(__file__))
+    target = os.path.join(here, "test_attention_classifier.py")
+    env = dict(os.environ, FLINK_ML_TPU_ISOLATED="1")
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        env.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=2").strip()
+    last = None
+    for _ in range(1 + _RETRIES):
+        try:
+            last = subprocess.run(
+                [sys.executable, "-m", "pytest", target, "-q", "-p", "no:cacheprovider"],
+                capture_output=True,
+                text=True,
+                env=env,
+                cwd=os.path.dirname(here),
+                # a stall OUTSIDE a collective rendezvous (which the XLA
+                # terminate flag does not cover) must become a retry, not
+                # an invisible suite hang; normal child runs take ~30 s
+                timeout=600,
+            )
+        except subprocess.TimeoutExpired as e:
+            last = subprocess.CompletedProcess(
+                e.cmd,
+                -9,
+                e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or ""),
+                e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or ""),
+            )
+            continue  # hang: retry like an abort
+        if last.returncode == 0:
+            return
+        if last.returncode not in (-6, 134):
+            break  # a real test failure: deterministic, no point retrying
+    pytest.fail(
+        f"isolated attention suite failed (rc={last.returncode}):\n"
+        f"{last.stdout[-4000:]}\n{last.stderr[-2000:]}"
+    )
