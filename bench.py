@@ -527,12 +527,15 @@ def _sweep_row(p, global_batch, d, nnz, K):
     # Pilot differencing to size the real delta: the marginal estimate
     # must itself be a difference (a single-point pilot is ~all fixed
     # ~1 s tunnel dispatch overhead at small shards). The final delta is
-    # sized to ~3 s of pure step time, a multiple of that overhead.
+    # sized to ~5 s of pure step time, a multiple of that overhead, with
+    # a 400-iteration floor — a contention spike during the pilot must
+    # not shrink the real delta into the noise (observed: a sweep row
+    # reading 7 ms where the headline's pinned protocol reads 12-17).
     premat_active = steps(2).onehot_premat_active  # compile + gate decision
     p1 = _median_time(lambda: steps(5), repeats=3)
     p2 = _median_time(lambda: steps(55), repeats=3)
     est_step = max((p2 - p1) / 50, 2e-4)
-    extra = int(min(max(100, 3.0 / est_step), 5000))
+    extra = int(min(max(400, 5.0 / est_step), 5000))
     i1, i2 = 10, 10 + extra
     t1 = _median_time(lambda: steps(i1))
     t2 = _median_time(lambda: steps(i2))

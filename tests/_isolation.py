@@ -1,0 +1,64 @@
+"""Shared subprocess-with-retry containment for the XLA CPU
+rendezvous-deadlock (see test_attention_isolated.py for the full story):
+run a collective-heavy workload in its own 2-device child so a SIGABRT
+kills a retryable, timeout-capped subprocess instead of the suite."""
+import os
+import re
+import subprocess
+
+import pytest
+
+ABORT_RCS = (-6, 134)  # SIGABRT raw / via shell
+_TIMEOUT_S = 600
+
+
+def two_device_env(extra=None):
+    """A child env pinned to a 2-participant virtual CPU mesh (two
+    rendezvous participants on one core collapse the deadlock odds that
+    eight have), off the TPU tunnel."""
+    env = dict(os.environ)
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        env.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=2").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # sitecustomize honors cpu only with this cleared
+    env.update(extra or {})
+    return env
+
+
+def run_contained(cmd, env, cwd, retries=3, what="isolated child"):
+    """Run ``cmd`` with retry on the known infra abort (or a hang past the
+    timeout, which the XLA collective terminate flag does not always
+    cover). A real failure reproduces deterministically in the child and
+    fails the calling test with the child's output. Returns the passing
+    CompletedProcess."""
+    last = None
+    for _ in range(1 + retries):
+        try:
+            last = subprocess.run(
+                cmd,
+                capture_output=True,
+                text=True,
+                env=env,
+                cwd=cwd,
+                timeout=_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired as e:
+            last = subprocess.CompletedProcess(
+                e.cmd,
+                -9,
+                e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or ""),
+                e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or ""),
+            )
+            continue  # hang: retry like an abort
+        if last.returncode == 0:
+            return last
+        if last.returncode not in ABORT_RCS:
+            break  # a real failure: deterministic, no point retrying
+    pytest.fail(
+        f"{what} failed (rc={last.returncode}):\n"
+        f"{last.stdout[-4000:]}\n{last.stderr[-2000:]}"
+    )

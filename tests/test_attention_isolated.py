@@ -18,52 +18,17 @@ in-process run; the env var lets the child collect it normally and
 relaxes conftest's 8-device assertion.
 """
 import os
-import re
-import subprocess
 import sys
 
-import pytest
-
-_RETRIES = 3
+from tests._isolation import run_contained, two_device_env
 
 
 def test_attention_classifier_suite_isolated():
     here = os.path.dirname(os.path.abspath(__file__))
     target = os.path.join(here, "test_attention_classifier.py")
-    env = dict(os.environ, FLINK_ML_TPU_ISOLATED="1")
-    flags = re.sub(
-        r"--xla_force_host_platform_device_count=\d+",
-        "",
-        env.get("XLA_FLAGS", ""),
-    )
-    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=2").strip()
-    last = None
-    for _ in range(1 + _RETRIES):
-        try:
-            last = subprocess.run(
-                [sys.executable, "-m", "pytest", target, "-q", "-p", "no:cacheprovider"],
-                capture_output=True,
-                text=True,
-                env=env,
-                cwd=os.path.dirname(here),
-                # a stall OUTSIDE a collective rendezvous (which the XLA
-                # terminate flag does not cover) must become a retry, not
-                # an invisible suite hang; normal child runs take ~30 s
-                timeout=600,
-            )
-        except subprocess.TimeoutExpired as e:
-            last = subprocess.CompletedProcess(
-                e.cmd,
-                -9,
-                e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or ""),
-                e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or ""),
-            )
-            continue  # hang: retry like an abort
-        if last.returncode == 0:
-            return
-        if last.returncode not in (-6, 134):
-            break  # a real test failure: deterministic, no point retrying
-    pytest.fail(
-        f"isolated attention suite failed (rc={last.returncode}):\n"
-        f"{last.stdout[-4000:]}\n{last.stderr[-2000:]}"
+    run_contained(
+        [sys.executable, "-m", "pytest", target, "-q", "-p", "no:cacheprovider"],
+        env=two_device_env({"FLINK_ML_TPU_ISOLATED": "1"}),
+        cwd=os.path.dirname(here),
+        what="isolated attention suite",
     )

@@ -1,14 +1,42 @@
 """Executes every example script (ref ExamplesTest.java — each example must
 run end-to-end and produce output)."""
 import io
+import os
 import pathlib
 import runpy
+import sys
 from contextlib import redirect_stdout
 
 import pytest
 
+from tests._isolation import run_contained, two_device_env
+
 EXAMPLES_DIR = pathlib.Path(__file__).resolve().parent.parent / "examples"
 EXAMPLES = sorted(EXAMPLES_DIR.rglob("*_example.py"))
+
+# Collective-heavy examples (thousands of ring-ppermute rendezvous per
+# fit) run in their own 2-device subprocess with retry — the same XLA CPU
+# rendezvous-deadlock containment as test_attention_isolated.py; every
+# other example runs in-process for speed.
+_ISOLATED_EXAMPLES = {"self_attention_classifier_example.py"}
+
+
+def _run_isolated(path):
+    root = EXAMPLES_DIR.parent
+    # The repo must ride the child's path explicitly: sys.path[0] of
+    # ``python examples/.../x.py`` is the example's own directory.
+    pythonpath = (
+        f"{root}{os.pathsep}{os.environ['PYTHONPATH']}"
+        if os.environ.get("PYTHONPATH")
+        else str(root)
+    )
+    done = run_contained(
+        [sys.executable, str(path)],
+        env=two_device_env({"PYTHONPATH": pythonpath}),
+        cwd=str(root),
+        what=f"isolated example {path.name}",
+    )
+    assert done.stdout.strip(), f"{path.name} produced no output"
 
 
 def test_examples_cover_every_family():
@@ -27,6 +55,9 @@ def test_examples_cover_every_family():
 
 @pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: str(p.relative_to(EXAMPLES_DIR)))
 def test_example_runs(path):
+    if path.name in _ISOLATED_EXAMPLES:
+        _run_isolated(path)
+        return
     buf = io.StringIO()
     with redirect_stdout(buf):
         runpy.run_path(str(path), run_name="__main__")
