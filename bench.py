@@ -491,7 +491,11 @@ def bench_onehot_per_chip_sweep(peak_flops):
         "note": "single-chip wall-clock at each p's per-shard shape; "
         "measured_time_falloff is the hardware-evidence column for the "
         "crossing-scaling projection (predicted_flop_falloff); excludes "
-        "the per-step psum (sub-ms at 16 MB over ICI)",
+        "the per-step psum (sub-ms at 16 MB over ICI). Deltas have a "
+        "400-iteration floor (a contention-shrunk pilot once produced an "
+        "unusable flat sweep); the time-shared chip still swings single "
+        "rows 2-4x, so cross-run BANDS (BASELINE.md) are the quotable "
+        "numbers, not any one run's row",
     }
 
 

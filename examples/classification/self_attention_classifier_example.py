@@ -27,7 +27,7 @@ def main():
         SelfAttentionClassifier()
         .set_embedding_dim(16)
         .set_num_heads(2)
-        .set_max_iter(60)
+        .set_max_iter(25)
         .set_learning_rate(0.01)
         .set_seed(7)
         .fit(train)
