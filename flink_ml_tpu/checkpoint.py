@@ -11,11 +11,19 @@ completed snapshot and converges to the same result
 TPU-native collapse: the single controller means there are no in-flight records and
 no barrier alignment — a checkpoint is exactly the iteration variables (device
 arrays) plus the epoch counter, taken between epochs. ``CheckpointManager`` writes
-them atomically (tmp dir + rename), keeps the newest ``max_to_keep``, and restores
-the latest complete snapshot. The iteration drivers call ``save``/``restore_latest``
+them atomically (tmp dir + fsync + rename), keeps the newest ``max_to_keep``, and
+restores the latest *intact* snapshot.
+
+Corruption tolerance (the supervised-execution contract, docs/fault_tolerance.md):
+every leaf carries a CRC32 in META.json; ``restore_latest`` verifies, quarantines a
+corrupt snapshot as ``ckpt-N.corrupt`` and falls back to the newest older intact one
+instead of crashing — the failover the reference gets from replicated JobManager
+checkpoint stores. A missing/truncated snapshot surfaces as the typed
+``CheckpointCorruptError`` (step + path attached) so the supervisor's error
+classifier can route it; a fingerprint mismatch is the typed — and fatal —
+``FingerprintMismatchError``. The iteration drivers call ``save``/``restore_latest``
 via ``IterationConfig.checkpoint_manager`` (iteration.py), giving every algorithm
-built on ``iterate_*`` kill/resume for free — the fault-recovery contract the
-reference gets from Flink restart strategies.
+built on ``iterate_*`` kill/resume for free.
 """
 from __future__ import annotations
 
@@ -23,14 +31,54 @@ import json
 import os
 import pickle
 import shutil
+import zlib
 from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-__all__ = ["CheckpointManager"]
+from flink_ml_tpu.faults import faults
+from flink_ml_tpu.metrics import MLMetrics, metrics
+
+__all__ = [
+    "CheckpointManager",
+    "CheckpointCorruptError",
+    "FingerprintMismatchError",
+]
 
 _STEP_PREFIX = "ckpt-"
+_CORRUPT_SUFFIX = ".corrupt"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A snapshot is missing, truncated, or fails checksum verification.
+
+    Carries ``step`` and ``path`` so the supervisor's error classifier
+    (execution/classify.py) can route it and logs can point at the bad dir.
+    """
+
+    def __init__(self, step: int, path: str, reason: str):
+        self.step = step
+        self.path = path
+        self.reason = reason
+        super().__init__(f"checkpoint step {step} at {path!r} is corrupt: {reason}")
+
+
+class FingerprintMismatchError(ValueError):
+    """A directory holds snapshots of a *different* run/config.
+
+    Subclasses ValueError for backward compatibility with callers matching the
+    legacy message; classified FATAL by the supervisor — restarting cannot fix
+    a job pointed at the wrong checkpoint directory.
+    """
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 class CheckpointManager:
@@ -49,6 +97,27 @@ class CheckpointManager:
         self.fingerprint = fingerprint
         self._user_pinned = fingerprint is not None
         os.makedirs(directory, exist_ok=True)
+        self._sweep_orphan_tmp()
+
+    def _sweep_orphan_tmp(self) -> None:
+        """Reclaim ``ckpt-N.tmp`` left by a kill mid-save.
+
+        They are invisible to ``all_steps`` (never restored) but would
+        otherwise accumulate forever; manager construction is the natural
+        recovery point — any tmp dir found here is by definition from a dead
+        incarnation, never from a concurrent save.
+        """
+        for name in os.listdir(self.directory):
+            if name.startswith(_STEP_PREFIX) and name.endswith(".tmp"):
+                path = os.path.join(self.directory, name)
+                if os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        continue
+                metrics.counter(MLMetrics.CHECKPOINT_GROUP, MLMetrics.CHECKPOINT_TMP_SWEPT)
 
     def set_fingerprint(self, fingerprint: str) -> None:
         """Install the run identity computed by an algorithm.
@@ -64,10 +133,13 @@ class CheckpointManager:
     def save(self, step: int, state: Any) -> str:
         """Snapshot ``state`` (pytree of arrays/scalars) as checkpoint ``step``.
 
-        Device arrays are fetched to host; the write is atomic (tmp + rename), so a
-        kill mid-save can never leave a half checkpoint that ``restore_latest``
-        would pick up — the moral of the reference's barrier-aligned snapshots.
+        Device arrays are fetched to host; the write is atomic and durable
+        (tmp dir + per-file fsync + rename + dir fsync), so a kill — or power
+        loss — mid-save can never leave a half checkpoint that
+        ``restore_latest`` would pick up. Each leaf's CRC32 is recorded in
+        META.json for read-time verification.
         """
+        faults.trip("checkpoint.save", step=step)
         leaves, treedef = jax.tree_util.tree_flatten(state)
         host_leaves = [np.asarray(jax.device_get(leaf)) for leaf in leaves]
         final_dir = os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
@@ -81,59 +153,153 @@ class CheckpointManager:
         )
         with open(os.path.join(tmp_dir, "treedef.pkl"), "wb") as f:
             pickle.dump(treedef, f)
+            f.flush()
+            os.fsync(f.fileno())
         with open(os.path.join(tmp_dir, "META.json"), "w") as f:
             json.dump(
                 {
                     "step": step,
                     "num_leaves": len(host_leaves),
                     "fingerprint": self.fingerprint,
+                    "crc32s": [
+                        zlib.crc32(np.ascontiguousarray(leaf).tobytes()) & 0xFFFFFFFF
+                        for leaf in host_leaves
+                    ],
                 },
                 f,
             )
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_path(os.path.join(tmp_dir, "arrays.npz"))
+        _fsync_path(tmp_dir)
         if os.path.exists(final_dir):
             shutil.rmtree(final_dir)
         os.rename(tmp_dir, final_dir)
+        _fsync_path(self.directory)
         self._prune()
         return final_dir
 
     # --- read ----------------------------------------------------------------
     def all_steps(self) -> List[int]:
+        """Steps of the (apparently) complete snapshots, ascending.
+
+        Anything whose name does not parse as ``ckpt-<int>`` — quarantined
+        ``ckpt-N.corrupt`` dirs, in-flight ``.tmp`` dirs, stray files — is
+        skipped rather than crashing the listing.
+        """
         steps = []
         for name in os.listdir(self.directory):
-            if name.startswith(_STEP_PREFIX) and not name.endswith(".tmp"):
-                if os.path.exists(os.path.join(self.directory, name, "META.json")):
-                    steps.append(int(name[len(_STEP_PREFIX) :]))
+            if not name.startswith(_STEP_PREFIX):
+                continue
+            try:
+                step = int(name[len(_STEP_PREFIX):])
+            except ValueError:
+                continue
+            if os.path.exists(os.path.join(self.directory, name, "META.json")):
+                steps.append(step)
         return sorted(steps)
 
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
+
+    def _read_meta(self, step: int) -> dict:
+        ckpt_dir = self._step_dir(step)
+        try:
+            with open(os.path.join(ckpt_dir, "META.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:  # missing, truncated, bad JSON
+            raise CheckpointCorruptError(step, ckpt_dir, f"META.json unreadable: {e!r}")
+
     def restore(self, step: int) -> Any:
-        ckpt_dir = os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
-        with open(os.path.join(ckpt_dir, "treedef.pkl"), "rb") as f:
-            treedef = pickle.load(f)
-        with np.load(os.path.join(ckpt_dir, "arrays.npz")) as z:
-            leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+        """Load and verify snapshot ``step``.
+
+        Any unreadable, truncated, or checksum-failing snapshot raises the
+        typed ``CheckpointCorruptError`` (never a bare FileNotFoundError/
+        KeyError/BadZipFile) so callers — and the supervisor's error
+        classifier — have one failure type to route.
+        """
+        ckpt_dir = self._step_dir(step)
+        meta = self._read_meta(step)
+        try:
+            with open(os.path.join(ckpt_dir, "treedef.pkl"), "rb") as f:
+                treedef = pickle.load(f)
+            with np.load(os.path.join(ckpt_dir, "arrays.npz")) as z:
+                leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:  # OSError, KeyError, BadZipFile, UnpicklingError, ...
+            raise CheckpointCorruptError(step, ckpt_dir, f"snapshot unreadable: {e!r}")
+        expected = meta.get("num_leaves")
+        if expected is not None and expected != len(leaves):
+            raise CheckpointCorruptError(
+                step, ckpt_dir, f"expected {expected} leaves, found {len(leaves)}"
+            )
+        crcs = meta.get("crc32s")
+        if crcs is not None:  # pre-hardening snapshots lack checksums
+            for i, (leaf, crc) in enumerate(zip(leaves, crcs)):
+                actual = zlib.crc32(np.ascontiguousarray(leaf).tobytes()) & 0xFFFFFFFF
+                if actual != crc:
+                    raise CheckpointCorruptError(
+                        step,
+                        ckpt_dir,
+                        f"leaf_{i} checksum mismatch (crc32 {actual:#x} != recorded {crc:#x})",
+                    )
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
-    def restore_latest(self) -> Optional[Tuple[int, Any]]:
-        """(step, state) of the newest complete snapshot, or None.
-
-        The signature the iteration drivers expect (iteration._maybe_restore).
-        """
-        steps = self.all_steps()
-        if not steps:
-            return None
-        step = steps[-1]
-        with open(os.path.join(self.directory, f"{_STEP_PREFIX}{step}", "META.json")) as f:
-            meta = json.load(f)
+    def _check_fingerprint(self, step: int, meta: dict) -> None:
         saved = meta.get("fingerprint")
         if saved is not None and self.fingerprint is not None and saved != self.fingerprint:
-            raise ValueError(
+            raise FingerprintMismatchError(
                 f"checkpoint directory {self.directory!r} holds snapshots of a different "
                 f"run (fingerprint {saved!r} != {self.fingerprint!r}); point this job at "
                 "a fresh directory or delete the stale checkpoints"
             )
-        return step, self.restore(step)
+
+    def _quarantine(self, step: int) -> None:
+        """Move a corrupt snapshot aside as ``ckpt-N.corrupt`` (kept for
+        forensics, invisible to ``all_steps``) instead of deleting evidence."""
+        src = self._step_dir(step)
+        dst = src + _CORRUPT_SUFFIX
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{src}{_CORRUPT_SUFFIX}.{n}"
+        try:
+            os.rename(src, dst)
+        except OSError:
+            shutil.rmtree(src, ignore_errors=True)
+        metrics.counter(MLMetrics.CHECKPOINT_GROUP, MLMetrics.CHECKPOINT_QUARANTINED)
+
+    def restore_latest(self) -> Optional[Tuple[int, Any]]:
+        """(step, state) of the newest *intact* snapshot, or None.
+
+        The signature the iteration drivers expect (iteration._maybe_restore).
+        A snapshot that fails verification is quarantined (``ckpt-N.corrupt``)
+        and the next older one is tried — corruption degrades to a slightly
+        older resume point, never a crash. A fingerprint mismatch still raises:
+        falling back would resume some *other* job's state.
+        """
+        fell_back = False
+        for step in reversed(self.all_steps()):
+            try:
+                meta = self._read_meta(step)
+            except CheckpointCorruptError:
+                self._quarantine(step)
+                fell_back = True
+                continue
+            self._check_fingerprint(step, meta)
+            try:
+                state = self.restore(step)
+            except CheckpointCorruptError:
+                self._quarantine(step)
+                fell_back = True
+                continue
+            if fell_back:
+                metrics.counter(MLMetrics.CHECKPOINT_GROUP, MLMetrics.CHECKPOINT_FALLBACKS)
+            return step, state
+        return None
 
     def _prune(self) -> None:
         steps = self.all_steps()
         for step in steps[: -self.max_to_keep] if self.max_to_keep else []:
-            shutil.rmtree(os.path.join(self.directory, f"{_STEP_PREFIX}{step}"))
+            shutil.rmtree(self._step_dir(step))

@@ -92,6 +92,14 @@ class Options:
         1,
         "Model-parallel axis size of the default mesh.",
     )
+    FAULT_INJECTION = ConfigOption(
+        "faults.spec",
+        str,
+        None,
+        "Deterministic fault-injection spec, e.g. "
+        "'checkpoint.save:at=2;iteration.epoch:prob=0.05,seed=7' "
+        "(see flink_ml_tpu.faults). Default: no faults armed.",
+    )
     NATIVE_DATACACHE_ENABLED = ConfigOption(
         "native.datacache.enabled",
         _parse_bool,

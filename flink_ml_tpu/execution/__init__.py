@@ -1,0 +1,54 @@
+"""Supervised execution: restart strategies + failure classification.
+
+Reference: the reference gets fault recovery from Flink L0 — a configured
+``RestartStrategy`` (fixed-delay / exponential-backoff / failure-rate), an
+error classifier splitting recoverable from non-recoverable throwables, and a
+JobManager that redeploys the job from its latest completed checkpoint. The
+host-loop world reproduces that contract here (see docs/fault_tolerance.md):
+
+  - ``restart``    : the three Flink restart policies + ``RestartStrategies``
+                     factory parity;
+  - ``classify``   : retryable (injected faults, spill I/O, transient
+                     collective aborts, checkpoint corruption) vs. fatal
+                     (fingerprint mismatch, shape/dtype errors);
+  - ``supervisor`` : ``Supervisor.run`` — the retry loop around
+                     ``iterate_*`` / ``Estimator.fit`` / ``SGD.optimize``,
+                     with resume via ``CheckpointManager.restore_latest()``
+                     and restart/recovery counters in ``metrics``.
+
+Deterministic fault injection for exercising all of this lives in
+``flink_ml_tpu.faults``.
+"""
+from flink_ml_tpu.execution.classify import (
+    DEFAULT_CLASSIFIER,
+    ErrorClassifier,
+    FailureKind,
+)
+from flink_ml_tpu.execution.restart import (
+    ExponentialBackoffRestartStrategy,
+    FailureRateRestartStrategy,
+    FixedDelayRestartStrategy,
+    NoRestartStrategy,
+    RestartStrategies,
+    RestartStrategy,
+)
+from flink_ml_tpu.execution.supervisor import (
+    AttemptFailure,
+    RestartsExhaustedError,
+    Supervisor,
+)
+
+__all__ = [
+    "AttemptFailure",
+    "DEFAULT_CLASSIFIER",
+    "ErrorClassifier",
+    "ExponentialBackoffRestartStrategy",
+    "FailureKind",
+    "FailureRateRestartStrategy",
+    "FixedDelayRestartStrategy",
+    "NoRestartStrategy",
+    "RestartStrategies",
+    "RestartStrategy",
+    "RestartsExhaustedError",
+    "Supervisor",
+]

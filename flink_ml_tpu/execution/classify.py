@@ -1,0 +1,90 @@
+"""Failure classification: which errors a restart can fix.
+
+Reference: Flink routes failures through ``ThrowableClassifier`` — a
+``RecoverableFailure`` triggers the restart strategy, a
+``NonRecoverableError`` (e.g. ``SuppressRestartsException``) fails the job
+immediately no matter the remaining budget. The same split here:
+
+RETRYABLE — another attempt, resumed from the latest checkpoint, can succeed:
+  - ``faults.InjectedFault`` (the test/CI failure class, by construction);
+  - spill-file and checkpoint I/O errors (``OSError`` and subclasses);
+  - transient collective/rendezvous aborts (XLA CPU's collective rendezvous
+    starvation, distributed barrier timeouts) — matched on message because the
+    raising type differs across jax versions and backends;
+  - ``CheckpointCorruptError`` — ``restore_latest`` already quarantines and
+    falls back, so one surfacing mid-run is worth exactly a retry.
+
+FATAL — deterministic; restarting replays the same crash:
+  - ``FingerprintMismatchError`` — the job is pointed at a foreign checkpoint
+    directory; retrying cannot make it the right one;
+  - shape/dtype/typing errors (``TypeError``, ``ValueError``) and anything
+    unrecognized (default-fatal, like Flink's conservative default).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Iterable, Tuple, Type
+
+from flink_ml_tpu.checkpoint import CheckpointCorruptError, FingerprintMismatchError
+from flink_ml_tpu.faults import InjectedFault
+
+__all__ = ["FailureKind", "ErrorClassifier", "DEFAULT_CLASSIFIER"]
+
+
+class FailureKind(enum.Enum):
+    RETRYABLE = "RETRYABLE"
+    FATAL = "FATAL"
+
+
+#: Message fragments marking a transient collective/rendezvous abort. These
+#: surface as RuntimeError / XlaRuntimeError / jax errors depending on the
+#: backend and jax version, so the match is on text, case-insensitively.
+_TRANSIENT_MARKERS: Tuple[str, ...] = (
+    "rendezvous",
+    "collective",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "connection reset",
+    "unavailable:",
+)
+
+
+class ErrorClassifier:
+    """Type- and message-based failure router for the supervisor.
+
+    ``extra_retryable`` / ``extra_fatal`` extend the built-in rules with
+    deployment-specific exception types (checked before the generic rules, so
+    a type can be re-routed either way).
+    """
+
+    def __init__(
+        self,
+        extra_retryable: Iterable[Type[BaseException]] = (),
+        extra_fatal: Iterable[Type[BaseException]] = (),
+    ):
+        self.extra_retryable = tuple(extra_retryable)
+        self.extra_fatal = tuple(extra_fatal)
+
+    def classify(self, error: BaseException) -> FailureKind:
+        if self.extra_fatal and isinstance(error, self.extra_fatal):
+            return FailureKind.FATAL
+        if self.extra_retryable and isinstance(error, self.extra_retryable):
+            return FailureKind.RETRYABLE
+        if isinstance(error, InjectedFault):
+            return FailureKind.RETRYABLE
+        if isinstance(error, FingerprintMismatchError):
+            return FailureKind.FATAL
+        if isinstance(error, CheckpointCorruptError):
+            return FailureKind.RETRYABLE
+        if isinstance(error, OSError):
+            return FailureKind.RETRYABLE
+        message = str(error).lower()
+        if any(marker in message for marker in _TRANSIENT_MARKERS):
+            return FailureKind.RETRYABLE
+        return FailureKind.FATAL
+
+    def is_retryable(self, error: BaseException) -> bool:
+        return self.classify(error) is FailureKind.RETRYABLE
+
+
+DEFAULT_CLASSIFIER = ErrorClassifier()

@@ -1,0 +1,236 @@
+"""Restart strategies — parity with Flink's ``RestartStrategies``.
+
+Reference: ``org.apache.flink.api.common.restartstrategy.RestartStrategies`` —
+the three production policies a Flink job picks from:
+
+  - fixed-delay   : up to N restarts, constant delay between attempts;
+  - exponential   : delay grows by a multiplier up to a cap, resets after the
+                    job has run cleanly for a threshold, optional jitter;
+  - failure-rate  : restart freely unless more than N failures land inside a
+                    sliding time interval.
+
+A strategy here is a small stateful policy object: the supervisor calls
+``next_restart(now)`` after each retryable failure and gets the backoff delay
+in seconds, or ``None`` when the restart budget is exhausted (→ the failure is
+re-raised, the job is dead). ``record_success(now)`` lets the exponential
+policy reset its backoff after a clean stretch. Time is injected (``now``)
+so strategies are deterministic under test.
+"""
+from __future__ import annotations
+
+import random
+from collections import deque
+from typing import Deque, Optional
+
+__all__ = [
+    "RestartStrategy",
+    "NoRestartStrategy",
+    "FixedDelayRestartStrategy",
+    "ExponentialBackoffRestartStrategy",
+    "FailureRateRestartStrategy",
+    "RestartStrategies",
+]
+
+
+class RestartStrategy:
+    """Policy deciding whether — and after how long — to restart a failed run."""
+
+    def next_restart(self, now: float) -> Optional[float]:
+        """Record a failure at time ``now``; return the delay in seconds
+        before the next attempt, or ``None`` if the budget is exhausted."""
+        raise NotImplementedError
+
+    def record_success(self, now: float) -> None:
+        """Called when an attempt completes cleanly (hook for backoff reset)."""
+
+    def reset(self) -> None:
+        """Forget all recorded failures (fresh job)."""
+
+
+class NoRestartStrategy(RestartStrategy):
+    """Ref ``RestartStrategies.noRestart()`` — every failure is final."""
+
+    def next_restart(self, now: float) -> Optional[float]:
+        return None
+
+    def __repr__(self) -> str:
+        return "NoRestartStrategy()"
+
+
+class FixedDelayRestartStrategy(RestartStrategy):
+    """Ref ``RestartStrategies.fixedDelayRestart(attempts, delay)``."""
+
+    def __init__(self, max_restarts: int, delay_s: float = 0.0):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        if delay_s < 0:
+            raise ValueError(f"delay_s must be >= 0, got {delay_s}")
+        self.max_restarts = max_restarts
+        self.delay_s = delay_s
+        self._used = 0
+
+    def next_restart(self, now: float) -> Optional[float]:
+        if self._used >= self.max_restarts:
+            return None
+        self._used += 1
+        return self.delay_s
+
+    def reset(self) -> None:
+        self._used = 0
+
+    def __repr__(self) -> str:
+        return f"FixedDelayRestartStrategy({self.max_restarts}, delay_s={self.delay_s})"
+
+
+class ExponentialBackoffRestartStrategy(RestartStrategy):
+    """Ref ``RestartStrategies.exponentialDelayRestart``.
+
+    The delay starts at ``initial_delay_s`` and multiplies by
+    ``backoff_multiplier`` per consecutive failure, capped at ``max_delay_s``.
+    After an attempt has run cleanly (``record_success``) for at least
+    ``reset_threshold_s`` since the last failure, the backoff resets to the
+    initial delay. ``jitter_factor`` (0..1) spreads each delay uniformly in
+    ``[delay*(1-j), delay*(1+j)]`` from a seeded RNG so runs stay reproducible.
+    ``max_restarts=None`` means unbounded (the Flink default for this policy).
+    """
+
+    def __init__(
+        self,
+        initial_delay_s: float = 1.0,
+        max_delay_s: float = 60.0,
+        backoff_multiplier: float = 2.0,
+        reset_threshold_s: Optional[float] = None,
+        jitter_factor: float = 0.0,
+        max_restarts: Optional[int] = None,
+        seed: int = 0,
+    ):
+        if initial_delay_s < 0 or max_delay_s < initial_delay_s:
+            raise ValueError(
+                f"need 0 <= initial_delay_s <= max_delay_s, got "
+                f"{initial_delay_s}, {max_delay_s}"
+            )
+        if backoff_multiplier < 1.0:
+            raise ValueError(f"backoff_multiplier must be >= 1, got {backoff_multiplier}")
+        if not 0.0 <= jitter_factor <= 1.0:
+            raise ValueError(f"jitter_factor must be in [0, 1], got {jitter_factor}")
+        self.initial_delay_s = initial_delay_s
+        self.max_delay_s = max_delay_s
+        self.backoff_multiplier = backoff_multiplier
+        self.reset_threshold_s = reset_threshold_s
+        self.jitter_factor = jitter_factor
+        self.max_restarts = max_restarts
+        self._rng = random.Random(seed)
+        self._consecutive = 0
+        self._used = 0
+        self._last_failure: Optional[float] = None
+
+    def next_restart(self, now: float) -> Optional[float]:
+        if self.max_restarts is not None and self._used >= self.max_restarts:
+            return None
+        delay = min(
+            self.initial_delay_s * self.backoff_multiplier**self._consecutive,
+            self.max_delay_s,
+        )
+        if self.jitter_factor:
+            delay *= 1.0 + self.jitter_factor * (2.0 * self._rng.random() - 1.0)
+        self._consecutive += 1
+        self._used += 1
+        self._last_failure = now
+        return delay
+
+    def record_success(self, now: float) -> None:
+        if (
+            self.reset_threshold_s is not None
+            and self._last_failure is not None
+            and now - self._last_failure >= self.reset_threshold_s
+        ):
+            self._consecutive = 0
+
+    def reset(self) -> None:
+        self._consecutive = 0
+        self._used = 0
+        self._last_failure = None
+
+    def __repr__(self) -> str:
+        return (
+            f"ExponentialBackoffRestartStrategy({self.initial_delay_s}, "
+            f"max={self.max_delay_s}, x{self.backoff_multiplier})"
+        )
+
+
+class FailureRateRestartStrategy(RestartStrategy):
+    """Ref ``RestartStrategies.failureRateRestart(max, interval, delay)``.
+
+    Restarts freely with ``delay_s`` between attempts — unless strictly more
+    than ``max_failures_per_interval`` failures fall inside the sliding
+    ``interval_s`` window, at which point the budget is exhausted. This is the
+    policy that distinguishes a transient blip (a few scattered failures) from
+    a crash loop (many failures close together).
+    """
+
+    def __init__(self, max_failures_per_interval: int, interval_s: float, delay_s: float = 0.0):
+        if max_failures_per_interval < 1:
+            raise ValueError(
+                f"max_failures_per_interval must be >= 1, got {max_failures_per_interval}"
+            )
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.max_failures_per_interval = max_failures_per_interval
+        self.interval_s = interval_s
+        self.delay_s = delay_s
+        self._failures: Deque[float] = deque()
+
+    def next_restart(self, now: float) -> Optional[float]:
+        self._failures.append(now)
+        while self._failures and self._failures[0] <= now - self.interval_s:
+            self._failures.popleft()
+        if len(self._failures) > self.max_failures_per_interval:
+            return None
+        return self.delay_s
+
+    def reset(self) -> None:
+        self._failures.clear()
+
+    def __repr__(self) -> str:
+        return (
+            f"FailureRateRestartStrategy({self.max_failures_per_interval} per "
+            f"{self.interval_s}s, delay_s={self.delay_s})"
+        )
+
+
+class RestartStrategies:
+    """Static factory parity with ``RestartStrategies.java``."""
+
+    @staticmethod
+    def no_restart() -> NoRestartStrategy:
+        return NoRestartStrategy()
+
+    @staticmethod
+    def fixed_delay_restart(restart_attempts: int, delay_s: float = 0.0) -> FixedDelayRestartStrategy:
+        return FixedDelayRestartStrategy(restart_attempts, delay_s)
+
+    @staticmethod
+    def exponential_delay_restart(
+        initial_delay_s: float = 1.0,
+        max_delay_s: float = 60.0,
+        backoff_multiplier: float = 2.0,
+        reset_threshold_s: Optional[float] = None,
+        jitter_factor: float = 0.0,
+        max_restarts: Optional[int] = None,
+        seed: int = 0,
+    ) -> ExponentialBackoffRestartStrategy:
+        return ExponentialBackoffRestartStrategy(
+            initial_delay_s,
+            max_delay_s,
+            backoff_multiplier,
+            reset_threshold_s,
+            jitter_factor,
+            max_restarts,
+            seed,
+        )
+
+    @staticmethod
+    def failure_rate_restart(
+        max_failures_per_interval: int, interval_s: float, delay_s: float = 0.0
+    ) -> FailureRateRestartStrategy:
+        return FailureRateRestartStrategy(max_failures_per_interval, interval_s, delay_s)

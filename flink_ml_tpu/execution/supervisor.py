@@ -1,0 +1,176 @@
+"""The supervisor: restart semantics around the iteration drivers.
+
+Reference: a failed Flink task triggers region failover — the JobManager
+consults the configured ``RestartStrategy``, waits the backoff, and
+redeploys the job, which resumes from the latest completed checkpoint
+(PAPER.md §5.3-5.4, proven by ``BoundedAllRoundCheckpointITCase`` /
+``UnboundedStreamCheckpointITCase``). The host-loop world has no JobManager,
+so this module IS the supervisor: ``Supervisor.run`` wraps any training
+callable — ``iterate_bounded_until_termination``, ``Estimator.fit``,
+``SGD.optimize`` — and replays it on retryable failures.
+
+Resume comes from the checkpoint layer, not from the supervisor: the wrapped
+callable re-invokes the iteration driver, which restores from
+``CheckpointManager.restore_latest()`` at entry, so each attempt continues
+where the last completed snapshot left off. The supervisor only decides
+*whether* and *when* to re-invoke:
+
+    mgr = CheckpointManager(ckpt_dir)
+    sup = Supervisor(RestartStrategies.fixed_delay_restart(3, delay_s=0.0))
+    coef = sup.run(lambda: SGD(..., checkpoint_manager=mgr,
+                               checkpoint_interval=1).optimize(w0, data, loss))
+
+Failures are routed through an ``ErrorClassifier`` (classify.py): retryable
+ones consult the restart strategy; fatal ones — fingerprint mismatch,
+shape/dtype errors — re-raise immediately with the budget untouched. When the
+strategy declines (budget exhausted), the original failure re-raises with a
+``RestartsExhaustedError`` chained in so callers can tell "died on first
+fault" from "died after N recoveries".
+
+Counters (``flink_ml_tpu.metrics``, scope ``ml.execution[<name>]``): attempts,
+restarts, fatal failures, last/total recovery downtime in ms.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Iterator, List, Optional
+
+from flink_ml_tpu.execution.classify import DEFAULT_CLASSIFIER, ErrorClassifier, FailureKind
+from flink_ml_tpu.execution.restart import FixedDelayRestartStrategy, RestartStrategy
+from flink_ml_tpu.metrics import MLMetrics, metrics
+
+__all__ = ["AttemptFailure", "RestartsExhaustedError", "Supervisor"]
+
+
+@dataclasses.dataclass
+class AttemptFailure:
+    """One failed attempt, as recorded in ``Supervisor.failures``."""
+
+    attempt: int
+    error: BaseException
+    kind: FailureKind
+    delay_s: Optional[float]  # backoff granted, None = budget exhausted / fatal
+
+
+class RestartsExhaustedError(RuntimeError):
+    """The restart strategy declined a further attempt.
+
+    Raised as the *context* of the final failure (``raise err from self``), so
+    the original exception type still propagates to callers/tests while the
+    attempt history stays reachable via ``__context__``/``__cause__``.
+    """
+
+    def __init__(self, name: str, strategy: RestartStrategy, failures: List[AttemptFailure]):
+        self.failures = list(failures)
+        super().__init__(
+            f"supervisor {name!r}: restart budget of {strategy!r} exhausted "
+            f"after {len(failures)} failure(s); last: {failures[-1].error!r}"
+        )
+
+
+class Supervisor:
+    """Retry loop with Flink restart semantics around a training callable.
+
+    ``strategy`` defaults to 3 immediate restarts (a CI-friendly
+    ``fixedDelayRestart(3, 0)``); ``classifier`` defaults to the built-in
+    retryable/fatal split. ``clock``/``sleep`` are injectable for
+    deterministic tests.
+    """
+
+    def __init__(
+        self,
+        strategy: Optional[RestartStrategy] = None,
+        classifier: Optional[ErrorClassifier] = None,
+        name: str = "supervisor",
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.strategy = strategy if strategy is not None else FixedDelayRestartStrategy(3, 0.0)
+        self.classifier = classifier if classifier is not None else DEFAULT_CLASSIFIER
+        self.name = name
+        self._clock = clock
+        self._sleep = sleep
+        self.failures: List[AttemptFailure] = []
+        self.attempts = 0
+        self.restarts = 0
+
+    @property
+    def metric_scope(self) -> str:
+        return f"{MLMetrics.EXECUTION_GROUP}[{self.name}]"
+
+    def _count(self, metric: str, inc: int = 1) -> None:
+        metrics.counter(self.metric_scope, metric, inc)
+
+    def _on_failure(self, error: BaseException) -> float:
+        """Classify; return the granted backoff or re-raise ``error``."""
+        kind = self.classifier.classify(error)
+        now = self._clock()
+        if kind is FailureKind.FATAL:
+            self.failures.append(AttemptFailure(self.attempts, error, kind, None))
+            self._count(MLMetrics.NUM_FATAL)
+            raise error
+        delay = self.strategy.next_restart(now)
+        self.failures.append(AttemptFailure(self.attempts, error, kind, delay))
+        if delay is None:
+            raise error from RestartsExhaustedError(self.name, self.strategy, self.failures)
+        self.restarts += 1
+        self._count(MLMetrics.NUM_RESTARTS)
+        return delay
+
+    def _record_recovery(self, failed_at: float) -> None:
+        downtime_ms = max(0.0, (self._clock() - failed_at) * 1000.0)
+        metrics.gauge(self.metric_scope, MLMetrics.RECOVERY_MS, downtime_ms)
+        total = metrics.get(self.metric_scope, MLMetrics.TOTAL_RECOVERY_MS, 0.0)
+        metrics.gauge(self.metric_scope, MLMetrics.TOTAL_RECOVERY_MS, total + downtime_ms)
+
+    def run(self, fn: Callable[..., Any], *args, **kwargs) -> Any:
+        """Invoke ``fn(*args, **kwargs)``, restarting on retryable failures.
+
+        Each retry re-invokes ``fn`` from the top; resume-from-checkpoint is
+        the callable's own contract (wire a ``CheckpointManager`` into the
+        estimator/driver it runs). Returns ``fn``'s result; raises the last
+        failure when fatal or when the strategy's budget is exhausted.
+        """
+        while True:
+            self.attempts += 1
+            self._count(MLMetrics.NUM_ATTEMPTS)
+            try:
+                result = fn(*args, **kwargs)
+            except Exception as e:
+                failed_at = self._clock()
+                delay = self._on_failure(e)
+                if delay:
+                    self._sleep(delay)
+                self._record_recovery(failed_at)
+                continue
+            self.strategy.record_success(self._clock())
+            return result
+
+    def run_stream(self, factory: Callable[[], Iterator[Any]]) -> Iterator[Any]:
+        """Supervise an unbounded/generator workload (``iterate_unbounded``).
+
+        ``factory`` must build a *fresh* generator per attempt — a Python
+        generator dies permanently on any exception raised through it. On a
+        retryable failure the factory is re-invoked; its driver restores the
+        model-version counter from the checkpoint and skips the replayed
+        source to the offset, so already-yielded epochs are not re-emitted
+        (exactly at ``checkpoint_interval=1``, at-least-once above that —
+        the ``UnboundedStreamCheckpointITCase`` contract).
+        """
+        while True:
+            self.attempts += 1
+            self._count(MLMetrics.NUM_ATTEMPTS)
+            stream = factory()
+            try:
+                for item in stream:
+                    yield item
+            except Exception as e:
+                failed_at = self._clock()
+                delay = self._on_failure(e)
+                if delay:
+                    self._sleep(delay)
+                self._record_recovery(failed_at)
+                continue
+            self.strategy.record_success(self._clock())
+            return

@@ -28,6 +28,7 @@ from typing import Dict, Iterator, List, Optional
 import jax
 import numpy as np
 
+from flink_ml_tpu.faults import faults
 from flink_ml_tpu.parallel.mesh import MeshContext, get_mesh_context
 
 __all__ = ["DeviceDataCache", "HostDataCache", "create_capacity_cache"]
@@ -201,6 +202,7 @@ class HostDataCache:
         (n,) = lengths
         nbytes = sum(v.nbytes for v in chunk.values())
         if self._mem_bytes + nbytes > self.memory_budget and self.spill_dir:
+            faults.trip("datacache.spill.write", chunk=self._spill_count)
             os.makedirs(self.spill_dir, exist_ok=True)
             files = {}
             for k, v in chunk.items():
@@ -235,6 +237,7 @@ class HostDataCache:
         entry = self._log[idx]
         if "mem" in entry:
             return entry["mem"]  # type: ignore[return-value]
+        faults.trip("datacache.spill.read", chunk=idx)
         return {
             k: np.load(path, mmap_mode="r")
             for k, path in entry["files"].items()  # type: ignore[union-attr]

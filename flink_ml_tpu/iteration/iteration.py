@@ -19,6 +19,8 @@ from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 
+from flink_ml_tpu.faults import faults
+
 __all__ = [
     "OperatorLifeCycle",
     "IterationConfig",
@@ -234,6 +236,7 @@ def iterate_bounded_until_termination(
     while True:
         if config.max_epochs is not None and epoch >= config.max_epochs:
             break
+        faults.trip("iteration.epoch", epoch=epoch)
         if data is not None:
             result = body(variables, epoch, data.epoch_view(epoch))
         else:
@@ -295,6 +298,7 @@ def iterate_unbounded(
                 stream = _drop_batches(stream, epoch)
 
     for batch in stream:
+        faults.trip("iteration.epoch", epoch=epoch)
         result = body(variables, batch, epoch)
         for listener in listeners:
             listener.on_epoch_watermark_incremented(epoch, context)

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from flink_ml_tpu.faults import faults
 from flink_ml_tpu.parallel.mesh import MeshContext
 
 __all__ = ["WindowSchedule", "WindowedStream", "is_host_cache", "plan_windows", "run_windows"]
@@ -236,6 +237,7 @@ def run_windows(
     }
     for i in range(start_run, len(runs)):
         j, starts_local = runs[i]
+        faults.trip("streaming.window", run=i, window=j)
         starts_c, active_c, n_active = sched.padded(starts_local)
         observe = dispatch(i, bufs[j], starts_c, active_c, n_active)
         next_j = runs[i + 1][0] if i + 1 < len(runs) else None

@@ -55,6 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flink_ml_tpu.parallel.mesh import shape_dtype_struct as _sds
 from flink_ml_tpu.parallel.mesh import vma_of as _vma_of_shared
 from flink_ml_tpu.utils.arrays import group_ranks, next_pow2
 
@@ -546,7 +547,7 @@ def dot_crossing_pallas(q, rhi, rlo, row_hi, interpret: bool = False):
             (1, 1, row_hi, _ROW_LO), lambda i, k: (i, k, 0, 0),
             memory_space=pltpu.VMEM,
         ),
-        out_shape=jax.ShapeDtypeStruct(
+        out_shape=_sds(
             (n_sub, ntiles, row_hi, _ROW_LO), jnp.float32, vma=_vma_of_shared(q)
         ),
         interpret=interpret,
@@ -603,7 +604,7 @@ def mult_crossing_pallas(mult3, rhi, rlo, row_hi, interpret: bool = False):
             row,
         ],
         out_specs=row,
-        out_shape=jax.ShapeDtypeStruct(
+        out_shape=_sds(
             (n_sub * (n + pad),), jnp.float32, vma=_vma_of_shared(rhi)
         ),
         interpret=interpret,
@@ -760,7 +761,7 @@ def dot_crossing_premat_pallas(q, oh_hi, oh_lo, wi=0, interpret: bool = False):
                 (1, 1, row_hi, _ROW_LO), lambda i, k, wi_ref: (i, k, 0, 0)
             ),
         ),
-        out_shape=jax.ShapeDtypeStruct(
+        out_shape=_sds(
             (n_sub, ntiles, row_hi, _ROW_LO), jnp.float32, vma=_vma_of_shared(q)
         ),
         interpret=interpret,
@@ -811,7 +812,7 @@ def mult_crossing_premat_pallas(mult3, oh_hi, oh_lo, wi=0, interpret: bool = Fal
                 (tile,), lambda i, k, wi_ref: (i * ntiles + k,)
             ),
         ),
-        out_shape=jax.ShapeDtypeStruct(
+        out_shape=_sds(
             (n_sub * n_pad,), jnp.float32, vma=_vma_of_shared(mult3)
         ),
         interpret=interpret,

@@ -18,12 +18,27 @@ __all__ = ["MLMetrics", "MetricsRegistry", "metrics"]
 
 
 class MLMetrics:
-    """Ref MLMetrics.java constants."""
+    """Ref MLMetrics.java constants, extended with the supervised-execution
+    counters (restart strategies / checkpoint failover — docs/fault_tolerance.md)."""
 
     ML_GROUP = "ml"
     ML_MODEL_GROUP = "ml.model"
     TIMESTAMP = "ml.model.timestamp"
     VERSION = "ml.model.version"
+
+    # Supervisor counters (scope = "ml.execution[<supervisor name>]").
+    EXECUTION_GROUP = "ml.execution"
+    NUM_ATTEMPTS = "ml.execution.attempts"
+    NUM_RESTARTS = "ml.execution.restarts"
+    NUM_FATAL = "ml.execution.fatal"
+    RECOVERY_MS = "ml.execution.recovery.ms"  # downtime of the last recovery
+    TOTAL_RECOVERY_MS = "ml.execution.recovery.total.ms"
+
+    # Checkpoint-failover counters (scope = CHECKPOINT_GROUP, process-global).
+    CHECKPOINT_GROUP = "ml.checkpoint"
+    CHECKPOINT_QUARANTINED = "ml.checkpoint.quarantined"
+    CHECKPOINT_FALLBACKS = "ml.checkpoint.fallbacks"
+    CHECKPOINT_TMP_SWEPT = "ml.checkpoint.tmp.swept"
 
 
 class MetricsRegistry:
@@ -36,6 +51,14 @@ class MetricsRegistry:
     def gauge(self, scope: str, name: str, value: Any) -> None:
         with self._lock:
             self._gauges.setdefault(scope, {})[name] = value
+
+    def counter(self, scope: str, name: str, inc: int = 1) -> int:
+        """Increment-and-get a monotonically growing gauge (restart counts,
+        quarantine events). Reads go through ``get`` like any gauge."""
+        with self._lock:
+            group = self._gauges.setdefault(scope, {})
+            group[name] = int(group.get(name, 0)) + inc
+            return group[name]
 
     def get(self, scope: str, name: str, default: Any = None) -> Any:
         with self._lock:

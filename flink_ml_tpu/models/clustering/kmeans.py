@@ -184,7 +184,14 @@ class KMeans(
         model.weights = np.asarray(jax.device_get(counts), np.float64)
         return model
 
-    def fit_stream(self, cache, chunk_rows: int = 65_536) -> KMeansModel:
+    def fit_stream(
+        self,
+        cache,
+        chunk_rows: int = 65_536,
+        checkpoint_manager=None,
+        checkpoint_interval: int = 0,
+        listeners=(),
+    ) -> KMeansModel:
         """Larger-than-HBM KMeans: the point set replays from a capacity-tier
         cache (column ``features``) every epoch through the iteration driver's
         ``ReplayableDataStreamList`` — the ``ListStateWithCache:224`` role.
@@ -192,6 +199,12 @@ class KMeans(
         and combines them on the host (the countWindowAll reduce). Same seed
         ⇒ same random-sample init as the in-HBM ``fit``, and matching results
         up to chunked summation order.
+
+        ``checkpoint_manager``/``checkpoint_interval`` give the fit the same
+        kill/resume contract as SGD (docs/fault_tolerance.md): the snapshot is
+        ``(epoch, [centroids])`` and a rerun — e.g. a supervised restart via
+        ``execution.Supervisor`` — resumes at the last snapshotted epoch and
+        lands on the identical model.
         """
         from flink_ml_tpu.iteration import (
             IterationBodyResult,
@@ -211,6 +224,26 @@ class KMeans(
         init = np.concatenate(
             [np.asarray(cache.rows(int(i), int(i) + 1)["features"], np.float32) for i in pick]
         )
+        if checkpoint_manager is not None:
+            import hashlib
+            import json as _json
+
+            checkpoint_manager.set_fingerprint(
+                hashlib.sha256(
+                    _json.dumps(
+                        {
+                            "algo": "KMeans.fit_stream",
+                            "k": k,
+                            "seed": self.get_seed(),
+                            "max_iter": self.get_max_iter(),
+                            "distance": self.get_distance_measure(),
+                            "rows": n,
+                            "dim": int(init.shape[1]),
+                        },
+                        sort_keys=True,
+                    ).encode()
+                ).hexdigest()[:16]
+            )
         partial = _partial_step(self.get_distance_measure(), k)
         data = ReplayableDataStreamList(replay={"points": cache})
         final_counts = np.zeros(k, np.float32)
@@ -247,12 +280,33 @@ class KMeans(
             final_counts = counts
             return IterationBodyResult([new], outputs=[new])
 
-        (centroids,) = iterate_bounded_until_termination(
+        outputs = iterate_bounded_until_termination(
             [init],
             body,
-            config=IterationConfig(max_epochs=self.get_max_iter()),
+            config=IterationConfig(
+                max_epochs=self.get_max_iter(),
+                checkpoint_manager=checkpoint_manager,
+                checkpoint_interval=checkpoint_interval,
+            ),
             data=data,
+            listeners=listeners,
         )
+        if outputs:
+            (centroids,) = outputs
+        else:
+            # Resumed at the terminal epoch: the body never ran, so the
+            # snapshot IS the final model; recompute assignment counts with
+            # the final centroids (one streamed pass, no centroid update).
+            _, (centroids,) = checkpoint_manager.restore_latest()
+            sums = np.zeros(k, np.float64)
+            c_dev = ctx.replicate(np.asarray(centroids, np.float32))
+            for chunk in rebatch(cache.iter_rows(), chunk_rows):
+                window = DeviceDataCache(
+                    {"x": np.asarray(chunk["features"], np.float32)}, ctx=ctx
+                )
+                _, counts = partial(c_dev, window["x"], window.mask)
+                sums += np.asarray(jax.device_get(counts), np.float64)
+            final_counts = sums
         model = KMeansModel()
         update_existing_params(model, self)
         model.centroids = np.asarray(centroids, np.float64)

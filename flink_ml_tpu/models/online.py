@@ -22,6 +22,7 @@ import numpy as np
 
 from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.faults import faults
 from flink_ml_tpu.iteration.stream import Batch, batch_stream_from_dataframe, rebatch
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.models.common import ModelArraysMixin
@@ -215,6 +216,13 @@ class SnapshotDriver:
         # writing the arrays twice per checkpoint.
         self._payload_from_state = payload_from_state
         self._to_skip = 0
+        # The in-flight mini-batch: pulled from the source but not yet
+        # committed as a model version. A retryable fault inside the step
+        # (collective abort, injected fault) must not lose it — a feedable
+        # source like QueueBatchStream cannot replay — so a supervised retry
+        # of __next__ redelivers it instead of pulling a fresh batch (the
+        # analogue of the reference snapshotting in-flight feedback records).
+        self._inflight: Optional[Batch] = None
         self.resumed = False
         self.restored_payload: Any = None
         if self._mgr is not None:
@@ -259,8 +267,13 @@ class SnapshotDriver:
                     "must replay the stream from the beginning"
                 ) from None
             self._to_skip -= 1
-        batch = next(self._stream)  # may raise StopIteration or StreamDry
+        if self._inflight is None:
+            # may raise StopIteration or StreamDry
+            self._inflight = next(self._stream)
+        batch = self._inflight
+        faults.trip("online.step", version=self.version + 1)
         self.state, payload = self._step(self.state, batch)
+        self._inflight = None  # committed: version counter owns it from here
         self.version += 1
         if self._mgr is not None and self.version % self._interval == 0:
             snap = {"state": self.state}

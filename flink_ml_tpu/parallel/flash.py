@@ -184,7 +184,7 @@ def _fold_pallas(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid, scale,
         (1, TQ_TILE, D), lambda i, j, *_: (i, j, 0), memory_space=pltpu.VMEM
     )
     full3 = pl.BlockSpec((1, Tk, D), lambda i, j, *_: (i, 0, 0), memory_space=pltpu.VMEM)
-    from flink_ml_tpu.parallel.mesh import vma_of
+    from flink_ml_tpu.parallel.mesh import shape_dtype_struct, vma_of
 
     vma = vma_of(q)
     mo, lo, ao = pl.pallas_call(
@@ -196,9 +196,9 @@ def _fold_pallas(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid, scale,
             out_specs=[tile2, tile2, tile3],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((BH, Tq, D), jnp.float32, vma=vma),
+            shape_dtype_struct((BH, Tq, 1), jnp.float32, vma=vma),
+            shape_dtype_struct((BH, Tq, 1), jnp.float32, vma=vma),
+            shape_dtype_struct((BH, Tq, D), jnp.float32, vma=vma),
         ],
         interpret=interpret,
     )(
@@ -313,7 +313,7 @@ def _fold_bwd_pallas(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    from flink_ml_tpu.parallel.mesh import vma_of
+    from flink_ml_tpu.parallel.mesh import shape_dtype_struct, vma_of
 
     B_, H, Tq, D = q.shape
     Tk = kb.shape[2]
@@ -453,7 +453,7 @@ def _fold_bwd_pallas(q, kb, vb, m, l, acc, q_pos0, k_pos0, causal, n_valid,
     fullk_mat = pl.BlockSpec((1, Tk, D), lambda i, j, *_: (i, 0, 0), memory_space=pltpu.VMEM)
 
     def sds(shape):
-        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
+        return shape_dtype_struct(shape, jnp.float32, vma=vma)
 
     q4 = q.reshape(BH, Tq, D)
     k4 = kb.reshape(BH, Tk, D)

@@ -186,6 +186,17 @@ def vma_of(x):
         return None
 
 
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` with the vma annotation when this jax supports
+    it (>= 0.6); older jaxlibs have no varying-axes tracking to annotate."""
+    import jax
+
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def get_mesh_context() -> MeshContext:
     """The process-global mesh; lazily created over all visible devices."""
     global _current

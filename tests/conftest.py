@@ -14,6 +14,36 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _xla_accepts(candidate_flags: str) -> bool:
+    """Whether this jaxlib's XLA parses ``candidate_flags``.
+
+    XLA hard-aborts the *process* (SIGABRT from parse_flags_from_env.cc) on
+    any unknown XLA_FLAGS entry, so support must be probed in a throwaway
+    subprocess — jaxlib builds differ in which xla_cpu_collective_call_*
+    flags exist, and an unsupported flag would otherwise kill the whole test
+    session before pytest prints a single line.
+    """
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=candidate_flags)
+    try:
+        return (
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                timeout=120,
+            ).returncode
+            == 0
+        )
+    except Exception:
+        return False
+
+
 if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
     # XLA CPU's collective rendezvous hard-aborts the PROCESS when a
     # participant misses it (8 SPMD participants on however few cores the
@@ -23,10 +53,13 @@ if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
     # avoided it — so keep the bound moderate: transient starvation under
     # 2 minutes survives, and a true deadlock aborts quickly enough for
     # the isolated-retry harness (test_attention_isolated.py) to retry.
-    _flags += (
+    # Only applied when this jaxlib's XLA knows the flags (see _xla_accepts).
+    _timeout_flags = (
         " --xla_cpu_collective_call_warn_stuck_timeout_seconds=30"
         " --xla_cpu_collective_call_terminate_timeout_seconds=120"
     )
+    if _xla_accepts(_flags + _timeout_flags):
+        _flags += _timeout_flags
 os.environ["XLA_FLAGS"] = _flags
 
 # The only place the deadlock has ever been observed (dozens of runs) is

@@ -1,0 +1,27 @@
+"""Tier-1 shim for ``tools/check_fault_points.py``.
+
+Every fault point registered in ``flink_ml_tpu.faults.FAULT_POINTS`` must
+have a runtime ``faults.trip`` call site AND a test exercising it — this test
+makes the tier-1 suite enforce that, so injection seams can't silently rot.
+"""
+import importlib.util
+import os
+
+_TOOL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+    "check_fault_points.py",
+)
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_fault_points", _TOOL)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_every_fault_point_is_tripped_and_tested():
+    problems, trip_sites = _load_tool().check()
+    assert not problems, "\n".join(problems)
+    assert trip_sites, "no fault points found at all — the registry is empty?"

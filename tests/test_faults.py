@@ -1,0 +1,164 @@
+"""Fault-injection framework tests (flink_ml_tpu/faults.py).
+
+The deterministic triggers (one-shot, seeded-probabilistic) and the spill /
+streaming seams. The end-to-end recovery tests that *use* these faults live in
+test_checkpoint.py / test_supervisor.py.
+"""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.faults import FAULT_POINTS, FaultInjector, InjectedFault, faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class TestTriggers:
+    def test_one_shot_fires_on_exactly_the_nth_hit(self):
+        inj = FaultInjector()
+        inj.arm("iteration.epoch", at=3)
+        inj.trip("iteration.epoch")
+        inj.trip("iteration.epoch")
+        with pytest.raises(InjectedFault) as e:
+            inj.trip("iteration.epoch")
+        assert e.value.point == "iteration.epoch"
+        assert e.value.hit == 3
+        # one-shot: disarmed after firing, later hits pass through
+        inj.trip("iteration.epoch")
+        assert inj.fires("iteration.epoch") == 1
+        assert inj.hits("iteration.epoch") == 4
+
+    def test_probabilistic_is_seed_deterministic(self):
+        def firing_pattern(seed):
+            inj = FaultInjector()
+            inj.arm("iteration.epoch", prob=0.3, seed=seed)
+            pattern = []
+            for _ in range(50):
+                try:
+                    inj.trip("iteration.epoch")
+                    pattern.append(False)
+                except InjectedFault:
+                    pattern.append(True)
+            return pattern
+
+        a, b = firing_pattern(7), firing_pattern(7)
+        assert a == b, "same seed must fire on the same hits"
+        assert any(a) and not all(a)
+        assert firing_pattern(8) != a, "a different seed gives a different pattern"
+
+    def test_arm_validates(self):
+        inj = FaultInjector()
+        with pytest.raises(LookupError, match="unknown fault point"):
+            inj.arm("no.such.point", at=1)
+        with pytest.raises(ValueError, match="exactly one"):
+            inj.arm("iteration.epoch")
+        with pytest.raises(ValueError, match="exactly one"):
+            inj.arm("iteration.epoch", at=1, prob=0.5)
+        with pytest.raises(ValueError, match="at must be"):
+            inj.arm("iteration.epoch", at=0)
+        with pytest.raises(ValueError, match="prob must be"):
+            inj.arm("iteration.epoch", prob=1.5)
+
+    def test_trip_on_unregistered_point_raises(self):
+        inj = FaultInjector()
+        inj._spec_loaded = True
+        with pytest.raises(LookupError, match="unregistered fault point"):
+            inj.trip("typo.point")
+
+    def test_reset_disarms_and_zeroes(self):
+        inj = FaultInjector()
+        inj.arm("checkpoint.save", at=1)
+        inj.reset()
+        inj.trip("checkpoint.save")  # does not fire
+        assert inj.fires("checkpoint.save") == 0
+
+
+class TestSpec:
+    def test_spec_string_arms_points(self):
+        inj = FaultInjector()
+        inj.load_spec("checkpoint.save:at=2; iteration.epoch:prob=0.5,seed=9")
+        assert inj.armed("checkpoint.save")
+        assert inj.armed("iteration.epoch")
+        inj.trip("checkpoint.save")
+        with pytest.raises(InjectedFault):
+            inj.trip("checkpoint.save")
+
+    def test_bare_point_means_first_hit(self):
+        inj = FaultInjector()
+        inj.load_spec("streaming.window")
+        with pytest.raises(InjectedFault):
+            inj.trip("streaming.window")
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown key"):
+            FaultInjector().load_spec("checkpoint.save:delay=3")
+
+    def test_spec_via_config_tier(self):
+        from flink_ml_tpu.config import Options, config
+
+        config.set(Options.FAULT_INJECTION, "checkpoint.save:at=1")
+        try:
+            inj = FaultInjector()
+            inj.load_spec()
+            assert inj.armed("checkpoint.save")
+        finally:
+            config.unset(Options.FAULT_INJECTION)
+
+
+class TestSeams:
+    """The spill/streaming seams raise InjectedFault where real I/O happens."""
+
+    def _spilling_cache(self, tmp_path):
+        from flink_ml_tpu.iteration.datacache import HostDataCache
+
+        # budget of 1 byte: every chunk past the first spills to disk
+        return HostDataCache(memory_budget_bytes=1, spill_dir=str(tmp_path / "spill"))
+
+    def test_datacache_spill_write_fault(self, tmp_path):
+        cache = self._spilling_cache(tmp_path)
+        cache.append({"x": np.ones((4, 2))})  # spills (over budget), unarmed
+        faults.arm("datacache.spill.write", at=1)
+        with pytest.raises(InjectedFault, match="datacache.spill.write"):
+            cache.append({"x": np.ones((4, 2))})
+
+    def test_datacache_spill_read_fault(self, tmp_path):
+        cache = self._spilling_cache(tmp_path)
+        cache.append({"x": np.arange(8.0).reshape(4, 2)})
+        cache.append({"x": np.arange(8.0).reshape(4, 2)})
+        cache.finish()
+        assert cache.rows(0, 8)["x"].shape == (8, 2)  # sanity: spill round-trips
+        faults.arm("datacache.spill.read", at=1)
+        with pytest.raises(InjectedFault, match="datacache.spill.read"):
+            cache.rows(0, 8)
+        # disarmed after the one-shot: the data is still there
+        assert cache.rows(0, 8)["x"].shape == (8, 2)
+
+    def test_streaming_window_fault(self):
+        from flink_ml_tpu.iteration.streaming import run_windows
+
+        class _Sched:
+            runs = [(0, np.zeros(1, np.int32)), (0, np.zeros(1, np.int32))]
+
+            @staticmethod
+            def padded(starts):
+                return starts, np.ones_like(starts), 1
+
+        class _Stream:
+            @staticmethod
+            def load(j):
+                return {}
+
+        dispatched = []
+        faults.arm("streaming.window", at=2)
+        with pytest.raises(InjectedFault, match="streaming.window"):
+            run_windows(_Stream(), _Sched(), lambda i, bufs, s, a, n: dispatched.append(i))
+        assert dispatched == [0], "the fault fired between run 0 and run 1"
+
+
+def test_registry_descriptions_nonempty():
+    for point, description in FAULT_POINTS.items():
+        assert description.strip(), point

@@ -1,0 +1,317 @@
+"""Supervised execution tests (flink_ml_tpu/execution/).
+
+Restart-strategy parity with Flink ``RestartStrategies``, the retryable/fatal
+error classifier, and ``Supervisor.run``/``run_stream`` semantics driven
+through the deterministic fault-injection points. The train-to-identical-result
+recovery-equivalence tests live in test_checkpoint.py.
+"""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointManager,
+    FingerprintMismatchError,
+)
+from flink_ml_tpu.execution import (
+    ErrorClassifier,
+    ExponentialBackoffRestartStrategy,
+    FailureKind,
+    FailureRateRestartStrategy,
+    FixedDelayRestartStrategy,
+    NoRestartStrategy,
+    RestartStrategies,
+    RestartsExhaustedError,
+    Supervisor,
+)
+from flink_ml_tpu.faults import InjectedFault, faults
+from flink_ml_tpu.metrics import MLMetrics, metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _fast_supervisor(strategy, **kw):
+    """A supervisor with time injected out (no real sleeping in tests)."""
+    kw.setdefault("clock", lambda: 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    return Supervisor(strategy, **kw)
+
+
+class TestRestartStrategies:
+    def test_no_restart(self):
+        assert NoRestartStrategy().next_restart(0.0) is None
+
+    def test_fixed_delay_budget(self):
+        s = FixedDelayRestartStrategy(2, delay_s=1.5)
+        assert s.next_restart(0.0) == 1.5
+        assert s.next_restart(1.0) == 1.5
+        assert s.next_restart(2.0) is None  # budget spent
+        s.reset()
+        assert s.next_restart(3.0) == 1.5
+
+    def test_exponential_backoff_sequence_and_cap(self):
+        s = ExponentialBackoffRestartStrategy(
+            initial_delay_s=1.0, max_delay_s=5.0, backoff_multiplier=2.0
+        )
+        assert [s.next_restart(float(t)) for t in range(4)] == [1.0, 2.0, 4.0, 5.0]
+
+    def test_exponential_backoff_resets_after_clean_stretch(self):
+        s = ExponentialBackoffRestartStrategy(
+            initial_delay_s=1.0, max_delay_s=60.0, reset_threshold_s=10.0
+        )
+        assert s.next_restart(0.0) == 1.0
+        assert s.next_restart(1.0) == 2.0
+        s.record_success(5.0)  # only 4s clean: no reset
+        assert s.next_restart(6.0) == 4.0
+        s.record_success(20.0)  # 14s clean since last failure: reset
+        assert s.next_restart(21.0) == 1.0
+
+    def test_exponential_backoff_jitter_is_seeded(self):
+        def delays(seed):
+            s = ExponentialBackoffRestartStrategy(1.0, 64.0, jitter_factor=0.5, seed=seed)
+            return [s.next_restart(0.0) for _ in range(5)]
+
+        assert delays(3) == delays(3)
+        for d, base in zip(delays(3), [1.0, 2.0, 4.0, 8.0, 16.0]):
+            assert base * 0.5 <= d <= base * 1.5
+
+    def test_exponential_backoff_max_restarts(self):
+        s = ExponentialBackoffRestartStrategy(0.0, 1.0, max_restarts=1)
+        assert s.next_restart(0.0) is not None
+        assert s.next_restart(1.0) is None
+
+    def test_failure_rate_window(self):
+        s = FailureRateRestartStrategy(2, interval_s=10.0, delay_s=0.5)
+        assert s.next_restart(0.0) == 0.5
+        assert s.next_restart(1.0) == 0.5
+        assert s.next_restart(2.0) is None  # 3 failures within 10s
+        s.reset()
+        assert s.next_restart(100.0) == 0.5
+        # failures spread wider than the window never exhaust the budget
+        assert s.next_restart(111.0) == 0.5
+        assert s.next_restart(122.0) == 0.5
+
+    def test_factory_parity(self):
+        assert isinstance(RestartStrategies.no_restart(), NoRestartStrategy)
+        assert isinstance(RestartStrategies.fixed_delay_restart(3, 1.0), FixedDelayRestartStrategy)
+        assert isinstance(
+            RestartStrategies.exponential_delay_restart(), ExponentialBackoffRestartStrategy
+        )
+        assert isinstance(
+            RestartStrategies.failure_rate_restart(3, 60.0), FailureRateRestartStrategy
+        )
+
+
+class TestErrorClassifier:
+    def test_builtin_rules(self, tmp_path):
+        c = ErrorClassifier()
+        retryable = [
+            InjectedFault("iteration.epoch", 1),
+            OSError("disk gone"),
+            FileNotFoundError("spill file missing"),
+            CheckpointCorruptError(3, str(tmp_path), "crc mismatch"),
+            RuntimeError("all-reduce rendezvous timed out"),
+            RuntimeError("DEADLINE_EXCEEDED: collective permute"),
+        ]
+        fatal = [
+            FingerprintMismatchError("different run"),
+            ValueError("shapes (3,) and (4,) not aligned"),
+            TypeError("dtype float32 expected"),
+            RuntimeError("some deterministic bug"),
+        ]
+        for e in retryable:
+            assert c.classify(e) is FailureKind.RETRYABLE, e
+        for e in fatal:
+            assert c.classify(e) is FailureKind.FATAL, e
+
+    def test_extra_types_override(self):
+        class DeploymentBlip(Exception):
+            pass
+
+        c = ErrorClassifier(extra_retryable=[DeploymentBlip], extra_fatal=[OSError])
+        assert c.is_retryable(DeploymentBlip())
+        assert c.classify(OSError("now fatal")) is FailureKind.FATAL
+
+
+class TestSupervisorRun:
+    def test_flaky_fn_recovers_and_counts(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise InjectedFault("iteration.epoch", len(calls))
+            return "done"
+
+        sup = _fast_supervisor(FixedDelayRestartStrategy(5, 0.0), name="t-flaky")
+        assert sup.run(flaky) == "done"
+        assert sup.attempts == 3 and sup.restarts == 2
+        scope = sup.metric_scope
+        assert metrics.get(scope, MLMetrics.NUM_ATTEMPTS) == 3
+        assert metrics.get(scope, MLMetrics.NUM_RESTARTS) == 2
+        assert metrics.get(scope, MLMetrics.RECOVERY_MS) is not None
+
+    def test_fatal_raises_immediately(self):
+        calls = []
+
+        def fatal():
+            calls.append(1)
+            raise ValueError("shape mismatch")
+
+        sup = _fast_supervisor(FixedDelayRestartStrategy(5, 0.0), name="t-fatal")
+        with pytest.raises(ValueError, match="shape mismatch"):
+            sup.run(fatal)
+        assert len(calls) == 1, "fatal failures must not consume restart budget"
+        assert metrics.get(sup.metric_scope, MLMetrics.NUM_FATAL) == 1
+
+    def test_budget_exhaustion_chains_restarts_exhausted(self):
+        def always_fails():
+            raise InjectedFault("iteration.epoch", 1)
+
+        sup = _fast_supervisor(FixedDelayRestartStrategy(2, 0.0), name="t-exhaust")
+        with pytest.raises(InjectedFault) as e:
+            sup.run(always_fails)
+        assert isinstance(e.value.__cause__, RestartsExhaustedError)
+        assert len(e.value.__cause__.failures) == 3  # initial + 2 retries
+        assert sup.attempts == 3
+
+    def test_sleeps_the_strategy_delay(self):
+        slept = []
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise InjectedFault("iteration.epoch", 1)
+            return 42
+
+        sup = Supervisor(
+            FixedDelayRestartStrategy(1, 2.5),
+            name="t-sleep",
+            clock=lambda: 0.0,
+            sleep=slept.append,
+        )
+        assert sup.run(flaky) == 42
+        assert slept == [2.5]
+
+    def test_failure_rate_exhaustion_through_fault_injection(self):
+        """A crash-looping job exhausts the FailureRate budget: every epoch
+        faults (prob=1.0), failures land back-to-back inside the interval."""
+        from flink_ml_tpu.iteration import (
+            IterationBodyResult,
+            IterationConfig,
+            iterate_bounded_until_termination,
+        )
+
+        def body(variables, epoch):
+            (x,) = variables
+            return IterationBodyResult([x + 1.0], outputs=[x])
+
+        def job():
+            return iterate_bounded_until_termination(
+                [np.asarray(0.0)], body, config=IterationConfig(max_epochs=5)
+            )
+
+        faults.arm("iteration.epoch", prob=1.0, seed=0)
+        t = iter(np.arange(0.0, 100.0, 0.5))
+        sup = Supervisor(
+            FailureRateRestartStrategy(3, interval_s=60.0, delay_s=0.0),
+            name="t-rate",
+            clock=lambda: float(next(t)),
+            sleep=lambda s: None,
+        )
+        with pytest.raises(InjectedFault) as e:
+            sup.run(job)
+        assert isinstance(e.value.__cause__, RestartsExhaustedError)
+        assert sup.attempts == 4  # initial + 3 allowed restarts, then exhausted
+
+
+class TestSupervisorStream:
+    def test_run_stream_resumes_unbounded_iteration(self, tmp_path):
+        """iterate_unbounded under the supervisor: an injected epoch fault
+        kills the generator; the restarted attempt restores the (epoch,
+        variables) snapshot, skips the replayed source, and the caller sees
+        every output exactly once (checkpoint_interval=1)."""
+        from flink_ml_tpu.iteration import (
+            IterationBodyResult,
+            IterationConfig,
+            iterate_unbounded,
+        )
+
+        batches = [np.asarray(float(i)) for i in range(6)]
+
+        def body(variables, batch, epoch):
+            (acc,) = variables
+            acc = acc + batch
+            return IterationBodyResult([acc], outputs=[float(acc)])
+
+        def factory():
+            mgr = CheckpointManager(str(tmp_path / "ub"))
+            config = IterationConfig(checkpoint_interval=1, checkpoint_manager=mgr)
+            return iterate_unbounded([np.asarray(0.0)], iter(batches), body, config=config)
+
+        faults.arm("iteration.epoch", at=4)  # dies before epoch 3's body
+        sup = _fast_supervisor(FixedDelayRestartStrategy(2, 0.0), name="t-stream")
+        outputs = list(sup.run_stream(factory))
+        assert sup.restarts == 1
+        assert outputs == [0.0, 1.0, 3.0, 6.0, 10.0, 15.0], "exactly-once outputs"
+
+
+class TestOnlineInflightReplay:
+    """The online.step seam: a fault after the batch left the queue must not
+    lose it — the SnapshotDriver redelivers the in-flight mini-batch on the
+    supervised retry (the in-flight feedback-record snapshot analogue)."""
+
+    def _est(self, mgr=None):
+        from flink_ml_tpu.api.dataframe import DataFrame
+        from flink_ml_tpu.models.classification.online_logistic_regression import (
+            OnlineLogisticRegression,
+        )
+
+        init = DataFrame.from_dict(
+            {"coefficient": np.zeros((1, 2)), "modelVersion": np.asarray([0])}
+        )
+        est = OnlineLogisticRegression().set_initial_model_data(init).set_global_batch_size(8)
+        if mgr is not None:
+            est.set_checkpoint(mgr, 1)
+        return est
+
+    def _batches(self, n=4):
+        rng = np.random.default_rng(11)
+        out = []
+        for _ in range(n):
+            X = rng.normal(size=(8, 2))
+            out.append({"features": X, "label": (X[:, 0] > 0).astype(np.float64)})
+        return out
+
+    def test_online_step_fault_redelivers_inflight_batch(self, tmp_path):
+        from flink_ml_tpu.models.online import QueueBatchStream
+
+        batches = self._batches(4)
+
+        def feed():
+            s = QueueBatchStream()
+            for b in batches:
+                s.add(b)
+            return s.close()
+
+        clean = self._est().fit(feed())
+        clean.advance()
+        assert clean.model_version == 4
+
+        mgr = CheckpointManager(str(tmp_path / "olr"))
+        model = self._est(mgr).fit(feed())
+        faults.arm("online.step", at=3)  # after batch 3 left the queue
+        sup = _fast_supervisor(FixedDelayRestartStrategy(2, 0.0), name="t-online")
+        applied = sup.run(model.advance)
+        assert sup.restarts == 1
+        # attempt 1 applied versions 1-2 then died on the in-flight batch 3;
+        # the retried advance() redelivered it and applied versions 3-4.
+        assert applied == 2
+        assert model.model_version == 4, "the in-flight batch was replayed, not lost"
+        np.testing.assert_array_equal(model.coefficient, clean.coefficient)
