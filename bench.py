@@ -1072,6 +1072,100 @@ def bench_kmeans(peak_gbps):
     return out
 
 
+def bench_serving():
+    """Offered-load sweep over the online serving runtime (docs/serving.md).
+
+    Request sizes 1/8/64 rows — the bucket shapes the micro-batcher pads to —
+    each driven from 4 client threads at saturation against a d=256 logistic
+    servable (the BASELINE.json CTR shape). Reports throughput (rows/s
+    through the full queue→batch→pad→transform→slice path) and p50/p99
+    request latency scraped from the server's own ``ml.serving.*`` histogram,
+    so BENCH rounds track the serving pillar with the same metrics a
+    deployment would alert on. Warmup happens once per bucket at server
+    construction (the hot-swap warm path), so compiles never land in the
+    timed window — the same discipline as every other workload here.
+    """
+    import threading
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.servable.lib import LogisticRegressionModelServable
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    rng = np.random.default_rng(5)
+    dim = 256
+    X = rng.standard_normal((4096, dim)).astype(np.float32)
+    servable = LogisticRegressionModelServable()
+    servable.coefficient = rng.standard_normal(dim).astype(np.float32)
+
+    n_threads = 4
+    requests_per_thread = 150
+    sweep = []
+    for req_rows in (1, 8, 64):
+        name = f"bench-load-{req_rows}"
+        server = InferenceServer(
+            servable,
+            name=name,
+            serving_config=ServingConfig(
+                max_batch_size=64,
+                max_delay_ms=1.0,
+                queue_capacity_rows=8192,
+                default_timeout_ms=120_000,
+            ),
+            warmup_template=DataFrame.from_dict({"features": X[:1]}),
+        )
+        try:
+            barrier = threading.Barrier(n_threads + 1)
+
+            def client(tid, req_rows=req_rows):
+                barrier.wait()
+                for i in range(requests_per_thread):
+                    j = (tid * 997 + i * 61) % (X.shape[0] - req_rows)
+                    server.predict(
+                        DataFrame.from_dict({"features": X[j : j + req_rows]})
+                    )
+
+            threads = [
+                threading.Thread(target=client, args=(t,)) for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            scraped = metrics.scope(server.scope)
+            lat = scraped[MLMetrics.SERVING_LATENCY_MS]
+            total_rows = n_threads * requests_per_thread * req_rows
+            batches = scraped[MLMetrics.SERVING_BATCHES]
+            sweep.append(
+                {
+                    "request_rows": req_rows,
+                    "rows_per_sec": round(total_rows / elapsed, 1),
+                    "requests_per_sec": round(
+                        n_threads * requests_per_thread / elapsed, 1
+                    ),
+                    "latency_p50_ms": round(lat.quantile(0.5), 3),
+                    "latency_p99_ms": round(lat.quantile(0.99), 3),
+                    "mean_batch_rows": round(total_rows / batches, 1),
+                    "batches": batches,
+                }
+            )
+        finally:
+            server.close()
+    return {
+        "name": "serving_microbatch_lr_d256",
+        "threads": n_threads,
+        "requests_per_thread": requests_per_thread,
+        "max_batch_size": 64,
+        "sweep": sweep,
+        "note": "end-to-end serving path (queue + micro-batch + pad + jit'd "
+        "transform + slice); latency is enqueue->response per request from "
+        "the ml.serving latency histogram",
+    }
+
+
 def bench_mlp_forward(peak_flops):
     import jax
     import jax.numpy as jnp
@@ -1133,6 +1227,7 @@ def main() -> None:
     mlp_train = bench_mlp_train(peak)
     attention = bench_attention(peak)
     attention_train = bench_attention_train(peak)
+    serving = bench_serving()
 
     detail = {
         "device_kind": kind,
@@ -1140,7 +1235,7 @@ def main() -> None:
         "peak_hbm_gbps": peak_bw,
         "workloads": [
             logreg, sparse, sweep, sparse_streamed, overlap, kmeans, mlp,
-            mlp_train, attention, attention_train,
+            mlp_train, attention, attention_train, serving,
         ],
     }
     with open("BENCH_DETAIL.json", "w") as f:

@@ -1,0 +1,58 @@
+"""Online serving: micro-batched concurrent inference with a hot model swap.
+
+Trains two LogisticRegression versions, publishes them to a model directory,
+and serves concurrent single-row traffic through an InferenceServer while the
+ModelVersionPoller swaps v2 in mid-run — the train → publish → serve loop of
+docs/serving.md in one script.
+"""
+import tempfile
+import threading
+
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.models.classification.logistic_regression import LogisticRegression
+from flink_ml_tpu.serving import InferenceServer, ServingConfig, publish_servable
+
+rng = np.random.default_rng(42)
+X = rng.normal(size=(512, 8))
+y = (X @ np.linspace(1.0, -1.0, 8) > 0).astype(np.float64)
+train = DataFrame.from_dict({"features": X, "label": y})
+
+v1 = LogisticRegression().set_max_iter(5).set_global_batch_size(512).fit(train)
+v2 = LogisticRegression().set_max_iter(40).set_global_batch_size(512).fit(train)
+
+with tempfile.TemporaryDirectory() as model_dir:
+    publish_servable(v1, model_dir)  # -> v-1
+    server = InferenceServer(
+        name="example",
+        serving_config=ServingConfig(max_batch_size=16, max_delay_ms=2),
+        warmup_template=DataFrame.from_dict({"features": X[:1]}),
+    )
+    poller = server.attach_poller(model_dir, start=False)
+    poller.poll_once()
+
+    versions_seen = []
+    lock = threading.Lock()
+
+    def client(tid):
+        for i in range(25):
+            j = (tid * 41 + i) % 512
+            resp = server.predict(DataFrame.from_dict({"features": X[j : j + 1]}))
+            with lock:
+                versions_seen.append(resp.model_version)
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+
+    publish_servable(v2, model_dir)  # -> v-2, mid-traffic
+    poller.poll_once()  # hot swap: warm every bucket, then atomic flip
+
+    for t in threads:
+        t.join()
+    server.close()
+
+print(f"served {len(versions_seen)} requests across versions {sorted(set(versions_seen))}")
+print(f"final serving version: {server.model_version}")
+assert server.model_version == 2

@@ -168,6 +168,34 @@ class DataFrame:
         names = list(data.keys())
         return DataFrame(names, None, [data[n] for n in names])
 
+    @staticmethod
+    def concat(frames: Sequence["DataFrame"]) -> "DataFrame":
+        """Row-concatenate DataFrames with identical schemas (column order and
+        names must match; types are taken from the first frame). The serving
+        micro-batcher's coalescing primitive, also behind ``serve_pending``."""
+        if not frames:
+            raise ValueError("concat of zero DataFrames")
+        first = frames[0]
+        if len(frames) == 1:
+            return first.clone()
+        names = first.get_column_names()
+        for f in frames[1:]:
+            if f.get_column_names() != names:
+                raise ValueError(
+                    f"schema mismatch in concat: {f.get_column_names()} != {names}"
+                )
+        cols: List[Column] = []
+        for name in names:
+            parts = [f.column(name) for f in frames]
+            if all(isinstance(p, np.ndarray) for p in parts):
+                cols.append(np.concatenate(parts))
+            else:
+                merged: list = []
+                for p in parts:
+                    merged.extend(p if isinstance(p, list) else list(p))
+                cols.append(merged)
+        return DataFrame(names, first.get_data_types(), cols)
+
     # --- schema --------------------------------------------------------------
     def get_column_names(self) -> List[str]:
         return list(self._names)

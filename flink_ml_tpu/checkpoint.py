@@ -44,10 +44,39 @@ __all__ = [
     "CheckpointManager",
     "CheckpointCorruptError",
     "FingerprintMismatchError",
+    "scan_numbered_dirs",
 ]
 
 _STEP_PREFIX = "ckpt-"
 _CORRUPT_SUFFIX = ".corrupt"
+
+
+def scan_numbered_dirs(directory: str, prefix: str = _STEP_PREFIX,
+                       marker_file: str = "META.json") -> List[int]:
+    """Numbers of the (apparently) complete ``<prefix><int>`` dirs, ascending.
+
+    The hardened listing contract shared by checkpoint restore and the serving
+    ``ModelVersionPoller``: anything whose name does not parse as
+    ``<prefix><int>`` — quarantined ``.corrupt`` dirs, in-flight ``.tmp`` dirs,
+    stray files — is skipped rather than crashing the listing, and a dir
+    missing its ``marker_file`` (written last in every atomic-publish protocol
+    here) is treated as incomplete.
+    """
+    numbers = []
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    for name in names:
+        if not name.startswith(prefix):
+            continue
+        try:
+            number = int(name[len(prefix):])
+        except ValueError:
+            continue
+        if os.path.exists(os.path.join(directory, name, marker_file)):
+            numbers.append(number)
+    return sorted(numbers)
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -185,19 +214,9 @@ class CheckpointManager:
 
         Anything whose name does not parse as ``ckpt-<int>`` — quarantined
         ``ckpt-N.corrupt`` dirs, in-flight ``.tmp`` dirs, stray files — is
-        skipped rather than crashing the listing.
+        skipped rather than crashing the listing (``scan_numbered_dirs``).
         """
-        steps = []
-        for name in os.listdir(self.directory):
-            if not name.startswith(_STEP_PREFIX):
-                continue
-            try:
-                step = int(name[len(_STEP_PREFIX):])
-            except ValueError:
-                continue
-            if os.path.exists(os.path.join(self.directory, name, "META.json")):
-                steps.append(step)
-        return sorted(steps)
+        return scan_numbered_dirs(self.directory)
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"{_STEP_PREFIX}{step}")

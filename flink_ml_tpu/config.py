@@ -100,6 +100,41 @@ class Options:
         "'checkpoint.save:at=2;iteration.epoch:prob=0.05,seed=7' "
         "(see flink_ml_tpu.faults). Default: no faults armed.",
     )
+    SERVING_MAX_BATCH_SIZE = ConfigOption(
+        "serving.max.batch.size",
+        int,
+        64,
+        "Largest micro-batch (rows) the serving batcher coalesces — also the "
+        "largest padded bucket, so it bounds the jit-compiled shape set.",
+    )
+    SERVING_MAX_DELAY_MS = ConfigOption(
+        "serving.max.delay.ms",
+        float,
+        2.0,
+        "How long the micro-batcher may hold the first queued request while "
+        "coalescing more (the batching-latency budget).",
+    )
+    SERVING_QUEUE_CAPACITY_ROWS = ConfigOption(
+        "serving.queue.capacity.rows",
+        int,
+        1024,
+        "Admission-control bound: rows that may wait in the serving queue "
+        "before new requests are rejected with ServingOverloadedError.",
+    )
+    SERVING_DEFAULT_TIMEOUT_MS = ConfigOption(
+        "serving.default.timeout.ms",
+        float,
+        10_000.0,
+        "Per-request deadline when the caller does not pass one; a request "
+        "not completed by its deadline raises ServingDeadlineError.",
+    )
+    SERVING_POLL_INTERVAL_MS = ConfigOption(
+        "serving.poll.interval.ms",
+        float,
+        1000.0,
+        "How often ModelVersionPoller re-scans the model directory for a "
+        "newer published version.",
+    )
     NATIVE_DATACACHE_ENABLED = ConfigOption(
         "native.datacache.enabled",
         _parse_bool,

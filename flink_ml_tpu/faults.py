@@ -71,6 +71,12 @@ FAULT_POINTS: Dict[str, str] = {
         "unbounded fit after the mini-batch was pulled but before the model "
         "version commits; recovery must replay the in-flight batch."
     ),
+    "serving.swap": (
+        "Model-version load inside the serving hot-swap path "
+        "(serving/registry.py ModelVersionPoller) — a bad published version "
+        "must be skipped with a fallback to the newest older intact one, and "
+        "the in-service model must keep serving untouched."
+    ),
 }
 
 

@@ -12,9 +12,9 @@ mirror the gauges to any sink.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
-__all__ = ["MLMetrics", "MetricsRegistry", "metrics"]
+__all__ = ["MLMetrics", "Histogram", "MetricsRegistry", "metrics"]
 
 
 class MLMetrics:
@@ -40,6 +40,75 @@ class MLMetrics:
     CHECKPOINT_FALLBACKS = "ml.checkpoint.fallbacks"
     CHECKPOINT_TMP_SWEPT = "ml.checkpoint.tmp.swept"
 
+    # Online-serving runtime (scope = "ml.serving[<server name>]" — see
+    # docs/serving.md for the full table).
+    SERVING_GROUP = "ml.serving"
+    SERVING_QUEUE_DEPTH = "ml.serving.queue.depth"  # rows waiting, gauge
+    SERVING_REQUESTS = "ml.serving.requests"  # admitted, counter
+    SERVING_BATCHES = "ml.serving.batches"  # executed batches, counter
+    SERVING_REJECTED = "ml.serving.rejected"  # ServingOverloadedError, counter
+    SERVING_TIMEOUTS = "ml.serving.timeouts"  # deadline expiries, counter
+    SERVING_SWAPS = "ml.serving.swaps"  # hot model swaps, counter
+    SERVING_SWAP_FAILURES = "ml.serving.swap.failures"  # rejected versions, counter
+    SERVING_BATCH_SIZE = "ml.serving.batch.size"  # pre-padding rows, histogram
+    SERVING_LATENCY_MS = "ml.serving.latency.ms"  # enqueue→response, histogram
+    SERVING_LATENCY_P50_MS = "ml.serving.latency.p50.ms"  # gauge from histogram
+    SERVING_LATENCY_P99_MS = "ml.serving.latency.p99.ms"  # gauge from histogram
+
+
+class Histogram:
+    """Bounded-window observation histogram (the DescriptiveStatisticsHistogram
+    role of Flink's metric system): keeps the last ``window`` observations and
+    answers quantiles over them. Thread-safe; cheap enough for per-request use."""
+
+    def __init__(self, window: int = 4096):
+        self._window = int(window)
+        self._values: List[float] = []
+        self._pos = 0
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            if len(self._values) < self._window:
+                self._values.append(value)
+            else:  # ring overwrite: oldest observation drops out
+                self._values[self._pos] = value
+                self._pos = (self._pos + 1) % self._window
+            self._count += 1
+            self._sum += value
+
+    @property
+    def count(self) -> int:
+        """Total observations ever (not just those still in the window)."""
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Nearest-rank quantile over the retained window; None when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if not self._values:
+                return None
+            ordered = sorted(self._values)
+        return ordered[min(int(q * len(ordered)), len(ordered) - 1)]
+
+    def values(self) -> List[float]:
+        """The retained observations (unordered), for test scraping."""
+        with self._lock:
+            return list(self._values)
+
+    def __repr__(self) -> str:
+        return f"Histogram(count={self.count}, p50={self.quantile(0.5)})"
+
 
 class MetricsRegistry:
     """Named gauges per scope (scope ≈ the operator's metric group)."""
@@ -59,6 +128,21 @@ class MetricsRegistry:
             group = self._gauges.setdefault(scope, {})
             group[name] = int(group.get(name, 0)) + inc
             return group[name]
+
+    def histogram(self, scope: str, name: str, window: int = 4096) -> Histogram:
+        """Get-or-create the named Histogram (scraped via ``get`` like any
+        gauge — the stored value IS the Histogram object)."""
+        with self._lock:
+            group = self._gauges.setdefault(scope, {})
+            hist = group.get(name)
+            if not isinstance(hist, Histogram):
+                hist = Histogram(window)
+                group[name] = hist
+            return hist
+
+    def observe(self, scope: str, name: str, value: float) -> None:
+        """Record one observation into the named histogram."""
+        self.histogram(scope, name).observe(value)
 
     def get(self, scope: str, name: str, default: Any = None) -> Any:
         with self._lock:

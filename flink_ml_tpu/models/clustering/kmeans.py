@@ -31,29 +31,18 @@ from flink_ml_tpu.api.types import DataTypes
 from flink_ml_tpu.iteration import DeviceDataCache
 from flink_ml_tpu.models.common import ModelArraysMixin
 from flink_ml_tpu.ops.distance import DistanceMeasure
-from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam, WithParams, update_existing_params
+from flink_ml_tpu.params.param import ParamValidators, StringParam, update_existing_params
 from flink_ml_tpu.params.shared import (
     HasDistanceMeasure,
     HasFeaturesCol,
+    HasK,
     HasMaxIter,
     HasPredictionCol,
     HasSeed,
 )
 from flink_ml_tpu.parallel.mesh import get_mesh_context
 
-__all__ = ["KMeans", "KMeansModel"]
-
-
-class HasK(WithParams):
-    """Ref KMeansModelParams.K — number of clusters, default 2."""
-
-    K = IntParam("k", "The max number of clusters to create.", 2, ParamValidators.gt(1))
-
-    def get_k(self) -> int:
-        return self.get(self.K)
-
-    def set_k(self, value: int):
-        return self.set(self.K, value)
+__all__ = ["KMeans", "KMeansModel", "HasK"]
 
 
 def _assign_partials(measure, k: int, centroids, X, mask):
@@ -114,10 +103,9 @@ def _train_loop(measure_name: str, k: int, n_epochs: int):
     return loop
 
 
-@functools.cache
-def _predict_step(measure_name: str):
-    measure = DistanceMeasure.get_instance(measure_name)
-    return jax.jit(lambda X, centroids: measure.find_closest(X, centroids))
+# Shared with OnlineKMeansModel and the runtime-free KMeansModelServable —
+# one jit cache entry per distance measure across all three surfaces.
+from flink_ml_tpu.ops.kernels import kmeans_predict_kernel as _predict_step
 
 
 class KMeansModel(ModelArraysMixin, Model, HasFeaturesCol, HasPredictionCol, HasDistanceMeasure, HasK):
@@ -129,6 +117,14 @@ class KMeansModel(ModelArraysMixin, Model, HasFeaturesCol, HasPredictionCol, Has
         super().__init__()
         self.centroids = None  # [k, d]
         self.weights = None  # [k]
+
+    @classmethod
+    def load_servable(cls, path: str):
+        """Runtime-free replica from this model's save dir (ref the
+        LogisticRegressionModel → LogisticRegressionModelServable pairing)."""
+        from flink_ml_tpu.servable.lib import KMeansModelServable
+
+        return KMeansModelServable.load_servable(path)
 
     def transform(self, *inputs):
         (df,) = inputs

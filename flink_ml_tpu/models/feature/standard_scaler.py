@@ -111,18 +111,9 @@ def _stats_kernel():
     return stats
 
 
-@functools.cache
-def _transform_kernel(with_mean: bool, with_std: bool):
-    @jax.jit
-    def kernel(X, mean, inv_std):
-        out = X
-        if with_mean:
-            out = out - mean[None, :]
-        if with_std:
-            out = out * inv_std[None, :]
-        return out
-
-    return kernel
+# Shared with the runtime-free StandardScalerModelServable — one jit cache
+# entry per (with_mean, with_std) across the batch, online and serving paths.
+from flink_ml_tpu.ops.kernels import scale_kernel as _transform_kernel
 
 
 class _ScalerTransformMixin(_ScalerParams):
@@ -159,6 +150,14 @@ class StandardScalerModel(ModelArraysMixin, Model, _ScalerTransformMixin):
         self.mean: Optional[np.ndarray] = None
         self.std: Optional[np.ndarray] = None
 
+    @classmethod
+    def load_servable(cls, path: str):
+        """Runtime-free replica from this model's save dir (ref the
+        LogisticRegressionModel → LogisticRegressionModelServable pairing)."""
+        from flink_ml_tpu.servable.lib import StandardScalerModelServable
+
+        return StandardScalerModelServable.load_servable(path)
+
     def transform(self, *inputs):
         (df,) = inputs
         return self._transform_df(df)
@@ -188,22 +187,8 @@ class StandardScaler(Estimator, _ScalerParams):
 
 
 def _concat_frames(frames):
-    """Row-concatenate DataFrames with identical schemas."""
-    first = frames[0]
-    if len(frames) == 1:
-        return first
-    names = first.get_column_names()
-    cols = []
-    for name in names:
-        parts = [f.column(name) for f in frames]
-        if isinstance(parts[0], np.ndarray):
-            cols.append(np.concatenate(parts))
-        else:
-            merged: list = []
-            for p in parts:
-                merged.extend(p)
-            cols.append(merged)
-    return DataFrame(names, first.get_data_types(), cols)
+    """Row-concatenate DataFrames with identical schemas (DataFrame.concat)."""
+    return frames[0] if len(frames) == 1 else DataFrame.concat(frames)
 
 
 class OnlineStandardScalerModel(

@@ -31,33 +31,11 @@ from flink_ml_tpu.params.shared import (
     HasWeightCol,
 )
 
+from flink_ml_tpu.ops.kernels import compute_dots  # canonical home: ops/kernels.py
+# (re-exported here for backward compatibility — the servable tier must reach
+# it without importing models/, the L1 "runtime-free" guarantee)
+
 __all__ = ["LinearEstimatorBase", "LinearModelBase", "compute_dots"]
-
-
-def compute_dots(df, features_col: str, coefficient) -> np.ndarray:
-    """Margins ``x·coef`` for a DataFrame features column, dense or sparse.
-
-    Sparse columns stay in the padded-CSR layout end-to-end (gather + row-sum
-    kernel) — a Criteo-width transform never materializes an [n, d] array.
-    Shared by every linear-family transform so the two layouts cannot produce
-    different margins.
-    """
-    import jax.numpy as jnp
-
-    from flink_ml_tpu.ops.kernels import dot_kernel, sparse_dot_kernel
-
-    coef = jnp.asarray(np.asarray(coefficient), jnp.float32)
-    if df.is_sparse(features_col):
-        batch = df.sparse_batch(features_col)
-        if batch.dim != coef.shape[0]:
-            raise ValueError(
-                f"features dim {batch.dim} != model dim {coef.shape[0]}"
-            )
-        return sparse_dot_kernel()(
-            jnp.asarray(batch.indices), jnp.asarray(batch.values), coef
-        )
-    X = df.vectors(features_col).astype(np.float32)
-    return dot_kernel()(X, coef)
 
 
 class LinearModelBase(ModelArraysMixin, Model, HasFeaturesCol, HasPredictionCol):

@@ -10,12 +10,16 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = [
     "dot_kernel",
     "sparse_dot_kernel",
     "logistic_from_dots_kernel",
     "logistic_predict_kernel",
+    "compute_dots",
+    "kmeans_predict_kernel",
+    "scale_kernel",
 ]
 
 
@@ -67,5 +71,58 @@ def logistic_predict_kernel():
     @jax.jit
     def kernel(X, coef):
         return logistic_from_dots_kernel()(X @ coef)
+
+    return kernel
+
+
+def compute_dots(df, features_col: str, coefficient) -> np.ndarray:
+    """Margins ``x·coef`` for a DataFrame features column, dense or sparse.
+
+    Sparse columns stay in the padded-CSR layout end-to-end (gather + row-sum
+    kernel) — a Criteo-width transform never materializes an [n, d] array.
+    Shared by every linear-family transform — training-side Models AND the
+    runtime-free servables — so the two layouts (and the two surfaces) cannot
+    produce different margins. Lives here (not models/) because the servable
+    tier must stay importable without the training stack.
+    """
+    coef = jnp.asarray(np.asarray(coefficient), jnp.float32)
+    if df.is_sparse(features_col):
+        batch = df.sparse_batch(features_col)
+        if batch.dim != coef.shape[0]:
+            raise ValueError(
+                f"features dim {batch.dim} != model dim {coef.shape[0]}"
+            )
+        return sparse_dot_kernel()(
+            jnp.asarray(batch.indices), jnp.asarray(batch.values), coef
+        )
+    X = df.vectors(features_col).astype(np.float32)
+    return dot_kernel()(X, coef)
+
+
+@functools.cache
+def kmeans_predict_kernel(measure_name: str):
+    """Closest-centroid assignment (ref KMeansModel.java predict). One cache
+    entry per distance measure, shared by KMeansModel, OnlineKMeansModel and
+    KMeansModelServable."""
+    from flink_ml_tpu.ops.distance import DistanceMeasure
+
+    measure = DistanceMeasure.get_instance(measure_name)
+    return jax.jit(lambda X, centroids: measure.find_closest(X, centroids))
+
+
+@functools.cache
+def scale_kernel(with_mean: bool, with_std: bool):
+    """Standardization transform (ref StandardScalerModel.java:60-97): subtract
+    mean if ``with_mean``, multiply by inv_std if ``with_std``. Shared by the
+    batch model, the online model and StandardScalerModelServable."""
+
+    @jax.jit
+    def kernel(X, mean, inv_std):
+        out = X
+        if with_mean:
+            out = out - mean[None, :]
+        if with_std:
+            out = out * inv_std[None, :]
+        return out
 
     return kernel

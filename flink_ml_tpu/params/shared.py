@@ -37,6 +37,7 @@ __all__ = [
     "HasElasticNet",
     "HasSeed",
     "HasDistanceMeasure",
+    "HasK",
     "HasHandleInvalid",
     "HasBatchStrategy",
     "HasMultiClass",
@@ -227,6 +228,20 @@ class HasDistanceMeasure(WithParams):
 
     def set_distance_measure(self, value: str):
         return self.set(self.DISTANCE_MEASURE, value)
+
+
+class HasK(WithParams):
+    """Ref KMeansModelParams.K — number of clusters, default 2. Lives here (not
+    clustering/kmeans.py) so the runtime-free KMeansModelServable can declare it
+    without importing the training stack."""
+
+    K = IntParam("k", "The max number of clusters to create.", 2, ParamValidators.gt(1))
+
+    def get_k(self) -> int:
+        return self.get(self.K)
+
+    def set_k(self, value: int):
+        return self.set(self.K, value)
 
 
 class HasHandleInvalid(WithParams):

@@ -7,13 +7,24 @@ in the reference means deployable without Flink; here it means no mesh, no itera
 driver, no training deps — a servable is parameters + small model arrays + a cached
 single-device jit executable (SURVEY.md §7.6), loadable in any Python service.
 """
-from flink_ml_tpu.servable.api import ModelServable, TransformerServable
+from flink_ml_tpu.servable.api import (
+    ModelDataConflictError,
+    ModelServable,
+    TransformerServable,
+)
 from flink_ml_tpu.servable.builder import PipelineModelServable
-from flink_ml_tpu.servable.lib import LogisticRegressionModelServable
+from flink_ml_tpu.servable.lib import (
+    KMeansModelServable,
+    LogisticRegressionModelServable,
+    StandardScalerModelServable,
+)
 
 __all__ = [
     "TransformerServable",
     "ModelServable",
+    "ModelDataConflictError",
     "PipelineModelServable",
     "LogisticRegressionModelServable",
+    "KMeansModelServable",
+    "StandardScalerModelServable",
 ]

@@ -21,7 +21,31 @@ from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.params.param import WithParams
 from flink_ml_tpu.utils import read_write as rw
 
-__all__ = ["TransformerServable", "ModelServable", "load_servable"]
+__all__ = [
+    "TransformerServable",
+    "ModelServable",
+    "ModelDataConflictError",
+    "load_servable",
+]
+
+
+class ModelDataConflictError(ValueError):
+    """Two model-data streams carry the same array name.
+
+    Raised by ``ModelServable.set_model_data`` when merging multiple npz
+    streams (the reference's varargs ``setModelData(InputStream...)``): a
+    duplicate key means the caller wired the same stream twice or two
+    incompatible exports — silently letting the later stream win would serve
+    from half of each.
+    """
+
+    def __init__(self, key: str, stream_index: int):
+        self.key = key
+        self.stream_index = stream_index
+        super().__init__(
+            f"model data stream {stream_index} redefines array {key!r} already "
+            "provided by an earlier stream"
+        )
 
 
 class TransformerServable(WithParams):
@@ -51,11 +75,23 @@ class ModelServable(TransformerServable):
     _MODEL_ARRAY_NAMES = ()
 
     def set_model_data(self, *model_data_inputs: BinaryIO) -> "ModelServable":
-        """Read model arrays from npz byte stream(s)."""
-        if len(model_data_inputs) != 1:
-            raise ValueError(f"expected 1 model data stream, got {len(model_data_inputs)}")
-        with np.load(io.BytesIO(model_data_inputs[0].read())) as z:
-            arrays = {k: z[k] for k in z.files}
+        """Read model arrays from npz byte stream(s).
+
+        Ref ModelServable.java:32 — the reference signature is varargs
+        ``setModelData(InputStream...)``; a model whose data is exported as
+        several streams (e.g. one per producing operator) merges them here.
+        Arrays merge by name across streams; a duplicate name raises the typed
+        ``ModelDataConflictError``.
+        """
+        if not model_data_inputs:
+            raise ValueError("expected at least 1 model data stream, got 0")
+        arrays: Dict[str, np.ndarray] = {}
+        for i, stream in enumerate(model_data_inputs):
+            with np.load(io.BytesIO(stream.read())) as z:
+                for k in z.files:
+                    if k in arrays:
+                        raise ModelDataConflictError(k, i)
+                    arrays[k] = z[k]
         return self._apply_model_arrays(arrays)
 
     def _apply_model_arrays(self, arrays: Dict[str, np.ndarray]) -> "ModelServable":

@@ -3,26 +3,45 @@
 Reference: ``flink-ml-servable-lib/.../LogisticRegressionModelServable.java:44`` —
 ``transform:62`` (dot + sigmoid per row), ``setModelData(InputStream):81``,
 ``load:89``. The reference ships exactly one servable-lib model; the pattern is
-that any Model can have a runtime-free replica (SURVEY.md §2.6).
+that any Model can have a runtime-free replica (SURVEY.md §2.6) — here the lib
+also covers the clustering and feature-scaling families.
+
+The L1 guarantee (enforced by ``tools/check_servable_imports.py``): nothing in
+this module imports the training stack (``iteration/``, ``execution/``,
+``builder/``, ``models/``). Numeric parity with the training-side Models comes
+from sharing the exact jit'd kernels in ``ops/kernels.py`` — the same compiled
+executable serves both surfaces, so results are bit-identical by construction.
 """
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.ops.kernels import (
+    compute_dots,
+    kmeans_predict_kernel,
+    logistic_from_dots_kernel,
+    scale_kernel,
+)
+from flink_ml_tpu.params.param import BoolParam
 from flink_ml_tpu.params.shared import (
+    HasDistanceMeasure,
     HasFeaturesCol,
+    HasInputCol,
+    HasK,
+    HasOutputCol,
     HasPredictionCol,
     HasRawPredictionCol,
 )
 from flink_ml_tpu.servable.api import ModelServable
 
-__all__ = ["LogisticRegressionModelServable"]
+__all__ = [
+    "LogisticRegressionModelServable",
+    "KMeansModelServable",
+    "StandardScalerModelServable",
+]
 
 
 
@@ -41,9 +60,6 @@ class LogisticRegressionModelServable(
         """Ref transform:62 — prediction = dot ≥ 0, rawPrediction = [1−p, p]."""
         if self.coefficient is None:
             raise RuntimeError("set_model_data must be called before transform")
-        from flink_ml_tpu.models.linear import compute_dots
-        from flink_ml_tpu.ops.kernels import logistic_from_dots_kernel
-
         dots = compute_dots(df, self.get_features_col(), self.coefficient)
         pred, raw = logistic_from_dots_kernel()(dots)
         out = df.clone()
@@ -52,5 +68,80 @@ class LogisticRegressionModelServable(
             self.get_raw_prediction_col(),
             DataTypes.vector(BasicType.DOUBLE),
             np.asarray(raw, np.float64),
+        )
+        return out
+
+
+class KMeansModelServable(
+    ModelServable, HasFeaturesCol, HasPredictionCol, HasDistanceMeasure, HasK
+):
+    """Runtime-free KMeansModel replica — prediction = closest centroid index
+    (ref KMeansModel.java predict), same ``kmeans_predict_kernel`` as the
+    training-side model."""
+
+    _MODEL_ARRAY_NAMES = ("centroids", "weights")
+
+    def __init__(self):
+        super().__init__()
+        self.centroids = None  # [k, d]
+        self.weights = None  # [k]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        if self.centroids is None:
+            raise RuntimeError("set_model_data must be called before transform")
+        X = df.vectors(self.get_features_col()).astype(np.float32)
+        pred = kmeans_predict_kernel(self.get_distance_measure())(
+            X, jnp.asarray(self.centroids, jnp.float32)
+        )
+        out = df.clone()
+        out.add_column(
+            self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64)
+        )
+        return out
+
+
+class StandardScalerModelServable(ModelServable, HasInputCol, HasOutputCol):
+    """Runtime-free StandardScalerModel replica (ref
+    StandardScalerModel.java:60-97), same ``scale_kernel`` as the batch and
+    online training-side models."""
+
+    # Param names match the training-side _ScalerParams so a saved
+    # StandardScalerModel's metadata restores them directly.
+    WITH_MEAN = BoolParam("withMean", "Whether centers the data with mean before scaling.", False)
+    WITH_STD = BoolParam("withStd", "Whether scales the data with standard deviation.", True)
+
+    _MODEL_ARRAY_NAMES = ("mean", "std")
+
+    def __init__(self):
+        super().__init__()
+        self.mean = None
+        self.std = None
+
+    def get_with_mean(self) -> bool:
+        return self.get(self.WITH_MEAN)
+
+    def set_with_mean(self, value: bool):
+        return self.set(self.WITH_MEAN, value)
+
+    def get_with_std(self) -> bool:
+        return self.get(self.WITH_STD)
+
+    def set_with_std(self, value: bool):
+        return self.set(self.WITH_STD, value)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        if self.mean is None:
+            raise RuntimeError("set_model_data must be called before transform")
+        X = df.vectors(self.get_input_col()).astype(np.float32)
+        std = np.asarray(self.std, np.float32)
+        inv_std = np.where(std == 0.0, 0.0, 1.0 / np.where(std == 0.0, 1.0, std))
+        out_vals = scale_kernel(self.get_with_mean(), self.get_with_std())(
+            X, np.asarray(self.mean, np.float32), inv_std
+        )
+        out = df.clone()
+        out.add_column(
+            self.get_output_col(),
+            DataTypes.vector(BasicType.DOUBLE),
+            np.asarray(out_vals, np.float64),
         )
         return out

@@ -1,0 +1,320 @@
+"""Dynamic micro-batching: coalesce concurrent small requests into a fixed
+set of padded batch shapes.
+
+Why: a jit'd transform compiles one executable per input shape. Serving
+single-row requests at their natural sizes would compile (and cache) an
+executable per distinct size — and on TPU an XLA recompile is a multi-second
+stall in the hot path (cf. the shape-stability discipline in "Fine-Tuning and
+Serving Gemma on Cloud TPU", PAPERS.md). The batcher therefore:
+
+1. holds the first queued request at most ``max_delay_ms`` while more arrive,
+2. claims whole requests FIFO up to ``max_batch_size`` rows,
+3. pads the coalesced batch up to the next power-of-two **bucket** (1, 2, 4,
+   …, max_batch_size) by repeating row 0 (row-wise transforms are
+   element-independent, so pad rows influence nothing and are sliced off),
+4. runs ONE transform on the padded batch and scatters per-request slices
+   back to the waiting clients.
+
+So a model version compiles at most ``log2(max_batch_size)+1`` executables,
+ever — the property asserted by ``tests/test_serving.py``'s recompile sweep.
+
+Admission control: the queue is bounded in rows; a full queue rejects
+synchronously with ``ServingOverloadedError`` (producers never block → no
+deadlock under overload). Each request carries a deadline; requests still
+queued past it are dropped with ``ServingDeadlineError``, but once claimed
+into a batch a request always gets exactly one response.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.serving.errors import (
+    ServingClosedError,
+    ServingDeadlineError,
+    ServingOverloadedError,
+)
+
+__all__ = ["power_of_two_buckets", "bucket_for", "pad_to", "PendingRequest", "MicroBatcher"]
+
+
+def power_of_two_buckets(max_batch_size: int) -> Tuple[int, ...]:
+    """(1, 2, 4, ..., max_batch_size). ``max_batch_size`` itself is always a
+    bucket even when not a power of two, so the largest coalesced batch pads
+    to exactly the configured bound."""
+    if max_batch_size < 1:
+        raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
+    buckets: List[int] = []
+    b = 1
+    while b < max_batch_size:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch_size)
+    return tuple(buckets)
+
+
+def bucket_for(rows: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket holding ``rows`` (buckets ascending)."""
+    for b in buckets:
+        if rows <= b:
+            return b
+    raise ValueError(f"{rows} rows exceed the largest bucket {buckets[-1]}")
+
+
+def pad_to(df: DataFrame, bucket: int) -> DataFrame:
+    """Pad ``df`` to exactly ``bucket`` rows by repeating row 0."""
+    n = len(df)
+    if n == bucket:
+        return df
+    idx = np.concatenate([np.arange(n, dtype=np.int64), np.zeros(bucket - n, np.int64)])
+    return df.take(idx)
+
+
+# Request lifecycle (transitions under the batcher lock):
+_PENDING = 0  # queued, waiting to be claimed
+_CLAIMED = 1  # inside an executing batch — WILL complete
+_TIMED_OUT = 2  # abandoned by its waiter; the drain loop discards it
+_DONE = 3  # response or error delivered
+
+
+class PendingRequest:
+    """A submitted request: the client-side handle (``result()``) and the
+    batcher-side state machine."""
+
+    __slots__ = (
+        "df", "rows", "enqueued_at", "deadline",
+        "_event", "_state", "response", "error", "_abandon_cb",
+    )
+
+    def __init__(self, df: DataFrame, deadline: float):
+        self.df = df
+        self.rows = len(df)
+        self.enqueued_at = time.perf_counter()
+        self.deadline = deadline
+        self._event = threading.Event()
+        self._state = _PENDING
+        self.response = None
+        self.error: Optional[BaseException] = None
+
+    def result(self):
+        """Block until the response (or typed error) arrives.
+
+        A request that times out while still queued raises
+        ``ServingDeadlineError`` and is marked abandoned so the batcher skips
+        it; one already claimed into a batch rides the batch to completion —
+        every admitted request resolves exactly once.
+        """
+        while True:
+            remaining = self.deadline - time.perf_counter()
+            if self._event.wait(timeout=max(remaining, 0.0)):
+                if self.error is not None:
+                    raise self.error
+                return self.response
+            # Deadline passed without completion. The state transition is
+            # done by the batcher (under its lock) via _try_abandon so the
+            # claim/abandon race has a single arbiter.
+            if self._abandon_cb():  # set by the batcher at submit
+                raise ServingDeadlineError(
+                    f"request not served within its deadline "
+                    f"(queued {time.perf_counter() - self.enqueued_at:.3f}s)"
+                )
+            # Lost the race: a batch claimed us concurrently — it will
+            # complete promptly; loop and wait for the event.
+            self._event.wait()
+            if self.error is not None:
+                raise self.error
+            return self.response
+
+
+class MicroBatcher:
+    """The coalescing loop. ``execute(padded_df)`` is supplied by the server
+    and returns ``(out_df, model_version)`` — the batcher owns queueing,
+    deadlines, padding, slicing, and the ``ml.serving.*`` metrics under
+    ``scope``."""
+
+    def __init__(
+        self,
+        execute: Callable[[DataFrame], Tuple[DataFrame, int]],
+        *,
+        max_batch_size: int,
+        max_delay_ms: float,
+        queue_capacity_rows: int,
+        scope: str,
+        response_factory: Callable[[DataFrame, int, float, int], object],
+    ):
+        self._execute = execute
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.queue_capacity_rows = int(queue_capacity_rows)
+        self.buckets = power_of_two_buckets(self.max_batch_size)
+        self.scope = scope
+        self._response_factory = response_factory
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[PendingRequest] = []
+        self._queued_rows = 0
+        self._closed = False
+        self._draining = False
+        self.executed_batch_sizes: List[Tuple[int, int]] = []  # (rows, bucket) history
+        self._thread = threading.Thread(target=self._loop, name=f"micro-batcher[{scope}]", daemon=True)
+        self._thread.start()
+
+    # -- client side ----------------------------------------------------------
+    def submit(self, df: DataFrame, timeout_s: float) -> PendingRequest:
+        rows = len(df)
+        if rows == 0:
+            raise ValueError("cannot serve an empty request")
+        if rows > self.max_batch_size:
+            raise ValueError(
+                f"request of {rows} rows exceeds max_batch_size={self.max_batch_size}; "
+                "split it or raise serving.max.batch.size"
+            )
+        req = PendingRequest(df, deadline=time.perf_counter() + timeout_s)
+        with self._cond:
+            if self._closed or self._draining:
+                raise ServingClosedError("server is shut down; request rejected")
+            if self._queued_rows + rows > self.queue_capacity_rows:
+                metrics.counter(self.scope, MLMetrics.SERVING_REJECTED)
+                raise ServingOverloadedError(self._queued_rows, self.queue_capacity_rows)
+            self._install_abandon(req)
+            self._queue.append(req)
+            self._queued_rows += rows
+            metrics.counter(self.scope, MLMetrics.SERVING_REQUESTS)
+            metrics.gauge(self.scope, MLMetrics.SERVING_QUEUE_DEPTH, self._queued_rows)
+            self._cond.notify_all()
+        return req
+
+    def _install_abandon(self, req: PendingRequest) -> None:
+        def abandon() -> bool:
+            with self._lock:
+                if req._state == _PENDING:
+                    req._state = _TIMED_OUT
+                    metrics.counter(self.scope, MLMetrics.SERVING_TIMEOUTS)
+                    return True
+                return False  # claimed (or done): the batch owns it now
+
+        req._abandon_cb = abandon
+
+    # -- batching loop --------------------------------------------------------
+    def _claim_batch(self) -> Optional[List[PendingRequest]]:
+        """Wait for work, coalesce up to max_batch_size rows, claim FIFO.
+        Returns None only when closed and the queue is drained."""
+        with self._cond:
+            while True:
+                self._reap_locked()
+                if self._queue:
+                    break
+                if self._closed:
+                    return None
+                self._cond.wait(timeout=0.05)
+            # Coalescing window: hold the head request up to max_delay while
+            # more arrive (or until a full batch is already waiting). A closed
+            # (draining) batcher skips the wait — latency no longer matters.
+            head = self._queue[0]
+            batch_deadline = head.enqueued_at + self.max_delay_s
+            while not self._closed:
+                self._reap_locked()
+                if self._queued_rows >= self.max_batch_size:
+                    break
+                remaining = batch_deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+            claimed: List[PendingRequest] = []
+            rows = 0
+            i = 0
+            while i < len(self._queue):
+                req = self._queue[i]
+                if rows + req.rows > self.max_batch_size:
+                    break
+                self._queue.pop(i)
+                self._queued_rows -= req.rows
+                req._state = _CLAIMED
+                claimed.append(req)
+                rows += req.rows
+            metrics.gauge(self.scope, MLMetrics.SERVING_QUEUE_DEPTH, self._queued_rows)
+            return claimed if claimed else []
+
+    def _reap_locked(self) -> None:
+        """Drop abandoned/expired requests still in the queue (lock held)."""
+        now = time.perf_counter()
+        kept = []
+        for req in self._queue:
+            if req._state == _TIMED_OUT:
+                self._queued_rows -= req.rows
+                continue
+            if req.deadline <= now:
+                req._state = _TIMED_OUT
+                req.error = ServingDeadlineError(
+                    f"request expired in queue after {now - req.enqueued_at:.3f}s"
+                )
+                self._queued_rows -= req.rows
+                metrics.counter(self.scope, MLMetrics.SERVING_TIMEOUTS)
+                req._event.set()
+                continue
+            kept.append(req)
+        self._queue[:] = kept
+
+    def _run_batch(self, claimed: List[PendingRequest]) -> None:
+        rows = sum(r.rows for r in claimed)
+        bucket = bucket_for(rows, self.buckets)
+        batch = claimed[0].df if len(claimed) == 1 else DataFrame.concat([r.df for r in claimed])
+        try:
+            out, version = self._execute(pad_to(batch, bucket))
+        except BaseException as e:  # noqa: BLE001 — delivered to each waiter
+            for req in claimed:
+                req.error = e
+                req._state = _DONE
+                req._event.set()
+            return
+        self.executed_batch_sizes.append((rows, bucket))
+        metrics.observe(self.scope, MLMetrics.SERVING_BATCH_SIZE, rows)
+        metrics.counter(self.scope, MLMetrics.SERVING_BATCHES)
+        now = time.perf_counter()
+        offset = 0
+        for req in claimed:
+            sliced = out.take(np.arange(offset, offset + req.rows, dtype=np.int64))
+            offset += req.rows
+            latency_ms = (now - req.enqueued_at) * 1000.0
+            req.response = self._response_factory(sliced, version, latency_ms, bucket)
+            metrics.observe(self.scope, MLMetrics.SERVING_LATENCY_MS, latency_ms)
+            req._state = _DONE
+            req._event.set()
+        hist = metrics.histogram(self.scope, MLMetrics.SERVING_LATENCY_MS)
+        metrics.gauge(self.scope, MLMetrics.SERVING_LATENCY_P50_MS, hist.quantile(0.5))
+        metrics.gauge(self.scope, MLMetrics.SERVING_LATENCY_P99_MS, hist.quantile(0.99))
+
+    def _loop(self) -> None:
+        while True:
+            claimed = self._claim_batch()
+            if claimed is None:
+                return
+            if claimed:
+                self._run_batch(claimed)
+
+    # -- shutdown -------------------------------------------------------------
+    def close(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop accepting requests; with ``drain`` (graceful) the loop finishes
+        everything already queued before exiting, otherwise queued requests
+        fail with ``ServingClosedError``."""
+        with self._cond:
+            if self._closed:
+                return
+            self._draining = True
+            if not drain:
+                for req in self._queue:
+                    if req._state == _PENDING:
+                        req._state = _DONE
+                        req.error = ServingClosedError("server shut down before execution")
+                        req._event.set()
+                self._queue.clear()
+                self._queued_rows = 0
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout_s)

@@ -1,0 +1,53 @@
+"""Typed failures of the online serving runtime.
+
+Every rejection a client can see is a distinct type so callers (and load
+balancers above them) can route: overload → shed/retry elsewhere, deadline →
+give up, closed → connection draining, no model → not ready yet. All subclass
+``ServingError`` for blanket handling.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "ServingError",
+    "ServingOverloadedError",
+    "ServingDeadlineError",
+    "ServingClosedError",
+    "NoModelError",
+]
+
+
+class ServingError(RuntimeError):
+    """Base of every serving-runtime failure."""
+
+
+class ServingOverloadedError(ServingError):
+    """Admission control rejected the request: the bounded queue is full.
+
+    Raised synchronously at ``submit`` — the queue never blocks producers, so
+    overload can shed load but never deadlock. Carries the observed depth so
+    callers can log/export it.
+    """
+
+    def __init__(self, queued_rows: int, capacity_rows: int):
+        self.queued_rows = queued_rows
+        self.capacity_rows = capacity_rows
+        super().__init__(
+            f"serving queue full ({queued_rows}/{capacity_rows} rows); request rejected"
+        )
+
+
+class ServingDeadlineError(ServingError, TimeoutError):
+    """The request's deadline expired before a batch picked it up.
+
+    Deadlines are enforced at batch admission: once a request is claimed into
+    an executing batch it always completes (exactly-one-response invariant);
+    a request still queued past its deadline is dropped and gets this error.
+    """
+
+
+class ServingClosedError(ServingError):
+    """The server is shut down (or draining) and accepts no new requests."""
+
+
+class NoModelError(ServingError):
+    """No model version has been swapped in yet — the server is not ready."""

@@ -1,0 +1,192 @@
+"""Versioned model registry: atomic hot swap + directory polling.
+
+The goodput framing ("ML Productivity Goodput", PAPERS.md): model updates must
+not cost availability. The contract here —
+
+- **Publish** (trainer side): ``publish_servable`` writes the saved stage into
+  ``<dir>/v-<N>.tmp`` and renames to ``v-<N>`` — the checkpoint tier's
+  atomic-publish protocol — so a poller can never observe a half-written
+  version.
+- **Discover** (``ModelVersionPoller``): the directory listing reuses the
+  hardened ``checkpoint.scan_numbered_dirs`` semantics — skip ``.tmp`` /
+  ``.corrupt`` / unparsable names, a version is only eligible once its
+  ``metadata`` marker exists.
+- **Load off the serving path**: the poller thread loads and **warms** the new
+  servable (one dummy batch per bucket, compiling every serving shape) while
+  the old version keeps serving; only then does ``ModelRegistry.swap`` flip
+  one tuple — a batch snapshots ``(version, servable)`` once, so every
+  response comes from exactly one fully-loaded version.
+- **Fall back**: a version that fails to load (``serving.swap`` fault point)
+  is remembered as bad and the next older intact one is tried — mirroring
+  ``CheckpointManager.restore_latest``'s quarantine-and-fall-back.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from flink_ml_tpu.checkpoint import scan_numbered_dirs
+from flink_ml_tpu.faults import faults
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.serving.errors import NoModelError
+
+__all__ = ["ModelRegistry", "ModelVersionPoller", "publish_servable"]
+
+VERSION_PREFIX = "v-"
+_METADATA_MARKER = "metadata"  # written by save_metadata; last file of a stage save
+
+
+def publish_servable(stage, directory: str, version: Optional[int] = None) -> str:
+    """Save ``stage`` (a Model/Transformer with ``.save``) as the next model
+    version under ``directory``, atomically (tmp dir + rename) so a concurrent
+    poller never loads a partial save. Returns the published path."""
+    os.makedirs(directory, exist_ok=True)
+    if version is None:
+        published = scan_numbered_dirs(directory, VERSION_PREFIX, _METADATA_MARKER)
+        version = (published[-1] + 1) if published else 1
+    final_dir = os.path.join(directory, f"{VERSION_PREFIX}{version}")
+    if os.path.exists(final_dir):
+        raise FileExistsError(f"model version {version} already published at {final_dir}")
+    tmp_dir = final_dir + ".tmp"
+    if os.path.exists(tmp_dir):
+        shutil.rmtree(tmp_dir)
+    stage.save(tmp_dir)
+    os.rename(tmp_dir, final_dir)
+    return final_dir
+
+
+class ModelRegistry:
+    """Holds the serving ``(version, servable)`` pair; ``swap`` is atomic.
+
+    Gauges: every swap updates the existing ``ml.model.version`` /
+    ``ml.model.timestamp`` gauges (the MLMetrics contract online models
+    already follow) plus the ``ml.serving.swaps`` counter, all under the
+    server's scope.
+    """
+
+    def __init__(self, scope: str):
+        self.scope = scope
+        self._lock = threading.Lock()
+        self._current: Optional[Tuple[int, object]] = None
+
+    @property
+    def version(self) -> Optional[int]:
+        current = self._current
+        return current[0] if current else None
+
+    def current(self) -> Tuple[int, object]:
+        """The serving pair — snapshotted ONCE per batch by the server so a
+        mid-batch swap can never mix versions inside one response."""
+        current = self._current
+        if current is None:
+            raise NoModelError("no model version loaded yet")
+        return current
+
+    def swap(self, version: int, servable) -> None:
+        with self._lock:
+            previous = self._current
+            if previous is not None and version <= previous[0]:
+                raise ValueError(
+                    f"hot swap must advance the version: {version} <= serving {previous[0]}"
+                )
+            self._current = (version, servable)
+        metrics.gauge(self.scope, MLMetrics.VERSION, version)
+        metrics.gauge(self.scope, MLMetrics.TIMESTAMP, int(time.time() * 1000))
+        metrics.counter(self.scope, MLMetrics.SERVING_SWAPS)
+
+
+class ModelVersionPoller:
+    """Watch ``directory`` for newly published ``v-<N>`` stage dirs and hot-swap
+    the newest intact one into ``registry``.
+
+    ``loader(path)`` turns a published dir into a servable (default:
+    ``servable.api.load_servable``); ``warmup(servable)`` is called before the
+    swap — the server wires its per-bucket compile pass here. Failures of
+    either never touch the serving model: the version is recorded in
+    ``failed`` (with the error), ``ml.serving.swap.failures`` is bumped, and
+    the next older intact version is considered instead.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        registry: ModelRegistry,
+        *,
+        loader: Optional[Callable[[str], object]] = None,
+        warmup: Optional[Callable[[object], None]] = None,
+        interval_ms: Optional[float] = None,
+        on_swap: Optional[Callable[[int, object], None]] = None,
+    ):
+        if loader is None:
+            from flink_ml_tpu.servable.api import load_servable
+
+            loader = load_servable
+        from flink_ml_tpu.config import Options, config
+
+        self.directory = directory
+        self.registry = registry
+        self.loader = loader
+        self.warmup = warmup
+        self.on_swap = on_swap
+        self.interval_s = (
+            float(interval_ms)
+            if interval_ms is not None
+            else config.get(Options.SERVING_POLL_INTERVAL_MS)
+        ) / 1000.0
+        self.failed: Dict[int, BaseException] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one scan -------------------------------------------------------------
+    def poll_once(self) -> Optional[int]:
+        """Try to advance to the newest intact published version newer than
+        the serving one. Returns the swapped-in version, or None."""
+        versions = scan_numbered_dirs(self.directory, VERSION_PREFIX, _METADATA_MARKER)
+        serving = self.registry.version
+        for version in reversed(versions):
+            if serving is not None and version <= serving:
+                break
+            if version in self.failed:
+                continue
+            path = os.path.join(self.directory, f"{VERSION_PREFIX}{version}")
+            try:
+                faults.trip("serving.swap", version=version, path=path)
+                servable = self.loader(path)
+                if self.warmup is not None:
+                    self.warmup(servable)
+            except BaseException as e:  # noqa: BLE001 — any load error = bad version
+                self.failed[version] = e
+                metrics.counter(self.registry.scope, MLMetrics.SERVING_SWAP_FAILURES)
+                continue  # fall back: try the next older intact version
+            self.registry.swap(version, servable)
+            if self.on_swap is not None:
+                self.on_swap(version, servable)
+            return version
+        return None
+
+    # -- background thread ----------------------------------------------------
+    def start(self) -> "ModelVersionPoller":
+        if self._thread is not None:
+            raise RuntimeError("poller already started")
+        self._thread = threading.Thread(
+            target=self._loop, name=f"model-version-poller[{self.directory}]", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # a scan error must not kill the poller
+                pass
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

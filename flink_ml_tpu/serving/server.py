@@ -1,0 +1,253 @@
+"""InferenceServer — the online serving front end.
+
+Wraps any ``TransformerServable``/``ModelServable`` (or a whole
+``PipelineModelServable``) behind:
+
+- a **dynamic micro-batcher** (batcher.py) — concurrent ``predict`` calls
+  coalesce into padded power-of-two buckets so jitted transforms see a small
+  fixed shape set;
+- a **versioned registry** (registry.py) — ``swap``/``attach_poller`` replace
+  the model with zero unavailability; every batch executes against one
+  snapshotted ``(version, servable)`` pair;
+- **admission control** — bounded queue, typed ``ServingOverloadedError``
+  rejection, per-request deadlines, graceful drain on ``close``;
+- **observability** — the ``ml.serving.*`` metrics under scope
+  ``ml.serving[<name>]`` (docs/serving.md has the table).
+
+This is the third pillar of the framework (train → supervise → serve): the
+inference half of the north star lives here, and it is runtime-free in the L1
+sense — importing it never pulls the training stack
+(tools/check_servable_imports.py enforces that).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.serving.batcher import MicroBatcher, pad_to
+from flink_ml_tpu.serving.errors import NoModelError, ServingClosedError
+from flink_ml_tpu.serving.registry import ModelRegistry, ModelVersionPoller
+
+__all__ = ["ServingConfig", "ServingResponse", "InferenceServer"]
+
+
+class ServingConfig:
+    """Resolved serving knobs. Every unset field falls back to the runtime
+    config tier (``flink_ml_tpu.config``), so deployments tune the server via
+    ``FLINK_ML_TPU_SERVING_*`` env vars without code changes."""
+
+    def __init__(
+        self,
+        max_batch_size: Optional[int] = None,
+        max_delay_ms: Optional[float] = None,
+        queue_capacity_rows: Optional[int] = None,
+        default_timeout_ms: Optional[float] = None,
+        poll_interval_ms: Optional[float] = None,
+    ):
+        self.max_batch_size = (
+            int(max_batch_size) if max_batch_size is not None
+            else config.get(Options.SERVING_MAX_BATCH_SIZE)
+        )
+        self.max_delay_ms = (
+            float(max_delay_ms) if max_delay_ms is not None
+            else config.get(Options.SERVING_MAX_DELAY_MS)
+        )
+        self.queue_capacity_rows = (
+            int(queue_capacity_rows) if queue_capacity_rows is not None
+            else config.get(Options.SERVING_QUEUE_CAPACITY_ROWS)
+        )
+        self.default_timeout_ms = (
+            float(default_timeout_ms) if default_timeout_ms is not None
+            else config.get(Options.SERVING_DEFAULT_TIMEOUT_MS)
+        )
+        self.poll_interval_ms = (
+            float(poll_interval_ms) if poll_interval_ms is not None
+            else config.get(Options.SERVING_POLL_INTERVAL_MS)
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"ServingConfig(max_batch_size={self.max_batch_size}, "
+            f"max_delay_ms={self.max_delay_ms}, "
+            f"queue_capacity_rows={self.queue_capacity_rows}, "
+            f"default_timeout_ms={self.default_timeout_ms}, "
+            f"poll_interval_ms={self.poll_interval_ms})"
+        )
+
+
+class ServingResponse:
+    """One request's result: the transformed rows, the model version that
+    served them (exactly one — see ModelRegistry.current), the enqueue→response
+    latency, and the padded ``bucket`` the batch executed at.
+
+    The bit-exactness contract (tested by the soak test): within one bucket
+    shape a row's result is invariant to its position and to the other rows in
+    the batch, so each response row is bit-identical to
+    ``servable.transform(pad_to(request_df, response.bucket))`` of the serving
+    version. Across *different* shapes XLA may legally differ by 1 ulp (a
+    [1,d] and a [64,d] matmul are different executables), which is why the
+    bucket rides on the response.
+    """
+
+    __slots__ = ("dataframe", "model_version", "latency_ms", "bucket")
+
+    def __init__(self, dataframe: DataFrame, model_version: int, latency_ms: float, bucket: int):
+        self.dataframe = dataframe
+        self.model_version = model_version
+        self.latency_ms = latency_ms
+        self.bucket = bucket
+
+    def __repr__(self) -> str:
+        return (
+            f"ServingResponse(rows={len(self.dataframe)}, "
+            f"model_version={self.model_version}, latency_ms={self.latency_ms:.2f}, "
+            f"bucket={self.bucket})"
+        )
+
+
+class InferenceServer:
+    """Concurrent, versioned, micro-batched serving for one servable slot.
+
+    >>> server = InferenceServer(servable, name="ctr")
+    >>> out = server.predict(one_row_df)          # blocks; batched under the hood
+    >>> out.dataframe["prediction"], out.model_version
+
+    Hot swap: ``server.swap(version, new_servable)`` (programmatic) or
+    ``server.attach_poller(model_dir)`` (watch a publish directory). Both warm
+    the incoming servable on every batch bucket *before* it starts serving.
+    """
+
+    def __init__(
+        self,
+        servable=None,
+        *,
+        version: int = 1,
+        name: str = "default",
+        serving_config: Optional[ServingConfig] = None,
+        warmup_template: Optional[DataFrame] = None,
+    ):
+        self.name = name
+        self.scope = f"{MLMetrics.SERVING_GROUP}[{name}]"
+        self.config = serving_config or ServingConfig()
+        self.registry = ModelRegistry(self.scope)
+        self._warmup_template = warmup_template
+        self._template_lock = threading.Lock()
+        self._poller: Optional[ModelVersionPoller] = None
+        self._closed = False
+        self._batcher = MicroBatcher(
+            self._execute,
+            max_batch_size=self.config.max_batch_size,
+            max_delay_ms=self.config.max_delay_ms,
+            queue_capacity_rows=self.config.queue_capacity_rows,
+            scope=self.scope,
+            response_factory=ServingResponse,
+        )
+        if servable is not None:
+            self.swap(version, servable)
+
+    # -- the one place a batch meets a model ----------------------------------
+    def _execute(self, padded_df: DataFrame) -> Tuple[DataFrame, int]:
+        version, servable = self.registry.current()  # one snapshot per batch
+        return servable.transform(padded_df), version
+
+    # -- client API ------------------------------------------------------------
+    def predict(self, df: DataFrame, timeout_ms: Optional[float] = None) -> ServingResponse:
+        """Serve ``df`` (1..max_batch_size rows), blocking until the response.
+
+        Raises ``ServingOverloadedError`` (queue full — immediately),
+        ``ServingDeadlineError`` (deadline passed while queued),
+        ``ServingClosedError`` (after close), or ``NoModelError`` via the
+        batch when no version is loaded.
+        """
+        return self.submit(df, timeout_ms).result()
+
+    def submit(self, df: DataFrame, timeout_ms: Optional[float] = None):
+        """Async variant of ``predict``: returns a handle with ``.result()``."""
+        if self._closed:
+            raise ServingClosedError("server is closed")
+        self._remember_template(df)
+        timeout_s = (
+            timeout_ms if timeout_ms is not None else self.config.default_timeout_ms
+        ) / 1000.0
+        return self._batcher.submit(df, timeout_s)
+
+    def _remember_template(self, df: DataFrame) -> None:
+        """First request doubles as the warmup template for later swaps when
+        the caller didn't provide one at construction."""
+        if self._warmup_template is None:
+            with self._template_lock:
+                if self._warmup_template is None:
+                    self._warmup_template = df.take([0])
+
+    # -- model lifecycle -------------------------------------------------------
+    def warmup(self, servable) -> None:
+        """Compile every serving shape on ``servable``: one dummy batch per
+        bucket, built from the warmup template. Runs on the CALLER's thread
+        (poller or swapper), never the serving path — the in-service model
+        keeps answering while the incoming one warms."""
+        template = self._warmup_template
+        if template is None:
+            return  # nothing seen yet: the first real batch compiles lazily
+        for bucket in self._batcher.buckets:
+            servable.transform(pad_to(template, bucket))
+
+    def swap(self, version: int, servable) -> None:
+        """Warm then atomically install ``servable`` as ``version``. The
+        version must advance (monotonic — a response's ``model_version`` is
+        unambiguous forever)."""
+        self.warmup(servable)
+        self.registry.swap(version, servable)
+
+    def attach_poller(
+        self,
+        directory: str,
+        *,
+        loader=None,
+        interval_ms: Optional[float] = None,
+        start: bool = True,
+    ) -> ModelVersionPoller:
+        """Watch ``directory`` for published versions (see
+        ``registry.publish_servable``) and hot-swap them in as they appear."""
+        if self._poller is not None:
+            raise RuntimeError("a poller is already attached")
+        self._poller = ModelVersionPoller(
+            directory,
+            self.registry,
+            loader=loader,
+            warmup=self.warmup,
+            interval_ms=interval_ms if interval_ms is not None else self.config.poll_interval_ms,
+        )
+        if start:
+            self._poller.start()
+        return self._poller
+
+    @property
+    def model_version(self) -> Optional[int]:
+        return self.registry.version
+
+    @property
+    def executed_batch_sizes(self) -> List[Tuple[int, int]]:
+        """(rows, bucket) per executed batch — the compile-counting hook the
+        recompile tests assert on."""
+        return list(self._batcher.executed_batch_sizes)
+
+    # -- shutdown --------------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        """Stop the poller and the batcher. ``drain=True`` (default) serves
+        everything already queued before returning — graceful; ``drain=False``
+        fails queued requests with ``ServingClosedError``."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._poller is not None:
+            self._poller.stop()
+        self._batcher.close(drain=drain)
+
+    def __enter__(self) -> "InferenceServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)

@@ -101,3 +101,25 @@ def test_set_none_behaves_like_unset(monkeypatch):
     assert config.get(opt) == 555
     config.set(opt, None)  # no override: env (then default) shows through
     assert config.get(opt) == 123
+
+
+def test_serving_options_resolve_through_config_tier(monkeypatch):
+    """ServingConfig consumes the serving.* options: set() > env > default —
+    a deployment tunes the server without code changes (docs/serving.md)."""
+    from flink_ml_tpu.serving import ServingConfig
+
+    assert ServingConfig().max_batch_size == 64  # defaults
+    assert ServingConfig().queue_capacity_rows == 1024
+
+    monkeypatch.setenv(Options.SERVING_MAX_BATCH_SIZE.env_var, "32")
+    monkeypatch.setenv(Options.SERVING_MAX_DELAY_MS.env_var, "7.5")
+    resolved = ServingConfig()
+    assert resolved.max_batch_size == 32
+    assert resolved.max_delay_ms == 7.5
+
+    config.set(Options.SERVING_MAX_BATCH_SIZE, 8)
+    try:
+        assert ServingConfig().max_batch_size == 8  # set() beats env
+        assert ServingConfig(max_batch_size=4).max_batch_size == 4  # arg beats all
+    finally:
+        config.unset(Options.SERVING_MAX_BATCH_SIZE)

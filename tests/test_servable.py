@@ -5,6 +5,7 @@ import io
 import os
 
 import numpy as np
+import pytest
 
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.builder.pipeline import PipelineModel
@@ -76,3 +77,138 @@ def test_load_servable_missing_method_errors(tmp_path):
 
     with pytest.raises(RuntimeError, match="load_servable"):
         load_servable(path)
+
+
+# ---------------------------------------------------------------------------
+# servable-lib coverage beyond the reference's single entry (SURVEY.md §2.6:
+# any Model can have a runtime-free replica)
+# ---------------------------------------------------------------------------
+def test_kmeans_servable_parity(tmp_path):
+    """KMeansModel.save → load_servable → transform identical to the
+    training-side model (same kmeans_predict_kernel → bit-identical)."""
+    from flink_ml_tpu.models.clustering.kmeans import KMeans
+    from flink_ml_tpu.servable import KMeansModelServable
+    from flink_ml_tpu.servable.api import load_servable
+
+    X = RNG.normal(size=(80, 4))
+    df = DataFrame.from_dict({"features": X})
+    model = KMeans().set_k(3).set_seed(5).set_max_iter(8).fit(df)
+    path = str(tmp_path / "km")
+    model.save(path)
+    servable = load_servable(path)
+    assert isinstance(servable, KMeansModelServable)
+    assert servable.get_k() == 3
+    np.testing.assert_array_equal(
+        servable.transform(df)["prediction"], model.transform(df)["prediction"]
+    )
+    np.testing.assert_array_equal(servable.centroids, model.centroids)
+    np.testing.assert_array_equal(servable.weights, model.weights)
+
+
+def test_standard_scaler_servable_parity(tmp_path):
+    """StandardScalerModel.save → load_servable → transform identical
+    (shared scale_kernel), params withMean/withStd restored."""
+    from flink_ml_tpu.models.feature.standard_scaler import StandardScaler
+    from flink_ml_tpu.servable import StandardScalerModelServable
+    from flink_ml_tpu.servable.api import load_servable
+
+    X = RNG.normal(size=(64, 3)) * 4.0 + 1.5
+    df = DataFrame.from_dict({"features": X})
+    scaler = (
+        StandardScaler()
+        .set_input_col("features")
+        .set_output_col("scaled")
+        .set_with_mean(True)
+        .set_with_std(True)
+    )
+    model = scaler.fit(df)
+    path = str(tmp_path / "scaler")
+    model.save(path)
+    servable = load_servable(path)
+    assert isinstance(servable, StandardScalerModelServable)
+    assert servable.get_with_mean() is True and servable.get_with_std() is True
+    np.testing.assert_array_equal(
+        servable.transform(df)["scaled"], model.transform(df)["scaled"]
+    )
+
+
+def test_scaler_servable_zero_std_column(tmp_path):
+    """The zero-variance column contract (ref StandardScalerModel.java: scale
+    by 0 when std == 0) survives the servable path."""
+    from flink_ml_tpu.models.feature.standard_scaler import StandardScaler
+    from flink_ml_tpu.servable.api import load_servable
+
+    X = RNG.normal(size=(32, 2))
+    X[:, 1] = 7.0  # constant column → std 0
+    df = DataFrame.from_dict({"features": X})
+    model = StandardScaler().set_input_col("features").set_output_col("scaled").fit(df)
+    path = str(tmp_path / "s0")
+    model.save(path)
+    servable = load_servable(path)
+    out = servable.transform(df)["scaled"]
+    np.testing.assert_array_equal(out[:, 1], np.zeros(32))
+    np.testing.assert_array_equal(out, model.transform(df)["scaled"])
+
+
+# ---------------------------------------------------------------------------
+# varargs set_model_data (ref ModelServable.java setModelData(InputStream...))
+# ---------------------------------------------------------------------------
+def test_set_model_data_merges_multiple_streams():
+    """KMeans model data split across two streams (one array each) merges."""
+    from flink_ml_tpu.servable import KMeansModelServable
+
+    centroids = RNG.normal(size=(2, 3))
+    weights = np.array([10.0, 20.0])
+    b1, b2 = io.BytesIO(), io.BytesIO()
+    np.savez(b1, centroids=centroids)
+    np.savez(b2, weights=weights)
+    b1.seek(0), b2.seek(0)
+    servable = KMeansModelServable().set_model_data(b1, b2)
+    np.testing.assert_array_equal(servable.centroids, centroids)
+    np.testing.assert_array_equal(servable.weights, weights)
+    df = DataFrame.from_dict({"features": RNG.normal(size=(8, 3))})
+    assert len(servable.transform(df)["prediction"]) == 8
+
+
+def test_set_model_data_duplicate_key_is_typed_error():
+    from flink_ml_tpu.servable import (
+        LogisticRegressionModelServable,
+        ModelDataConflictError,
+    )
+
+    b1, b2 = io.BytesIO(), io.BytesIO()
+    np.savez(b1, coefficient=np.ones(3))
+    np.savez(b2, coefficient=np.zeros(3))
+    b1.seek(0), b2.seek(0)
+    with pytest.raises(ModelDataConflictError, match="coefficient"):
+        LogisticRegressionModelServable().set_model_data(b1, b2)
+
+
+def test_set_model_data_zero_streams_rejected():
+    from flink_ml_tpu.servable import LogisticRegressionModelServable
+
+    with pytest.raises(ValueError, match="at least 1"):
+        LogisticRegressionModelServable().set_model_data()
+
+
+def test_pipeline_servable_with_scaler_and_lr(tmp_path):
+    """A scaler→LR PipelineModel round-trips through the servable tier with
+    identical predictions — the multi-stage serving path."""
+    from flink_ml_tpu.models.feature.standard_scaler import StandardScaler
+    from flink_ml_tpu.builder.pipeline import Pipeline
+
+    X = RNG.normal(size=(96, 3)) * 3.0
+    y = (X @ np.array([1.0, -2.0, 0.5]) > 0).astype(np.float64)
+    df = DataFrame.from_dict({"features": X, "label": y})
+    pipe = Pipeline([
+        StandardScaler().set_input_col("features").set_output_col("scaled"),
+        LogisticRegression().set_features_col("scaled").set_max_iter(10).set_global_batch_size(96),
+    ])
+    pipeline_model = pipe.fit(df)
+    path = str(tmp_path / "pipe2")
+    pipeline_model.save(path)
+    servable = PipelineModelServable.load(path)
+    assert len(servable.servables) == 2
+    np.testing.assert_array_equal(
+        servable.transform(df)["prediction"], pipeline_model.transform(df)["prediction"]
+    )

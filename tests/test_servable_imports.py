@@ -1,0 +1,64 @@
+"""Tier-1 shim for ``tools/check_servable_imports.py``.
+
+The L1 guarantee from the reference (SURVEY.md §2.6): the servable/serving
+tier is deployable without the training runtime. This test makes tier-1
+enforce it — any import (even lazy, function-local) of ``iteration/``,
+``execution/``, ``builder/`` or ``models/`` from ``flink_ml_tpu/servable/``
+or ``flink_ml_tpu/serving/`` fails the suite.
+"""
+import importlib.util
+import os
+
+_TOOL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+    "check_servable_imports.py",
+)
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_servable_imports", _TOOL)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_serving_tier_is_runtime_free():
+    tool = _load_tool()
+    problems, checked = tool.check()
+    assert not problems, "\n".join(problems)
+    # Both packages must actually be present in the sweep — an empty check
+    # passing would be the guard silently rotting.
+    assert any("servable" in f for f in checked)
+    assert any(os.path.join("flink_ml_tpu", "serving") in f for f in checked)
+
+
+def test_checker_catches_lazy_imports(tmp_path):
+    """The guard must see function-local imports, not just module top-level."""
+    tool = _load_tool()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def transform(df):\n"
+        "    from flink_ml_tpu.models.linear import compute_dots\n"
+        "    import flink_ml_tpu.iteration.datacache as dc\n"
+        "    from flink_ml_tpu import builder\n"
+        "    return compute_dots\n"
+    )
+    found = sorted(m for _, m in tool._violations_in_file(str(bad)))
+    assert found == [
+        "flink_ml_tpu.builder",
+        "flink_ml_tpu.iteration.datacache",
+        "flink_ml_tpu.models.linear",
+    ]
+
+
+def test_checker_allows_runtime_free_imports(tmp_path):
+    tool = _load_tool()
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import numpy as np\n"
+        "from flink_ml_tpu.api.dataframe import DataFrame\n"
+        "from flink_ml_tpu.ops.kernels import compute_dots\n"
+        "from flink_ml_tpu.checkpoint import scan_numbered_dirs\n"
+    )
+    assert list(tool._violations_in_file(str(good))) == []

@@ -1,0 +1,496 @@
+"""Online serving runtime tests (flink_ml_tpu/serving/).
+
+The acceptance contract of the serving pillar:
+
+- soak: ≥8 concurrent client threads with a hot swap mid-run — every request
+  gets exactly one response, bit-identical to the serving version's transform
+  at the response's bucket shape, and ``ml.model.version`` only advances;
+- shape stability: a 1..max-batch request-size sweep executes only padded
+  power-of-two buckets and compiles at most one executable per bucket;
+- overload: the bounded queue rejects with the typed ``ServingOverloadedError``
+  (never blocks, never deadlocks) and everything is observable through
+  ``MetricsRegistry`` under ``ml.serving[<name>]``.
+"""
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.faults import InjectedFault, faults
+from flink_ml_tpu.metrics import Histogram, MLMetrics, metrics
+from flink_ml_tpu.servable.api import TransformerServable, load_servable
+from flink_ml_tpu.serving import (
+    InferenceServer,
+    ModelRegistry,
+    ModelVersionPoller,
+    NoModelError,
+    ServingClosedError,
+    ServingConfig,
+    ServingDeadlineError,
+    ServingOverloadedError,
+    bucket_for,
+    pad_to,
+    power_of_two_buckets,
+    publish_servable,
+)
+
+RNG = np.random.default_rng(11)
+DIM = 5  # distinctive width so jit-cache assertions don't collide with other tests
+
+
+def _fit_lr(max_iter=10):
+    X = RNG.normal(size=(96, DIM))
+    y = (X @ np.arange(1.0, DIM + 1.0) > 0).astype(np.float64)
+    df = DataFrame.from_dict({"features": X, "label": y})
+    from flink_ml_tpu.models.classification.logistic_regression import LogisticRegression
+
+    return LogisticRegression().set_max_iter(max_iter).set_global_batch_size(96).fit(df), X
+
+
+def _servable(model):
+    from flink_ml_tpu.servable import LogisticRegressionModelServable
+
+    buf = io.BytesIO()
+    np.savez(buf, coefficient=model.coefficient)
+    buf.seek(0)
+    return LogisticRegressionModelServable().set_model_data(buf)
+
+
+def _row(X, i):
+    return DataFrame.from_dict({"features": X[i : i + 1]})
+
+
+class _SlowEcho(TransformerServable):
+    """Clones its input after a fixed delay — the knob for queue-pressure tests."""
+
+    def __init__(self, delay_s: float = 0.0):
+        super().__init__()
+        self.delay_s = delay_s
+
+    def transform(self, df):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return df.clone()
+
+
+# ---------------------------------------------------------------------------
+# bucketing primitives
+# ---------------------------------------------------------------------------
+class TestBuckets:
+    def test_power_of_two_buckets(self):
+        assert power_of_two_buckets(64) == (1, 2, 4, 8, 16, 32, 64)
+        assert power_of_two_buckets(1) == (1,)
+
+    def test_non_power_of_two_max_is_its_own_bucket(self):
+        assert power_of_two_buckets(48) == (1, 2, 4, 8, 16, 32, 48)
+
+    def test_bucket_for(self):
+        buckets = power_of_two_buckets(16)
+        assert [bucket_for(n, buckets) for n in (1, 2, 3, 9, 16)] == [1, 2, 4, 16, 16]
+        with pytest.raises(ValueError):
+            bucket_for(17, buckets)
+
+    def test_pad_to_repeats_row_zero(self):
+        df = DataFrame.from_dict({"features": np.arange(6.0).reshape(2, 3)})
+        padded = pad_to(df, 4)
+        assert len(padded) == 4
+        np.testing.assert_array_equal(padded["features"][2], padded["features"][0])
+        np.testing.assert_array_equal(padded["features"][:2], df["features"])
+
+
+# ---------------------------------------------------------------------------
+# single-server behavior
+# ---------------------------------------------------------------------------
+class TestInferenceServer:
+    def test_single_request_matches_direct_transform(self):
+        model, X = _fit_lr()
+        sv = _servable(model)
+        with InferenceServer(sv, name="t-single") as server:
+            resp = server.predict(_row(X, 0))
+            assert resp.model_version == 1
+            direct = sv.transform(pad_to(_row(X, 0), resp.bucket))
+            np.testing.assert_array_equal(
+                resp.dataframe["rawPrediction"], direct.take([0])["rawPrediction"]
+            )
+
+    def test_concurrent_requests_coalesce_into_buckets(self):
+        model, X = _fit_lr()
+        sv = _servable(model)
+        cfg = ServingConfig(max_batch_size=16, max_delay_ms=10, queue_capacity_rows=256)
+        with InferenceServer(sv, name="t-coalesce", serving_config=cfg) as server:
+            results = {}
+
+            def client(i):
+                results[i] = server.predict(_row(X, i))
+
+            threads = [threading.Thread(target=client, args=(i,)) for i in range(32)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(results) == 32
+            executed = server.executed_batch_sizes
+            assert all(b in server._batcher.buckets for _, b in executed)
+            # coalescing happened: fewer batches than requests
+            assert len(executed) < 32
+
+    def test_no_model_is_a_typed_error(self):
+        with InferenceServer(name="t-nomodel") as server:
+            with pytest.raises(NoModelError):
+                server.predict(DataFrame.from_dict({"features": np.zeros((1, DIM))}))
+
+    def test_oversized_request_rejected(self):
+        model, X = _fit_lr()
+        cfg = ServingConfig(max_batch_size=4)
+        with InferenceServer(_servable(model), name="t-oversize", serving_config=cfg) as server:
+            with pytest.raises(ValueError, match="max_batch_size"):
+                server.predict(DataFrame.from_dict({"features": X[:5]}))
+
+    def test_closed_server_rejects(self):
+        model, X = _fit_lr()
+        server = InferenceServer(_servable(model), name="t-closed")
+        server.close()
+        with pytest.raises(ServingClosedError):
+            server.predict(_row(X, 0))
+
+
+# ---------------------------------------------------------------------------
+# shape stability: the recompile bound
+# ---------------------------------------------------------------------------
+class TestShapeStability:
+    def test_sweep_executes_only_buckets_and_compiles_once_per_bucket(self):
+        from flink_ml_tpu.ops.kernels import dot_kernel
+
+        model, X = _fit_lr()
+        sv = _servable(model)
+        cfg = ServingConfig(max_batch_size=16, max_delay_ms=0.0, queue_capacity_rows=256)
+        buckets = power_of_two_buckets(16)
+        with InferenceServer(sv, name="t-shapes", serving_config=cfg) as server:
+            before = dot_kernel()._cache_size()
+
+            def sweep():
+                for n in range(1, 17):
+                    df = DataFrame.from_dict({"features": X[:n]})
+                    resp = server.predict(df)
+                    assert len(resp.dataframe) == n
+
+            sweep()
+            after_first = dot_kernel()._cache_size()
+            # at most one executable per bucket, for the whole 1..16 sweep
+            assert after_first - before <= len(buckets)
+            sweep()
+            # a second identical sweep compiles NOTHING new
+            assert dot_kernel()._cache_size() == after_first
+            executed = {b for _, b in server.executed_batch_sizes}
+            assert executed <= set(buckets)
+
+    def test_swap_warms_every_bucket_before_serving(self):
+        from flink_ml_tpu.ops.kernels import dot_kernel
+
+        model, X = _fit_lr()
+        model2, _ = _fit_lr(max_iter=25)
+        cfg = ServingConfig(max_batch_size=8, max_delay_ms=0.0, queue_capacity_rows=64)
+        with InferenceServer(_servable(model), name="t-warm", serving_config=cfg,
+                             warmup_template=_row(X, 0)) as server:
+            server.predict(_row(X, 0))  # compile through the serving path
+            for n in range(1, 9):
+                server.predict(DataFrame.from_dict({"features": X[:n]}))
+            before = dot_kernel()._cache_size()
+            server.swap(2, _servable(model2))
+            # same shapes, same kernels: the swap (incl. its warmup) must not
+            # have compiled any new executable
+            assert dot_kernel()._cache_size() == before
+            resp = server.predict(_row(X, 1))
+            assert resp.model_version == 2
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+class TestAdmissionControl:
+    def test_overload_rejects_typed_and_never_deadlocks(self):
+        cfg = ServingConfig(
+            max_batch_size=1, max_delay_ms=0.0, queue_capacity_rows=4,
+            default_timeout_ms=30_000,
+        )
+        server = InferenceServer(
+            _SlowEcho(delay_s=0.15), name="t-overload", serving_config=cfg,
+            warmup_template=DataFrame.from_dict({"x": np.zeros((1, 2))}),
+        )
+        try:
+            one = DataFrame.from_dict({"x": np.ones((1, 2))})
+            first = server.submit(one)  # claimed into the executing batch
+            deadline = time.perf_counter() + 5.0
+            while server._batcher._queued_rows and time.perf_counter() < deadline:
+                time.sleep(0.005)
+            handles = [server.submit(one) for _ in range(4)]  # fills capacity
+            with pytest.raises(ServingOverloadedError) as exc:
+                server.submit(one)
+            assert exc.value.capacity_rows == 4
+            assert metrics.get(server.scope, MLMetrics.SERVING_REJECTED) == 1
+            # no deadlock: everything admitted completes
+            assert first.result() is not None
+            for h in handles:
+                assert h.result() is not None
+        finally:
+            server.close()
+
+    def test_queued_request_past_deadline_gets_deadline_error(self):
+        cfg = ServingConfig(max_batch_size=1, max_delay_ms=0.0, queue_capacity_rows=16)
+        server = InferenceServer(
+            _SlowEcho(delay_s=0.25), name="t-deadline", serving_config=cfg,
+            warmup_template=DataFrame.from_dict({"x": np.zeros((1, 2))}),
+        )
+        try:
+            one = DataFrame.from_dict({"x": np.ones((1, 2))})
+            blocker = server.submit(one, timeout_ms=30_000)
+            deadline = time.perf_counter() + 5.0
+            while server._batcher._queued_rows and time.perf_counter() < deadline:
+                time.sleep(0.005)
+            victim = server.submit(one, timeout_ms=30)  # expires while queued
+            with pytest.raises(ServingDeadlineError):
+                victim.result()
+            assert blocker.result() is not None
+            assert metrics.get(server.scope, MLMetrics.SERVING_TIMEOUTS) >= 1
+        finally:
+            server.close()
+
+    def test_graceful_drain_serves_queued_requests(self):
+        cfg = ServingConfig(max_batch_size=2, max_delay_ms=0.0, queue_capacity_rows=64)
+        server = InferenceServer(
+            _SlowEcho(delay_s=0.02), name="t-drain", serving_config=cfg,
+            warmup_template=DataFrame.from_dict({"x": np.zeros((1, 2))}),
+        )
+        one = DataFrame.from_dict({"x": np.ones((1, 2))})
+        handles = [server.submit(one) for _ in range(8)]
+        server.close(drain=True)
+        for h in handles:
+            assert h.result() is not None
+        with pytest.raises(ServingClosedError):
+            server.predict(one)
+
+    def test_hard_close_fails_queued_requests(self):
+        cfg = ServingConfig(max_batch_size=1, max_delay_ms=0.0, queue_capacity_rows=64)
+        server = InferenceServer(
+            _SlowEcho(delay_s=0.1), name="t-hardclose", serving_config=cfg,
+            warmup_template=DataFrame.from_dict({"x": np.zeros((1, 2))}),
+        )
+        one = DataFrame.from_dict({"x": np.ones((1, 2))})
+        server.submit(one)
+        deadline = time.perf_counter() + 5.0
+        while server._batcher._queued_rows and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        queued = [server.submit(one) for _ in range(3)]
+        server.close(drain=False)
+        failed = 0
+        for h in queued:
+            try:
+                h.result()
+            except ServingClosedError:
+                failed += 1
+        assert failed == 3
+
+
+# ---------------------------------------------------------------------------
+# versioned hot swap
+# ---------------------------------------------------------------------------
+class TestHotSwap:
+    def test_registry_requires_monotonic_versions(self):
+        registry = ModelRegistry("ml.serving[t-monotonic]")
+        registry.swap(3, object())
+        with pytest.raises(ValueError, match="advance"):
+            registry.swap(3, object())
+        with pytest.raises(ValueError, match="advance"):
+            registry.swap(2, object())
+
+    def test_publish_servable_versions_and_refuses_overwrite(self, tmp_path):
+        model, _ = _fit_lr()
+        d = str(tmp_path / "pub")
+        p1 = publish_servable(model, d)
+        p2 = publish_servable(model, d)
+        assert os.path.basename(p1) == "v-1" and os.path.basename(p2) == "v-2"
+        with pytest.raises(FileExistsError):
+            publish_servable(model, d, version=2)
+        # published dirs are loadable servables
+        assert load_servable(p1) is not None
+
+    def test_poller_skips_corrupt_and_falls_back_to_newest_intact(self, tmp_path):
+        model, X = _fit_lr()
+        d = str(tmp_path / "models")
+        publish_servable(model, d)  # v-1, intact
+        # v-2: present, marker exists, but unloadable (truncated metadata)
+        os.makedirs(os.path.join(d, "v-2"))
+        with open(os.path.join(d, "v-2", "metadata"), "w") as f:
+            f.write("{not json")
+        # noise the scan must ignore
+        os.makedirs(os.path.join(d, "v-3.tmp"))
+        os.makedirs(os.path.join(d, "v-9.corrupt"))
+        registry = ModelRegistry("ml.serving[t-fallback]")
+        poller = ModelVersionPoller(d, registry, interval_ms=10)
+        assert poller.poll_once() == 1  # v-2 rejected, fell back to v-1
+        assert registry.version == 1
+        assert set(poller.failed) == {2}
+        assert metrics.get(registry.scope, MLMetrics.SERVING_SWAP_FAILURES) == 1
+        # a newer intact publish still swaps in
+        publish_servable(model, d, version=4)
+        assert poller.poll_once() == 4
+        assert registry.version == 4
+
+    def test_serving_swap_fault_point_falls_back(self, tmp_path):
+        """An injected load failure (the 'serving.swap' seam) must leave the
+        in-service model untouched and fall back to an older intact version."""
+        model, _ = _fit_lr()
+        d = str(tmp_path / "models")
+        publish_servable(model, d)  # v-1
+        publish_servable(model, d)  # v-2
+        registry = ModelRegistry("ml.serving[t-fault]")
+        poller = ModelVersionPoller(d, registry, interval_ms=10)
+        faults.reset()
+        try:
+            faults.arm("serving.swap", at=1)
+            assert poller.poll_once() == 1  # v-2 load injected to fail → v-1
+            assert registry.version == 1
+            assert 2 in poller.failed and isinstance(poller.failed[2], InjectedFault)
+        finally:
+            faults.reset()
+
+    def test_swap_requires_loaded_model_data(self):
+        """A half-loaded servable (params but no model data) must fail closed
+        at warmup — before it ever becomes the serving version."""
+        from flink_ml_tpu.servable import LogisticRegressionModelServable
+
+        model, X = _fit_lr()
+        with InferenceServer(_servable(model), name="t-halfload",
+                             warmup_template=_row(X, 0)) as server:
+            empty = LogisticRegressionModelServable()  # no set_model_data
+            with pytest.raises(RuntimeError, match="set_model_data"):
+                server.swap(2, empty)
+            assert server.model_version == 1  # still serving v1
+            assert server.predict(_row(X, 0)).model_version == 1
+
+
+# ---------------------------------------------------------------------------
+# the soak: concurrent traffic + hot swap mid-run
+# ---------------------------------------------------------------------------
+class TestConcurrentSoak:
+    N_THREADS = 8
+    REQUESTS_PER_THREAD = 40
+
+    def test_soak_with_hot_swap_mid_traffic(self, tmp_path):
+        m1, X = _fit_lr(max_iter=8)
+        m2, _ = _fit_lr(max_iter=30)
+        assert not np.array_equal(m1.coefficient, m2.coefficient)
+        d = str(tmp_path / "models")
+        publish_servable(m1, d)  # v-1
+        cfg = ServingConfig(
+            max_batch_size=16, max_delay_ms=2, queue_capacity_rows=4096,
+            default_timeout_ms=60_000,
+        )
+        server = InferenceServer(name="t-soak", serving_config=cfg,
+                                 warmup_template=_row(X, 0))
+        poller = server.attach_poller(d, interval_ms=5, start=False)
+        assert poller.poll_once() == 1
+        servables = {1: load_servable(os.path.join(d, "v-1"))}
+
+        responses = {}  # (thread, i) -> ServingResponse
+        errors = []
+        started = threading.Barrier(self.N_THREADS + 1)
+
+        def client(tid):
+            try:
+                started.wait()
+                for i in range(self.REQUESTS_PER_THREAD):
+                    j = (tid * 37 + i * 13) % X.shape[0]
+                    responses[(tid, i)] = (j, server.predict(_row(X, j)))
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(t,)) for t in range(self.N_THREADS)]
+        for t in threads:
+            t.start()
+        started.wait()
+        # hot swap mid-run: publish v2 while all 8 threads hammer the server
+        time.sleep(0.05)
+        publish_servable(m2, d)  # v-2
+        assert poller.poll_once() == 2
+        servables[2] = load_servable(os.path.join(d, "v-2"))
+        for t in threads:
+            t.join()
+        server.close()
+
+        assert not errors, errors
+        # exactly one response per request — nothing lost, nothing duplicated
+        assert len(responses) == self.N_THREADS * self.REQUESTS_PER_THREAD
+        versions = {r.model_version for _, r in responses.values()}
+        assert versions == {1, 2}, f"expected traffic on both versions, saw {versions}"
+        # per-thread version monotonicity: the swap is one-way
+        for tid in range(self.N_THREADS):
+            seen = [responses[(tid, i)][1].model_version
+                    for i in range(self.REQUESTS_PER_THREAD)]
+            assert seen == sorted(seen)
+        # every response is bit-identical to the serving version's transform
+        # at the response's bucket shape — no half-loaded, no mixed versions
+        for j, resp in responses.values():
+            ref = servables[resp.model_version].transform(pad_to(_row(X, j), resp.bucket))
+            np.testing.assert_array_equal(
+                resp.dataframe["rawPrediction"], ref.take([0])["rawPrediction"]
+            )
+            np.testing.assert_array_equal(
+                resp.dataframe["prediction"], ref.take([0])["prediction"]
+            )
+            # and the hard decision agrees with the plain unbatched transform
+            np.testing.assert_array_equal(
+                resp.dataframe["prediction"],
+                servables[resp.model_version].transform(_row(X, j))["prediction"],
+            )
+        # the version gauge advanced and is scrapeable like any online model's
+        assert metrics.get(server.scope, MLMetrics.VERSION) == 2
+        assert metrics.get(server.scope, MLMetrics.SERVING_SWAPS) == 2
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+class TestServingMetrics:
+    def test_histogram_quantiles(self):
+        h = Histogram(window=100)
+        assert h.quantile(0.5) is None
+        for v in range(1, 101):
+            h.observe(float(v))
+        assert h.count == 100
+        assert h.quantile(0.0) == 1.0
+        assert h.quantile(1.0) == 100.0
+        assert 45.0 <= h.quantile(0.5) <= 55.0
+        assert h.quantile(0.99) >= 99.0
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    def test_histogram_window_drops_oldest(self):
+        h = Histogram(window=4)
+        for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+            h.observe(v)
+        assert h.count == 5  # lifetime count
+        assert sorted(h.values()) == [2.0, 3.0, 4.0, 100.0]
+
+    def test_serving_scope_is_scrapeable(self):
+        model, X = _fit_lr()
+        cfg = ServingConfig(max_batch_size=8, max_delay_ms=1, queue_capacity_rows=64)
+        with InferenceServer(_servable(model), name="t-metrics", serving_config=cfg) as server:
+            for i in range(12):
+                server.predict(_row(X, i))
+            scraped = metrics.scope(server.scope)
+        assert scraped[MLMetrics.SERVING_REQUESTS] == 12
+        assert scraped[MLMetrics.SERVING_QUEUE_DEPTH] == 0
+        assert scraped[MLMetrics.SERVING_BATCHES] >= 1
+        assert scraped[MLMetrics.VERSION] == 1
+        lat = scraped[MLMetrics.SERVING_LATENCY_MS]
+        assert isinstance(lat, Histogram) and lat.count == 12
+        assert scraped[MLMetrics.SERVING_LATENCY_P50_MS] > 0
+        assert scraped[MLMetrics.SERVING_LATENCY_P99_MS] >= scraped[MLMetrics.SERVING_LATENCY_P50_MS]
+        sizes = scraped[MLMetrics.SERVING_BATCH_SIZE]
+        assert isinstance(sizes, Histogram) and sum(sizes.values()) == 12
