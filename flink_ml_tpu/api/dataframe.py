@@ -117,8 +117,8 @@ def _normalize_column(col: Any) -> Column:
         arr = np.asarray(col)
         if arr.dtype != object:
             return arr
-    except Exception:
-        pass
+    except (ValueError, TypeError):
+        pass  # ragged / mixed content stays a Python list
     return col
 
 

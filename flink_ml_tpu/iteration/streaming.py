@@ -64,7 +64,8 @@ class WindowSchedule:
     ):
         # The cycling rule is offset_schedule's — the single source of truth the
         # resident fused path also consumes, so the two paths cannot drift.
-        from flink_ml_tpu.ops.optimizer import fused_chunk_len, offset_schedule
+        from flink_ml_tpu.ops.optimizer import fused_chunk_len
+        from flink_ml_tpu.ops.schedule import offset_schedule
 
         b = local_batch
         W = max(b, min(int(window_rows), local_rows))
@@ -92,7 +93,7 @@ class WindowSchedule:
     def padded(self, starts: np.ndarray) -> Tuple[np.ndarray, np.ndarray, int]:
         """(starts, active, n_active) padded to the fixed chunk width — the
         same padding contract as every chunked fused trainer."""
-        from flink_ml_tpu.ops.optimizer import chunked_schedule
+        from flink_ml_tpu.ops.schedule import chunked_schedule
 
         starts_c, _, active, n_active = next(
             chunked_schedule(starts, starts, len(starts), self.chunk_len)

@@ -301,7 +301,7 @@ class OneHotSparseLayout:
         (the MXU crossing path carries values as split-bf16 pairs, which
         reconstruct f32-grade precision, not f64; the SGD gate admits only
         f32 fits, but direct callers lose f64 precision here)."""
-        from flink_ml_tpu.ops.optimizer import offset_schedule
+        from flink_ml_tpu.ops.schedule import offset_schedule
 
         indices = np.asarray(indices, np.int64)
         values = np.asarray(values)

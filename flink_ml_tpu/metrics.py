@@ -50,6 +50,7 @@ class MLMetrics:
     SERVING_TIMEOUTS = "ml.serving.timeouts"  # deadline expiries, counter
     SERVING_SWAPS = "ml.serving.swaps"  # hot model swaps, counter
     SERVING_SWAP_FAILURES = "ml.serving.swap.failures"  # rejected versions, counter
+    SERVING_POLL_ERRORS = "ml.serving.poll.errors"  # poller scan failures, counter
     SERVING_BATCH_SIZE = "ml.serving.batch.size"  # pre-padding rows, histogram
     SERVING_LATENCY_MS = "ml.serving.latency.ms"  # enqueue→response, histogram
     SERVING_LATENCY_P50_MS = "ml.serving.latency.p50.ms"  # gauge from histogram

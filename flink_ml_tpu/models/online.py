@@ -15,8 +15,9 @@ training, and serving — the InMemorySourceFunction workflow.
 """
 from __future__ import annotations
 
+import time
 from collections import deque
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -290,6 +291,11 @@ class OnlineModelBase(ModelArraysMixin, Model):
     The estimator attaches the training generator via ``_attach_stream``.
     """
 
+    #: Injectable wall clock (seconds) behind the ml.model.timestamp gauge.
+    #: Class-level default; tests pin ``model.clock`` to a fixed value and
+    #: assert on the gauge without racing real time.
+    clock: Callable[[], float] = staticmethod(time.time)
+
     def __init__(self):
         super().__init__()
         self.model_version: int = 0
@@ -342,8 +348,6 @@ class OnlineModelBase(ModelArraysMixin, Model):
         """Consume up to ``n`` model snapshots (None = until the stream ends);
         returns how many were applied. Each applied snapshot bumps
         ``ml.model.version`` / ``ml.model.timestamp`` gauges."""
-        import time
-
         applied = 0
         while n is None or applied < n:
             try:
@@ -357,6 +361,6 @@ class OnlineModelBase(ModelArraysMixin, Model):
             self.version_history.append(version)
             scope = self._metric_scope()
             metrics.gauge(scope, MLMetrics.VERSION, version)
-            metrics.gauge(scope, MLMetrics.TIMESTAMP, int(time.time() * 1000))
+            metrics.gauge(scope, MLMetrics.TIMESTAMP, int(self.clock() * 1000))
             applied += 1
         return applied

@@ -56,6 +56,11 @@ from flink_ml_tpu.iteration import (
     iterate_bounded_until_termination,
 )
 from flink_ml_tpu.ops.lossfunc import LossFunc
+
+# Re-exported for the fused-trainer callers (models, iteration.streaming);
+# the schedules themselves live at the compute tier so linalg can plan
+# windows without importing this runtime-coupled module.
+from flink_ml_tpu.ops.schedule import chunked_schedule, offset_schedule
 from flink_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -205,41 +210,6 @@ def _sgd_epoch_math(
     return new_coef, mean_loss
 
 
-def offset_schedule(m: int, local_batch: int, n_epochs: int):
-    """Per-epoch (start, offset) slice schedule for a cache of ``m`` local rows.
-
-    The reference's nextBatchOffset cycling (SGD.java:265-268) is a pure function
-    of the epoch index, so the whole schedule is computed on the host and fed to
-    the fused program as scan ``xs``. This matters for compile time: a slice start
-    carried through the loop (or looked up from a carried counter) makes XLA's
-    loop optimizer blow up — minutes of compile for what executes in milliseconds;
-    starts arriving via scan xs compile in about a second.
-    """
-    starts = np.empty(n_epochs, np.int32)
-    offsets = np.empty(n_epochs, np.int32)
-    off = 0
-    for e in range(n_epochs):
-        offsets[e] = off
-        starts[e] = min(off, m - local_batch)
-        off = 0 if off + local_batch >= m else off + local_batch
-    return starts, offsets
-
-
-def chunked_schedule(starts: np.ndarray, offsets: np.ndarray, max_iter: int, chunk: int):
-    """Yield per-chunk (starts, offsets, active, n_active) views of an epoch
-    schedule, padding the last chunk to the fixed program width with inactive
-    epochs. Shared by every chunked fused trainer (SGD, MLPClassifier)."""
-    for c0 in range(0, max_iter, chunk):
-        pad = max(0, c0 + chunk - max_iter)
-        sl = slice(c0, c0 + chunk - pad)
-        yield (
-            np.concatenate([starts[sl], np.zeros(pad, np.int32)]),
-            np.concatenate([offsets[sl], np.zeros(pad, np.int32)]),
-            np.concatenate([np.ones(chunk - pad, bool), np.zeros(pad, bool)]),
-            chunk - pad,
-        )
-
-
 _TOL_CHUNK = 64  # epochs per dispatch when a tol criteria is active
 # Upper bound on epochs per dispatch without a criteria. Two regimes,
 # both measured on chip:
@@ -308,8 +278,8 @@ def _hbm_bytes_limit(ctx: Optional[MeshContext] = None) -> int:
         limit = int(stats.get("bytes_limit", 0))
         if limit > 0:
             return limit
-    except Exception:
-        pass
+    except (AttributeError, NotImplementedError, RuntimeError, TypeError, ValueError):
+        pass  # backend has no memory introspection: fall back to host RAM
     ram = _host_ram_bytes()
     if ram:
         return min(16 << 30, ram // max(1, len(devices)))

@@ -1,0 +1,48 @@
+"""Host-side epoch/minibatch schedules for the fused trainers.
+
+Pure numpy, no runtime imports — this sits at the compute tier (L1) so the
+sparse layout builder (``linalg.onehot_sparse``) can plan windows without
+pulling ``ops.optimizer`` (and with it the whole iteration runtime) into the
+servable-reachable import graph. ``ops.optimizer`` re-exports both functions
+for its callers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["offset_schedule", "chunked_schedule"]
+
+
+def offset_schedule(m: int, local_batch: int, n_epochs: int):
+    """Per-epoch (start, offset) slice schedule for a cache of ``m`` local rows.
+
+    The reference's nextBatchOffset cycling (SGD.java:265-268) is a pure function
+    of the epoch index, so the whole schedule is computed on the host and fed to
+    the fused program as scan ``xs``. This matters for compile time: a slice start
+    carried through the loop (or looked up from a carried counter) makes XLA's
+    loop optimizer blow up — minutes of compile for what executes in milliseconds;
+    starts arriving via scan xs compile in about a second.
+    """
+    starts = np.empty(n_epochs, np.int32)
+    offsets = np.empty(n_epochs, np.int32)
+    off = 0
+    for e in range(n_epochs):
+        offsets[e] = off
+        starts[e] = min(off, m - local_batch)
+        off = 0 if off + local_batch >= m else off + local_batch
+    return starts, offsets
+
+
+def chunked_schedule(starts: np.ndarray, offsets: np.ndarray, max_iter: int, chunk: int):
+    """Yield per-chunk (starts, offsets, active, n_active) views of an epoch
+    schedule, padding the last chunk to the fixed program width with inactive
+    epochs. Shared by every chunked fused trainer (SGD, MLPClassifier)."""
+    for c0 in range(0, max_iter, chunk):
+        pad = max(0, c0 + chunk - max_iter)
+        sl = slice(c0, c0 + chunk - pad)
+        yield (
+            np.concatenate([starts[sl], np.zeros(pad, np.int32)]),
+            np.concatenate([offsets[sl], np.zeros(pad, np.int32)]),
+            np.concatenate([np.ones(chunk - pad, bool), np.zeros(pad, bool)]),
+            chunk - pad,
+        )

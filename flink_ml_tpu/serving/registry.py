@@ -67,6 +67,10 @@ class ModelRegistry:
     server's scope.
     """
 
+    #: Injectable wall clock (seconds) for the ml.model.timestamp gauge —
+    #: tests pin it instead of sleeping around assertions.
+    clock: Callable[[], float] = staticmethod(time.time)
+
     def __init__(self, scope: str):
         self.scope = scope
         self._lock = threading.Lock()
@@ -94,7 +98,7 @@ class ModelRegistry:
                 )
             self._current = (version, servable)
         metrics.gauge(self.scope, MLMetrics.VERSION, version)
-        metrics.gauge(self.scope, MLMetrics.TIMESTAMP, int(time.time() * 1000))
+        metrics.gauge(self.scope, MLMetrics.TIMESTAMP, int(self.clock() * 1000))
         metrics.counter(self.scope, MLMetrics.SERVING_SWAPS)
 
 
@@ -181,8 +185,11 @@ class ModelVersionPoller:
         while not self._stop.is_set():
             try:
                 self.poll_once()
-            except Exception:  # a scan error must not kill the poller
-                pass
+            except Exception:
+                # A scan error must not kill the poller, but it must not be
+                # invisible either: ml.serving.poll.errors is the alarm for a
+                # publish directory that stopped being readable.
+                metrics.counter(self.registry.scope, MLMetrics.SERVING_POLL_ERRORS)
             self._stop.wait(self.interval_s)
 
     def stop(self) -> None:

@@ -1,84 +1,33 @@
 #!/usr/bin/env python
 """CI guard: fault-injection seams must not silently rot.
 
-Asserts, for every fault point registered in ``flink_ml_tpu.faults.FAULT_POINTS``:
+Thin shim over the graftcheck ``fault-points`` rule (tools/graftcheck/rules/
+fault_points.py): every point in ``flink_ml_tpu.faults.FAULT_POINTS`` needs a
+runtime ``faults.trip()`` call site and a test naming it, and every trip site
+must name a registered point. Kept for its entry point and ``check()``
+contract — ``tests/test_fault_points.py`` calls it; new invariants belong in
+graftcheck rules, not here.
 
-  1. the runtime has at least one ``faults.trip("<name>", ...)`` call site
-     under ``flink_ml_tpu/`` (a registered point nobody trips is dead), and
-  2. at least one test under ``tests/`` names the point (arming it or firing
-     it) — recovery paths that CI never exercises are recovery paths that
-     don't work.
-
-And conversely: every ``faults.trip(...)`` call site in the runtime names a
-registered point (a typo'd name would raise LookupError only when reached).
-
-Run directly (``python tools/check_fault_points.py``) or through the tier-1
-suite via ``tests/test_fault_points.py``.
+Run directly (``python tools/check_fault_points.py``) or via
+``python -m tools.graftcheck`` (the full suite).
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
 
-_TRIP_RE = re.compile(r"""faults\.trip\(\s*["']([^"']+)["']""")
+from tools.graftcheck.rules import fault_points as _rule  # noqa: E402
 
-
-def _py_files(root: str):
-    for dirpath, _, filenames in os.walk(root):
-        for name in filenames:
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
+__all__ = ["check", "main"]
 
 
 def check(repo_root: str = REPO_ROOT):
     """Returns (problems, trip_sites) — empty problems list means pass."""
-    sys.path.insert(0, repo_root)
-    try:
-        from flink_ml_tpu.faults import FAULT_POINTS
-    finally:
-        sys.path.pop(0)
-
-    src_root = os.path.join(repo_root, "flink_ml_tpu")
-    test_root = os.path.join(repo_root, "tests")
-
-    trip_sites = {}  # point -> [file, ...]
-    for path in _py_files(src_root):
-        if os.path.basename(path) == "faults.py":
-            continue  # the framework itself (docstrings mention trip("<name>"))
-        with open(path, encoding="utf-8") as f:
-            for point in _TRIP_RE.findall(f.read()):
-                trip_sites.setdefault(point, []).append(os.path.relpath(path, repo_root))
-
-    tested = set()
-    for path in _py_files(test_root):
-        with open(path, encoding="utf-8") as f:
-            content = f.read()
-        for point in FAULT_POINTS:
-            if point in content:
-                tested.add(point)
-
-    problems = []
-    for point in sorted(FAULT_POINTS):
-        if point not in trip_sites:
-            problems.append(
-                f"fault point {point!r} is registered but has no "
-                f"faults.trip() call site under flink_ml_tpu/"
-            )
-        if point not in tested:
-            problems.append(
-                f"fault point {point!r} is not exercised by any test under "
-                f"tests/ — its recovery path is unproven"
-            )
-    for point in sorted(trip_sites):
-        if point not in FAULT_POINTS:
-            problems.append(
-                f"faults.trip({point!r}) at {trip_sites[point]} names an "
-                f"unregistered fault point (typo?)"
-            )
-    return problems, trip_sites
+    return _rule.check(repo_root)
 
 
 def main() -> int:

@@ -1,93 +1,44 @@
 #!/usr/bin/env python
 """CI guard: the serving tier must stay runtime-free.
 
-The reference's L1 layer (``flink-ml-servable-core``/``-lib``) exists so a
-model can serve online traffic without the training runtime on the classpath.
-Our analogue: nothing under ``flink_ml_tpu/servable/`` or
-``flink_ml_tpu/serving/`` may import the training stack —
+Thin shim over the graftcheck ``layer-deps`` rule (tools/graftcheck/rules/
+layer_deps.py), which owns the layer map this guarantee is one slice of:
+nothing under ``flink_ml_tpu/servable/`` or ``flink_ml_tpu/serving/`` may
+import the training stack (iteration / execution / builder / models), lazy
+function-local imports included. Kept for its entry point and its ``check()``
+/ ``_violations_in_file()`` contract — ``tests/test_servable_imports.py`` and
+muscle memory both call it; new invariants belong in graftcheck rules, not
+here.
 
-    flink_ml_tpu.iteration   (iteration drivers, data caches)
-    flink_ml_tpu.execution   (supervisor, restart strategies)
-    flink_ml_tpu.builder     (pipeline/graph estimators)
-    flink_ml_tpu.models      (the algorithm library)
-
-The check is AST-based so function-local (lazy) imports are caught too — a
-deferred ``from flink_ml_tpu.models.linear import ...`` still drags the
-training stack into a serving process the first time a request arrives, which
-is exactly when it must not happen.
-
-Run directly (``python tools/check_servable_imports.py``) or through the
-tier-1 suite via ``tests/test_servable_imports.py``.
+Run directly (``python tools/check_servable_imports.py``) or via
+``python -m tools.graftcheck`` (the full suite).
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
 
-#: Packages whose files must honor the guarantee.
-RUNTIME_FREE_PACKAGES = ("flink_ml_tpu/servable", "flink_ml_tpu/serving")
-
-#: Training-stack roots, as dotted module prefixes.
-FORBIDDEN_PREFIXES = (
-    "flink_ml_tpu.iteration",
-    "flink_ml_tpu.execution",
-    "flink_ml_tpu.builder",
-    "flink_ml_tpu.models",
+from tools.graftcheck.rules.layer_deps import (  # noqa: E402
+    FORBIDDEN_PREFIXES,
+    RUNTIME_FREE_PACKAGES,
+    servable_check,
+    servable_violations_in_file,
 )
 
-
-def _forbidden(module: str) -> bool:
-    return any(
-        module == p or module.startswith(p + ".") for p in FORBIDDEN_PREFIXES
-    )
+__all__ = ["FORBIDDEN_PREFIXES", "RUNTIME_FREE_PACKAGES", "check", "main"]
 
 
 def _violations_in_file(path: str):
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                if _forbidden(alias.name):
-                    yield node.lineno, alias.name
-        elif isinstance(node, ast.ImportFrom):
-            module = node.module or ""
-            if node.level:  # relative import: resolve against the package
-                continue  # servable/serving have no training-stack subpackages
-            if _forbidden(module):
-                yield node.lineno, module
-            elif module == "flink_ml_tpu":
-                # ``from flink_ml_tpu import models`` style
-                for alias in node.names:
-                    if _forbidden(f"flink_ml_tpu.{alias.name}"):
-                        yield node.lineno, f"flink_ml_tpu.{alias.name}"
+    return servable_violations_in_file(path)
 
 
 def check(repo_root: str = REPO_ROOT):
     """Returns (problems, checked_files) — empty problems list means pass."""
-    problems = []
-    checked = []
-    for package in RUNTIME_FREE_PACKAGES:
-        pkg_dir = os.path.join(repo_root, package)
-        for dirpath, _, filenames in os.walk(pkg_dir):
-            for name in sorted(filenames):
-                if not name.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, name)
-                rel = os.path.relpath(path, repo_root)
-                checked.append(rel)
-                for lineno, module in _violations_in_file(path):
-                    problems.append(
-                        f"{rel}:{lineno} imports {module} — the serving tier "
-                        "must not depend on the training stack (L1 "
-                        "runtime-free guarantee)"
-                    )
-    if not checked:
-        problems.append("no files checked — package layout changed?")
-    return problems, checked
+    return servable_check(repo_root)
 
 
 def main() -> int:

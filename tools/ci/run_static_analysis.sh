@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Static-analysis gate: graftcheck over the library tree, failing fast with
+# the human-readable report before any test process spins up a device mesh.
+# See docs/static_analysis.md for the rule catalogue and suppression policy.
+set -euo pipefail
+
+ci_path="$(cd -- "$(dirname "$0")" >/dev/null 2>&1; pwd -P)"
+root_path="$(cd "${ci_path}/../.."; pwd -P)"
+cd "$root_path"
+
+echo "=== graftcheck static analysis ==="
+python -m tools.graftcheck flink_ml_tpu "$@"

@@ -13,6 +13,10 @@ export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8 --xla_cpu_collective_call_warn_stuck_timeout_seconds=30 --xla_cpu_collective_call_terminate_timeout_seconds=120"
 export PYTHONPATH="${root_path}${PYTHONPATH:+:$PYTHONPATH}"
 
+# Static analysis first: an import-layer leak or lock-order cycle should fail
+# the build in seconds, not after the full suite has run.
+"${ci_path}/run_static_analysis.sh"
+
 echo "=== unit + integration tests (8-device virtual mesh) ==="
 python -m pytest tests/ -q
 

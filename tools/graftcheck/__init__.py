@@ -1,0 +1,19 @@
+"""graftcheck — the repo's pluggable AST static-analysis suite.
+
+Usage:
+    python -m tools.graftcheck flink_ml_tpu            # human output
+    python -m tools.graftcheck --format json           # machine output
+    python -m tools.graftcheck --list-rules
+
+Importing this package loads the engine and registers the built-in rules;
+``tests/test_graftcheck.py`` runs the whole suite as part of tier-1.
+"""
+from tools.graftcheck.engine import (  # noqa: F401
+    Finding,
+    Project,
+    REGISTRY,
+    Rule,
+    register,
+    run_rules,
+)
+from tools.graftcheck import rules  # noqa: F401  (registers built-in rules)

@@ -1,0 +1,93 @@
+"""graftcheck command line: ``python -m tools.graftcheck [targets ...]``.
+
+Exit codes: 0 clean (warnings allowed), 1 error-severity findings (or any
+finding with ``--strict``), 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftcheck",
+        description="AST static analysis: layer, jit-purity, lock-order, "
+        "fault-point and error-hygiene invariants.",
+    )
+    p.add_argument(
+        "targets",
+        nargs="*",
+        default=["flink_ml_tpu"],
+        help="files or directories relative to the repo root (default: flink_ml_tpu)",
+    )
+    p.add_argument("--root", default=REPO_ROOT, help="repo root (default: autodetected)")
+    p.add_argument(
+        "--rules",
+        help="comma-separated subset of rules to run (default: all registered)",
+    )
+    p.add_argument(
+        "--severity",
+        action="append",
+        default=[],
+        metavar="RULE=LEVEL",
+        help="override a rule's severity (error|warning); repeatable",
+    )
+    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument(
+        "--strict", action="store_true", help="warnings also fail (exit 1)"
+    )
+    p.add_argument("--list-rules", action="store_true", help="print the rule catalogue")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    from tools.graftcheck.engine import REGISTRY, Project, run_rules
+    import tools.graftcheck.rules  # noqa: F401  (registration)
+
+    if args.list_rules:
+        for name in sorted(REGISTRY):
+            rule = REGISTRY[name]
+            print(f"{name:16s} [{rule.severity}] {rule.description}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    overrides = {}
+    for spec in args.severity:
+        if "=" not in spec:
+            print(f"bad --severity {spec!r} (want RULE=error|warning)", file=sys.stderr)
+            return 2
+        rule, sev = spec.split("=", 1)
+        overrides[rule.strip()] = sev.strip()
+
+    for target in args.targets:
+        if not os.path.exists(os.path.join(args.root, target)):
+            print(f"target {target!r} not found under {args.root}", file=sys.stderr)
+            return 2
+
+    project = Project(args.root, args.targets)
+    try:
+        result = run_rules(project, rules=rules, severity_overrides=overrides)
+    except (KeyError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+    else:
+        print(result.render_human())
+    if result.errors:
+        return 1
+    if args.strict and result.findings:
+        return 1
+    return 0

@@ -1,0 +1,277 @@
+"""graftcheck engine: rule registry, project model, suppressions, reporting.
+
+The framework is deliberately small: a *rule* is an object with a ``name``,
+a default ``severity``, a ``description`` and a ``run(project)`` method that
+returns :class:`Finding`s. Rules register themselves via :func:`register`;
+``tools.graftcheck.rules`` imports every rule module so importing the package
+populates the registry. The engine owns everything rule-agnostic —
+
+- parsing the target tree once into :class:`SourceFile`s (path, dotted module
+  name, source, AST),
+- ``# graftcheck: disable=<rule>[,<rule>...]`` / ``disable=all`` line
+  suppressions (same-line only, like ``noqa``),
+- severity overrides, JSON/human rendering, and the exit-code contract
+  (non-zero iff an unsuppressed *error*-severity finding exists).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import asdict, dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+__all__ = [
+    "Finding",
+    "SourceFile",
+    "Project",
+    "Rule",
+    "REGISTRY",
+    "register",
+    "run_rules",
+    "JSON_SCHEMA_VERSION",
+]
+
+JSON_SCHEMA_VERSION = 1
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``path`` is repo-relative with forward slashes so JSON
+    output is stable across platforms; ``line`` is 1-based."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    severity: str = "error"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.severity}: {self.message}"
+
+
+@dataclass
+class SourceFile:
+    path: str  # absolute
+    rel: str  # repo-relative, forward slashes
+    module: str  # dotted ("flink_ml_tpu.serving.batcher"; packages lose .__init__)
+    source: str
+    tree: ast.AST
+
+    _suppressions: Optional[Dict[int, Set[str]]] = None
+
+    @property
+    def suppressions(self) -> Dict[int, Set[str]]:
+        if self._suppressions is None:
+            self._suppressions = parse_suppressions(self.source)
+        return self._suppressions
+
+
+_SUPPRESS_RE = re.compile(r"#\s*graftcheck:\s*disable=([A-Za-z0-9_\-,\s]+)")
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """line (1-based) -> set of suppressed rule names (or {"all"})."""
+    out: Dict[int, Set[str]] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if rules:
+                out[lineno] = rules
+    return out
+
+
+class Project:
+    """The parsed analysis targets plus enough repo context for cross-cutting
+    rules (fault-points needs ``tests/``; layer-deps needs the module set)."""
+
+    def __init__(self, repo_root: str, targets: Sequence[str]):
+        self.repo_root = os.path.abspath(repo_root)
+        self.targets = list(targets)
+        self.files: List[SourceFile] = []
+        self.parse_errors: List[Finding] = []
+        for target in self.targets:
+            self._load(os.path.join(self.repo_root, target))
+        self.files.sort(key=lambda f: f.rel)
+
+    def _load(self, target: str) -> None:
+        if os.path.isfile(target):
+            self._load_file(target)
+            return
+        for dirpath, dirnames, filenames in os.walk(target):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    self._load_file(os.path.join(dirpath, name))
+
+    def _load_file(self, path: str) -> None:
+        rel = os.path.relpath(path, self.repo_root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        module = rel[: -len(".py")].replace("/", ".")
+        if module.endswith(".__init__"):
+            module = module[: -len(".__init__")]
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_errors.append(
+                Finding(
+                    rule="parse",
+                    path=rel,
+                    line=e.lineno or 1,
+                    message=f"syntax error: {e.msg}",
+                )
+            )
+            return
+        self.files.append(SourceFile(path=path, rel=rel, module=module, source=source, tree=tree))
+
+    def iter_files(self, prefix: Optional[str] = None) -> Iterable[SourceFile]:
+        """Files whose repo-relative path starts with ``prefix`` (all if None)."""
+        for f in self.files:
+            if prefix is None or f.rel.startswith(prefix):
+                yield f
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        rel = rel.replace(os.sep, "/")
+        for f in self.files:
+            if f.rel == rel:
+                return f
+        return None
+
+
+class Rule:
+    """Base class. Subclasses set ``name``/``severity``/``description`` and
+    implement ``run``; most also expose module-level helpers so shims and
+    tests can reuse the analysis without the engine."""
+
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def run(self, project: Project) -> List[Finding]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def finding(self, path: str, line: int, message: str, severity: Optional[str] = None) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=path,
+            line=line,
+            message=message,
+            severity=severity or self.severity,
+        )
+
+
+#: name -> rule instance. Populated by :func:`register` at import time of
+#: ``tools.graftcheck.rules``.
+REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the registry."""
+    rule = cls()
+    if not rule.name:
+        raise ValueError(f"{cls.__name__} has no rule name")
+    if rule.severity not in SEVERITIES:
+        raise ValueError(f"{cls.__name__}: bad severity {rule.severity!r}")
+    if rule.name in REGISTRY:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    REGISTRY[rule.name] = rule
+    return cls
+
+
+@dataclass
+class RunResult:
+    findings: List[Finding]  # unsuppressed, sorted
+    suppressed: List[Finding]
+    files_checked: int
+    rules_run: List[str]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.errors else 0
+
+    def to_json(self) -> dict:
+        by_rule: Dict[str, int] = {}
+        for f in self.findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        return {
+            "version": JSON_SCHEMA_VERSION,
+            "rules": [
+                {
+                    "name": REGISTRY[name].name,
+                    "severity": REGISTRY[name].severity,
+                    "description": REGISTRY[name].description,
+                }
+                for name in self.rules_run
+                if name in REGISTRY
+            ],
+            "findings": [asdict(f) for f in self.findings],
+            "summary": {
+                "files_checked": self.files_checked,
+                "findings": len(self.findings),
+                "errors": len(self.errors),
+                "suppressed": len(self.suppressed),
+                "by_rule": by_rule,
+            },
+        }
+
+    def render_human(self) -> str:
+        lines = []
+        for f in self.findings:
+            lines.append(f.render())
+        lines.append(
+            f"graftcheck: {len(self.findings)} finding(s) "
+            f"({len(self.errors)} error(s), {len(self.suppressed)} suppressed) "
+            f"across {self.files_checked} file(s), rules: {', '.join(self.rules_run)}"
+        )
+        return "\n".join(lines)
+
+
+def run_rules(
+    project: Project,
+    rules: Optional[Sequence[str]] = None,
+    severity_overrides: Optional[Dict[str, str]] = None,
+) -> RunResult:
+    """Run ``rules`` (default: every registered rule, sorted by name) over the
+    project, apply suppressions and severity overrides, and sort findings."""
+    names = sorted(REGISTRY) if rules is None else list(rules)
+    unknown = [n for n in names if n not in REGISTRY]
+    if unknown:
+        raise KeyError(f"unknown rule(s): {', '.join(unknown)} (have: {', '.join(sorted(REGISTRY))})")
+    overrides = severity_overrides or {}
+    for sev in overrides.values():
+        if sev not in SEVERITIES:
+            raise ValueError(f"bad severity override {sev!r}")
+
+    raw: List[Finding] = list(project.parse_errors)
+    for name in names:
+        for f in REGISTRY[name].run(project):
+            sev = overrides.get(f.rule, f.severity)
+            if sev != f.severity:
+                f = Finding(rule=f.rule, path=f.path, line=f.line, message=f.message, severity=sev)
+            raw.append(f)
+
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    by_rel = {f.rel: f for f in project.files}
+    for f in raw:
+        sf = by_rel.get(f.path)
+        rules_at_line = sf.suppressions.get(f.line, set()) if sf else set()
+        if f.rule in rules_at_line or "all" in rules_at_line:
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    key = lambda f: (f.path, f.line, f.rule, f.message)
+    return RunResult(
+        findings=sorted(kept, key=key),
+        suppressed=sorted(suppressed, key=key),
+        files_checked=len(project.files),
+        rules_run=names,
+    )

@@ -1,0 +1,11 @@
+"""Rule modules. Importing this package registers every rule with the engine
+(``tools.graftcheck.engine.REGISTRY``); a new rule = a new module here plus an
+import line below. See docs/static_analysis.md for the authoring walkthrough.
+"""
+from tools.graftcheck.rules import (  # noqa: F401  (imported for registration)
+    error_hygiene,
+    fault_points,
+    jit_purity,
+    layer_deps,
+    lock_order,
+)

@@ -1,0 +1,95 @@
+"""error-hygiene: no silently swallowed exceptions in library code.
+
+A bare ``except:`` catches ``KeyboardInterrupt``/``SystemExit`` and hides the
+stack trace of every bug below it; ``except Exception: pass`` is the same
+silence with better manners. In a fleet-scale runtime the symptom is goodput
+that degrades with no diagnostic — a poller that stops polling, a cache that
+stops spilling — so library code must either catch something *narrow* or
+*do* something (log, count a metric, re-raise) with what it caught.
+
+Flagged under the analyzed tree:
+
+- any bare ``except:``;
+- ``except Exception:`` / ``except BaseException:`` (alone or in a tuple)
+  whose body is only ``pass`` / ``...``.
+
+Exempt: handlers inside ``__del__`` — a finalizer that raises during
+interpreter teardown is strictly worse than one that swallows.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graftcheck.engine import Finding, Project, Rule, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node: ast.AST | None) -> bool:
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Attribute):
+        return type_node.attr in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return False
+
+
+def _is_silent(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring or `...`
+        return False
+    return True
+
+
+@register
+class ErrorHygieneRule(Rule):
+    name = "error-hygiene"
+    severity = "error"
+    description = (
+        "no bare `except:`; no `except Exception: pass` outside finalizers — "
+        "catch narrowly or handle (log/count/re-raise)"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in project.files:
+            func_stack: List[str] = []
+
+            def visit(node: ast.AST) -> None:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    func_stack.append(node.name)
+                    for child in ast.iter_child_nodes(node):
+                        visit(child)
+                    func_stack.pop()
+                    return
+                if isinstance(node, ast.ExceptHandler) and "__del__" not in func_stack:
+                    if node.type is None:
+                        findings.append(
+                            self.finding(
+                                sf.rel,
+                                node.lineno,
+                                "bare `except:` catches KeyboardInterrupt/SystemExit "
+                                "— name the exception(s)",
+                            )
+                        )
+                    elif _is_broad(node.type) and _is_silent(node.body):
+                        findings.append(
+                            self.finding(
+                                sf.rel,
+                                node.lineno,
+                                "`except Exception: pass` silently swallows every "
+                                "error — catch narrowly, or log/count the failure",
+                            )
+                        )
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+
+            visit(sf.tree)
+        return findings

@@ -1,0 +1,215 @@
+"""jit-purity: no host syncs or impure host calls inside jitted functions.
+
+JAX transformations assume functionally pure traced code (DrJAX,
+arXiv:2403.07128 §2); a stray ``.item()`` or ``float(tracer)`` inside a
+``jax.jit`` silently inserts a device→host sync on every call — exactly the
+goodput leak the serving tier's micro-batching exists to avoid — and
+``time.time()`` / ``np.random.*`` burn their value into the compiled
+executable at trace time, so the "dynamic" value is a constant forever after.
+
+Scope: functions *statically recognizable* as jitted inside ``ops/``,
+``models/`` and ``parallel/`` — decorated with ``jit`` / ``jax.jit`` /
+``partial(jax.jit, ...)`` (bare or called), or passed by name to a
+``jit(...)`` call in the same module. Flagged inside their bodies:
+
+- ``<x>.item()``                      — device→host sync per call
+- ``float(p)`` / ``int(p)`` / ``bool(p)`` on a function parameter
+                                      — concretizes a tracer (TracerError or sync)
+- ``np.asarray`` / ``np.array`` on a function parameter
+                                      — host materialization of a traced value
+- ``time.time()`` & friends           — trace-time constant, not a clock
+- ``np.random.*``                     — host RNG; thread a ``jax.random`` key
+- ``print(...)``                      — host I/O at trace time; use
+                                        ``jax.debug.print`` if needed
+
+Heuristic by design: a helper jitted from another module is not seen, and
+numpy on *static* values inside a jitted function is legal — which is why the
+numpy/float checks only fire on direct function parameters.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from tools.graftcheck.engine import Finding, Project, Rule, SourceFile, register
+
+SCOPE_PREFIXES = (
+    "flink_ml_tpu/ops/",
+    "flink_ml_tpu/models/",
+    "flink_ml_tpu/parallel/",
+)
+
+_TIME_ATTRS = {"time", "perf_counter", "monotonic", "time_ns", "perf_counter_ns"}
+
+
+def _is_jit_expr(node: ast.AST, jax_names: Set[str]) -> bool:
+    """``jit`` (imported from jax) or ``<jax alias>.jit``."""
+    if isinstance(node, ast.Name):
+        return node.id in jax_names
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return isinstance(node.value, ast.Name)
+    return False
+
+
+def _module_aliases(tree: ast.AST) -> Dict[str, Set[str]]:
+    """Track how numpy / time / jax.jit are spelled in this module."""
+    np_names: Set[str] = set()
+    time_names: Set[str] = set()
+    time_funcs: Set[str] = set()
+    jit_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if alias.name == "numpy":
+                    np_names.add(bound)
+                elif alias.name == "time":
+                    time_names.add(bound)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time":
+                for alias in node.names:
+                    if alias.name in _TIME_ATTRS:
+                        time_funcs.add(alias.asname or alias.name)
+            elif node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "jit":
+                        jit_names.add(alias.asname or alias.name)
+    return {"np": np_names, "time": time_names, "time_funcs": time_funcs, "jit": jit_names}
+
+
+def _is_jitted(fn: ast.AST, jit_names: Set[str]) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        if _is_jit_expr(dec, jit_names):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jit_expr(dec.func, jit_names):
+                return True  # @jax.jit(static_argnums=...)
+            is_partial = (isinstance(dec.func, ast.Name) and dec.func.id == "partial") or (
+                isinstance(dec.func, ast.Attribute) and dec.func.attr == "partial"
+            )
+            if is_partial and any(_is_jit_expr(a, jit_names) for a in dec.args):
+                return True
+    return False
+
+
+def jitted_functions(sf: SourceFile, jit_names: Set[str]) -> List[ast.AST]:
+    """FunctionDefs decorated as jitted, plus ones passed by name to a
+    ``jit(...)`` call anywhere in the module."""
+    defs: Dict[str, List[ast.AST]] = {}
+    out: List[ast.AST] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+            if _is_jitted(node, jit_names):
+                out.append(node)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func, jit_names) and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                for fn in defs.get(target.id, []):
+                    if fn not in out:
+                        out.append(fn)
+    return out
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    names.discard("self")
+    return names
+
+
+@register
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    severity = "error"
+    description = (
+        "no host syncs (.item(), float(tracer), np.asarray) or impure host "
+        "calls (time.time, np.random, print) inside jitted functions"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in project.files:
+            if not any(sf.rel.startswith(p) for p in SCOPE_PREFIXES):
+                continue
+            aliases = _module_aliases(sf.tree)
+            for fn in jitted_functions(sf, aliases["jit"]):
+                findings.extend(self._check_function(sf, fn, aliases))
+        return findings
+
+    def _check_function(self, sf: SourceFile, fn: ast.AST, aliases) -> List[Finding]:
+        out: List[Finding] = []
+        params = _param_names(fn)
+        where = f"jitted `{fn.name}`"
+
+        def flag(node: ast.AST, msg: str) -> None:
+            out.append(self.finding(sf.rel, node.lineno, f"{where}: {msg}"))
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr == "item" and not node.args:
+                    flag(node, ".item() forces a device->host sync on every call")
+                elif (
+                    isinstance(func.value, ast.Name)
+                    and func.value.id in aliases["time"]
+                    and func.attr in _TIME_ATTRS
+                ):
+                    flag(
+                        node,
+                        f"{func.value.id}.{func.attr}() is evaluated once at trace "
+                        "time and burned into the executable — pass time in as an "
+                        "argument or read it outside jit",
+                    )
+                elif (
+                    isinstance(func.value, ast.Attribute)
+                    and func.value.attr == "random"
+                    and isinstance(func.value.value, ast.Name)
+                    and func.value.value.id in aliases["np"]
+                ):
+                    flag(
+                        node,
+                        f"np.random.{func.attr} is host RNG fixed at trace time — "
+                        "thread a jax.random key instead",
+                    )
+                elif (
+                    isinstance(func.value, ast.Name)
+                    and func.value.id in aliases["np"]
+                    and func.attr in ("asarray", "array")
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params
+                ):
+                    flag(
+                        node,
+                        f"np.{func.attr}({node.args[0].id}) materializes a traced "
+                        "argument on the host — use jnp, or convert before jit",
+                    )
+            elif isinstance(func, ast.Name):
+                if func.id == "print":
+                    flag(
+                        node,
+                        "print() runs at trace time only — use jax.debug.print or "
+                        "log outside jit",
+                    )
+                elif func.id in aliases["time_funcs"]:
+                    flag(node, f"{func.id}() is a wall-clock read fixed at trace time")
+                elif (
+                    func.id in ("float", "int", "bool")
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params
+                ):
+                    flag(
+                        node,
+                        f"{func.id}({node.args[0].id}) concretizes a traced argument "
+                        "(TracerError or a silent host sync)",
+                    )
+        return out
