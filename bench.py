@@ -1089,20 +1089,40 @@ def bench_serving():
 
     from flink_ml_tpu.api.dataframe import DataFrame
     from flink_ml_tpu.metrics import MLMetrics, metrics
-    from flink_ml_tpu.servable.lib import LogisticRegressionModelServable
+    from flink_ml_tpu.servable import PipelineModelServable
+    from flink_ml_tpu.servable.lib import (
+        LogisticRegressionModelServable,
+        StandardScalerModelServable,
+    )
     from flink_ml_tpu.serving import InferenceServer, ServingConfig
 
     rng = np.random.default_rng(5)
     dim = 256
     X = rng.standard_normal((4096, dim)).astype(np.float32)
-    servable = LogisticRegressionModelServable()
-    servable.coefficient = rng.standard_normal(dim).astype(np.float32)
+
+    def make_lr(features_col="features"):
+        servable = LogisticRegressionModelServable().set_features_col(features_col)
+        servable.coefficient = rng.standard_normal(dim).astype(np.float32)
+        return servable
+
+    def make_pipeline():
+        """Depth-2 pipeline: scaler -> logistic, the fusion benchmark shape."""
+        scaler = (
+            StandardScalerModelServable()
+            .set_input_col("features")
+            .set_output_col("scaled")
+            .set_with_mean(True)
+        )
+        scaler.mean = rng.standard_normal(dim).astype(np.float32)
+        scaler.std = (np.abs(rng.standard_normal(dim)) + 0.5).astype(np.float32)
+        return PipelineModelServable([scaler, make_lr("scaled")])
 
     n_threads = 4
     requests_per_thread = 150
-    sweep = []
-    for req_rows in (1, 8, 64):
-        name = f"bench-load-{req_rows}"
+
+    def run_load(servable, name, req_rows, *, fastpath=None, pipeline_depth=None):
+        """Drive the server at saturation from n_threads clients; report
+        throughput + p50/p99 from the server's own ml.serving histogram."""
         server = InferenceServer(
             servable,
             name=name,
@@ -1111,13 +1131,15 @@ def bench_serving():
                 max_delay_ms=1.0,
                 queue_capacity_rows=8192,
                 default_timeout_ms=120_000,
+                fastpath=fastpath,
+                pipeline_depth=pipeline_depth,
             ),
             warmup_template=DataFrame.from_dict({"features": X[:1]}),
         )
         try:
             barrier = threading.Barrier(n_threads + 1)
 
-            def client(tid, req_rows=req_rows):
+            def client(tid):
                 barrier.wait()
                 for i in range(requests_per_thread):
                     j = (tid * 997 + i * 61) % (X.shape[0] - req_rows)
@@ -1139,30 +1161,58 @@ def bench_serving():
             lat = scraped[MLMetrics.SERVING_LATENCY_MS]
             total_rows = n_threads * requests_per_thread * req_rows
             batches = scraped[MLMetrics.SERVING_BATCHES]
-            sweep.append(
-                {
-                    "request_rows": req_rows,
-                    "rows_per_sec": round(total_rows / elapsed, 1),
-                    "requests_per_sec": round(
-                        n_threads * requests_per_thread / elapsed, 1
-                    ),
-                    "latency_p50_ms": round(lat.quantile(0.5), 3),
-                    "latency_p99_ms": round(lat.quantile(0.99), 3),
-                    "mean_batch_rows": round(total_rows / batches, 1),
-                    "batches": batches,
-                }
-            )
+            return {
+                "request_rows": req_rows,
+                "rows_per_sec": round(total_rows / elapsed, 1),
+                "requests_per_sec": round(
+                    n_threads * requests_per_thread / elapsed, 1
+                ),
+                "latency_p50_ms": round(lat.quantile(0.5), 3),
+                "latency_p99_ms": round(lat.quantile(0.99), 3),
+                "mean_batch_rows": round(total_rows / batches, 1),
+                "batches": batches,
+                "fused_batches": scraped.get(MLMetrics.SERVING_FUSED_BATCHES, 0),
+                "warmup_compile_ms": round(
+                    scraped.get(MLMetrics.SERVING_WARMUP_COMPILE_MS, 0.0), 1
+                ),
+            }
         finally:
             server.close()
+
+    sweep = [
+        run_load(make_lr(), f"bench-load-{req_rows}", req_rows)
+        for req_rows in (1, 8, 64)
+    ]
+
+    # Fused-vs-unfused + pipeline-depth sweep on the depth-2 pipeline: the
+    # fast-path acceptance contract is a p50 win for fastpath on at depth>=2
+    # (fused executable + device-resident weights + pipelined dispatch) over
+    # the per-stage transform path on the same pipeline.
+    fused_sweep = []
+    for fastpath, depth in ((False, 1), (True, 1), (True, 2), (True, 3)):
+        leg = run_load(
+            make_pipeline(),
+            f"bench-fused-{int(fastpath)}-d{depth}",
+            8,
+            fastpath=fastpath,
+            pipeline_depth=depth,
+        )
+        leg.update({"fastpath": fastpath, "pipeline_depth": depth})
+        fused_sweep.append(leg)
+
     return {
         "name": "serving_microbatch_lr_d256",
         "threads": n_threads,
         "requests_per_thread": requests_per_thread,
         "max_batch_size": 64,
         "sweep": sweep,
+        "fused_sweep": fused_sweep,
         "note": "end-to-end serving path (queue + micro-batch + pad + jit'd "
         "transform + slice); latency is enqueue->response per request from "
-        "the ml.serving latency histogram",
+        "the ml.serving latency histogram. fused_sweep: depth-2 "
+        "scaler->logistic pipeline, per-stage transform path (fastpath "
+        "false) vs ONE fused AOT executable per bucket with device-resident "
+        "weights, at dispatch windows 1-3",
     }
 
 

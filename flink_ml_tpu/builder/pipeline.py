@@ -115,3 +115,14 @@ class PipelineModel(Model):
     def load(cls, path: str) -> "PipelineModel":
         rw.load_metadata(path, rw.stage_class_name(cls))
         return cls(_load_stages(path))
+
+    @classmethod
+    def load_servable(cls, path: str):
+        """Runtime-free replica of the whole saved pipeline (ref
+        PipelineModelServable.java) — each stage loads through its own
+        ``load_servable`` hook, so ``publish_servable(pipeline_model, dir)``
+        feeds the serving tier directly and kernel-spec stages fuse on the
+        serving fast path (docs/serving.md)."""
+        from flink_ml_tpu.servable.builder import PipelineModelServable
+
+        return PipelineModelServable.load(path)

@@ -135,6 +135,23 @@ class Options:
         "How often ModelVersionPoller re-scans the model directory for a "
         "newer published version.",
     )
+    SERVING_FASTPATH = ConfigOption(
+        "serving.fastpath",
+        _parse_bool,
+        True,
+        "Serve through CompiledServingPlan when the servable exposes kernel "
+        "specs: fused per-bucket AOT executables with device-resident model "
+        "arrays (docs/serving.md). Off = always the per-stage transform path.",
+    )
+    SERVING_PIPELINE_DEPTH = ConfigOption(
+        "serving.pipeline.depth",
+        int,
+        2,
+        "Micro-batcher dispatch window: how many batches may be dispatched to "
+        "the device before the oldest is finalized. 2 overlaps host-side "
+        "claim/pad/scatter of batch N+1 with device execution of batch N; "
+        "1 = strict sequential. Only effective on the fast path.",
+    )
     NATIVE_DATACACHE_ENABLED = ConfigOption(
         "native.datacache.enabled",
         _parse_bool,

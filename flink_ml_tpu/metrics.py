@@ -56,6 +56,15 @@ class MLMetrics:
     SERVING_LATENCY_P50_MS = "ml.serving.latency.p50.ms"  # gauge from histogram
     SERVING_LATENCY_P99_MS = "ml.serving.latency.p99.ms"  # gauge from histogram
 
+    # Serving fast path (serving/plan.py — fused per-bucket executables).
+    SERVING_FUSED_STAGES = "ml.serving.fastpath.fused.stages"  # stages fused, gauge
+    SERVING_FALLBACK_STAGES = "ml.serving.fastpath.fallback.stages"  # per-stage, gauge
+    SERVING_FUSED_BATCHES = "ml.serving.fastpath.fused.batches"  # fused executions, counter
+    SERVING_FALLBACK_BATCHES = "ml.serving.fastpath.fallback.batches"  # ineligible batches, counter
+    SERVING_FASTPATH_COMPILES = "ml.serving.fastpath.compiles"  # post-warmup compiles (0 = healthy), counter
+    SERVING_WARMUP_COMPILE_MS = "ml.serving.fastpath.warmup.compile.ms"  # AOT warmup wall time, gauge
+    SERVING_INFLIGHT_DEPTH = "ml.serving.inflight.depth"  # dispatched-not-finalized batches, gauge
+
 
 class Histogram:
     """Bounded-window observation histogram (the DescriptiveStatisticsHistogram

@@ -3,6 +3,13 @@
 Single source for kernels used by several surfaces (training-side model, online
 model, runtime-free servable) so prediction semantics cannot diverge and each
 kernel has one jit cache entry.
+
+Each kernel's math lives in a plain (unjitted) ``*_fn`` function; the
+``*_kernel`` factories jit exactly that function. The serving fast path
+(``serving/plan.py``) composes the same ``*_fn``s into one fused per-bucket
+program, so the fused and per-stage paths trace identical operations — the
+bit-exactness contract between the two paths holds at the op level, not just
+by test.
 """
 from __future__ import annotations
 
@@ -15,10 +22,13 @@ import numpy as np
 __all__ = [
     "dot_kernel",
     "sparse_dot_kernel",
+    "logistic_from_dots_fn",
     "logistic_from_dots_kernel",
     "logistic_predict_kernel",
     "compute_dots",
+    "kmeans_assign_fn",
     "kmeans_predict_kernel",
+    "scale_fn",
     "scale_kernel",
 ]
 
@@ -46,22 +56,23 @@ def sparse_dot_kernel():
     return kernel
 
 
-@functools.cache
-def logistic_from_dots_kernel():
+def logistic_from_dots_fn(dots):
     """prediction = dot ≥ 0, rawPrediction = [1−p, p] with p = sigmoid(dot).
 
     Ref LogisticRegressionModelServable.java:62 (shared by
     LogisticRegressionModel, OnlineLogisticRegressionModel and the servable,
-    for both dense and sparse margins).
+    for both dense and sparse margins). Pure — composable into fused serving
+    programs.
     """
+    prob = jax.nn.sigmoid(dots)
+    pred = (dots >= 0).astype(dots.dtype)
+    return pred, jnp.stack([1.0 - prob, prob], axis=1)
 
-    @jax.jit
-    def kernel(dots):
-        prob = jax.nn.sigmoid(dots)
-        pred = (dots >= 0).astype(dots.dtype)
-        return pred, jnp.stack([1.0 - prob, prob], axis=1)
 
-    return kernel
+@functools.cache
+def logistic_from_dots_kernel():
+    """Jitted ``logistic_from_dots_fn`` — one cache entry for every surface."""
+    return jax.jit(logistic_from_dots_fn)
 
 
 @functools.cache
@@ -99,30 +110,42 @@ def compute_dots(df, features_col: str, coefficient) -> np.ndarray:
     return dot_kernel()(X, coef)
 
 
+def kmeans_assign_fn(measure_name: str):
+    """Pure closest-centroid assignment ``(X, centroids) -> [n] indices`` for
+    ``measure_name`` — the unjitted body of ``kmeans_predict_kernel``."""
+    from flink_ml_tpu.ops.distance import DistanceMeasure
+
+    measure = DistanceMeasure.get_instance(measure_name)
+    return measure.find_closest
+
+
 @functools.cache
 def kmeans_predict_kernel(measure_name: str):
     """Closest-centroid assignment (ref KMeansModel.java predict). One cache
     entry per distance measure, shared by KMeansModel, OnlineKMeansModel and
     KMeansModelServable."""
-    from flink_ml_tpu.ops.distance import DistanceMeasure
+    fn = kmeans_assign_fn(measure_name)
+    return jax.jit(lambda X, centroids: fn(X, centroids))
 
-    measure = DistanceMeasure.get_instance(measure_name)
-    return jax.jit(lambda X, centroids: measure.find_closest(X, centroids))
+
+def scale_fn(X, mean, inv_std, *, with_mean: bool, with_std: bool):
+    """Pure standardization math (ref StandardScalerModel.java:60-97): subtract
+    mean if ``with_mean``, multiply by inv_std if ``with_std``."""
+    out = X
+    if with_mean:
+        out = out - mean[None, :]
+    if with_std:
+        out = out * inv_std[None, :]
+    return out
 
 
 @functools.cache
 def scale_kernel(with_mean: bool, with_std: bool):
-    """Standardization transform (ref StandardScalerModel.java:60-97): subtract
-    mean if ``with_mean``, multiply by inv_std if ``with_std``. Shared by the
-    batch model, the online model and StandardScalerModelServable."""
+    """Jitted ``scale_fn`` at fixed flags. Shared by the batch model, the
+    online model and StandardScalerModelServable."""
 
     @jax.jit
     def kernel(X, mean, inv_std):
-        out = X
-        if with_mean:
-            out = out - mean[None, :]
-        if with_std:
-            out = out * inv_std[None, :]
-        return out
+        return scale_fn(X, mean, inv_std, with_mean=with_mean, with_std=with_std)
 
     return kernel

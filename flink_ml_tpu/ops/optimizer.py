@@ -1127,6 +1127,7 @@ class SGD(Optimizer):
         )
 
         if self.onehot_premat == "off":
+            self._drop_premat_memo(train_data)
             return False, ()
         n_units = lay.n_windows * lay.n_sub
         per_dev = premat_bytes(n_units, lay.n_flat, lay.row_hi) + 7 * n_units * lay.n_flat
@@ -1134,6 +1135,7 @@ class SGD(Optimizer):
             self.onehot_premat == "auto"
             and per_dev > self._ONEHOT_PREMAT_HBM_FRACTION * _hbm_bytes_limit(ctx)
         ):
+            self._drop_premat_memo(train_data)
             return False, ()
         key = (ctx.n_data, ctx.n_model, lay.dim, lay.local_batch, lay.row_hi)
         memo = getattr(train_data, "_onehot_premat_memo", None)
@@ -1147,6 +1149,15 @@ class SGD(Optimizer):
         )(stacks[1], lay.row_hi)
         train_data._onehot_premat_memo = (key, oh_stacks)
         return True, oh_stacks
+
+    @staticmethod
+    def _drop_premat_memo(train_data) -> None:
+        """Release memoized premat one-hots when a fit decides AGAINST the
+        premat path ('off', or the auto gate rejecting): the one-hots cost
+        ~73x the packed stacks, so an A/B 'off' fit must not run with a
+        previous 'on' fit's multi-GB arrays still resident on the cache."""
+        if getattr(train_data, "_onehot_premat_memo", None) is not None:
+            train_data._onehot_premat_memo = None
 
     def _premat_streamed(self, plan, n_mb, n_sub, ctx) -> bool:
         """The streamed route's premat decision. Unlike the resident gate,

@@ -13,6 +13,7 @@ from flink_ml_tpu.servable.api import (
     TransformerServable,
 )
 from flink_ml_tpu.servable.builder import PipelineModelServable
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 from flink_ml_tpu.servable.lib import (
     KMeansModelServable,
     LogisticRegressionModelServable,
@@ -23,6 +24,7 @@ __all__ = [
     "TransformerServable",
     "ModelServable",
     "ModelDataConflictError",
+    "KernelSpec",
     "PipelineModelServable",
     "LogisticRegressionModelServable",
     "KMeansModelServable",

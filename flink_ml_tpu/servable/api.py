@@ -54,6 +54,15 @@ class TransformerServable(WithParams):
     def transform(self, df: DataFrame) -> DataFrame:
         raise NotImplementedError
 
+    def kernel_spec(self):
+        """Optional pure-kernel description of ``transform`` for the serving
+        fast path (``servable/kernel_spec.py``). Returning a ``KernelSpec``
+        lets ``serving/plan.py`` fuse this stage with its neighbours into one
+        jitted per-bucket program with device-resident model arrays; returning
+        None (the default) keeps the stage on the per-stage ``transform``
+        fallback — mixed pipelines still serve, bit-exactly."""
+        return None
+
     # --- persistence (ServableReadWriteUtils.loadServableParam) -------------
     @classmethod
     def load_servable(cls, path: str) -> "TransformerServable":

@@ -1,0 +1,67 @@
+"""KernelSpec — a servable's transform as a pure, fusable device program.
+
+``TransformerServable.transform`` is a host-level contract: DataFrame in,
+DataFrame out. That is the right boundary for generality, but in a serving
+pipeline it forces a full host materialization between every pair of stages
+and re-uploads model arrays on every call. A servable that is row-wise and
+numerically pure can *additionally* describe itself as a :class:`KernelSpec`:
+
+- ``input_cols`` — the dense vector columns the kernel reads. Each is
+  ingested exactly the way ``transform`` would read it
+  (``df.vectors(col).astype(float32)``), so the fused path sees bit-identical
+  inputs.
+- ``outputs`` — ``(column name, DataType)`` pairs the kernel produces, in the
+  order ``transform`` would ``add_column`` them.
+- ``model_arrays`` — name → host ndarray, already in the dtype the kernel
+  consumes. The serving plan uploads these ONCE (at publish/warmup time) and
+  the per-request path only ever passes the committed device buffers back in.
+- ``kernel_fn(model_arrays, column_arrays) -> {name: array}`` — pure jnp math
+  from the shared ``ops/kernels.py`` ``*_fn`` bodies. It must not touch the
+  host (no ``.item()``, no numpy on traced values, no I/O): the serving plan
+  AOT-compiles consecutive specs into a per-bucket executable chain
+  (``serving/plan.py``), and anything impure would be burned in at trace time.
+
+The spec is a *snapshot*: it captures the servable's current params and model
+data at construction, which is exactly the hot-swap discipline — a published
+version is immutable, so the plan compiled from its specs stays valid for the
+version's whole serving life.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["KernelSpec"]
+
+
+class KernelSpec:
+    """Pure-kernel description of one servable stage (see module docstring)."""
+
+    __slots__ = ("input_cols", "outputs", "model_arrays", "kernel_fn")
+
+    def __init__(
+        self,
+        *,
+        input_cols: Sequence[str],
+        outputs: Sequence[Tuple[str, Any]],
+        model_arrays: Mapping[str, np.ndarray],
+        kernel_fn: Callable[[Dict[str, Any], Dict[str, Any]], Dict[str, Any]],
+    ):
+        self.input_cols: Tuple[str, ...] = tuple(input_cols)
+        self.outputs: Tuple[Tuple[str, Any], ...] = tuple(outputs)
+        self.model_arrays: Dict[str, np.ndarray] = {
+            k: np.asarray(v) for k, v in model_arrays.items()
+        }
+        self.kernel_fn = kernel_fn
+
+    @property
+    def output_names(self) -> Tuple[str, ...]:
+        return tuple(name for name, _ in self.outputs)
+
+    def __repr__(self) -> str:
+        return (
+            f"KernelSpec(inputs={list(self.input_cols)}, "
+            f"outputs={list(self.output_names)}, "
+            f"model_arrays={list(self.model_arrays)})"
+        )

@@ -21,8 +21,11 @@ from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.ops.kernels import (
     compute_dots,
+    kmeans_assign_fn,
     kmeans_predict_kernel,
+    logistic_from_dots_fn,
     logistic_from_dots_kernel,
+    scale_fn,
     scale_kernel,
 )
 from flink_ml_tpu.params.param import BoolParam
@@ -36,6 +39,7 @@ from flink_ml_tpu.params.shared import (
     HasRawPredictionCol,
 )
 from flink_ml_tpu.servable.api import ModelServable
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = [
     "LogisticRegressionModelServable",
@@ -71,6 +75,33 @@ class LogisticRegressionModelServable(
         )
         return out
 
+    def kernel_spec(self) -> KernelSpec:
+        """Dense fast-path spec: margin matmul + logistic, the same math
+        ``transform`` jits (``dot_kernel`` + ``logistic_from_dots_fn``). The
+        serving plan falls back to ``transform`` per batch when the features
+        column arrives sparse — ``compute_dots``'s padded-CSR branch stays the
+        per-stage path."""
+        if self.coefficient is None:
+            raise RuntimeError("set_model_data must be called before kernel_spec")
+        features_col = self.get_features_col()
+
+        def kernel_fn(model, cols):
+            pred, raw = logistic_from_dots_fn(cols[features_col] @ model["coefficient"])
+            return {
+                self.get_prediction_col(): pred,
+                self.get_raw_prediction_col(): raw,
+            }
+
+        return KernelSpec(
+            input_cols=(features_col,),
+            outputs=(
+                (self.get_prediction_col(), DataTypes.DOUBLE),
+                (self.get_raw_prediction_col(), DataTypes.vector(BasicType.DOUBLE)),
+            ),
+            model_arrays={"coefficient": np.asarray(self.coefficient, np.float32)},
+            kernel_fn=kernel_fn,
+        )
+
 
 class KMeansModelServable(
     ModelServable, HasFeaturesCol, HasPredictionCol, HasDistanceMeasure, HasK
@@ -98,6 +129,27 @@ class KMeansModelServable(
             self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64)
         )
         return out
+
+    def kernel_spec(self) -> KernelSpec:
+        """Closest-centroid assignment as a fusable spec — the same
+        ``find_closest`` body ``kmeans_predict_kernel`` jits, with the
+        centroids device-resident instead of re-uploaded per call."""
+        if self.centroids is None:
+            raise RuntimeError("set_model_data must be called before kernel_spec")
+        features_col = self.get_features_col()
+        assign = kmeans_assign_fn(self.get_distance_measure())
+
+        def kernel_fn(model, cols):
+            return {
+                self.get_prediction_col(): assign(cols[features_col], model["centroids"])
+            }
+
+        return KernelSpec(
+            input_cols=(features_col,),
+            outputs=((self.get_prediction_col(), DataTypes.DOUBLE),),
+            model_arrays={"centroids": np.asarray(self.centroids, np.float32)},
+            kernel_fn=kernel_fn,
+        )
 
 
 class StandardScalerModelServable(ModelServable, HasInputCol, HasOutputCol):
@@ -129,14 +181,17 @@ class StandardScalerModelServable(ModelServable, HasInputCol, HasOutputCol):
     def set_with_std(self, value: bool):
         return self.set(self.WITH_STD, value)
 
+    def _inv_std(self) -> np.ndarray:
+        """0-std features scale to 0 (the reference's guard), never divide."""
+        std = np.asarray(self.std, np.float32)
+        return np.where(std == 0.0, 0.0, 1.0 / np.where(std == 0.0, 1.0, std))
+
     def transform(self, df: DataFrame) -> DataFrame:
         if self.mean is None:
             raise RuntimeError("set_model_data must be called before transform")
         X = df.vectors(self.get_input_col()).astype(np.float32)
-        std = np.asarray(self.std, np.float32)
-        inv_std = np.where(std == 0.0, 0.0, 1.0 / np.where(std == 0.0, 1.0, std))
         out_vals = scale_kernel(self.get_with_mean(), self.get_with_std())(
-            X, np.asarray(self.mean, np.float32), inv_std
+            X, np.asarray(self.mean, np.float32), self._inv_std()
         )
         out = df.clone()
         out.add_column(
@@ -145,3 +200,33 @@ class StandardScalerModelServable(ModelServable, HasInputCol, HasOutputCol):
             np.asarray(out_vals, np.float64),
         )
         return out
+
+    def kernel_spec(self) -> KernelSpec:
+        """Standardization as a fusable spec (``scale_fn``, the body of
+        ``scale_kernel``); mean and the precomputed inverse std become
+        device-resident model arrays."""
+        if self.mean is None:
+            raise RuntimeError("set_model_data must be called before kernel_spec")
+        input_col = self.get_input_col()
+        with_mean, with_std = self.get_with_mean(), self.get_with_std()
+
+        def kernel_fn(model, cols):
+            return {
+                self.get_output_col(): scale_fn(
+                    cols[input_col],
+                    model["mean"],
+                    model["inv_std"],
+                    with_mean=with_mean,
+                    with_std=with_std,
+                )
+            }
+
+        return KernelSpec(
+            input_cols=(input_col,),
+            outputs=((self.get_output_col(), DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={
+                "mean": np.asarray(self.mean, np.float32),
+                "inv_std": self._inv_std(),
+            },
+            kernel_fn=kernel_fn,
+        )

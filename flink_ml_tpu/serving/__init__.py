@@ -10,6 +10,7 @@ Runtime-free like the servable tier it wraps: importing this package never
 pulls the training stack (enforced by tools/check_servable_imports.py).
 """
 from flink_ml_tpu.serving.batcher import MicroBatcher, bucket_for, pad_to, power_of_two_buckets
+from flink_ml_tpu.serving.plan import CompiledServingPlan, PlanExecution
 from flink_ml_tpu.serving.errors import (
     NoModelError,
     ServingClosedError,
@@ -25,6 +26,8 @@ __all__ = [
     "ServingConfig",
     "ServingResponse",
     "MicroBatcher",
+    "CompiledServingPlan",
+    "PlanExecution",
     "ModelRegistry",
     "ModelVersionPoller",
     "publish_servable",

@@ -23,12 +23,20 @@ synchronously with ``ServingOverloadedError`` (producers never block → no
 deadlock under overload). Each request carries a deadline; requests still
 queued past it are dropped with ``ServingDeadlineError``, but once claimed
 into a batch a request always gets exactly one response.
+
+Pipelined dispatch (the fast path, docs/serving.md): when the server supplies
+a ``dispatch`` callable (returning a handle whose ``result()`` performs the
+blocking readback), the loop keeps up to ``pipeline_depth`` batches in flight —
+JAX async dispatch runs batch N on the device while this thread claims, pads
+and scatters batch N+1 on the host, instead of blocking on every result.
+Claimed requests still complete exactly once and in FIFO order.
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List, Optional, Sequence, Tuple
+from collections import deque
+from typing import Callable, Deque, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -146,8 +154,14 @@ class MicroBatcher:
         queue_capacity_rows: int,
         scope: str,
         response_factory: Callable[[DataFrame, int, float, int], object],
+        dispatch: Optional[Callable[[DataFrame], Optional[object]]] = None,
+        pipeline_depth: int = 1,
     ):
         self._execute = execute
+        # Async seam: dispatch(padded_df) -> handle with .result() -> (df,
+        # version), or None to serve this batch through the sync ``execute``.
+        self._dispatch = dispatch
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self.max_batch_size = int(max_batch_size)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.queue_capacity_rows = int(queue_capacity_rows)
@@ -202,9 +216,11 @@ class MicroBatcher:
         req._abandon_cb = abandon
 
     # -- batching loop --------------------------------------------------------
-    def _claim_batch(self) -> Optional[List[PendingRequest]]:
+    def _claim_batch(self, block: bool = True) -> Optional[List[PendingRequest]]:
         """Wait for work, coalesce up to max_batch_size rows, claim FIFO.
-        Returns None only when closed and the queue is drained."""
+        Returns None only when closed and the queue is drained; with
+        ``block=False`` (batches in flight behind us) returns [] immediately
+        when the queue is empty so the loop can finalize instead of waiting."""
         with self._cond:
             while True:
                 self._reap_locked()
@@ -212,6 +228,8 @@ class MicroBatcher:
                     break
                 if self._closed:
                     return None
+                if not block:
+                    return []
                 self._cond.wait(timeout=0.05)
             # Coalescing window: hold the head request up to max_delay while
             # more arrive (or until a full batch is already waiting). A closed
@@ -261,18 +279,17 @@ class MicroBatcher:
             kept.append(req)
         self._queue[:] = kept
 
-    def _run_batch(self, claimed: List[PendingRequest]) -> None:
-        rows = sum(r.rows for r in claimed)
-        bucket = bucket_for(rows, self.buckets)
-        batch = claimed[0].df if len(claimed) == 1 else DataFrame.concat([r.df for r in claimed])
-        try:
-            out, version = self._execute(pad_to(batch, bucket))
-        except BaseException as e:  # noqa: BLE001 — delivered to each waiter
-            for req in claimed:
-                req.error = e
-                req._state = _DONE
-                req._event.set()
-            return
+    def _deliver_error(self, claimed: List[PendingRequest], e: BaseException) -> None:
+        for req in claimed:
+            req.error = e
+            req._state = _DONE
+            req._event.set()
+
+    def _deliver(
+        self, claimed: List[PendingRequest], out: DataFrame, version: int,
+        rows: int, bucket: int,
+    ) -> None:
+        """Scatter one executed batch's rows back to its waiters."""
         self.executed_batch_sizes.append((rows, bucket))
         metrics.observe(self.scope, MLMetrics.SERVING_BATCH_SIZE, rows)
         metrics.counter(self.scope, MLMetrics.SERVING_BATCHES)
@@ -290,13 +307,66 @@ class MicroBatcher:
         metrics.gauge(self.scope, MLMetrics.SERVING_LATENCY_P50_MS, hist.quantile(0.5))
         metrics.gauge(self.scope, MLMetrics.SERVING_LATENCY_P99_MS, hist.quantile(0.99))
 
+    def _run_batch(self, claimed: List[PendingRequest]) -> Optional[Tuple]:
+        """Pad and launch one batch. Returns an in-flight record
+        ``(claimed, rows, bucket, handle)`` when the batch was dispatched
+        asynchronously, or None when it was served (or failed) synchronously."""
+        rows = sum(r.rows for r in claimed)
+        bucket = bucket_for(rows, self.buckets)
+        batch = claimed[0].df if len(claimed) == 1 else DataFrame.concat([r.df for r in claimed])
+        padded = pad_to(batch, bucket)
+        if self._dispatch is not None:
+            try:
+                handle = self._dispatch(padded)
+            except BaseException as e:  # noqa: BLE001 — delivered to each waiter
+                self._deliver_error(claimed, e)
+                return None
+            if handle is not None:
+                return (claimed, rows, bucket, handle)
+        try:
+            out, version = self._execute(padded)
+        except BaseException as e:  # noqa: BLE001 — delivered to each waiter
+            self._deliver_error(claimed, e)
+            return None
+        self._deliver(claimed, out, version, rows, bucket)
+        return None
+
+    def _finalize_inflight(self, record: Tuple) -> None:
+        claimed, rows, bucket, handle = record
+        try:
+            out, version = handle.result()  # the one blocking readback
+        except BaseException as e:  # noqa: BLE001 — delivered to each waiter
+            self._deliver_error(claimed, e)
+            return
+        self._deliver(claimed, out, version, rows, bucket)
+
     def _loop(self) -> None:
+        inflight: Deque[Tuple] = deque()
+
+        def gauge_depth() -> None:
+            metrics.gauge(self.scope, MLMetrics.SERVING_INFLIGHT_DEPTH, len(inflight))
+
         while True:
-            claimed = self._claim_batch()
-            if claimed is None:
+            claimed = self._claim_batch(block=not inflight)
+            if claimed is None:  # closed and queue drained
+                while inflight:
+                    self._finalize_inflight(inflight.popleft())
+                    gauge_depth()
                 return
             if claimed:
-                self._run_batch(claimed)
+                record = self._run_batch(claimed)
+                if record is not None:
+                    inflight.append(record)
+                    gauge_depth()
+                # Keep at most pipeline_depth batches outstanding; finalizing
+                # here (not before dispatch) is what overlaps batch N's device
+                # time with batch N+1's host-side claim/pad/dispatch.
+                while len(inflight) >= self.pipeline_depth:
+                    self._finalize_inflight(inflight.popleft())
+                    gauge_depth()
+            elif inflight:  # queue idle: harvest the oldest in-flight batch
+                self._finalize_inflight(inflight.popleft())
+                gauge_depth()
 
     # -- shutdown -------------------------------------------------------------
     def close(self, drain: bool = True, timeout_s: float = 30.0) -> None:

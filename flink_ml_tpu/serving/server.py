@@ -29,9 +29,27 @@ from flink_ml_tpu.config import Options, config
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.serving.batcher import MicroBatcher, pad_to
 from flink_ml_tpu.serving.errors import NoModelError, ServingClosedError
+from flink_ml_tpu.serving.plan import CompiledServingPlan
 from flink_ml_tpu.serving.registry import ModelRegistry, ModelVersionPoller
 
 __all__ = ["ServingConfig", "ServingResponse", "InferenceServer"]
+
+#: "plan not built yet" marker distinct from "built, and it is None".
+_PLAN_UNSET = object()
+
+
+class _DispatchHandle:
+    """A dispatched fast-path batch: pairs the plan's in-flight execution with
+    the model version snapshotted at dispatch time."""
+
+    __slots__ = ("_execution", "_version")
+
+    def __init__(self, execution, version: int):
+        self._execution = execution
+        self._version = version
+
+    def result(self) -> Tuple[DataFrame, int]:
+        return self._execution.finalize(), self._version
 
 
 class ServingConfig:
@@ -46,6 +64,8 @@ class ServingConfig:
         queue_capacity_rows: Optional[int] = None,
         default_timeout_ms: Optional[float] = None,
         poll_interval_ms: Optional[float] = None,
+        fastpath: Optional[bool] = None,
+        pipeline_depth: Optional[int] = None,
     ):
         self.max_batch_size = (
             int(max_batch_size) if max_batch_size is not None
@@ -67,6 +87,14 @@ class ServingConfig:
             float(poll_interval_ms) if poll_interval_ms is not None
             else config.get(Options.SERVING_POLL_INTERVAL_MS)
         )
+        self.fastpath = (
+            bool(fastpath) if fastpath is not None
+            else config.get(Options.SERVING_FASTPATH)
+        )
+        self.pipeline_depth = (
+            int(pipeline_depth) if pipeline_depth is not None
+            else config.get(Options.SERVING_PIPELINE_DEPTH)
+        )
 
     def __repr__(self) -> str:
         return (
@@ -74,7 +102,8 @@ class ServingConfig:
             f"max_delay_ms={self.max_delay_ms}, "
             f"queue_capacity_rows={self.queue_capacity_rows}, "
             f"default_timeout_ms={self.default_timeout_ms}, "
-            f"poll_interval_ms={self.poll_interval_ms})"
+            f"poll_interval_ms={self.poll_interval_ms}, "
+            f"fastpath={self.fastpath}, pipeline_depth={self.pipeline_depth})"
         )
 
 
@@ -144,14 +173,47 @@ class InferenceServer:
             queue_capacity_rows=self.config.queue_capacity_rows,
             scope=self.scope,
             response_factory=ServingResponse,
+            dispatch=self._dispatch if self.config.fastpath else None,
+            pipeline_depth=self.config.pipeline_depth,
         )
         if servable is not None:
             self.swap(version, servable)
 
     # -- the one place a batch meets a model ----------------------------------
+    def _plan_for(self, servable) -> Optional[CompiledServingPlan]:
+        """The servable's compiled plan (built once, cached on the servable so
+        the registry's ``(version, servable)`` snapshot carries it). Normally
+        built by ``warmup`` off the serving path; a server that never saw a
+        warmup template builds it lazily on the first batch instead — that one
+        build compiles lazily per bucket and is visible as
+        ``ml.serving.fastpath.compiles``."""
+        if not self.config.fastpath:
+            return None
+        plan = getattr(servable, "_fastpath_plan", _PLAN_UNSET)
+        if plan is _PLAN_UNSET:
+            plan = CompiledServingPlan.build(servable, scope=self.scope)
+            try:
+                servable._fastpath_plan = plan
+            except AttributeError:  # __slots__ servable: serve without a plan
+                return None
+        return plan
+
     def _execute(self, padded_df: DataFrame) -> Tuple[DataFrame, int]:
         version, servable = self.registry.current()  # one snapshot per batch
+        plan = self._plan_for(servable)
+        if plan is not None:
+            return plan.execute(padded_df), version
         return servable.transform(padded_df), version
+
+    def _dispatch(self, padded_df: DataFrame):
+        """Async seam for the batcher's pipelined window: returns a handle
+        whose ``result()`` is the single blocking readback, or None to serve
+        this batch synchronously (no plan — per-stage path)."""
+        version, servable = self.registry.current()  # one snapshot per batch
+        plan = self._plan_for(servable)
+        if plan is None:
+            return None
+        return _DispatchHandle(plan.dispatch(padded_df), version)
 
     # -- client API ------------------------------------------------------------
     def predict(self, df: DataFrame, timeout_ms: Optional[float] = None) -> ServingResponse:
@@ -187,10 +249,20 @@ class InferenceServer:
         """Compile every serving shape on ``servable``: one dummy batch per
         bucket, built from the warmup template. Runs on the CALLER's thread
         (poller or swapper), never the serving path — the in-service model
-        keeps answering while the incoming one warms."""
+        keeps answering while the incoming one warms.
+
+        On the fast path this is also where the incoming version's
+        ``CompiledServingPlan`` is built (one ``device_put`` per model array)
+        and every (version, bucket) executable is AOT-compiled — all before
+        the atomic version flip, so the hot path never traces, compiles, or
+        uploads weights."""
+        plan = self._plan_for(servable)  # device-puts model arrays, off-path
         template = self._warmup_template
         if template is None:
             return  # nothing seen yet: the first real batch compiles lazily
+        if plan is not None:
+            plan.warmup(template, self._batcher.buckets)
+            return
         for bucket in self._batcher.buckets:
             servable.transform(pad_to(template, bucket))
 

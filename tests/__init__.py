@@ -1,0 +1,4 @@
+"""Test package marker: makes ``tests`` a real package so a bare ``pytest``
+invocation (no PYTHONPATH) resolves ``from tests._isolation import ...`` —
+pytest inserts the package's *parent* (the repo root) on sys.path instead of
+``tests/`` itself (ADVICE.md round 5)."""

@@ -158,6 +158,28 @@ def test_layer_deps_module_overrides_beat_package_layer(tmp_path):
     assert f.line == 2 and "ops.optimizer" in f.message
 
 
+def test_layer_deps_covers_serving_plan_at_l1(tmp_path):
+    """The serving fast path (serving/plan.py) sits at L1: composing servable
+    kernel specs and ops kernels is allowed, pulling the runtime/library
+    tiers into a fused executable is an upward import."""
+    from tools.graftcheck.rules.layer_deps import layer_of
+
+    assert layer_of("serving.plan") == 1
+    result = run_on(
+        tmp_path,
+        {
+            "flink_ml_tpu/serving/plan.py": """
+                from flink_ml_tpu.servable.kernel_spec import KernelSpec
+                from flink_ml_tpu.ops.kernels import scale_fn
+                from flink_ml_tpu.models.clustering import KMeansModel
+            """,
+        },
+        rules=["layer-deps"],
+    )
+    (f,) = result.findings
+    assert f.line == 3 and "models" in f.message and "upward" in f.message
+
+
 def test_layer_deps_flags_unmapped_package(tmp_path):
     result = run_on(
         tmp_path,
@@ -257,9 +279,19 @@ def test_jit_purity_flags_host_syncs_and_impurities(tmp_path):
 def test_jit_purity_clean_file_and_out_of_scope_package(tmp_path):
     result = run_on(tmp_path, {"flink_ml_tpu/ops/clean.py": JIT_CLEAN}, rules=["jit-purity"])
     assert result.findings == []
-    # same bad source outside ops/models/parallel is out of scope
+    # same bad source outside the scoped packages is out of scope
     result = run_on(tmp_path, {"flink_ml_tpu/utils/elsewhere.py": JIT_BAD}, rules=["jit-purity"])
     assert result.findings == []
+
+
+def test_jit_purity_covers_servable_and_serving(tmp_path):
+    """The serving fast path fuses servable kernel specs into AOT programs,
+    so an impure jitted fn in servable/ or serving/ is in scope."""
+    for i, rel in enumerate(("flink_ml_tpu/servable/bad.py", "flink_ml_tpu/serving/bad.py")):
+        root = tmp_path / f"tree{i}"
+        root.mkdir()
+        result = run_on(root, {rel: JIT_BAD}, rules=["jit-purity"])
+        assert any(".item()" in f.message for f in result.findings), rel
 
 
 # -----------------------------------------------------------------------------

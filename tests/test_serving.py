@@ -188,6 +188,20 @@ class TestShapeStability:
             assert dot_kernel()._cache_size() == after_first
             executed = {b for _, b in server.executed_batch_sizes}
             assert executed <= set(buckets)
+            # fast path: the whole sweep ran on fused executables with ZERO
+            # post-warmup XLA compiles (ml.serving.fastpath.compiles is the
+            # lazy-compile alarm; the first batch builds the plan lazily —
+            # no warmup template was given — and every later batch hits the
+            # compiled per-bucket cache)
+            fused = metrics.get(server.scope, MLMetrics.SERVING_FUSED_BATCHES)
+            lazy = metrics.get(server.scope, MLMetrics.SERVING_FASTPATH_COMPILES) or 0
+            assert fused == len(server.executed_batch_sizes)
+            assert lazy <= len(buckets)  # at most the first hit of each bucket
+            before_recompiles = lazy
+            sweep()
+            # steady state: repeating the sweep compiles nothing on the fast path
+            assert (metrics.get(server.scope, MLMetrics.SERVING_FASTPATH_COMPILES) or 0) \
+                == before_recompiles
 
     def test_swap_warms_every_bucket_before_serving(self):
         from flink_ml_tpu.ops.kernels import dot_kernel
@@ -414,18 +428,28 @@ class TestConcurrentSoak:
         for t in threads:
             t.start()
         started.wait()
-        # hot swap mid-run: publish v2 while all 8 threads hammer the server
-        time.sleep(0.05)
+        # hot swap mid-run: publish v2 while the 8 threads hammer the server.
+        # The fused fast path both serves faster and AOT-compiles the incoming
+        # version at swap, so guarantee v1/v2 traffic structurally instead of
+        # by sleep: swap after some v1 responses exist, then drive a few
+        # requests from this thread strictly after the flip.
+        deadline = time.perf_counter() + 30.0
+        while len(responses) < self.N_THREADS and time.perf_counter() < deadline:
+            time.sleep(0.001)
         publish_servable(m2, d)  # v-2
         assert poller.poll_once() == 2
         servables[2] = load_servable(os.path.join(d, "v-2"))
+        for k in range(4):  # post-swap traffic: must all be v2
+            j = (k * 29) % X.shape[0]
+            responses[("post-swap", k)] = (j, server.predict(_row(X, j)))
+            assert responses[("post-swap", k)][1].model_version == 2
         for t in threads:
             t.join()
         server.close()
 
         assert not errors, errors
         # exactly one response per request — nothing lost, nothing duplicated
-        assert len(responses) == self.N_THREADS * self.REQUESTS_PER_THREAD
+        assert len(responses) == self.N_THREADS * self.REQUESTS_PER_THREAD + 4
         versions = {r.model_version for _, r in responses.values()}
         assert versions == {1, 2}, f"expected traffic on both versions, saw {versions}"
         # per-thread version monotonicity: the swap is one-way

@@ -1,0 +1,414 @@
+"""Serving fast path (serving/plan.py) — the compiled-plan contract:
+
+- **bit-exact fusion**: a pure pipeline's fused per-bucket executable produces
+  results bit-identical to the per-stage ``transform`` chain, for depth-1,
+  multi-stage, and mixed (fallback) pipelines, and across a hot swap;
+- **zero hot-path cost**: after warmup the serving path never XLA-compiles
+  and never ``device_put``s model arrays — weights are committed device
+  buffers from publish time;
+- **per-batch fallback**: a batch the compiled signature cannot take (sparse
+  features) silently serves through the per-stage path, bit-exactly, and is
+  counted;
+- **pipelined dispatch**: a two-deep dispatch window returns the same results
+  as strict sequential execution under concurrent load.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.servable import (
+    KMeansModelServable,
+    LogisticRegressionModelServable,
+    PipelineModelServable,
+    StandardScalerModelServable,
+)
+from flink_ml_tpu.servable.api import TransformerServable
+from flink_ml_tpu.serving import (
+    CompiledServingPlan,
+    InferenceServer,
+    ServingConfig,
+    pad_to,
+    power_of_two_buckets,
+)
+
+RNG = np.random.default_rng(23)
+DIM = 6  # distinctive width so jit-cache assertions don't collide with other tests
+
+
+def _scaler(seed=0, dim=DIM):
+    rng = np.random.default_rng(seed)
+    sc = StandardScalerModelServable().set_input_col("features").set_output_col("scaled")
+    sc.mean = rng.normal(size=dim)
+    sc.std = np.abs(rng.normal(size=dim)) + 0.5
+    sc.std[1] = 0.0  # exercise the zero-std guard in both paths
+    sc.set_with_mean(True)
+    return sc
+
+
+def _lr(seed=1, features_col="scaled", dim=DIM):
+    rng = np.random.default_rng(seed)
+    lr = LogisticRegressionModelServable().set_features_col(features_col)
+    lr.coefficient = rng.normal(size=dim)
+    return lr
+
+
+def _kmeans(seed=2, features_col="scaled", dim=DIM):
+    rng = np.random.default_rng(seed)
+    km = KMeansModelServable().set_features_col(features_col).set_prediction_col("cluster")
+    km.centroids = rng.normal(size=(3, dim))
+    km.weights = np.ones(3)
+    return km
+
+
+class _Echo(TransformerServable):
+    """Spec-less stage — forces a fallback segment in mixed pipelines."""
+
+    def transform(self, df):
+        return df.clone()
+
+
+def _features(n, seed=3):
+    return DataFrame.from_dict(
+        {"features": np.random.default_rng(seed).normal(size=(n, DIM))}
+    )
+
+
+def _assert_frames_bitexact(a: DataFrame, b: DataFrame):
+    assert a.get_column_names() == b.get_column_names()
+    for name in a.get_column_names():
+        ca, cb = np.asarray(a[name]), np.asarray(b[name])
+        assert ca.dtype == cb.dtype, name
+        np.testing.assert_array_equal(ca, cb, err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# plan-level parity
+# ---------------------------------------------------------------------------
+class TestPlanParity:
+    BUCKETS = power_of_two_buckets(16)
+
+    def _check(self, servable, df):
+        plan = CompiledServingPlan.build(servable, scope="ml.serving[t-parity]")
+        assert plan is not None
+        plan.warmup(df.take([0]), self.BUCKETS)
+        for bucket in self.BUCKETS:
+            padded = pad_to(df, bucket) if bucket >= len(df) else df.take(
+                np.arange(bucket)
+            )
+            _assert_frames_bitexact(servable.transform(padded), plan.execute(padded))
+        return plan
+
+    def test_depth1_pipelines_each_servable(self):
+        df = _features(8)
+        self._check(_scaler(), df)
+        self._check(_lr(features_col="features"), df)
+        self._check(_kmeans(features_col="features"), df)
+
+    def test_pure_pipeline_fuses_to_one_segment(self):
+        pipe = PipelineModelServable([_scaler(), _lr(), _kmeans()])
+        df = _features(8)
+        plan = self._check(pipe, df)
+        assert len(plan.segments) == 1  # all three stages in ONE executable chain
+        assert metrics.get("ml.serving[t-parity]", MLMetrics.SERVING_FUSED_STAGES) == 3
+
+    @pytest.mark.parametrize("dim", [8, 16, 256])
+    def test_parity_at_reduction_sensitive_widths(self, dim):
+        """Regression for the whole-chain-program design: at widths >= 8 XLA
+        fuses a scaler's elementwise math into a following dot reduction and
+        moves the margin by 100s of ulps. The per-stage executable chain must
+        stay bit-exact at exactly those widths."""
+        pipe = PipelineModelServable(
+            [_scaler(dim=dim), _lr(dim=dim), _kmeans(dim=dim)]
+        )
+        df = DataFrame.from_dict(
+            {"features": np.random.default_rng(dim).normal(size=(16, dim))}
+        )
+        plan = CompiledServingPlan.build(pipe, scope=f"ml.serving[t-ulp{dim}]")
+        plan.warmup(df.take([0]), (4, 16))
+        for bucket in (4, 16):
+            padded = df.take(np.arange(bucket))
+            _assert_frames_bitexact(pipe.transform(padded), plan.execute(padded))
+
+    def test_mixed_pipeline_falls_back_per_stage(self):
+        pipe = PipelineModelServable([_scaler(), _Echo(), _lr()])
+        df = _features(8)
+        plan = self._check(pipe, df)
+        assert len(plan.segments) == 3  # fused / fallback / fused
+        scope = "ml.serving[t-parity]"
+        assert metrics.get(scope, MLMetrics.SERVING_FUSED_STAGES) == 2
+        assert metrics.get(scope, MLMetrics.SERVING_FALLBACK_STAGES) == 1
+
+    def test_speclss_servable_builds_no_plan(self):
+        assert CompiledServingPlan.build(_Echo()) is None
+        assert CompiledServingPlan.build(PipelineModelServable([_Echo(), _Echo()])) is None
+
+    def test_sparse_batch_falls_back_bitexact(self):
+        lr = _lr(features_col="features")
+        plan = CompiledServingPlan.build(lr, scope="ml.serving[t-sparse]")
+        dense_template = _features(1)
+        plan.warmup(dense_template, (1, 4))
+        before = metrics.get("ml.serving[t-sparse]", MLMetrics.SERVING_FALLBACK_BATCHES) or 0
+        sparse_df = DataFrame.from_dict(
+            {"features": [SparseVector(DIM, [0, 3], [1.5, -2.0]) for _ in range(4)]}
+        )
+        _assert_frames_bitexact(lr.transform(sparse_df), plan.execute(sparse_df))
+        after = metrics.get("ml.serving[t-sparse]", MLMetrics.SERVING_FALLBACK_BATCHES)
+        assert after == before + 1
+
+    def test_sparse_warmup_template_still_swaps_and_serves(self):
+        """A sparse features template must not poison warmup/swap: the fused
+        segment warms through the per-stage path and traffic serves via the
+        counted per-batch fallback — PR 2's sparse serving keeps working."""
+        lr = _lr(features_col="features")
+        ref = _lr(features_col="features")
+        row = [SparseVector(DIM, [1, 4], [0.5, 2.0])]
+        template = DataFrame.from_dict({"features": row})
+        cfg = ServingConfig(max_batch_size=4, max_delay_ms=0.0)
+        with InferenceServer(lr, name="t-sparse-warm", serving_config=cfg,
+                             warmup_template=template) as server:
+            resp = server.predict(DataFrame.from_dict({"features": row * 2}))
+            expected = ref.transform(
+                pad_to(DataFrame.from_dict({"features": row * 2}), resp.bucket)
+            ).take([0, 1])
+            _assert_frames_bitexact(resp.dataframe, expected)
+
+    def test_warmup_compiles_every_bucket_and_reports(self):
+        pipe = PipelineModelServable([_scaler(), _lr()])
+        plan = CompiledServingPlan.build(pipe, scope="ml.serving[t-warm]")
+        plan.warmup(_features(1), self.BUCKETS)
+        seg = plan.segments[0]
+        assert set(seg.compiled) == set(self.BUCKETS)
+        assert metrics.get("ml.serving[t-warm]", MLMetrics.SERVING_WARMUP_COMPILE_MS) > 0
+
+
+# ---------------------------------------------------------------------------
+# server-level: the zero-cost hot path
+# ---------------------------------------------------------------------------
+class TestHotPathIsCold:
+    def test_zero_compiles_and_zero_weight_uploads_after_warmup(self, monkeypatch):
+        """After warmup the fast path must never trace/compile an executable
+        nor device_put weights: compiles are blocked outright and
+        ``jax.device_put`` is poisoned for the whole traffic phase."""
+        import jax
+
+        pipe = PipelineModelServable([_scaler(), _lr()])
+        ref = PipelineModelServable([_scaler(), _lr()])  # untouched reference
+        cfg = ServingConfig(max_batch_size=16, max_delay_ms=0.0, queue_capacity_rows=256)
+        X = np.asarray(_features(16)["features"])
+        with InferenceServer(
+            pipe, name="t-cold", serving_config=cfg,
+            warmup_template=_features(1),
+        ) as server:
+            plan = pipe._fastpath_plan
+            assert plan is not None
+
+            def no_compile(*a, **k):
+                raise AssertionError("XLA compile on the hot path after warmup")
+
+            for segment in plan.segments:
+                for jitted in segment.stage_jits:
+                    monkeypatch.setattr(jitted, "lower", no_compile, raising=False)
+
+            def no_device_put(*a, **k):
+                raise AssertionError("device_put on the hot path after warmup")
+
+            monkeypatch.setattr(jax, "device_put", no_device_put)
+
+            for n in list(range(1, 17)) + list(range(1, 17)):
+                df = DataFrame.from_dict({"features": X[:n]})
+                resp = server.predict(df)
+                expected = ref.transform(pad_to(df, resp.bucket)).take(
+                    np.arange(n)
+                )
+                _assert_frames_bitexact(resp.dataframe, expected)
+            scope = server.scope
+        assert not metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES)
+        assert metrics.get(scope, MLMetrics.SERVING_FUSED_BATCHES) >= 32
+
+    def test_fastpath_off_serves_identically(self):
+        pipe = PipelineModelServable([_scaler(), _lr()])
+        df = _features(5)
+        cfg_off = ServingConfig(max_batch_size=8, max_delay_ms=0.0, fastpath=False)
+        cfg_on = ServingConfig(max_batch_size=8, max_delay_ms=0.0, fastpath=True)
+        with InferenceServer(pipe, name="t-off", serving_config=cfg_off,
+                             warmup_template=df.take([0])) as off:
+            resp_off = off.predict(df)
+        with InferenceServer(pipe, name="t-on", serving_config=cfg_on,
+                             warmup_template=df.take([0])) as on:
+            resp_on = on.predict(df)
+        assert resp_off.bucket == resp_on.bucket
+        _assert_frames_bitexact(resp_off.dataframe, resp_on.dataframe)
+
+
+# ---------------------------------------------------------------------------
+# pipelined dispatch window
+# ---------------------------------------------------------------------------
+class TestPipelinedDispatch:
+    @pytest.mark.parametrize("depth", [1, 2, 3])
+    def test_depth_sweep_same_results(self, depth):
+        pipe = PipelineModelServable([_scaler(), _lr()])
+        ref = PipelineModelServable([_scaler(), _lr()])
+        cfg = ServingConfig(
+            max_batch_size=8, max_delay_ms=1.0, queue_capacity_rows=1024,
+            pipeline_depth=depth, default_timeout_ms=60_000,
+        )
+        X = np.asarray(_features(64, seed=depth)["features"])
+        results = {}
+        errors = []
+        with InferenceServer(pipe, name=f"t-depth{depth}", serving_config=cfg,
+                             warmup_template=_features(1)) as server:
+
+            def client(tid):
+                try:
+                    for i in range(24):
+                        j = (tid * 17 + i * 5) % X.shape[0]
+                        results[(tid, i)] = (j, server.predict(
+                            DataFrame.from_dict({"features": X[j : j + 1]})
+                        ))
+                except BaseException as e:  # noqa: BLE001 — surfaced below
+                    errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(t,)) for t in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+        assert len(results) == 96
+        for j, resp in results.values():
+            expected = ref.transform(
+                pad_to(DataFrame.from_dict({"features": X[j : j + 1]}), resp.bucket)
+            ).take([0])
+            _assert_frames_bitexact(resp.dataframe, expected)
+
+    def test_inflight_gauge_drains_to_zero(self):
+        pipe = PipelineModelServable([_scaler(), _lr()])
+        cfg = ServingConfig(max_batch_size=4, max_delay_ms=0.0, pipeline_depth=2)
+        with InferenceServer(pipe, name="t-inflight", serving_config=cfg,
+                             warmup_template=_features(1)) as server:
+            for _ in range(8):
+                server.predict(_features(2))
+            scope = server.scope
+        assert metrics.get(scope, MLMetrics.SERVING_INFLIGHT_DEPTH) == 0
+
+
+# ---------------------------------------------------------------------------
+# publish → serve for whole trained pipelines
+# ---------------------------------------------------------------------------
+class TestPublishedPipelineServes:
+    def test_trained_pipeline_publishes_loads_and_fuses(self, tmp_path):
+        """``publish_servable(pipeline_model, dir)`` must round-trip into a
+        servable pipeline (PipelineModel.load_servable) whose kernel-spec
+        stages fuse on the fast path."""
+        from flink_ml_tpu.builder.pipeline import Pipeline
+        from flink_ml_tpu.models.classification.logistic_regression import (
+            LogisticRegression,
+        )
+        from flink_ml_tpu.models.feature.standard_scaler import StandardScaler
+        from flink_ml_tpu.serving import publish_servable
+
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(64, DIM))
+        y = (X @ np.ones(DIM) > 0).astype(np.float64)
+        train = DataFrame.from_dict({"features": X, "label": y})
+        model = Pipeline(
+            [
+                StandardScaler().set_input_col("features").set_output_col("scaled"),
+                LogisticRegression()
+                .set_features_col("scaled")
+                .set_max_iter(3)
+                .set_global_batch_size(64),
+            ]
+        ).fit(train)
+        d = str(tmp_path / "models")
+        publish_servable(model, d)
+        with InferenceServer(name="t-pub-pipe",
+                             warmup_template=DataFrame.from_dict({"features": X[:1]})
+                             ) as server:
+            poller = server.attach_poller(d, start=False)
+            assert poller.poll_once() == 1, poller.failed
+            resp = server.predict(DataFrame.from_dict({"features": X[:2]}))
+            served = PipelineModelServable.load(f"{d}/v-1")
+            assert isinstance(served, PipelineModelServable)
+            expected = served.transform(
+                pad_to(DataFrame.from_dict({"features": X[:2]}), resp.bucket)
+            ).take([0, 1])
+            _assert_frames_bitexact(resp.dataframe, expected)
+            assert metrics.get(server.scope, MLMetrics.SERVING_FUSED_STAGES) == 2
+
+
+# ---------------------------------------------------------------------------
+# hot swap mid-traffic against the fused path
+# ---------------------------------------------------------------------------
+class TestFusedHotSwapSoak:
+    N_THREADS = 6
+    REQUESTS_PER_THREAD = 30
+
+    def test_fused_soak_with_hot_swap(self):
+        pipe_v1 = PipelineModelServable([_scaler(seed=10), _lr(seed=11)])
+        pipe_v2 = PipelineModelServable([_scaler(seed=20), _lr(seed=21)])
+        refs = {
+            1: PipelineModelServable([_scaler(seed=10), _lr(seed=11)]),
+            2: PipelineModelServable([_scaler(seed=20), _lr(seed=21)]),
+        }
+        X = np.asarray(_features(64, seed=9)["features"])
+        cfg = ServingConfig(
+            max_batch_size=16, max_delay_ms=2.0, queue_capacity_rows=4096,
+            default_timeout_ms=60_000, pipeline_depth=2,
+        )
+        server = InferenceServer(pipe_v1, name="t-fused-soak", serving_config=cfg,
+                                 warmup_template=_features(1))
+        responses = {}
+        errors = []
+        started = threading.Barrier(self.N_THREADS + 1)
+
+        def client(tid):
+            try:
+                started.wait()
+                for i in range(self.REQUESTS_PER_THREAD):
+                    j = (tid * 37 + i * 13) % X.shape[0]
+                    responses[(tid, i)] = (j, server.predict(
+                        DataFrame.from_dict({"features": X[j : j + 1]})
+                    ))
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(t,)) for t in range(self.N_THREADS)]
+        try:
+            for t in threads:
+                t.start()
+            started.wait()
+            deadline = time.perf_counter() + 30.0
+            while len(responses) < self.N_THREADS and time.perf_counter() < deadline:
+                time.sleep(0.001)
+            server.swap(2, pipe_v2)  # warms + AOT-compiles, then flips
+            for k in range(4):
+                j = (k * 31) % X.shape[0]
+                responses[("post-swap", k)] = (j, server.predict(
+                    DataFrame.from_dict({"features": X[j : j + 1]})
+                ))
+                assert responses[("post-swap", k)][1].model_version == 2
+            for t in threads:
+                t.join()
+        finally:
+            server.close()
+        assert not errors, errors
+        assert len(responses) == self.N_THREADS * self.REQUESTS_PER_THREAD + 4
+        versions = {r.model_version for _, r in responses.values()}
+        assert versions == {1, 2}
+        for tid in range(self.N_THREADS):
+            seen = [responses[(tid, i)][1].model_version
+                    for i in range(self.REQUESTS_PER_THREAD)]
+            assert seen == sorted(seen)
+        # bit-exact against the matching version's PER-STAGE transform at the
+        # response's bucket — the fused/hot-swap parity contract
+        for j, resp in responses.values():
+            expected = refs[resp.model_version].transform(
+                pad_to(DataFrame.from_dict({"features": X[j : j + 1]}), resp.bucket)
+            ).take([0])
+            _assert_frames_bitexact(resp.dataframe, expected)

@@ -8,9 +8,11 @@ goodput leak the serving tier's micro-batching exists to avoid — and
 executable at trace time, so the "dynamic" value is a constant forever after.
 
 Scope: functions *statically recognizable* as jitted inside ``ops/``,
-``models/`` and ``parallel/`` — decorated with ``jit`` / ``jax.jit`` /
-``partial(jax.jit, ...)`` (bare or called), or passed by name to a
-``jit(...)`` call in the same module. Flagged inside their bodies:
+``models/``, ``parallel/``, ``servable/`` and ``serving/`` (the serving fast
+path composes servable kernel specs into fused AOT executables — an impure
+call there is burned into every per-bucket program) — decorated with ``jit``
+/ ``jax.jit`` / ``partial(jax.jit, ...)`` (bare or called), or passed by name
+to a ``jit(...)`` call in the same module. Flagged inside their bodies:
 
 - ``<x>.item()``                      — device→host sync per call
 - ``float(p)`` / ``int(p)`` / ``bool(p)`` on a function parameter
@@ -37,6 +39,8 @@ SCOPE_PREFIXES = (
     "flink_ml_tpu/ops/",
     "flink_ml_tpu/models/",
     "flink_ml_tpu/parallel/",
+    "flink_ml_tpu/servable/",
+    "flink_ml_tpu/serving/",
 )
 
 _TIME_ATTRS = {"time", "perf_counter", "monotonic", "time_ns", "perf_counter_ns"}

@@ -17,9 +17,13 @@ Three modules live at a different layer than their package (``MODULE_LAYERS``):
 ``ops.optimizer`` / ``native.cache`` / ``parallel.datastream_utils`` are
 runtime-coupled (they import the iteration tier) and sit at L2, which is why
 ``ops/kernels.py`` — not ``ops/optimizer.py`` — is what the servable tier may
-use. Imports *within* one top-level subpackage are not layered (a package's
-internal structure is its own business), and an import of an unmapped
-``flink_ml_tpu`` subpackage is itself a finding so the map cannot silently rot.
+use. ``serving.plan`` (the compiled fast path) deliberately sits at the
+package's L1: it composes ``servable`` kernel specs and ``ops/kernels.py``
+``*_fn`` bodies only, so the runtime-free guarantee covers the fused
+executables too. Imports *within* one top-level subpackage are not layered (a
+package's internal structure is its own business), and an import of an
+unmapped ``flink_ml_tpu`` subpackage is itself a finding so the map cannot
+silently rot.
 
 This rule generalizes and absorbs ``tools/check_servable_imports.py``: the L1
 runtime-free guarantee (servable/serving never import iteration / execution /
