@@ -82,6 +82,26 @@ def _median_time(fn, repeats=5):
     return sorted(times)[len(times) // 2]
 
 
+def _median_time_spread(fn, repeats=5):
+    """Same protocol as :func:`_median_time`, but also returns the min/max
+    window so readers of the JSON see the box's noise next to the headline."""
+    fn()  # warm-up: XLA compile
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    median = times[len(times) // 2]
+    spread = {
+        "min_s": round(times[0], 4),
+        "median_s": round(median, 4),
+        "max_s": round(times[-1], 4),
+        "repeats": repeats,
+    }
+    return median, spread
+
+
 def cpu_env() -> dict:
     """The baseline environment record: which CPU, how many cores, how loaded.
     The reference fixes its measurement procedure (BenchmarkUtils.java:132-143);
@@ -1216,6 +1236,177 @@ def bench_serving():
     }
 
 
+def bench_pipeline_batch_transform():
+    """Batch transform fast path (docs/batch_transform.md): fused chunked
+    CompiledBatchPlan vs the per-stage transform path on a 6-stage feature
+    chain (scaler → normalizer → weighting product → idf → rescale →
+    binarizer), 400k x 32 (columns several times last-level cache, so both legs run at DRAM bandwidth and the fused plan's ~2x traffic advantage is what the ratio measures).
+
+    The per-stage path pays, per stage: a host gather + f64 astype of its
+    input column, a jit dispatch, a blocking ``np.asarray`` readback and a
+    full host DataFrame materialization. The fused plan pays one ingest + one
+    readback per chunk with columns staying device-resident across all six
+    stages (the five elementwise stages merge into reduction-free XLA
+    programs; the normalizer's row-norm reduction keeps its own), and
+    overlaps chunk j+1's host ingest with chunk j's execution
+    (``batch.prefetch.depth``). Reports rows/s for both legs plus a
+    chunk-rows × prefetch-depth sweep with p50 per-chunk latency from the
+    plan's own ``ml.batch.fastpath`` histogram.
+
+    On a single-core host the whole bench runs with synchronous CPU dispatch
+    (restored on exit): the async dispatch thread buys no overlap with one
+    core — both legs block on every readback anyway — and its context
+    switches tax the fused path's many short program calls 30-40%.
+    """
+    import os
+
+    import jax
+
+    if (os.cpu_count() or 1) == 1:
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+        try:
+            return _bench_pipeline_batch_transform_body()
+        finally:
+            jax.config.update("jax_cpu_enable_async_dispatch", True)
+    return _bench_pipeline_batch_transform_body()
+
+
+def _bench_pipeline_batch_transform_body():
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.builder.batch_plan import CompiledBatchPlan
+    from flink_ml_tpu.config import Options, config
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.models.feature.binarizer import Binarizer
+    from flink_ml_tpu.models.feature.elementwise_product import ElementwiseProduct
+    from flink_ml_tpu.models.feature.idf import IDFModel
+    from flink_ml_tpu.models.feature.normalizer import Normalizer
+    from flink_ml_tpu.models.feature.standard_scaler import StandardScalerModel
+
+    rng = np.random.default_rng(9)
+    n, d = 400_000, 32
+    df = DataFrame.from_dict({"input": rng.standard_normal((n, d))})
+
+    scaler = StandardScalerModel().set_input_col("input").set_output_col("scaled")
+    scaler.set_with_mean(True)
+    scaler.mean = rng.standard_normal(d)
+    scaler.std = np.abs(rng.standard_normal(d)) + 0.5
+    idf = IDFModel().set_input_col("weighted").set_output_col("tfidf")
+    idf.idf = np.abs(rng.standard_normal(d)) + 0.2
+    idf.doc_freq = np.ones(d)
+    idf.num_docs = np.asarray(float(n))
+    rescale = StandardScalerModel().set_input_col("tfidf").set_output_col("rescaled")
+    rescale.set_with_mean(False)
+    rescale.mean = np.zeros(d)
+    rescale.std = np.abs(rng.standard_normal(d)) + 0.5
+    stages = [
+        scaler,
+        Normalizer().set_input_col("scaled").set_output_col("norm"),
+        ElementwiseProduct()
+        .set_scaling_vec(np.abs(rng.standard_normal(d)) + 0.1)
+        .set_input_col("norm")
+        .set_output_col("weighted"),
+        idf,
+        rescale,
+        Binarizer()
+        .set_input_cols("rescaled")
+        .set_output_cols("bin")
+        .set_thresholds(0.05),
+    ]
+
+    def run_per_stage():
+        out = df
+        for stage in stages:
+            out = stage.transform(out)
+        return out
+
+    def fused_leg(chunk_rows, depth, scope):
+        config.set(Options.BATCH_CHUNK_ROWS, chunk_rows)
+        config.set(Options.BATCH_PREFETCH_DEPTH, depth)
+        try:
+            plan = CompiledBatchPlan.build(stages, scope=scope)
+            plan.transform(df)  # warm: compiles both chunk signatures
+            t, spread = _median_time_spread(lambda: plan.transform(df), repeats=3)
+            hist = metrics.get(scope, MLMetrics.BATCH_CHUNK_MS)
+            return {
+                "chunk_rows": chunk_rows,
+                "prefetch_depth": depth,
+                "rows_per_sec": round(n / t, 1),
+                "spread": spread,
+                "chunk_p50_ms": round(hist.quantile(0.5), 3) if hist else None,
+                "compiles": metrics.get(scope, MLMetrics.BATCH_COMPILES, 0),
+            }
+        finally:
+            config.unset(Options.BATCH_CHUNK_ROWS)
+            config.unset(Options.BATCH_PREFETCH_DEPTH)
+
+    # Headline: per-stage vs fused at the config DEFAULTS. The box is
+    # time-shared and ambient load swings wall time 3x on a ~100 ms sample,
+    # so the protocol is interleaved best-of-N: alternate the legs (so load
+    # bursts hit both) and take each leg's MINIMUM — the run with the least
+    # interference, the best estimate of true cost on a noisy host (the
+    # pyperf min protocol). Medians are reported alongside for honesty.
+    plan = CompiledBatchPlan.build(stages, scope="ml.batch[bench-main]")
+    for _ in range(2):  # warm twice: jit caches + chunk signatures on the
+        run_per_stage()  # first pass, allocator/arena steady state on the
+        plan.transform(df)  # second (first-call-after-compile runs ~20% cold)
+    ps_times, fu_times = [], []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        run_per_stage()
+        ps_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        plan.transform(df)
+        fu_times.append(time.perf_counter() - t0)
+    ps_times.sort()
+    fu_times.sort()
+    t_ps, t_fu = ps_times[0], fu_times[0]
+    hist = metrics.get("ml.batch[bench-main]", MLMetrics.BATCH_CHUNK_MS)
+    per_stage = {
+        "rows_per_sec": round(n / t_ps, 1),
+        "spread": {
+            "min_s": round(ps_times[0], 4),
+            "median_s": round(ps_times[len(ps_times) // 2], 4),
+            "max_s": round(ps_times[-1], 4),
+            "repeats": len(ps_times),
+        },
+    }
+    fused = {
+        "rows_per_sec": round(n / t_fu, 1),
+        "spread": {
+            "min_s": round(fu_times[0], 4),
+            "median_s": round(fu_times[len(fu_times) // 2], 4),
+            "max_s": round(fu_times[-1], 4),
+            "repeats": len(fu_times),
+        },
+        "chunk_p50_ms": round(hist.quantile(0.5), 3) if hist else None,
+    }
+    sweep = [
+        fused_leg(chunk_rows, depth, f"ml.batch[bench-{chunk_rows}-{depth}]")
+        for chunk_rows in (8_192, 32_768, 131_072)
+        for depth in (1, 2)
+    ]
+    return {
+        "name": "pipeline_batch_transform_6stage_d32",
+        "rows": n,
+        "dim": d,
+        "stages": 6,
+        "per_stage_rows_per_sec": per_stage["rows_per_sec"],
+        "per_stage_spread": per_stage["spread"],
+        "fused_rows_per_sec": fused["rows_per_sec"],
+        "fused_spread": fused["spread"],
+        "fused_chunk_p50_ms": fused["chunk_p50_ms"],
+        "fused_vs_per_stage": round(
+            fused["rows_per_sec"] / per_stage["rows_per_sec"], 2
+        ),
+        "sweep": sweep,
+        "note": "per-stage = today's PipelineModel.transform loop (jit + "
+        "readback + DataFrame per stage); fused = CompiledBatchPlan, one "
+        "ingest/readback per chunk, columns device-resident across stages, "
+        "double-buffered chunk prefetch. Bit-exactness of the two paths is "
+        "pinned by tests/test_batch_fastpath.py.",
+    }
+
+
 def bench_mlp_forward(peak_flops):
     import jax
     import jax.numpy as jnp
@@ -1278,6 +1469,7 @@ def main() -> None:
     attention = bench_attention(peak)
     attention_train = bench_attention_train(peak)
     serving = bench_serving()
+    batch_transform = bench_pipeline_batch_transform()
 
     detail = {
         "device_kind": kind,
@@ -1285,7 +1477,7 @@ def main() -> None:
         "peak_hbm_gbps": peak_bw,
         "workloads": [
             logreg, sparse, sweep, sparse_streamed, overlap, kmeans, mlp,
-            mlp_train, attention, attention_train, serving,
+            mlp_train, attention, attention_train, serving, batch_transform,
         ],
     }
     with open("BENCH_DETAIL.json", "w") as f:

@@ -3,6 +3,7 @@
 Reference: flink-ml-core/src/main/java/org/apache/flink/ml/builder/.
 """
 
+from flink_ml_tpu.builder.batch_plan import CompiledBatchPlan
 from flink_ml_tpu.builder.pipeline import Pipeline, PipelineModel
 
-__all__ = ["Pipeline", "PipelineModel"]
+__all__ = ["CompiledBatchPlan", "Pipeline", "PipelineModel"]

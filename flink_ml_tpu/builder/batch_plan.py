@@ -1,0 +1,279 @@
+"""CompiledBatchPlan — the batch transform fast path.
+
+``PipelineModel.transform`` classically executes one jit call per column per
+stage with an immediate blocking ``np.asarray`` readback and a full host
+DataFrame materialization between stages. For chains of elementwise/feature
+operators that is pure overhead — the fusion-plan win SystemML's optimizer
+documents (Boehm et al., PAPERS.md) and Flare applies to whole Spark
+pipelines (Essertel et al., PAPERS.md). This plan extends PR 4's serving fast
+path to offline data, on the shared chain compiler (``servable/planner.py``):
+
+- **Fusion**: consecutive stages exposing a
+  :class:`~flink_ml_tpu.servable.kernel_spec.KernelSpec` run as an executable
+  chain — one AOT program per reduction-bearing stage, with runs of
+  ``elementwise`` specs merged into single programs (bit-exact with the
+  per-stage path by construction, see the planner docstring), columns
+  flowing between programs as device arrays: one host→device ingest and one
+  device→host readback per chunk, zero inter-stage DataFrame
+  materialization.
+- **Chunked, double-buffered ingest**: inputs larger than
+  ``batch.chunk.rows`` stream through the chain in chunks with a prefetch
+  window (``batch.prefetch.depth``): the host gather + ``device_put`` of
+  chunk j+1 overlaps the device execution of chunk j — the streamed-SGD
+  prefetch-gap design of ``ops/optimizer.py`` / ``iteration/streaming.py``,
+  applied to inference. At most ``depth`` chunks are dispatched-unfinalized,
+  so HBM residency stays bounded regardless of input size.
+- **Chain-boundary fallback**: a stage without a spec (or whose params make
+  it unfusable — e.g. a row-dropping Bucketizer) materializes the full
+  DataFrame at the segment boundary and runs today's per-stage path; a
+  column a compiled chain cannot take (sparse features, ragged lists) makes
+  the *whole segment* fall back for that call, bit-exactly.
+
+Programs are keyed by the ingest signature itself (chunk rows × column
+shapes/dtypes) and compile lazily on first sight — a batch tier has no
+version flip to warm up against; ``ml.batch.fastpath.compiles`` counts the
+signatures seen.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.servable.planner import (
+    FallbackStage,
+    FusedSegment,
+    IneligibleBatch,
+    build_segments,
+    run_segment,
+)
+
+__all__ = ["BatchPlanInapplicable", "CompiledBatchPlan"]
+
+_POOL_LOCK = threading.Lock()
+_POOL: Optional[Any] = None
+
+
+class _InlineExecutor:
+    """Degenerate executor for single-core hosts: thread hops buy no overlap
+    there, only scheduling overhead, so tasks run on the submitting thread."""
+
+    def submit(self, fn, *args):
+        future: Future = Future()
+        try:
+            future.set_result(fn(*args))
+        except BaseException as e:  # noqa: BLE001 — mirror executor semantics
+            future.set_exception(e)
+        return future
+
+
+def _readback_pool() -> Any:
+    """Process-wide pool for chunk readbacks (lazy: plain transforms that
+    never fuse must not spawn threads). Tasks are pure disjoint slice writes,
+    so plans can share it freely; single-core hosts get the inline executor
+    instead of threads."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            workers = min(4, os.cpu_count() or 1)
+            _POOL = (
+                ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="batch-readback"
+                )
+                if workers > 1
+                else _InlineExecutor()
+            )
+        return _POOL
+
+
+class BatchPlanInapplicable(Exception):
+    """The plan met a pipeline shape it cannot chain (a fallback stage
+    returned multiple DataFrames) — the caller should rerun the classic
+    per-stage path."""
+
+
+class CompiledBatchPlan:
+    """Compiled form of a PipelineModel's stage chain for offline data.
+    Build via :meth:`build`; ``None`` means no stage has a kernel spec and
+    the classic per-stage path should run."""
+
+    def __init__(self, stages: Sequence[Any], segments: List[Any], scope: str):
+        self._stages = list(stages)
+        self.segments = segments
+        self.scope = scope
+        n_fused = sum(len(s.specs) for s in segments if isinstance(s, FusedSegment))
+        n_fallback = sum(1 for s in segments if isinstance(s, FallbackStage))
+        metrics.gauge(scope, MLMetrics.BATCH_FUSED_STAGES, n_fused)
+        metrics.gauge(scope, MLMetrics.BATCH_FALLBACK_STAGES, n_fallback)
+
+    # -- construction ---------------------------------------------------------
+    @staticmethod
+    def build(stages: Sequence[Any], *, scope: str = "ml.batch[plan]") -> Optional["CompiledBatchPlan"]:
+        """Group consecutive kernel-spec stages into fused segments and
+        commit their model arrays to the device (the once-per-plan upload).
+        Raises whatever ``kernel_spec()`` raises — an unloaded model fails
+        closed here exactly as its ``transform`` would. Publishes
+        ``ml.batch.fastpath.plan.build.ms``."""
+        t0 = time.perf_counter()
+        segments = build_segments(stages)
+        if not any(isinstance(s, FusedSegment) for s in segments):
+            return None
+        plan = CompiledBatchPlan(stages, segments, scope)
+        metrics.gauge(
+            scope, MLMetrics.BATCH_PLAN_BUILD_MS, (time.perf_counter() - t0) * 1000.0
+        )
+        return plan
+
+    # -- execution ------------------------------------------------------------
+    def transform(self, df: DataFrame) -> DataFrame:
+        """Run the chain. Fused segments stream chunk-wise with the prefetch
+        window; spec-less stages run their ordinary ``transform`` on the full
+        materialized DataFrame at the chain boundary."""
+        for segment in self.segments:
+            if isinstance(segment, FallbackStage):
+                out = segment.stage.transform(df)
+                if isinstance(out, (list, tuple)):
+                    if len(out) != 1:
+                        raise BatchPlanInapplicable(
+                            f"stage {type(segment.stage).__name__} returned "
+                            f"{len(out)} outputs"
+                        )
+                    out = out[0]
+                df = out
+                continue
+            df = self._run_fused(segment, df)
+        return df
+
+    def _run_fused(self, segment: FusedSegment, df: DataFrame) -> DataFrame:
+        n = len(df)
+        if n == 0:
+            return self._fallback(segment, df, count=False)
+        try:
+            # One host-side gather per external input for the WHOLE call, at
+            # the column's own float dtype: chunk ingest below device_puts a
+            # contiguous row view, and the f64→f32 canonicalization happens
+            # inside that single C++ convert+copy pass (bit-identical to a
+            # host astype — both are IEEE round-to-nearest — and one full
+            # memory pass cheaper). Non-float columns cast to f32 once, the
+            # same float math the per-stage kernels apply.
+            full: Dict[str, np.ndarray] = {}
+            for name in segment.external_inputs:
+                arr = segment.gather(df, name, raw=True)
+                if arr.dtype not in (np.float32, np.float64):
+                    arr = np.asarray(arr, np.float32)
+                elif not arr.flags.c_contiguous:
+                    arr = np.ascontiguousarray(arr)
+                full[name] = arr
+        except IneligibleBatch:
+            return self._fallback(segment, df, count=True)
+
+        chunk_rows = max(1, int(config.get(Options.BATCH_CHUNK_ROWS)))
+        depth = max(1, int(config.get(Options.BATCH_PREFETCH_DEPTH)))
+        starts = list(range(0, n, chunk_rows))
+        chunk_hist = metrics.histogram(self.scope, MLMetrics.BATCH_CHUNK_MS)
+
+        def ingest(lo: int) -> Tuple[Hashable, Dict[str, Any]]:
+            hi = min(lo + chunk_rows, n)
+            # device_put of a contiguous row view — host gather + upload of
+            # chunk j+1 runs on the host thread while the device executes
+            # the chunks still in flight (the double-buffer overlap), and
+            # the programs then take committed device arrays, the fast
+            # intake path (a numpy arg costs an extra conversion pass per
+            # program call).
+            inputs = {
+                name: jax.device_put(arr[lo:hi]) for name, arr in full.items()
+            }
+            key = tuple(
+                (name, tuple(inputs[name].shape), str(inputs[name].dtype))
+                for name in segment.external_inputs
+            )
+            return key, inputs
+
+        def on_compile() -> None:
+            metrics.counter(self.scope, MLMetrics.BATCH_COMPILES)
+
+        # Declared outputs land in preallocated full-length host buffers —
+        # buffers are disjoint per chunk, so each chunk readback is an
+        # independent slice assignment (``buf[lo:hi] = view``): a single-pass
+        # device-view → storage-dtype cast, no per-chunk intermediate array
+        # and no final concatenate. Readbacks run on the shared pool (numpy
+        # releases the GIL for the cast), overlapping the host dispatch of
+        # later chunks; the prefetch window keeps at most ``depth`` chunks
+        # dispatched-unfinalized so host/HBM residency stays bounded.
+        out_bufs: Dict[str, np.ndarray] = {}
+        out_decl: Dict[str, Any] = {}
+        inflight: List[Tuple[float, List[Any]]] = []
+
+        def readback_one(buf: np.ndarray, lo: int, hi: int, arr: Any) -> None:
+            # np.asarray blocks until the device value is ready (zero-copy
+            # view on the CPU backend); the widening cast (f32→f64) in the
+            # slice assignment is value-exact.
+            buf[lo:hi] = np.asarray(arr)
+
+        def finalize_oldest() -> None:
+            t_dispatch, futures = inflight.pop(0)
+            for f in futures:
+                f.result()
+            chunk_hist.observe((time.perf_counter() - t_dispatch) * 1000.0)
+
+        pool = _readback_pool()
+        nxt = ingest(starts[0])
+        for i, lo in enumerate(starts):
+            key, inputs = nxt
+            t_dispatch = time.perf_counter()
+            outputs = run_segment(segment, key, inputs, on_compile=on_compile)
+            pending = segment.pending(outputs)
+            if not out_bufs:  # shapes are fixed by the programs: alloc once
+                for name, dtype, arr, np_dtype in pending:
+                    out_bufs[name] = np.empty((n,) + tuple(arr.shape[1:]), np_dtype)
+                    out_decl[name] = dtype
+            hi = min(lo + chunk_rows, n)
+            inflight.append(
+                (
+                    t_dispatch,
+                    [
+                        pool.submit(readback_one, out_bufs[name], lo, hi, arr)
+                        for name, _dtype, arr, _np_dtype in pending
+                    ],
+                )
+            )
+            if i + 1 < len(starts):
+                nxt = ingest(starts[i + 1])  # overlaps the async device exec
+            while len(inflight) >= depth:
+                finalize_oldest()
+        while inflight:
+            finalize_oldest()
+
+        metrics.counter(self.scope, MLMetrics.BATCH_FUSED_CHUNKS, len(starts))
+        metrics.counter(self.scope, MLMetrics.BATCH_FUSED_ROWS, n)
+        out = df.clone()
+        for name, _ in segment.outputs:
+            host = out_bufs[name]
+            dtype = out_decl[name]
+            if dtype is None:  # shape-following output: infer like transform
+                dtype = (
+                    DataTypes.vector(BasicType.DOUBLE)
+                    if host.ndim == 2
+                    else DataTypes.DOUBLE
+                )
+            out.add_column(name, dtype, host)
+        return out
+
+    def _fallback(self, segment: FusedSegment, df: DataFrame, *, count: bool) -> DataFrame:
+        """Per-stage execution of a fused segment's stages (sparse/ragged
+        input, or an empty frame not worth compiling for)."""
+        if count:
+            metrics.counter(self.scope, MLMetrics.BATCH_FALLBACK_SEGMENTS)
+        for stage in segment.stages:
+            out = stage.transform(df)
+            df = out[0] if isinstance(out, (list, tuple)) else out
+        return df

@@ -11,11 +11,13 @@ Semantics preserved exactly:
 """
 from __future__ import annotations
 
+import json
 import os
-from typing import List, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 from flink_ml_tpu.api.core import AlgoOperator, Estimator, Model, Stage, Transformer
 from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.config import Options, config
 from flink_ml_tpu.utils import read_write as rw
 
 __all__ = ["Pipeline", "PipelineModel"]
@@ -82,16 +84,59 @@ class PipelineModel(Model):
     def __init__(self, stages: Sequence[Stage] = ()):
         super().__init__()
         self.stages: List[Stage] = list(stages)
+        #: (fingerprint, plan-or-None) — see :meth:`_batch_plan`.
+        self._plan_cache: Optional[Tuple[Tuple, object]] = None
 
     def transform(self, *inputs: DataFrame):
-        """Ref PipelineModel.transform:66."""
+        """Ref PipelineModel.transform:66.
+
+        With ``batch.fastpath`` on (the default), single-input chains whose
+        stages expose kernel specs run through a
+        :class:`~flink_ml_tpu.builder.batch_plan.CompiledBatchPlan`: fused
+        device-resident stage chains over chunked, prefetch-overlapped ingest
+        — bit-exact with the per-stage path (docs/batch_transform.md).
+        """
+        if len(inputs) == 1 and config.get(Options.BATCH_FASTPATH):
+            from flink_ml_tpu.builder.batch_plan import BatchPlanInapplicable
+
+            plan = self._batch_plan()
+            if plan is not None:
+                try:
+                    return plan.transform(inputs[0])
+                except BatchPlanInapplicable:
+                    pass  # a multi-output stage mid-chain: classic path below
         last_inputs = list(inputs)
         for stage in self.stages:
             out = stage.transform(*last_inputs)
             last_inputs = list(out) if isinstance(out, (list, tuple)) else [out]
         return last_inputs[0] if len(last_inputs) == 1 else last_inputs
 
+    def _fingerprint(self) -> Tuple:
+        """Cheap identity of the chain a compiled plan snapshots: stage
+        object identity plus each stage's param map. Model *data* is covered
+        by ``set_model_data`` invalidating the cache; mutating a stage's
+        arrays directly requires :meth:`invalidate_batch_plan`."""
+        return tuple(
+            (id(stage), json.dumps(stage.param_map_to_json(), sort_keys=True, default=str))
+            for stage in self.stages
+        )
+
+    def _batch_plan(self):
+        from flink_ml_tpu.builder.batch_plan import CompiledBatchPlan
+
+        fp = self._fingerprint()
+        if self._plan_cache is None or self._plan_cache[0] != fp:
+            self._plan_cache = (fp, CompiledBatchPlan.build(self.stages))
+        return self._plan_cache[1]
+
+    def invalidate_batch_plan(self) -> "PipelineModel":
+        """Drop the cached CompiledBatchPlan (after mutating a stage's model
+        arrays in place — ``set_model_data`` does this automatically)."""
+        self._plan_cache = None
+        return self
+
     def set_model_data(self, *model_data: DataFrame) -> "PipelineModel":
+        self.invalidate_batch_plan()
         i = 0
         for stage in self.stages:
             if isinstance(stage, Model):

@@ -152,6 +152,32 @@ class Options:
         "claim/pad/scatter of batch N+1 with device execution of batch N; "
         "1 = strict sequential. Only effective on the fast path.",
     )
+    BATCH_FASTPATH = ConfigOption(
+        "batch.fastpath",
+        _parse_bool,
+        True,
+        "Run PipelineModel.transform through CompiledBatchPlan when stages "
+        "expose kernel specs: fused per-stage AOT programs with columns "
+        "device-resident between stages, chunked for larger-than-HBM inputs "
+        "(docs/batch_transform.md). Off = always the per-stage transform path.",
+    )
+    BATCH_CHUNK_ROWS = ConfigOption(
+        "batch.chunk.rows",
+        int,
+        65_536,
+        "Rows per device chunk for the batch transform fast path — the "
+        "datacache-window role: inputs larger than one chunk stream through "
+        "the compiled plan chunk by chunk (one ingest + one readback each).",
+    )
+    BATCH_PREFETCH_DEPTH = ConfigOption(
+        "batch.prefetch.depth",
+        int,
+        2,
+        "Chunks that may be dispatched to the device before the oldest is "
+        "read back. 2 overlaps host gather + device_put of chunk j+1 with "
+        "device execution of chunk j (the streamed-SGD prefetch-gap design); "
+        "1 = strict sequential.",
+    )
     NATIVE_DATACACHE_ENABLED = ConfigOption(
         "native.datacache.enabled",
         _parse_bool,

@@ -65,6 +65,18 @@ class MLMetrics:
     SERVING_WARMUP_COMPILE_MS = "ml.serving.fastpath.warmup.compile.ms"  # AOT warmup wall time, gauge
     SERVING_INFLIGHT_DEPTH = "ml.serving.inflight.depth"  # dispatched-not-finalized batches, gauge
 
+    # Batch transform fast path (builder/batch_plan.py — fused chunked plans;
+    # scope = "ml.batch[plan]" unless the caller names its own).
+    BATCH_GROUP = "ml.batch"
+    BATCH_FUSED_STAGES = "ml.batch.fastpath.fused.stages"  # stages fused, gauge
+    BATCH_FALLBACK_STAGES = "ml.batch.fastpath.fallback.stages"  # per-stage, gauge
+    BATCH_FUSED_CHUNKS = "ml.batch.fastpath.fused.chunks"  # chunk executions, counter
+    BATCH_FUSED_ROWS = "ml.batch.fastpath.fused.rows"  # rows through fused chains, counter
+    BATCH_FALLBACK_SEGMENTS = "ml.batch.fastpath.fallback.segments"  # ineligible segment runs, counter
+    BATCH_COMPILES = "ml.batch.fastpath.compiles"  # chain compiles (per new chunk signature), counter
+    BATCH_PLAN_BUILD_MS = "ml.batch.fastpath.plan.build.ms"  # build + model upload wall time, gauge
+    BATCH_CHUNK_MS = "ml.batch.fastpath.chunk.ms"  # dispatch→readback per chunk, histogram
+
 
 class Histogram:
     """Bounded-window observation histogram (the DescriptiveStatisticsHistogram

@@ -3,26 +3,25 @@
 Reference: ``flink-ml-lib/.../feature/binarizer/Binarizer.java`` — multi-column
 transformer; per input column i, values > thresholds[i] → 1.0 else 0.0; works on
 numeric columns and on vectors (element-wise, sparse kept sparse).
+
+Dense columns run through the shared ``binarize`` kernel (``ops/kernels.py``)
+in the column's OWN dtype — no float64 upcast before the kernel (it would
+double host memory/bandwidth only for jit to truncate back to float32) — and
+float columns come back in their input dtype.
 """
 from __future__ import annotations
 
-import functools
-
-import jax
 import numpy as np
 
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.linalg.vectors import SparseVector, Vector
+from flink_ml_tpu.ops.kernels import binarize_fn, binarize_kernel
 from flink_ml_tpu.params.param import FloatArrayParam, ParamValidators
 from flink_ml_tpu.params.shared import HasInputCols, HasOutputCols
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["Binarizer"]
-
-
-@functools.cache
-def _kernel(threshold: float):
-    return jax.jit(lambda x: (x > threshold).astype(x.dtype))
 
 
 class Binarizer(Transformer, HasInputCols, HasOutputCols):
@@ -53,7 +52,13 @@ class Binarizer(Transformer, HasInputCols, HasOutputCols):
         for name, out_name, thr in zip(in_cols, out_cols, thresholds):
             col = df.column(name)
             if isinstance(col, np.ndarray):
-                vals = np.asarray(_kernel(float(thr))(col.astype(np.float64)))
+                # Run in the column's dtype: floats go to the device as-is
+                # (jit canonicalizes f64→f32; no host-side upcast copy),
+                # integers/bools widen once.
+                x = col if col.dtype.kind == "f" else col.astype(np.float64)
+                vals = np.asarray(binarize_kernel(float(thr))(x))
+                if col.dtype.kind == "f":
+                    vals = vals.astype(col.dtype, copy=False)
                 dtype = (
                     DataTypes.vector(BasicType.DOUBLE) if vals.ndim == 2 else DataTypes.DOUBLE
                 )
@@ -72,3 +77,27 @@ class Binarizer(Transformer, HasInputCols, HasOutputCols):
                         new_col.append(1.0 if v > thr else 0.0)
                 out.add_column(out_name, DataTypes.vector(BasicType.DOUBLE), new_col)
         return out
+
+    def kernel_spec(self):
+        """Fusable per-column thresholding — ``binarize_fn``, the body
+        ``transform``'s jitted kernel wraps. List (sparse-vector) columns are
+        per-stage territory, so inputs ingest as ``dense`` and anything
+        ragged falls the segment back. Output DataTypes follow the input
+        shape at readback (scalar vs vector), like ``transform``."""
+        in_cols, out_cols = self.get_input_cols(), self.get_output_cols()
+        thresholds = self.get_thresholds()
+        if not in_cols or thresholds is None or len(in_cols) != len(thresholds):
+            return None  # transform raises the param error on the classic path
+        bindings = tuple(zip(in_cols, out_cols, [float(t) for t in thresholds]))
+
+        def kernel_fn(model, cols):
+            return {o: binarize_fn(cols[n], t) for n, o, t in bindings}
+
+        return KernelSpec(
+            input_cols=in_cols,
+            outputs=tuple((o, None) for o in out_cols),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+            input_kinds={n: "dense" for n in in_cols},
+            elementwise=True,  # threshold compare: no FP accumulation
+        )

@@ -4,6 +4,11 @@ Reference: ``flink-ml-lib/.../feature/bucketizer/Bucketizer.java`` — multi-col
 value in [splits[j], splits[j+1]) → bucket j (last bucket right-inclusive);
 values outside the splits or NaN are invalid, handled per ``handleInvalid``:
 'error' raises, 'skip' drops the row, 'keep' maps to the extra bucket numSplits-1.
+
+The bucket search runs on the shared ``bucketize`` kernel (``ops/kernels.py``);
+'error' raising and 'skip' row-dropping consume the kernel's invalid mask on
+the host (they are inherently host decisions — a fused device program cannot
+raise or change the row count, which is why only 'keep' exports a kernel spec).
 """
 from __future__ import annotations
 
@@ -11,8 +16,10 @@ import numpy as np
 
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import DataTypes
+from flink_ml_tpu.ops.kernels import bucketize_fn, bucketize_kernel
 from flink_ml_tpu.params.param import Param, ParamValidators
 from flink_ml_tpu.params.shared import HasHandleInvalid, HasInputCols, HasOutputCols
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["Bucketizer"]
 
@@ -52,26 +59,22 @@ class Bucketizer(Transformer, HasInputCols, HasOutputCols, HasHandleInvalid):
         if len(in_cols) != len(splits_array):
             raise ValueError("Bucketizer: one splits array per input column required")
 
+        kernel = bucketize_kernel(handle == "keep")
         n = len(df)
         keep_mask = np.ones(n, bool)
         buckets = []
         for name, splits in zip(in_cols, splits_array):
             x = df.scalars(name)
-            splits = np.asarray(splits, np.float64)
-            # bucket j for [splits[j], splits[j+1]); last bucket right-inclusive
-            idx = np.searchsorted(splits, x, side="right") - 1
-            idx = np.where(x == splits[-1], len(splits) - 2, idx)
-            invalid = (x < splits[0]) | (x > splits[-1]) | np.isnan(x)
+            idx, invalid = kernel(x, np.asarray(splits, np.float64))
+            idx, invalid = np.asarray(idx, np.float64), np.asarray(invalid)
             if handle == "error" and invalid.any():
                 raise ValueError(
                     f"The input contains invalid value {x[invalid][0]} for column {name}. "
                     "See Bucketizer handleInvalid."
                 )
-            if handle == "keep":
-                idx = np.where(invalid, len(splits) - 1, idx)
-            else:  # skip
+            if handle == "skip":
                 keep_mask &= ~invalid
-            buckets.append(idx.astype(np.float64))
+            buckets.append(idx)
 
         out = df.clone()
         for out_name, idx in zip(out_cols, buckets):
@@ -79,3 +82,36 @@ class Bucketizer(Transformer, HasInputCols, HasOutputCols, HasHandleInvalid):
         if handle == "skip" and not keep_mask.all():
             out = out.take(np.nonzero(keep_mask)[0])
         return out
+
+    def kernel_spec(self):
+        """Bucket search as a fusable spec — ``bucketize_fn`` in 'keep' mode,
+        the splits committed as device buffers. 'error'/'skip' need the host
+        (raise / row-drop), so they stay per-stage."""
+        splits_array = self.get_splits_array()
+        in_cols, out_cols = self.get_input_cols(), self.get_output_cols()
+        if (
+            self.get_handle_invalid() != "keep"
+            or splits_array is None
+            or not in_cols
+            or len(in_cols) != len(splits_array)
+        ):
+            return None
+        bindings = tuple((i, n, o) for i, (n, o) in enumerate(zip(in_cols, out_cols)))
+
+        def kernel_fn(model, cols):
+            return {
+                o: bucketize_fn(cols[n], model[f"splits{i}"], True)[0]
+                for i, n, o in bindings
+            }
+
+        return KernelSpec(
+            input_cols=in_cols,
+            outputs=tuple((o, DataTypes.DOUBLE) for o in out_cols),
+            model_arrays={
+                f"splits{i}": np.asarray(s, np.float32)
+                for i, s in enumerate(splits_array)
+            },
+            kernel_fn=kernel_fn,
+            input_kinds={n: "scalar" for n in in_cols},
+            elementwise=True,  # searchsorted + compares: no FP accumulation
+        )

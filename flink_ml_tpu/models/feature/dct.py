@@ -4,46 +4,23 @@ Reference: ``flink-ml-lib/.../feature/dct/DCT.java`` — orthonormal DCT-II of t
 input vector (inverse = DCT-III when ``inverse``).
 
 TPU-native: the transform is a [d, d] cosine-basis matmul over the whole batch —
-an MXU op — instead of the reference's per-row FFT library call. The basis is
-built once per dimension and cached.
+an MXU op — instead of the reference's per-row FFT library call. The basis and
+the matmul are the shared ``dct_basis`` / ``dct`` kernel (``ops/kernels.py``);
+the basis is built once per dimension and burned into the compiled program as a
+constant by both the per-stage kernel and the fused spec.
 """
 from __future__ import annotations
 
-import functools
-
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.ops.kernels import dct_basis, dct_fn, dct_kernel
 from flink_ml_tpu.params.param import BoolParam
 from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["DCT"]
-
-
-@functools.cache
-def _dct_matrix(d: int) -> np.ndarray:
-    """Orthonormal DCT-II basis: B[k, j] = s_k cos(pi (j + 1/2) k / d)."""
-    j = np.arange(d)
-    k = np.arange(d)[:, None]
-    basis = np.cos(np.pi * (j + 0.5) * k / d)
-    scale = np.full(d, np.sqrt(2.0 / d))
-    scale[0] = np.sqrt(1.0 / d)
-    return (basis * scale[:, None]).astype(np.float64)
-
-
-@functools.cache
-def _kernel(d: int, inverse: bool):
-    mat = jnp.asarray(_dct_matrix(d))
-
-    @jax.jit
-    def forward(X):
-        # orthonormal: inverse is the transpose
-        return X @ (mat if inverse else mat.T)
-
-    return forward
 
 
 class DCT(Transformer, HasInputCol, HasOutputCol):
@@ -62,7 +39,7 @@ class DCT(Transformer, HasInputCol, HasOutputCol):
     def transform(self, *inputs):
         (df,) = inputs
         X = df.vectors(self.get_input_col())
-        vals = _kernel(X.shape[1], self.get_inverse())(X.astype(np.float64))
+        vals = dct_kernel(X.shape[1], bool(self.get_inverse()))(X.astype(np.float64))
         out = df.clone()
         out.add_column(
             self.get_output_col(),
@@ -70,3 +47,21 @@ class DCT(Transformer, HasInputCol, HasOutputCol):
             np.asarray(vals, np.float64),
         )
         return out
+
+    def kernel_spec(self):
+        """Basis matmul as a fusable spec — ``dct_fn`` with the per-dimension
+        basis resolved at trace time (static width) and embedded as the same
+        compile-time constant ``transform``'s kernel uses."""
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        inverse = bool(self.get_inverse())
+
+        def kernel_fn(model, cols):
+            X = cols[in_col]
+            return {out_col: dct_fn(X, dct_basis(X.shape[1], inverse))}
+
+        return KernelSpec(
+            input_cols=(in_col,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+        )

@@ -1,7 +1,9 @@
 """ElementwiseProduct.
 
 Reference: ``flink-ml-lib/.../feature/elementwiseproduct/ElementwiseProduct.java`` —
-Hadamard product of each input vector with the ``scalingVec`` param.
+Hadamard product of each input vector with the ``scalingVec`` param. Dense
+columns run the shared ``elementwise_product`` kernel (``ops/kernels.py``);
+sparse vectors stay sparse on the host path.
 """
 from __future__ import annotations
 
@@ -10,8 +12,10 @@ import numpy as np
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.linalg.vectors import SparseVector, Vector
+from flink_ml_tpu.ops.kernels import elementwise_product_fn, elementwise_product_kernel
 from flink_ml_tpu.params.param import ParamValidators, VectorParam
 from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["ElementwiseProduct"]
 
@@ -32,17 +36,21 @@ class ElementwiseProduct(Transformer, HasInputCol, HasOutputCol):
     def set_scaling_vec(self, value):
         return self.set(self.SCALING_VEC, value)
 
+    def _scaling_array(self) -> np.ndarray:
+        scaling = self.get_scaling_vec()
+        return scaling.to_array() if isinstance(scaling, Vector) else np.asarray(scaling)
+
     def transform(self, *inputs):
         (df,) = inputs
-        scaling = self.get_scaling_vec()
-        s = scaling.to_array() if isinstance(scaling, Vector) else np.asarray(scaling)
+        s = self._scaling_array()
         col = df.column(self.get_input_col())
         out = df.clone()
         if isinstance(col, np.ndarray):
+            vals = elementwise_product_kernel()(col.astype(np.float64), s)
             out.add_column(
                 self.get_output_col(),
                 DataTypes.vector(BasicType.DOUBLE),
-                col.astype(np.float64) * s[None, :],
+                np.asarray(vals, np.float64),
             )
         else:  # sparse vectors stay sparse (product with stored values only)
             new_col = [
@@ -53,3 +61,23 @@ class ElementwiseProduct(Transformer, HasInputCol, HasOutputCol):
             ]
             out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), new_col)
         return out
+
+    def kernel_spec(self):
+        """Hadamard product as a fusable spec — ``elementwise_product_fn``
+        with the scaling vector as a committed device buffer. List (sparse)
+        columns stay per-stage, so the input ingests as ``dense``."""
+        if self.get_scaling_vec() is None:
+            return None
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+
+        def kernel_fn(model, cols):
+            return {out_col: elementwise_product_fn(cols[in_col], model["scaling"])}
+
+        return KernelSpec(
+            input_cols=(in_col,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={"scaling": np.asarray(self._scaling_array(), np.float32)},
+            kernel_fn=kernel_fn,
+            input_kinds={in_col: "dense"},
+            elementwise=True,  # Hadamard product: no FP accumulation
+        )

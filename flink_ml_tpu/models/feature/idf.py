@@ -14,8 +14,10 @@ from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.linalg.vectors import SparseVector, Vector
 from flink_ml_tpu.models.common import ModelArraysMixin
+from flink_ml_tpu.ops.kernels import idf_scale_fn, idf_scale_kernel
 from flink_ml_tpu.params.param import IntParam, ParamValidators, update_existing_params
 from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["IDF", "IDFModel"]
 
@@ -51,10 +53,11 @@ class IDFModel(ModelArraysMixin, Model, _IDFParams):
         col = df.column(self.get_input_col())
         out = df.clone()
         if isinstance(col, np.ndarray):
+            vals = idf_scale_kernel()(col.astype(np.float64), self.idf)
             out.add_column(
                 self.get_output_col(),
                 DataTypes.vector(BasicType.DOUBLE),
-                col.astype(np.float64) * self.idf[None, :],
+                np.asarray(vals, np.float64),
             )
         else:
             new_col = [
@@ -65,6 +68,27 @@ class IDFModel(ModelArraysMixin, Model, _IDFParams):
             ]
             out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), new_col)
         return out
+
+    def kernel_spec(self):
+        """idf scaling as a fusable spec — ``idf_scale_fn``, the body
+        ``transform``'s jitted kernel wraps, with the idf vector as a
+        committed device buffer. Sparse columns stay per-stage (sparsity
+        preserved there), so the input ingests as ``dense``."""
+        if self.idf is None:
+            raise RuntimeError("set_model_data must be called before kernel_spec")
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+
+        def kernel_fn(model, cols):
+            return {out_col: idf_scale_fn(cols[in_col], model["idf"])}
+
+        return KernelSpec(
+            input_cols=(in_col,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={"idf": np.asarray(self.idf, np.float32)},
+            kernel_fn=kernel_fn,
+            input_kinds={in_col: "dense"},
+            elementwise=True,  # per-term scaling: no FP accumulation
+        )
 
 
 class IDF(Estimator, _IDFParams):

@@ -13,8 +13,10 @@ import numpy as np
 from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.types import DataTypes
 from flink_ml_tpu.models.common import ModelArraysMixin
+from flink_ml_tpu.ops.kernels import impute_fn, impute_kernel
 from flink_ml_tpu.params.param import FloatParam, ParamValidators, StringParam, update_existing_params
 from flink_ml_tpu.params.shared import HasInputCols, HasOutputCols, HasRelativeError
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["Imputer", "ImputerModel"]
 
@@ -49,8 +51,15 @@ def _is_missing(x: np.ndarray, missing: float) -> np.ndarray:
     return np.isnan(x) if np.isnan(missing) else (x == missing)
 
 
+def _missing_static(missing: float):
+    """Canonicalize the placeholder for the kernel cache: NaN placeholders
+    must key as (True, 0.0) — NaN != NaN would defeat ``functools.cache``."""
+    return (True, 0.0) if np.isnan(missing) else (False, float(missing))
+
+
 class ImputerModel(ModelArraysMixin, Model, _ImputerParams):
-    """Ref ImputerModel.java — surrogate per input column."""
+    """Ref ImputerModel.java — surrogate per input column, filled by the
+    shared ``impute`` kernel (``ops/kernels.py``)."""
 
     _MODEL_ARRAY_NAMES = ("surrogates",)
 
@@ -60,15 +69,41 @@ class ImputerModel(ModelArraysMixin, Model, _ImputerParams):
 
     def transform(self, *inputs):
         (df,) = inputs
-        missing = self.get_missing_value()
+        is_nan, value = _missing_static(self.get_missing_value())
+        kernel = impute_kernel(is_nan, value)
         out = df.clone()
         for i, (in_name, out_name) in enumerate(
             zip(self.get_input_cols(), self.get_output_cols())
         ):
             x = df.scalars(in_name)
-            filled = np.where(_is_missing(x, missing), self.surrogates[i], x)
-            out.add_column(out_name, DataTypes.DOUBLE, filled)
+            filled = kernel(x, self.surrogates[i])
+            out.add_column(out_name, DataTypes.DOUBLE, np.asarray(filled, np.float64))
         return out
+
+    def kernel_spec(self):
+        """Per-column surrogate fill as a fusable spec — ``impute_fn``, the
+        body ``transform``'s jitted kernel wraps, with the surrogates as a
+        committed device buffer."""
+        if self.surrogates is None:
+            raise RuntimeError("set_model_data must be called before kernel_spec")
+        in_cols, out_cols = self.get_input_cols(), self.get_output_cols()
+        is_nan, value = _missing_static(self.get_missing_value())
+        bindings = tuple((i, n, o) for i, (n, o) in enumerate(zip(in_cols, out_cols)))
+
+        def kernel_fn(model, cols):
+            return {
+                o: impute_fn(cols[n], model["surrogates"][i], is_nan, value)
+                for i, n, o in bindings
+            }
+
+        return KernelSpec(
+            input_cols=in_cols,
+            outputs=tuple((o, DataTypes.DOUBLE) for o in out_cols),
+            model_arrays={"surrogates": np.asarray(self.surrogates, np.float32)},
+            kernel_fn=kernel_fn,
+            input_kinds={n: "scalar" for n in in_cols},
+            elementwise=True,  # isnan/where fill: no FP accumulation
+        )
 
 
 class Imputer(Estimator, _ImputerParams):

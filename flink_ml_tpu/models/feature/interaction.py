@@ -3,35 +3,20 @@
 Reference: ``flink-ml-lib/.../feature/interaction/Interaction.java`` — output
 vector of all cross-products across the input columns (numeric columns act as
 1-dim vectors): out[i,j,...] = col1[i]·col2[j]·…  The first column's index varies
-slowest (row-major over columns left to right).
+slowest (row-major over columns left to right). The batched outer product is
+the shared ``interaction`` kernel (``ops/kernels.py``).
 """
 from __future__ import annotations
 
-import functools
-
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.ops.kernels import interaction_fn, interaction_kernel
 from flink_ml_tpu.params.shared import HasInputCols, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["Interaction"]
-
-
-@functools.cache
-def _kernel(dims: tuple):
-    @jax.jit
-    def interact(*cols):
-        # batched outer product across columns: [n, d1] x [n, d2] ... -> [n, d1*d2*...]
-        acc = cols[0]
-        for c in cols[1:]:
-            acc = acc[:, :, None] * c[:, None, :]
-            acc = acc.reshape(acc.shape[0], -1)
-        return acc
-
-    return interact
 
 
 class Interaction(Transformer, HasInputCols, HasOutputCol):
@@ -46,7 +31,7 @@ class Interaction(Transformer, HasInputCols, HasOutputCol):
                 mats.append(col.astype(np.float64))
             else:
                 mats.append(df.vectors(name).astype(np.float64))
-        vals = _kernel(tuple(m.shape[1] for m in mats))(*mats)
+        vals = interaction_kernel()(*mats)
         out = df.clone()
         out.add_column(
             self.get_output_col(),
@@ -54,3 +39,22 @@ class Interaction(Transformer, HasInputCols, HasOutputCol):
             np.asarray(vals, np.float64),
         )
         return out
+
+    def kernel_spec(self):
+        """Cross-products as a fusable spec — ``interaction_fn``, the body
+        ``transform``'s jitted kernel wraps. Inputs ingest as vectors
+        (scalars widen to [n, 1], exactly like ``transform``)."""
+        in_cols, out_col = tuple(self.get_input_cols() or ()), self.get_output_col()
+        if not in_cols:
+            return None
+
+        def kernel_fn(model, cols):
+            return {out_col: interaction_fn(*(cols[n] for n in in_cols))}
+
+        return KernelSpec(
+            input_cols=in_cols,
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+            elementwise=True,  # broadcast products only: no FP accumulation
+        )

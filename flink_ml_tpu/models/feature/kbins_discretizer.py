@@ -15,8 +15,10 @@ import numpy as np
 
 from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.ops.kernels import kbins_transform_fn, kbins_transform_kernel
 from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam, update_existing_params
 from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 from flink_ml_tpu.utils import read_write as rw
 
 __all__ = ["KBinsDiscretizer", "KBinsDiscretizerModel"]
@@ -59,22 +61,58 @@ class _KbdParams(HasInputCol, HasOutputCol):
 
 
 class KBinsDiscretizerModel(Model, _KbdParams):
-    """Ref KBinsDiscretizerModel.java — per-dim bin edges."""
+    """Ref KBinsDiscretizerModel.java — per-dim bin edges; the binary search
+    with clipping is the shared ``kbins_transform`` kernel (``ops/kernels.py``),
+    which takes the ragged per-dim edges right-padded to [d, E] with +inf."""
 
     def __init__(self):
         super().__init__()
         self.bin_edges: Optional[List[np.ndarray]] = None
 
+    def _packed_edges(self):
+        """(edges [d, E] +inf-padded, n_edges [d]) — the kernel's layout."""
+        max_e = max(len(e) for e in self.bin_edges)
+        edges = np.full((len(self.bin_edges), max_e), np.inf, np.float64)
+        n_edges = np.zeros(len(self.bin_edges), np.int32)
+        for d, e in enumerate(self.bin_edges):
+            edges[d, : len(e)] = e
+            n_edges[d] = len(e)
+        return edges, n_edges
+
     def transform(self, *inputs):
         (df,) = inputs
         X = df.vectors(self.get_input_col()).astype(np.float64)
-        out_vals = np.zeros_like(X)
-        for d, edges in enumerate(self.bin_edges):
-            idx = np.searchsorted(edges, X[:, d], side="right") - 1
-            out_vals[:, d] = np.clip(idx, 0, len(edges) - 2)
+        edges, n_edges = self._packed_edges()
+        out_vals = kbins_transform_kernel()(X, edges, n_edges)
         out = df.clone()
-        out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), out_vals)
+        out.add_column(
+            self.get_output_col(),
+            DataTypes.vector(BasicType.DOUBLE),
+            np.asarray(out_vals, np.float64),
+        )
         return out
+
+    def kernel_spec(self):
+        """Bin search as a fusable spec — ``kbins_transform_fn``, the body
+        ``transform``'s jitted kernel wraps, with the packed edges as
+        committed device buffers."""
+        if self.bin_edges is None:
+            raise RuntimeError("set_model_data must be called before kernel_spec")
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        edges, n_edges = self._packed_edges()
+
+        def kernel_fn(model, cols):
+            return {
+                out_col: kbins_transform_fn(cols[in_col], model["edges"], model["n_edges"])
+            }
+
+        return KernelSpec(
+            input_cols=(in_col,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={"edges": edges.astype(np.float32), "n_edges": n_edges},
+            kernel_fn=kernel_fn,
+            elementwise=True,  # searchsorted + clip: no FP accumulation
+        )
 
     def get_model_data(self):
         from flink_ml_tpu.api.dataframe import DataFrame

@@ -1,32 +1,21 @@
 """Normalizer.
 
 Reference: ``flink-ml-lib/.../feature/normalizer/Normalizer.java`` — scale each
-vector to unit p-norm (p ≥ 1, default 2).
+vector to unit p-norm (p ≥ 1, default 2). The math is the shared ``normalize``
+kernel (``ops/kernels.py``), composable into fused batch plans.
 """
 from __future__ import annotations
 
-import functools
-
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.ops.kernels import normalize_fn, normalize_kernel
 from flink_ml_tpu.params.param import FloatParam, ParamValidators
 from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["Normalizer"]
-
-
-@functools.cache
-def _kernel(p: float):
-    @jax.jit
-    def normalize(X):
-        norm = jnp.sum(jnp.abs(X) ** p, axis=1, keepdims=True) ** (1.0 / p)
-        return X / jnp.where(norm == 0.0, 1.0, norm)
-
-    return normalize
 
 
 class Normalizer(Transformer, HasInputCol, HasOutputCol):
@@ -43,7 +32,7 @@ class Normalizer(Transformer, HasInputCol, HasOutputCol):
     def transform(self, *inputs):
         (df,) = inputs
         X = df.vectors(self.get_input_col()).astype(np.float64)
-        vals = _kernel(self.get_p())(X)
+        vals = normalize_kernel(float(self.get_p()))(X)
         out = df.clone()
         out.add_column(
             self.get_output_col(),
@@ -51,3 +40,18 @@ class Normalizer(Transformer, HasInputCol, HasOutputCol):
             np.asarray(vals, np.float64),
         )
         return out
+
+    def kernel_spec(self):
+        """Row-wise unit p-norm scaling as a fusable spec — ``normalize_fn``,
+        the body ``transform``'s jitted kernel wraps."""
+        in_col, out_col, p = self.get_input_col(), self.get_output_col(), float(self.get_p())
+
+        def kernel_fn(model, cols):
+            return {out_col: normalize_fn(cols[in_col], p)}
+
+        return KernelSpec(
+            input_cols=(in_col,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+        )

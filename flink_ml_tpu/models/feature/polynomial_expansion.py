@@ -5,43 +5,22 @@ Reference: ``flink-ml-lib/.../feature/polynomialexpansion/PolynomialExpansion.ja
 
 Output ordering here is ``itertools.combinations_with_replacement`` grouped by
 degree (deterministic and documented); the reference follows Spark's recursive
-ordering, which enumerates the same monomial set in a different order.
+ordering, which enumerates the same monomial set in a different order. The
+expansion is the shared ``poly_expand`` kernel (``ops/kernels.py``), which
+derives the combo set from the trace-time width.
 """
 from __future__ import annotations
 
-import functools
-import itertools
-
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.ops.kernels import poly_expand_fn, poly_expand_kernel
 from flink_ml_tpu.params.param import IntParam, ParamValidators
 from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["PolynomialExpansion"]
-
-
-@functools.cache
-def _combos(d: int, degree: int):
-    out = []
-    for deg in range(1, degree + 1):
-        out.extend(itertools.combinations_with_replacement(range(d), deg))
-    return tuple(out)
-
-
-@functools.cache
-def _kernel(d: int, degree: int):
-    combos = _combos(d, degree)
-
-    @jax.jit
-    def expand(X):
-        cols = [jnp.prod(X[:, jnp.asarray(c)], axis=1) for c in combos]
-        return jnp.stack(cols, axis=1)
-
-    return expand
 
 
 class PolynomialExpansion(Transformer, HasInputCol, HasOutputCol):
@@ -58,7 +37,7 @@ class PolynomialExpansion(Transformer, HasInputCol, HasOutputCol):
     def transform(self, *inputs):
         (df,) = inputs
         X = df.vectors(self.get_input_col()).astype(np.float64)
-        vals = _kernel(X.shape[1], self.get_degree())(X)
+        vals = poly_expand_kernel(int(self.get_degree()))(X)
         out = df.clone()
         out.add_column(
             self.get_output_col(),
@@ -66,3 +45,20 @@ class PolynomialExpansion(Transformer, HasInputCol, HasOutputCol):
             np.asarray(vals, np.float64),
         )
         return out
+
+    def kernel_spec(self):
+        """Monomial expansion as a fusable spec — ``poly_expand_fn``, the body
+        ``transform``'s jitted kernel wraps (combos resolve from the static
+        trace-time width, so one spec serves any input dimension)."""
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        degree = int(self.get_degree())
+
+        def kernel_fn(model, cols):
+            return {out_col: poly_expand_fn(cols[in_col], degree)}
+
+        return KernelSpec(
+            input_cols=(in_col,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+        )

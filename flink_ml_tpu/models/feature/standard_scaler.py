@@ -113,7 +113,7 @@ def _stats_kernel():
 
 # Shared with the runtime-free StandardScalerModelServable — one jit cache
 # entry per (with_mean, with_std) across the batch, online and serving paths.
-from flink_ml_tpu.ops.kernels import scale_kernel as _transform_kernel
+from flink_ml_tpu.ops.kernels import scale_fn, scale_kernel as _transform_kernel
 
 
 class _ScalerTransformMixin(_ScalerParams):
@@ -161,6 +161,39 @@ class StandardScalerModel(ModelArraysMixin, Model, _ScalerTransformMixin):
     def transform(self, *inputs):
         (df,) = inputs
         return self._transform_df(df)
+
+    def kernel_spec(self):
+        """Standardization as a fusable spec for the batch fast path — the
+        same ``scale_fn`` body ``_transform_df``'s jitted kernel wraps, with
+        mean and precomputed inverse std as committed device buffers
+        (mirrors StandardScalerModelServable.kernel_spec)."""
+        if self.mean is None:
+            raise RuntimeError("model must be fit/loaded before kernel_spec")
+        from flink_ml_tpu.servable.kernel_spec import KernelSpec
+
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        with_mean, with_std = self.get_with_mean(), self.get_with_std()
+        std = np.asarray(self.std, np.float32)
+        inv_std = np.where(std == 0.0, 0.0, 1.0 / np.where(std == 0.0, 1.0, std))
+
+        def kernel_fn(model, cols):
+            return {
+                out_col: scale_fn(
+                    cols[in_col],
+                    model["mean"],
+                    model["inv_std"],
+                    with_mean=with_mean,
+                    with_std=with_std,
+                )
+            }
+
+        return KernelSpec(
+            input_cols=(in_col,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={"mean": np.asarray(self.mean, np.float32), "inv_std": inv_std},
+            kernel_fn=kernel_fn,
+            elementwise=True,  # shift + scale: no FP accumulation
+        )
 
 
 class StandardScaler(Estimator, _ScalerParams):

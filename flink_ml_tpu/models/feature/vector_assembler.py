@@ -7,13 +7,16 @@ on null/NaN/size mismatch, 'skip' drops the row, 'keep' fills nulls with NaN.
 """
 from __future__ import annotations
 
+import jax.numpy as jnp
 import numpy as np
 
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.linalg.vectors import Vector
+from flink_ml_tpu.ops.kernels import assemble_fn, assemble_kernel
 from flink_ml_tpu.params.param import IntArrayParam, ParamValidators
 from flink_ml_tpu.params.shared import HasHandleInvalid, HasInputCols, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["VectorAssembler"]
 
@@ -57,15 +60,13 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol, HasHandleInvalid)
         if len(sizes) != len(in_cols):
             raise ValueError("VectorAssembler: one input size per input column required")
         n = len(df)
-        total = sum(sizes)
-        assembled = np.zeros((n, total), np.float64)
         invalid = np.zeros(n, bool)
 
         # Size-mismatch semantics (VectorAssembler.java:120-126, 183-186): 'error'
         # raises, 'skip' drops the row, 'keep' keeps it (the reference then emits a
         # ragged output vector; the columnar layout here fills NaN instead — the
         # one documented deviation).
-        offset = 0
+        blocks = []
         for name, size in zip(in_cols, sizes):
             col = df.column(name)
             block = np.full((n, size), np.nan)
@@ -95,8 +96,10 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol, HasHandleInvalid)
                         invalid[i] = True
                         continue
                     block[i] = arr
-            assembled[:, offset : offset + size] = block
-            offset += size
+            blocks.append(block)
+        # The concat is the shared ``assemble`` kernel, so per-stage and fused
+        # outputs agree bitwise (device f32, stored as DOUBLE like every stage).
+        assembled = np.asarray(assemble_kernel()(*blocks), np.float64)
 
         nan_rows = np.isnan(assembled).any(axis=1)
         if handle == "error":
@@ -114,3 +117,40 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol, HasHandleInvalid)
             self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), assembled
         )
         return out
+
+    def kernel_spec(self):
+        """Concatenation as a fusable spec — ``assemble_fn``, the body
+        ``transform``'s jitted kernel wraps. Only 'keep' mode fuses: 'error'
+        must raise on runtime NaN (a host decision) and 'skip' changes the
+        row count. Inputs ingest as ``dense`` (null-bearing list columns fall
+        the segment back to the per-stage path); a declared-size mismatch is
+        static at trace time and fills NaN, exactly the 'keep' semantics."""
+        in_cols = self.get_input_cols()
+        if self.get_handle_invalid() != "keep" or not in_cols:
+            return None
+        out_col = self.get_output_col()
+        declared = self.get_input_sizes()
+        sizes = [int(s) for s in declared] if declared is not None else [None] * len(in_cols)
+        if len(sizes) != len(in_cols):
+            return None  # transform raises the param error on the classic path
+        bindings = tuple(zip(in_cols, sizes))
+
+        def kernel_fn(model, cols):
+            blocks = []
+            for name, size in bindings:
+                arr = cols[name]
+                if arr.ndim == 1:
+                    arr = arr[:, None]
+                if size is not None and arr.shape[1] != size:
+                    arr = jnp.full((arr.shape[0], size), jnp.nan, arr.dtype)
+                blocks.append(arr)
+            return {out_col: assemble_fn(*blocks)}
+
+        return KernelSpec(
+            input_cols=in_cols,
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+            input_kinds={n: "dense" for n in in_cols},
+            elementwise=True,  # reshape + concat: no FP arithmetic at all
+        )

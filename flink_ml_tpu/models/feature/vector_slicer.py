@@ -1,7 +1,9 @@
 """VectorSlicer.
 
 Reference: ``flink-ml-lib/.../feature/vectorslicer/VectorSlicer.java`` — select the
-given indices (in order, duplicates disallowed) from each input vector.
+given indices (in order, duplicates disallowed) from each input vector. Dense
+columns run the shared ``vector_slice`` gather kernel (``ops/kernels.py``);
+sparse/ragged vectors keep the host path (sparsity preserved).
 """
 from __future__ import annotations
 
@@ -10,8 +12,10 @@ import numpy as np
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.linalg.vectors import SparseVector, Vector
+from flink_ml_tpu.ops.kernels import vector_slice_fn, vector_slice_kernel
 from flink_ml_tpu.params.param import IntArrayParam
 from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["VectorSlicer"]
 
@@ -43,20 +47,24 @@ class VectorSlicer(Transformer, HasInputCol, HasOutputCol):
 
     def transform(self, *inputs):
         (df,) = inputs
-        idx = np.asarray([int(i) for i in self.get_indices()])
+        idx = tuple(int(i) for i in self.get_indices())
         col = df.column(self.get_input_col())
         out = df.clone()
         if isinstance(col, np.ndarray):
-            if idx.max() >= col.shape[1]:
+            if max(idx) >= col.shape[1]:
                 raise ValueError(
-                    f"Index {idx.max()} out of bounds for vector of size {col.shape[1]}"
+                    f"Index {max(idx)} out of bounds for vector of size {col.shape[1]}"
                 )
+            vals = vector_slice_kernel(idx)(col.astype(np.float64))
             out.add_column(
-                self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), col[:, idx]
+                self.get_output_col(),
+                DataTypes.vector(BasicType.DOUBLE),
+                np.asarray(vals, np.float64),
             )
         else:
+            idx_arr = np.asarray(idx)
             new_col = []
-            pos = {int(i): j for j, i in enumerate(idx)}
+            pos = {int(i): j for j, i in enumerate(idx_arr)}
             for v in col:
                 if isinstance(v, SparseVector):
                     keep = [j for j, i in enumerate(v.indices) if int(i) in pos]
@@ -64,13 +72,40 @@ class VectorSlicer(Transformer, HasInputCol, HasOutputCol):
                     order = np.argsort(new_idx) if len(new_idx) else new_idx
                     new_col.append(
                         SparseVector(
-                            len(idx),
+                            len(idx_arr),
                             new_idx[order] if len(new_idx) else new_idx,
                             v.values[keep][order] if len(keep) else np.zeros(0),
                         )
                     )
                 else:
                     arr = v.to_array() if isinstance(v, Vector) else np.asarray(v)
-                    new_col.append(arr[idx])
+                    new_col.append(arr[idx_arr])
             out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), new_col)
         return out
+
+    def kernel_spec(self):
+        """Feature gather as a fusable spec — ``vector_slice_fn``, the body
+        ``transform``'s jitted kernel wraps. List (sparse) columns stay
+        per-stage, so the input ingests as ``dense``; an out-of-bounds index
+        for the traced width fails at compile, like ``transform`` raises."""
+        if self.get_indices() is None:
+            return None
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        idx = tuple(int(i) for i in self.get_indices())
+
+        def kernel_fn(model, cols):
+            X = cols[in_col]
+            if max(idx) >= X.shape[1]:  # static trace-time width
+                raise ValueError(
+                    f"Index {max(idx)} out of bounds for vector of size {X.shape[1]}"
+                )
+            return {out_col: vector_slice_fn(X, idx)}
+
+        return KernelSpec(
+            input_cols=(in_col,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+            input_kinds={in_col: "dense"},
+            elementwise=True,  # gather: no FP arithmetic at all
+        )

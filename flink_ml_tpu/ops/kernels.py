@@ -14,6 +14,7 @@ by test.
 from __future__ import annotations
 
 import functools
+import itertools
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +31,32 @@ __all__ = [
     "kmeans_predict_kernel",
     "scale_fn",
     "scale_kernel",
+    # feature-transform bodies (batch fast path, docs/batch_transform.md)
+    "binarize_fn",
+    "binarize_kernel",
+    "normalize_fn",
+    "normalize_kernel",
+    "elementwise_product_fn",
+    "elementwise_product_kernel",
+    "poly_expand_fn",
+    "poly_expand_kernel",
+    "interaction_fn",
+    "interaction_kernel",
+    "dct_basis",
+    "dct_fn",
+    "dct_kernel",
+    "impute_fn",
+    "impute_kernel",
+    "bucketize_fn",
+    "bucketize_kernel",
+    "kbins_transform_fn",
+    "kbins_transform_kernel",
+    "vector_slice_fn",
+    "vector_slice_kernel",
+    "assemble_fn",
+    "assemble_kernel",
+    "idf_scale_fn",
+    "idf_scale_kernel",
 ]
 
 
@@ -149,3 +176,211 @@ def scale_kernel(with_mean: bool, with_std: bool):
         return scale_fn(X, mean, inv_std, with_mean=with_mean, with_std=with_std)
 
     return kernel
+
+
+# ---------------------------------------------------------------------------
+# Feature-transform bodies — the batch fast path (builder/batch_plan.py).
+#
+# Each transformer in models/feature/ that exports a KernelSpec routes its
+# per-stage ``transform`` through the jitted ``*_kernel`` here, and its spec's
+# ``kernel_fn`` composes the matching ``*_fn`` body — so the fused
+# device-resident chain and the per-stage fallback trace identical operations
+# (enforced by graftcheck's kernel-spec-consistency rule).
+# ---------------------------------------------------------------------------
+
+
+def binarize_fn(x, threshold: float):
+    """values > threshold → 1 else 0, in the input's dtype (ref Binarizer.java)."""
+    return (x > threshold).astype(x.dtype)
+
+
+@functools.cache
+def binarize_kernel(threshold: float):
+    """Jitted ``binarize_fn`` at a fixed threshold — one cache entry per
+    threshold, shared by Binarizer.transform and its kernel spec."""
+    return jax.jit(lambda x: binarize_fn(x, threshold))
+
+
+def normalize_fn(X, p: float):
+    """Scale each row to unit p-norm; zero rows stay zero (ref Normalizer.java)."""
+    norm = jnp.sum(jnp.abs(X) ** p, axis=1, keepdims=True) ** (1.0 / p)
+    return X / jnp.where(norm == 0.0, 1.0, norm)
+
+
+@functools.cache
+def normalize_kernel(p: float):
+    """Jitted ``normalize_fn`` at a fixed p."""
+    return jax.jit(lambda X: normalize_fn(X, p))
+
+
+def elementwise_product_fn(X, scaling):
+    """Hadamard product with the scaling vector (ref ElementwiseProduct.java)."""
+    return X * scaling[None, :]
+
+
+@functools.cache
+def elementwise_product_kernel():
+    """Jitted ``elementwise_product_fn``."""
+    return jax.jit(elementwise_product_fn)
+
+
+@functools.cache
+def _poly_combos(d: int, degree: int):
+    out = []
+    for deg in range(1, degree + 1):
+        out.extend(itertools.combinations_with_replacement(range(d), deg))
+    return tuple(out)
+
+
+def poly_expand_fn(X, degree: int):
+    """All monomials of degree 1..degree over the row, combos grouped by degree
+    (ref PolynomialExpansion.java; ordering documented in that module). The
+    combo set derives from the static trace-time width ``X.shape[1]``."""
+    combos = _poly_combos(X.shape[1], degree)
+    cols = [jnp.prod(X[:, jnp.asarray(c)], axis=1) for c in combos]
+    return jnp.stack(cols, axis=1)
+
+
+@functools.cache
+def poly_expand_kernel(degree: int):
+    """Jitted ``poly_expand_fn`` at a fixed degree (per-width programs come
+    from jit's shape specialization)."""
+    return jax.jit(lambda X: poly_expand_fn(X, degree))
+
+
+def interaction_fn(*cols):
+    """Batched outer product across columns: [n,d1] x [n,d2] ... -> [n,d1*d2*...]
+    with the first column's index varying slowest (ref Interaction.java)."""
+    acc = cols[0]
+    for c in cols[1:]:
+        acc = acc[:, :, None] * c[:, None, :]
+        acc = acc.reshape(acc.shape[0], -1)
+    return acc
+
+
+@functools.cache
+def interaction_kernel():
+    """Jitted ``interaction_fn`` (variadic; shape-specialized by jit)."""
+    return jax.jit(interaction_fn)
+
+
+@functools.cache
+def dct_basis(d: int, inverse: bool) -> np.ndarray:
+    """Orthonormal DCT-II basis B[k, j] = s_k cos(pi (j + 1/2) k / d), already
+    transposed for the forward direction so ``dct_fn`` is a plain matmul in
+    both directions (orthonormal: the inverse is the transpose)."""
+    j = np.arange(d)
+    k = np.arange(d)[:, None]
+    basis = np.cos(np.pi * (j + 0.5) * k / d)
+    scale = np.full(d, np.sqrt(2.0 / d))
+    scale[0] = np.sqrt(1.0 / d)
+    mat = (basis * scale[:, None]).astype(np.float64)
+    return mat if inverse else np.ascontiguousarray(mat.T)
+
+
+def dct_fn(X, basis):
+    """Cosine-basis matmul — the whole-batch MXU form of the reference's
+    per-row FFT call (ref DCT.java). ``basis`` is the [d, d] matrix from
+    :func:`dct_basis`, embedded as a trace-time constant by both the
+    per-stage kernel and the fused spec."""
+    return X @ jnp.asarray(basis)
+
+
+@functools.cache
+def dct_kernel(d: int, inverse: bool):
+    """Jitted ``dct_fn`` with the basis for dimension ``d`` burned in as a
+    compile-time constant — one cache entry per (d, direction)."""
+    basis = dct_basis(d, inverse)
+    return jax.jit(lambda X: dct_fn(X, basis))
+
+
+def impute_fn(x, surrogate, missing_is_nan: bool, missing_value: float):
+    """Replace missing entries with the surrogate (ref ImputerModel.java).
+    The missing-value test is static: NaN placeholders compare via isnan."""
+    miss = jnp.isnan(x) if missing_is_nan else (x == missing_value)
+    return jnp.where(miss, surrogate, x)
+
+
+@functools.cache
+def impute_kernel(missing_is_nan: bool, missing_value: float):
+    """Jitted ``impute_fn`` at a fixed missing-value placeholder. NaN
+    placeholders must be canonicalized to ``(True, 0.0)`` by the caller so the
+    cache key stays hashable-equal."""
+    return jax.jit(lambda x, s: impute_fn(x, s, missing_is_nan, missing_value))
+
+
+def bucketize_fn(x, splits, keep_invalid: bool):
+    """Bucket ids for [splits[j], splits[j+1]) with a right-inclusive last
+    bucket, plus the invalid mask (ref Bucketizer.java). ``keep_invalid``
+    maps invalid entries to the extra bucket numSplits-1 (the 'keep' mode);
+    otherwise they keep their clamped id and the caller handles the mask
+    (raise for 'error', row-drop for 'skip') on the host."""
+    n = splits.shape[0]
+    idx = jnp.searchsorted(splits, x, side="right") - 1
+    idx = jnp.where(x == splits[n - 1], n - 2, idx)
+    invalid = (x < splits[0]) | (x > splits[n - 1]) | jnp.isnan(x)
+    if keep_invalid:
+        idx = jnp.where(invalid, n - 1, idx)
+    return idx.astype(jnp.float32), invalid
+
+
+@functools.cache
+def bucketize_kernel(keep_invalid: bool):
+    """Jitted ``bucketize_fn`` at a fixed invalid-handling mode."""
+    return jax.jit(lambda x, splits: bucketize_fn(x, splits, keep_invalid))
+
+
+def kbins_transform_fn(X, edges, n_edges):
+    """Per-dimension bin ids with out-of-range clamping (ref
+    KBinsDiscretizerModel.java). ``edges`` is [d, E] right-padded with +inf
+    (ragged per-dim edge counts padded to the max), ``n_edges`` [d] the real
+    counts — finite values never land in the padding, and the per-dim clip
+    bound comes from the real count."""
+
+    def per_dim(x_col, e, ne):
+        idx = jnp.searchsorted(e, x_col, side="right") - 1
+        return jnp.clip(idx, 0, ne - 2)
+
+    idx = jax.vmap(per_dim, in_axes=(1, 0, 0), out_axes=1)(X, edges, n_edges)
+    return idx.astype(X.dtype)
+
+
+@functools.cache
+def kbins_transform_kernel():
+    """Jitted ``kbins_transform_fn``."""
+    return jax.jit(kbins_transform_fn)
+
+
+def vector_slice_fn(X, indices: tuple):
+    """Select the given feature indices, in order (ref VectorSlicer.java)."""
+    return X[:, jnp.asarray(indices)]
+
+
+@functools.cache
+def vector_slice_kernel(indices: tuple):
+    """Jitted ``vector_slice_fn`` at a fixed index set."""
+    return jax.jit(lambda X: vector_slice_fn(X, indices))
+
+
+def assemble_fn(*blocks):
+    """Concatenate per-column [n, size] blocks into one vector column
+    (ref VectorAssembler.java); scalar columns arrive as [n] and reshape."""
+    n = blocks[0].shape[0]
+    return jnp.concatenate([b.reshape(n, -1) for b in blocks], axis=1)
+
+
+@functools.cache
+def assemble_kernel():
+    """Jitted ``assemble_fn`` (variadic; shape-specialized by jit)."""
+    return jax.jit(assemble_fn)
+
+
+def idf_scale_fn(X, idf):
+    """Term-frequency vectors scaled elementwise by idf (ref IDFModel.java)."""
+    return X * idf[None, :]
+
+
+@functools.cache
+def idf_scale_kernel():
+    """Jitted ``idf_scale_fn``."""
+    return jax.jit(idf_scale_fn)

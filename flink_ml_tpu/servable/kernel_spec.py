@@ -1,44 +1,71 @@
-"""KernelSpec — a servable's transform as a pure, fusable device program.
+"""KernelSpec — a transform as a pure, fusable device program.
 
-``TransformerServable.transform`` is a host-level contract: DataFrame in,
-DataFrame out. That is the right boundary for generality, but in a serving
-pipeline it forces a full host materialization between every pair of stages
-and re-uploads model arrays on every call. A servable that is row-wise and
-numerically pure can *additionally* describe itself as a :class:`KernelSpec`:
+``TransformerServable.transform`` (and a training-side ``Transformer``'s
+``transform``) is a host-level contract: DataFrame in, DataFrame out. That is
+the right boundary for generality, but in a pipeline it forces a full host
+materialization between every pair of stages and re-uploads model arrays on
+every call. A stage that is row-wise and numerically pure can *additionally*
+describe itself as a :class:`KernelSpec`:
 
-- ``input_cols`` — the dense vector columns the kernel reads. Each is
-  ingested exactly the way ``transform`` would read it
-  (``df.vectors(col).astype(float32)``), so the fused path sees bit-identical
-  inputs.
+- ``input_cols`` — the columns the kernel reads. Each is ingested exactly the
+  way ``transform`` would read it, in float32 (the device dtype JAX
+  canonicalizes to); the ``input_kinds`` entry picks the host accessor:
+
+  * ``"vector"`` (default) — ``df.vectors(col)``: dense [n, d], scalars
+    widened to [n, 1], lists of dense vectors stacked.
+  * ``"scalar"`` — ``df.scalars(col)``: a [n] scalar column.
+  * ``"dense"`` — ``df.column(col)`` must already be an ndarray ([n] or
+    [n, d]), kept at its natural shape. Used by transforms whose per-stage
+    path does *host* math for ragged (list) columns — a list column must fall
+    back so fused and per-stage results agree.
+
+  Sparse columns are never ingested — they raise the planner's ineligibility
+  signal and the whole segment falls back to per-stage ``transform``.
 - ``outputs`` — ``(column name, DataType)`` pairs the kernel produces, in the
-  order ``transform`` would ``add_column`` them.
+  order ``transform`` would ``add_column`` them. A ``None`` DataType means
+  "infer at readback" (scalar DOUBLE for 1-d results, vector(DOUBLE) for
+  2-d) — for transforms like Binarizer whose output shape follows the input.
+- ``readback_dtypes`` — optional per-output numpy dtype for the host
+  readback; defaults to float64 (the tier's storage dtype).
 - ``model_arrays`` — name → host ndarray, already in the dtype the kernel
-  consumes. The serving plan uploads these ONCE (at publish/warmup time) and
-  the per-request path only ever passes the committed device buffers back in.
+  consumes. The plan uploads these ONCE (at build/warmup time) and the hot
+  path only ever passes the committed device buffers back in.
+- ``elementwise`` — declares the kernel body free of cross-element floating
+  point accumulation (no sums/dots/norms/prods: comparisons, gathers,
+  concats, and per-element arithmetic only). The planner MERGES consecutive
+  elementwise specs into one XLA program: with no reduction in the merged
+  graph there is no accumulation order to reorder, so the merge is bit-exact
+  by construction, while a spec with a reduction (Normalizer's row norm,
+  DCT's matmul) always keeps its own program (see ``servable/planner.py``).
+  Default False — unset is always safe, merely unmerged.
 - ``kernel_fn(model_arrays, column_arrays) -> {name: array}`` — pure jnp math
   from the shared ``ops/kernels.py`` ``*_fn`` bodies. It must not touch the
-  host (no ``.item()``, no numpy on traced values, no I/O): the serving plan
-  AOT-compiles consecutive specs into a per-bucket executable chain
-  (``serving/plan.py``), and anything impure would be burned in at trace time.
+  host (no ``.item()``, no numpy on traced values, no I/O): the planners
+  AOT-compile consecutive specs into executable chains (``servable/planner.py``)
+  and anything impure would be burned in at trace time.
 
-The spec is a *snapshot*: it captures the servable's current params and model
+The spec is a *snapshot*: it captures the stage's current params and model
 data at construction, which is exactly the hot-swap discipline — a published
 version is immutable, so the plan compiled from its specs stays valid for the
-version's whole serving life.
+version's whole serving life. The batch tier re-snapshots when a pipeline's
+params or model data change (``builder/batch_plan.py``).
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Mapping, Sequence, Tuple
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
 __all__ = ["KernelSpec"]
 
+_VALID_KINDS = ("vector", "scalar", "dense")
+
 
 class KernelSpec:
-    """Pure-kernel description of one servable stage (see module docstring)."""
+    """Pure-kernel description of one pipeline stage (see module docstring)."""
 
-    __slots__ = ("input_cols", "outputs", "model_arrays", "kernel_fn")
+    __slots__ = ("input_cols", "outputs", "model_arrays", "kernel_fn",
+                 "input_kinds", "readback_dtypes", "elementwise")
 
     def __init__(
         self,
@@ -47,6 +74,9 @@ class KernelSpec:
         outputs: Sequence[Tuple[str, Any]],
         model_arrays: Mapping[str, np.ndarray],
         kernel_fn: Callable[[Dict[str, Any], Dict[str, Any]], Dict[str, Any]],
+        input_kinds: Optional[Mapping[str, str]] = None,
+        readback_dtypes: Optional[Mapping[str, Any]] = None,
+        elementwise: bool = False,
     ):
         self.input_cols: Tuple[str, ...] = tuple(input_cols)
         self.outputs: Tuple[Tuple[str, Any], ...] = tuple(outputs)
@@ -54,10 +84,26 @@ class KernelSpec:
             k: np.asarray(v) for k, v in model_arrays.items()
         }
         self.kernel_fn = kernel_fn
+        self.input_kinds: Dict[str, str] = dict(input_kinds or {})
+        for name, kind in self.input_kinds.items():
+            if kind not in _VALID_KINDS:
+                raise ValueError(
+                    f"input kind {kind!r} for column {name!r}; expected one of {_VALID_KINDS}"
+                )
+        self.readback_dtypes: Dict[str, Any] = {
+            k: np.dtype(v) for k, v in (readback_dtypes or {}).items()
+        }
+        self.elementwise = bool(elementwise)
 
     @property
     def output_names(self) -> Tuple[str, ...]:
         return tuple(name for name, _ in self.outputs)
+
+    def input_kind(self, name: str) -> str:
+        return self.input_kinds.get(name, "vector")
+
+    def readback_dtype(self, name: str) -> np.dtype:
+        return self.readback_dtypes.get(name, np.dtype(np.float64))
 
     def __repr__(self) -> str:
         return (

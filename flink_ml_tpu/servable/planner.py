@@ -1,0 +1,297 @@
+"""Shared stage-chain planner — the compiler both fast paths are built on.
+
+PR 4's serving fast path (``serving/plan.py``) introduced the machinery:
+consecutive :class:`~flink_ml_tpu.servable.kernel_spec.KernelSpec` stages
+compose into an **executable chain** — one AOT program per stage, stage
+outputs flowing between programs as device arrays, a single host→device
+ingest and a single device→host readback, zero inter-stage DataFrame
+materialization. The batch tier (``builder/batch_plan.py``) needs exactly the
+same compiler over the same specs, so the chain machinery lives here, at the
+servable layer, metric-free and policy-free; the two plan classes add their
+own policy on top:
+
+- the serving plan keys programs by padded *bucket*, AOT-warms them before a
+  version flip, and falls back per batch on any signature mismatch;
+- the batch plan keys programs by the ingest *signature* itself (chunk rows ×
+  column widths), compiles lazily on first sight, and streams chunks through
+  with a double-buffered prefetch window.
+
+Program granularity — the bit-exactness contract:
+
+Whole-pipeline programs are NOT bit-stable — XLA legally fuses one stage's
+elementwise math into the next stage's dot reduction, which reorders the
+accumulation (measured: 100s of ulps on a scaler→logistic margin at widths
+≥ 8, and an ``optimization_barrier`` does not pin the dot emitter's choice).
+So any spec containing a reduction (Normalizer's row norm, DCT's matmul, a
+model head's dot) keeps its OWN program: on the same input bits it reproduces
+the per-stage path's numerics by construction.
+
+Consecutive specs that declare ``elementwise=True`` (no cross-element FP
+accumulation at all — comparisons, gathers, concats, per-element arithmetic)
+DO merge into one program: a reduction-free graph has no accumulation order
+for XLA to reorder, each merged stage's output is still a program output (a
+single HLO value feeds both the readback and the next stage — identical to
+handing the same device array to a separate program), and every elementwise
+op computes per element exactly as it would alone. Merging saves one HBM
+round-trip and one program dispatch per interior boundary, which is most of
+the fused win on short chains.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.api.types import BasicType, DataTypes
+
+__all__ = [
+    "IneligibleBatch",
+    "FusedSegment",
+    "FallbackStage",
+    "PlanExecution",
+    "build_segments",
+    "run_segment",
+]
+
+
+class IneligibleBatch(Exception):
+    """This batch cannot ride a fused executable (sparse/ragged input, or a
+    shape differing from the compiled signature) — fall back to per-stage."""
+
+
+class _Program:
+    """One XLA program of a segment's chain: a single spec, or a merged run
+    of consecutive ``elementwise`` specs (see module docstring)."""
+
+    __slots__ = ("specs", "models", "inputs", "jitted")
+
+    def __init__(self, specs: Sequence[Any], models: Sequence[Dict[str, Any]]):
+        self.specs = tuple(specs)
+        self.models = tuple(models)
+        needed: List[str] = []
+        produced: set = set()
+        for spec in self.specs:
+            for name in spec.input_cols:
+                if name not in produced and name not in needed:
+                    needed.append(name)
+            produced.update(spec.output_names)
+        self.inputs: Tuple[str, ...] = tuple(needed)
+
+        def program_fn(models, cols):
+            cols = dict(cols)
+            outs: Dict[str, Any] = {}
+            for spec, model in zip(self.specs, models):
+                stage_out = spec.kernel_fn(model, cols)
+                cols.update(stage_out)
+                outs.update(stage_out)
+            return outs
+
+        self.jitted = jax.jit(program_fn)
+
+
+class FusedSegment:
+    """A maximal run of consecutive kernel-spec stages, compiled as one
+    executable chain per key: one AOT program per reduction-bearing stage
+    (merged programs for elementwise runs), stage outputs flowing between
+    programs as device arrays (never through the host)."""
+
+    __slots__ = (
+        "stages", "specs", "external_inputs", "device_models", "programs",
+        "compiled", "signatures",
+    )
+
+    def __init__(self, staged: Sequence[Tuple[Any, Any]]):
+        self.stages = [stage for stage, _ in staged]
+        self.specs = [spec for _, spec in staged]
+        produced: set = set()
+        external: List[str] = []
+        for spec in self.specs:
+            for name in spec.input_cols:
+                if name not in produced and name not in external:
+                    external.append(name)
+            produced.update(spec.output_names)
+        self.external_inputs: Tuple[str, ...] = tuple(external)
+        # One upload per model array, at construction — the committed buffers
+        # the hot path closes over.
+        self.device_models: Tuple[Dict[str, Any], ...] = tuple(
+            {k: jax.device_put(v) for k, v in spec.model_arrays.items()}
+            for spec in self.specs
+        )
+        # Program partition (see module docstring): consecutive elementwise
+        # specs merge into one program; anything with a reduction keeps its
+        # own so no accumulation can cross a per-stage-path boundary.
+        self.programs: List[_Program] = []
+        i = 0
+        while i < len(self.specs):
+            j = i + 1
+            if self.specs[i].elementwise:
+                while j < len(self.specs) and self.specs[j].elementwise:
+                    j += 1
+            self.programs.append(
+                _Program(self.specs[i:j], self.device_models[i:j])
+            )
+            i = j
+        #: key -> [jax.stages.Compiled, ...] (one per program, in order)
+        self.compiled: Dict[Hashable, List[Any]] = {}
+        #: key -> {input name: (shape, dtype)} recorded at compile time
+        self.signatures: Dict[Hashable, Dict[str, Tuple[Tuple[int, ...], Any]]] = {}
+
+    def input_kind(self, name: str) -> str:
+        """The ingest accessor for an external input — the first consuming
+        spec's declared kind (specs sharing a column agree by construction:
+        they all read it the way ``transform`` would)."""
+        for spec in self.specs:
+            if name in spec.input_cols:
+                return spec.input_kind(name)
+        return "vector"
+
+    def gather(self, df: DataFrame, name: str, *, raw: bool = False) -> np.ndarray:
+        """One host-side gather of an external input column, exactly the way
+        the consuming stage's ``transform`` would read it, as float32 (the
+        dtype JAX canonicalizes device arrays to — host astype and jit-time
+        canonicalization round identically). ``raw=True`` skips the float32
+        cast so a caller can do its own (the batch tier casts large inputs in
+        parallel row blocks — block-wise astype is the same value-exact cast).
+        Raises :class:`IneligibleBatch` for anything a fused program cannot
+        take."""
+        try:
+            if df.is_sparse(name):
+                raise IneligibleBatch(f"column {name!r} is sparse")
+            kind = self.input_kind(name)
+            if kind == "scalar":
+                arr = df.scalars(name)
+            elif kind == "dense":
+                col = df.column(name)
+                if not isinstance(col, np.ndarray):
+                    raise IneligibleBatch(
+                        f"column {name!r} is ragged — per-stage path owns list columns"
+                    )
+                arr = col
+            else:
+                arr = df.vectors(name)
+            if raw:
+                return arr
+            return np.asarray(arr, np.float32)
+        except IneligibleBatch:
+            raise
+        except Exception as e:  # ragged / non-numeric / missing column
+            raise IneligibleBatch(f"column {name!r} not fusable: {e}") from e
+
+    @property
+    def outputs(self) -> List[Tuple[str, Any]]:
+        out: List[Tuple[str, Any]] = []
+        for spec in self.specs:
+            out.extend(spec.outputs)
+        return out
+
+    def pending(self, outputs: Dict[str, Any]) -> List[Tuple[str, Any, Any, Any]]:
+        """Readback-ready (name, declared DataType, device array, numpy dtype)
+        tuples for every declared stage output, in ``add_column`` order."""
+        out = []
+        for spec in self.specs:
+            for name, dtype in spec.outputs:
+                out.append((name, dtype, outputs[name], spec.readback_dtype(name)))
+        return out
+
+
+class FallbackStage:
+    """A stage served through its ordinary ``transform`` (no kernel spec)."""
+
+    __slots__ = ("stage",)
+
+    def __init__(self, stage):
+        self.stage = stage
+
+
+def build_segments(stages: Sequence[Any]) -> List[Any]:
+    """Group consecutive kernel-spec stages into :class:`FusedSegment` runs,
+    everything else into :class:`FallbackStage`. Raises whatever
+    ``kernel_spec()`` raises (an unloaded model must fail closed at plan
+    build, before it could ever run); a stage whose ``kernel_spec()`` returns
+    None falls back."""
+    segments: List[Any] = []
+    run: List[Tuple[Any, Any]] = []
+    for stage in stages:
+        spec = stage.kernel_spec() if hasattr(stage, "kernel_spec") else None
+        if spec is not None:
+            run.append((stage, spec))
+        else:
+            if run:
+                segments.append(FusedSegment(run))
+                run = []
+            segments.append(FallbackStage(stage))
+    if run:
+        segments.append(FusedSegment(run))
+    return segments
+
+
+def run_segment(
+    segment: FusedSegment,
+    key: Hashable,
+    inputs: Dict[str, Any],
+    *,
+    on_compile: Optional[Callable[[], None]] = None,
+) -> Dict[str, Any]:
+    """Execute the segment's executable chain for ``key``: each program runs
+    on the committed device model buffers and the (device-resident) outputs
+    of the programs before it. Compiles the chain first if ``key`` was never
+    seen — calling ``on_compile`` once so the caller can count it (the
+    serving tier's warmup-coverage alarm, the batch tier's chunk-shape
+    accounting)."""
+    chain = segment.compiled.get(key)
+    if chain is None:
+        if on_compile is not None:
+            on_compile()
+        chain = []
+        cols: Dict[str, Any] = dict(inputs)
+        for prog in segment.programs:
+            stage_inputs = {n: cols[n] for n in prog.inputs}
+            compiled = prog.jitted.lower(
+                prog.models,
+                {
+                    n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for n, a in stage_inputs.items()
+                },
+            ).compile()
+            chain.append(compiled)
+            cols.update(compiled(prog.models, stage_inputs))
+        segment.compiled[key] = chain
+        segment.signatures[key] = {
+            name: (tuple(arr.shape), arr.dtype) for name, arr in inputs.items()
+        }
+    cols = dict(inputs)
+    outs: Dict[str, Any] = {}
+    for prog, compiled in zip(segment.programs, chain):
+        prog_out = compiled(prog.models, {n: cols[n] for n in prog.inputs})
+        cols.update(prog_out)
+        outs.update(prog_out)
+    return outs
+
+
+class PlanExecution:
+    """An in-flight dispatched batch: host DataFrame so far plus trailing
+    fused outputs still resident on device. ``finalize`` is the single
+    blocking readback."""
+
+    __slots__ = ("_df", "_pending")
+
+    def __init__(self, df: DataFrame, pending: List[Tuple[str, Any, Any, Any]]):
+        self._df = df
+        self._pending = pending
+
+    def finalize(self) -> DataFrame:
+        if not self._pending:
+            return self._df
+        out = self._df.clone()
+        for name, dtype, arr, np_dtype in self._pending:
+            host = np.asarray(arr, np_dtype)
+            if dtype is None:  # shape-following output: infer like transform would
+                dtype = (
+                    DataTypes.vector(BasicType.DOUBLE)
+                    if host.ndim == 2
+                    else DataTypes.DOUBLE
+                )
+            out.add_column(name, dtype, host)
+        return out

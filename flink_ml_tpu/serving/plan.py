@@ -12,35 +12,22 @@ generality forces but a hot path never should:
    flatten) instead of a pre-compiled executable.
 
 The plan removes all three for stages that expose a
-:class:`~flink_ml_tpu.servable.kernel_spec.KernelSpec`:
+:class:`~flink_ml_tpu.servable.kernel_spec.KernelSpec`. The chain compiler —
+fusion into per-stage AOT programs with device-resident model buffers and
+device-to-device stage handoff — is the shared planner
+(``servable/planner.py``, also behind the batch tier's
+``builder/batch_plan.py``); this module adds the *serving* policy:
 
-- **Fusion** (the operator-fusion win of "On Optimizing Operator Fusion Plans
-  for Large-Scale Machine Learning in SystemML", PAPERS.md): consecutive
-  spec-bearing stages compose into one pre-compiled **executable chain** per
-  batch bucket — single host→device ingest of the input columns, stage
-  outputs flowing between stage programs as device arrays, single
-  device→host readback of the declared outputs, zero inter-stage DataFrame
-  materialization. Each stage keeps its OWN program (the same
-  ``ops/kernels.py`` ``*_fn`` body its jitted per-stage kernel wraps) rather
-  than collapsing the chain into one XLA program: whole-pipeline programs
-  are NOT bit-stable — XLA legally fuses one stage's elementwise math into
-  the next stage's dot reduction, which reorders the accumulation (measured:
-  100s of ulps on a scaler→logistic margin at most widths ≥ 8, and an
-  ``optimization_barrier`` does not pin the dot emitter's choice). Per-stage
-  programs on the same input bits are the per-stage path's numerics by
-  construction, so fused results stay bit-exact within a bucket shape — the
-  serving tier's response contract — while still eliminating the host round
-  trips, the per-call weight uploads, and all tracing from the hot path.
-- **Device-resident model state**: each spec's model arrays are
-  ``jax.device_put`` ONCE at plan construction (publish/warmup time, off the
-  serving path); the per-request path only passes the committed buffers back
-  into the executable — it never uploads weights.
-- **AOT compilation** (the warmup discipline of "Fine-Tuning and Serving
-  Gemma on Cloud TPU", PAPERS.md): ``warmup`` lowers and compiles every
-  (segment, bucket) executable via ``jit(...).lower(...).compile()`` before
-  the version flip, so the hot path never traces or compiles. A bucket the
-  warmup did not cover compiles lazily and bumps
-  ``ml.serving.fastpath.compiles`` — the alarm that warmup coverage is wrong.
+- **Per-bucket programs** (the operator-fusion win of "On Optimizing Operator
+  Fusion Plans for Large-Scale Machine Learning in SystemML", PAPERS.md):
+  chains are keyed by the micro-batcher's padded bucket sizes, so the
+  executable set is fixed and small.
+- **AOT warmup** (the warmup discipline of "Fine-Tuning and Serving Gemma on
+  Cloud TPU", PAPERS.md): ``warmup`` lowers and compiles every
+  (segment, bucket) executable before the version flip, so the hot path never
+  traces or compiles. A bucket the warmup did not cover compiles lazily and
+  bumps ``ml.serving.fastpath.compiles`` — the alarm that warmup coverage is
+  wrong.
 - **Fallback**: stages without a spec run their ordinary ``transform`` on a
   materialized DataFrame, so mixed pipelines serve bit-exactly; a batch whose
   input columns do not match the compiled signature (sparse features, changed
@@ -58,94 +45,27 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-import jax
 import numpy as np
 
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.servable.builder import PipelineModelServable
+from flink_ml_tpu.servable.planner import (
+    FallbackStage,
+    FusedSegment,
+    IneligibleBatch,
+    PlanExecution,
+    build_segments,
+    run_segment,
+)
 from flink_ml_tpu.serving.batcher import pad_to
 
 __all__ = ["CompiledServingPlan", "PlanExecution"]
 
-
-class _IneligibleBatch(Exception):
-    """This batch cannot ride the fused executable (sparse/ragged input, or a
-    shape differing from the compiled signature) — fall back to per-stage."""
-
-
-class _FusedSegment:
-    """A maximal run of consecutive kernel-spec stages, compiled as one
-    executable chain per bucket: one AOT program per stage, stage outputs
-    flowing between programs as device arrays (never through the host)."""
-
-    __slots__ = (
-        "stages", "specs", "external_inputs", "device_models", "stage_jits",
-        "compiled", "signatures",
-    )
-
-    def __init__(self, staged: Sequence[Tuple[Any, Any]]):
-        self.stages = [stage for stage, _ in staged]
-        self.specs = [spec for _, spec in staged]
-        produced: set = set()
-        external: List[str] = []
-        for spec in self.specs:
-            for name in spec.input_cols:
-                if name not in produced and name not in external:
-                    external.append(name)
-            produced.update(spec.output_names)
-        self.external_inputs: Tuple[str, ...] = tuple(external)
-        # One upload per model array, at construction — the committed buffers
-        # the hot path closes over.
-        self.device_models: Tuple[Dict[str, Any], ...] = tuple(
-            {k: jax.device_put(v) for k, v in spec.model_arrays.items()}
-            for spec in self.specs
-        )
-        # One program per STAGE (see module docstring: a whole-chain program
-        # would let XLA reorder a dot reduction across the stage boundary and
-        # break bit-exactness vs the per-stage path).
-        self.stage_jits = [
-            jax.jit(spec.kernel_fn) for spec in self.specs
-        ]
-        #: bucket -> [jax.stages.Compiled, ...] (one per stage, in order)
-        self.compiled: Dict[int, List[Any]] = {}
-        self.signatures: Dict[int, Dict[str, Tuple[Tuple[int, ...], Any]]] = {}
-
-    @property
-    def outputs(self) -> List[Tuple[str, Any]]:
-        out: List[Tuple[str, Any]] = []
-        for spec in self.specs:
-            out.extend(spec.outputs)
-        return out
-
-
-class _FallbackStage:
-    """A stage served through its ordinary ``transform`` (no kernel spec)."""
-
-    __slots__ = ("stage",)
-
-    def __init__(self, stage):
-        self.stage = stage
-
-
-class PlanExecution:
-    """An in-flight dispatched batch: host DataFrame so far plus trailing
-    fused outputs still resident on device. ``finalize`` is the single
-    blocking readback."""
-
-    __slots__ = ("_df", "_pending")
-
-    def __init__(self, df: DataFrame, pending: List[Tuple[str, Any, Any]]):
-        self._df = df
-        self._pending = pending
-
-    def finalize(self) -> DataFrame:
-        if not self._pending:
-            return self._df
-        out = self._df.clone()
-        for name, dtype, arr in self._pending:
-            out.add_column(name, dtype, np.asarray(arr, np.float64))
-        return out
+# Back-compat aliases — the private names tests and tooling grew up with.
+_IneligibleBatch = IneligibleBatch
+_FusedSegment = FusedSegment
+_FallbackStage = FallbackStage
 
 
 class CompiledServingPlan:
@@ -157,8 +77,8 @@ class CompiledServingPlan:
         self._stages = list(stages)
         self.segments = segments
         self.scope = scope
-        n_fused = sum(len(s.specs) for s in segments if isinstance(s, _FusedSegment))
-        n_fallback = sum(1 for s in segments if isinstance(s, _FallbackStage))
+        n_fused = sum(len(s.specs) for s in segments if isinstance(s, FusedSegment))
+        n_fallback = sum(1 for s in segments if isinstance(s, FallbackStage))
         metrics.gauge(scope, MLMetrics.SERVING_FUSED_STAGES, n_fused)
         metrics.gauge(scope, MLMetrics.SERVING_FALLBACK_STAGES, n_fallback)
 
@@ -173,20 +93,8 @@ class CompiledServingPlan:
             if isinstance(servable, PipelineModelServable)
             else [servable]
         )
-        segments: List[Any] = []
-        run: List[Tuple[Any, Any]] = []
-        for stage in stages:
-            spec = stage.kernel_spec() if hasattr(stage, "kernel_spec") else None
-            if spec is not None:
-                run.append((stage, spec))
-            else:
-                if run:
-                    segments.append(_FusedSegment(run))
-                    run = []
-                segments.append(_FallbackStage(stage))
-        if run:
-            segments.append(_FusedSegment(run))
-        if not any(isinstance(s, _FusedSegment) for s in segments):
+        segments = build_segments(stages)
+        if not any(isinstance(s, FusedSegment) for s in segments):
             return None
         return CompiledServingPlan(stages, segments, scope)
 
@@ -200,12 +108,12 @@ class CompiledServingPlan:
         for bucket in buckets:
             df = pad_to(template, bucket)
             for segment in self.segments:
-                if isinstance(segment, _FallbackStage):
+                if isinstance(segment, FallbackStage):
                     df = segment.stage.transform(df)
                     continue
                 try:
                     inputs = self._ingest(segment, df, bucket)
-                except _IneligibleBatch:
+                except IneligibleBatch:
                     # e.g. a sparse features template: this segment will serve
                     # through the per-stage path (as dispatch falls back), so
                     # warm the stages' own jit kernels instead of compiling a
@@ -213,81 +121,44 @@ class CompiledServingPlan:
                     for stage in segment.stages:
                         df = stage.transform(df)
                     continue
-                outputs = self._run_segment(segment, bucket, inputs, warmup=True)
-                df = self._materialize(df, self._pending(segment, outputs))
+                outputs = run_segment(segment, bucket, inputs)
+                df = self._materialize(df, segment.pending(outputs))
         metrics.gauge(
             self.scope,
             MLMetrics.SERVING_WARMUP_COMPILE_MS,
             (time.perf_counter() - t0) * 1000.0,
         )
 
-    def _run_segment(
-        self, segment: _FusedSegment, bucket: int, inputs: Dict[str, Any], *, warmup: bool
-    ) -> Dict[str, Any]:
-        """Execute the segment's per-bucket executable chain: each stage's
-        pre-compiled program runs on the committed device model buffers and
-        the (device-resident) outputs of the stages before it. Compiles the
-        chain first if this bucket was never warmed (the
-        ``ml.serving.fastpath.compiles`` alarm)."""
-        chain = segment.compiled.get(bucket)
-        if chain is None:
-            if not warmup:
-                # The alarm: warmup should have covered every serving bucket.
-                metrics.counter(self.scope, MLMetrics.SERVING_FASTPATH_COMPILES)
-            chain = []
-            cols: Dict[str, Any] = dict(inputs)
-            for spec, jitted, model in zip(
-                segment.specs, segment.stage_jits, segment.device_models
-            ):
-                stage_inputs = {n: cols[n] for n in spec.input_cols}
-                compiled = jitted.lower(
-                    model,
-                    {
-                        n: jax.ShapeDtypeStruct(a.shape, a.dtype)
-                        for n, a in stage_inputs.items()
-                    },
-                ).compile()
-                chain.append(compiled)
-                cols.update(compiled(model, stage_inputs))
-            segment.compiled[bucket] = chain
-            segment.signatures[bucket] = {
-                name: (tuple(arr.shape), arr.dtype) for name, arr in inputs.items()
-            }
-        cols = dict(inputs)
-        outs: Dict[str, Any] = {}
-        for spec, compiled, model in zip(segment.specs, chain, segment.device_models):
-            stage_out = compiled(model, {n: cols[n] for n in spec.input_cols})
-            cols.update(stage_out)
-            outs.update(stage_out)
-        return outs
+    def _run_segment(self, segment: FusedSegment, bucket: int, inputs: Dict[str, Any]):
+        """Hot-path execution: compiling here means warmup coverage was wrong
+        — the ``ml.serving.fastpath.compiles`` alarm counts it."""
+        return run_segment(
+            segment,
+            bucket,
+            inputs,
+            on_compile=lambda: metrics.counter(
+                self.scope, MLMetrics.SERVING_FASTPATH_COMPILES
+            ),
+        )
 
     # -- the hot path ---------------------------------------------------------
-    def _ingest(self, segment: _FusedSegment, df: DataFrame, bucket: int) -> Dict[str, np.ndarray]:
+    def _ingest(self, segment: FusedSegment, df: DataFrame, bucket: int) -> Dict[str, np.ndarray]:
         """One host-side gather of the segment's input columns, exactly the
-        way each stage's ``transform`` would read them (dense f32)."""
+        way each stage's ``transform`` would read them (dense f32), checked
+        against the bucket's compiled signature."""
         inputs: Dict[str, np.ndarray] = {}
         signature = segment.signatures.get(bucket)
         for name in segment.external_inputs:
-            try:
-                if df.is_sparse(name):
-                    raise _IneligibleBatch(f"column {name!r} is sparse")
-                arr = df.vectors(name).astype(np.float32)
-            except _IneligibleBatch:
-                raise
-            except Exception as e:  # ragged / non-vector column
-                raise _IneligibleBatch(f"column {name!r} not fusable: {e}") from e
+            arr = segment.gather(df, name)
             if signature is not None and (tuple(arr.shape), arr.dtype) != signature[name]:
-                raise _IneligibleBatch(
+                raise IneligibleBatch(
                     f"column {name!r} shape {arr.shape} != compiled {signature[name]}"
                 )
             inputs[name] = arr
         return inputs
 
-    def _pending(self, segment: _FusedSegment, outputs) -> List[Tuple[str, Any, Any]]:
-        return [(name, dtype, outputs[name]) for name, dtype in segment.outputs]
-
     @staticmethod
-    def _materialize(df: DataFrame, pending: List[Tuple[str, Any, Any]]) -> DataFrame:
+    def _materialize(df: DataFrame, pending: List[Tuple[str, Any, Any, Any]]) -> DataFrame:
         return PlanExecution(df, pending).finalize()
 
     def dispatch(self, padded_df: DataFrame) -> PlanExecution:
@@ -299,10 +170,10 @@ class CompiledServingPlan:
         pipelined batcher exploits is the trailing one."""
         bucket = len(padded_df)
         df = padded_df
-        pending: List[Tuple[str, Any, Any]] = []
+        pending: List[Tuple[str, Any, Any, Any]] = []
         fused_ran = False
         for segment in self.segments:
-            if isinstance(segment, _FallbackStage):
+            if isinstance(segment, FallbackStage):
                 df = self._materialize(df, pending)
                 pending = []
                 df = segment.stage.transform(df)
@@ -311,15 +182,15 @@ class CompiledServingPlan:
             # segment always finds pending drained by a fallback stage.
             try:
                 inputs = self._ingest(segment, df, bucket)
-            except _IneligibleBatch:
+            except IneligibleBatch:
                 metrics.counter(self.scope, MLMetrics.SERVING_FALLBACK_BATCHES)
                 df = self._materialize(df, pending)
                 pending = []
                 for stage in segment.stages:
                     df = stage.transform(df)
                 continue
-            outputs = self._run_segment(segment, bucket, inputs, warmup=False)
-            pending = self._pending(segment, outputs)
+            outputs = self._run_segment(segment, bucket, inputs)
+            pending = segment.pending(outputs)
             fused_ran = True
         if fused_ran:
             metrics.counter(self.scope, MLMetrics.SERVING_FUSED_BATCHES)

@@ -1,0 +1,459 @@
+"""Batch transform fast path (builder/batch_plan.py) — the compiled-plan
+contract:
+
+- **bit-exact fusion**: every transformer exporting a KernelSpec produces
+  fused results bit-identical to its per-stage ``transform``, alone and in
+  chains, at reduction-sensitive widths (8/16/256);
+- **chunked execution**: chunk/prefetch-depth sweeps reproduce the unchunked
+  results bit-exactly, with one compile per distinct chunk signature;
+- **fallback**: sparse/ragged inputs, spec-less stages mid-chain, and
+  row-count-changing params (Bucketizer 'skip') run per-stage, bit-exactly;
+- **plan lifecycle**: the plan caches across calls, invalidates on
+  ``set_model_data`` / param changes, and ``batch.fastpath`` off is the
+  classic path.
+"""
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.builder import CompiledBatchPlan, PipelineModel
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.models.feature.binarizer import Binarizer
+from flink_ml_tpu.models.feature.bucketizer import Bucketizer
+from flink_ml_tpu.models.feature.dct import DCT
+from flink_ml_tpu.models.feature.elementwise_product import ElementwiseProduct
+from flink_ml_tpu.models.feature.idf import IDFModel
+from flink_ml_tpu.models.feature.imputer import ImputerModel
+from flink_ml_tpu.models.feature.interaction import Interaction
+from flink_ml_tpu.models.feature.kbins_discretizer import KBinsDiscretizerModel
+from flink_ml_tpu.models.feature.normalizer import Normalizer
+from flink_ml_tpu.models.feature.polynomial_expansion import PolynomialExpansion
+from flink_ml_tpu.models.feature.standard_scaler import StandardScalerModel
+from flink_ml_tpu.models.feature.vector_assembler import VectorAssembler
+from flink_ml_tpu.models.feature.vector_slicer import VectorSlicer
+
+SCOPE = "ml.batch[plan]"
+
+
+@pytest.fixture(autouse=True)
+def _reset_batch_config():
+    yield
+    config.unset(Options.BATCH_FASTPATH)
+    config.unset(Options.BATCH_CHUNK_ROWS)
+    config.unset(Options.BATCH_PREFETCH_DEPTH)
+
+
+def _assert_frames_bitexact(a: DataFrame, b: DataFrame):
+    assert a.get_column_names() == b.get_column_names()
+    for name in a.get_column_names():
+        ca, cb = a.column(name), b.column(name)
+        if isinstance(ca, np.ndarray) or isinstance(cb, np.ndarray):
+            ca, cb = np.asarray(ca), np.asarray(cb)
+            assert ca.dtype == cb.dtype, (name, ca.dtype, cb.dtype)
+            np.testing.assert_array_equal(ca, cb, err_msg=name)
+        else:
+            for va, vb in zip(ca, cb):
+                if isinstance(va, SparseVector):
+                    np.testing.assert_array_equal(va.to_array(), vb.to_array())
+                else:
+                    np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+def _transform_both(model: PipelineModel, df: DataFrame):
+    """(per-stage result, fused result) for the same model + data, asserting
+    the fused run actually rode a compiled plan."""
+    config.set(Options.BATCH_FASTPATH, False)
+    slow = model.transform(df)
+    config.set(Options.BATCH_FASTPATH, True)
+    model.invalidate_batch_plan()
+    before = metrics.get(SCOPE, MLMetrics.BATCH_FUSED_ROWS, 0)
+    fast = model.transform(df)
+    # counted once per fused segment, so ≥ one plan's worth of rows
+    assert metrics.get(SCOPE, MLMetrics.BATCH_FUSED_ROWS, 0) >= before + len(df)
+    return slow, fast
+
+
+def _vec_df(n, d, seed=7):
+    return DataFrame.from_dict(
+        {"input": np.random.default_rng(seed).normal(size=(n, d))}
+    )
+
+
+def _scaler(d, seed=0):
+    rng = np.random.default_rng(seed)
+    m = StandardScalerModel().set_input_col("input").set_output_col("output")
+    m.set_with_mean(True)
+    m.mean = rng.normal(size=d)
+    m.std = np.abs(rng.normal(size=d)) + 0.5
+    m.std[min(1, d - 1)] = 0.0  # exercise the zero-std guard in both paths
+    return m
+
+
+def _imputer_model(cols, seed=3):
+    m = ImputerModel().set_input_cols(*cols).set_output_cols(
+        *[f"{c}_f" for c in cols]
+    )
+    m.surrogates = np.random.default_rng(seed).normal(size=len(cols))
+    return m
+
+
+def _kbins_model(d, seed=4):
+    rng = np.random.default_rng(seed)
+    m = KBinsDiscretizerModel().set_input_col("input").set_output_col("output")
+    # deliberately ragged per-dim edge counts to exercise the +inf padding
+    m.bin_edges = [
+        np.sort(rng.normal(size=3 + (i % 3)))
+        for i in range(d)
+    ]
+    return m
+
+
+def _idf_model(d, seed=5):
+    m = IDFModel().set_input_col("input").set_output_col("output")
+    m.idf = np.abs(np.random.default_rng(seed).normal(size=d))
+    return m
+
+
+class _Echo(Transformer):
+    """Spec-less stage — forces a fallback segment in mixed chains."""
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        return df.clone()
+
+
+# ---------------------------------------------------------------------------
+# per-transformer bit-exact parity, widths 8/16/256
+# ---------------------------------------------------------------------------
+N = 203  # odd on purpose: no accidental alignment with chunk sizes
+
+
+def _case_binarizer(d):
+    return Binarizer().set_input_cols("input").set_output_cols("output").set_thresholds(0.2), _vec_df(N, d)
+
+
+def _case_normalizer(d):
+    return Normalizer().set_p(3.0).set_input_col("input").set_output_col("output"), _vec_df(N, d)
+
+
+def _case_elementwise(d):
+    s = np.random.default_rng(11).normal(size=d)
+    return ElementwiseProduct().set_scaling_vec(s).set_input_col("input").set_output_col("output"), _vec_df(N, d)
+
+
+def _case_dct(d):
+    return DCT().set_input_col("input").set_output_col("output"), _vec_df(N, d)
+
+
+def _case_poly(d):
+    return PolynomialExpansion().set_degree(2).set_input_col("input").set_output_col("output"), _vec_df(N, d)
+
+
+def _case_interaction(d):
+    df = DataFrame.from_dict(
+        {
+            "a": np.random.default_rng(12).normal(size=N),
+            "input": np.random.default_rng(13).normal(size=(N, d)),
+        }
+    )
+    return Interaction().set_input_cols("a", "input").set_output_col("output"), df
+
+
+def _case_slicer(d):
+    idx = list(range(0, d, 2))
+    return VectorSlicer().set_indices(*idx).set_input_col("input").set_output_col("output"), _vec_df(N, d)
+
+
+def _case_scaler(d):
+    return _scaler(d), _vec_df(N, d)
+
+
+def _case_kbins(d):
+    return _kbins_model(d), _vec_df(N, d)
+
+
+def _case_idf(d):
+    df = _vec_df(N, d)
+    df.column("input")[np.random.default_rng(14).random((N, d)) < 0.3] = 0.0
+    return _idf_model(d), df
+
+
+def _case_imputer(_d):
+    rng = np.random.default_rng(15)
+    a, b = rng.normal(size=N), rng.normal(size=N)
+    a[rng.random(N) < 0.2] = np.nan
+    b[rng.random(N) < 0.2] = np.nan
+    return _imputer_model(["a", "b"]), DataFrame.from_dict({"a": a, "b": b})
+
+
+def _case_bucketizer(_d):
+    x = np.random.default_rng(16).normal(size=N) * 3
+    stage = (
+        Bucketizer()
+        .set_input_cols("x")
+        .set_output_cols("b")
+        .set_splits_array([[-2.0, -0.5, 0.5, 2.0]])
+        .set_handle_invalid("keep")
+    )
+    return stage, DataFrame.from_dict({"x": x})
+
+
+def _case_assembler(d):
+    rng = np.random.default_rng(17)
+    df = DataFrame.from_dict(
+        {"a": rng.normal(size=N), "input": rng.normal(size=(N, d))}
+    )
+    stage = (
+        VectorAssembler()
+        .set_input_cols("a", "input")
+        .set_input_sizes(1, d)
+        .set_handle_invalid("keep")
+        .set_output_col("output")
+    )
+    return stage, df
+
+
+CASES = {
+    "binarizer": (_case_binarizer, (8, 16, 256)),
+    "normalizer": (_case_normalizer, (8, 16, 256)),
+    "elementwise_product": (_case_elementwise, (8, 16, 256)),
+    "dct": (_case_dct, (8, 16, 256)),
+    "poly_expansion": (_case_poly, (8, 16)),  # 256 → 33k monomials: compile-bound
+    "interaction": (_case_interaction, (8, 16, 256)),
+    "vector_slicer": (_case_slicer, (8, 16, 256)),
+    "standard_scaler": (_case_scaler, (8, 16, 256)),
+    "kbins": (_case_kbins, (8, 16, 256)),
+    "idf": (_case_idf, (8, 16, 256)),
+    "imputer": (_case_imputer, (8,)),  # scalar columns: width-independent
+    "bucketizer": (_case_bucketizer, (8,)),
+    "assembler": (_case_assembler, (8, 16, 256)),
+}
+
+
+@pytest.mark.parametrize(
+    "name,width",
+    [(n, w) for n, (_, widths) in sorted(CASES.items()) for w in widths],
+)
+def test_fused_matches_per_stage_bitexact(name, width):
+    make, _ = CASES[name]
+    stage, df = make(width)
+    slow, fast = _transform_both(PipelineModel([stage]), df)
+    _assert_frames_bitexact(slow, fast)
+
+
+# ---------------------------------------------------------------------------
+# chains: multi-stage fusion, mixed spec/spec-less, sparse fallback
+# ---------------------------------------------------------------------------
+def _chain(d=16):
+    rng = np.random.default_rng(21)
+    scaler = _scaler(d)
+    scaler.set_output_col("scaled")
+    return [
+        scaler,
+        Normalizer().set_input_col("scaled").set_output_col("norm"),
+        ElementwiseProduct()
+        .set_scaling_vec(rng.normal(size=d))
+        .set_input_col("norm")
+        .set_output_col("prod"),
+        Binarizer().set_input_cols("prod").set_output_cols("bin").set_thresholds(0.05),
+    ]
+
+
+def test_four_stage_chain_fused_bitexact():
+    model = PipelineModel(_chain())
+    slow, fast = _transform_both(model, _vec_df(N, 16))
+    _assert_frames_bitexact(slow, fast)
+    assert metrics.get(SCOPE, MLMetrics.BATCH_FUSED_STAGES) == 4
+    assert metrics.get(SCOPE, MLMetrics.BATCH_FALLBACK_STAGES) == 0
+
+
+def test_mixed_chain_spec_less_stage_breaks_segment_bitexact():
+    stages = _chain()
+    stages.insert(2, _Echo())  # scaler+normalizer | echo | product+binarizer
+    model = PipelineModel(stages)
+    slow, fast = _transform_both(model, _vec_df(N, 16))
+    _assert_frames_bitexact(slow, fast)
+    assert metrics.get(SCOPE, MLMetrics.BATCH_FUSED_STAGES) == 4
+    assert metrics.get(SCOPE, MLMetrics.BATCH_FALLBACK_STAGES) == 1
+
+
+def test_sparse_input_falls_back_bitexact():
+    rng = np.random.default_rng(22)
+    vecs = [
+        SparseVector(16, np.sort(rng.choice(16, size=4, replace=False)), rng.normal(size=4))
+        for _ in range(24)
+    ]
+    df = DataFrame(["input"], None, [vecs])
+    stage = (
+        ElementwiseProduct()
+        .set_scaling_vec(rng.normal(size=16))
+        .set_input_col("input")
+        .set_output_col("output")
+    )
+    model = PipelineModel([stage])
+    config.set(Options.BATCH_FASTPATH, False)
+    slow = model.transform(df)
+    config.set(Options.BATCH_FASTPATH, True)
+    before = metrics.get(SCOPE, MLMetrics.BATCH_FALLBACK_SEGMENTS, 0)
+    fast = model.transform(df)
+    assert metrics.get(SCOPE, MLMetrics.BATCH_FALLBACK_SEGMENTS, 0) == before + 1
+    _assert_frames_bitexact(slow, fast)
+
+
+def test_bucketizer_skip_mode_has_no_spec_and_matches():
+    """'skip' changes the row count — host territory; the plan must not fuse."""
+    x = np.asarray([-9.0, 0.1, 0.7, 9.0])
+    df = DataFrame.from_dict({"x": x})
+    stage = (
+        Bucketizer()
+        .set_input_cols("x")
+        .set_output_cols("b")
+        .set_splits_array([[0.0, 0.5, 1.0]])
+        .set_handle_invalid("skip")
+    )
+    assert stage.kernel_spec() is None
+    assert CompiledBatchPlan.build([stage]) is None
+    config.set(Options.BATCH_FASTPATH, True)
+    out = PipelineModel([stage]).transform(df)
+    np.testing.assert_array_equal(out["b"], [0.0, 1.0])
+
+
+# ---------------------------------------------------------------------------
+# chunked, double-buffered execution
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_chunked_prefetch_depths_bitexact(depth):
+    model = PipelineModel(_chain())
+    df = _vec_df(N, 16)
+    config.set(Options.BATCH_FASTPATH, False)
+    slow = model.transform(df)
+    config.set(Options.BATCH_FASTPATH, True)
+    config.set(Options.BATCH_CHUNK_ROWS, 64)  # 203 rows → 3 full + 1 remainder
+    config.set(Options.BATCH_PREFETCH_DEPTH, depth)
+    model.invalidate_batch_plan()
+    before_chunks = metrics.get(SCOPE, MLMetrics.BATCH_FUSED_CHUNKS, 0)
+    fast = model.transform(df)
+    _assert_frames_bitexact(slow, fast)
+    assert metrics.get(SCOPE, MLMetrics.BATCH_FUSED_CHUNKS, 0) == before_chunks + 4
+
+
+def test_chunked_compiles_once_per_signature_and_caches_across_calls():
+    model = PipelineModel(_chain())
+    df = _vec_df(200, 16)
+    config.set(Options.BATCH_FASTPATH, True)
+    config.set(Options.BATCH_CHUNK_ROWS, 64)  # 3×64 + 8: two distinct signatures
+    before = metrics.get(SCOPE, MLMetrics.BATCH_COMPILES, 0)
+    model.transform(df)
+    assert metrics.get(SCOPE, MLMetrics.BATCH_COMPILES, 0) == before + 2
+    model.transform(df)  # same plan, same signatures: zero new compiles
+    assert metrics.get(SCOPE, MLMetrics.BATCH_COMPILES, 0) == before + 2
+    hist = metrics.get(SCOPE, MLMetrics.BATCH_CHUNK_MS)
+    assert hist is not None and hist.count >= 8
+
+
+def test_set_model_data_invalidates_cached_plan():
+    d = 8
+    model = PipelineModel([_scaler(d)])
+    df = _vec_df(32, d)
+    config.set(Options.BATCH_FASTPATH, True)
+    out1 = model.transform(df)
+    # swap in different model data through the official route
+    replacement = _scaler(d, seed=99)
+    model.set_model_data(*replacement.get_model_data())
+    out2 = model.transform(df)
+    assert not np.array_equal(np.asarray(out1["output"]), np.asarray(out2["output"]))
+    config.set(Options.BATCH_FASTPATH, False)
+    _assert_frames_bitexact(model.transform(df), out2)
+
+
+def test_param_change_refreshes_plan():
+    stage = Normalizer().set_p(2.0).set_input_col("input").set_output_col("output")
+    model = PipelineModel([stage])
+    df = _vec_df(32, 8)
+    config.set(Options.BATCH_FASTPATH, True)
+    out2 = model.transform(df)
+    stage.set_p(1.0)
+    out1 = model.transform(df)
+    assert not np.array_equal(np.asarray(out2["output"]), np.asarray(out1["output"]))
+    config.set(Options.BATCH_FASTPATH, False)
+    _assert_frames_bitexact(model.transform(df), out1)
+
+
+def test_fastpath_off_is_classic_path():
+    model = PipelineModel(_chain())
+    df = _vec_df(40, 16)
+    config.set(Options.BATCH_FASTPATH, False)
+    before = metrics.get(SCOPE, MLMetrics.BATCH_FUSED_ROWS, 0)
+    model.transform(df)
+    assert metrics.get(SCOPE, MLMetrics.BATCH_FUSED_ROWS, 0) == before
+
+
+def test_empty_frame_runs_per_stage():
+    model = PipelineModel([Normalizer().set_input_col("input").set_output_col("output")])
+    df = DataFrame.from_dict({"input": np.zeros((0, 4))})
+    config.set(Options.BATCH_FASTPATH, True)
+    out = model.transform(df)
+    assert len(out) == 0 and "output" in out.get_column_names()
+
+
+# ---------------------------------------------------------------------------
+# program partition: elementwise runs merge, reduction specs stay solo
+# ---------------------------------------------------------------------------
+def test_elementwise_runs_merge_reduction_specs_stay_solo():
+    d = 16
+    rng = np.random.default_rng(41)
+    scaler = _scaler(d)
+    scaler.set_output_col("scaled")
+    ep = (
+        ElementwiseProduct()
+        .set_scaling_vec(rng.normal(size=d))
+        .set_input_col("scaled")
+        .set_output_col("prod")
+    )
+    binz = Binarizer().set_input_cols("prod").set_output_cols("bin").set_thresholds(0.1)
+    norm = Normalizer().set_input_col("scaled").set_output_col("norm")
+    dct = DCT().set_input_col("prod").set_output_col("freq")
+
+    # scaler | normalizer (row-norm reduction) | ep+binarizer merge
+    plan = CompiledBatchPlan.build(
+        [scaler, norm, ep.set_input_col("norm"), binz]
+    )
+    (segment,) = plan.segments
+    assert [len(p.specs) for p in segment.programs] == [1, 1, 2]
+
+    # a DCT (matmul) splits an elementwise run: scaler+ep merge, dct solo
+    ep2 = (
+        ElementwiseProduct()
+        .set_scaling_vec(rng.normal(size=d))
+        .set_input_col("scaled")
+        .set_output_col("prod")
+    )
+    plan2 = CompiledBatchPlan.build([_scaler(d).set_output_col("scaled"), ep2, dct])
+    (segment2,) = plan2.segments
+    assert [len(p.specs) for p in segment2.programs] == [2, 1]
+    # and the merged plan is still bit-exact against per-stage
+    model = PipelineModel([_scaler(d).set_output_col("scaled"), ep2, dct])
+    slow, fast = _transform_both(model, _vec_df(N, d))
+    _assert_frames_bitexact(slow, fast)
+
+
+# ---------------------------------------------------------------------------
+# binarizer dtype preservation (the upcast fix)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_binarizer_preserves_float_dtype(dtype):
+    X = np.random.default_rng(31).normal(size=(16, 4)).astype(dtype)
+    df = DataFrame.from_dict({"input": X})
+    out = (
+        Binarizer()
+        .set_input_cols("input")
+        .set_output_cols("output")
+        .set_thresholds(0.0)
+        .transform(df)
+    )
+    vals = out["output"]
+    assert vals.dtype == dtype  # no float64 upcast round-trip
+    np.testing.assert_array_equal(vals, (X > 0.0).astype(dtype))

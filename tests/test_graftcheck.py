@@ -30,7 +30,14 @@ from tools.graftcheck import REGISTRY, Project, run_rules  # noqa: E402
 from tools.graftcheck.engine import JSON_SCHEMA_VERSION, parse_suppressions  # noqa: E402
 from tools.graftcheck.rules import layer_deps, lock_order  # noqa: E402
 
-ALL_RULES = ("error-hygiene", "fault-points", "jit-purity", "layer-deps", "lock-order")
+ALL_RULES = (
+    "error-hygiene",
+    "fault-points",
+    "jit-purity",
+    "kernel-spec-consistency",
+    "layer-deps",
+    "lock-order",
+)
 
 
 def write_tree(root, files):
@@ -292,6 +299,137 @@ def test_jit_purity_covers_servable_and_serving(tmp_path):
         root.mkdir()
         result = run_on(root, {rel: JIT_BAD}, rules=["jit-purity"])
         assert any(".item()" in f.message for f in result.findings), rel
+
+
+def test_jit_purity_covers_builder(tmp_path):
+    """The batch fast path (builder/batch_plan.py) AOT-compiles kernel specs
+    per chunk signature — builder/ is in scope."""
+    result = run_on(tmp_path, {"flink_ml_tpu/builder/bad.py": JIT_BAD}, rules=["jit-purity"])
+    assert any(".item()" in f.message for f in result.findings)
+
+
+# -----------------------------------------------------------------------------
+# 3b. kernel-spec-consistency
+# -----------------------------------------------------------------------------
+
+SPEC_CLEAN = """
+    from flink_ml_tpu.ops.kernels import binarize_fn, binarize_kernel
+
+    class Binarizerish:
+        def transform(self, df):
+            return binarize_kernel(0.5)(df)
+
+        def kernel_spec(self):
+            def kernel_fn(model, cols):
+                return {"out": binarize_fn(cols["in"], 0.5)}
+            return object()
+"""
+
+SPEC_DRIFT = """
+    from flink_ml_tpu.ops.kernels import binarize_kernel, normalize_fn
+
+    class Drifted:
+        def transform(self, df):
+            return binarize_kernel(0.5)(df)
+
+        def kernel_spec(self):
+            def kernel_fn(model, cols):
+                return {"out": normalize_fn(cols["in"], 2.0)}
+            return object()
+"""
+
+SPEC_HANDROLLED = """
+    import jax.numpy as jnp
+
+    class HandRolled:
+        def transform(self, df):
+            return df
+
+        def kernel_spec(self):
+            def kernel_fn(model, cols):
+                return {"out": jnp.tanh(cols["in"])}
+            return object()
+"""
+
+SPEC_ALIASED = """
+    from flink_ml_tpu.ops.kernels import kmeans_assign_fn, kmeans_predict_kernel
+
+    class KMeansish:
+        def transform(self, df):
+            return kmeans_predict_kernel("euclidean")(df, df)
+
+        def kernel_spec(self):
+            assign = kmeans_assign_fn("euclidean")
+            def kernel_fn(model, cols):
+                return {"out": assign(cols["in"], model["centroids"])}
+            return object()
+"""
+
+SPEC_DEFAULT_HOOK = """
+    class Base:
+        def transform(self, df):
+            return df
+
+        def kernel_spec(self):
+            return None
+"""
+
+
+def test_kernel_spec_consistency_clean_pairing(tmp_path):
+    result = run_on(
+        tmp_path,
+        {"flink_ml_tpu/models/feature/ok.py": SPEC_CLEAN},
+        rules=["kernel-spec-consistency"],
+    )
+    assert result.findings == []
+
+
+def test_kernel_spec_consistency_flags_drift(tmp_path):
+    result = run_on(
+        tmp_path,
+        {"flink_ml_tpu/models/feature/drift.py": SPEC_DRIFT},
+        rules=["kernel-spec-consistency"],
+    )
+    assert len(result.findings) == 1
+    assert "'normalize'" in result.findings[0].message
+
+
+def test_kernel_spec_consistency_flags_hand_rolled_math(tmp_path):
+    result = run_on(
+        tmp_path,
+        {"flink_ml_tpu/models/feature/hand.py": SPEC_HANDROLLED},
+        rules=["kernel-spec-consistency"],
+    )
+    assert len(result.findings) == 1
+    assert "references no ops/kernels.py body" in result.findings[0].message
+
+
+def test_kernel_spec_consistency_resolves_fn_factory_aliases(tmp_path):
+    """kmeans_predict_kernel jits kmeans_assign_fn — the alias table pairs
+    them, so the historical naming does not flag."""
+    result = run_on(
+        tmp_path,
+        {"flink_ml_tpu/models/clustering/km.py": SPEC_ALIASED},
+        rules=["kernel-spec-consistency"],
+    )
+    assert result.findings == []
+
+
+def test_kernel_spec_consistency_skips_declaration_only_hooks(tmp_path):
+    result = run_on(
+        tmp_path,
+        {"flink_ml_tpu/servable/base.py": SPEC_DEFAULT_HOOK},
+        rules=["kernel-spec-consistency"],
+    )
+    assert result.findings == []
+
+
+def test_kernel_spec_consistency_shipped_transformers_all_pair():
+    """Every shipped kernel_spec composes a body its transform path jits —
+    the batch fast path's no-drift guarantee, as a tier-1 gate."""
+    project = Project(REPO_ROOT, ["flink_ml_tpu"])
+    result = run_rules(project, rules=["kernel-spec-consistency"])
+    assert result.findings == [], "\n".join(f.render() for f in result.findings)
 
 
 # -----------------------------------------------------------------------------

@@ -211,8 +211,8 @@ class TestHotPathIsCold:
                 raise AssertionError("XLA compile on the hot path after warmup")
 
             for segment in plan.segments:
-                for jitted in segment.stage_jits:
-                    monkeypatch.setattr(jitted, "lower", no_compile, raising=False)
+                for prog in segment.programs:
+                    monkeypatch.setattr(prog.jitted, "lower", no_compile, raising=False)
 
             def no_device_put(*a, **k):
                 raise AssertionError("device_put on the hot path after warmup")

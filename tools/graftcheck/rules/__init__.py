@@ -6,6 +6,7 @@ from tools.graftcheck.rules import (  # noqa: F401  (imported for registration)
     error_hygiene,
     fault_points,
     jit_purity,
+    kernel_spec_consistency,
     layer_deps,
     lock_order,
 )

@@ -8,9 +8,10 @@ goodput leak the serving tier's micro-batching exists to avoid — and
 executable at trace time, so the "dynamic" value is a constant forever after.
 
 Scope: functions *statically recognizable* as jitted inside ``ops/``,
-``models/``, ``parallel/``, ``servable/`` and ``serving/`` (the serving fast
-path composes servable kernel specs into fused AOT executables — an impure
-call there is burned into every per-bucket program) — decorated with ``jit``
+``models/``, ``parallel/``, ``servable/``, ``serving/`` and ``builder/``
+(both fast paths compose kernel specs into fused AOT executables — an impure
+call there is burned into every per-bucket / per-chunk program) — decorated
+with ``jit``
 / ``jax.jit`` / ``partial(jax.jit, ...)`` (bare or called), or passed by name
 to a ``jit(...)`` call in the same module. Flagged inside their bodies:
 
@@ -41,6 +42,9 @@ SCOPE_PREFIXES = (
     "flink_ml_tpu/parallel/",
     "flink_ml_tpu/servable/",
     "flink_ml_tpu/serving/",
+    # the batch fast path composes kernel specs into fused AOT chains, same
+    # stakes as serving/ — an impure call would burn into every chunk program
+    "flink_ml_tpu/builder/",
 )
 
 _TIME_ATTRS = {"time", "perf_counter", "monotonic", "time_ns", "perf_counter_ns"}

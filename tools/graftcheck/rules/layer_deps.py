@@ -72,6 +72,10 @@ MODULE_LAYERS = {
     "ops.optimizer": 2,  # fused trainers: imports iteration at module level
     "native.cache": 2,  # native-backed datacache: reaches into iteration.datacache
     "parallel.datastream_utils": 2,  # external sort / co-group over HostDataCache
+    # The batch fast path sits at builder's own L2 but only consumes L0/L1
+    # (servable.planner + kernel specs, api, config, metrics) — registered
+    # explicitly so the fused batch tier's dependency story is auditable.
+    "builder.batch_plan": 2,
 }
 
 #: The absorbed check_servable_imports.py contract (see module docstring).
