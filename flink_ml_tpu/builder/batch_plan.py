@@ -153,7 +153,7 @@ class CompiledBatchPlan:
             df = self._run_fused(segment, df)
         return df
 
-    def _run_fused(self, segment: FusedSegment, df: DataFrame) -> DataFrame:
+    def _run_fused(self, segment: FusedSegment, df: DataFrame) -> DataFrame:  # graftcheck: hot-root
         n = len(df)
         if n == 0:
             return self._fallback(segment, df, count=False)
@@ -213,10 +213,12 @@ class CompiledBatchPlan:
         out_decl: Dict[str, Any] = {}
         inflight: List[Tuple[float, List[Any]]] = []
 
-        def readback_one(buf: np.ndarray, lo: int, hi: int, arr: Any) -> None:
-            # np.asarray blocks until the device value is ready (zero-copy
-            # view on the CPU backend); the widening cast (f32→f64) in the
-            # slice assignment is value-exact.
+        def readback_one(buf: np.ndarray, lo: int, hi: int, arr: Any) -> None:  # graftcheck: readback
+            # THE designated sync point of the batch fast path: np.asarray
+            # blocks until the device value is ready (zero-copy view on the
+            # CPU backend); the widening cast (f32→f64) in the slice
+            # assignment is value-exact. Runs on the readback pool, behind
+            # the prefetch window — never serially with dispatch.
             buf[lo:hi] = np.asarray(arr)
 
         def finalize_oldest() -> None:

@@ -281,7 +281,10 @@ class PlanExecution:
         self._df = df
         self._pending = pending
 
-    def finalize(self) -> DataFrame:
+    def finalize(self) -> DataFrame:  # graftcheck: readback
+        # THE designated sync point of the serving fast path — the single
+        # blocking readback the pipelined batcher defers until the next
+        # batch is already dispatched.
         if not self._pending:
             return self._df
         out = self._df.clone()

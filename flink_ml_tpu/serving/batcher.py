@@ -340,7 +340,7 @@ class MicroBatcher:
             return
         self._deliver(claimed, out, version, rows, bucket)
 
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # graftcheck: hot-root
         inflight: Deque[Tuple] = deque()
 
         def gauge_depth() -> None:

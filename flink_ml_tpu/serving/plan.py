@@ -84,10 +84,16 @@ class CompiledServingPlan:
 
     # -- construction ---------------------------------------------------------
     @staticmethod
-    def build(servable, *, scope: str = "ml.serving[plan]") -> Optional["CompiledServingPlan"]:
+    def build(servable, *, scope: str = "ml.serving[plan]") -> Optional["CompiledServingPlan"]:  # graftcheck: cold
         """Group the servable's consecutive kernel-spec stages into fused
         segments. Raises whatever ``kernel_spec()`` raises (an unloaded model
-        must fail closed at warmup, before it could ever serve)."""
+        must fail closed at warmup, before it could ever serve).
+
+        Build-time work (one device_put per model array, jit wrapper
+        construction per program): normally runs at warmup/swap time, off the
+        serving path. The ``graftcheck: cold`` mark documents the one lazy
+        exception — a server that never saw a warmup template builds on the
+        first batch, visible as ``ml.serving.fastpath.compiles``."""
         stages = (
             list(servable.servables)
             if isinstance(servable, PipelineModelServable)
@@ -161,7 +167,7 @@ class CompiledServingPlan:
     def _materialize(df: DataFrame, pending: List[Tuple[str, Any, Any, Any]]) -> DataFrame:
         return PlanExecution(df, pending).finalize()
 
-    def dispatch(self, padded_df: DataFrame) -> PlanExecution:
+    def dispatch(self, padded_df: DataFrame) -> PlanExecution:  # graftcheck: hot-root
         """Run the plan on an already-padded batch. Fused segments execute
         their pre-compiled per-bucket program against the committed device
         buffers; the TRAILING fused outputs stay on device (JAX async

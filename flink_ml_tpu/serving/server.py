@@ -198,14 +198,14 @@ class InferenceServer:
                 return None
         return plan
 
-    def _execute(self, padded_df: DataFrame) -> Tuple[DataFrame, int]:
+    def _execute(self, padded_df: DataFrame) -> Tuple[DataFrame, int]:  # graftcheck: hot-root
         version, servable = self.registry.current()  # one snapshot per batch
         plan = self._plan_for(servable)
         if plan is not None:
             return plan.execute(padded_df), version
         return servable.transform(padded_df), version
 
-    def _dispatch(self, padded_df: DataFrame):
+    def _dispatch(self, padded_df: DataFrame):  # graftcheck: hot-root
         """Async seam for the batcher's pipelined window: returns a handle
         whose ``result()`` is the single blocking readback, or None to serve
         this batch synchronously (no plan — per-stage path)."""

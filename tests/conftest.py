@@ -81,3 +81,13 @@ _MIN_DEVICES = 2 if _ISOLATED else 8
 assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= _MIN_DEVICES, (
     "tests require the virtual CPU mesh; got " + repr(jax.devices())
 )
+
+
+def pytest_configure(config):
+    # tier-1 deselects with `-m 'not slow'`; register the marker so strict
+    # marker settings and -W error runs stay clean.
+    config.addinivalue_line(
+        "markers",
+        "slow: environment-sensitive or long-running; excluded from tier-1 "
+        "(run explicitly with -m slow)",
+    )

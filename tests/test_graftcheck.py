@@ -31,12 +31,16 @@ from tools.graftcheck.engine import JSON_SCHEMA_VERSION, parse_suppressions  # n
 from tools.graftcheck.rules import layer_deps, lock_order  # noqa: E402
 
 ALL_RULES = (
+    "blocking-under-lock",
+    "elementwise-claim",
     "error-hygiene",
     "fault-points",
+    "host-sync",
     "jit-purity",
     "kernel-spec-consistency",
     "layer-deps",
     "lock-order",
+    "recompile-hazard",
 )
 
 

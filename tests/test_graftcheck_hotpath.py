@@ -1,0 +1,489 @@
+"""The graftcheck v2 hot-path rules: recompile-hazard, host-sync,
+blocking-under-lock and elementwise-claim, each proven on a clean and a
+seeded-dirty fixture tree (the analyzer-works layer of the tier-1 gate; the
+shipped-tree-clean layer lives in test_graftcheck.py).
+
+These rules are the whole point of the v2 engine: every one of them needs the
+cross-module call graph (transitive reaches, singleton/import/constructor/
+return-type resolution) and the annotated-hot-root convention
+(``# graftcheck: hot-root`` / ``readback`` / ``cold``) that per-file AST
+walks could never see.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.graftcheck import Project, run_rules  # noqa: E402
+import tools.graftcheck.rules  # noqa: F401, E402  (registration)
+
+from tests.test_graftcheck import run_on, write_tree  # noqa: E402
+
+
+# -----------------------------------------------------------------------------
+# recompile-hazard
+# -----------------------------------------------------------------------------
+
+RECOMPILE_DIRTY = """
+    import jax
+
+    @jax.jit
+    def f(x, n):
+        if n > 3:
+            return x + 1
+        return x
+
+    def serve(xs):
+        out = []
+        for i in range(10):
+            k = jax.jit(lambda v: v + i)
+            out.append(k(xs))
+            out.append(f(xs, i))
+        return out
+
+    def per_call(x):
+        return jax.jit(lambda v: v * 2)(x)
+"""
+
+RECOMPILE_CLEAN = """
+    import functools
+    import jax
+    from functools import partial
+
+    @functools.cache
+    def scale_kernel(factor):
+        return jax.jit(lambda x: x * factor)   # memoized factory: fine
+
+    @partial(jax.jit, static_argnums=1)
+    def g(x, mode):
+        if mode:                               # static arg: fine
+            return x + 1
+        return x
+
+    @jax.jit
+    def h(x):
+        if x.shape[0] > 4:                     # shape metadata: fine
+            return x[:4]
+        return x
+
+    module_level = jax.jit(lambda x: x + 1)    # constructed once: fine
+
+    def serve(xs, n):
+        k = scale_kernel(2.0)
+        for i in range(n):
+            xs = k(xs)
+            xs = g(xs, True)
+        return xs
+"""
+
+
+def test_recompile_hazard_dirty_fixture(tmp_path):
+    result = run_on(
+        tmp_path, {"flink_ml_tpu/ops/bad.py": RECOMPILE_DIRTY}, rules=["recompile-hazard"]
+    )
+    msgs = [f.message for f in result.findings]
+    assert any("inside a loop" in m for m in msgs), msgs
+    assert any("varying Python scalar(s) `i`" in m for m in msgs), msgs
+    assert any("branches in Python on traced value(s) n" in m for m in msgs), msgs
+    assert any("jit(f)(...)" in m for m in msgs), msgs
+    assert all(f.severity == "error" for f in result.findings)
+    assert result.exit_code == 1
+
+
+def test_recompile_hazard_clean_fixture(tmp_path):
+    result = run_on(
+        tmp_path, {"flink_ml_tpu/ops/ok.py": RECOMPILE_CLEAN}, rules=["recompile-hazard"]
+    )
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+def test_recompile_hazard_hot_region_construction(tmp_path):
+    """jit construction reachable from a hot root flags even outside a loop —
+    and a `# graftcheck: cold` mark on the lazy-build edge clears it."""
+    dirty = {
+        "flink_ml_tpu/serving/hot.py": """
+            import jax
+
+            class Server:
+                def loop(self):  # graftcheck: hot-root
+                    return self.plan()
+
+                def plan(self):
+                    return jax.jit(lambda v: v + 1)
+        """
+    }
+    result = run_on(tmp_path, dirty, rules=["recompile-hazard"])
+    assert len(result.findings) == 1
+    assert "hot region" in result.findings[0].message
+    clean = {
+        "flink_ml_tpu/serving/hot.py": """
+            import jax
+
+            class Server:
+                def loop(self):  # graftcheck: hot-root
+                    return self.plan()
+
+                def plan(self):  # graftcheck: cold
+                    return jax.jit(lambda v: v + 1)
+        """
+    }
+    result = run_on(tmp_path / "clean", clean, rules=["recompile-hazard"])
+    assert result.findings == []
+
+
+def test_recompile_hazard_out_of_scope_package(tmp_path):
+    result = run_on(
+        tmp_path, {"flink_ml_tpu/utils/x.py": RECOMPILE_DIRTY}, rules=["recompile-hazard"]
+    )
+    assert result.findings == []
+
+
+# -----------------------------------------------------------------------------
+# host-sync
+# -----------------------------------------------------------------------------
+
+HOST_SYNC_DIRTY = {
+    "flink_ml_tpu/serving/loop.py": """
+        from flink_ml_tpu.serving.helpers import finish
+
+        class Batcher:
+            def run(self):  # graftcheck: hot-root
+                while True:
+                    self._step()
+
+            def _step(self):
+                return finish(self._execute())
+
+            def _execute(self):
+                return object()
+    """,
+    "flink_ml_tpu/serving/helpers.py": """
+        import numpy as np
+
+        def finish(out):
+            host = np.asarray(out)
+            return out.item() + float(out)
+    """,
+}
+
+HOST_SYNC_CLEAN = {
+    "flink_ml_tpu/serving/loop.py": """
+        from flink_ml_tpu.serving.helpers import finish, build
+
+        class Batcher:
+            def run(self):  # graftcheck: hot-root
+                plan = build()
+                return finish(self._execute())
+
+            def _execute(self):
+                return object()
+    """,
+    "flink_ml_tpu/serving/helpers.py": """
+        import numpy as np
+
+        def finish(out):  # graftcheck: readback
+            return np.asarray(out).item()
+
+        def build():  # graftcheck: cold
+            import time
+            probe = make_probe()
+            return probe.item()
+
+        def make_probe():
+            return object()
+    """,
+}
+
+
+def test_host_sync_dirty_fixture(tmp_path):
+    result = run_on(tmp_path, HOST_SYNC_DIRTY, rules=["host-sync"])
+    msgs = [f.message for f in result.findings]
+    assert any(".item()" in m for m in msgs), msgs
+    assert any("np.asarray(out)" in m for m in msgs), msgs
+    assert any("float(out)" in m for m in msgs), msgs
+    # findings anchor in the helper file, naming the root that reaches them
+    assert all(f.path == "flink_ml_tpu/serving/helpers.py" for f in result.findings)
+    assert all("Batcher.run" in f.message for f in result.findings)
+    assert result.exit_code == 1
+
+
+def test_host_sync_readback_and_cold_marks_exempt(tmp_path):
+    result = run_on(tmp_path, HOST_SYNC_CLEAN, rules=["host-sync"])
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+def test_host_sync_without_roots_is_silent(tmp_path):
+    files = {
+        "flink_ml_tpu/serving/noroot.py": """
+            def f(out):
+                return out.item()
+        """
+    }
+    result = run_on(tmp_path, files, rules=["host-sync"])
+    assert result.findings == []
+
+
+def test_host_sync_param_heuristics_scoped_to_device_tiers(tmp_path):
+    """np.asarray/float on parameters only report in the device-adjacent
+    tiers; .item() reports anywhere a hot root reaches."""
+    files = {
+        "flink_ml_tpu/serving/loop.py": """
+            from flink_ml_tpu.api.frame import pack
+
+            class B:
+                def run(self):  # graftcheck: hot-root
+                    return pack(self._go())
+
+                def _go(self):
+                    return object()
+        """,
+        "flink_ml_tpu/api/frame.py": """
+            import numpy as np
+
+            def pack(col):
+                host = np.asarray(col)   # host-layer materialization: fine
+                return host.item()       # device sync: flagged anywhere
+        """,
+    }
+    result = run_on(tmp_path, files, rules=["host-sync"])
+    assert [(".item()" in f.message) for f in result.findings] == [True]
+
+
+def test_host_sync_reaches_nested_defs(tmp_path):
+    files = {
+        "flink_ml_tpu/builder/chunks.py": """
+            class Plan:
+                def run(self, arrs):  # graftcheck: hot-root
+                    def readback(a):
+                        return a.item()
+                    return [readback(a) for a in arrs]
+        """
+    }
+    result = run_on(tmp_path, files, rules=["host-sync"])
+    assert len(result.findings) == 1 and ".item()" in result.findings[0].message
+
+
+# -----------------------------------------------------------------------------
+# blocking-under-lock
+# -----------------------------------------------------------------------------
+
+BLOCKING_DIRTY = {
+    "flink_ml_tpu/serving/poller.py": """
+        import threading
+        import time
+        import os
+        import jax
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._wake = threading.Event()
+
+            def poll(self):
+                with self._lock:
+                    time.sleep(0.05)
+                    versions = self.scan()
+                    self._wake.wait(1.0)
+                return versions
+
+            def scan(self):
+                return os.listdir(self.directory)
+
+            def warm(self, fn, args):
+                with self._lock:
+                    return jax.device_put(args)
+    """,
+}
+
+BLOCKING_CLEAN = {
+    "flink_ml_tpu/serving/poller.py": """
+        import threading
+        import time
+        import os
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._thread = threading.Thread(target=self._loop)
+
+            def claim(self):
+                with self._cond:
+                    self._cond.wait(0.05)   # waits on the HELD lock: releases it
+                    return 1
+
+            def poll(self):
+                versions = self.scan()      # blocking work outside the lock
+                with self._lock:
+                    self.latest = versions
+                time.sleep(0.05)            # sleep outside the lock
+                return versions
+
+            def scan(self):
+                return os.listdir(self.directory)
+
+            def close(self):
+                with self._lock:
+                    self.closed = True
+                self._thread.join(1.0)      # join outside the lock
+
+            def _loop(self):
+                pass
+    """,
+}
+
+
+def test_blocking_under_lock_dirty_fixture(tmp_path):
+    result = run_on(tmp_path, BLOCKING_DIRTY, rules=["blocking-under-lock"])
+    msgs = [f.message for f in result.findings]
+    assert any("sleeps" in m and "time.sleep" in m for m in msgs), msgs
+    # transitive: the call to scan() under the lock reaches os.listdir
+    assert any("calls" in m and "os.listdir" in m for m in msgs), msgs
+    assert any("waits" in m and "_wake" in m for m in msgs), msgs
+    assert any("device_put" in m for m in msgs), msgs
+    assert result.exit_code == 1
+
+
+def test_blocking_under_lock_clean_fixture(tmp_path):
+    result = run_on(tmp_path, BLOCKING_CLEAN, rules=["blocking-under-lock"])
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+def test_blocking_under_lock_out_of_scope_package(tmp_path):
+    files = {"flink_ml_tpu/iteration/x.py": BLOCKING_DIRTY["flink_ml_tpu/serving/poller.py"]}
+    result = run_on(tmp_path, files, rules=["blocking-under-lock"])
+    assert result.findings == []
+
+
+# -----------------------------------------------------------------------------
+# elementwise-claim
+# -----------------------------------------------------------------------------
+
+EW_KERNELS = """
+    import jax.numpy as jnp
+
+    def scale_fn(x, s):
+        return x * s
+
+    def reduce_fn(x):
+        return jnp.sum(x, axis=1)
+
+    def chained_fn(x):
+        return helper(x) + 1.0
+
+    def helper(x):
+        return x @ x.T
+
+    def searchsorted_fn(x, splits):
+        return jnp.searchsorted(splits, x)
+"""
+
+
+def _spec_module(fn_import: str, fn_call: str, elementwise: str) -> str:
+    return f"""
+        from flink_ml_tpu.ops.kernels import {fn_import}
+        from flink_ml_tpu.servable.kernel_spec import KernelSpec
+
+        class Stage:
+            def transform(self, df):
+                return {fn_import}
+
+            def kernel_spec(self):
+                def kfn(model, cols):
+                    return {{"o": {fn_call}}}
+                return KernelSpec(
+                    input_cols=["i"], outputs=[("o", None)],
+                    model_arrays={{}}, kernel_fn=kfn, elementwise={elementwise},
+                )
+    """
+
+
+def test_elementwise_claim_dirty_direct_reduction(tmp_path):
+    files = {
+        "flink_ml_tpu/ops/kernels.py": EW_KERNELS,
+        "flink_ml_tpu/models/feature/bad.py": _spec_module(
+            "reduce_fn", 'reduce_fn(cols["i"])', "True"
+        ),
+    }
+    result = run_on(tmp_path, files, rules=["elementwise-claim"])
+    assert len(result.findings) == 1
+    f = result.findings[0]
+    assert "`reduce_fn`" in f.message and "`sum`" in f.message
+    assert f.path == "flink_ml_tpu/models/feature/bad.py"
+    assert result.exit_code == 1
+
+
+def test_elementwise_claim_dirty_transitive_matmul(tmp_path):
+    """The reduction hides one call down inside ops/kernels.py — and is the
+    @ operator, not a named primitive."""
+    files = {
+        "flink_ml_tpu/ops/kernels.py": EW_KERNELS,
+        "flink_ml_tpu/models/feature/bad.py": _spec_module(
+            "chained_fn", 'chained_fn(cols["i"])', "True"
+        ),
+    }
+    result = run_on(tmp_path, files, rules=["elementwise-claim"])
+    assert len(result.findings) == 1
+    assert "`matmul`" in result.findings[0].message
+
+
+def test_elementwise_claim_clean_fixtures(tmp_path):
+    files = {
+        "flink_ml_tpu/ops/kernels.py": EW_KERNELS,
+        # elementwise over genuinely elementwise bodies: fine
+        "flink_ml_tpu/models/feature/ok.py": _spec_module(
+            "scale_fn", 'scale_fn(cols["i"], 2.0)', "True"
+        ),
+        # searchsorted is per-element binary search, not a reduction
+        "flink_ml_tpu/models/feature/ok2.py": _spec_module(
+            "searchsorted_fn", 'searchsorted_fn(cols["i"], model["s"])', "True"
+        ),
+        # a reduction WITHOUT the elementwise claim: fine (merely unmerged)
+        "flink_ml_tpu/models/feature/ok3.py": _spec_module(
+            "reduce_fn", 'reduce_fn(cols["i"])', "False"
+        ),
+    }
+    result = run_on(tmp_path, files, rules=["elementwise-claim"])
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+def test_elementwise_claim_skips_trees_without_kernels_module(tmp_path):
+    files = {
+        "flink_ml_tpu/models/feature/x.py": """
+            class Stage:
+                def kernel_spec(self):
+                    return None
+        """
+    }
+    result = run_on(tmp_path, files, rules=["elementwise-claim"])
+    assert result.findings == []
+
+
+# -----------------------------------------------------------------------------
+# the shipped tree carries the annotation convention
+# -----------------------------------------------------------------------------
+
+
+def test_shipped_tree_declares_hot_roots_and_readbacks():
+    """The annotated-hot-root convention is wired into the real fast paths —
+    without roots, host-sync and the hot half of recompile-hazard are inert."""
+    project = Project(REPO_ROOT, ["flink_ml_tpu"])
+    index = project.index
+    marks = {}
+    for _f, node, ff in index.iter_functions():
+        for mark in ff["marks"]:
+            marks.setdefault(mark, []).append(node)
+    assert "flink_ml_tpu.serving.batcher:MicroBatcher._loop" in marks["hot-root"]
+    assert "flink_ml_tpu.serving.plan:CompiledServingPlan.dispatch" in marks["hot-root"]
+    assert "flink_ml_tpu.builder.batch_plan:CompiledBatchPlan._run_fused" in marks["hot-root"]
+    assert any("PlanExecution.finalize" in n for n in marks["readback"])
+    assert any("readback_one" in n for n in marks["readback"])
+    assert any("CompiledServingPlan.build" in n for n in marks["cold"])
+    # and the hot region they span is non-trivial (the call graph resolves
+    # through the server/plan/planner layers)
+    reach = index.reachable(marks["hot-root"])
+    assert "flink_ml_tpu.servable.planner:run_segment" in reach

@@ -2,11 +2,32 @@
 # Static-analysis gate: graftcheck over the library tree, failing fast with
 # the human-readable report before any test process spins up a device mesh.
 # See docs/static_analysis.md for the rule catalogue and suppression policy.
+#
+# The FULL-TREE run is (and stays) the CI gate. For the local pre-commit
+# loop, pass --changed-only (or set GRAFTCHECK_CHANGED_ONLY=1): the analysis
+# still runs whole-program, but reporting and the exit code narrow to files
+# touched per `git status`, and the warm index cache (.graftcheck/) makes the
+# run sub-second.
+#
+# Set GRAFTCHECK_SARIF=<path> to also emit a SARIF 2.1.0 report for CI
+# annotation UIs (GitHub code scanning et al.); the second run rides the
+# cache written by the first.
 set -euo pipefail
 
 ci_path="$(cd -- "$(dirname "$0")" >/dev/null 2>&1; pwd -P)"
 root_path="$(cd "${ci_path}/../.."; pwd -P)"
 cd "$root_path"
 
+extra_args=()
+if [[ "${GRAFTCHECK_CHANGED_ONLY:-0}" == "1" ]]; then
+    extra_args+=(--changed-only)
+fi
+
 echo "=== graftcheck static analysis ==="
-python -m tools.graftcheck flink_ml_tpu "$@"
+python -m tools.graftcheck "${extra_args[@]}" "$@"
+
+if [[ -n "${GRAFTCHECK_SARIF:-}" ]]; then
+    python -m tools.graftcheck --format sarif "${extra_args[@]}" "$@" \
+        > "${GRAFTCHECK_SARIF}"
+    echo "graftcheck: SARIF report written to ${GRAFTCHECK_SARIF}"
+fi

@@ -1,0 +1,129 @@
+"""Incremental on-disk cache for the project index and file-local findings.
+
+The cache is one JSON file (default ``<repo>/.graftcheck/cache.json``) keyed
+by **file content hash**: each entry stores the file's extracted index facts
+and, per file-granularity rule, its findings. A warm run therefore re-parses
+nothing — it hashes sources (cheap), loads facts and local findings straight
+from disk, and only the global composition (call-graph resolution, lock-graph
+cycles, hot-region traversal) runs fresh. That is what makes
+``--changed-only`` and the second-run CI loop sub-second while the full-tree
+cold run stays the gate.
+
+Invalidation is entirely content-driven:
+
+- a file edit changes its hash → that file's facts and findings re-extract;
+- a facts-schema change bumps ``index.FACTS_VERSION`` → whole cache ignored;
+- a rule logic change bumps that rule's ``cache_version`` → only that rule's
+  cached findings re-run (facts survive).
+
+Corrupt or unreadable caches are treated as empty — the cache is a pure
+accelerator and can never change results.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from tools.graftcheck.index import FACTS_VERSION
+
+__all__ = ["IndexCache", "content_hash", "default_cache_path"]
+
+CACHE_SCHEMA_VERSION = 1
+
+
+def content_hash(source: str) -> str:
+    return hashlib.sha1(source.encode("utf-8")).hexdigest()
+
+
+def default_cache_path(repo_root: str) -> str:
+    return os.path.join(repo_root, ".graftcheck", "cache.json")
+
+
+class IndexCache:
+    """Load/store per-file facts and per-(file, rule) findings by content hash."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._files: Dict[str, Dict[str, Any]] = {}
+        self._dirty = False
+        self.hits = 0
+        self.misses = 0
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                payload = json.load(f)
+            if (
+                payload.get("schema") == CACHE_SCHEMA_VERSION
+                and payload.get("facts_version") == FACTS_VERSION
+            ):
+                self._files = payload.get("files", {})
+        except (OSError, ValueError):
+            self._files = {}
+
+    # -- facts -----------------------------------------------------------------
+    def get_facts(self, rel: str, digest: str) -> Optional[Dict[str, Any]]:
+        entry = self._files.get(rel)
+        if entry and entry.get("hash") == digest and "facts" in entry:
+            self.hits += 1
+            return entry["facts"]
+        self.misses += 1
+        return None
+
+    def put_facts(self, rel: str, digest: str, facts: Dict[str, Any]) -> None:
+        entry = self._files.get(rel)
+        if entry is None or entry.get("hash") != digest:
+            entry = {"hash": digest, "findings": {}}
+            self._files[rel] = entry
+        entry["facts"] = facts
+        self._dirty = True
+
+    # -- file-local rule findings ----------------------------------------------
+    def get_findings(self, rel: str, digest: str, rule_key: str) -> Optional[List[dict]]:
+        entry = self._files.get(rel)
+        if entry and entry.get("hash") == digest:
+            return entry.get("findings", {}).get(rule_key)
+        return None
+
+    def put_findings(self, rel: str, digest: str, rule_key: str, findings: List[dict]) -> None:
+        entry = self._files.get(rel)
+        if entry is None or entry.get("hash") != digest:
+            entry = {"hash": digest, "findings": {}}
+            self._files[rel] = entry
+        entry.setdefault("findings", {})[rule_key] = findings
+        self._dirty = True
+
+    def prune(self, repo_root: str, live_rels: List[str]) -> None:
+        """Drop entries for files that no longer exist on disk. Entries merely
+        outside the current target set survive — a single-file run must not
+        evict the full-tree cache (hash checks keep stale entries harmless)."""
+        for rel in set(self._files) - set(live_rels):
+            if not os.path.exists(os.path.join(repo_root, rel)):
+                del self._files[rel]
+                self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        payload = {
+            "schema": CACHE_SCHEMA_VERSION,
+            "facts_version": FACTS_VERSION,
+            "files": self._files,
+        }
+        directory = os.path.dirname(self.path)
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)  # atomic: a reader never sees a partial cache
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        self._dirty = False

@@ -2,14 +2,21 @@
 
 Exit codes: 0 clean (warnings allowed), 1 error-severity findings (or any
 finding with ``--strict``), 2 usage error.
+
+The index cache (``--cache-dir``, default ``<root>/.graftcheck``) makes the
+second consecutive run skip every ``ast.parse``; ``--changed-only`` restricts
+*reporting* (and the exit code) to files touched per ``git status`` while the
+analysis itself stays whole-program — the local pre-commit loop is
+sub-second, the full-tree run stays the CI gate.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -17,8 +24,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__fi
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.graftcheck",
-        description="AST static analysis: layer, jit-purity, lock-order, "
-        "fault-point and error-hygiene invariants.",
+        description="Whole-program static analysis: layer, jit-purity, lock-order, "
+        "fault-point, error-hygiene, recompile-hazard, host-sync, "
+        "blocking-under-lock and elementwise-claim invariants.",
     )
     p.add_argument(
         "targets",
@@ -38,25 +46,69 @@ def _build_parser() -> argparse.ArgumentParser:
         metavar="RULE=LEVEL",
         help="override a rule's severity (error|warning); repeatable",
     )
-    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument("--format", choices=("human", "json", "sarif"), default="human")
     p.add_argument(
         "--strict", action="store_true", help="warnings also fail (exit 1)"
     )
     p.add_argument("--list-rules", action="store_true", help="print the rule catalogue")
+    p.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="report (and gate) only findings in files changed per git status; "
+        "the analysis still runs whole-program",
+    )
+    p.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="disable the on-disk index cache (always re-extract)",
+    )
+    p.add_argument(
+        "--cache-dir",
+        default=None,
+        metavar="DIR",
+        help="index cache directory (default: <root>/.graftcheck)",
+    )
     return p
+
+
+def _changed_files(root: str) -> Optional[Set[str]]:
+    """Repo-relative paths touched per git (staged, unstaged and untracked);
+    None when git is unavailable — the caller falls back to full reporting."""
+    try:
+        proc = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain", "--untracked-files=all"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out: Set[str] = set()
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:  # rename: report the new side
+            path = path.split(" -> ", 1)[1]
+        out.add(path.strip('"'))
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     if REPO_ROOT not in sys.path:
         sys.path.insert(0, REPO_ROOT)
+    from tools.graftcheck.cache import IndexCache, default_cache_path
     from tools.graftcheck.engine import REGISTRY, Project, run_rules
+    from tools.graftcheck.sarif import to_sarif
     import tools.graftcheck.rules  # noqa: F401  (registration)
 
     if args.list_rules:
         for name in sorted(REGISTRY):
             rule = REGISTRY[name]
-            print(f"{name:16s} [{rule.severity}] {rule.description}")
+            print(f"{name:24s} [{rule.severity}] {rule.description}")
         return 0
 
     rules = None
@@ -75,15 +127,32 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"target {target!r} not found under {args.root}", file=sys.stderr)
             return 2
 
-    project = Project(args.root, args.targets)
+    cache = None
+    if not args.no_cache:
+        cache_path = (
+            os.path.join(args.cache_dir, "cache.json")
+            if args.cache_dir
+            else default_cache_path(args.root)
+        )
+        cache = IndexCache(cache_path)
+
+    project = Project(args.root, args.targets, cache=cache)
     try:
         result = run_rules(project, rules=rules, severity_overrides=overrides)
     except (KeyError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2
+    project.save_cache()
+
+    if args.changed_only:
+        changed = _changed_files(args.root)
+        if changed is not None:
+            result = result.restricted_to(changed)
 
     if args.format == "json":
         print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(result, REGISTRY), indent=2, sort_keys=True))
     else:
         print(result.render_human())
     if result.errors:

@@ -1,25 +1,36 @@
-"""graftcheck engine: rule registry, project model, suppressions, reporting.
+"""graftcheck engine: rule registry, project model, index/cache, reporting.
 
 The framework is deliberately small: a *rule* is an object with a ``name``,
-a default ``severity``, a ``description`` and a ``run(project)`` method that
-returns :class:`Finding`s. Rules register themselves via :func:`register`;
-``tools.graftcheck.rules`` imports every rule module so importing the package
-populates the registry. The engine owns everything rule-agnostic —
+a default ``severity``, a ``description``, a ``granularity`` and a
+``run(project)`` (or, for file-granularity rules, ``check_file(project, sf)``)
+method returning :class:`Finding`s. Rules register themselves via
+:func:`register`; ``tools.graftcheck.rules`` imports every rule module so
+importing the package populates the registry. The engine owns everything
+rule-agnostic —
 
-- parsing the target tree once into :class:`SourceFile`s (path, dotted module
-  name, source, AST),
+- loading the target tree into :class:`SourceFile`s (path, dotted module
+  name, source, content hash) with **lazy** AST parsing — a warm cached run
+  never calls ``ast.parse``;
+- the **project index** (``tools/graftcheck/index.py``): symbol table,
+  resolved import graph, call graph, per-file rule facts — built once per run
+  and cached incrementally on disk keyed by file content hash
+  (``tools/graftcheck/cache.py``);
+- per-file caching of **file-granularity** rule findings (same content-hash
+  key, plus the rule's ``cache_version``);
 - ``# graftcheck: disable=<rule>[,<rule>...]`` / ``disable=all`` line
-  suppressions (same-line only, like ``noqa``),
-- severity overrides, JSON/human rendering, and the exit-code contract
-  (non-zero iff an unsuppressed *error*-severity finding exists).
+  suppressions (same-line only, like ``noqa``), severity overrides,
+  JSON/human rendering (SARIF lives in ``sarif.py``), and the exit-code
+  contract (non-zero iff an unsuppressed *error*-severity finding exists).
 """
 from __future__ import annotations
 
 import ast
 import os
 import re
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from tools.graftcheck.index import ProjectIndex, extract_facts
 
 __all__ = [
     "Finding",
@@ -32,7 +43,7 @@ __all__ = [
     "JSON_SCHEMA_VERSION",
 ]
 
-JSON_SCHEMA_VERSION = 1
+JSON_SCHEMA_VERSION = 2  # v2: adds index/cache stats to the summary
 
 SEVERITIES = ("error", "warning")
 
@@ -58,9 +69,26 @@ class SourceFile:
     rel: str  # repo-relative, forward slashes
     module: str  # dotted ("flink_ml_tpu.serving.batcher"; packages lose .__init__)
     source: str
-    tree: ast.AST
+    digest: str  # content hash (the cache key)
 
+    _tree: Optional[ast.AST] = field(default=None, repr=False)
+    _parsed: bool = False
+    parse_error: Optional[tuple] = None  # (line, message) when unparsable
     _suppressions: Optional[Dict[int, Set[str]]] = None
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        """The parsed AST — parsed on first access so cache-warm runs that
+        never need it never pay for it. ``None`` when the file has a syntax
+        error (recorded in :attr:`parse_error`)."""
+        if not self._parsed:
+            self._parsed = True
+            try:
+                self._tree = ast.parse(self.source, filename=self.path)
+            except SyntaxError as e:
+                self._tree = None
+                self.parse_error = (e.lineno or 1, f"syntax error: {e.msg}")
+        return self._tree
 
     @property
     def suppressions(self) -> Dict[int, Set[str]]:
@@ -85,17 +113,27 @@ def parse_suppressions(source: str) -> Dict[int, Set[str]]:
 
 
 class Project:
-    """The parsed analysis targets plus enough repo context for cross-cutting
-    rules (fault-points needs ``tests/``; layer-deps needs the module set)."""
+    """The analysis targets plus enough repo context for cross-cutting rules
+    (fault-points needs ``tests/``; layer-deps needs the module set).
 
-    def __init__(self, repo_root: str, targets: Sequence[str]):
+    ``cache`` is an optional :class:`tools.graftcheck.cache.IndexCache`; when
+    attached, per-file index facts and file-granularity findings come from /
+    go to disk keyed by content hash. The :attr:`index` property materializes
+    the whole-program :class:`ProjectIndex` on first access.
+    """
+
+    def __init__(self, repo_root: str, targets: Sequence[str], cache=None):
         self.repo_root = os.path.abspath(repo_root)
         self.targets = list(targets)
+        self.cache = cache
         self.files: List[SourceFile] = []
-        self.parse_errors: List[Finding] = []
         for target in self.targets:
             self._load(os.path.join(self.repo_root, target))
         self.files.sort(key=lambda f: f.rel)
+        self._by_rel = {f.rel: f for f in self.files}
+        self._facts: Optional[Dict[str, dict]] = None
+        self._index: Optional[ProjectIndex] = None
+        self.parse_errors: List[Finding] = []
 
     def _load(self, target: str) -> None:
         if os.path.isfile(target):
@@ -108,26 +146,58 @@ class Project:
                     self._load_file(os.path.join(dirpath, name))
 
     def _load_file(self, path: str) -> None:
+        from tools.graftcheck.cache import content_hash
+
         rel = os.path.relpath(path, self.repo_root).replace(os.sep, "/")
         with open(path, encoding="utf-8") as f:
             source = f.read()
         module = rel[: -len(".py")].replace("/", ".")
         if module.endswith(".__init__"):
             module = module[: -len(".__init__")]
-        try:
-            tree = ast.parse(source, filename=path)
-        except SyntaxError as e:
-            self.parse_errors.append(
-                Finding(
-                    rule="parse",
-                    path=rel,
-                    line=e.lineno or 1,
-                    message=f"syntax error: {e.msg}",
-                )
+        self.files.append(
+            SourceFile(
+                path=path, rel=rel, module=module, source=source,
+                digest=content_hash(source),
             )
-            return
-        self.files.append(SourceFile(path=path, rel=rel, module=module, source=source, tree=tree))
+        )
 
+    # -- index / facts ---------------------------------------------------------
+    def facts(self) -> Dict[str, dict]:
+        """Per-file index facts for every file, from the cache where content
+        hashes match, extracted (one AST pass) where they don't. Also fills
+        :attr:`parse_errors`."""
+        if self._facts is not None:
+            return self._facts
+        out: Dict[str, dict] = {}
+        errors: List[Finding] = []
+        for sf in self.files:
+            facts = self.cache.get_facts(sf.rel, sf.digest) if self.cache else None
+            if facts is None:
+                facts = extract_facts(sf.rel, sf.module, sf.source, sf.tree)
+                if sf.parse_error is not None:
+                    facts["parse_error"] = [sf.parse_error[0], sf.parse_error[1]]
+                if self.cache:
+                    self.cache.put_facts(sf.rel, sf.digest, facts)
+            if facts.get("parse_error"):
+                line, msg = facts["parse_error"]
+                errors.append(Finding(rule="parse", path=sf.rel, line=line, message=msg))
+            out[sf.rel] = facts
+        self._facts = out
+        self.parse_errors = errors
+        return out
+
+    @property
+    def index(self) -> ProjectIndex:
+        if self._index is None:
+            self._index = ProjectIndex(self.facts())
+        return self._index
+
+    def save_cache(self) -> None:
+        if self.cache:
+            self.cache.prune(self.repo_root, [f.rel for f in self.files])
+            self.cache.save()
+
+    # -- lookups ---------------------------------------------------------------
     def iter_files(self, prefix: Optional[str] = None) -> Iterable[SourceFile]:
         """Files whose repo-relative path starts with ``prefix`` (all if None)."""
         for f in self.files:
@@ -135,24 +205,31 @@ class Project:
                 yield f
 
     def file(self, rel: str) -> Optional[SourceFile]:
-        rel = rel.replace(os.sep, "/")
-        for f in self.files:
-            if f.rel == rel:
-                return f
-        return None
+        return self._by_rel.get(rel.replace(os.sep, "/"))
 
 
 class Rule:
     """Base class. Subclasses set ``name``/``severity``/``description`` and
-    implement ``run``; most also expose module-level helpers so shims and
-    tests can reuse the analysis without the engine."""
+    implement ``run`` (project granularity) or ``check_file`` (file
+    granularity — findings are cacheable per content hash; bump
+    ``cache_version`` whenever the rule's logic changes)."""
 
     name: str = ""
     severity: str = "error"
     description: str = ""
+    granularity: str = "project"  # or "file"
+    cache_version: int = 1
 
-    def run(self, project: Project) -> List[Finding]:  # pragma: no cover - abstract
-        raise NotImplementedError
+    def run(self, project: Project) -> List[Finding]:
+        if self.granularity == "file":
+            out: List[Finding] = []
+            for sf in project.files:
+                out.extend(self.check_file(project, sf))
+            return out
+        raise NotImplementedError  # pragma: no cover - abstract
+
+    def check_file(self, project: Project, sf: SourceFile) -> List[Finding]:
+        raise NotImplementedError  # pragma: no cover - abstract
 
     def finding(self, path: str, line: int, message: str, severity: Optional[str] = None) -> Finding:
         return Finding(
@@ -188,6 +265,8 @@ class RunResult:
     suppressed: List[Finding]
     files_checked: int
     rules_run: List[str]
+    cache_hits: int = 0
+    cache_misses: int = 0
 
     @property
     def errors(self) -> List[Finding]:
@@ -196,6 +275,18 @@ class RunResult:
     @property
     def exit_code(self) -> int:
         return 1 if self.errors else 0
+
+    def restricted_to(self, paths: Set[str]) -> "RunResult":
+        """The same run, findings filtered to ``paths`` (the ``--changed-only``
+        view: analysis still ran whole-program, only reporting narrows)."""
+        return RunResult(
+            findings=[f for f in self.findings if f.path in paths],
+            suppressed=[f for f in self.suppressed if f.path in paths],
+            files_checked=self.files_checked,
+            rules_run=self.rules_run,
+            cache_hits=self.cache_hits,
+            cache_misses=self.cache_misses,
+        )
 
     def to_json(self) -> dict:
         by_rule: Dict[str, int] = {}
@@ -219,6 +310,7 @@ class RunResult:
                 "errors": len(self.errors),
                 "suppressed": len(self.suppressed),
                 "by_rule": by_rule,
+                "cache": {"hits": self.cache_hits, "misses": self.cache_misses},
             },
         }
 
@@ -232,6 +324,19 @@ class RunResult:
             f"across {self.files_checked} file(s), rules: {', '.join(self.rules_run)}"
         )
         return "\n".join(lines)
+
+
+def _run_file_rule(project: Project, rule: Rule, sf: SourceFile) -> List[Finding]:
+    """File-granularity execution with content-hash finding cache."""
+    key = f"{rule.name}:{rule.cache_version}"
+    if project.cache is not None:
+        cached = project.cache.get_findings(sf.rel, sf.digest, key)
+        if cached is not None:
+            return [Finding(**d) for d in cached]
+    found = list(rule.check_file(project, sf))
+    if project.cache is not None:
+        project.cache.put_findings(sf.rel, sf.digest, key, [asdict(f) for f in found])
+    return found
 
 
 def run_rules(
@@ -250,28 +355,39 @@ def run_rules(
         if sev not in SEVERITIES:
             raise ValueError(f"bad severity override {sev!r}")
 
+    project.facts()  # materialize the index facts (and parse errors) once
     raw: List[Finding] = list(project.parse_errors)
     for name in names:
-        for f in REGISTRY[name].run(project):
-            sev = overrides.get(f.rule, f.severity)
-            if sev != f.severity:
-                f = Finding(rule=f.rule, path=f.path, line=f.line, message=f.message, severity=sev)
-            raw.append(f)
+        rule = REGISTRY[name]
+        if rule.granularity == "file":
+            for sf in project.files:
+                raw.extend(_run_file_rule(project, rule, sf))
+        else:
+            raw.extend(rule.run(project))
+
+    processed: List[Finding] = []
+    for f in raw:
+        sev = overrides.get(f.rule, f.severity)
+        if sev != f.severity:
+            f = Finding(rule=f.rule, path=f.path, line=f.line, message=f.message, severity=sev)
+        processed.append(f)
 
     kept: List[Finding] = []
     suppressed: List[Finding] = []
-    by_rel = {f.rel: f for f in project.files}
-    for f in raw:
-        sf = by_rel.get(f.path)
+    for f in processed:
+        sf = project.file(f.path)
         rules_at_line = sf.suppressions.get(f.line, set()) if sf else set()
         if f.rule in rules_at_line or "all" in rules_at_line:
             suppressed.append(f)
         else:
             kept.append(f)
     key = lambda f: (f.path, f.line, f.rule, f.message)
+    cache = project.cache
     return RunResult(
         findings=sorted(kept, key=key),
         suppressed=sorted(suppressed, key=key),
         files_checked=len(project.files),
         rules_run=names,
+        cache_hits=cache.hits if cache else 0,
+        cache_misses=cache.misses if cache else 0,
     )

@@ -1,0 +1,1115 @@
+"""The shared project index — graftcheck v2's whole-program analysis core.
+
+PR 3's rules each walked file ASTs independently, so every invariant was
+per-function syntax. The hazards that actually destroy TPU goodput — silent
+recompiles, implicit device→host syncs on the serving path, blocking work
+under serving locks — are *cross-module* properties: a `.item()` three calls
+below the dispatch loop, a `time.sleep` inside a helper invoked while a lock
+is held. This module builds, once per run, everything those rules query:
+
+- a **symbol table**: every module / class / function (methods and nested
+  defs included) with its parameters, decorators and graftcheck markers;
+- a **resolved import graph**: ``from X import f as g`` bindings, module
+  aliases, and re-export chains followed into project modules;
+- a **call graph** with method resolution on known classes: ``self.m()``,
+  ``self.attr.m()`` on constructor/annotation-typed attributes, module
+  singletons (``metrics = MetricsRegistry()``), imported functions and
+  singletons, constructors, lexically scoped nested defs, and one level of
+  return-type inference (``self.dispatch(x).finalize()`` resolves when every
+  ``return`` of ``dispatch`` is ``PlanExecution(...)``);
+- **per-file rule facts** extracted in the same AST pass: lock acquisitions
+  and calls-while-holding, blocking-operation sites, host-sync sites, jit
+  construction / jitted-call sites, branch-on-parameter sites, reduction
+  primitives, KernelSpec constructions, fault trip sites, kernels imports.
+
+Everything per-file is a plain-JSON value keyed by the file's content hash,
+which is what makes the on-disk cache (``tools/graftcheck/cache.py``)
+incremental: an unchanged file's facts (and its file-local rule findings)
+load back without re-parsing, so a warm run never calls ``ast.parse``.
+
+Marker convention (the annotated-hot-root contract, docs/static_analysis.md):
+
+- ``# graftcheck: hot-root`` on a ``def`` line — the function is a serving /
+  batch hot region root; everything reachable from it through the call graph
+  is "hot" (host-sync and recompile-hazard police it).
+- ``# graftcheck: readback`` — the function IS a designated device→host sync
+  boundary (the plan's single blocking readback); traversal stops here.
+- ``# graftcheck: cold`` — reachable from a hot root only on a lazily-taken
+  build/warmup edge (counted by its own metric); excluded from the hot region.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "FACTS_VERSION",
+    "KERNELS_MODULE",
+    "KERNEL_ALIASES",
+    "kernel_base",
+    "extract_facts",
+    "ProjectIndex",
+]
+
+#: Bump whenever the shape/semantics of extracted facts change — it is part of
+#: the disk-cache key, so stale caches self-invalidate.
+FACTS_VERSION = 1
+
+KERNELS_MODULE = "flink_ml_tpu.ops.kernels"
+
+#: fn-name base -> factory-name base for kernel pairs that predate the
+#: *_fn/*_kernel naming convention (the factory jits exactly that fn body).
+KERNEL_ALIASES = {
+    "kmeans_predict": "kmeans_assign",
+    "logistic_predict": "logistic_from_dots",
+    "dct_basis": "dct",  # the basis builder is part of the dct body pairing
+}
+
+#: Cross-element accumulation primitives — anything here inside an
+#: ``elementwise=True`` kernel body breaks the PR 5 merge contract.
+REDUCTION_PRIMS = {
+    "sum", "dot", "mean", "median", "einsum", "matmul", "tensordot", "vdot",
+    "cumsum", "cumprod", "prod", "sort", "argsort", "argmax", "argmin",
+    "norm", "std", "var",
+}
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_TIME_ATTRS = {"time", "perf_counter", "monotonic", "time_ns", "perf_counter_ns"}
+_OS_BLOCKING = {
+    "listdir", "scandir", "makedirs", "mkdir", "remove", "unlink", "rename",
+    "replace", "stat", "rmdir", "walk", "fsync",
+}
+_MEMO_DECORATORS = {"cache", "lru_cache"}
+
+KNOWN_MARKS = ("hot-root", "readback", "cold")
+
+_MARK_RE = re.compile(r"#\s*graftcheck:\s*([A-Za-z0-9_\-,=\s]+)")
+
+
+def kernel_base(name: str) -> str:
+    """Normalize an ops/kernels.py symbol to its shared-body base."""
+    for suffix in ("_kernel", "_fn"):
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
+            break
+    return KERNEL_ALIASES.get(name, name)
+
+
+def _line_marks(lines: Sequence[str], lineno: int) -> List[str]:
+    """graftcheck markers on a source line (1-based); ``disable=`` tokens are
+    suppressions and belong to the engine, not the marker set."""
+    if not 1 <= lineno <= len(lines):
+        return []
+    m = _MARK_RE.search(lines[lineno - 1])
+    if not m:
+        return []
+    out = []
+    for tok in m.group(1).split(","):
+        tok = tok.strip()
+        if tok and "=" not in tok and tok in KNOWN_MARKS:
+            out.append(tok)
+    return out
+
+
+def _empty_facts(rel: str, module: str) -> Dict[str, Any]:
+    return {
+        "v": FACTS_VERSION,
+        "rel": rel,
+        "module": module,
+        "parse_error": None,
+        "imports": [],  # [line, absolute dotted module] (iter_imports semantics)
+        "bindings": {},  # local name -> [source module, original name]
+        "module_aliases": {},  # local name -> module ("import x.y as z")
+        "singletons": {},  # module-level name -> class simple name
+        "module_locks": {},  # module-level name -> def line
+        "classes": {},
+        "functions": {},
+        "jit_passed": {},  # fn name passed to jit(...) -> {"static": bool}
+        "jit_bound": {},  # module-level name bound to jit(...) -> {"static": bool}
+        "kernels": {"imports": {}, "outside": [], "specs": []},
+        "kspec_ctors": [],
+        "trip_sites": [],  # [point name, line]
+    }
+
+
+def _ctor_class_name(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _annotation_name(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.strip("\"'")
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _is_jit_expr(node: ast.AST, jit_names: Set[str]) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in jit_names
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return isinstance(node.value, ast.Name)
+    return False
+
+
+def _has_static_args(call: ast.Call) -> bool:
+    return any(kw.arg in ("static_argnums", "static_argnames") for kw in call.keywords)
+
+
+def _static_param_names(fn: ast.AST, dec: ast.Call) -> List[str]:
+    """Best-effort names of statically-declared params of a jitted def."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: List[str] = []
+    for kw in dec.keywords:
+        if kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(kw.value, ast.Tuple) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    if 0 <= v.value < len(params):
+                        out.append(params[v.value])
+        elif kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    out.append(v.value)
+    return out
+
+
+class _ClassInfo:
+    __slots__ = (
+        "name", "line", "bases", "locks", "aliases", "attr_types",
+        "event_attrs", "queue_attrs", "thread_attrs",
+    )
+
+    def __init__(self, node: ast.ClassDef):
+        self.name = node.name
+        self.line = node.lineno
+        self.bases = [b.id for b in node.bases if isinstance(b, ast.Name)]
+        self.locks: Dict[str, int] = {}
+        self.aliases: Dict[str, str] = {}
+        self.attr_types: Dict[str, str] = {}
+        self.event_attrs: List[str] = []
+        self.queue_attrs: List[str] = []
+        self.thread_attrs: List[str] = []
+
+    def lock_attr(self, attr: str) -> Optional[str]:
+        attr = self.aliases.get(attr, attr)
+        return attr if attr in self.locks else None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "line": self.line,
+            "bases": self.bases,
+            "locks": self.locks,
+            "aliases": self.aliases,
+            "attr_types": self.attr_types,
+            "event_attrs": self.event_attrs,
+            "queue_attrs": self.queue_attrs,
+            "thread_attrs": self.thread_attrs,
+        }
+
+
+def _collect_class_info(tree: ast.AST) -> Dict[str, _ClassInfo]:
+    """Pre-pass: lock/alias/typed-attr structure of every class, gathered from
+    every ``self.X = ...`` assignment in any method (the lock-order pass-1
+    semantics, now shared by every rule through the index)."""
+    out: Dict[str, _ClassInfo] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = _ClassInfo(node)
+        out[node.name] = ci
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            ann = {
+                a.arg: _annotation_name(a.annotation)
+                for a in item.args.args + item.args.kwonlyargs
+            }
+            for sub in ast.walk(item):
+                if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
+                    continue
+                attr = _self_attr(sub.targets[0])
+                if attr is None:
+                    continue
+                val = sub.value
+                if isinstance(val, ast.Call) and isinstance(val.func, ast.Attribute):
+                    ctor = val.func.attr
+                    if ctor in _LOCK_CTORS:
+                        ci.locks[attr] = sub.lineno
+                    elif ctor == "Condition":
+                        inner = _self_attr(val.args[0]) if val.args else None
+                        if inner is not None:
+                            ci.aliases[attr] = inner
+                        else:
+                            ci.locks[attr] = sub.lineno  # owns its lock
+                    elif ctor == "Event":
+                        ci.event_attrs.append(attr)
+                    elif ctor == "Queue":
+                        ci.queue_attrs.append(attr)
+                    elif ctor == "Thread":
+                        ci.thread_attrs.append(attr)
+                elif isinstance(val, ast.Call):
+                    ctor = _ctor_class_name(val)
+                    if ctor == "Event":
+                        ci.event_attrs.append(attr)
+                    elif ctor == "Queue":
+                        ci.queue_attrs.append(attr)
+                    elif ctor == "Thread":
+                        ci.thread_attrs.append(attr)
+                    elif ctor is not None:
+                        ci.attr_types[attr] = ctor
+                elif isinstance(val, ast.Name) and ann.get(val.id):
+                    ci.attr_types[attr] = ann[val.id]
+    return out
+
+
+class _Extractor:
+    """One recursive pass over a parsed module, carrying the context the flat
+    ``ast.walk`` rules could never see: enclosing function/class, loop depth,
+    and the set of locks lexically held."""
+
+    def __init__(self, rel: str, module: str, source: str, tree: ast.AST):
+        self.facts = _empty_facts(rel, module)
+        self.module = module
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.classes = _collect_class_info(tree)
+        self.facts["classes"] = {n: ci.to_json() for n, ci in self.classes.items()}
+        # Aliases for numpy / time / jax.jit spellings in this module (first:
+        # the module prepass needs the jit spellings for `x = jit(f)` bindings).
+        self.np_names: Set[str] = set()
+        self.time_names: Set[str] = set()
+        self.time_funcs: Set[str] = set()
+        self.jit_names: Set[str] = set()
+        self.jax_names: Set[str] = set()
+        self._alias_prepass(tree)
+        self._module_prepass(tree)
+
+    # -- module-level prepasses ----------------------------------------------
+    def _module_prepass(self, tree: ast.AST) -> None:
+        f = self.facts
+        is_init = f["rel"].endswith("/__init__.py")
+        parts = self.module.split(".")
+        package = parts if is_init else parts[:-1]
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    f["imports"].append([node.lineno, alias.name])
+                    f["module_aliases"][alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = package[: len(package) - (node.level - 1)]
+                    mod = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    mod = node.module or ""
+                if not mod:
+                    continue
+                f["imports"].append([node.lineno, mod])
+                for alias in node.names:
+                    f["imports"].append([node.lineno, f"{mod}.{alias.name}"])
+                    f["bindings"][alias.asname or alias.name] = [mod, alias.name]
+                if mod == KERNELS_MODULE:
+                    for alias in node.names:
+                        f["kernels"]["imports"][alias.asname or alias.name] = kernel_base(
+                            alias.name
+                        )
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                cname = _ctor_class_name(node.value)
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    val = node.value
+                    if (
+                        isinstance(val.func, ast.Attribute)
+                        and val.func.attr in _LOCK_CTORS
+                    ):
+                        f["module_locks"][tgt.id] = node.lineno
+                    elif _is_jit_expr(val.func, self.jit_names):
+                        f["jit_bound"][tgt.id] = {"static": _has_static_args(val)}
+                    elif cname:
+                        f["singletons"][tgt.id] = cname
+
+    def _alias_prepass(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if alias.name == "numpy":
+                        self.np_names.add(bound)
+                    elif alias.name == "time":
+                        self.time_names.add(bound)
+                    elif alias.name == "jax":
+                        self.jax_names.add(bound)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "time":
+                    for alias in node.names:
+                        if alias.name in _TIME_ATTRS:
+                            self.time_funcs.add(alias.asname or alias.name)
+                elif node.module == "jax":
+                    for alias in node.names:
+                        if alias.name == "jit":
+                            self.jit_names.add(alias.asname or alias.name)
+
+    # -- main walk ------------------------------------------------------------
+    def run(self) -> Dict[str, Any]:
+        for stmt in self.tree.body:
+            self._walk_toplevel(stmt, cls=None)
+        self._second_pass_jitted()
+        return self.facts
+
+    def _walk_toplevel(self, node: ast.AST, cls: Optional[str]) -> None:
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                self._walk_toplevel(item, cls=node.name)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._extract_function(node, cls=cls, parent=None)
+            return
+        # module-level statements: jit-by-name bindings, trip sites, kernels refs
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._record_module_call(sub)
+            elif isinstance(sub, ast.Name) and sub.id in self.facts["kernels"]["imports"]:
+                base = self.facts["kernels"]["imports"][sub.id]
+                if base not in self.facts["kernels"]["outside"]:
+                    self.facts["kernels"]["outside"].append(base)
+
+    def _record_module_call(self, call: ast.Call) -> None:
+        if _is_jit_expr(call.func, self.jit_names) and call.args:
+            target = call.args[0]
+            if isinstance(target, ast.Name):
+                self.facts["jit_passed"].setdefault(
+                    target.id, {"static": _has_static_args(call)}
+                )
+        point = _trip_point(call)
+        if point is not None:
+            self.facts["trip_sites"].append([point, call.lineno])
+
+    # -- per-function extraction ----------------------------------------------
+    def _extract_function(
+        self, fn: ast.AST, cls: Optional[str], parent: Optional[str]
+    ) -> None:
+        qual = (
+            f"{parent}.<locals>.{fn.name}"
+            if parent
+            else (f"{cls}.{fn.name}" if cls else fn.name)
+        )
+        a = fn.args
+        params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            params.append(a.vararg.arg)
+        if a.kwarg:
+            params.append(a.kwarg.arg)
+        params = [p for p in params if p != "self"]
+
+        is_jitted = False
+        static_names: List[str] = []
+        has_static = False
+        memoized = False
+        for dec in getattr(fn, "decorator_list", []):
+            if _is_jit_expr(dec, self.jit_names):
+                is_jitted = True
+            elif isinstance(dec, ast.Call):
+                if _is_jit_expr(dec.func, self.jit_names):
+                    is_jitted = True
+                    has_static = has_static or _has_static_args(dec)
+                    static_names += _static_param_names(fn, dec)
+                is_partial = (
+                    isinstance(dec.func, ast.Name) and dec.func.id == "partial"
+                ) or (isinstance(dec.func, ast.Attribute) and dec.func.attr == "partial")
+                if is_partial and any(
+                    _is_jit_expr(x, self.jit_names) for x in dec.args
+                ):
+                    is_jitted = True
+                    has_static = has_static or _has_static_args(dec)
+                    static_names += _static_param_names(fn, dec)
+                if (
+                    isinstance(dec.func, ast.Name) and dec.func.id in _MEMO_DECORATORS
+                ) or (
+                    isinstance(dec.func, ast.Attribute)
+                    and dec.func.attr in _MEMO_DECORATORS
+                ):
+                    memoized = True
+            elif isinstance(dec, ast.Name) and dec.id in _MEMO_DECORATORS:
+                memoized = True
+            elif isinstance(dec, ast.Attribute) and dec.attr in _MEMO_DECORATORS:
+                memoized = True
+
+        ff: Dict[str, Any] = {
+            "line": fn.lineno,
+            "name": fn.name,
+            "cls": cls,
+            "parent": parent,
+            "params": params,
+            "is_jitted": is_jitted,
+            "has_static": has_static,
+            "static_names": sorted(set(static_names)),
+            "memoized": memoized,
+            "marks": _line_marks(self.lines, fn.lineno),
+            "returns_class": None,
+            "calls": [],  # [ref, line, [held lock tokens]]
+            "acquires": [],  # canonical lock tokens directly acquired
+            "nest_edges": [],  # [outer, inner, line]
+            "blocking": [],  # [kind, line, detail, [held]]
+            "sync_sites": [],  # [kind, line, detail]
+            "jit_sites": [],  # [line, form, binding, in_loop]
+            "jitted_call_sites": [],  # [callee, line, [loop-var args]]
+            "param_branches": [],  # [line, [param names in value-wise branch test]]
+            "scalar_loop_vars": [],
+            "reductions": [],  # [prim, line]
+            "is_kernel_spec": fn.name == "kernel_spec",
+            "spec_trivial": True,
+            "spec_refs": [],  # kernel bases referenced inside (kernel_spec only)
+            "spec_names": [],  # original imported kernel names referenced inside
+        }
+        self.facts["functions"][qual] = ff
+
+        ci = self.classes.get(cls) if cls else None
+        returns: List[Optional[str]] = []
+        self._body_walk(fn, ff, qual, ci, held=[], loop=0, returns=returns)
+        if returns and all(r is not None and r == returns[0] for r in returns):
+            ff["returns_class"] = returns[0]
+        if ff["is_kernel_spec"]:
+            ff["spec_trivial"] = _spec_trivial(fn)
+
+    def _lock_token(self, ci: Optional[_ClassInfo], expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and ci is not None:
+            canon = ci.lock_attr(attr)
+            if canon is not None:
+                return f"self.{canon}"
+            return None
+        if isinstance(expr, ast.Name) and expr.id in self.facts["module_locks"]:
+            return f"mod.{expr.id}"
+        return None
+
+    def _body_walk(
+        self,
+        fn: ast.AST,
+        ff: Dict[str, Any],
+        qual: str,
+        ci: Optional[_ClassInfo],
+        held: List[str],
+        loop: int,
+        returns: List[Optional[str]],
+    ) -> None:
+        def walk(node: ast.AST, held: List[str], loop: int) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._extract_function(node, cls=ff["cls"], parent=qual)
+                return
+            if isinstance(node, ast.Lambda):
+                return
+            if isinstance(node, ast.Return):
+                val = node.value
+                returns.append(
+                    _ctor_class_name(val) if isinstance(val, ast.Call) else None
+                )
+            if isinstance(node, ast.With):
+                acquired: List[str] = []
+                for item in node.items:
+                    token = self._lock_token(ci, item.context_expr)
+                    if token is not None:
+                        ff["acquires"].append(token)
+                        for h in held:
+                            ff["nest_edges"].append([h, token, node.lineno])
+                        acquired.append(token)
+                    else:
+                        walk(item.context_expr, held, loop)
+                for stmt in node.body:
+                    walk(stmt, held + acquired, loop)
+                return
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                if isinstance(node, ast.For):
+                    self._note_scalar_loop_var(node, ff)
+                    walk(node.iter, held, loop)
+                    walk(node.target, held, loop)
+                elif isinstance(node, ast.While):
+                    walk(node.test, held, loop)
+                for stmt in node.body + node.orelse:
+                    walk(stmt, held, loop + 1)
+                return
+            if isinstance(node, (ast.If, ast.IfExp)):
+                self._note_param_branch(node.test, ff)
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+                ff["reductions"].append(["matmul", node.lineno])
+            if isinstance(node, ast.Call):
+                self._record_call(node, ff, ci, held, loop)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held, loop)
+
+        for stmt in fn.body:
+            walk(stmt, list(held), loop)
+
+    def _note_scalar_loop_var(self, node: ast.For, ff: Dict[str, Any]) -> None:
+        """Loop variables that are definitely Python scalars: ``for i in
+        range(...)`` and the counter of ``for i, x in enumerate(...)``."""
+        it = node.iter
+        if not isinstance(it, ast.Call) or not isinstance(it.func, ast.Name):
+            return
+        if it.func.id == "range" and isinstance(node.target, ast.Name):
+            ff["scalar_loop_vars"].append(node.target.id)
+        elif (
+            it.func.id == "enumerate"
+            and isinstance(node.target, ast.Tuple)
+            and node.target.elts
+            and isinstance(node.target.elts[0], ast.Name)
+        ):
+            ff["scalar_loop_vars"].append(node.target.elts[0].id)
+
+    def _note_param_branch(self, test: ast.AST, ff: Dict[str, Any]) -> None:
+        """Names a branch test depends on *by value*: bare parameter reads,
+        excluding reads that only touch static metadata (``p.shape`` /
+        ``p.ndim`` / ``p.dtype`` — legal trace-time constants)."""
+        params = set(ff["params"])
+        hits: Set[str] = set()
+        shape_parents: Set[int] = set()
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim", "dtype"):
+                for inner in ast.walk(sub.value):
+                    shape_parents.add(id(inner))
+        for sub in ast.walk(test):
+            if (
+                isinstance(sub, ast.Name)
+                and sub.id in params
+                and id(sub) not in shape_parents
+            ):
+                hits.add(sub.id)
+        if hits:
+            ff["param_branches"].append([test.lineno, sorted(hits)])
+
+    # -- per-call classification ----------------------------------------------
+    def _record_call(
+        self,
+        call: ast.Call,
+        ff: Dict[str, Any],
+        ci: Optional[_ClassInfo],
+        held: List[str],
+        loop: int,
+    ) -> None:
+        func = call.func
+        ref = _call_ref(func)
+        if ref is not None:
+            ff["calls"].append([ref, call.lineno, list(held)])
+
+        point = _trip_point(call)
+        if point is not None:
+            self.facts["trip_sites"].append([point, call.lineno])
+
+        # jit construction / jit-by-name sites
+        if _is_jit_expr(func, self.jit_names) and (call.args or call.keywords):
+            target = call.args[0] if call.args else None
+            form = "bare"
+            if isinstance(target, ast.Lambda):
+                form = "lambda"
+            elif isinstance(target, ast.Name):
+                form = "named"
+                self.facts["jit_passed"].setdefault(
+                    target.id, {"static": _has_static_args(call)}
+                )
+            ff["jit_sites"].append([call.lineno, form, "expr", loop > 0])
+        if (
+            isinstance(func, ast.Call)
+            and _is_jit_expr(func.func, self.jit_names)
+        ):
+            # jit(f)(args): construct-and-invoke in one expression
+            ff["jit_sites"].append([call.lineno, "immediate", "call", loop > 0])
+
+        # blocking-operation classification
+        self._classify_blocking(call, ff, ci, held)
+        # host-sync classification
+        self._classify_sync(call, ff)
+        # reduction primitives
+        prim = _reduction_prim(call)
+        if prim is not None:
+            ff["reductions"].append([prim, call.lineno])
+
+        # jitted-by-name call sites with scalar loop-var args
+        if isinstance(func, ast.Name):
+            loop_args = [
+                arg.id
+                for arg in call.args
+                if isinstance(arg, ast.Name) and arg.id in ff["scalar_loop_vars"]
+            ]
+            if loop_args:
+                ff["jitted_call_sites"].append([func.id, call.lineno, loop_args])
+
+    def _classify_blocking(
+        self,
+        call: ast.Call,
+        ff: Dict[str, Any],
+        ci: Optional[_ClassInfo],
+        held: List[str],
+    ) -> None:
+        func = call.func
+        kind: Optional[str] = None
+        detail = ""
+        if isinstance(func, ast.Name):
+            if func.id == "open":
+                kind, detail = "io", "open()"
+            elif func.id in self.time_funcs and func.id == "sleep":
+                kind, detail = "sleep", "sleep()"
+            elif func.id == "sleep" and "sleep" in self.facts["bindings"] and (
+                self.facts["bindings"]["sleep"][0] == "time"
+            ):
+                kind, detail = "sleep", "time.sleep()"
+            elif func.id == "device_put" and self.facts["bindings"].get(
+                "device_put", ["", ""]
+            )[0] in ("jax", "jax.numpy"):
+                kind, detail = "device", "device_put()"
+        elif isinstance(func, ast.Attribute):
+            base = func.value
+            attr = func.attr
+            base_name = base.id if isinstance(base, ast.Name) else None
+            if base_name in self.time_names and attr == "sleep":
+                kind, detail = "sleep", f"{base_name}.sleep()"
+            elif base_name in ("os", "shutil") and attr in _OS_BLOCKING | {
+                "copy", "copytree", "rmtree", "move"
+            }:
+                kind, detail = "io", f"{base_name}.{attr}()"
+            elif base_name in self.jax_names and attr in (
+                "device_put", "block_until_ready", "device_get"
+            ):
+                kind, detail = "device", f"{base_name}.{attr}()"
+            elif attr in ("compile", "block_until_ready"):
+                kind, detail = "device", f".{attr}()"
+            elif attr == "result":
+                kind, detail = "future", ".result()"
+            elif attr == "join":
+                tattr = _self_attr(base)
+                if tattr is not None and ci is not None and tattr in ci.thread_attrs:
+                    kind, detail = "join", f"self.{tattr}.join()"
+            elif attr in ("get", "put"):
+                tattr = _self_attr(base)
+                if tattr is not None and ci is not None and tattr in ci.queue_attrs:
+                    kind, detail = "queue", f"self.{tattr}.{attr}()"
+            elif attr == "wait":
+                tattr = _self_attr(base)
+                if tattr is not None and ci is not None:
+                    if tattr in ci.event_attrs:
+                        kind, detail = "wait", f"self.{tattr}.wait()"
+                    else:
+                        canon = ci.lock_attr(tattr)
+                        if canon is not None:
+                            # Condition.wait RELEASES its own lock — only a
+                            # wait on a *different* lock's condition blocks.
+                            if f"self.{canon}" not in held:
+                                kind, detail = "wait", f"self.{tattr}.wait()"
+        if kind is not None:
+            ff["blocking"].append([kind, call.lineno, detail, list(held)])
+
+    def _classify_sync(self, call: ast.Call, ff: Dict[str, Any]) -> None:
+        func = call.func
+        params = set(ff["params"])
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not call.args:
+                ff["sync_sites"].append(["item", call.lineno, ".item()"])
+            elif func.attr == "block_until_ready" and not call.args:
+                ff["sync_sites"].append(
+                    ["block", call.lineno, ".block_until_ready()"]
+                )
+            elif (
+                isinstance(func.value, ast.Name)
+                and func.value.id in self.jax_names
+                and func.attr in ("block_until_ready", "device_get")
+            ):
+                ff["sync_sites"].append(
+                    ["block", call.lineno, f"jax.{func.attr}()"]
+                )
+            elif (
+                isinstance(func.value, ast.Name)
+                and func.value.id in self.np_names
+                and func.attr in ("asarray", "array")
+                and call.args
+                and isinstance(call.args[0], ast.Name)
+                and call.args[0].id in params
+            ):
+                ff["sync_sites"].append(
+                    [
+                        "asarray",
+                        call.lineno,
+                        f"np.{func.attr}({call.args[0].id})",
+                    ]
+                )
+        elif isinstance(func, ast.Name):
+            if (
+                func.id in ("float", "int", "bool")
+                and len(call.args) == 1
+                and isinstance(call.args[0], ast.Name)
+                and call.args[0].id in params
+            ):
+                ff["sync_sites"].append(
+                    ["scalar", call.lineno, f"{func.id}({call.args[0].id})"]
+                )
+
+    # -- post passes -----------------------------------------------------------
+    def _second_pass_jitted(self) -> None:
+        """Mark defs passed by name to a ``jit(...)`` call as jitted, record
+        kernel-spec name references, and KernelSpec constructions."""
+        for qual, ff in self.facts["functions"].items():
+            if ff["name"] in self.facts["jit_passed"] and ff["parent"] is None:
+                ff["is_jitted"] = True
+                if self.facts["jit_passed"][ff["name"]]["static"]:
+                    ff["has_static"] = True
+        # kernel-spec reference bookkeeping needs node identity, so it runs on
+        # the AST directly (cheap: only modules importing ops.kernels).
+        kimports = self.facts["kernels"]["imports"]
+        spec_defs = [
+            node
+            for node in ast.walk(self.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "kernel_spec"
+        ]
+        spec_nodes: Set[int] = set()
+        spec_records = []
+        for fn in spec_defs:
+            inside_nodes = set(map(id, ast.walk(fn)))
+            spec_nodes |= inside_nodes
+            inside_bases: Set[str] = set()
+            inside_names: Set[str] = set()
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Name) and n.id in kimports:
+                    inside_bases.add(kimports[n.id])
+                    inside_names.add(n.id)
+            spec_records.append(
+                {
+                    "line": fn.lineno,
+                    "trivial": _spec_trivial(fn),
+                    "inside": sorted(inside_bases),
+                    "names": sorted(inside_names),
+                    "_nodes": inside_nodes,
+                }
+            )
+        if kimports:
+            outside: Set[str] = set(self.facts["kernels"]["outside"])
+            for n in ast.walk(self.tree):
+                if (
+                    isinstance(n, ast.Name)
+                    and n.id in kimports
+                    and id(n) not in spec_nodes
+                ):
+                    outside.add(kimports[n.id])
+            self.facts["kernels"]["outside"] = sorted(outside)
+        for rec in spec_records:
+            rec.pop("_nodes", None)
+        self.facts["kernels"]["specs"] = spec_records
+        # KernelSpec(...) constructions, paired with the enclosing spec def's
+        # kernel references (elementwise-claim facts).
+        for fn in spec_defs:
+            for n in ast.walk(fn):
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == "KernelSpec"
+                ):
+                    ew = False
+                    for kw in n.keywords:
+                        if kw.arg == "elementwise":
+                            ew = bool(
+                                isinstance(kw.value, ast.Constant) and kw.value.value
+                            )
+                    names = sorted(
+                        {
+                            x.id
+                            for x in ast.walk(fn)
+                            if isinstance(x, ast.Name) and x.id in kimports
+                        }
+                    )
+                    self.facts["kspec_ctors"].append(
+                        {"line": n.lineno, "elementwise": ew, "kernel_names": names}
+                    )
+
+
+def _spec_trivial(fn: ast.AST) -> bool:
+    """Declaration-only kernel_spec: every return is bare / ``return None``."""
+    returns = [n for n in ast.walk(fn) if isinstance(n, ast.Return)]
+    return all(
+        r.value is None
+        or (isinstance(r.value, ast.Constant) and r.value.value is None)
+        for r in returns
+    )
+
+
+def _trip_point(call: ast.Call) -> Optional[str]:
+    func = call.func
+    is_trip = (
+        isinstance(func, ast.Attribute)
+        and func.attr == "trip"
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "faults"
+    ) or (isinstance(func, ast.Name) and func.id == "trip")
+    if is_trip and call.args and isinstance(call.args[0], ast.Constant):
+        if isinstance(call.args[0].value, str):
+            return call.args[0].value
+    return None
+
+
+def _reduction_prim(call: ast.Call) -> Optional[str]:
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in REDUCTION_PRIMS:
+        return func.attr
+    if isinstance(func, ast.Name) and func.id in REDUCTION_PRIMS:
+        return func.id
+    return None
+
+
+def _call_ref(func: ast.AST) -> Optional[list]:
+    """Serializable syntactic call reference, resolved by :class:`ProjectIndex`."""
+    if isinstance(func, ast.Name):
+        return ["n", func.id]
+    if isinstance(func, ast.Attribute):
+        v = func.value
+        if isinstance(v, ast.Name):
+            if v.id == "self":
+                return ["self", func.attr]
+            return ["attr", v.id, func.attr]
+        inner_attr = _self_attr(v)
+        if inner_attr is not None:
+            return ["selfattr", inner_attr, func.attr]
+        if isinstance(v, ast.Call):
+            inner = _call_ref(v.func)
+            if inner is not None:
+                return ["resultm", inner, func.attr]
+    return None
+
+
+def extract_facts(rel: str, module: str, source: str, tree: Optional[ast.AST]) -> Dict[str, Any]:
+    """Per-file facts for the index. ``tree`` is the parsed AST or ``None``
+    (the caller records the parse error separately via ``parse_error``)."""
+    if tree is None:
+        return _empty_facts(rel, module)
+    return _Extractor(rel, module, source, tree).run()
+
+
+# ---------------------------------------------------------------------------
+# ProjectIndex: global resolution over per-file facts
+# ---------------------------------------------------------------------------
+
+
+class ProjectIndex:
+    """Resolved whole-program view over per-file facts. Node ids are
+    ``"<module>:<qual>"`` (qual ``"f"``, ``"Cls.m"``, ``"Cls.m.<locals>.g"``)."""
+
+    def __init__(self, facts_by_rel: Dict[str, Dict[str, Any]]):
+        self.files = facts_by_rel
+        self.by_module: Dict[str, Dict[str, Any]] = {
+            f["module"]: f for f in facts_by_rel.values()
+        }
+        #: class simple name -> [(module, class facts dict)]
+        self.class_table: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
+        for f in facts_by_rel.values():
+            for cname, cfacts in f["classes"].items():
+                self.class_table.setdefault(cname, []).append((f["module"], cfacts))
+        #: resolved call graph: node -> [(target node, line)]
+        self.edges: Dict[str, List[Tuple[str, int]]] = {}
+        #: nested defs: node -> [child node]
+        self.children: Dict[str, List[str]] = {}
+        for f in facts_by_rel.values():
+            module = f["module"]
+            for qual, ff in f["functions"].items():
+                node = f"{module}:{qual}"
+                if ff["parent"]:
+                    self.children.setdefault(f"{module}:{ff['parent']}", []).append(node)
+                out: List[Tuple[str, int]] = []
+                for ref, line, _held in ff["calls"]:
+                    tgt = self.resolve_ref(module, ff["cls"], qual, ref)
+                    if tgt is not None:
+                        out.append((tgt, line))
+                if out:
+                    self.edges[node] = out
+
+    # -- lookups ---------------------------------------------------------------
+    def function(self, node: str) -> Optional[Dict[str, Any]]:
+        module, _, qual = node.partition(":")
+        f = self.by_module.get(module)
+        return f["functions"].get(qual) if f else None
+
+    def iter_functions(self, prefix: str = "") -> Iterable[Tuple[Dict[str, Any], str, Dict[str, Any]]]:
+        """Yield (file facts, node id, function facts), optionally filtered by
+        repo-relative path prefix."""
+        for rel in sorted(self.files):
+            f = self.files[rel]
+            if prefix and not rel.startswith(prefix):
+                continue
+            for qual in sorted(f["functions"]):
+                yield f, f"{f['module']}:{qual}", f["functions"][qual]
+
+    def marks(self, node: str) -> List[str]:
+        ff = self.function(node)
+        return ff["marks"] if ff else []
+
+    def resolve_class(self, name: str, prefer_module: Optional[str] = None) -> Optional[Tuple[str, Dict[str, Any]]]:
+        entries = self.class_table.get(name)
+        if not entries:
+            return None
+        if prefer_module is not None:
+            for module, cfacts in entries:
+                if module == prefer_module:
+                    return module, cfacts
+        return entries[0]
+
+    def _method_node(self, cls_name: str, method: str, prefer_module: Optional[str]) -> Optional[str]:
+        hit = self.resolve_class(cls_name, prefer_module)
+        if hit is None:
+            return None
+        module, _cfacts = hit
+        f = self.by_module.get(module)
+        if f and f"{cls_name}.{method}" in f["functions"]:
+            return f"{module}:{cls_name}.{method}"
+        return None
+
+    def _follow_binding(self, module: str, name: str, depth: int = 0):
+        """Resolve an imported name to ('fn'|'class'|'singleton', module, name)."""
+        if depth > 3:
+            return None
+        f = self.by_module.get(module)
+        if f is None:
+            return None
+        if name in f["functions"] and f["functions"][name]["parent"] is None and f["functions"][name]["cls"] is None:
+            return ("fn", module, name)
+        if name in f["classes"]:
+            return ("class", module, name)
+        if name in f["singletons"]:
+            return ("singleton", module, f["singletons"][name])
+        if name in f["bindings"]:
+            src, orig = f["bindings"][name]
+            return self._follow_binding(src, orig, depth + 1)
+        return None
+
+    def resolve_ref(
+        self, module: str, cls: Optional[str], qual: str, ref: list
+    ) -> Optional[str]:
+        f = self.by_module.get(module)
+        if f is None or not ref:
+            return None
+        kind = ref[0]
+        if kind == "self" and cls is not None:
+            if f"{cls}.{ref[1]}" in f["functions"]:
+                return f"{module}:{cls}.{ref[1]}"
+            return None
+        if kind == "n":
+            name = ref[1]
+            # lexically scoped nested defs: own children, then enclosing chain
+            scope = qual
+            while scope:
+                cand = f"{scope}.<locals>.{name}"
+                if cand in f["functions"]:
+                    return f"{module}:{cand}"
+                ff = f["functions"].get(scope)
+                scope = ff["parent"] if ff else None
+            if name in f["functions"] and f["functions"][name]["cls"] is None and f["functions"][name]["parent"] is None:
+                return f"{module}:{name}"
+            if name in f["classes"]:
+                return self._method_node(name, "__init__", module)
+            if name in f["singletons"]:
+                return None
+            if name in f["bindings"]:
+                hit = self._follow_binding(*f["bindings"][name])
+                if hit is None:
+                    return None
+                hkind, hmod, hname = hit
+                if hkind == "fn":
+                    return f"{hmod}:{hname}"
+                if hkind == "class":
+                    return self._method_node(hname, "__init__", hmod)
+            return None
+        if kind == "selfattr" and cls is not None:
+            cfacts = f["classes"].get(cls)
+            if not cfacts:
+                return None
+            tname = cfacts["attr_types"].get(ref[1])
+            if tname:
+                return self._method_node(tname, ref[2], module)
+            return None
+        if kind == "attr":
+            obj, method = ref[1], ref[2]
+            if obj in f["singletons"]:
+                return self._method_node(f["singletons"][obj], method, module)
+            if obj in f["bindings"]:
+                hit = self._follow_binding(*f["bindings"][obj])
+                if hit is not None:
+                    hkind, hmod, hname = hit
+                    if hkind in ("singleton", "class"):
+                        return self._method_node(hname, method, hmod)
+                    return None
+            if obj in f["module_aliases"]:
+                target = f["module_aliases"][obj]
+                tf = self.by_module.get(target)
+                if tf and method in tf["functions"]:
+                    return f"{target}:{method}"
+            return None
+        if kind == "resultm":
+            inner = self.resolve_ref(module, cls, qual, ref[1])
+            if inner is None:
+                return None
+            iff = self.function(inner)
+            if iff is None or not iff["returns_class"]:
+                return None
+            imod = inner.partition(":")[0]
+            return self._method_node(iff["returns_class"], ref[2], imod)
+        return None
+
+    # -- traversals ------------------------------------------------------------
+    def reachable(
+        self,
+        roots: Sequence[str],
+        *,
+        stop_marks: Sequence[str] = ("readback", "cold"),
+        include_nested: bool = True,
+    ) -> Dict[str, str]:
+        """BFS over the call graph from ``roots``. Returns
+        ``{node: root it was first reached from}``. Traversal does not enter
+        functions carrying a stop mark (the annotated sync/cold boundaries)."""
+        stop = set(stop_marks)
+        out: Dict[str, str] = {}
+        work: List[Tuple[str, str]] = [(r, r) for r in roots]
+        while work:
+            node, root = work.pop()
+            if node in out:
+                continue
+            if set(self.marks(node)) & stop and node != root:
+                continue
+            out[node] = root
+            for tgt, _line in self.edges.get(node, []):
+                if tgt not in out:
+                    work.append((tgt, root))
+            if include_nested:
+                for child in self.children.get(node, []):
+                    if child not in out:
+                        work.append((child, root))
+        return out
+
+    def transitive_closure(self, direct: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+        """Fixpoint of ``direct`` propagated backwards over call edges: the
+        result maps each node to ``direct`` facts reachable through any call
+        chain starting at it (lock acquisition, blocking ops, ...)."""
+        trans: Dict[str, Set[str]] = {k: set(v) for k, v in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for node, outs in self.edges.items():
+                mine = trans.setdefault(node, set())
+                before = len(mine)
+                for tgt, _line in outs:
+                    mine |= trans.get(tgt, set())
+                if len(mine) != before:
+                    changed = True
+        return trans

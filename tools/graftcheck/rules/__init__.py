@@ -3,10 +3,14 @@
 import line below. See docs/static_analysis.md for the authoring walkthrough.
 """
 from tools.graftcheck.rules import (  # noqa: F401  (imported for registration)
+    blocking_under_lock,
+    elementwise_claim,
     error_hygiene,
     fault_points,
+    host_sync,
     jit_purity,
     kernel_spec_consistency,
     layer_deps,
     lock_order,
+    recompile_hazard,
 )

@@ -1,0 +1,135 @@
+"""blocking-under-lock: no blocking work inside serving-tier lock regions.
+
+The serving tier's locks guard tiny state transitions (queue membership, the
+``(version, servable)`` tuple, metric dicts) and sit directly on the request
+path: ``submit`` takes the batcher lock per request, every metric bump takes
+the registry lock. Anything *blocking* done while holding one — a sleep, file
+I/O, an XLA ``.compile()``, a ``device_put`` upload, a thread join, a
+blocking queue/future wait — turns every concurrent request into a convoy
+behind it (and a multi-second XLA compile under a lock is a p99 cliff, the
+swap-off-the-serving-path discipline PR 2/4 exist to prevent).
+
+The rule composes with lock-order's machinery on the shared index: lock
+regions come from the same per-file facts (``with self._lock:`` nesting with
+``Condition`` aliasing), and blocking reach is transitive over the resolved
+call graph — a helper that sleeps three calls down still flags at the call
+site made while the lock is held.
+
+Blocking operations (extracted per file by the index):
+
+- ``time.sleep`` (module alias and from-import aware)
+- file I/O: ``open``, blocking ``os.*`` / ``shutil.*`` calls
+- device/compile work: ``.compile()``, ``jax.device_put``,
+  ``block_until_ready``, ``jax.device_get``
+- blocking waits: ``.join()`` on ``threading.Thread`` attributes, ``.get()``
+  / ``.put()`` on ``queue.Queue`` attributes, ``.wait()`` on
+  ``threading.Event`` attributes, ``.result()`` on futures/handles
+
+``Condition.wait`` on the condition of the *held* lock is exempt — it
+releases that lock while waiting (the batcher's coalescing window); a wait
+against any *other* lock's condition still flags.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from tools.graftcheck.engine import Finding, Project, Rule, register
+from tools.graftcheck.rules.lock_order import SCOPE as LOCK_SCOPE, _lock_id
+
+#: Lock regions policed here: the serving tier (lock-order's scope) plus the
+#: two fast-path modules whose plans execute next to serving locks.
+SCOPE = LOCK_SCOPE + (
+    "flink_ml_tpu/servable/planner.py",
+    "flink_ml_tpu/builder/batch_plan.py",
+)
+
+_KIND_LABEL = {
+    "sleep": "sleeps",
+    "io": "does file I/O",
+    "device": "does device/compile work",
+    "queue": "blocks on a queue",
+    "join": "joins a thread",
+    "wait": "waits on an event/condition",
+    "future": "blocks on a future result",
+}
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    name = "blocking-under-lock"
+    severity = "error"
+    description = (
+        "no blocking work (sleep, file I/O, XLA compile/device_put, queue/"
+        "thread/future waits) inside serving-tier lock regions, directly or "
+        "through any resolved call chain"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        index = project.index
+        in_scope = [
+            rel
+            for rel in sorted(index.files)
+            if any(rel.startswith(p) for p in SCOPE)
+        ]
+
+        # Transitive "this callee may block" facts over the whole call graph
+        # (direct facts from every file — the finding only fires at a scoped
+        # call site made while a lock is held).
+        direct: Dict[str, Set[str]] = {}
+        for rel, f in index.files.items():
+            module = f["module"]
+            for qual, ff in f["functions"].items():
+                kinds = {
+                    f"{kind}:{detail}" for kind, _line, detail, _held in ff["blocking"]
+                }
+                if kinds:
+                    direct[f"{module}:{qual}"] = kinds
+        trans = index.transitive_closure(direct)
+
+        findings: List[Finding] = []
+        for rel in in_scope:
+            f = index.files[rel]
+            module = f["module"]
+            for qual in sorted(f["functions"]):
+                ff = f["functions"][qual]
+                where = f"{module}.{qual}"
+                for kind, line, detail, held in ff["blocking"]:
+                    if not held:
+                        continue
+                    lock = _lock_id(module, ff["cls"], held[-1])
+                    findings.append(
+                        self.finding(
+                            rel,
+                            line,
+                            f"{where} {_KIND_LABEL[kind]} ({detail}) while "
+                            f"holding {lock} — blocking work under a serving "
+                            "lock convoys every concurrent request; move it "
+                            "outside the lock region",
+                        )
+                    )
+                seen: Set[tuple] = set()
+                for ref, line, held in ff["calls"]:
+                    if not held:
+                        continue
+                    callee = index.resolve_ref(module, ff["cls"], qual, ref)
+                    if callee is None:
+                        continue
+                    kinds = trans.get(callee, set())
+                    if not kinds:
+                        continue
+                    lock = _lock_id(module, ff["cls"], held[-1])
+                    if (callee, lock) in seen:
+                        continue
+                    seen.add((callee, lock))
+                    ops = ", ".join(sorted(k.split(":", 1)[1] for k in kinds))
+                    findings.append(
+                        self.finding(
+                            rel,
+                            line,
+                            f"{where} calls {callee.replace(':', '.')} while "
+                            f"holding {lock}, which reaches blocking work "
+                            f"({ops}) — hoist the blocking call out of the "
+                            "lock region",
+                        )
+                    )
+        return findings

@@ -1,0 +1,99 @@
+"""elementwise-claim: an ``elementwise=True`` kernel must be reduction-free.
+
+The planners (``servable/planner.py``) merge consecutive
+``KernelSpec(elementwise=True)`` stages into single XLA programs — the PR 5
+fast-path win — and the merge is bit-exact **only because** a reduction-free
+graph has no accumulation order for XLA to reorder (the SystemML fusion-plan
+lesson, PAPERS.md: plan-validity invariants must be checked, not assumed). A
+spec that claims ``elementwise=True`` over a body that actually sums, dots or
+sorts would let the merge move hundreds of ulps, silently, on whichever
+batches happen to fuse.
+
+The claim is statically checkable because of the shared-body convention
+(kernel-spec-consistency): every spec's math comes from ``ops/kernels.py``
+``*_fn`` functions. For each ``KernelSpec(elementwise=True)`` construction,
+the rule resolves the kernels-module functions the enclosing ``kernel_spec``
+references (through the index's import bindings and ``KERNEL_ALIASES``) and
+walks their bodies **and their resolved callees within ops/kernels.py**
+(nested defs included) for cross-element accumulation primitives:
+``sum`` / ``dot`` / ``mean`` / ``einsum`` / ``matmul`` (the ``@`` operator
+included) / ``cumsum`` / ``prod`` / ``sort`` / ``argmax`` / ``norm`` and
+friends (``index.REDUCTION_PRIMS``).
+
+Reduction-bearing kernels are fine — Normalizer's row norm, DCT's matmul and
+the model heads all keep their own programs — they just must not *claim*
+elementwise. Unset is always safe, merely unmerged.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from tools.graftcheck.engine import Finding, Project, Rule, register
+
+KERNELS_REL = "flink_ml_tpu/ops/kernels.py"
+
+
+@register
+class ElementwiseClaimRule(Rule):
+    name = "elementwise-claim"
+    severity = "error"
+    description = (
+        "KernelSpec(elementwise=True) bodies (and their resolved ops/kernels "
+        "callees) must contain no reduction primitives — the program-merge "
+        "bit-exactness contract"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        index = project.index
+        kfacts = index.files.get(KERNELS_REL)
+        if kfacts is None:
+            return []  # fixture trees without a kernels module: nothing to check
+        kmodule = kfacts["module"]
+
+        # Reductions per kernels-module function, nested defs folded into
+        # their parent, then the transitive closure over resolved calls.
+        direct: Dict[str, Set[str]] = {}
+        for qual, ff in kfacts["functions"].items():
+            owner = qual.split(".<locals>.")[0]
+            node = f"{kmodule}:{owner}"
+            for prim, line in ff["reductions"]:
+                direct.setdefault(node, set()).add(f"{prim}@{line}")
+        trans = index.transitive_closure(direct)
+
+        def reductions_of(fn_name: str) -> List[Tuple[str, int]]:
+            hits = trans.get(f"{kmodule}:{fn_name}", set())
+            out = []
+            for h in sorted(hits):
+                prim, _, line = h.partition("@")
+                out.append((prim, int(line)))
+            return out
+
+        findings: List[Finding] = []
+        for rel in sorted(index.files):
+            f = index.files[rel]
+            if not rel.startswith("flink_ml_tpu/"):
+                continue
+            for ctor in f.get("kspec_ctors", []):
+                if not ctor["elementwise"]:
+                    continue
+                for bound in ctor["kernel_names"]:
+                    binding = f["bindings"].get(bound)
+                    if binding is None:
+                        continue
+                    src, orig = binding
+                    if src != kmodule or orig not in kfacts["functions"]:
+                        continue
+                    for prim, line in reductions_of(orig):
+                        findings.append(
+                            self.finding(
+                                rel,
+                                ctor["line"],
+                                f"KernelSpec(elementwise=True) composes "
+                                f"`{orig}` which performs the reduction "
+                                f"`{prim}` ({KERNELS_REL}:{line}) — merging "
+                                "it would reorder FP accumulation across the "
+                                "program boundary; drop elementwise=True or "
+                                "split the reduction into its own spec",
+                            )
+                        )
+        return findings

@@ -52,44 +52,47 @@ def _is_silent(body: List[ast.stmt]) -> bool:
 class ErrorHygieneRule(Rule):
     name = "error-hygiene"
     severity = "error"
+    granularity = "file"
+    cache_version = 2  # v2: file-granularity (findings cached per content hash)
     description = (
         "no bare `except:`; no `except Exception: pass` outside finalizers — "
         "catch narrowly or handle (log/count/re-raise)"
     )
 
-    def run(self, project: Project) -> List[Finding]:
+    def check_file(self, project: Project, sf: SourceFile) -> List[Finding]:
         findings: List[Finding] = []
-        for sf in project.files:
-            func_stack: List[str] = []
+        if sf.tree is None:
+            return findings  # parse error reported by the engine
+        func_stack: List[str] = []
 
-            def visit(node: ast.AST) -> None:
-                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    func_stack.append(node.name)
-                    for child in ast.iter_child_nodes(node):
-                        visit(child)
-                    func_stack.pop()
-                    return
-                if isinstance(node, ast.ExceptHandler) and "__del__" not in func_stack:
-                    if node.type is None:
-                        findings.append(
-                            self.finding(
-                                sf.rel,
-                                node.lineno,
-                                "bare `except:` catches KeyboardInterrupt/SystemExit "
-                                "— name the exception(s)",
-                            )
-                        )
-                    elif _is_broad(node.type) and _is_silent(node.body):
-                        findings.append(
-                            self.finding(
-                                sf.rel,
-                                node.lineno,
-                                "`except Exception: pass` silently swallows every "
-                                "error — catch narrowly, or log/count the failure",
-                            )
-                        )
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_stack.append(node.name)
                 for child in ast.iter_child_nodes(node):
                     visit(child)
+                func_stack.pop()
+                return
+            if isinstance(node, ast.ExceptHandler) and "__del__" not in func_stack:
+                if node.type is None:
+                    findings.append(
+                        self.finding(
+                            sf.rel,
+                            node.lineno,
+                            "bare `except:` catches KeyboardInterrupt/SystemExit "
+                            "— name the exception(s)",
+                        )
+                    )
+                elif _is_broad(node.type) and _is_silent(node.body):
+                    findings.append(
+                        self.finding(
+                            sf.rel,
+                            node.lineno,
+                            "`except Exception: pass` silently swallows every "
+                            "error — catch narrowly, or log/count the failure",
+                        )
+                    )
+            for child in ast.iter_child_nodes(node):
+                visit(child)
 
-            visit(sf.tree)
+        visit(sf.tree)
         return findings

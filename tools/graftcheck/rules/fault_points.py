@@ -52,21 +52,21 @@ def _trip_name(node: ast.Call) -> str | None:
 
 
 def analyze(project: Project) -> Tuple[List[Tuple[str, str, int]], Dict[str, List[str]], set]:
-    """(problems, trip_sites, tested). Problems are (message, rel, line)."""
+    """(problems, trip_sites, tested). Problems are (message, rel, line).
+    Trip sites come from the shared index facts (``facts["trip_sites"]``), so
+    a cache-warm run discovers them without re-parsing a single file."""
     fault_points = _load_fault_points(project.repo_root)
     faults_sf = project.file(FAULTS_MODULE_REL)
 
     trip_sites: Dict[str, List[str]] = {}
     site_lines: Dict[str, Tuple[str, int]] = {}
+    all_facts = project.facts()
     for sf in project.iter_files("flink_ml_tpu/"):
         if sf.rel == FAULTS_MODULE_REL:
             continue  # the framework itself (docstrings mention trip("<name>"))
-        for node in ast.walk(sf.tree):
-            if isinstance(node, ast.Call):
-                point = _trip_name(node)
-                if point is not None:
-                    trip_sites.setdefault(point, []).append(sf.rel)
-                    site_lines.setdefault(point, (sf.rel, node.lineno))
+        for point, lineno in all_facts.get(sf.rel, {}).get("trip_sites", []):
+            trip_sites.setdefault(point, []).append(sf.rel)
+            site_lines.setdefault(point, (sf.rel, lineno))
 
     tested = set()
     test_root = os.path.join(project.repo_root, "tests")

@@ -1,0 +1,115 @@
+"""host-sync: no implicit device→host syncs reachable from hot-path roots.
+
+jit-purity polices host syncs *inside* jitted functions, but the serving
+dispatch loop and the batch chunk loop are hot regions **outside** jit: a
+``.item()`` or an eager ``np.asarray`` three calls below
+``MicroBatcher._loop`` stalls the host on device work once per batch —
+exactly the goodput leak the fast paths' deferred-readback design exists to
+avoid (one blocking readback per batch, at the designated point, after the
+next batch has been dispatched). "ML Productivity Goodput" (PAPERS.md)
+attributes a large slice of fleet waste to precisely these host stalls.
+
+The rule generalizes jit-purity to hot regions via the shared index's call
+graph and the **annotated-hot-root convention** (docs/static_analysis.md):
+
+- functions marked ``# graftcheck: hot-root`` (the serving dispatch loop in
+  ``serving/``, the batch chunk loop in ``builder/batch_plan.py``, the shared
+  chain executor in ``servable/planner.py``) are traversal roots;
+- everything reachable from a root through resolved calls — nested defs
+  included — is the hot region;
+- functions marked ``# graftcheck: readback`` are the designated sync
+  boundaries (each plan has exactly one blocking readback); traversal stops
+  there and their bodies are exempt;
+- functions marked ``# graftcheck: cold`` are build/warmup-time code lazily
+  reachable from a hot root (counted by its own metric when taken); excluded.
+
+Flagged inside the hot region:
+
+- ``<x>.item()``                    — device→host sync per call
+- ``<x>.block_until_ready()`` / ``jax.block_until_ready`` / ``jax.device_get``
+                                    — explicit host stall
+- ``np.asarray(p)`` / ``np.array(p)`` on a direct function parameter
+                                    — eager host materialization
+- ``float(p)`` / ``int(p)`` / ``bool(p)`` on a direct function parameter
+                                    — host concretization
+
+As with jit-purity the numpy/float checks fire on direct parameters only
+(numpy on values that are already host-resident is legal and common) — false
+negatives are acceptable, false positives are not. For the same reason the
+parameter-based checks (``np.asarray`` / ``float``) only report inside the
+device-adjacent tiers (``serving/``, ``servable/``, ``builder/``, ``ops/``)
+where a parameter plausibly holds a device array; ``.item()`` and
+``block_until_ready`` are unambiguous syncs and report anywhere a hot root
+reaches (the host-side ``api``/``metrics`` layers take parameters that are
+plain host values by contract).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from tools.graftcheck.engine import Finding, Project, Rule, register
+
+#: Where the parameter-heuristic kinds (asarray/scalar) are trusted.
+DEVICE_TIER_PREFIXES = (
+    "flink_ml_tpu/serving/",
+    "flink_ml_tpu/servable/",
+    "flink_ml_tpu/builder/",
+    "flink_ml_tpu/ops/",
+)
+
+_KIND_MESSAGES = {
+    "item": "forces a device->host sync on every call",
+    "block": "stalls the host on device work",
+    "asarray": "eagerly materializes a traced/device value on the host",
+    "scalar": "concretizes a value on the host",
+}
+
+_PARAM_KINDS = {"asarray", "scalar"}
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync"
+    severity = "error"
+    description = (
+        "no device->host syncs (.item(), block_until_ready, np.asarray/float "
+        "on parameters) reachable from `# graftcheck: hot-root` functions, "
+        "outside the designated `# graftcheck: readback` boundaries"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        index = project.index
+        roots = [
+            node
+            for _facts, node, ff in index.iter_functions()
+            if "hot-root" in ff["marks"]
+        ]
+        if not roots:
+            return []
+        reach = index.reachable(roots)
+        findings: List[Finding] = []
+        rel_of = {f["module"]: rel for rel, f in index.files.items()}
+        for node in sorted(reach):
+            ff = index.function(node)
+            if ff is None or not ff["sync_sites"]:
+                continue
+            module = node.partition(":")[0]
+            rel = rel_of.get(module)
+            if rel is None:
+                continue
+            root_display = reach[node].replace(":", ".")
+            in_device_tier = any(rel.startswith(p) for p in DEVICE_TIER_PREFIXES)
+            for kind, line, detail in ff["sync_sites"]:
+                if kind in _PARAM_KINDS and not in_device_tier:
+                    continue
+                findings.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"hot region (reachable from hot-root {root_display}): "
+                        f"{detail} {_KIND_MESSAGES[kind]} — defer it to the "
+                        "designated `# graftcheck: readback` boundary or move "
+                        "it off the hot path",
+                    )
+                )
+        return findings

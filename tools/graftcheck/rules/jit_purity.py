@@ -135,19 +135,22 @@ def _param_names(fn: ast.AST) -> Set[str]:
 class JitPurityRule(Rule):
     name = "jit-purity"
     severity = "error"
+    granularity = "file"
+    cache_version = 2  # v2: file-granularity (findings cached per content hash)
     description = (
         "no host syncs (.item(), float(tracer), np.asarray) or impure host "
         "calls (time.time, np.random, print) inside jitted functions"
     )
 
-    def run(self, project: Project) -> List[Finding]:
+    def check_file(self, project: Project, sf: SourceFile) -> List[Finding]:
         findings: List[Finding] = []
-        for sf in project.files:
-            if not any(sf.rel.startswith(p) for p in SCOPE_PREFIXES):
-                continue
-            aliases = _module_aliases(sf.tree)
-            for fn in jitted_functions(sf, aliases["jit"]):
-                findings.extend(self._check_function(sf, fn, aliases))
+        if not any(sf.rel.startswith(p) for p in SCOPE_PREFIXES):
+            return findings
+        if sf.tree is None:
+            return findings  # parse error reported by the engine
+        aliases = _module_aliases(sf.tree)
+        for fn in jitted_functions(sf, aliases["jit"]):
+            findings.extend(self._check_function(sf, fn, aliases))
         return findings
 
     def _check_function(self, sf: SourceFile, fn: ast.AST, aliases) -> List[Finding]:

@@ -8,17 +8,17 @@ factory). A spec that hand-rolls its own jnp math can silently drift from the
 fallback path — results then differ depending on which path a batch happens
 to ride, the exact bug the shared-body pattern exists to prevent.
 
-The check, per module that defines a ``kernel_spec`` method:
+Since graftcheck v2 the per-module analysis comes from the shared index's
+kernel facts (``facts["kernels"]``): the bound → base import map of
+``flink_ml_tpu.ops.kernels`` names (``binarize_fn`` / ``binarize_kernel``
+normalize to one base; ``KERNEL_ALIASES`` pairs the historical fn/factory
+names), the bases referenced inside each ``kernel_spec`` body, and the bases
+referenced outside them (the transform path). The check, per module that
+defines a ``kernel_spec`` method:
 
-1. collect every name imported from ``flink_ml_tpu.ops.kernels`` and
-   normalize it to its kernel *base* — strip a trailing ``_fn`` / ``_kernel``
-   (``binarize_fn`` and ``binarize_kernel`` are one base, the documented
-   pairing), then apply ``KERNEL_ALIASES`` for the historical pairs whose fn
-   and factory names differ (``kmeans_predict_kernel`` jits
-   ``kmeans_assign_fn``);
-2. a ``kernel_spec`` body must reference at least one kernels import — a
-   spec with none is doing its own math;
-3. every base a ``kernel_spec`` body references must ALSO be referenced
+1. a non-trivial ``kernel_spec`` body must reference at least one kernels
+   import — a spec with none is doing its own math;
+2. every base a ``kernel_spec`` body references must ALSO be referenced
    outside ``kernel_spec`` bodies in the same module (the transform path) —
    otherwise the fused path runs a body the per-stage path never does.
 
@@ -28,34 +28,15 @@ one module, so a spec built from helpers in another module is not followed.
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Set
+from typing import Dict, List
 
 from tools.graftcheck.engine import Finding, Project, Rule, SourceFile, register
-
-KERNELS_MODULE = "flink_ml_tpu.ops.kernels"
-
-#: fn-name base -> factory-name base for pairs that predate the *_fn/*_kernel
-#: naming convention (the factory jits exactly that fn body).
-KERNEL_ALIASES = {
-    "kmeans_predict": "kmeans_assign",
-    "logistic_predict": "logistic_from_dots",
-    "dct_basis": "dct",  # the basis builder is part of the dct body pairing
-}
-
-
-def kernel_base(name: str) -> str:
-    """Normalize an ops/kernels.py symbol to its body base."""
-    for suffix in ("_kernel", "_fn"):
-        if name.endswith(suffix):
-            name = name[: -len(suffix)]
-            break
-    return KERNEL_ALIASES.get(name, name)
+from tools.graftcheck.index import KERNEL_ALIASES, KERNELS_MODULE, kernel_base  # noqa: F401  (re-export: the historical home of these names)
 
 
 def kernels_imports(tree: ast.AST) -> Dict[str, str]:
     """local bound name -> kernel base, for ``from flink_ml_tpu.ops.kernels
-    import X [as Y]`` (and ``import flink_ml_tpu.ops.kernels as K`` attribute
-    access is NOT tracked — the tree uses from-imports)."""
+    import X [as Y]`` — retained for shims/tests that analyze a lone AST."""
     out: Dict[str, str] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.ImportFrom) and node.module == KERNELS_MODULE:
@@ -64,78 +45,48 @@ def kernels_imports(tree: ast.AST) -> Dict[str, str]:
     return out
 
 
-def _referenced_bases(node: ast.AST, bound: Dict[str, str]) -> Set[str]:
-    return {
-        bound[n.id]
-        for n in ast.walk(node)
-        if isinstance(n, ast.Name) and n.id in bound
-    }
-
-
-def _is_trivial(fn: ast.AST) -> bool:
-    """A declaration-only kernel_spec: every return is a bare ``return`` /
-    ``return None`` (the TransformerServable default hook, or an
-    ineligible-params early-out-only stub). Such a def promises no fused
-    math, so there is nothing to cross-check."""
-    returns = [n for n in ast.walk(fn) if isinstance(n, ast.Return)]
-    return all(
-        r.value is None
-        or (isinstance(r.value, ast.Constant) and r.value.value is None)
-        for r in returns
-    )
-
-
 @register
 class KernelSpecConsistencyRule(Rule):
     name = "kernel-spec-consistency"
     severity = "error"
+    granularity = "file"
+    cache_version = 2  # v2: migrated onto the shared index facts
     description = (
         "a kernel_spec must compose the same ops/kernels.py *_fn body its "
         "per-stage transform jits — no drift between fused and fallback math"
     )
 
-    def run(self, project: Project) -> List[Finding]:
+    def check_file(self, project: Project, sf: SourceFile) -> List[Finding]:
+        if not sf.rel.startswith("flink_ml_tpu/"):
+            return []
+        facts = project.facts().get(sf.rel)
+        if not facts:
+            return []
+        kf = facts["kernels"]
         findings: List[Finding] = []
-        for sf in project.iter_files("flink_ml_tpu/"):
-            spec_defs = [
-                node
-                for node in ast.walk(sf.tree)
-                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and node.name == "kernel_spec"
-            ]
-            if not spec_defs:
+        outside = set(kf["outside"])
+        for spec in kf["specs"]:
+            if spec["trivial"]:
                 continue
-            bound = kernels_imports(sf.tree)
-            spec_nodes = set()
-            for fn in spec_defs:
-                spec_nodes.update(ast.walk(fn))
-            outside: Set[str] = {
-                bound[n.id]
-                for n in ast.walk(sf.tree)
-                if isinstance(n, ast.Name) and n.id in bound and n not in spec_nodes
-            }
-            for fn in spec_defs:
-                if _is_trivial(fn):
-                    continue
-                inside = _referenced_bases(fn, bound)
-                if not inside:
-                    findings.append(
-                        self.finding(
-                            sf.rel,
-                            fn.lineno,
-                            "kernel_spec references no ops/kernels.py body — "
-                            "fused math must come from the shared *_fn bodies",
-                        )
+            inside = set(spec["inside"])
+            if not inside:
+                findings.append(
+                    self.finding(
+                        sf.rel,
+                        spec["line"],
+                        "kernel_spec references no ops/kernels.py body — "
+                        "fused math must come from the shared *_fn bodies",
                     )
-                    continue
-                for base in sorted(inside - outside):
-                    findings.append(
-                        self.finding(
-                            sf.rel,
-                            fn.lineno,
-                            f"kernel_spec composes {base!r} but the per-stage "
-                            "transform path in this module never references "
-                            f"a {base!r} kernel — fused and fallback math drift",
-                        )
+                )
+                continue
+            for base in sorted(inside - outside):
+                findings.append(
+                    self.finding(
+                        sf.rel,
+                        spec["line"],
+                        f"kernel_spec composes {base!r} but the per-stage "
+                        "transform path in this module never references "
+                        f"a {base!r} kernel — fused and fallback math drift",
                     )
+                )
         return findings

@@ -134,17 +134,22 @@ def _subpath(module: str) -> Optional[str]:
 class LayerDepsRule(Rule):
     name = "layer-deps"
     severity = "error"
+    granularity = "file"
+    cache_version = 2  # v2: reads the shared index's import facts
     description = (
         "imports within flink_ml_tpu must not point at a higher layer "
         "(foundation < compute/servable < runtime < library)"
     )
 
-    def run(self, project: Project) -> List[Finding]:
+    def check_file(self, project: Project, sf: SourceFile) -> List[Finding]:
         findings: List[Finding] = []
-        for sf in project.iter_files(ROOT_PACKAGE + "/"):
-            src_sub = _subpath(sf.module)
-            if src_sub is None:
-                continue
+        if not sf.rel.startswith(ROOT_PACKAGE + "/"):
+            return findings
+        facts = project.facts().get(sf.rel)
+        if facts is None:
+            return findings
+        src_sub = _subpath(sf.module)
+        if src_sub is not None:
             src_layer = layer_of(src_sub)
             if src_layer is None:
                 findings.append(
@@ -155,9 +160,9 @@ class LayerDepsRule(Rule):
                         "top-level package to PACKAGE_LAYERS",
                     )
                 )
-                continue
+                return findings
             seen = set()
-            for lineno, module in iter_imports(sf):
+            for lineno, module in facts["imports"]:
                 dst_sub = _subpath(module)
                 if dst_sub is None:
                     continue  # stdlib / third-party

@@ -7,65 +7,35 @@ holding" relation is a deadlock waiting for the right interleaving, and no
 test reliably catches it: this rule derives the graph statically and fails on
 any cycle (including self-loops — ``threading.Lock`` is non-reentrant).
 
-How the graph is built (scope: ``flink_ml_tpu/serving/`` + ``metrics.py``):
+Since graftcheck v2 the rule is a thin composition over the **shared project
+index** (``tools/graftcheck/index.py``): lock nodes, ``with``-nesting edges
+and calls-made-while-holding all come from the per-file facts the index
+extracts once for every rule, and callee resolution (``self.method``, typed
+attributes, module singletons like ``metrics``, imported functions,
+constructors) is the index's call graph. The graph composition is:
 
 1. **Lock nodes** — every ``self.X = threading.Lock()`` / ``RLock()`` /
-   ``Condition()`` in a class body becomes node ``<module>.<Class>.X``;
-   ``threading.Condition(self.Y)`` makes ``X`` an *alias* of ``Y`` (entering
-   the condition acquires that lock).
+   ``Condition()`` in a scoped class becomes node ``<module>.<Class>.X``
+   (``threading.Condition(self.Y)`` aliases ``Y``); module-level locks become
+   ``<module>.<NAME>``.
 2. **Direct edges** — ``with self.A:`` lexically nested inside
    ``with self.B:`` adds ``B -> A``.
 3. **Call edges** — a call made while holding ``B`` adds ``B -> L`` for every
-   lock ``L`` the callee may (transitively) acquire. Callees resolve through
-   ``self.method(...)``, ``self.attr.method(...)`` where ``attr`` was
-   constructed (or annotated) as an analyzed class, module-level singletons
-   (``metrics = MetricsRegistry()``), and ``ClassName(...)`` constructors.
+   lock ``L`` the resolved callee may (transitively) acquire.
 
 Known blind spots, chosen to keep the rule sound-for-this-codebase rather
-than universally complete: nested ``def``s are deferred work (analyzed at
-their own call sites, not where defined), property reads are not calls, and
-an unresolvable callee contributes no edge.
+than universally complete: nested ``def``s are analyzed at their own call
+sites (not where defined), property reads are not calls, and an unresolvable
+callee contributes no edge.
 """
 from __future__ import annotations
 
-import ast
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Set, Tuple
 
-from tools.graftcheck.engine import Finding, Project, Rule, SourceFile, register
+from tools.graftcheck.engine import Finding, Project, Rule, register
 
 SCOPE = ("flink_ml_tpu/serving/", "flink_ml_tpu/metrics.py")
-
-_LOCK_CTORS = {"Lock", "RLock"}
-
-
-@dataclass
-class _Method:
-    cls: "_Class"
-    node: ast.FunctionDef
-    acquires: Set[str] = field(default_factory=set)  # canonical lock ids, direct
-    calls: Set[Tuple[str, str]] = field(default_factory=set)  # (class qualname, method)
-    held_calls: Set[Tuple[str, Tuple[str, str]]] = field(default_factory=set)
-    nest_edges: Set[Tuple[str, str, int]] = field(default_factory=set)  # (outer, inner, line)
-    held_call_lines: Dict[Tuple[str, Tuple[str, str]], int] = field(default_factory=dict)
-
-
-@dataclass
-class _Class:
-    qualname: str  # "<module>.<Class>"
-    name: str
-    sf: SourceFile
-    node: ast.ClassDef
-    locks: Dict[str, int] = field(default_factory=dict)  # attr -> def line
-    aliases: Dict[str, str] = field(default_factory=dict)  # attr -> lock attr
-    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> class simple name
-    methods: Dict[str, _Method] = field(default_factory=dict)
-
-    def lock_id(self, attr: str) -> Optional[str]:
-        attr = self.aliases.get(attr, attr)
-        if attr in self.locks:
-            return f"{self.qualname}.{attr}"
-        return None
 
 
 @dataclass
@@ -99,192 +69,84 @@ class LockGraph:
         return out
 
 
-def _ctor_class_name(call: ast.Call) -> Optional[str]:
-    if isinstance(call.func, ast.Name):
-        return call.func.id
-    return None
-
-
-def _self_attr(node: ast.AST) -> Optional[str]:
-    if (
-        isinstance(node, ast.Attribute)
-        and isinstance(node.value, ast.Name)
-        and node.value.id == "self"
-    ):
-        return node.attr
-    return None
-
-
-def _annotation_name(node: Optional[ast.AST]) -> Optional[str]:
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value.strip("\"'")
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    return None
+def _lock_id(module: str, cls, token: str) -> str:
+    """Canonical lock id for a facts token: ``self.<attr>`` on a class lock,
+    ``mod.<NAME>`` on a module-level lock."""
+    if token.startswith("self."):
+        return f"{module}.{cls}.{token[len('self.'):]}"
+    return f"{module}.{token[len('mod.'):]}"
 
 
 def build_lock_graph(project: Project, scope: Sequence[str] = SCOPE) -> LockGraph:
-    files = [sf for sf in project.files if any(sf.rel.startswith(p) for p in scope)]
-
-    # Pass 1: classes, locks/aliases, attribute types, module singletons.
-    classes: Dict[str, _Class] = {}  # simple name -> info (corpus-wide)
-    singletons: Dict[str, str] = {}  # bare name -> class simple name
-    for sf in files:
-        for node in sf.tree.body:
-            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                cname = _ctor_class_name(node.value)
-                if cname:
-                    for tgt in node.targets:
-                        if isinstance(tgt, ast.Name):
-                            singletons[tgt.id] = cname
-        for node in ast.walk(sf.tree):
-            if isinstance(node, ast.ClassDef):
-                classes[node.name] = _Class(
-                    qualname=f"{sf.module}.{node.name}", name=node.name, sf=sf, node=node
-                )
-    for ci in classes.values():
-        for item in ci.node.body:
-            if isinstance(item, ast.FunctionDef):
-                ci.methods[item.name] = _Method(cls=ci, node=item)
-                ann = {
-                    a.arg: _annotation_name(a.annotation)
-                    for a in item.args.args + item.args.kwonlyargs
-                }
-                for sub in ast.walk(item):
-                    if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
-                        continue
-                    attr = _self_attr(sub.targets[0])
-                    if attr is None:
-                        continue
-                    val = sub.value
-                    if isinstance(val, ast.Call) and isinstance(val.func, ast.Attribute):
-                        # threading.Lock() / RLock() / Condition(...)
-                        if val.func.attr in _LOCK_CTORS:
-                            ci.locks[attr] = sub.lineno
-                        elif val.func.attr == "Condition":
-                            inner = _self_attr(val.args[0]) if val.args else None
-                            if inner is not None:
-                                ci.aliases[attr] = inner
-                            else:
-                                ci.locks[attr] = sub.lineno  # owns its lock
-                    elif isinstance(val, ast.Call):
-                        cname = _ctor_class_name(val)
-                        if cname in classes:
-                            ci.attr_types[attr] = cname
-                    elif isinstance(val, ast.Name) and ann.get(val.id) in classes:
-                        ci.attr_types[attr] = ann[val.id]
-
-    # Pass 2: per-method acquisition/call structure (nested defs excluded —
-    # a closure's body runs when called, not where written).
-    def resolve_call(ci: _Class, call: ast.Call) -> Optional[Tuple[str, str]]:
-        func = call.func
-        if isinstance(func, ast.Attribute):
-            recv = func.value
-            if isinstance(recv, ast.Name):
-                if recv.id == "self" and func.attr in ci.methods:
-                    return (ci.qualname, func.attr)
-                tname = singletons.get(recv.id)
-                if tname in classes and func.attr in classes[tname].methods:
-                    return (classes[tname].qualname, func.attr)
-            attr = _self_attr(recv)
-            if attr is not None:
-                tname = ci.attr_types.get(attr)
-                if tname in classes and func.attr in classes[tname].methods:
-                    return (classes[tname].qualname, func.attr)
-        elif isinstance(func, ast.Name) and func.id in classes:
-            if "__init__" in classes[func.id].methods:
-                return (classes[func.id].qualname, "__init__")
-        return None
-
-    by_qualname = {ci.qualname: ci for ci in classes.values()}
-
-    def analyze(mi: _Method) -> None:
-        ci = mi.cls
-
-        def walk(node: ast.AST, held: List[str]) -> None:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-                return
-            if isinstance(node, ast.With):
-                acquired_here: List[str] = []
-                for item in node.items:
-                    attr = _self_attr(item.context_expr)
-                    lock = ci.lock_id(attr) if attr else None
-                    if lock:
-                        mi.acquires.add(lock)
-                        for h in held:
-                            mi.nest_edges.add((h, lock, node.lineno))
-                        acquired_here.append(lock)
-                    else:
-                        walk(item.context_expr, held)
-                for stmt in node.body:
-                    walk(stmt, held + acquired_here)
-                return
-            if isinstance(node, ast.Call):
-                callee = resolve_call(ci, node)
-                if callee is not None:
-                    mi.calls.add(callee)
-                    for h in held:
-                        mi.held_calls.add((h, callee))
-                        mi.held_call_lines.setdefault((h, callee), node.lineno)
-            for child in ast.iter_child_nodes(node):
-                walk(child, held)
-
-        for stmt in mi.node.body:
-            walk(stmt, [])
-
-    for ci in classes.values():
-        for mi in ci.methods.values():
-            analyze(mi)
-
-    # Fixpoint: locks a method may acquire transitively through its calls.
-    direct: Dict[Tuple[str, str], Set[str]] = {
-        (ci.qualname, m): set(mi.acquires)
-        for ci in classes.values()
-        for m, mi in ci.methods.items()
-    }
-    trans: Dict[Tuple[str, str], Set[str]] = {k: set(v) for k, v in direct.items()}
-    changed = True
-    while changed:
-        changed = False
-        for ci in classes.values():
-            for m, mi in ci.methods.items():
-                mine = trans[(ci.qualname, m)]
-                before = len(mine)
-                for callee in mi.calls:
-                    mine |= trans.get(callee, set())
-                if len(mine) != before:
-                    changed = True
+    index = project.index
+    in_scope = [
+        rel for rel in sorted(index.files) if any(rel.startswith(p) for p in scope)
+    ]
 
     nodes: Dict[str, Tuple[str, int]] = {}
-    for ci in classes.values():
-        for attr, line in ci.locks.items():
-            nodes[f"{ci.qualname}.{attr}"] = (ci.sf.rel, line)
+    for rel in in_scope:
+        f = index.files[rel]
+        module = f["module"]
+        for cname, cfacts in f["classes"].items():
+            for attr, line in cfacts["locks"].items():
+                nodes[f"{module}.{cname}.{attr}"] = (rel, line)
+        for name, line in f["module_locks"].items():
+            nodes[f"{module}.{name}"] = (rel, line)
+
+    # Direct acquisition per call-graph node, then the transitive fixpoint
+    # over the resolved call graph ("which locks might this callee take").
+    direct: Dict[str, Set[str]] = {}
+    for rel in in_scope:
+        f = index.files[rel]
+        module = f["module"]
+        for qual, ff in f["functions"].items():
+            acquired = {
+                _lock_id(module, ff["cls"], tok) for tok in ff["acquires"]
+            }
+            if acquired:
+                direct[f"{module}:{qual}"] = acquired
+    trans = index.transitive_closure(direct)
+
     edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
-    for ci in classes.values():
-        for m, mi in ci.methods.items():
-            where = f"{ci.qualname}.{m}"
-            for outer, inner, line in mi.nest_edges:
-                edges.setdefault(
-                    (outer, inner), (ci.sf.rel, line, f"nested `with` in {where}")
-                )
-            for (held, callee), line in mi.held_call_lines.items():
-                for lock in trans.get(callee, set()):
-                    if lock == held and lock not in direct.get(callee, set()):
-                        # Re-acquisition of the held lock deep in the call
-                        # chain is a *consequence* of a cycle among the other
-                        # edges, which will be reported on its own — a derived
-                        # self-loop here would triple-report one deadlock.
+    for rel in in_scope:
+        f = index.files[rel]
+        module = f["module"]
+        for qual in sorted(f["functions"]):
+            ff = f["functions"][qual]
+            where = f"{module}.{qual}"
+            for outer, inner, line in ff["nest_edges"]:
+                a = _lock_id(module, ff["cls"], outer)
+                b = _lock_id(module, ff["cls"], inner)
+                edges.setdefault((a, b), (rel, line, f"nested `with` in {where}"))
+            seen_calls: Set[Tuple[str, str]] = set()
+            for ref, line, held in ff["calls"]:
+                if not held:
+                    continue
+                callee = index.resolve_ref(module, ff["cls"], qual, ref)
+                if callee is None:
+                    continue
+                callee_display = callee.replace(":", ".")
+                for tok in held:
+                    held_id = _lock_id(module, ff["cls"], tok)
+                    if (held_id, callee) in seen_calls:
                         continue
-                    edges.setdefault(
-                        (held, lock),
-                        (
-                            ci.sf.rel,
-                            line,
-                            f"{where} calls {callee[0]}.{callee[1]} while holding",
-                        ),
-                    )
+                    seen_calls.add((held_id, callee))
+                    for lock in trans.get(callee, set()):
+                        if lock == held_id and lock not in direct.get(callee, set()):
+                            # Re-acquisition of the held lock deep in the call
+                            # chain is a *consequence* of a cycle among the
+                            # other edges, which will be reported on its own —
+                            # a derived self-loop here would triple-report one
+                            # deadlock.
+                            continue
+                        edges.setdefault(
+                            (held_id, lock),
+                            (
+                                rel,
+                                line,
+                                f"{where} calls {callee_display} while holding",
+                            ),
+                        )
     return LockGraph(nodes=nodes, edges=edges)
 
 

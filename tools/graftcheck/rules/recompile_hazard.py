@@ -1,0 +1,166 @@
+"""recompile-hazard: no jit cache churn on hot or iterated paths.
+
+On TPU an XLA recompile is a multi-second hot-path stall, and the subtle part
+is that nothing *looks* wrong at the call site — the three hazard shapes this
+rule catches all type-check, run, and silently destroy goodput ("ML
+Productivity Goodput", PAPERS.md, measures exactly this waste):
+
+1. **jit wrappers constructed per call** — ``jax.jit(lambda ...)`` or
+   ``jit(local_fn)`` built inside a loop, or anywhere on a hot region
+   (reachable from a ``# graftcheck: hot-root``), or immediately invoked
+   (``jit(f)(x)``). jit's trace cache keys on function identity: a fresh
+   lambda/closure each iteration is a fresh cache entry — a recompile every
+   time. The sanctioned patterns are exempt: a ``functools.cache``/
+   ``lru_cache``-memoized factory (the ``ops/kernels.py`` ``*_kernel``
+   convention — one wrapper per config tuple, ever) and module-scope
+   construction.
+2. **varying Python scalars fed to jitted calls without ``static_argnums``**
+   — a ``range``/``enumerate`` counter passed straight into a jitted function
+   becomes a fresh trace-time constant signature per value. The repo's
+   convention is to burn config scalars into a cached factory's closure or
+   declare them static; feeding them raw churns the cache.
+3. **Python branching on traced values inside jitted functions** — an
+   ``if p > 0:`` on a non-static parameter either raises a TracerError or, if
+   the value happens to be concrete, silently specializes the executable per
+   outcome (shape-dependent branching being the classic case). Reads of
+   ``p.shape`` / ``p.ndim`` / ``p.dtype`` are static metadata and exempt;
+   ``jnp.where`` / ``lax.cond`` are the traced alternatives.
+
+Scope: the jitted tiers (``ops/``, ``models/``, ``parallel/``, ``servable/``,
+``serving/``, ``builder/``) — the same surface jit-purity polices, now with
+the index's call graph deciding what is hot.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from tools.graftcheck.engine import Finding, Project, Rule, register
+
+SCOPE_PREFIXES = (
+    "flink_ml_tpu/ops/",
+    "flink_ml_tpu/models/",
+    "flink_ml_tpu/parallel/",
+    "flink_ml_tpu/servable/",
+    "flink_ml_tpu/serving/",
+    "flink_ml_tpu/builder/",
+)
+
+
+@register
+class RecompileHazardRule(Rule):
+    name = "recompile-hazard"
+    severity = "error"
+    description = (
+        "no per-call jit construction (loops / hot regions / jit(f)(x)), no "
+        "varying Python scalars into jitted calls without static_argnums, no "
+        "Python branching on traced values inside jitted functions"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        index = project.index
+        roots = [
+            node
+            for _facts, node, ff in index.iter_functions()
+            if "hot-root" in ff["marks"]
+        ]
+        hot = index.reachable(roots) if roots else {}
+        findings: List[Finding] = []
+        for f, node, ff in index.iter_functions():
+            rel = f["rel"]
+            if not any(rel.startswith(p) for p in SCOPE_PREFIXES):
+                continue
+            findings.extend(self._check_function(index, f, node, ff, hot))
+        return findings
+
+    def _check_function(self, index, f, node, ff, hot) -> List[Finding]:
+        out: List[Finding] = []
+        rel = f["rel"]
+        name = ff["name"]
+
+        # 1. per-call jit construction
+        for line, form, _binding, in_loop in ff["jit_sites"]:
+            if ff["memoized"]:
+                continue  # the cached-factory convention: one wrapper, ever
+            what = {
+                "lambda": "a jit-wrapped lambda",
+                "named": "a jit wrapper",
+                "bare": "a jit wrapper",
+                "immediate": "a jit wrapper",
+            }[form]
+            if form == "immediate":
+                out.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"`{name}` constructs AND invokes {what} in one "
+                        "expression (jit(f)(...)) — a fresh trace-cache entry "
+                        "per call, i.e. a recompile every time; jit once at "
+                        "module scope or behind functools.cache",
+                    )
+                )
+            elif in_loop:
+                out.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"`{name}` constructs {what} inside a loop — each "
+                        "iteration creates a new callable identity and a "
+                        "fresh jit cache entry (recompile per iteration); "
+                        "hoist the jit out of the loop or memoize the factory",
+                    )
+                )
+            elif node in hot:
+                root = hot[node].replace(":", ".")
+                out.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"`{name}` constructs {what} on a hot region "
+                        f"(reachable from hot-root {root}) — per-request jit "
+                        "construction recompiles on every call; build it at "
+                        "plan/warmup time (`# graftcheck: cold`) instead",
+                    )
+                )
+
+        # 2. varying Python scalars into jitted calls without static_argnums
+        for callee, line, loop_args in ff["jitted_call_sites"]:
+            target = f["functions"].get(callee)
+            is_jitted = bool(target and target["is_jitted"])
+            has_static = bool(target and target["has_static"])
+            if not is_jitted and callee in f.get("jit_bound", {}):
+                is_jitted = True
+                has_static = f["jit_bound"][callee]["static"]
+            if is_jitted and not has_static:
+                args = ", ".join(sorted(set(loop_args)))
+                out.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"jitted `{callee}` is fed varying Python scalar(s) "
+                        f"`{args}` (loop counters) without static_argnums — "
+                        "each value becomes a fresh trace signature; declare "
+                        "them static or burn them into a cached factory",
+                    )
+                )
+
+        # 3. Python branching on traced values inside jitted functions
+        if ff["is_jitted"]:
+            static = set(ff["static_names"])
+            for line, names in ff["param_branches"]:
+                dyn = sorted(n for n in names if n not in static)
+                if not dyn:
+                    continue
+                if ff["has_static"] and not ff["static_names"]:
+                    continue  # statics declared but not statically parseable
+                out.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"jitted `{name}` branches in Python on traced "
+                        f"value(s) {', '.join(dyn)} — shape/value-dependent "
+                        "control flow re-specializes (or TracerErrors) per "
+                        "outcome; use jnp.where/lax.cond or mark the argument "
+                        "static",
+                    )
+                )
+        return out

@@ -1,0 +1,77 @@
+"""SARIF 2.1.0 rendering of a graftcheck run.
+
+SARIF (Static Analysis Results Interchange Format) is what CI systems ingest
+to surface findings as inline annotations — GitHub code scanning, Gerrit
+checks, VS Code's SARIF viewer all speak it. The mapping is deliberately
+minimal and lossless: one ``run``, one ``tool.driver`` with the full rule
+catalogue (so a clean run still advertises what was checked), one ``result``
+per unsuppressed finding with a single physical location.
+
+Severity mapping: graftcheck ``error`` → SARIF level ``error`` (gates CI),
+``warning`` → ``warning``. Suppressed findings are emitted with a
+``suppressions`` entry (kind ``inSource``) as the spec prescribes, so the
+annotation UI can show them greyed out instead of hiding them.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json"
+
+__all__ = ["to_sarif"]
+
+
+def _result(finding, suppressed: bool) -> Dict:
+    out: Dict = {
+        "ruleId": finding.rule,
+        "level": finding.severity,
+        "message": {"text": finding.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {"uri": finding.path, "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": finding.line},
+                }
+            }
+        ],
+    }
+    if suppressed:
+        out["suppressions"] = [{"kind": "inSource"}]
+    return out
+
+
+def to_sarif(result, registry: Dict, *, tool_version: str = "2.0") -> Dict:
+    """Render a :class:`~tools.graftcheck.engine.RunResult` as a SARIF log."""
+    rules: List[Dict] = []
+    for name in result.rules_run:
+        rule = registry.get(name)
+        if rule is None:
+            continue
+        rules.append(
+            {
+                "id": rule.name,
+                "shortDescription": {"text": rule.description},
+                "defaultConfiguration": {"level": rule.severity},
+            }
+        )
+    results = [_result(f, suppressed=False) for f in result.findings]
+    results += [_result(f, suppressed=True) for f in result.suppressed]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftcheck",
+                        "version": tool_version,
+                        "informationUri": "docs/static_analysis.md",
+                        "rules": rules,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results,
+            }
+        ],
+    }
