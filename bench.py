@@ -1236,6 +1236,211 @@ def bench_serving():
     }
 
 
+def bench_mlp_serving_throughput():
+    """Throughput-mode MLP serving (VERDICT r6 item 8): the batched,
+    weight-resident counterpart of ``mlp_forward``'s 0.0135-MFU latency shape.
+
+    Same 256->512->512->8 network, served end-to-end through the
+    InferenceServer at batched request sizes (64 rows, coalescing onto a
+    256-row max bucket) from 4 client threads at saturation — so the number
+    includes queueing, micro-batching, padding and readback, not just the
+    matmuls. The fastpath leg keeps every layer's weights device-resident
+    (one upload at swap) and serves one fused AOT program per bucket; the
+    per-stage leg re-uploads weights per call — the throughput delta IS the
+    weight-residency + AOT win. The same network architecture reproduces from
+    the CLI alone via the JSON suite
+    (``python -m flink_ml_tpu.benchmark flink_ml_tpu/benchmark/configs/
+    mlpclassifier-benchmark.json``).
+    """
+    import threading
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.servable.lib import MLPClassifierModelServable
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    rng = np.random.default_rng(17)
+    dims = (256, 512, 512, 8)
+    servable = MLPClassifierModelServable()
+    arrays = {"labels": np.arange(dims[-1], dtype=np.float64)}
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        arrays[f"W{i}"] = (
+            rng.normal(size=(d_in, d_out)) * np.sqrt(2.0 / d_in)
+        ).astype(np.float32)
+        arrays[f"b{i}"] = np.zeros(d_out, np.float32)
+    X = rng.standard_normal((8192, dims[0])).astype(np.float32)
+
+    n_threads = 4
+    requests_per_thread = 60
+    req_rows = 64
+
+    def run_leg(fastpath):
+        leg_servable = MLPClassifierModelServable()._apply_model_arrays(arrays)
+        server = InferenceServer(
+            leg_servable,
+            name=f"bench-mlp-throughput-{int(fastpath)}",
+            serving_config=ServingConfig(
+                max_batch_size=256,
+                max_delay_ms=1.0,
+                queue_capacity_rows=16384,
+                default_timeout_ms=120_000,
+                fastpath=fastpath,
+                pipeline_depth=2,
+            ),
+            warmup_template=DataFrame.from_dict({"features": X[:1]}),
+        )
+        try:
+            barrier = threading.Barrier(n_threads + 1)
+
+            def client(tid):
+                barrier.wait()
+                for i in range(requests_per_thread):
+                    j = (tid * 997 + i * 193) % (X.shape[0] - req_rows)
+                    server.predict(
+                        DataFrame.from_dict({"features": X[j : j + req_rows]})
+                    )
+
+            threads = [
+                threading.Thread(target=client, args=(t,)) for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            scraped = metrics.scope(server.scope)
+            lat = scraped[MLMetrics.SERVING_LATENCY_MS]
+            total_rows = n_threads * requests_per_thread * req_rows
+            return {
+                "fastpath": fastpath,
+                "rows_per_sec": round(total_rows / elapsed, 1),
+                "latency_p50_ms": round(lat.quantile(0.5), 3),
+                "latency_p99_ms": round(lat.quantile(0.99), 3),
+                "mean_batch_rows": round(
+                    total_rows / scraped[MLMetrics.SERVING_BATCHES], 1
+                ),
+                "fused_batches": scraped.get(MLMetrics.SERVING_FUSED_BATCHES, 0),
+                "fastpath_compiles_post_warmup": scraped.get(
+                    MLMetrics.SERVING_FASTPATH_COMPILES, 0
+                ),
+            }
+        finally:
+            server.close()
+
+    legs = [run_leg(False), run_leg(True)]
+    fused, per_stage = legs[1]["rows_per_sec"], legs[0]["rows_per_sec"]
+    return {
+        "name": "mlp_serving_throughput_b64_256_512_512_8",
+        "threads": n_threads,
+        "requests_per_thread": requests_per_thread,
+        "request_rows": req_rows,
+        "max_batch_size": 256,
+        "legs": legs,
+        "fused_vs_per_stage": round(fused / per_stage, 2) if per_stage else None,
+        "note": "throughput counterpart of mlp_forward's latency shape: "
+        "batched 64-row requests through the full serving path; fastpath leg "
+        "= device-resident weights + one fused AOT program per bucket, "
+        "per-stage leg re-uploads weights per call. Config-suite twin: "
+        "mlpclassifier-benchmark.json trains/transforms the same network "
+        "from the CLI.",
+    }
+
+
+def bench_continuous_loop():
+    """Continuous learning loop (docs/continuous.md): the closed train →
+    publish → AOT-warm → flip cycle at the Criteo-ish d=256 online-LR shape.
+
+    What the row quantifies is the loop's *model logistics* cost: the
+    publish→serve latency per version (save + poll + plan build + per-bucket
+    AOT warm + atomic flip — the window in which the fleet serves the
+    previous version), the pre-flip warm time itself, and the goodput
+    fraction (productive train/serve time over total, the ML Productivity
+    Goodput accounting). Serving-path compiles must be zero: every flip is
+    warmed before activation.
+    """
+    import tempfile
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.linalg.vectors import DenseVector
+    from flink_ml_tpu.loop import ContinuousLearningLoop, ContinuousTrainer, DriftMonitor
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.models.classification.online_logistic_regression import (
+        OnlineLogisticRegression,
+    )
+    from flink_ml_tpu.models.online import QueueBatchStream
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    dim = 256
+    rng = np.random.default_rng(23)
+    true_w = rng.normal(size=dim) / np.sqrt(dim)
+
+    def batch(n=4096, seed=0):
+        r = np.random.default_rng(seed)
+        X = r.normal(size=(n, dim))
+        y = (X @ true_w > 0).astype(np.float64)
+        return {"features": X.astype(np.float64), "label": y}
+
+    n_versions = 6
+    with tempfile.TemporaryDirectory() as tmp:
+        scope = f"{MLMetrics.LOOP_GROUP}[bench]"
+        stream = QueueBatchStream()
+        for i in range(n_versions):
+            stream.add(batch(seed=i))
+        trainer = ContinuousTrainer(
+            OnlineLogisticRegression()
+            .set_initial_model_data(
+                DataFrame(["coefficient"], None, [[DenseVector(np.zeros(dim))]])
+            )
+            .set_alpha(0.5)
+            .set_global_batch_size(4096),
+            stream,
+            tmp + "/pub",
+            publish_every_versions=1,
+            scope=scope,
+        )
+        server = InferenceServer(
+            name="bench-loop",
+            serving_config=ServingConfig(max_batch_size=64, max_delay_ms=0.5),
+            warmup_template=DataFrame.from_dict(
+                {"features": batch(1, seed=99)["features"]}
+            ),
+        )
+        loop = ContinuousLearningLoop(
+            trainer,
+            server,
+            eval_source=lambda: DataFrame.from_dict(batch(64, seed=77)),
+            name="bench",
+            monitor=DriftMonitor(window=4, scope=scope),
+        )
+        t0 = time.perf_counter()
+        loop.run(publish_target=n_versions, max_steps=n_versions + 2)
+        elapsed = time.perf_counter() - t0
+        scraped = metrics.scope(scope)
+        hist = scraped[MLMetrics.LOOP_PUBLISH_TO_SERVE_MS]
+        result = {
+            "name": f"continuous_loop_lr_d{dim}",
+            "versions_published": scraped[MLMetrics.LOOP_PUBLISHED],
+            "versions_swapped": scraped[MLMetrics.LOOP_SWAPPED],
+            "publish_to_serve_p50_ms": round(hist.quantile(0.5), 2),
+            "publish_to_serve_p99_ms": round(hist.quantile(0.99), 2),
+            "warm_ms_last": round(scraped[MLMetrics.LOOP_WARM_MS], 2),
+            "goodput_fraction": round(scraped[MLMetrics.LOOP_GOODPUT_FRACTION], 4),
+            "versions_per_sec": round(n_versions / elapsed, 2),
+            "serving_path_compiles": metrics.get(
+                server.scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0
+            ),
+            "note": "closed train->publish->warm->flip loop; "
+            "publish_to_serve is the stale-model window per version (save + "
+            "poll + plan build + per-bucket AOT warm + atomic flip), "
+            "goodput_fraction = productive/(productive+overhead) per the ML "
+            "Productivity Goodput accounting; serving_path_compiles must be 0",
+        }
+        server.close()
+        return result
+
+
 def bench_pipeline_batch_transform():
     """Batch transform fast path (docs/batch_transform.md): fused chunked
     CompiledBatchPlan vs the per-stage transform path on a 6-stage feature
@@ -1469,6 +1674,8 @@ def main() -> None:
     attention = bench_attention(peak)
     attention_train = bench_attention_train(peak)
     serving = bench_serving()
+    mlp_serving = bench_mlp_serving_throughput()
+    continuous_loop = bench_continuous_loop()
     batch_transform = bench_pipeline_batch_transform()
 
     detail = {
@@ -1477,7 +1684,8 @@ def main() -> None:
         "peak_hbm_gbps": peak_bw,
         "workloads": [
             logreg, sparse, sweep, sparse_streamed, overlap, kmeans, mlp,
-            mlp_train, attention, attention_train, serving, batch_transform,
+            mlp_train, attention, attention_train, serving, mlp_serving,
+            continuous_loop, batch_transform,
         ],
     }
     with open("BENCH_DETAIL.json", "w") as f:

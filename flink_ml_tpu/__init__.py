@@ -15,6 +15,9 @@ Layer map (mirrors the reference's Maven layering, reference SURVEY.md section 1
   - ``ops``         : losses, optimizers, distance measures, quantiles, windows
   - ``models``      : the algorithm library (ref flink-ml-lib)
   - ``servable``    : runtime-free inference (ref flink-ml-servable-core/servable)
+  - ``serving``     : online serving runtime (micro-batching, hot swap, fast path)
+  - ``loop``        : continuous learning loop — closed train → publish → serve
+                      with drift detection and rollback (docs/continuous.md)
   - ``benchmark``   : JSON-config benchmark harness (ref flink-ml-benchmark)
 """
 

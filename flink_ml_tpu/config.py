@@ -178,6 +178,53 @@ class Options:
         "device execution of chunk j (the streamed-SGD prefetch-gap design); "
         "1 = strict sequential.",
     )
+    LOOP_PUBLISH_EVERY_VERSIONS = ConfigOption(
+        "loop.publish.every.versions",
+        int,
+        1,
+        "Continuous-learning publish cadence: every Nth trained model version "
+        "is published as a servable (docs/continuous.md). 1 = every version.",
+    )
+    LOOP_PUBLISH_EVERY_SECONDS = ConfigOption(
+        "loop.publish.every.seconds",
+        float,
+        None,
+        "Additional time-based publish trigger: a trained-but-unpublished "
+        "version older than this is published even before the Nth-version "
+        "cadence is due. Default: none — cadence only.",
+    )
+    LOOP_DRIFT_WINDOW = ConfigOption(
+        "loop.drift.window",
+        int,
+        4,
+        "Rolling window (number of scored evaluation batches) the drift "
+        "monitor averages per model version before comparing against the "
+        "baseline version.",
+    )
+    LOOP_DRIFT_REL_THRESHOLD = ConfigOption(
+        "loop.drift.rel.threshold",
+        float,
+        0.25,
+        "Relative regression threshold: the live version regresses when its "
+        "rolling score is worse than the baseline's by more than this "
+        "fraction (loss: mean > baseline * (1 + t); AUC-style metrics: "
+        "mean < baseline * (1 - t)).",
+    )
+    LOOP_DRIFT_ABS_THRESHOLD = ConfigOption(
+        "loop.drift.abs.threshold",
+        float,
+        0.0,
+        "Absolute slack added on top of the relative drift threshold — a "
+        "live score within this distance of the baseline never regresses "
+        "(guards near-zero baselines).",
+    )
+    LOOP_DRIFT_MIN_SCORES = ConfigOption(
+        "loop.drift.min.scores",
+        int,
+        1,
+        "Minimum scored batches for the live version before a drift verdict "
+        "may fire (a single noisy window should not roll back a model).",
+    )
     NATIVE_DATACACHE_ENABLED = ConfigOption(
         "native.datacache.enabled",
         _parse_bool,

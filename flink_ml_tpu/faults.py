@@ -77,6 +77,22 @@ FAULT_POINTS: Dict[str, str] = {
         "must be skipped with a fallback to the newest older intact one, and "
         "the in-service model must keep serving untouched."
     ),
+    "loop.publish": (
+        "Continuous-learning publish step (loop/trainer.py) — kill the loop "
+        "after a model version trained but before its servable save/rename "
+        "lands; recovery must republish the lagging version without reusing "
+        "or skipping a version number."
+    ),
+    "loop.swap": (
+        "Continuous-learning swap step (loop/loop.py) — kill the loop between "
+        "a publish and the warmed atomic flip; the in-service version must "
+        "keep serving and the retry must complete the flip."
+    ),
+    "loop.rollback": (
+        "Drift rollback (loop/rollback.py) — kill the loop after a regression "
+        "verdict but before the revert-to-N-1 flip; the retry must finish the "
+        "quarantine + rollback with zero serving errors in between."
+    ),
 }
 
 

@@ -28,6 +28,7 @@ from flink_ml_tpu.iteration.iteration import (
     IterationConfig,
     IterationListener,
     Iterations,
+    OperatorLifeCycle,
     ReplayableDataStreamList,
     iterate_bounded_until_termination,
     iterate_unbounded,
@@ -48,6 +49,7 @@ __all__ = [
     "IterationConfig",
     "IterationListener",
     "Iterations",
+    "OperatorLifeCycle",
     "ReplayableDataStreamList",
     "iterate_bounded_until_termination",
     "iterate_unbounded",

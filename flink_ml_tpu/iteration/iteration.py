@@ -34,8 +34,16 @@ __all__ = [
 
 class OperatorLifeCycle(enum.Enum):
     """Ref IterationConfig.OperatorLifeCycle — ALL_ROUND keeps one operator instance
-    across epochs; PER_ROUND builds fresh per epoch (forEachRound). In the host-loop
-    world ALL_ROUND = state carried in ``variables``/closures, PER_ROUND = pure body."""
+    across epochs; PER_ROUND builds fresh per epoch (forEachRound).
+
+    Host-loop mapping: ALL_ROUND passes the SAME body callable every epoch, so
+    closure/attribute state carries across rounds exactly like a long-lived
+    operator instance. PER_ROUND treats ``body`` as a zero-arg FACTORY — the
+    ``forEachRound`` subgraph builder — invoked once per epoch; the returned
+    epoch body starts from fresh state every round and is discarded at the
+    round boundary (cross-round state must flow through ``variables``, the
+    feedback edge, which is the reference's per-round contract:
+    IterationBody.java:73)."""
 
     ALL_ROUND = "ALL_ROUND"
     PER_ROUND = "PER_ROUND"
@@ -194,6 +202,23 @@ class _PipelineThrottle:
             jax.block_until_ready(self._inflight.pop(0))
 
 
+def _epoch_body(body: Callable, config: IterationConfig) -> Callable:
+    """Resolve the callable to run THIS epoch under the configured lifecycle:
+    ALL_ROUND returns ``body`` itself (one operator instance across rounds);
+    PER_ROUND invokes ``body`` as the per-round factory and returns the fresh
+    epoch body it built."""
+    if config.operator_life_cycle is not OperatorLifeCycle.PER_ROUND:
+        return body
+    fresh = body()
+    if not callable(fresh):
+        raise TypeError(
+            "PER_ROUND lifecycle: the body must be a zero-arg factory "
+            "returning the epoch body (the forEachRound builder), got "
+            f"{type(fresh).__name__} from {body!r}"
+        )
+    return fresh
+
+
 def _criteria_continues(criteria: Any) -> bool:
     """Evaluate a termination criteria 'stream': truthy = keep iterating."""
     if criteria is None:
@@ -237,10 +262,11 @@ def iterate_bounded_until_termination(
         if config.max_epochs is not None and epoch >= config.max_epochs:
             break
         faults.trip("iteration.epoch", epoch=epoch)
+        epoch_body = _epoch_body(body, config)
         if data is not None:
-            result = body(variables, epoch, data.epoch_view(epoch))
+            result = epoch_body(variables, epoch, data.epoch_view(epoch))
         else:
-            result = body(variables, epoch)
+            result = epoch_body(variables, epoch)
         if result.outputs:
             outputs = list(result.outputs)
         for listener in listeners:
@@ -299,7 +325,7 @@ def iterate_unbounded(
 
     for batch in stream:
         faults.trip("iteration.epoch", epoch=epoch)
-        result = body(variables, batch, epoch)
+        result = _epoch_body(body, config)(variables, batch, epoch)
         for listener in listeners:
             listener.on_epoch_watermark_incremented(epoch, context)
         epoch += 1

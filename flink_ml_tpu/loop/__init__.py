@@ -1,0 +1,31 @@
+"""Continuous learning loop — closed-loop train → publish → serve.
+
+The composition tier the reference exists for (online ML on unbounded
+streams, SURVEY.md §2.5) run as ONE continuously supervised system instead of
+unit-tested fragments: a :class:`ContinuousTrainer` consumes a feedable batch
+stream through an online estimator and publishes a servable model version on
+a rows/seconds cadence; the serving tier's registry/poller AOT-warms each
+version's per-bucket chains before the atomic flip; a :class:`DriftMonitor`
+scores the live model on labelled tail traffic; a :class:`RollbackController`
+atomically reverts to the newest intact older version on regression,
+quarantining the bad one. ``ContinuousLearningLoop`` drives all of it under
+``execution.Supervisor`` with deterministic fault points (``loop.publish``,
+``loop.swap``, ``loop.rollback``) and ``ml.loop.*`` goodput accounting.
+
+See docs/continuous.md.
+"""
+from flink_ml_tpu.loop.drift import DriftMonitor, auc, logloss
+from flink_ml_tpu.loop.loop import ContinuousLearningLoop, LoopReport
+from flink_ml_tpu.loop.rollback import RollbackController, RollbackImpossibleError
+from flink_ml_tpu.loop.trainer import ContinuousTrainer
+
+__all__ = [
+    "ContinuousTrainer",
+    "DriftMonitor",
+    "RollbackController",
+    "RollbackImpossibleError",
+    "ContinuousLearningLoop",
+    "LoopReport",
+    "logloss",
+    "auc",
+]

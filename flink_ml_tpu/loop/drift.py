@@ -1,0 +1,153 @@
+"""DriftMonitor — rolling quality windows + regression verdicts.
+
+The live model is scored on held-out/labelled *tail traffic* (the loop serves
+the evaluation rows through the real serving path, so the score measures what
+users see — version, bucket padding, fast path and all). Scores accumulate in
+a bounded rolling window per model version; a version regresses when its
+window mean is worse than the baseline version's by more than the configured
+thresholds. Verdicts are deliberately conservative: no baseline, or fewer
+than ``min_scores`` observations, is never a regression — a single noisy
+window must not roll a model back.
+
+Scorers: ``logloss`` (lower is better — the default for the CTR/RTB shape)
+and ``auc`` (higher is better) are plain-numpy helpers usable standalone; the
+monitor itself is metric-agnostic and only needs ``higher_is_better`` to
+orient its comparison.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Deque, Dict, Optional
+
+import numpy as np
+
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.metrics import MLMetrics, metrics
+
+__all__ = ["DriftMonitor", "logloss", "auc"]
+
+
+def logloss(labels, p, eps: float = 1e-7) -> float:
+    """Mean binary cross-entropy of probabilities ``p`` against 0/1 labels
+    (clipped away from {0,1} so an overconfident wrong prediction scores a
+    large finite loss instead of inf)."""
+    y = np.asarray(labels, np.float64).ravel()
+    p = np.clip(np.asarray(p, np.float64).ravel(), eps, 1.0 - eps)
+    return float(-np.mean(y * np.log(p) + (1.0 - y) * np.log(1.0 - p)))
+
+
+def auc(labels, scores) -> float:
+    """Rank-based ROC AUC (the Mann-Whitney statistic, ties shared) — the
+    evaluator-free counterpart of BinaryClassificationEvaluator's areaUnderROC
+    for the monitor's rolling windows. Degenerate single-class windows score
+    0.5 (no information) rather than raising."""
+    y = np.asarray(labels, np.float64).ravel()
+    s = np.asarray(scores, np.float64).ravel()
+    pos = y > 0.5
+    n_pos = int(pos.sum())
+    n_neg = y.size - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    order = np.argsort(s, kind="mergesort")
+    ranks = np.empty(y.size, np.float64)
+    ranks[order] = np.arange(1, y.size + 1, dtype=np.float64)
+    # average ranks over tied scores so ties contribute 0.5
+    sorted_s = s[order]
+    i = 0
+    while i < y.size:
+        j = i
+        while j + 1 < y.size and sorted_s[j + 1] == sorted_s[i]:
+            j += 1
+        if j > i:
+            ranks[order[i : j + 1]] = 0.5 * (i + 1 + j + 1)
+        i = j + 1
+    rank_sum_pos = float(ranks[pos].sum())
+    return (rank_sum_pos - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg)
+
+
+class DriftMonitor:
+    """Per-version rolling score windows with a thresholded regression test.
+
+    ``regressed(live, baseline)`` compares rolling means:
+
+    - lower-is-better (loss, default): regress when
+      ``mean(live) > mean(baseline) * (1 + rel) + abs``;
+    - higher-is-better (AUC): regress when
+      ``mean(live) < mean(baseline) * (1 - rel) - abs``.
+
+    Thresholds default to the ``loop.drift.*`` config options. Every verdict
+    publishes the ``ml.loop.drift.score`` / ``ml.loop.drift.baseline`` gauges;
+    a positive verdict bumps ``ml.loop.drift.regressions``.
+    """
+
+    def __init__(
+        self,
+        *,
+        window: Optional[int] = None,
+        rel_threshold: Optional[float] = None,
+        abs_threshold: Optional[float] = None,
+        min_scores: Optional[int] = None,
+        higher_is_better: bool = False,
+        scope: str = f"{MLMetrics.LOOP_GROUP}[loop]",
+    ):
+        self.window = int(
+            window if window is not None else config.get(Options.LOOP_DRIFT_WINDOW)
+        )
+        self.rel_threshold = float(
+            rel_threshold
+            if rel_threshold is not None
+            else config.get(Options.LOOP_DRIFT_REL_THRESHOLD)
+        )
+        self.abs_threshold = float(
+            abs_threshold
+            if abs_threshold is not None
+            else config.get(Options.LOOP_DRIFT_ABS_THRESHOLD)
+        )
+        self.min_scores = int(
+            min_scores
+            if min_scores is not None
+            else config.get(Options.LOOP_DRIFT_MIN_SCORES)
+        )
+        self.higher_is_better = bool(higher_is_better)
+        self.scope = scope
+        self._windows: Dict[int, Deque[float]] = {}
+
+    # -- observations ----------------------------------------------------------
+    def observe(self, version: int, score: float) -> None:
+        """Record one evaluation-batch score for ``version``."""
+        window = self._windows.setdefault(version, deque(maxlen=self.window))
+        window.append(float(score))
+
+    def count(self, version: int) -> int:
+        return len(self._windows.get(version, ()))
+
+    def mean(self, version: int) -> Optional[float]:
+        window = self._windows.get(version)
+        if not window:
+            return None
+        return float(np.mean(window))
+
+    # -- the verdict -----------------------------------------------------------
+    def regressed(self, live: int, baseline: Optional[int]) -> bool:
+        """Whether ``live``'s rolling score has regressed past ``baseline``'s
+        by more than the thresholds (False whenever either side lacks data)."""
+        live_mean = self.mean(live)
+        if live_mean is not None:
+            metrics.gauge(self.scope, MLMetrics.LOOP_DRIFT_SCORE, live_mean)
+        if baseline is None or live == baseline:
+            return False
+        base_mean = self.mean(baseline)
+        if base_mean is None or live_mean is None:
+            return False
+        metrics.gauge(self.scope, MLMetrics.LOOP_DRIFT_BASELINE, base_mean)
+        if self.count(live) < self.min_scores:
+            return False
+        if self.higher_is_better:
+            bound = base_mean * (1.0 - self.rel_threshold) - self.abs_threshold
+            verdict = live_mean < bound
+        else:
+            bound = base_mean * (1.0 + self.rel_threshold) + self.abs_threshold
+            verdict = live_mean > bound
+        if verdict:
+            metrics.counter(self.scope, MLMetrics.LOOP_DRIFT_REGRESSIONS)
+        return verdict

@@ -1,0 +1,102 @@
+"""RollbackController — atomic revert to the newest intact older version.
+
+On a drift regression the bad version is quarantined on disk
+(``v-<N>`` → ``v-<N>.quarantined``, the checkpoint tier's corrupt-snapshot
+semantics: kept for forensics, invisible to every directory scan) and the
+newest intact OLDER published version is loaded, AOT-warmed on this thread,
+and atomically flipped back into serving (``InferenceServer.rollback`` →
+``ModelRegistry.swap(..., allow_rollback=True)``). The in-service model keeps
+answering through all of it — a rollback is just a hot swap that goes
+backwards.
+
+Crash discipline (the ``loop.rollback`` fault point): the trip sits before
+the quarantine, so a kill anywhere in the revert leaves either (a) nothing
+done — retry redoes it all — or (b) the bad dir already renamed — the
+idempotent quarantine returns None and the retry proceeds straight to the
+flip. Serving never errors in between: until the flip lands, responses keep
+coming from the (regressed but functional) bad version.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from flink_ml_tpu.faults import faults
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.serving.registry import quarantine_version
+
+__all__ = ["RollbackController", "RollbackImpossibleError"]
+
+
+class RollbackImpossibleError(RuntimeError):
+    """No intact published version older than the regressed one exists.
+
+    Deliberately NOT retryable (the supervisor's default-fatal routing):
+    re-running the revert cannot conjure an older version; the regressed
+    model stays in service and the operator must intervene.
+    """
+
+
+class RollbackController:
+    """Revert ``server`` to the newest intact version below a regressed one."""
+
+    def __init__(
+        self,
+        server,
+        publish_dir: str,
+        *,
+        loader: Optional[Callable[[str], object]] = None,
+        scope: str = f"{MLMetrics.LOOP_GROUP}[loop]",
+    ):
+        if loader is None:
+            from flink_ml_tpu.servable.api import load_servable
+
+            loader = load_servable
+        self.server = server
+        self.publish_dir = publish_dir
+        self.loader = loader
+        self.scope = scope
+
+    def _published(self):
+        import os
+
+        from flink_ml_tpu.checkpoint import scan_numbered_dirs
+        from flink_ml_tpu.serving.registry import VERSION_PREFIX, _METADATA_MARKER
+
+        versions = scan_numbered_dirs(
+            self.publish_dir, VERSION_PREFIX, _METADATA_MARKER
+        )
+        return [
+            (v, os.path.join(self.publish_dir, f"{VERSION_PREFIX}{v}"))
+            for v in versions
+        ]
+
+    def rollback(self, bad_version: int) -> int:  # graftcheck: cold
+        """Quarantine ``bad_version`` and restore the newest intact older one.
+
+        Returns the restored version. A candidate that fails to load or warm
+        is itself quarantined (it could never serve again anyway) and the next
+        older one is tried — the poller's corrupt-version fallback, reversed.
+        Raises :class:`RollbackImpossibleError` when no candidate survives.
+        """
+        faults.trip("loop.rollback", bad_version=bad_version)
+        if quarantine_version(self.publish_dir, bad_version) is not None:
+            metrics.counter(self.scope, MLMetrics.LOOP_QUARANTINED)
+        candidates = [
+            (v, path) for v, path in self._published() if v < bad_version
+        ]
+        for version, path in reversed(candidates):
+            try:
+                servable = self.loader(path)
+                # AOT-warm + atomic backwards flip, all off the serving path.
+                self.server.rollback(version, servable)
+            except Exception:
+                if quarantine_version(self.publish_dir, version) is not None:
+                    metrics.counter(self.scope, MLMetrics.LOOP_QUARANTINED)
+                metrics.counter(self.scope, MLMetrics.SERVING_SWAP_FAILURES)
+                continue
+            metrics.counter(self.scope, MLMetrics.LOOP_ROLLBACKS)
+            return version
+        raise RollbackImpossibleError(
+            f"no intact published version older than {bad_version} under "
+            f"{self.publish_dir!r}; the regressed version stays in service"
+        )

@@ -1,0 +1,175 @@
+"""ContinuousTrainer — the training half of the closed loop.
+
+Wraps an online estimator (anything built on ``models/online.py``:
+``fit(stream)`` returns an ``OnlineModelBase`` whose ``advance`` steps the
+``SnapshotDriver``) and turns its version stream into *published servable
+versions*: every Nth trained version — or any trained-but-unpublished version
+older than the time budget — is written through
+``serving.registry.publish_servable`` under the loop's publish directory,
+atomically, numbered by the model's own version counter.
+
+Crash discipline (the ``loop.publish`` fault point): the trip sits between
+"version trained" and "servable saved", so a kill there leaves the version
+counter ahead of the publish directory. ``process`` repairs that lag first —
+it republishes the newest trained version if its cadence slot is empty —
+before pulling new batches, so a supervised retry never reuses or skips a
+version number and never loses a due publish. An already-published version on
+disk (crash between the atomic rename and the bookkeeping) is detected via
+``FileExistsError`` and adopted rather than failed.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.faults import faults
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.serving.registry import (
+    VERSION_PREFIX,
+    _METADATA_MARKER,
+    publish_servable,
+)
+
+__all__ = ["ContinuousTrainer"]
+
+
+class ContinuousTrainer:
+    """Train an online estimator on a stream and publish servable versions.
+
+    ``estimator`` must expose ``fit(stream) -> OnlineModelBase`` (every
+    ``models/online.py`` estimator does); checkpointing for kill/resume is the
+    estimator's own contract (``HasCheckpointing.set_checkpoint``) and rides
+    along untouched. ``publish_every_versions`` / ``publish_every_s`` default
+    to the ``loop.publish.every.*`` config options.
+    """
+
+    #: Injectable wall clock (seconds) — the publish timestamps behind the
+    #: loop's publish→serve latency histogram; tests pin it.
+    clock: Callable[[], float] = staticmethod(time.time)
+
+    def __init__(
+        self,
+        estimator,
+        stream,
+        publish_dir: str,
+        *,
+        publish_every_versions: Optional[int] = None,
+        publish_every_s: Optional[float] = None,
+        scope: str = f"{MLMetrics.LOOP_GROUP}[loop]",
+    ):
+        self.estimator = estimator
+        self.stream = stream
+        self.publish_dir = publish_dir
+        self.scope = scope
+        self.publish_every_versions = max(
+            1,
+            int(
+                publish_every_versions
+                if publish_every_versions is not None
+                else config.get(Options.LOOP_PUBLISH_EVERY_VERSIONS)
+            ),
+        )
+        self.publish_every_s = (
+            float(publish_every_s)
+            if publish_every_s is not None
+            else config.get(Options.LOOP_PUBLISH_EVERY_SECONDS)
+        )
+        self._model = None
+        #: version -> wall-clock publish time (the publish→serve latency base).
+        self.published_at: Dict[int, float] = {}
+        self.published_versions: List[int] = []
+        self._last_publish_time: Optional[float] = None
+        #: Cumulative seconds spent saving/publishing — overhead in the
+        #: loop's goodput accounting, never productive serving/training time.
+        self.publish_s: float = 0.0
+
+    # -- lifecycle -------------------------------------------------------------
+    @property
+    def model(self):
+        if self._model is None:
+            raise RuntimeError("ContinuousTrainer.start() has not been called")
+        return self._model
+
+    @property
+    def started(self) -> bool:
+        return self._model is not None
+
+    def start(self):
+        """``fit`` the estimator on the (lazy, unbounded) stream. On a
+        checkpointed estimator this is also the resume point: the snapshot
+        driver restores the newest intact snapshot and the model continues at
+        the checkpointed version — ``process`` then repairs any publish lag
+        against what is already on disk."""
+        if self._model is not None:
+            raise RuntimeError("trainer already started")
+        self._model = self.estimator.fit(self.stream)
+        return self._model
+
+    # -- publish cadence -------------------------------------------------------
+    def _published_on_disk(self) -> List[int]:
+        from flink_ml_tpu.checkpoint import scan_numbered_dirs
+
+        return scan_numbered_dirs(self.publish_dir, VERSION_PREFIX, _METADATA_MARKER)
+
+    def _cadence_due(self, version: int) -> bool:
+        return version > 0 and version % self.publish_every_versions == 0
+
+    def _time_due(self) -> bool:
+        if self.publish_every_s is None:
+            return False
+        last = self._last_publish_time
+        return last is None or (self.clock() - last) >= self.publish_every_s
+
+    def _publish(self, version: int) -> Optional[str]:  # graftcheck: cold
+        """Publish the model's CURRENT state as ``version`` (atomic tmp dir +
+        rename, ``serving.registry.publish_servable``)."""
+        faults.trip("loop.publish", version=version)
+        t0 = time.perf_counter()
+        try:
+            path = publish_servable(self.model, self.publish_dir, version=version)
+        except FileExistsError:
+            # Crash landed between the atomic rename and this bookkeeping on a
+            # previous attempt: the version IS published — adopt it.
+            path = None
+        self.publish_s += time.perf_counter() - t0
+        now = self.clock()
+        self.published_at.setdefault(version, now)
+        self._last_publish_time = now
+        self.published_versions.append(version)
+        metrics.counter(self.scope, MLMetrics.LOOP_PUBLISHED)
+        return path
+
+    def _repair_publish_lag(self) -> List[int]:
+        """Publish the newest trained version if its slot is empty and due —
+        the recovery path after a ``loop.publish`` crash (only the current
+        payload exists in memory, so only the newest version is repairable;
+        intermediate non-due versions were never owed a publish)."""
+        version = self.model.model_version
+        if version <= 0:
+            return []
+        if not (self._cadence_due(version) or self._time_due()):
+            return []
+        if version in self.published_at or version in self._published_on_disk():
+            return []
+        self._publish(version)
+        return [version]
+
+    # -- the training turn -----------------------------------------------------
+    def process(self, max_new_versions: Optional[int] = None) -> tuple:
+        """Advance training and publish due versions.
+
+        Pulls up to ``max_new_versions`` snapshots (None = until the stream
+        runs dry), publishing at each due version boundary via the
+        ``advance(on_snapshot=...)`` seam. Returns
+        ``(versions_trained, versions_published)`` for this turn.
+        """
+        published: List[int] = list(self._repair_publish_lag())
+
+        def on_snapshot(version: int, payload) -> None:
+            if self._cadence_due(version) or self._time_due():
+                self._publish(version)
+                published.append(version)
+
+        trained = self.model.advance(max_new_versions, on_snapshot=on_snapshot)
+        return trained, published

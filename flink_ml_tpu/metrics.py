@@ -65,6 +65,21 @@ class MLMetrics:
     SERVING_WARMUP_COMPILE_MS = "ml.serving.fastpath.warmup.compile.ms"  # AOT warmup wall time, gauge
     SERVING_INFLIGHT_DEPTH = "ml.serving.inflight.depth"  # dispatched-not-finalized batches, gauge
 
+    # Continuous learning loop (loop/ — closed train → publish → serve loop;
+    # scope = "ml.loop[<loop name>]", docs/continuous.md has the table).
+    LOOP_GROUP = "ml.loop"
+    LOOP_PUBLISHED = "ml.loop.versions.published"  # servable versions published, counter
+    LOOP_SWAPPED = "ml.loop.versions.swapped"  # versions flipped into serving, counter
+    LOOP_ROLLBACKS = "ml.loop.rollbacks"  # regressions reverted to N-1, counter
+    LOOP_QUARANTINED = "ml.loop.versions.quarantined"  # bad versions set aside, counter
+    LOOP_PUBLISH_TO_SERVE_MS = "ml.loop.publish.to.serve.ms"  # publish→flip, histogram
+    LOOP_WARM_MS = "ml.loop.warm.ms"  # last pre-flip AOT warm wall time, gauge
+    LOOP_STEPS = "ml.loop.steps"  # loop turns completed, counter
+    LOOP_GOODPUT_FRACTION = "ml.loop.goodput.fraction"  # productive/total time, gauge
+    LOOP_DRIFT_SCORE = "ml.loop.drift.score"  # live model rolling score, gauge
+    LOOP_DRIFT_BASELINE = "ml.loop.drift.baseline"  # reference version score, gauge
+    LOOP_DRIFT_REGRESSIONS = "ml.loop.drift.regressions"  # threshold trips, counter
+
     # Batch transform fast path (builder/batch_plan.py — fused chunked plans;
     # scope = "ml.batch[plan]" unless the caller names its own).
     BATCH_GROUP = "ml.batch"

@@ -189,6 +189,16 @@ class MLPClassifierModel(Model, _MlpParams):
         ]
         return model
 
+    @classmethod
+    def load_servable(cls, path: str):
+        """A saved MLP serves runtime-free through
+        ``MLPClassifierModelServable`` (same W{i}/b{i}/labels arrays, same
+        param names) — the weight-resident throughput serving path and the
+        ``publish_servable`` hook for continuous loops (docs/continuous.md)."""
+        from flink_ml_tpu.servable.lib import MLPClassifierModelServable
+
+        return MLPClassifierModelServable.load_servable(path)
+
     def get_model_data(self):
         from flink_ml_tpu.api.dataframe import DataFrame
 

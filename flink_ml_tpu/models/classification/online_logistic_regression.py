@@ -115,6 +115,17 @@ class OnlineLogisticRegressionModel(
     def _apply_snapshot(self, payload) -> None:
         self.coefficient = np.asarray(payload)
 
+    @classmethod
+    def load_servable(cls, path: str):
+        """A published online-LR version serves through the runtime-free
+        ``LogisticRegressionModelServable`` (same coefficient array, same
+        param names) — this is what lets ``publish_servable(model, dir)``
+        feed the serving tier's poller/fast path directly from a live
+        continuous-training loop (docs/continuous.md)."""
+        from flink_ml_tpu.servable.lib import LogisticRegressionModelServable
+
+        return LogisticRegressionModelServable.load_servable(path)
+
     def transform(self, *inputs):
         (df,) = inputs
         if self.coefficient is None:

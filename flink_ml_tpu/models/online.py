@@ -344,10 +344,22 @@ class OnlineModelBase(ModelArraysMixin, Model):
         return model
 
     # -- the public online surface -------------------------------------------
-    def advance(self, n: Optional[int] = None) -> int:
+    def advance(
+        self,
+        n: Optional[int] = None,
+        on_snapshot: Optional[Callable[[int, Any], None]] = None,
+    ) -> int:
         """Consume up to ``n`` model snapshots (None = until the stream ends);
         returns how many were applied. Each applied snapshot bumps
-        ``ml.model.version`` / ``ml.model.timestamp`` gauges."""
+        ``ml.model.version`` / ``ml.model.timestamp`` gauges.
+
+        ``on_snapshot(version, payload)`` fires after each snapshot is
+        installed — the per-version seam continuous consumers hook (the
+        publish cadence of ``loop/trainer.py`` rides here, so a publisher
+        observes every version boundary without stepping the stream one
+        snapshot at a time). An exception from the callback propagates with
+        the snapshot already applied and counted: training state is intact
+        and a supervised retry resumes at the NEXT version."""
         applied = 0
         while n is None or applied < n:
             try:
@@ -363,4 +375,6 @@ class OnlineModelBase(ModelArraysMixin, Model):
             metrics.gauge(scope, MLMetrics.VERSION, version)
             metrics.gauge(scope, MLMetrics.TIMESTAMP, int(self.clock() * 1000))
             applied += 1
+            if on_snapshot is not None:
+                on_snapshot(version, payload)
         return applied

@@ -29,6 +29,8 @@ __all__ = [
     "compute_dots",
     "kmeans_assign_fn",
     "kmeans_predict_kernel",
+    "mlp_predict_fn",
+    "mlp_predict_kernel",
     "scale_fn",
     "scale_kernel",
     # feature-transform bodies (batch fast path, docs/batch_transform.md)
@@ -153,6 +155,33 @@ def kmeans_predict_kernel(measure_name: str):
     KMeansModelServable."""
     fn = kmeans_assign_fn(measure_name)
     return jax.jit(lambda X, centroids: fn(X, centroids))
+
+
+def mlp_predict_fn(layers, X):
+    """Pure float32 MLP forward: relu hidden layers, softmax head; returns
+    ``(argmax class index as f32, [n, classes] probabilities)``.
+
+    The identical op sequence to the training-side
+    ``mlp_classifier._forward`` + predict head at ``compute_type='float32'``
+    (matmul, add, relu per hidden layer; softmax/argmax on f32 logits), so
+    the weight-resident serving path and the training-side model cannot
+    diverge. ``layers`` is a sequence of ``(W, b)`` pairs — any length; jit
+    retraces per layer-count, which is one trace per architecture.
+    """
+    h = X
+    for W, b in layers[:-1]:
+        h = jax.nn.relu(h @ W + b)
+    W, b = layers[-1]
+    logits = (h @ W + b).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.argmax(logits, axis=-1).astype(jnp.float32), probs
+
+
+@functools.cache
+def mlp_predict_kernel():
+    """Jitted ``mlp_predict_fn`` — the per-stage path of
+    ``MLPClassifierModelServable`` (the fused path composes the same body)."""
+    return jax.jit(lambda layers, X: mlp_predict_fn(layers, X))
 
 
 def scale_fn(X, mean, inv_std, *, with_mean: bool, with_std: bool):

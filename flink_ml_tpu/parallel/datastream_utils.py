@@ -116,13 +116,43 @@ def reduce(
     columns: Columns,
     fn: Callable[[Columns, Columns], Columns],
     ctx: Optional[MeshContext] = None,
+    parallel: Optional[bool] = None,
 ) -> Columns:
-    """Two-stage reduce (ref DataStreamUtils.reduce:153): partial reduce per
-    partition (here: the partition slice itself), then a parallelism-1 final
-    reduce over the partials."""
-    parts = map_partition(columns, lambda part: part, ctx=ctx)
-    acc = parts[0]
-    for other in parts[1:]:
+    """Two-stage reduce (ref DataStreamUtils.reduce:153).
+
+    ``fn`` is a record-level reducer: it receives two one-row column dicts and
+    returns one (the reference's ``ReduceFunction`` over records). Stage 1
+    folds every partition's OWN rows into a single-row partial — running on
+    the ``map_partition`` thread belt, so partials compute concurrently like
+    the reference's per-subtask partial-reduce operators; stage 2 is the
+    parallelism-1 final fold over the per-partition partials. ``fn`` must be
+    associative (any reduce's contract): the row-visit order within a
+    partition is positional, but the partition boundaries move with the mesh's
+    data-axis size.
+
+    Empty input returns the empty columns unchanged; partitions with no rows
+    (more subtasks than rows) contribute no partial, exactly like an empty
+    subtask in the reference.
+    """
+
+    def partial(part: Columns) -> Optional[Columns]:
+        n = _num_rows(part)
+        if n == 0:
+            return None
+        acc = {k: v[0:1] for k, v in part.items()}
+        for i in range(1, n):
+            acc = fn(acc, {k: v[i : i + 1] for k, v in part.items()})
+        return acc
+
+    partials = [
+        p
+        for p in map_partition(columns, partial, ctx=ctx, parallel=parallel)
+        if p is not None
+    ]
+    if not partials:
+        return {k: v[0:0] for k, v in columns.items()}
+    acc = partials[0]
+    for other in partials[1:]:
         acc = fn(acc, other)
     return acc
 

@@ -17,6 +17,7 @@ from flink_ml_tpu.servable.kernel_spec import KernelSpec
 from flink_ml_tpu.servable.lib import (
     KMeansModelServable,
     LogisticRegressionModelServable,
+    MLPClassifierModelServable,
     StandardScalerModelServable,
 )
 
@@ -28,5 +29,6 @@ __all__ = [
     "PipelineModelServable",
     "LogisticRegressionModelServable",
     "KMeansModelServable",
+    "MLPClassifierModelServable",
     "StandardScalerModelServable",
 ]

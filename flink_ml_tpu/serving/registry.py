@@ -33,10 +33,36 @@ from flink_ml_tpu.faults import faults
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.serving.errors import NoModelError
 
-__all__ = ["ModelRegistry", "ModelVersionPoller", "publish_servable"]
+__all__ = [
+    "ModelRegistry",
+    "ModelVersionPoller",
+    "publish_servable",
+    "quarantine_version",
+]
 
 VERSION_PREFIX = "v-"
 _METADATA_MARKER = "metadata"  # written by save_metadata; last file of a stage save
+_QUARANTINE_SUFFIX = ".quarantined"
+
+
+def quarantine_version(directory: str, version: int) -> Optional[str]:
+    """Move a published ``v-<N>`` dir aside as ``v-<N>.quarantined`` — the
+    checkpoint tier's corrupt-snapshot semantics (``ckpt-N.corrupt``): kept for
+    forensics, invisible to ``scan_numbered_dirs`` (the suffixed name no longer
+    parses), so neither a poller nor a restarted loop can ever reload it.
+    Idempotent: a version already quarantined (or never published) returns
+    None, so a supervised retry that crashed mid-rollback just falls through.
+    """
+    src = os.path.join(directory, f"{VERSION_PREFIX}{version}")
+    if not os.path.exists(src):
+        return None
+    dst = src + _QUARANTINE_SUFFIX
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{src}{_QUARANTINE_SUFFIX}.{n}"
+    os.rename(src, dst)
+    return dst
 
 
 def publish_servable(stage, directory: str, version: Optional[int] = None) -> str:
@@ -89,10 +115,22 @@ class ModelRegistry:
             raise NoModelError("no model version loaded yet")
         return current
 
-    def swap(self, version: int, servable) -> None:
+    def swap(self, version: int, servable, *, allow_rollback: bool = False) -> None:
+        """Atomically install ``(version, servable)``.
+
+        Versions must advance — a response's ``model_version`` is unambiguous
+        forever — except under ``allow_rollback``, the controlled revert path
+        (loop/rollback.py): a drift rollback re-installs an OLDER version, and
+        the registry permits exactly that regression (never the same version;
+        an equal number would make two different servables indistinguishable
+        in responses)."""
         with self._lock:
             previous = self._current
-            if previous is not None and version <= previous[0]:
+            if previous is not None and version == previous[0]:
+                raise ValueError(
+                    f"hot swap must advance the version: {version} is already serving"
+                )
+            if previous is not None and version < previous[0] and not allow_rollback:
                 raise ValueError(
                     f"hot swap must advance the version: {version} <= serving {previous[0]}"
                 )

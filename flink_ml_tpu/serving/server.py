@@ -273,6 +273,15 @@ class InferenceServer:
         self.warmup(servable)
         self.registry.swap(version, servable)
 
+    def rollback(self, version: int, servable) -> None:
+        """Warm then atomically REVERT serving to an older ``version`` — the
+        drift-rollback path (loop/rollback.py). Same discipline as ``swap``:
+        the restored version's plan is rebuilt and AOT-warmed on the caller's
+        thread before the flip, so the rollback itself never puts a compile on
+        the serving path."""
+        self.warmup(servable)
+        self.registry.swap(version, servable, allow_rollback=True)
+
     def attach_poller(
         self,
         directory: str,

@@ -88,6 +88,84 @@ def test_bounded_iteration_max_epochs_safety_bound():
     iterate_bounded_until_termination([0], body, config=config)  # must not hang
 
 
+class TestPerRoundLifecycle:
+    """OperatorLifeCycle.PER_ROUND — the forEachRound contract
+    (BoundedPerRoundStreamIterationITCase shape): the body factory builds a
+    FRESH epoch body per round, so per-instance state never leaks across
+    rounds; cross-round state flows only through the feedback variables."""
+
+    class _StatefulBody:
+        """A body whose instance state would corrupt results if reused."""
+
+        def __init__(self, per_instance_calls):
+            self.calls = 0  # fresh per PER_ROUND instance
+            self._log = per_instance_calls
+
+        def __call__(self, variables, epoch, streams=None):
+            self.calls += 1
+            self._log.append(self.calls)
+            (x,) = variables
+            # `calls` enters the math: an ALL_ROUND-style reuse would add
+            # 1, 2, 3, ... instead of 1 every round.
+            x = x + float(self.calls)
+            return IterationBodyResult([x], outputs=[x])
+
+    def test_bounded_per_round_builds_fresh_body_each_epoch(self):
+        from flink_ml_tpu.iteration import OperatorLifeCycle
+
+        log = []
+        factory = lambda: self._StatefulBody(log)  # noqa: E731
+        config = IterationConfig(
+            operator_life_cycle=OperatorLifeCycle.PER_ROUND, max_epochs=4
+        )
+        outs = iterate_bounded_until_termination([0.0], factory, config=config)
+        assert log == [1, 1, 1, 1]  # every round saw a fresh instance
+        assert float(outs[0]) == 4.0  # 0 + 1 + 1 + 1 + 1
+
+    def test_all_round_keeps_one_body_instance(self):
+        log = []
+        body = self._StatefulBody(log)
+        config = IterationConfig(max_epochs=4)  # default ALL_ROUND
+        outs = iterate_bounded_until_termination([0.0], body, config=config)
+        assert log == [1, 2, 3, 4]  # the same instance accumulated state
+        assert float(outs[0]) == 10.0
+
+    def test_unbounded_per_round_builds_fresh_body_each_batch(self):
+        from flink_ml_tpu.iteration import OperatorLifeCycle
+
+        log = []
+        batches = [{"x": np.full(2, float(i))} for i in range(3)]
+
+        def factory():
+            inner = self._StatefulBody(log)
+
+            def body(variables, batch, epoch):
+                inner.calls += 1
+                log.append(inner.calls)
+                (total,) = variables
+                return IterationBodyResult(
+                    [total + batch["x"].sum()], outputs=[float(total)]
+                )
+
+            return body
+
+        config = IterationConfig(operator_life_cycle=OperatorLifeCycle.PER_ROUND)
+        outs = list(iterate_unbounded([0.0], iter(batches), factory, config=config))
+        assert log == [1, 1, 1]
+        assert outs == [0.0, 0.0, 2.0]
+
+    def test_per_round_rejects_non_factory_body(self):
+        from flink_ml_tpu.iteration import OperatorLifeCycle
+
+        config = IterationConfig(
+            operator_life_cycle=OperatorLifeCycle.PER_ROUND, max_epochs=2
+        )
+        with pytest.raises(TypeError, match="zero-arg factory"):
+            iterate_bounded_until_termination(
+                [0.0], lambda: 42, config=config  # factory returns a non-callable
+            )
+
+
 def test_unbounded_iteration_yields_per_batch():
     """Model-as-stream: one output per arriving window (UnboundedStreamIterationITCase)."""
     batches = [{"x": np.full(4, float(i))} for i in range(3)]

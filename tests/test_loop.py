@@ -1,0 +1,449 @@
+"""Continuous-learning-loop tests (docs/continuous.md).
+
+The closed train → publish → serve loop exercised as ONE system — the
+ROADMAP item 3 scenario: an online FTRL trainer on a feedable stream,
+versions published on a cadence, every flip AOT-warmed before activation,
+drift scored on labelled tail traffic through the REAL serving path, and
+automatic rollback to the newest intact older version on regression —
+plus deterministic fault injection at the three loop seams
+(``loop.publish``, ``loop.swap``, ``loop.rollback``) and a full kill/resume
+(new incarnation, same checkpoint + publish dirs) recovery proof.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.checkpoint import CheckpointManager
+from flink_ml_tpu.execution import Supervisor
+from flink_ml_tpu.faults import InjectedFault, faults
+from flink_ml_tpu.linalg.vectors import DenseVector
+from flink_ml_tpu.loop import (
+    ContinuousLearningLoop,
+    ContinuousTrainer,
+    DriftMonitor,
+    RollbackImpossibleError,
+    auc,
+    logloss,
+)
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.models.classification.online_logistic_regression import (
+    OnlineLogisticRegression,
+)
+from flink_ml_tpu.models.online import QueueBatchStream
+from flink_ml_tpu.serving import InferenceServer, ServingConfig
+from flink_ml_tpu.serving.registry import quarantine_version
+
+D = 8
+_TRUE_W = np.linspace(1.0, -1.0, D)
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _batch(n=64, seed=0, flip=False):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, D))
+    y = (X @ _TRUE_W > 0).astype(np.float64)
+    if flip:
+        y = 1.0 - y
+    return {"features": X.astype(np.float64), "label": y}
+
+
+def _estimator(alpha=1.0, checkpoint_dir=None):
+    est = (
+        OnlineLogisticRegression()
+        .set_initial_model_data(
+            DataFrame(["coefficient"], None, [[DenseVector(np.zeros(D))]])
+        )
+        .set_alpha(alpha)
+        .set_global_batch_size(64)
+    )
+    if checkpoint_dir is not None:
+        est.set_checkpoint(CheckpointManager(str(checkpoint_dir)), interval=1)
+    return est
+
+
+def _server(name):
+    return InferenceServer(
+        name=name,
+        serving_config=ServingConfig(max_batch_size=8, max_delay_ms=0.5),
+        warmup_template=DataFrame.from_dict(
+            {"features": _batch(1, seed=99)["features"]}
+        ),
+    )
+
+
+def _eval_source():
+    return DataFrame.from_dict(_batch(32, seed=7))
+
+
+def _make_loop(tmp_path, name, *, publish_every=2, checkpoint_dir=None, stream=None):
+    stream = stream if stream is not None else QueueBatchStream()
+    scope = f"{MLMetrics.LOOP_GROUP}[{name}]"
+    trainer = ContinuousTrainer(
+        _estimator(checkpoint_dir=checkpoint_dir),
+        stream,
+        str(tmp_path / "pub"),
+        publish_every_versions=publish_every,
+        scope=scope,
+    )
+    server = _server(name)
+    loop = ContinuousLearningLoop(
+        trainer,
+        server,
+        eval_source=_eval_source,
+        name=name,
+        monitor=DriftMonitor(
+            window=2, rel_threshold=0.2, min_scores=1, scope=scope
+        ),
+    )
+    return loop, trainer, server, stream
+
+
+def _serve_traffic(server, seed=123, requests=4, rows=4):
+    """Client traffic through the real request path; returns
+    (errors, served versions) — the zero-serving-errors probe."""
+    X = _batch(requests * rows, seed=seed)["features"]
+    errors, versions = 0, []
+    for i in range(requests):
+        try:
+            resp = server.predict(
+                DataFrame.from_dict({"features": X[i * rows : (i + 1) * rows]})
+            )
+            versions.append(resp.model_version)
+        except Exception:
+            errors += 1
+    return errors, versions
+
+
+class TestEndToEndScenario:
+    def test_stream_to_versions_to_drift_to_rollback(self, tmp_path):
+        """The acceptance scenario: stream in → ≥3 versions trained AND
+        published AND served → drift injected via label-flipped training →
+        automatic rollback to the newest good version — with (a) zero
+        fast-path compiles on the serving path (every flip AOT-warmed before
+        activation), (b) zero serving errors throughout, and (c) ml.loop.*
+        metrics consistent with the injected schedule."""
+        name = "t-loop-e2e"
+        loop, trainer, server, stream = _make_loop(tmp_path, name)
+        scope = loop.scope
+        pub = trainer.publish_dir
+
+        # --- phase 1: healthy stream, 3 versions published and served ------
+        for i in range(6):
+            stream.add(_batch(seed=i))
+        reports = loop.run(publish_target=3, max_steps=10)
+        assert trainer.published_versions == [2, 4, 6]
+        assert server.model_version == 6
+        swapped = [r.swapped for r in reports if r.swapped is not None]
+        assert swapped == [2, 4, 6]
+        errors, versions = _serve_traffic(server, seed=200)
+        assert errors == 0 and set(versions) == {6}
+
+        # --- phase 2: drift injected — flipped labels degrade the model ----
+        for i in range(4):
+            stream.add(_batch(seed=50 + i, flip=True))
+        reports2 = loop.run(publish_target=4, max_steps=10)
+        rollbacks = [r for r in reports2 if r.rolled_back_to is not None]
+        assert len(rollbacks) == 1
+        assert rollbacks[-1].rolled_back_to == 6  # reverted to N-1 (last good)
+        assert server.model_version == 6
+        # the bad version is quarantined on disk, invisible to any scan
+        names = sorted(os.listdir(pub))
+        assert "v-8.quarantined" in names and "v-8" not in names
+        assert {"v-2", "v-4", "v-6"} <= set(names)
+
+        # (a) every flip was AOT-warmed: zero serving-path compiles, and the
+        # fast path genuinely served (fused batches happened)
+        assert not metrics.get(server.scope, MLMetrics.SERVING_FASTPATH_COMPILES)
+        assert metrics.get(server.scope, MLMetrics.SERVING_FUSED_BATCHES, 0) > 0
+
+        # (b) zero serving errors during swaps and rollback — the eval
+        # traffic above already rode every swap; a final probe serves from
+        # the restored version
+        errors, versions = _serve_traffic(server, seed=300)
+        assert errors == 0 and set(versions) == {6}
+
+        # (c) ml.loop.* metrics consistent with the injected schedule
+        scraped = metrics.scope(scope)
+        assert scraped[MLMetrics.LOOP_PUBLISHED] == 4  # v2, v4, v6, v8
+        assert scraped[MLMetrics.LOOP_SWAPPED] == 4
+        assert scraped[MLMetrics.LOOP_ROLLBACKS] == 1
+        assert scraped[MLMetrics.LOOP_QUARANTINED] == 1
+        assert scraped[MLMetrics.LOOP_DRIFT_REGRESSIONS] == 1
+        hist = scraped[MLMetrics.LOOP_PUBLISH_TO_SERVE_MS]
+        assert hist.count == 4  # one publish→serve latency per flip
+        assert all(v >= 0.0 for v in hist.values())
+        assert scraped[MLMetrics.LOOP_WARM_MS] > 0.0
+        goodput = scraped[MLMetrics.LOOP_GOODPUT_FRACTION]
+        assert 0.0 < goodput <= 1.0
+        assert scraped[MLMetrics.LOOP_STEPS] == len(reports) + len(reports2)
+        assert scraped[MLMetrics.LOOP_DRIFT_SCORE] > scraped[
+            MLMetrics.LOOP_DRIFT_BASELINE
+        ]  # the regression verdict's own evidence
+        server.close()
+
+
+class TestLoopFaultPoints:
+    def test_loop_publish_fault_recovers_without_version_gap(self, tmp_path):
+        """loop.publish killed mid-step: the supervised retry republishes the
+        lagging version — no version reuse, no gap, publish counter exact."""
+        name = "t-loop-fp-publish"
+        loop, trainer, server, stream = _make_loop(
+            tmp_path, name, publish_every=1
+        )
+        for i in range(3):
+            stream.add(_batch(seed=i))
+        faults.arm("loop.publish", at=1)
+        sup = Supervisor(name=name)
+        loop.run(publish_target=3, max_steps=10, supervisor=sup)
+        assert sup.restarts == 1
+        assert faults.fires("loop.publish") == 1
+        assert trainer.published_versions == [1, 2, 3]
+        assert sorted(os.listdir(trainer.publish_dir)) == ["v-1", "v-2", "v-3"]
+        assert metrics.get(loop.scope, MLMetrics.LOOP_PUBLISHED) == 3
+        errors, versions = _serve_traffic(server)
+        assert errors == 0 and set(versions) == {3}
+        server.close()
+
+    def test_loop_swap_fault_keeps_serving_and_retry_flips(self, tmp_path):
+        """loop.swap killed between publish and flip: the in-service version
+        keeps serving through the fault; the retried step completes a flip to
+        the newest published version with zero serving errors."""
+        name = "t-loop-fp-swap"
+        loop, trainer, server, stream = _make_loop(
+            tmp_path, name, publish_every=1
+        )
+        stream.add(_batch(seed=0))
+        loop.run(publish_target=1, max_steps=5)
+        assert server.model_version == 1
+        # arm the swap seam, feed more data, run supervised
+        faults.arm("loop.swap", at=1)
+        for i in range(1, 3):
+            stream.add(_batch(seed=i))
+        sup = Supervisor(name=name)
+        loop.run(publish_target=3, max_steps=10, supervisor=sup)
+        assert sup.restarts == 1
+        assert faults.fires("loop.swap") == 1
+        assert server.model_version == 3  # newest published won the retry flip
+        errors, versions = _serve_traffic(server)
+        assert errors == 0 and set(versions) == {3}
+        server.close()
+
+    def test_loop_rollback_fault_retry_completes_revert(self, tmp_path):
+        """loop.rollback killed after the regression verdict: serving stays on
+        the (bad but functional) version — zero errors — and the supervised
+        retry finishes quarantine + revert to the last good version."""
+        name = "t-loop-fp-rollback"
+        loop, trainer, server, stream = _make_loop(tmp_path, name)
+        for i in range(6):
+            stream.add(_batch(seed=i))
+        loop.run(publish_target=3, max_steps=10)
+        assert server.model_version == 6
+        faults.arm("loop.rollback", at=1)
+        for i in range(6):
+            stream.add(_batch(seed=50 + i, flip=True))
+        sup = Supervisor(name=name)
+        loop.run(publish_target=5, max_steps=12, supervisor=sup)
+        assert sup.restarts >= 1
+        assert faults.fires("loop.rollback") == 1
+        # the revert landed: serving is on a good (pre-drift) version and at
+        # least one bad version is quarantined on disk
+        assert server.model_version <= 6
+        assert any(
+            n.endswith(".quarantined") for n in os.listdir(trainer.publish_dir)
+        )
+        assert metrics.get(loop.scope, MLMetrics.LOOP_ROLLBACKS, 0) >= 1
+        errors, _ = _serve_traffic(server)
+        assert errors == 0
+        server.close()
+
+
+class TestKillResume:
+    def test_kill_resume_restores_checkpoint_and_last_good_version(self, tmp_path):
+        """Hard kill mid-loop (no supervisor — the process-death analogue):
+        a NEW incarnation pointed at the same checkpoint + publish dirs
+        resumes training from the checkpointed version (no reuse, no gap) and
+        serving from the last good published version, with zero serving
+        errors across the whole recovery window."""
+        ckpt = tmp_path / "ckpt"
+        name1 = "t-loop-kill-1"
+        loop1, trainer1, server1, stream1 = _make_loop(
+            tmp_path, name1, publish_every=1, checkpoint_dir=ckpt
+        )
+        for i in range(4):
+            stream1.add(_batch(seed=i))
+        loop1.run(publish_target=2, max_steps=5)
+        assert trainer1.published_versions == [1, 2]
+        assert server1.model_version == 2
+        # the kill: online.step fault with NO supervisor — propagates like a
+        # process death between version 2 and version 3
+        faults.arm("online.step", at=1)
+        with pytest.raises(InjectedFault):
+            loop1.step()
+        faults.reset()
+        # the serving half survives a trainer crash: still on v2, no errors
+        errors, versions = _serve_traffic(server1)
+        assert errors == 0 and set(versions) == {2}
+        server1.close()
+
+        # --- incarnation 2: same dirs, replayed stream ---------------------
+        name2 = "t-loop-kill-2"
+        stream2 = QueueBatchStream()
+        for i in range(4):  # the replay-from-the-beginning contract
+            stream2.add(_batch(seed=i))
+        for i in range(4, 6):  # new traffic beyond the crash point
+            stream2.add(_batch(seed=i))
+        loop2, trainer2, server2, _ = _make_loop(
+            tmp_path, name2, publish_every=1, checkpoint_dir=ckpt, stream=stream2
+        )
+        # recovery turn: serving comes back FIRST, from the last good
+        # published version, before any new training happens
+        report = loop2.step(train_versions=0)
+        assert report.trained == 0
+        assert server2.model_version == 2
+        errors, versions = _serve_traffic(server2)
+        assert errors == 0 and set(versions) == {2}
+        # training resumes from the checkpoint: next version is 3 — the
+        # replayed prefix is skipped, nothing reused, nothing lost
+        loop2.run(publish_target=2, max_steps=8)
+        assert trainer2.published_versions == [3, 4]
+        assert trainer2.model.model_version == 4
+        assert server2.model_version == 4
+        assert sorted(os.listdir(trainer2.publish_dir)) == [
+            "v-1", "v-2", "v-3", "v-4",
+        ]
+        errors, versions = _serve_traffic(server2)
+        assert errors == 0 and set(versions) == {4}
+        server2.close()
+
+
+class TestDriftMonitor:
+    def test_rolling_window_bounds_and_means(self):
+        monitor = DriftMonitor(window=3, scope="ml.loop[t-dm]")
+        for s in (1.0, 2.0, 3.0, 4.0):
+            monitor.observe(1, s)
+        assert monitor.count(1) == 3  # oldest dropped
+        assert monitor.mean(1) == pytest.approx(3.0)
+        assert monitor.mean(2) is None
+
+    def test_loss_regression_thresholds(self):
+        monitor = DriftMonitor(
+            window=4, rel_threshold=0.5, min_scores=1, scope="ml.loop[t-dm2]"
+        )
+        monitor.observe(1, 0.2)
+        monitor.observe(2, 0.25)  # within 1.5x baseline: fine
+        assert not monitor.regressed(2, 1)
+        monitor.observe(3, 0.5)  # 2.5x baseline: regressed
+        assert monitor.regressed(3, 1)
+        assert (
+            metrics.get("ml.loop[t-dm2]", MLMetrics.LOOP_DRIFT_REGRESSIONS) == 1
+        )
+
+    def test_higher_is_better_direction(self):
+        monitor = DriftMonitor(
+            window=4,
+            rel_threshold=0.1,
+            min_scores=1,
+            higher_is_better=True,
+            scope="ml.loop[t-dm3]",
+        )
+        monitor.observe(1, 0.9)  # AUC-style baseline
+        monitor.observe(2, 0.88)
+        assert not monitor.regressed(2, 1)
+        monitor.observe(3, 0.6)
+        assert monitor.regressed(3, 1)
+
+    def test_min_scores_guard_and_missing_baseline(self):
+        monitor = DriftMonitor(
+            window=4, rel_threshold=0.0, min_scores=2, scope="ml.loop[t-dm4]"
+        )
+        monitor.observe(1, 0.1)
+        monitor.observe(2, 10.0)  # hugely worse, but only one observation
+        assert not monitor.regressed(2, 1)
+        assert not monitor.regressed(2, None)  # no baseline: never regress
+        monitor.observe(2, 10.0)
+        assert monitor.regressed(2, 1)
+
+    def test_logloss_and_auc_helpers(self):
+        y = np.array([0.0, 0.0, 1.0, 1.0])
+        good = np.array([0.1, 0.2, 0.8, 0.9])
+        bad = 1.0 - good
+        assert logloss(y, good) < logloss(y, bad)
+        assert auc(y, good) == 1.0
+        assert auc(y, bad) == 0.0
+        assert auc(y, np.full(4, 0.5)) == 0.5
+        assert auc(np.zeros(4), good) == 0.5  # degenerate single-class window
+
+
+class TestTrainerCadence:
+    def test_publish_every_n_versions(self, tmp_path):
+        stream = QueueBatchStream()
+        for i in range(5):
+            stream.add(_batch(seed=i))
+        trainer = ContinuousTrainer(
+            _estimator(),
+            stream,
+            str(tmp_path / "pub"),
+            publish_every_versions=2,
+            scope="ml.loop[t-cadence]",
+        )
+        trainer.start()
+        trained, published = trainer.process()
+        assert trained == 5
+        assert published == [2, 4]
+        assert sorted(os.listdir(trainer.publish_dir)) == ["v-2", "v-4"]
+
+    def test_time_based_publish_trigger(self, tmp_path):
+        stream = QueueBatchStream()
+        for i in range(3):
+            stream.add(_batch(seed=i))
+        trainer = ContinuousTrainer(
+            _estimator(),
+            stream,
+            str(tmp_path / "pub"),
+            publish_every_versions=100,  # cadence never fires
+            publish_every_s=10.0,
+            scope="ml.loop[t-time]",
+        )
+        now = [1000.0]
+        trainer.clock = lambda: now[0]
+        trainer.start()
+        trained, published = trainer.process(1)
+        assert published == [1]  # nothing published yet: time trigger fires
+        now[0] += 5.0
+        trained, published = trainer.process(1)
+        assert published == []  # inside the budget window
+        now[0] += 6.0
+        trained, published = trainer.process(0)
+        # budget exceeded: the lag repair publishes the newest TRAINED
+        # version (v2) even before any new training happens
+        assert published == [2]
+        assert trained == 0
+        now[0] += 11.0
+        trained, published = trainer.process(1)
+        assert published == [3]  # v3 trains and the lapsed budget publishes it
+
+    def test_quarantine_version_is_idempotent(self, tmp_path):
+        d = str(tmp_path)
+        os.makedirs(os.path.join(d, "v-3"))
+        assert quarantine_version(d, 3).endswith("v-3.quarantined")
+        assert quarantine_version(d, 3) is None  # already gone
+        assert os.path.isdir(os.path.join(d, "v-3.quarantined"))
+
+    def test_rollback_impossible_without_older_version(self, tmp_path):
+        name = "t-loop-noroll"
+        loop, trainer, server, stream = _make_loop(
+            tmp_path, name, publish_every=1
+        )
+        stream.add(_batch(seed=0))
+        loop.run(publish_target=1, max_steps=3)
+        with pytest.raises(RollbackImpossibleError):
+            loop.controller.rollback(server.model_version)
+        server.close()

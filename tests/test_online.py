@@ -112,6 +112,41 @@ class TestOnlineLogisticRegression:
         assert model.advance() == 1  # empty frame skipped, real batch trained
         assert model.model_version == 1
 
+    def test_advance_on_snapshot_hook_fires_per_version(self, tmp_path):
+        """The per-version seam the continuous loop's publisher rides
+        (loop/trainer.py): on_snapshot fires after each snapshot is applied,
+        with the applied version and payload; a callback exception propagates
+        with training state intact so a retry resumes at the NEXT version."""
+        stream = QueueBatchStream()
+        model = (
+            OnlineLogisticRegression()
+            .set_initial_model_data(_init_lr_model_data())
+            .fit(stream)
+        )
+        seen = []
+
+        def hook(version, payload):
+            assert model.model_version == version  # applied BEFORE the hook
+            seen.append((version, np.asarray(payload).copy()))
+
+        stream.add(_lr_batch(seed=1))
+        stream.add(_lr_batch(seed=2))
+        assert model.advance(on_snapshot=hook) == 2
+        assert [v for v, _ in seen] == [1, 2]
+        np.testing.assert_array_equal(seen[-1][1], model.coefficient)
+
+        stream.add(_lr_batch(seed=3))
+
+        def boom(version, payload):
+            raise RuntimeError("publisher crashed")
+
+        with pytest.raises(RuntimeError, match="publisher crashed"):
+            model.advance(on_snapshot=boom)
+        assert model.model_version == 3  # the snapshot itself was applied
+        stream.add(_lr_batch(seed=4))
+        assert model.advance() == 1  # training continues at the next version
+        assert model.model_version == 4
+
     def test_save_load_preserves_model_version(self, tmp_path):
         stream = QueueBatchStream()
         model = (

@@ -105,6 +105,67 @@ def test_kmeans_servable_parity(tmp_path):
     np.testing.assert_array_equal(servable.weights, model.weights)
 
 
+def test_mlp_servable_parity_and_fused_path(tmp_path):
+    """MLPClassifierModel.save → load_servable → transform identical to the
+    training-side model (same mlp_predict_fn body), and the fused
+    CompiledServingPlan path matches the per-stage servable path bit for bit
+    (weight-resident layers, device-side label gather)."""
+    from flink_ml_tpu.models.classification.mlp_classifier import MLPClassifier
+    from flink_ml_tpu.servable import MLPClassifierModelServable
+    from flink_ml_tpu.servable.api import load_servable
+    from flink_ml_tpu.serving.plan import CompiledServingPlan
+
+    X = RNG.normal(size=(96, 6))
+    y = RNG.integers(0, 3, size=96).astype(np.float64) * 2  # class values 0/2/4
+    df = DataFrame.from_dict({"features": X, "label": y})
+    model = (
+        MLPClassifier()
+        .set_hidden_layers(16)
+        .set_max_iter(3)
+        .set_global_batch_size(48)
+        .fit(df)
+    )
+    path = str(tmp_path / "mlp")
+    model.save(path)
+    servable = load_servable(path)
+    assert isinstance(servable, MLPClassifierModelServable)
+    assert len(servable.layers) == 2  # hidden + head
+    features = df.drop("label")
+    out_model = model.transform(df)
+    out_servable = servable.transform(features)
+    np.testing.assert_array_equal(
+        out_servable["prediction"], out_model["prediction"]
+    )
+    np.testing.assert_array_equal(
+        np.stack(out_servable["rawPrediction"]),
+        np.stack(out_model["rawPrediction"]),
+    )
+    # fused plan (weight-resident, single AOT program) == per-stage servable
+    plan = CompiledServingPlan.build(servable, scope="ml.serving[t-mlp]")
+    assert plan is not None
+    out_fused = plan.execute(features)
+    np.testing.assert_array_equal(
+        np.asarray(out_fused["prediction"]), np.asarray(out_servable["prediction"])
+    )
+    np.testing.assert_array_equal(
+        np.stack(out_fused["rawPrediction"]),
+        np.stack(out_servable["rawPrediction"]),
+    )
+
+
+def test_mlp_servable_requires_model_data():
+    from flink_ml_tpu.servable import MLPClassifierModelServable
+
+    servable = MLPClassifierModelServable()
+    df = DataFrame.from_dict({"features": np.zeros((2, 3))})
+    with pytest.raises(RuntimeError, match="set_model_data"):
+        servable.transform(df)
+    with pytest.raises(RuntimeError, match="set_model_data"):
+        servable.kernel_spec()
+    with pytest.raises(ValueError, match="W0/b0"):
+        servable._apply_model_arrays({"labels": np.arange(3.0)})
+
+
 def test_standard_scaler_servable_parity(tmp_path):
     """StandardScalerModel.save → load_servable → transform identical
     (shared scale_kernel), params withMean/withStd restored."""

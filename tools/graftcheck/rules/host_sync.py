@@ -55,6 +55,9 @@ DEVICE_TIER_PREFIXES = (
     "flink_ml_tpu/servable/",
     "flink_ml_tpu/builder/",
     "flink_ml_tpu/ops/",
+    # the continuous loop's serve/evaluate turns touch device-backed serving
+    # results; its publish/warm/rollback edges are `# graftcheck: cold`
+    "flink_ml_tpu/loop/",
 )
 
 _KIND_MESSAGES = {

@@ -45,6 +45,9 @@ SCOPE_PREFIXES = (
     # the batch fast path composes kernel specs into fused AOT chains, same
     # stakes as serving/ — an impure call would burn into every chunk program
     "flink_ml_tpu/builder/",
+    # the continuous loop drives serving swaps + eval traffic: any jitted fn
+    # it introduces carries the serving tier's purity stakes
+    "flink_ml_tpu/loop/",
 )
 
 _TIME_ATTRS = {"time", "perf_counter", "monotonic", "time_ns", "perf_counter_ns"}

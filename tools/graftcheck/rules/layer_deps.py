@@ -63,6 +63,13 @@ PACKAGE_LAYERS = {
     "builder": 2,
     "models": 3,
     "benchmark": 3,
+    # The continuous-learning loop composes the serving tier's publish/swap
+    # machinery WITH the model library's online estimators and the execution
+    # supervisor, so it sits above all of them at the library layer — the
+    # serving-tier pieces it drives (registry, poller, fast path) stay at L1
+    # and keep their runtime-free guarantee; the loop is the one place the
+    # two halves are allowed to meet (docs/continuous.md).
+    "loop": 3,
     # the root package surface (flink_ml_tpu/__init__.py) re-exports the API
     "": 3,
 }
