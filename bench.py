@@ -1612,6 +1612,85 @@ def _bench_pipeline_batch_transform_body():
     }
 
 
+def bench_tracing_overhead():
+    """graftscope acceptance row (docs/observability.md): the same
+    single-client serving loop with tracing off vs on.
+
+    Off is the default production state — the contract is that the disabled
+    tracer is one attribute check per instrumented site, so the off leg must
+    match the untraced PR 7 baseline path (tier-1 asserts the structural
+    half: zero spans, shared no-op span, no per-request span allocation; this
+    row quantifies the residual). The on leg prices full span recording —
+    ~7 spans per request — for capacity planning.
+    """
+    from flink_ml_tpu import trace
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.servable.lib import LogisticRegressionModelServable
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    rng = np.random.default_rng(17)
+    dim = 256
+    X = rng.standard_normal((2048, dim)).astype(np.float32)
+    requests = 400
+    req_rows = 8
+
+    def run_leg(name):
+        servable = LogisticRegressionModelServable().set_features_col("features")
+        servable.coefficient = rng.standard_normal(dim).astype(np.float32)
+        server = InferenceServer(
+            servable,
+            name=name,
+            serving_config=ServingConfig(
+                max_batch_size=64,
+                max_delay_ms=0.0,  # single client: coalescing buys nothing
+                default_timeout_ms=120_000,
+            ),
+            warmup_template=DataFrame.from_dict({"features": X[:1]}),
+        )
+        try:
+            t0 = time.perf_counter()
+            for i in range(requests):
+                j = (i * 61) % (X.shape[0] - req_rows)
+                server.predict(DataFrame.from_dict({"features": X[j : j + req_rows]}))
+            elapsed = time.perf_counter() - t0
+            hist = metrics.histogram(server.scope, MLMetrics.SERVING_LATENCY_MS)
+            p50, p99 = hist.quantiles((0.5, 0.99))
+            return {
+                "requests": requests,
+                "request_rows": req_rows,
+                "rows_per_sec": round(requests * req_rows / elapsed, 1),
+                "latency_p50_ms": round(p50, 3),
+                "latency_p99_ms": round(p99, 3),
+            }
+        finally:
+            server.close()
+
+    off = run_leg("bench-trace-off")
+    assert not trace.tracer.enabled
+    with trace.capture() as recorder:
+        on = run_leg("bench-trace-on")
+        on["spans"] = recorder.recorded
+        report = recorder.goodput_report()
+        on["goodput_fraction"] = round(
+            report.fraction("ml.serving[bench-trace-on]") or 0.0, 4
+        )
+    overhead = (
+        round(100.0 * (on["latency_p50_ms"] / off["latency_p50_ms"] - 1.0), 1)
+        if off["latency_p50_ms"]
+        else None
+    )
+    return {
+        "name": "tracing_overhead_serving_microbatch",
+        "off": off,
+        "on": on,
+        "p50_overhead_pct": overhead,
+        "note": "single-client closed loop, d=256 logistic servable; off = "
+        "default disabled tracer (one attribute check per site), on = full "
+        "span recording incl. queue/pad/dispatch/readback tree per request",
+    }
+
+
 def bench_mlp_forward(peak_flops):
     import jax
     import jax.numpy as jnp
@@ -1674,6 +1753,7 @@ def main() -> None:
     attention = bench_attention(peak)
     attention_train = bench_attention_train(peak)
     serving = bench_serving()
+    tracing = bench_tracing_overhead()
     mlp_serving = bench_mlp_serving_throughput()
     continuous_loop = bench_continuous_loop()
     batch_transform = bench_pipeline_batch_transform()
@@ -1684,8 +1764,8 @@ def main() -> None:
         "peak_hbm_gbps": peak_bw,
         "workloads": [
             logreg, sparse, sweep, sparse_streamed, overlap, kmeans, mlp,
-            mlp_train, attention, attention_train, serving, mlp_serving,
-            continuous_loop, batch_transform,
+            mlp_train, attention, attention_train, serving, tracing,
+            mlp_serving, continuous_loop, batch_transform,
         ],
     }
     with open("BENCH_DETAIL.json", "w") as f:

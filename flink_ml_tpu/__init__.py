@@ -18,6 +18,8 @@ Layer map (mirrors the reference's Maven layering, reference SURVEY.md section 1
   - ``serving``     : online serving runtime (micro-batching, hot swap, fast path)
   - ``loop``        : continuous learning loop — closed train → publish → serve
                       with drift detection and rollback (docs/continuous.md)
+  - ``trace``       : graftscope structured tracing + goodput attribution
+                      across all tiers (docs/observability.md)
   - ``benchmark``   : JSON-config benchmark harness (ref flink-ml-benchmark)
 """
 

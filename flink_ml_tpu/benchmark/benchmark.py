@@ -170,8 +170,24 @@ def main(argv=None) -> int:
         help="emit a jax.profiler trace per benchmark under DIR "
         "(view with TensorBoard/XProf or Perfetto)",
     )
+    parser.add_argument(
+        "--trace",
+        metavar="FILE",
+        help="record graftscope spans across the run and export Chrome "
+        "trace-event JSON to FILE (analyze with tools/traceview.py or "
+        "Perfetto; combine with --profile to nest spans in the XLA dump "
+        "via observability.trace.xprof)",
+    )
     args = parser.parse_args(argv)
-    results = run_config(args.config, profile_dir=args.profile)
+    if args.trace:
+        from flink_ml_tpu import trace
+
+        with trace.capture() as recorder:
+            results = run_config(args.config, profile_dir=args.profile)
+        n = recorder.export_chrome_trace(args.trace)
+        print(f"graftscope: {n} spans written to {args.trace}", file=sys.stderr)
+    else:
+        results = run_config(args.config, profile_dir=args.profile)
     payload = json.dumps(results, indent=2)
     if args.output_file:
         with open(args.output_file, "w") as f:

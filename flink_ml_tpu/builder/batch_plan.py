@@ -56,6 +56,7 @@ from flink_ml_tpu.servable.planner import (
     build_segments,
     run_segment,
 )
+from flink_ml_tpu.trace import CAT_PRODUCTIVE, CAT_READBACK, tracer
 
 __all__ = ["BatchPlanInapplicable", "CompiledBatchPlan"]
 
@@ -138,20 +139,22 @@ class CompiledBatchPlan:
         """Run the chain. Fused segments stream chunk-wise with the prefetch
         window; spec-less stages run their ordinary ``transform`` on the full
         materialized DataFrame at the chain boundary."""
-        for segment in self.segments:
-            if isinstance(segment, FallbackStage):
-                out = segment.stage.transform(df)
-                if isinstance(out, (list, tuple)):
-                    if len(out) != 1:
-                        raise BatchPlanInapplicable(
-                            f"stage {type(segment.stage).__name__} returned "
-                            f"{len(out)} outputs"
-                        )
-                    out = out[0]
-                df = out
-                continue
-            df = self._run_fused(segment, df)
-        return df
+        with tracer.span("batch.transform", CAT_PRODUCTIVE, scope=self.scope) as span:
+            span.set_attr("input_rows", len(df))
+            for segment in self.segments:
+                if isinstance(segment, FallbackStage):
+                    out = segment.stage.transform(df)
+                    if isinstance(out, (list, tuple)):
+                        if len(out) != 1:
+                            raise BatchPlanInapplicable(
+                                f"stage {type(segment.stage).__name__} returned "
+                                f"{len(out)} outputs"
+                            )
+                        out = out[0]
+                    df = out
+                    continue
+                df = self._run_fused(segment, df)
+            return df
 
     def _run_fused(self, segment: FusedSegment, df: DataFrame) -> DataFrame:  # graftcheck: hot-root
         n = len(df)
@@ -189,9 +192,11 @@ class CompiledBatchPlan:
             # the programs then take committed device arrays, the fast
             # intake path (a numpy arg costs an extra conversion pass per
             # program call).
-            inputs = {
-                name: jax.device_put(arr[lo:hi]) for name, arr in full.items()
-            }
+            with tracer.span("batch.ingest", CAT_PRODUCTIVE, scope=self.scope) as sp:
+                sp.set_attr("chunk_rows", hi - lo)
+                inputs = {
+                    name: jax.device_put(arr[lo:hi]) for name, arr in full.items()
+                }
             key = tuple(
                 (name, tuple(inputs[name].shape), str(inputs[name].dtype))
                 for name in segment.external_inputs
@@ -223,8 +228,9 @@ class CompiledBatchPlan:
 
         def finalize_oldest() -> None:
             t_dispatch, futures = inflight.pop(0)
-            for f in futures:
-                f.result()
+            with tracer.span("batch.readback", CAT_READBACK, scope=self.scope):
+                for f in futures:
+                    f.result()
             chunk_hist.observe((time.perf_counter() - t_dispatch) * 1000.0)
 
         pool = _readback_pool()
@@ -232,8 +238,10 @@ class CompiledBatchPlan:
         for i, lo in enumerate(starts):
             key, inputs = nxt
             t_dispatch = time.perf_counter()
-            outputs = run_segment(segment, key, inputs, on_compile=on_compile)
-            pending = segment.pending(outputs)
+            with tracer.span("batch.chunk", CAT_PRODUCTIVE, scope=self.scope) as sp:
+                sp.set_attr("chunk_rows", min(lo + chunk_rows, n) - lo)
+                outputs = run_segment(segment, key, inputs, on_compile=on_compile)
+                pending = segment.pending(outputs)
             if not out_bufs:  # shapes are fixed by the programs: alloc once
                 for name, dtype, arr, np_dtype in pending:
                     out_bufs[name] = np.empty((n,) + tuple(arr.shape[1:]), np_dtype)

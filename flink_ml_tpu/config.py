@@ -225,6 +225,32 @@ class Options:
         "Minimum scored batches for the live version before a drift verdict "
         "may fire (a single noisy window should not roll back a model).",
     )
+    OBSERVABILITY_TRACE = ConfigOption(
+        "observability.trace",
+        _parse_bool,
+        False,
+        "Record structured spans (flink_ml_tpu.trace) across serving, batch "
+        "transform, iteration and the continuous loop. Off = the tracer is a "
+        "single attribute check on every instrumented site — no spans, no "
+        "allocation, no lock (docs/observability.md).",
+    )
+    OBSERVABILITY_TRACE_CAPACITY = ConfigOption(
+        "observability.trace.capacity",
+        int,
+        65_536,
+        "Bounded-ring capacity of the span recorder: the newest N finished "
+        "spans are retained; older ones drop off (SpanRecorder.dropped counts "
+        "them).",
+    )
+    OBSERVABILITY_TRACE_XPROF = ConfigOption(
+        "observability.trace.xprof",
+        _parse_bool,
+        False,
+        "Mirror every traced span into jax.profiler.TraceAnnotation so spans "
+        "nest inside XLA profiler dumps captured around the traced region "
+        "(e.g. benchmark --profile). Only meaningful while a profile is "
+        "active; adds per-span overhead, so it is a separate switch.",
+    )
     NATIVE_DATACACHE_ENABLED = ConfigOption(
         "native.datacache.enabled",
         _parse_bool,

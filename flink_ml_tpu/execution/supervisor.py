@@ -39,6 +39,7 @@ from typing import Any, Callable, Iterator, List, Optional
 from flink_ml_tpu.execution.classify import DEFAULT_CLASSIFIER, ErrorClassifier, FailureKind
 from flink_ml_tpu.execution.restart import FixedDelayRestartStrategy, RestartStrategy
 from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.trace import CAT_PRODUCTIVE, CAT_RECOVERY, tracer
 
 __all__ = ["AttemptFailure", "RestartsExhaustedError", "Supervisor"]
 
@@ -136,12 +137,17 @@ class Supervisor:
             self.attempts += 1
             self._count(MLMetrics.NUM_ATTEMPTS)
             try:
-                result = fn(*args, **kwargs)
+                with tracer.span("execution.attempt", CAT_PRODUCTIVE, scope=self.metric_scope) as sp:
+                    sp.set_attr("attempt", self.attempts)
+                    result = fn(*args, **kwargs)
             except Exception as e:
                 failed_at = self._clock()
-                delay = self._on_failure(e)
-                if delay:
-                    self._sleep(delay)
+                # The recovery window — classify + backoff until re-invoke —
+                # is exactly the downtime RECOVERY_MS measures.
+                with tracer.span("execution.recovery", CAT_RECOVERY, scope=self.metric_scope):
+                    delay = self._on_failure(e)
+                    if delay:
+                        self._sleep(delay)
                 self._record_recovery(failed_at)
                 continue
             self.strategy.record_success(self._clock())
@@ -167,9 +173,10 @@ class Supervisor:
                     yield item
             except Exception as e:
                 failed_at = self._clock()
-                delay = self._on_failure(e)
-                if delay:
-                    self._sleep(delay)
+                with tracer.span("execution.recovery", CAT_RECOVERY, scope=self.metric_scope):
+                    delay = self._on_failure(e)
+                    if delay:
+                        self._sleep(delay)
                 self._record_recovery(failed_at)
                 continue
             self.strategy.record_success(self._clock())

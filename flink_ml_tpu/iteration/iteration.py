@@ -20,6 +20,7 @@ from typing import Any, Callable, List, Optional, Sequence
 import jax
 
 from flink_ml_tpu.faults import faults
+from flink_ml_tpu.trace import CAT_PRODUCTIVE, CAT_RECOVERY, CAT_SWAP, tracer
 
 __all__ = [
     "OperatorLifeCycle",
@@ -262,11 +263,13 @@ def iterate_bounded_until_termination(
         if config.max_epochs is not None and epoch >= config.max_epochs:
             break
         faults.trip("iteration.epoch", epoch=epoch)
-        epoch_body = _epoch_body(body, config)
-        if data is not None:
-            result = epoch_body(variables, epoch, data.epoch_view(epoch))
-        else:
-            result = epoch_body(variables, epoch)
+        with tracer.span("iteration.epoch", CAT_PRODUCTIVE, scope="ml.iteration[bounded]") as sp:
+            sp.set_attr("epoch", epoch)
+            epoch_body = _epoch_body(body, config)
+            if data is not None:
+                result = epoch_body(variables, epoch, data.epoch_view(epoch))
+            else:
+                result = epoch_body(variables, epoch)
         if result.outputs:
             outputs = list(result.outputs)
         for listener in listeners:
@@ -325,7 +328,9 @@ def iterate_unbounded(
 
     for batch in stream:
         faults.trip("iteration.epoch", epoch=epoch)
-        result = _epoch_body(body, config)(variables, batch, epoch)
+        with tracer.span("iteration.epoch", CAT_PRODUCTIVE, scope="ml.iteration[unbounded]") as sp:
+            sp.set_attr("epoch", epoch)
+            result = _epoch_body(body, config)(variables, batch, epoch)
         for listener in listeners:
             listener.on_epoch_watermark_incremented(epoch, context)
         epoch += 1
@@ -375,14 +380,17 @@ def _maybe_checkpoint(config: IterationConfig, epoch: int, variables) -> None:
     if mgr is None or not config.checkpoint_interval:
         return
     if epoch % config.checkpoint_interval == 0:
-        mgr.save(epoch, variables)
+        with tracer.span("iteration.checkpoint", CAT_SWAP, scope="ml.iteration") as sp:
+            sp.set_attr("epoch", epoch)
+            mgr.save(epoch, variables)
 
 
 def _maybe_restore(config: IterationConfig):
     mgr = config.checkpoint_manager
     if mgr is None:
         return None
-    return mgr.restore_latest()
+    with tracer.span("iteration.restore", CAT_RECOVERY, scope="ml.iteration"):
+        return mgr.restore_latest()
 
 
 class Iterations:

@@ -40,6 +40,13 @@ from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.loop.drift import DriftMonitor, logloss
 from flink_ml_tpu.loop.rollback import RollbackController
 from flink_ml_tpu.loop.trainer import ContinuousTrainer
+from flink_ml_tpu.trace import (
+    CAT_PRODUCTIVE,
+    CAT_RECOVERY,
+    CAT_SWAP,
+    GoodputReport,
+    tracer,
+)
 
 __all__ = ["ContinuousLearningLoop", "LoopReport"]
 
@@ -115,10 +122,19 @@ class ContinuousLearningLoop:
         #: version is definitionally the good one, it has no baseline).
         self.baseline_version: Optional[int] = None
         self.steps = 0
-        self._productive_s = 0.0
-        self._overhead_s = 0.0
+        #: Category → cumulative seconds, the loop's goodput ledger: kept by
+        #: the loop's own clock so ``ml.loop.goodput.fraction`` works with
+        #: tracing off; a ``GoodputReport`` over it publishes the
+        #: ``ml.goodput.*`` gauges, and with tracing on the span-derived
+        #: report reproduces the same fraction (tests/test_loop.py).
+        self._goodput_s: dict = {}
 
     # -- the turns -------------------------------------------------------------
+    def _charge(self, category: str, seconds: float) -> None:
+        """Add seconds to one goodput category of the loop's ledger."""
+        if seconds > 0.0:
+            self._goodput_s[category] = self._goodput_s.get(category, 0.0) + seconds
+
     def _swap(self) -> Optional[int]:  # graftcheck: cold
         """Flip to the newest published version (if any), AOT-warmed first."""
         faults.trip("loop.swap", serving=self.server.model_version)
@@ -127,8 +143,9 @@ class ContinuousLearningLoop:
             self.server.scope, MLMetrics.SERVING_WARMUP_COMPILE_MS
         )
         t0 = self.clock()
-        version = self._poller.poll_once()
-        self._overhead_s += self.clock() - t0
+        with tracer.span("loop.swap", CAT_SWAP, scope=self.scope):
+            version = self._poller.poll_once()
+        self._charge(CAT_SWAP, self.clock() - t0)
         if version is None:
             return None
         if serving_before is not None:
@@ -181,22 +198,26 @@ class ContinuousLearningLoop:
         if not self.monitor.regressed(live, self.baseline_version):
             return None
         t0 = self.clock()
-        restored = self.controller.rollback(live)
-        self._overhead_s += self.clock() - t0
+        with tracer.span("loop.rollback", CAT_RECOVERY, scope=self.scope) as sp:
+            sp.set_attr("from_version", live)
+            restored = self.controller.rollback(live)
+        self._charge(CAT_RECOVERY, self.clock() - t0)
         # The restored version is definitionally good — it must not be judged
         # against itself or against the version it just replaced.
         self.baseline_version = None
         return restored
 
     def _account(self, productive_s: float) -> None:
-        self._productive_s += productive_s
-        total = self._productive_s + self._overhead_s
-        if total > 0.0:
-            metrics.gauge(
-                self.scope,
-                MLMetrics.LOOP_GOODPUT_FRACTION,
-                self._productive_s / total,
-            )
+        """Fold this turn's productive seconds into the ledger and publish:
+        the goodput fraction gauge (productive / total, as before) now comes
+        from a :class:`GoodputReport` over the category ledger, which also
+        writes the per-category ``ml.goodput.*`` gauges for the loop scope."""
+        self._charge(CAT_PRODUCTIVE, productive_s)
+        report = GoodputReport({self.scope: dict(self._goodput_s)})
+        fraction = report.fraction(self.scope)
+        if fraction is not None:
+            metrics.gauge(self.scope, MLMetrics.LOOP_GOODPUT_FRACTION, fraction)
+            report.publish()
 
     # -- public API ------------------------------------------------------------
     def step(self, train_versions: Optional[int] = 1) -> LoopReport:  # graftcheck: hot-root
@@ -208,23 +229,28 @@ class ContinuousLearningLoop:
         (``_swap``), revert (``controller.rollback``) — marked ``cold``:
         they run off the serving path by design, and anything they compile or
         upload must never leak into the per-turn region."""
-        t0 = self.clock()
-        if not self.trainer.started:
-            self.trainer.start()
-        trained, published = self.trainer.process(train_versions)
-        t_train = self.clock() - t0
-        swapped = self._swap()
-        t1 = self.clock()
-        score = self._evaluate()
-        t_eval = self.clock() - t1
-        rolled_back_to = self._maybe_rollback()
-        # Training and serving evaluation traffic are the productive slices;
-        # the trainer's own publish seconds move to the overhead bucket.
-        publish_s = self.trainer.publish_s
-        self.trainer.publish_s = 0.0
-        self._overhead_s += publish_s
-        self._account(max(0.0, t_train - publish_s) + t_eval)
-        self.steps += 1
+        with tracer.span("loop.step", CAT_PRODUCTIVE, scope=self.scope) as step_span:
+            step_span.set_attr("step", self.steps + 1)
+            t0 = self.clock()
+            if not self.trainer.started:
+                self.trainer.start()
+            with tracer.span("loop.train", CAT_PRODUCTIVE, scope=self.scope):
+                trained, published = self.trainer.process(train_versions)
+            t_train = self.clock() - t0
+            swapped = self._swap()
+            t1 = self.clock()
+            with tracer.span("loop.evaluate", CAT_PRODUCTIVE, scope=self.scope):
+                score = self._evaluate()
+            t_eval = self.clock() - t1
+            rolled_back_to = self._maybe_rollback()
+            # Training and serving evaluation traffic are the productive
+            # slices; the trainer's own publish seconds move to the swap
+            # (version-lifecycle) bucket of the ledger.
+            publish_s = self.trainer.publish_s
+            self.trainer.publish_s = 0.0
+            self._charge(CAT_SWAP, publish_s)
+            self._account(max(0.0, t_train - publish_s) + t_eval)
+            self.steps += 1
         metrics.counter(self.scope, MLMetrics.LOOP_STEPS)
         return LoopReport(
             step=self.steps,

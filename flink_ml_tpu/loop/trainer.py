@@ -30,6 +30,7 @@ from flink_ml_tpu.serving.registry import (
     _METADATA_MARKER,
     publish_servable,
 )
+from flink_ml_tpu.trace import CAT_SWAP, tracer
 
 __all__ = ["ContinuousTrainer"]
 
@@ -126,12 +127,14 @@ class ContinuousTrainer:
         rename, ``serving.registry.publish_servable``)."""
         faults.trip("loop.publish", version=version)
         t0 = time.perf_counter()
-        try:
-            path = publish_servable(self.model, self.publish_dir, version=version)
-        except FileExistsError:
-            # Crash landed between the atomic rename and this bookkeeping on a
-            # previous attempt: the version IS published — adopt it.
-            path = None
+        with tracer.span("loop.publish", CAT_SWAP, scope=self.scope) as sp:
+            sp.set_attr("version", version)
+            try:
+                path = publish_servable(self.model, self.publish_dir, version=version)
+            except FileExistsError:
+                # Crash landed between the atomic rename and this bookkeeping
+                # on a previous attempt: the version IS published — adopt it.
+                path = None
         self.publish_s += time.perf_counter() - t0
         now = self.clock()
         self.published_at.setdefault(version, now)

@@ -11,8 +11,9 @@ mirror the gauges to any sink.
 """
 from __future__ import annotations
 
+import re
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["MLMetrics", "Histogram", "MetricsRegistry", "metrics"]
 
@@ -80,6 +81,17 @@ class MLMetrics:
     LOOP_DRIFT_BASELINE = "ml.loop.drift.baseline"  # reference version score, gauge
     LOOP_DRIFT_REGRESSIONS = "ml.loop.drift.regressions"  # threshold trips, counter
 
+    # Goodput attribution (flink_ml_tpu.trace — the ML Productivity Goodput
+    # accounting; one gauge set per traced scope, docs/observability.md).
+    GOODPUT_GROUP = "ml.goodput"
+    GOODPUT_FRACTION = "ml.goodput.fraction"  # productive / total traced, gauge
+
+    @staticmethod
+    def goodput_ms(category: str) -> str:
+        """Gauge name for one goodput category's attributed milliseconds
+        (``ml.goodput.productive.ms``, ``ml.goodput.queue.ms``, ...)."""
+        return f"{MLMetrics.GOODPUT_GROUP}.{category}.ms"
+
     # Batch transform fast path (builder/batch_plan.py — fused chunked plans;
     # scope = "ml.batch[plan]" unless the caller names its own).
     BATCH_GROUP = "ml.batch"
@@ -130,13 +142,22 @@ class Histogram:
 
     def quantile(self, q: float) -> Optional[float]:
         """Nearest-rank quantile over the retained window; None when empty."""
-        if not 0.0 <= q <= 1.0:
-            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        return self.quantiles((q,))[0]
+
+    def quantiles(self, qs: Sequence[float]) -> List[Optional[float]]:
+        """Nearest-rank quantiles over the retained window with ONE sort for
+        the whole batch — the per-batch p50/p99 gauge refresh on the serving
+        hot path sorts the 4096-entry window once instead of once per
+        quantile. All-None when empty."""
+        for q in qs:
+            if not 0.0 <= q <= 1.0:
+                raise ValueError(f"quantile must be in [0, 1], got {q}")
         with self._lock:
             if not self._values:
-                return None
+                return [None for _ in qs]
             ordered = sorted(self._values)
-        return ordered[min(int(q * len(ordered)), len(ordered) - 1)]
+        n = len(ordered)
+        return [ordered[min(int(q * n), n - 1)] for q in qs]
 
     def values(self) -> List[float]:
         """The retained observations (unordered), for test scraping."""
@@ -196,6 +217,67 @@ class MetricsRegistry:
     def clear(self) -> None:
         with self._lock:
             self._gauges.clear()
+
+    def render_prometheus(self) -> str:  # graftcheck: cold
+        """The whole registry in Prometheus text exposition format (0.0.4).
+
+        Metric names sanitize to ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (dots become
+        underscores); the scope rides as a ``scope`` label. Counters are not
+        distinguishable from gauges in this registry (both are stored values),
+        so every numeric renders as ``gauge``; ``Histogram``s render as
+        ``summary`` — p50/p90/p99 via one :meth:`Histogram.quantiles` sort,
+        plus ``_count``/``_sum``. Non-numeric gauge values are skipped.
+        """
+        numeric: Dict[str, List[Tuple[str, float]]] = {}
+        hists: Dict[str, List[Tuple[str, Histogram]]] = {}
+        for scope, group in sorted(self.scopes().items()):
+            for name, value in sorted(group.items()):
+                if isinstance(value, Histogram):
+                    hists.setdefault(name, []).append((scope, value))
+                elif isinstance(value, bool):
+                    numeric.setdefault(name, []).append((scope, float(value)))
+                elif isinstance(value, (int, float)):
+                    numeric.setdefault(name, []).append((scope, float(value)))
+        lines: List[str] = []
+        for name in sorted(set(numeric) | set(hists)):
+            san = _prometheus_name(name)
+            if name in numeric:
+                lines.append(f"# TYPE {san} gauge")
+                for scope, value in numeric[name]:
+                    lines.append(f"{san}{{scope={_prometheus_label(scope)}}} {_prometheus_value(value)}")
+            if name in hists:
+                lines.append(f"# TYPE {san} summary")
+                for scope, hist in hists[name]:
+                    label = _prometheus_label(scope)
+                    for q, v in zip((0.5, 0.9, 0.99), hist.quantiles((0.5, 0.9, 0.99))):
+                        if v is not None:
+                            lines.append(
+                                f'{san}{{scope={label},quantile="{q}"}} {_prometheus_value(v)}'
+                            )
+                    lines.append(f"{san}_count{{scope={label}}} {hist.count}")
+                    lines.append(f"{san}_sum{{scope={label}}} {_prometheus_value(hist.sum)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _prometheus_name(name: str) -> str:
+    """Sanitize a dotted metric name to the Prometheus grammar."""
+    san = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if san and san[0].isdigit():
+        san = "_" + san
+    return san
+
+
+def _prometheus_label(value: str) -> str:
+    """A quoted, escaped label value."""
+    escaped = value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    return f'"{escaped}"'
+
+
+def _prometheus_value(value: float) -> str:
+    """Render a sample value (integers without a trailing .0 for stability)."""
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
 
 
 metrics = MetricsRegistry()

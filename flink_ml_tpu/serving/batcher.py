@@ -47,6 +47,13 @@ from flink_ml_tpu.serving.errors import (
     ServingDeadlineError,
     ServingOverloadedError,
 )
+from flink_ml_tpu.trace import (
+    CAT_PADDING,
+    CAT_PRODUCTIVE,
+    CAT_QUEUE,
+    CAT_READBACK,
+    tracer,
+)
 
 __all__ = ["power_of_two_buckets", "bucket_for", "pad_to", "PendingRequest", "MicroBatcher"]
 
@@ -96,7 +103,7 @@ class PendingRequest:
 
     __slots__ = (
         "df", "rows", "enqueued_at", "deadline",
-        "_event", "_state", "response", "error", "_abandon_cb",
+        "_event", "_state", "response", "error", "_abandon_cb", "trace",
     )
 
     def __init__(self, df: DataFrame, deadline: float):
@@ -108,6 +115,11 @@ class PendingRequest:
         self._state = _PENDING
         self.response = None
         self.error: Optional[BaseException] = None
+        #: Root trace span of this request (None with tracing off) — THE
+        #: parent-ID handoff across the batcher thread boundary: the client
+        #: thread begins it at submit, the batcher thread parents its
+        #: queue/batch spans to it and ends it at delivery.
+        self.trace = None
 
     def result(self):
         """Block until the response (or typed error) arrives.
@@ -189,7 +201,15 @@ class MicroBatcher:
                 f"request of {rows} rows exceeds max_batch_size={self.max_batch_size}; "
                 "split it or raise serving.max.batch.size"
             )
+        # Root span begins BEFORE the request object so its interval covers
+        # enqueued_at — every child (queue wait included) nests inside it.
+        req_span = None
+        if tracer.enabled:
+            req_span = tracer.begin("serving.request", CAT_PRODUCTIVE, scope=self.scope)
+            if req_span is not None:
+                req_span.set_attr("rows", rows)
         req = PendingRequest(df, deadline=time.perf_counter() + timeout_s)
+        req.trace = req_span
         with self._cond:
             if self._closed or self._draining:
                 raise ServingClosedError("server is shut down; request rejected")
@@ -279,15 +299,24 @@ class MicroBatcher:
             kept.append(req)
         self._queue[:] = kept
 
-    def _deliver_error(self, claimed: List[PendingRequest], e: BaseException) -> None:
+    def _deliver_error(
+        self, claimed: List[PendingRequest], e: BaseException, batch_span=None,
+    ) -> None:
         for req in claimed:
             req.error = e
             req._state = _DONE
             req._event.set()
+        if batch_span is not None:
+            batch_span.set_attr("error", type(e).__name__)
+            tracer.end(batch_span)
+        for req in claimed:
+            if req.trace is not None:
+                req.trace.set_attr("error", type(e).__name__)
+                tracer.end(req.trace)
 
     def _deliver(
         self, claimed: List[PendingRequest], out: DataFrame, version: int,
-        rows: int, bucket: int,
+        rows: int, bucket: int, batch_span=None,
     ) -> None:
         """Scatter one executed batch's rows back to its waiters."""
         self.executed_batch_sizes.append((rows, bucket))
@@ -295,50 +324,98 @@ class MicroBatcher:
         metrics.counter(self.scope, MLMetrics.SERVING_BATCHES)
         now = time.perf_counter()
         offset = 0
-        for req in claimed:
-            sliced = out.take(np.arange(offset, offset + req.rows, dtype=np.int64))
-            offset += req.rows
-            latency_ms = (now - req.enqueued_at) * 1000.0
-            req.response = self._response_factory(sliced, version, latency_ms, bucket)
-            metrics.observe(self.scope, MLMetrics.SERVING_LATENCY_MS, latency_ms)
-            req._state = _DONE
-            req._event.set()
+        with tracer.span("serving.respond", CAT_PRODUCTIVE, scope=self.scope, parent=batch_span):
+            for req in claimed:
+                sliced = out.take(np.arange(offset, offset + req.rows, dtype=np.int64))
+                offset += req.rows
+                latency_ms = (now - req.enqueued_at) * 1000.0
+                req.response = self._response_factory(sliced, version, latency_ms, bucket)
+                metrics.observe(self.scope, MLMetrics.SERVING_LATENCY_MS, latency_ms)
+                req._state = _DONE
+                req._event.set()
         hist = metrics.histogram(self.scope, MLMetrics.SERVING_LATENCY_MS)
-        metrics.gauge(self.scope, MLMetrics.SERVING_LATENCY_P50_MS, hist.quantile(0.5))
-        metrics.gauge(self.scope, MLMetrics.SERVING_LATENCY_P99_MS, hist.quantile(0.99))
+        p50, p99 = hist.quantiles((0.5, 0.99))  # one sort for both gauges
+        metrics.gauge(self.scope, MLMetrics.SERVING_LATENCY_P50_MS, p50)
+        metrics.gauge(self.scope, MLMetrics.SERVING_LATENCY_P99_MS, p99)
+        # Close the batch span before the request roots so every child
+        # interval (pad/dispatch/readback/respond, then the batch itself)
+        # nests inside its parent.
+        tracer.end(batch_span)
+        for req in claimed:
+            if req.trace is not None:
+                req.trace.set_attr("version", version)
+                tracer.end(req.trace)
+
+    def _begin_batch_span(self, claimed: List[PendingRequest], rows: int, bucket: int):
+        """Queue-wait spans (enqueue→claim, on each request's own thread
+        identity) + the batch span, parented to the head request — the
+        request whose arrival opened the coalescing window; followers carry
+        the batch span id in their root's attrs."""
+        now = tracer.clock()
+        for req in claimed:
+            if req.trace is not None:
+                tracer.record(
+                    "serving.queue", CAT_QUEUE, self.scope,
+                    req.enqueued_at, now, parent=req.trace,
+                )
+        batch_span = tracer.begin(
+            "serving.batch", CAT_PRODUCTIVE, scope=self.scope,
+            parent=claimed[0].trace,
+        )
+        if batch_span is None:  # tracer raced to disabled mid-claim
+            return None
+        batch_span.set_attr("rows", rows)
+        batch_span.set_attr("bucket", bucket)
+        batch_span.set_attr("requests", len(claimed))
+        for req in claimed[1:]:
+            if req.trace is not None:
+                req.trace.set_attr("batch", batch_span.span_id)
+        return batch_span
 
     def _run_batch(self, claimed: List[PendingRequest]) -> Optional[Tuple]:
         """Pad and launch one batch. Returns an in-flight record
-        ``(claimed, rows, bucket, handle)`` when the batch was dispatched
-        asynchronously, or None when it was served (or failed) synchronously."""
+        ``(claimed, rows, bucket, handle, batch_span)`` when the batch was
+        dispatched asynchronously, or None when it was served (or failed)
+        synchronously."""
         rows = sum(r.rows for r in claimed)
         bucket = bucket_for(rows, self.buckets)
-        batch = claimed[0].df if len(claimed) == 1 else DataFrame.concat([r.df for r in claimed])
-        padded = pad_to(batch, bucket)
+        batch_span = self._begin_batch_span(claimed, rows, bucket) if tracer.enabled else None
+        with tracer.span("serving.pad", CAT_PADDING, scope=self.scope, parent=batch_span):
+            batch = claimed[0].df if len(claimed) == 1 else DataFrame.concat([r.df for r in claimed])
+            padded = pad_to(batch, bucket)
         if self._dispatch is not None:
             try:
-                handle = self._dispatch(padded)
+                with tracer.span("serving.dispatch", CAT_PRODUCTIVE, scope=self.scope, parent=batch_span) as sp:
+                    sp.set_attr("rows", rows)
+                    sp.set_attr("bucket", bucket)
+                    handle = self._dispatch(padded)
             except BaseException as e:  # noqa: BLE001 — delivered to each waiter
-                self._deliver_error(claimed, e)
+                self._deliver_error(claimed, e, batch_span)
                 return None
             if handle is not None:
-                return (claimed, rows, bucket, handle)
+                return (claimed, rows, bucket, handle, batch_span)
         try:
-            out, version = self._execute(padded)
+            with tracer.span("serving.exec", CAT_PRODUCTIVE, scope=self.scope, parent=batch_span) as sp:
+                sp.set_attr("rows", rows)
+                sp.set_attr("bucket", bucket)
+                out, version = self._execute(padded)
         except BaseException as e:  # noqa: BLE001 — delivered to each waiter
-            self._deliver_error(claimed, e)
+            self._deliver_error(claimed, e, batch_span)
             return None
-        self._deliver(claimed, out, version, rows, bucket)
+        self._deliver(claimed, out, version, rows, bucket, batch_span)
         return None
 
     def _finalize_inflight(self, record: Tuple) -> None:
-        claimed, rows, bucket, handle = record
+        claimed, rows, bucket, handle, batch_span = record
         try:
-            out, version = handle.result()  # the one blocking readback
+            with tracer.span("serving.readback", CAT_READBACK, scope=self.scope, parent=batch_span) as sp:
+                sp.set_attr("rows", rows)
+                sp.set_attr("bucket", bucket)
+                out, version = handle.result()  # the one blocking readback
         except BaseException as e:  # noqa: BLE001 — delivered to each waiter
-            self._deliver_error(claimed, e)
+            self._deliver_error(claimed, e, batch_span)
             return
-        self._deliver(claimed, out, version, rows, bucket)
+        self._deliver(claimed, out, version, rows, bucket, batch_span)
 
     def _loop(self) -> None:  # graftcheck: hot-root
         inflight: Deque[Tuple] = deque()

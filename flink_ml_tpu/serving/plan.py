@@ -59,6 +59,7 @@ from flink_ml_tpu.servable.planner import (
     run_segment,
 )
 from flink_ml_tpu.serving.batcher import pad_to
+from flink_ml_tpu.trace import CAT_COMPILE, tracer
 
 __all__ = ["CompiledServingPlan", "PlanExecution"]
 
@@ -112,23 +113,25 @@ class CompiledServingPlan:
         ``ml.serving.fastpath.warmup.compile.ms``."""
         t0 = time.perf_counter()
         for bucket in buckets:
-            df = pad_to(template, bucket)
-            for segment in self.segments:
-                if isinstance(segment, FallbackStage):
-                    df = segment.stage.transform(df)
-                    continue
-                try:
-                    inputs = self._ingest(segment, df, bucket)
-                except IneligibleBatch:
-                    # e.g. a sparse features template: this segment will serve
-                    # through the per-stage path (as dispatch falls back), so
-                    # warm the stages' own jit kernels instead of compiling a
-                    # fused chain the traffic can never hit.
-                    for stage in segment.stages:
-                        df = stage.transform(df)
-                    continue
-                outputs = run_segment(segment, bucket, inputs)
-                df = self._materialize(df, segment.pending(outputs))
+            with tracer.span("serving.plan.warmup", CAT_COMPILE, scope=self.scope) as sp:
+                sp.set_attr("bucket", bucket)
+                df = pad_to(template, bucket)
+                for segment in self.segments:
+                    if isinstance(segment, FallbackStage):
+                        df = segment.stage.transform(df)
+                        continue
+                    try:
+                        inputs = self._ingest(segment, df, bucket)
+                    except IneligibleBatch:
+                        # e.g. a sparse features template: this segment will serve
+                        # through the per-stage path (as dispatch falls back), so
+                        # warm the stages' own jit kernels instead of compiling a
+                        # fused chain the traffic can never hit.
+                        for stage in segment.stages:
+                            df = stage.transform(df)
+                        continue
+                    outputs = run_segment(segment, bucket, inputs)
+                    df = self._materialize(df, segment.pending(outputs))
         metrics.gauge(
             self.scope,
             MLMetrics.SERVING_WARMUP_COMPILE_MS,

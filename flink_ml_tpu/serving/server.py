@@ -31,6 +31,7 @@ from flink_ml_tpu.serving.batcher import MicroBatcher, pad_to
 from flink_ml_tpu.serving.errors import NoModelError, ServingClosedError
 from flink_ml_tpu.serving.plan import CompiledServingPlan
 from flink_ml_tpu.serving.registry import ModelRegistry, ModelVersionPoller
+from flink_ml_tpu.trace import CAT_COMPILE, CAT_SWAP, tracer
 
 __all__ = ["ServingConfig", "ServingResponse", "InferenceServer"]
 
@@ -256,22 +257,25 @@ class InferenceServer:
         and every (version, bucket) executable is AOT-compiled — all before
         the atomic version flip, so the hot path never traces, compiles, or
         uploads weights."""
-        plan = self._plan_for(servable)  # device-puts model arrays, off-path
-        template = self._warmup_template
-        if template is None:
-            return  # nothing seen yet: the first real batch compiles lazily
-        if plan is not None:
-            plan.warmup(template, self._batcher.buckets)
-            return
-        for bucket in self._batcher.buckets:
-            servable.transform(pad_to(template, bucket))
+        with tracer.span("serving.warmup", CAT_COMPILE, scope=self.scope):
+            plan = self._plan_for(servable)  # device-puts model arrays, off-path
+            template = self._warmup_template
+            if template is None:
+                return  # nothing seen yet: the first real batch compiles lazily
+            if plan is not None:
+                plan.warmup(template, self._batcher.buckets)
+                return
+            for bucket in self._batcher.buckets:
+                servable.transform(pad_to(template, bucket))
 
     def swap(self, version: int, servable) -> None:
         """Warm then atomically install ``servable`` as ``version``. The
         version must advance (monotonic — a response's ``model_version`` is
         unambiguous forever)."""
-        self.warmup(servable)
-        self.registry.swap(version, servable)
+        with tracer.span("serving.swap", CAT_SWAP, scope=self.scope) as sp:
+            sp.set_attr("version", version)
+            self.warmup(servable)
+            self.registry.swap(version, servable)
 
     def rollback(self, version: int, servable) -> None:
         """Warm then atomically REVERT serving to an older ``version`` — the
@@ -279,8 +283,10 @@ class InferenceServer:
         the restored version's plan is rebuilt and AOT-warmed on the caller's
         thread before the flip, so the rollback itself never puts a compile on
         the serving path."""
-        self.warmup(servable)
-        self.registry.swap(version, servable, allow_rollback=True)
+        with tracer.span("serving.rollback", CAT_SWAP, scope=self.scope) as sp:
+            sp.set_attr("version", version)
+            self.warmup(servable)
+            self.registry.swap(version, servable, allow_rollback=True)
 
     def attach_poller(
         self,

@@ -447,3 +447,37 @@ class TestTrainerCadence:
         with pytest.raises(RollbackImpossibleError):
             loop.controller.rollback(server.model_version)
         server.close()
+
+
+class TestGoodputConsistency:
+    def test_span_report_reproduces_ledger_fraction(self, tmp_path):
+        """graftscope consistency: with tracing on, the ``GoodputReport``
+        recomputed from the loop-scope spans reproduces the ledger-driven
+        ``ml.loop.goodput.fraction`` gauge (two independent measurements of
+        the same clock-bounded turns — equal up to the loop's span/metric
+        bookkeeping, microseconds against millisecond-scale turns)."""
+        from flink_ml_tpu import trace
+        from flink_ml_tpu.trace import CAT_PRODUCTIVE, GoodputReport
+
+        name = "t-loop-goodput"
+        loop, trainer, server, stream = _make_loop(tmp_path, name)
+        for i in range(4):
+            stream.add(_batch(seed=i))
+        with trace.capture() as recorder:
+            loop.run(publish_target=2, max_steps=8)
+        gauge = metrics.get(loop.scope, MLMetrics.LOOP_GOODPUT_FRACTION)
+        assert 0.0 < gauge <= 1.0
+        spans = recorder.snapshot()
+        step_spans = [s for s in spans if s.name == "loop.step"]
+        assert step_spans  # every turn traced
+        assert {"loop.train", "loop.swap", "loop.evaluate", "loop.publish"} <= {
+            s.name for s in spans if s.scope == loop.scope
+        }
+        report = GoodputReport.from_spans(spans)
+        fraction = report.fraction(loop.scope)
+        assert fraction is not None
+        assert fraction == pytest.approx(gauge, abs=0.1)
+        # the ledger-backed report published the per-category gauges too
+        assert metrics.get(loop.scope, MLMetrics.goodput_ms(CAT_PRODUCTIVE)) > 0.0
+        assert metrics.get(loop.scope, MLMetrics.GOODPUT_FRACTION) == pytest.approx(gauge)
+        server.close()

@@ -30,4 +30,12 @@ python -m flink_ml_tpu.benchmark.benchmark \
 python bin/benchmark-results-visualize.py /tmp/ci-bench-results.json \
     --output /tmp/ci-bench-results.png
 
+# Trace smoke: serve a burst with tracing on, export a Chrome trace, run the
+# offline analyzer on it. TRACE_ARTIFACT overrides the export path (the CI
+# annotation artifact, mirroring GRAFTCHECK_SARIF).
+echo "=== trace smoke (graftscope burst + traceview) ==="
+trace_artifact="${TRACE_ARTIFACT:-/tmp/ci-trace.json}"
+python tools/ci/trace_smoke.py "${trace_artifact}"
+python tools/traceview.py "${trace_artifact}"
+
 echo "CI OK"

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""CI trace smoke: serve a tiny traffic burst with tracing on, export the
+Chrome trace, sanity-check the span tree, print the goodput fraction.
+
+Driven by tools/ci/run_tests.sh after the benchmark smoke; the artifact path
+comes in as argv[1] (the script's caller resolves the ``TRACE_ARTIFACT`` env
+var, mirroring GRAFTCHECK_SARIF), and run_tests.sh then runs
+``tools/traceview.py`` on the export — the end-to-end proof that the
+instrumentation, the exporter and the offline analyzer agree.
+
+Exit codes: 0 = trace exported and structurally sound, 1 = no spans / no
+request tree / export failed.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: trace_smoke.py <artifact-path>", file=sys.stderr)
+        return 1
+    artifact = argv[0]
+
+    import threading
+
+    import numpy as np
+
+    from flink_ml_tpu import trace
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.servable.lib import LogisticRegressionModelServable
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    rng = np.random.default_rng(11)
+    dim = 32
+    servable = LogisticRegressionModelServable().set_features_col("features")
+    servable.coefficient = rng.standard_normal(dim).astype(np.float32)
+    X = rng.standard_normal((256, dim)).astype(np.float32)
+
+    with trace.capture() as recorder:
+        server = InferenceServer(
+            servable,
+            name="trace-smoke",
+            serving_config=ServingConfig(
+                max_batch_size=16,
+                max_delay_ms=0.5,
+                default_timeout_ms=60_000,
+            ),
+            warmup_template=DataFrame.from_dict({"features": X[:1]}),
+        )
+        try:
+            def client(tid: int) -> None:
+                for i in range(20):
+                    j = (tid * 37 + i * 5) % (X.shape[0] - 4)
+                    server.predict(DataFrame.from_dict({"features": X[j : j + 4]}))
+
+            threads = [threading.Thread(target=client, args=(t,)) for t in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            server.close()
+        exported = recorder.export_chrome_trace(artifact)
+        report = recorder.goodput_report()
+
+    spans = recorder.snapshot()
+    names = {s.name for s in spans}
+    required = {"serving.request", "serving.queue", "serving.batch", "serving.pad"}
+    missing = required - names
+    if exported == 0 or missing:
+        print(f"trace smoke FAILED: {exported} spans, missing {sorted(missing)}", file=sys.stderr)
+        return 1
+    scope = "ml.serving[trace-smoke]"
+    print(
+        f"trace smoke: {exported} spans -> {artifact}; "
+        f"goodput fraction {report.fraction(scope):.4f} "
+        f"(wall {report.wall_s(scope) * 1000.0:.1f} ms)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

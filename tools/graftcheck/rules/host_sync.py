@@ -58,6 +58,9 @@ DEVICE_TIER_PREFIXES = (
     # the continuous loop's serve/evaluate turns touch device-backed serving
     # results; its publish/warm/rollback edges are `# graftcheck: cold`
     "flink_ml_tpu/loop/",
+    # graftscope span machinery runs inside every hot region; its
+    # flush/export surface is `# graftcheck: cold`
+    "flink_ml_tpu/trace",
 )
 
 _KIND_MESSAGES = {

@@ -48,6 +48,9 @@ SCOPE_PREFIXES = (
     # the continuous loop drives serving swaps + eval traffic: any jitted fn
     # it introduces carries the serving tier's purity stakes
     "flink_ml_tpu/loop/",
+    # graftscope: the tracer is called from inside every hot region — a
+    # jitted helper here would burn into all four tiers at once
+    "flink_ml_tpu/trace",
 )
 
 _TIME_ATTRS = {"time", "perf_counter", "monotonic", "time_ns", "perf_counter_ns"}

@@ -7,7 +7,7 @@ map explicitly:
 
     L0 foundation          config, utils, faults, metrics, native
     L1 compute / servable  linalg, params, api, ops, checkpoint, parallel,
-                           servable, serving
+                           servable, serving, trace
     L2 runtime             iteration, execution, builder
     L3 library             models, benchmark, the root package
 
@@ -58,6 +58,10 @@ PACKAGE_LAYERS = {
     "parallel": 1,
     "servable": 1,
     "serving": 1,
+    # graftscope tracing: consumed by every tier including the L1 serving
+    # fast path, so it sits at L1 itself and only imports L0 (config,
+    # metrics) — the runtime-free guarantee covers instrumented servables.
+    "trace": 1,
     "iteration": 2,
     "execution": 2,
     "builder": 2,

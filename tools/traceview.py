@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""traceview — offline analysis of a graftscope Chrome trace export.
+
+Loads the trace-event JSON written by
+``flink_ml_tpu.trace.SpanRecorder.export_chrome_trace`` and prints, per scope
+(= trace-event pid, named by ``process_name`` metadata):
+
+- the goodput breakdown: attributed milliseconds and share of traced wall
+  time per category (productive / queue / padding / compile / swap /
+  recovery / readback — the ML Productivity Goodput buckets), plus the
+  goodput fraction;
+- per-span-name latency stats: count, p50, p99, total ms, % of the scope's
+  wall time.
+
+The same span self-time attribution as the live ``GoodputReport`` (parents
+minus same-scope children), reconstructed from the ``span_id``/``parent_id``
+the exporter stashes under each event's ``args`` — so the offline numbers
+match what ``ml.goodput.*`` gauges would have read.
+
+Usage:
+    python tools/traceview.py /tmp/trace.json [--scope ml.serving] [--top 20]
+
+Exit codes: 0 = analyzed, 2 = unreadable/invalid/empty trace.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from flink_ml_tpu.trace import CATEGORIES, GoodputReport, Span  # noqa: E402
+
+__all__ = ["load_spans", "summarize", "main"]
+
+
+def load_spans(path: str) -> List[Span]:
+    """Reconstruct Span records from a Chrome trace-event export."""
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    events = payload.get("traceEvents", payload if isinstance(payload, list) else None)
+    if not isinstance(events, list):
+        raise ValueError("not a trace-event file: no traceEvents array")
+    scope_of_pid: Dict[Any, str] = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            scope_of_pid[ev.get("pid")] = ev.get("args", {}).get("name", str(ev.get("pid")))
+    spans: List[Span] = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = dict(ev.get("args", {}))
+        start_s = float(ev.get("ts", 0.0)) / 1e6
+        span = Span(
+            name=ev.get("name", "?"),
+            category=ev.get("cat", "productive"),
+            scope=scope_of_pid.get(ev.get("pid"), str(ev.get("pid"))),
+            start=start_s,
+            span_id=args.pop("span_id", len(spans) + 1),
+            parent_id=args.pop("parent_id", None),
+            thread_id=ev.get("tid", 0),
+            thread_name=str(ev.get("tid", 0)),
+        )
+        span.end = start_s + float(ev.get("dur", 0.0)) / 1e6
+        if args:
+            span.attrs = args
+        spans.append(span)
+    return spans
+
+
+def _quantile(ordered: List[float], q: float) -> float:
+    return ordered[min(int(q * len(ordered)), len(ordered) - 1)]
+
+
+def summarize(spans: List[Span], scope_filter: Optional[str] = None, top: int = 20) -> str:
+    """The human report (one string, printed by main)."""
+    if scope_filter:
+        spans = [s for s in spans if s.scope.startswith(scope_filter)]
+    report = GoodputReport.from_spans(spans)
+    lines: List[str] = []
+    for scope in report.scopes():
+        wall_ms = report.wall_s(scope) * 1000.0
+        lines.append(f"scope {scope} — traced wall {wall_ms:.3f} ms")
+        fraction = report.fraction(scope)
+        if fraction is not None:
+            lines.append(f"  goodput fraction: {fraction:.4f}")
+        lines.append(f"  {'category':<12} {'ms':>12} {'% wall':>8}")
+        for category in CATEGORIES:
+            ms = report.category_s(scope, category) * 1000.0
+            if ms <= 0.0:
+                continue
+            pct = 100.0 * ms / wall_ms if wall_ms > 0.0 else 0.0
+            lines.append(f"  {category:<12} {ms:>12.3f} {pct:>7.1f}%")
+        by_name: Dict[str, List[float]] = {}
+        for s in spans:
+            if s.scope == scope:
+                by_name.setdefault(s.name, []).append(s.duration * 1000.0)
+        lines.append(
+            f"  {'span':<24} {'count':>7} {'p50 ms':>10} {'p99 ms':>10} "
+            f"{'total ms':>12} {'% wall':>8}"
+        )
+        ranked = sorted(by_name.items(), key=lambda kv: -sum(kv[1]))[:top]
+        for name, durs in ranked:
+            ordered = sorted(durs)
+            total = sum(durs)
+            pct = 100.0 * total / wall_ms if wall_ms > 0.0 else 0.0
+            lines.append(
+                f"  {name:<24} {len(durs):>7} {_quantile(ordered, 0.5):>10.3f} "
+                f"{_quantile(ordered, 0.99):>10.3f} {total:>12.3f} {pct:>7.1f}%"
+            )
+        lines.append("")
+    overall = report.fraction()
+    if overall is not None:
+        lines.append(f"overall goodput fraction: {overall:.4f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="graftscope trace analyzer")
+    parser.add_argument("trace", help="Chrome trace-event JSON (SpanRecorder.export_chrome_trace)")
+    parser.add_argument("--scope", help="only scopes with this prefix (e.g. ml.serving)")
+    parser.add_argument("--top", type=int, default=20, help="span names per scope (by total time)")
+    args = parser.parse_args(argv)
+    try:
+        spans = load_spans(args.trace)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"traceview: cannot load {args.trace}: {e}", file=sys.stderr)
+        return 2
+    if not spans:
+        print(f"traceview: {args.trace} contains no spans", file=sys.stderr)
+        return 2
+    try:
+        print(f"{args.trace}: {len(spans)} spans")
+        print(summarize(spans, scope_filter=args.scope, top=args.top))
+    except BrokenPipeError:  # e.g. `traceview t.json | head` — a clean exit
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
