@@ -1612,6 +1612,276 @@ def _bench_pipeline_batch_transform_body():
     }
 
 
+_SHARDED_NOTE = (
+    "HONEST NOTE: measured on a 1-core dev box with "
+    "--xla_force_host_platform_device_count=8 — the 8 'devices' time-share "
+    "one core, so these rows measure SPMD DISPATCH OVERHEAD (partitioning, "
+    "per-shard buffers, collective plumbing), not speedup. On real chips the "
+    "same programs split N-ways in wall time; here mesh>1 legs are expected "
+    "to run SLOWER than mesh=1. Bit-exactness vs mesh=1 is pinned by "
+    "tests/test_sharded_plans.py."
+)
+
+
+def _bench_serving_sharded_body():
+    """Mesh sweep over the sharded serving fast path (child process only —
+    requires the forced 8-device grid; see bench_sharded_fanout)."""
+    import threading
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.servable import PipelineModelServable
+    from flink_ml_tpu.servable.lib import (
+        LogisticRegressionModelServable,
+        StandardScalerModelServable,
+    )
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    rng = np.random.default_rng(5)
+    dim = 256
+    X = rng.standard_normal((4096, dim)).astype(np.float32)
+
+    def make_pipeline():
+        scaler = (
+            StandardScalerModelServable()
+            .set_input_col("features")
+            .set_output_col("scaled")
+            .set_with_mean(True)
+        )
+        scaler.mean = rng.standard_normal(dim).astype(np.float32)
+        scaler.std = (np.abs(rng.standard_normal(dim)) + 0.5).astype(np.float32)
+        lr = LogisticRegressionModelServable().set_features_col("scaled")
+        lr.coefficient = rng.standard_normal(dim).astype(np.float32)
+        return PipelineModelServable([scaler, lr])
+
+    n_threads, requests_per_thread, req_rows = 2, 60, 8
+    sweep = []
+    for mesh in (1, 2, 4, 8):
+        server = InferenceServer(
+            make_pipeline(),
+            name=f"bench-shard-{mesh}",
+            serving_config=ServingConfig(
+                max_batch_size=64,
+                max_delay_ms=1.0,
+                queue_capacity_rows=8192,
+                default_timeout_ms=120_000,
+                mesh=mesh,
+            ),
+            warmup_template=DataFrame.from_dict({"features": X[:1]}),
+        )
+        try:
+            barrier = threading.Barrier(n_threads + 1)
+
+            def client(tid):
+                barrier.wait()
+                for i in range(requests_per_thread):
+                    j = (tid * 997 + i * 61) % (X.shape[0] - req_rows)
+                    server.predict(
+                        DataFrame.from_dict({"features": X[j : j + req_rows]})
+                    )
+
+            threads = [
+                threading.Thread(target=client, args=(t,)) for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            scraped = metrics.scope(server.scope)
+            lat = scraped[MLMetrics.SERVING_LATENCY_MS]
+            total_rows = n_threads * requests_per_thread * req_rows
+            sweep.append(
+                {
+                    "mesh": mesh,
+                    "buckets": list(server._batcher.buckets),
+                    "rows_per_sec": round(total_rows / elapsed, 1),
+                    "latency_p50_ms": round(lat.quantile(0.5), 3),
+                    "latency_p99_ms": round(lat.quantile(0.99), 3),
+                    "fastpath_compiles": scraped.get(
+                        MLMetrics.SERVING_FASTPATH_COMPILES, 0
+                    ),
+                    "shard_rows": scraped.get(MLMetrics.SERVING_SHARD_ROWS, 0),
+                    "warmup_compile_ms": round(
+                        scraped.get(MLMetrics.SERVING_WARMUP_COMPILE_MS, 0.0), 1
+                    ),
+                }
+            )
+        finally:
+            server.close()
+    return {
+        "name": "serving_sharded_scaler_lr_d256",
+        "threads": n_threads,
+        "requests_per_thread": requests_per_thread,
+        "request_rows": req_rows,
+        "sweep": sweep,
+        "note": _SHARDED_NOTE,
+    }
+
+
+def _bench_batch_sharded_body():
+    """Mesh sweep over the sharded batch-transform fast path (child process
+    only — see bench_sharded_fanout)."""
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.builder.batch_plan import CompiledBatchPlan
+    from flink_ml_tpu.config import Options, config
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.servable.lib import (
+        LogisticRegressionModelServable,
+        StandardScalerModelServable,
+    )
+    from flink_ml_tpu.servable.sharding import resolve_plan_sharding
+
+    rng = np.random.default_rng(9)
+    n, d = 200_000, 32
+    df = DataFrame.from_dict({"features": rng.standard_normal((n, d))})
+    scaler = (
+        StandardScalerModelServable()
+        .set_input_col("features")
+        .set_output_col("scaled")
+        .set_with_mean(True)
+    )
+    scaler.mean = rng.standard_normal(d)
+    scaler.std = np.abs(rng.standard_normal(d)) + 0.5
+    lr = LogisticRegressionModelServable().set_features_col("scaled")
+    lr.coefficient = rng.standard_normal(d)
+    stages = [scaler, lr]
+
+    config.set(Options.BATCH_CHUNK_ROWS, 32_768)
+    sweep = []
+    try:
+        for mesh in (1, 2, 4, 8):
+            scope = f"ml.batch[bench-shard-{mesh}]"
+            sharding = resolve_plan_sharding(mesh)
+            plan = CompiledBatchPlan.build(stages, scope=scope, sharding=sharding)
+            plan.transform(df)  # warm: compiles the chunk signatures
+            t, spread = _median_time_spread(lambda: plan.transform(df), repeats=3)
+            sweep.append(
+                {
+                    "mesh": mesh,
+                    "rows_per_sec": round(n / t, 1),
+                    "spread": spread,
+                    "shard_rows": metrics.get(scope, MLMetrics.BATCH_SHARD_ROWS, 0),
+                    "shard_pad_rows": metrics.get(
+                        scope, MLMetrics.BATCH_SHARD_PAD_ROWS, 0
+                    ),
+                    "replicated_chunks": metrics.get(
+                        scope, MLMetrics.BATCH_SHARD_REPLICATED_CHUNKS, 0
+                    ),
+                }
+            )
+    finally:
+        config.unset(Options.BATCH_CHUNK_ROWS)
+    return {
+        "name": "batch_sharded_scaler_lr_200k_d32",
+        "rows": n,
+        "dim": d,
+        "chunk_rows": 32_768,
+        "sweep": sweep,
+        "note": _SHARDED_NOTE,
+    }
+
+
+def _bench_sharded_trace_attrs():
+    """One traced mesh=4 burst: the per-shard span attrs BENCH rounds record
+    so traceview's shard section is reproducible from the artifact."""
+    from flink_ml_tpu import trace
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.servable.lib import LogisticRegressionModelServable
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    rng = np.random.default_rng(3)
+    dim = 64
+    servable = LogisticRegressionModelServable().set_features_col("features")
+    servable.coefficient = rng.standard_normal(dim).astype(np.float32)
+    X = rng.standard_normal((256, dim)).astype(np.float32)
+    with trace.capture() as recorder:
+        with InferenceServer(
+            servable,
+            name="bench-shard-trace",
+            serving_config=ServingConfig(
+                max_batch_size=64, max_delay_ms=0.0, default_timeout_ms=60_000,
+                mesh=4,
+            ),
+            warmup_template=DataFrame.from_dict({"features": X[:1]}),
+        ) as server:
+            for i in range(16):
+                j = (i * 31) % (X.shape[0] - 4)
+                server.predict(DataFrame.from_dict({"features": X[j : j + 4]}))
+    spans = recorder.snapshot()
+    sharded = [
+        s for s in spans
+        if s.attrs and s.attrs.get("shards") == 4
+        and s.name in ("serving.dispatch", "serving.exec", "serving.batch")
+    ]
+    by_name = {}
+    for s in sharded:
+        entry = by_name.setdefault(
+            s.name, {"count": 0, "total_ms": 0.0, "shards": 4, "shard_rows": None}
+        )
+        entry["count"] += 1
+        entry["total_ms"] = round(entry["total_ms"] + s.duration * 1000.0, 3)
+        if isinstance(s.attrs.get("shard_rows"), int):
+            entry["shard_rows"] = s.attrs["shard_rows"]
+    return {
+        "mesh": 4,
+        "sharded_spans": len(sharded),
+        "per_span": by_name,
+        "note": "spans carrying shards/shard_rows attrs; traceview divides "
+        "their device time per shard (tools/traceview.py shard section)",
+    }
+
+
+def _sharded_child() -> None:
+    """Entry point of the forced-8-device child (bench_sharded_fanout)."""
+    print(
+        json.dumps(
+            {
+                "serving_sharded": _bench_serving_sharded_body(),
+                "batch_sharded": _bench_batch_sharded_body(),
+                "trace_shard_attrs": _bench_sharded_trace_attrs(),
+            }
+        )
+    )
+
+
+def bench_sharded_fanout():
+    """Pod-scale fan-out sweep (serving.mesh / batch.mesh 1-8) in a
+    tunnel-free subprocess on the 8-device virtual CPU grid — the same
+    re-exec pattern as bench_streamed_overlap_cpu_mesh, because the sharded
+    paths need the forced device count before jax initializes."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": (
+                env.get("XLA_FLAGS", "")
+                + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=30"
+                + " --xla_cpu_collective_call_terminate_timeout_seconds=120"
+                + " --xla_force_host_platform_device_count=8"
+            ).strip(),
+            "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+        }
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--sharded-child"],
+            capture_output=True, text=True, timeout=1800, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        payload["name"] = "sharded_fanout_mesh_sweep"
+        return payload
+    except Exception as e:  # never sink the whole bench for the side artifact
+        return {"name": "sharded_fanout_mesh_sweep", "error": f"{type(e).__name__}: {e}"}
+
+
 def bench_tracing_overhead():
     """graftscope acceptance row (docs/observability.md): the same
     single-client serving loop with tracing off vs on.
@@ -1757,6 +2027,7 @@ def main() -> None:
     mlp_serving = bench_mlp_serving_throughput()
     continuous_loop = bench_continuous_loop()
     batch_transform = bench_pipeline_batch_transform()
+    sharded = bench_sharded_fanout()
 
     detail = {
         "device_kind": kind,
@@ -1765,7 +2036,7 @@ def main() -> None:
         "workloads": [
             logreg, sparse, sweep, sparse_streamed, overlap, kmeans, mlp,
             mlp_train, attention, attention_train, serving, tracing,
-            mlp_serving, continuous_loop, batch_transform,
+            mlp_serving, continuous_loop, batch_transform, sharded,
         ],
     }
     with open("BENCH_DETAIL.json", "w") as f:
@@ -1785,4 +2056,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--sharded-child" in sys.argv[1:]:
+        sys.exit(_sharded_child())
     sys.exit(main())

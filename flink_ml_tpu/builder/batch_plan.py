@@ -33,6 +33,18 @@ Programs are keyed by the ingest signature itself (chunk rows × column
 shapes/dtypes) and compile lazily on first sight — a batch tier has no
 version flip to warm up against; ``ml.batch.fastpath.compiles`` counts the
 signatures seen.
+
+**Mesh sharding** (``batch.mesh`` > 1, docs/batch_transform.md): chunks
+ingest through the plan tier's blessed boundary
+(``PlanSharding.put_batch`` — one ``device_put`` per chunk, split by the
+runtime into one transfer per shard) and the fused programs run SPMD with
+rows split over the data axis; columns still flow device-to-device between
+stages, never through the host. A ragged final chunk rounds up to a mesh
+multiple (pad rows repeat row 0 and are sliced off at readback, counted by
+``ml.batch.shard.pad.rows``); a tail too small to keep every shard in the
+row-count-invariant regime (see MIN_SHARD_ROWS in ``servable/sharding.py``)
+runs **replicated** instead — the same local program shape mesh=1 compiles —
+so per-row results stay bit-identical to the single-device path either way.
 """
 from __future__ import annotations
 
@@ -56,6 +68,7 @@ from flink_ml_tpu.servable.planner import (
     build_segments,
     run_segment,
 )
+from flink_ml_tpu.servable.sharding import resolve_plan_sharding
 from flink_ml_tpu.trace import CAT_PRODUCTIVE, CAT_READBACK, tracer
 
 __all__ = ["BatchPlanInapplicable", "CompiledBatchPlan"]
@@ -107,28 +120,49 @@ class CompiledBatchPlan:
     Build via :meth:`build`; ``None`` means no stage has a kernel spec and
     the classic per-stage path should run."""
 
-    def __init__(self, stages: Sequence[Any], segments: List[Any], scope: str):
+    def __init__(
+        self,
+        stages: Sequence[Any],
+        segments: List[Any],
+        scope: str,
+        sharding: Optional[Any] = None,
+    ):
         self._stages = list(stages)
         self.segments = segments
         self.scope = scope
+        self.sharding = sharding
         n_fused = sum(len(s.specs) for s in segments if isinstance(s, FusedSegment))
         n_fallback = sum(1 for s in segments if isinstance(s, FallbackStage))
         metrics.gauge(scope, MLMetrics.BATCH_FUSED_STAGES, n_fused)
         metrics.gauge(scope, MLMetrics.BATCH_FALLBACK_STAGES, n_fallback)
+        if sharding is not None:
+            metrics.gauge(scope, MLMetrics.BATCH_SHARD_COUNT, sharding.n_data)
 
     # -- construction ---------------------------------------------------------
     @staticmethod
-    def build(stages: Sequence[Any], *, scope: str = "ml.batch[plan]") -> Optional["CompiledBatchPlan"]:
+    def build(
+        stages: Sequence[Any],
+        *,
+        scope: str = "ml.batch[plan]",
+        sharding: Optional[Any] = None,
+    ) -> Optional["CompiledBatchPlan"]:
         """Group consecutive kernel-spec stages into fused segments and
-        commit their model arrays to the device (the once-per-plan upload).
-        Raises whatever ``kernel_spec()`` raises — an unloaded model fails
-        closed here exactly as its ``transform`` would. Publishes
-        ``ml.batch.fastpath.plan.build.ms``."""
+        commit their model arrays to the device (the once-per-plan upload —
+        per shard when a mesh is configured). Raises whatever
+        ``kernel_spec()`` raises — an unloaded model fails closed here
+        exactly as its ``transform`` would. Publishes
+        ``ml.batch.fastpath.plan.build.ms``. ``sharding`` defaults to the
+        ``batch.mesh`` / ``batch.mesh.model`` config options (1 = the
+        single-device path)."""
         t0 = time.perf_counter()
-        segments = build_segments(stages)
+        if sharding is None:
+            sharding = resolve_plan_sharding(
+                config.get(Options.BATCH_MESH), config.get(Options.BATCH_MESH_MODEL)
+            )
+        segments = build_segments(stages, sharding)
         if not any(isinstance(s, FusedSegment) for s in segments):
             return None
-        plan = CompiledBatchPlan(stages, segments, scope)
+        plan = CompiledBatchPlan(stages, segments, scope, sharding)
         metrics.gauge(
             scope, MLMetrics.BATCH_PLAN_BUILD_MS, (time.perf_counter() - t0) * 1000.0
         )
@@ -184,24 +218,51 @@ class CompiledBatchPlan:
         starts = list(range(0, n, chunk_rows))
         chunk_hist = metrics.histogram(self.scope, MLMetrics.BATCH_CHUNK_MS)
 
-        def ingest(lo: int) -> Tuple[Hashable, Dict[str, Any]]:
+        sharding = self.sharding
+
+        def pad_rows_block(view: np.ndarray, padded: int) -> np.ndarray:
+            # DP round-up: repeat row 0 (row-independent programs — pad rows
+            # influence nothing and are sliced off at readback).
+            pad = padded - view.shape[0]
+            return np.concatenate(
+                [view, np.broadcast_to(view[:1], (pad,) + view.shape[1:])]
+            )
+
+        def ingest(lo: int) -> Tuple[Hashable, Dict[str, Any], int, bool]:  # graftcheck: ingest
             hi = min(lo + chunk_rows, n)
+            rows = hi - lo
             # device_put of a contiguous row view — host gather + upload of
             # chunk j+1 runs on the host thread while the device executes
             # the chunks still in flight (the double-buffer overlap), and
             # the programs then take committed device arrays, the fast
             # intake path (a numpy arg costs an extra conversion pass per
-            # program call).
+            # program call). On a mesh, PlanSharding.put_batch is the
+            # blessed ingest boundary: one device_put per chunk, one
+            # transfer per shard; a tail below the shardable floor goes
+            # replicated so its local program shape matches mesh=1 exactly.
+            replicated = sharding is not None and not sharding.shardable_rows(rows)
+            padded = rows if sharding is None or replicated else sharding.padded_rows(rows)
             with tracer.span("batch.ingest", CAT_PRODUCTIVE, scope=self.scope) as sp:
-                sp.set_attr("chunk_rows", hi - lo)
-                inputs = {
-                    name: jax.device_put(arr[lo:hi]) for name, arr in full.items()
-                }
+                sp.set_attr("rows", rows)
+                sp.set_attr("bucket", padded)
+                if sharding is not None:
+                    sp.set_attr("shards", 1 if replicated else sharding.n_data)
+                inputs = {}
+                for name, arr in full.items():
+                    view = arr[lo:hi]
+                    if sharding is None:
+                        inputs[name] = jax.device_put(view)
+                    elif replicated:
+                        inputs[name] = sharding.put_replicated(view)
+                    else:
+                        if padded != rows:
+                            view = pad_rows_block(view, padded)
+                        inputs[name] = sharding.put_batch(view)
             key = tuple(
                 (name, tuple(inputs[name].shape), str(inputs[name].dtype))
                 for name in segment.external_inputs
-            )
-            return key, inputs
+            ) + ((("replicated",) if replicated else ()))
+            return key, inputs, rows, replicated
 
         def on_compile() -> None:
             metrics.counter(self.scope, MLMetrics.BATCH_COMPILES)
@@ -222,9 +283,11 @@ class CompiledBatchPlan:
             # THE designated sync point of the batch fast path: np.asarray
             # blocks until the device value is ready (zero-copy view on the
             # CPU backend); the widening cast (f32→f64) in the slice
-            # assignment is value-exact. Runs on the readback pool, behind
-            # the prefetch window — never serially with dispatch.
-            buf[lo:hi] = np.asarray(arr)
+            # assignment is value-exact. The [:hi-lo] slice drops the DP
+            # round-up pad rows of a sharded ragged chunk (a no-op when
+            # unpadded). Runs on the readback pool, behind the prefetch
+            # window — never serially with dispatch.
+            buf[lo:hi] = np.asarray(arr)[: hi - lo]
 
         def finalize_oldest() -> None:
             t_dispatch, futures = inflight.pop(0)
@@ -236,12 +299,32 @@ class CompiledBatchPlan:
         pool = _readback_pool()
         nxt = ingest(starts[0])
         for i, lo in enumerate(starts):
-            key, inputs = nxt
+            key, inputs, rows, replicated = nxt
+            padded = next(iter(inputs.values())).shape[0] if inputs else rows
             t_dispatch = time.perf_counter()
             with tracer.span("batch.chunk", CAT_PRODUCTIVE, scope=self.scope) as sp:
-                sp.set_attr("chunk_rows", min(lo + chunk_rows, n) - lo)
-                outputs = run_segment(segment, key, inputs, on_compile=on_compile)
+                # rows = true chunk rows, bucket = the DP-padded shape the
+                # program ran at — the goodput padding split counts the
+                # round-up exactly once, here and nowhere else.
+                sp.set_attr("rows", rows)
+                sp.set_attr("bucket", padded)
+                if sharding is not None:
+                    sp.set_attr("shards", 1 if replicated else sharding.n_data)
+                outputs = run_segment(
+                    segment, key, inputs, on_compile=on_compile, replicated=replicated
+                )
                 pending = segment.pending(outputs)
+            if sharding is not None:
+                if replicated:
+                    metrics.counter(self.scope, MLMetrics.BATCH_SHARD_REPLICATED_CHUNKS)
+                else:
+                    metrics.counter(
+                        self.scope, MLMetrics.BATCH_SHARD_ROWS, padded // sharding.n_data
+                    )
+                    if padded != rows:
+                        metrics.counter(
+                            self.scope, MLMetrics.BATCH_SHARD_PAD_ROWS, padded - rows
+                        )
             if not out_bufs:  # shapes are fixed by the programs: alloc once
                 for name, dtype, arr, np_dtype in pending:
                     out_bufs[name] = np.empty((n,) + tuple(arr.shape[1:]), np_dtype)

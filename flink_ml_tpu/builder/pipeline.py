@@ -113,10 +113,17 @@ class PipelineModel(Model):
 
     def _fingerprint(self) -> Tuple:
         """Cheap identity of the chain a compiled plan snapshots: stage
-        object identity plus each stage's param map. Model *data* is covered
-        by ``set_model_data`` invalidating the cache; mutating a stage's
-        arrays directly requires :meth:`invalidate_batch_plan`."""
-        return tuple(
+        object identity plus each stage's param map, plus the mesh config
+        the plan's programs and committed buffers were placed under (a
+        ``batch.mesh`` change mid-process must rebuild, not serve stale
+        local shapes). Model *data* is covered by ``set_model_data``
+        invalidating the cache; mutating a stage's arrays directly requires
+        :meth:`invalidate_batch_plan`."""
+        mesh_key = (
+            config.get(Options.BATCH_MESH),
+            config.get(Options.BATCH_MESH_MODEL),
+        )
+        return (mesh_key,) + tuple(
             (id(stage), json.dumps(stage.param_map_to_json(), sort_keys=True, default=str))
             for stage in self.stages
         )

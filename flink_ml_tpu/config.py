@@ -152,6 +152,45 @@ class Options:
         "claim/pad/scatter of batch N+1 with device execution of batch N; "
         "1 = strict sequential. Only effective on the fast path.",
     )
+    SERVING_MESH = ConfigOption(
+        "serving.mesh",
+        int,
+        1,
+        "Data-parallel mesh width of the serving fast path: fused per-bucket "
+        "executables compile as SPMD programs with micro-batch rows sharded "
+        "over N devices, model arrays device-put per shard at swap time. "
+        "1 (default) = today's single-device path, unchanged. Buckets become "
+        "multiples of N with at least MIN_SHARD_ROWS rows per shard so "
+        "per-row results stay bit-identical to mesh=1 (docs/serving.md).",
+    )
+    SERVING_MESH_MODEL = ConfigOption(
+        "serving.mesh.model",
+        int,
+        1,
+        "OPTIONAL tensor-parallel axis of the serving mesh: wide 2-D model "
+        "heads (e.g. MLP W{i}) additionally shard their output dim over this "
+        "many devices. NOT covered by the bit-exactness contract — partial "
+        "products may reassociate; results carry a documented ulp envelope "
+        "(docs/serving.md). 1 (default) = no tensor parallelism.",
+    )
+    BATCH_MESH = ConfigOption(
+        "batch.mesh",
+        int,
+        1,
+        "Data-parallel mesh width of the batch transform fast path: chunk "
+        "ingest device-puts one shard per device and fused programs run "
+        "SPMD over N devices. Ragged final chunks round up to a multiple of "
+        "N (pad rows sliced off, counted by ml.batch.shard.pad.rows); tails "
+        "too small to shard run replicated so per-row results stay "
+        "bit-identical to mesh=1 (docs/batch_transform.md). 1 = today's path.",
+    )
+    BATCH_MESH_MODEL = ConfigOption(
+        "batch.mesh.model",
+        int,
+        1,
+        "Optional tensor-parallel axis of the batch transform mesh — same "
+        "wide-head sharding and ulp caveat as serving.mesh.model. 1 = off.",
+    )
     BATCH_FASTPATH = ConfigOption(
         "batch.fastpath",
         _parse_bool,

@@ -35,6 +35,18 @@ handing the same device array to a separate program), and every elementwise
 op computes per element exactly as it would alone. Merging saves one HBM
 round-trip and one program dispatch per interior boundary, which is most of
 the fused win on short chains.
+
+Mesh sharding (``servable/sharding.py``): a segment built with a
+:class:`~flink_ml_tpu.servable.sharding.PlanSharding` commits its model
+arrays **per shard** (replicated, or TP-split for wide heads) and lowers its
+programs with batch rows sharded over the mesh's data axis — the same
+per-stage program partition, now SPMD. Row-independence means no program
+here contains a cross-row accumulation for the shard boundary to cut, and
+the callers' padding discipline (buckets/chunks keep every shard in the
+row-count-invariant regime — see the MIN_SHARD_ROWS note in
+``servable/sharding.py``) keeps per-row results bit-identical to the
+single-device path. The planner stays policy-free: WHERE the rows come from
+and how they are padded belongs to the serving/batch tiers.
 """
 from __future__ import annotations
 
@@ -99,12 +111,13 @@ class FusedSegment:
 
     __slots__ = (
         "stages", "specs", "external_inputs", "device_models", "programs",
-        "compiled", "signatures",
+        "compiled", "signatures", "sharding",
     )
 
-    def __init__(self, staged: Sequence[Tuple[Any, Any]]):
+    def __init__(self, staged: Sequence[Tuple[Any, Any]], sharding: Optional[Any] = None):
         self.stages = [stage for stage, _ in staged]
         self.specs = [spec for _, spec in staged]
+        self.sharding = sharding
         produced: set = set()
         external: List[str] = []
         for spec in self.specs:
@@ -114,11 +127,19 @@ class FusedSegment:
             produced.update(spec.output_names)
         self.external_inputs: Tuple[str, ...] = tuple(external)
         # One upload per model array, at construction — the committed buffers
-        # the hot path closes over.
-        self.device_models: Tuple[Dict[str, Any], ...] = tuple(
-            {k: jax.device_put(v) for k, v in spec.model_arrays.items()}
-            for spec in self.specs
-        )
+        # the hot path closes over. On a mesh this is the per-shard weight
+        # placement (replicated or TP-split), paid at build/warmup time —
+        # for serving, at swap time before the version flip.
+        if sharding is not None:
+            self.device_models = tuple(
+                {k: sharding.put_model(v) for k, v in spec.model_arrays.items()}
+                for spec in self.specs
+            )
+        else:
+            self.device_models = tuple(
+                {k: jax.device_put(v) for k, v in spec.model_arrays.items()}
+                for spec in self.specs
+            )
         # Program partition (see module docstring): consecutive elementwise
         # specs merge into one program; anything with a reduction keeps its
         # own so no accumulation can cross a per-stage-path boundary.
@@ -205,12 +226,14 @@ class FallbackStage:
         self.stage = stage
 
 
-def build_segments(stages: Sequence[Any]) -> List[Any]:
+def build_segments(stages: Sequence[Any], sharding: Optional[Any] = None) -> List[Any]:
     """Group consecutive kernel-spec stages into :class:`FusedSegment` runs,
     everything else into :class:`FallbackStage`. Raises whatever
     ``kernel_spec()`` raises (an unloaded model must fail closed at plan
     build, before it could ever run); a stage whose ``kernel_spec()`` returns
-    None falls back."""
+    None falls back. With a ``sharding``
+    (:class:`~flink_ml_tpu.servable.sharding.PlanSharding`), fused segments
+    commit their model arrays per shard and compile SPMD programs."""
     segments: List[Any] = []
     run: List[Tuple[Any, Any]] = []
     for stage in stages:
@@ -219,12 +242,25 @@ def build_segments(stages: Sequence[Any]) -> List[Any]:
             run.append((stage, spec))
         else:
             if run:
-                segments.append(FusedSegment(run))
+                segments.append(FusedSegment(run, sharding))
                 run = []
             segments.append(FallbackStage(stage))
     if run:
-        segments.append(FusedSegment(run))
+        segments.append(FusedSegment(run, sharding))
     return segments
+
+
+def _lowering_struct(segment: FusedSegment, arr: Any, replicated: bool) -> jax.ShapeDtypeStruct:
+    """Aval for one program input at lowering time. Device arrays (program
+    intermediates, pre-committed ingests) carry their own placement; host
+    arrays take the segment's batch sharding (or full replication for the
+    sub-floor ragged-tail path); the unsharded path keeps today's plain
+    structs."""
+    if segment.sharding is None:
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+    if isinstance(arr, jax.Array):
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype, sharding=arr.sharding)
+    return segment.sharding.input_struct(arr.shape, arr.dtype, replicated=replicated)
 
 
 def run_segment(
@@ -233,17 +269,28 @@ def run_segment(
     inputs: Dict[str, Any],
     *,
     on_compile: Optional[Callable[[], None]] = None,
+    replicated: bool = False,
 ) -> Dict[str, Any]:
     """Execute the segment's executable chain for ``key``: each program runs
     on the committed device model buffers and the (device-resident) outputs
     of the programs before it. Compiles the chain first if ``key`` was never
     seen — calling ``on_compile`` once so the caller can count it (the
     serving tier's warmup-coverage alarm, the batch tier's chunk-shape
-    accounting)."""
+    accounting). On a sharded segment the chain lowers SPMD — batch rows
+    split over the data axis, or fully ``replicated`` for a sub-floor ragged
+    tail (the caller bakes the mode into ``key``: the two compile different
+    executables)."""
     chain = segment.compiled.get(key)
     if chain is None:
         if on_compile is not None:
             on_compile()
+        if segment.sharding is not None and not replicated:
+            rows = next(iter(inputs.values())).shape[0]
+            if rows % segment.sharding.n_data:
+                raise IneligibleBatch(
+                    f"{rows} rows not divisible by the {segment.sharding.n_data}-way "
+                    "data axis — pad to a mesh multiple or run replicated"
+                )
         chain = []
         cols: Dict[str, Any] = dict(inputs)
         for prog in segment.programs:
@@ -251,7 +298,7 @@ def run_segment(
             compiled = prog.jitted.lower(
                 prog.models,
                 {
-                    n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    n: _lowering_struct(segment, a, replicated)
                     for n, a in stage_inputs.items()
                 },
             ).compile()

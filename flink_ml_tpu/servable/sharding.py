@@ -1,0 +1,206 @@
+"""PlanSharding — mesh placement policy for compiled plans (pod-scale fan-out).
+
+The chain compiler (``servable/planner.py``) and both of its consumers — the
+serving tier's ``CompiledServingPlan`` and the batch tier's
+``CompiledBatchPlan`` — are single-device by default. This module is the one
+place the plan tier meets a device mesh (``parallel/mesh.py``): a resolved
+:class:`PlanSharding` carries the mesh, the batch/replicated/model
+``NamedSharding`` vocabulary, and the padding discipline that keeps sharded
+results **bit-identical per row** to the single-device path.
+
+Why bit-exactness needs a discipline at all — the MIN_SHARD_ROWS note:
+
+Row-independent programs (everything a :class:`KernelSpec` may contain:
+elementwise math, per-row reductions like a logistic margin or a row norm)
+have no cross-row accumulation, so sharding rows across a data axis cannot
+reorder any sum *in the program*. What CAN change bits is XLA's emitter
+choice per **shape**: measured on this backend, a gemv-style dot (``x @ w``)
+row-blocks in units of 8 — rows inside complete 8-row blocks are
+bit-invariant across every shape measured, while the trailing ``rows % 8``
+remainder rows take a shape-dependent strategy (~1 ulp of movement).
+Elementwise ops, matmuls, row norms and distance reductions showed no row
+dependence at any shape. A sharded program is therefore bit-identical per
+row to the mesh=1 program exactly when **neither side computes any row in a
+remainder position**:
+
+- **Serving buckets** are multiples of ``MIN_SHARD_ROWS * n_data``
+  (:meth:`serving_buckets`): the mesh=1 bucket shape and every local shard
+  shape are both remainder-free, so every row is in-block in both programs.
+- **Batch chunks** shard when the chunk's row count is a multiple of
+  ``MIN_SHARD_ROWS`` (mesh=1's own program for that chunk is
+  remainder-free), padding up to a multiple of ``MIN_SHARD_ROWS * n_data``
+  so local shapes are too (pad rows repeat row 0 and are sliced off). A
+  ragged tail failing that test runs **replicated** instead — every device
+  computes the tail at its natural shape, the exact local program mesh=1
+  compiles, so its rows are bit-identical too, just redundantly computed.
+
+Tensor parallelism (``n_model > 1``) is the documented exception: sharding a
+wide head's output dim makes XLA reassociate partial products, so TP results
+carry an ulp envelope instead of bit-equality. It is opt-in per plan and
+never on by default.
+
+Weights placed through :meth:`put_model` are committed **per shard at
+build/warmup time** — for serving that is swap time, before the atomic
+version flip, so hot swap and rollback stay off the serving path on every
+device. :meth:`put_batch` is THE blessed host→device ingest boundary of the
+sharded paths (one ``device_put`` per call; the runtime splits it into one
+transfer per shard) — graftcheck's host-sync rule flags any other
+``device_put`` inside a hot region.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from flink_ml_tpu.parallel.mesh import MeshContext
+
+__all__ = [
+    "MIN_SHARD_ROWS",
+    "TP_MIN_WIDTH",
+    "PlanSharding",
+    "resolve_plan_sharding",
+]
+
+#: The row-blocking unit of XLA CPU's gemv emitter — the bit-exactness
+#: contract requires every sharded shape (global and per-shard) to be a
+#: multiple of it, so no row is ever computed by the shape-dependent
+#: remainder strategy (~1 ulp of movement) on one side but not the other.
+MIN_SHARD_ROWS = 8
+
+#: Narrowest trailing dim a 2-D model array must have before the optional
+#: tensor-parallel axis shards it — heads narrower than this gain nothing
+#: from TP and would pay a collective per program.
+TP_MIN_WIDTH = 64
+
+
+class PlanSharding:
+    """Resolved mesh placement for one compiled plan (see module docstring).
+
+    Wraps a :class:`~flink_ml_tpu.parallel.mesh.MeshContext` over the first
+    ``n_data * n_model`` visible devices and exposes exactly the vocabulary
+    the plan tier needs: batch/replicated shardings, the DP padding rules,
+    and the two blessed ``device_put`` entry points.
+    """
+
+    __slots__ = ("ctx", "n_data", "n_model", "batch", "replicated")
+
+    def __init__(self, n_data: int, n_model: int = 1, devices: Optional[Sequence[Any]] = None):
+        self.ctx = MeshContext(
+            devices=list(devices) if devices is not None else jax.devices(),
+            n_data=int(n_data),
+            n_model=int(n_model),
+        )
+        self.n_data = self.ctx.n_data
+        self.n_model = self.ctx.n_model
+        self.batch = self.ctx.batch
+        self.replicated = self.ctx.replicated
+
+    # -- identity --------------------------------------------------------------
+    @property
+    def key(self) -> Tuple[int, int]:
+        """Cache identity of this placement — plans compiled under one key
+        are invalid under another (different local shapes, different
+        committed buffers)."""
+        return (self.n_data, self.n_model)
+
+    def __repr__(self) -> str:
+        return f"PlanSharding(data={self.n_data}, model={self.n_model})"
+
+    # -- padding discipline ----------------------------------------------------
+    @property
+    def row_multiple(self) -> int:
+        """The quantum every sharded shape must be a multiple of: local
+        shards stay remainder-free (see the MIN_SHARD_ROWS note)."""
+        return MIN_SHARD_ROWS * self.n_data
+
+    def padded_rows(self, n: int) -> int:
+        """``n`` rounded up to the sharded-shape quantum (``row_multiple``):
+        even shards for XLA, remainder-free local shapes for bit-exactness."""
+        r = n % self.row_multiple
+        return n if r == 0 else n + (self.row_multiple - r)
+
+    def shardable_rows(self, n: int) -> bool:
+        """Whether an ``n``-row block may shard under the bit-exactness
+        contract: mesh=1's own program for these rows must be remainder-free
+        (``n % MIN_SHARD_ROWS == 0``) — the padded local shape then is too."""
+        return n % MIN_SHARD_ROWS == 0
+
+    def serving_buckets(self, max_batch_size: int) -> Tuple[int, ...]:
+        """The mesh-aware bucket ladder: doubling sizes from the floor
+        ``MIN_SHARD_ROWS * n_data`` up to ``max_batch_size`` (itself always a
+        bucket, as in ``power_of_two_buckets``). Every bucket is a multiple
+        of the quantum, so both the mesh=1 bucket shape and every local
+        shard shape are remainder-free — sharded buckets serve
+        bit-identically to mesh=1."""
+        floor = self.row_multiple
+        if max_batch_size < floor or max_batch_size % floor:
+            raise ValueError(
+                f"serving.mesh={self.n_data} needs serving.max.batch.size to be a "
+                f"multiple of {floor} (= MIN_SHARD_ROWS * mesh, the sharded "
+                f"bucket quantum); got {max_batch_size}"
+            )
+        buckets = []
+        b = floor
+        while b < max_batch_size:
+            buckets.append(b)
+            b *= 2
+        buckets.append(max_batch_size)
+        return tuple(buckets)
+
+    # -- placement -------------------------------------------------------------
+    def put_batch(self, array) -> jax.Array:  # graftcheck: ingest
+        # THE blessed host->device ingest boundary of the sharded fast paths:
+        # one device_put per call, split by the runtime into one transfer per
+        # shard. Rows must already be a multiple of n_data (the padding
+        # discipline above) — uneven shards would change local shapes.
+        return jax.device_put(array, self.batch)
+
+    def put_replicated(self, array) -> jax.Array:  # graftcheck: ingest
+        """Full copy on every device (the other blessed ingest form, used
+        for sub-quantum ragged tails: every device runs the mesh=1 program
+        shape, bit-identical, redundant)."""
+        return jax.device_put(array, self.replicated)
+
+    def put_model(self, array) -> jax.Array:
+        """Commit one model array to the mesh — the per-shard weight
+        placement hot swap pays at warmup time, never on the serving path.
+
+        Default placement is replicated (every shard holds a full copy, the
+        broadcast-variable layout). With a tensor-parallel axis, wide 2-D
+        heads (trailing dim divisible by ``n_model`` and >= TP_MIN_WIDTH)
+        shard their output dim instead — the documented ulp-envelope tier."""
+        arr = np.asarray(array)
+        if (
+            self.n_model > 1
+            and arr.ndim == 2
+            and arr.shape[1] >= TP_MIN_WIDTH
+            and arr.shape[1] % self.n_model == 0
+        ):
+            from flink_ml_tpu.parallel.mesh import MODEL_AXIS
+
+            return jax.device_put(arr, self.ctx.sharding(None, MODEL_AXIS))
+        return jax.device_put(arr, self.replicated)
+
+    def input_struct(self, shape, dtype, *, replicated: bool = False) -> jax.ShapeDtypeStruct:
+        """Lowering aval for one ingest column: leading dim sharded over the
+        data axis (or fully replicated for the sub-floor tail path)."""
+        return jax.ShapeDtypeStruct(
+            tuple(shape), dtype, sharding=self.replicated if replicated else self.batch
+        )
+
+
+def resolve_plan_sharding(
+    mesh: Optional[int], mesh_model: Optional[int] = 1
+) -> Optional["PlanSharding"]:
+    """Resolve a plan tier's mesh config to a placement, or ``None`` for the
+    single-device path (``mesh`` unset, 1, or fewer — today's default).
+    Raises ``ValueError`` when the host exposes fewer devices than the mesh
+    asks for: a silently-shrunk mesh would serve with different local shapes
+    than the deployment was validated at."""
+    n_data = int(mesh) if mesh else 1
+    n_model = int(mesh_model) if mesh_model else 1
+    if n_data <= 1 and n_model <= 1:
+        return None
+    return PlanSharding(max(1, n_data), max(1, n_model))

@@ -168,6 +168,8 @@ class MicroBatcher:
         response_factory: Callable[[DataFrame, int, float, int], object],
         dispatch: Optional[Callable[[DataFrame], Optional[object]]] = None,
         pipeline_depth: int = 1,
+        buckets: Optional[Sequence[int]] = None,
+        shards: int = 1,
     ):
         self._execute = execute
         # Async seam: dispatch(padded_df) -> handle with .result() -> (df,
@@ -177,7 +179,16 @@ class MicroBatcher:
         self.max_batch_size = int(max_batch_size)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.queue_capacity_rows = int(queue_capacity_rows)
-        self.buckets = power_of_two_buckets(self.max_batch_size)
+        # Mesh-aware bucket selection: the server passes the sharding tier's
+        # ladder (multiples of the data axis — PlanSharding.serving_buckets)
+        # so every padded batch splits evenly across shards; default is the
+        # classic power-of-two set. ``shards`` only annotates spans — the
+        # goodput report divides a batch's device time per shard.
+        self.buckets = (
+            tuple(buckets) if buckets is not None
+            else power_of_two_buckets(self.max_batch_size)
+        )
+        self.shards = max(1, int(shards))
         self.scope = scope
         self._response_factory = response_factory
 
@@ -367,6 +378,12 @@ class MicroBatcher:
         batch_span.set_attr("rows", rows)
         batch_span.set_attr("bucket", bucket)
         batch_span.set_attr("requests", len(claimed))
+        if self.shards > 1:
+            # ``rows`` stays the true request rows and ``bucket`` the padded
+            # (mesh-multiple) size, so the goodput padding split counts the
+            # DP round-up exactly once; ``shards`` lets traceview attribute
+            # the batch's device time per shard.
+            batch_span.set_attr("shards", self.shards)
         for req in claimed[1:]:
             if req.trace is not None:
                 req.trace.set_attr("batch", batch_span.span_id)
@@ -388,6 +405,9 @@ class MicroBatcher:
                 with tracer.span("serving.dispatch", CAT_PRODUCTIVE, scope=self.scope, parent=batch_span) as sp:
                     sp.set_attr("rows", rows)
                     sp.set_attr("bucket", bucket)
+                    if self.shards > 1:
+                        sp.set_attr("shards", self.shards)
+                        sp.set_attr("shard_rows", bucket // self.shards)
                     handle = self._dispatch(padded)
             except BaseException as e:  # noqa: BLE001 — delivered to each waiter
                 self._deliver_error(claimed, e, batch_span)
@@ -398,6 +418,9 @@ class MicroBatcher:
             with tracer.span("serving.exec", CAT_PRODUCTIVE, scope=self.scope, parent=batch_span) as sp:
                 sp.set_attr("rows", rows)
                 sp.set_attr("bucket", bucket)
+                if self.shards > 1:
+                    sp.set_attr("shards", self.shards)
+                    sp.set_attr("shard_rows", bucket // self.shards)
                 out, version = self._execute(padded)
         except BaseException as e:  # noqa: BLE001 — delivered to each waiter
             self._deliver_error(claimed, e, batch_span)
@@ -411,6 +434,8 @@ class MicroBatcher:
             with tracer.span("serving.readback", CAT_READBACK, scope=self.scope, parent=batch_span) as sp:
                 sp.set_attr("rows", rows)
                 sp.set_attr("bucket", bucket)
+                if self.shards > 1:
+                    sp.set_attr("shards", self.shards)
                 out, version = handle.result()  # the one blocking readback
         except BaseException as e:  # noqa: BLE001 — delivered to each waiter
             self._deliver_error(claimed, e, batch_span)

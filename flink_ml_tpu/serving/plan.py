@@ -74,21 +74,37 @@ class CompiledServingPlan:
     fixed bucket set. Build via :meth:`build`; ``None`` means no stage has a
     kernel spec and the classic per-stage path should serve."""
 
-    def __init__(self, stages: Sequence[Any], segments: List[Any], scope: str):
+    def __init__(
+        self,
+        stages: Sequence[Any],
+        segments: List[Any],
+        scope: str,
+        sharding: Optional[Any] = None,
+    ):
         self._stages = list(stages)
         self.segments = segments
         self.scope = scope
+        self.sharding = sharding
         n_fused = sum(len(s.specs) for s in segments if isinstance(s, FusedSegment))
         n_fallback = sum(1 for s in segments if isinstance(s, FallbackStage))
         metrics.gauge(scope, MLMetrics.SERVING_FUSED_STAGES, n_fused)
         metrics.gauge(scope, MLMetrics.SERVING_FALLBACK_STAGES, n_fallback)
+        if sharding is not None:
+            metrics.gauge(scope, MLMetrics.SERVING_SHARD_COUNT, sharding.n_data)
+            metrics.gauge(scope, MLMetrics.SERVING_SHARD_MODEL_AXIS, sharding.n_model)
 
     # -- construction ---------------------------------------------------------
     @staticmethod
-    def build(servable, *, scope: str = "ml.serving[plan]") -> Optional["CompiledServingPlan"]:  # graftcheck: cold
+    def build(  # graftcheck: cold
+        servable, *, scope: str = "ml.serving[plan]", sharding: Optional[Any] = None
+    ) -> Optional["CompiledServingPlan"]:
         """Group the servable's consecutive kernel-spec stages into fused
         segments. Raises whatever ``kernel_spec()`` raises (an unloaded model
-        must fail closed at warmup, before it could ever serve).
+        must fail closed at warmup, before it could ever serve). With a
+        ``sharding`` (``serving.mesh`` > 1), segments commit weights per
+        shard and compile SPMD per-bucket executables — hot swap and rollback
+        pay the per-device placement here, at warmup, never on the serving
+        path.
 
         Build-time work (one device_put per model array, jit wrapper
         construction per program): normally runs at warmup/swap time, off the
@@ -100,10 +116,10 @@ class CompiledServingPlan:
             if isinstance(servable, PipelineModelServable)
             else [servable]
         )
-        segments = build_segments(stages)
+        segments = build_segments(stages, sharding)
         if not any(isinstance(s, FusedSegment) for s in segments):
             return None
-        return CompiledServingPlan(stages, segments, scope)
+        return CompiledServingPlan(stages, segments, scope, sharding)
 
     # -- warmup / AOT ---------------------------------------------------------
     def warmup(self, template: DataFrame, buckets: Sequence[int]) -> None:
@@ -115,6 +131,8 @@ class CompiledServingPlan:
         for bucket in buckets:
             with tracer.span("serving.plan.warmup", CAT_COMPILE, scope=self.scope) as sp:
                 sp.set_attr("bucket", bucket)
+                if self.sharding is not None:
+                    sp.set_attr("shards", self.sharding.n_data)
                 df = pad_to(template, bucket)
                 for segment in self.segments:
                     if isinstance(segment, FallbackStage):
@@ -155,6 +173,15 @@ class CompiledServingPlan:
         """One host-side gather of the segment's input columns, exactly the
         way each stage's ``transform`` would read them (dense f32), checked
         against the bucket's compiled signature."""
+        if self.sharding is not None and bucket % self.sharding.row_multiple:
+            # A bucket off the mesh ladder cannot shard bit-exactly (local
+            # shapes would gain remainder rows) — only reachable when a
+            # caller bypasses the mesh bucket ladder; fall back per-stage
+            # rather than serve different bits.
+            raise IneligibleBatch(
+                f"bucket {bucket} not a multiple of the sharded bucket "
+                f"quantum {self.sharding.row_multiple}"
+            )
         inputs: Dict[str, np.ndarray] = {}
         signature = segment.signatures.get(bucket)
         for name in segment.external_inputs:
@@ -203,6 +230,12 @@ class CompiledServingPlan:
             fused_ran = True
         if fused_ran:
             metrics.counter(self.scope, MLMetrics.SERVING_FUSED_BATCHES)
+            if self.sharding is not None:
+                metrics.counter(
+                    self.scope,
+                    MLMetrics.SERVING_SHARD_ROWS,
+                    bucket // self.sharding.n_data,
+                )
         return PlanExecution(df, pending)
 
     def execute(self, padded_df: DataFrame) -> DataFrame:

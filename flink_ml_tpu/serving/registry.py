@@ -15,7 +15,12 @@ not cost availability. The contract here —
   servable (one dummy batch per bucket, compiling every serving shape) while
   the old version keeps serving; only then does ``ModelRegistry.swap`` flip
   one tuple — a batch snapshots ``(version, servable)`` once, so every
-  response comes from exactly one fully-loaded version.
+  response comes from exactly one fully-loaded version. On a mesh-sharded
+  server (``serving.mesh`` > 1) the warmup's plan build is also where the
+  incoming version's weights are device-put **per shard** (replicated or
+  TP-split — ``servable/sharding.py``) and every (version, bucket, mesh)
+  SPMD executable AOT-compiles — so a flip or rollback never puts a
+  transfer or compile on the serving path of any device.
 - **Fall back**: a version that fails to load (``serving.swap`` fault point)
   is remembered as bad and the next older intact one is tried — mirroring
   ``CheckpointManager.restore_latest``'s quarantine-and-fall-back.

@@ -31,6 +31,7 @@ from flink_ml_tpu.serving.batcher import MicroBatcher, pad_to
 from flink_ml_tpu.serving.errors import NoModelError, ServingClosedError
 from flink_ml_tpu.serving.plan import CompiledServingPlan
 from flink_ml_tpu.serving.registry import ModelRegistry, ModelVersionPoller
+from flink_ml_tpu.servable.sharding import resolve_plan_sharding
 from flink_ml_tpu.trace import CAT_COMPILE, CAT_SWAP, tracer
 
 __all__ = ["ServingConfig", "ServingResponse", "InferenceServer"]
@@ -67,6 +68,8 @@ class ServingConfig:
         poll_interval_ms: Optional[float] = None,
         fastpath: Optional[bool] = None,
         pipeline_depth: Optional[int] = None,
+        mesh: Optional[int] = None,
+        mesh_model: Optional[int] = None,
     ):
         self.max_batch_size = (
             int(max_batch_size) if max_batch_size is not None
@@ -96,6 +99,13 @@ class ServingConfig:
             int(pipeline_depth) if pipeline_depth is not None
             else config.get(Options.SERVING_PIPELINE_DEPTH)
         )
+        self.mesh = (
+            int(mesh) if mesh is not None else config.get(Options.SERVING_MESH)
+        )
+        self.mesh_model = (
+            int(mesh_model) if mesh_model is not None
+            else config.get(Options.SERVING_MESH_MODEL)
+        )
 
     def __repr__(self) -> str:
         return (
@@ -104,7 +114,8 @@ class ServingConfig:
             f"queue_capacity_rows={self.queue_capacity_rows}, "
             f"default_timeout_ms={self.default_timeout_ms}, "
             f"poll_interval_ms={self.poll_interval_ms}, "
-            f"fastpath={self.fastpath}, pipeline_depth={self.pipeline_depth})"
+            f"fastpath={self.fastpath}, pipeline_depth={self.pipeline_depth}, "
+            f"mesh={self.mesh}, mesh_model={self.mesh_model})"
         )
 
 
@@ -167,6 +178,16 @@ class InferenceServer:
         self._template_lock = threading.Lock()
         self._poller: Optional[ModelVersionPoller] = None
         self._closed = False
+        # Mesh-sharded serving (serving.mesh > 1, docs/serving.md): one
+        # placement for the server's whole life — every version's plan
+        # compiles SPMD per-bucket executables against it, with weights
+        # device-put per shard at swap time. Resolving here (not lazily)
+        # makes a mesh the host cannot satisfy fail at construction.
+        self._sharding = (
+            resolve_plan_sharding(self.config.mesh, self.config.mesh_model)
+            if self.config.fastpath
+            else None
+        )
         self._batcher = MicroBatcher(
             self._execute,
             max_batch_size=self.config.max_batch_size,
@@ -176,6 +197,12 @@ class InferenceServer:
             response_factory=ServingResponse,
             dispatch=self._dispatch if self.config.fastpath else None,
             pipeline_depth=self.config.pipeline_depth,
+            buckets=(
+                self._sharding.serving_buckets(self.config.max_batch_size)
+                if self._sharding is not None
+                else None
+            ),
+            shards=self._sharding.n_data if self._sharding is not None else 1,
         )
         if servable is not None:
             self.swap(version, servable)
@@ -191,8 +218,17 @@ class InferenceServer:
         if not self.config.fastpath:
             return None
         plan = getattr(servable, "_fastpath_plan", _PLAN_UNSET)
-        if plan is _PLAN_UNSET:
-            plan = CompiledServingPlan.build(servable, scope=self.scope)
+        if plan is _PLAN_UNSET or (
+            # A plan compiled under a different placement (the same servable
+            # object attached to a server with another mesh) has the wrong
+            # local shapes and committed buffers — rebuild for this mesh.
+            plan is not None
+            and getattr(plan.sharding, "key", None)
+            != (self._sharding.key if self._sharding is not None else None)
+        ):
+            plan = CompiledServingPlan.build(
+                servable, scope=self.scope, sharding=self._sharding
+            )
             try:
                 servable._fastpath_plan = plan
             except AttributeError:  # __slots__ servable: serve without a plan

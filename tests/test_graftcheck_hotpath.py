@@ -267,6 +267,68 @@ def test_host_sync_reaches_nested_defs(tmp_path):
     assert len(result.findings) == 1 and ".item()" in result.findings[0].message
 
 
+INGEST_DIRTY = {
+    "flink_ml_tpu/builder/chunks.py": """
+        import jax
+
+        class Plan:
+            def run(self, arrs):  # graftcheck: hot-root
+                return [self._upload(a) for a in arrs]
+
+            def _upload(self, a):
+                return jax.device_put(a)   # per-call upload outside the boundary
+    """,
+}
+
+INGEST_CLEAN = {
+    "flink_ml_tpu/builder/chunks.py": """
+        import jax
+
+        class Plan:
+            def run(self, arrs):  # graftcheck: hot-root
+                return [self._upload(a) for a in arrs]
+
+            def _upload(self, a):  # graftcheck: ingest
+                return jax.device_put(a)   # THE blessed boundary
+    """,
+}
+
+
+def test_host_sync_flags_device_put_in_hot_region(tmp_path):
+    """A per-call jax.device_put inside the hot region (outside an ingest
+    boundary) is the per-shard-upload leak the sharded fast paths forbid."""
+    result = run_on(tmp_path, INGEST_DIRTY, rules=["host-sync"])
+    assert len(result.findings) == 1, [f.render() for f in result.findings]
+    assert "device_put" in result.findings[0].message
+    assert "ingest" in result.findings[0].message
+
+
+def test_host_sync_ingest_mark_blesses_device_put(tmp_path):
+    result = run_on(tmp_path, INGEST_CLEAN, rules=["host-sync"])
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+def test_host_sync_ingest_mark_does_not_exempt_syncs(tmp_path):
+    """The ingest boundary blesses uploads only — a device->host sync inside
+    it still flags."""
+    files = {
+        "flink_ml_tpu/builder/chunks.py": """
+            import jax
+
+            class Plan:
+                def run(self, arrs):  # graftcheck: hot-root
+                    return [self._upload(a) for a in arrs]
+
+                def _upload(self, a):  # graftcheck: ingest
+                    probe = jax.device_put(a)
+                    return probe.item()
+        """,
+    }
+    result = run_on(tmp_path, files, rules=["host-sync"])
+    assert len(result.findings) == 1, [f.render() for f in result.findings]
+    assert ".item()" in result.findings[0].message
+
+
 # -----------------------------------------------------------------------------
 # blocking-under-lock
 # -----------------------------------------------------------------------------
@@ -483,6 +545,11 @@ def test_shipped_tree_declares_hot_roots_and_readbacks():
     assert any("PlanExecution.finalize" in n for n in marks["readback"])
     assert any("readback_one" in n for n in marks["readback"])
     assert any("CompiledServingPlan.build" in n for n in marks["cold"])
+    # the sharded fast paths' blessed upload boundaries (pod-scale fan-out)
+    assert any("PlanSharding.put_batch" in n for n in marks["ingest"])
+    assert any("PlanSharding.put_replicated" in n for n in marks["ingest"])
+    assert any(":CompiledBatchPlan._run_fused.ingest" in n or "ingest" in n.rsplit(".", 1)[-1]
+               for n in marks["ingest"])
     # and the hot region they span is non-trivial (the call graph resolves
     # through the server/plan/planner layers)
     reach = index.reachable(marks["hot-root"])

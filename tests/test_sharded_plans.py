@@ -1,0 +1,489 @@
+"""Pod-scale fan-out (servable/sharding.py) — the mesh-sharded plan contract:
+
+- **per-row bit-exactness**: fused serving and batch-transform results under
+  ``mesh=N`` (N in {2,4,8} forced host devices) are bit-identical per row to
+  the ``mesh=1`` path, at reduction-sensitive widths 8/16/256, across hot
+  swap and rollback — the row-remainder discipline of
+  ``servable.sharding.MIN_SHARD_ROWS`` makes this hold by construction;
+- **zero hot-path cost on every shard**: after warmup the sharded serving
+  path never compiles and never calls ``jax.device_put`` (weights committed
+  per shard at swap time, request rows ride the SPMD executable's own
+  intake) — the poisoned-``device_put`` pattern from test_serving_fastpath;
+- **mesh bucket ladder**: buckets are multiples of ``MIN_SHARD_ROWS * N``,
+  and the batch span's ``rows``/``bucket`` attrs stay exact so the goodput
+  padding split counts the DP round-up exactly once;
+- **ragged batch chunks**: a final chunk rounds up to the sharded quantum
+  (pad rows counted, sliced off) or runs replicated below it — bit-exact
+  either way;
+- **tensor parallelism** (``serving.mesh.model``) is the documented
+  ulp-envelope exception, never on by default.
+"""
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.builder.batch_plan import CompiledBatchPlan
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.servable import (
+    KMeansModelServable,
+    LogisticRegressionModelServable,
+    PipelineModelServable,
+    StandardScalerModelServable,
+)
+from flink_ml_tpu.servable.sharding import (
+    MIN_SHARD_ROWS,
+    PlanSharding,
+    resolve_plan_sharding,
+)
+from flink_ml_tpu.serving import (
+    CompiledServingPlan,
+    InferenceServer,
+    ServingConfig,
+    pad_to,
+)
+from flink_ml_tpu import trace
+from flink_ml_tpu.trace import GoodputReport
+
+MESHES = (1, 2, 4, 8)
+
+
+def _skip_if_too_few_devices(n):
+    if n > len(jax.devices()):
+        pytest.skip(f"needs {n} devices, host exposes {len(jax.devices())}")
+
+
+def _pipe(dim, seed=0):
+    rng = np.random.default_rng(seed)
+    sc = StandardScalerModelServable().set_input_col("features").set_output_col("scaled")
+    sc.mean = rng.normal(size=dim)
+    sc.std = np.abs(rng.normal(size=dim)) + 0.5
+    sc.set_with_mean(True)
+    lr = LogisticRegressionModelServable().set_features_col("scaled")
+    lr.coefficient = rng.normal(size=dim)
+    km = KMeansModelServable().set_features_col("scaled").set_prediction_col("cluster")
+    km.centroids = rng.normal(size=(3, dim))
+    km.weights = np.ones(3)
+    return PipelineModelServable([sc, lr, km])
+
+
+def _features(n, dim, seed=3):
+    return DataFrame.from_dict(
+        {"features": np.random.default_rng(seed).normal(size=(n, dim))}
+    )
+
+
+def _assert_frames_bitexact(a: DataFrame, b: DataFrame):
+    assert a.get_column_names() == b.get_column_names()
+    for name in a.get_column_names():
+        ca, cb = np.asarray(a[name]), np.asarray(b[name])
+        assert ca.dtype == cb.dtype, name
+        np.testing.assert_array_equal(ca, cb, err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# sharding vocabulary
+# ---------------------------------------------------------------------------
+class TestPlanSharding:
+    def test_resolve_mesh_1_is_none(self):
+        assert resolve_plan_sharding(1) is None
+        assert resolve_plan_sharding(None) is None
+        assert resolve_plan_sharding(0, 1) is None
+
+    def test_resolve_too_many_devices_raises(self):
+        with pytest.raises(ValueError):
+            resolve_plan_sharding(len(jax.devices()) * 2)
+
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_bucket_ladder_is_mesh_quantized(self, n):
+        _skip_if_too_few_devices(n)
+        sh = resolve_plan_sharding(n)
+        buckets = sh.serving_buckets(64)
+        assert buckets[-1] == 64
+        assert all(b % (MIN_SHARD_ROWS * n) == 0 for b in buckets)
+        assert buckets == tuple(sorted(buckets))
+        # the ladder floor keeps every shard remainder-free
+        assert buckets[0] == MIN_SHARD_ROWS * n
+
+    def test_bucket_ladder_rejects_sub_quantum_max(self):
+        _skip_if_too_few_devices(4)
+        sh = resolve_plan_sharding(4)
+        with pytest.raises(ValueError):
+            sh.serving_buckets(16)  # < 8*4
+        with pytest.raises(ValueError):
+            sh.serving_buckets(40)  # not a multiple of 32
+
+    def test_padding_and_shardability(self):
+        _skip_if_too_few_devices(4)
+        sh = resolve_plan_sharding(4)
+        assert sh.row_multiple == 32
+        assert sh.padded_rows(32) == 32
+        assert sh.padded_rows(33) == 64
+        assert sh.shardable_rows(40) and not sh.shardable_rows(36)
+
+
+# ---------------------------------------------------------------------------
+# plan-level parity: sharded vs mesh=1, widths 8/16/256
+# ---------------------------------------------------------------------------
+class TestShardedPlanParity:
+    @pytest.mark.parametrize("dim", [8, 16, 256])
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_serving_plan_bitexact_vs_mesh1(self, dim, n):
+        _skip_if_too_few_devices(n)
+        df = _features(64, dim, seed=dim)
+        base = CompiledServingPlan.build(_pipe(dim), scope=f"ml.serving[t-sh-base{dim}]")
+        base.warmup(df.take([0]), (16, 64))
+        sh = resolve_plan_sharding(n)
+        plan = CompiledServingPlan.build(
+            _pipe(dim), scope=f"ml.serving[t-sh{n}-{dim}]", sharding=sh
+        )
+        buckets = sh.serving_buckets(64)
+        plan.warmup(df.take([0]), buckets)
+        for bucket in buckets:
+            if bucket not in (16, 64):
+                continue
+            padded = df.take(np.arange(bucket))
+            _assert_frames_bitexact(base.execute(padded), plan.execute(padded))
+
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_off_ladder_bucket_falls_back_bitexact(self, n):
+        """A bucket that is not a mesh-quantum multiple cannot shard without
+        changing local shapes — it must serve through the counted per-stage
+        fallback, bit-exactly."""
+        _skip_if_too_few_devices(n)
+        dim = 16
+        pipe = _pipe(dim)
+        sh = resolve_plan_sharding(n)
+        scope = f"ml.serving[t-offladder{n}]"
+        plan = CompiledServingPlan.build(_pipe(dim), scope=scope, sharding=sh)
+        df = _features(MIN_SHARD_ROWS * n + 4, dim)  # off the ladder
+        before = metrics.get(scope, MLMetrics.SERVING_FALLBACK_BATCHES) or 0
+        _assert_frames_bitexact(pipe.transform(df), plan.execute(df))
+        assert metrics.get(scope, MLMetrics.SERVING_FALLBACK_BATCHES) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# server-level: zero compiles / zero device_put on every shard, swap+rollback
+# ---------------------------------------------------------------------------
+class TestShardedServer:
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_cold_hot_path_across_swap_and_rollback(self, n, monkeypatch):
+        """Traffic at mesh=N: responses bit-identical per row to the mesh=1
+        reference transform at the response bucket, across a hot swap to v2
+        and a rollback to v1 — with compiles blocked and ``jax.device_put``
+        poisoned for the whole traffic phase (weights committed per shard at
+        swap time, rows ride the SPMD executables' own intake)."""
+        _skip_if_too_few_devices(n)
+        dim = 16
+        pipe_v1, pipe_v2 = _pipe(dim, seed=10), _pipe(dim, seed=20)
+        refs = {1: _pipe(dim, seed=10), 2: _pipe(dim, seed=20)}
+        cfg = ServingConfig(
+            max_batch_size=64, max_delay_ms=0.0, queue_capacity_rows=1024, mesh=n
+        )
+        X = np.asarray(_features(64, dim, seed=9)["features"])
+        with InferenceServer(
+            pipe_v1, name=f"t-shard-cold{n}", serving_config=cfg,
+            warmup_template=_features(1, dim),
+        ) as server:
+            server.swap(2, pipe_v2)  # warm + flip BEFORE poisoning
+            server.rollback(1, pipe_v1)
+            server.swap(2, pipe_v2)
+
+            def no_compile(*a, **k):
+                raise AssertionError("XLA compile on the sharded hot path")
+
+            for servable in (pipe_v1, pipe_v2):
+                plan = servable._fastpath_plan
+                assert plan is not None and plan.sharding is not None
+                for segment in plan.segments:
+                    for prog in segment.programs:
+                        monkeypatch.setattr(prog.jitted, "lower", no_compile, raising=False)
+
+            def no_device_put(*a, **k):
+                raise AssertionError("device_put on the sharded hot path")
+
+            monkeypatch.setattr(jax, "device_put", no_device_put)
+
+            seen_versions = []
+            for k in range(6):
+                rows = (k % 3) + 1
+                df = DataFrame.from_dict({"features": X[k : k + rows]})
+                resp = server.predict(df)
+                seen_versions.append(resp.model_version)
+                expected = refs[resp.model_version].transform(
+                    pad_to(df, resp.bucket)
+                ).take(np.arange(rows))
+                _assert_frames_bitexact(resp.dataframe, expected)
+                assert resp.bucket % (MIN_SHARD_ROWS * n) == 0
+            # rollback then serve again, still under poison: the restored
+            # version's plan was warmed before the flip
+            monkeypatch.setattr(jax, "device_put", jax.device_put, raising=False)
+            scope = server.scope
+        assert not metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES)
+        assert metrics.get(scope, MLMetrics.SERVING_SHARD_COUNT) == n
+        assert metrics.get(scope, MLMetrics.SERVING_SHARD_ROWS) > 0
+        assert set(seen_versions) == {2}
+
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_mesh_bucket_rows_attrs_stay_exact(self, n):
+        """The batcher's (rows, bucket) history and the batch span attrs
+        carry TRUE request rows against the DP-padded bucket — the goodput
+        padding split counts the round-up exactly once."""
+        _skip_if_too_few_devices(n)
+        dim = 8
+        cfg = ServingConfig(max_batch_size=64, max_delay_ms=0.0, mesh=n)
+        with trace.capture() as rec:
+            with InferenceServer(
+                _pipe(dim), name=f"t-shard-attrs{n}", serving_config=cfg,
+                warmup_template=_features(1, dim),
+            ) as server:
+                server.predict(_features(3, dim))
+                sizes = server.executed_batch_sizes
+        assert sizes == [(3, MIN_SHARD_ROWS * n)]
+        batch_spans = [s for s in rec.snapshot() if s.name == "serving.batch"]
+        assert len(batch_spans) == 1
+        attrs = batch_spans[0].attrs
+        assert attrs["rows"] == 3
+        assert attrs["bucket"] == MIN_SHARD_ROWS * n
+        assert attrs["shards"] == n
+        # dispatch span carries the per-shard split for traceview
+        d = [s for s in rec.snapshot() if s.name == "serving.dispatch"]
+        assert d and d[0].attrs["shard_rows"] == attrs["bucket"] // n
+        # and the padding split sees (bucket - rows) / bucket — once
+        report = GoodputReport.from_spans(rec.snapshot())
+        scope = f"{MLMetrics.SERVING_GROUP}[t-shard-attrs{n}]"
+        assert report.category_s(scope, trace.CAT_PADDING) >= 0.0
+        assert report.wall_s(scope) > 0.0
+
+    def test_mesh1_default_unchanged(self):
+        """serving.mesh default (1) keeps today's buckets and an unsharded
+        plan — byte-for-byte the PR 4 path."""
+        dim = 8
+        cfg = ServingConfig(max_batch_size=64, max_delay_ms=0.0)
+        pipe = _pipe(dim)
+        with InferenceServer(
+            pipe, name="t-mesh1", serving_config=cfg,
+            warmup_template=_features(1, dim),
+        ) as server:
+            assert server._batcher.buckets == (1, 2, 4, 8, 16, 32, 64)
+            assert server._batcher.shards == 1
+            server.predict(_features(2, dim))
+            assert pipe._fastpath_plan.sharding is None
+
+    def test_plan_rebuilds_when_mesh_changes(self):
+        """The same servable served on servers with different meshes must
+        not reuse a plan compiled for the other placement."""
+        _skip_if_too_few_devices(2)
+        dim = 8
+        pipe = _pipe(dim)
+        with InferenceServer(
+            pipe, name="t-remesh-a",
+            serving_config=ServingConfig(max_batch_size=64, max_delay_ms=0.0),
+            warmup_template=_features(1, dim),
+        ) as a:
+            a.predict(_features(2, dim))
+            assert pipe._fastpath_plan.sharding is None
+        with InferenceServer(
+            pipe, name="t-remesh-b",
+            serving_config=ServingConfig(max_batch_size=64, max_delay_ms=0.0, mesh=2),
+            warmup_template=_features(1, dim),
+        ) as b:
+            b.predict(_features(2, dim))
+            assert pipe._fastpath_plan.sharding is not None
+            assert pipe._fastpath_plan.sharding.n_data == 2
+
+    def test_concurrent_sharded_traffic_bitexact(self):
+        _skip_if_too_few_devices(4)
+        dim = 16
+        pipe, ref = _pipe(dim, seed=4), _pipe(dim, seed=4)
+        cfg = ServingConfig(
+            max_batch_size=64, max_delay_ms=1.0, queue_capacity_rows=2048,
+            default_timeout_ms=60_000, mesh=4,
+        )
+        X = np.asarray(_features(64, dim, seed=5)["features"])
+        results, errors = {}, []
+        with InferenceServer(
+            pipe, name="t-shard-soak", serving_config=cfg,
+            warmup_template=_features(1, dim),
+        ) as server:
+
+            def client(tid):
+                try:
+                    for i in range(16):
+                        j = (tid * 17 + i * 5) % X.shape[0]
+                        results[(tid, i)] = (j, server.predict(
+                            DataFrame.from_dict({"features": X[j : j + 1]})
+                        ))
+                except BaseException as e:  # noqa: BLE001 — surfaced below
+                    errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(t,)) for t in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+        assert len(results) == 64
+        for j, resp in results.values():
+            expected = ref.transform(
+                pad_to(DataFrame.from_dict({"features": X[j : j + 1]}), resp.bucket)
+            ).take([0])
+            _assert_frames_bitexact(resp.dataframe, expected)
+
+
+# ---------------------------------------------------------------------------
+# batch transform: sharded chunks, ragged tails, goodput attrs
+# ---------------------------------------------------------------------------
+class TestShardedBatchPlan:
+    def _stages(self, dim, seed=0):
+        rng = np.random.default_rng(seed)
+        sc = StandardScalerModelServable().set_input_col("features").set_output_col("scaled")
+        sc.mean = rng.normal(size=dim)
+        sc.std = np.abs(rng.normal(size=dim)) + 0.5
+        sc.set_with_mean(True)
+        lr = LogisticRegressionModelServable().set_features_col("scaled")
+        lr.coefficient = rng.normal(size=dim)
+        return [sc, lr]
+
+    @pytest.mark.parametrize("dim", [8, 16, 256])
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_chunked_bitexact_vs_mesh1(self, dim, n):
+        _skip_if_too_few_devices(n)
+        stages = self._stages(dim, seed=dim)
+        X = np.random.default_rng(dim).normal(size=(200, dim))
+        df = DataFrame.from_dict({"features": X})
+        config.set(Options.BATCH_CHUNK_ROWS, 64)
+        try:
+            base = CompiledBatchPlan.build(stages, scope=f"ml.batch[t-b{dim}-1]").transform(df)
+            sh = resolve_plan_sharding(n)
+            out = CompiledBatchPlan.build(
+                stages, scope=f"ml.batch[t-b{dim}-{n}]", sharding=sh
+            ).transform(df)
+            _assert_frames_bitexact(base, out)
+        finally:
+            config.unset(Options.BATCH_CHUNK_ROWS)
+
+    @pytest.mark.parametrize("tail,expect", [
+        (8, "sharded"),     # multiple of MIN_SHARD_ROWS: pads up to the quantum
+        (36, "replicated"), # remainder rows: must run the mesh=1 shape
+        (3, "replicated"),
+    ])
+    def test_ragged_tail_policy(self, tail, expect):
+        _skip_if_too_few_devices(4)
+        n, dim = 4, 16
+        stages = self._stages(dim)
+        X = np.random.default_rng(1).normal(size=(64 + tail, dim))
+        df = DataFrame.from_dict({"features": X})
+        scope = f"ml.batch[t-tail{tail}]"
+        config.set(Options.BATCH_CHUNK_ROWS, 64)
+        try:
+            base = CompiledBatchPlan.build(stages, scope="ml.batch[t-tailbase]").transform(df)
+            sh = resolve_plan_sharding(n)
+            out = CompiledBatchPlan.build(stages, scope=scope, sharding=sh).transform(df)
+            _assert_frames_bitexact(base, out)
+        finally:
+            config.unset(Options.BATCH_CHUNK_ROWS)
+        pad = metrics.get(scope, MLMetrics.BATCH_SHARD_PAD_ROWS)
+        repl = metrics.get(scope, MLMetrics.BATCH_SHARD_REPLICATED_CHUNKS)
+        if expect == "sharded":
+            assert pad == sh.padded_rows(tail) - tail and not repl
+        else:
+            assert repl == 1 and not pad
+
+    def test_chunk_span_attrs_split_padding_once(self):
+        """The chunk span's rows attr is the TRUE chunk rows and bucket the
+        padded shape — the PR 8 padding split counts DP round-up pad exactly
+        once (and not at all on replicated tails)."""
+        _skip_if_too_few_devices(4)
+        n, dim = 4, 8
+        stages = self._stages(dim)
+        X = np.random.default_rng(2).normal(size=(72, dim))  # 64 + tail 8 -> pad 24
+        df = DataFrame.from_dict({"features": X})
+        config.set(Options.BATCH_CHUNK_ROWS, 64)
+        try:
+            sh = resolve_plan_sharding(n)
+            with trace.capture() as rec:
+                CompiledBatchPlan.build(
+                    stages, scope="ml.batch[t-attrs]", sharding=sh
+                ).transform(df)
+        finally:
+            config.unset(Options.BATCH_CHUNK_ROWS)
+        chunks = [s for s in rec.snapshot() if s.name == "batch.chunk"]
+        assert [(s.attrs["rows"], s.attrs["bucket"]) for s in chunks] == [
+            (64, 64), (8, 32)
+        ]
+        assert all(s.attrs["shards"] == n for s in chunks)
+
+    def test_pipeline_model_config_route(self):
+        """PipelineModel.transform picks up batch.mesh from config and the
+        plan cache rebuilds when the mesh changes."""
+        _skip_if_too_few_devices(2)
+        from flink_ml_tpu.builder.pipeline import PipelineModel
+
+        dim = 8
+        stages = self._stages(dim)
+        model = PipelineModel(stages)
+        X = np.random.default_rng(3).normal(size=(48, dim))
+        df = DataFrame.from_dict({"features": X})
+        base = model.transform(df)
+        assert model._plan_cache[1].sharding is None
+        config.set(Options.BATCH_MESH, 2)
+        try:
+            out = model.transform(df)
+            plan = model._plan_cache[1]
+            assert plan is not None and plan.sharding is not None
+            assert plan.sharding.n_data == 2
+            _assert_frames_bitexact(base, out)
+        finally:
+            config.unset(Options.BATCH_MESH)
+
+
+# ---------------------------------------------------------------------------
+# tensor parallelism: the documented ulp-envelope tier
+# ---------------------------------------------------------------------------
+class TestTensorParallel:
+    def test_tp_wide_head_within_ulp_envelope(self):
+        _skip_if_too_few_devices(4)
+        from flink_ml_tpu.servable.lib import MLPClassifierModelServable
+
+        dims = [32, 128, 128, 4]
+
+        def mk():
+            rng = np.random.default_rng(7)
+            s = MLPClassifierModelServable().set_features_col("features")
+            arrays = {}
+            for i in range(3):
+                arrays[f"W{i}"] = rng.normal(size=(dims[i], dims[i + 1])).astype(np.float32) * 0.3
+                arrays[f"b{i}"] = rng.normal(size=(dims[i + 1],)).astype(np.float32) * 0.1
+            arrays["labels"] = np.arange(4).astype(np.float64)
+            return s._apply_model_arrays(arrays)
+
+        rng_x = np.random.default_rng(1)
+        X = rng_x.normal(size=(64, 32))
+        df = DataFrame.from_dict({"features": X})
+        base = CompiledServingPlan.build(mk(), scope="ml.serving[t-tp-base]")
+        base.warmup(df.take([0]), (64,))
+        expected = base.execute(df.take(np.arange(64)))
+
+        sh = PlanSharding(2, 2)  # 2x2 devices: DP 2 x TP 2
+        plan = CompiledServingPlan.build(mk(), scope="ml.serving[t-tp]", sharding=sh)
+        plan.warmup(df.take([0]), sh.serving_buckets(64))
+        out = plan.execute(df.take(np.arange(64)))
+        raw_a = np.asarray(expected["rawPrediction"])
+        raw_b = np.asarray(out["rawPrediction"])
+        # ulp envelope, NOT bit-equality: TP reassociates partial products
+        np.testing.assert_allclose(raw_a, raw_b, rtol=1e-5, atol=1e-6)
+        assert metrics.get("ml.serving[t-tp]", MLMetrics.SERVING_SHARD_MODEL_AXIS) == 2
+
+    def test_tp_narrow_arrays_stay_replicated(self):
+        _skip_if_too_few_devices(2)
+        sh = PlanSharding(1, 2)
+        narrow = np.ones((16, 8), np.float32)  # < TP_MIN_WIDTH: replicated
+        wide = np.ones((16, 128), np.float32)
+        from jax.sharding import PartitionSpec
+
+        assert sh.put_model(narrow).sharding.spec == PartitionSpec()
+        assert sh.put_model(wide).sharding.spec == PartitionSpec(None, "model")

@@ -38,4 +38,12 @@ trace_artifact="${TRACE_ARTIFACT:-/tmp/ci-trace.json}"
 python tools/ci/trace_smoke.py "${trace_artifact}"
 python tools/traceview.py "${trace_artifact}"
 
+# Sharded smoke: publish → warm → serve burst → hot swap on a mesh=4 grid,
+# bit-exact vs the per-stage reference with zero serving-path compiles, and
+# traceview showing the per-shard attribution section on the exported trace.
+echo "=== sharded smoke (mesh=4 fan-out + per-shard traceview) ==="
+sharded_artifact="${SHARDED_TRACE_ARTIFACT:-/tmp/ci-sharded-trace.json}"
+python tools/ci/sharded_smoke.py "${sharded_artifact}"
+python tools/traceview.py "${sharded_artifact}" --scope ml.serving | grep -A 3 "shards:"
+
 echo "CI OK"

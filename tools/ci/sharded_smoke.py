@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""CI sharded smoke: the pod-scale fan-out pillar exercised end to end on a
+mesh=4 virtual device grid — publish a trained pipeline, warm + serve a
+traffic burst through the SPMD fast path, hot-swap a second version, and
+prove the trace carries per-shard attribution.
+
+Checks (any failure exits 1):
+- responses are bit-identical per row to the per-stage reference transform
+  at the response bucket, before AND after the swap;
+- zero ``ml.serving.fastpath.compiles`` — every (version, bucket, mesh)
+  executable was AOT-compiled at swap time, off the serving path;
+- buckets ride the mesh ladder (multiples of MIN_SHARD_ROWS * 4);
+- the exported Chrome trace contains dispatch/exec spans with ``shards``
+  attrs, and ``tools/traceview.py`` (run by run_tests.sh on the artifact)
+  shows the per-shard section.
+
+Driven by tools/ci/run_tests.sh after the trace smoke; artifact path in
+argv[1] (SHARDED_TRACE_ARTIFACT resolves it, mirroring TRACE_ARTIFACT).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+MESH = 4
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: sharded_smoke.py <artifact-path>", file=sys.stderr)
+        return 1
+    artifact = argv[0]
+
+    import numpy as np
+
+    from flink_ml_tpu import trace
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.servable import (
+        LogisticRegressionModelServable,
+        PipelineModelServable,
+        StandardScalerModelServable,
+    )
+    from flink_ml_tpu.servable.sharding import MIN_SHARD_ROWS
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig, pad_to
+
+    rng = np.random.default_rng(11)
+    dim = 32
+
+    def make_pipe(seed):
+        r = np.random.default_rng(seed)
+        sc = StandardScalerModelServable().set_input_col("features").set_output_col("scaled")
+        sc.mean = r.standard_normal(dim)
+        sc.std = np.abs(r.standard_normal(dim)) + 0.5
+        sc.set_with_mean(True)
+        lr = LogisticRegressionModelServable().set_features_col("scaled")
+        lr.coefficient = r.standard_normal(dim)
+        return PipelineModelServable([sc, lr])
+
+    pipe_v1, pipe_v2 = make_pipe(1), make_pipe(2)
+    refs = {1: make_pipe(1), 2: make_pipe(2)}
+    X = rng.standard_normal((256, dim))
+
+    failures = []
+    with trace.capture() as recorder:
+        server = InferenceServer(
+            pipe_v1,
+            name="sharded-smoke",
+            serving_config=ServingConfig(
+                max_batch_size=64,
+                max_delay_ms=0.5,
+                default_timeout_ms=60_000,
+                mesh=MESH,
+            ),
+            warmup_template=DataFrame.from_dict({"features": X[:1]}),
+        )
+        try:
+            def burst(n_requests):
+                for i in range(n_requests):
+                    j = (i * 37) % (X.shape[0] - 4)
+                    req = DataFrame.from_dict({"features": X[j : j + 3]})
+                    resp = server.predict(req)
+                    if resp.bucket % (MIN_SHARD_ROWS * MESH):
+                        failures.append(f"bucket {resp.bucket} off the mesh ladder")
+                    expected = refs[resp.model_version].transform(
+                        pad_to(req, resp.bucket)
+                    ).take([0, 1, 2])
+                    for name in expected.get_column_names():
+                        if not np.array_equal(
+                            np.asarray(resp.dataframe[name]), np.asarray(expected[name])
+                        ):
+                            failures.append(
+                                f"v{resp.model_version} column {name} not bit-exact"
+                            )
+
+            burst(12)
+            server.swap(2, pipe_v2)  # AOT per (version, bucket, mesh), then flip
+            burst(12)
+            scope = server.scope
+        finally:
+            server.close()
+        exported = recorder.export_chrome_trace(artifact)
+
+    spans = recorder.snapshot()
+    sharded = [
+        s for s in spans
+        if s.name in ("serving.dispatch", "serving.exec") and s.attrs
+        and s.attrs.get("shards") == MESH
+    ]
+    if not sharded:
+        failures.append("no dispatch/exec spans carrying the shards attr")
+    compiles = metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES)
+    if compiles:
+        failures.append(f"{compiles} serving-path compiles (warmup coverage broken)")
+    if metrics.get(scope, MLMetrics.SERVING_SHARD_COUNT) != MESH:
+        failures.append("ml.serving.shard.count gauge missing")
+    if exported == 0:
+        failures.append("trace export wrote no spans")
+
+    if failures:
+        print("sharded smoke FAILED:\n  " + "\n  ".join(failures), file=sys.stderr)
+        return 1
+    print(
+        f"sharded smoke: mesh={MESH}, {exported} spans -> {artifact}; "
+        f"{len(sharded)} per-shard spans, 0 serving-path compiles, "
+        f"shard rows {metrics.get(scope, MLMetrics.SERVING_SHARD_ROWS)}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,10 @@ Marker convention (the annotated-hot-root contract, docs/static_analysis.md):
   boundary (the plan's single blocking readback); traversal stops here.
 - ``# graftcheck: cold`` — reachable from a hot root only on a lazily-taken
   build/warmup edge (counted by its own metric); excluded from the hot region.
+- ``# graftcheck: ingest`` — the function IS a designated host→device ingest
+  boundary (the plan tier's blessed ``device_put``, one per chunk/shard);
+  ``device_put`` inside it is exempt from host-sync's hot-region flagging,
+  everything else still applies.
 """
 from __future__ import annotations
 
@@ -54,7 +58,7 @@ __all__ = [
 
 #: Bump whenever the shape/semantics of extracted facts change — it is part of
 #: the disk-cache key, so stale caches self-invalidate.
-FACTS_VERSION = 1
+FACTS_VERSION = 2  # 2: "ingest" joined the marker vocabulary
 
 KERNELS_MODULE = "flink_ml_tpu.ops.kernels"
 
@@ -82,7 +86,7 @@ _OS_BLOCKING = {
 }
 _MEMO_DECORATORS = {"cache", "lru_cache"}
 
-KNOWN_MARKS = ("hot-root", "readback", "cold")
+KNOWN_MARKS = ("hot-root", "readback", "cold", "ingest")
 
 _MARK_RE = re.compile(r"#\s*graftcheck:\s*([A-Za-z0-9_\-,=\s]+)")
 

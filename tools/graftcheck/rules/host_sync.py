@@ -32,6 +32,19 @@ Flagged inside the hot region:
                                     — eager host materialization
 - ``float(p)`` / ``int(p)`` / ``bool(p)`` on a direct function parameter
                                     — host concretization
+- ``jax.device_put(...)``           — host→device upload, unless the function
+                                    is marked ``# graftcheck: ingest``: the
+                                    plan tier's designated ingest boundaries
+                                    (the batch chunk uploader,
+                                    ``PlanSharding.put_batch``/``put_replicated``)
+                                    are the ONLY places the sharded fast
+                                    paths may upload — one ``device_put`` per
+                                    chunk, split per shard by the runtime.
+                                    Anywhere else in a hot region it is a
+                                    per-call transfer the AOT weight-resident
+                                    design exists to avoid (weights commit at
+                                    swap/build time, request rows ride the
+                                    compiled executable's own intake).
 
 As with jit-purity the numpy/float checks fire on direct parameters only
 (numpy on values that are already host-resident is legal and common) — false
@@ -79,8 +92,10 @@ class HostSyncRule(Rule):
     severity = "error"
     description = (
         "no device->host syncs (.item(), block_until_ready, np.asarray/float "
-        "on parameters) reachable from `# graftcheck: hot-root` functions, "
-        "outside the designated `# graftcheck: readback` boundaries"
+        "on parameters) nor host->device uploads (device_put outside "
+        "`# graftcheck: ingest` boundaries) reachable from "
+        "`# graftcheck: hot-root` functions, outside the designated "
+        "`# graftcheck: readback` boundaries"
     )
 
     def run(self, project: Project) -> List[Finding]:
@@ -97,7 +112,7 @@ class HostSyncRule(Rule):
         rel_of = {f["module"]: rel for rel, f in index.files.items()}
         for node in sorted(reach):
             ff = index.function(node)
-            if ff is None or not ff["sync_sites"]:
+            if ff is None:
                 continue
             module = node.partition(":")[0]
             rel = rel_of.get(module)
@@ -118,4 +133,23 @@ class HostSyncRule(Rule):
                         "it off the hot path",
                     )
                 )
+            # Per-device uploads: device_put belongs to the designated
+            # `# graftcheck: ingest` boundaries (one per chunk/shard);
+            # anywhere else in a hot region it is a per-call host->device
+            # transfer the weight-resident AOT design forbids.
+            if "ingest" in ff["marks"]:
+                continue
+            for kind, line, detail, _held in ff["blocking"]:
+                if kind == "device" and "device_put" in detail:
+                    findings.append(
+                        self.finding(
+                            rel,
+                            line,
+                            f"hot region (reachable from hot-root {root_display}): "
+                            f"{detail} uploads host data per call — route it "
+                            "through a designated `# graftcheck: ingest` "
+                            "boundary (one device_put per chunk, split per "
+                            "shard) or commit it at build/warmup time",
+                        )
+                    )
         return findings

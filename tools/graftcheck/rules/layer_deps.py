@@ -87,6 +87,11 @@ MODULE_LAYERS = {
     # (servable.planner + kernel specs, api, config, metrics) — registered
     # explicitly so the fused batch tier's dependency story is auditable.
     "builder.batch_plan": 2,
+    # Mesh placement for compiled plans (pod-scale fan-out): L1 like the
+    # rest of servable — it may import parallel.mesh (same layer) but stays
+    # inside the runtime-free guarantee; registered explicitly so the
+    # sharded fast paths' dependency story is auditable.
+    "servable.sharding": 1,
 }
 
 #: The absorbed check_servable_imports.py contract (see module docstring).
@@ -146,7 +151,7 @@ class LayerDepsRule(Rule):
     name = "layer-deps"
     severity = "error"
     granularity = "file"
-    cache_version = 2  # v2: reads the shared index's import facts
+    cache_version = 3  # v3: servable.sharding registered (pod-scale fan-out)
     description = (
         "imports within flink_ml_tpu must not point at a higher layer "
         "(foundation < compute/servable < runtime < library)"

@@ -10,7 +10,12 @@ Loads the trace-event JSON written by
   recovery / readback — the ML Productivity Goodput buckets), plus the
   goodput fraction;
 - per-span-name latency stats: count, p50, p99, total ms, % of the scope's
-  wall time.
+  wall time;
+- per-shard attribution when the scope served through a mesh
+  (``serving.mesh``/``batch.mesh`` > 1): spans carrying a ``shards`` attr
+  split their device time evenly across the mesh's data axis (SPMD shards
+  run in lock-step), so the report shows how many device-milliseconds each
+  shard absorbed and what per-shard goodput looks like.
 
 The same span self-time attribution as the live ``GoodputReport`` (parents
 minus same-scope children), reconstructed from the ``span_id``/``parent_id``
@@ -77,6 +82,39 @@ def _quantile(ordered: List[float], q: float) -> float:
     return ordered[min(int(q * len(ordered)), len(ordered) - 1)]
 
 
+def _shard_summary(scope_spans: List[Span]) -> List[str]:
+    """Per-shard device-time attribution for a mesh-sharded scope: spans
+    carrying a ``shards`` attr (serving dispatch/exec, batch chunks) ran SPMD
+    with rows split over the data axis — lock-step shards, so each shard's
+    share of the span is 1/shards of its wall. Returns [] for unsharded
+    scopes (no such spans)."""
+    sharded = [
+        s for s in scope_spans
+        if s.attrs and isinstance(s.attrs.get("shards"), int) and s.attrs["shards"] > 1
+    ]
+    if not sharded:
+        return []
+    widths = sorted({s.attrs["shards"] for s in sharded})
+    total_ms = sum(s.duration for s in sharded) * 1000.0
+    rows = sum(
+        s.attrs.get("shard_rows", 0) * s.attrs["shards"]
+        for s in sharded
+        if isinstance(s.attrs.get("shard_rows"), int)
+    )
+    lines = [
+        f"  shards: mesh width(s) {'/'.join(str(w) for w in widths)} — "
+        f"{len(sharded)} sharded spans, {total_ms:.3f} ms device time"
+    ]
+    for w in widths:
+        ms = sum(s.duration for s in sharded if s.attrs["shards"] == w) * 1000.0
+        lines.append(
+            f"    {w}-way: {ms:.3f} ms total, {ms / w:.3f} ms per shard"
+        )
+    if rows:
+        lines.append(f"    sharded rows (padded): {rows}")
+    return lines
+
+
 def summarize(spans: List[Span], scope_filter: Optional[str] = None, top: int = 20) -> str:
     """The human report (one string, printed by main)."""
     if scope_filter:
@@ -113,6 +151,10 @@ def summarize(spans: List[Span], scope_filter: Optional[str] = None, top: int = 
                 f"  {name:<24} {len(durs):>7} {_quantile(ordered, 0.5):>10.3f} "
                 f"{_quantile(ordered, 0.99):>10.3f} {total:>12.3f} {pct:>7.1f}%"
             )
+        shard_lines = _shard_summary(
+            [s for s in spans if s.scope == scope]
+        )
+        lines.extend(shard_lines)
         lines.append("")
     overall = report.fraction()
     if overall is not None:
