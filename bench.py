@@ -1612,6 +1612,201 @@ def _bench_pipeline_batch_transform_body():
     }
 
 
+def bench_fusion_sweep():
+    """Fusion tiers (docs/fusion.md): ``fusion.mode=exact`` vs ``fast`` vs
+    ``fast`` with Pallas megakernels forced hot, on the two benched chains —
+    the 6-stage feature chain (400k × 32, chunked batch transform) and the
+    serving heads (scaler → logistic d=32 and scaler → MLP 256→512→512→8 at
+    bucket 64, p50/p99 per batch).
+
+    What each leg measures on this box: the exact tier compiles one program
+    per reduction-bearing stage (3 programs for the 6-stage chain, 2 for each
+    serving head); the fast tier merges each chain into ONE XLA program —
+    the win here is per-program dispatch + XLA fusing elementwise math into
+    the neighbouring reduction. The megakernel leg runs under
+    ``pallas.interpret`` on CPU (the tier-1 fallback): it proves the code
+    path and prices the interpreter, NOT the VMEM-residency win — on real
+    TPUs the megakernel is where the BENCH_r05 flash-attention-style 4.7×
+    lives. Ulp envelopes of every fast leg are pinned by
+    tests/test_fusion.py.
+    """
+    import os
+
+    import jax
+
+    if (os.cpu_count() or 1) == 1:
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+        try:
+            return _bench_fusion_sweep_body()
+        finally:
+            jax.config.update("jax_cpu_enable_async_dispatch", True)
+    return _bench_fusion_sweep_body()
+
+
+def _bench_fusion_sweep_body():
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.builder.batch_plan import CompiledBatchPlan
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.models.feature.binarizer import Binarizer
+    from flink_ml_tpu.models.feature.elementwise_product import ElementwiseProduct
+    from flink_ml_tpu.models.feature.idf import IDFModel
+    from flink_ml_tpu.models.feature.normalizer import Normalizer
+    from flink_ml_tpu.models.feature.standard_scaler import StandardScalerModel
+    from flink_ml_tpu.servable.builder import PipelineModelServable
+    from flink_ml_tpu.servable.fusion import FusionTier, ULP_ENVELOPE
+    from flink_ml_tpu.servable.lib import (
+        LogisticRegressionModelServable,
+        MLPClassifierModelServable,
+        StandardScalerModelServable,
+    )
+    from flink_ml_tpu.serving.plan import CompiledServingPlan
+
+    rng = np.random.default_rng(9)
+    n, d = 400_000, 32
+    df = DataFrame.from_dict({"input": rng.standard_normal((n, d))})
+
+    scaler = StandardScalerModel().set_input_col("input").set_output_col("scaled")
+    scaler.set_with_mean(True)
+    scaler.mean = rng.standard_normal(d)
+    scaler.std = np.abs(rng.standard_normal(d)) + 0.5
+    idf = IDFModel().set_input_col("weighted").set_output_col("tfidf")
+    idf.idf = np.abs(rng.standard_normal(d)) + 0.2
+    idf.doc_freq = np.ones(d)
+    idf.num_docs = np.asarray(float(n))
+    rescale = StandardScalerModel().set_input_col("tfidf").set_output_col("rescaled")
+    rescale.set_with_mean(False)
+    rescale.mean = np.zeros(d)
+    rescale.std = np.abs(rng.standard_normal(d)) + 0.5
+    stages = [
+        scaler,
+        Normalizer().set_input_col("scaled").set_output_col("norm"),
+        ElementwiseProduct()
+        .set_scaling_vec(np.abs(rng.standard_normal(d)) + 0.1)
+        .set_input_col("norm")
+        .set_output_col("weighted"),
+        idf,
+        rescale,
+        Binarizer()
+        .set_input_cols("rescaled")
+        .set_output_cols("bin")
+        .set_thresholds(0.05),
+    ]
+
+    tiers = {
+        "exact": None,
+        "fast": FusionTier("fast", megakernel=False),
+        "megakernel": FusionTier("fast", megakernel=True, min_score=1.0),
+    }
+
+    # Batch chain: interleaved best-of-N (the pyperf min protocol of
+    # pipeline_batch_transform — this box's ambient load swings 3x).
+    plans = {
+        name: CompiledBatchPlan.build(stages, scope=f"ml.batch[fusion-{name}]", fusion=tier)
+        for name, tier in tiers.items()
+    }
+    for plan in plans.values():  # warm both chunk signatures, twice
+        plan.transform(df)
+        plan.transform(df)
+    times = {name: [] for name in plans}
+    for _ in range(7):
+        for name, plan in plans.items():
+            t0 = time.perf_counter()
+            plan.transform(df)
+            times[name].append(time.perf_counter() - t0)
+    batch_rows = {}
+    for name, ts in times.items():
+        ts.sort()
+        batch_rows[name] = {
+            "rows_per_sec": round(n / ts[0], 1),
+            "spread": {
+                "min_s": round(ts[0], 4),
+                "median_s": round(ts[len(ts) // 2], 4),
+                "max_s": round(ts[-1], 4),
+                "repeats": len(ts),
+            },
+            "programs_per_chunk": (
+                len(plans[name].segments[0].programs)
+            ),
+            "megakernel_compiles": metrics.get(
+                f"ml.batch[fusion-{name}]", MLMetrics.FUSION_PROGRAMS_MEGAKERNEL, 0
+            ),
+        }
+
+    # Serving heads: closed-loop p50/p99 per 64-row batch through the
+    # compiled plan (the micro-batcher's exec step, isolated).
+    def serving_chain(servable, dim, reps=400):
+        r = np.random.default_rng(1)
+        batch = DataFrame.from_dict({"features": r.standard_normal((64, dim))})
+        out = {}
+        for name, tier in tiers.items():
+            plan = CompiledServingPlan.build(
+                servable, scope=f"ml.serving[fusion-{name}]", fusion=tier
+            )
+            plan.execute(batch)
+            plan.execute(batch)
+            lat = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                plan.execute(batch)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            lat.sort()
+            p50 = lat[len(lat) // 2]
+            out[name] = {
+                "latency_p50_ms": round(p50, 4),
+                "latency_p99_ms": round(lat[int(len(lat) * 0.99)], 4),
+                "rows_per_sec": round(64 / (p50 / 1e3), 1),
+            }
+        return out
+
+    sc = StandardScalerModelServable().set_input_col("features").set_output_col("scaled")
+    sc.set_with_mean(True)
+    sc.mean = rng.standard_normal(d)
+    sc.std = np.abs(rng.standard_normal(d)) + 0.5
+    lr = LogisticRegressionModelServable().set_features_col("scaled")
+    lr.coefficient = rng.standard_normal(d)
+    lr_rows = serving_chain(PipelineModelServable([sc, lr]), d)
+
+    sc2 = StandardScalerModelServable().set_input_col("features").set_output_col("scaled")
+    sc2.set_with_mean(True)
+    sc2.mean = rng.standard_normal(256)
+    sc2.std = np.abs(rng.standard_normal(256)) + 0.5
+    mlp = MLPClassifierModelServable().set_features_col("scaled")
+    dims = [256, 512, 512, 8]
+    arrays = {"labels": np.arange(8.0)}
+    for i in range(3):
+        arrays[f"W{i}"] = (
+            rng.standard_normal((dims[i], dims[i + 1])) / np.sqrt(dims[i])
+        ).astype(np.float32)
+        arrays[f"b{i}"] = rng.standard_normal(dims[i + 1]).astype(np.float32)
+    mlp._apply_model_arrays(arrays)
+    mlp_rows = serving_chain(PipelineModelServable([sc2, mlp]), 256)
+
+    return {
+        "name": "fusion_sweep",
+        "batch_6stage_400k_d32": batch_rows,
+        "batch_fast_vs_exact": round(
+            batch_rows["fast"]["rows_per_sec"] / batch_rows["exact"]["rows_per_sec"], 3
+        ),
+        "serving_scale_logistic_d32_b64": lr_rows,
+        "serving_logistic_fast_vs_exact": round(
+            lr_rows["fast"]["rows_per_sec"] / lr_rows["exact"]["rows_per_sec"], 3
+        ),
+        "serving_scale_mlp_256_512_512_8_b64": mlp_rows,
+        "serving_mlp_fast_vs_exact": round(
+            mlp_rows["fast"]["rows_per_sec"] / mlp_rows["exact"]["rows_per_sec"], 3
+        ),
+        "ulp_envelopes": dict(ULP_ENVELOPE),
+        "note": "exact = per-stage programs (bit-identical to the per-stage "
+        "path); fast = ONE cross-reduction XLA program per fusable chain "
+        "(ulp-envelope numerics, tests/test_fusion.py); megakernel = the "
+        "same chain as ONE Pallas kernel — on this CPU box it runs "
+        "interpret-mode (code-path proof + interpreter price; the batch leg "
+        "is expected SLOWER than fast), on TPU it is the VMEM-residency "
+        "tier. The fast-vs-exact ratios are the honest CPU win: mostly "
+        "saved per-program dispatch.",
+    }
+
+
 _SHARDED_NOTE = (
     "HONEST NOTE: measured on a 1-core dev box with "
     "--xla_force_host_platform_device_count=8 — the 8 'devices' time-share "
@@ -2027,6 +2222,7 @@ def main() -> None:
     mlp_serving = bench_mlp_serving_throughput()
     continuous_loop = bench_continuous_loop()
     batch_transform = bench_pipeline_batch_transform()
+    fusion = bench_fusion_sweep()
     sharded = bench_sharded_fanout()
 
     detail = {
@@ -2036,7 +2232,7 @@ def main() -> None:
         "workloads": [
             logreg, sparse, sweep, sparse_streamed, overlap, kmeans, mlp,
             mlp_train, attention, attention_train, serving, tracing,
-            mlp_serving, continuous_loop, batch_transform, sharded,
+            mlp_serving, continuous_loop, batch_transform, fusion, sharded,
         ],
     }
     with open("BENCH_DETAIL.json", "w") as f:

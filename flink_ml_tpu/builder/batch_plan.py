@@ -61,6 +61,7 @@ from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.config import Options, config
 from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.servable.fusion import plan_recorder, resolve_fusion_tier
 from flink_ml_tpu.servable.planner import (
     FallbackStage,
     FusedSegment,
@@ -126,15 +127,19 @@ class CompiledBatchPlan:
         segments: List[Any],
         scope: str,
         sharding: Optional[Any] = None,
+        fusion: Optional[Any] = None,
     ):
         self._stages = list(stages)
         self.segments = segments
         self.scope = scope
         self.sharding = sharding
+        self.fusion = fusion if fusion is not None else resolve_fusion_tier()
+        self._on_plan = plan_recorder(scope)
         n_fused = sum(len(s.specs) for s in segments if isinstance(s, FusedSegment))
         n_fallback = sum(1 for s in segments if isinstance(s, FallbackStage))
         metrics.gauge(scope, MLMetrics.BATCH_FUSED_STAGES, n_fused)
         metrics.gauge(scope, MLMetrics.BATCH_FALLBACK_STAGES, n_fallback)
+        metrics.gauge(scope, MLMetrics.FUSION_MODE, 1 if self.fusion.fast else 0)
         if sharding is not None:
             metrics.gauge(scope, MLMetrics.BATCH_SHARD_COUNT, sharding.n_data)
 
@@ -145,6 +150,7 @@ class CompiledBatchPlan:
         *,
         scope: str = "ml.batch[plan]",
         sharding: Optional[Any] = None,
+        fusion: Optional[Any] = None,
     ) -> Optional["CompiledBatchPlan"]:
         """Group consecutive kernel-spec stages into fused segments and
         commit their model arrays to the device (the once-per-plan upload —
@@ -153,16 +159,21 @@ class CompiledBatchPlan:
         exactly as its ``transform`` would. Publishes
         ``ml.batch.fastpath.plan.build.ms``. ``sharding`` defaults to the
         ``batch.mesh`` / ``batch.mesh.model`` config options (1 = the
-        single-device path)."""
+        single-device path); ``fusion`` to the ``fusion.mode`` config
+        (docs/fusion.md) — the plan snapshots the tier, and
+        ``builder/pipeline.py`` fingerprints the config so a flip rebuilds
+        the cached plan instead of silently serving the old tier."""
         t0 = time.perf_counter()
         if sharding is None:
             sharding = resolve_plan_sharding(
                 config.get(Options.BATCH_MESH), config.get(Options.BATCH_MESH_MODEL)
             )
-        segments = build_segments(stages, sharding)
+        if fusion is None:
+            fusion = resolve_fusion_tier()
+        segments = build_segments(stages, sharding, fusion)
         if not any(isinstance(s, FusedSegment) for s in segments):
             return None
-        plan = CompiledBatchPlan(stages, segments, scope, sharding)
+        plan = CompiledBatchPlan(stages, segments, scope, sharding, fusion)
         metrics.gauge(
             scope, MLMetrics.BATCH_PLAN_BUILD_MS, (time.perf_counter() - t0) * 1000.0
         )
@@ -311,8 +322,17 @@ class CompiledBatchPlan:
                 if sharding is not None:
                     sp.set_attr("shards", 1 if replicated else sharding.n_data)
                 outputs = run_segment(
-                    segment, key, inputs, on_compile=on_compile, replicated=replicated
+                    segment,
+                    key,
+                    inputs,
+                    on_compile=on_compile,
+                    on_plan=self._on_plan,
+                    replicated=replicated,
                 )
+                # The fusion tier this chunk's compiled chain runs at
+                # ("exact" / "fast" / "fast+mega") — goodput attribution
+                # distinguishes the tiers by this attr.
+                sp.set_attr("fusion", segment.plan_label(key))
                 pending = segment.pending(outputs)
             if sharding is not None:
                 if replicated:

@@ -116,14 +116,22 @@ class PipelineModel(Model):
         object identity plus each stage's param map, plus the mesh config
         the plan's programs and committed buffers were placed under (a
         ``batch.mesh`` change mid-process must rebuild, not serve stale
-        local shapes). Model *data* is covered by ``set_model_data``
-        invalidating the cache; mutating a stage's arrays directly requires
+        local shapes) and the fusion-tier config the programs were
+        partitioned under (a ``fusion.mode`` flip must rebuild, not silently
+        keep serving the old tier's numerics contract — docs/fusion.md).
+        Model *data* is covered by ``set_model_data`` invalidating the
+        cache; mutating a stage's arrays directly requires
         :meth:`invalidate_batch_plan`."""
         mesh_key = (
             config.get(Options.BATCH_MESH),
             config.get(Options.BATCH_MESH_MODEL),
         )
-        return (mesh_key,) + tuple(
+        fusion_key = (
+            config.get(Options.FUSION_MODE),
+            config.get(Options.FUSION_MEGAKERNEL),
+            config.get(Options.FUSION_MEGAKERNEL_MIN_SCORE),
+        )
+        return (mesh_key, fusion_key) + tuple(
             (id(stage), json.dumps(stage.param_map_to_json(), sort_keys=True, default=str))
             for stage in self.stages
         )

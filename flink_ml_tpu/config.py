@@ -191,6 +191,37 @@ class Options:
         "Optional tensor-parallel axis of the batch transform mesh — same "
         "wide-head sharding and ulp caveat as serving.mesh.model. 1 = off.",
     )
+    FUSION_MODE = ConfigOption(
+        "fusion.mode",
+        str,
+        "exact",
+        "Fusion tier of the compiled plans (docs/fusion.md). 'exact' "
+        "(default) = per-stage programs with elementwise-only merges — "
+        "bit-identical to the per-stage transform path. 'fast' = fuse across "
+        "reduction boundaries into single XLA programs and, for chains the "
+        "cost model marks hottest, hand-fused Pallas megakernels keeping "
+        "intermediates VMEM-resident; results carry a documented per-chain "
+        "ulp envelope instead of bit-equality.",
+    )
+    FUSION_MEGAKERNEL = ConfigOption(
+        "fusion.megakernel",
+        _parse_bool,
+        True,
+        "Whether fusion.mode=fast may lower Pallas megakernels for hot "
+        "chains (servable/megakernels.py; pallas.interpret on CPU). Off = "
+        "fast mode still merges across reductions but only into XLA "
+        "programs. No effect in exact mode.",
+    )
+    FUSION_MEGAKERNEL_MIN_SCORE = ConfigOption(
+        "fusion.megakernel.min.score",
+        float,
+        1e6,
+        "Cost-model hotness bar for the megakernel lowering: a chain lowers "
+        "as a Pallas megakernel only when rows x estimated-FLOPs-per-row "
+        "(from stage shapes) reaches this score at compile time "
+        "(docs/fusion.md has the model). Below the bar, fast mode uses the "
+        "merged XLA program.",
+    )
     BATCH_FASTPATH = ConfigOption(
         "batch.fastpath",
         _parse_bool,

@@ -109,6 +109,16 @@ class MLMetrics:
     BATCH_PLAN_BUILD_MS = "ml.batch.fastpath.plan.build.ms"  # build + model upload wall time, gauge
     BATCH_CHUNK_MS = "ml.batch.fastpath.chunk.ms"  # dispatch→readback per chunk, histogram
 
+    # Fusion tier of the compiled plans (fusion.mode — docs/fusion.md).
+    # Published under the owning plan's scope, like the fastpath metrics.
+    FUSION_GROUP = "ml.fusion"
+    FUSION_MODE = "ml.fusion.mode"  # 0 = exact, 1 = fast (the plan's tier), gauge
+    FUSION_PROGRAMS_EXACT = "ml.fusion.programs.exact"  # exact-partition program compiles, counter
+    FUSION_PROGRAMS_FUSED = "ml.fusion.programs.fused"  # cross-reduction XLA program compiles, counter
+    FUSION_PROGRAMS_MEGAKERNEL = "ml.fusion.programs.megakernel"  # Pallas megakernel compiles, counter
+    FUSION_PLAN_CHOICE = "ml.fusion.plan.choice"  # most aggressive tier last compiled: 0 exact / 1 fused / 2 megakernel, gauge
+    FUSION_PLAN_SCORE = "ml.fusion.plan.score"  # cost-model score of the last compiled chain, gauge
+
     # Mesh-sharded batch transform (batch.mesh > 1 — docs/batch_transform.md).
     BATCH_SHARD_COUNT = "ml.batch.shard.count"  # data-axis width of the plan's mesh, gauge
     BATCH_SHARD_ROWS = "ml.batch.shard.rows"  # per-shard rows through sharded chunks, counter

@@ -100,4 +100,5 @@ class Binarizer(Transformer, HasInputCols, HasOutputCols):
             kernel_fn=kernel_fn,
             input_kinds={n: "dense" for n in in_cols},
             elementwise=True,  # threshold compare: no FP accumulation
+            fusion_op="binarize",  # megakernel-safe
         )

@@ -80,4 +80,5 @@ class ElementwiseProduct(Transformer, HasInputCol, HasOutputCol):
             kernel_fn=kernel_fn,
             input_kinds={in_col: "dense"},
             elementwise=True,  # Hadamard product: no FP accumulation
+            fusion_op="elementwise_product",  # megakernel-safe
         )

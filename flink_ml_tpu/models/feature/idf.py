@@ -88,6 +88,7 @@ class IDFModel(ModelArraysMixin, Model, _IDFParams):
             kernel_fn=kernel_fn,
             input_kinds={in_col: "dense"},
             elementwise=True,  # per-term scaling: no FP accumulation
+            fusion_op="idf",  # megakernel-safe
         )
 
 

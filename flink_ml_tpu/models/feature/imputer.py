@@ -103,6 +103,7 @@ class ImputerModel(ModelArraysMixin, Model, _ImputerParams):
             kernel_fn=kernel_fn,
             input_kinds={n: "scalar" for n in in_cols},
             elementwise=True,  # isnan/where fill: no FP accumulation
+            fusion_op="impute",  # megakernel-safe
         )
 
 

@@ -54,4 +54,5 @@ class Normalizer(Transformer, HasInputCol, HasOutputCol):
             outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
             model_arrays={},
             kernel_fn=kernel_fn,
+            fusion_op="normalize",  # row-local reduction: megakernel-safe
         )

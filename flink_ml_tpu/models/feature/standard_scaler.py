@@ -193,6 +193,7 @@ class StandardScalerModel(ModelArraysMixin, Model, _ScalerTransformMixin):
             model_arrays={"mean": np.asarray(self.mean, np.float32), "inv_std": inv_std},
             kernel_fn=kernel_fn,
             elementwise=True,  # shift + scale: no FP accumulation
+            fusion_op="scale",  # megakernel-safe (docs/fusion.md vocabulary)
         )
 
 

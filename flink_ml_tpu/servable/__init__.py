@@ -13,6 +13,12 @@ from flink_ml_tpu.servable.api import (
     TransformerServable,
 )
 from flink_ml_tpu.servable.builder import PipelineModelServable
+from flink_ml_tpu.servable.fusion import (
+    ULP_ENVELOPE,
+    FusionTier,
+    resolve_fusion_tier,
+    ulp_diff,
+)
 from flink_ml_tpu.servable.kernel_spec import KernelSpec
 from flink_ml_tpu.servable.lib import (
     KMeansModelServable,
@@ -26,6 +32,10 @@ __all__ = [
     "ModelServable",
     "ModelDataConflictError",
     "KernelSpec",
+    "FusionTier",
+    "ULP_ENVELOPE",
+    "resolve_fusion_tier",
+    "ulp_diff",
     "PipelineModelServable",
     "LogisticRegressionModelServable",
     "KMeansModelServable",

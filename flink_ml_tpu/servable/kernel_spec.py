@@ -38,6 +38,19 @@ describe itself as a :class:`KernelSpec`:
   by construction, while a spec with a reduction (Normalizer's row norm,
   DCT's matmul) always keeps its own program (see ``servable/planner.py``).
   Default False — unset is always safe, merely unmerged.
+- ``fusable`` — whether ``fusion.mode=fast`` may merge this spec ACROSS a
+  reduction boundary into a whole-chain program (docs/fusion.md). Default
+  True; a spec whose numerics must stay pinned even under the fast tier's
+  ulp envelope sets False and keeps its own program in every mode. Exact
+  mode ignores it — the exact partition never crosses a reduction.
+- ``fusion_op`` — optional symbolic op id ("scale", "logistic", "mlp", ...)
+  naming this kernel in the Pallas megakernel vocabulary
+  (``servable/megakernels.py``). Only set for kernels whose body is in the
+  megakernel-safe op set; a chain lowers as a hand-fused megakernel only
+  when EVERY spec in it carries a registered ``fusion_op``. None (default)
+  = the chain falls back to the merged XLA program in fast mode.
+- ``flops_per_row`` — optional exact per-row FLOPs for the fusion cost model
+  (``servable/fusion.py``); default: estimated from ``model_arrays`` shapes.
 - ``kernel_fn(model_arrays, column_arrays) -> {name: array}`` — pure jnp math
   from the shared ``ops/kernels.py`` ``*_fn`` bodies. It must not touch the
   host (no ``.item()``, no numpy on traced values, no I/O): the planners
@@ -65,7 +78,8 @@ class KernelSpec:
     """Pure-kernel description of one pipeline stage (see module docstring)."""
 
     __slots__ = ("input_cols", "outputs", "model_arrays", "kernel_fn",
-                 "input_kinds", "readback_dtypes", "elementwise")
+                 "input_kinds", "readback_dtypes", "elementwise",
+                 "fusable", "fusion_op", "flops_per_row")
 
     def __init__(
         self,
@@ -77,6 +91,9 @@ class KernelSpec:
         input_kinds: Optional[Mapping[str, str]] = None,
         readback_dtypes: Optional[Mapping[str, Any]] = None,
         elementwise: bool = False,
+        fusable: bool = True,
+        fusion_op: Optional[str] = None,
+        flops_per_row: Optional[float] = None,
     ):
         self.input_cols: Tuple[str, ...] = tuple(input_cols)
         self.outputs: Tuple[Tuple[str, Any], ...] = tuple(outputs)
@@ -94,6 +111,11 @@ class KernelSpec:
             k: np.dtype(v) for k, v in (readback_dtypes or {}).items()
         }
         self.elementwise = bool(elementwise)
+        self.fusable = bool(fusable)
+        if fusion_op is not None and not isinstance(fusion_op, str):
+            raise ValueError(f"fusion_op must be a string op id; got {fusion_op!r}")
+        self.fusion_op = fusion_op
+        self.flops_per_row = None if flops_per_row is None else float(flops_per_row)
 
     @property
     def output_names(self) -> Tuple[str, ...]:

@@ -103,6 +103,7 @@ class LogisticRegressionModelServable(
             ),
             model_arrays={"coefficient": np.asarray(self.coefficient, np.float32)},
             kernel_fn=kernel_fn,
+            fusion_op="logistic",  # dot + sigmoid head: megakernel-safe
         )
 
 
@@ -152,6 +153,7 @@ class KMeansModelServable(
             outputs=((self.get_prediction_col(), DataTypes.DOUBLE),),
             model_arrays={"centroids": np.asarray(self.centroids, np.float32)},
             kernel_fn=kernel_fn,
+            fusion_op="kmeans",  # pairwise distance + argmin: megakernel-safe
         )
 
 
@@ -247,6 +249,7 @@ class MLPClassifierModelServable(
             ),
             model_arrays=model_arrays,
             kernel_fn=kernel_fn,
+            fusion_op="mlp",  # matmul/relu layers + softmax head: megakernel-safe
         )
 
 
@@ -327,4 +330,6 @@ class StandardScalerModelServable(ModelServable, HasInputCol, HasOutputCol):
                 "inv_std": self._inv_std(),
             },
             kernel_fn=kernel_fn,
+            elementwise=True,  # shift + scale: no FP accumulation
+            fusion_op="scale",  # megakernel-safe (docs/fusion.md vocabulary)
         )

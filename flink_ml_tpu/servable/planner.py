@@ -36,6 +36,19 @@ op computes per element exactly as it would alone. Merging saves one HBM
 round-trip and one program dispatch per interior boundary, which is most of
 the fused win on short chains.
 
+Fusion tiers (``fusion.mode``, resolved in ``servable/fusion.py``): the
+partition above is the **exact** tier — the default, bit-identical to the
+per-stage path. A segment built with a fast :class:`FusionTier` instead
+partitions into maximal ``fusable`` runs (``_partition_fast``): one XLA
+program per run, *crossing* reduction boundaries, so XLA may fuse a scaler's
+elementwise math straight into the following dot — the relaxed-numerics tier
+whose movement is bounded by the documented ulp envelope
+(``fusion.ULP_ENVELOPE``). At compile time (rows known, per key) the cost
+model may lower a hot run as a hand-fused Pallas megakernel instead
+(``servable/megakernels.py``) — intermediates VMEM-resident for the whole
+chain. Megakernels require an unsharded segment; sharded fast-tier segments
+lower their merged programs through the same SPMD machinery below.
+
 Mesh sharding (``servable/sharding.py``): a segment built with a
 :class:`~flink_ml_tpu.servable.sharding.PlanSharding` commits its model
 arrays **per shard** (replicated, or TP-split for wide heads) and lowers its
@@ -46,7 +59,8 @@ the callers' padding discipline (buckets/chunks keep every shard in the
 row-count-invariant regime — see the MIN_SHARD_ROWS note in
 ``servable/sharding.py``) keeps per-row results bit-identical to the
 single-device path. The planner stays policy-free: WHERE the rows come from
-and how they are padded belongs to the serving/batch tiers.
+and how they are padded belongs to the serving/batch tiers; WHICH fusion
+tier applies belongs to the resolved ``FusionTier`` the caller passes.
 """
 from __future__ import annotations
 
@@ -57,6 +71,7 @@ import numpy as np
 
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.servable.fusion import chain_score
 
 __all__ = [
     "IneligibleBatch",
@@ -67,6 +82,12 @@ __all__ = [
     "run_segment",
 ]
 
+#: Program kinds a compiled chain may carry (the plan-choice vocabulary the
+#: ``ml.fusion.*`` metrics and the ``fusion`` span attribute report).
+PLAN_EXACT = "exact"
+PLAN_FUSED = "fused"
+PLAN_MEGAKERNEL = "megakernel"
+
 
 class IneligibleBatch(Exception):
     """This batch cannot ride a fused executable (sparse/ragged input, or a
@@ -74,14 +95,19 @@ class IneligibleBatch(Exception):
 
 
 class _Program:
-    """One XLA program of a segment's chain: a single spec, or a merged run
-    of consecutive ``elementwise`` specs (see module docstring)."""
+    """One XLA program of a segment's chain: a single spec, a merged run of
+    consecutive ``elementwise`` specs (exact tier), or a maximal ``fusable``
+    run crossing reduction boundaries (fast tier — ``kind`` records which;
+    see module docstring)."""
 
-    __slots__ = ("specs", "models", "inputs", "jitted")
+    __slots__ = ("specs", "models", "inputs", "jitted", "kind")
 
-    def __init__(self, specs: Sequence[Any], models: Sequence[Dict[str, Any]]):
+    def __init__(
+        self, specs: Sequence[Any], models: Sequence[Dict[str, Any]], kind: str = PLAN_EXACT
+    ):
         self.specs = tuple(specs)
         self.models = tuple(models)
+        self.kind = kind
         needed: List[str] = []
         produced: set = set()
         for spec in self.specs:
@@ -103,6 +129,84 @@ class _Program:
         self.jitted = jax.jit(program_fn)
 
 
+class _MegaProgram:
+    """A hot fast-tier run lowered as one hand-fused Pallas megakernel
+    (``servable/megakernels.py``) — same calling convention as
+    :class:`_Program`, so ``run_segment`` lowers/compiles/executes it through
+    the identical machinery. Built only behind the fast tier (see
+    ``_fast_megakernels``); the cost model decides per compiled key whether
+    the chain is hot enough to use it."""
+
+    __slots__ = ("specs", "models", "inputs", "jitted", "kind")
+
+    def __init__(self, program: _Program, mega_fn: Callable):
+        self.specs = program.specs
+        self.models = program.models
+        self.inputs = program.inputs
+        self.kind = PLAN_MEGAKERNEL
+        self.jitted = jax.jit(mega_fn)
+
+
+def _partition_exact(specs: Sequence[Any]) -> List[Tuple[int, int]]:
+    """The exact tier's program partition: one program per spec, except
+    consecutive ``elementwise`` specs, which merge (a reduction-free graph
+    has no accumulation order to reorder — the bit-exactness contract in the
+    module docstring). No program here ever spans a reduction boundary; the
+    graftcheck ``fusion-tier`` rule pins this function to that shape."""
+    runs: List[Tuple[int, int]] = []
+    i = 0
+    while i < len(specs):
+        j = i + 1
+        if specs[i].elementwise:
+            while j < len(specs) and specs[j].elementwise:
+                j += 1
+        runs.append((i, j))
+        i = j
+    return runs
+
+
+def _partition_fast(specs: Sequence[Any]) -> List[Tuple[int, int]]:
+    """The fast tier's program partition: maximal runs of ``fusable`` specs
+    become ONE program each, crossing reduction boundaries — XLA fuses the
+    whole run (ulp-envelope numerics, docs/fusion.md). A spec with
+    ``fusable=False`` keeps its own program in every tier."""
+    runs: List[Tuple[int, int]] = []
+    i = 0
+    while i < len(specs):
+        j = i + 1
+        if specs[i].fusable:
+            while j < len(specs) and specs[j].fusable:
+                j += 1
+        runs.append((i, j))
+        i = j
+    return runs
+
+
+def _fast_megakernels(
+    programs: Sequence[_Program], sharding: Optional[Any]
+) -> Dict[int, _MegaProgram]:
+    """Megakernel candidates per fast-tier program index: built only for
+    unsharded segments (a megakernel is a single-device program; sharded
+    fast-tier segments keep the merged SPMD XLA programs) and only for runs
+    whose every spec carries a megakernel-safe ``fusion_op``. Whether a
+    candidate is actually USED is the cost model's per-key call in
+    ``run_segment`` — building the candidate here costs one closure, no
+    compile."""
+    if sharding is not None:
+        return {}
+    from flink_ml_tpu.servable.megakernels import build_megakernel_fn, chain_eligible
+
+    interpret = jax.default_backend() != "tpu"
+    out: Dict[int, _MegaProgram] = {}
+    for idx, prog in enumerate(programs):
+        if chain_eligible(prog.specs):
+            mega_fn = build_megakernel_fn(
+                prog.specs, prog.models, prog.inputs, interpret
+            )
+            out[idx] = _MegaProgram(prog, mega_fn)
+    return out
+
+
 class FusedSegment:
     """A maximal run of consecutive kernel-spec stages, compiled as one
     executable chain per key: one AOT program per reduction-bearing stage
@@ -111,13 +215,19 @@ class FusedSegment:
 
     __slots__ = (
         "stages", "specs", "external_inputs", "device_models", "programs",
-        "compiled", "signatures", "sharding",
+        "compiled", "signatures", "sharding", "fusion", "mega", "plan_kinds",
     )
 
-    def __init__(self, staged: Sequence[Tuple[Any, Any]], sharding: Optional[Any] = None):
+    def __init__(
+        self,
+        staged: Sequence[Tuple[Any, Any]],
+        sharding: Optional[Any] = None,
+        fusion: Optional[Any] = None,
+    ):
         self.stages = [stage for stage, _ in staged]
         self.specs = [spec for _, spec in staged]
         self.sharding = sharding
+        self.fusion = fusion  # resolved FusionTier, or None ≡ exact
         produced: set = set()
         external: List[str] = []
         for spec in self.specs:
@@ -140,24 +250,32 @@ class FusedSegment:
                 {k: jax.device_put(v) for k, v in spec.model_arrays.items()}
                 for spec in self.specs
             )
-        # Program partition (see module docstring): consecutive elementwise
-        # specs merge into one program; anything with a reduction keeps its
-        # own so no accumulation can cross a per-stage-path boundary.
-        self.programs: List[_Program] = []
-        i = 0
-        while i < len(self.specs):
-            j = i + 1
-            if self.specs[i].elementwise:
-                while j < len(self.specs) and self.specs[j].elementwise:
-                    j += 1
-            self.programs.append(
-                _Program(self.specs[i:j], self.device_models[i:j])
-            )
-            i = j
-        #: key -> [jax.stages.Compiled, ...] (one per program, in order)
-        self.compiled: Dict[Hashable, List[Any]] = {}
+        # Program partition (see module docstring): the exact tier merges
+        # only consecutive elementwise specs, so no accumulation can cross a
+        # per-stage-path boundary; the fast tier merges maximal fusable runs
+        # across reductions and builds Pallas megakernel candidates for the
+        # cost model to pick per compiled key.
+        if fusion is not None and fusion.fast:
+            runs = _partition_fast(self.specs)
+            kind = PLAN_FUSED
+        else:
+            runs = _partition_exact(self.specs)
+            kind = PLAN_EXACT
+        self.programs: List[_Program] = [
+            _Program(self.specs[i:j], self.device_models[i:j], kind)
+            for i, j in runs
+        ]
+        #: fast tier only: program index -> megakernel candidate
+        self.mega: Dict[int, _MegaProgram] = {}
+        if fusion is not None and fusion.fast and fusion.megakernel:
+            self.mega = _fast_megakernels(self.programs, sharding)
+        #: key -> [(program-or-megakernel, jax.stages.Compiled), ...] in order
+        self.compiled: Dict[Hashable, List[Tuple[Any, Any]]] = {}
         #: key -> {input name: (shape, dtype)} recorded at compile time
         self.signatures: Dict[Hashable, Dict[str, Tuple[Tuple[int, ...], Any]]] = {}
+        #: key -> tuple of program kinds chosen at compile time (the span
+        #: attribute / plan-choice vocabulary)
+        self.plan_kinds: Dict[Hashable, Tuple[str, ...]] = {}
 
     def input_kind(self, name: str) -> str:
         """The ingest accessor for an external input — the first consuming
@@ -207,6 +325,18 @@ class FusedSegment:
             out.extend(spec.outputs)
         return out
 
+    def plan_label(self, key: Hashable) -> str:
+        """The fusion tier the compiled chain for ``key`` actually runs at —
+        ``"exact"``, ``"fast"`` (merged XLA programs), or ``"fast+mega"``
+        (at least one program lowered as a Pallas megakernel). The value the
+        callers put on their trace spans' ``fusion`` attribute."""
+        kinds = self.plan_kinds.get(key, ())
+        if PLAN_MEGAKERNEL in kinds:
+            return "fast+mega"
+        if PLAN_FUSED in kinds:
+            return "fast"
+        return PLAN_EXACT
+
     def pending(self, outputs: Dict[str, Any]) -> List[Tuple[str, Any, Any, Any]]:
         """Readback-ready (name, declared DataType, device array, numpy dtype)
         tuples for every declared stage output, in ``add_column`` order."""
@@ -226,14 +356,21 @@ class FallbackStage:
         self.stage = stage
 
 
-def build_segments(stages: Sequence[Any], sharding: Optional[Any] = None) -> List[Any]:
+def build_segments(
+    stages: Sequence[Any],
+    sharding: Optional[Any] = None,
+    fusion: Optional[Any] = None,
+) -> List[Any]:
     """Group consecutive kernel-spec stages into :class:`FusedSegment` runs,
     everything else into :class:`FallbackStage`. Raises whatever
     ``kernel_spec()`` raises (an unloaded model must fail closed at plan
     build, before it could ever run); a stage whose ``kernel_spec()`` returns
     None falls back. With a ``sharding``
     (:class:`~flink_ml_tpu.servable.sharding.PlanSharding`), fused segments
-    commit their model arrays per shard and compile SPMD programs."""
+    commit their model arrays per shard and compile SPMD programs. With a
+    fast ``fusion`` (:class:`~flink_ml_tpu.servable.fusion.FusionTier`),
+    segments partition across reduction boundaries (module docstring);
+    ``None`` is the exact tier."""
     segments: List[Any] = []
     run: List[Tuple[Any, Any]] = []
     for stage in stages:
@@ -242,11 +379,11 @@ def build_segments(stages: Sequence[Any], sharding: Optional[Any] = None) -> Lis
             run.append((stage, spec))
         else:
             if run:
-                segments.append(FusedSegment(run, sharding))
+                segments.append(FusedSegment(run, sharding, fusion))
                 run = []
             segments.append(FallbackStage(stage))
     if run:
-        segments.append(FusedSegment(run, sharding))
+        segments.append(FusedSegment(run, sharding, fusion))
     return segments
 
 
@@ -269,6 +406,7 @@ def run_segment(
     inputs: Dict[str, Any],
     *,
     on_compile: Optional[Callable[[], None]] = None,
+    on_plan: Optional[Callable[[str, float], None]] = None,
     replicated: bool = False,
 ) -> Dict[str, Any]:
     """Execute the segment's executable chain for ``key``: each program runs
@@ -276,41 +414,68 @@ def run_segment(
     of the programs before it. Compiles the chain first if ``key`` was never
     seen — calling ``on_compile`` once so the caller can count it (the
     serving tier's warmup-coverage alarm, the batch tier's chunk-shape
-    accounting). On a sharded segment the chain lowers SPMD — batch rows
-    split over the data axis, or fully ``replicated`` for a sub-floor ragged
-    tail (the caller bakes the mode into ``key``: the two compile different
-    executables)."""
+    accounting), and ``on_plan(kind, score)`` once per program with the plan
+    choice the cost model made (exact / fused / megakernel — the
+    ``ml.fusion.*`` accounting). On a fast-tier segment the choice is
+    per-key: a run with a megakernel candidate lowers it only when the
+    cost-model score at this key's rows clears the tier's bar. On a sharded
+    segment the chain lowers SPMD — batch rows split over the data axis, or
+    fully ``replicated`` for a sub-floor ragged tail (the caller bakes the
+    mode into ``key``: the two compile different executables)."""
     chain = segment.compiled.get(key)
     if chain is None:
         if on_compile is not None:
             on_compile()
+        rows = next(iter(inputs.values())).shape[0] if inputs else 0
+        width = max(
+            (int(a.shape[1]) for a in inputs.values() if getattr(a, "ndim", 1) == 2),
+            default=0,
+        )
         if segment.sharding is not None and not replicated:
-            rows = next(iter(inputs.values())).shape[0]
             if rows % segment.sharding.n_data:
                 raise IneligibleBatch(
                     f"{rows} rows not divisible by the {segment.sharding.n_data}-way "
                     "data axis — pad to a mesh multiple or run replicated"
                 )
         chain = []
+        kinds: List[str] = []
         cols: Dict[str, Any] = dict(inputs)
-        for prog in segment.programs:
+        for idx, xla_prog in enumerate(segment.programs):
+            prog = xla_prog
+            mega = segment.mega.get(idx)
+            if mega is not None and segment.fusion.megakernel_hot(
+                prog.specs, rows, width
+            ):
+                prog = mega
             stage_inputs = {n: cols[n] for n in prog.inputs}
-            compiled = prog.jitted.lower(
-                prog.models,
-                {
-                    n: _lowering_struct(segment, a, replicated)
-                    for n, a in stage_inputs.items()
-                },
-            ).compile()
-            chain.append(compiled)
+            structs = {
+                n: _lowering_struct(segment, a, replicated)
+                for n, a in stage_inputs.items()
+            }
+            try:
+                compiled = prog.jitted.lower(prog.models, structs).compile()
+            except Exception:
+                if prog is xla_prog:
+                    raise
+                # A megakernel the backend's Pallas lowering rejects (e.g.
+                # Mosaic tiling rules stricter than interpret mode) must not
+                # take the fast tier down — the merged XLA program computes
+                # the same chain inside the same ulp envelope.
+                prog = xla_prog
+                compiled = prog.jitted.lower(prog.models, structs).compile()
+            if on_plan is not None:
+                on_plan(prog.kind, chain_score(prog.specs, rows, width))
+            kinds.append(prog.kind)
+            chain.append((prog, compiled))
             cols.update(compiled(prog.models, stage_inputs))
         segment.compiled[key] = chain
+        segment.plan_kinds[key] = tuple(kinds)
         segment.signatures[key] = {
             name: (tuple(arr.shape), arr.dtype) for name, arr in inputs.items()
         }
     cols = dict(inputs)
     outs: Dict[str, Any] = {}
-    for prog, compiled in zip(segment.programs, chain):
+    for prog, compiled in chain:
         prog_out = compiled(prog.models, {n: cols[n] for n in prog.inputs})
         cols.update(prog_out)
         outs.update(prog_out)

@@ -50,6 +50,7 @@ import numpy as np
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.servable.builder import PipelineModelServable
+from flink_ml_tpu.servable.fusion import plan_recorder, resolve_fusion_tier
 from flink_ml_tpu.servable.planner import (
     FallbackStage,
     FusedSegment,
@@ -80,15 +81,19 @@ class CompiledServingPlan:
         segments: List[Any],
         scope: str,
         sharding: Optional[Any] = None,
+        fusion: Optional[Any] = None,
     ):
         self._stages = list(stages)
         self.segments = segments
         self.scope = scope
         self.sharding = sharding
+        self.fusion = fusion if fusion is not None else resolve_fusion_tier()
+        self._on_plan = plan_recorder(scope)
         n_fused = sum(len(s.specs) for s in segments if isinstance(s, FusedSegment))
         n_fallback = sum(1 for s in segments if isinstance(s, FallbackStage))
         metrics.gauge(scope, MLMetrics.SERVING_FUSED_STAGES, n_fused)
         metrics.gauge(scope, MLMetrics.SERVING_FALLBACK_STAGES, n_fallback)
+        metrics.gauge(scope, MLMetrics.FUSION_MODE, 1 if self.fusion.fast else 0)
         if sharding is not None:
             metrics.gauge(scope, MLMetrics.SERVING_SHARD_COUNT, sharding.n_data)
             metrics.gauge(scope, MLMetrics.SERVING_SHARD_MODEL_AXIS, sharding.n_model)
@@ -96,7 +101,11 @@ class CompiledServingPlan:
     # -- construction ---------------------------------------------------------
     @staticmethod
     def build(  # graftcheck: cold
-        servable, *, scope: str = "ml.serving[plan]", sharding: Optional[Any] = None
+        servable,
+        *,
+        scope: str = "ml.serving[plan]",
+        sharding: Optional[Any] = None,
+        fusion: Optional[Any] = None,
     ) -> Optional["CompiledServingPlan"]:
         """Group the servable's consecutive kernel-spec stages into fused
         segments. Raises whatever ``kernel_spec()`` raises (an unloaded model
@@ -104,7 +113,11 @@ class CompiledServingPlan:
         ``sharding`` (``serving.mesh`` > 1), segments commit weights per
         shard and compile SPMD per-bucket executables — hot swap and rollback
         pay the per-device placement here, at warmup, never on the serving
-        path.
+        path. ``fusion`` is the resolved
+        :class:`~flink_ml_tpu.servable.fusion.FusionTier`; default: the
+        ``fusion.mode`` config (docs/fusion.md). The plan snapshots the tier
+        — a config flip after build is a REBUILD key, never a silent
+        repartition (``serving/server.py`` compares ``fusion.key``).
 
         Build-time work (one device_put per model array, jit wrapper
         construction per program): normally runs at warmup/swap time, off the
@@ -116,10 +129,12 @@ class CompiledServingPlan:
             if isinstance(servable, PipelineModelServable)
             else [servable]
         )
-        segments = build_segments(stages, sharding)
+        if fusion is None:
+            fusion = resolve_fusion_tier()
+        segments = build_segments(stages, sharding, fusion)
         if not any(isinstance(s, FusedSegment) for s in segments):
             return None
-        return CompiledServingPlan(stages, segments, scope, sharding)
+        return CompiledServingPlan(stages, segments, scope, sharding, fusion)
 
     # -- warmup / AOT ---------------------------------------------------------
     def warmup(self, template: DataFrame, buckets: Sequence[int]) -> None:
@@ -131,6 +146,7 @@ class CompiledServingPlan:
         for bucket in buckets:
             with tracer.span("serving.plan.warmup", CAT_COMPILE, scope=self.scope) as sp:
                 sp.set_attr("bucket", bucket)
+                sp.set_attr("fusion", self.fusion.mode)
                 if self.sharding is not None:
                     sp.set_attr("shards", self.sharding.n_data)
                 df = pad_to(template, bucket)
@@ -148,7 +164,10 @@ class CompiledServingPlan:
                         for stage in segment.stages:
                             df = stage.transform(df)
                         continue
-                    outputs = run_segment(segment, bucket, inputs)
+                    outputs = run_segment(segment, bucket, inputs, on_plan=self._on_plan)
+                    # The cost model's per-bucket choice (may be "fast+mega")
+                    # — goodput attribution splits compile time by tier.
+                    sp.set_attr("fusion", segment.plan_label(bucket))
                     df = self._materialize(df, segment.pending(outputs))
         metrics.gauge(
             self.scope,
@@ -166,6 +185,7 @@ class CompiledServingPlan:
             on_compile=lambda: metrics.counter(
                 self.scope, MLMetrics.SERVING_FASTPATH_COMPILES
             ),
+            on_plan=self._on_plan,
         )
 
     # -- the hot path ---------------------------------------------------------

@@ -31,6 +31,7 @@ from flink_ml_tpu.serving.batcher import MicroBatcher, pad_to
 from flink_ml_tpu.serving.errors import NoModelError, ServingClosedError
 from flink_ml_tpu.serving.plan import CompiledServingPlan
 from flink_ml_tpu.serving.registry import ModelRegistry, ModelVersionPoller
+from flink_ml_tpu.servable.fusion import resolve_fusion_tier
 from flink_ml_tpu.servable.sharding import resolve_plan_sharding
 from flink_ml_tpu.trace import CAT_COMPILE, CAT_SWAP, tracer
 
@@ -70,6 +71,7 @@ class ServingConfig:
         pipeline_depth: Optional[int] = None,
         mesh: Optional[int] = None,
         mesh_model: Optional[int] = None,
+        fusion_mode: Optional[str] = None,
     ):
         self.max_batch_size = (
             int(max_batch_size) if max_batch_size is not None
@@ -106,6 +108,10 @@ class ServingConfig:
             int(mesh_model) if mesh_model is not None
             else config.get(Options.SERVING_MESH_MODEL)
         )
+        self.fusion_mode = (
+            str(fusion_mode) if fusion_mode is not None
+            else config.get(Options.FUSION_MODE)
+        )
 
     def __repr__(self) -> str:
         return (
@@ -115,7 +121,8 @@ class ServingConfig:
             f"default_timeout_ms={self.default_timeout_ms}, "
             f"poll_interval_ms={self.poll_interval_ms}, "
             f"fastpath={self.fastpath}, pipeline_depth={self.pipeline_depth}, "
-            f"mesh={self.mesh}, mesh_model={self.mesh_model})"
+            f"mesh={self.mesh}, mesh_model={self.mesh_model}, "
+            f"fusion_mode={self.fusion_mode})"
         )
 
 
@@ -188,6 +195,16 @@ class InferenceServer:
             if self.config.fastpath
             else None
         )
+        # Fusion tier, resolved once like the mesh: every version's plan
+        # compiles under it, and a plan a servable carries from elsewhere
+        # (another server, a flipped config) rebuilds on key mismatch —
+        # flipping fusion.mode must never silently serve the old tier.
+        # Resolving here also fail-fasts a bad mode at construction.
+        self._fusion = (
+            resolve_fusion_tier(self.config.fusion_mode)
+            if self.config.fastpath
+            else None
+        )
         self._batcher = MicroBatcher(
             self._execute,
             max_batch_size=self.config.max_batch_size,
@@ -221,13 +238,20 @@ class InferenceServer:
         if plan is _PLAN_UNSET or (
             # A plan compiled under a different placement (the same servable
             # object attached to a server with another mesh) has the wrong
-            # local shapes and committed buffers — rebuild for this mesh.
+            # local shapes and committed buffers, and a plan compiled under a
+            # different fusion tier has the wrong program partition and
+            # numerics contract — rebuild for this server's mesh + tier
+            # (the same bug class the batch fingerprint covers for
+            # batch.mesh / fusion.mode, docs/fusion.md).
             plan is not None
-            and getattr(plan.sharding, "key", None)
-            != (self._sharding.key if self._sharding is not None else None)
+            and (
+                getattr(plan.sharding, "key", None)
+                != (self._sharding.key if self._sharding is not None else None)
+                or getattr(plan.fusion, "key", None) != self._fusion.key
+            )
         ):
             plan = CompiledServingPlan.build(
-                servable, scope=self.scope, sharding=self._sharding
+                servable, scope=self.scope, sharding=self._sharding, fusion=self._fusion
             )
             try:
                 servable._fastpath_plan = plan

@@ -35,6 +35,7 @@ ALL_RULES = (
     "elementwise-claim",
     "error-hygiene",
     "fault-points",
+    "fusion-tier",
     "host-sync",
     "jit-purity",
     "kernel-spec-consistency",
@@ -771,3 +772,145 @@ def test_cli_list_rules_and_usage_errors():
         assert rule in proc.stdout
     assert _cli("no_such_dir").returncode == 2
     assert _cli("--rules", "bogus", "flink_ml_tpu").returncode == 2
+
+
+# -----------------------------------------------------------------------------
+# fusion-tier: exact partitions never span a reduction; Pallas behind fast only
+# -----------------------------------------------------------------------------
+
+FUSION_PLANNER_CLEAN = """
+    PLAN_FUSED = "fused"
+
+    def _partition_exact(specs):
+        runs, i = [], 0
+        while i < len(specs):
+            j = i + 1
+            if specs[i].elementwise:
+                while j < len(specs) and specs[j].elementwise:
+                    j += 1
+            runs.append((i, j))
+            i = j
+        return runs
+
+    def _partition_fast(specs):
+        return [(0, len(specs))]
+
+    def _fast_megakernels(programs):
+        from flink_ml_tpu.servable.megakernels import build_megakernel_fn
+        return {0: build_megakernel_fn(programs)}
+
+    class FusedSegment:
+        def __init__(self, specs, fusion=None):
+            if fusion is not None and fusion.fast:
+                self.runs = _partition_fast(specs)
+                if fusion.megakernel:
+                    self.mega = _fast_megakernels(self.runs)
+            else:
+                self.runs = _partition_exact(specs)
+"""
+
+FUSION_MEGAKERNELS = """
+    from jax.experimental import pallas as pl
+
+    def build_megakernel_fn(programs):
+        return pl.pallas_call
+"""
+
+
+def test_fusion_tier_clean_fixture_passes(tmp_path):
+    result = run_on(
+        tmp_path,
+        {
+            "flink_ml_tpu/servable/planner.py": FUSION_PLANNER_CLEAN,
+            "flink_ml_tpu/servable/megakernels.py": FUSION_MEGAKERNELS,
+        },
+        rules=["fusion-tier"],
+    )
+    assert result.findings == [], "\n".join(f.render() for f in result.findings)
+
+
+def test_fusion_tier_flags_pallas_outside_megakernels(tmp_path):
+    result = run_on(
+        tmp_path,
+        {
+            "flink_ml_tpu/servable/planner.py": FUSION_PLANNER_CLEAN,
+            "flink_ml_tpu/serving/plan.py": """
+                from jax.experimental import pallas as pl
+            """,
+        },
+        rules=["fusion-tier"],
+    )
+    assert len(result.findings) == 1
+    assert result.findings[0].path == "flink_ml_tpu/serving/plan.py"
+    assert "Pallas import in the plan tier" in result.findings[0].message
+
+
+def test_fusion_tier_flags_exact_partition_merging_on_fusable(tmp_path):
+    dirty = FUSION_PLANNER_CLEAN.replace(
+        "if specs[i].elementwise:", "if specs[i].fusable:"
+    ).replace(
+        "while j < len(specs) and specs[j].elementwise:",
+        "while j < len(specs) and specs[j].fusable:",
+    )
+    result = run_on(
+        tmp_path,
+        {"flink_ml_tpu/servable/planner.py": dirty},
+        rules=["fusion-tier"],
+    )
+    msgs = [f.message for f in result.findings]
+    assert any("never tests .elementwise" in m for m in msgs)
+    assert any(".fusable" in m for m in msgs)
+
+
+def test_fusion_tier_flags_missing_exact_partition(tmp_path):
+    result = run_on(
+        tmp_path,
+        {"flink_ml_tpu/servable/planner.py": "def build(): pass\n"},
+        rules=["fusion-tier"],
+    )
+    assert any("no _partition_exact" in f.message for f in result.findings)
+
+
+def test_fusion_tier_flags_module_level_megakernel_import(tmp_path):
+    dirty = (
+        "from flink_ml_tpu.servable.megakernels import build_megakernel_fn\n"
+        + textwrap.dedent(FUSION_PLANNER_CLEAN).lstrip("\n")
+    )
+    result = run_on(
+        tmp_path,
+        {"flink_ml_tpu/servable/planner.py": dirty},
+        rules=["fusion-tier"],
+    )
+    msgs = [f.message for f in result.findings]
+    assert any("import must be function-local" in m for m in msgs)
+
+
+def test_fusion_tier_flags_unguarded_fast_machinery(tmp_path):
+    dirty = FUSION_PLANNER_CLEAN.replace(
+        """            if fusion is not None and fusion.fast:
+                self.runs = _partition_fast(specs)
+                if fusion.megakernel:
+                    self.mega = _fast_megakernels(self.runs)
+            else:
+                self.runs = _partition_exact(specs)""",
+        """            self.runs = _partition_fast(specs)
+            self.mega = _fast_megakernels(self.runs)""",
+    )
+    assert "_partition_exact(specs)" not in dirty.split("class FusedSegment")[1]
+    result = run_on(
+        tmp_path,
+        {"flink_ml_tpu/servable/planner.py": dirty},
+        rules=["fusion-tier"],
+    )
+    unguarded = [
+        f for f in result.findings if "outside a fusion-fast guard" in f.message
+    ]
+    assert len(unguarded) == 2  # _partition_fast and _fast_megakernels
+
+
+def test_fusion_tier_shipped_tree_contract():
+    """The real planner satisfies the rule with ZERO suppressions, and the
+    real megakernel module is the plan tier's only Pallas user."""
+    result = run_rules(Project(REPO_ROOT, ["flink_ml_tpu"]), rules=["fusion-tier"])
+    assert result.findings == [], "\n".join(f.render() for f in result.findings)
+    assert result.suppressed == []

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""CI fusion smoke: both fusion tiers built and served end to end.
+
+Builds the same published pipeline (scaler → logistic head) under
+``fusion.mode=exact`` and ``fusion.mode=fast`` (megakernels forced hot so the
+Pallas lowering is on the exercised path), warms each, serves a burst, and
+checks (any failure exits 1):
+
+- ZERO ``ml.serving.fastpath.compiles`` after warmup in EACH tier — warmup
+  coverage holds for exact programs, cross-reduction fused programs, and
+  megakernels alike;
+- exact-tier responses are bit-identical per row to the per-stage reference
+  transform at the response bucket (the PR 4 contract, unchanged by the
+  fusion planner);
+- fast-tier responses stay inside the documented ulp envelope of the exact
+  tier's (``fusion.ULP_ENVELOPE['scale_logistic']``, docs/fusion.md), and the
+  megakernel program counter actually moved — the fast tier really ran the
+  hand-fused kernel, not a silent fallback.
+
+Driven by tools/ci/run_tests.sh after the sharded smoke.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def main() -> int:
+    import numpy as np
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.servable import (
+        LogisticRegressionModelServable,
+        PipelineModelServable,
+        StandardScalerModelServable,
+    )
+    from flink_ml_tpu.servable.fusion import ULP_ENVELOPE, ulp_diff
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig, pad_to
+
+    dim = 32
+    rng = np.random.default_rng(23)
+    sc = StandardScalerModelServable().set_input_col("features").set_output_col("scaled")
+    sc.set_with_mean(True)
+    sc.mean = rng.standard_normal(dim)
+    sc.std = np.abs(rng.standard_normal(dim)) + 0.5
+    lr = LogisticRegressionModelServable().set_features_col("scaled")
+    lr.coefficient = rng.standard_normal(dim)
+    reference = PipelineModelServable([sc, lr])
+
+    template = DataFrame.from_dict({"features": rng.standard_normal((1, dim))})
+    requests = [
+        DataFrame.from_dict({"features": rng.standard_normal((4, dim))})
+        for _ in range(16)
+    ]
+
+    from flink_ml_tpu.config import Options, config
+
+    config.set(Options.FUSION_MEGAKERNEL_MIN_SCORE, 1.0)  # force megakernels hot
+    try:
+        results = {}
+        for mode in ("exact", "fast"):
+            # fresh servable per tier so each carries its own compiled plan
+            servable = PipelineModelServable([sc, lr])
+            with InferenceServer(
+                servable,
+                name=f"fusion-smoke-{mode}",
+                serving_config=ServingConfig(max_delay_ms=0.1, fusion_mode=mode),
+                warmup_template=template,
+            ) as server:
+                before = metrics.get(server.scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+                outs = [server.predict(req) for req in requests]
+                compiles = (
+                    metrics.get(server.scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+                    - before
+                )
+                if compiles:
+                    print(
+                        f"FAIL: {compiles} fast-path compiles after warmup in "
+                        f"fusion.mode={mode}"
+                    )
+                    return 1
+                results[mode] = outs
+            if mode == "fast":
+                megas = metrics.get(
+                    server.scope, MLMetrics.FUSION_PROGRAMS_MEGAKERNEL, 0
+                )
+                if not megas:
+                    print("FAIL: fast tier never compiled a megakernel program")
+                    return 1
+
+        envelope = ULP_ENVELOPE["scale_logistic"]
+        for req, exact_out, fast_out in zip(requests, results["exact"], results["fast"]):
+            ref = reference.transform(pad_to(req, exact_out.bucket))
+            for col in ("prediction", "rawPrediction"):
+                got = np.asarray(exact_out.dataframe.column(col))
+                want = np.asarray(ref.column(col))[: len(req)]
+                if not np.array_equal(got, want):
+                    print(f"FAIL: exact tier not bit-identical on {col}")
+                    return 1
+                moved = ulp_diff(
+                    fast_out.dataframe.column(col), exact_out.dataframe.column(col)
+                )
+                if moved > envelope:
+                    print(
+                        f"FAIL: fast tier moved {moved} ulps on {col} "
+                        f"(envelope {envelope})"
+                    )
+                    return 1
+    finally:
+        config.unset(Options.FUSION_MEGAKERNEL_MIN_SCORE)
+
+    print(
+        "fusion smoke OK: both tiers warm-covered (0 compiles), exact "
+        "bit-identical, fast inside the ulp envelope, megakernels exercised"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,4 +46,11 @@ sharded_artifact="${SHARDED_TRACE_ARTIFACT:-/tmp/ci-sharded-trace.json}"
 python tools/ci/sharded_smoke.py "${sharded_artifact}"
 python tools/traceview.py "${sharded_artifact}" --scope ml.serving | grep -A 3 "shards:"
 
+# Fusion smoke: build and serve BOTH fusion tiers (exact + fast with
+# megakernels forced hot), assert zero fast-path compiles after warmup in
+# each, exact bit-identical to the per-stage reference, fast inside the
+# documented ulp envelope (docs/fusion.md).
+echo "=== fusion smoke (exact + fast tiers, zero post-warmup compiles) ==="
+python tools/ci/fusion_smoke.py
+
 echo "CI OK"

@@ -26,7 +26,7 @@ def _build_parser() -> argparse.ArgumentParser:
         prog="python -m tools.graftcheck",
         description="Whole-program static analysis: layer, jit-purity, lock-order, "
         "fault-point, error-hygiene, recompile-hazard, host-sync, "
-        "blocking-under-lock and elementwise-claim invariants.",
+        "blocking-under-lock, elementwise-claim and fusion-tier invariants.",
     )
     p.add_argument(
         "targets",

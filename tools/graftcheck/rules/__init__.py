@@ -7,6 +7,7 @@ from tools.graftcheck.rules import (  # noqa: F401  (imported for registration)
     elementwise_claim,
     error_hygiene,
     fault_points,
+    fusion_tier,
     host_sync,
     jit_purity,
     kernel_spec_consistency,

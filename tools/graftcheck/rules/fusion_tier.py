@@ -1,0 +1,239 @@
+"""fusion-tier: the exact tier never spans a reduction; Pallas only behind fast.
+
+``fusion.mode`` (docs/fusion.md) is a numerics contract enforced by code
+*shape*: the exact tier's program partition
+(``servable/planner.py::_partition_exact``) may merge only consecutive
+``elementwise`` specs, and the relaxed-numerics machinery — the
+cross-reduction ``_partition_fast`` and the Pallas megakernels
+(``servable/megakernels.py``) — must be reachable only behind the fast tier.
+A refactor that let the exact partition merge on ``fusable`` (the fast
+vocabulary), or that called the megakernel builder outside a
+``fusion.fast`` guard, would silently move the default tier onto
+ulp-envelope numerics. This rule pins three invariants statically:
+
+1. **Exact partition purity** — ``_partition_exact`` must exist, must gate
+   its merge on ``.elementwise``, and must not reference the fast
+   vocabulary (``.fusable``, ``.fusion_op``, the fast partition/megakernel
+   helpers, or the fused/megakernel plan kinds). Composed with the
+   ``elementwise-claim`` rule (every ``elementwise=True`` body is
+   reduction-free, callees included), this proves the exact tier's program
+   partitions never span a reduction primitive — the extension of the PR 6
+   elementwise machinery to the planner's partition output.
+
+2. **Pallas containment** — within the plan tier (``servable/``,
+   ``serving/``, ``builder/``), only ``servable/megakernels.py`` may import
+   or reference Pallas. Kernel code elsewhere in the tree (``ops/``,
+   ``parallel/``, model internals) is out of scope — those are training
+   kernels with their own rules.
+
+3. **Fast gating** — every planner reference to the megakernel module and
+   every call of the fast-partition helpers (``_partition_fast``,
+   ``_fast_megakernels``) must sit either inside those helpers themselves
+   or under an ``if`` whose test reads the tier's ``.fast`` flag (or the
+   ``FUSION_FAST`` constant). The megakernel import itself must be
+   function-local to a fast helper — module import time must not pay for
+   (or expose) Pallas on the exact tier.
+
+Zero suppressions: the shipped tree satisfies all three by construction.
+
+File granularity: every check reads only the file it fires in (the planner's
+gating is self-contained — the megakernel import names are bound inside
+planner.py itself), so findings cache per content hash and a warm run parses
+nothing (the PR 6 cache contract).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.graftcheck.engine import Finding, Project, Rule, SourceFile, register
+
+PLANNER_REL = "flink_ml_tpu/servable/planner.py"
+MEGAKERNELS_REL = "flink_ml_tpu/servable/megakernels.py"
+PLAN_TIER_PREFIXES = (
+    "flink_ml_tpu/servable/",
+    "flink_ml_tpu/serving/",
+    "flink_ml_tpu/builder/",
+)
+#: The only planner functions allowed to touch the megakernel module.
+FAST_HELPERS = {"_partition_fast", "_fast_megakernels"}
+#: Fast-tier vocabulary the exact partition must never read.
+FAST_ATTRS = {"fusable", "fusion_op"}
+FAST_NAMES = {"PLAN_FUSED", "PLAN_MEGAKERNEL", "FUSION_FAST"} | FAST_HELPERS
+
+
+def _test_reads_fast(test: ast.AST) -> bool:
+    """Whether an ``if`` test reads the fast-tier switch: an attribute
+    ``.fast`` / ``.megakernel`` access or the FUSION_FAST constant."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and n.attr in ("fast", "megakernel"):
+            return True
+        if isinstance(n, ast.Name) and n.id == "FUSION_FAST":
+            return True
+    return False
+
+
+def _pallas_imports(tree: ast.AST) -> List[ast.AST]:
+    out = []
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            if any("pallas" in (a.name or "") for a in n.names):
+                out.append(n)
+        elif isinstance(n, ast.ImportFrom):
+            mod = n.module or ""
+            if "pallas" in mod or any("pallas" in (a.name or "") for a in n.names):
+                out.append(n)
+    return out
+
+
+@register
+class FusionTierRule(Rule):
+    name = "fusion-tier"
+    severity = "error"
+    description = (
+        "exact-mode program partitions merge only on elementwise (never span "
+        "a reduction), Pallas stays inside servable/megakernels.py, and "
+        "megakernel machinery is reachable only behind the fast fusion tier"
+    )
+    granularity = "file"
+    cache_version = 1
+
+    def check_file(self, project: Project, sf: SourceFile) -> List[Finding]:
+        if not sf.rel.startswith(PLAN_TIER_PREFIXES) or sf.rel == MEGAKERNELS_REL:
+            return []
+        if sf.tree is None:
+            return []
+        findings: List[Finding] = []
+
+        # -- 2: Pallas containment in the plan tier ---------------------------
+        for node in _pallas_imports(sf.tree):
+            findings.append(
+                self.finding(
+                    sf.rel,
+                    node.lineno,
+                    "Pallas import in the plan tier outside "
+                    f"{MEGAKERNELS_REL} — megakernel bodies (and their "
+                    "dependency on Pallas) live only there, reachable "
+                    "only behind fusion.mode=fast",
+                )
+            )
+
+        if sf.rel != PLANNER_REL:
+            return findings
+        planner = sf
+
+        # -- 1: exact partition purity ---------------------------------------
+        exact_def: Optional[ast.FunctionDef] = None
+        for n in ast.walk(planner.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n.name == "_partition_exact":
+                exact_def = n
+                break
+        if exact_def is None:
+            findings.append(
+                self.finding(
+                    PLANNER_REL,
+                    1,
+                    "planner has no _partition_exact function — the exact "
+                    "tier's partition must be a named, statically checkable "
+                    "unit",
+                )
+            )
+        else:
+            reads_elementwise = any(
+                isinstance(n, ast.Attribute) and n.attr == "elementwise"
+                for n in ast.walk(exact_def)
+            )
+            if not reads_elementwise:
+                findings.append(
+                    self.finding(
+                        PLANNER_REL,
+                        exact_def.lineno,
+                        "_partition_exact never tests .elementwise — the "
+                        "exact tier's only legal merge condition (the "
+                        "bit-exactness contract)",
+                    )
+                )
+            for n in ast.walk(exact_def):
+                if isinstance(n, ast.Attribute) and n.attr in FAST_ATTRS:
+                    findings.append(
+                        self.finding(
+                            PLANNER_REL,
+                            n.lineno,
+                            f"_partition_exact reads the fast-tier attribute "
+                            f".{n.attr} — exact partitions may merge only on "
+                            ".elementwise, never across a reduction boundary",
+                        )
+                    )
+                elif isinstance(n, ast.Name) and n.id in FAST_NAMES:
+                    findings.append(
+                        self.finding(
+                            PLANNER_REL,
+                            n.lineno,
+                            f"_partition_exact references fast-tier machinery "
+                            f"{n.id} — the exact tier must not reach relaxed-"
+                            "numerics code",
+                        )
+                    )
+
+        # -- 3: fast gating of megakernel reachability ------------------------
+        mega_bound: Set[str] = set()
+        for n in ast.walk(planner.tree):
+            if isinstance(n, ast.ImportFrom) and (n.module or "").endswith(
+                "servable.megakernels"
+            ):
+                mega_bound.update(a.asname or a.name for a in n.names)
+        findings.extend(self._check_gating(planner, mega_bound))
+        return findings
+
+    def _check_gating(self, planner, mega_bound: Set[str]) -> List[Finding]:
+        """Walk the planner with an ancestor stack: references to megakernel
+        imports / fast helpers are legal only inside the fast helpers or
+        under an ``if`` that reads the fast switch."""
+        findings: List[Finding] = []
+        watched = mega_bound | FAST_HELPERS
+
+        def visit(node: ast.AST, in_fast_helper: bool, guarded: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                in_helper = in_fast_helper or node.name in FAST_HELPERS
+                for child in ast.iter_child_nodes(node):
+                    visit(child, in_helper, False)  # a def resets if-guards
+                return
+            if isinstance(node, ast.If):
+                child_guard = guarded or _test_reads_fast(node.test)
+                for child in node.body:
+                    visit(child, in_fast_helper, child_guard)
+                for child in node.orelse:
+                    visit(child, in_fast_helper, guarded)
+                visit(node.test, in_fast_helper, guarded)
+                return
+            if isinstance(node, ast.ImportFrom) and (node.module or "").endswith(
+                "servable.megakernels"
+            ):
+                if not in_fast_helper:
+                    findings.append(
+                        self.finding(
+                            PLANNER_REL,
+                            node.lineno,
+                            "megakernel import outside the fast helpers — the "
+                            "import must be function-local to "
+                            f"{sorted(FAST_HELPERS)} so the exact tier never "
+                            "pays for (or reaches) Pallas",
+                        )
+                    )
+            elif isinstance(node, ast.Name) and node.id in watched:
+                if not (in_fast_helper or guarded):
+                    findings.append(
+                        self.finding(
+                            PLANNER_REL,
+                            node.lineno,
+                            f"{node.id} referenced outside a fusion-fast guard "
+                            "— megakernel/fast-partition machinery must be "
+                            "reachable only behind an `if <tier>.fast` test "
+                            "or inside the fast helpers themselves",
+                        )
+                    )
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_fast_helper, guarded)
+
+        visit(planner.tree, False, False)
+        return findings
