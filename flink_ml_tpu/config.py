@@ -152,6 +152,74 @@ class Options:
         "claim/pad/scatter of batch N+1 with device execution of batch N; "
         "1 = strict sequential. Only effective on the fast path.",
     )
+    SERVING_CONTROLLER = ConfigOption(
+        "serving.controller",
+        _parse_bool,
+        True,
+        "SLO-adaptive serving controller (serving/controller.py, "
+        "docs/serving.md): priority-aware load shedding under sustained "
+        "overload, deadline-aware bucket downshift, and pipeline-depth "
+        "stepping driven by the live goodput ledger. Off = admission control "
+        "is the bounded queue alone (pre-PR-11 behavior).",
+    )
+    SERVING_SHED_WATERMARK = ConfigOption(
+        "serving.shed.watermark",
+        float,
+        0.75,
+        "Queue-occupancy fraction (queued rows / capacity) above which the "
+        "adaptive controller begins shedding sheddable-priority requests — "
+        "strictly below 1.0 so sheds happen BEFORE the bounded queue "
+        "hard-rejects everything indiscriminately.",
+    )
+    SERVING_SHED_SUSTAIN_MS = ConfigOption(
+        "serving.shed.sustain.ms",
+        float,
+        20.0,
+        "How long the queue must stay above serving.shed.watermark before "
+        "priority shedding starts — a single coalescing burst should not "
+        "shed anybody; sustained overload should.",
+    )
+    SERVING_SHED_PRIORITY = ConfigOption(
+        "serving.shed.priority",
+        int,
+        1,
+        "Lowest priority value the controller may shed (requests carry an "
+        "integer priority, 0 = most important). Priorities >= this value are "
+        "sheddable under sustained overload; priorities below it are only "
+        "ever rejected by the hard queue bound.",
+    )
+    SERVING_CONTROLLER_WINDOW_MS = ConfigOption(
+        "serving.controller.window.ms",
+        float,
+        2000.0,
+        "Rolling window of the controller's live goodput ledger — the queue/"
+        "productive/padding second totals its decisions read are sums over "
+        "the last this-many milliseconds.",
+    )
+    SERVING_CONTROLLER_QUEUE_FRACTION = ConfigOption(
+        "serving.controller.queue.fraction",
+        float,
+        0.5,
+        "Queue-category share of the goodput ledger above which the "
+        "controller steps serving.pipeline.depth up (and, at the depth "
+        "ceiling, recommends the next mesh width on the PR 9 ladder); the "
+        "depth steps back down when the share falls below a quarter of this.",
+    )
+    SERVING_CONTROLLER_DEPTH_MAX = ConfigOption(
+        "serving.controller.depth.max",
+        int,
+        4,
+        "Ceiling of the controller's pipeline-depth ladder: "
+        "serving.pipeline.depth is stepped within [configured depth, this].",
+    )
+    SERVING_DEADLINE_SAFETY = ConfigOption(
+        "serving.deadline.safety",
+        float,
+        2.0,
+        "Safety factor of the deadline-aware bucket downshift: a batch is "
+        "capped to the largest bucket whose EWMA service time x this factor "
+        "fits the head request's remaining deadline.",
+    )
     SERVING_MESH = ConfigOption(
         "serving.mesh",
         int,

@@ -93,6 +93,24 @@ FAULT_POINTS: Dict[str, str] = {
         "verdict but before the revert-to-N-1 flip; the retry must finish the "
         "quarantine + rollback with zero serving errors in between."
     ),
+    "serving.admit": (
+        "Serving admission seam (serving/batcher.py submit) — fail a request "
+        "at the queue door under live traffic; the caller sees a typed "
+        "synchronous failure and the queue state stays consistent (nothing "
+        "half-admitted, no deadlock)."
+    ),
+    "serving.dispatch": (
+        "Serving batch dispatch seam (serving/batcher.py _run_batch) — kill "
+        "a claimed batch after padding but before device dispatch; every "
+        "claimed request must resolve exactly once with the typed fault and "
+        "the next batch must serve normally."
+    ),
+    "loadgen.tick": (
+        "Open-loop load-generator arrival tick (loadgen/generator.py) — drop "
+        "an arrival mid-schedule; the harness must record the loss and keep "
+        "the rest of the schedule on time (chaos-under-load runs arm this to "
+        "prove the measurement rig itself survives faults)."
+    ),
 }
 
 

@@ -1,0 +1,48 @@
+"""Open-loop load generation — offered load, not closed-loop politeness.
+
+Every serving number before this package was closed-loop: each client thread
+waits for its last response before sending the next request, so the offered
+rate silently adapts to the server's capacity and queueing collapse — the
+failure mode that actually kills high-traffic serving — is structurally
+invisible. This package drives the serving tier the way real traffic does:
+arrivals fire on a **schedule** (seeded Poisson or bursty processes with a
+heavy-tailed request-size mix, rampable step by step) regardless of what the
+server is doing, and the harness records what overload actually looks like —
+p50/p99/p999, sheds, hard rejects, deadline misses, and time-to-first-shed
+per load step.
+
+Schedules are **seeded and replayable**: the same seed produces a
+byte-identical schedule, schedules serialize to JSON, and a recorded
+schedule replays against any target (including a virtual-clock one —
+determinism is testable without a wall clock). The generator's own arrival
+loop is a registered fault point (``loadgen.tick``), so chaos runs can prove
+the measurement rig itself survives injected faults. See docs/serving.md
+"Load shedding & adaptive control".
+"""
+from flink_ml_tpu.loadgen.arrivals import (
+    Arrival,
+    BurstyArrivals,
+    FixedSizes,
+    PoissonArrivals,
+    Schedule,
+    ZipfSizes,
+    ramp_schedule,
+)
+from flink_ml_tpu.loadgen.generator import (
+    LoadReport,
+    OpenLoopLoadGenerator,
+    StepStats,
+)
+
+__all__ = [
+    "Arrival",
+    "Schedule",
+    "PoissonArrivals",
+    "BurstyArrivals",
+    "ZipfSizes",
+    "FixedSizes",
+    "ramp_schedule",
+    "OpenLoopLoadGenerator",
+    "LoadReport",
+    "StepStats",
+]

@@ -1,0 +1,305 @@
+"""Seeded arrival processes, request-size mixes, and replayable schedules.
+
+A :class:`Schedule` is the fully materialized traffic plan: one
+:class:`Arrival` per request, each carrying its offset from run start, row
+count, priority, and load-step index. Everything that involves randomness
+happens HERE, at build time, from one ``random.Random(seed)`` — the open-loop
+generator (generator.py) just walks the list. That split is what makes runs
+replayable: the same seed yields a byte-identical schedule
+(``Schedule.to_json`` is canonical), and a recorded schedule replays against
+any target without re-rolling a single die.
+
+Processes:
+
+- :class:`PoissonArrivals` — memoryless inter-arrival gaps
+  (``Exp(rate)``), the classic open-loop offered-load model;
+- :class:`BurstyArrivals` — a two-state modulated Poisson process (a
+  burst state at ``burst_factor x`` the base rate alternating with idle
+  gaps), the self-similar traffic shape that defeats average-rate capacity
+  planning.
+
+Sizes:
+
+- :class:`ZipfSizes` — heavy-tailed request-size mix over a bucket-aligned
+  vocabulary (mass ∝ rank^-alpha: single rows dominate, the occasional
+  near-max-batch request drags the tail);
+- :class:`FixedSizes` — every request the same size (calibration runs).
+"""
+from __future__ import annotations
+
+import json
+import random
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Arrival",
+    "PoissonArrivals",
+    "BurstyArrivals",
+    "ZipfSizes",
+    "FixedSizes",
+    "Schedule",
+    "ramp_schedule",
+]
+
+
+class Arrival:
+    """One scheduled request: when (seconds from run start), how many rows,
+    at what priority, and which load step it belongs to."""
+
+    __slots__ = ("t", "rows", "priority", "step")
+
+    def __init__(self, t: float, rows: int, priority: int = 0, step: int = 0):
+        self.t = float(t)
+        self.rows = int(rows)
+        self.priority = int(priority)
+        self.step = int(step)
+
+    def as_list(self) -> List:
+        return [self.t, self.rows, self.priority, self.step]
+
+    def __repr__(self) -> str:
+        return f"Arrival(t={self.t:.6f}, rows={self.rows}, priority={self.priority}, step={self.step})"
+
+
+class PoissonArrivals:
+    """Open-loop Poisson process at ``rate`` arrivals/s: inter-arrival gaps
+    are iid ``Exp(rate)`` draws from the shared rng."""
+
+    def __init__(self, rate: float):
+        if rate <= 0.0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        self.rate = float(rate)
+
+    def gaps(self, rng: random.Random, duration_s: float):
+        """Yield inter-arrival gaps until their sum exceeds ``duration_s``."""
+        t = 0.0
+        while True:
+            gap = rng.expovariate(self.rate)
+            t += gap
+            if t > duration_s:
+                return
+            yield gap
+
+
+class BurstyArrivals:
+    """Two-state modulated Poisson process: bursts at
+    ``rate x burst_factor`` of mean length ``mean_burst_s`` alternate with
+    idle stretches of mean length ``mean_idle_s`` (both exponentially
+    distributed). With the default geometry the long-run average rate stays
+    close to ``rate`` while short windows see ``burst_factor x`` — the shape
+    that collapses a queue sized for the average."""
+
+    def __init__(
+        self,
+        rate: float,
+        burst_factor: float = 8.0,
+        mean_burst_s: float = 0.05,
+        mean_idle_s: Optional[float] = None,
+    ):
+        if rate <= 0.0 or burst_factor <= 1.0:
+            raise ValueError("rate must be > 0 and burst_factor > 1")
+        self.rate = float(rate)
+        self.burst_factor = float(burst_factor)
+        self.mean_burst_s = float(mean_burst_s)
+        # Idle length that keeps the long-run average at ``rate``: all
+        # arrivals land in bursts, so E[arrivals per cycle] =
+        # burst_rate*mean_burst must equal rate*(mean_burst+mean_idle).
+        self.mean_idle_s = (
+            float(mean_idle_s) if mean_idle_s is not None
+            else mean_burst_s * (burst_factor - 1.0)
+        )
+
+    def gaps(self, rng: random.Random, duration_s: float):
+        burst_rate = self.rate * self.burst_factor
+        t = 0.0
+        prev = 0.0
+        while t < duration_s:
+            burst_end = t + rng.expovariate(1.0 / self.mean_burst_s)
+            while True:
+                gap = rng.expovariate(burst_rate)
+                if t + gap > burst_end:
+                    break
+                t += gap
+                if t > duration_s:
+                    return
+                yield t - prev
+                prev = t
+            t = burst_end + rng.expovariate(1.0 / self.mean_idle_s)
+
+
+class ZipfSizes:
+    """Heavy-tailed request sizes: mass ∝ rank^-alpha over an ascending,
+    bucket-aligned vocabulary (default powers of two). alpha=1.5 puts ~70%
+    of requests at the smallest size with a real tail at the largest."""
+
+    def __init__(self, sizes: Sequence[int] = (1, 2, 4, 8, 16, 32), alpha: float = 1.5):
+        if not sizes or any(s < 1 for s in sizes):
+            raise ValueError(f"sizes must be >= 1, got {sizes}")
+        self.sizes = tuple(int(s) for s in sizes)
+        self.alpha = float(alpha)
+        weights = [(rank + 1) ** -self.alpha for rank in range(len(self.sizes))]
+        total = sum(weights)
+        self._cum: List[float] = []
+        acc = 0.0
+        for w in weights:
+            acc += w / total
+            self._cum.append(acc)
+        self._cum[-1] = 1.0  # guard fp drift
+
+    @property
+    def mean_rows(self) -> float:
+        probs = [self._cum[0]] + [
+            self._cum[i] - self._cum[i - 1] for i in range(1, len(self._cum))
+        ]
+        return sum(s * p for s, p in zip(self.sizes, probs))
+
+    def draw(self, rng: random.Random) -> int:
+        u = rng.random()
+        for size, cum in zip(self.sizes, self._cum):
+            if u <= cum:
+                return size
+        return self.sizes[-1]
+
+
+class FixedSizes:
+    """Every request ``rows`` rows (calibration / microbenchmark mixes)."""
+
+    def __init__(self, rows: int = 1):
+        self.rows = int(rows)
+
+    @property
+    def mean_rows(self) -> float:
+        return float(self.rows)
+
+    def draw(self, rng: random.Random) -> int:
+        return self.rows
+
+
+class Schedule:
+    """A materialized, replayable traffic plan.
+
+    ``meta`` records how it was built (seed, steps, process) purely for
+    humans; replay uses only ``entries``. Serialization is canonical
+    (sorted keys, explicit separators), so determinism is byte-testable:
+    building twice from the same seed yields identical ``to_json`` bytes.
+    """
+
+    VERSION = 1
+
+    def __init__(self, entries: Sequence[Arrival], meta: Optional[Dict] = None):
+        self.entries: List[Arrival] = list(entries)
+        self.meta: Dict = dict(meta or {})
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def __iter__(self):
+        return iter(self.entries)
+
+    @property
+    def n_steps(self) -> int:
+        return max((e.step for e in self.entries), default=-1) + 1
+
+    @property
+    def duration_s(self) -> float:
+        return self.entries[-1].t if self.entries else 0.0
+
+    def step_entries(self, step: int) -> List[Arrival]:
+        return [e for e in self.entries if e.step == step]
+
+    def offered_rows(self, step: Optional[int] = None) -> int:
+        return sum(e.rows for e in self.entries if step is None or e.step == step)
+
+    # -- serialization (canonical → byte-testable determinism) ---------------
+    def to_json(self) -> str:
+        payload = {
+            "version": self.VERSION,
+            "meta": self.meta,
+            "entries": [e.as_list() for e in self.entries],
+        }
+        return json.dumps(payload, sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, text: str) -> "Schedule":
+        payload = json.loads(text)
+        version = payload.get("version")
+        if version != cls.VERSION:
+            raise ValueError(f"unsupported schedule version {version!r}")
+        entries = [Arrival(t, rows, priority, step) for t, rows, priority, step in payload["entries"]]
+        return cls(entries, payload.get("meta"))
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "Schedule":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_json(f.read())
+
+    def __repr__(self) -> str:
+        return (
+            f"Schedule(arrivals={len(self.entries)}, steps={self.n_steps}, "
+            f"duration_s={self.duration_s:.3f})"
+        )
+
+
+def _draw_priority(rng: random.Random, mix: Sequence[Tuple[int, float]]) -> int:
+    u = rng.random()
+    acc = 0.0
+    for priority, weight in mix:
+        acc += weight
+        if u <= acc:
+            return priority
+    return mix[-1][0]
+
+
+def ramp_schedule(
+    steps: Sequence[Tuple[float, float]],
+    *,
+    sizes=None,
+    priority_mix: Optional[Dict[int, float]] = None,
+    seed: int = 0,
+    process: str = "poisson",
+    burst_factor: float = 8.0,
+    mean_burst_s: float = 0.05,
+) -> Schedule:
+    """Build an offered-load ramp: one (``rate_rps``, ``duration_s``) pair
+    per step, arrivals drawn by the chosen process, sizes by the mix
+    (default :class:`ZipfSizes`), priorities by ``priority_mix`` (priority →
+    probability, normalized; default all priority 0). One seeded rng drives
+    every draw, in schedule order — the whole build is deterministic."""
+    if not steps:
+        raise ValueError("need at least one (rate_rps, duration_s) step")
+    if process not in ("poisson", "bursty"):
+        raise ValueError(f"unknown process {process!r} (expected poisson|bursty)")
+    sizes = sizes if sizes is not None else ZipfSizes()
+    mix: List[Tuple[int, float]] = [(0, 1.0)]
+    if priority_mix:
+        total = sum(priority_mix.values())
+        if total <= 0.0:
+            raise ValueError("priority_mix weights must sum > 0")
+        mix = [(int(p), w / total) for p, w in sorted(priority_mix.items())]
+    rng = random.Random(seed)
+    entries: List[Arrival] = []
+    t0 = 0.0
+    for step_idx, (rate, duration_s) in enumerate(steps):
+        proc = (
+            PoissonArrivals(rate) if process == "poisson"
+            else BurstyArrivals(rate, burst_factor=burst_factor, mean_burst_s=mean_burst_s)
+        )
+        t = 0.0
+        for gap in proc.gaps(rng, duration_s):
+            t += gap
+            entries.append(
+                Arrival(t0 + t, sizes.draw(rng), _draw_priority(rng, mix), step_idx)
+            )
+        t0 += duration_s
+    meta = {
+        "seed": seed,
+        "process": process,
+        "steps": [[float(r), float(d)] for r, d in steps],
+        "mean_rows": round(sizes.mean_rows, 6),
+        "priority_mix": {str(p): w for p, w in mix},
+    }
+    return Schedule(entries, meta)

@@ -1,0 +1,355 @@
+"""The open-loop driver: fire a schedule at a serving target, record truth.
+
+``OpenLoopLoadGenerator.run(target)`` walks a :class:`Schedule` on its own
+clock — a request fires when its arrival time comes, **not** when the last
+response lands. The target only needs the ``InferenceServer`` submit
+contract: ``submit(df, timeout_ms=..., priority=...) -> handle`` where
+``handle.result()`` blocks for the response or raises a typed serving error.
+Submission is non-blocking by design (admission control is synchronous), so
+one driver thread holds the schedule on time while a small collector pool
+resolves outstanding handles.
+
+Accounting is exhaustive — every arrival ends in exactly one bin per step
+(:class:`StepStats`): completed (with latency), shed (controller
+priority-shed), rejected (hard queue bound), deadline misses split by the
+phase they died in (queued / dispatch), injected faults (the chaos bins:
+``loadgen.tick`` dropped the arrival, or ``serving.admit`` /
+``serving.dispatch`` failed it), other typed serving errors, and — the bin
+chaos suites assert is empty — ``unexpected`` untyped failures. Per load
+step the report carries p50/p99/p999 latency, time-to-first-shed, and
+per-priority breakdowns.
+
+Clocks are injectable (``clock``/``sleep``), so replay determinism is
+provable under a virtual clock with a deterministic target
+(tests/test_loadgen.py) — no wall-clock flake in the contract.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from flink_ml_tpu.faults import InjectedFault, faults
+from flink_ml_tpu.loadgen.arrivals import Schedule
+from flink_ml_tpu.serving.errors import (
+    ServingDeadlineError,
+    ServingError,
+    ServingOverloadedError,
+)
+
+__all__ = ["StepStats", "LoadReport", "OpenLoopLoadGenerator"]
+
+
+def _percentile(ordered: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not ordered:
+        return None
+    return ordered[min(int(q * len(ordered)), len(ordered) - 1)]
+
+
+class StepStats:
+    """Everything that happened during one load step. Counter updates are
+    lock-guarded — the driver and every collector write concurrently."""
+
+    __slots__ = (
+        "step", "offered_rps", "duration_s", "arrivals", "offered_rows",
+        "submitted", "completed", "shed", "rejected",
+        "deadline_miss_queued", "deadline_miss_dispatch", "injected",
+        "typed_errors", "unexpected", "latencies_ms",
+        "first_shed_at_s", "max_lag_s", "by_priority", "_lock",
+    )
+
+    def __init__(self, step: int, offered_rps: float, duration_s: float):
+        self.step = step
+        self.offered_rps = offered_rps
+        self.duration_s = duration_s
+        self.arrivals = 0
+        self.offered_rows = 0
+        self.submitted = 0
+        self.completed = 0
+        self.shed = 0  # controller priority-sheds (ServingOverloadedError.shed)
+        self.rejected = 0  # hard queue-bound rejections
+        self.deadline_miss_queued = 0
+        self.deadline_miss_dispatch = 0
+        self.injected = 0  # InjectedFault in any seam (tick/admit/dispatch)
+        self.typed_errors = 0  # other ServingError (closed, no model, ...)
+        self.unexpected: List[BaseException] = []  # MUST stay empty in chaos runs
+        self.latencies_ms: List[float] = []
+        self.first_shed_at_s: Optional[float] = None  # step-relative, shed OR reject
+        self.max_lag_s = 0.0  # worst driver lateness against the schedule
+        self.by_priority: Dict[int, Dict[str, int]] = {}
+        self._lock = threading.Lock()
+
+    # -- concurrent bumps -----------------------------------------------------
+    def _prio(self, priority: int) -> Dict[str, int]:
+        return self.by_priority.setdefault(
+            priority,
+            {"arrivals": 0, "completed": 0, "shed": 0, "rejected": 0, "deadline_miss": 0},
+        )
+
+    def note_arrival(self, priority: int, rows: int) -> None:
+        with self._lock:
+            self.arrivals += 1
+            self.offered_rows += rows
+            self._prio(priority)["arrivals"] += 1
+
+    def note_submitted(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def note_completed(self, priority: int, latency_ms: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self.latencies_ms.append(latency_ms)
+            self._prio(priority)["completed"] += 1
+
+    def note_overload(self, priority: int, err: ServingOverloadedError, at_s: float) -> None:
+        with self._lock:
+            if err.shed:
+                self.shed += 1
+                self._prio(priority)["shed"] += 1
+            else:
+                self.rejected += 1
+                self._prio(priority)["rejected"] += 1
+            if self.first_shed_at_s is None:
+                self.first_shed_at_s = at_s
+
+    def note_deadline(self, priority: int, err: ServingDeadlineError) -> None:
+        with self._lock:
+            if getattr(err, "phase", "queued") == "dispatch":
+                self.deadline_miss_dispatch += 1
+            else:
+                self.deadline_miss_queued += 1
+            self._prio(priority)["deadline_miss"] += 1
+
+    def note_injected(self) -> None:
+        with self._lock:
+            self.injected += 1
+
+    def note_typed_error(self) -> None:
+        with self._lock:
+            self.typed_errors += 1
+
+    def note_unexpected(self, err: BaseException) -> None:
+        with self._lock:
+            self.unexpected.append(err)
+
+    def note_lag(self, lag_s: float) -> None:
+        with self._lock:
+            if lag_s > self.max_lag_s:
+                self.max_lag_s = lag_s
+
+    # -- reading --------------------------------------------------------------
+    @property
+    def deadline_misses(self) -> int:
+        return self.deadline_miss_queued + self.deadline_miss_dispatch
+
+    @property
+    def resolved(self) -> int:
+        """Arrivals accounted for — completion, typed rejection, miss, or
+        injected fault. Equal to ``arrivals`` once the run is drained (the
+        no-deadlock invariant)."""
+        return (
+            self.completed + self.shed + self.rejected + self.deadline_misses
+            + self.injected + self.typed_errors + len(self.unexpected)
+        )
+
+    def latency_ms(self, q: float) -> Optional[float]:
+        with self._lock:
+            ordered = sorted(self.latencies_ms)
+        return _percentile(ordered, q)
+
+    def as_dict(self) -> Dict:
+        with self._lock:
+            ordered = sorted(self.latencies_ms)
+            return {
+                "step": self.step,
+                "offered_rps": self.offered_rps,
+                "duration_s": self.duration_s,
+                "arrivals": self.arrivals,
+                "offered_rows": self.offered_rows,
+                "completed": self.completed,
+                "shed": self.shed,
+                "rejected": self.rejected,
+                "deadline_miss_queued": self.deadline_miss_queued,
+                "deadline_miss_dispatch": self.deadline_miss_dispatch,
+                "injected": self.injected,
+                "typed_errors": self.typed_errors,
+                "unexpected": len(self.unexpected),
+                "latency_p50_ms": _percentile(ordered, 0.5),
+                "latency_p99_ms": _percentile(ordered, 0.99),
+                "latency_p999_ms": _percentile(ordered, 0.999),
+                "time_to_first_shed_s": self.first_shed_at_s,
+                "max_lag_s": round(self.max_lag_s, 6),
+                "by_priority": {str(p): dict(v) for p, v in sorted(self.by_priority.items())},
+            }
+
+
+class LoadReport:
+    """One run's verdict: per-step stats plus whole-run invariant helpers."""
+
+    def __init__(self, steps: List[StepStats], wall_s: float):
+        self.steps = steps
+        self.wall_s = wall_s
+
+    def step(self, idx: int) -> StepStats:
+        return self.steps[idx]
+
+    @property
+    def total_arrivals(self) -> int:
+        return sum(s.arrivals for s in self.steps)
+
+    @property
+    def total_resolved(self) -> int:
+        return sum(s.resolved for s in self.steps)
+
+    @property
+    def unexpected(self) -> List[BaseException]:
+        return [e for s in self.steps for e in s.unexpected]
+
+    def fully_resolved(self) -> bool:
+        """Every arrival ended in exactly one bin — the no-deadlock,
+        nothing-lost invariant chaos runs assert."""
+        return self.total_resolved == self.total_arrivals
+
+    def as_dict(self) -> Dict:
+        return {
+            "wall_s": round(self.wall_s, 6),
+            "arrivals": self.total_arrivals,
+            "resolved": self.total_resolved,
+            "steps": [s.as_dict() for s in self.steps],
+        }
+
+    def __repr__(self) -> str:
+        return f"LoadReport(steps={len(self.steps)}, arrivals={self.total_arrivals}, wall_s={self.wall_s:.3f})"
+
+
+#: Collector-queue sentinel — posted once per collector at drain time.
+_DONE = object()
+
+
+class OpenLoopLoadGenerator:
+    """Drive a :class:`Schedule` at a serving target, open-loop.
+
+    ``request_factory(rows) -> DataFrame`` builds each request's payload;
+    ``timeout_ms`` is either a number (every request) or a mapping
+    ``priority -> ms`` (per-SLO deadlines — tight for best-effort, generous
+    for guaranteed traffic). ``clock``/``sleep`` default to the wall clock
+    and are injectable for virtual-time replay.
+    """
+
+    def __init__(
+        self,
+        schedule: Schedule,
+        request_factory: Callable[[int], object],
+        *,
+        timeout_ms=10_000.0,
+        collectors: int = 8,
+        clock: Callable[[], float] = time.perf_counter,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.schedule = schedule
+        self.request_factory = request_factory
+        self._timeout_ms = timeout_ms
+        self.collectors = max(1, int(collectors))
+        self._clock = clock
+        self._sleep = sleep
+
+    def timeout_ms_for(self, priority: int) -> float:
+        if isinstance(self._timeout_ms, dict):
+            if priority in self._timeout_ms:
+                return float(self._timeout_ms[priority])
+            return float(max(self._timeout_ms.values()))
+        return float(self._timeout_ms)
+
+    def _steps_from_schedule(self) -> List[StepStats]:
+        meta_steps = self.schedule.meta.get("steps") or []
+        stats: List[StepStats] = []
+        for idx in range(max(self.schedule.n_steps, len(meta_steps))):
+            rate, duration = (
+                meta_steps[idx] if idx < len(meta_steps) else (0.0, 0.0)
+            )
+            stats.append(StepStats(idx, float(rate), float(duration)))
+        return stats
+
+    def run(self, target) -> LoadReport:
+        """Fire the whole schedule; block until every outstanding handle is
+        resolved; return the per-step report."""
+        steps = self._steps_from_schedule()
+        if not steps:
+            return LoadReport([], 0.0)
+        pending: "queue.Queue" = queue.Queue()
+
+        def collect() -> None:
+            while True:
+                item = pending.get()
+                if item is _DONE:
+                    return
+                arrival, handle = item
+                stats = steps[arrival.step]
+                try:
+                    response = handle.result()
+                except ServingDeadlineError as e:
+                    stats.note_deadline(arrival.priority, e)
+                except InjectedFault:
+                    stats.note_injected()
+                except ServingError:
+                    stats.note_typed_error()
+                except BaseException as e:  # noqa: BLE001 — the chaos bin
+                    stats.note_unexpected(e)
+                else:
+                    stats.note_completed(arrival.priority, response.latency_ms)
+
+        threads = [
+            threading.Thread(target=collect, name=f"loadgen-collector-{i}", daemon=True)
+            for i in range(self.collectors)
+        ]
+        for t in threads:
+            t.start()
+
+        t_start = self._clock()
+        step_starts: Dict[int, float] = {}
+        for i, arrival in enumerate(self.schedule):
+            due = t_start + arrival.t
+            now = self._clock()
+            if due > now:
+                self._sleep(due - now)
+            else:
+                steps[arrival.step].note_lag(now - due)
+            step_starts.setdefault(arrival.step, arrival.t)
+            stats = steps[arrival.step]
+            stats.note_arrival(arrival.priority, arrival.rows)
+            step_rel_s = arrival.t - step_starts[arrival.step]
+            try:
+                # The harness's own chaos seam: an armed tick fault drops
+                # THIS arrival (recorded as injected) and the schedule
+                # stays on time — the rig survives its own faults.
+                faults.trip("loadgen.tick", arrival=i, step=arrival.step)
+            except InjectedFault:
+                stats.note_injected()
+                continue
+            df = self.request_factory(arrival.rows)
+            try:
+                handle = target.submit(
+                    df,
+                    timeout_ms=self.timeout_ms_for(arrival.priority),
+                    priority=arrival.priority,
+                )
+            except ServingOverloadedError as e:
+                stats.note_overload(arrival.priority, e, step_rel_s)
+            except InjectedFault:
+                stats.note_injected()
+            except ServingError:
+                stats.note_typed_error()
+            except BaseException as e:  # noqa: BLE001 — the chaos bin
+                stats.note_unexpected(e)
+            else:
+                stats.note_submitted()
+                pending.put((arrival, handle))
+
+        for _ in threads:
+            pending.put(_DONE)
+        for t in threads:
+            t.join()
+        return LoadReport(steps, self._clock() - t_start)

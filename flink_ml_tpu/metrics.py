@@ -66,6 +66,15 @@ class MLMetrics:
     SERVING_WARMUP_COMPILE_MS = "ml.serving.fastpath.warmup.compile.ms"  # AOT warmup wall time, gauge
     SERVING_INFLIGHT_DEPTH = "ml.serving.inflight.depth"  # dispatched-not-finalized batches, gauge
 
+    # SLO-adaptive controller (serving/controller.py — docs/serving.md
+    # "Load shedding & adaptive control").
+    SERVING_SHED = "ml.serving.shed"  # priority sheds under sustained overload, counter
+    SERVING_DEADLINE_DISPATCH = "ml.serving.deadline.dispatch"  # expired-in-window fail-fasts before dispatch, counter
+    SERVING_CONTROLLER_DEPTH = "ml.serving.controller.depth"  # live pipeline-depth setting, gauge
+    SERVING_CONTROLLER_ACTIONS = "ml.serving.controller.actions"  # controller actions fired, counter
+    SERVING_CONTROLLER_DOWNSHIFTS = "ml.serving.controller.downshifts"  # deadline-aware bucket caps applied, counter
+    SERVING_CONTROLLER_MESH_RECOMMEND = "ml.serving.controller.mesh.recommend"  # next mesh width on the ladder, gauge
+
     # Mesh-sharded serving (serving.mesh > 1 — docs/serving.md).
     SERVING_SHARD_COUNT = "ml.serving.shard.count"  # data-axis width of the plan's mesh, gauge
     SERVING_SHARD_MODEL_AXIS = "ml.serving.shard.model.axis"  # tensor-parallel width, gauge

@@ -10,6 +10,7 @@ Runtime-free like the servable tier it wraps: importing this package never
 pulls the training stack (enforced by tools/check_servable_imports.py).
 """
 from flink_ml_tpu.serving.batcher import MicroBatcher, bucket_for, pad_to, power_of_two_buckets
+from flink_ml_tpu.serving.controller import AdaptiveController, ControllerAction, GoodputLedger
 from flink_ml_tpu.serving.plan import CompiledServingPlan, PlanExecution
 from flink_ml_tpu.serving.errors import (
     NoModelError,
@@ -26,6 +27,9 @@ __all__ = [
     "ServingConfig",
     "ServingResponse",
     "MicroBatcher",
+    "AdaptiveController",
+    "ControllerAction",
+    "GoodputLedger",
     "CompiledServingPlan",
     "PlanExecution",
     "ModelRegistry",

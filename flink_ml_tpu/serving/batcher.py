@@ -30,6 +30,18 @@ blocking readback), the loop keeps up to ``pipeline_depth`` batches in flight â€
 JAX async dispatch runs batch N on the device while this thread claims, pads
 and scatters batch N+1 on the host, instead of blocking on every result.
 Claimed requests still complete exactly once and in FIFO order.
+
+Adaptive control (serving/controller.py, docs/serving.md "Load shedding &
+adaptive control"): with an :class:`AdaptiveController` attached, every
+request carries a ``priority`` (0 = most important); sustained overload
+sheds sheddable priorities at admission *before* the hard queue bound,
+claims are capped to the largest bucket the head request's remaining
+deadline can afford, deadlines are re-checked immediately before dispatch
+(an expired request fails fast instead of burning a device slot), and the
+dispatch window steps along the depth ladder when the live goodput ledger
+says queueing dominates. Chaos seams: ``serving.admit`` (the queue door)
+and ``serving.dispatch`` (post-pad, pre-device) are registered fault
+points.
 """
 from __future__ import annotations
 
@@ -41,6 +53,7 @@ from typing import Callable, Deque, List, Optional, Sequence, Tuple
 import numpy as np
 
 from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.faults import faults
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.serving.errors import (
     ServingClosedError,
@@ -102,15 +115,18 @@ class PendingRequest:
     batcher-side state machine."""
 
     __slots__ = (
-        "df", "rows", "enqueued_at", "deadline",
+        "df", "rows", "enqueued_at", "deadline", "priority",
         "_event", "_state", "response", "error", "_abandon_cb", "trace",
     )
 
-    def __init__(self, df: DataFrame, deadline: float):
+    def __init__(self, df: DataFrame, deadline: float, priority: int = 0):
         self.df = df
         self.rows = len(df)
         self.enqueued_at = time.perf_counter()
         self.deadline = deadline
+        #: 0 = most important (the default). The adaptive controller sheds
+        #: priorities >= ``serving.shed.priority`` under sustained overload.
+        self.priority = priority
         self._event = threading.Event()
         self._state = _PENDING
         self.response = None
@@ -140,8 +156,9 @@ class PendingRequest:
             # claim/abandon race has a single arbiter.
             if self._abandon_cb():  # set by the batcher at submit
                 raise ServingDeadlineError(
-                    f"request not served within its deadline "
-                    f"(queued {time.perf_counter() - self.enqueued_at:.3f}s)"
+                    "request not served within its deadline",
+                    phase="queued",
+                    queued_ms=(time.perf_counter() - self.enqueued_at) * 1000.0,
                 )
             # Lost the race: a batch claimed us concurrently â€” it will
             # complete promptly; loop and wait for the event.
@@ -170,8 +187,14 @@ class MicroBatcher:
         pipeline_depth: int = 1,
         buckets: Optional[Sequence[int]] = None,
         shards: int = 1,
+        controller=None,
     ):
         self._execute = execute
+        # SLO-adaptive controller (serving/controller.py) or None: priority
+        # shedding at admission, deadline-aware bucket caps at claim, depth
+        # stepping from the live goodput ledger. Every hook below is gated on
+        # it so controller-off behavior is byte-for-byte the classic path.
+        self._controller = controller
         # Async seam: dispatch(padded_df) -> handle with .result() -> (df,
         # version), or None to serve this batch through the sync ``execute``.
         self._dispatch = dispatch
@@ -203,7 +226,7 @@ class MicroBatcher:
         self._thread.start()
 
     # -- client side ----------------------------------------------------------
-    def submit(self, df: DataFrame, timeout_s: float) -> PendingRequest:
+    def submit(self, df: DataFrame, timeout_s: float, priority: int = 0) -> PendingRequest:
         rows = len(df)
         if rows == 0:
             raise ValueError("cannot serve an empty request")
@@ -212,6 +235,10 @@ class MicroBatcher:
                 f"request of {rows} rows exceeds max_batch_size={self.max_batch_size}; "
                 "split it or raise serving.max.batch.size"
             )
+        # Admission seam: an armed fault fails the request synchronously at
+        # the queue door â€” before any queue state is touched, so nothing is
+        # half-admitted (chaos suites arm this under live load).
+        faults.trip("serving.admit", rows=rows, priority=priority)
         # Root span begins BEFORE the request object so its interval covers
         # enqueued_at â€” every child (queue wait included) nests inside it.
         req_span = None
@@ -219,20 +246,51 @@ class MicroBatcher:
             req_span = tracer.begin("serving.request", CAT_PRODUCTIVE, scope=self.scope)
             if req_span is not None:
                 req_span.set_attr("rows", rows)
-        req = PendingRequest(df, deadline=time.perf_counter() + timeout_s)
+        req = PendingRequest(df, deadline=time.perf_counter() + timeout_s, priority=priority)
         req.trace = req_span
-        with self._cond:
-            if self._closed or self._draining:
-                raise ServingClosedError("server is shut down; request rejected")
-            if self._queued_rows + rows > self.queue_capacity_rows:
-                metrics.counter(self.scope, MLMetrics.SERVING_REJECTED)
-                raise ServingOverloadedError(self._queued_rows, self.queue_capacity_rows)
-            self._install_abandon(req)
-            self._queue.append(req)
-            self._queued_rows += rows
-            metrics.counter(self.scope, MLMetrics.SERVING_REQUESTS)
-            metrics.gauge(self.scope, MLMetrics.SERVING_QUEUE_DEPTH, self._queued_rows)
-            self._cond.notify_all()
+        try:
+            with self._cond:
+                if self._closed or self._draining:
+                    raise ServingClosedError("server is shut down; request rejected")
+                controller = self._controller
+                if controller is not None:
+                    # Shed BEFORE the hard bound: sustained occupancy above
+                    # the watermark drops sheddable priorities with backoff
+                    # context while high-priority traffic still admits.
+                    controller.note_queue(self._queued_rows + rows)
+                    if controller.should_shed(priority, self._queued_rows + rows):
+                        controller.record_shed(priority, self._queued_rows)
+                        raise ServingOverloadedError(
+                            self._queued_rows,
+                            self.queue_capacity_rows,
+                            retry_after_ms=controller.retry_after_ms(self._queued_rows),
+                            shed=True,
+                            priority=priority,
+                        )
+                if self._queued_rows + rows > self.queue_capacity_rows:
+                    metrics.counter(self.scope, MLMetrics.SERVING_REJECTED)
+                    raise ServingOverloadedError(
+                        self._queued_rows,
+                        self.queue_capacity_rows,
+                        retry_after_ms=(
+                            controller.retry_after_ms(self._queued_rows)
+                            if controller is not None
+                            else None
+                        ),
+                    )
+                self._install_abandon(req)
+                self._queue.append(req)
+                self._queued_rows += rows
+                metrics.counter(self.scope, MLMetrics.SERVING_REQUESTS)
+                metrics.gauge(self.scope, MLMetrics.SERVING_QUEUE_DEPTH, self._queued_rows)
+                self._cond.notify_all()
+        except BaseException as e:
+            # A rejected request's root span still records (with the error
+            # attr) instead of leaking unfinished.
+            if req_span is not None:
+                req_span.set_attr("error", type(e).__name__)
+                tracer.end(req_span)
+            raise
         return req
 
     def _install_abandon(self, req: PendingRequest) -> None:
@@ -278,15 +336,37 @@ class MicroBatcher:
             claimed: List[PendingRequest] = []
             rows = 0
             i = 0
+            controller = self._controller
+            cap_rows = self.max_batch_size
+            if controller is not None and self._queue:
+                # Deadline-aware bucket downshift: cap the claim to the
+                # largest bucket the head request's remaining deadline can
+                # afford (never below the head itself â€” a too-late request
+                # is the dispatch re-check's problem, not a starvation one).
+                head = self._queue[0]
+                cap = controller.bucket_cap(
+                    head.deadline - time.perf_counter(), self.buckets
+                )
+                if cap is not None and cap < cap_rows:
+                    cap_rows = max(cap, head.rows)
+            downshifted = False
             while i < len(self._queue):
                 req = self._queue[i]
-                if rows + req.rows > self.max_batch_size:
+                if rows + req.rows > cap_rows:
+                    downshifted = cap_rows < self.max_batch_size
                     break
                 self._queue.pop(i)
                 self._queued_rows -= req.rows
                 req._state = _CLAIMED
                 claimed.append(req)
                 rows += req.rows
+            if downshifted and claimed:
+                controller.record_downshift(bucket_for(rows, self.buckets))
+            if controller is not None:
+                controller.note_queue(self._queued_rows)
+                claim_t = time.perf_counter()
+                for req in claimed:
+                    controller.observe_queue_wait(claim_t - req.enqueued_at)
             metrics.gauge(self.scope, MLMetrics.SERVING_QUEUE_DEPTH, self._queued_rows)
             return claimed if claimed else []
 
@@ -301,10 +381,19 @@ class MicroBatcher:
             if req.deadline <= now:
                 req._state = _TIMED_OUT
                 req.error = ServingDeadlineError(
-                    f"request expired in queue after {now - req.enqueued_at:.3f}s"
+                    "request expired in queue",
+                    phase="queued",
+                    queued_ms=(now - req.enqueued_at) * 1000.0,
+                    retry_after_ms=(
+                        self._controller.retry_after_ms(self._queued_rows)
+                        if self._controller is not None
+                        else None
+                    ),
                 )
                 self._queued_rows -= req.rows
                 metrics.counter(self.scope, MLMetrics.SERVING_TIMEOUTS)
+                if self._controller is not None:
+                    self._controller.observe_queue_wait(now - req.enqueued_at)
                 req._event.set()
                 continue
             kept.append(req)
@@ -389,17 +478,66 @@ class MicroBatcher:
                 req.trace.set_attr("batch", batch_span.span_id)
         return batch_span
 
+    def _fail_expired_before_dispatch(
+        self, claimed: List[PendingRequest]
+    ) -> List[PendingRequest]:
+        """The deadline re-check immediately before dispatch: a request that
+        expired in the pad/scatter window (claimed during a congested
+        coalescing wait, or stuck behind a deep in-flight window) fails fast
+        with the typed error instead of burning a device slot on rows nobody
+        is waiting for. Returns the still-live requests."""
+        now = time.perf_counter()
+        if all(req.deadline > now for req in claimed):
+            return claimed
+        live: List[PendingRequest] = []
+        for req in claimed:
+            if req.deadline > now:
+                live.append(req)
+                continue
+            req.error = ServingDeadlineError(
+                "request expired before dispatch",
+                phase="dispatch",
+                queued_ms=(now - req.enqueued_at) * 1000.0,
+                retry_after_ms=(
+                    self._controller.retry_after_ms(self._queued_rows)
+                    if self._controller is not None
+                    else None
+                ),
+            )
+            req._state = _DONE
+            metrics.counter(self.scope, MLMetrics.SERVING_TIMEOUTS)
+            metrics.counter(self.scope, MLMetrics.SERVING_DEADLINE_DISPATCH)
+            if self._controller is not None:
+                self._controller.observe_queue_wait(now - req.enqueued_at)
+            req._event.set()
+            if req.trace is not None:
+                req.trace.set_attr("error", "ServingDeadlineError")
+                tracer.end(req.trace)
+        return live
+
     def _run_batch(self, claimed: List[PendingRequest]) -> Optional[Tuple]:
         """Pad and launch one batch. Returns an in-flight record
-        ``(claimed, rows, bucket, handle, batch_span)`` when the batch was
-        dispatched asynchronously, or None when it was served (or failed)
-        synchronously."""
+        ``(claimed, rows, bucket, handle, dispatched_at, batch_span)`` when
+        the batch was dispatched asynchronously, or None when it was served
+        (or failed) synchronously."""
+        claimed = self._fail_expired_before_dispatch(claimed)
+        if not claimed:
+            return None
         rows = sum(r.rows for r in claimed)
         bucket = bucket_for(rows, self.buckets)
         batch_span = self._begin_batch_span(claimed, rows, bucket) if tracer.enabled else None
         with tracer.span("serving.pad", CAT_PADDING, scope=self.scope, parent=batch_span):
             batch = claimed[0].df if len(claimed) == 1 else DataFrame.concat([r.df for r in claimed])
             padded = pad_to(batch, bucket)
+        try:
+            # Dispatch seam: an armed fault kills the batch after padding but
+            # before any device work; every claimed waiter gets the typed
+            # fault and the loop goes on to the next batch.
+            faults.trip("serving.dispatch", rows=rows, bucket=bucket)
+        except BaseException as e:  # noqa: BLE001 â€” delivered to each waiter
+            self._deliver_error(claimed, e, batch_span)
+            return None
+        t0 = time.perf_counter() if self._controller is not None else 0.0
         if self._dispatch is not None:
             try:
                 with tracer.span("serving.dispatch", CAT_PRODUCTIVE, scope=self.scope, parent=batch_span) as sp:
@@ -413,7 +551,7 @@ class MicroBatcher:
                 self._deliver_error(claimed, e, batch_span)
                 return None
             if handle is not None:
-                return (claimed, rows, bucket, handle, batch_span)
+                return (claimed, rows, bucket, handle, t0, batch_span)
         try:
             with tracer.span("serving.exec", CAT_PRODUCTIVE, scope=self.scope, parent=batch_span) as sp:
                 sp.set_attr("rows", rows)
@@ -425,11 +563,13 @@ class MicroBatcher:
         except BaseException as e:  # noqa: BLE001 â€” delivered to each waiter
             self._deliver_error(claimed, e, batch_span)
             return None
+        if self._controller is not None:
+            self._controller.observe_batch(rows, bucket, time.perf_counter() - t0)
         self._deliver(claimed, out, version, rows, bucket, batch_span)
         return None
 
     def _finalize_inflight(self, record: Tuple) -> None:
-        claimed, rows, bucket, handle, batch_span = record
+        claimed, rows, bucket, handle, dispatched_at, batch_span = record
         try:
             with tracer.span("serving.readback", CAT_READBACK, scope=self.scope, parent=batch_span) as sp:
                 sp.set_attr("rows", rows)
@@ -440,6 +580,10 @@ class MicroBatcher:
         except BaseException as e:  # noqa: BLE001 â€” delivered to each waiter
             self._deliver_error(claimed, e, batch_span)
             return
+        if self._controller is not None:
+            self._controller.observe_batch(
+                rows, bucket, time.perf_counter() - dispatched_at
+            )
         self._deliver(claimed, out, version, rows, bucket, batch_span)
 
     def _loop(self) -> None:  # graftcheck: hot-root
@@ -460,6 +604,14 @@ class MicroBatcher:
                 if record is not None:
                     inflight.append(record)
                     gauge_depth()
+                if self._controller is not None:
+                    # Depth stepping from the live goodput ledger: widen the
+                    # dispatch window while queueing dominates, narrow it
+                    # back when it subsides. Applied here, between batches,
+                    # so a step never tears an in-flight record.
+                    action = self._controller.maybe_step(self.pipeline_depth)
+                    if action is not None and action.kind == "depth":
+                        self.pipeline_depth = action.value
                 # Keep at most pipeline_depth batches outstanding; finalizing
                 # here (not before dispatch) is what overlaps batch N's device
                 # time with batch N+1's host-side claim/pad/dispatch.

@@ -1,0 +1,382 @@
+"""SLO-adaptive serving control: act on the live goodput signal.
+
+graftscope (flink_ml_tpu/trace.py) attributes every traced millisecond to
+productive/queue/padding/compile/swap/recovery/readback — but tracing is an
+*observer*. This module closes the loop: the :class:`AdaptiveController`
+keeps its own always-on, windowed :class:`GoodputLedger` (the same category
+vocabulary, fed by the micro-batcher with a handful of clock reads per
+batch — no spans, no ring, works with tracing off) and uses it plus the
+live queue depth to act *before* the bounded queue turns overload into
+indiscriminate hard rejections:
+
+1. **Priority shedding** — every request carries an integer ``priority``
+   (0 = most important, the default). When queue occupancy stays above
+   ``serving.shed.watermark`` for ``serving.shed.sustain.ms``, requests
+   with ``priority >= serving.shed.priority`` are shed at admission with a
+   typed ``ServingOverloadedError(shed=True, retry_after_ms=...)``. The
+   watermark is below 1.0 by design: sheddable traffic drains first, so
+   the hard queue bound — which rejects *everyone* — is the last resort,
+   and high-priority deadlines survive overload that would otherwise
+   collapse the queue (the ML Productivity Goodput argument: goodput under
+   offered load, not idle latency, is the fleet metric).
+
+2. **Deadline-aware bucket downshift** — the controller EWMAs per-bucket
+   batch service time; when the head request's remaining deadline cannot
+   afford the large-bucket pipeline (``est(bucket) x serving.deadline.safety``),
+   the claim is capped to the largest bucket that still fits, trading
+   batching efficiency for meeting the deadline at all.
+
+3. **Pipeline-depth stepping** — when the queue category dominates the
+   ledger (share >= ``serving.controller.queue.fraction``), the batcher's
+   dispatch window steps up along [configured depth,
+   ``serving.controller.depth.max``]; it steps back down when queueing
+   subsides. At the depth ceiling with queueing still dominant the
+   controller *recommends* the next mesh width on the PR 9 ladder
+   (``ml.serving.controller.mesh.recommend`` — mesh rebuilds are a swap-time
+   operation, not a hot-path one, so the recommendation is surfaced for the
+   deployment layer rather than applied mid-flight; docs/serving.md).
+
+Every controller method called from the serving hot path is pure arithmetic
+under a short private lock — no I/O, no sleeps, no device work
+(graftcheck's blocking-under-lock rule covers serving/).
+
+The ledger is a *control signal*, not an audit: pipelined batches overlap,
+so its per-category sums are approximate where graftscope's self-time
+attribution is exact. Chaos runs therefore assert the exact invariant on
+graftscope's report and drive the controller from this one.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.trace import (
+    CAT_PADDING,
+    CAT_PRODUCTIVE,
+    CAT_QUEUE,
+    GoodputReport,
+)
+
+__all__ = ["GoodputLedger", "ControllerAction", "AdaptiveController"]
+
+
+class GoodputLedger:
+    """Windowed per-category seconds — the live, tracing-independent goodput
+    signal. ``add`` appends an (at, category, seconds) event; totals are sums
+    over the trailing window. Thread-safe; every operation is O(evicted)."""
+
+    def __init__(self, window_s: float = 2.0, clock: Callable[[], float] = time.perf_counter):
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._events: Deque[Tuple[float, str, float]] = deque()
+        self._totals: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def _evict_locked(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._events and self._events[0][0] < horizon:
+            _, category, seconds = self._events.popleft()
+            remaining = self._totals.get(category, 0.0) - seconds
+            if remaining <= 1e-12:
+                self._totals.pop(category, None)
+            else:
+                self._totals[category] = remaining
+
+    def add(self, category: str, seconds: float) -> None:
+        if seconds <= 0.0:
+            return
+        now = self._clock()
+        with self._lock:
+            self._events.append((now, category, seconds))
+            self._totals[category] = self._totals.get(category, 0.0) + seconds
+            self._evict_locked(now)
+
+    def totals(self) -> Dict[str, float]:
+        """Current per-category seconds over the trailing window."""
+        with self._lock:
+            self._evict_locked(self._clock())
+            return dict(self._totals)
+
+    def share(self, category: str) -> Optional[float]:
+        """``category`` seconds / all attributed seconds in the window, or
+        None while the window is empty."""
+        totals = self.totals()
+        denom = sum(totals.values())
+        if denom <= 0.0:
+            return None
+        return totals.get(category, 0.0) / denom
+
+    def report(self, scope: str) -> GoodputReport:
+        """The window as a :class:`GoodputReport` (publishable to the
+        ``ml.goodput.*`` gauges like a span-derived report)."""
+        return GoodputReport({scope: self.totals()})
+
+
+class ControllerAction:
+    """One control decision, for introspection and tests: what fired, the
+    new value, and the ledger evidence it fired on."""
+
+    __slots__ = ("kind", "value", "reason", "at")
+
+    def __init__(self, kind: str, value, reason: str, at: float):
+        self.kind = kind  # "shed" | "bucket" | "depth" | "mesh.recommend"
+        self.value = value
+        self.reason = reason
+        self.at = at
+
+    def __repr__(self) -> str:
+        return f"ControllerAction({self.kind!r}, value={self.value!r}, reason={self.reason!r})"
+
+
+#: EWMA smoothing for per-bucket service time and drain rate.
+_EWMA_ALPHA = 0.25
+#: Bound on the retry-after estimate handed to clients (ms).
+_RETRY_AFTER_CAP_MS = 10_000.0
+#: Bound on the remembered action history.
+_MAX_ACTIONS = 256
+
+
+class AdaptiveController:
+    """The serving control loop (one instance per :class:`InferenceServer`).
+
+    The micro-batcher feeds it (``note_queue`` / ``observe_queue_wait`` /
+    ``observe_batch``) and consults it (``should_shed`` / ``bucket_cap`` /
+    ``maybe_step``); all knobs resolve through the config tier
+    (``serving.shed.*`` / ``serving.controller.*`` / ``serving.deadline.safety``)
+    with per-server overrides via the keyword arguments.
+    """
+
+    def __init__(
+        self,
+        scope: str,
+        capacity_rows: int,
+        max_batch_size: int,
+        *,
+        base_depth: int = 1,
+        mesh: int = 1,
+        shed_watermark: Optional[float] = None,
+        shed_sustain_ms: Optional[float] = None,
+        shed_priority: Optional[int] = None,
+        window_ms: Optional[float] = None,
+        queue_fraction: Optional[float] = None,
+        depth_max: Optional[int] = None,
+        deadline_safety: Optional[float] = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        self.scope = scope
+        self.capacity_rows = int(capacity_rows)
+        self.max_batch_size = int(max_batch_size)
+        self.base_depth = max(1, int(base_depth))
+        self.mesh = max(1, int(mesh))
+        self.shed_watermark = float(
+            shed_watermark if shed_watermark is not None
+            else config.get(Options.SERVING_SHED_WATERMARK)
+        )
+        self.shed_sustain_s = float(
+            shed_sustain_ms if shed_sustain_ms is not None
+            else config.get(Options.SERVING_SHED_SUSTAIN_MS)
+        ) / 1000.0
+        self.shed_priority = int(
+            shed_priority if shed_priority is not None
+            else config.get(Options.SERVING_SHED_PRIORITY)
+        )
+        window_s = float(
+            window_ms if window_ms is not None
+            else config.get(Options.SERVING_CONTROLLER_WINDOW_MS)
+        ) / 1000.0
+        self.queue_fraction = float(
+            queue_fraction if queue_fraction is not None
+            else config.get(Options.SERVING_CONTROLLER_QUEUE_FRACTION)
+        )
+        self.depth_max = max(self.base_depth, int(
+            depth_max if depth_max is not None
+            else config.get(Options.SERVING_CONTROLLER_DEPTH_MAX)
+        ))
+        self.deadline_safety = float(
+            deadline_safety if deadline_safety is not None
+            else config.get(Options.SERVING_DEADLINE_SAFETY)
+        )
+        self._clock = clock
+        self.ledger = GoodputLedger(window_s, clock)
+        self._lock = threading.Lock()
+        self._over_since: Optional[float] = None
+        self._shedding = False  # inside a shedding episode (action dedup)
+        self._service_ewma_s: Dict[int, float] = {}  # bucket -> batch seconds
+        self._drain_rows_per_s: Optional[float] = None
+        self._last_step_at: Optional[float] = None
+        self._step_cooldown_s = max(0.05, window_s / 4.0)
+        self.actions: List[ControllerAction] = []
+
+    # -- bookkeeping fed by the batcher ---------------------------------------
+    def note_queue(self, queued_rows: int) -> None:
+        """Track sustained overload: called on every admission attempt and
+        every claim with the current queued-row count."""
+        over = queued_rows >= self.shed_watermark * self.capacity_rows
+        with self._lock:
+            if over:
+                if self._over_since is None:
+                    self._over_since = self._clock()
+            else:
+                self._over_since = None
+                self._shedding = False  # the episode is over
+
+    def observe_queue_wait(self, seconds: float) -> None:
+        """One request's admitted→claimed (or admitted→expired) wait."""
+        self.ledger.add(CAT_QUEUE, seconds)
+
+    def observe_batch(self, rows: int, bucket: int, seconds: float) -> None:
+        """One executed batch: ``seconds`` of dispatch→result wall, split
+        between productive and padding in the pad-row proportion, plus the
+        per-bucket service EWMA and the drain-rate estimate."""
+        if seconds <= 0.0 or bucket <= 0:
+            return
+        pad_share = max(0.0, (bucket - rows) / bucket) if rows < bucket else 0.0
+        self.ledger.add(CAT_PRODUCTIVE, seconds * (1.0 - pad_share))
+        if pad_share > 0.0:
+            self.ledger.add(CAT_PADDING, seconds * pad_share)
+        with self._lock:
+            prev = self._service_ewma_s.get(bucket)
+            self._service_ewma_s[bucket] = (
+                seconds if prev is None
+                else prev + _EWMA_ALPHA * (seconds - prev)
+            )
+            rate = rows / seconds
+            prev_rate = self._drain_rows_per_s
+            self._drain_rows_per_s = (
+                rate if prev_rate is None
+                else prev_rate + _EWMA_ALPHA * (rate - prev_rate)
+            )
+
+    # -- admission ------------------------------------------------------------
+    def retry_after_ms(self, queued_rows: int) -> Optional[float]:
+        """Drain estimate for a rejected/shed request: queued rows over the
+        EWMA drain rate, capped. None before any batch has been observed."""
+        with self._lock:
+            rate = self._drain_rows_per_s
+        if not rate or rate <= 0.0:
+            return None
+        return min(_RETRY_AFTER_CAP_MS, 1000.0 * max(queued_rows, 1) / rate)
+
+    def should_shed(self, priority: int, queued_rows: int) -> bool:
+        """Shed this request? True only for sheddable priorities under
+        overload sustained past the configured hold-down."""
+        if priority < self.shed_priority:
+            return False
+        with self._lock:
+            over_since = self._over_since
+        if over_since is None:
+            return False
+        return (self._clock() - over_since) >= self.shed_sustain_s
+
+    def record_shed(self, priority: int, queued_rows: int) -> None:
+        metrics.counter(self.scope, MLMetrics.SERVING_SHED)
+        # One ACTION per shedding episode (every shed still counts in the
+        # metric) — a sustained-overload window sheds thousands of requests
+        # and must not flush the bounded action history.
+        with self._lock:
+            first = not self._shedding
+            self._shedding = True
+        if first:
+            self._record_action(
+                "shed", priority, f"queue {queued_rows}/{self.capacity_rows} sustained"
+            )
+
+    # -- deadline-aware bucket selection --------------------------------------
+    def estimated_service_s(self, bucket: int) -> Optional[float]:
+        """EWMA batch service time for ``bucket``; falls back to the nearest
+        observed bucket at or above it, then the largest observed one."""
+        with self._lock:
+            if not self._service_ewma_s:
+                return None
+            if bucket in self._service_ewma_s:
+                return self._service_ewma_s[bucket]
+            larger = [b for b in self._service_ewma_s if b >= bucket]
+            key = min(larger) if larger else max(self._service_ewma_s)
+            return self._service_ewma_s[key]
+
+    def bucket_cap(self, remaining_s: float, buckets: Sequence[int]) -> Optional[int]:
+        """The largest bucket whose estimated service time (x the safety
+        factor) fits ``remaining_s``, or None for "no cap" (no estimates yet,
+        or even the largest bucket fits). The smallest bucket is always
+        allowed — a request that cannot afford any bucket is the dispatch
+        deadline re-check's problem, not a reason to starve the queue."""
+        if remaining_s <= 0.0:
+            return None
+        est_largest = self.estimated_service_s(buckets[-1])
+        if est_largest is None or est_largest * self.deadline_safety <= remaining_s:
+            return None
+        cap = buckets[0]
+        for b in buckets[1:]:
+            est = self.estimated_service_s(b)
+            if est is not None and est * self.deadline_safety > remaining_s:
+                break
+            cap = b
+        return cap
+
+    def record_downshift(self, cap: int) -> None:
+        metrics.counter(self.scope, MLMetrics.SERVING_CONTROLLER_DOWNSHIFTS)
+        self._record_action("bucket", cap, "remaining deadline cannot afford the large-bucket pipeline")
+
+    # -- depth / mesh stepping ------------------------------------------------
+    def maybe_step(self, current_depth: int) -> Optional[ControllerAction]:
+        """Step the pipeline depth along the ladder when the queue category
+        dominates the live ledger (cooldown-limited so one congested window
+        steps once, not once per batch). Returns the action to apply, or
+        None. At the depth ceiling, emits a mesh-width recommendation
+        instead (gauge only — rebuilding the mesh is a swap-time operation)."""
+        now = self._clock()
+        with self._lock:
+            if self._last_step_at is not None and now - self._last_step_at < self._step_cooldown_s:
+                return None
+        queue_share = self.ledger.share(CAT_QUEUE)
+        if queue_share is None:
+            return None
+        action: Optional[ControllerAction] = None
+        if queue_share >= self.queue_fraction:
+            if current_depth < self.depth_max:
+                action = self._record_action(
+                    "depth", current_depth + 1,
+                    f"queue share {queue_share:.2f} >= {self.queue_fraction}",
+                )
+            else:
+                metrics.gauge(
+                    self.scope, MLMetrics.SERVING_CONTROLLER_MESH_RECOMMEND, self.mesh * 2
+                )
+                action = self._record_action(
+                    "mesh.recommend", self.mesh * 2,
+                    f"queue share {queue_share:.2f} at depth ceiling {self.depth_max}",
+                )
+        elif current_depth > self.base_depth and queue_share < self.queue_fraction / 4.0:
+            action = self._record_action(
+                "depth", current_depth - 1,
+                f"queue share {queue_share:.2f} subsided",
+            )
+        if action is not None:
+            with self._lock:
+                self._last_step_at = now
+            if action.kind == "depth":
+                metrics.gauge(self.scope, MLMetrics.SERVING_CONTROLLER_DEPTH, action.value)
+        return action
+
+    # -- introspection --------------------------------------------------------
+    def _record_action(self, kind: str, value, reason: str) -> ControllerAction:
+        action = ControllerAction(kind, value, reason, self._clock())
+        with self._lock:
+            self.actions.append(action)
+            if len(self.actions) > _MAX_ACTIONS:
+                del self.actions[: len(self.actions) - _MAX_ACTIONS]
+        metrics.counter(self.scope, MLMetrics.SERVING_CONTROLLER_ACTIONS)
+        return action
+
+    def actions_of(self, kind: str) -> List[ControllerAction]:
+        with self._lock:
+            return [a for a in self.actions if a.kind == kind]
+
+    def publish_goodput(self) -> None:
+        """Publish the ledger window as ``ml.goodput.*`` gauges under this
+        server's scope (the same gauges a span-derived report writes)."""
+        self.ledger.report(self.scope).publish()

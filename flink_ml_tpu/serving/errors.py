@@ -4,8 +4,17 @@ Every rejection a client can see is a distinct type so callers (and load
 balancers above them) can route: overload → shed/retry elsewhere, deadline →
 give up, closed → connection draining, no model → not ready yet. All subclass
 ``ServingError`` for blanket handling.
+
+Overload and deadline rejections carry **structured backoff context** —
+observed queue depth, capacity, the phase the request died in, and a
+``retry_after_ms`` drain estimate — so a client can back off proportionally
+to the actual congestion instead of blind-retrying into a queue that is
+still full (blind retries under overload are how a shed turns into a
+collapse; docs/serving.md "Load shedding & adaptive control").
 """
 from __future__ import annotations
+
+from typing import Optional
 
 __all__ = [
     "ServingError",
@@ -21,28 +30,80 @@ class ServingError(RuntimeError):
 
 
 class ServingOverloadedError(ServingError):
-    """Admission control rejected the request: the bounded queue is full.
+    """Admission control rejected the request — either the bounded queue is
+    full (hard reject) or the adaptive controller shed it by priority under
+    sustained overload *before* the queue filled (``shed=True``).
 
     Raised synchronously at ``submit`` — the queue never blocks producers, so
-    overload can shed load but never deadlock. Carries the observed depth so
-    callers can log/export it.
+    overload can shed load but never deadlock. Carries the observed depth,
+    the capacity, and a ``retry_after_ms`` drain estimate so callers can back
+    off instead of blind-retrying.
     """
 
-    def __init__(self, queued_rows: int, capacity_rows: int):
+    def __init__(
+        self,
+        queued_rows: int,
+        capacity_rows: int,
+        *,
+        retry_after_ms: Optional[float] = None,
+        shed: bool = False,
+        priority: Optional[int] = None,
+    ):
         self.queued_rows = queued_rows
         self.capacity_rows = capacity_rows
-        super().__init__(
-            f"serving queue full ({queued_rows}/{capacity_rows} rows); request rejected"
-        )
+        self.retry_after_ms = retry_after_ms
+        self.shed = shed
+        self.priority = priority
+        if shed:
+            msg = (
+                f"request shed under sustained overload "
+                f"({queued_rows}/{capacity_rows} rows queued"
+                + (f", priority {priority}" if priority is not None else "")
+                + ")"
+            )
+        else:
+            msg = f"serving queue full ({queued_rows}/{capacity_rows} rows); request rejected"
+        if retry_after_ms is not None:
+            msg += f"; retry after ~{retry_after_ms:.0f} ms"
+        super().__init__(msg)
+
+    @property
+    def queue_depth(self) -> int:
+        """Alias for ``queued_rows`` (the wire-protocol field name)."""
+        return self.queued_rows
 
 
 class ServingDeadlineError(ServingError, TimeoutError):
-    """The request's deadline expired before a batch picked it up.
+    """The request's deadline expired before it could be served.
 
-    Deadlines are enforced at batch admission: once a request is claimed into
-    an executing batch it always completes (exactly-one-response invariant);
-    a request still queued past its deadline is dropped and gets this error.
+    Deadlines are enforced at three seams, identified by ``phase``:
+
+    - ``"queued"`` — still waiting when the deadline passed (dropped by the
+      reaper or abandoned by its waiter);
+    - ``"dispatch"`` — claimed into a batch but expired in the pad/scatter
+      window; it fails fast here instead of burning a device slot on rows
+      nobody is waiting for.
+
+    Once a batch is actually dispatched a claimed request always completes
+    (exactly-one-response invariant). ``queued_ms`` is the time the request
+    spent admitted; ``retry_after_ms`` is the drain estimate at failure time
+    (None when no controller is attached).
     """
+
+    def __init__(
+        self,
+        message: str = "request deadline expired",
+        *,
+        phase: str = "queued",
+        queued_ms: Optional[float] = None,
+        retry_after_ms: Optional[float] = None,
+    ):
+        self.phase = phase
+        self.queued_ms = queued_ms
+        self.retry_after_ms = retry_after_ms
+        if queued_ms is not None:
+            message += f" (phase={phase}, queued {queued_ms:.1f} ms)"
+        super().__init__(message)
 
 
 class ServingClosedError(ServingError):

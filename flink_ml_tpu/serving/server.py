@@ -28,6 +28,7 @@ from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.config import Options, config
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.serving.batcher import MicroBatcher, pad_to
+from flink_ml_tpu.serving.controller import AdaptiveController
 from flink_ml_tpu.serving.errors import NoModelError, ServingClosedError
 from flink_ml_tpu.serving.plan import CompiledServingPlan
 from flink_ml_tpu.serving.registry import ModelRegistry, ModelVersionPoller
@@ -72,6 +73,14 @@ class ServingConfig:
         mesh: Optional[int] = None,
         mesh_model: Optional[int] = None,
         fusion_mode: Optional[str] = None,
+        controller: Optional[bool] = None,
+        shed_watermark: Optional[float] = None,
+        shed_sustain_ms: Optional[float] = None,
+        shed_priority: Optional[int] = None,
+        controller_window_ms: Optional[float] = None,
+        controller_queue_fraction: Optional[float] = None,
+        controller_depth_max: Optional[int] = None,
+        deadline_safety: Optional[float] = None,
     ):
         self.max_batch_size = (
             int(max_batch_size) if max_batch_size is not None
@@ -112,6 +121,20 @@ class ServingConfig:
             str(fusion_mode) if fusion_mode is not None
             else config.get(Options.FUSION_MODE)
         )
+        self.controller = (
+            bool(controller) if controller is not None
+            else config.get(Options.SERVING_CONTROLLER)
+        )
+        # Controller knobs: kept un-defaulted here (None = "resolve through
+        # the config tier at AdaptiveController construction") so a server
+        # built before a config.set still picks the deployment's values.
+        self.shed_watermark = shed_watermark
+        self.shed_sustain_ms = shed_sustain_ms
+        self.shed_priority = shed_priority
+        self.controller_window_ms = controller_window_ms
+        self.controller_queue_fraction = controller_queue_fraction
+        self.controller_depth_max = controller_depth_max
+        self.deadline_safety = deadline_safety
 
     def __repr__(self) -> str:
         return (
@@ -122,7 +145,7 @@ class ServingConfig:
             f"poll_interval_ms={self.poll_interval_ms}, "
             f"fastpath={self.fastpath}, pipeline_depth={self.pipeline_depth}, "
             f"mesh={self.mesh}, mesh_model={self.mesh_model}, "
-            f"fusion_mode={self.fusion_mode})"
+            f"fusion_mode={self.fusion_mode}, controller={self.controller})"
         )
 
 
@@ -205,6 +228,29 @@ class InferenceServer:
             if self.config.fastpath
             else None
         )
+        # SLO-adaptive controller (serving.controller, default on): priority
+        # shedding before the hard queue bound, deadline-aware bucket caps,
+        # pipeline-depth stepping from its live goodput ledger. With default
+        # knobs it only ever acts under sustained overload, so steady-state
+        # serving is unchanged.
+        self.controller = (
+            AdaptiveController(
+                self.scope,
+                self.config.queue_capacity_rows,
+                self.config.max_batch_size,
+                base_depth=self.config.pipeline_depth,
+                mesh=self.config.mesh,
+                shed_watermark=self.config.shed_watermark,
+                shed_sustain_ms=self.config.shed_sustain_ms,
+                shed_priority=self.config.shed_priority,
+                window_ms=self.config.controller_window_ms,
+                queue_fraction=self.config.controller_queue_fraction,
+                depth_max=self.config.controller_depth_max,
+                deadline_safety=self.config.deadline_safety,
+            )
+            if self.config.controller
+            else None
+        )
         self._batcher = MicroBatcher(
             self._execute,
             max_batch_size=self.config.max_batch_size,
@@ -220,6 +266,7 @@ class InferenceServer:
                 else None
             ),
             shards=self._sharding.n_data if self._sharding is not None else 1,
+            controller=self.controller,
         )
         if servable is not None:
             self.swap(version, servable)
@@ -277,17 +324,27 @@ class InferenceServer:
         return _DispatchHandle(plan.dispatch(padded_df), version)
 
     # -- client API ------------------------------------------------------------
-    def predict(self, df: DataFrame, timeout_ms: Optional[float] = None) -> ServingResponse:
+    def predict(
+        self, df: DataFrame, timeout_ms: Optional[float] = None, priority: int = 0
+    ) -> ServingResponse:
         """Serve ``df`` (1..max_batch_size rows), blocking until the response.
 
-        Raises ``ServingOverloadedError`` (queue full — immediately),
-        ``ServingDeadlineError`` (deadline passed while queued),
-        ``ServingClosedError`` (after close), or ``NoModelError`` via the
-        batch when no version is loaded.
-        """
-        return self.submit(df, timeout_ms).result()
+        ``priority`` (0 = most important, the default) feeds the adaptive
+        controller: under sustained overload, priorities >=
+        ``serving.shed.priority`` are shed with backoff context before the
+        queue hard-rejects anyone.
 
-    def submit(self, df: DataFrame, timeout_ms: Optional[float] = None):
+        Raises ``ServingOverloadedError`` (queue full or shed — immediately,
+        with ``retry_after_ms``), ``ServingDeadlineError`` (deadline passed
+        while queued or in the pre-dispatch window), ``ServingClosedError``
+        (after close), or ``NoModelError`` via the batch when no version is
+        loaded.
+        """
+        return self.submit(df, timeout_ms, priority=priority).result()
+
+    def submit(
+        self, df: DataFrame, timeout_ms: Optional[float] = None, priority: int = 0
+    ):
         """Async variant of ``predict``: returns a handle with ``.result()``."""
         if self._closed:
             raise ServingClosedError("server is closed")
@@ -295,7 +352,7 @@ class InferenceServer:
         timeout_s = (
             timeout_ms if timeout_ms is not None else self.config.default_timeout_ms
         ) / 1000.0
-        return self._batcher.submit(df, timeout_s)
+        return self._batcher.submit(df, timeout_s, priority=priority)
 
     def _remember_template(self, df: DataFrame) -> None:
         """First request doubles as the warmup template for later swaps when

@@ -1,0 +1,725 @@
+"""Open-loop load harness + SLO-adaptive serving (flink_ml_tpu/loadgen/,
+serving/controller.py).
+
+The acceptance contract of the robustness PR:
+
+- determinism: same seed ⇒ byte-identical arrival schedule and request-size
+  sequence; replay of a recorded schedule reproduces identical shed/miss
+  counters (proven under a virtual clock — no wall-clock flake);
+- structured rejection: overload/shed/deadline errors carry queue depth,
+  capacity, phase, and retry-after context; the deadline is re-checked
+  immediately before dispatch so an expired request never burns a device slot;
+- fault points: ``serving.admit``, ``serving.dispatch``, ``loadgen.tick``
+  fire deterministically and the serving loop / harness survive each;
+- the control loop: under a seeded open-loop ramp past saturation, low
+  priorities shed before any high-priority deadline miss, at least one
+  controller action fires from the live goodput signal, and post-fault
+  goodput recovers to the pre-fault fraction — with graftscope's per-category
+  attribution summing to traced wall time throughout.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.faults import InjectedFault, faults
+from flink_ml_tpu.loadgen import (
+    BurstyArrivals,
+    FixedSizes,
+    OpenLoopLoadGenerator,
+    PoissonArrivals,
+    Schedule,
+    ZipfSizes,
+    ramp_schedule,
+)
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.servable.api import TransformerServable
+from flink_ml_tpu.serving import (
+    AdaptiveController,
+    GoodputLedger,
+    InferenceServer,
+    ServingConfig,
+    ServingDeadlineError,
+    ServingOverloadedError,
+)
+from flink_ml_tpu.serving.batcher import MicroBatcher, PendingRequest
+from flink_ml_tpu.serving.batcher import _CLAIMED  # noqa: F401 — state seam
+from flink_ml_tpu.trace import CAT_PRODUCTIVE, CAT_QUEUE
+from flink_ml_tpu import trace
+
+
+class _SlowEcho(TransformerServable):
+    """Clones its input after a fixed per-batch delay — a deterministic
+    service time, so saturation is computable: max_batch_size/delay rows/s."""
+
+    def __init__(self, delay_s: float):
+        super().__init__()
+        self.delay_s = delay_s
+
+    def transform(self, df):
+        time.sleep(self.delay_s)
+        return df.clone()
+
+
+def _echo_server(name, *, delay_s=0.004, max_batch=8, capacity=32, **cfg_kwargs):
+    cfg = ServingConfig(
+        max_batch_size=max_batch,
+        max_delay_ms=0.5,
+        queue_capacity_rows=capacity,
+        default_timeout_ms=30_000,
+        **cfg_kwargs,
+    )
+    return InferenceServer(
+        _SlowEcho(delay_s),
+        name=name,
+        serving_config=cfg,
+        warmup_template=DataFrame.from_dict({"x": np.zeros((1, 2))}),
+    )
+
+
+def _req(rows):
+    return DataFrame.from_dict({"x": np.ones((rows, 2), np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# schedules: seeded determinism + serialization
+# ---------------------------------------------------------------------------
+class TestScheduleDeterminism:
+    STEPS = [(200.0, 0.25), (1000.0, 0.25)]
+
+    def test_same_seed_byte_identical_schedule(self):
+        a = ramp_schedule(self.STEPS, priority_mix={0: 0.7, 1: 0.3}, seed=42)
+        b = ramp_schedule(self.STEPS, priority_mix={0: 0.7, 1: 0.3}, seed=42)
+        assert a.to_json() == b.to_json()  # byte-identical, not just equal
+        assert [e.rows for e in a] == [e.rows for e in b]  # size sequence
+        assert [e.t for e in a] == [e.t for e in b]  # arrival times
+        assert [e.priority for e in a] == [e.priority for e in b]
+
+    def test_different_seeds_differ(self):
+        a = ramp_schedule(self.STEPS, seed=1)
+        b = ramp_schedule(self.STEPS, seed=2)
+        assert a.to_json() != b.to_json()
+
+    def test_bursty_process_deterministic_and_bursty(self):
+        a = ramp_schedule(self.STEPS, process="bursty", seed=9)
+        b = ramp_schedule(self.STEPS, process="bursty", seed=9)
+        assert a.to_json() == b.to_json()
+        # burstiness: max arrivals in any 50 ms window far exceeds the
+        # average-rate expectation for that window
+        times = [e.t for e in a if e.step == 0]
+        if len(times) >= 4:
+            best = max(
+                sum(1 for t in times if t0 <= t < t0 + 0.05) for t0 in times
+            )
+            assert best >= 2
+
+    def test_roundtrip_is_identity(self, tmp_path):
+        a = ramp_schedule(self.STEPS, priority_mix={0: 0.5, 2: 0.5}, seed=5)
+        path = str(tmp_path / "sched.json")
+        a.save(path)
+        b = Schedule.load(path)
+        assert a.to_json() == b.to_json()
+        assert b.meta["seed"] == 5
+        assert b.n_steps == a.n_steps
+
+    def test_schedule_step_accounting(self):
+        s = ramp_schedule([(500.0, 0.2)], sizes=FixedSizes(4), seed=3)
+        assert s.n_steps == 1
+        assert s.offered_rows(0) == 4 * len(s)
+        assert all(e.rows == 4 for e in s)
+
+    def test_zipf_sizes_heavy_tailed(self):
+        import random
+
+        sizes = ZipfSizes((1, 2, 4, 8, 16), alpha=1.5)
+        rng = random.Random(0)
+        draws = [sizes.draw(rng) for _ in range(4000)]
+        assert set(draws) <= {1, 2, 4, 8, 16}
+        assert draws.count(1) > len(draws) * 0.4  # head dominates
+        assert 16 in draws  # but the tail is real
+        assert 1.0 < sizes.mean_rows < 8.0
+
+    def test_invalid_args_raise(self):
+        with pytest.raises(ValueError):
+            PoissonArrivals(0.0)
+        with pytest.raises(ValueError):
+            BurstyArrivals(10.0, burst_factor=1.0)
+        with pytest.raises(ValueError):
+            ZipfSizes(())
+        with pytest.raises(ValueError):
+            ramp_schedule([])
+        with pytest.raises(ValueError):
+            ramp_schedule([(10, 1)], process="constant")
+        with pytest.raises(ValueError):
+            Schedule.from_json('{"version": 99, "entries": []}')
+
+
+# ---------------------------------------------------------------------------
+# replay determinism under a virtual clock
+# ---------------------------------------------------------------------------
+class _ManualClock:
+    """Virtual time: ``sleep`` jumps it forward, nothing else moves it."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, dt: float) -> None:
+        self.t += max(0.0, dt)
+
+
+class _VirtualResponse:
+    __slots__ = ("latency_ms",)
+
+    def __init__(self, latency_ms):
+        self.latency_ms = latency_ms
+
+
+class _VirtualHandle:
+    __slots__ = ("_response", "_error")
+
+    def __init__(self, response=None, error=None):
+        self._response = response
+        self._error = error
+
+    def result(self):
+        if self._error is not None:
+            raise self._error
+        return self._response
+
+
+class _VirtualRequest:
+    """Payload stub: the generator only needs ``len``."""
+
+    __slots__ = ("rows",)
+
+    def __init__(self, rows):
+        self.rows = rows
+
+    def __len__(self):
+        return self.rows
+
+
+class _VirtualServer:
+    """Deterministic virtual-time server: fixed drain rate, bounded backlog.
+    Every decision is a pure function of (arrival time, backlog), so a
+    replayed schedule produces identical shed/miss counters."""
+
+    def __init__(self, clock, *, rows_per_s=200.0, capacity_rows=16):
+        self._clock = clock
+        self.rate = rows_per_s
+        self.capacity = capacity_rows
+        self._busy_until = 0.0
+
+    def submit(self, df, timeout_ms, priority):
+        now = self._clock()
+        backlog_rows = max(0.0, self._busy_until - now) * self.rate
+        if backlog_rows + len(df) > self.capacity:
+            raise ServingOverloadedError(
+                int(backlog_rows), self.capacity,
+                shed=priority > 0, priority=priority,
+                retry_after_ms=1000.0 * backlog_rows / self.rate,
+            )
+        self._busy_until = max(now, self._busy_until) + len(df) / self.rate
+        latency_ms = (self._busy_until - now) * 1000.0
+        if latency_ms > timeout_ms:
+            return _VirtualHandle(error=ServingDeadlineError(
+                "virtual deadline", phase="queued", queued_ms=latency_ms,
+            ))
+        return _VirtualHandle(response=_VirtualResponse(latency_ms))
+
+
+class TestReplayDeterminism:
+    def _run(self, schedule):
+        clock = _ManualClock()
+        server = _VirtualServer(clock)
+        gen = OpenLoopLoadGenerator(
+            schedule,
+            _VirtualRequest,
+            timeout_ms={0: 500.0, 1: 60.0},
+            collectors=4,
+            clock=clock,
+            sleep=clock.sleep,
+        )
+        return gen.run(server)
+
+    def _counters(self, report):
+        return [
+            (s.arrivals, s.completed, s.shed, s.rejected,
+             s.deadline_miss_queued, s.deadline_miss_dispatch,
+             s.first_shed_at_s, tuple(sorted(s.latencies_ms)))
+            for s in report.steps
+        ]
+
+    def test_replay_reproduces_identical_counters(self, tmp_path):
+        sched = ramp_schedule(
+            [(100.0, 0.5), (600.0, 0.5), (100.0, 0.5)],
+            priority_mix={0: 0.6, 1: 0.4},
+            sizes=ZipfSizes((1, 2, 4)),
+            seed=17,
+        )
+        first = self._run(sched)
+        # recorded → saved → reloaded → replayed: identical counters, to the
+        # latency sample
+        path = str(tmp_path / "recorded.json")
+        sched.save(path)
+        second = self._run(Schedule.load(path))
+        assert self._counters(first) == self._counters(second)
+        assert first.fully_resolved() and second.fully_resolved()
+        # the ramp actually overloads the virtual server mid-run
+        assert first.step(1).shed + first.step(1).rejected > 0
+        assert first.step(1).first_shed_at_s is not None
+
+    def test_virtual_run_never_lags(self):
+        sched = ramp_schedule([(300.0, 0.3)], seed=23)
+        clock = _ManualClock()
+        gen = OpenLoopLoadGenerator(
+            sched, _VirtualRequest, timeout_ms=1000.0,
+            clock=clock, sleep=clock.sleep,
+        )
+        report = gen.run(_VirtualServer(clock))
+        assert report.steps[0].max_lag_s < 1e-9
+        assert report.wall_s >= sched.duration_s - 1e-9
+
+
+# ---------------------------------------------------------------------------
+# structured rejection context
+# ---------------------------------------------------------------------------
+class TestErrorContext:
+    def test_overload_error_carries_backoff_context(self):
+        e = ServingOverloadedError(48, 64, retry_after_ms=120.0)
+        assert e.queued_rows == 48 and e.queue_depth == 48
+        assert e.capacity_rows == 64
+        assert e.retry_after_ms == 120.0
+        assert not e.shed
+        assert "retry after" in str(e)
+
+    def test_shed_error_is_distinguishable(self):
+        e = ServingOverloadedError(40, 64, retry_after_ms=80.0, shed=True, priority=2)
+        assert e.shed and e.priority == 2
+        assert "shed" in str(e)
+
+    def test_deadline_error_carries_phase_and_wait(self):
+        e = ServingDeadlineError("x", phase="dispatch", queued_ms=12.5, retry_after_ms=9.0)
+        assert e.phase == "dispatch"
+        assert e.queued_ms == 12.5
+        assert e.retry_after_ms == 9.0
+        assert isinstance(e, TimeoutError)
+
+    def test_live_hard_reject_carries_depth_capacity_and_estimate(self):
+        server = _echo_server("t-ctx-reject", delay_s=0.05, max_batch=1, capacity=4)
+        try:
+            blocker = server.submit(_req(1))
+            deadline = time.perf_counter() + 5.0
+            while server._batcher._queued_rows and time.perf_counter() < deadline:
+                time.sleep(0.005)
+            handles = [server.submit(_req(1)) for _ in range(4)]
+            with pytest.raises(ServingOverloadedError) as exc:
+                server.submit(_req(1))
+            assert exc.value.capacity_rows == 4
+            assert exc.value.queued_rows == 4
+            assert not exc.value.shed
+            # once a batch has been observed the controller has a drain-rate
+            # estimate, so the NEXT hard reject carries retry-after context
+            blocker.result()
+            with pytest.raises(ServingOverloadedError) as exc2:
+                for _ in range(8):
+                    server.submit(_req(1))
+            assert exc2.value.retry_after_ms is not None
+            assert exc2.value.retry_after_ms > 0.0
+        finally:
+            server.close()
+
+    def test_queued_deadline_error_has_context(self):
+        server = _echo_server("t-ctx-deadline", delay_s=0.08, max_batch=1, capacity=16)
+        try:
+            blocker = server.submit(_req(1), timeout_ms=30_000)
+            deadline = time.perf_counter() + 5.0
+            while server._batcher._queued_rows and time.perf_counter() < deadline:
+                time.sleep(0.005)
+            victim = server.submit(_req(1), timeout_ms=20)
+            with pytest.raises(ServingDeadlineError) as exc:
+                victim.result()
+            assert exc.value.phase == "queued"
+            assert exc.value.queued_ms is not None and exc.value.queued_ms >= 0.0
+            assert blocker.result() is not None
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# the pre-dispatch deadline re-check
+# ---------------------------------------------------------------------------
+class TestDispatchDeadlineRecheck:
+    def _batcher(self, executed):
+        def execute(padded_df):
+            executed.append(len(padded_df))
+            return padded_df.clone(), 1
+
+        class _Resp:
+            def __init__(self, df, version, latency_ms, bucket):
+                self.dataframe = df
+                self.model_version = version
+                self.latency_ms = latency_ms
+                self.bucket = bucket
+
+        return MicroBatcher(
+            execute,
+            max_batch_size=4,
+            max_delay_ms=0.0,
+            queue_capacity_rows=64,
+            scope="ml.serving[t-recheck]",
+            response_factory=_Resp,
+        )
+
+    def test_expired_claimed_request_fails_fast_without_device_slot(self):
+        """A request that expired in the pad/scatter window (claimed but past
+        deadline at dispatch time) fails with phase='dispatch' and is NOT
+        executed; live requests in the same claim still serve."""
+        executed = []
+        batcher = self._batcher(executed)
+        try:
+            now = time.perf_counter()
+            expired = PendingRequest(_req(1), deadline=now - 0.01)
+            live = PendingRequest(_req(1), deadline=now + 30.0)
+            for r in (expired, live):
+                r._state = _CLAIMED
+                batcher._install_abandon(r)
+            before = metrics.get(batcher.scope, MLMetrics.SERVING_DEADLINE_DISPATCH) or 0
+            batcher._run_batch([expired, live])
+            assert isinstance(expired.error, ServingDeadlineError)
+            assert expired.error.phase == "dispatch"
+            assert expired.error.queued_ms is not None
+            assert live.error is None and live.response is not None
+            # the expired request's row never reached the device: the batch
+            # executed at bucket 1, not 2
+            assert executed == [1]
+            after = metrics.get(batcher.scope, MLMetrics.SERVING_DEADLINE_DISPATCH)
+            assert after == before + 1
+        finally:
+            batcher.close()
+
+    def test_all_expired_skips_execution_entirely(self):
+        executed = []
+        batcher = self._batcher(executed)
+        try:
+            now = time.perf_counter()
+            reqs = [PendingRequest(_req(1), deadline=now - 0.01) for _ in range(3)]
+            for r in reqs:
+                r._state = _CLAIMED
+                batcher._install_abandon(r)
+            assert batcher._run_batch(list(reqs)) is None
+            assert executed == []
+            for r in reqs:
+                assert isinstance(r.error, ServingDeadlineError)
+                assert r.error.phase == "dispatch"
+        finally:
+            batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# fault points: serving.admit / serving.dispatch / loadgen.tick
+# ---------------------------------------------------------------------------
+class TestServingFaultPoints:
+    def test_serving_admit_fault_fails_synchronously_queue_stays_consistent(self):
+        server = _echo_server("t-fault-admit", delay_s=0.001)
+        faults.reset()
+        try:
+            faults.arm("serving.admit", at=2)
+            assert server.predict(_req(1)) is not None  # hit 1: passes
+            with pytest.raises(InjectedFault):
+                server.predict(_req(1))  # hit 2: fails at the queue door
+            # nothing half-admitted: the queue drains and later traffic serves
+            assert server.predict(_req(2)) is not None
+            assert server._batcher._queued_rows == 0
+        finally:
+            faults.reset()
+            server.close()
+
+    def test_serving_dispatch_fault_fails_batch_typed_then_recovers(self):
+        server = _echo_server("t-fault-dispatch", delay_s=0.001)
+        faults.reset()
+        try:
+            assert server.predict(_req(1)) is not None
+            faults.arm("serving.dispatch", at=1)
+            with pytest.raises(InjectedFault):
+                server.predict(_req(1))  # the claimed batch dies post-pad
+            # exactly-once: the next batch serves normally — no deadlock, no
+            # stuck claim
+            assert server.predict(_req(1)) is not None
+        finally:
+            faults.reset()
+            server.close()
+
+    def test_loadgen_tick_fault_drops_one_arrival_and_run_continues(self):
+        sched = ramp_schedule([(400.0, 0.1)], sizes=FixedSizes(1), seed=31)
+        assert len(sched) >= 5
+        clock = _ManualClock()
+        server = _VirtualServer(clock, rows_per_s=10_000.0, capacity_rows=1 << 20)
+        gen = OpenLoopLoadGenerator(
+            sched, _VirtualRequest, timeout_ms=10_000.0,
+            clock=clock, sleep=clock.sleep,
+        )
+        faults.reset()
+        try:
+            faults.arm("loadgen.tick", at=3)
+            report = gen.run(server)
+        finally:
+            faults.reset()
+        stats = report.steps[0]
+        assert stats.injected == 1  # the dropped arrival, accounted
+        assert stats.completed == stats.arrivals - 1  # the rest stayed on time
+        assert report.fully_resolved()
+
+
+# ---------------------------------------------------------------------------
+# controller units
+# ---------------------------------------------------------------------------
+class TestGoodputLedger:
+    def test_window_eviction(self):
+        clock = _ManualClock()
+        ledger = GoodputLedger(window_s=1.0, clock=clock)
+        ledger.add(CAT_QUEUE, 0.5)
+        clock.t = 0.5
+        ledger.add(CAT_PRODUCTIVE, 0.25)
+        totals = ledger.totals()
+        assert totals[CAT_QUEUE] == pytest.approx(0.5)
+        assert totals[CAT_PRODUCTIVE] == pytest.approx(0.25)
+        clock.t = 1.2  # the first event falls out of the window
+        totals = ledger.totals()
+        assert CAT_QUEUE not in totals
+        assert totals[CAT_PRODUCTIVE] == pytest.approx(0.25)
+
+    def test_share_and_report(self):
+        clock = _ManualClock()
+        ledger = GoodputLedger(window_s=10.0, clock=clock)
+        assert ledger.share(CAT_QUEUE) is None
+        ledger.add(CAT_QUEUE, 3.0)
+        ledger.add(CAT_PRODUCTIVE, 1.0)
+        assert ledger.share(CAT_QUEUE) == pytest.approx(0.75)
+        report = ledger.report("ml.serving[t-ledger]")
+        assert report.fraction("ml.serving[t-ledger]") == pytest.approx(0.25)
+        assert report.wall_s("ml.serving[t-ledger]") == pytest.approx(4.0)
+
+
+class TestAdaptiveControllerUnits:
+    def _controller(self, clock, **kw):
+        kw.setdefault("shed_watermark", 0.5)
+        kw.setdefault("shed_sustain_ms", 100.0)
+        kw.setdefault("shed_priority", 1)
+        kw.setdefault("window_ms", 10_000.0)
+        kw.setdefault("queue_fraction", 0.5)
+        kw.setdefault("depth_max", 4)
+        kw.setdefault("deadline_safety", 2.0)
+        return AdaptiveController(
+            "ml.serving[t-ctrl]", 100, 16, base_depth=1, clock=clock, **kw
+        )
+
+    def test_shed_requires_sustained_overload_and_sheddable_priority(self):
+        clock = _ManualClock()
+        c = self._controller(clock)
+        c.note_queue(80)  # above the 50-row watermark
+        assert not c.should_shed(1, 80)  # not sustained yet
+        clock.t = 0.2  # 200 ms > the 100 ms hold-down
+        assert c.should_shed(1, 80)
+        assert not c.should_shed(0, 80)  # priority 0 is never shed
+        c.note_queue(10)  # drained below the watermark: overload over
+        clock.t = 1.0
+        assert not c.should_shed(1, 80)
+
+    def test_retry_after_tracks_drain_rate(self):
+        clock = _ManualClock()
+        c = self._controller(clock)
+        assert c.retry_after_ms(50) is None  # no batches observed yet
+        c.observe_batch(16, 16, 0.1)  # 160 rows/s
+        est = c.retry_after_ms(32)
+        assert est == pytest.approx(1000.0 * 32 / 160.0, rel=0.01)
+
+    def test_bucket_cap_downshifts_to_affordable_bucket(self):
+        clock = _ManualClock()
+        c = self._controller(clock)
+        buckets = (1, 2, 4, 8, 16)
+        assert c.bucket_cap(0.05, buckets) is None  # no estimates yet
+        for b, s in ((1, 0.002), (2, 0.004), (4, 0.008), (8, 0.016), (16, 0.032)):
+            for _ in range(4):
+                c.observe_batch(b, b, s)
+        # 20 ms remaining, safety 2 → needs est*2 <= 0.020 → bucket 4 (0.008*2)
+        assert c.bucket_cap(0.020, buckets) == 4
+        # plenty of time → no cap
+        assert c.bucket_cap(10.0, buckets) is None
+        # hopeless deadline still allows the smallest bucket (starvation guard)
+        assert c.bucket_cap(0.001, buckets) == 1
+
+    def test_depth_steps_up_down_and_recommends_mesh_at_ceiling(self):
+        clock = _ManualClock()
+        c = self._controller(clock, depth_max=3)  # 10 s window → 2.5 s cooldown
+        c.ledger.add(CAT_QUEUE, 3.0)
+        c.ledger.add(CAT_PRODUCTIVE, 1.0)
+        a1 = c.maybe_step(1)
+        assert a1 is not None and a1.kind == "depth" and a1.value == 2
+        # cooldown: an immediate second call does nothing
+        assert c.maybe_step(2) is None
+        clock.t = 3.0  # past the cooldown, still inside the ledger window
+        a2 = c.maybe_step(2)
+        assert a2 is not None and a2.kind == "depth" and a2.value == 3
+        clock.t = 6.0
+        a3 = c.maybe_step(3)  # at the ceiling → mesh recommendation
+        assert a3 is not None and a3.kind == "mesh.recommend" and a3.value == 2
+        assert metrics.get(c.scope, MLMetrics.SERVING_CONTROLLER_MESH_RECOMMEND) == 2
+        # queueing subsides (old window evicted, fresh productive-only signal)
+        # → step back down toward base depth
+        clock.t = 25.0
+        c.ledger.add(CAT_PRODUCTIVE, 1.0)
+        a4 = c.maybe_step(3)
+        assert a4 is not None and a4.kind == "depth" and a4.value == 2
+
+
+# ---------------------------------------------------------------------------
+# the closed control loop under open-loop overload (the acceptance scenario)
+# ---------------------------------------------------------------------------
+class TestAdaptiveServingUnderLoad:
+    """Seeded open-loop ramp to ≥2x saturation with faults armed at the
+    serving seams. _SlowEcho(4 ms) at max_batch 2 saturates at
+    2/0.004 = 500 rows/s; 1-row requests at 1100 rps offer ~2.2x that."""
+
+    def test_ramp_sheds_low_priority_before_high_priority_misses(self):
+        server = _echo_server(
+            "t-ramp-priority", delay_s=0.004, max_batch=2, capacity=24,
+            shed_sustain_ms=5.0, shed_watermark=0.6,
+        )
+        sched = ramp_schedule(
+            # the 2 rps step between overload and recovery lets the bounded
+            # queue drain so the recovery step starts below the watermark
+            [(80.0, 0.3), (1100.0, 0.8), (2.0, 0.4), (80.0, 0.3)],
+            priority_mix={0: 0.5, 1: 0.5},
+            sizes=FixedSizes(1),
+            seed=101,
+        )
+        gen = OpenLoopLoadGenerator(
+            sched, _req,
+            # generous deadline for guaranteed traffic, tight for best-effort
+            timeout_ms={0: 30_000.0, 1: 2_000.0},
+        )
+        faults.reset()
+        try:
+            report = gen.run(server)
+        finally:
+            faults.reset()
+            server.close()
+        assert report.fully_resolved()
+        assert not report.unexpected
+        overload = report.step(1)
+        # the ramp actually overloaded: sheds happened, and they happened to
+        # the sheddable priority only
+        assert overload.shed > 0
+        assert overload.by_priority[1]["shed"] == overload.shed
+        assert overload.by_priority.get(0, {}).get("shed", 0) == 0
+        assert overload.first_shed_at_s is not None
+        # low-priority shed BEFORE any high-priority deadline miss: priority-0
+        # traffic met every deadline end to end
+        p0 = {k: v for s in report.steps for k, v in s.by_priority.get(0, {}).items()}
+        assert sum(
+            s.by_priority.get(0, {}).get("deadline_miss", 0) for s in report.steps
+        ) == 0, p0
+        # recovery step is clean again
+        recovery = report.step(3)
+        assert recovery.shed == 0 and recovery.rejected == 0
+        # shed counter is observable
+        assert metrics.get(server.scope, MLMetrics.SERVING_SHED) >= overload.shed
+
+    def test_controller_action_fires_from_live_goodput_signal(self):
+        server = _echo_server(
+            "t-ramp-action", delay_s=0.004, max_batch=2, capacity=64,
+            shed_sustain_ms=5.0, controller_window_ms=400.0,
+            controller_queue_fraction=0.4,
+        )
+        sched = ramp_schedule(
+            [(1100.0, 0.8)], sizes=FixedSizes(1), seed=7,
+            priority_mix={0: 0.5, 1: 0.5},
+        )
+        gen = OpenLoopLoadGenerator(
+            sched, _req, timeout_ms={0: 30_000.0, 1: 1_000.0},
+        )
+        faults.reset()
+        try:
+            report = gen.run(server)
+            controller = server.controller
+            # the queue category dominated the live ledger under the ramp and
+            # at least one control action fired off it (depth step up — the
+            # queue share gate — or a deadline-driven bucket downshift)
+            stepped = controller.actions_of("depth") + controller.actions_of("bucket")
+            assert stepped, controller.actions
+            if controller.actions_of("depth"):
+                assert metrics.get(server.scope, MLMetrics.SERVING_CONTROLLER_DEPTH) >= 2
+            assert metrics.get(server.scope, MLMetrics.SERVING_CONTROLLER_ACTIONS) >= 1
+        finally:
+            faults.reset()
+            server.close()
+        assert report.fully_resolved()
+
+    def test_chaos_ramp_recovers_goodput_with_exact_attribution(self):
+        """Faults armed at the serving seams DURING a live open-loop ramp:
+        typed-error-only failures, no deadlock, and post-fault goodput within
+        10% of the pre-fault baseline — with graftscope's per-category
+        attribution summing to traced wall time in every phase."""
+        server = _echo_server(
+            "t-chaos", delay_s=0.004, max_batch=2, capacity=24,
+            shed_sustain_ms=5.0,
+        )
+
+        def phase(steps, seed):
+            sched = ramp_schedule(
+                steps, sizes=FixedSizes(1), seed=seed, priority_mix={0: 0.6, 1: 0.4}
+            )
+            gen = OpenLoopLoadGenerator(
+                sched, _req, timeout_ms={0: 30_000.0, 1: 1_500.0},
+            )
+            with trace.capture() as recorder:
+                report = gen.run(server)
+            spans = recorder.snapshot()
+            gp = recorder.goodput_report()
+            return report, spans, gp
+
+        faults.reset()
+        try:
+            baseline_report, base_spans, base_gp = phase([(100.0, 0.5)], seed=1)
+            # chaos: overload ramp past saturation with both serving seams
+            # armed probabilistically (seeded — the run is reproducible)
+            faults.arm("serving.dispatch", prob=0.05, seed=3)
+            faults.arm("serving.admit", prob=0.02, seed=4)
+            chaos_report, _, _ = phase([(1100.0, 0.8)], seed=2)
+            faults.reset()
+            recovery_report, rec_spans, rec_gp = phase([(100.0, 0.5)], seed=5)
+        finally:
+            faults.reset()
+            server.close()
+
+        # no deadlock, nothing lost, nothing untyped — in every phase
+        for report in (baseline_report, chaos_report, recovery_report):
+            assert report.fully_resolved()
+            assert not report.unexpected, report.unexpected
+        # the chaos phase actually failed work through the armed seams
+        assert chaos_report.step(0).injected > 0
+        assert chaos_report.step(0).shed + chaos_report.step(0).rejected > 0
+        # exact attribution invariant: per-scope category totals sum to the
+        # scope's root-span wall time (graftscope's contract), both phases
+        for spans, gp in ((base_spans, base_gp), (rec_spans, rec_gp)):
+            by_scope = {}
+            ids_by_scope = {}
+            for s in spans:
+                ids_by_scope.setdefault(s.scope, set()).add(s.span_id)
+            for s in spans:
+                if s.parent_id is None or s.parent_id not in ids_by_scope[s.scope]:
+                    by_scope[s.scope] = by_scope.get(s.scope, 0.0) + s.duration
+            for scope, root_wall in by_scope.items():
+                assert gp.wall_s(scope) == pytest.approx(root_wall, rel=1e-6)
+        # goodput recovered: the post-fault fraction is within 10% of the
+        # pre-fault baseline at the same offered load
+        scope = server.scope
+        base_fraction = base_gp.fraction(scope)
+        rec_fraction = rec_gp.fraction(scope)
+        assert base_fraction is not None and rec_fraction is not None
+        assert rec_fraction >= 0.9 * base_fraction, (base_fraction, rec_fraction)

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""CI chaos smoke: a seeded open-loop ramp past saturation with faults armed
+at the serving seams, proving the server sheds gracefully, never deadlocks,
+and recovers to its pre-fault goodput.
+
+Three phases against ONE live server (flink_ml_tpu/loadgen driving the real
+``InferenceServer.submit`` path):
+
+1. **baseline** — low offered load, tracing on; records the goodput fraction.
+2. **chaos** — a Poisson ramp to >= 2x saturation with a heavy-tailed size
+   mix and a 50/50 priority split, while ``serving.dispatch`` (seeded
+   probabilistic) and ``serving.swap`` (one-shot, against a live publish)
+   are armed — the PR 1/PR 2 fault machinery under real offered load.
+3. **recovery** — baseline load again, faults disarmed.
+
+Asserted:
+
+- no deadlock / nothing lost: every arrival resolves into exactly one bin;
+- typed-error-only failures: the ``unexpected`` bin is empty in every phase —
+  all rejected work failed with ServingError subtypes or InjectedFault, and
+  overload rejections carried retry-after context;
+- priority discipline: sheds happened, all of them to the sheddable
+  priority, and priority-0 traffic missed zero deadlines;
+- the control loop acted: at least one controller action (depth step or
+  bucket downshift) fired from the live goodput ledger;
+- the armed swap failed typed and serving kept answering on the old version;
+- recovery: the post-fault goodput fraction is within 10% of baseline, and
+  graftscope's per-category attribution sums to traced wall time in the
+  traced phases.
+
+Exit codes: 0 = all invariants hold, 1 = any violated.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from flink_ml_tpu import trace
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.faults import faults
+    from flink_ml_tpu.loadgen import OpenLoopLoadGenerator, ZipfSizes, ramp_schedule
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.servable.api import TransformerServable
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    failures = []
+
+    def check(ok: bool, what: str) -> None:
+        print(f"  {'ok ' if ok else 'FAIL'} {what}")
+        if not ok:
+            failures.append(what)
+
+    class SlowEcho(TransformerServable):
+        """Deterministic 4 ms service time → saturation is computable."""
+
+        def __init__(self, delay_s: float = 0.004):
+            super().__init__()
+            self.delay_s = delay_s
+
+        def transform(self, df):
+            time.sleep(self.delay_s)
+            return df.clone()
+
+    max_batch = 4
+    delay_s = 0.004
+    saturation_rows_per_s = max_batch / delay_s  # 1000 rows/s
+    cfg = ServingConfig(
+        max_batch_size=max_batch,
+        max_delay_ms=0.5,
+        queue_capacity_rows=48,
+        default_timeout_ms=30_000,
+        shed_sustain_ms=5.0,
+    )
+    server = InferenceServer(
+        SlowEcho(delay_s),
+        name="chaos-smoke",
+        serving_config=cfg,
+        warmup_template=DataFrame.from_dict({"x": np.zeros((1, 4))}),
+    )
+
+    def request(rows: int):
+        return DataFrame.from_dict({"x": np.ones((rows, 4), np.float32)})
+
+    sizes = ZipfSizes((1, 2, 4), alpha=1.5)  # heavy-tailed, bucket-aligned
+
+    def run_phase(steps, seed, traced):
+        sched = ramp_schedule(
+            steps, sizes=sizes, priority_mix={0: 0.5, 1: 0.5}, seed=seed
+        )
+        gen = OpenLoopLoadGenerator(
+            sched, request, timeout_ms={0: 30_000.0, 1: 1_500.0}
+        )
+        if not traced:
+            return gen.run(server), None, None
+        with trace.capture() as recorder:
+            report = gen.run(server)
+        return report, recorder.snapshot(), recorder.goodput_report()
+
+    # mean Zipf size ~1.5 rows → offered rows/s ~= rps * 1.5
+    base_rps = 0.2 * saturation_rows_per_s / sizes.mean_rows
+    chaos_rps = 2.2 * saturation_rows_per_s / sizes.mean_rows
+    print(
+        f"chaos smoke: saturation ~{saturation_rows_per_s:.0f} rows/s, "
+        f"baseline {base_rps:.0f} rps, chaos ramp to {chaos_rps:.0f} rps "
+        f"(~2.2x saturation, mean {sizes.mean_rows:.2f} rows/request)"
+    )
+
+    faults.reset()
+    try:
+        print("phase 1: baseline (traced)")
+        base_report, base_spans, base_gp = run_phase([(base_rps, 0.8)], seed=11, traced=True)
+
+        print("phase 2: chaos ramp with serving.dispatch + serving.swap armed")
+        # A published v-2 the armed swap seam will reject mid-ramp: the
+        # poller must record it failed and the in-service v1 must keep
+        # answering (only the atomic-publish layout matters here — the
+        # armed seam fires before the loader ever runs).
+        pub_dir = tempfile.mkdtemp(prefix="chaos-smoke-models-")
+        v2_dir = os.path.join(pub_dir, "v-2")
+        os.makedirs(v2_dir)
+        with open(os.path.join(v2_dir, "metadata"), "w", encoding="utf-8") as f:
+            f.write("{}")
+        poller = server.attach_poller(
+            pub_dir, loader=lambda path: SlowEcho(delay_s), start=False
+        )
+        faults.arm("serving.dispatch", prob=0.03, seed=23)
+        faults.arm("serving.swap", at=1)
+        chaos_report, _, _ = run_phase(
+            [(0.8 * chaos_rps / 2.2, 0.3), (chaos_rps, 1.0)], seed=13, traced=False
+        )
+        swapped = poller.poll_once()  # the armed seam fires in here
+        faults.reset()
+
+        print("phase 3: recovery (traced)")
+        rec_report, rec_spans, rec_gp = run_phase([(base_rps, 0.8)], seed=17, traced=True)
+    finally:
+        faults.reset()
+        server.close()
+
+    # -- invariants -----------------------------------------------------------
+    print("invariants:")
+    for name, report in (
+        ("baseline", base_report), ("chaos", chaos_report), ("recovery", rec_report)
+    ):
+        check(report.fully_resolved(),
+              f"{name}: every arrival resolved exactly once "
+              f"({report.total_resolved}/{report.total_arrivals})")
+        check(not report.unexpected,
+              f"{name}: typed-error-only failures (unexpected={report.unexpected!r})")
+
+    overload = chaos_report.steps[-1]
+    check(overload.shed > 0, f"chaos: sheds happened ({overload.shed})")
+    check(overload.first_shed_at_s is not None,
+          f"chaos: time-to-first-shed recorded ({overload.first_shed_at_s})")
+    shed_p0 = sum(s.by_priority.get(0, {}).get("shed", 0) for s in chaos_report.steps)
+    check(shed_p0 == 0, "chaos: priority-0 traffic was never shed")
+    miss_p0 = sum(
+        s.by_priority.get(0, {}).get("deadline_miss", 0)
+        for r in (base_report, chaos_report, rec_report) for s in r.steps
+    )
+    check(miss_p0 == 0, "priority-0 traffic missed zero deadlines, all phases")
+    check(overload.injected > 0,
+          f"chaos: armed serving.dispatch actually fired ({overload.injected} typed fault failures)")
+
+    controller = server.controller
+    acted = controller.actions_of("depth") + controller.actions_of("bucket")
+    check(bool(acted),
+          f"controller acted from the live goodput signal ({[a.kind for a in acted][:4]})")
+
+    from flink_ml_tpu.faults import InjectedFault
+
+    check(
+        swapped is None
+        and server.model_version == 1
+        and isinstance(poller.failed.get(2), InjectedFault),
+        f"armed serving.swap rejected v-2 typed, serving stayed on v{server.model_version}",
+    )
+
+    rejected_with_context = metrics.get(server.scope, MLMetrics.SERVING_SHED) or 0
+    check(rejected_with_context >= overload.shed, "sheds observable in ml.serving.shed")
+
+    # graftscope's exact-attribution invariant in both traced phases
+    for name, spans, gp in (("baseline", base_spans, base_gp), ("recovery", rec_spans, rec_gp)):
+        roots = {}
+        ids = {s.span_id for s in spans}
+        for s in spans:
+            if s.parent_id is None or s.parent_id not in ids:
+                roots[s.scope] = roots.get(s.scope, 0.0) + s.duration
+        ok = all(abs(gp.wall_s(scope) - wall) <= 1e-6 * max(wall, 1.0)
+                 for scope, wall in roots.items())
+        check(ok, f"{name}: per-category goodput sums to traced wall time")
+
+    base_fraction = base_gp.fraction(server.scope)
+    rec_fraction = rec_gp.fraction(server.scope)
+    check(
+        base_fraction is not None and rec_fraction is not None
+        and rec_fraction >= 0.9 * base_fraction,
+        f"recovery goodput within 10% of pre-fault baseline "
+        f"({base_fraction:.3f} -> {rec_fraction:.3f})",
+    )
+
+    if failures:
+        print(f"chaos smoke FAILED: {len(failures)} invariant(s) violated", file=sys.stderr)
+        return 1
+    p999 = overload.latency_ms(0.999)
+    print(
+        f"chaos smoke OK: {chaos_report.total_arrivals} chaos arrivals, "
+        f"{overload.shed} shed / {overload.rejected} hard-rejected / "
+        f"{overload.deadline_misses} missed, p99 "
+        f"{overload.latency_ms(0.99):.1f} ms, p999 {p999:.1f} ms, "
+        f"goodput {base_fraction:.3f} -> {rec_fraction:.3f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

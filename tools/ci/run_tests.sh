@@ -53,4 +53,13 @@ python tools/traceview.py "${sharded_artifact}" --scope ml.serving | grep -A 3 "
 echo "=== fusion smoke (exact + fast tiers, zero post-warmup compiles) ==="
 python tools/ci/fusion_smoke.py
 
+# Chaos smoke: a seeded open-loop ramp to ~2.2x saturation with
+# serving.dispatch + serving.swap armed against a live server — no deadlock,
+# typed-error-only failures with retry context, priority sheds before any
+# high-priority deadline miss, at least one adaptive-controller action from
+# the live goodput ledger, and recovery to within 10% of the pre-fault
+# goodput fraction (docs/serving.md "Load shedding & adaptive control").
+echo "=== chaos smoke (open-loop ramp past saturation, faults armed) ==="
+python tools/ci/chaos_smoke.py
+
 echo "CI OK"

@@ -9,7 +9,7 @@ map explicitly:
     L1 compute / servable  linalg, params, api, ops, checkpoint, parallel,
                            servable, serving, trace
     L2 runtime             iteration, execution, builder
-    L3 library             models, benchmark, the root package
+    L3 library             models, benchmark, loop, loadgen, the root package
 
 A module may import same-layer or lower — importing *up* is the violation
 (a servable-tier file importing the runtime, a kernel importing a model).
@@ -67,6 +67,11 @@ PACKAGE_LAYERS = {
     "builder": 2,
     "models": 3,
     "benchmark": 3,
+    # The open-loop load harness drives the serving tier from the outside
+    # (schedules, offered-load ramps, chaos accounting) — a measurement rig
+    # over L1, not a dependency of it, so it sits at the library layer like
+    # benchmark; nothing below may import it.
+    "loadgen": 3,
     # The continuous-learning loop composes the serving tier's publish/swap
     # machinery WITH the model library's online estimators and the execution
     # supervisor, so it sits above all of them at the library layer — the
