@@ -122,11 +122,18 @@ def _normalize_column(col: Any) -> Column:
     return col
 
 
-class DataFrame:
+class DataFrame:  # graftcheck: serialized
     """Columnar table with a row-boundary API.
 
     Construct from columns (``DataFrame(names, types, columns)``) or rows
     (``DataFrame.from_rows``).
+
+    Concurrency contract (the ``serialized`` mark above): a DataFrame is a
+    request/response *value* — it crosses threads only through an ownership
+    handoff (the batcher queue and its ``Event`` delivery, a datacache
+    chunk boundary) that orders every access, and no two threads mutate one
+    instance concurrently. graftcheck's shared-state-guard trusts this
+    documented handoff instead of demanding a per-instance lock.
     """
 
     def __init__(

@@ -242,16 +242,20 @@ class FaultInjector:
     def trip(self, point: str, **context) -> None:
         """Called by the runtime at fault point ``point``; raises
         ``InjectedFault`` when an armed trigger fires, else returns."""
+        # Deferred spec load (importing the runtime never parses env specs
+        # unless a fault point is actually reached). The claim-then-load is
+        # two lock regions ON DIFFERENT state: the flag flips inside one
+        # region, and load_spec (config/env reads — work that must not run
+        # under the trip lock) runs outside it. The previous implementation
+        # release()/acquire()d the held lock mid-`with`, which static
+        # analysis cannot see — this shape is equivalent and analyzable.
         with self._lock:
-            if not self._spec_loaded:
-                # Deferred so importing the runtime never parses env specs
-                # unless a fault point is actually reached.
+            load_now = not self._spec_loaded
+            if load_now:
                 self._spec_loaded = True
-                self._lock.release()
-                try:
-                    self.load_spec()
-                finally:
-                    self._lock.acquire()
+        if load_now:
+            self.load_spec()
+        with self._lock:
             self._hits[point] = self._hits.get(point, 0) + 1
             armed = self._armed.get(point)
             if armed is None:

@@ -141,19 +141,26 @@ class StepStats:
                 self.max_lag_s = lag_s
 
     # -- reading --------------------------------------------------------------
+    # The aggregate reads take the same lock as the counter bumps: the driver
+    # (and live dashboards) read these while collectors are still writing,
+    # and an unlocked multi-field sum is a torn snapshot — the exact
+    # inconsistent-lockset shape graftcheck's shared-state-guard convicts.
     @property
     def deadline_misses(self) -> int:
-        return self.deadline_miss_queued + self.deadline_miss_dispatch
+        with self._lock:
+            return self.deadline_miss_queued + self.deadline_miss_dispatch
 
     @property
     def resolved(self) -> int:
         """Arrivals accounted for — completion, typed rejection, miss, or
         injected fault. Equal to ``arrivals`` once the run is drained (the
         no-deadlock invariant)."""
-        return (
-            self.completed + self.shed + self.rejected + self.deadline_misses
-            + self.injected + self.typed_errors + len(self.unexpected)
-        )
+        with self._lock:
+            return (
+                self.completed + self.shed + self.rejected
+                + self.deadline_miss_queued + self.deadline_miss_dispatch
+                + self.injected + self.typed_errors + len(self.unexpected)
+            )
 
     def latency_ms(self, q: float) -> Optional[float]:
         with self._lock:
@@ -287,7 +294,7 @@ class OpenLoopLoadGenerator:
                 if item is _DONE:
                     return
                 arrival, handle = item
-                stats = steps[arrival.step]
+                stats: StepStats = steps[arrival.step]
                 try:
                     response = handle.result()
                 except ServingDeadlineError as e:
@@ -318,7 +325,7 @@ class OpenLoopLoadGenerator:
             else:
                 steps[arrival.step].note_lag(now - due)
             step_starts.setdefault(arrival.step, arrival.t)
-            stats = steps[arrival.step]
+            stats: StepStats = steps[arrival.step]
             stats.note_arrival(arrival.priority, arrival.rows)
             step_rel_s = arrival.t - step_starts[arrival.step]
             try:

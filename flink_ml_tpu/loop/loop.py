@@ -40,6 +40,7 @@ from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.loop.drift import DriftMonitor, logloss
 from flink_ml_tpu.loop.rollback import RollbackController
 from flink_ml_tpu.loop.trainer import ContinuousTrainer
+from flink_ml_tpu.serving.registry import ModelVersionPoller
 from flink_ml_tpu.trace import (
     CAT_PRODUCTIVE,
     CAT_RECOVERY,
@@ -115,7 +116,9 @@ class ContinuousLearningLoop:
         # point between training turns and the scenario tests are
         # deterministic. (A deployment wanting free-running swaps can start
         # the poller instead and skip the loop's _swap turn.)
-        self._poller = server.attach_poller(trainer.publish_dir, start=False)
+        self._poller: ModelVersionPoller = server.attach_poller(
+            trainer.publish_dir, start=False
+        )
         #: The version drift verdicts compare the live model against: the
         #: version that was serving before the newest flip. None until two
         #: versions have served (or right after a rollback — the restored

@@ -55,6 +55,7 @@ import numpy as np
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.faults import faults
 from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.serving.controller import AdaptiveController
 from flink_ml_tpu.serving.errors import (
     ServingClosedError,
     ServingDeadlineError,
@@ -194,7 +195,10 @@ class MicroBatcher:
         # shedding at admission, deadline-aware bucket caps at claim, depth
         # stepping from the live goodput ledger. Every hook below is gated on
         # it so controller-off behavior is byte-for-byte the classic path.
-        self._controller = controller
+        # The annotation types the attribute for graftcheck's call-graph
+        # resolution: the batcher thread's calls into the controller join the
+        # lock-order graph and give its ledger state the micro-batcher role.
+        self._controller: Optional[AdaptiveController] = controller
         # Async seam: dispatch(padded_df) -> handle with .result() -> (df,
         # version), or None to serve this batch through the sync ``execute``.
         self._dispatch = dispatch
@@ -489,6 +493,10 @@ class MicroBatcher:
         now = time.perf_counter()
         if all(req.deadline > now for req in claimed):
             return claimed
+        # Queue depth feeds the retry-after hint only; snapshot it under the
+        # lock once rather than reading it raw off this (unlocked) thread.
+        with self._lock:
+            queued_rows = self._queued_rows
         live: List[PendingRequest] = []
         for req in claimed:
             if req.deadline > now:
@@ -499,7 +507,7 @@ class MicroBatcher:
                 phase="dispatch",
                 queued_ms=(now - req.enqueued_at) * 1000.0,
                 retry_after_ms=(
-                    self._controller.retry_after_ms(self._queued_rows)
+                    self._controller.retry_after_ms(queued_rows)
                     if self._controller is not None
                     else None
                 ),

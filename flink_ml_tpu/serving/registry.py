@@ -109,13 +109,18 @@ class ModelRegistry:
 
     @property
     def version(self) -> Optional[int]:
-        current = self._current
+        with self._lock:
+            current = self._current
         return current[0] if current else None
 
     def current(self) -> Tuple[int, object]:
         """The serving pair — snapshotted ONCE per batch by the server so a
-        mid-batch swap can never mix versions inside one response."""
-        current = self._current
+        mid-batch swap can never mix versions inside one response. The read
+        takes the swap lock: the tuple flip is atomic either way under the
+        GIL, but a consistent lockset is the contract shared-state-guard
+        verifies, and an uncontended acquire costs nothing next to a batch."""
+        with self._lock:
+            current = self._current
         if current is None:
             raise NoModelError("no model version loaded yet")
         return current
@@ -183,9 +188,22 @@ class ModelVersionPoller:
             if interval_ms is not None
             else config.get(Options.SERVING_POLL_INTERVAL_MS)
         ) / 1000.0
+        #: Versions that failed to load/warm (with the error) — written by the
+        #: poller thread, read by manual pollers (the continuous loop) and
+        #: operator introspection, so every access holds ``_lock``.
         self.failed: Dict[int, BaseException] = {}
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _record_failed(self, version: int, error: BaseException) -> None:
+        with self._lock:
+            self.failed[version] = error
+        metrics.counter(self.registry.scope, MLMetrics.SERVING_SWAP_FAILURES)
+
+    def known_failed(self, version: int) -> bool:
+        with self._lock:
+            return version in self.failed
 
     # -- one scan -------------------------------------------------------------
     def poll_once(self) -> Optional[int]:
@@ -196,7 +214,7 @@ class ModelVersionPoller:
         for version in reversed(versions):
             if serving is not None and version <= serving:
                 break
-            if version in self.failed:
+            if self.known_failed(version):
                 continue
             path = os.path.join(self.directory, f"{VERSION_PREFIX}{version}")
             try:
@@ -205,8 +223,7 @@ class ModelVersionPoller:
                 if self.warmup is not None:
                     self.warmup(servable)
             except BaseException as e:  # noqa: BLE001 — any load error = bad version
-                self.failed[version] = e
-                metrics.counter(self.registry.scope, MLMetrics.SERVING_SWAP_FAILURES)
+                self._record_failed(version, e)
                 continue  # fall back: try the next older intact version
             self.registry.swap(version, servable)
             if self.on_swap is not None:

@@ -356,11 +356,13 @@ class InferenceServer:
 
     def _remember_template(self, df: DataFrame) -> None:
         """First request doubles as the warmup template for later swaps when
-        the caller didn't provide one at construction."""
-        if self._warmup_template is None:
-            with self._template_lock:
-                if self._warmup_template is None:
-                    self._warmup_template = df.take([0])
+        the caller didn't provide one at construction. Check-and-set in ONE
+        lock region (no double-checked unlocked read): the poller thread
+        reads the template mid-warmup, so every access shares the lock — an
+        uncontended acquire per submit is noise next to the queue lock."""
+        with self._template_lock:
+            if self._warmup_template is None:
+                self._warmup_template = df.take([0])
 
     # -- model lifecycle -------------------------------------------------------
     def warmup(self, servable) -> None:
@@ -376,7 +378,8 @@ class InferenceServer:
         uploads weights."""
         with tracer.span("serving.warmup", CAT_COMPILE, scope=self.scope):
             plan = self._plan_for(servable)  # device-puts model arrays, off-path
-            template = self._warmup_template
+            with self._template_lock:
+                template = self._warmup_template
             if template is None:
                 return  # nothing seen yet: the first real batch compiles lazily
             if plan is not None:

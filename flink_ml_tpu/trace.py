@@ -411,9 +411,13 @@ class Tracer:
     clock: Callable[[], float] = staticmethod(time.perf_counter)
 
     def __init__(self, recorder: Optional[SpanRecorder] = None, enabled: bool = False):
-        self.enabled = bool(enabled)
-        self.xprof = bool(config.get(Options.OBSERVABILITY_TRACE_XPROF))
-        self.recorder = recorder if recorder is not None else SpanRecorder()
+        # Deliberately single-writer fields: only the main (caller/API) role
+        # flips them via enable()/disable(); every instrumented thread reads
+        # them raw — a benign-stale read costs at most one span. Keeping
+        # `enabled` a plain unlocked attribute IS the disabled-path contract.
+        self.enabled = bool(enabled)  # graftcheck: owned-by=main
+        self.xprof = bool(config.get(Options.OBSERVABILITY_TRACE_XPROF))  # graftcheck: owned-by=main
+        self.recorder = recorder if recorder is not None else SpanRecorder()  # graftcheck: owned-by=main
         self._tls = threading.local()
 
     # -- span stack -----------------------------------------------------------

@@ -162,3 +162,56 @@ class TestSeams:
 def test_registry_descriptions_nonempty():
     for point, description in FAULT_POINTS.items():
         assert description.strip(), point
+
+
+class TestDeferredSpecLoad:
+    """graftcheck v3 regression: trip()'s deferred env-spec load used to
+    release()/acquire() the held lock mid-`with` (invisible to static
+    analysis and a re-entrancy trap); it is now two lock regions with the
+    load outside both. Contract unchanged: the FIRST trip loads the spec
+    exactly once, and an armed spec fires on that very trip."""
+
+    def test_first_trip_loads_the_config_spec_and_fires(self):
+        from flink_ml_tpu.config import Options, config
+
+        config.set(Options.FAULT_INJECTION, "checkpoint.save:at=1")
+        try:
+            inj = FaultInjector()
+            assert not inj._spec_loaded
+            with pytest.raises(InjectedFault):
+                inj.trip("checkpoint.save")  # deferred load happens HERE
+            assert inj._spec_loaded
+            inj.trip("checkpoint.save")  # one-shot: disarmed after firing
+        finally:
+            config.unset(Options.FAULT_INJECTION)
+
+    def test_concurrent_first_trips_load_the_spec_once(self):
+        import threading
+
+        inj = FaultInjector()
+        loads = []
+        original = FaultInjector.load_spec
+
+        def counting_load(self, spec=None):
+            loads.append(1)
+            return original(self, "iteration.epoch:at=1000000")
+
+        inj.load_spec = counting_load.__get__(inj)
+        barrier = threading.Barrier(8)
+        errors = []
+
+        def tripper():
+            barrier.wait()
+            try:
+                inj.trip("iteration.epoch")
+            except BaseException as e:  # noqa: BLE001 — must be no error at all
+                errors.append(e)
+
+        threads = [threading.Thread(target=tripper) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert len(loads) == 1  # the claim-then-load region admits one loader
+        assert inj.hits("iteration.epoch") == 8

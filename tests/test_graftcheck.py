@@ -32,6 +32,7 @@ from tools.graftcheck.rules import layer_deps, lock_order  # noqa: E402
 
 ALL_RULES = (
     "blocking-under-lock",
+    "check-then-act",
     "elementwise-claim",
     "error-hygiene",
     "fault-points",
@@ -42,6 +43,7 @@ ALL_RULES = (
     "layer-deps",
     "lock-order",
     "recompile-hazard",
+    "shared-state-guard",
 )
 
 

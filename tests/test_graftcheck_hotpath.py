@@ -344,6 +344,13 @@ BLOCKING_DIRTY = {
             def __init__(self):
                 self._lock = threading.Lock()
                 self._wake = threading.Event()
+                # A second role acquires the lock: it is CONTENDED, so
+                # blocking work under it convoys the other thread.
+                self._thread = threading.Thread(target=self._loop, name="poller-loop")
+
+            def _loop(self):
+                with self._lock:
+                    self.latest = None
 
             def poll(self):
                 with self._lock:
@@ -415,10 +422,35 @@ def test_blocking_under_lock_clean_fixture(tmp_path):
     assert result.findings == [], [f.render() for f in result.findings]
 
 
-def test_blocking_under_lock_out_of_scope_package(tmp_path):
+def test_blocking_under_lock_runs_whole_package(tmp_path):
+    """The serving-tier allowlist is gone: the same contended-lock fixture
+    flags anywhere in the package (graftcheck v3 topology-driven scoping)."""
     files = {"flink_ml_tpu/iteration/x.py": BLOCKING_DIRTY["flink_ml_tpu/serving/poller.py"]}
     result = run_on(tmp_path, files, rules=["blocking-under-lock"])
-    assert result.findings == []
+    assert any("sleeps" in f.message for f in result.findings), [
+        f.render() for f in result.findings
+    ]
+
+
+def test_blocking_under_uncontended_lock_is_quiet(tmp_path):
+    """A lock only the main role ever takes convoys nobody: blocking under
+    it is exempt — the inferred topology, not a path allowlist, decides."""
+    files = {
+        "flink_ml_tpu/iteration/y.py": """
+            import threading
+            import time
+
+            class Builder:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def build(self):
+                    with self._lock:
+                        time.sleep(0.05)   # main-role-only lock: no convoy
+        """
+    }
+    result = run_on(tmp_path, files, rules=["blocking-under-lock"])
+    assert result.findings == [], [f.render() for f in result.findings]
 
 
 # -----------------------------------------------------------------------------

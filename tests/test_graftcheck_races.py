@@ -1,0 +1,730 @@
+"""graftcheck v3: thread-topology inference and the lockset race rules.
+
+Three layers, mirroring the suite convention:
+
+1. **The shipped tree is clean** — shared-state-guard and check-then-act run
+   whole-program over ``flink_ml_tpu`` with zero suppressions and zero
+   findings, and the inferred topology names the real fleet roles
+   (micro-batcher, model-version-poller, loadgen-collector, batch-readback).
+2. **The analyzer works** — clean + seeded-dirty fixtures per rule:
+   cross-thread unguarded write, inconsistent lockset, split check-then-act,
+   pool-resolved spawn targets, the ``owned-by`` exemption (honored and
+   *verified*), the ``serialized`` handoff mark, multi-instance self-races,
+   and the interprocedural lock context that keeps ``_reap_locked``-style
+   helpers quiet.
+3. **The framework works** — the historical 5-node serving lock graph is a
+   subgraph of the whole-program graph, changed-only reporting anchors race
+   findings at the access site, and a facts-schema bump invalidates the
+   warm cache.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.graftcheck import Project, run_rules  # noqa: E402
+import tools.graftcheck.rules  # noqa: F401, E402  (registration)
+from tools.graftcheck.index import FACTS_VERSION  # noqa: E402
+from tools.graftcheck.rules.lock_order import build_lock_graph, _lock_id  # noqa: E402
+from tools.graftcheck.topology import (  # noqa: E402
+    MAIN_ROLE,
+    build_topology,
+    lock_context,
+    topology_for,
+)
+
+from tests.test_graftcheck import run_on, write_tree  # noqa: E402
+
+RACE_RULES = ["shared-state-guard", "check-then-act"]
+
+
+def project_on(root, files) -> Project:
+    write_tree(root, files)
+    return Project(str(root), ["flink_ml_tpu"])
+
+
+# -----------------------------------------------------------------------------
+# 1. shipped tree: clean, and the topology names the real fleet
+# -----------------------------------------------------------------------------
+
+
+def test_shipped_tree_clean_for_race_rules():
+    result = run_rules(Project(REPO_ROOT, ["flink_ml_tpu"]), rules=RACE_RULES)
+    assert result.findings == [], "\n".join(f.render() for f in result.findings)
+    assert result.suppressed == []  # zero suppressions, by contract
+
+
+def test_shipped_tree_topology_names_the_fleet_roles():
+    project = Project(REPO_ROOT, ["flink_ml_tpu"])
+    topo = topology_for(project)
+    assert {
+        "micro-batcher",
+        "model-version-poller",
+        "loadgen-collector",
+        "batch-readback",
+    } <= set(topo.roles)
+    # pool / looped spawns are multi-instance; the singleton loops are not
+    assert topo.is_multi("loadgen-collector")
+    assert topo.is_multi("batch-readback")
+    assert not topo.is_multi("micro-batcher")
+    assert not topo.is_multi("model-version-poller")
+    # role assignment crosses modules through the resolved call graph
+    assert "micro-batcher" in topo.roles_of(
+        "flink_ml_tpu.serving.batcher:MicroBatcher._reap_locked"
+    )
+    assert topo.roles_of("flink_ml_tpu.serving.registry:ModelVersionPoller.poll_once") >= {
+        MAIN_ROLE,
+        "model-version-poller",
+    }
+    assert "loadgen-collector" in topo.roles_of(
+        "flink_ml_tpu.loadgen.generator:StepStats.note_completed"
+    )
+    # the controller ledger runs on the batcher thread (typed-attr resolution)
+    assert "micro-batcher" in topo.roles_of(
+        "flink_ml_tpu.serving.controller:GoodputLedger.add"
+    )
+
+
+def test_shipped_tree_lock_context_covers_locked_helpers():
+    project = Project(REPO_ROOT, ["flink_ml_tpu"])
+    ctx = lock_context(project.index, _lock_id)
+    assert ctx["flink_ml_tpu.serving.batcher:MicroBatcher._reap_locked"] == {
+        "flink_ml_tpu.serving.batcher.MicroBatcher._lock"
+    }
+    assert ctx["flink_ml_tpu.serving.controller:GoodputLedger._evict_locked"] == {
+        "flink_ml_tpu.serving.controller.GoodputLedger._lock"
+    }
+
+
+def test_historical_serving_lock_graph_is_a_subgraph():
+    """The PR 3/6 hand-scoped 5-node serving graph must survive, verbatim,
+    inside the whole-program graph the deleted SCOPE allowlist gave way to."""
+    project = Project(REPO_ROOT, ["flink_ml_tpu"])
+    whole = build_lock_graph(project)
+    historical = build_lock_graph(
+        project, scope=("flink_ml_tpu/serving/", "flink_ml_tpu/metrics.py")
+    )
+    assert set(historical.nodes) <= set(whole.nodes)
+    assert set(historical.edges) <= set(whole.edges)
+    assert set(historical.nodes) >= {
+        "flink_ml_tpu.serving.batcher.MicroBatcher._lock",
+        "flink_ml_tpu.serving.registry.ModelRegistry._lock",
+        "flink_ml_tpu.serving.server.InferenceServer._template_lock",
+        "flink_ml_tpu.metrics.Histogram._lock",
+        "flink_ml_tpu.metrics.MetricsRegistry._lock",
+    }
+    # ... and whole-program scoping actually added the new subsystems' locks
+    assert {
+        "flink_ml_tpu.serving.controller.AdaptiveController._lock",
+        "flink_ml_tpu.serving.controller.GoodputLedger._lock",
+        "flink_ml_tpu.serving.registry.ModelVersionPoller._lock",
+        "flink_ml_tpu.loadgen.generator.StepStats._lock",
+        "flink_ml_tpu.trace.SpanRecorder._lock",
+        "flink_ml_tpu.config.Configuration._lock",
+        "flink_ml_tpu.faults.FaultInjector._lock",
+        "flink_ml_tpu.builder.batch_plan._POOL_LOCK",
+    } <= set(whole.nodes)
+    # the batcher's calls into the controller join the acyclicity contract
+    assert (
+        "flink_ml_tpu.serving.batcher.MicroBatcher._lock",
+        "flink_ml_tpu.serving.controller.AdaptiveController._lock",
+    ) in whole.edges
+    assert whole.cycles() == []
+
+
+# -----------------------------------------------------------------------------
+# 2. topology inference units (synthetic two-thread module)
+# -----------------------------------------------------------------------------
+
+TWO_THREAD = {
+    "flink_ml_tpu/race/twothread.py": """
+        import threading
+
+        def helper():
+            return 1
+
+        def worker():
+            return helper()
+
+        def main_entry():
+            helper()
+            t = threading.Thread(target=worker, name="worker-loop")
+            t.start()
+            return t
+    """
+}
+
+
+def test_topology_two_thread_module(tmp_path):
+    project = project_on(tmp_path, TWO_THREAD)
+    topo = build_topology(project.index)
+    assert set(topo.roles) == {"worker-loop"}
+    assert not topo.is_multi("worker-loop")
+    mod = "flink_ml_tpu.race.twothread"
+    assert topo.roles_of(f"{mod}:worker") == {"worker-loop"}
+    assert topo.roles_of(f"{mod}:main_entry") == {MAIN_ROLE}
+    assert topo.roles_of(f"{mod}:helper") == {MAIN_ROLE, "worker-loop"}
+
+
+def test_topology_resolves_self_method_spawn_target(tmp_path):
+    files = {
+        "flink_ml_tpu/race/cls.py": """
+            import threading
+
+            class Batcher:
+                def __init__(self):
+                    self._thread = threading.Thread(
+                        target=self._loop, name=f"my-batcher[{id(self)}]"
+                    )
+
+                def _loop(self):
+                    self._drain()
+
+                def _drain(self):
+                    pass
+        """
+    }
+    project = project_on(tmp_path, files)
+    topo = build_topology(project.index)
+    # f-string literal head, trailing separator stripped
+    assert "my-batcher" in topo.roles
+    mod = "flink_ml_tpu.race.cls"
+    assert topo.roles_of(f"{mod}:Batcher._drain") == {"my-batcher"}
+
+
+def test_topology_resolves_pool_spawn_target(tmp_path):
+    files = {
+        "flink_ml_tpu/race/pool.py": """
+            from concurrent.futures import ThreadPoolExecutor
+
+            def work():
+                return 1
+
+            def run():
+                with ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="tally-worker"
+                ) as pool:
+                    for _ in range(4):
+                        pool.submit(work)
+        """
+    }
+    project = project_on(tmp_path, files)
+    topo = build_topology(project.index)
+    assert "tally-worker" in topo.roles
+    assert topo.is_multi("tally-worker")  # pools are multi-instance
+    assert topo.roles_of("flink_ml_tpu.race.pool:work") == {"tally-worker"}
+
+
+def test_topology_loop_spawn_is_multi_and_unresolved_targets_reported(tmp_path):
+    files = {
+        "flink_ml_tpu/race/many.py": """
+            import threading
+
+            def worker():
+                return 1
+
+            def run(fn):
+                threads = [
+                    threading.Thread(target=worker, name="collector")
+                    for _ in range(8)
+                ]
+                threading.Thread(target=fn).start()  # param: unresolvable
+                return threads
+        """
+    }
+    project = project_on(tmp_path, files)
+    topo = build_topology(project.index)
+    assert topo.is_multi("collector")  # spawned in a comprehension
+    assert any(ref == ["n", "fn"] for _rel, _line, ref in topo.unresolved_spawns)
+
+
+def test_lock_context_intersection_semantics(tmp_path):
+    files = {
+        "flink_ml_tpu/race/ctx.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def locked_only(self):
+                    with self._lock:
+                        self._helper()
+
+                def mixed(self):
+                    self._also()
+                    with self._lock:
+                        self._also()
+
+                def _helper(self):
+                    pass
+
+                def _also(self):
+                    pass
+        """
+    }
+    project = project_on(tmp_path, files)
+    ctx = lock_context(project.index, _lock_id)
+    mod = "flink_ml_tpu.race.ctx"
+    lock = f"{mod}.Box._lock"
+    assert ctx[f"{mod}:Box._helper"] == {lock}  # every caller holds it
+    assert ctx[f"{mod}:Box._also"] == set()  # one lock-free call site kills it
+
+
+# -----------------------------------------------------------------------------
+# 3. shared-state-guard fixtures
+# -----------------------------------------------------------------------------
+
+UNGUARDED = {
+    "flink_ml_tpu/race/unguarded.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._count = 0
+                self._thread = threading.Thread(target=self._loop, name="worker-loop")
+
+            def _loop(self):
+                self._count += 1
+
+            def read(self):
+                return self._count
+    """
+}
+
+
+def test_cross_thread_unguarded_write_flags_with_roles(tmp_path):
+    result = run_on(tmp_path, UNGUARDED, rules=["shared-state-guard"])
+    assert len(result.findings) == 1
+    f = result.findings[0]
+    assert f.severity == "error" and result.exit_code == 1
+    assert "Worker._count" in f.message and "empty lockset" in f.message
+    # the inferred thread roles are named in the message
+    assert "worker-loop" in f.message and "main" in f.message
+
+
+INCONSISTENT = {
+    "flink_ml_tpu/race/inconsistent.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+                self._thread = threading.Thread(target=self._loop, name="worker-loop")
+
+            def _loop(self):
+                with self._lock:
+                    self._count += 1
+
+            def read(self):
+                return self._count
+    """
+}
+
+
+def test_inconsistent_lockset_flags_at_the_unlocked_access(tmp_path):
+    result = run_on(tmp_path, INCONSISTENT, rules=["shared-state-guard"])
+    assert len(result.findings) == 1
+    f = result.findings[0]
+    assert "inconsistent lockset" in f.message and "Worker._lock" in f.message
+    assert "read in Worker.read" in f.message
+    # anchored at the unlocked access site (the `return self._count` line)
+    assert f.path == "flink_ml_tpu/race/inconsistent.py"
+    assert "worker-loop" in f.message
+
+
+CLEAN = {
+    "flink_ml_tpu/race/clean.py": """
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self, size):
+                self._lock = threading.Lock()
+                self._count = 0
+                self.size = size                      # immutable after __init__
+                self._inbox = queue.Queue()           # inherently safe
+                self._wake = threading.Event()        # inherently safe
+                self._thread = threading.Thread(target=self._loop, name="worker-loop")
+
+            def _loop(self):
+                with self._lock:
+                    self._count += 1
+                self._inbox.put(self.size)
+                self._wake.set()
+
+            def read(self):
+                with self._lock:
+                    return self._count
+    """
+}
+
+
+def test_consistent_lockset_and_safe_shapes_are_clean(tmp_path):
+    result = run_on(tmp_path, CLEAN, rules=RACE_RULES)
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+def test_single_role_state_is_not_flagged(tmp_path):
+    # No spawn anywhere: only the main role exists, nothing can interleave.
+    files = {
+        "flink_ml_tpu/race/solo.py": """
+            class Model:
+                def __init__(self):
+                    self.steps = 0
+
+                def fit(self):
+                    self.steps += 1
+        """
+    }
+    result = run_on(tmp_path, files, rules=RACE_RULES)
+    assert result.findings == []
+
+
+def test_multi_instance_role_races_with_itself(tmp_path):
+    files = {
+        "flink_ml_tpu/race/poolrace.py": """
+            from concurrent.futures import ThreadPoolExecutor
+
+            class Tally:
+                def __init__(self):
+                    self.total = 0
+
+                def bump(self):
+                    self.total += 1
+
+            def run(tally: Tally):
+                with ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="tally-worker"
+                ) as pool:
+                    for _ in range(8):
+                        pool.submit(tally.bump)
+        """
+    }
+    result = run_on(tmp_path, files, rules=["shared-state-guard"])
+    assert len(result.findings) == 1
+    assert "Tally.total" in result.findings[0].message
+    assert "tally-worker(multi)" in result.findings[0].message
+
+
+def test_guarded_helper_called_under_lock_is_clean(tmp_path):
+    # The interprocedural lock context: _drain touches state with no lexical
+    # lock, but every resolved call site holds it.
+    files = {
+        "flink_ml_tpu/race/helper.py": """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+                    self._thread = threading.Thread(target=self._loop, name="worker-loop")
+
+                def _loop(self):
+                    with self._lock:
+                        self._drain()
+
+                def _drain(self):
+                    self._items.clear()
+
+                def push(self, x):
+                    with self._lock:
+                        self._items.append(x)
+        """
+    }
+    result = run_on(tmp_path, files, rules=["shared-state-guard"])
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+# -- owned-by ----------------------------------------------------------------
+
+OWNED_OK = {
+    "flink_ml_tpu/race/owned.py": """
+        import threading
+
+        class Gauge:
+            def __init__(self):
+                self.level = 0  # graftcheck: owned-by=filler-loop
+                self._thread = threading.Thread(target=self._fill, name="filler-loop")
+
+            def _fill(self):
+                self.level += 1
+
+            def read(self):
+                return self.level
+    """
+}
+
+
+def test_owned_by_exempts_the_single_writer_field(tmp_path):
+    result = run_on(tmp_path, OWNED_OK, rules=RACE_RULES)
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+def test_owned_by_violation_is_an_error(tmp_path):
+    files = {
+        "flink_ml_tpu/race/owned_bad.py": """
+            import threading
+
+            class Gauge:
+                def __init__(self):
+                    self.level = 0  # graftcheck: owned-by=filler-loop
+                    self._thread = threading.Thread(target=self._fill, name="filler-loop")
+
+                def _fill(self):
+                    self.level += 1
+
+                def reset(self):
+                    self.level = 0  # main writes an owned field: violation
+        """
+    }
+    result = run_on(tmp_path, files, rules=["shared-state-guard"])
+    assert len(result.findings) == 1
+    f = result.findings[0]
+    assert "owned-by=filler-loop" in f.message and "violated" in f.message
+    assert "main" in f.message
+
+
+def test_owned_by_unknown_role_is_an_error(tmp_path):
+    files = {
+        "flink_ml_tpu/race/owned_typo.py": UNGUARDED[
+            "flink_ml_tpu/race/unguarded.py"
+        ].replace(
+            "self._count = 0",
+            "self._count = 0  # graftcheck: owned-by=wroker-loop",
+        )
+    }
+    result = run_on(tmp_path, files, rules=["shared-state-guard"])
+    assert len(result.findings) == 1
+    assert "no such thread role" in result.findings[0].message
+
+
+def test_owned_by_multi_role_owner_is_an_error(tmp_path):
+    files = {
+        "flink_ml_tpu/race/owned_multi.py": """
+            from concurrent.futures import ThreadPoolExecutor
+
+            class Tally:
+                def __init__(self):
+                    self.total = 0  # graftcheck: owned-by=tally-worker
+
+                def bump(self):
+                    self.total += 1
+
+            def run(tally: Tally):
+                with ThreadPoolExecutor(thread_name_prefix="tally-worker") as pool:
+                    pool.submit(tally.bump)
+        """
+    }
+    result = run_on(tmp_path, files, rules=["shared-state-guard"])
+    assert len(result.findings) == 1
+    assert "multi-instance role" in result.findings[0].message
+
+
+def test_serialized_class_mark_exempts_handoff_types(tmp_path):
+    files = {
+        "flink_ml_tpu/race/handoff.py": """
+            import threading
+
+            class Envelope:  # graftcheck: serialized
+                def __init__(self):
+                    self.value = None
+
+                def fill(self, v):
+                    self.value = v
+
+            class Child(Envelope):
+                def refill(self, v):
+                    self.value = v
+
+            def worker(env: Envelope):
+                env.fill(1)
+
+            def launch(env: Envelope):
+                threading.Thread(target=worker, args=(env,), name="filler").start()
+                env.fill(0)
+        """
+    }
+    result = run_on(tmp_path, files, rules=RACE_RULES)
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+# -----------------------------------------------------------------------------
+# 4. check-then-act fixtures
+# -----------------------------------------------------------------------------
+
+CTA_DIRTY = {
+    "flink_ml_tpu/race/cta.py": """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._thread = threading.Thread(target=self._bump, name="bumper")
+
+            def _bump(self):
+                with self._lock:
+                    room = self._n < 10
+                if room:
+                    with self._lock:
+                        self._n += 1
+
+            def read(self):
+                with self._lock:
+                    return self._n
+    """
+}
+
+
+def test_check_then_act_split_regions_flag(tmp_path):
+    result = run_on(tmp_path, CTA_DIRTY, rules=["check-then-act"])
+    assert len(result.findings) == 1
+    f = result.findings[0]
+    assert f.severity == "error"
+    assert "Counter._n" in f.message and "separate acquisition" in f.message
+    assert "bumper" in f.message  # inferred roles named
+    # every access is still consistently guarded: no shared-state finding
+    guard = run_on(tmp_path, CTA_DIRTY, rules=["shared-state-guard"])
+    assert guard.findings == []
+
+
+def test_check_then_act_single_region_is_clean(tmp_path):
+    files = {
+        "flink_ml_tpu/race/cta_ok.py": """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                    self._thread = threading.Thread(target=self._bump, name="bumper")
+
+                def _bump(self):
+                    with self._lock:
+                        if self._n < 10:
+                            self._n += 1
+
+                def read(self):
+                    with self._lock:
+                        return self._n
+        """
+    }
+    result = run_on(tmp_path, files, rules=["check-then-act"])
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+def test_check_then_act_skips_single_role_attrs(tmp_path):
+    # Same split shape, but nothing else ever runs: no interleaving exists.
+    files = {
+        "flink_ml_tpu/race/cta_solo.py": """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        room = self._n < 10
+                    if room:
+                        with self._lock:
+                            self._n += 1
+        """
+    }
+    result = run_on(tmp_path, files, rules=["check-then-act"])
+    assert result.findings == []
+
+
+# -----------------------------------------------------------------------------
+# 5. framework: changed-only anchoring, cache invalidation
+# -----------------------------------------------------------------------------
+
+CROSS_FILE = {
+    "flink_ml_tpu/race/state.py": """
+        class Shared:
+            def __init__(self):
+                self.hits = 0
+
+            def bump(self):
+                self.hits += 1
+
+            def read(self):
+                return self.hits
+    """,
+    "flink_ml_tpu/race/spawner.py": """
+        import threading
+
+        from flink_ml_tpu.race.state import Shared
+
+        def launch():
+            shared = Shared()
+            threading.Thread(target=shared.bump, name="bumper").start()
+            return shared.read()
+    """,
+}
+
+
+def test_changed_only_reports_at_the_access_site(tmp_path):
+    """The race is only a race because of the spawn in spawner.py — but the
+    finding anchors at the access site in state.py, so a changed set
+    containing state.py reports it even though the conflicting evidence
+    lives elsewhere."""
+    result = run_on(tmp_path, CROSS_FILE, rules=["shared-state-guard"])
+    assert len(result.findings) == 1
+    assert result.findings[0].path == "flink_ml_tpu/race/state.py"
+    narrowed = result.restricted_to({"flink_ml_tpu/race/state.py"})
+    assert len(narrowed.findings) == 1 and narrowed.exit_code == 1
+    elsewhere = result.restricted_to({"flink_ml_tpu/race/spawner.py"})
+    assert elsewhere.findings == [] and elsewhere.exit_code == 0
+
+
+def test_facts_version_bump_invalidates_the_cache(tmp_path, monkeypatch):
+    from tools.graftcheck.cache import IndexCache
+
+    write_tree(tmp_path, INCONSISTENT)
+    cache_path = str(tmp_path / ".gc" / "cache.json")
+
+    def run_with_cache():
+        cache = IndexCache(cache_path)
+        project = Project(str(tmp_path), ["flink_ml_tpu"], cache=cache)
+        result = run_rules(project, rules=RACE_RULES)
+        project.save_cache()
+        return cache, result
+
+    cache1, r1 = run_with_cache()
+    assert cache1.misses > 0  # cold: everything extracted
+    cache2, r2 = run_with_cache()
+    assert cache2.misses == 0 and cache2.hits > 0  # warm: nothing re-parsed
+    # a facts-schema bump (new spawn/attr-access facts) drops the whole cache
+    monkeypatch.setattr("tools.graftcheck.cache.FACTS_VERSION", FACTS_VERSION + 1)
+    cache3, r3 = run_with_cache()
+    assert cache3.hits == 0 and cache3.misses > 0
+    # the cache is a pure accelerator: findings identical on every run
+    assert [f.message for f in r1.findings] == [f.message for f in r2.findings]
+    assert [f.message for f in r2.findings] == [f.message for f in r3.findings]
+    assert len(r1.findings) == 1
+
+
+def test_race_rules_are_suppressible_like_any_rule(tmp_path):
+    files = {
+        "flink_ml_tpu/race/sup.py": UNGUARDED[
+            "flink_ml_tpu/race/unguarded.py"
+        ].replace(
+            "self._count += 1",
+            "self._count += 1  # graftcheck: disable=shared-state-guard",
+        )
+    }
+    result = run_on(tmp_path, files, rules=["shared-state-guard"])
+    # the finding anchors at the write (first offender) — suppressed there
+    assert result.findings == []
+    assert len(result.suppressed) == 1

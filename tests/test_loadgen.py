@@ -30,6 +30,7 @@ from flink_ml_tpu.loadgen import (
     OpenLoopLoadGenerator,
     PoissonArrivals,
     Schedule,
+    StepStats,
     ZipfSizes,
     ramp_schedule,
 )
@@ -723,3 +724,44 @@ class TestAdaptiveServingUnderLoad:
         rec_fraction = rec_gp.fraction(scope)
         assert base_fraction is not None and rec_fraction is not None
         assert rec_fraction >= 0.9 * base_fraction, (base_fraction, rec_fraction)
+
+
+# -----------------------------------------------------------------------------
+# shared-state-guard regression: StepStats aggregates are lock-consistent
+# -----------------------------------------------------------------------------
+
+
+class TestStepStatsConcurrency:
+    def test_aggregate_reads_are_exact_under_concurrent_writers(self):
+        """graftcheck v3 regression: `resolved` / `deadline_misses` used to
+        sum the counters without the lock the writers hold — an
+        inconsistent-lockset torn read. With every access locked, hammering
+        the counters from collector-like threads while the main thread reads
+        must end in exact totals and never a mid-flight impossibility."""
+        import threading as _threading
+
+        stats = StepStats(0, 100.0, 1.0)
+        n_threads, per_thread = 4, 500
+        start = _threading.Barrier(n_threads + 1)
+
+        def writer():
+            start.wait()
+            for _ in range(per_thread):
+                stats.note_completed(0, 1.0)
+                stats.note_injected()
+                stats.note_deadline(1, ServingDeadlineError("x", phase="dispatch"))
+
+        threads = [_threading.Thread(target=writer, daemon=True) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        start.wait()
+        for _ in range(200):  # concurrent aggregate reads: locked snapshots
+            snapshot = stats.resolved
+            assert 0 <= snapshot <= n_threads * per_thread * 3
+        for t in threads:
+            t.join()
+        assert stats.completed == n_threads * per_thread
+        assert stats.injected == n_threads * per_thread
+        assert stats.deadline_misses == n_threads * per_thread
+        assert stats.resolved == n_threads * per_thread * 3
+        assert stats.by_priority[1]["deadline_miss"] == n_threads * per_thread

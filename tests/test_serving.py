@@ -518,3 +518,60 @@ class TestServingMetrics:
         assert scraped[MLMetrics.SERVING_LATENCY_P99_MS] >= scraped[MLMetrics.SERVING_LATENCY_P50_MS]
         sizes = scraped[MLMetrics.SERVING_BATCH_SIZE]
         assert isinstance(sizes, Histogram) and sum(sizes.values()) == 12
+
+
+class TestLocksetRegressions:
+    """graftcheck v3 shared-state-guard regressions: the registry snapshot,
+    the poller's failed-version map, and the warmup template all moved onto
+    consistent locksets — these tests pin the observable contracts."""
+
+    def test_registry_snapshot_pairs_version_and_servable_under_swaps(self):
+        registry = ModelRegistry("ml.serving[t-lockset]")
+        registry.swap(1, "servable-1")
+        stop = threading.Event()
+
+        def swapper():
+            version = 2
+            while not stop.is_set() and version < 400:
+                registry.swap(version, f"servable-{version}")
+                version += 1
+
+        thread = threading.Thread(target=swapper, daemon=True)
+        thread.start()
+        try:
+            for _ in range(1000):
+                version, servable = registry.current()  # one locked snapshot
+                assert servable == f"servable-{version}"
+                v = registry.version
+                assert v is not None and v >= 1
+        finally:
+            stop.set()
+            thread.join(timeout=10.0)
+
+    def test_poller_failed_map_is_lock_guarded_and_skips_known_bad(self, tmp_path):
+        registry = ModelRegistry("ml.serving[t-failedmap]")
+        poller = ModelVersionPoller(
+            str(tmp_path), registry, loader=lambda path: object(), interval_ms=5.0
+        )
+        err = RuntimeError("bad version")
+        poller._record_failed(7, err)
+        assert poller.known_failed(7)
+        assert not poller.known_failed(8)
+        assert poller.failed[7] is err  # introspection surface unchanged
+
+    def test_warmup_template_is_set_once_and_never_overwritten(self):
+        X = np.arange(8 * DIM, dtype=np.float64).reshape(8, DIM)
+        server = InferenceServer(_SlowEcho(), name="t-template-once")
+        try:
+            first = _row(X, 3)
+            server._remember_template(first)
+            again = _row(X, 5)
+            server._remember_template(again)  # must not replace the first
+            with server._template_lock:
+                template = server._warmup_template
+            assert template is not None and len(template) == 1
+            np.testing.assert_array_equal(
+                np.asarray(template["features"]), np.asarray(first["features"])
+            )
+        finally:
+            server.close()

@@ -1,6 +1,10 @@
 #!/usr/bin/env bash
 # Static-analysis gate: graftcheck over the library tree, failing fast with
 # the human-readable report before any test process spins up a device mesh.
+# Runs every registered rule — including the v3 concurrency suite
+# (shared-state-guard, check-then-act, and the whole-program lock-order /
+# blocking-under-lock re-scope over the inferred thread topology) — and the
+# SARIF artifact carries their findings like any other rule's.
 # See docs/static_analysis.md for the rule catalogue and suppression policy.
 #
 # The FULL-TREE run is (and stays) the CI gate. For the local pre-commit

@@ -26,7 +26,9 @@ def _build_parser() -> argparse.ArgumentParser:
         prog="python -m tools.graftcheck",
         description="Whole-program static analysis: layer, jit-purity, lock-order, "
         "fault-point, error-hygiene, recompile-hazard, host-sync, "
-        "blocking-under-lock, elementwise-claim and fusion-tier invariants.",
+        "blocking-under-lock, elementwise-claim, fusion-tier, "
+        "shared-state-guard and check-then-act invariants over the inferred "
+        "thread topology.",
     )
     p.add_argument(
         "targets",

@@ -192,6 +192,14 @@ class Project:
             self._index = ProjectIndex(self.facts())
         return self._index
 
+    @property
+    def topology(self):
+        """The inferred thread topology (``tools/graftcheck/topology.py``) —
+        built from the index once per run, shared by every concurrency rule."""
+        from tools.graftcheck.topology import topology_for
+
+        return topology_for(self)
+
     def save_cache(self) -> None:
         if self.cache:
             self.cache.prune(self.repo_root, [f.rel for f in self.files])

@@ -20,7 +20,12 @@ is held. This module builds, once per run, everything those rules query:
 - **per-file rule facts** extracted in the same AST pass: lock acquisitions
   and calls-while-holding, blocking-operation sites, host-sync sites, jit
   construction / jitted-call sites, branch-on-parameter sites, reduction
-  primitives, KernelSpec constructions, fault trip sites, kernels imports.
+  primitives, KernelSpec constructions, fault trip sites, kernels imports,
+  **thread spawn sites** (``threading.Thread(target=...)`` / ``Timer`` /
+  executor ``submit``/``map``) and **per-``self.X`` attribute accesses**
+  with the lexically held locks and lock-region identity at each access —
+  the raw material of graftcheck v3's thread-topology inference
+  (``tools/graftcheck/topology.py``) and lockset race detection.
 
 Everything per-file is a plain-JSON value keyed by the file's content hash,
 which is what makes the on-disk cache (``tools/graftcheck/cache.py``)
@@ -40,6 +45,16 @@ Marker convention (the annotated-hot-root contract, docs/static_analysis.md):
   boundary (the plan tier's blessed ``device_put``, one per chunk/shard);
   ``device_put`` inside it is exempt from host-sync's hot-region flagging,
   everything else still applies.
+- ``# graftcheck: serialized`` on a ``class`` line — instances of the class
+  cross threads only through an ownership handoff (a queue put/get, an
+  ``Event`` wait, the registry's atomic publish) that orders every access;
+  the lockset race detector trusts the documented handoff instead of
+  demanding a per-instance lock. Inherited by subclasses.
+- ``# graftcheck: owned-by=<role>`` on a ``self.X = ...`` line — the field
+  is deliberately single-writer: only the named thread role (see
+  ``tools/graftcheck/topology.py``) ever writes it after ``__init__``;
+  reads from other roles accept benign staleness. The detector *verifies*
+  the claim: a write from any other role is an error.
 """
 from __future__ import annotations
 
@@ -58,7 +73,7 @@ __all__ = [
 
 #: Bump whenever the shape/semantics of extracted facts change — it is part of
 #: the disk-cache key, so stale caches self-invalidate.
-FACTS_VERSION = 2  # 2: "ingest" joined the marker vocabulary
+FACTS_VERSION = 3  # 3: spawn sites, attr accesses, owned-by / serialized marks
 
 KERNELS_MODULE = "flink_ml_tpu.ops.kernels"
 
@@ -86,7 +101,18 @@ _OS_BLOCKING = {
 }
 _MEMO_DECORATORS = {"cache", "lru_cache"}
 
-KNOWN_MARKS = ("hot-root", "readback", "cold", "ingest")
+KNOWN_MARKS = ("hot-root", "readback", "cold", "ingest", "serialized")
+
+#: key=value marker keys (``disable=`` belongs to the engine's suppressions).
+OWNED_BY_KEY = "owned-by"
+
+#: Container-method calls on a ``self.X`` attribute that mutate the
+#: container — a write for lockset purposes (``self._queue.append(r)``).
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "insert", "add", "discard", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault", "sort",
+    "reverse",
+}
 
 _MARK_RE = re.compile(r"#\s*graftcheck:\s*([A-Za-z0-9_\-,=\s]+)")
 
@@ -116,6 +142,25 @@ def _line_marks(lines: Sequence[str], lineno: int) -> List[str]:
     return out
 
 
+def _line_kv_marks(lines: Sequence[str], lineno: int) -> Dict[str, str]:
+    """``key=value`` graftcheck markers on a source line (``owned-by=role``);
+    ``disable=`` tokens are suppressions and belong to the engine."""
+    if not 1 <= lineno <= len(lines):
+        return {}
+    m = _MARK_RE.search(lines[lineno - 1])
+    if not m:
+        return {}
+    out: Dict[str, str] = {}
+    for tok in m.group(1).split(","):
+        tok = tok.strip()
+        if "=" in tok:
+            key, _, value = tok.partition("=")
+            key, value = key.strip(), value.strip()
+            if key and key != "disable" and value:
+                out[key] = value
+    return out
+
+
 def _empty_facts(rel: str, module: str) -> Dict[str, Any]:
     return {
         "v": FACTS_VERSION,
@@ -134,6 +179,7 @@ def _empty_facts(rel: str, module: str) -> Dict[str, Any]:
         "kernels": {"imports": {}, "outside": [], "specs": []},
         "kspec_ctors": [],
         "trip_sites": [],  # [point name, line]
+        "pool_name_prefixes": [],  # ThreadPoolExecutor thread_name_prefix literals
     }
 
 
@@ -160,6 +206,11 @@ def _annotation_name(node: Optional[ast.AST]) -> Optional[str]:
         return node.value.strip("\"'")
     if isinstance(node, ast.Attribute):
         return node.attr
+    if isinstance(node, ast.Subscript):
+        # Optional[X] types like X for resolution (None adds no methods).
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "Optional":
+            return _annotation_name(node.slice)
     return None
 
 
@@ -197,10 +248,10 @@ def _static_param_names(fn: ast.AST, dec: ast.Call) -> List[str]:
 class _ClassInfo:
     __slots__ = (
         "name", "line", "bases", "locks", "aliases", "attr_types",
-        "event_attrs", "queue_attrs", "thread_attrs",
+        "event_attrs", "queue_attrs", "thread_attrs", "marks", "attr_marks",
     )
 
-    def __init__(self, node: ast.ClassDef):
+    def __init__(self, node: ast.ClassDef, lines: Sequence[str]):
         self.name = node.name
         self.line = node.lineno
         self.bases = [b.id for b in node.bases if isinstance(b, ast.Name)]
@@ -210,6 +261,11 @@ class _ClassInfo:
         self.event_attrs: List[str] = []
         self.queue_attrs: List[str] = []
         self.thread_attrs: List[str] = []
+        #: graftcheck flag marks on the ``class`` line ("serialized").
+        self.marks = _line_marks(lines, node.lineno)
+        #: attr -> owning thread role, from ``# graftcheck: owned-by=<role>``
+        #: on any ``self.X = ...`` line in any method.
+        self.attr_marks: Dict[str, str] = {}
 
     def lock_attr(self, attr: str) -> Optional[str]:
         attr = self.aliases.get(attr, attr)
@@ -225,10 +281,12 @@ class _ClassInfo:
             "event_attrs": self.event_attrs,
             "queue_attrs": self.queue_attrs,
             "thread_attrs": self.thread_attrs,
+            "marks": self.marks,
+            "attr_marks": self.attr_marks,
         }
 
 
-def _collect_class_info(tree: ast.AST) -> Dict[str, _ClassInfo]:
+def _collect_class_info(tree: ast.AST, lines: Sequence[str]) -> Dict[str, _ClassInfo]:
     """Pre-pass: lock/alias/typed-attr structure of every class, gathered from
     every ``self.X = ...`` assignment in any method (the lock-order pass-1
     semantics, now shared by every rule through the index)."""
@@ -236,7 +294,7 @@ def _collect_class_info(tree: ast.AST) -> Dict[str, _ClassInfo]:
     for node in ast.walk(tree):
         if not isinstance(node, ast.ClassDef):
             continue
-        ci = _ClassInfo(node)
+        ci = _ClassInfo(node, lines)
         out[node.name] = ci
         for item in node.body:
             if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -246,11 +304,27 @@ def _collect_class_info(tree: ast.AST) -> Dict[str, _ClassInfo]:
                 for a in item.args.args + item.args.kwonlyargs
             }
             for sub in ast.walk(item):
+                if isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                    attr = _self_attr(sub.target)
+                    if attr is not None:
+                        owner = _line_kv_marks(lines, sub.lineno).get(OWNED_BY_KEY)
+                        if owner:
+                            ci.attr_marks.setdefault(attr, owner)
+                        if isinstance(sub, ast.AnnAssign):
+                            # `self.x: Cls = ...` types the attribute like an
+                            # annotated-param assignment does.
+                            tname = _annotation_name(sub.annotation)
+                            if tname:
+                                ci.attr_types.setdefault(attr, tname)
+                    continue
                 if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
                     continue
                 attr = _self_attr(sub.targets[0])
                 if attr is None:
                     continue
+                owner = _line_kv_marks(lines, sub.lineno).get(OWNED_BY_KEY)
+                if owner:
+                    ci.attr_marks.setdefault(attr, owner)
                 val = sub.value
                 if isinstance(val, ast.Call) and isinstance(val.func, ast.Attribute):
                     ctor = val.func.attr
@@ -293,7 +367,7 @@ class _Extractor:
         self.module = module
         self.lines = source.splitlines()
         self.tree = tree
-        self.classes = _collect_class_info(tree)
+        self.classes = _collect_class_info(tree, self.lines)
         self.facts["classes"] = {n: ci.to_json() for n, ci in self.classes.items()}
         # Aliases for numpy / time / jax.jit spellings in this module (first:
         # the module prepass needs the jit spellings for `x = jit(f)` bindings).
@@ -484,8 +558,18 @@ class _Extractor:
             "spec_trivial": True,
             "spec_refs": [],  # kernel bases referenced inside (kernel_spec only)
             "spec_names": [],  # original imported kernel names referenced inside
+            "spawns": [],  # [kind, line, target ref or None, name hint or None, multi]
+            "attr_accesses": [],  # [attr, "r"|"w"|"m", line, [held], [regions]]
+            "local_types": {},  # annotated locals: `x: Cls = ...` -> {"x": "Cls"}
         }
         self.facts["functions"][qual] = ff
+
+        # Annotated parameters type their locals for method resolution,
+        # like annotated attrs and `x: Cls = ...` assignments do.
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            tname = _annotation_name(p.annotation)
+            if tname and p.arg != "self":
+                ff["local_types"].setdefault(p.arg, tname)
 
         ci = self.classes.get(cls) if cls else None
         returns: List[Optional[str]] = []
@@ -516,7 +600,7 @@ class _Extractor:
         loop: int,
         returns: List[Optional[str]],
     ) -> None:
-        def walk(node: ast.AST, held: List[str], loop: int) -> None:
+        def walk(node: ast.AST, held: List[str], regions: List[str], loop: int, comp: int) -> None:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._extract_function(node, cls=ff["cls"], parent=qual)
                 return
@@ -529,6 +613,7 @@ class _Extractor:
                 )
             if isinstance(node, ast.With):
                 acquired: List[str] = []
+                acquired_regions: List[str] = []
                 for item in node.items:
                     token = self._lock_token(ci, item.context_expr)
                     if token is not None:
@@ -536,32 +621,74 @@ class _Extractor:
                         for h in held:
                             ff["nest_edges"].append([h, token, node.lineno])
                         acquired.append(token)
+                        acquired_regions.append(f"{token}@{node.lineno}")
                     else:
-                        walk(item.context_expr, held, loop)
+                        walk(item.context_expr, held, regions, loop, comp)
                 for stmt in node.body:
-                    walk(stmt, held + acquired, loop)
+                    walk(stmt, held + acquired, regions + acquired_regions, loop, comp)
                 return
             if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
                 if isinstance(node, ast.For):
                     self._note_scalar_loop_var(node, ff)
-                    walk(node.iter, held, loop)
-                    walk(node.target, held, loop)
+                    walk(node.iter, held, regions, loop, comp)
+                    walk(node.target, held, regions, loop, comp)
                 elif isinstance(node, ast.While):
-                    walk(node.test, held, loop)
+                    walk(node.test, held, regions, loop, comp)
                 for stmt in node.body + node.orelse:
-                    walk(stmt, held, loop + 1)
+                    walk(stmt, held, regions, loop + 1, comp)
+                return
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+                # Comprehensions iterate like loops, but only spawn-site
+                # multiplicity cares — the jit-construction loop counter
+                # keeps its original (statement-loop) semantics.
+                for child in ast.iter_child_nodes(node):
+                    walk(child, held, regions, loop, comp + 1)
                 return
             if isinstance(node, (ast.If, ast.IfExp)):
                 self._note_param_branch(node.test, ff)
+            if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                tname = _annotation_name(node.annotation)
+                if tname:  # `stats: StepStats = ...` types the local for resolution
+                    ff["local_types"].setdefault(node.target.id, tname)
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                # `x = Cls(...)` and `x = self.typed_attr` type the local too
+                # (a local binding shadows module singletons either way).
+                val = node.value
+                tname = None
+                if isinstance(val, ast.Call):
+                    tname = _ctor_class_name(val)
+                else:
+                    src_attr = _self_attr(val)
+                    if src_attr is not None and ci is not None:
+                        tname = ci.attr_types.get(src_attr)
+                if tname:
+                    ff["local_types"].setdefault(node.targets[0].id, tname)
             if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
                 ff["reductions"].append(["matmul", node.lineno])
+            if isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if attr is not None:
+                    mode = "w" if isinstance(node.ctx, (ast.Store, ast.Del)) else "r"
+                    ff["attr_accesses"].append(
+                        [attr, mode, node.lineno, list(held), list(regions)]
+                    )
+            if isinstance(node, ast.Subscript) and isinstance(node.ctx, (ast.Store, ast.Del)):
+                attr = _self_attr(node.value)
+                if attr is not None:  # self.X[i] = v mutates the container
+                    ff["attr_accesses"].append(
+                        [attr, "m", node.lineno, list(held), list(regions)]
+                    )
             if isinstance(node, ast.Call):
-                self._record_call(node, ff, ci, held, loop)
+                self._record_call(node, ff, ci, held, regions, loop, comp)
             for child in ast.iter_child_nodes(node):
-                walk(child, held, loop)
+                walk(child, held, regions, loop, comp)
 
         for stmt in fn.body:
-            walk(stmt, list(held), loop)
+            walk(stmt, list(held), [], loop, 0)
 
     def _note_scalar_loop_var(self, node: ast.For, ff: Dict[str, Any]) -> None:
         """Loop variables that are definitely Python scalars: ``for i in
@@ -607,12 +734,23 @@ class _Extractor:
         ff: Dict[str, Any],
         ci: Optional[_ClassInfo],
         held: List[str],
+        regions: List[str],
         loop: int,
+        comp: int,
     ) -> None:
         func = call.func
         ref = _call_ref(func)
         if ref is not None:
             ff["calls"].append([ref, call.lineno, list(held)])
+
+        # thread spawn sites + container-mutator writes
+        self._classify_spawn(call, ff, loop, comp)
+        if isinstance(func, ast.Attribute) and func.attr in MUTATOR_METHODS:
+            tattr = _self_attr(func.value)
+            if tattr is not None:
+                ff["attr_accesses"].append(
+                    [tattr, "m", call.lineno, list(held), list(regions)]
+                )
 
         point = _trip_point(call)
         if point is not None:
@@ -655,6 +793,53 @@ class _Extractor:
             ]
             if loop_args:
                 ff["jitted_call_sites"].append([func.id, call.lineno, loop_args])
+
+    def _classify_spawn(self, call: ast.Call, ff: Dict[str, Any], loop: int, comp: int) -> None:
+        """Thread spawn sites: ``threading.Thread(target=f)`` / ``Timer``
+        constructions and executor ``submit(f, ...)`` / ``map(f, xs)`` calls.
+        ``multi`` marks spawn sites that can create several threads sharing
+        the same state (inside a loop/comprehension, or any pool)."""
+        func = call.func
+        multi = loop > 0 or comp > 0
+        ctor: Optional[str] = None
+        if isinstance(func, ast.Attribute) and func.attr in ("Thread", "Timer"):
+            ctor = func.attr
+        elif isinstance(func, ast.Name) and func.id in ("Thread", "Timer"):
+            ctor = func.id
+        if ctor is not None:
+            target = None
+            hint = None
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target = _call_ref(kw.value)
+                elif kw.arg == "name":
+                    hint = _name_literal(kw.value)
+            if ctor == "Timer" and target is None and len(call.args) >= 2:
+                target = _call_ref(call.args[1])
+            ff["spawns"].append(["thread", call.lineno, target, hint, multi])
+            return
+        if (
+            isinstance(func, ast.Attribute)
+            and (func.attr == "submit" or (func.attr == "map" and len(call.args) >= 2))
+            and call.args
+        ):
+            target = _call_ref(call.args[0])
+            if target is not None:
+                ff["spawns"].append(["pool", call.lineno, target, None, True])
+        ctor_name = None
+        if isinstance(func, ast.Name):
+            ctor_name = func.id
+        elif isinstance(func, ast.Attribute):
+            ctor_name = func.attr
+        if ctor_name == "ThreadPoolExecutor":
+            for kw in call.keywords:
+                if (
+                    kw.arg == "thread_name_prefix"
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                    and kw.value.value not in self.facts["pool_name_prefixes"]
+                ):
+                    self.facts["pool_name_prefixes"].append(kw.value.value)
 
     def _classify_blocking(
         self,
@@ -852,6 +1037,17 @@ def _spec_trivial(fn: ast.AST) -> bool:
     )
 
 
+def _name_literal(node: ast.AST) -> Optional[str]:
+    """Literal (or literal head of an f-string) thread-name hint."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+    return None
+
+
 def _trip_point(call: ast.Call) -> Optional[str]:
     func = call.func
     is_trip = (
@@ -1045,6 +1241,11 @@ class ProjectIndex:
             return None
         if kind == "attr":
             obj, method = ref[1], ref[2]
+            ff = f["functions"].get(qual)
+            if ff is not None:
+                tname = ff.get("local_types", {}).get(obj)
+                if tname:  # annotated locals shadow module-level names
+                    return self._method_node(tname, method, module)
             if obj in f["singletons"]:
                 return self._method_node(f["singletons"][obj], method, module)
             if obj in f["bindings"]:

@@ -4,6 +4,7 @@ import line below. See docs/static_analysis.md for the authoring walkthrough.
 """
 from tools.graftcheck.rules import (  # noqa: F401  (imported for registration)
     blocking_under_lock,
+    check_then_act,
     elementwise_claim,
     error_hygiene,
     fault_points,
@@ -14,4 +15,5 @@ from tools.graftcheck.rules import (  # noqa: F401  (imported for registration)
     layer_deps,
     lock_order,
     recompile_hazard,
+    shared_state_guard,
 )

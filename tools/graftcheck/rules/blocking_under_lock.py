@@ -1,13 +1,22 @@
-"""blocking-under-lock: no blocking work inside serving-tier lock regions.
+"""blocking-under-lock: no blocking work inside contended lock regions.
 
-The serving tier's locks guard tiny state transitions (queue membership, the
-``(version, servable)`` tuple, metric dicts) and sit directly on the request
-path: ``submit`` takes the batcher lock per request, every metric bump takes
-the registry lock. Anything *blocking* done while holding one — a sleep, file
-I/O, an XLA ``.compile()``, a ``device_put`` upload, a thread join, a
-blocking queue/future wait — turns every concurrent request into a convoy
-behind it (and a multi-second XLA compile under a lock is a p99 cliff, the
+The runtime's locks guard tiny state transitions (queue membership, the
+``(version, servable)`` tuple, ledger windows, metric dicts) and sit directly
+on request paths: ``submit`` takes the batcher lock per request, every metric
+bump takes the registry lock, every admission consults the controller.
+Anything *blocking* done while holding one — a sleep, file I/O, an XLA
+``.compile()``, a ``device_put`` upload, a thread join, a blocking
+queue/future wait — turns every concurrent request into a convoy behind it
+(and a multi-second XLA compile under a lock is a p99 cliff, the
 swap-off-the-serving-path discipline PR 2/4 exist to prevent).
+
+Until graftcheck v3 the rule was allowlisted to the serving tier; it now
+runs whole-program, gated by the inferred thread topology
+(``tools/graftcheck/topology.py``): a lock is **contended** when functions
+acquiring it span ≥ 2 thread roles, or one multi-instance role (a pool
+races with itself). Blocking under an uncontended lock (a module-level init
+lock only the main role ever takes) convoys nobody and stays quiet — the
+topology, not a path allowlist, decides what is policed.
 
 The rule composes with lock-order's machinery on the shared index: lock
 regions come from the same per-file facts (``with self._lock:`` nesting with
@@ -34,14 +43,8 @@ from __future__ import annotations
 from typing import Dict, List, Set
 
 from tools.graftcheck.engine import Finding, Project, Rule, register
-from tools.graftcheck.rules.lock_order import SCOPE as LOCK_SCOPE, _lock_id
-
-#: Lock regions policed here: the serving tier (lock-order's scope) plus the
-#: two fast-path modules whose plans execute next to serving locks.
-SCOPE = LOCK_SCOPE + (
-    "flink_ml_tpu/servable/planner.py",
-    "flink_ml_tpu/builder/batch_plan.py",
-)
+from tools.graftcheck.rules.lock_order import _lock_id
+from tools.graftcheck.topology import topology_for
 
 _KIND_LABEL = {
     "sleep": "sleeps",
@@ -54,27 +57,42 @@ _KIND_LABEL = {
 }
 
 
+def contended_locks(project: Project) -> Set[str]:
+    """Canonical ids of locks whose acquirers span ≥ 2 thread roles (or one
+    multi-instance role) — the locks a second thread can actually wait on."""
+    index = project.index
+    topo = topology_for(project)
+    lock_roles: Dict[str, Set[str]] = {}
+    for rel, f in index.files.items():
+        module = f["module"]
+        for qual, ff in f["functions"].items():
+            if not ff["acquires"]:
+                continue
+            roles = topo.roles_of(f"{module}:{qual}")
+            for tok in ff["acquires"]:
+                lock_roles.setdefault(_lock_id(module, ff["cls"], tok), set()).update(roles)
+    return {
+        lock
+        for lock, roles in lock_roles.items()
+        if len(roles) >= 2 or any(topo.is_multi(r) for r in roles)
+    }
+
+
 @register
 class BlockingUnderLockRule(Rule):
     name = "blocking-under-lock"
     severity = "error"
     description = (
         "no blocking work (sleep, file I/O, XLA compile/device_put, queue/"
-        "thread/future waits) inside serving-tier lock regions, directly or "
-        "through any resolved call chain"
+        "thread/future waits) inside contended lock regions anywhere in the "
+        "package, directly or through any resolved call chain"
     )
 
     def run(self, project: Project) -> List[Finding]:
         index = project.index
-        in_scope = [
-            rel
-            for rel in sorted(index.files)
-            if any(rel.startswith(p) for p in SCOPE)
-        ]
+        contended = contended_locks(project)
 
-        # Transitive "this callee may block" facts over the whole call graph
-        # (direct facts from every file — the finding only fires at a scoped
-        # call site made while a lock is held).
+        # Transitive "this callee may block" facts over the whole call graph.
         direct: Dict[str, Set[str]] = {}
         for rel, f in index.files.items():
             module = f["module"]
@@ -87,29 +105,30 @@ class BlockingUnderLockRule(Rule):
         trans = index.transitive_closure(direct)
 
         findings: List[Finding] = []
-        for rel in in_scope:
+        for rel in sorted(index.files):
             f = index.files[rel]
             module = f["module"]
             for qual in sorted(f["functions"]):
                 ff = f["functions"][qual]
                 where = f"{module}.{qual}"
                 for kind, line, detail, held in ff["blocking"]:
-                    if not held:
+                    lock = self._contended_innermost(module, ff, held, contended)
+                    if lock is None:
                         continue
-                    lock = _lock_id(module, ff["cls"], held[-1])
                     findings.append(
                         self.finding(
                             rel,
                             line,
                             f"{where} {_KIND_LABEL[kind]} ({detail}) while "
-                            f"holding {lock} — blocking work under a serving "
-                            "lock convoys every concurrent request; move it "
+                            f"holding {lock} — blocking work under a contended "
+                            "lock convoys every thread waiting on it; move it "
                             "outside the lock region",
                         )
                     )
                 seen: Set[tuple] = set()
                 for ref, line, held in ff["calls"]:
-                    if not held:
+                    lock = self._contended_innermost(module, ff, held, contended)
+                    if lock is None:
                         continue
                     callee = index.resolve_ref(module, ff["cls"], qual, ref)
                     if callee is None:
@@ -117,7 +136,6 @@ class BlockingUnderLockRule(Rule):
                     kinds = trans.get(callee, set())
                     if not kinds:
                         continue
-                    lock = _lock_id(module, ff["cls"], held[-1])
                     if (callee, lock) in seen:
                         continue
                     seen.add((callee, lock))
@@ -133,3 +151,12 @@ class BlockingUnderLockRule(Rule):
                         )
                     )
         return findings
+
+    @staticmethod
+    def _contended_innermost(module, ff, held, contended) -> "str | None":
+        """The innermost *contended* held lock at a site, or None."""
+        for tok in reversed(held):
+            lock = _lock_id(module, ff["cls"], tok)
+            if lock in contended:
+                return lock
+        return None

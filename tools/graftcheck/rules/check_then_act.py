@@ -1,0 +1,132 @@
+"""check-then-act: a guarded decision must not go stale before its write.
+
+A consistent lockset (shared-state-guard) is necessary but not sufficient:
+``if self._n < cap`` under the lock, release, then ``self._n += 1`` under a
+*second* acquisition is still a race — another thread interleaves between
+the regions and the decision is stale by the time the write lands (the
+classic TOCTOU lost-update). This rule flags, **within one function**, a
+read of a shared, lock-guarded attribute in one lock region followed by a
+write to the same attribute under a separate acquisition of the same lock.
+
+Composition: the shared substrate (per-attr accesses, effective locksets,
+thread roles) comes from shared-state-guard's class-state collection; only
+attributes that are actually *shared* (≥ 2 roles, or a multi role) and
+*consistently guarded* are candidates — an unguarded attribute is already a
+shared-state-guard error, and a single-role attribute cannot interleave.
+
+Scope is intra-procedural by design (the RacerD trade-off): a read region in
+one method and a write region in another is a normal guarded API (``should_
+shed`` deciding, ``record_shed`` recording); the atomicity obligation the
+rule enforces is the one a *single* function visibly splits.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftcheck.engine import Finding, Project, Rule, register
+from tools.graftcheck.rules.lock_order import _lock_id
+from tools.graftcheck.rules.shared_state_guard import (
+    AttrAccess,
+    collect_class_states,
+    shared_roles,
+)
+from tools.graftcheck.topology import topology_for
+
+
+def _region_for(access: AttrAccess, lock: str, module: str, cls: str) -> Optional[str]:
+    """The lexical region id of ``lock`` at this access, or None when the
+    lock is only held through the interprocedural context (the caller's
+    region — not splittable within this function)."""
+    for region in access.regions:
+        token, _, line = region.rpartition("@")
+        if _lock_id(module, cls, token) == lock:
+            return region
+    return None
+
+
+@register
+class CheckThenActRule(Rule):
+    name = "check-then-act"
+    severity = "error"
+    description = (
+        "a read-decide-write of one shared, guarded attribute must not be "
+        "split across separate acquisitions of its lock within one function"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        topo = topology_for(project)
+        findings: List[Finding] = []
+        for state in collect_class_states(project):
+            if state.cfacts.get("attr_marks"):
+                marked = set(state.cfacts["attr_marks"])
+            else:
+                marked = set()
+            for attr in sorted(state.attrs):
+                accesses = [a for a in state.attrs[attr] if not a.in_init]
+                if not accesses or attr in marked:
+                    continue
+                if not any(a.is_write for a in accesses):
+                    continue
+                roles = shared_roles(topo, accesses)
+                if roles is None:
+                    continue
+                common = frozenset.intersection(*(a.locks for a in accesses))
+                if not common:
+                    continue  # shared-state-guard's problem, not ours
+                for lock in sorted(common):
+                    findings.extend(
+                        self._check_attr(state, attr, lock, accesses, topo, roles)
+                    )
+        return findings
+
+    def _check_attr(self, state, attr, lock, accesses, topo, roles) -> List[Finding]:
+        # Group this attribute's accesses per function, then per lexical
+        # region of `lock` within that function.
+        by_fn: Dict[str, List[AttrAccess]] = {}
+        for a in accesses:
+            by_fn.setdefault(a.qual, []).append(a)
+        out: List[Finding] = []
+        for qual in sorted(by_fn):
+            regions: Dict[str, Dict[str, int]] = {}
+            for a in by_fn[qual]:
+                region = _region_for(a, lock, state.module, state.cls)
+                if region is None:
+                    continue
+                info = regions.setdefault(region, {})
+                if a.is_write:
+                    info["write"] = min(info.get("write", a.line), a.line)
+                else:
+                    info["read"] = min(info.get("read", a.line), a.line)
+            if len(regions) < 2:
+                continue
+            read_only = [
+                (info["read"], region)
+                for region, info in regions.items()
+                if "read" in info and "write" not in info
+            ]
+            writes = [
+                (info["write"], region)
+                for region, info in regions.items()
+                if "write" in info
+            ]
+            if not read_only or not writes:
+                continue
+            read_line, read_region = min(read_only)
+            later = [(line, region) for line, region in writes if line > read_line and region != read_region]
+            if not later:
+                continue
+            write_line, _ = min(later)
+            out.append(
+                self.finding(
+                    state.rel,
+                    write_line,
+                    f"check-then-act: {state.module}.{qual} reads "
+                    f"{state.cls}.{attr} under {lock} (line {read_line}) and "
+                    f"writes it under a separate acquisition (line {write_line}) "
+                    f"— thread roles [{topo.describe(roles)}] can interleave "
+                    "between the two regions and the decision goes stale; merge "
+                    "the read and the write into one lock region (or re-validate "
+                    "before writing)",
+                )
+            )
+        return out

@@ -1,11 +1,20 @@
-"""lock-order: the lock-acquisition graph of the serving tier must be acyclic.
+"""lock-order: the whole-program lock-acquisition graph must be acyclic.
 
-The serving runtime holds real locks on real request paths — the batcher's
-queue lock, the registry's swap lock, the server's template lock, and the two
-metrics locks every one of them calls into. A cycle in the "acquired while
-holding" relation is a deadlock waiting for the right interleaving, and no
-test reliably catches it: this rule derives the graph statically and fails on
-any cycle (including self-loops — ``threading.Lock`` is non-reentrant).
+The runtime holds real locks on real request paths — the batcher's queue
+lock, the registry's swap lock, the adaptive controller and its goodput
+ledger, the loadgen step counters, the trace ring, the config/faults/metrics
+registries, and the module-level mesh/native/readback-pool locks. A cycle in
+the "acquired while holding" relation is a deadlock waiting for the right
+interleaving, and no test reliably catches it: this rule derives the graph
+statically and fails on any cycle (including self-loops —
+``threading.Lock`` is non-reentrant).
+
+Until graftcheck v3 the graph was hand-scoped to ``serving/`` +
+``metrics.py`` (5 nodes); the inferred thread topology
+(``tools/graftcheck/topology.py``) made whole-program scoping the default:
+every lock any thread role can reach joins the acyclicity contract, and the
+historical serving graph is asserted (in tests) to be a subgraph of this
+one.
 
 Since graftcheck v2 the rule is a thin composition over the **shared project
 index** (``tools/graftcheck/index.py``): lock nodes, ``with``-nesting edges
@@ -31,11 +40,9 @@ callee contributes no edge.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from tools.graftcheck.engine import Finding, Project, Rule, register
-
-SCOPE = ("flink_ml_tpu/serving/", "flink_ml_tpu/metrics.py")
 
 
 @dataclass
@@ -77,10 +84,14 @@ def _lock_id(module: str, cls, token: str) -> str:
     return f"{module}.{token[len('mod.'):]}"
 
 
-def build_lock_graph(project: Project, scope: Sequence[str] = SCOPE) -> LockGraph:
+def build_lock_graph(project: Project, scope: Optional[Sequence[str]] = None) -> LockGraph:
+    """The whole-program lock graph (``scope`` narrows to path prefixes for
+    targeted analysis; the rule itself always runs unscoped)."""
     index = project.index
     in_scope = [
-        rel for rel in sorted(index.files) if any(rel.startswith(p) for p in scope)
+        rel
+        for rel in sorted(index.files)
+        if scope is None or any(rel.startswith(p) for p in scope)
     ]
 
     nodes: Dict[str, Tuple[str, int]] = {}
@@ -155,7 +166,7 @@ class LockOrderRule(Rule):
     name = "lock-order"
     severity = "error"
     description = (
-        "the serving-tier lock-acquisition graph (with-nesting + cross-module "
+        "the whole-program lock-acquisition graph (with-nesting + cross-module "
         "call edges) must be acyclic"
     )
 

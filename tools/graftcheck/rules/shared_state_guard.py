@@ -1,0 +1,281 @@
+"""shared-state-guard: RacerD-style lockset race detection over the package.
+
+A field that two thread roles can touch — the client thread appending to the
+batcher queue while the batcher loop drains it, the poller swapping a version
+while a batch snapshots it, N loadgen collectors bumping one counter — is
+only safe under a *consistent, non-empty lockset*: every access holds the
+same lock. A single unguarded read is enough for a torn snapshot or a lost
+update, and no soak test reliably catches the interleaving; this rule
+convicts it statically, per class, from the index's per-``self.X`` access
+facts and the inferred thread topology (``tools/graftcheck/topology.py``).
+
+Per class, each attribute accessed outside ``__init__`` must satisfy one of:
+
+- **consistent lockset** — the intersection of locks held (lexically, or
+  *definitely* held at every resolved call site reaching the method — the
+  interprocedural lock context) across all accesses is non-empty;
+- **immutable after publish** — written only in ``__init__`` (the ownership
+  assumption: an object under construction is unpublished);
+- **inherently safe** — the attribute is itself a ``Lock`` / ``Condition`` /
+  ``Event`` / ``Queue`` / ``Thread``, or holds a project class instance
+  (internally synchronized state is that class's own analysis problem —
+  mutations through the reference are reads of the reference here);
+- **single-writer annotation** — ``# graftcheck: owned-by=<role>`` on the
+  field's definition line: only the named role writes, reads elsewhere
+  accept benign staleness. The claim is *verified* — a write from any other
+  role, or naming a multi-instance role (which races with itself), is an
+  error;
+- **ownership handoff** — the class (or a base) is marked
+  ``# graftcheck: serialized``: instances cross threads only through a
+  documented synchronization point that orders every access.
+
+The race criterion needs concurrency evidence: accesses from ≥ 2 distinct
+roles, or from one *multi* role (a pool / looped spawn shares state between
+its own instances). Objects only the implicit ``main`` role touches are
+assumed externally confined — flagging every single-threaded model's fields
+would bury the real races.
+
+Known blind spots (deliberate, documented in docs/static_analysis.md):
+accesses through non-``self`` references (``req._state`` from the batcher),
+module-level globals, and roles lost through callable-attribute indirection
+(``self._execute = execute``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tools.graftcheck.engine import Finding, Project, Rule, register
+from tools.graftcheck.rules.lock_order import _lock_id
+from tools.graftcheck.topology import MAIN_ROLE, lock_context, topology_for
+
+#: Builtin containers whose mutator-method calls are writes; a project-class
+#: attribute's method calls are just reads of the reference.
+BUILTIN_CONTAINERS = {
+    "deque", "dict", "list", "set", "defaultdict", "OrderedDict", "Counter",
+}
+
+
+class AttrAccess:
+    __slots__ = ("mode", "line", "locks", "regions", "node", "qual", "roles", "in_init")
+
+    def __init__(self, mode, line, locks, regions, node, qual, roles, in_init):
+        self.mode = mode  # "r" | "w" | "m"
+        self.line = line
+        self.locks = locks  # frozenset of canonical lock ids (lexical ∪ context)
+        self.regions = regions  # raw lexical region ids ("self._lock@218")
+        self.node = node
+        self.qual = qual
+        self.roles = roles
+        self.in_init = in_init
+
+    @property
+    def is_write(self) -> bool:
+        return self.mode in ("w", "m")
+
+
+class ClassState:
+    __slots__ = ("rel", "module", "cls", "cfacts", "attrs")
+
+    def __init__(self, rel, module, cls, cfacts, attrs):
+        self.rel = rel
+        self.module = module
+        self.cls = cls
+        self.cfacts = cfacts
+        self.attrs = attrs  # attr -> List[AttrAccess]
+
+
+def _is_serialized(index, module: str, cname: str, seen: Optional[Set[str]] = None) -> bool:
+    if seen is None:
+        seen = set()
+    if cname in seen:
+        return False
+    seen.add(cname)
+    hit = index.resolve_class(cname, module)
+    if hit is None:
+        return False
+    mod, cfacts = hit
+    if "serialized" in cfacts.get("marks", []):
+        return True
+    return any(_is_serialized(index, mod, base, seen) for base in cfacts.get("bases", []))
+
+
+def collect_class_states(project: Project) -> List[ClassState]:
+    """Per-class shared-state accesses with effective locksets and thread
+    roles — the shared substrate of shared-state-guard and check-then-act."""
+    cached = getattr(project, "_class_states", None)
+    if cached is not None:
+        return cached
+    index = project.index
+    topo = topology_for(project)
+    ctx = lock_context(index, _lock_id)
+
+    states: List[ClassState] = []
+    for rel in sorted(index.files):
+        f = index.files[rel]
+        module = f["module"]
+        if not f["classes"]:
+            continue
+        by_cls: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
+        method_names: Dict[str, Set[str]] = {}
+        for qual, ff in f["functions"].items():
+            if not ff["cls"]:
+                continue
+            by_cls.setdefault(ff["cls"], []).append((qual, ff))
+            if ff["parent"] is None:
+                method_names.setdefault(ff["cls"], set()).add(ff["name"])
+        for cname, cfacts in f["classes"].items():
+            if _is_serialized(index, module, cname):
+                continue
+            safe = (
+                set(cfacts["locks"])
+                | set(cfacts["aliases"])
+                | set(cfacts["event_attrs"])
+                | set(cfacts["queue_attrs"])
+                | set(cfacts["thread_attrs"])
+            )
+            methods = method_names.get(cname, set())
+            attrs: Dict[str, List[AttrAccess]] = {}
+            for qual, ff in by_cls.get(cname, []):
+                node = f"{module}:{qual}"
+                roles = frozenset(topo.roles_of(node))
+                parts = qual.split(".")
+                in_init = len(parts) > 1 and parts[1] == "__init__"
+                fn_ctx = ctx.get(node, set())
+                for attr, mode, line, held, regions in ff.get("attr_accesses", []):
+                    if attr in safe or attr in methods:
+                        continue
+                    if mode == "m":
+                        tname = cfacts["attr_types"].get(attr)
+                        if tname and tname not in BUILTIN_CONTAINERS and index.resolve_class(tname, module):
+                            mode = "r"  # project-class reference: internally synchronized
+                    locks = frozenset(
+                        {_lock_id(module, cname, tok) for tok in held} | fn_ctx
+                    )
+                    attrs.setdefault(attr, []).append(
+                        AttrAccess(mode, line, locks, list(regions), node, qual, roles, in_init)
+                    )
+            if attrs:
+                states.append(ClassState(rel, module, cname, cfacts, attrs))
+    project._class_states = states
+    return states
+
+
+def shared_roles(topo, accesses: List[AttrAccess]) -> Optional[Set[str]]:
+    """The role set making this attribute race-eligible, or None when the
+    accesses lack concurrency evidence (single non-multi role)."""
+    roles: Set[str] = set()
+    for a in accesses:
+        roles |= a.roles
+    if len(roles) >= 2 or any(topo.is_multi(r) for r in roles):
+        return roles
+    return None
+
+
+def _site(a: AttrAccess) -> str:
+    verb = {"r": "read", "w": "written", "m": "mutated"}[a.mode]
+    lock = f"holding {sorted(a.locks)[0]}" if a.locks else "with NO lock"
+    return f"{verb} in {a.qual} (line {a.line}, {lock})"
+
+
+@register
+class SharedStateGuardRule(Rule):
+    name = "shared-state-guard"
+    severity = "error"
+    description = (
+        "every class attribute reachable from two thread roles (or one pool "
+        "role) must have a consistent lockset, be immutable after __init__, "
+        "be an inherently-safe primitive, or carry a verified "
+        "`# graftcheck: owned-by=<role>` annotation"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        topo = topology_for(project)
+        findings: List[Finding] = []
+        for state in collect_class_states(project):
+            marks = state.cfacts.get("attr_marks", {})
+            for attr in sorted(state.attrs):
+                accesses = [a for a in state.attrs[attr] if not a.in_init]
+                if not accesses or not any(a.is_write for a in accesses):
+                    continue  # immutable after publish (or never accessed live)
+                roles = shared_roles(topo, accesses)
+                if roles is None:
+                    continue
+                label = f"{state.cls}.{attr}"
+                owner = marks.get(attr)
+                if owner is not None:
+                    findings.extend(
+                        self._check_owned(state, label, owner, accesses, topo, roles)
+                    )
+                    continue
+                common = frozenset.intersection(*(a.locks for a in accesses))
+                if common:
+                    continue
+                findings.append(self._race_finding(state, label, accesses, topo, roles))
+        return findings
+
+    def _check_owned(self, state, label, owner, accesses, topo, roles) -> List[Finding]:
+        out: List[Finding] = []
+        if owner != MAIN_ROLE and owner not in topo.roles:
+            first = min(accesses, key=lambda a: a.line)
+            out.append(
+                self.finding(
+                    state.rel,
+                    first.line,
+                    f"{label} is annotated `owned-by={owner}` but no such thread "
+                    f"role exists (inferred roles: "
+                    f"{topo.describe(set(topo.roles) | {MAIN_ROLE})})",
+                )
+            )
+            return out
+        if topo.is_multi(owner):
+            first = min(accesses, key=lambda a: a.line)
+            out.append(
+                self.finding(
+                    state.rel,
+                    first.line,
+                    f"{label} is annotated `owned-by={owner}`, but {owner} is a "
+                    "multi-instance role (pool/looped spawn) — its threads race "
+                    "with each other, so single-writer ownership cannot hold",
+                )
+            )
+            return out
+        for a in accesses:
+            if a.is_write and not (a.roles <= {owner}):
+                out.append(
+                    self.finding(
+                        state.rel,
+                        a.line,
+                        f"{label} is annotated `owned-by={owner}` but is "
+                        f"{_site(a)} on thread role(s) "
+                        f"{topo.describe(a.roles - {owner})} — the single-writer "
+                        "claim is violated; guard the field with a lock instead",
+                    )
+                )
+        return out
+
+    def _race_finding(self, state, label, accesses, topo, roles) -> Finding:
+        # The most frequent lock across accesses (if any) is presumed the
+        # intended guard; accesses missing it are the offenders we anchor on.
+        freq: Dict[str, int] = {}
+        for a in accesses:
+            for lock in a.locks:
+                freq[lock] = freq.get(lock, 0) + 1
+        majority = max(freq, key=lambda k: (freq[k], k)) if freq else None
+        if majority is not None:
+            offenders = [a for a in accesses if majority not in a.locks]
+            kind = f"inconsistent lockset (most accesses hold {majority})"
+        else:
+            offenders = [a for a in accesses if a.is_write] or accesses
+            kind = "empty lockset"
+        offenders.sort(key=lambda a: a.line)
+        shown = "; ".join(_site(a) for a in offenders[:3])
+        more = f" (+{len(offenders) - 3} more)" if len(offenders) > 3 else ""
+        return self.finding(
+            state.rel,
+            offenders[0].line,
+            f"data race candidate: {label} is shared across thread roles "
+            f"[{topo.describe(roles)}] with {kind}: {shown}{more} — guard every "
+            "access with one lock, make the field immutable after __init__, or "
+            "annotate its definition with `# graftcheck: owned-by=<role>` if it "
+            "is deliberately single-writer",
+        )

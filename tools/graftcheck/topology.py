@@ -1,0 +1,245 @@
+"""Thread-topology inference — graftcheck v3's whole-program concurrency map.
+
+The runtime rebuilt Flink's managed concurrency on raw Python threads: the
+micro-batcher loop, the model-version poller, the loadgen driver's collector
+pool, the batch-plan readback pool, plus every client thread calling the
+public API. Which *thread role* a function can run on is a whole-program
+property — a spawn site in one module, the target resolved through the call
+graph into five others — and it is the input every lockset question needs:
+two accesses only race when two different threads (or two instances of a
+multi-threaded role) can make them.
+
+This module derives, from the shared project index
+(``tools/graftcheck/index.py``), with **no new parsing**:
+
+- **Roles** — one per resolved spawn site (``threading.Thread(target=...)``,
+  ``Timer``, executor ``submit``/``map``), named from the thread's literal
+  name prefix (``name=f"micro-batcher[{scope}]"`` → ``micro-batcher``), the
+  module's ``ThreadPoolExecutor(thread_name_prefix=...)`` for pool workers,
+  or the target function as a fallback. A role is ``multi`` when the spawn
+  site can create several threads sharing state (spawned in a loop or
+  comprehension, or any pool) — a multi role races with *itself*. The
+  implicit ``main`` role is every caller thread entering through the public
+  API.
+- **fn_roles** — for every function, the set of roles it can run on:
+  spawn-target reachability over the resolved call graph (markers like
+  ``cold``/``readback`` do NOT stop this traversal — a cold function called
+  from the poller thread still runs on the poller), plus ``main``
+  reachability seeded from every un-called, un-spawned top-level function
+  (the public API surface). A function no traversal reaches defaults to
+  ``main`` — everything is at least caller-callable.
+- **Lock context** — for every function, the set of locks *definitely held*
+  at every resolved call site reaching it (the RacerD-style interprocedural
+  lockset): a helper only ever invoked under ``with self._lock`` inherits
+  that lock for its own attribute accesses. Computed as the greatest
+  fixpoint of ``ctx(f) = ⋂ over call sites (locks held at site ∪
+  ctx(caller))``; a function with no resolved callers (an entry point) has
+  an empty context.
+
+Known blind spots (documented, deliberately unhandled): targets stored in
+callable attributes (``self._execute = execute``) don't propagate roles
+through the callback, module-level globals are outside the per-class lockset
+analysis, and ``fn`` parameters handed to a pool stay unresolved (reported in
+``unresolved_spawns``).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tools.graftcheck.index import ProjectIndex
+
+__all__ = ["MAIN_ROLE", "ThreadRole", "ThreadTopology", "build_topology", "topology_for", "lock_context"]
+
+#: The implicit role of every caller thread entering through the public API.
+MAIN_ROLE = "main"
+
+_ROLE_STRIP = re.compile(r"[^A-Za-z0-9_]+$")
+
+
+class ThreadRole:
+    """One spawn site's thread role."""
+
+    __slots__ = ("name", "rel", "line", "target", "kind", "multi")
+
+    def __init__(self, name: str, rel: str, line: int, target: Optional[str], kind: str, multi: bool):
+        self.name = name
+        self.rel = rel  # file containing the spawn site
+        self.line = line
+        self.target = target  # resolved call-graph node, or None
+        self.kind = kind  # "thread" | "pool"
+        self.multi = multi
+
+    def __repr__(self) -> str:
+        return f"ThreadRole({self.name!r}, target={self.target!r}, multi={self.multi})"
+
+
+class ThreadTopology:
+    """Resolved whole-program thread map: roles, per-function role sets."""
+
+    def __init__(
+        self,
+        roles: Dict[str, ThreadRole],
+        fn_roles: Dict[str, Set[str]],
+        unresolved_spawns: List[Tuple[str, int, list]],
+    ):
+        self.roles = roles
+        self.fn_roles = fn_roles
+        #: spawn sites whose target could not be resolved: (rel, line, ref)
+        self.unresolved_spawns = unresolved_spawns
+
+    def roles_of(self, node: str) -> Set[str]:
+        """Role names a call-graph node can run on (``{"main"}`` default)."""
+        return self.fn_roles.get(node, {MAIN_ROLE})
+
+    def is_multi(self, role_name: str) -> bool:
+        role = self.roles.get(role_name)
+        return role.multi if role is not None else False
+
+    def describe(self, names) -> str:
+        """Human form of a role set for findings: sorted, multi-instance
+        roles marked ``xN``-style with ``(pool)``."""
+        out = []
+        for name in sorted(names):
+            out.append(f"{name}(multi)" if self.is_multi(name) else name)
+        return ", ".join(out)
+
+
+def _clean_role(hint: str) -> str:
+    return _ROLE_STRIP.sub("", hint.strip())
+
+
+def _role_name(
+    kind: str,
+    hint: Optional[str],
+    target: Optional[str],
+    module: str,
+    pool_prefixes: List[str],
+) -> str:
+    if hint:
+        cleaned = _clean_role(hint)
+        if cleaned:
+            return cleaned
+    if kind == "pool":
+        if len(set(pool_prefixes)) == 1:
+            return _clean_role(pool_prefixes[0]) or f"pool[{module.split('.')[-1]}]"
+        return f"pool[{module.split('.')[-1]}]"
+    if target is not None:
+        qual = target.partition(":")[2]
+        return f"thread:{qual.split('.<locals>.')[-1]}"
+    return f"thread[{module.split('.')[-1]}]"
+
+
+def build_topology(index: ProjectIndex) -> ThreadTopology:
+    roles: Dict[str, ThreadRole] = {}
+    unresolved: List[Tuple[str, int, list]] = []
+    target_roles: Dict[str, List[str]] = {}  # target node -> role names
+
+    for rel in sorted(index.files):
+        f = index.files[rel]
+        module = f["module"]
+        prefixes = f.get("pool_name_prefixes", [])
+        for qual in sorted(f["functions"]):
+            ff = f["functions"][qual]
+            for kind, line, ref, hint, multi in ff.get("spawns", []):
+                target = (
+                    index.resolve_ref(module, ff["cls"], qual, ref)
+                    if ref is not None
+                    else None
+                )
+                if target is None:
+                    unresolved.append((rel, line, ref))
+                    continue
+                name = _role_name(kind, hint, target, module, prefixes)
+                existing = roles.get(name)
+                if existing is None:
+                    roles[name] = ThreadRole(name, rel, line, target, kind, multi)
+                else:
+                    # Same role name spawned twice (a second site or a loop
+                    # re-spawn): merge conservatively — it is multi now.
+                    existing.multi = existing.multi or multi or existing.target != target
+                target_roles.setdefault(target, []).append(name)
+
+    # Spawn-target reachability per role. Stop marks do NOT apply: thread
+    # identity follows calls regardless of hot/cold annotations.
+    fn_roles: Dict[str, Set[str]] = {}
+    for target, names in target_roles.items():
+        for node in index.reachable([target], stop_marks=()):
+            fn_roles.setdefault(node, set()).update(names)
+
+    # The main role: everything reachable from an entry point — a top-level
+    # function nobody (resolved) calls and nothing spawns. Spawn targets are
+    # excluded as seeds but not as traversal interior: a directly *called*
+    # spawn target also runs on the caller's thread.
+    has_in_edge: Set[str] = set()
+    for outs in index.edges.values():
+        for tgt, _line in outs:
+            has_in_edge.add(tgt)
+    spawn_targets = set(target_roles)
+    seeds = [
+        node
+        for _f, node, ff in index.iter_functions()
+        if ff["parent"] is None and node not in has_in_edge and node not in spawn_targets
+    ]
+    for node in index.reachable(seeds, stop_marks=()):
+        fn_roles.setdefault(node, set()).add(MAIN_ROLE)
+
+    # Anything no traversal reached is still caller-callable.
+    for _f, node, _ff in index.iter_functions():
+        fn_roles.setdefault(node, {MAIN_ROLE})
+
+    return ThreadTopology(roles, fn_roles, unresolved)
+
+
+def lock_context(index: ProjectIndex, lock_id) -> Dict[str, Set[str]]:
+    """Locks definitely held at *every* resolved call site reaching each
+    function — greatest fixpoint of ``ctx(f) = ⋂ (site held ∪ ctx(caller))``
+    over the call graph. ``lock_id(module, cls, token)`` canonicalizes a
+    per-file held token (``self._lock`` / ``mod.NAME``) to a global lock id.
+
+    A helper only ever invoked under a lock (``MicroBatcher._reap_locked``)
+    inherits that lock for its attribute accesses; a function with any
+    lock-free resolved caller — or no resolved caller at all — has an empty
+    context.
+    """
+    # call sites per callee: callee -> [(caller node, frozenset(held ids))]
+    sites: Dict[str, List[Tuple[str, frozenset]]] = {}
+    all_locks: Set[str] = set()
+    for rel in index.files:
+        f = index.files[rel]
+        module = f["module"]
+        for qual, ff in f["functions"].items():
+            caller = f"{module}:{qual}"
+            for ref, _line, held in ff["calls"]:
+                callee = index.resolve_ref(module, ff["cls"], qual, ref)
+                if callee is None:
+                    continue
+                held_ids = frozenset(lock_id(module, ff["cls"], tok) for tok in held)
+                all_locks |= held_ids
+                sites.setdefault(callee, []).append((caller, held_ids))
+
+    top = frozenset(all_locks)
+    ctx: Dict[str, Set[str]] = {callee: set(top) for callee in sites}
+    changed = True
+    while changed:
+        changed = False
+        for callee, callers in sites.items():
+            new: Optional[Set[str]] = None
+            for caller, held_ids in callers:
+                inherited = set(held_ids) | ctx.get(caller, set())
+                new = inherited if new is None else (new & inherited)
+            if new is None:
+                new = set()
+            if new != ctx[callee]:
+                ctx[callee] = new
+                changed = True
+    return ctx
+
+
+def topology_for(project) -> ThreadTopology:
+    """The project's topology, built once per run and cached on the project."""
+    topo = getattr(project, "_topology", None)
+    if topo is None:
+        topo = build_topology(project.index)
+        project._topology = topo
+    return topo
