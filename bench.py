@@ -2269,6 +2269,150 @@ def bench_tracing_overhead():
     }
 
 
+def bench_journal_overhead():
+    """Flight-recorder acceptance row (docs/observability.md): the d=256
+    logistic fast path with the always-on journal disabled vs enabled (the
+    shipped default), as a paired median-of-ratios measurement.
+
+    Protocol: 15 alternating off/on leg pairs (400 closed-loop requests
+    each), per-leg p50 + mean latency, and the reported overhead is the
+    MEDIAN of the 15 pairwise on/off ratios. One leg on this 1-core box
+    carries heavy-tailed scheduler noise (individual legs swing >15% in
+    both directions — the per-pair ratios are recorded); pairing adjacent
+    legs cancels slow drift and the median rejects the outlier legs, which
+    best-of-N and single-pair protocols measurably do not here.
+
+    The journal records *decisions*, not requests — the steady fast path
+    reaches zero emit() sites, so the expected delta is zero by
+    construction; this row prices the residual (the writer thread existing,
+    the disabled-vs-armed branch) and the separate overload leg prices the
+    emit sites that DO fire under load (sheds/deadline misses at 2x
+    saturation: one bounded-queue enqueue each, writes on the
+    flight-recorder thread — tests/test_telemetry.py asserts the thread
+    discipline and the dispatch path's zero-write contract).
+    """
+    import statistics
+    import tempfile
+
+    import flink_ml_tpu.telemetry as telemetry
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.loadgen import OpenLoopLoadGenerator, ZipfSizes, ramp_schedule
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.servable.lib import LogisticRegressionModelServable
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    rng = np.random.default_rng(29)
+    dim = 256
+    X = rng.standard_normal((4096, dim)).astype(np.float32)
+    requests = 400
+    req_rows = 8
+
+    def make_server(name, queue_capacity=1024):
+        servable = LogisticRegressionModelServable().set_features_col("features")
+        servable.coefficient = rng.standard_normal(dim).astype(np.float32)
+        return InferenceServer(
+            servable,
+            name=name,
+            serving_config=ServingConfig(
+                max_batch_size=64,
+                max_delay_ms=0.0,  # single client: coalescing buys nothing
+                queue_capacity_rows=queue_capacity,
+                default_timeout_ms=30_000,
+                shed_sustain_ms=10.0,
+            ),
+            warmup_template=DataFrame.from_dict({"features": X[:1]}),
+        )
+
+    def leg(name):
+        """(p50 ms, mean ms/request) of one closed-loop leg."""
+        server = make_server(name)
+        try:
+            t0 = time.perf_counter()
+            for i in range(requests):
+                j = (i * 61) % (X.shape[0] - req_rows)
+                server.predict(DataFrame.from_dict({"features": X[j : j + req_rows]}))
+            mean_ms = (time.perf_counter() - t0) / requests * 1000.0
+            hist = metrics.histogram(server.scope, MLMetrics.SERVING_LATENCY_MS)
+            return hist.quantile(0.5), mean_ms
+        finally:
+            server.close()
+
+    pairs = 15
+    off_p50s, on_p50s, p50_ratios, mean_ratios = [], [], [], []
+    try:
+        telemetry.configure(enabled=False)
+        leg("bench-journal-warm")  # discarded: pays the process-wide compiles
+        for r in range(pairs):
+            order = ("off", "on") if r % 2 == 0 else ("on", "off")
+            results = {}
+            for mode in order:
+                if mode == "off":
+                    telemetry.configure(enabled=False)
+                else:
+                    telemetry.configure(tempfile.mkdtemp(prefix="bench-journal-"))
+                results[mode] = leg(f"bench-journal-{mode}-{r}")
+            off_p50s.append(results["off"][0])
+            on_p50s.append(results["on"][0])
+            p50_ratios.append(results["on"][0] / results["off"][0])
+            mean_ratios.append(results["on"][1] / results["off"][1])
+        # Overload leg (journal on): ~2x a measured saturation, where the
+        # shed/deadline decision sites actually emit.
+        recorder = telemetry.configure(tempfile.mkdtemp(prefix="bench-journal-"))
+        sizes = ZipfSizes((1, 2, 4, 8, 16, 32), alpha=1.5)
+        server = make_server("bench-journal-overload", queue_capacity=256)
+
+        def request(rows):
+            j = int(rng.integers(0, X.shape[0] - rows))
+            return DataFrame.from_dict({"features": X[j : j + rows]})
+
+        overload_rps = 8000.0  # ~2x this head's measured ~4k rps saturation
+        try:
+            sched = ramp_schedule(
+                [(overload_rps, 1.0)], sizes=sizes, priority_mix={0: 0.7, 1: 0.3}, seed=9
+            )
+            report = OpenLoopLoadGenerator(
+                sched, request, timeout_ms={0: 30_000.0, 1: 250.0}
+            ).run(server)
+            step = report.steps[0]
+        finally:
+            server.close()
+        recorder.flush(10.0)
+        overload = {
+            "offered_rps": overload_rps,
+            "latency_p50_ms": round(step.latency_ms(0.5), 3),
+            "shed": step.shed,
+            "deadline_misses": step.deadline_misses,
+            "journal_events": recorder.seq,
+            "journal_dropped": recorder.dropped,
+        }
+    finally:
+        telemetry.configure(None)
+    p50_med = statistics.median(p50_ratios)
+    mean_med = statistics.median(mean_ratios)
+    return {
+        "name": "journal_overhead_serving_microbatch",
+        "pairs": pairs,
+        "requests_per_leg": requests,
+        "request_rows": req_rows,
+        "off": {"median_latency_p50_ms": round(statistics.median(off_p50s), 3)},
+        "on": {"median_latency_p50_ms": round(statistics.median(on_p50s), 3)},
+        "p50_pairwise_ratios": [round(x, 3) for x in p50_ratios],
+        "p50_overhead_pct": round(100.0 * (p50_med - 1.0), 2),
+        "mean_latency_overhead_pct": round(100.0 * (mean_med - 1.0), 2),
+        "overload_on": overload,
+        "note": "d=256 logistic fast path, single-client closed loop; off = "
+        "observability.journal disabled, on = the shipped always-on "
+        "default. Overhead = median of 15 pairwise on/off ratios (paired "
+        "legs cancel drift, the median rejects this box's heavy-tailed "
+        "scheduler outliers — individual legs swing >15% both directions, "
+        "see the recorded ratios). The steady path reaches zero emit() "
+        "sites by design; overload_on exercises the shed/deadline emit "
+        "sites (one bounded-queue enqueue each, journal_dropped must stay "
+        "0, writes only on the flight-recorder thread per "
+        "tests/test_telemetry.py).",
+    }
+
+
 def bench_mlp_forward(peak_flops):
     import jax
     import jax.numpy as jnp
@@ -2333,6 +2477,7 @@ def main() -> None:
     serving = bench_serving()
     open_loop = bench_serving_open_loop()
     tracing = bench_tracing_overhead()
+    journal = bench_journal_overhead()
     mlp_serving = bench_mlp_serving_throughput()
     continuous_loop = bench_continuous_loop()
     batch_transform = bench_pipeline_batch_transform()
@@ -2346,8 +2491,8 @@ def main() -> None:
         "workloads": [
             logreg, sparse, sweep, sparse_streamed, overlap, kmeans, mlp,
             mlp_train, attention, attention_train, serving, open_loop,
-            tracing, mlp_serving, continuous_loop, batch_transform, fusion,
-            sharded,
+            tracing, journal, mlp_serving, continuous_loop, batch_transform,
+            fusion, sharded,
         ],
     }
     with open("BENCH_DETAIL.json", "w") as f:

@@ -380,6 +380,83 @@ class Options:
         "spans are retained; older ones drop off (SpanRecorder.dropped counts "
         "them).",
     )
+    OBSERVABILITY_JOURNAL = ConfigOption(
+        "observability.journal",
+        _parse_bool,
+        True,
+        "Always-on flight recorder (flink_ml_tpu.telemetry): every runtime "
+        "decision (swap, rollback, shed, controller action, plan choice, "
+        "fault trip, restart) appends one structured JSONL record to a "
+        "crash-safe on-disk journal, written by a dedicated writer thread — "
+        "the hot path pays one bounded-queue enqueue. Off = emit() is a "
+        "single attribute check (docs/observability.md).",
+    )
+    OBSERVABILITY_JOURNAL_DIR = ConfigOption(
+        "observability.journal.dir",
+        str,
+        None,
+        "Directory of the flight-recorder journal (and, by default, its "
+        "incident bundles). Default: none — a fresh per-process directory "
+        "under the system temp dir. Configure a stable path in deployments "
+        "so a new incarnation resumes the sequence after a crash and "
+        "crash-resume itself emits an incident bundle.",
+    )
+    OBSERVABILITY_JOURNAL_QUEUE = ConfigOption(
+        "observability.journal.queue",
+        int,
+        8192,
+        "Bounded queue between event emitters and the journal writer thread "
+        "(records). On overflow new events are dropped and counted "
+        "(FlightRecorder.dropped / ml.telemetry.journal.dropped) — the hot "
+        "path never blocks on telemetry.",
+    )
+    OBSERVABILITY_JOURNAL_MAX_BYTES = ConfigOption(
+        "observability.journal.max.bytes",
+        int,
+        64 << 20,
+        "Rotation bound of one journal file: past this many bytes the writer "
+        "rotates to a new part file (oldest parts beyond "
+        "observability.journal.keep.files are deleted).",
+    )
+    OBSERVABILITY_JOURNAL_KEEP_FILES = ConfigOption(
+        "observability.journal.keep.files",
+        int,
+        4,
+        "Journal part files kept after rotation (bounded retention; the "
+        "sequence numbers stay monotone across parts and incarnations).",
+    )
+    OBSERVABILITY_HTTP_PORT = ConfigOption(
+        "observability.http.port",
+        int,
+        None,
+        "Port of the live telemetry endpoint (/metrics, /healthz, "
+        "/events?n=) an InferenceServer starts alongside itself. Default: "
+        "none — no HTTP thread. 0 = bind an ephemeral port (tests read "
+        "server.telemetry.port).",
+    )
+    OBSERVABILITY_INCIDENT_WINDOW_S = ConfigOption(
+        "observability.incident.window.s",
+        float,
+        30.0,
+        "How many trailing seconds of the journal an incident bundle "
+        "snapshots (from the writer's in-memory tail ring).",
+    )
+    OBSERVABILITY_INCIDENT_KEEP = ConfigOption(
+        "observability.incident.keep",
+        int,
+        8,
+        "Incident bundles retained per journal directory — oldest bundles "
+        "beyond this are deleted (bounded retention).",
+    )
+    OBSERVABILITY_INCIDENT_MIN_INTERVAL_S = ConfigOption(
+        "observability.incident.min.interval.s",
+        float,
+        30.0,
+        "Per-kind incident rate limit: a second incident of the same kind "
+        "within this window is counted (ml.telemetry.incidents.suppressed) "
+        "but writes no bundle — a shedding storm yields one bundle, not "
+        "thousands.",
+    )
     OBSERVABILITY_TRACE_XPROF = ConfigOption(
         "observability.trace.xprof",
         _parse_bool,

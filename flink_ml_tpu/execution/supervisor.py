@@ -36,6 +36,7 @@ import dataclasses
 import time
 from typing import Any, Callable, Iterator, List, Optional
 
+import flink_ml_tpu.telemetry as telemetry
 from flink_ml_tpu.execution.classify import DEFAULT_CLASSIFIER, ErrorClassifier, FailureKind
 from flink_ml_tpu.execution.restart import FixedDelayRestartStrategy, RestartStrategy
 from flink_ml_tpu.metrics import MLMetrics, metrics
@@ -110,13 +111,45 @@ class Supervisor:
         if kind is FailureKind.FATAL:
             self.failures.append(AttemptFailure(self.attempts, error, kind, None))
             self._count(MLMetrics.NUM_FATAL)
+            telemetry.emit(
+                "execution.fatal",
+                self.metric_scope,
+                {"attempt": self.attempts, "error": type(error).__name__},
+            )
             raise error
         delay = self.strategy.next_restart(now)
         self.failures.append(AttemptFailure(self.attempts, error, kind, delay))
         if delay is None:
+            telemetry.emit(
+                "execution.exhausted",
+                self.metric_scope,
+                {"attempt": self.attempts, "error": type(error).__name__},
+            )
             raise error from RestartsExhaustedError(self.name, self.strategy, self.failures)
         self.restarts += 1
         self._count(MLMetrics.NUM_RESTARTS)
+        # Every granted restart is both a journal record and an incident:
+        # the workload just lost an attempt's worth of progress.
+        telemetry.emit(
+            "execution.restart",
+            self.metric_scope,
+            {
+                "attempt": self.attempts,
+                "restart": self.restarts,
+                "error": type(error).__name__,
+                "detail": str(error)[:200],
+                "delay_s": delay,
+            },
+        )
+        telemetry.incident(
+            "supervisor-restart",
+            self.metric_scope,
+            {
+                "attempt": self.attempts,
+                "restart": self.restarts,
+                "error": type(error).__name__,
+            },
+        )
         return delay
 
     def _record_recovery(self, failed_at: float) -> None:

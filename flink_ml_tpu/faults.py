@@ -111,6 +111,13 @@ FAULT_POINTS: Dict[str, str] = {
         "the rest of the schedule on time (chaos-under-load runs arm this to "
         "prove the measurement rig itself survives faults)."
     ),
+    "telemetry.journal": (
+        "Flight-recorder journal write (telemetry/journal.py _write_record) — "
+        "kill the writer thread mid-record, leaving a torn tail line on "
+        "disk; the reader must tolerate it and a new incarnation must "
+        "resume the sequence (no reuse) and emit a crash-resume incident "
+        "bundle."
+    ),
 }
 
 
@@ -166,6 +173,23 @@ class FaultInjector:
         self._hits: Dict[str, int] = {}
         self._fires: Dict[str, int] = {}
         self._spec_loaded = False
+        #: Fired-fault observers, called OUTSIDE the trip lock with
+        #: (point, hit, context) just before InjectedFault raises. This is
+        #: how the L1 flight recorder (flink_ml_tpu.telemetry) journals
+        #: trips without this L0 module importing upward. Appended at
+        #: registration time, read-only after (iteration takes a snapshot).
+        self._observers: list = []
+        #: Observer callbacks that raised (counted, never propagated — a
+        #: broken telemetry hook must not mask the injected fault itself).
+        self.observer_errors = 0
+
+    def add_observer(self, fn) -> "FaultInjector":
+        """Register ``fn(point, hit, context)`` to run when any armed point
+        fires (idempotent — re-registering the same callable is a no-op)."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers = self._observers + [fn]
+        return self
 
     # -- arming ---------------------------------------------------------------
     def arm(
@@ -273,6 +297,15 @@ class FaultInjector:
             hit = armed.hits
             if armed.at is not None:
                 del self._armed[point]  # one-shot: disarm after firing
+            observers = self._observers
+        for observer in observers:
+            try:
+                observer(point, hit, context)
+            except Exception:
+                # Counted, not raised: telemetry must never mask the
+                # injected fault itself.
+                with self._lock:
+                    self.observer_errors += 1
         raise InjectedFault(point, hit, context)
 
     # -- introspection --------------------------------------------------------

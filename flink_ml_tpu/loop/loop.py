@@ -35,6 +35,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+import flink_ml_tpu.telemetry as telemetry
 from flink_ml_tpu.faults import faults
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.loop.drift import DriftMonitor, logloss
@@ -154,6 +155,11 @@ class ContinuousLearningLoop:
         if serving_before is not None:
             self.baseline_version = serving_before
         metrics.counter(self.scope, MLMetrics.LOOP_SWAPPED)
+        telemetry.emit(
+            "loop.swap",
+            self.scope,
+            {"version": version, "from": serving_before},
+        )
         warm_ms = metrics.get(self.server.scope, MLMetrics.SERVING_WARMUP_COMPILE_MS)
         if warm_ms is not None and warm_ms != warm_before:
             metrics.gauge(self.scope, MLMetrics.LOOP_WARM_MS, warm_ms)
@@ -198,7 +204,27 @@ class ContinuousLearningLoop:
         live = self.server.model_version
         if live is None:
             return None
-        if not self.monitor.regressed(live, self.baseline_version):
+        regressed = self.monitor.regressed(live, self.baseline_version)
+        if self.monitor.count(live) > 0:
+            # The drift verdict is a decision even when it clears the model —
+            # postmortems need "we looked and it was fine" as much as the
+            # regression itself.
+            telemetry.emit(
+                "loop.drift",
+                self.scope,
+                {
+                    "version": live,
+                    "baseline": self.baseline_version,
+                    "score": self.monitor.mean(live),
+                    "baseline_score": (
+                        self.monitor.mean(self.baseline_version)
+                        if self.baseline_version is not None
+                        else None
+                    ),
+                    "regressed": regressed,
+                },
+            )
+        if not regressed:
             return None
         t0 = self.clock()
         with tracer.span("loop.rollback", CAT_RECOVERY, scope=self.scope) as sp:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+import flink_ml_tpu.telemetry as telemetry
 from flink_ml_tpu.faults import faults
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.serving.registry import quarantine_version
@@ -81,6 +82,12 @@ class RollbackController:
         faults.trip("loop.rollback", bad_version=bad_version)
         if quarantine_version(self.publish_dir, bad_version) is not None:
             metrics.counter(self.scope, MLMetrics.LOOP_QUARANTINED)
+            telemetry.emit(
+                "loop.quarantine", self.scope, {"version": bad_version}
+            )
+            telemetry.incident(
+                "quarantine", self.scope, {"version": bad_version}
+            )
         candidates = [
             (v, path) for v, path in self._published() if v < bad_version
         ]
@@ -89,12 +96,27 @@ class RollbackController:
                 servable = self.loader(path)
                 # AOT-warm + atomic backwards flip, all off the serving path.
                 self.server.rollback(version, servable)
-            except Exception:
+            except Exception as e:
                 if quarantine_version(self.publish_dir, version) is not None:
                     metrics.counter(self.scope, MLMetrics.LOOP_QUARANTINED)
+                    telemetry.emit(
+                        "loop.quarantine",
+                        self.scope,
+                        {"version": version, "error": type(e).__name__},
+                    )
                 metrics.counter(self.scope, MLMetrics.SERVING_SWAP_FAILURES)
                 continue
             metrics.counter(self.scope, MLMetrics.LOOP_ROLLBACKS)
+            telemetry.emit(
+                "loop.rollback",
+                self.scope,
+                {"from_version": bad_version, "restored": version},
+            )
+            telemetry.incident(
+                "rollback",
+                self.scope,
+                {"from_version": bad_version, "restored": version},
+            )
             return version
         raise RollbackImpossibleError(
             f"no intact published version older than {bad_version} under "

@@ -22,6 +22,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional
 
+import flink_ml_tpu.telemetry as telemetry
 from flink_ml_tpu.config import Options, config
 from flink_ml_tpu.faults import faults
 from flink_ml_tpu.metrics import MLMetrics, metrics
@@ -141,6 +142,11 @@ class ContinuousTrainer:
         self._last_publish_time = now
         self.published_versions.append(version)
         metrics.counter(self.scope, MLMetrics.LOOP_PUBLISHED)
+        telemetry.emit(
+            "loop.publish",
+            self.scope,
+            {"version": version, "adopted": path is None},
+        )
         return path
 
     def _repair_publish_lag(self) -> List[int]:

@@ -134,6 +134,17 @@ class MLMetrics:
     BATCH_SHARD_PAD_ROWS = "ml.batch.shard.pad.rows"  # DP round-up pad rows on ragged chunks, counter
     BATCH_SHARD_REPLICATED_CHUNKS = "ml.batch.shard.replicated.chunks"  # tails run replicated, counter
 
+    # Flight recorder + incident bundles (flink_ml_tpu.telemetry — the
+    # always-on decision journal; scope = "ml.telemetry", docs/observability.md).
+    TELEMETRY_GROUP = "ml.telemetry"
+    TELEMETRY_EVENTS = "ml.telemetry.journal.events"  # records written to disk, counter
+    TELEMETRY_DROPPED = "ml.telemetry.journal.dropped"  # queue-overflow drops, counter
+    TELEMETRY_WRITE_ERRORS = "ml.telemetry.journal.write.errors"  # failed/torn writes, counter
+    TELEMETRY_SEQ = "ml.telemetry.journal.seq"  # last written sequence number, gauge
+    TELEMETRY_INCIDENTS = "ml.telemetry.incidents"  # bundles written, counter
+    TELEMETRY_INCIDENTS_SUPPRESSED = "ml.telemetry.incidents.suppressed"  # rate-limited, counter
+    TELEMETRY_HTTP_REQUESTS = "ml.telemetry.http.requests"  # endpoint hits, counter
+
 
 class Histogram:
     """Bounded-window observation histogram (the DescriptiveStatisticsHistogram
@@ -204,6 +215,10 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._gauges: Dict[str, Dict[str, Any]] = {}
+        # Names incremented via counter() — the Prometheus exposition needs
+        # the distinction (counters render as `# TYPE ... counter` with the
+        # `_total` suffix real scrapers expect; everything else is a gauge).
+        self._counter_names: set = set()
 
     def gauge(self, scope: str, name: str, value: Any) -> None:
         with self._lock:
@@ -215,6 +230,7 @@ class MetricsRegistry:
         with self._lock:
             group = self._gauges.setdefault(scope, {})
             group[name] = int(group.get(name, 0)) + inc
+            self._counter_names.add(name)
             return group[name]
 
     def histogram(self, scope: str, name: str, window: int = 4096) -> Histogram:
@@ -247,16 +263,23 @@ class MetricsRegistry:
     def clear(self) -> None:
         with self._lock:
             self._gauges.clear()
+            self._counter_names.clear()
+
+    def is_counter(self, name: str) -> bool:
+        """Whether ``name`` has ever been incremented via :meth:`counter`."""
+        with self._lock:
+            return name in self._counter_names
 
     def render_prometheus(self) -> str:  # graftcheck: cold
         """The whole registry in Prometheus text exposition format (0.0.4).
 
         Metric names sanitize to ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (dots become
-        underscores); the scope rides as a ``scope`` label. Counters are not
-        distinguishable from gauges in this registry (both are stored values),
-        so every numeric renders as ``gauge``; ``Histogram``s render as
-        ``summary`` — p50/p90/p99 via one :meth:`Histogram.quantiles` sort,
-        plus ``_count``/``_sum``. Non-numeric gauge values are skipped.
+        underscores); the scope rides as a ``scope`` label. Values grown via
+        :meth:`counter` render as ``# TYPE ... counter`` with the ``_total``
+        suffix real Prometheus scrapers expect; every other numeric renders
+        as ``gauge``; ``Histogram``s render as ``summary`` — p50/p90/p99 via
+        one :meth:`Histogram.quantiles` sort, plus ``_count``/``_sum``.
+        Non-numeric gauge values are skipped.
         """
         numeric: Dict[str, List[Tuple[str, float]]] = {}
         hists: Dict[str, List[Tuple[str, Histogram]]] = {}
@@ -272,9 +295,17 @@ class MetricsRegistry:
         for name in sorted(set(numeric) | set(hists)):
             san = _prometheus_name(name)
             if name in numeric:
-                lines.append(f"# TYPE {san} gauge")
+                if self.is_counter(name):
+                    # Counters take the conventional `_total` suffix; in the
+                    # 0.0.4 text format the TYPE line names the sample
+                    # itself, so the suffix appears in both.
+                    san_sample = f"{san}_total"
+                    lines.append(f"# TYPE {san_sample} counter")
+                else:
+                    san_sample = san
+                    lines.append(f"# TYPE {san} gauge")
                 for scope, value in numeric[name]:
-                    lines.append(f"{san}{{scope={_prometheus_label(scope)}}} {_prometheus_value(value)}")
+                    lines.append(f"{san_sample}{{scope={_prometheus_label(scope)}}} {_prometheus_value(value)}")
             if name in hists:
                 lines.append(f"# TYPE {san} summary")
                 for scope, hist in hists[name]:

@@ -191,12 +191,18 @@ def plan_recorder(scope: str):
     (``ml.fusion.programs.*``) and publishes the plan-choice gauge (the kind
     of the last compiled program) plus the cost-model score behind the
     choice. The counters are the precise per-kind accounting; the gauges are
-    the at-a-glance "what did the cost model just decide" view."""
+    the at-a-glance "what did the cost model just decide" view — and every
+    choice lands in the flight recorder (one record per compiled program,
+    at compile/warmup time, never the dispatch path)."""
+    import flink_ml_tpu.telemetry as telemetry
 
     def on_plan(kind: str, score: float) -> None:
         metrics.counter(scope, _PLAN_COUNTER[kind])
         metrics.gauge(scope, MLMetrics.FUSION_PLAN_CHOICE, _PLAN_CHOICE[kind])
         metrics.gauge(scope, MLMetrics.FUSION_PLAN_SCORE, score)
+        telemetry.emit(
+            "fusion.plan", scope, {"choice": kind, "score": float(score)}
+        )
 
     return on_plan
 

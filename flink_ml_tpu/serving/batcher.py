@@ -52,6 +52,7 @@ from typing import Callable, Deque, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import flink_ml_tpu.telemetry as telemetry
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.faults import faults
 from flink_ml_tpu.metrics import MLMetrics, metrics
@@ -229,6 +230,18 @@ class MicroBatcher:
         self._thread = threading.Thread(target=self._loop, name=f"micro-batcher[{scope}]", daemon=True)
         self._thread.start()
 
+    @property
+    def draining(self) -> bool:
+        """Whether a graceful close is in progress (or done) — the /healthz
+        503 signal. Locked read: shared with the submit/claim paths."""
+        with self._lock:
+            return self._draining
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
     # -- client side ----------------------------------------------------------
     def submit(self, df: DataFrame, timeout_s: float, priority: int = 0) -> PendingRequest:
         rows = len(df)
@@ -398,6 +411,16 @@ class MicroBatcher:
                 metrics.counter(self.scope, MLMetrics.SERVING_TIMEOUTS)
                 if self._controller is not None:
                     self._controller.observe_queue_wait(now - req.enqueued_at)
+                telemetry.emit(
+                    "serving.deadline.miss",
+                    self.scope,
+                    {
+                        "phase": "queued",
+                        "rows": req.rows,
+                        "priority": req.priority,
+                        "queued_ms": round((now - req.enqueued_at) * 1000.0, 3),
+                    },
+                )
                 req._event.set()
                 continue
             kept.append(req)
@@ -517,6 +540,16 @@ class MicroBatcher:
             metrics.counter(self.scope, MLMetrics.SERVING_DEADLINE_DISPATCH)
             if self._controller is not None:
                 self._controller.observe_queue_wait(now - req.enqueued_at)
+            telemetry.emit(
+                "serving.deadline.miss",
+                self.scope,
+                {
+                    "phase": "dispatch",
+                    "rows": req.rows,
+                    "priority": req.priority,
+                    "queued_ms": round((now - req.enqueued_at) * 1000.0, 3),
+                },
+            )
             req._event.set()
             if req.trace is not None:
                 req.trace.set_attr("error", "ServingDeadlineError")

@@ -50,8 +50,9 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
+import flink_ml_tpu.telemetry as telemetry
 from flink_ml_tpu.config import Options, config
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.trace import (
@@ -284,6 +285,20 @@ class AdaptiveController:
             self._record_action(
                 "shed", priority, f"queue {queued_rows}/{self.capacity_rows} sustained"
             )
+            # A shed episode is an incident: the runtime started refusing
+            # work. One bundle per episode start (further sheds inside the
+            # episode are dedup'd here; the per-kind rate limit bounds
+            # episode churn).
+            telemetry.incident(
+                "shed-episode",
+                self.scope,
+                {
+                    "priority": priority,
+                    "queued_rows": queued_rows,
+                    "capacity_rows": self.capacity_rows,
+                    "ledger": self._ledger_snapshot(),
+                },
+            )
 
     # -- deadline-aware bucket selection --------------------------------------
     def estimated_service_s(self, bucket: int) -> Optional[float]:
@@ -363,6 +378,13 @@ class AdaptiveController:
         return action
 
     # -- introspection --------------------------------------------------------
+    def _ledger_snapshot(self) -> Dict[str, float]:
+        """The windowed per-category milliseconds behind a decision — what
+        the journal records as the action's justifying evidence."""
+        return {
+            cat: round(s * 1000.0, 3) for cat, s in self.ledger.totals().items()
+        }
+
     def _record_action(self, kind: str, value, reason: str) -> ControllerAction:
         action = ControllerAction(kind, value, reason, self._clock())
         with self._lock:
@@ -370,7 +392,36 @@ class AdaptiveController:
             if len(self.actions) > _MAX_ACTIONS:
                 del self.actions[: len(self.actions) - _MAX_ACTIONS]
         metrics.counter(self.scope, MLMetrics.SERVING_CONTROLLER_ACTIONS)
+        # Every control decision lands in the flight recorder WITH the
+        # ledger window that justified it (one enqueue; the write happens on
+        # the journal's writer thread).
+        telemetry.emit(
+            "controller.action",
+            self.scope,
+            {
+                "action": kind,
+                "value": value,
+                "reason": reason,
+                "ledger_ms": self._ledger_snapshot(),
+            },
+        )
         return action
+
+    def state(self) -> Dict[str, Any]:
+        """Controller snapshot for /healthz: shedding flag, action counts by
+        kind, drain-rate estimate, and the live ledger window."""
+        with self._lock:
+            shedding = self._shedding
+            drain = self._drain_rows_per_s
+            kinds: Dict[str, int] = {}
+            for a in self.actions:
+                kinds[a.kind] = kinds.get(a.kind, 0) + 1
+        return {
+            "shedding": shedding,
+            "drain_rows_per_s": round(drain, 1) if drain else None,
+            "actions": kinds,
+            "ledger_ms": self._ledger_snapshot(),
+        }
 
     def actions_of(self, kind: str) -> List[ControllerAction]:
         with self._lock:

@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+import flink_ml_tpu.telemetry as telemetry
 from flink_ml_tpu.checkpoint import scan_numbered_dirs
 from flink_ml_tpu.faults import faults
 from flink_ml_tpu.metrics import MLMetrics, metrics
@@ -200,6 +201,29 @@ class ModelVersionPoller:
         with self._lock:
             self.failed[version] = error
         metrics.counter(self.registry.scope, MLMetrics.SERVING_SWAP_FAILURES)
+        # A rejected published version is a postmortem-worthy episode: the
+        # trainer shipped something that cannot serve. Journal it and bundle
+        # the window (serving itself is untouched — the fallback keeps the
+        # old version in service, which the bundle's lineage shows).
+        telemetry.emit(
+            "serving.swap.failed",
+            self.registry.scope,
+            {
+                "version": version,
+                "error": type(error).__name__,
+                "detail": str(error)[:200],
+                "serving": self.registry.version,
+            },
+        )
+        telemetry.incident(
+            "swap-failure",
+            self.registry.scope,
+            {
+                "version": version,
+                "error": type(error).__name__,
+                "serving": self.registry.version,
+            },
+        )
 
     def known_failed(self, version: int) -> bool:
         with self._lock:

@@ -24,6 +24,7 @@ from __future__ import annotations
 import threading
 from typing import List, Optional, Tuple
 
+import flink_ml_tpu.telemetry as telemetry
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.config import Options, config
 from flink_ml_tpu.metrics import MLMetrics, metrics
@@ -34,7 +35,7 @@ from flink_ml_tpu.serving.plan import CompiledServingPlan
 from flink_ml_tpu.serving.registry import ModelRegistry, ModelVersionPoller
 from flink_ml_tpu.servable.fusion import resolve_fusion_tier
 from flink_ml_tpu.servable.sharding import resolve_plan_sharding
-from flink_ml_tpu.trace import CAT_COMPILE, CAT_SWAP, tracer
+from flink_ml_tpu.trace import CAT_COMPILE, CAT_PRODUCTIVE, CAT_SWAP, tracer
 
 __all__ = ["ServingConfig", "ServingResponse", "InferenceServer"]
 
@@ -74,6 +75,7 @@ class ServingConfig:
         mesh_model: Optional[int] = None,
         fusion_mode: Optional[str] = None,
         controller: Optional[bool] = None,
+        http_port: Optional[int] = None,
         shed_watermark: Optional[float] = None,
         shed_sustain_ms: Optional[float] = None,
         shed_priority: Optional[int] = None,
@@ -124,6 +126,13 @@ class ServingConfig:
         self.controller = (
             bool(controller) if controller is not None
             else config.get(Options.SERVING_CONTROLLER)
+        )
+        # Live telemetry endpoint (telemetry/http.py): None = no HTTP
+        # thread (the default); 0 = ephemeral port (tests read
+        # server.telemetry.port).
+        self.http_port = (
+            int(http_port) if http_port is not None
+            else config.get(Options.OBSERVABILITY_HTTP_PORT)
         )
         # Controller knobs: kept un-defaulted here (None = "resolve through
         # the config tier at AdaptiveController construction") so a server
@@ -268,6 +277,13 @@ class InferenceServer:
             shards=self._sharding.n_data if self._sharding is not None else 1,
             controller=self.controller,
         )
+        # Live per-replica endpoint (/metrics, /healthz, /events) — off
+        # unless observability.http.port / ServingConfig(http_port=) is set.
+        self.telemetry = (
+            telemetry.TelemetryServer(self.config.http_port, health=self.health)
+            if self.config.http_port is not None
+            else None
+        )
         if servable is not None:
             self.swap(version, servable)
 
@@ -381,12 +397,18 @@ class InferenceServer:
             with self._template_lock:
                 template = self._warmup_template
             if template is None:
+                telemetry.emit("serving.warmup", self.scope, {"buckets": 0})
                 return  # nothing seen yet: the first real batch compiles lazily
             if plan is not None:
                 plan.warmup(template, self._batcher.buckets)
-                return
-            for bucket in self._batcher.buckets:
-                servable.transform(pad_to(template, bucket))
+            else:
+                for bucket in self._batcher.buckets:
+                    servable.transform(pad_to(template, bucket))
+            telemetry.emit(
+                "serving.warmup",
+                self.scope,
+                {"buckets": len(self._batcher.buckets), "fastpath": plan is not None},
+            )
 
     def swap(self, version: int, servable) -> None:
         """Warm then atomically install ``servable`` as ``version``. The
@@ -394,8 +416,12 @@ class InferenceServer:
         unambiguous forever)."""
         with tracer.span("serving.swap", CAT_SWAP, scope=self.scope) as sp:
             sp.set_attr("version", version)
+            previous = self.registry.version
             self.warmup(servable)
             self.registry.swap(version, servable)
+            telemetry.emit(
+                "serving.swap", self.scope, {"version": version, "from": previous}
+            )
 
     def rollback(self, version: int, servable) -> None:
         """Warm then atomically REVERT serving to an older ``version`` — the
@@ -405,8 +431,12 @@ class InferenceServer:
         the serving path."""
         with tracer.span("serving.rollback", CAT_SWAP, scope=self.scope) as sp:
             sp.set_attr("version", version)
+            previous = self.registry.version
             self.warmup(servable)
             self.registry.swap(version, servable, allow_rollback=True)
+            telemetry.emit(
+                "serving.rollback", self.scope, {"version": version, "from": previous}
+            )
 
     def attach_poller(
         self,
@@ -435,6 +465,38 @@ class InferenceServer:
     def model_version(self) -> Optional[int]:
         return self.registry.version
 
+    def health(self) -> Tuple[bool, dict]:  # graftcheck: cold
+        """The /healthz snapshot: ``(ok, payload)``. ``ok`` is False —
+        rendered as HTTP 503 by the telemetry endpoint — while the server is
+        draining or closed (the load-balancer takes the replica out before
+        in-flight work finishes). A live server with no model yet reports
+        ``status="no-model"`` but stays 200: it is healthy, just unwarmed."""
+        draining = self._batcher.draining
+        closed = self._closed or self._batcher.closed
+        version = self.registry.version
+        payload = {
+            "status": (
+                "closed" if closed
+                else "draining" if draining
+                else "no-model" if version is None
+                else "serving"
+            ),
+            "name": self.name,
+            "version": version,
+            "queue_depth_rows": metrics.get(self.scope, MLMetrics.SERVING_QUEUE_DEPTH, 0),
+            "queue_capacity_rows": self.config.queue_capacity_rows,
+            "pipeline_depth": self._batcher.pipeline_depth,
+            "goodput_fraction": (
+                self.controller.ledger.share(CAT_PRODUCTIVE)
+                if self.controller is not None
+                else None
+            ),
+            "controller": (
+                self.controller.state() if self.controller is not None else None
+            ),
+        }
+        return (not closed and not draining), payload
+
     @property
     def executed_batch_sizes(self) -> List[Tuple[int, int]]:
         """(rows, bucket) per executed batch — the compile-counting hook the
@@ -452,6 +514,10 @@ class InferenceServer:
         if self._poller is not None:
             self._poller.stop()
         self._batcher.close(drain=drain)
+        # The endpoint outlives the batcher drain so /healthz answers 503
+        # through the whole shutdown window, then stops last.
+        if self.telemetry is not None:
+            self.telemetry.close()
 
     def __enter__(self) -> "InferenceServer":
         return self

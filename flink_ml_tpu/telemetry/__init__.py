@@ -1,0 +1,51 @@
+"""flink_ml_tpu.telemetry — always-on flight recorder, incidents, endpoint.
+
+Three pieces (docs/observability.md):
+
+- :mod:`~flink_ml_tpu.telemetry.journal` — the :class:`FlightRecorder`:
+  an always-on, append-only, crash-safe JSONL journal of runtime decisions,
+  one bounded-queue enqueue on the hot path, a dedicated writer thread;
+- :mod:`~flink_ml_tpu.telemetry.incidents` — self-contained
+  ``incident-<seq>-<kind>/`` postmortem bundles (journal window + metrics +
+  spans + config + version lineage), rate-limited and bounded-retention;
+- :mod:`~flink_ml_tpu.telemetry.http` — the live ``/metrics`` /
+  ``/healthz`` / ``/events`` endpoint behind ``observability.http.port``.
+
+Layering: L1 like ``trace`` — the package imports only L0 (config, faults,
+metrics) and L1 (trace), so instrumenting the serving tier keeps the
+runtime-free guarantee. The faults module (L0) reaches the journal through
+its observer hook, never by importing upward.
+"""
+from flink_ml_tpu.telemetry.incidents import (
+    list_bundles,
+    load_bundle,
+    version_lineage,
+    write_bundle,
+)
+from flink_ml_tpu.telemetry.journal import (
+    FlightRecorder,
+    configure,
+    emit,
+    get_recorder,
+    incident,
+    journal_files,
+    journal_tail,
+    read_journal,
+)
+from flink_ml_tpu.telemetry.http import TelemetryServer
+
+__all__ = [
+    "FlightRecorder",
+    "TelemetryServer",
+    "configure",
+    "emit",
+    "get_recorder",
+    "incident",
+    "journal_files",
+    "journal_tail",
+    "list_bundles",
+    "load_bundle",
+    "read_journal",
+    "version_lineage",
+    "write_bundle",
+]

@@ -1,0 +1,125 @@
+"""Live telemetry endpoint — /metrics, /healthz, /events on a stdlib thread.
+
+The fleet tier (ROADMAP item 3) needs a scrapeable per-replica surface; this
+module is it, with zero dependencies beyond ``http.server``:
+
+- ``GET /metrics`` — the whole metrics registry in Prometheus text
+  exposition (``metrics.render_prometheus``: ``# TYPE`` lines, ``_total``
+  counter suffixes, histogram summaries);
+- ``GET /healthz`` — a JSON liveness/readiness snapshot of the attached
+  :class:`~flink_ml_tpu.serving.server.InferenceServer` (serving version,
+  queue depth, goodput fraction, controller state) with **503** while the
+  server is draining or closed — the load-balancer contract;
+- ``GET /events?n=50`` — the newest n flight-recorder records (the
+  journal's in-memory tail ring).
+
+Off by default: an ``InferenceServer`` starts one only when
+``observability.http.port`` (or ``ServingConfig(http_port=...)``) is set;
+port 0 binds an ephemeral port (tests read ``TelemetryServer.port``). The
+whole surface is a cold export path — request handling never touches a
+serving lock beyond the metrics registry's own.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from flink_ml_tpu.metrics import MLMetrics, metrics
+
+__all__ = ["TelemetryServer"]
+
+
+class TelemetryServer:  # graftcheck: cold
+    """One HTTP thread serving /metrics, /healthz and /events.
+
+    ``health`` is a callable returning ``(ok, payload)`` — an
+    ``InferenceServer`` passes its own ``health`` method; without one the
+    endpoint reports a bare 200 (process up). ``recorder`` defaults to the
+    process flight recorder.
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        *,
+        health: Optional[Callable[[], Tuple[bool, Dict[str, Any]]]] = None,
+        recorder=None,
+        host: str = "127.0.0.1",
+        scope: str = MLMetrics.TELEMETRY_GROUP,
+    ):
+        if recorder is None:
+            from flink_ml_tpu.telemetry.journal import get_recorder
+
+            recorder = get_recorder()
+        self.recorder = recorder
+        self.scope = scope
+        self._health = health
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # no stderr chatter per scrape
+                pass
+
+            def do_GET(self):
+                try:
+                    outer._handle(self)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # scraper went away mid-response
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"telemetry-http[{self.port}]",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- request handling (http.server worker threads) -------------------------
+    def _handle(self, request) -> None:
+        parsed = urlparse(request.path)
+        metrics.counter(self.scope, MLMetrics.TELEMETRY_HTTP_REQUESTS)
+        if parsed.path == "/metrics":
+            body = metrics.render_prometheus().encode("utf-8")
+            self._respond(request, 200, body, "text/plain; version=0.0.4; charset=utf-8")
+        elif parsed.path == "/healthz":
+            ok, payload = self._health() if self._health is not None else (True, {"status": "up"})
+            body = json.dumps(payload, indent=1, default=str).encode("utf-8")
+            self._respond(request, 200 if ok else 503, body, "application/json")
+        elif parsed.path == "/events":
+            try:
+                n = int(parse_qs(parsed.query).get("n", ["100"])[0])
+            except (ValueError, IndexError):
+                n = 100
+            body = json.dumps(self.recorder.tail(n), default=str).encode("utf-8")
+            self._respond(request, 200, body, "application/json")
+        else:
+            self._respond(request, 404, b"not found\n", "text/plain")
+
+    @staticmethod
+    def _respond(request, code: int, body: bytes, content_type: str) -> None:
+        request.send_response(code)
+        request.send_header("Content-Type", content_type)
+        request.send_header("Content-Length", str(len(body)))
+        request.end_headers()
+        request.wfile.write(body)
+
+    # -- lifecycle -------------------------------------------------------------
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "TelemetryServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,178 @@
+"""Incident bundles — a self-contained postmortem directory per episode.
+
+When the runtime makes a *bad-day* decision — a drift rollback, a version
+quarantine, a sustained shed episode, a supervisor restart, a swap rejected
+by the poller, or a crash-resume detected at journal startup — the flight
+recorder snapshots everything an operator needs into one
+``incident-<seq>-<kind>/`` directory:
+
+- ``incident.json`` — kind, trigger context, sequence/incarnation anchors,
+  the resolved runtime config (``config.to_dict()``), and the **version
+  lineage** reconstructed from the journal window (every publish / swap /
+  rollback / quarantine decision, in sequence order);
+- ``journal.jsonl`` — the last ``observability.incident.window.s`` seconds
+  of the decision journal (plus the incident's own record);
+- ``metrics.prom`` — the full metrics registry in Prometheus exposition;
+- ``spans.json`` — the tracer ring as a Chrome trace, when tracing is on.
+
+Bundles are written by the journal's writer thread (never a hot path),
+rate-limited per kind (``observability.incident.min.interval.s``) and
+retained bounded (``observability.incident.keep`` — oldest deleted).
+``tools/traceview.py incident <bundle>`` renders the postmortem timeline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Dict, List, Optional
+
+from flink_ml_tpu.config import config
+from flink_ml_tpu.trace import tracer
+
+__all__ = ["list_bundles", "load_bundle", "version_lineage", "write_bundle"]
+
+_BUNDLE_RE = re.compile(r"^incident-(\d+)-(.+)$")
+
+#: Journal record kinds that constitute the version lineage.
+_LINEAGE_KINDS = (
+    "loop.publish",
+    "serving.swap",
+    "serving.rollback",
+    "serving.swap.failed",
+    "loop.quarantine",
+    "loop.rollback",
+)
+
+
+def list_bundles(directory: str) -> List[str]:
+    """Bundle directories under ``directory``, oldest first (by seq)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    found = []
+    for name in names:
+        m = _BUNDLE_RE.match(name)
+        if m and os.path.isdir(os.path.join(directory, name)):
+            found.append((int(m.group(1)), os.path.join(directory, name)))
+    return [path for _, path in sorted(found)]
+
+
+def version_lineage(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The model-version decisions in a journal window, in sequence order —
+    who published/flipped/reverted/quarantined what, the trail a postmortem
+    walks first."""
+    lineage = []
+    for rec in records:
+        if rec.get("kind") in _LINEAGE_KINDS:
+            entry = {"seq": rec.get("seq"), "kind": rec.get("kind"), "t": rec.get("t")}
+            data = rec.get("data") or {}
+            if "version" in data:
+                entry["version"] = data["version"]
+            if "scope" in rec:
+                entry["scope"] = rec["scope"]
+            lineage.append(entry)
+    return lineage
+
+
+def write_bundle(
+    directory: str,
+    kind: str,
+    *,
+    seq: int,
+    incarnation: int,
+    context: Dict[str, Any],
+    records: List[Dict[str, Any]],
+    window_s: float,
+    now: float,
+    wall: float,
+    keep: int = 8,
+) -> str:
+    """Write one bundle (journal writer thread only); returns its path.
+    ``records`` is the recorder's tail ring — the window filter keeps the
+    trailing ``window_s`` seconds of it. Prunes the oldest bundles past
+    ``keep`` after writing."""
+    os.makedirs(directory, exist_ok=True)
+    bundle = os.path.join(directory, f"incident-{seq:06d}-{_safe(kind)}")
+    tmp = bundle + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    # The time window applies to THIS incarnation's records; records seeded
+    # from an earlier incarnation (the crash-resume postmortem tail) carry a
+    # different process's monotonic timebase and are kept as-is.
+    horizon = now - max(0.0, window_s)
+    window = [
+        r for r in records
+        if r.get("inc", incarnation) != incarnation
+        or float(r.get("t", now)) >= horizon
+    ]
+
+    with open(os.path.join(tmp, "journal.jsonl"), "w", encoding="utf-8") as f:
+        for rec in window:
+            f.write(json.dumps(rec, separators=(",", ":"), default=str) + "\n")
+
+    from flink_ml_tpu.metrics import metrics
+
+    with open(os.path.join(tmp, "metrics.prom"), "w", encoding="utf-8") as f:
+        f.write(metrics.render_prometheus())
+
+    spans = None
+    if tracer.enabled:
+        spans = "spans.json"
+        tracer.recorder.export_chrome_trace(os.path.join(tmp, spans))
+
+    manifest = {
+        "kind": kind,
+        "seq": seq,
+        "incarnation": incarnation,
+        "t": now,
+        "wall": wall,
+        "window_s": window_s,
+        "context": context,
+        "journal_records": len(window),
+        "spans": spans,
+        "lineage": version_lineage(window),
+        "config": _jsonable(config.to_dict()),
+    }
+    with open(os.path.join(tmp, "incident.json"), "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, default=str)
+
+    os.rename(tmp, bundle)  # the checkpoint tier's atomic-publish discipline
+    for old in list_bundles(directory)[: -keep or None]:
+        if old != bundle:
+            shutil.rmtree(old, ignore_errors=True)
+    return bundle
+
+
+def load_bundle(bundle: str) -> Dict[str, Any]:
+    """Parse one bundle for analysis (tools/traceview.py incident): the
+    manifest plus its journal records (and span events when captured).
+    Raises ``OSError``/``ValueError`` on a malformed bundle."""
+    with open(os.path.join(bundle, "incident.json"), encoding="utf-8") as f:
+        manifest = json.load(f)
+    records: List[Dict[str, Any]] = []
+    with open(os.path.join(bundle, "journal.jsonl"), encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    events: List[Dict[str, Any]] = []
+    spans_name = manifest.get("spans")
+    if spans_name:
+        spans_path = os.path.join(bundle, spans_name)
+        if os.path.exists(spans_path):
+            with open(spans_path, encoding="utf-8") as f:
+                events = json.load(f).get("traceEvents", [])
+    return {"manifest": manifest, "records": records, "trace_events": events}
+
+
+def _safe(kind: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", kind)
+
+
+def _jsonable(d: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: (v if isinstance(v, (int, float, str, bool, type(None))) else str(v)) for k, v in d.items()}

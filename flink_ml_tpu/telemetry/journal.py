@@ -1,0 +1,681 @@
+"""FlightRecorder — the always-on, crash-safe decision journal.
+
+graftscope (flink_ml_tpu/trace.py) attributes traced milliseconds, but it is
+opt-in, in-memory, and dies with the process — exactly when a postmortem
+needs it most. The flight recorder is the other half of the observability
+story: an **always-on** (``observability.journal``, default on), append-only,
+on-disk journal of the runtime's *decisions* — hot swaps, rollbacks,
+quarantines, priority sheds, bucket downshifts, depth steps, fusion plan
+choices, fault trips, supervisor restarts — one compact JSONL record per
+decision, surviving any crash up to the last flushed line.
+
+Design (docs/observability.md "Flight recorder"):
+
+- **One enqueue on the hot path.** ``emit()`` builds a small dict and appends
+  it to a bounded queue under a short lock — no I/O, no serialization, no
+  clock beyond two reads. A dedicated writer thread (``flight-recorder`` in
+  the graftcheck thread topology) serializes, assigns sequence numbers, and
+  appends to disk. On queue overflow new events are **dropped and counted**
+  (``dropped`` / ``ml.telemetry.journal.dropped``) — telemetry never applies
+  backpressure to serving.
+- **Crash-safe, torn-tail-tolerant.** Records are newline-delimited JSON,
+  flushed per writer batch. A hard kill mid-write leaves at most one torn
+  tail line; :func:`read_journal` skips unparsable lines, and a new
+  incarnation resumes the **sequence numbers without reuse** (scanning the
+  existing files for the maximum valid ``seq``), bumps the incarnation
+  counter, journals a ``recorder.resume`` record, and — when the previous
+  incarnation did not write its clean ``recorder.stop`` marker — emits a
+  ``crash-resume`` incident bundle (telemetry/incidents.py).
+- **Causally linked to graftscope.** Every record carries monotonic
+  (``time.perf_counter`` — the tracer's timebase) and wall timestamps, the
+  emitting thread's name, and — when tracing is on — the innermost open span
+  id of the emitting thread, so ``tools/traceview.py incident`` can
+  interleave decisions with span categories on one timeline.
+
+The default journal directory is a fresh per-process directory under the
+system temp dir (the journal is always on, but an unconfigured process never
+scribbles into a repo or resumes someone else's sequence). Deployments set
+``observability.journal.dir`` to a stable path to get cross-incarnation
+resume and crash-resume incident bundles.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.faults import InjectedFault, faults
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.trace import tracer
+
+__all__ = [
+    "FlightRecorder",
+    "configure",
+    "emit",
+    "get_recorder",
+    "incident",
+    "journal_files",
+    "journal_tail",
+    "read_journal",
+]
+
+#: journal-<incarnation>-<part>.jsonl
+_FILE_RE = re.compile(r"^journal-(\d+)-(\d+)\.jsonl$")
+
+#: Clean-shutdown marker record kind (see FlightRecorder.close).
+_STOP_KIND = "recorder.stop"
+
+#: In-memory tail ring the incident bundler and /events endpoint read.
+_TAIL_CAPACITY = 2048
+
+
+def journal_files(directory: str) -> List[Tuple[int, int, str]]:
+    """Sorted ``(incarnation, part, path)`` of the journal files under
+    ``directory`` (empty when the directory does not exist)."""
+    out: List[Tuple[int, int, str]] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        m = _FILE_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), int(m.group(2)), os.path.join(directory, name)))
+    out.sort()
+    return out
+
+
+def _read_file(path: str) -> Tuple[List[Dict[str, Any]], int]:
+    """(valid records, torn/invalid line count) of one journal file. A torn
+    tail — a kill mid-write — is at most one unparsable trailing line; any
+    unparsable line anywhere is skipped and counted, never fatal."""
+    records: List[Dict[str, Any]] = []
+    torn = 0
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            payload = f.read()
+    except OSError:
+        return records, torn
+    for line in payload.split("\n"):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            torn += 1
+            continue
+        if isinstance(rec, dict) and "seq" in rec:
+            records.append(rec)
+        else:
+            torn += 1
+    return records, torn
+
+
+def read_journal(directory: str) -> List[Dict[str, Any]]:
+    """Every valid record in the journal under ``directory``, in file order
+    (incarnation, part, position). Torn/invalid lines are silently skipped —
+    the torn-tail tolerance contract."""
+    records: List[Dict[str, Any]] = []
+    for _, _, path in journal_files(directory):
+        recs, _ = _read_file(path)
+        records.extend(recs)
+    return records
+
+
+def journal_tail(directory: str, n: int = 100) -> List[Dict[str, Any]]:
+    """The newest ``n`` valid records of the on-disk journal."""
+    return read_journal(directory)[-max(0, int(n)):]
+
+
+class FlightRecorder:
+    """The journal's writer half: a bounded queue fed by ``emit`` /
+    ``incident`` on any thread, drained by one dedicated writer thread that
+    owns the sequence counter, the open file, the in-memory tail ring, and
+    the incident bundler. See the module docstring for the contract."""
+
+    #: Injectable clocks (monotonic shares the tracer's timebase so journal
+    #: records interleave exactly with span intervals).
+    clock: Callable[[], float] = staticmethod(time.perf_counter)
+    wall_clock: Callable[[], float] = staticmethod(time.time)
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        *,
+        enabled: Optional[bool] = None,
+        queue_capacity: Optional[int] = None,
+        max_bytes: Optional[int] = None,
+        keep_files: Optional[int] = None,
+        incident_dir: Optional[str] = None,
+        incident_window_s: Optional[float] = None,
+        incident_keep: Optional[int] = None,
+        incident_min_interval_s: Optional[float] = None,
+        scope: str = MLMetrics.TELEMETRY_GROUP,
+    ):
+        self.enabled = (  # graftcheck: owned-by=main
+            bool(enabled) if enabled is not None
+            else bool(config.get(Options.OBSERVABILITY_JOURNAL))
+        )
+        if directory is None:
+            directory = config.get(Options.OBSERVABILITY_JOURNAL_DIR)
+        if directory is None and self.enabled:
+            # Unconfigured default: a fresh per-process dir — always-on
+            # recording without cross-process sequence collisions.
+            directory = tempfile.mkdtemp(prefix="flink-ml-tpu-flight-")
+        self.directory = directory
+        self.scope = scope
+        self.queue_capacity = int(
+            queue_capacity if queue_capacity is not None
+            else config.get(Options.OBSERVABILITY_JOURNAL_QUEUE)
+        )
+        self.max_bytes = int(
+            max_bytes if max_bytes is not None
+            else config.get(Options.OBSERVABILITY_JOURNAL_MAX_BYTES)
+        )
+        self.keep_files = max(1, int(
+            keep_files if keep_files is not None
+            else config.get(Options.OBSERVABILITY_JOURNAL_KEEP_FILES)
+        ))
+        self.incident_dir = incident_dir or (
+            os.path.join(directory, "incidents") if directory else None
+        )
+        self.incident_window_s = float(
+            incident_window_s if incident_window_s is not None
+            else config.get(Options.OBSERVABILITY_INCIDENT_WINDOW_S)
+        )
+        self.incident_keep = max(1, int(
+            incident_keep if incident_keep is not None
+            else config.get(Options.OBSERVABILITY_INCIDENT_KEEP)
+        ))
+        self.incident_min_interval_s = float(
+            incident_min_interval_s if incident_min_interval_s is not None
+            else config.get(Options.OBSERVABILITY_INCIDENT_MIN_INTERVAL_S)
+        )
+
+        # Queue state — every access under _lock/_cond (shared-state-guard's
+        # consistent-lockset contract across emitter threads and the writer).
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._closed = False
+        self._dropped = 0
+        self._enqueued = 0
+        self._flushed_through = 0
+        self._last_incident: Dict[str, float] = {}
+        self._incidents_suppressed = 0
+
+        # Writer-thread state: the startup scan (incarnation/sequence resume,
+        # file open) and every mutation below happen ONLY on the writer
+        # thread, so the hot path never does file I/O — emit() is one
+        # bounded-queue append. Reads elsewhere (properties, tests) accept
+        # benign staleness.
+        self._seq = 0  # graftcheck: owned-by=flight-recorder
+        self._incarnation = 0  # graftcheck: owned-by=flight-recorder
+        self._part = 0  # graftcheck: owned-by=flight-recorder
+        self._file = None  # graftcheck: owned-by=flight-recorder
+        self._bytes = 0  # graftcheck: owned-by=flight-recorder
+        self._write_errors = 0  # graftcheck: owned-by=flight-recorder
+        self._events_written = 0  # graftcheck: owned-by=flight-recorder
+        self._dropped_published = 0  # graftcheck: owned-by=flight-recorder
+        self._incidents_written = 0  # graftcheck: owned-by=flight-recorder
+        self._resumed_from = None  # graftcheck: owned-by=flight-recorder
+        self._crash_resume = False  # graftcheck: owned-by=flight-recorder
+
+        # Tail ring: appended by the writer, snapshotted by /events and the
+        # incident bundler — its own short lock, never held during I/O.
+        self._tail_lock = threading.Lock()
+        self._tail: deque = deque(maxlen=_TAIL_CAPACITY)
+
+        #: Set once the writer finished its startup scan (sequence resumed,
+        #: file open, resume/incident records written) — flush() waits on it
+        #: so "flush then read the journal" is race-free in tests.
+        self._started = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.enabled:
+            self._thread = threading.Thread(
+                target=self._loop,
+                name=f"flight-recorder[{self.directory}]",
+                daemon=True,
+            )
+            self._thread.start()
+
+    # -- the hot-path half -----------------------------------------------------
+    def emit(self, kind: str, scope: Optional[str] = None, data: Optional[Dict[str, Any]] = None) -> bool:
+        """Enqueue one decision record. Returns False when disabled, closed,
+        or dropped on overflow — callers never care, but tests do. ONE
+        bounded-queue append: no I/O, no serialization on this thread."""
+        if not self.enabled:
+            return False
+        span = tracer.current() if tracer.enabled else None
+        rec: Dict[str, Any] = {
+            "kind": kind,
+            "t": self.clock(),
+            "wall": self.wall_clock(),
+            "thread": threading.current_thread().name,
+        }
+        if scope is not None:
+            rec["scope"] = scope
+        if span is not None:
+            rec["span"] = span.span_id
+        if data:
+            rec["data"] = data
+        with self._cond:
+            if self._closed:
+                return False
+            if len(self._queue) >= self.queue_capacity:
+                self._dropped += 1
+                return False
+            self._queue.append(rec)
+            self._enqueued += 1
+            self._cond.notify()
+        return True
+
+    def incident(self, kind: str, scope: Optional[str] = None, context: Optional[Dict[str, Any]] = None) -> bool:
+        """Request an incident bundle (written by the writer thread, off
+        every hot path): the last ``incident_window_s`` of the journal, the
+        full metrics registry, recent spans (if tracing is on), the resolved
+        config, and the version lineage, into a self-contained
+        ``incident-<seq>-<kind>/`` directory. Rate-limited per kind and
+        bounded-retention (docs/observability.md "Incident bundles")."""
+        if not self.enabled:
+            return False
+        now = self.clock()
+        entry: Dict[str, Any] = {
+            "kind": "incident",
+            "_incident": kind,
+            "t": now,
+            "wall": self.wall_clock(),
+            "thread": threading.current_thread().name,
+        }
+        if scope is not None:
+            entry["scope"] = scope
+        if context:
+            entry["data"] = dict(context)
+        with self._cond:
+            if self._closed:
+                return False
+            last = self._last_incident.get(kind)
+            if last is not None and now - last < self.incident_min_interval_s:
+                self._incidents_suppressed += 1
+                return False
+            self._last_incident[kind] = now
+            # Incidents are rare and precious: they enqueue even past the
+            # event-drop watermark (the queue bound still exists — a closed
+            # recorder or a dead writer simply never drains them).
+            self._queue.append(entry)
+            self._enqueued += 1
+            self._cond.notify()
+        return True
+
+    # -- introspection ---------------------------------------------------------
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    @property
+    def incidents_suppressed(self) -> int:
+        with self._lock:
+            return self._incidents_suppressed
+
+    @property
+    def seq(self) -> int:
+        """Last written sequence number (writer-owned; benign-stale read)."""
+        return self._seq
+
+    @property
+    def incarnation(self) -> int:
+        return self._incarnation
+
+    @property
+    def write_errors(self) -> int:
+        return self._write_errors
+
+    @property
+    def crash_resumed(self) -> bool:
+        """Whether startup found a previous incarnation without its clean
+        stop marker (and therefore journaled a resume + incident)."""
+        return self._crash_resume
+
+    def tail(self, n: int = 100) -> List[Dict[str, Any]]:
+        """The newest ``n`` records already written (the in-memory ring —
+        what /events and incident bundles read)."""
+        with self._tail_lock:
+            records = list(self._tail)
+        return records[-max(0, int(n)):]
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Block until everything enqueued so far is written and flushed (or
+        the timeout passes — e.g. the writer died on an injected fault).
+        Test/shutdown surface, never called from a hot path."""
+        deadline = time.monotonic() + timeout_s
+        if self.enabled and not self._started.wait(timeout_s):
+            return False
+        with self._cond:
+            target = self._enqueued
+            while self._flushed_through < target:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._alive():
+                    return self._flushed_through >= target
+                self._cond.wait(min(remaining, 0.1))
+        return True
+
+    def _alive(self) -> bool:
+        thread = self._thread
+        return thread is not None and thread.is_alive()
+
+    # -- lifecycle -------------------------------------------------------------
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Journal the clean-shutdown marker, drain the queue, close the
+        file. A recorder that is killed instead (no close) is exactly what
+        the crash-resume path detects next incarnation."""
+        self.emit(_STOP_KIND)
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    # -- the writer thread -----------------------------------------------------
+    def _loop(self) -> None:
+        try:
+            self._startup()
+            self._safe_flush()  # start/resume records visible before any batch
+        except Exception:
+            self._write_errors += 1
+            return
+        finally:
+            self._started.set()
+        crashed = False
+        while not crashed:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait(0.2)
+                batch = list(self._queue)
+                self._queue.clear()
+                drained_to = self._enqueued
+                closing = self._closed
+            for entry in batch:
+                try:
+                    if "_incident" in entry:
+                        self._handle_incident(entry)
+                    else:
+                        self._write_record(entry)
+                except BaseException as e:  # noqa: BLE001 — per-record containment
+                    self._write_errors += 1
+                    if isinstance(e, InjectedFault):
+                        # The telemetry.journal seam: a mid-write kill. Leave
+                        # the torn tail exactly as a hard kill would and die —
+                        # the crash-recovery tests resume a new incarnation
+                        # over it.
+                        crashed = True
+                        break
+                    try:  # seal the torn line so later records stay parsable
+                        if self._file is not None:
+                            self._file.write("\n")
+                    except OSError:
+                        pass
+            self._safe_flush()
+            self._publish_metrics()
+            with self._cond:
+                self._flushed_through = max(self._flushed_through, drained_to)
+                self._cond.notify_all()
+                if crashed or (closing and not self._queue):
+                    break
+        if not crashed and self._file is not None:
+            try:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._file.close()
+            except OSError:
+                self._write_errors += 1
+            self._file = None
+
+    def _startup(self) -> None:
+        """Writer-thread first act: scan the directory, resume the sequence
+        and incarnation counters past everything already on disk, open the
+        new incarnation's file, and journal the start/resume record (plus
+        the crash-resume incident when the last incarnation died unclean)."""
+        os.makedirs(self.directory, exist_ok=True)
+        last_seq = 0
+        last_inc = 0
+        last_kind: Optional[str] = None
+        torn_tail = False
+        prior: List[Dict[str, Any]] = []
+        for inc, _, path in journal_files(self.directory):
+            records, torn = _read_file(path)
+            last_inc = max(last_inc, inc)
+            prior.extend(records)
+            if records:
+                tail = records[-1]
+                if tail["seq"] >= last_seq:
+                    last_seq = tail["seq"]
+                    last_kind = tail.get("kind")
+                    torn_tail = torn > 0
+            elif torn:
+                torn_tail = True
+        # Seed the tail ring with the previous life's newest records so a
+        # crash-resume incident bundle is a postmortem of the PRIOR
+        # incarnation, not an empty window. (Their monotonic `t` values are
+        # from another process and incomparable — the bundler's window
+        # filter exempts records of earlier incarnations.)
+        if prior:
+            with self._tail_lock:
+                self._tail.extend(prior[-256:])
+        self._seq = last_seq
+        self._incarnation = last_inc + 1
+        self._part = 0
+        self._open_part()
+        if last_inc == 0:
+            self._write_record(self._system_record("recorder.start"))
+            return
+        # A previous incarnation exists: resume the sequence (no reuse) and
+        # decide whether it shut down cleanly.
+        self._resumed_from = last_inc
+        clean = last_kind == _STOP_KIND
+        self._crash_resume = not clean
+        self._write_record(
+            self._system_record(
+                "recorder.resume",
+                {
+                    "prior_incarnation": last_inc,
+                    "prior_seq": last_seq,
+                    "clean_shutdown": clean,
+                    "torn_tail": torn_tail,
+                },
+            )
+        )
+        if not clean:
+            self._handle_incident(
+                self._system_record(
+                    "incident",
+                    {
+                        "prior_incarnation": last_inc,
+                        "prior_seq": last_seq,
+                        "torn_tail": torn_tail,
+                    },
+                    _incident="crash-resume",
+                )
+            )
+
+    def _system_record(self, kind: str, data: Optional[Dict[str, Any]] = None, _incident: Optional[str] = None) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "kind": kind,
+            "t": self.clock(),
+            "wall": self.wall_clock(),
+            "thread": threading.current_thread().name,
+            "scope": self.scope,
+        }
+        if data:
+            rec["data"] = data
+        if _incident is not None:
+            rec["_incident"] = _incident
+        return rec
+
+    def _open_part(self) -> None:
+        path = os.path.join(
+            self.directory, f"journal-{self._incarnation:06d}-{self._part:04d}.jsonl"
+        )
+        self._file = open(path, "a", encoding="utf-8")
+        self._bytes = 0
+
+    def _rotate_if_needed(self) -> None:
+        if self._bytes < self.max_bytes:
+            return
+        try:
+            self._file.flush()
+            self._file.close()
+        except OSError:
+            self._write_errors += 1
+        self._part += 1
+        self._open_part()
+        files = journal_files(self.directory)
+        for _, _, path in files[: max(0, len(files) - self.keep_files)]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _write_record(self, rec: Dict[str, Any]) -> None:
+        """Serialize + append one record (writer thread only). The
+        ``telemetry.journal`` fault point sits mid-write: an armed kill
+        leaves a torn tail line, exactly like a power cut."""
+        self._seq += 1
+        rec = dict(rec)
+        rec.pop("_incident", None)
+        rec["seq"] = self._seq
+        rec["inc"] = self._incarnation
+        line = json.dumps(rec, separators=(",", ":"), default=str) + "\n"
+        mid = max(1, len(line) // 2)
+        self._file.write(line[:mid])
+        try:
+            faults.trip("telemetry.journal", seq=self._seq)
+        except BaseException:
+            self._file.flush()  # the torn half-line reaches disk, as a kill would
+            raise
+        self._file.write(line[mid:])
+        self._bytes += len(line)
+        self._events_written += 1
+        with self._tail_lock:
+            self._tail.append(rec)
+        self._rotate_if_needed()
+
+    def _handle_incident(self, entry: Dict[str, Any]) -> None:
+        """Journal the incident record, then write the bundle (both on the
+        writer thread — the journal's own record of the incident is part of
+        the bundle's tail window)."""
+        kind = entry["_incident"]
+        record = dict(entry)
+        record["kind"] = "incident"
+        data = dict(record.get("data") or {})
+        data["incident"] = kind
+        record["data"] = data
+        self._write_record(record)
+        self._safe_flush()
+        from flink_ml_tpu.telemetry.incidents import write_bundle
+
+        try:
+            path = write_bundle(
+                self.incident_dir,
+                kind,
+                seq=self._seq,
+                incarnation=self._incarnation,
+                context=dict(entry.get("data") or {}),
+                records=self.tail(_TAIL_CAPACITY),
+                window_s=self.incident_window_s,
+                now=self.clock(),
+                wall=entry.get("wall", self.wall_clock()),
+                keep=self.incident_keep,
+            )
+        except Exception:
+            self._write_errors += 1
+            return
+        self._incidents_written += 1
+        metrics.counter(self.scope, MLMetrics.TELEMETRY_INCIDENTS)
+        self.emit("incident.written", self.scope, {"incident": kind, "path": path})
+
+    def _safe_flush(self) -> None:
+        if self._file is None:
+            return
+        try:
+            self._file.flush()
+        except OSError:
+            self._write_errors += 1
+
+    def _publish_metrics(self) -> None:
+        metrics.gauge(self.scope, MLMetrics.TELEMETRY_SEQ, self._seq)
+        written = self._events_written
+        if written:
+            self._events_written = 0
+            metrics.counter(self.scope, MLMetrics.TELEMETRY_EVENTS, written)
+        with self._lock:
+            dropped = self._dropped
+        delta = dropped - self._dropped_published
+        if delta > 0:
+            self._dropped_published = dropped
+            metrics.counter(self.scope, MLMetrics.TELEMETRY_DROPPED, delta)
+        if self._write_errors:
+            metrics.gauge(self.scope, MLMetrics.TELEMETRY_WRITE_ERRORS, self._write_errors)
+
+
+# -- the process recorder ------------------------------------------------------
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    """The process flight recorder, created lazily on the first decision
+    event (so importing the package never touches the filesystem — the
+    writer thread's startup scan does, off every caller path)."""
+    global _recorder
+    rec = _recorder
+    if rec is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+            rec = _recorder
+    return rec
+
+
+def configure(directory: Optional[str] = None, **kwargs) -> FlightRecorder:
+    """Install a fresh process recorder (closing the previous one) — the
+    deployment/test entry point for pointing the journal at a stable
+    directory. Accepts every :class:`FlightRecorder` keyword."""
+    global _recorder
+    with _recorder_lock:
+        previous = _recorder
+        _recorder = FlightRecorder(directory, **kwargs)
+    if previous is not None:
+        previous.close()
+    return _recorder
+
+
+def emit(kind: str, scope: Optional[str] = None, data: Optional[Dict[str, Any]] = None) -> bool:
+    """Journal one decision record through the process recorder."""
+    return get_recorder().emit(kind, scope, data)
+
+
+def incident(kind: str, scope: Optional[str] = None, context: Optional[Dict[str, Any]] = None) -> bool:
+    """Request an incident bundle through the process recorder."""
+    return get_recorder().incident(kind, scope, context)
+
+
+def _on_fault_fired(point: str, hit: int, context: Dict[str, Any]) -> None:
+    """The faults-module observer: every fired fault point lands in the
+    journal (telemetry's own seam excluded — the writer must not journal
+    its own injected death recursively)."""
+    if point.startswith("telemetry."):
+        return
+    emit("fault.trip", None, {"point": point, "hit": hit, "context": dict(context)})
+
+
+faults.add_observer(_on_fault_fired)

@@ -455,8 +455,8 @@ class TestPrometheusExposition:
             'ml_serving_latency_ms_sum{scope="ml.serving[a]"} 10\n'
             '# TYPE ml_serving_queue_depth gauge\n'
             'ml_serving_queue_depth{scope="ml.serving[a]"} 3\n'
-            '# TYPE ml_serving_requests gauge\n'
-            'ml_serving_requests{scope="ml.serving[a]"} 7\n'
+            '# TYPE ml_serving_requests_total counter\n'
+            'ml_serving_requests_total{scope="ml.serving[a]"} 7\n'
         )
         assert registry.render_prometheus() == golden
 
@@ -471,7 +471,8 @@ class TestPrometheusExposition:
     def test_global_registry_renders_after_serving(self):
         _serve(n_requests=2, rows=2, name="t-trace-prom")
         out = metrics.render_prometheus()
-        assert '# TYPE ml_serving_requests gauge' in out
+        assert '# TYPE ml_serving_requests_total counter' in out
+        assert 'ml_serving_requests_total{scope="ml.serving[t-trace-prom]"}' in out
         assert 'ml_serving_latency_ms{scope="ml.serving[t-trace-prom]",quantile="0.5"}' in out
 
 

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""bench_trend — compare the two newest BENCH_r0*.json rounds.
+
+The repo records one ``BENCH_r<NN>.json`` per PR round (bench.py). This tool
+diffs the newest round against its predecessor, per workload row, and prints
+the per-metric deltas — flagging (non-fatally) any latency p50 that grew or
+any rows-per-second that shrank by more than the threshold (default 10%).
+
+It is wired into ``tools/ci/run_tests.sh`` as an *informational* step: a
+regression prints a WARN block and the build stays green — bench numbers on
+shared CI boxes are directional, not contractual (the honest-1-core-box
+notes in the BENCH files); the gate is a human reading the warning in the
+log. ``--strict`` turns warnings into exit 1 for local perf work.
+
+Matching: workloads pair by their ``name`` field (rows without one are
+skipped); within a pair, every numeric field whose key contains ``p50`` /
+``p99`` / ``p999`` counts as a latency (lower is better) and every field
+containing ``rows_per_sec`` / ``rows_per_s`` / ``per_sec`` as a throughput
+(higher is better). Nested dicts are walked with dotted key paths; lists of
+dicts (offered-load sweeps) are walked by index.
+
+Usage:
+    python tools/bench_trend.py [--dir REPO_ROOT] [--threshold 0.10] [--strict]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+#: Key-substring → direction ("lower" | "higher" is better).
+_LATENCY_KEYS = ("p50", "p99", "p999")
+_THROUGHPUT_KEYS = ("rows_per_sec", "rows_per_s", "per_sec")
+
+__all__ = ["bench_rounds", "compare_workloads", "flatten_numeric", "main"]
+
+
+def bench_rounds(directory: str) -> List[Tuple[int, str]]:
+    """Sorted (round number, path) of the BENCH_r*.json files."""
+    out = []
+    for name in os.listdir(directory):
+        m = _BENCH_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def flatten_numeric(obj: Any, prefix: str = "") -> Dict[str, float]:
+    """Dotted-path → value for every numeric leaf (bools excluded)."""
+    flat: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            flat.update(flatten_numeric(v, f"{prefix}{k}."))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            flat.update(flatten_numeric(v, f"{prefix}{i}."))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        flat[prefix[:-1]] = float(obj)
+    return flat
+
+
+def _direction(key: str) -> Optional[str]:
+    leaf = key.rsplit(".", 1)[-1]
+    if any(t in leaf for t in _THROUGHPUT_KEYS):
+        return "higher"
+    if any(t in leaf for t in _LATENCY_KEYS):
+        return "lower"
+    return None
+
+
+def compare_workloads(
+    old: Dict[str, Any], new: Dict[str, Any], threshold: float
+) -> Tuple[List[str], List[str]]:
+    """(report lines, warnings) for one workload pair."""
+    lines: List[str] = []
+    warnings: List[str] = []
+    old_flat = flatten_numeric(old)
+    new_flat = flatten_numeric(new)
+    for key in sorted(set(old_flat) & set(new_flat)):
+        direction = _direction(key)
+        if direction is None:
+            continue
+        before, after = old_flat[key], new_flat[key]
+        if before == 0.0:
+            continue
+        rel = (after - before) / abs(before)
+        marker = ""
+        regressed = (direction == "lower" and rel > threshold) or (
+            direction == "higher" and rel < -threshold
+        )
+        if regressed:
+            marker = "  <-- REGRESSION"
+        lines.append(f"    {key:<48} {before:>12.4g} -> {after:>12.4g} ({rel:+.1%}){marker}")
+        if regressed:
+            warnings.append(
+                f"{new.get('name', '?')}: {key} {before:.4g} -> {after:.4g} "
+                f"({rel:+.1%}, {'latency grew' if direction == 'lower' else 'throughput fell'})"
+            )
+    return lines, warnings
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="compare the two newest BENCH rounds")
+    parser.add_argument("--dir", default=REPO_ROOT, help="directory holding BENCH_r*.json")
+    parser.add_argument("--threshold", type=float, default=0.10,
+                        help="relative regression bound before warning (default 0.10)")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 on any regression (default: informational, exit 0)")
+    args = parser.parse_args(argv)
+
+    rounds = bench_rounds(args.dir)
+    if len(rounds) < 2:
+        print(f"bench_trend: fewer than two BENCH rounds under {args.dir} — nothing to compare")
+        return 0
+    (old_n, old_path), (new_n, new_path) = rounds[-2], rounds[-1]
+    try:
+        with open(old_path, encoding="utf-8") as f:
+            old = json.load(f)
+        with open(new_path, encoding="utf-8") as f:
+            new = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_trend: cannot load rounds: {e}", file=sys.stderr)
+        return 0 if not args.strict else 1
+
+    old_rows = {w["name"]: w for w in old.get("workloads", []) if isinstance(w, dict) and "name" in w}
+    new_rows = {w["name"]: w for w in new.get("workloads", []) if isinstance(w, dict) and "name" in w}
+    shared = sorted(set(old_rows) & set(new_rows))
+    print(f"bench_trend: r{old_n:02d} -> r{new_n:02d}, {len(shared)} shared workload row(s)")
+    for name in sorted(set(new_rows) - set(old_rows)):
+        print(f"  + new row {name}")
+    for name in sorted(set(old_rows) - set(new_rows)):
+        print(f"  - dropped row {name}")
+
+    all_warnings: List[str] = []
+    for name in shared:
+        lines, warnings = compare_workloads(old_rows[name], new_rows[name], args.threshold)
+        if lines:
+            print(f"  {name}:")
+            for line in lines:
+                print(line)
+        all_warnings.extend(warnings)
+
+    if all_warnings:
+        print(f"\nbench_trend WARN: {len(all_warnings)} metric(s) regressed past "
+              f"{args.threshold:.0%} (informational — see the honest-box notes in the BENCH files):")
+        for w in all_warnings:
+            print(f"  ! {w}")
+        return 1 if args.strict else 0
+    print("bench_trend: no regressions past the threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,12 @@ Asserted:
 - the armed swap failed typed and serving kept answering on the old version;
 - recovery: the post-fault goodput fraction is within 10% of baseline, and
   graftscope's per-category attribution sums to traced wall time in the
-  traced phases.
+  traced phases;
+- flight recorder (always on, pointed at a scratch journal): zero dropped
+  records, every controller action / swap / fired fault appears in the
+  journal exactly once with strictly increasing sequence numbers, and the
+  armed-swap episode yields exactly one well-formed ``swap-failure``
+  incident bundle that ``tools/traceview.py incident`` renders with exit 0.
 
 Exit codes: 0 = all invariants hold, 1 = any violated.
 """
@@ -48,13 +53,18 @@ def main(argv=None) -> int:
 
     import numpy as np
 
-    from flink_ml_tpu import trace
+    from flink_ml_tpu import telemetry, trace
     from flink_ml_tpu.api.dataframe import DataFrame
     from flink_ml_tpu.faults import faults
     from flink_ml_tpu.loadgen import OpenLoopLoadGenerator, ZipfSizes, ramp_schedule
     from flink_ml_tpu.metrics import MLMetrics, metrics
     from flink_ml_tpu.servable.api import TransformerServable
     from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    # The recorder is on by default; point it at a scratch journal so this
+    # run's decisions are assertable (and the incident bundles land here).
+    journal_dir = tempfile.mkdtemp(prefix="chaos-smoke-journal-")
+    recorder = telemetry.configure(journal_dir)
 
     failures = []
 
@@ -142,6 +152,8 @@ def main(argv=None) -> int:
             [(0.8 * chaos_rps / 2.2, 0.3), (chaos_rps, 1.0)], seed=13, traced=False
         )
         swapped = poller.poll_once()  # the armed seam fires in here
+        dispatch_fires = faults.fires("serving.dispatch")
+        swap_fires = faults.fires("serving.swap")
         faults.reset()
 
         print("phase 3: recovery (traced)")
@@ -211,6 +223,65 @@ def main(argv=None) -> int:
         f"recovery goodput within 10% of pre-fault baseline "
         f"({base_fraction:.3f} -> {rec_fraction:.3f})",
     )
+
+    # -- flight-recorder invariants (the journal saw everything, exactly once)
+    check(recorder.flush(15.0), "journal flushed to disk")
+    check(recorder.dropped == 0, f"zero journal records dropped ({recorder.dropped})")
+    records = telemetry.read_journal(journal_dir)
+    seqs = [r["seq"] for r in records]
+    check(
+        seqs == sorted(seqs) and len(set(seqs)) == len(seqs),
+        f"journal sequence strictly increasing ({len(seqs)} records)",
+    )
+    journal_actions = [r for r in records if r["kind"] == "controller.action"]
+    counted_actions = metrics.get(server.scope, MLMetrics.SERVING_CONTROLLER_ACTIONS) or 0
+    check(
+        len(journal_actions) == counted_actions,
+        f"every controller action journaled exactly once "
+        f"({len(journal_actions)} == {counted_actions}), each with its ledger evidence",
+    )
+    check(
+        all(a.get("data", {}).get("ledger_ms") is not None for a in journal_actions),
+        "controller-action records carry the justifying ledger snapshot",
+    )
+    journal_swaps = [r for r in records if r["kind"] == "serving.swap"]
+    check(
+        len(journal_swaps) == 1 and journal_swaps[0]["data"]["version"] == 1,
+        "the one completed swap (v1 install) journaled exactly once",
+    )
+    journal_trips = [r for r in records if r["kind"] == "fault.trip"]
+    check(
+        len(journal_trips) == dispatch_fires + swap_fires,
+        f"every fired fault journaled exactly once "
+        f"({len(journal_trips)} == {dispatch_fires} dispatch + {swap_fires} swap)",
+    )
+    swap_failed = [r for r in records if r["kind"] == "serving.swap.failed"]
+    check(
+        len(swap_failed) == 1 and swap_failed[0]["data"]["version"] == 2,
+        "the armed-swap rejection journaled exactly once",
+    )
+    shed_records = [r for r in records if r["kind"] == "controller.action"
+                    and r["data"]["action"] == "shed"]
+    check(bool(shed_records), f"shed episodes journaled ({len(shed_records)})")
+
+    bundles = telemetry.list_bundles(recorder.incident_dir)
+    swap_bundles = [b for b in bundles if b.endswith("swap-failure")]
+    check(
+        len(swap_bundles) == 1,
+        f"armed-swap episode yielded exactly one incident bundle ({swap_bundles})",
+    )
+    if swap_bundles:
+        import contextlib
+        import io
+
+        import tools.traceview as traceview
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            code = traceview.main(["incident", swap_bundles[0], "--top", "12"])
+        check(code == 0, f"traceview incident renders the bundle (exit {code})")
+        check("swap-failure" in out.getvalue(), "incident summary names the episode")
+    recorder.close()
 
     if failures:
         print(f"chaos smoke FAILED: {len(failures)} invariant(s) violated", file=sys.stderr)

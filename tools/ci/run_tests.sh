@@ -59,7 +59,18 @@ python tools/ci/fusion_smoke.py
 # high-priority deadline miss, at least one adaptive-controller action from
 # the live goodput ledger, and recovery to within 10% of the pre-fault
 # goodput fraction (docs/serving.md "Load shedding & adaptive control").
+# Runs with the flight recorder pointed at a scratch journal: every
+# controller action, swap and fault trip must land in the journal exactly
+# once, and the armed-swap episode must yield one incident bundle that
+# `traceview incident` renders (docs/observability.md).
 echo "=== chaos smoke (open-loop ramp past saturation, faults armed) ==="
 python tools/ci/chaos_smoke.py
+
+# Bench trend (informational): diff the two newest BENCH_r*.json rounds and
+# warn on >10% p50 / rows-per-second movement — directional on shared CI
+# boxes, so the step never fails the build (tools/bench_trend.py --strict
+# exists for local perf work).
+echo "=== bench trend (informational) ==="
+python tools/bench_trend.py || true
 
 echo "CI OK"

@@ -62,6 +62,12 @@ PACKAGE_LAYERS = {
     # fast path, so it sits at L1 itself and only imports L0 (config,
     # metrics) — the runtime-free guarantee covers instrumented servables.
     "trace": 1,
+    # The always-on flight recorder (journal / incidents / HTTP endpoint):
+    # instrumented by the serving tier and the fast-path planners, so it
+    # sits at L1 like trace and imports only L0 (config, faults, metrics)
+    # plus trace itself. The L0 faults module reaches it through its
+    # observer hook — never by importing upward.
+    "telemetry": 1,
     "iteration": 2,
     "execution": 2,
     "builder": 2,
@@ -156,7 +162,7 @@ class LayerDepsRule(Rule):
     name = "layer-deps"
     severity = "error"
     granularity = "file"
-    cache_version = 3  # v3: servable.sharding registered (pod-scale fan-out)
+    cache_version = 4  # v4: telemetry registered (flight recorder, L1)
     description = (
         "imports within flink_ml_tpu must not point at a higher layer "
         "(foundation < compute/servable < runtime < library)"

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""traceview — offline analysis of a graftscope Chrome trace export.
+"""traceview — offline analysis of graftscope traces and incident bundles.
 
-Loads the trace-event JSON written by
+**Trace mode** loads the trace-event JSON written by
 ``flink_ml_tpu.trace.SpanRecorder.export_chrome_trace`` and prints, per scope
 (= trace-event pid, named by ``process_name`` metadata):
 
@@ -20,12 +20,21 @@ Loads the trace-event JSON written by
 The same span self-time attribution as the live ``GoodputReport`` (parents
 minus same-scope children), reconstructed from the ``span_id``/``parent_id``
 the exporter stashes under each event's ``args`` — so the offline numbers
-match what ``ml.goodput.*`` gauges would have read.
+match what ``ml.goodput.*`` gauges would have read. ``--json`` emits the
+summary and per-category sections machine-readable so CI can assert on
+attribution numbers without screen-scraping.
+
+**Incident mode** renders a flight-recorder incident bundle
+(``flink_ml_tpu.telemetry``, docs/observability.md) as a postmortem
+timeline: the journal's decision records interleaved with the bundle's span
+categories on one monotonic clock (they share the ``time.perf_counter``
+timebase by construction), plus the trigger context and version lineage.
 
 Usage:
-    python tools/traceview.py /tmp/trace.json [--scope ml.serving] [--top 20]
+    python tools/traceview.py /tmp/trace.json [--scope ml.serving] [--top 20] [--json]
+    python tools/traceview.py incident /path/to/incident-000004-rollback [--json]
 
-Exit codes: 0 = analyzed, 2 = unreadable/invalid/empty trace.
+Exit codes: 0 = analyzed, 2 = unreadable/invalid/empty input.
 """
 from __future__ import annotations
 
@@ -41,7 +50,14 @@ if REPO_ROOT not in sys.path:
 
 from flink_ml_tpu.trace import CATEGORIES, GoodputReport, Span  # noqa: E402
 
-__all__ = ["load_spans", "summarize", "main"]
+__all__ = [
+    "load_spans",
+    "summarize",
+    "summarize_data",
+    "incident_timeline",
+    "summarize_incident",
+    "main",
+]
 
 
 def load_spans(path: str) -> List[Span]:
@@ -115,6 +131,63 @@ def _shard_summary(scope_spans: List[Span]) -> List[str]:
     return lines
 
 
+def summarize_data(
+    spans: List[Span], scope_filter: Optional[str] = None, top: int = 20
+) -> Dict[str, Any]:
+    """The machine-readable form of :func:`summarize` — same attribution,
+    as a JSON-safe dict (``--json``): per scope the traced wall ms, goodput
+    fraction, per-category ms + share, and the ranked per-span stats; plus
+    the overall goodput fraction. CI asserts on these numbers instead of
+    screen-scraping the human report."""
+    if scope_filter:
+        spans = [s for s in spans if s.scope.startswith(scope_filter)]
+    report = GoodputReport.from_spans(spans)
+    scopes: Dict[str, Any] = {}
+    for scope in report.scopes():
+        wall_ms = report.wall_s(scope) * 1000.0
+        categories: Dict[str, Any] = {}
+        for category in CATEGORIES:
+            ms = report.category_s(scope, category) * 1000.0
+            if ms <= 0.0:
+                continue
+            categories[category] = {
+                "ms": round(ms, 6),
+                "share": round(ms / wall_ms, 6) if wall_ms > 0.0 else 0.0,
+            }
+        by_name: Dict[str, List[float]] = {}
+        for s in spans:
+            if s.scope == scope:
+                by_name.setdefault(s.name, []).append(s.duration * 1000.0)
+        ranked = sorted(by_name.items(), key=lambda kv: -sum(kv[1]))[:top]
+        span_stats = []
+        for name, durs in ranked:
+            ordered = sorted(durs)
+            total = sum(durs)
+            span_stats.append(
+                {
+                    "name": name,
+                    "count": len(durs),
+                    "p50_ms": round(_quantile(ordered, 0.5), 6),
+                    "p99_ms": round(_quantile(ordered, 0.99), 6),
+                    "total_ms": round(total, 6),
+                    "share": round(total / wall_ms, 6) if wall_ms > 0.0 else 0.0,
+                }
+            )
+        fraction = report.fraction(scope)
+        scopes[scope] = {
+            "wall_ms": round(wall_ms, 6),
+            "goodput_fraction": round(fraction, 6) if fraction is not None else None,
+            "categories": categories,
+            "spans": span_stats,
+        }
+    overall = report.fraction()
+    return {
+        "spans": len(spans),
+        "scopes": scopes,
+        "overall_goodput_fraction": round(overall, 6) if overall is not None else None,
+    }
+
+
 def summarize(spans: List[Span], scope_filter: Optional[str] = None, top: int = 20) -> str:
     """The human report (one string, printed by main)."""
     if scope_filter:
@@ -162,11 +235,159 @@ def summarize(spans: List[Span], scope_filter: Optional[str] = None, top: int = 
     return "\n".join(lines)
 
 
+def incident_timeline(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """One merged timeline of a loaded incident bundle: journal decision
+    records and span intervals (by start time), sorted on the shared
+    monotonic clock. Each entry: ``{"t", "source": "journal"|"span",
+    "label", "category"|None, "detail"}``."""
+    current_inc = bundle.get("manifest", {}).get("incarnation", 0)
+    entries: List[Dict[str, Any]] = []
+    for rec in bundle.get("records", []):
+        detail = dict(rec.get("data") or {})
+        entries.append(
+            {
+                "t": float(rec.get("t", 0.0)),
+                "inc": rec.get("inc", current_inc),
+                "source": "journal",
+                "label": rec.get("kind", "?"),
+                "seq": rec.get("seq"),
+                "scope": rec.get("scope"),
+                "category": None,
+                "detail": detail,
+            }
+        )
+    for ev in bundle.get("trace_events", []):
+        if ev.get("ph") != "X":
+            continue
+        entries.append(
+            {
+                "t": float(ev.get("ts", 0.0)) / 1e6,
+                "inc": current_inc,
+                "source": "span",
+                "label": ev.get("name", "?"),
+                "seq": None,
+                "scope": None,
+                "category": ev.get("cat"),
+                "detail": {"dur_ms": round(float(ev.get("dur", 0.0)) / 1e3, 3)},
+            }
+        )
+    # Monotonic clocks are per-process: order by incarnation first (a
+    # crash-resume bundle carries the prior life's tail), then by time —
+    # comparable within one incarnation by construction.
+    entries.sort(key=lambda e: (e["inc"], e["t"]))
+    return entries
+
+
+def summarize_incident(bundle: Dict[str, Any], top: int = 200) -> str:
+    """The human postmortem: trigger header, version lineage, then the
+    interleaved journal/span timeline (relative seconds from the first
+    entry; span entries grouped per category)."""
+    manifest = bundle.get("manifest", {})
+    lines: List[str] = []
+    lines.append(
+        f"incident {manifest.get('kind', '?')} — seq {manifest.get('seq')}, "
+        f"incarnation {manifest.get('incarnation')}"
+    )
+    context = manifest.get("context") or {}
+    if context:
+        lines.append(f"  context: {json.dumps(context, default=str)}")
+    lineage = manifest.get("lineage") or []
+    if lineage:
+        lines.append("  version lineage:")
+        for entry in lineage:
+            version = entry.get("version")
+            lines.append(
+                f"    seq {entry.get('seq'):>6}  {entry.get('kind'):<22}"
+                + (f" v{version}" if version is not None else "")
+            )
+    timeline = incident_timeline(bundle)
+    if timeline:
+        t0 = timeline[0]["t"]
+        cat_ms: Dict[str, float] = {}
+        for e in timeline:
+            if e["source"] == "span" and e["category"]:
+                cat_ms[e["category"]] = cat_ms.get(e["category"], 0.0) + e["detail"].get("dur_ms", 0.0)
+        if cat_ms:
+            lines.append("  span categories in the window:")
+            for cat in CATEGORIES:
+                if cat in cat_ms:
+                    lines.append(f"    {cat:<12} {cat_ms[cat]:>12.3f} ms")
+        lines.append(f"  timeline ({len(timeline)} entries):")
+        shown = timeline if len(timeline) <= top else timeline[-top:]
+        if len(shown) < len(timeline):
+            lines.append(f"    ... {len(timeline) - len(shown)} earlier entries elided ...")
+        # Relative seconds restart per incarnation: monotonic clocks are
+        # per-process, so cross-incarnation offsets are meaningless.
+        inc_t0: Dict[Any, float] = {}
+        for e in timeline:
+            inc_t0.setdefault(e["inc"], e["t"])
+        last_inc = None
+        for e in shown:
+            if e["inc"] != last_inc:
+                if last_inc is not None or len(inc_t0) > 1:
+                    lines.append(f"    -- incarnation {e['inc']} --")
+                last_inc = e["inc"]
+            rel = e["t"] - inc_t0[e["inc"]]
+            if e["source"] == "journal":
+                detail = json.dumps(e["detail"], default=str) if e["detail"] else ""
+                lines.append(f"    +{rel:9.4f}s  [journal #{e['seq']}] {e['label']} {detail}")
+            else:
+                lines.append(
+                    f"    +{rel:9.4f}s  [span:{e['category']}] {e['label']} "
+                    f"({e['detail'].get('dur_ms', 0.0):.3f} ms)"
+                )
+    return "\n".join(lines)
+
+
+def _main_incident(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="traceview incident", description="flight-recorder incident postmortem"
+    )
+    parser.add_argument("bundle", help="incident-<seq>-<kind>/ directory (telemetry bundles)")
+    parser.add_argument("--json", action="store_true", help="machine-readable timeline + manifest")
+    parser.add_argument("--top", type=int, default=200, help="timeline entries shown (newest kept)")
+    args = parser.parse_args(argv)
+    from flink_ml_tpu.telemetry import load_bundle  # noqa: E402 — repo-root path set above
+
+    try:
+        bundle = load_bundle(args.bundle)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"traceview: cannot load incident bundle {args.bundle}: {e}", file=sys.stderr)
+        return 2
+    if not bundle.get("records"):
+        print(f"traceview: {args.bundle} contains no journal records", file=sys.stderr)
+        return 2
+    try:
+        if args.json:
+            print(
+                json.dumps(
+                    {
+                        "manifest": bundle["manifest"],
+                        "timeline": incident_timeline(bundle),
+                    },
+                    indent=1,
+                    default=str,
+                )
+            )
+        else:
+            print(summarize_incident(bundle, top=args.top))
+    except BrokenPipeError:
+        return 0
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "incident":
+        return _main_incident(argv[1:])
     parser = argparse.ArgumentParser(description="graftscope trace analyzer")
     parser.add_argument("trace", help="Chrome trace-event JSON (SpanRecorder.export_chrome_trace)")
     parser.add_argument("--scope", help="only scopes with this prefix (e.g. ml.serving)")
     parser.add_argument("--top", type=int, default=20, help="span names per scope (by total time)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable summary (summarize_data) instead of the human report",
+    )
     args = parser.parse_args(argv)
     try:
         spans = load_spans(args.trace)
@@ -177,8 +398,11 @@ def main(argv=None) -> int:
         print(f"traceview: {args.trace} contains no spans", file=sys.stderr)
         return 2
     try:
-        print(f"{args.trace}: {len(spans)} spans")
-        print(summarize(spans, scope_filter=args.scope, top=args.top))
+        if args.json:
+            print(json.dumps(summarize_data(spans, scope_filter=args.scope, top=args.top), indent=1))
+        else:
+            print(f"{args.trace}: {len(spans)} spans")
+            print(summarize(spans, scope_filter=args.scope, top=args.top))
     except BrokenPipeError:  # e.g. `traceview t.json | head` — a clean exit
         return 0
     return 0
