@@ -1554,6 +1554,182 @@ def bench_continuous_loop():
         return result
 
 
+def _make_feature6_stages(rng, d, n_docs=400_000):
+    """The benched 6-stage feature chain (scaler → normalizer → weighting
+    product → idf → rescale → binarizer) — shared by the fusion sweep and
+    the cold-start bench so both rows name the same chain."""
+    from flink_ml_tpu.models.feature.binarizer import Binarizer
+    from flink_ml_tpu.models.feature.elementwise_product import ElementwiseProduct
+    from flink_ml_tpu.models.feature.idf import IDFModel
+    from flink_ml_tpu.models.feature.normalizer import Normalizer
+    from flink_ml_tpu.models.feature.standard_scaler import StandardScalerModel
+
+    scaler = StandardScalerModel().set_input_col("input").set_output_col("scaled")
+    scaler.set_with_mean(True)
+    scaler.mean = rng.standard_normal(d)
+    scaler.std = np.abs(rng.standard_normal(d)) + 0.5
+    idf = IDFModel().set_input_col("weighted").set_output_col("tfidf")
+    idf.idf = np.abs(rng.standard_normal(d)) + 0.2
+    idf.doc_freq = np.ones(d)
+    idf.num_docs = np.asarray(float(n_docs))
+    rescale = StandardScalerModel().set_input_col("tfidf").set_output_col("rescaled")
+    rescale.set_with_mean(False)
+    rescale.mean = np.zeros(d)
+    rescale.std = np.abs(rng.standard_normal(d)) + 0.5
+    return [
+        scaler,
+        Normalizer().set_input_col("scaled").set_output_col("norm"),
+        ElementwiseProduct()
+        .set_scaling_vec(np.abs(rng.standard_normal(d)) + 0.1)
+        .set_input_col("norm")
+        .set_output_col("weighted"),
+        idf,
+        rescale,
+        Binarizer()
+        .set_input_cols("rescaled")
+        .set_output_cols("bin")
+        .set_thresholds(0.05),
+    ]
+
+
+def bench_cold_start():
+    """Persistent compiled-plan cache (docs/plancache.md): publish→first-
+    response wall on the 6-stage feature chain + logistic head, three legs
+    per fusion tier:
+
+    - **cold cache** — a fresh plan-cache directory: every (program, bucket)
+      pays trace + XLA compile + serialize/store. The pre-PR-14 restart cost
+      plus the one-time store tax.
+    - **warm cache** — a new "incarnation" (fresh servable/plan/server
+      objects — fresh jit closures, so nothing rides the in-process jit
+      cache) over the populated directory: every program loads its
+      serialized executable; compiles drop to zero
+      (``ml.plancache.misses`` asserted unchanged).
+    - **in-process warm** — the same server again: the steady-state request
+      path, for scale.
+
+    Honest 1-core-box note: on this CPU backend the warm leg still pays
+    tracing/lowering per program (the digest is the lowered StableHLO — see
+    docs/plancache.md), so the win is the compile term only; on real TPUs
+    the compile term is 10-100× larger and the ratio grows with it. The
+    fast+mega tier reports whether interpret-mode megakernel executables
+    serialized or fell back to live compiles (store_errors).
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.config import Options, config
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.servable.builder import PipelineModelServable
+    from flink_ml_tpu.servable.fusion import FusionTier
+    from flink_ml_tpu.servable.lib import LogisticRegressionModelServable
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+    from flink_ml_tpu.serving.plan import CompiledServingPlan
+
+    d = 32
+    max_batch = 16
+    rng = np.random.default_rng(31)
+    template = DataFrame.from_dict({"input": rng.standard_normal((1, d))})
+    request = DataFrame.from_dict({"input": rng.standard_normal((max_batch, d))})
+
+    def make_servable():
+        stage_rng = np.random.default_rng(77)
+        lr = LogisticRegressionModelServable().set_features_col("bin")
+        lr.coefficient = stage_rng.standard_normal(d)
+        return PipelineModelServable(_make_feature6_stages(stage_rng, d) + [lr])
+
+    def leg(name, fusion, repeats=3):
+        """One (cold, warm, steady) measurement set for a fusion tier."""
+        colds, warms = [], []
+        pc_scope = MLMetrics.PLANCACHE_GROUP
+        cold_stores = warm_miss = store_errors = 0
+        base_dir = tempfile.mkdtemp(prefix=f"bench-plancache-{name}-")
+        steady_ms = []
+        try:
+            for r in range(repeats):
+                # A fresh, never-seen directory per repeat: the cold leg
+                # must start from an empty cache every time.
+                config.set(Options.PLANCACHE_DIR, os.path.join(base_dir, f"r{r}"))
+
+                def first_response(tag):
+                    t0 = time.perf_counter()
+                    server = InferenceServer(
+                        make_servable(),
+                        name=f"bench-cold-{name}-{tag}",
+                        serving_config=ServingConfig(
+                            max_batch_size=max_batch,
+                            max_delay_ms=0.1,
+                            fusion_mode=fusion.mode if fusion else None,
+                        ),
+                        warmup_template=template,
+                    )
+                    server.predict(request)
+                    wall = time.perf_counter() - t0
+                    return server, wall
+
+                if fusion is not None:
+                    config.set(Options.FUSION_MEGAKERNEL_MIN_SCORE, 1.0)
+                e0 = metrics.get(pc_scope, MLMetrics.PLANCACHE_STORE_ERRORS, 0)
+                s0 = metrics.get(pc_scope, MLMetrics.PLANCACHE_STORES, 0)
+                server, cold_s = first_response(f"c{r}")
+                colds.append(cold_s)
+                cold_stores = metrics.get(pc_scope, MLMetrics.PLANCACHE_STORES, 0) - s0
+                store_errors = metrics.get(pc_scope, MLMetrics.PLANCACHE_STORE_ERRORS, 0) - e0
+                server.close()
+                m0 = metrics.get(pc_scope, MLMetrics.PLANCACHE_MISSES, 0)
+                server, warm_s = first_response(f"w{r}")
+                warms.append(warm_s)
+                warm_miss = metrics.get(pc_scope, MLMetrics.PLANCACHE_MISSES, 0) - m0
+                if r == repeats - 1:
+                    for _ in range(20):
+                        t0 = time.perf_counter()
+                        server.predict(request)
+                        steady_ms.append((time.perf_counter() - t0) * 1000.0)
+                server.close()
+        finally:
+            config.unset(Options.PLANCACHE_DIR)
+            config.unset(Options.FUSION_MEGAKERNEL_MIN_SCORE)
+            shutil.rmtree(base_dir, ignore_errors=True)
+        cold = sorted(colds)[len(colds) // 2]
+        warm = sorted(warms)[len(warms) // 2]
+        return {
+            "cold_publish_to_first_response_s": round(cold, 3),
+            "warm_publish_to_first_response_s": round(warm, 3),
+            "in_process_warm_p50_ms": round(sorted(steady_ms)[len(steady_ms) // 2], 3),
+            "speedup_warm_vs_cold": round(cold / warm, 2),
+            "cold_stores": cold_stores,
+            "warm_live_compiles": warm_miss,
+            "store_errors": store_errors,
+        }
+
+    exact = leg("exact", None)
+    mega = leg("mega", FusionTier("fast", megakernel=True, min_score=1.0))
+    mega["note"] = (
+        "interpret-mode Pallas megakernel executables "
+        + (
+            "serialized and resumed from cache"
+            if mega["store_errors"] == 0 and mega["warm_live_compiles"] == 0
+            else f"fell back to live compiles for {max(mega['store_errors'], mega['warm_live_compiles'])} program(s)"
+        )
+    )
+    return {
+        "name": "cold_start_feature6_logistic",
+        "chain": "6-stage feature chain + logistic head, d=32, buckets 1..16",
+        "exact": exact,
+        "fast_mega": mega,
+        "note": "publish->first-response wall per leg (server build + plan "
+        "build + per-bucket AOT warm + first request). warm = fresh "
+        "servable/plan/server objects over a populated plancache.dir (fresh "
+        "jit closures — nothing rides the in-process jit cache); "
+        "warm_live_compiles must be 0. 1-core-box note: the warm leg still "
+        "pays per-program trace/lowering (the digest is the lowered "
+        "StableHLO), so the ratio here prices the XLA-compile term only — "
+        "it grows with compile cost on real accelerators.",
+    }
+
+
 def bench_pipeline_batch_transform():
     """Batch transform fast path (docs/batch_transform.md): fused chunked
     CompiledBatchPlan vs the per-stage transform path on a 6-stage feature
@@ -1594,42 +1770,13 @@ def _bench_pipeline_batch_transform_body():
     from flink_ml_tpu.builder.batch_plan import CompiledBatchPlan
     from flink_ml_tpu.config import Options, config
     from flink_ml_tpu.metrics import MLMetrics, metrics
-    from flink_ml_tpu.models.feature.binarizer import Binarizer
-    from flink_ml_tpu.models.feature.elementwise_product import ElementwiseProduct
-    from flink_ml_tpu.models.feature.idf import IDFModel
-    from flink_ml_tpu.models.feature.normalizer import Normalizer
-    from flink_ml_tpu.models.feature.standard_scaler import StandardScalerModel
 
     rng = np.random.default_rng(9)
     n, d = 400_000, 32
     df = DataFrame.from_dict({"input": rng.standard_normal((n, d))})
 
-    scaler = StandardScalerModel().set_input_col("input").set_output_col("scaled")
-    scaler.set_with_mean(True)
-    scaler.mean = rng.standard_normal(d)
-    scaler.std = np.abs(rng.standard_normal(d)) + 0.5
-    idf = IDFModel().set_input_col("weighted").set_output_col("tfidf")
-    idf.idf = np.abs(rng.standard_normal(d)) + 0.2
-    idf.doc_freq = np.ones(d)
-    idf.num_docs = np.asarray(float(n))
-    rescale = StandardScalerModel().set_input_col("tfidf").set_output_col("rescaled")
-    rescale.set_with_mean(False)
-    rescale.mean = np.zeros(d)
-    rescale.std = np.abs(rng.standard_normal(d)) + 0.5
-    stages = [
-        scaler,
-        Normalizer().set_input_col("scaled").set_output_col("norm"),
-        ElementwiseProduct()
-        .set_scaling_vec(np.abs(rng.standard_normal(d)) + 0.1)
-        .set_input_col("norm")
-        .set_output_col("weighted"),
-        idf,
-        rescale,
-        Binarizer()
-        .set_input_cols("rescaled")
-        .set_output_cols("bin")
-        .set_thresholds(0.05),
-    ]
+    # Same rng draw order as the old inline construction — identical params.
+    stages = _make_feature6_stages(rng, d, n_docs=n)
 
     def run_per_stage():
         out = df
@@ -1760,11 +1907,6 @@ def _bench_fusion_sweep_body():
     from flink_ml_tpu.api.dataframe import DataFrame
     from flink_ml_tpu.builder.batch_plan import CompiledBatchPlan
     from flink_ml_tpu.metrics import MLMetrics, metrics
-    from flink_ml_tpu.models.feature.binarizer import Binarizer
-    from flink_ml_tpu.models.feature.elementwise_product import ElementwiseProduct
-    from flink_ml_tpu.models.feature.idf import IDFModel
-    from flink_ml_tpu.models.feature.normalizer import Normalizer
-    from flink_ml_tpu.models.feature.standard_scaler import StandardScalerModel
     from flink_ml_tpu.servable.builder import PipelineModelServable
     from flink_ml_tpu.servable.fusion import FusionTier, ULP_ENVELOPE
     from flink_ml_tpu.servable.lib import (
@@ -1778,32 +1920,8 @@ def _bench_fusion_sweep_body():
     n, d = 400_000, 32
     df = DataFrame.from_dict({"input": rng.standard_normal((n, d))})
 
-    scaler = StandardScalerModel().set_input_col("input").set_output_col("scaled")
-    scaler.set_with_mean(True)
-    scaler.mean = rng.standard_normal(d)
-    scaler.std = np.abs(rng.standard_normal(d)) + 0.5
-    idf = IDFModel().set_input_col("weighted").set_output_col("tfidf")
-    idf.idf = np.abs(rng.standard_normal(d)) + 0.2
-    idf.doc_freq = np.ones(d)
-    idf.num_docs = np.asarray(float(n))
-    rescale = StandardScalerModel().set_input_col("tfidf").set_output_col("rescaled")
-    rescale.set_with_mean(False)
-    rescale.mean = np.zeros(d)
-    rescale.std = np.abs(rng.standard_normal(d)) + 0.5
-    stages = [
-        scaler,
-        Normalizer().set_input_col("scaled").set_output_col("norm"),
-        ElementwiseProduct()
-        .set_scaling_vec(np.abs(rng.standard_normal(d)) + 0.1)
-        .set_input_col("norm")
-        .set_output_col("weighted"),
-        idf,
-        rescale,
-        Binarizer()
-        .set_input_cols("rescaled")
-        .set_output_cols("bin")
-        .set_thresholds(0.05),
-    ]
+    # Same rng draw order as the old inline construction — identical params.
+    stages = _make_feature6_stages(rng, d, n_docs=n)
 
     tiers = {
         "exact": None,
@@ -2483,6 +2601,7 @@ def main() -> None:
     batch_transform = bench_pipeline_batch_transform()
     fusion = bench_fusion_sweep()
     sharded = bench_sharded_fanout()
+    cold_start = bench_cold_start()
 
     detail = {
         "device_kind": kind,
@@ -2492,7 +2611,7 @@ def main() -> None:
             logreg, sparse, sweep, sparse_streamed, overlap, kmeans, mlp,
             mlp_train, attention, attention_train, serving, open_loop,
             tracing, journal, mlp_serving, continuous_loop, batch_transform,
-            fusion, sharded,
+            fusion, sharded, cold_start,
         ],
     }
     with open("BENCH_DETAIL.json", "w") as f:

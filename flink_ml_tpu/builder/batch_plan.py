@@ -62,6 +62,7 @@ from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.config import Options, config
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.servable.fusion import plan_recorder, resolve_fusion_tier
+from flink_ml_tpu.servable.plancache import resolve_plan_cache
 from flink_ml_tpu.servable.planner import (
     FallbackStage,
     FusedSegment,
@@ -134,6 +135,10 @@ class CompiledBatchPlan:
         self.scope = scope
         self.sharding = sharding
         self.fusion = fusion if fusion is not None else resolve_fusion_tier()
+        # Persistent compiled-plan cache (docs/plancache.md): chain programs
+        # for chunk signatures a previous plan (or a previous process) ever
+        # compiled load their serialized executables instead of compiling.
+        self.plancache = resolve_plan_cache()
         self._on_plan = plan_recorder(scope)
         n_fused = sum(len(s.specs) for s in segments if isinstance(s, FusedSegment))
         n_fallback = sum(1 for s in segments if isinstance(s, FallbackStage))
@@ -290,6 +295,16 @@ class CompiledBatchPlan:
         out_decl: Dict[str, Any] = {}
         inflight: List[Tuple[float, List[Any]]] = []
 
+        # Plan-cache outcome of the chunk currently compiling — the chunk
+        # span publishes it on the shared `plancache` attr (compile-path
+        # only: a signature already chained never reaches the cache).
+        span_holder: Dict[str, Any] = {}
+
+        def on_cache(outcome: str, ms: float) -> None:
+            sp = span_holder.get("sp")
+            if sp is not None:
+                sp.set_attr("plancache", outcome)
+
         def readback_one(buf: np.ndarray, lo: int, hi: int, arr: Any) -> None:  # graftcheck: readback
             # THE designated sync point of the batch fast path: np.asarray
             # blocks until the device value is ready (zero-copy view on the
@@ -321,6 +336,7 @@ class CompiledBatchPlan:
                 sp.set_attr("bucket", padded)
                 if sharding is not None:
                     sp.set_attr("shards", 1 if replicated else sharding.n_data)
+                span_holder["sp"] = sp
                 outputs = run_segment(
                     segment,
                     key,
@@ -328,6 +344,8 @@ class CompiledBatchPlan:
                     on_compile=on_compile,
                     on_plan=self._on_plan,
                     replicated=replicated,
+                    cache=self.plancache,
+                    on_cache=on_cache if self.plancache is not None else None,
                 )
                 # The fusion tier this chunk's compiled chain runs at
                 # ("exact" / "fast" / "fast+mega") — goodput attribution

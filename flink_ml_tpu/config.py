@@ -259,6 +259,37 @@ class Options:
         "Optional tensor-parallel axis of the batch transform mesh — same "
         "wide-head sharding and ulp caveat as serving.mesh.model. 1 = off.",
     )
+    PLANCACHE_ENABLED = ConfigOption(
+        "plancache.enabled",
+        _parse_bool,
+        True,
+        "Whether the compiled plans may use the persistent plan cache "
+        "(servable/plancache.py, docs/plancache.md) when plancache.dir is "
+        "configured: fused chain executables are serialized to disk at "
+        "compile time and loaded back on the next (re)build — a restarted "
+        "or hot-swapped incarnation reaches first response in O(load) "
+        "instead of O(XLA compile). Off = always compile live.",
+    )
+    PLANCACHE_DIR = ConfigOption(
+        "plancache.dir",
+        str,
+        None,
+        "Directory of the persistent compiled-plan cache. Default: none — "
+        "the cache is inactive and every plan compiles live (unchanged "
+        "behavior). Configure a stable path in deployments so supervisor "
+        "restarts, hot swaps, and rollbacks reuse the serialized "
+        "executables (docs/plancache.md has the key schema and the "
+        "corruption/fallback contract).",
+    )
+    PLANCACHE_MAX_BYTES = ConfigOption(
+        "plancache.max.bytes",
+        int,
+        256 << 20,
+        "LRU bound of the plan-cache entry tier: past this many bytes of "
+        "*.plan entries the least-recently-loaded entries are evicted "
+        "(ml.plancache.evicted). The second tier (JAX's own persistent "
+        "compilation cache under <dir>/xla) is governed by JAX's knobs.",
+    )
     FUSION_MODE = ConfigOption(
         "fusion.mode",
         str,

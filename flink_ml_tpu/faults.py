@@ -111,6 +111,20 @@ FAULT_POINTS: Dict[str, str] = {
         "the rest of the schedule on time (chaos-under-load runs arm this to "
         "prove the measurement rig itself survives faults)."
     ),
+    "plancache.load": (
+        "Plan-cache entry deserialization (servable/plancache.py "
+        "PlanCache.load) — kill a warmup/rebuild mid-deserialize; the entry "
+        "must be quarantined with the checkpoint-corrupt semantics and the "
+        "chain must fall back to a live compile (fail-open, never wrong), "
+        "with serving unaffected."
+    ),
+    "plancache.write": (
+        "Plan-cache entry write (servable/plancache.py PlanCache.store) — "
+        "kill a store mid-write, leaving a torn .tmp orphan on disk; the "
+        "final entry must never become visible (tmp+rename discipline), the "
+        "compiled chain keeps serving, and a later cache init sweeps the "
+        "orphan."
+    ),
     "telemetry.journal": (
         "Flight-recorder journal write (telemetry/journal.py _write_record) — "
         "kill the writer thread mid-record, leaving a torn tail line on "

@@ -63,7 +63,8 @@ class MLMetrics:
     SERVING_FUSED_BATCHES = "ml.serving.fastpath.fused.batches"  # fused executions, counter
     SERVING_FALLBACK_BATCHES = "ml.serving.fastpath.fallback.batches"  # ineligible batches, counter
     SERVING_FASTPATH_COMPILES = "ml.serving.fastpath.compiles"  # post-warmup compiles (0 = healthy), counter
-    SERVING_WARMUP_COMPILE_MS = "ml.serving.fastpath.warmup.compile.ms"  # AOT warmup wall time, gauge
+    SERVING_WARMUP_COMPILE_MS = "ml.serving.fastpath.warmup.compile.ms"  # AOT warmup wall time minus cache loads, gauge
+    SERVING_WARMUP_CACHE_LOAD_MS = "ml.serving.fastpath.warmup.cache.load.ms"  # warmup time spent loading cached executables, gauge
     SERVING_INFLIGHT_DEPTH = "ml.serving.inflight.depth"  # dispatched-not-finalized batches, gauge
 
     # SLO-adaptive controller (serving/controller.py — docs/serving.md
@@ -88,7 +89,8 @@ class MLMetrics:
     LOOP_ROLLBACKS = "ml.loop.rollbacks"  # regressions reverted to N-1, counter
     LOOP_QUARANTINED = "ml.loop.versions.quarantined"  # bad versions set aside, counter
     LOOP_PUBLISH_TO_SERVE_MS = "ml.loop.publish.to.serve.ms"  # publish→flip, histogram
-    LOOP_WARM_MS = "ml.loop.warm.ms"  # last pre-flip AOT warm wall time, gauge
+    LOOP_WARM_MS = "ml.loop.warm.ms"  # last pre-flip AOT warm compile time (cache loads excluded), gauge
+    LOOP_WARM_CACHE_MS = "ml.loop.warm.cache.ms"  # last pre-flip warm time spent loading cached executables, gauge
     LOOP_STEPS = "ml.loop.steps"  # loop turns completed, counter
     LOOP_GOODPUT_FRACTION = "ml.loop.goodput.fraction"  # productive/total time, gauge
     LOOP_DRIFT_SCORE = "ml.loop.drift.score"  # live model rolling score, gauge
@@ -133,6 +135,19 @@ class MLMetrics:
     BATCH_SHARD_ROWS = "ml.batch.shard.rows"  # per-shard rows through sharded chunks, counter
     BATCH_SHARD_PAD_ROWS = "ml.batch.shard.pad.rows"  # DP round-up pad rows on ragged chunks, counter
     BATCH_SHARD_REPLICATED_CHUNKS = "ml.batch.shard.replicated.chunks"  # tails run replicated, counter
+
+    # Persistent compiled-plan cache (servable/plancache.py — serialized AOT
+    # executables on disk; scope = "ml.plancache", docs/plancache.md).
+    PLANCACHE_GROUP = "ml.plancache"
+    PLANCACHE_HITS = "ml.plancache.hits"  # executables served from disk, counter
+    PLANCACHE_MISSES = "ml.plancache.misses"  # entry absent -> live compile, counter
+    PLANCACHE_STORES = "ml.plancache.stores"  # entries written, counter
+    PLANCACHE_STORE_ERRORS = "ml.plancache.store.errors"  # serialize/write failures (fail-open), counter
+    PLANCACHE_QUARANTINED = "ml.plancache.quarantined"  # corrupt/mismatched entries set aside, counter
+    PLANCACHE_EVICTED = "ml.plancache.evicted"  # LRU evictions past plancache.max.bytes, counter
+    PLANCACHE_BYTES = "ml.plancache.bytes"  # bytes of *.plan entries on disk, gauge
+    PLANCACHE_LOAD_MS = "ml.plancache.load.ms"  # read+verify+deserialize per hit, histogram
+    PLANCACHE_TMP_SWEPT = "ml.plancache.tmp.swept"  # orphaned .tmp files swept at init, counter
 
     # Flight recorder + incident bundles (flink_ml_tpu.telemetry — the
     # always-on decision journal; scope = "ml.telemetry", docs/observability.md).

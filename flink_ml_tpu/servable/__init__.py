@@ -20,6 +20,7 @@ from flink_ml_tpu.servable.fusion import (
     ulp_diff,
 )
 from flink_ml_tpu.servable.kernel_spec import KernelSpec
+from flink_ml_tpu.servable.plancache import PlanCache, resolve_plan_cache
 from flink_ml_tpu.servable.lib import (
     KMeansModelServable,
     LogisticRegressionModelServable,
@@ -32,6 +33,8 @@ __all__ = [
     "ModelServable",
     "ModelDataConflictError",
     "KernelSpec",
+    "PlanCache",
+    "resolve_plan_cache",
     "FusionTier",
     "ULP_ENVELOPE",
     "resolve_fusion_tier",

@@ -64,6 +64,7 @@ tier applies belongs to the resolved ``FusionTier`` the caller passes.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
 
 import jax
@@ -387,6 +388,56 @@ def build_segments(
     return segments
 
 
+def _compile_lowered(lowered: Any) -> Any:
+    """THE XLA-compile seam of the chain executor — every live compile of a
+    chain program goes through this one call, so the zero-compile-resume
+    proof (tests/test_plancache.py, tools/ci/restart_smoke.py) can poison it
+    and assert a cache-warmed incarnation never reaches it."""
+    return lowered.compile()
+
+
+def _load_or_compile(  # graftcheck: cold
+    prog: Any,
+    structs: Dict[str, jax.ShapeDtypeStruct],
+    segment: FusedSegment,
+    replicated: bool,
+    cache: Optional[Any],
+    on_cache: Optional[Callable[[str, float], None]],
+) -> Any:
+    """One program's executable: lower always (cheap — the tracing term),
+    then load the serialized executable from the plan cache by its content
+    digest, falling back to the live XLA compile on a miss (and storing the
+    result for the next incarnation). With no cache this is exactly the old
+    ``lower().compile()``."""
+    lowered = prog.jitted.lower(prog.models, structs)
+    if cache is None:
+        return _compile_lowered(lowered)
+    from flink_ml_tpu.servable.plancache import program_digest
+
+    digest = program_digest(
+        lowered,
+        kind=prog.kind,
+        sharding_key=segment.sharding.key if segment.sharding is not None else None,
+        fusion_key=segment.fusion.key if segment.fusion is not None else None,
+        replicated=replicated,
+    )
+    t0 = time.perf_counter()
+    compiled = cache.load(digest)
+    if compiled is not None:
+        if on_cache is not None:
+            on_cache("hit", (time.perf_counter() - t0) * 1000.0)
+        return compiled
+    if on_cache is not None:
+        on_cache("miss", (time.perf_counter() - t0) * 1000.0)
+    compiled = _compile_lowered(lowered)
+    cache.store(
+        digest,
+        compiled,
+        meta={"kind": prog.kind, "inputs": sorted(structs)},
+    )
+    return compiled
+
+
 def _lowering_struct(segment: FusedSegment, arr: Any, replicated: bool) -> jax.ShapeDtypeStruct:
     """Aval for one program input at lowering time. Device arrays (program
     intermediates, pre-committed ingests) carry their own placement; host
@@ -408,6 +459,8 @@ def run_segment(
     on_compile: Optional[Callable[[], None]] = None,
     on_plan: Optional[Callable[[str, float], None]] = None,
     replicated: bool = False,
+    cache: Optional[Any] = None,
+    on_cache: Optional[Callable[[str, float], None]] = None,
 ) -> Dict[str, Any]:
     """Execute the segment's executable chain for ``key``: each program runs
     on the committed device model buffers and the (device-resident) outputs
@@ -421,7 +474,14 @@ def run_segment(
     cost-model score at this key's rows clears the tier's bar. On a sharded
     segment the chain lowers SPMD — batch rows split over the data axis, or
     fully ``replicated`` for a sub-floor ragged tail (the caller bakes the
-    mode into ``key``: the two compile different executables)."""
+    mode into ``key``: the two compile different executables).
+
+    With a ``cache`` (:class:`~flink_ml_tpu.servable.plancache.PlanCache`),
+    the compile becomes load-or-compile: each program's serialized
+    executable is fetched by content digest — a restarted incarnation
+    reaches a ready chain in O(load) not O(XLA) — and ``on_cache(outcome,
+    ms)`` reports "hit"/"miss" per program so callers can split warm time
+    between cache loads and true compiles (docs/plancache.md)."""
     chain = segment.compiled.get(key)
     if chain is None:
         if on_compile is not None:
@@ -453,7 +513,9 @@ def run_segment(
                 for n, a in stage_inputs.items()
             }
             try:
-                compiled = prog.jitted.lower(prog.models, structs).compile()
+                compiled = _load_or_compile(
+                    prog, structs, segment, replicated, cache, on_cache
+                )
             except Exception:
                 if prog is xla_prog:
                     raise
@@ -462,7 +524,9 @@ def run_segment(
                 # take the fast tier down — the merged XLA program computes
                 # the same chain inside the same ulp envelope.
                 prog = xla_prog
-                compiled = prog.jitted.lower(prog.models, structs).compile()
+                compiled = _load_or_compile(
+                    prog, structs, segment, replicated, cache, on_cache
+                )
             if on_plan is not None:
                 on_plan(prog.kind, chain_score(prog.specs, rows, width))
             kinds.append(prog.kind)

@@ -404,11 +404,16 @@ class InferenceServer:
             else:
                 for bucket in self._batcher.buckets:
                     servable.transform(pad_to(template, bucket))
-            telemetry.emit(
-                "serving.warmup",
-                self.scope,
-                {"buckets": len(self._batcher.buckets), "fastpath": plan is not None},
-            )
+            payload = {
+                "buckets": len(self._batcher.buckets),
+                "fastpath": plan is not None,
+            }
+            if plan is not None and plan.last_warmup_cache is not None:
+                # The incarnation's cold-start story in one record: how much
+                # of this flip's warm came off the plan cache vs live XLA
+                # (docs/plancache.md — the zero-compile-resume contract).
+                payload["plancache"] = plan.last_warmup_cache
+            telemetry.emit("serving.warmup", self.scope, payload)
 
     def swap(self, version: int, servable) -> None:
         """Warm then atomically install ``servable`` as ``version``. The

@@ -1,0 +1,508 @@
+"""Persistent compiled-plan cache (servable/plancache.py, docs/plancache.md):
+
+- **zero-compile resume**: a fresh "incarnation" (new plan objects, same
+  cache dir) warms every bucket from serialized executables with the XLA
+  compile seam poisoned, and serves bit-identically to the incarnation that
+  compiled;
+- **fail-open, never wrong**: corrupt, truncated, version-mismatched, or
+  mid-deserialize-dying entries are quarantined (checkpoint-corrupt
+  semantics) and the chain live-compiles — the request path never errors;
+- **torn-write discipline**: a store killed mid-write (fault point
+  ``plancache.write``) leaves only a ``.tmp`` orphan, never a visible entry;
+  the next cache init sweeps it;
+- **bounded**: LRU eviction keeps the entry tier under plancache.max.bytes;
+- **inactive by default**: with no ``plancache.dir`` configured nothing
+  changes — resolve returns None and every plan compiles live.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import flink_ml_tpu.servable.planner as planner
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.faults import InjectedFault, faults
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.servable import (
+    LogisticRegressionModelServable,
+    PipelineModelServable,
+    StandardScalerModelServable,
+)
+from flink_ml_tpu.servable.plancache import (
+    PlanCache,
+    program_digest,
+    resolve_plan_cache,
+)
+from flink_ml_tpu.serving import (
+    CompiledServingPlan,
+    InferenceServer,
+    ServingConfig,
+    pad_to,
+    power_of_two_buckets,
+)
+
+DIM = 7  # distinctive width so jit caches don't collide with other tests
+BUCKETS = power_of_two_buckets(8)
+
+
+def _servable(seed=11, dim=DIM):
+    rng = np.random.default_rng(seed)
+    sc = StandardScalerModelServable().set_input_col("features").set_output_col("scaled")
+    sc.mean = rng.normal(size=dim)
+    sc.std = np.abs(rng.normal(size=dim)) + 0.5
+    sc.set_with_mean(True)
+    lr = LogisticRegressionModelServable().set_features_col("scaled")
+    lr.coefficient = rng.normal(size=dim)
+    return PipelineModelServable([sc, lr])
+
+
+def _features(n, seed=3, dim=DIM):
+    return DataFrame.from_dict(
+        {"features": np.random.default_rng(seed).normal(size=(n, dim))}
+    )
+
+
+def _assert_frames_bitexact(a: DataFrame, b: DataFrame):
+    assert a.get_column_names() == b.get_column_names()
+    for name in a.get_column_names():
+        ca, cb = np.asarray(a[name]), np.asarray(b[name])
+        assert ca.dtype == cb.dtype, name
+        np.testing.assert_array_equal(ca, cb, err_msg=name)
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    """Point the plan cache at a per-test dir; restore the config after."""
+    d = str(tmp_path / "plancache")
+    config.set(Options.PLANCACHE_DIR, d)
+    try:
+        yield d
+    finally:
+        config.unset(Options.PLANCACHE_DIR)
+        config.unset(Options.PLANCACHE_MAX_BYTES)
+        faults.reset()
+
+
+def _pc(name: str, default=0):
+    return metrics.get(MLMetrics.PLANCACHE_GROUP, name, default)
+
+
+def _poison(monkeypatch):
+    def blocked(lowered):
+        raise AssertionError("XLA compile blocked — cache should have served this")
+
+    monkeypatch.setattr(planner, "_compile_lowered", blocked)
+
+
+def _entries(cache_dir):
+    return sorted(n for n in os.listdir(cache_dir) if n.endswith(".plan"))
+
+
+# ---------------------------------------------------------------------------
+# resolution / defaults
+# ---------------------------------------------------------------------------
+class TestResolution:
+    def test_inactive_without_dir(self):
+        assert resolve_plan_cache() is None
+
+    def test_enabled_flag_gates(self, cache_dir):
+        assert resolve_plan_cache() is not None
+        config.set(Options.PLANCACHE_ENABLED, False)
+        try:
+            assert resolve_plan_cache() is None
+        finally:
+            config.unset(Options.PLANCACHE_ENABLED)
+
+    def test_plan_without_cache_compiles_live(self):
+        plan = CompiledServingPlan.build(_servable(), scope="ml.serving[pc-off]")
+        assert plan.plancache is None
+        df = _features(4)
+        plan.warmup(df.take([0]), BUCKETS)
+        _assert_frames_bitexact(
+            _servable().transform(pad_to(df, 4)), plan.execute(pad_to(df, 4))
+        )
+
+
+# ---------------------------------------------------------------------------
+# the tentpole: zero-compile resume, bit-identical
+# ---------------------------------------------------------------------------
+class TestZeroCompileResume:
+    def test_second_incarnation_serves_from_cache(self, cache_dir, monkeypatch):
+        df = _features(5)
+        template = df.take([0])
+
+        plan1 = CompiledServingPlan.build(_servable(), scope="ml.serving[pc-inc1]")
+        assert plan1.plancache is not None
+        plan1.warmup(template, BUCKETS)
+        stores = _pc(MLMetrics.PLANCACHE_STORES)
+        assert stores > 0
+        first = {b: plan1.execute(pad_to(df, b) if b >= len(df) else df.take(np.arange(b))) for b in BUCKETS}
+
+        # "New incarnation": fresh plan objects over the same cache dir, with
+        # the one XLA-compile seam poisoned — every bucket of every program
+        # must come off the serialized executables.
+        hits_before = _pc(MLMetrics.PLANCACHE_HITS)
+        misses_before = _pc(MLMetrics.PLANCACHE_MISSES)
+        _poison(monkeypatch)
+        plan2 = CompiledServingPlan.build(_servable(), scope="ml.serving[pc-inc2]")
+        plan2.warmup(template, BUCKETS)
+        assert _pc(MLMetrics.PLANCACHE_MISSES) == misses_before  # zero compiles
+        assert _pc(MLMetrics.PLANCACHE_HITS) - hits_before == stores
+        for b in BUCKETS:
+            padded = pad_to(df, b) if b >= len(df) else df.take(np.arange(b))
+            _assert_frames_bitexact(first[b], plan2.execute(padded))
+
+    def test_warmup_gauge_split(self, cache_dir):
+        template = _features(1)
+        scope = "ml.serving[pc-gauge1]"
+        plan1 = CompiledServingPlan.build(_servable(), scope=scope)
+        plan1.warmup(template, BUCKETS)
+        # All-miss warmup: compile gauge carries (almost) the whole wall.
+        assert metrics.get(scope, MLMetrics.SERVING_WARMUP_COMPILE_MS) > 0
+        assert metrics.get(scope, MLMetrics.SERVING_WARMUP_CACHE_LOAD_MS) == 0.0
+        assert plan1.last_warmup_cache["misses"] > 0
+        assert plan1.last_warmup_cache["hits"] == 0
+
+        scope2 = "ml.serving[pc-gauge2]"
+        plan2 = CompiledServingPlan.build(_servable(), scope=scope2)
+        plan2.warmup(template, BUCKETS)
+        # All-hit warmup: the cache gauge carries the load time and the
+        # hit/miss stats invert.
+        assert metrics.get(scope2, MLMetrics.SERVING_WARMUP_CACHE_LOAD_MS) > 0
+        assert plan2.last_warmup_cache["misses"] == 0
+        assert plan2.last_warmup_cache["hits"] == plan1.last_warmup_cache["misses"]
+
+    def test_server_resume_zero_serving_compiles(self, cache_dir, monkeypatch):
+        cfg = ServingConfig(max_batch_size=8, max_delay_ms=0.1)
+        template = _features(1)
+        req = _features(5, seed=9)
+        with InferenceServer(
+            _servable(), name="pc-s1", serving_config=cfg, warmup_template=template
+        ) as s1:
+            r1 = s1.predict(req)
+        _poison(monkeypatch)
+        with InferenceServer(
+            _servable(), name="pc-s2", serving_config=cfg, warmup_template=template
+        ) as s2:
+            r2 = s2.predict(req)
+            assert metrics.get(s2.scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0) == 0
+        _assert_frames_bitexact(r1.dataframe, r2.dataframe)
+
+
+# ---------------------------------------------------------------------------
+# corruption / mismatch / fault injection — fail-open, never wrong
+# ---------------------------------------------------------------------------
+class TestCorruptionFallback:
+    def _warm_one(self, cache_dir, scope):
+        plan = CompiledServingPlan.build(_servable(), scope=scope)
+        plan.warmup(_features(1), [4])
+        assert _entries(cache_dir)
+        return plan
+
+    def test_corrupt_entry_quarantined_and_served_live(self, cache_dir):
+        self._warm_one(cache_dir, "ml.serving[pc-c1]")
+        for name in _entries(cache_dir):
+            path = os.path.join(cache_dir, name)
+            raw = bytearray(open(path, "rb").read())
+            raw[-3] ^= 0xFF  # flip payload bits: CRC must catch it
+            open(path, "wb").write(bytes(raw))
+        q_before = _pc(MLMetrics.PLANCACHE_QUARANTINED)
+        plan2 = CompiledServingPlan.build(_servable(), scope="ml.serving[pc-c2]")
+        plan2.warmup(_features(1), [4])
+        df = pad_to(_features(3), 4)
+        _assert_frames_bitexact(_servable().transform(df), plan2.execute(df))
+        assert _pc(MLMetrics.PLANCACHE_QUARANTINED) > q_before
+        assert any(
+            name.endswith(".corrupt") for name in os.listdir(cache_dir)
+        ), "quarantined entry kept for forensics"
+
+    def test_truncated_entry_quarantined(self, cache_dir):
+        self._warm_one(cache_dir, "ml.serving[pc-t1]")
+        for name in _entries(cache_dir):
+            path = os.path.join(cache_dir, name)
+            raw = open(path, "rb").read()
+            open(path, "wb").write(raw[: len(raw) // 2])  # torn file
+        plan2 = CompiledServingPlan.build(_servable(), scope="ml.serving[pc-t2]")
+        plan2.warmup(_features(1), [4])
+        df = pad_to(_features(3), 4)
+        _assert_frames_bitexact(_servable().transform(df), plan2.execute(df))
+        assert not _entries(cache_dir) or _pc(MLMetrics.PLANCACHE_QUARANTINED) > 0
+
+    def test_version_mismatch_quarantined(self, cache_dir):
+        import json
+        import struct
+        import zlib
+
+        self._warm_one(cache_dir, "ml.serving[pc-v1]")
+        name = _entries(cache_dir)[0]
+        path = os.path.join(cache_dir, name)
+        raw = open(path, "rb").read()
+        (hlen,) = struct.unpack(">I", raw[8:12])
+        header = json.loads(raw[12: 12 + hlen])
+        header["env"] = dict(header["env"], jaxlib="0.0.0-other")
+        hb = json.dumps(header, sort_keys=True).encode()
+        open(path, "wb").write(raw[:8] + struct.pack(">I", len(hb)) + hb + raw[12 + hlen:])
+        q_before = _pc(MLMetrics.PLANCACHE_QUARANTINED)
+        cache = resolve_plan_cache()
+        digest = name[: -len(".plan")]
+        assert cache.load(digest) is None
+        assert _pc(MLMetrics.PLANCACHE_QUARANTINED) == q_before + 1
+
+    def test_fault_plancache_load_quarantines_and_falls_back(self, cache_dir):
+        """Deterministic fault at plancache.load: a warmup dying
+        mid-deserialize quarantines the entry and live-compiles — the
+        request path never sees an error."""
+        self._warm_one(cache_dir, "ml.serving[pc-f1]")
+        n_entries = len(_entries(cache_dir))
+        q_before = _pc(MLMetrics.PLANCACHE_QUARANTINED)
+        faults.arm("plancache.load", at=1)
+        try:
+            plan2 = CompiledServingPlan.build(_servable(), scope="ml.serving[pc-f2]")
+            plan2.warmup(_features(1), [4])
+            df = pad_to(_features(3), 4)
+            _assert_frames_bitexact(_servable().transform(df), plan2.execute(df))
+            fires = faults.fires("plancache.load")
+        finally:
+            faults.reset()
+        assert fires == 1
+        assert _pc(MLMetrics.PLANCACHE_QUARANTINED) == q_before + 1
+        # The quarantined entry was re-stored by the live compile fallback.
+        assert len(_entries(cache_dir)) == n_entries
+
+    def test_fault_plancache_write_leaves_torn_tmp_only(self, cache_dir):
+        """Deterministic fault at plancache.write: a store killed mid-write
+        leaves a torn .tmp orphan, never a visible entry, and the compiled
+        chain keeps serving; the next cache init sweeps the orphan."""
+        errors_before = _pc(MLMetrics.PLANCACHE_STORE_ERRORS)
+        faults.arm("plancache.write", at=1)
+        try:
+            plan = CompiledServingPlan.build(_servable(), scope="ml.serving[pc-w1]")
+            plan.warmup(_features(1), [4])
+            df = pad_to(_features(3), 4)
+            _assert_frames_bitexact(_servable().transform(df), plan.execute(df))
+            fires = faults.fires("plancache.write")
+        finally:
+            faults.reset()
+        assert fires == 1
+        assert _pc(MLMetrics.PLANCACHE_STORE_ERRORS) == errors_before + 1
+        orphans = [n for n in os.listdir(cache_dir) if ".plan.tmp." in n]
+        assert orphans, "torn tmp file left behind (the kill analogue)"
+        # The torn write never became an entry for ITS program; later
+        # programs of the same warmup stored normally.
+        torn_digest = orphans[0].split(".plan.tmp.")[0]
+        assert f"{torn_digest}.plan" not in _entries(cache_dir)
+        # A new incarnation's cache init sweeps the orphan.
+        swept_before = _pc(MLMetrics.PLANCACHE_TMP_SWEPT)
+        PlanCache(cache_dir, max_bytes=1 << 30)
+        assert not [n for n in os.listdir(cache_dir) if ".plan.tmp." in n]
+        assert _pc(MLMetrics.PLANCACHE_TMP_SWEPT) > swept_before
+
+    def test_store_serialize_failure_is_fail_open(self, cache_dir, monkeypatch):
+        from jax.experimental import serialize_executable
+
+        def broken(compiled):
+            raise ValueError("Compilation does not support serialization")
+
+        monkeypatch.setattr(serialize_executable, "serialize", broken)
+        errors_before = _pc(MLMetrics.PLANCACHE_STORE_ERRORS)
+        plan = CompiledServingPlan.build(_servable(), scope="ml.serving[pc-ser]")
+        plan.warmup(_features(1), [4])
+        df = pad_to(_features(3), 4)
+        _assert_frames_bitexact(_servable().transform(df), plan.execute(df))
+        assert _pc(MLMetrics.PLANCACHE_STORE_ERRORS) > errors_before
+        assert not _entries(cache_dir)
+
+
+# ---------------------------------------------------------------------------
+# bounds / lifecycle
+# ---------------------------------------------------------------------------
+class TestBounds:
+    def test_lru_eviction_respects_max_bytes(self, cache_dir):
+        plan = CompiledServingPlan.build(_servable(), scope="ml.serving[pc-lru1]")
+        plan.warmup(_features(1), BUCKETS)
+        entry_bytes = max(
+            os.path.getsize(os.path.join(cache_dir, n)) for n in _entries(cache_dir)
+        )
+        n_before = len(_entries(cache_dir))
+        assert n_before >= 4
+        # Rebuild the cache with room for ~2 entries: storing one more must
+        # evict the stalest down to the bound.
+        config.set(Options.PLANCACHE_MAX_BYTES, int(entry_bytes * 2.5))
+        small = resolve_plan_cache()
+        assert small.max_bytes < small.bytes_used()
+        evicted_before = _pc(MLMetrics.PLANCACHE_EVICTED)
+        small._enforce_budget()
+        assert small.bytes_used() <= small.max_bytes
+        assert _pc(MLMetrics.PLANCACHE_EVICTED) > evicted_before
+        assert len(_entries(cache_dir)) < n_before
+        assert _pc(MLMetrics.PLANCACHE_BYTES) <= small.max_bytes
+
+    def test_hits_refresh_lru_recency(self, cache_dir):
+        plan = CompiledServingPlan.build(_servable(), scope="ml.serving[pc-lru2]")
+        plan.warmup(_features(1), [2, 4])
+        names = _entries(cache_dir)
+        oldest = os.path.join(cache_dir, names[0])
+        past = os.path.getmtime(oldest) - 3600
+        os.utime(oldest, (past, past))
+        cache = resolve_plan_cache()
+        digest = names[0][: -len(".plan")]
+        assert cache.load(digest) is not None
+        assert os.path.getmtime(oldest) > past + 1800  # touched on hit
+
+
+# ---------------------------------------------------------------------------
+# digest schema
+# ---------------------------------------------------------------------------
+class TestDigest:
+    def _lowered(self, dim=DIM, rows=4):
+        import jax
+        import jax.numpy as jnp
+
+        def f(models, cols):
+            return {"out": cols["x"] * models["w"]}
+
+        w = np.ones(dim, np.float32)
+        return jax.jit(f).lower(
+            {"w": w}, {"x": jax.ShapeDtypeStruct((rows, dim), jnp.float32)}
+        )
+
+    def test_deterministic_for_equal_programs(self):
+        a = program_digest(self._lowered(), kind="exact")
+        b = program_digest(self._lowered(), kind="exact")
+        assert a == b
+
+    def test_distinguishes_shape_kind_tier_and_topology(self):
+        base = program_digest(self._lowered(), kind="exact")
+        assert program_digest(self._lowered(rows=8), kind="exact") != base
+        assert program_digest(self._lowered(), kind="fused") != base
+        assert (
+            program_digest(self._lowered(), kind="exact", fusion_key=("fast", True, 1.0))
+            != base
+        )
+        assert (
+            program_digest(self._lowered(), kind="exact", sharding_key=(4, 1))
+            != base
+        )
+        assert program_digest(self._lowered(), kind="exact", replicated=True) != base
+
+
+# ---------------------------------------------------------------------------
+# sharded (SPMD) programs
+# ---------------------------------------------------------------------------
+class TestShardedPlans:
+    def test_sharded_plan_resumes_from_cache(self, cache_dir, monkeypatch):
+        import jax
+
+        from flink_ml_tpu.servable.sharding import resolve_plan_sharding
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices for a sharded plan")
+        sharding = resolve_plan_sharding(2)
+        buckets = sharding.serving_buckets(16)
+        df = _features(max(buckets))
+        template = df.take([0])
+
+        plan1 = CompiledServingPlan.build(
+            _servable(), scope="ml.serving[pc-sh1]", sharding=resolve_plan_sharding(2)
+        )
+        plan1.warmup(template, buckets)
+        assert _pc(MLMetrics.PLANCACHE_STORES) > 0
+        first = {b: plan1.execute(df.take(np.arange(b))) for b in buckets}
+
+        misses_before = _pc(MLMetrics.PLANCACHE_MISSES)
+        _poison(monkeypatch)
+        plan2 = CompiledServingPlan.build(
+            _servable(), scope="ml.serving[pc-sh2]", sharding=resolve_plan_sharding(2)
+        )
+        plan2.warmup(template, buckets)
+        assert _pc(MLMetrics.PLANCACHE_MISSES) == misses_before
+        for b in buckets:
+            _assert_frames_bitexact(first[b], plan2.execute(df.take(np.arange(b))))
+
+
+# ---------------------------------------------------------------------------
+# continuous loop: the warm split
+# ---------------------------------------------------------------------------
+class TestLoopWarmSplit:
+    def test_second_flip_warm_time_moves_to_cache_gauge(self, cache_dir, tmp_path):
+        """Cross-version hits: version 2's chain programs have the same
+        architecture as version 1's (weight values are arguments, not part
+        of the key), so the second flip warms from cache and its warm time
+        lands in ml.loop.warm.cache.ms — never booked as compile seconds."""
+        from flink_ml_tpu.linalg.vectors import DenseVector
+        from flink_ml_tpu.loop import ContinuousLearningLoop, ContinuousTrainer
+        from flink_ml_tpu.models.classification.online_logistic_regression import (
+            OnlineLogisticRegression,
+        )
+        from flink_ml_tpu.models.online import QueueBatchStream
+
+        d = DIM
+        rng = np.random.default_rng(0)
+
+        def batch(seed):
+            X = np.random.default_rng(seed).normal(size=(64, d))
+            return {
+                "features": X,
+                "label": (X @ np.linspace(1, -1, d) > 0).astype(np.float64),
+            }
+
+        stream = QueueBatchStream()
+        for i in range(2):
+            stream.add(batch(i))
+        scope = f"{MLMetrics.LOOP_GROUP}[pc-loop]"
+        trainer = ContinuousTrainer(
+            OnlineLogisticRegression()
+            .set_initial_model_data(
+                DataFrame(["coefficient"], None, [[DenseVector(np.zeros(d))]])
+            )
+            .set_global_batch_size(64),
+            stream,
+            str(tmp_path / "pub"),
+            publish_every_versions=1,
+            scope=scope,
+        )
+        server = InferenceServer(
+            name="pc-loop",
+            serving_config=ServingConfig(max_batch_size=8, max_delay_ms=0.5),
+            warmup_template=DataFrame.from_dict(
+                {"features": rng.normal(size=(1, d))}
+            ),
+        )
+        loop = ContinuousLearningLoop(trainer, server, name="pc-loop")
+        try:
+            loop.run(publish_target=2, max_steps=4)
+        finally:
+            server.close()
+        scraped = metrics.scope(scope)
+        assert scraped[MLMetrics.LOOP_SWAPPED] == 2
+        # The second flip loaded every chain program from the first flip's
+        # stores: its warm time is cache-load, not compile.
+        assert scraped[MLMetrics.LOOP_WARM_CACHE_MS] > 0.0
+        assert _pc(MLMetrics.PLANCACHE_HITS) > 0
+
+
+# ---------------------------------------------------------------------------
+# batch tier
+# ---------------------------------------------------------------------------
+class TestBatchPlan:
+    def test_batch_plan_resumes_from_cache(self, cache_dir, monkeypatch):
+        from flink_ml_tpu.builder.batch_plan import CompiledBatchPlan
+        from flink_ml_tpu.models.feature.standard_scaler import StandardScalerModel
+
+        rng = np.random.default_rng(5)
+        sc = StandardScalerModel().set_input_col("input").set_output_col("scaled")
+        sc.set_with_mean(True)
+        sc.mean = rng.normal(size=DIM)
+        sc.std = np.abs(rng.normal(size=DIM)) + 0.5
+        df = DataFrame.from_dict({"input": rng.normal(size=(64, DIM))})
+
+        plan1 = CompiledBatchPlan.build([sc], scope="ml.batch[pc-1]")
+        assert plan1.plancache is not None
+        out1 = plan1.transform(df)
+        assert _pc(MLMetrics.PLANCACHE_STORES) > 0
+
+        _poison(monkeypatch)
+        plan2 = CompiledBatchPlan.build([sc], scope="ml.batch[pc-2]")
+        out2 = plan2.transform(df)
+        _assert_frames_bitexact(out1, out2)

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""restart_smoke — hard-kill → zero-compile resume from the persistent plan
+cache, across a REAL process boundary (docs/plancache.md).
+
+Incarnation 1 builds the serving head (scaler → logistic, fixed seeds),
+AOT-warms every bucket — populating the plan cache — serves one request per
+bucket, records the raw response bytes, then dies by ``os._exit(1)`` (a hard
+kill: no atexit, no graceful close — the supervisor-restart analogue).
+
+Incarnation 2 starts over the same cache directory with the chain executor's
+ONE XLA-compile seam (``servable.planner._compile_lowered``) poisoned to
+raise. It must warm every bucket and answer every request purely from the
+serialized executables:
+
+- zero plan-cache misses and zero serving-path compiles (the counters), the
+  poisoned seam never reached (the hard proof);
+- every response bit-identical to incarnation 1's recorded bytes;
+- inside the smoke deadline — the O(load)-not-O(XLA) cold-start contract.
+
+Run: ``python tools/ci/restart_smoke.py`` (wired into tools/ci/run_tests.sh).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+#: Wall-clock bound on the RESUMING incarnation (spawn → exit, jax import
+#: included). Generous for a loaded 1-core CI box — the point is O(load)
+#: cold start, not a microbenchmark; the per-phase timings print below.
+RESUME_DEADLINE_S = 120.0
+
+DIM = 24
+BUCKET_CAP = 16  # buckets 1/2/4/8/16
+
+
+def _build_servable():
+    import numpy as np
+
+    from flink_ml_tpu.servable import (
+        LogisticRegressionModelServable,
+        PipelineModelServable,
+        StandardScalerModelServable,
+    )
+
+    rng = np.random.default_rng(42)
+    sc = StandardScalerModelServable().set_input_col("features").set_output_col("scaled")
+    sc.mean = rng.normal(size=DIM)
+    sc.std = np.abs(rng.normal(size=DIM)) + 0.5
+    sc.set_with_mean(True)
+    lr = LogisticRegressionModelServable().set_features_col("scaled")
+    lr.coefficient = rng.normal(size=DIM)
+    return PipelineModelServable([sc, lr])
+
+
+def _requests():
+    import numpy as np
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.serving import power_of_two_buckets
+
+    rng = np.random.default_rng(7)
+    out = []
+    for bucket in power_of_two_buckets(BUCKET_CAP):
+        out.append(
+            (bucket, DataFrame.from_dict({"features": rng.normal(size=(bucket, DIM))}))
+        )
+    return out
+
+
+def _serve_all(workdir: str, incarnation: int):
+    import numpy as np
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.config import Options, config
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    config.set(Options.PLANCACHE_DIR, os.path.join(workdir, "plancache"))
+    template = DataFrame.from_dict(
+        {"features": np.random.default_rng(3).normal(size=(1, DIM))}
+    )
+    t0 = time.perf_counter()
+    server = InferenceServer(
+        _build_servable(),
+        name=f"restart-smoke-{incarnation}",
+        serving_config=ServingConfig(max_batch_size=BUCKET_CAP, max_delay_ms=0.1),
+        warmup_template=template,
+    )
+    responses = {}
+    first_response_s = None
+    for bucket, df in _requests():
+        r = server.predict(df)
+        if first_response_s is None:
+            first_response_s = time.perf_counter() - t0
+        assert r.bucket == bucket, f"request of {bucket} rows ran at bucket {r.bucket}"
+        raw = np.asarray(
+            [np.asarray(v, np.float64) for v in r.dataframe.column("rawPrediction")]
+        )
+        pred = np.asarray(r.dataframe.column("prediction"), np.float64)
+        responses[str(bucket)] = (raw, pred)
+    stats = {
+        "publish_to_first_response_s": round(first_response_s, 3),
+        "warmup_compile_ms": metrics.get(
+            server.scope, MLMetrics.SERVING_WARMUP_COMPILE_MS, 0.0
+        ),
+        "warmup_cache_load_ms": metrics.get(
+            server.scope, MLMetrics.SERVING_WARMUP_CACHE_LOAD_MS, 0.0
+        ),
+        "serving_path_compiles": metrics.get(
+            server.scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0
+        ),
+        "plancache": dict(metrics.scope(MLMetrics.PLANCACHE_GROUP)),
+    }
+    stats["plancache"].pop("ml.plancache.load.ms", None)  # histogram: not JSON
+    return server, responses, stats
+
+
+def incarnation_1(workdir: str) -> None:
+    import numpy as np
+
+    _server, responses, stats = _serve_all(workdir, 1)
+    np.savez(
+        os.path.join(workdir, "responses1.npz"),
+        **{
+            f"{k}.{part}": arr
+            for k, (raw, pred) in responses.items()
+            for part, arr in (("raw", raw), ("pred", pred))
+        },
+    )
+    assert stats["plancache"].get("ml.plancache.stores", 0) > 0, (
+        "incarnation 1 stored nothing — the cache never engaged"
+    )
+    with open(os.path.join(workdir, "inc1.json"), "w") as f:
+        json.dump(stats, f)
+    print(f"[inc1] served {len(responses)} buckets, stats: {stats}", flush=True)
+    # Hard kill: no drain, no close, no atexit — the supervisor-kill shape.
+    os._exit(1)
+
+
+def incarnation_2(workdir: str) -> None:
+    import numpy as np
+
+    import flink_ml_tpu.servable.planner as planner
+
+    def blocked(lowered):
+        raise AssertionError(
+            "XLA compile reached in the resuming incarnation — the plan "
+            "cache failed the zero-compile-resume contract"
+        )
+
+    planner._compile_lowered = blocked
+
+    server, responses, stats = _serve_all(workdir, 2)
+    saved = np.load(os.path.join(workdir, "responses1.npz"))
+    for key, (raw, pred) in responses.items():
+        assert np.array_equal(saved[f"{key}.raw"], raw), f"bucket {key}: raw differs"
+        assert np.array_equal(saved[f"{key}.pred"], pred), f"bucket {key}: pred differs"
+    assert stats["serving_path_compiles"] == 0, stats
+    pc = stats["plancache"]
+    assert pc.get("ml.plancache.misses", 0) == 0, f"live compiles on resume: {pc}"
+    assert pc.get("ml.plancache.quarantined", 0) == 0, pc
+    assert pc.get("ml.plancache.hits", 0) > 0, pc
+    server.close()
+    with open(os.path.join(workdir, "inc2.json"), "w") as f:
+        json.dump(stats, f)
+    print(
+        f"[inc2] zero-compile resume OK: {len(responses)} buckets bit-identical, "
+        f"stats: {stats}",
+        flush=True,
+    )
+
+
+def main() -> int:
+    import tempfile
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    with tempfile.TemporaryDirectory(prefix="restart-smoke-") as workdir:
+        print("=== incarnation 1: compile, serve, populate cache, hard-kill ===")
+        p1 = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--incarnation", "1", workdir],
+            env=env,
+            timeout=600,
+        )
+        if p1.returncode != 1 or not os.path.exists(os.path.join(workdir, "inc1.json")):
+            print(f"FAIL: incarnation 1 rc={p1.returncode} (expected the hard-kill 1)")
+            return 1
+        print("=== incarnation 2: resume with the XLA compile seam poisoned ===")
+        t0 = time.perf_counter()
+        p2 = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--incarnation", "2", workdir],
+            env=env,
+            timeout=600,
+        )
+        resume_wall = time.perf_counter() - t0
+        if p2.returncode != 0 or not os.path.exists(os.path.join(workdir, "inc2.json")):
+            print(f"FAIL: incarnation 2 rc={p2.returncode}")
+            return 1
+        if resume_wall > RESUME_DEADLINE_S:
+            print(
+                f"FAIL: resume took {resume_wall:.1f}s > deadline {RESUME_DEADLINE_S}s"
+            )
+            return 1
+        with open(os.path.join(workdir, "inc1.json")) as f:
+            s1 = json.load(f)
+        with open(os.path.join(workdir, "inc2.json")) as f:
+            s2 = json.load(f)
+        print(
+            f"restart_smoke OK: resume wall {resume_wall:.1f}s "
+            f"(deadline {RESUME_DEADLINE_S:.0f}s); publish->first-response "
+            f"{s1['publish_to_first_response_s']}s cold vs "
+            f"{s2['publish_to_first_response_s']}s warm; warm split "
+            f"compile {s2['warmup_compile_ms']:.1f}ms / "
+            f"cache {s2['warmup_cache_load_ms']:.1f}ms"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    if "--incarnation" in sys.argv:
+        idx = sys.argv.index("--incarnation")
+        which, workdir = sys.argv[idx + 1], sys.argv[idx + 2]
+        (incarnation_1 if which == "1" else incarnation_2)(workdir)
+        sys.exit(0)
+    sys.exit(main())

@@ -66,6 +66,14 @@ python tools/ci/fusion_smoke.py
 echo "=== chaos smoke (open-loop ramp past saturation, faults armed) ==="
 python tools/ci/chaos_smoke.py
 
+# Restart smoke: serve → hard-kill (os._exit) → a new incarnation over the
+# same plan-cache directory resumes with the XLA compile seam POISONED and
+# answers every bucket bit-identically from the serialized executables,
+# inside the smoke deadline — the zero-compile-resume contract
+# (docs/plancache.md).
+echo "=== restart smoke (hard-kill -> zero-compile resume from plan cache) ==="
+python tools/ci/restart_smoke.py
+
 # Bench trend (informational): diff the two newest BENCH_r*.json rounds and
 # warn on >10% p50 / rows-per-second movement — directional on shared CI
 # boxes, so the step never fails the build (tools/bench_trend.py --strict

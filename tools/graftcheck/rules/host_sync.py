@@ -45,6 +45,15 @@ Flagged inside the hot region:
                                     design exists to avoid (weights commit at
                                     swap/build time, request rows ride the
                                     compiled executable's own intake).
+- file I/O (``open()``, blocking ``os.*``/``shutil.*``) inside the
+  device-adjacent tiers — the persistent plan cache (``servable/plancache.py``)
+  put disk reads/writes one call below the chain executor, so the rule now
+  proves cache I/O can never be reached from a hot root: ``PlanCache``'s
+  load/store surfaces are ``# graftcheck: cold`` (taken only on the
+  compile/warmup path, counted by ``ml.plancache.*``), and any OTHER file
+  I/O a hot region grows is flagged. Scoped to the device-adjacent tiers by
+  the I/O site's own file, like the parameter heuristics: host-side tiers
+  (checkpointing, datacache spill) have their own designated I/O seams.
 
 As with jit-purity the numpy/float checks fire on direct parameters only
 (numpy on values that are already host-resident is legal and common) — false
@@ -90,10 +99,12 @@ _PARAM_KINDS = {"asarray", "scalar"}
 class HostSyncRule(Rule):
     name = "host-sync"
     severity = "error"
+    cache_version = 2  # v2: file I/O flagged in device-tier hot regions
     description = (
         "no device->host syncs (.item(), block_until_ready, np.asarray/float "
-        "on parameters) nor host->device uploads (device_put outside "
-        "`# graftcheck: ingest` boundaries) reachable from "
+        "on parameters), host->device uploads (device_put outside "
+        "`# graftcheck: ingest` boundaries), nor device-tier file I/O "
+        "(open/os/shutil — plan-cache discipline) reachable from "
         "`# graftcheck: hot-root` functions, outside the designated "
         "`# graftcheck: readback` boundaries"
     )
@@ -150,6 +161,20 @@ class HostSyncRule(Rule):
                             "through a designated `# graftcheck: ingest` "
                             "boundary (one device_put per chunk, split per "
                             "shard) or commit it at build/warmup time",
+                        )
+                    )
+                elif kind == "io" and in_device_tier:
+                    # The plan-cache discipline: disk I/O belongs to the
+                    # `# graftcheck: cold` load/store surfaces (compile and
+                    # warmup paths), never to a hot dispatch region.
+                    findings.append(
+                        self.finding(
+                            rel,
+                            line,
+                            f"hot region (reachable from hot-root {root_display}): "
+                            f"{detail} performs file I/O on a hot path — move "
+                            "it behind a `# graftcheck: cold` build/warmup "
+                            "surface (the plan-cache load/store discipline)",
                         )
                     )
         return findings

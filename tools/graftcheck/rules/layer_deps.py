@@ -103,6 +103,12 @@ MODULE_LAYERS = {
     # inside the runtime-free guarantee; registered explicitly so the
     # sharded fast paths' dependency story is auditable.
     "servable.sharding": 1,
+    # The persistent compiled-plan cache: L1 like the rest of servable — it
+    # imports only L0 (config, faults, metrics) plus telemetry (same layer),
+    # so the runtime-free guarantee covers cache-served executables too.
+    # Its load/store surfaces are `# graftcheck: cold` and the host-sync
+    # rule's file-I/O scope proves no hot root can reach cache disk I/O.
+    "servable.plancache": 1,
 }
 
 #: The absorbed check_servable_imports.py contract (see module docstring).
@@ -162,7 +168,7 @@ class LayerDepsRule(Rule):
     name = "layer-deps"
     severity = "error"
     granularity = "file"
-    cache_version = 4  # v4: telemetry registered (flight recorder, L1)
+    cache_version = 5  # v5: servable.plancache registered (plan cache, L1)
     description = (
         "imports within flink_ml_tpu must not point at a higher layer "
         "(foundation < compute/servable < runtime < library)"
