@@ -1872,6 +1872,172 @@ def _bench_pipeline_batch_transform_body():
     }
 
 
+def bench_sparse_pipelines():
+    """Sparse/ragged fast path (docs/sparse.md): the two acceptance
+    workloads, fused (sparse calling convention: ELL triples on the nnz-cap
+    ladder, segment-reduce kernels, chains device-resident end to end) vs
+    the per-stage fallback path, batch tier.
+
+    - ``sparse_text_pipeline``: tokenize → hashingTF → IDF → logistic over
+      ragged documents. Both legs pay the same host tokenize+hash featurize;
+      the fused leg's win is everything downstream — no SparseVector
+      materialization between stages, the counts/idf/margin chain as three
+      AOT programs over the packed triple. An nnz-cap sweep sizes the
+      ladder-padding cost.
+    - ``sparse_ctr_pipeline``: one-hot → interaction → logistic (the CTR
+      shape, nnz 1 per one-hot, cross dim = cats_a × cats_b never
+      densified in the fused leg).
+
+    Single-core hosts run with synchronous CPU dispatch like the other batch
+    benches (restored on exit).
+    """
+    import os
+
+    import jax
+
+    if (os.cpu_count() or 1) == 1:
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+        try:
+            return _bench_sparse_pipelines_body()
+        finally:
+            jax.config.update("jax_cpu_enable_async_dispatch", True)
+    return _bench_sparse_pipelines_body()
+
+
+def _bench_sparse_pipelines_body():
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.builder.pipeline import Pipeline
+    from flink_ml_tpu.config import Options, config
+    from flink_ml_tpu.models.classification.logistic_regression import LogisticRegression
+    from flink_ml_tpu.models.feature.hashing_tf import HashingTF
+    from flink_ml_tpu.models.feature.idf import IDF
+    from flink_ml_tpu.models.feature.interaction import Interaction
+    from flink_ml_tpu.models.feature.one_hot_encoder import OneHotEncoder
+    from flink_ml_tpu.models.feature.tokenizer import Tokenizer
+
+    rng = np.random.default_rng(29)
+    words = [f"w{i:03d}" for i in range(64)]
+
+    def text_df(n, tokens_per_doc):
+        docs = [
+            " ".join(rng.choice(words, size=tokens_per_doc)) for _ in range(n)
+        ]
+        return DataFrame.from_dict(
+            {"text": docs, "label": rng.integers(0, 2, n).astype(np.float64)}
+        )
+
+    def both_legs(model, df, repeats=3):
+        n = len(df)
+        config.set(Options.BATCH_FASTPATH, False)
+        model.transform(df)  # warm per-stage jit caches
+        t_slow, slow_spread = _median_time_spread(
+            lambda: model.transform(df), repeats=repeats
+        )
+        config.set(Options.BATCH_FASTPATH, True)
+        model.invalidate_batch_plan()
+        model.transform(df)  # warm: compiles the chunk signatures
+        t_fast, fast_spread = _median_time_spread(
+            lambda: model.transform(df), repeats=repeats
+        )
+        config.unset(Options.BATCH_FASTPATH)
+        return {
+            "per_stage_rows_per_sec": round(n / t_slow, 1),
+            "fused_rows_per_sec": round(n / t_fast, 1),
+            "fused_vs_per_stage": round(t_slow / t_fast, 3),
+            "per_stage_spread": slow_spread,
+            "fused_spread": fast_spread,
+        }
+
+    # -- text ----------------------------------------------------------------
+    n_text, dim = 50_000, 4096
+    fit_df = text_df(2_000, 8)
+    text_model = Pipeline(
+        [
+            Tokenizer().set_input_col("text").set_output_col("tokens"),
+            HashingTF().set_input_col("tokens").set_output_col("tf").set_num_features(dim),
+            IDF().set_input_col("tf").set_output_col("feat"),
+            LogisticRegression()
+            .set_features_col("feat")
+            .set_label_col("label")
+            .set_prediction_col("pred")
+            .set_raw_prediction_col("raw")
+            .set_max_iter(2),
+        ]
+    ).fit(fit_df)
+    headline = both_legs(text_model, text_df(n_text, 8))
+    cap_sweep = []
+    for tokens in (4, 16, 64):
+        config.set(Options.SPARSE_NNZ_CAP_MAX, 64)
+        legs = both_legs(text_model, text_df(n_text // 5, tokens), repeats=3)
+        config.unset(Options.SPARSE_NNZ_CAP_MAX)
+        legs["tokens_per_doc"] = tokens
+        from flink_ml_tpu.linalg.sparse_batch import ladder_cap
+
+        legs["nnz_cap"] = ladder_cap(tokens)
+        cap_sweep.append(legs)
+    text = {
+        "name": "sparse_text_pipeline",
+        "chain": f"tokenize->hashingTF(d={dim})->idf->logistic, {n_text} docs x 8 tokens",
+        **headline,
+        "nnz_cap_sweep": cap_sweep,
+        "note": (
+            "both legs pay the same host tokenize+hash featurize; the fused "
+            "leg chains counts/idf/margin on device over the packed ELL "
+            "triple with zero SparseVector materialization between stages. "
+            "1-core box: ratios are directional; the host featurize share "
+            "shrinks (and the fused win grows) with vocabulary/doc size."
+        ),
+    }
+
+    # -- CTR -----------------------------------------------------------------
+    n_ctr, cats = 200_000, (1000, 500)
+    fit = DataFrame.from_dict(
+        {
+            "ad": rng.integers(0, cats[0], 4_000).astype(np.float64),
+            "user": rng.integers(0, cats[1], 4_000).astype(np.float64),
+            "label": rng.integers(0, 2, 4_000).astype(np.float64),
+        }
+    )
+    ctr_model = Pipeline(
+        [
+            OneHotEncoder()
+            .set_input_cols("ad", "user")
+            .set_output_cols("ad_v", "user_v")
+            .set_handle_invalid("keep")
+            .set_drop_last(False),
+            Interaction().set_input_cols("ad_v", "user_v").set_output_col("cross"),
+            LogisticRegression()
+            .set_features_col("cross")
+            .set_label_col("label")
+            .set_prediction_col("pred")
+            .set_raw_prediction_col("raw")
+            .set_max_iter(2),
+        ]
+    ).fit(fit)
+    ctr_df = DataFrame.from_dict(
+        {
+            "ad": rng.integers(0, cats[0], n_ctr).astype(np.float64),
+            "user": rng.integers(0, cats[1], n_ctr).astype(np.float64),
+        }
+    )
+    ctr = {
+        "name": "sparse_ctr_pipeline",
+        "chain": (
+            f"one-hot({cats[0]},{cats[1]})->interaction(cross dim "
+            f"{cats[0] * cats[1]})->logistic, {n_ctr} rows"
+        ),
+        **both_legs(ctr_model, ctr_df),
+        "note": (
+            "nnz 1 per one-hot; the fused leg never densifies the "
+            f"{cats[0] * cats[1]}-dim cross — margins ride the "
+            "gather-scale-segment-sum head at cap 1. 1-core box note as above."
+        ),
+    }
+    out = {"name": "sparse_pipelines", "workloads": [text, ctr]}
+    print(json.dumps(out, indent=1))
+    return out
+
+
 def bench_fusion_sweep():
     """Fusion tiers (docs/fusion.md): ``fusion.mode=exact`` vs ``fast`` vs
     ``fast`` with Pallas megakernels forced hot, on the two benched chains —
@@ -2602,6 +2768,7 @@ def main() -> None:
     fusion = bench_fusion_sweep()
     sharded = bench_sharded_fanout()
     cold_start = bench_cold_start()
+    sparse_pipelines = bench_sparse_pipelines()
 
     detail = {
         "device_kind": kind,
@@ -2611,7 +2778,7 @@ def main() -> None:
             logreg, sparse, sweep, sparse_streamed, overlap, kmeans, mlp,
             mlp_train, attention, attention_train, serving, open_loop,
             tracing, journal, mlp_serving, continuous_loop, batch_transform,
-            fusion, sharded, cold_start,
+            fusion, sharded, cold_start, sparse_pipelines,
         ],
     }
     with open("BENCH_DETAIL.json", "w") as f:

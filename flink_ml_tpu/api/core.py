@@ -54,6 +54,21 @@ class Transformer(AlgoOperator):
     """Marker: an AlgoOperator whose semantics is record-wise feature transformation.
     Ref Transformer.java:39."""
 
+    @classmethod
+    def load_servable(cls, path: str) -> "Transformer":
+        """Stateless feature Transformers are their own runtime-free replica:
+        params fully describe ``transform``, so the serving tier's
+        ``load_servable`` dispatch (servable/api.py) restores the stage
+        itself — what lets Tokenizer→HashingTF→… pipelines publish and serve
+        (docs/sparse.md). Models carry model data and MUST override with a
+        real servable pairing; the guard keeps a missing override the same
+        hard error it always was, never a silently data-less servable."""
+        if issubclass(cls, Model):
+            raise RuntimeError(
+                f"{cls.__name__}.load_servable(path) is not implemented."
+            )
+        return cls.load(path)
+
 
 class Model(Transformer):
     """A Transformer with model data. Ref Model.java:31.

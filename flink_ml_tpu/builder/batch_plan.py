@@ -71,6 +71,13 @@ from flink_ml_tpu.servable.planner import (
     run_segment,
 )
 from flink_ml_tpu.servable.sharding import resolve_plan_sharding
+from flink_ml_tpu.servable.sparse import (
+    ids_name,
+    nnz_name,
+    rebuild_sparse_column,
+    resolve_nnz_cap_max,
+    values_name,
+)
 from flink_ml_tpu.trace import CAT_PRODUCTIVE, CAT_READBACK, tracer
 
 __all__ = ["BatchPlanInapplicable", "CompiledBatchPlan"]
@@ -156,6 +163,7 @@ class CompiledBatchPlan:
         scope: str = "ml.batch[plan]",
         sharding: Optional[Any] = None,
         fusion: Optional[Any] = None,
+        sparse: Optional[Dict[str, int]] = None,
     ) -> Optional["CompiledBatchPlan"]:
         """Group consecutive kernel-spec stages into fused segments and
         commit their model arrays to the device (the once-per-plan upload —
@@ -175,7 +183,7 @@ class CompiledBatchPlan:
             )
         if fusion is None:
             fusion = resolve_fusion_tier()
-        segments = build_segments(stages, sharding, fusion)
+        segments = build_segments(stages, sharding, fusion, sparse)
         if not any(isinstance(s, FusedSegment) for s in segments):
             return None
         plan = CompiledBatchPlan(stages, segments, scope, sharding, fusion)
@@ -193,6 +201,9 @@ class CompiledBatchPlan:
             span.set_attr("input_rows", len(df))
             for segment in self.segments:
                 if isinstance(segment, FallbackStage):
+                    metrics.counter(
+                        self.scope, MLMetrics.fallback_reason("batch", "specless")
+                    )
                     out = segment.stage.transform(df)
                     if isinstance(out, (list, tuple)):
                         if len(out) != 1:
@@ -217,16 +228,30 @@ class CompiledBatchPlan:
             # inside that single C++ convert+copy pass (bit-identical to a
             # host astype — both are IEEE round-to-nearest — and one full
             # memory pass cheaper). Non-float columns cast to f32 once, the
-            # same float math the per-stage kernels apply.
+            # same float math the per-stage kernels apply. Sparse-convention
+            # inputs pack ONCE for the whole call at their ladder cap
+            # (docs/sparse.md) — the triple's [n, K]/[n] arrays then slice
+            # per chunk exactly like dense columns.
             full: Dict[str, np.ndarray] = {}
+            nnz_cap = 0
+            cap_max = resolve_nnz_cap_max()
             for name in segment.external_inputs:
+                if segment.input_kind(name) in ("sparse", "entries"):
+                    arrays, col_cap, _col_nnz = segment.gather_sparse(
+                        df, name, cap_max=cap_max
+                    )
+                    full.update(arrays)
+                    nnz_cap = max(nnz_cap, col_cap)
+                    continue
                 arr = segment.gather(df, name, raw=True)
                 if arr.dtype not in (np.float32, np.float64):
                     arr = np.asarray(arr, np.float32)
                 elif not arr.flags.c_contiguous:
                     arr = np.ascontiguousarray(arr)
                 full[name] = arr
-        except IneligibleBatch:
+            nnz_names = [n for n in full if n.endswith("!nnz")]
+        except IneligibleBatch as e:
+            metrics.counter(self.scope, MLMetrics.fallback_reason("batch", e.reason))
             return self._fallback(segment, df, count=True)
 
         chunk_rows = max(1, int(config.get(Options.BATCH_CHUNK_ROWS)))
@@ -276,7 +301,8 @@ class CompiledBatchPlan:
                         inputs[name] = sharding.put_batch(view)
             key = tuple(
                 (name, tuple(inputs[name].shape), str(inputs[name].dtype))
-                for name in segment.external_inputs
+                for name in sorted(inputs)  # program-level names (sparse
+                # columns expand to their values/ids/nnz triples)
             ) + ((("replicated",) if replicated else ()))
             return key, inputs, rows, replicated
 
@@ -334,6 +360,16 @@ class CompiledBatchPlan:
                 # round-up exactly once, here and nowhere else.
                 sp.set_attr("rows", rows)
                 sp.set_attr("bucket", padded)
+                if nnz_cap:
+                    # ELL attribution: entries the chunk's TRUE rows carry vs
+                    # the bucket×cap cells the program computes — graftscope
+                    # counts ELL + row padding exactly once from these
+                    # (docs/observability.md).
+                    hi_ = min(lo + chunk_rows, n)
+                    sp.set_attr(
+                        "nnz", int(sum(int(full[m][lo:hi_].sum()) for m in nnz_names))
+                    )
+                    sp.set_attr("nnz_cap", nnz_cap)
                 if sharding is not None:
                     sp.set_attr("shards", 1 if replicated else sharding.n_data)
                 span_holder["sp"] = sp
@@ -388,6 +424,22 @@ class CompiledBatchPlan:
         metrics.counter(self.scope, MLMetrics.BATCH_FUSED_ROWS, n)
         out = df.clone()
         for name, _ in segment.outputs:
+            if name in segment.sparse_outputs:
+                # A sparse-convention output: the three part buffers rebuild
+                # the SparseVector column (leading-nnz slots, sorted-unique
+                # by the kernels' compaction invariant) — the same column the
+                # per-stage path would have added.
+                out.add_column(
+                    name,
+                    DataTypes.vector(BasicType.DOUBLE),
+                    rebuild_sparse_column(
+                        segment.sparse_outputs[name],
+                        out_bufs[values_name(name)],
+                        out_bufs[ids_name(name)],
+                        out_bufs[nnz_name(name)],
+                    ),
+                )
+                continue
             host = out_bufs[name]
             dtype = out_decl[name]
             if dtype is None:  # shape-following output: infer like transform

@@ -99,7 +99,7 @@ class PipelineModel(Model):
         if len(inputs) == 1 and config.get(Options.BATCH_FASTPATH):
             from flink_ml_tpu.builder.batch_plan import BatchPlanInapplicable
 
-            plan = self._batch_plan()
+            plan = self._batch_plan(inputs[0])
             if plan is not None:
                 try:
                     return plan.transform(inputs[0])
@@ -111,16 +111,18 @@ class PipelineModel(Model):
             last_inputs = list(out) if isinstance(out, (list, tuple)) else [out]
         return last_inputs[0] if len(last_inputs) == 1 else last_inputs
 
-    def _fingerprint(self) -> Tuple:
+    def _fingerprint(self, sparse_hints) -> Tuple:
         """Cheap identity of the chain a compiled plan snapshots: stage
         object identity plus each stage's param map, plus the mesh config
         the plan's programs and committed buffers were placed under (a
         ``batch.mesh`` change mid-process must rebuild, not serve stale
-        local shapes) and the fusion-tier config the programs were
+        local shapes), the fusion-tier config the programs were
         partitioned under (a ``fusion.mode`` flip must rebuild, not silently
-        keep serving the old tier's numerics contract — docs/fusion.md).
-        Model *data* is covered by ``set_model_data`` invalidating the
-        cache; mutating a stage's arrays directly requires
+        keep serving the old tier's numerics contract — docs/fusion.md),
+        and the sparse hints the segments were specialized for (a call whose
+        columns' sparseness differs needs differently-partitioned programs —
+        docs/sparse.md). Model *data* is covered by ``set_model_data``
+        invalidating the cache; mutating a stage's arrays directly requires
         :meth:`invalidate_batch_plan`."""
         mesh_key = (
             config.get(Options.BATCH_MESH),
@@ -131,17 +133,25 @@ class PipelineModel(Model):
             config.get(Options.FUSION_MEGAKERNEL),
             config.get(Options.FUSION_MEGAKERNEL_MIN_SCORE),
         )
-        return (mesh_key, fusion_key) + tuple(
+        sparse_key = (
+            None if sparse_hints is None else tuple(sorted(sparse_hints.items()))
+        )
+        return (mesh_key, fusion_key, sparse_key) + tuple(
             (id(stage), json.dumps(stage.param_map_to_json(), sort_keys=True, default=str))
             for stage in self.stages
         )
 
-    def _batch_plan(self):
+    def _batch_plan(self, df: Optional[DataFrame] = None):
         from flink_ml_tpu.builder.batch_plan import CompiledBatchPlan
+        from flink_ml_tpu.servable.sparse import resolve_sparse_hints
 
-        fp = self._fingerprint()
+        sparse_hints = resolve_sparse_hints(df)
+        fp = self._fingerprint(sparse_hints)
         if self._plan_cache is None or self._plan_cache[0] != fp:
-            self._plan_cache = (fp, CompiledBatchPlan.build(self.stages))
+            self._plan_cache = (
+                fp,
+                CompiledBatchPlan.build(self.stages, sparse=sparse_hints),
+            )
         return self._plan_cache[1]
 
     def invalidate_batch_plan(self) -> "PipelineModel":

@@ -321,6 +321,34 @@ class Options:
         "(docs/fusion.md has the model). Below the bar, fast mode uses the "
         "merged XLA program.",
     )
+    SPARSE_FASTPATH = ConfigOption(
+        "sparse.fastpath",
+        _parse_bool,
+        True,
+        "Let sparse/ragged columns ride the compiled plans through the sparse "
+        "calling convention (docs/sparse.md): values/ids/segment-ids as dense "
+        "device arrays on the power-of-two nnz-cap ladder, segment-reduce "
+        "kernels, sparse-aware fusion costing. Off = every sparse column "
+        "falls back to the bit-exact per-stage path (pre-sparse behavior).",
+    )
+    SPARSE_NNZ_CAP_MAX = ConfigOption(
+        "sparse.nnz.cap.max",
+        int,
+        64,
+        "Top rung of the sparse nnz-per-row bucket ladder. A batch whose "
+        "rows carry more entries than this is off-ladder and serves through "
+        "the per-stage fallback (counted under the 'off_ladder' fallback "
+        "reason) instead of compiling an unbounded executable set.",
+    )
+    SPARSE_WARMUP_CAPS = ConfigOption(
+        "sparse.warmup.caps",
+        str,
+        None,
+        "Comma-separated nnz caps the serving warmup AOT-compiles per bucket "
+        "for sparse segments (each rounds up to its ladder rung). Default: "
+        "the full power-of-two ladder up to sparse.nnz.cap.max — zero "
+        "post-warmup compiles for every on-ladder batch.",
+    )
     BATCH_FASTPATH = ConfigOption(
         "batch.fastpath",
         _parse_bool,

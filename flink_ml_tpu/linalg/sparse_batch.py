@@ -24,9 +24,21 @@ import numpy as np
 
 from flink_ml_tpu.linalg.vectors import SparseVector, Vector
 
-__all__ = ["SparseBatch"]
+__all__ = ["SparseBatch", "ladder_cap"]
 
 _LANE = 8  # pad K to a multiple of this (TPU sublane-friendly)
+
+
+def ladder_cap(max_nnz: int) -> int:
+    """The nnz-per-row bucket ladder of the sparse fast path: the smallest
+    power of two ≥ ``max_nnz`` (floor 1). Mirrors the dense serving buckets
+    (power-of-two row counts): every ragged batch pads its row width K up to
+    a ladder cap, so the compiled-executable set is ≤ 1 per (row bucket,
+    nnz cap) instead of one per max-row-length seen (docs/sparse.md)."""
+    cap = 1
+    while cap < max(1, int(max_nnz)):
+        cap *= 2
+    return cap
 
 
 class SparseBatch:

@@ -108,6 +108,20 @@ class MLMetrics:
         (``ml.goodput.productive.ms``, ``ml.goodput.queue.ms``, ...)."""
         return f"{MLMetrics.GOODPUT_GROUP}.{category}.ms"
 
+    #: Reason labels of the per-reason fast-path fallback counters
+    #: (docs/sparse.md): why a batch/segment left the compiled plan.
+    FALLBACK_REASONS = ("sparse", "ragged", "off_ladder", "signature", "specless")
+
+    @staticmethod
+    def fallback_reason(tier: str, reason: str) -> str:
+        """Counter name for one reason-labelled fast-path fallback —
+        ``ml.serving.fastpath.fallback.sparse``,
+        ``ml.batch.fastpath.fallback.off_ladder``, ... ``tier`` is
+        ``"serving"`` or ``"batch"``. The unlabelled aggregate counters
+        (``...fallback.batches`` / ``...fallback.segments``) keep counting
+        every fallback; the labelled ones attribute each to its cause."""
+        return f"ml.{tier}.fastpath.fallback.{reason}"
+
     # Batch transform fast path (builder/batch_plan.py — fused chunked plans;
     # scope = "ml.batch[plan]" unless the caller names its own).
     BATCH_GROUP = "ml.batch"

@@ -35,11 +35,41 @@ class LogisticRegressionModel(LinearModelBase, HasRawPredictionCol, HasMultiClas
         return LogisticRegressionModelServable.load_servable(path)
 
     def transform(self, *inputs):
-        from flink_ml_tpu.models.linear import compute_dots
-        from flink_ml_tpu.ops.kernels import logistic_from_dots_kernel
+        import jax.numpy as jnp
+
+        from flink_ml_tpu.ops.kernels import (
+            dot_kernel,
+            logistic_from_dots_kernel,
+            sparse_dot_kernel,
+        )
+        from flink_ml_tpu.servable.sparse import pack_sparse_column, sparse_names
 
         (df,) = inputs
-        dots = compute_dots(df, self.get_features_col(), self.coefficient)
+        features_col = self.get_features_col()
+        if len(df) == 0:
+            # An empty features column carries no width to check or dot.
+            out = df.clone()
+            out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.zeros(0))
+            out.add_column(
+                self.get_raw_prediction_col(),
+                DataTypes.vector(BasicType.DOUBLE),
+                np.zeros((0, 2)),
+            )
+            return out
+        coef = jnp.asarray(np.asarray(self.coefficient), jnp.float32)
+        if df.is_sparse(features_col):
+            # Padded-CSR margins through the same ``sparse_dot`` body the
+            # fused sparse spec composes — the sequential segment-sum fold
+            # makes the margin bit-invariant to the packed nnz cap, so the
+            # per-stage and fused paths agree bit for bit (docs/sparse.md).
+            arrays, _cap, _dim, _nnz = pack_sparse_column(
+                df, features_col, dim=int(coef.shape[0])
+            )
+            in_v, in_i, _ = sparse_names(features_col)
+            dots = sparse_dot_kernel()(arrays[in_i], arrays[in_v], coef)
+        else:
+            X = df.vectors(features_col).astype(np.float32)
+            dots = dot_kernel()(X, coef)
         pred, raw = logistic_from_dots_kernel()(dots)
         out = df.clone()
         out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))
@@ -49,6 +79,46 @@ class LogisticRegressionModel(LinearModelBase, HasRawPredictionCol, HasMultiClas
             np.asarray(raw, np.float64),
         )
         return out
+
+    def sparse_kernel_spec(self, known):
+        """Sparse-convention head for the batch fast path (docs/sparse.md):
+        identical spec to the servable's — ``transform``'s sparse branch
+        jits the same ``sparse_dot`` gather-scale-segment-sum body the spec
+        composes, so the fused chain and the per-stage ``transform`` agree
+        bit for bit at every nnz cap (the segment-sum fold is cap-invariant)."""
+        from flink_ml_tpu.ops.kernels import logistic_from_dots_fn, sparse_dot_fn
+        from flink_ml_tpu.servable.kernel_spec import KernelSpec
+        from flink_ml_tpu.servable.sparse import sparse_names
+
+        if self.coefficient is None:
+            raise RuntimeError("set_model_data must be called before kernel_spec")
+        features_col = self.get_features_col()
+        dim = int(np.asarray(self.coefficient).shape[0])
+        if known.get(features_col) != dim:
+            return None
+        in_v, in_i, _in_z = sparse_names(features_col)
+
+        def kernel_fn(model, cols):
+            pred, raw = logistic_from_dots_fn(
+                sparse_dot_fn(cols[in_v], cols[in_i], model["coefficient"])
+            )
+            return {
+                self.get_prediction_col(): pred,
+                self.get_raw_prediction_col(): raw,
+            }
+
+        return KernelSpec(
+            input_cols=(features_col,),
+            outputs=(
+                (self.get_prediction_col(), DataTypes.DOUBLE),
+                (self.get_raw_prediction_col(), DataTypes.vector(BasicType.DOUBLE)),
+            ),
+            model_arrays={"coefficient": np.asarray(self.coefficient, np.float32)},
+            kernel_fn=kernel_fn,
+            input_kinds={features_col: "sparse"},
+            sparse_input_dims={features_col: dim},
+            fusion_op="sparse_logistic",
+        )
 
 
 class LogisticRegression(LinearEstimatorBase, HasRawPredictionCol, HasMultiClass):

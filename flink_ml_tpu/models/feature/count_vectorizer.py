@@ -17,8 +17,21 @@ from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.ops.kernels import (
+    sparse_combine_fn,
+    sparse_combine_kernel,
+    sparse_threshold_fn,
+    sparse_threshold_kernel,
+)
 from flink_ml_tpu.params.param import BoolParam, FloatParam, IntParam, ParamValidators, update_existing_params
 from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
+from flink_ml_tpu.servable.sparse import (
+    entries_names,
+    pack_entry_rows,
+    rebuild_sparse_column,
+    sparse_names,
+)
 from flink_ml_tpu.utils import read_write as rw
 
 __all__ = ["CountVectorizer", "CountVectorizerModel"]
@@ -86,25 +99,94 @@ class CountVectorizerModel(Model, _CvParams):
         super().__init__()
         self.vocabulary: Optional[List[str]] = None
 
+    def _featurize(self, col):
+        """Host half of the featurize: vocabulary lookup per token (strings
+        cannot run on device), out-of-vocabulary tokens dropped, duplicates
+        preserved for the device ``sparse_combine`` segment reduce. Shared by
+        ``transform`` and the fused spec's host ingest."""
+        vocab = {term: i for i, term in enumerate(self.vocabulary)}
+        rows = []
+        lengths = []
+        for tokens in col:
+            rows.append([(vocab[t], 1.0) for t in tokens if t in vocab])
+            lengths.append(len(tokens))
+        return rows, lengths
+
+    def _min_tf_threshold(self, lengths: np.ndarray) -> np.ndarray:
+        """Per-row minTF bar: absolute when ≥ 1, else a fraction of the
+        document's raw token count (ref CountVectorizerModel.java)."""
+        min_tf = float(self.get_min_tf())
+        lengths = np.asarray(lengths, np.float32)
+        if min_tf >= 1.0:
+            return np.full(lengths.shape, min_tf, np.float32)
+        return (min_tf * lengths).astype(np.float32)
+
     def transform(self, *inputs):
         (df,) = inputs
-        vocab = {term: i for i, term in enumerate(self.vocabulary)}
-        min_tf = self.get_min_tf()
-        binary = self.get_binary()
-        vectors = []
-        for tokens in df.column(self.get_input_col()):
-            counts = {}
-            for t in tokens:
-                if t in vocab:
-                    counts[vocab[t]] = counts.get(vocab[t], 0) + 1
-            threshold = min_tf if min_tf >= 1.0 else min_tf * len(tokens)
-            items = [(i, c) for i, c in sorted(counts.items()) if c >= threshold]
-            indices = np.asarray([i for i, _ in items], np.int64)
-            values = np.asarray([1.0 if binary else float(c) for _, c in items])
-            vectors.append(SparseVector(len(vocab), indices, values))
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        rows, lengths = self._featurize(df.column(in_col))
+        arrays, _cap, _total = pack_entry_rows(out_col, rows, lengths)
+        vn, idn, zn, _ln = entries_names(out_col)
+        # Device segment reduce + minTF filter — the SAME bodies the fused
+        # sparse spec composes (counts and thresholds are exact in f32 up to
+        # the documented fractional-minTF rounding, shared by both paths).
+        values, ids, nnz = sparse_combine_kernel()(arrays[vn], arrays[idn], arrays[zn])
+        values, ids, nnz = sparse_threshold_kernel()(
+            values, ids, nnz, self._min_tf_threshold(np.asarray(lengths))
+        )
+        values = np.asarray(values)
+        if self.get_binary():
+            values = np.minimum(values, 1.0)
+        vectors = rebuild_sparse_column(
+            len(self.vocabulary), values, np.asarray(ids), np.asarray(nnz)
+        )
         out = df.clone()
-        out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), vectors)
+        out.add_column(out_col, DataTypes.vector(BasicType.DOUBLE), vectors)
         return out
+
+    def sparse_kernel_spec(self, known):
+        """Sparse-convention spec (docs/sparse.md): host vocabulary lookup
+        at ingest, device ``sparse_combine`` + ``sparse_threshold`` segment
+        reduce — the bodies ``transform`` jits — with the fractional-minTF
+        bar computed from the raw document length the entries quadruple
+        carries."""
+        if self.vocabulary is None:
+            raise RuntimeError("set_model_data must be called before kernel_spec")
+        binary = self.get_binary()
+        min_tf = float(self.get_min_tf())
+        dim = len(self.vocabulary)
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        vn, idn, zn, ln = entries_names(in_col)
+        out_v, out_i, out_z = sparse_names(out_col)
+
+        def host_ingest(df, cap, cap_max, truncate):
+            rows, lengths = self._featurize(df.column(in_col))
+            return pack_entry_rows(
+                in_col, rows, lengths, cap=cap, cap_max=cap_max, truncate=truncate
+            )
+
+        def kernel_fn(model, cols):
+            import jax.numpy as jnp
+
+            values, ids, nnz = sparse_combine_fn(cols[vn], cols[idn], cols[zn])
+            if min_tf >= 1.0:
+                thr = jnp.full(nnz.shape, min_tf, jnp.float32)
+            else:
+                thr = (min_tf * cols[ln]).astype(jnp.float32)
+            values, ids, nnz = sparse_threshold_fn(values, ids, nnz, thr)
+            if binary:
+                values = jnp.minimum(values, 1.0)
+            return {out_v: values, out_i: ids, out_z: nnz}
+
+        return KernelSpec(
+            input_cols=(in_col,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+            input_kinds={in_col: "entries"},
+            host_ingests={in_col: host_ingest},
+            sparse_outputs={out_col: int(dim)},
+        )
 
     # model data = the ordered vocabulary
     def get_model_data(self):
@@ -125,6 +207,13 @@ class CountVectorizerModel(Model, _CvParams):
         model.load_param_map_from_json(metadata["paramMap"])
         model.vocabulary = [str(s) for s in rw.load_model_arrays(path)["vocabulary"]]
         return model
+
+    @classmethod
+    def load_servable(cls, path: str) -> "CountVectorizerModel":
+        """The fitted model is its own runtime-free replica (state = the
+        vocabulary) — published text pipelines load it directly on the
+        serving tier (docs/sparse.md)."""
+        return cls.load(path)
 
 
 class CountVectorizer(Estimator, _CvParams):

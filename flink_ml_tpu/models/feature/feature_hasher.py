@@ -12,10 +12,17 @@ import numpy as np
 
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
-from flink_ml_tpu.linalg.vectors import SparseVector
 from flink_ml_tpu.ops import hashing
+from flink_ml_tpu.ops.kernels import sparse_combine_fn, sparse_combine_kernel
 from flink_ml_tpu.params.param import IntParam, ParamValidators
 from flink_ml_tpu.params.shared import HasCategoricalCols, HasInputCols, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
+from flink_ml_tpu.servable.sparse import (
+    entries_names,
+    pack_entry_rows,
+    rebuild_sparse_column,
+    sparse_names,
+)
 
 __all__ = ["FeatureHasher"]
 
@@ -37,8 +44,14 @@ class FeatureHasher(Transformer, HasInputCols, HasOutputCol, HasCategoricalCols)
     def set_num_features(self, value: int):
         return self.set(self.NUM_FEATURES, value)
 
-    def transform(self, *inputs):
-        (df,) = inputs
+    def _featurize(self, df):
+        """Host half of the row hashing: every column's contribution as raw
+        (index, value) entries per row — numeric columns at the static
+        hash(colName) index with value x, categorical at hash("col=value")
+        with value 1.0, duplicates (collisions) preserved for the device
+        ``sparse_combine`` segment reduce, in column order (the reference's
+        accumulation order). Shared by ``transform`` and the fused spec's
+        host ingest (ref FeatureHasher.java:185-190)."""
         num_features = self.get_num_features()
         in_cols = list(self.get_input_cols())
         cat_cols = list(self.get_categorical_cols())
@@ -55,29 +68,72 @@ class FeatureHasher(Transformer, HasInputCols, HasOutputCol, HasCategoricalCols)
                 num_cols.append(name)
             else:
                 cat_cols.append(name)
-
         n = len(df)
-        vectors = []
         columns = {name: df.column(name) for name in in_cols}
+        num_idx = {name: _index(name, num_features) for name in num_cols}
+        rows = []
         for i in range(n):
-            feature = {}
+            entries = []
             for name in num_cols:
                 v = columns[name][i]
                 if v is None:
                     continue
-                idx = _index(name, num_features)
-                feature[idx] = feature.get(idx, 0.0) + float(v)
+                entries.append((num_idx[name], float(v)))
             for name in cat_cols:
                 v = columns[name][i]
                 if v is None:
                     continue
                 if isinstance(v, (bool, np.bool_)):
                     v = "true" if v else "false"  # Java String.valueOf(boolean)
-                idx = _index(f"{name}={v}", num_features)
-                feature[idx] = feature.get(idx, 0.0) + 1.0
-            indices = np.asarray(sorted(feature), np.int64)
-            values = np.asarray([feature[j] for j in indices], np.float64)
-            vectors.append(SparseVector(num_features, indices, values))
+                entries.append((_index(f"{name}={v}", num_features), 1.0))
+            rows.append(entries)
+        return rows, [len(r) for r in rows]
+
+    def transform(self, *inputs):
+        (df,) = inputs
+        num_features = self.get_num_features()
+        out_col = self.get_output_col()
+        rows, lengths = self._featurize(df)
+        arrays, _cap, _total = pack_entry_rows(out_col, rows, lengths)
+        vn, idn, zn, _ln = entries_names(out_col)
+        # Device segment reduce — the SAME ``sparse_combine`` body the fused
+        # sparse spec composes: sort by index, fold colliding contributions
+        # in column order, compact.
+        values, ids, nnz = sparse_combine_kernel()(arrays[vn], arrays[idn], arrays[zn])
+        vectors = rebuild_sparse_column(
+            num_features, np.asarray(values), np.asarray(ids), np.asarray(nnz)
+        )
         out = df.clone()
-        out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), vectors)
+        out.add_column(out_col, DataTypes.vector(BasicType.DOUBLE), vectors)
         return out
+
+    def sparse_kernel_spec(self, known):
+        """Sparse-convention spec (docs/sparse.md): the whole row hashes on
+        the host into raw entries (strings cannot run on device) under a
+        synthetic source column; the device kernel is the ``sparse_combine``
+        segment reduce ``transform`` jits. Output statically sparse."""
+        num_features = self.get_num_features()
+        out_col = self.get_output_col()
+        src = f"{out_col}!src"  # synthetic: the ingest reads the df directly
+        vn, idn, zn, _ln = entries_names(src)
+        out_v, out_i, out_z = sparse_names(out_col)
+
+        def host_ingest(df, cap, cap_max, truncate):
+            rows, lengths = self._featurize(df)
+            return pack_entry_rows(
+                src, rows, lengths, cap=cap, cap_max=cap_max, truncate=truncate
+            )
+
+        def kernel_fn(model, cols):
+            values, ids, nnz = sparse_combine_fn(cols[vn], cols[idn], cols[zn])
+            return {out_v: values, out_i: ids, out_z: nnz}
+
+        return KernelSpec(
+            input_cols=(src,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+            input_kinds={src: "entries"},
+            host_ingests={src: host_ingest},
+            sparse_outputs={out_col: int(num_features)},
+        )

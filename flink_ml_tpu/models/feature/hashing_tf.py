@@ -11,10 +11,17 @@ import numpy as np
 
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
-from flink_ml_tpu.linalg.vectors import SparseVector
 from flink_ml_tpu.ops import hashing
+from flink_ml_tpu.ops.kernels import sparse_combine_fn, sparse_combine_kernel
 from flink_ml_tpu.params.param import BoolParam, IntParam, ParamValidators
 from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
+from flink_ml_tpu.servable.sparse import (
+    entries_names,
+    pack_entry_rows,
+    rebuild_sparse_column,
+    sparse_names,
+)
 
 __all__ = ["HashingTF"]
 
@@ -61,20 +68,81 @@ class HashingTF(Transformer, HasInputCol, HasOutputCol):
     def set_num_features(self, value: int):
         return self.set(self.NUM_FEATURES, value)
 
+    def _featurize(self, col):
+        """Host half of the hashing trick: each row's terms hashed to raw
+        (index, 1.0) entries, duplicates preserved — the device
+        ``sparse_combine`` segment reduce turns them into sorted term counts.
+        Shared by ``transform`` and the fused spec's host ingest, so both
+        paths hash identically (ref HashingTF.java:137-138)."""
+        num_features = self.get_num_features()
+        rows = []
+        lengths = []
+        for terms in col:
+            rows.append(
+                [
+                    (hashing.non_negative_mod(_hash(term), num_features), 1.0)
+                    for term in terms
+                ]
+            )
+            lengths.append(len(terms))
+        return rows, lengths
+
     def transform(self, *inputs):
         (df,) = inputs
         num_features = self.get_num_features()
-        binary = self.get_binary()
-        col = df.column(self.get_input_col())
-        vectors = []
-        for terms in col:
-            counts = {}
-            for term in terms:
-                idx = hashing.non_negative_mod(_hash(term), num_features)
-                counts[idx] = 1 if (binary or idx not in counts) else counts[idx] + 1
-            indices = np.asarray(sorted(counts), np.int64)
-            values = np.asarray([counts[i] for i in indices], np.float64)
-            vectors.append(SparseVector(num_features, indices, values))
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        rows, lengths = self._featurize(df.column(in_col))
+        arrays, _cap, _total = pack_entry_rows(out_col, rows, lengths)
+        vn, idn, zn, _ln = entries_names(out_col)
+        # Device segment reduce — the SAME ``sparse_combine`` body the fused
+        # sparse spec composes: sort by term index, sum duplicate counts,
+        # compact. Counts are small integers, exact in f32, so this equals
+        # the reference's host dict counting bit for bit.
+        values, ids, nnz = sparse_combine_kernel()(
+            arrays[vn], arrays[idn], arrays[zn]
+        )
+        values = np.asarray(values)
+        if self.get_binary():
+            values = np.minimum(values, 1.0)
+        vectors = rebuild_sparse_column(num_features, values, np.asarray(ids), np.asarray(nnz))
         out = df.clone()
-        out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), vectors)
+        out.add_column(out_col, DataTypes.vector(BasicType.DOUBLE), vectors)
         return out
+
+    def sparse_kernel_spec(self, known):
+        """Sparse-convention spec (docs/sparse.md): the tokens column
+        featurizes on the host (``_featurize`` — string hashing cannot run on
+        device) into raw entries at a ladder cap; the device kernel is the
+        ``sparse_combine`` segment reduce ``transform`` jits. The output is
+        statically sparse — downstream specs (IDF, the logistic head) chain
+        on-device without ever materializing SparseVectors."""
+        num_features = self.get_num_features()
+        binary = self.get_binary()
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        vn, idn, zn, _ln = entries_names(in_col)
+        out_v, out_i, out_z = sparse_names(out_col)
+
+        def host_ingest(df, cap, cap_max, truncate):
+            rows, lengths = self._featurize(df.column(in_col))
+            arrays, used_cap, total = pack_entry_rows(
+                in_col, rows, lengths, cap=cap, cap_max=cap_max, truncate=truncate
+            )
+            return arrays, used_cap, total
+
+        def kernel_fn(model, cols):
+            values, ids, nnz = sparse_combine_fn(cols[vn], cols[idn], cols[zn])
+            if binary:
+                import jax.numpy as jnp
+
+                values = jnp.minimum(values, 1.0)
+            return {out_v: values, out_i: ids, out_z: nnz}
+
+        return KernelSpec(
+            input_cols=(in_col,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+            input_kinds={in_col: "entries"},
+            host_ingests={in_col: host_ingest},
+            sparse_outputs={out_col: int(num_features)},
+        )

@@ -14,10 +14,16 @@ from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.linalg.vectors import SparseVector, Vector
 from flink_ml_tpu.models.common import ModelArraysMixin
-from flink_ml_tpu.ops.kernels import idf_scale_fn, idf_scale_kernel
+from flink_ml_tpu.ops.kernels import (
+    idf_scale_fn,
+    idf_scale_kernel,
+    sparse_idf_scale_fn,
+    sparse_idf_scale_kernel,
+)
 from flink_ml_tpu.params.param import IntParam, ParamValidators, update_existing_params
 from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
 from flink_ml_tpu.servable.kernel_spec import KernelSpec
+from flink_ml_tpu.servable.sparse import sparse_names
 
 __all__ = ["IDF", "IDFModel"]
 
@@ -48,10 +54,23 @@ class IDFModel(ModelArraysMixin, Model, _IDFParams):
         self.doc_freq: Optional[np.ndarray] = None
         self.num_docs: Optional[np.ndarray] = None
 
+    @classmethod
+    def load_servable(cls, path: str) -> "IDFModel":
+        """The fitted model is its own runtime-free replica (state = the idf
+        vector; ``transform`` is one jitted kernel) — published text
+        pipelines load it directly on the serving tier (docs/sparse.md)."""
+        return cls.load(path)
+
     def transform(self, *inputs):
         (df,) = inputs
-        col = df.column(self.get_input_col())
+        in_col = self.get_input_col()
+        col = df.column(in_col)
         out = df.clone()
+        if len(df) == 0:
+            # An empty column normalizes to a shapeless (0,) array — nothing
+            # to scale, and the kernels cannot infer a width from it.
+            out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), [])
+            return out
         if isinstance(col, np.ndarray):
             vals = idf_scale_kernel()(col.astype(np.float64), self.idf)
             out.add_column(
@@ -59,6 +78,25 @@ class IDFModel(ModelArraysMixin, Model, _IDFParams):
                 DataTypes.vector(BasicType.DOUBLE),
                 np.asarray(vals, np.float64),
             )
+        elif df.is_sparse(in_col):
+            # Sparse path: one batched gather-scale kernel over the padded-CSR
+            # layout — the SAME ``sparse_idf_scale`` body the fused sparse
+            # spec composes, so the two paths agree bit for bit (per-entry
+            # f32 multiply, widened to the f64 storage dtype).
+            batch = df.sparse_batch(in_col)
+            vals = np.asarray(
+                sparse_idf_scale_kernel()(
+                    batch.values, batch.indices, np.asarray(self.idf, np.float32)
+                ),
+                np.float64,
+            )
+            new_col = []
+            for i, v in enumerate(col):
+                k = len(v.indices) if isinstance(v, SparseVector) else int(batch.nnz[i])
+                new_col.append(
+                    SparseVector(batch.dim, batch.indices[i, :k].astype(np.int64), vals[i, :k])
+                )
+            out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), new_col)
         else:
             new_col = [
                 SparseVector(v.size(), v.indices, v.values * self.idf[v.indices])
@@ -68,6 +106,41 @@ class IDFModel(ModelArraysMixin, Model, _IDFParams):
             ]
             out.add_column(self.get_output_col(), DataTypes.vector(BasicType.DOUBLE), new_col)
         return out
+
+    def sparse_kernel_spec(self, known):
+        """Sparse-convention spec (docs/sparse.md): when the input column is
+        statically known sparse, idf scaling fuses as a per-entry
+        gather-scale (``sparse_idf_scale_fn`` — the body the per-stage sparse
+        path jits), structure (ids/nnz) passing through unchanged. No
+        cross-entry accumulation, so the spec is elementwise and merges
+        bit-exactly; ``sparse_idf`` is in the megakernel vocabulary."""
+        if self.idf is None:
+            raise RuntimeError("set_model_data must be called before kernel_spec")
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        dim = int(len(self.idf))
+        if known.get(in_col) != dim:
+            return None  # not sparse here (or a dim-mismatched model): dense spec
+        in_v, in_i, in_z = sparse_names(in_col)
+        out_v, out_i, out_z = sparse_names(out_col)
+
+        def kernel_fn(model, cols):
+            return {
+                out_v: sparse_idf_scale_fn(cols[in_v], cols[in_i], model["idf"]),
+                out_i: cols[in_i],
+                out_z: cols[in_z],
+            }
+
+        return KernelSpec(
+            input_cols=(in_col,),
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={"idf": np.asarray(self.idf, np.float32)},
+            kernel_fn=kernel_fn,
+            input_kinds={in_col: "sparse"},
+            sparse_outputs={out_col: dim},
+            sparse_input_dims={in_col: dim},
+            elementwise=True,  # per-entry gather + multiply: no accumulation
+            fusion_op="sparse_idf",  # megakernel-safe
+        )
 
     def kernel_spec(self):
         """idf scaling as a fusable spec — ``idf_scale_fn``, the body

@@ -12,11 +12,21 @@ import numpy as np
 
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
-from flink_ml_tpu.ops.kernels import interaction_fn, interaction_kernel
+from flink_ml_tpu.ops.kernels import (
+    interaction_fn,
+    interaction_kernel,
+    sparse_interaction_fn,
+    sparse_interaction_kernel,
+)
 from flink_ml_tpu.params.shared import HasInputCols, HasOutputCol
 from flink_ml_tpu.servable.kernel_spec import KernelSpec
+from flink_ml_tpu.servable.sparse import rebuild_sparse_column, sparse_names
 
 __all__ = ["Interaction"]
+
+#: Sparse cross-product ids live in int32 on device — the product of the
+#: input dims must stay addressable.
+_MAX_SPARSE_DIM = 1 << 31
 
 
 class Interaction(Transformer, HasInputCols, HasOutputCol):
@@ -24,8 +34,13 @@ class Interaction(Transformer, HasInputCols, HasOutputCol):
 
     def transform(self, *inputs):
         (df,) = inputs
+        in_cols = list(self.get_input_cols())
+        if len(in_cols) >= 2 and all(df.is_sparse(name) for name in in_cols):
+            out = self._transform_sparse(df, in_cols)
+            if out is not None:
+                return out
         mats = []
-        for name in self.get_input_cols():
+        for name in in_cols:
             col = df.column(name)
             if isinstance(col, np.ndarray) and col.ndim == 2:
                 mats.append(col.astype(np.float64))
@@ -39,6 +54,75 @@ class Interaction(Transformer, HasInputCols, HasOutputCol):
             np.asarray(vals, np.float64),
         )
         return out
+
+    def _transform_sparse(self, df, in_cols):
+        """All-sparse inputs (the one-hot CTR shape) stay sparse: pairwise
+        device cross products through the SAME ``sparse_interaction`` body
+        the fused sparse spec composes — nnz multiplies instead of the dim
+        product the densified path would materialize (docs/sparse.md).
+        Returns None when the cross dim overflows int32 addressing (the
+        densified path would be equally infeasible, but fail the same way
+        as before)."""
+        batches = [df.sparse_batch(name) for name in in_cols]
+        total_dim = 1
+        for b in batches:
+            total_dim *= b.dim
+        if total_dim >= _MAX_SPARSE_DIM:
+            return None
+        acc = batches[0]
+        av, ai, az = acc.values, acc.indices, acc.nnz
+        dim = acc.dim
+        for b in batches[1:]:
+            av, ai, az = sparse_interaction_kernel(b.dim)(
+                av, ai, az, b.values, b.indices, b.nnz
+            )
+            dim *= b.dim
+        out = df.clone()
+        out.add_column(
+            self.get_output_col(),
+            DataTypes.vector(BasicType.DOUBLE),
+            rebuild_sparse_column(dim, np.asarray(av), np.asarray(ai), np.asarray(az)),
+        )
+        return out
+
+    def sparse_kernel_spec(self, known):
+        """Sparse-convention spec (docs/sparse.md): when every input column
+        is statically known sparse, the cross product folds pairwise through
+        ``sparse_interaction_fn`` (the body the per-stage sparse path jits),
+        output sparse at the product dim — the interior of the
+        one-hot→interaction→head CTR chain. Compaction sorts, so the spec is
+        a reduction spec, never elementwise."""
+        in_cols = tuple(self.get_input_cols() or ())
+        out_col = self.get_output_col()
+        if len(in_cols) < 2 or any(name not in known for name in in_cols):
+            return None
+        dims = [int(known[name]) for name in in_cols]
+        total_dim = 1
+        for d in dims:
+            total_dim *= d
+        if total_dim >= _MAX_SPARSE_DIM:
+            return None
+        out_v, out_i, out_z = sparse_names(out_col)
+
+        def kernel_fn(model, cols):
+            v0, i0, z0 = sparse_names(in_cols[0])
+            av, ai, az = cols[v0], cols[i0], cols[z0]
+            for name, d in zip(in_cols[1:], dims[1:]):
+                vn, idn, zn = sparse_names(name)
+                av, ai, az = sparse_interaction_fn(
+                    av, ai, az, cols[vn], cols[idn], cols[zn], d
+                )
+            return {out_v: av, out_i: ai, out_z: az}
+
+        return KernelSpec(
+            input_cols=in_cols,
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+            input_kinds={name: "sparse" for name in in_cols},
+            sparse_input_dims={name: d for name, d in zip(in_cols, dims)},
+            sparse_outputs={out_col: total_dim},
+        )
 
     def kernel_spec(self):
         """Cross-products as a fusable spec — ``interaction_fn``, the body

@@ -14,10 +14,12 @@ import numpy as np
 
 from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.types import BasicType, DataTypes
-from flink_ml_tpu.linalg.vectors import SparseVector
 from flink_ml_tpu.models.common import ModelArraysMixin
+from flink_ml_tpu.ops.kernels import onehot_encode_fn, onehot_encode_kernel
 from flink_ml_tpu.params.param import BoolParam, update_existing_params
 from flink_ml_tpu.params.shared import HasHandleInvalid, HasInputCols, HasOutputCols
+from flink_ml_tpu.servable.kernel_spec import KernelSpec
+from flink_ml_tpu.servable.sparse import rebuild_sparse_column, sparse_names
 
 __all__ = ["OneHotEncoder", "OneHotEncoderModel"]
 
@@ -41,9 +43,23 @@ class OneHotEncoderModel(ModelArraysMixin, Model, _OheParams):
         super().__init__()
         self.category_sizes: Optional[np.ndarray] = None  # num categories per column
 
+    @classmethod
+    def load_servable(cls, path: str) -> "OneHotEncoderModel":
+        """The fitted model is its own runtime-free replica (state = the
+        per-column category sizes) — published CTR pipelines load it directly
+        on the serving tier (docs/sparse.md)."""
+        return cls.load(path)
+
+    def _layout(self, i: int):
+        """(size, vec_len) of input column ``i`` under the current params —
+        the static category layout both paths encode against."""
+        handle = self.get_handle_invalid()
+        size = int(self.category_sizes[i]) + (1 if handle == "keep" else 0)
+        vec_len = size - 1 if self.get_drop_last() else size
+        return size, vec_len
+
     def transform(self, *inputs):
         (df,) = inputs
-        drop_last = self.get_drop_last()
         handle = self.get_handle_invalid()
         n = len(df)
         keep_mask = np.ones(n, bool)
@@ -51,29 +67,68 @@ class OneHotEncoderModel(ModelArraysMixin, Model, _OheParams):
         new_cols = []
         for i, name in enumerate(self.get_input_cols()):
             idx = df.scalars(name)
-            size = int(self.category_sizes[i]) + (1 if handle == "keep" else 0)
-            vec_len = size - 1 if drop_last else size
+            size, vec_len = self._layout(i)
             invalid = (idx < 0) | (idx != np.floor(idx)) | (idx >= size)
             if handle == "error" and invalid.any():
                 raise ValueError(
                     f"The input contains invalid index {idx[invalid][0]} for column {name}."
                 )
-            if handle == "keep":
-                idx = np.where(invalid, size - 1, idx)
-            else:
+            if handle != "keep":
                 keep_mask &= ~invalid
-            vectors = [
-                SparseVector(vec_len, np.asarray([], np.int64), np.asarray([]))
-                if int(j) >= vec_len
-                else SparseVector(vec_len, np.asarray([int(j)]), np.asarray([1.0]))
-                for j in idx
-            ]
-            new_cols.append(vectors)
+            # Device encode — the SAME ``onehot_encode`` body the fused
+            # sparse spec composes ('keep' maps invalid to the extra
+            # category; rows masked out under 'skip' drop below, so their
+            # encoded value is never observed).
+            values, ids, nnz = onehot_encode_kernel(size, vec_len)(
+                idx.astype(np.float32)
+            )
+            new_cols.append(
+                rebuild_sparse_column(
+                    vec_len, np.asarray(values), np.asarray(ids), np.asarray(nnz)
+                )
+            )
         for out_name, vectors in zip(self.get_output_cols(), new_cols):
             out.add_column(out_name, DataTypes.vector(BasicType.DOUBLE), vectors)
         if not keep_mask.all():
             out = out.take(np.nonzero(keep_mask)[0])
         return out
+
+    def sparse_kernel_spec(self, known):
+        """Sparse-convention spec (docs/sparse.md): each scalar index column
+        encodes on device as at-most-one sparse entry (``onehot_encode_fn``,
+        the body ``transform`` jits) — the head of the one-hot→interaction
+        CTR chain. Only ``handleInvalid='keep'`` fuses: 'error' must raise on
+        the host and 'skip' changes the row count."""
+        if self.category_sizes is None:
+            raise RuntimeError("set_model_data must be called before kernel_spec")
+        if self.get_handle_invalid() != "keep":
+            return None
+        in_cols = tuple(self.get_input_cols())
+        out_cols = tuple(self.get_output_cols())
+        layouts = [self._layout(i) for i in range(len(in_cols))]
+
+        def kernel_fn(model, cols):
+            outs = {}
+            for name, out_name, (size, vec_len) in zip(in_cols, out_cols, layouts):
+                ov, oi, oz = sparse_names(out_name)
+                values, ids, nnz = onehot_encode_fn(cols[name], size, vec_len)
+                outs[ov], outs[oi], outs[oz] = values, ids, nnz
+            return outs
+
+        return KernelSpec(
+            input_cols=in_cols,
+            outputs=tuple(
+                (name, DataTypes.vector(BasicType.DOUBLE)) for name in out_cols
+            ),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+            input_kinds={name: "scalar" for name in in_cols},
+            sparse_outputs={
+                out_name: vec_len
+                for out_name, (_size, vec_len) in zip(out_cols, layouts)
+            },
+            elementwise=True,  # compare + where per row: no accumulation
+        )
 
 
 class OneHotEncoder(Estimator, _OheParams):

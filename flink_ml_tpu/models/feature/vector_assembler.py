@@ -13,9 +13,15 @@ import numpy as np
 from flink_ml_tpu.api.core import Transformer
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.linalg.vectors import Vector
-from flink_ml_tpu.ops.kernels import assemble_fn, assemble_kernel
+from flink_ml_tpu.ops.kernels import (
+    assemble_fn,
+    assemble_kernel,
+    sparse_to_dense_fn,
+    sparse_to_dense_kernel,
+)
 from flink_ml_tpu.params.param import IntArrayParam, ParamValidators
 from flink_ml_tpu.params.shared import HasHandleInvalid, HasInputCols, HasOutputCol
+from flink_ml_tpu.servable.sparse import sparse_names
 from flink_ml_tpu.servable.kernel_spec import KernelSpec
 
 __all__ = ["VectorAssembler"]
@@ -70,6 +76,26 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol, HasHandleInvalid)
         for name, size in zip(in_cols, sizes):
             col = df.column(name)
             block = np.full((n, size), np.nan)
+            if df.is_sparse(name):
+                # Sparse input: densify on device through the SAME
+                # ``sparse_to_dense`` scatter the fused sparse spec composes
+                # (per-entry set, no accumulation — docs/sparse.md). A
+                # malformed column (None rows, dim mismatch) falls through
+                # to the per-row loop's invalid handling below.
+                try:
+                    batch = df.sparse_batch(name)
+                except (TypeError, ValueError):
+                    batch = None
+                if batch is not None and batch.dim == size:
+                    blocks.append(
+                        np.asarray(
+                            sparse_to_dense_kernel(size)(
+                                batch.values, batch.indices, batch.nnz
+                            ),
+                            np.float64,
+                        )
+                    )
+                    continue
             if isinstance(col, np.ndarray):
                 vals = col if col.ndim == 2 else col[:, None].astype(np.float64)
                 if vals.shape[1] != size:
@@ -153,4 +179,64 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol, HasHandleInvalid)
             kernel_fn=kernel_fn,
             input_kinds={n: "dense" for n in in_cols},
             elementwise=True,  # reshape + concat: no FP arithmetic at all
+        )
+
+    def sparse_kernel_spec(self, known):
+        """Sparse-convention spec (docs/sparse.md): input columns that arrive
+        sparse densify on device (``sparse_to_dense_fn`` — the per-entry
+        scatter ``transform``'s sparse branch jits) before the shared
+        ``assemble`` concat; dense inputs ingest as usual. The output is the
+        same dense vector the per-stage path emits, bit for bit. Requires
+        declared or known sizes for the sparse inputs ('keep' mode only,
+        like the dense spec)."""
+        in_cols = tuple(self.get_input_cols() or ())
+        if self.get_handle_invalid() != "keep" or not in_cols:
+            return None
+        if not any(name in known for name in in_cols):
+            return None  # nothing sparse here: the dense spec serves
+        declared = self.get_input_sizes()
+        sizes = [int(s) for s in declared] if declared is not None else [None] * len(in_cols)
+        if len(sizes) != len(in_cols):
+            return None
+        out_col = self.get_output_col()
+        bindings = []
+        sparse_dims = {}
+        for name, size in zip(in_cols, sizes):
+            if name in known:
+                dim = int(known[name])
+                if size is not None and size != dim:
+                    return None  # size-mismatched sparse input: per-stage path
+                bindings.append((name, dim, True))
+                sparse_dims[name] = dim
+            else:
+                bindings.append((name, size, False))
+
+        def kernel_fn(model, cols):
+            blocks = []
+            for name, size, is_sp in bindings:
+                if is_sp:
+                    vn, idn, zn = sparse_names(name)
+                    blocks.append(
+                        sparse_to_dense_fn(cols[vn], cols[idn], cols[zn], size)
+                    )
+                    continue
+                arr = cols[name]
+                if arr.ndim == 1:
+                    arr = arr[:, None]
+                if size is not None and arr.shape[1] != size:
+                    arr = jnp.full((arr.shape[0], size), jnp.nan, arr.dtype)
+                blocks.append(arr)
+            return {out_col: assemble_fn(*blocks)}
+
+        return KernelSpec(
+            input_cols=in_cols,
+            outputs=((out_col, DataTypes.vector(BasicType.DOUBLE)),),
+            model_arrays={},
+            kernel_fn=kernel_fn,
+            input_kinds={
+                name: ("sparse" if is_sp else "dense")
+                for name, _size, is_sp in bindings
+            },
+            sparse_input_dims=sparse_dims,
+            elementwise=True,  # scatter-set + reshape + concat: no accumulation
         )

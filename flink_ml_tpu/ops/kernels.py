@@ -59,6 +59,22 @@ __all__ = [
     "assemble_kernel",
     "idf_scale_fn",
     "idf_scale_kernel",
+    # sparse segment-reduce bodies (the ELL fast path, docs/sparse.md)
+    "segment_sum",
+    "sparse_dot_fn",
+    "sparse_idf_scale_fn",
+    "sparse_idf_scale_kernel",
+    "sparse_compact_fn",
+    "sparse_combine_fn",
+    "sparse_combine_kernel",
+    "sparse_threshold_fn",
+    "sparse_threshold_kernel",
+    "onehot_encode_fn",
+    "onehot_encode_kernel",
+    "sparse_to_dense_fn",
+    "sparse_to_dense_kernel",
+    "sparse_interaction_fn",
+    "sparse_interaction_kernel",
 ]
 
 
@@ -73,14 +89,50 @@ def dot_kernel():
     return kernel
 
 
+def segment_sum(terms):
+    """Row segment-sum of ``terms [n, K]`` as a strictly sequential left fold
+    over the slot axis (``lax.scan``) — THE reduction primitive of the sparse
+    calling convention (docs/sparse.md).
+
+    Why not ``jnp.sum``: XLA's row-sum strategy is *width-dependent* (measured
+    on XLA CPU: widths < 64 accumulate sequentially, ≥ 64 in blocks — bits
+    differ between K=32 and K=64 on the same real entries), so the same row
+    packed at two different nnz caps would produce different margins. A
+    sequential fold is width-invariant by construction: appending padding
+    slots (index 0 / value 0) appends exact identity adds, so a row's result
+    is bit-identical at EVERY cap on the nnz ladder — the property the
+    fused-vs-per-stage parity contract rests on. graftcheck's
+    elementwise-claim treats ``segment_sum`` as a reduction primitive: a
+    sparse spec composing it may never claim ``elementwise=True``.
+    """
+    import jax.lax as lax
+
+    def step(acc, t):
+        acc = acc + t
+        return acc, None
+
+    acc, _ = lax.scan(step, jnp.zeros_like(terms[:, 0]), terms.T)
+    return acc
+
+
+def sparse_dot_fn(values, indices, coef):
+    """Padded-CSR margins: gather-scale-segment-sum (the BLAS.java sparse-dot
+    branch, batched; padding slots are index 0 / value 0 and contribute
+    exact-identity adds under :func:`segment_sum`, so the margin is
+    bit-invariant to the nnz cap the batch happened to pack at)."""
+    return segment_sum(values * coef[indices])
+
+
 @functools.cache
 def sparse_dot_kernel():
-    """Padded-CSR margins: gather + row-sum (the BLAS.java sparse-dot branch,
-    batched; padding slots are index 0 / value 0 and contribute nothing)."""
+    """Jitted :func:`sparse_dot_fn` — one cache entry for every surface
+    (training-side transforms via ``compute_dots``, the LR servable's
+    per-stage sparse path, and the fused sparse specs compose the same
+    body)."""
 
     @jax.jit
     def kernel(indices, values, coef):
-        return jnp.sum(values * coef[indices], axis=1)
+        return sparse_dot_fn(values, indices, coef)
 
     return kernel
 
@@ -413,3 +465,172 @@ def idf_scale_fn(X, idf):
 def idf_scale_kernel():
     """Jitted ``idf_scale_fn``."""
     return jax.jit(idf_scale_fn)
+
+
+# ---------------------------------------------------------------------------
+# Sparse segment-reduce bodies — the ELL/padded-CSR fast path (docs/sparse.md).
+#
+# The sparse calling convention (servable/sparse.py) moves a ragged column
+# through compiled chains as three dense arrays: values [n, K] f32,
+# ids [n, K] i32, nnz [n] i32, with K a power-of-two nnz cap from the bucket
+# ladder and padding slots id 0 / value 0. The bodies below are the device
+# half of every sparse transformer: per-row duplicate-combine (a sorted-run
+# segment reduce), compaction, thresholding, one-hot encode, densify, outer
+# interaction, and the gather-scale-segment-sum margin. Per-stage transforms
+# jit the ``*_kernel`` factories; the fused specs compose the ``*_fn`` bodies
+# — one math, two paths, the kernel-spec-consistency contract.
+# ---------------------------------------------------------------------------
+
+
+def _valid_slots(shape_like, nnz):
+    """[n, K] mask of real (non-padding) entry slots: slot index < row nnz."""
+    return jnp.arange(shape_like.shape[1])[None, :] < nnz[:, None]
+
+
+def sparse_idf_scale_fn(values, ids, idf):
+    """Sparse term-frequency entries scaled by their dimension's idf —
+    gather + per-entry multiply, no accumulation (ref IDFModel.java sparse
+    branch). ids/nnz pass through unchanged: structure-preserving."""
+    return values * idf[ids]
+
+
+@functools.cache
+def sparse_idf_scale_kernel():
+    """Jitted ``sparse_idf_scale_fn`` — IDFModel's per-stage sparse path (the
+    fused sparse spec composes the same body)."""
+    return jax.jit(sparse_idf_scale_fn)
+
+
+def sparse_compact_fn(values, ids, keep):
+    """Compact the kept entries of each row to its leading slots, preserving
+    their relative (id-sorted) order, and zero the padding tail:
+    ``(values, ids, keep) -> (values, ids, nnz)``. The stable argsort on the
+    drop mask moves every kept entry forward without reordering kept-vs-kept
+    — the invariant every sparse column in the convention carries (real
+    entries first, sorted by id, then id-0/value-0 padding)."""
+    drop = (~keep).astype(jnp.int32)
+    order = jnp.argsort(drop, axis=1)  # jax sorts are stable
+    svals = jnp.take_along_axis(jnp.where(keep, values, 0.0), order, axis=1)
+    sids = jnp.take_along_axis(jnp.where(keep, ids, 0), order, axis=1)
+    nnz = jnp.sum(keep.astype(jnp.int32), axis=1)  # int sum: exact
+    return svals, sids, nnz
+
+
+def sparse_combine_fn(values, ids, nnz):
+    """Per-row duplicate-combine — THE segment-reduce kernel of the sparse
+    fast path: sort each row's entries by id (stable, padding last), sum the
+    values of equal-id runs with a strictly sequential in-run fold (slot
+    order — exactly the order the host dict accumulation of the per-stage
+    reference path applies, and exact for single-entry runs), keep one entry
+    per distinct id, compact. Used by HashingTF (term counts: values are
+    1.0s), CountVectorizer (vocabulary counts) and FeatureHasher (collision
+    accumulation)."""
+    import jax.lax as lax
+
+    valid = _valid_slots(ids, nnz)
+    skey = jnp.where(valid, ids, jnp.iinfo(jnp.int32).max)
+    order = jnp.argsort(skey, axis=1)  # stable: equal ids keep slot order
+    sids = jnp.take_along_axis(skey, order, axis=1)
+    svals = jnp.take_along_axis(jnp.where(valid, values, 0.0), order, axis=1)
+    svalid = jnp.take_along_axis(valid, order, axis=1)
+    # same[j]: slot j continues slot j-1's id run (padding never matches a
+    # real id — the sort key is INT32_MAX there).
+    prev = jnp.concatenate([jnp.full_like(sids[:, :1], -1), sids[:, :-1]], axis=1)
+    same = sids == prev
+    # Sequential run fold: acc restarts at each new id, so the run total
+    # lands at the run's LAST slot in exact slot order.
+    def step(acc, x):
+        v, s = x
+        acc = v + jnp.where(s, acc, 0.0)
+        return acc, acc
+
+    _, run = lax.scan(step, jnp.zeros_like(svals[:, 0]), (svals.T, same.T))
+    run = run.T
+    nxt = jnp.concatenate([same[:, 1:], jnp.zeros_like(same[:, :1])], axis=1)
+    last = svalid & ~nxt  # last slot of each real id run
+    return sparse_compact_fn(run, sids, last)
+
+
+@functools.cache
+def sparse_combine_kernel():
+    """Jitted ``sparse_combine_fn`` — the per-stage path of HashingTF /
+    CountVectorizer / FeatureHasher (their fused specs compose the body)."""
+    return jax.jit(sparse_combine_fn)
+
+
+def sparse_threshold_fn(values, ids, nnz, threshold):
+    """Drop entries whose value falls below the per-row ``threshold [n]``
+    (CountVectorizer's minTF filter), recompacting survivors."""
+    keep = _valid_slots(ids, nnz) & (values >= threshold[:, None])
+    return sparse_compact_fn(values, ids, keep)
+
+
+@functools.cache
+def sparse_threshold_kernel():
+    """Jitted ``sparse_threshold_fn``."""
+    return jax.jit(sparse_threshold_fn)
+
+
+def onehot_encode_fn(idx, size: int, vec_len: int):
+    """One scalar index column as sparse one-hot entries (ref
+    OneHotEncoderModel.java, handleInvalid='keep' semantics): invalid indices
+    (negative / fractional / ≥ size) map to the keep category ``size - 1``;
+    an index ≥ ``vec_len`` (the dropLast category) encodes as the empty row.
+    Purely elementwise — one entry slot per row."""
+    invalid = (idx < 0) | (idx != jnp.floor(idx)) | (idx >= size)
+    mapped = jnp.where(invalid, float(size - 1), idx)
+    hit = mapped < vec_len
+    ids = jnp.where(hit, mapped, 0.0).astype(jnp.int32)[:, None]
+    values = jnp.where(hit, 1.0, 0.0).astype(jnp.float32)[:, None]
+    nnz = hit.astype(jnp.int32)
+    return values, ids, nnz
+
+
+@functools.cache
+def onehot_encode_kernel(size: int, vec_len: int):
+    """Jitted ``onehot_encode_fn`` at a fixed category layout."""
+    return jax.jit(lambda idx: onehot_encode_fn(idx, size, vec_len))
+
+
+def sparse_to_dense_fn(values, ids, nnz, size: int):
+    """Scatter sparse entries into a dense [n, size] block (the
+    VectorAssembler densify). Entry ids are unique per row (the convention's
+    sorted-unique invariant), so the scatter is a pure per-entry ``set`` —
+    no accumulation; padding slots dump into a spare trailing column that is
+    sliced off."""
+    n = values.shape[0]
+    valid = _valid_slots(ids, nnz)
+    dump = jnp.where(valid, ids, size)
+    dense = jnp.zeros((n, size + 1), values.dtype)
+    dense = dense.at[jnp.arange(n)[:, None], dump].set(jnp.where(valid, values, 0.0))
+    return dense[:, :size]
+
+
+@functools.cache
+def sparse_to_dense_kernel(size: int):
+    """Jitted ``sparse_to_dense_fn`` at a fixed width."""
+    return jax.jit(lambda v, i, z: sparse_to_dense_fn(v, i, z, size))
+
+
+def sparse_interaction_fn(a_values, a_ids, a_nnz, b_values, b_ids, b_nnz, dim_b: int):
+    """Sparse × sparse outer interaction (ref Interaction.java on one-hot /
+    sparse inputs): out[id_a * dim_b + id_b] = v_a · v_b for every real entry
+    pair, compacted. Both inputs carry sorted-unique ids, so the flattened
+    (a-major) pair order is already id-sorted and the output keeps the
+    convention's invariant."""
+    n, ka = a_ids.shape
+    kb = b_ids.shape[1]
+    ids = (a_ids[:, :, None] * dim_b + b_ids[:, None, :]).reshape(n, ka * kb)
+    values = (a_values[:, :, None] * b_values[:, None, :]).reshape(n, ka * kb)
+    keep = (
+        _valid_slots(a_ids, a_nnz)[:, :, None] & _valid_slots(b_ids, b_nnz)[:, None, :]
+    ).reshape(n, ka * kb)
+    return sparse_compact_fn(values, ids, keep)
+
+
+@functools.cache
+def sparse_interaction_kernel(dim_b: int):
+    """Jitted ``sparse_interaction_fn`` at a fixed right-side width."""
+    return jax.jit(
+        lambda av, ai, an, bv, bi, bn: sparse_interaction_fn(av, ai, an, bv, bi, bn, dim_b)
+    )

@@ -91,15 +91,43 @@ ULP_ENVELOPE = {
     # (measured 0 ulps on XLA CPU at batch 64). Sized with tail headroom
     # like scale_logistic — saturated softmax tails amplify logit error.
     "scale_mlp": 16_384,
+    # Sparse IDF → logistic head (docs/sparse.md): the idf gather-scale fuses
+    # with the gather-scale-segment-sum margin. The margin fold is a
+    # sequential lax.scan — XLA cannot reassociate it — so the fused form
+    # measured 0 ulps at dims 8/64/256 and caps 1..64 on XLA CPU (interpret
+    # megakernel included); the bound carries the scale_logistic tail
+    # headroom because the contract is the envelope, not the measured order.
+    "sparse_idf_logistic": 32_768,
 }
 
 
-def spec_flops_per_row(spec: Any) -> float:
+#: Default FLOPs one entry slot pays in a sparse kernel (gather + multiply +
+#: segment-add + compaction bookkeeping) — the per-nnz analogue of the dense
+#: model-array estimate, override per spec via
+#: ``KernelSpec(sparse_flops_per_nnz=...)``.
+SPARSE_FLOPS_PER_NNZ = 8.0
+
+
+def spec_flops_per_row(spec: Any, nnz_cap: int = 0) -> float:
     """Estimated FLOPs one row pays in ``spec``'s kernel, from the stage
     shapes the spec already carries. A spec may pin the estimate exactly via
     ``KernelSpec(flops_per_row=...)``; otherwise 2-D model arrays count as
     matmul operands (2·size FLOPs/row — the dominant term for model heads)
-    and 1-D arrays as broadcast operands (1·size)."""
+    and 1-D arrays as broadcast operands (1·size).
+
+    Sparse specs (docs/sparse.md) are costed by what they TOUCH, not what
+    they address: a gather-scale-segment-sum over a 2^18-dim coefficient
+    reads ``nnz_cap`` entries per row, not 2^18 — so the per-row term is
+    ``sparse_flops_per_nnz × nnz_cap``, using the compile-time **cap** (the
+    padded ELL width) rather than the true nnz. The cap−nnz slack IS the
+    padding-waste term: a chain packed at a wasteful cap scores hotter only
+    because it genuinely computes the padding, keeping the score monotone in
+    the cap exactly as it is in rows and widths (SystemML's sparsity-aware
+    fusion costing, PAPERS.md)."""
+    if getattr(spec, "is_sparse", False):
+        declared = getattr(spec, "sparse_flops_per_nnz", None)
+        per_nnz = SPARSE_FLOPS_PER_NNZ if declared is None else float(declared)
+        return 8.0 + per_nnz * float(max(0, nnz_cap))
     declared = getattr(spec, "flops_per_row", None)
     if declared is not None:
         return float(declared)
@@ -110,14 +138,16 @@ def spec_flops_per_row(spec: Any) -> float:
     return total
 
 
-def chain_score(specs: Sequence[Any], rows: int, width: int = 0) -> float:
+def chain_score(specs: Sequence[Any], rows: int, width: int = 0, nnz_cap: int = 0) -> float:
     """Hotness of compiling ``specs`` as one chain at ``rows``: arithmetic
-    intensity per row × rows. ``width`` (the widest ingest column at compile
-    time) adds the elementwise traffic model-array sizes cannot see —
-    4 FLOPs/element/stage covers the load/op/store of a merged stage.
-    Monotone in ``rows``, ``width`` and every model-array size (the
-    shape-monotonicity tests pin this)."""
-    per_row = sum(spec_flops_per_row(s) for s in specs) + 4.0 * width * len(specs)
+    intensity per row × rows. ``width`` (the widest dense ingest column at
+    compile time) adds the elementwise traffic model-array sizes cannot see —
+    4 FLOPs/element/stage covers the load/op/store of a merged stage;
+    ``nnz_cap`` (the ELL ladder cap of a sparse chain's columns) feeds the
+    sparse specs' per-entry term. Monotone in ``rows``, ``width``,
+    ``nnz_cap`` and every model-array size (the shape-monotonicity tests pin
+    this)."""
+    per_row = sum(spec_flops_per_row(s, nnz_cap) for s in specs) + 4.0 * width * len(specs)
     return rows * per_row  # per_row is a host float: plain int × float math
 
 
@@ -149,13 +179,15 @@ class FusionTier:
         (``serving/server.py``) both compare it."""
         return (self.mode, self.megakernel, self.min_score)
 
-    def megakernel_hot(self, specs: Sequence[Any], rows: int, width: int = 0) -> bool:
+    def megakernel_hot(
+        self, specs: Sequence[Any], rows: int, width: int = 0, nnz_cap: int = 0
+    ) -> bool:
         """Whether the cost model marks this chain hot enough for the Pallas
         megakernel lowering at ``rows`` (fast mode only; the planner also
         requires every spec to carry a megakernel-safe ``fusion_op``)."""
         if not (self.fast and self.megakernel):
             return False
-        return chain_score(specs, rows, width) >= self.min_score
+        return chain_score(specs, rows, width, nnz_cap) >= self.min_score
 
     def __repr__(self) -> str:
         return (

@@ -19,8 +19,19 @@ describe itself as a :class:`KernelSpec`:
     path does *host* math for ragged (list) columns — a list column must fall
     back so fused and per-stage results agree.
 
-  Sparse columns are never ingested — they raise the planner's ineligibility
-  signal and the whole segment falls back to per-stage ``transform``.
+  * ``"sparse"`` — the column rides the sparse calling convention
+    (docs/sparse.md): it enters the program as the dense triple
+    ``col!values`` / ``col!ids`` / ``col!nnz`` packed at a power-of-two nnz
+    cap from the bucket ladder; ``kernel_fn`` reads and writes the expanded
+    names.
+  * ``"entries"`` — host-featurized raw entries (token hashing, vocabulary
+    lookup): the spec's ``host_ingests[col]`` callable builds the quadruple
+    (``!values``/``!ids``/``!nnz``/``!len``) on the host at ingest time;
+    the device kernel owns the segment reduce (duplicate combine).
+
+  A sparse column arriving where the spec expects a dense kind still raises
+  the planner's ineligibility signal and the whole segment falls back to
+  per-stage ``transform`` (reason-labelled in the fallback counters).
 - ``outputs`` — ``(column name, DataType)`` pairs the kernel produces, in the
   order ``transform`` would ``add_column`` them. A ``None`` DataType means
   "infer at readback" (scalar DOUBLE for 1-d results, vector(DOUBLE) for
@@ -69,9 +80,19 @@ from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
+from flink_ml_tpu.servable.sparse import entries_names, sparse_names
+
 __all__ = ["KernelSpec"]
 
-_VALID_KINDS = ("vector", "scalar", "dense")
+_VALID_KINDS = ("vector", "scalar", "dense", "sparse", "entries")
+
+#: Input kinds that ride the sparse calling convention (docs/sparse.md):
+#: ``"sparse"`` — a SparseVector column packed to the values/ids/nnz triple
+#: at a ladder nnz cap; ``"entries"`` — a host-featurized column (token
+#: hashing, vocabulary lookup) whose ``host_ingest`` callable produces the
+#: raw entries quadruple (values/ids/nnz/len, duplicates allowed, device
+#: combine pending).
+SPARSE_KINDS = ("sparse", "entries")
 
 
 class KernelSpec:
@@ -79,7 +100,8 @@ class KernelSpec:
 
     __slots__ = ("input_cols", "outputs", "model_arrays", "kernel_fn",
                  "input_kinds", "readback_dtypes", "elementwise",
-                 "fusable", "fusion_op", "flops_per_row")
+                 "fusable", "fusion_op", "flops_per_row", "sparse_outputs",
+                 "sparse_input_dims", "host_ingests", "sparse_flops_per_nnz")
 
     def __init__(
         self,
@@ -94,6 +116,10 @@ class KernelSpec:
         fusable: bool = True,
         fusion_op: Optional[str] = None,
         flops_per_row: Optional[float] = None,
+        sparse_outputs: Optional[Mapping[str, int]] = None,
+        sparse_input_dims: Optional[Mapping[str, int]] = None,
+        host_ingests: Optional[Mapping[str, Callable]] = None,
+        sparse_flops_per_nnz: Optional[float] = None,
     ):
         self.input_cols: Tuple[str, ...] = tuple(input_cols)
         self.outputs: Tuple[Tuple[str, Any], ...] = tuple(outputs)
@@ -110,19 +136,81 @@ class KernelSpec:
         self.readback_dtypes: Dict[str, Any] = {
             k: np.dtype(v) for k, v in (readback_dtypes or {}).items()
         }
+        #: Outputs in the sparse convention: column -> dimension (the
+        #: SparseVector size the readback rebuilds). The kernel_fn returns
+        #: the expanded values/ids/nnz names for these, not the column name.
+        self.sparse_outputs: Dict[str, int] = {
+            k: int(v) for k, v in (sparse_outputs or {}).items()
+        }
+        for name in self.sparse_outputs:
+            if name not in {n for n, _ in self.outputs}:
+                raise ValueError(f"sparse output {name!r} not in outputs")
+        #: Expected dimension per "sparse"-kind input column — the ingest
+        #: validates the packed batch against it (a dim mismatch must fall
+        #: back per-stage, where the reference path raises, never gather a
+        #: wrong-dim model array silently).
+        self.sparse_input_dims: Dict[str, int] = {
+            k: int(v) for k, v in (sparse_input_dims or {}).items()
+        }
+        #: Host featurizers for "entries"-kind inputs:
+        #: ``fn(df, cap, cap_max, truncate) -> (arrays, cap, nnz_total)`` —
+        #: runs on the ingest path (host hashing / vocabulary lookup), never
+        #: inside a program.
+        self.host_ingests: Dict[str, Callable] = dict(host_ingests or {})
+        for name, kind in self.input_kinds.items():
+            if kind == "entries" and name not in self.host_ingests:
+                raise ValueError(f"entries-kind column {name!r} needs a host_ingests entry")
         self.elementwise = bool(elementwise)
         self.fusable = bool(fusable)
         if fusion_op is not None and not isinstance(fusion_op, str):
             raise ValueError(f"fusion_op must be a string op id; got {fusion_op!r}")
         self.fusion_op = fusion_op
         self.flops_per_row = None if flops_per_row is None else float(flops_per_row)
+        #: Sparse cost-model input: FLOPs per real-or-padding entry slot
+        #: (``servable/fusion.py`` multiplies by the compile-time nnz cap —
+        #: the padding-waste term rides the cap, not the true nnz).
+        self.sparse_flops_per_nnz = (
+            None if sparse_flops_per_nnz is None else float(sparse_flops_per_nnz)
+        )
 
     @property
     def output_names(self) -> Tuple[str, ...]:
         return tuple(name for name, _ in self.outputs)
 
+    @property
+    def is_sparse(self) -> bool:
+        """Whether any input or output rides the sparse convention."""
+        return bool(self.sparse_outputs) or any(
+            k in SPARSE_KINDS for k in self.input_kinds.values()
+        )
+
     def input_kind(self, name: str) -> str:
         return self.input_kinds.get(name, "vector")
+
+    def program_input_names(self, col: str) -> Tuple[str, ...]:
+        """The program-level names one logical input column expands to:
+        the convention triple/quadruple for sparse kinds, the column itself
+        otherwise (docs/sparse.md)."""
+        kind = self.input_kind(col)
+        if kind == "sparse":
+            return sparse_names(col)
+        if kind == "entries":
+            return entries_names(col)
+        return (col,)
+
+    def program_output_names(self, col: str) -> Tuple[str, ...]:
+        """The program-level names one declared output expands to."""
+        if col in self.sparse_outputs:
+            return sparse_names(col)
+        return (col,)
+
+    @property
+    def program_outputs(self) -> Tuple[str, ...]:
+        """Every program-level output name, in declaration order."""
+        out: Tuple[str, ...] = ()
+        for name, _ in self.outputs:
+            out += self.program_output_names(name)
+        return out
 
     def readback_dtype(self, name: str) -> np.dtype:
         return self.readback_dtypes.get(name, np.dtype(np.float64))

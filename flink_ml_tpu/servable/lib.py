@@ -20,7 +20,7 @@ import numpy as np
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.ops.kernels import (
-    compute_dots,
+    dot_kernel,
     kmeans_assign_fn,
     kmeans_predict_kernel,
     logistic_from_dots_fn,
@@ -29,6 +29,8 @@ from flink_ml_tpu.ops.kernels import (
     mlp_predict_kernel,
     scale_fn,
     scale_kernel,
+    sparse_dot_fn,
+    sparse_dot_kernel,
 )
 from flink_ml_tpu.params.param import BoolParam
 from flink_ml_tpu.params.shared import (
@@ -42,6 +44,7 @@ from flink_ml_tpu.params.shared import (
 )
 from flink_ml_tpu.servable.api import ModelServable
 from flink_ml_tpu.servable.kernel_spec import KernelSpec
+from flink_ml_tpu.servable.sparse import pack_sparse_column, sparse_names
 
 __all__ = [
     "LogisticRegressionModelServable",
@@ -64,10 +67,27 @@ class LogisticRegressionModelServable(
         self.coefficient = None
 
     def transform(self, df: DataFrame) -> DataFrame:
-        """Ref transform:62 — prediction = dot ≥ 0, rawPrediction = [1−p, p]."""
+        """Ref transform:62 — prediction = dot ≥ 0, rawPrediction = [1−p, p].
+
+        Sparse features stay in the padded-CSR layout: margins come from the
+        ``sparse_dot`` gather-scale-segment-sum kernel — the same body the
+        fused sparse spec composes, and its sequential fold makes the margin
+        bit-invariant to the nnz cap the batch packed at (docs/sparse.md) —
+        so the per-stage and fused paths agree bit for bit. Dense features
+        take the matmul kernel, exactly ``compute_dots``'s split."""
         if self.coefficient is None:
             raise RuntimeError("set_model_data must be called before transform")
-        dots = compute_dots(df, self.get_features_col(), self.coefficient)
+        features_col = self.get_features_col()
+        coef = jnp.asarray(np.asarray(self.coefficient), jnp.float32)
+        if df.is_sparse(features_col):
+            arrays, _cap, _dim, _nnz = pack_sparse_column(
+                df, features_col, dim=int(coef.shape[0])
+            )
+            in_v, in_i, _ = sparse_names(features_col)
+            dots = sparse_dot_kernel()(arrays[in_i], arrays[in_v], coef)
+        else:
+            X = df.vectors(features_col).astype(np.float32)
+            dots = dot_kernel()(X, coef)
         pred, raw = logistic_from_dots_kernel()(dots)
         out = df.clone()
         out.add_column(self.get_prediction_col(), DataTypes.DOUBLE, np.asarray(pred, np.float64))
@@ -104,6 +124,42 @@ class LogisticRegressionModelServable(
             model_arrays={"coefficient": np.asarray(self.coefficient, np.float32)},
             kernel_fn=kernel_fn,
             fusion_op="logistic",  # dot + sigmoid head: megakernel-safe
+        )
+
+    def sparse_kernel_spec(self, known):
+        """Sparse-convention head (docs/sparse.md): when the features column
+        is statically known sparse, the margin is the gather-scale-segment-
+        sum ``sparse_dot_fn`` — the body ``transform``'s sparse path jits —
+        feeding the shared logistic head. ``segment_sum`` is a reduction:
+        the spec never claims elementwise, and chains end here."""
+        if self.coefficient is None:
+            raise RuntimeError("set_model_data must be called before kernel_spec")
+        features_col = self.get_features_col()
+        dim = int(np.asarray(self.coefficient).shape[0])
+        if known.get(features_col) != dim:
+            return None  # dense features (or wrong dim): the dense spec serves
+        in_v, in_i, _in_z = sparse_names(features_col)
+
+        def kernel_fn(model, cols):
+            pred, raw = logistic_from_dots_fn(
+                sparse_dot_fn(cols[in_v], cols[in_i], model["coefficient"])
+            )
+            return {
+                self.get_prediction_col(): pred,
+                self.get_raw_prediction_col(): raw,
+            }
+
+        return KernelSpec(
+            input_cols=(features_col,),
+            outputs=(
+                (self.get_prediction_col(), DataTypes.DOUBLE),
+                (self.get_raw_prediction_col(), DataTypes.vector(BasicType.DOUBLE)),
+            ),
+            model_arrays={"coefficient": np.asarray(self.coefficient, np.float32)},
+            kernel_fn=kernel_fn,
+            input_kinds={features_col: "sparse"},
+            sparse_input_dims={features_col: dim},
+            fusion_op="sparse_logistic",  # megakernel-safe sparse head
         )
 
 

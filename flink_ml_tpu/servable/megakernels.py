@@ -55,6 +55,10 @@ MEGAKERNEL_OPS = frozenset(
         "logistic",  # dot + logistic_from_dots_fn head
         "kmeans",  # distance pairwise + argmin assignment
         "mlp",  # mlp_predict_fn: matmul/relu layers + softmax head
+        # Sparse calling convention (docs/sparse.md) — row-local gathers and
+        # the sequential segment-sum fold both lower through Pallas:
+        "sparse_idf",  # sparse_idf_scale_fn: gather + per-entry multiply
+        "sparse_logistic",  # sparse_dot_fn segment-sum + logistic head
     }
 )
 
@@ -129,7 +133,9 @@ def build_megakernel_fn(
     model_items: List[Tuple[int, str]] = [
         (si, k) for si, m in enumerate(models) for k in sorted(m)
     ]
-    out_names: List[str] = [n for spec in specs for n, _ in spec.outputs]
+    # Program-level names: a sparse-convention output expands to its
+    # values/ids/nnz triple (the kernel body writes the expanded names).
+    out_names: List[str] = [n for spec in specs for n in spec.program_outputs]
 
     def chain(model_seq, cols):
         cols = dict(cols)

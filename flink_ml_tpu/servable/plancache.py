@@ -120,16 +120,20 @@ def program_digest(
     sharding_key: Optional[Tuple] = None,
     fusion_key: Optional[Tuple] = None,
     replicated: bool = False,
+    sparse_key: Optional[int] = None,
 ) -> str:
     """Content fingerprint of one chain program: the lowered StableHLO text
     (spec-chain params as traced constants, model-array shapes/dtypes as
     executable inputs, the input signature/bucket as argument shapes) plus
-    the mesh shape + TP split, the fusion tier + program kind, and the
-    jax/jaxlib/backend versions. Deterministic across processes — the
-    cross-incarnation cache identity (docs/plancache.md)."""
+    the mesh shape + TP split, the fusion tier + program kind, the sparse
+    nnz-cap ladder key (the ELL cap already shapes the lowered text — the
+    explicit component keeps two caps distinct even for a program whose
+    lowering happens not to read the padding), and the jax/jaxlib/backend
+    versions. Deterministic across processes — the cross-incarnation cache
+    identity (docs/plancache.md)."""
     h = sha256()
     h.update(json.dumps(_env_fingerprint(), sort_keys=True).encode())
-    h.update(repr((kind, sharding_key, fusion_key, bool(replicated))).encode())
+    h.update(repr((kind, sharding_key, fusion_key, bool(replicated), sparse_key)).encode())
     h.update(lowered.as_text().encode())
     return h.hexdigest()
 

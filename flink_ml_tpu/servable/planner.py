@@ -73,6 +73,13 @@ import numpy as np
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.servable.fusion import chain_score
+from flink_ml_tpu.servable.sparse import (
+    SPARSE_MARK,
+    OffLadderError,
+    pack_sparse_column,
+    rebuild_sparse_column,
+    sparse_names,
+)
 
 __all__ = [
     "IneligibleBatch",
@@ -91,8 +98,18 @@ PLAN_MEGAKERNEL = "megakernel"
 
 
 class IneligibleBatch(Exception):
-    """This batch cannot ride a fused executable (sparse/ragged input, or a
-    shape differing from the compiled signature) — fall back to per-stage."""
+    """This batch cannot ride a fused executable — fall back to per-stage.
+
+    ``reason`` labels the per-reason fallback counters
+    (``ml.<tier>.fastpath.fallback.<reason>``): ``"sparse"`` (a sparse column
+    where the spec expects a dense kind), ``"ragged"`` (list column / shape
+    the convention cannot take), ``"off_ladder"`` (nnz above
+    ``sparse.nnz.cap.max``, or a bucket off the mesh row ladder),
+    ``"signature"`` (shape/dim differing from the compiled signature)."""
+
+    def __init__(self, message: str, reason: str = "ragged"):
+        super().__init__(message)
+        self.reason = reason
 
 
 class _Program:
@@ -112,10 +129,11 @@ class _Program:
         needed: List[str] = []
         produced: set = set()
         for spec in self.specs:
-            for name in spec.input_cols:
-                if name not in produced and name not in needed:
-                    needed.append(name)
-            produced.update(spec.output_names)
+            for col in spec.input_cols:
+                for name in spec.program_input_names(col):
+                    if name not in produced and name not in needed:
+                        needed.append(name)
+            produced.update(spec.program_outputs)
         self.inputs: Tuple[str, ...] = tuple(needed)
 
         def program_fn(models, cols):
@@ -217,6 +235,7 @@ class FusedSegment:
     __slots__ = (
         "stages", "specs", "external_inputs", "device_models", "programs",
         "compiled", "signatures", "sharding", "fusion", "mega", "plan_kinds",
+        "sparse_outputs", "has_sparse_inputs",
     )
 
     def __init__(
@@ -232,11 +251,26 @@ class FusedSegment:
         produced: set = set()
         external: List[str] = []
         for spec in self.specs:
-            for name in spec.input_cols:
-                if name not in produced and name not in external:
-                    external.append(name)
-            produced.update(spec.output_names)
+            for col in spec.input_cols:
+                expanded = spec.program_input_names(col)
+                if all(n in produced for n in expanded):
+                    continue
+                if col not in external:
+                    external.append(col)
+            produced.update(spec.program_outputs)
         self.external_inputs: Tuple[str, ...] = tuple(external)
+        #: Sparse-convention outputs of the whole segment: column -> dim
+        #: (the readback rebuilds SparseVector columns from the triples).
+        self.sparse_outputs: Dict[str, int] = {}
+        for spec in self.specs:
+            self.sparse_outputs.update(spec.sparse_outputs)
+        #: Whether any external input rides the sparse convention — such
+        #: segments key their compiled chains by (bucket, nnz cap) and the
+        #: serving warmup covers the configured cap ladder.
+        self.has_sparse_inputs = any(
+            self.input_kind(name) in ("sparse", "entries")
+            for name in self.external_inputs
+        )
         # One upload per model array, at construction — the committed buffers
         # the hot path closes over. On a mesh this is the per-shard weight
         # placement (replicated or TP-split), paid at build/warmup time —
@@ -298,7 +332,11 @@ class FusedSegment:
         take."""
         try:
             if df.is_sparse(name):
-                raise IneligibleBatch(f"column {name!r} is sparse")
+                # A sparse column where this spec expects a dense kind: the
+                # sparse calling convention covers only declared-sparse specs
+                # (docs/sparse.md) — everything else keeps the bit-exact
+                # per-stage fallback, reason-labelled.
+                raise IneligibleBatch(f"column {name!r} is sparse", reason="sparse")
             kind = self.input_kind(name)
             if kind == "scalar":
                 arr = df.scalars(name)
@@ -318,6 +356,56 @@ class FusedSegment:
             raise
         except Exception as e:  # ragged / non-numeric / missing column
             raise IneligibleBatch(f"column {name!r} not fusable: {e}") from e
+
+    def gather_sparse(
+        self,
+        df: DataFrame,
+        name: str,
+        *,
+        cap: Optional[int] = None,
+        cap_max: Optional[int] = None,
+        truncate: bool = False,
+    ) -> Tuple[Dict[str, Any], int, int]:
+        """One host-side gather of a sparse-convention external input:
+        ``"sparse"`` columns pack through the ELL ladder
+        (``servable/sparse.py``), ``"entries"`` columns run the consuming
+        spec's host featurizer. Returns ``(arrays, nnz_cap, true_nnz)``.
+        Raises :class:`IneligibleBatch` (reason-labelled) for anything the
+        convention cannot take — off-ladder rows, dim mismatches, columns
+        that are not actually sparse."""
+        kind = self.input_kind(name)
+        try:
+            if kind == "entries":
+                for spec in self.specs:
+                    fn = spec.host_ingests.get(name)
+                    if fn is not None:
+                        return fn(df, cap, cap_max, truncate)
+                raise IneligibleBatch(f"no host ingest for column {name!r}")
+            if not df.is_sparse(name):
+                raise IneligibleBatch(
+                    f"column {name!r} is not sparse — compiled signature expects "
+                    "the sparse convention",
+                    reason="signature",
+                )
+            dim = None
+            for spec in self.specs:
+                if name in spec.sparse_input_dims:
+                    dim = spec.sparse_input_dims[name]
+                    break
+            arrays, used_cap, _dim, total = pack_sparse_column(
+                df, name, dim=dim, cap=cap, cap_max=cap_max, truncate=truncate
+            )
+            return arrays, used_cap, total
+        except IneligibleBatch:
+            raise
+        except OffLadderError as e:
+            raise IneligibleBatch(str(e), reason="off_ladder") from e
+        except ValueError as e:  # dim mismatch / malformed column
+            raise IneligibleBatch(
+                f"column {name!r} not packable: {e}", reason="signature"
+            ) from e
+        except Exception as e:
+            raise IneligibleBatch(f"column {name!r} not packable: {e}") from e
 
     @property
     def outputs(self) -> List[Tuple[str, Any]]:
@@ -340,11 +428,21 @@ class FusedSegment:
 
     def pending(self, outputs: Dict[str, Any]) -> List[Tuple[str, Any, Any, Any]]:
         """Readback-ready (name, declared DataType, device array, numpy dtype)
-        tuples for every declared stage output, in ``add_column`` order."""
+        tuples for every declared stage output, in ``add_column`` order. A
+        sparse-convention output expands to its three parts, the DataType
+        slot carrying the ``(SPARSE_MARK, column, dim, part)`` marker the
+        readback paths rebuild the SparseVector column from."""
         out = []
         for spec in self.specs:
             for name, dtype in spec.outputs:
-                out.append((name, dtype, outputs[name], spec.readback_dtype(name)))
+                if name in spec.sparse_outputs:
+                    dim = spec.sparse_outputs[name]
+                    vn, idn, zn = sparse_names(name)
+                    out.append((vn, (SPARSE_MARK, name, dim, "values"), outputs[vn], np.dtype(np.float64)))
+                    out.append((idn, (SPARSE_MARK, name, dim, "ids"), outputs[idn], np.dtype(np.int64)))
+                    out.append((zn, (SPARSE_MARK, name, dim, "nnz"), outputs[zn], np.dtype(np.int64)))
+                else:
+                    out.append((name, dtype, outputs[name], spec.readback_dtype(name)))
         return out
 
 
@@ -361,6 +459,7 @@ def build_segments(
     stages: Sequence[Any],
     sharding: Optional[Any] = None,
     fusion: Optional[Any] = None,
+    sparse: Optional[Dict[str, int]] = None,
 ) -> List[Any]:
     """Group consecutive kernel-spec stages into :class:`FusedSegment` runs,
     everything else into :class:`FallbackStage`. Raises whatever
@@ -371,18 +470,45 @@ def build_segments(
     commit their model arrays per shard and compile SPMD programs. With a
     fast ``fusion`` (:class:`~flink_ml_tpu.servable.fusion.FusionTier`),
     segments partition across reduction boundaries (module docstring);
-    ``None`` is the exact tier."""
+    ``None`` is the exact tier.
+
+    ``sparse`` enables the sparse calling convention (docs/sparse.md):
+    a ``{column: dim}`` map of inputs KNOWN to arrive sparse (the caller's
+    hints — the serving template, the batch call's DataFrame), or ``None``
+    when ``sparse.fastpath`` is off. Sparseness then propagates statically:
+    before asking each stage for a spec, the planner offers the known-sparse
+    set to the stage's ``sparse_kernel_spec(known)`` hook; a stage whose
+    inputs arrive sparse (or that featurizes ragged data — HashingTF,
+    CountVectorizer) returns a sparse-convention spec, and its
+    ``sparse_outputs`` join the known set for downstream stages. Stages
+    without the hook (or returning None) fall back to their dense
+    ``kernel_spec()``, exactly as before."""
     segments: List[Any] = []
     run: List[Tuple[Any, Any]] = []
+    known: Dict[str, int] = dict(sparse or {})
     for stage in stages:
-        spec = stage.kernel_spec() if hasattr(stage, "kernel_spec") else None
+        spec = None
+        if sparse is not None and hasattr(stage, "sparse_kernel_spec"):
+            spec = stage.sparse_kernel_spec(dict(known))
+        if spec is None and hasattr(stage, "kernel_spec"):
+            spec = stage.kernel_spec()
         if spec is not None:
             run.append((stage, spec))
+            known.update(spec.sparse_outputs)
+            for name in spec.output_names:
+                if name not in spec.sparse_outputs:
+                    known.pop(name, None)  # densely overwritten column
         else:
             if run:
                 segments.append(FusedSegment(run, sharding, fusion))
                 run = []
             segments.append(FallbackStage(stage))
+            # A fallback stage's outputs are opaque — any column it may
+            # overwrite stays whatever the DataFrame says at run time; the
+            # static known-set keeps only the caller's original hints for
+            # columns a spec never touched. (Conservative: a fallback stage
+            # that densifies a hinted column surfaces as a per-batch
+            # signature fallback, never a wrong result.)
     if run:
         segments.append(FusedSegment(run, sharding, fusion))
     return segments
@@ -403,6 +529,7 @@ def _load_or_compile(  # graftcheck: cold
     replicated: bool,
     cache: Optional[Any],
     on_cache: Optional[Callable[[str, float], None]],
+    sparse_key: Optional[int] = None,
 ) -> Any:
     """One program's executable: lower always (cheap — the tracing term),
     then load the serialized executable from the plan cache by its content
@@ -420,6 +547,7 @@ def _load_or_compile(  # graftcheck: cold
         sharding_key=segment.sharding.key if segment.sharding is not None else None,
         fusion_key=segment.fusion.key if segment.fusion is not None else None,
         replicated=replicated,
+        sparse_key=sparse_key,
     )
     t0 = time.perf_counter()
     compiled = cache.load(digest)
@@ -487,8 +615,22 @@ def run_segment(
         if on_compile is not None:
             on_compile()
         rows = next(iter(inputs.values())).shape[0] if inputs else 0
+        # Expanded sparse-convention names carry a `!` — their [n, K] shapes
+        # feed the cost model's nnz-cap term, not the dense ingest width.
         width = max(
-            (int(a.shape[1]) for a in inputs.values() if getattr(a, "ndim", 1) == 2),
+            (
+                int(a.shape[1])
+                for name, a in inputs.items()
+                if getattr(a, "ndim", 1) == 2 and "!" not in name
+            ),
+            default=0,
+        )
+        nnz_cap = max(
+            (
+                int(a.shape[1])
+                for name, a in inputs.items()
+                if name.endswith("!ids") and getattr(a, "ndim", 1) == 2
+            ),
             default=0,
         )
         if segment.sharding is not None and not replicated:
@@ -504,7 +646,7 @@ def run_segment(
             prog = xla_prog
             mega = segment.mega.get(idx)
             if mega is not None and segment.fusion.megakernel_hot(
-                prog.specs, rows, width
+                prog.specs, rows, width, nnz_cap
             ):
                 prog = mega
             stage_inputs = {n: cols[n] for n in prog.inputs}
@@ -514,7 +656,8 @@ def run_segment(
             }
             try:
                 compiled = _load_or_compile(
-                    prog, structs, segment, replicated, cache, on_cache
+                    prog, structs, segment, replicated, cache, on_cache,
+                    sparse_key=nnz_cap or None,
                 )
             except Exception:
                 if prog is xla_prog:
@@ -525,10 +668,11 @@ def run_segment(
                 # the same chain inside the same ulp envelope.
                 prog = xla_prog
                 compiled = _load_or_compile(
-                    prog, structs, segment, replicated, cache, on_cache
+                    prog, structs, segment, replicated, cache, on_cache,
+                    sparse_key=nnz_cap or None,
                 )
             if on_plan is not None:
-                on_plan(prog.kind, chain_score(prog.specs, rows, width))
+                on_plan(prog.kind, chain_score(prog.specs, rows, width, nnz_cap))
             kinds.append(prog.kind)
             chain.append((prog, compiled))
             cols.update(compiled(prog.models, stage_inputs))
@@ -564,8 +708,26 @@ class PlanExecution:
         if not self._pending:
             return self._df
         out = self._df.clone()
+        sparse_parts: Dict[str, Dict[str, Any]] = {}
         for name, dtype, arr, np_dtype in self._pending:
             host = np.asarray(arr, np_dtype)
+            if isinstance(dtype, tuple) and dtype and dtype[0] == SPARSE_MARK:
+                # One part of a sparse-convention output: rebuild the
+                # SparseVector column once all three have arrived — the
+                # parts are adjacent in pending order, so insertion order
+                # matches the per-stage path's add_column order.
+                _mark, col, dim, part = dtype
+                parts = sparse_parts.setdefault(col, {})
+                parts[part] = host
+                if len(parts) == 3:
+                    out.add_column(
+                        col,
+                        DataTypes.vector(BasicType.DOUBLE),
+                        rebuild_sparse_column(
+                            dim, parts["values"], parts["ids"], parts["nnz"]
+                        ),
+                    )
+                continue
             if dtype is None:  # shape-following output: infer like transform would
                 dtype = (
                     DataTypes.vector(BasicType.DOUBLE)

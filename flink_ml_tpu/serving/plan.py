@@ -60,6 +60,7 @@ from flink_ml_tpu.servable.planner import (
     build_segments,
     run_segment,
 )
+from flink_ml_tpu.servable.sparse import resolve_nnz_cap_max, resolve_warm_caps
 from flink_ml_tpu.serving.batcher import pad_to
 from flink_ml_tpu.trace import CAT_COMPILE, CAT_SWAP, tracer
 
@@ -83,12 +84,17 @@ class CompiledServingPlan:
         scope: str,
         sharding: Optional[Any] = None,
         fusion: Optional[Any] = None,
+        sparse: Optional[Dict[str, int]] = None,
     ):
         self._stages = list(stages)
         self.segments = segments
         self.scope = scope
         self.sharding = sharding
         self.fusion = fusion if fusion is not None else resolve_fusion_tier()
+        #: The sparse hints the segments were built under (None = convention
+        #: off) — part of the server's rebuild key, like the mesh and the
+        #: fusion tier: a template whose sparseness differs must rebuild.
+        self.sparse_hints = sparse
         # Persistent compiled-plan cache (docs/plancache.md): None unless
         # plancache.dir is configured. Resolved at build time like the mesh
         # and the fusion tier — warmup/swap/rollback then load serialized
@@ -116,6 +122,7 @@ class CompiledServingPlan:
         scope: str = "ml.serving[plan]",
         sharding: Optional[Any] = None,
         fusion: Optional[Any] = None,
+        sparse: Optional[Dict[str, int]] = None,
     ) -> Optional["CompiledServingPlan"]:
         """Group the servable's consecutive kernel-spec stages into fused
         segments. Raises whatever ``kernel_spec()`` raises (an unloaded model
@@ -141,10 +148,10 @@ class CompiledServingPlan:
         )
         if fusion is None:
             fusion = resolve_fusion_tier()
-        segments = build_segments(stages, sharding, fusion)
+        segments = build_segments(stages, sharding, fusion, sparse)
         if not any(isinstance(s, FusedSegment) for s in segments):
             return None
-        return CompiledServingPlan(stages, segments, scope, sharding, fusion)
+        return CompiledServingPlan(stages, segments, scope, sharding, fusion, sparse)
 
     # -- warmup / AOT ---------------------------------------------------------
     def warmup(self, template: DataFrame, buckets: Sequence[int]) -> None:
@@ -160,62 +167,81 @@ class CompiledServingPlan:
         count cache loads as compile seconds (docs/plancache.md)."""
         t0 = time.perf_counter()
         totals = {"hits": 0, "misses": 0, "load_ms": 0.0}
+        # Sparse segments key executables by (bucket, nnz cap): warm the
+        # configured cap ladder per bucket so zero-post-warmup-compiles
+        # holds for every on-ladder batch, not just the template's cap.
+        warm_caps: Tuple[Optional[int], ...] = (None,)
+        if any(
+            isinstance(s, FusedSegment) and s.has_sparse_inputs for s in self.segments
+        ):
+            warm_caps = resolve_warm_caps()
         for bucket in buckets:
-            with tracer.span("serving.plan.warmup", CAT_COMPILE, scope=self.scope) as sp:
-                sp.set_attr("bucket", bucket)
-                sp.set_attr("fusion", self.fusion.mode)
-                if self.sharding is not None:
-                    sp.set_attr("shards", self.sharding.n_data)
-                bucket_cache = {"hits": 0, "misses": 0}
+            for cap in warm_caps:
+                with tracer.span("serving.plan.warmup", CAT_COMPILE, scope=self.scope) as sp:
+                    sp.set_attr("bucket", bucket)
+                    sp.set_attr("fusion", self.fusion.mode)
+                    if cap is not None:
+                        sp.set_attr("nnz_cap", cap)
+                    if self.sharding is not None:
+                        sp.set_attr("shards", self.sharding.n_data)
+                    bucket_cache = {"hits": 0, "misses": 0}
 
-                def on_cache(outcome: str, ms: float, _b=bucket_cache) -> None:
-                    _b["hits" if outcome == "hit" else "misses"] += 1
-                    totals["hits" if outcome == "hit" else "misses"] += 1
-                    if outcome == "hit":
-                        totals["load_ms"] += ms
+                    def on_cache(outcome: str, ms: float, _b=bucket_cache) -> None:
+                        _b["hits" if outcome == "hit" else "misses"] += 1
+                        totals["hits" if outcome == "hit" else "misses"] += 1
+                        if outcome == "hit":
+                            totals["load_ms"] += ms
 
-                df = pad_to(template, bucket)
-                for segment in self.segments:
-                    if isinstance(segment, FallbackStage):
-                        df = segment.stage.transform(df)
-                        continue
-                    try:
-                        inputs = self._ingest(segment, df, bucket)
-                    except IneligibleBatch:
-                        # e.g. a sparse features template: this segment will serve
-                        # through the per-stage path (as dispatch falls back), so
-                        # warm the stages' own jit kernels instead of compiling a
-                        # fused chain the traffic can never hit.
-                        for stage in segment.stages:
-                            df = stage.transform(df)
-                        continue
-                    outputs = run_segment(
-                        segment,
-                        bucket,
-                        inputs,
-                        on_plan=self._on_plan,
-                        cache=self.plancache,
-                        on_cache=on_cache if self.plancache is not None else None,
-                    )
-                    # The cost model's per-bucket choice (may be "fast+mega")
-                    # — goodput attribution splits compile time by tier.
-                    sp.set_attr("fusion", segment.plan_label(bucket))
-                    df = self._materialize(df, segment.pending(outputs))
-                if self.plancache is not None:
-                    sp.set_attr(
-                        "plancache",
-                        f"{bucket_cache['hits']}h/{bucket_cache['misses']}m",
-                    )
-                    if (
-                        bucket_cache["hits"]
-                        and not bucket_cache["misses"]
-                        and hasattr(sp, "category")  # tracing-off: _NoopSpan
-                    ):
-                        # Every chain program of this bucket loaded from disk:
-                        # the span's time is version-lifecycle work, not XLA
-                        # compilation — keep the compile goodput category
-                        # honest for the zero-compile-resume story.
-                        sp.category = CAT_SWAP
+                    df = pad_to(template, bucket)
+                    for segment in self.segments:
+                        if isinstance(segment, FallbackStage):
+                            df = segment.stage.transform(df)
+                            continue
+                        try:
+                            inputs, key, _cap, _nnz = self._ingest(
+                                segment,
+                                df,
+                                bucket,
+                                cap=cap if segment.has_sparse_inputs else None,
+                                warm=True,
+                            )
+                        except IneligibleBatch:
+                            # e.g. a sparse features template where the spec
+                            # expects dense: this segment will serve through
+                            # the per-stage path (as dispatch falls back), so
+                            # warm the stages' own jit kernels instead of
+                            # compiling a fused chain the traffic can never hit.
+                            for stage in segment.stages:
+                                df = stage.transform(df)
+                            continue
+                        outputs = run_segment(
+                            segment,
+                            key,
+                            inputs,
+                            on_plan=self._on_plan,
+                            cache=self.plancache,
+                            on_cache=on_cache if self.plancache is not None else None,
+                        )
+                        # The cost model's per-bucket choice (may be
+                        # "fast+mega") — goodput attribution splits compile
+                        # time by tier.
+                        sp.set_attr("fusion", segment.plan_label(key))
+                        df = self._materialize(df, segment.pending(outputs))
+                    if self.plancache is not None:
+                        sp.set_attr(
+                            "plancache",
+                            f"{bucket_cache['hits']}h/{bucket_cache['misses']}m",
+                        )
+                        if (
+                            bucket_cache["hits"]
+                            and not bucket_cache["misses"]
+                            and hasattr(sp, "category")  # tracing-off: _NoopSpan
+                        ):
+                            # Every chain program of this bucket loaded from
+                            # disk: the span's time is version-lifecycle work,
+                            # not XLA compilation — keep the compile goodput
+                            # category honest for the zero-compile-resume story.
+                            sp.category = CAT_SWAP
         wall_ms = (time.perf_counter() - t0) * 1000.0
         cache_ms = totals["load_ms"]
         metrics.gauge(
@@ -233,14 +259,14 @@ class CompiledServingPlan:
                 "load_ms": round(cache_ms, 3),
             }
 
-    def _run_segment(self, segment: FusedSegment, bucket: int, inputs: Dict[str, Any]):
+    def _run_segment(self, segment: FusedSegment, key: Any, inputs: Dict[str, Any]):
         """Hot-path execution: compiling here means warmup coverage was wrong
         — the ``ml.serving.fastpath.compiles`` alarm counts it. The plan
         cache rides along so even that uncovered bucket builds from a
         serialized executable when a previous incarnation compiled it."""
         return run_segment(
             segment,
-            bucket,
+            key,
             inputs,
             on_compile=lambda: metrics.counter(
                 self.scope, MLMetrics.SERVING_FASTPATH_COMPILES
@@ -250,10 +276,23 @@ class CompiledServingPlan:
         )
 
     # -- the hot path ---------------------------------------------------------
-    def _ingest(self, segment: FusedSegment, df: DataFrame, bucket: int) -> Dict[str, np.ndarray]:
+    def _ingest(
+        self,
+        segment: FusedSegment,
+        df: DataFrame,
+        bucket: int,
+        cap: Optional[int] = None,
+        warm: bool = False,
+    ) -> Tuple[Dict[str, np.ndarray], Any, int, int]:
         """One host-side gather of the segment's input columns, exactly the
-        way each stage's ``transform`` would read them (dense f32), checked
-        against the bucket's compiled signature."""
+        way each stage's ``transform`` would read them (dense f32; sparse
+        columns as the convention triple on the nnz-cap ladder), checked
+        against the compiled signature. Returns ``(inputs, key, nnz_cap,
+        true_nnz)`` — the key is the padded bucket, extended with the shared
+        nnz cap when the segment has sparse inputs, so the executable set is
+        ≤ 1 per (bucket, cap) rung. ``cap`` forces the rung (warmup walks the
+        configured ladder; ``warm`` packs shape-only, truncating rows a small
+        rung cannot hold)."""
         if self.sharding is not None and bucket % self.sharding.row_multiple:
             # A bucket off the mesh ladder cannot shard bit-exactly (local
             # shapes would gain remainder rows) — only reachable when a
@@ -261,18 +300,43 @@ class CompiledServingPlan:
             # rather than serve different bits.
             raise IneligibleBatch(
                 f"bucket {bucket} not a multiple of the sharded bucket "
-                f"quantum {self.sharding.row_multiple}"
+                f"quantum {self.sharding.row_multiple}",
+                reason="off_ladder",
             )
         inputs: Dict[str, np.ndarray] = {}
-        signature = segment.signatures.get(bucket)
+        sparse_packed: Dict[str, Dict[str, np.ndarray]] = {}
+        shared_cap = cap if cap is not None else 0  # forced rung is an int
+        true_nnz = 0
+        cap_max = resolve_nnz_cap_max()
         for name in segment.external_inputs:
-            arr = segment.gather(df, name)
-            if signature is not None and (tuple(arr.shape), arr.dtype) != signature[name]:
-                raise IneligibleBatch(
-                    f"column {name!r} shape {arr.shape} != compiled {signature[name]}"
+            if segment.input_kind(name) in ("sparse", "entries"):
+                arrays, col_cap, col_nnz = segment.gather_sparse(
+                    df, name, cap=cap, cap_max=cap_max, truncate=warm
                 )
-            inputs[name] = arr
-        return inputs
+                sparse_packed[name] = arrays
+                shared_cap = max(shared_cap, col_cap)
+                true_nnz += col_nnz
+            else:
+                inputs[name] = segment.gather(df, name)
+        for arrays in sparse_packed.values():
+            for pname, arr in arrays.items():
+                if arr.ndim == 2 and arr.shape[1] < shared_cap:
+                    # All sparse columns of one batch share the widest rung
+                    # (one key per batch, the warmed set stays one-per-rung);
+                    # the extra slots are id-0/value-0 padding — exact
+                    # identity terms under segment_sum.
+                    arr = np.pad(arr, ((0, 0), (0, shared_cap - arr.shape[1])))
+                inputs[pname] = arr
+        key: Any = (bucket, shared_cap) if sparse_packed else bucket
+        signature = segment.signatures.get(key)
+        if signature is not None:
+            for name, arr in inputs.items():
+                if (tuple(arr.shape), arr.dtype) != signature[name]:
+                    raise IneligibleBatch(
+                        f"column {name!r} shape {arr.shape} != compiled {signature[name]}",
+                        reason="signature",
+                    )
+        return inputs, key, shared_cap, true_nnz
 
     @staticmethod
     def _materialize(df: DataFrame, pending: List[Tuple[str, Any, Any, Any]]) -> DataFrame:
@@ -291,6 +355,9 @@ class CompiledServingPlan:
         fused_ran = False
         for segment in self.segments:
             if isinstance(segment, FallbackStage):
+                metrics.counter(
+                    self.scope, MLMetrics.fallback_reason("serving", "specless")
+                )
                 df = self._materialize(df, pending)
                 pending = []
                 df = segment.stage.transform(df)
@@ -298,15 +365,38 @@ class CompiledServingPlan:
             # Consecutive fused stages share a segment, so entering a fused
             # segment always finds pending drained by a fallback stage.
             try:
-                inputs = self._ingest(segment, df, bucket)
-            except IneligibleBatch:
+                inputs, key, nnz_cap, true_nnz = self._ingest(segment, df, bucket)
+            except IneligibleBatch as e:
                 metrics.counter(self.scope, MLMetrics.SERVING_FALLBACK_BATCHES)
+                metrics.counter(
+                    self.scope, MLMetrics.fallback_reason("serving", e.reason)
+                )
                 df = self._materialize(df, pending)
                 pending = []
                 for stage in segment.stages:
                     df = stage.transform(df)
                 continue
-            outputs = self._run_segment(segment, bucket, inputs)
+            if nnz_cap:
+                # ELL padding attribution: the enclosing dispatch/exec span
+                # (the batcher's, carrying rows/bucket) learns the cap and
+                # the true entries of the TRUE rows (pad rows repeat row 0 —
+                # their entries are padding work, not carried work) —
+                # graftscope's padding split then counts every padded cell
+                # exactly once (docs/observability.md).
+                sp = tracer.current()
+                if sp is not None:
+                    rows_attr = sp.attrs.get("rows") if sp.attrs else None
+                    if isinstance(rows_attr, int) and 0 < rows_attr < bucket:
+                        true_nnz = int(
+                            sum(
+                                int(arr[:rows_attr].sum())
+                                for pname, arr in inputs.items()
+                                if pname.endswith("!nnz")
+                            )
+                        )
+                    sp.set_attr("nnz", true_nnz)
+                    sp.set_attr("nnz_cap", nnz_cap)
+            outputs = self._run_segment(segment, key, inputs)
             pending = segment.pending(outputs)
             fused_ran = True
         if fused_ran:

@@ -35,6 +35,7 @@ from flink_ml_tpu.serving.plan import CompiledServingPlan
 from flink_ml_tpu.serving.registry import ModelRegistry, ModelVersionPoller
 from flink_ml_tpu.servable.fusion import resolve_fusion_tier
 from flink_ml_tpu.servable.sharding import resolve_plan_sharding
+from flink_ml_tpu.servable.sparse import resolve_sparse_hints
 from flink_ml_tpu.trace import CAT_COMPILE, CAT_PRODUCTIVE, CAT_SWAP, tracer
 
 __all__ = ["ServingConfig", "ServingResponse", "InferenceServer"]
@@ -297,6 +298,13 @@ class InferenceServer:
         ``ml.serving.fastpath.compiles``."""
         if not self.config.fastpath:
             return None
+        # Sparse hints from the warmup template (docs/sparse.md): columns the
+        # template shows sparse build sparse-convention segments; a template
+        # whose sparseness differs from the cached plan's is a rebuild key,
+        # like the mesh and the fusion tier.
+        with self._template_lock:
+            template = self._warmup_template
+        sparse_hints = resolve_sparse_hints(template)
         plan = getattr(servable, "_fastpath_plan", _PLAN_UNSET)
         if plan is _PLAN_UNSET or (
             # A plan compiled under a different placement (the same servable
@@ -311,10 +319,15 @@ class InferenceServer:
                 getattr(plan.sharding, "key", None)
                 != (self._sharding.key if self._sharding is not None else None)
                 or getattr(plan.fusion, "key", None) != self._fusion.key
+                or getattr(plan, "sparse_hints", None) != sparse_hints
             )
         ):
             plan = CompiledServingPlan.build(
-                servable, scope=self.scope, sharding=self._sharding, fusion=self._fusion
+                servable,
+                scope=self.scope,
+                sharding=self._sharding,
+                fusion=self._fusion,
+                sparse=sparse_hints,
             )
             try:
                 servable._fastpath_plan = plan

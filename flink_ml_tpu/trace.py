@@ -387,7 +387,30 @@ class GoodputReport:
 def _padding_share(span: Span) -> float:
     """Fraction of a span's self time attributed to bucket padding: spans
     carrying ``rows``/``bucket`` attrs executed a padded batch, and
-    ``(bucket - rows) / bucket`` of their work fed pad rows."""
+    ``(bucket - rows) / bucket`` of their work fed pad rows.
+
+    Spans that additionally carry ``nnz``/``nnz_cap`` attrs executed a
+    sparse-convention batch (docs/sparse.md): the program computed
+    ``bucket × nnz_cap`` entry cells of which only ``nnz`` (the true
+    entries of the true rows) were real. That single ratio covers BOTH the
+    row round-up and the ELL slot padding, and REPLACES the rows/bucket
+    split for such spans — each padded cell is counted exactly once, the
+    same discipline as the PR 9 DP round-up accounting."""
+    attrs = span.attrs or {}
+    nnz = attrs.get("nnz")
+    cap = attrs.get("nnz_cap")
+    bucket = attrs.get("bucket")
+    if (
+        isinstance(nnz, int)
+        and isinstance(cap, int)
+        and isinstance(bucket, int)
+        and cap > 0
+        and bucket > 0
+    ):
+        cells = bucket * cap
+        if nnz < 0 or nnz >= cells:
+            return 0.0
+        return (cells - nnz) / cells
     attrs = span.attrs
     if not attrs:
         return 0.0

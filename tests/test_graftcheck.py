@@ -439,6 +439,52 @@ def test_kernel_spec_consistency_shipped_transformers_all_pair():
     assert result.findings == [], "\n".join(f.render() for f in result.findings)
 
 
+SPARSE_SPEC_DRIFT = """
+    from flink_ml_tpu.ops.kernels import sparse_combine_kernel, sparse_dot_fn
+
+    class SparseDrifted:
+        def transform(self, df):
+            return sparse_combine_kernel()(df)
+
+        def sparse_kernel_spec(self, known):
+            def kernel_fn(model, cols):
+                return {"o": sparse_dot_fn(cols["v"], cols["i"], model["c"])}
+            return object()
+"""
+
+SPARSE_SPEC_CLEAN = """
+    from flink_ml_tpu.ops.kernels import sparse_combine_fn, sparse_combine_kernel
+
+    class SparseCombiner:
+        def transform(self, df):
+            return sparse_combine_kernel()(df)
+
+        def sparse_kernel_spec(self, known):
+            def kernel_fn(model, cols):
+                return {"o": sparse_combine_fn(cols["v"], cols["i"], cols["z"])}
+            return object()
+"""
+
+
+def test_kernel_spec_consistency_covers_sparse_specs(tmp_path):
+    """The sparse convention's ``sparse_kernel_spec`` hook is held to the
+    same shared-body contract as ``kernel_spec``: a sparse spec composing a
+    segment-reduce body the per-stage path never jits is drift."""
+    result = run_on(
+        tmp_path,
+        {"flink_ml_tpu/models/feature/sdrift.py": SPARSE_SPEC_DRIFT},
+        rules=["kernel-spec-consistency"],
+    )
+    assert len(result.findings) == 1
+    assert "'sparse_dot'" in result.findings[0].message
+    clean = run_on(
+        tmp_path / "clean",
+        {"flink_ml_tpu/models/feature/sok.py": SPARSE_SPEC_CLEAN},
+        rules=["kernel-spec-consistency"],
+    )
+    assert clean.findings == []
+
+
 # -----------------------------------------------------------------------------
 # 4. lock-order
 # -----------------------------------------------------------------------------

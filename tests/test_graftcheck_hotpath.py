@@ -525,6 +525,55 @@ def test_elementwise_claim_dirty_transitive_matmul(tmp_path):
     assert "`matmul`" in result.findings[0].message
 
 
+def _sparse_spec_module(fn_import: str, fn_call: str, elementwise: str) -> str:
+    return f"""
+        from flink_ml_tpu.ops.kernels import {fn_import}
+        from flink_ml_tpu.servable.kernel_spec import KernelSpec
+
+        class Stage:
+            def transform(self, df):
+                return {fn_import}
+
+            def sparse_kernel_spec(self, known):
+                def kfn(model, cols):
+                    return {{"o": {fn_call}}}
+                return KernelSpec(
+                    input_cols=["i"], outputs=[("o", None)],
+                    model_arrays={{}}, kernel_fn=kfn, elementwise={elementwise},
+                )
+    """
+
+
+def test_elementwise_claim_covers_sparse_specs(tmp_path):
+    """segment-sum is a reduction (index.REDUCTION_PRIMS): a sparse spec
+    claiming elementwise over a gather-scale-segment-sum body would let the
+    planner merge a margin fold into an elementwise run — flagged, through
+    the ``sparse_kernel_spec`` hook like any ``kernel_spec``."""
+    files = {
+        "flink_ml_tpu/ops/kernels.py": EW_KERNELS + (
+            "\n"
+            "    def segment_sum(t):\n"
+            "        return t\n"
+            "\n"
+            "    def sparse_head_fn(v, i, c):\n"
+            "        return segment_sum(v * c[i])\n"
+        ),
+        "flink_ml_tpu/models/feature/sbad.py": _sparse_spec_module(
+            "sparse_head_fn", 'sparse_head_fn(cols["v"], cols["i"], model["c"])', "True"
+        ),
+    }
+    result = run_on(tmp_path, files, rules=["elementwise-claim"])
+    assert len(result.findings) == 1
+    assert "`sparse_head_fn`" in result.findings[0].message
+    assert "`segment_sum`" in result.findings[0].message
+    # the same spec WITHOUT the claim is fine — merely unmerged
+    files["flink_ml_tpu/models/feature/sbad.py"] = _sparse_spec_module(
+        "sparse_head_fn", 'sparse_head_fn(cols["v"], cols["i"], model["c"])', "False"
+    )
+    clean = run_on(tmp_path / "clean", files, rules=["elementwise-claim"])
+    assert clean.findings == []
+
+
 def test_elementwise_claim_clean_fixtures(tmp_path):
     files = {
         "flink_ml_tpu/ops/kernels.py": EW_KERNELS,

@@ -7,10 +7,16 @@ AOT-warms every bucket — populating the plan cache — serves one request per
 bucket, records the raw response bytes, then dies by ``os._exit(1)`` (a hard
 kill: no atexit, no graceful close — the supervisor-restart analogue).
 
+A second **sparse leg** (docs/sparse.md) does the same for the sparse
+calling convention: an IDF → logistic servable chain over SparseVector
+features, warmed across the nnz-cap ladder (caps 1/2/4), served at every
+rung — its segment executables (values/ids/nnz triple programs) must
+serialize and restore through the same plan cache.
+
 Incarnation 2 starts over the same cache directory with the chain executor's
 ONE XLA-compile seam (``servable.planner._compile_lowered``) poisoned to
-raise. It must warm every bucket and answer every request purely from the
-serialized executables:
+raise. It must warm every bucket — dense AND every sparse (bucket, nnz-cap)
+rung — and answer every request purely from the serialized executables:
 
 - zero plan-cache misses and zero serving-path compiles (the counters), the
   poisoned seam never reached (the hard proof);
@@ -122,10 +128,93 @@ def _serve_all(workdir: str, incarnation: int):
     return server, responses, stats
 
 
+SPARSE_DIM = 20
+SPARSE_CAPS = "1,2,4"
+
+
+def _build_sparse_servable():
+    import numpy as np
+
+    from flink_ml_tpu.models.feature.idf import IDFModel
+    from flink_ml_tpu.servable import (
+        LogisticRegressionModelServable,
+        PipelineModelServable,
+    )
+
+    rng = np.random.default_rng(17)
+    idf_m = IDFModel().set_input_col("features").set_output_col("scaled")
+    idf_m.idf = np.abs(rng.standard_normal(SPARSE_DIM))
+    idf_m.doc_freq = np.ones(SPARSE_DIM)
+    idf_m.num_docs = np.asarray([8])
+    lr = (
+        LogisticRegressionModelServable()
+        .set_features_col("scaled")
+        .set_prediction_col("pred")
+        .set_raw_prediction_col("raw")
+    )
+    lr.coefficient = rng.standard_normal(SPARSE_DIM).astype(np.float32)
+    return PipelineModelServable([idf_m, lr])
+
+
+def _sparse_rows(n, max_nnz, seed):
+    import numpy as np
+
+    from flink_ml_tpu.linalg.vectors import SparseVector
+
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        k = int(rng.integers(0, max_nnz + 1))
+        idx = np.sort(rng.choice(SPARSE_DIM, size=k, replace=False))
+        rows.append(SparseVector(SPARSE_DIM, idx, rng.standard_normal(k)))
+    return rows
+
+
+def _serve_sparse(workdir: str, incarnation: int):
+    """The sparse leg: one request per nnz-cap rung, compiled chains keyed
+    (bucket, cap) and — on resume — loaded, never compiled."""
+    import numpy as np
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.config import Options, config
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+    config.set(Options.PLANCACHE_DIR, os.path.join(workdir, "plancache"))
+    config.set(Options.SPARSE_WARMUP_CAPS, SPARSE_CAPS)
+    config.set(Options.SPARSE_NNZ_CAP_MAX, 4)
+    template = DataFrame.from_dict({"features": _sparse_rows(1, 2, seed=5)})
+    server = InferenceServer(
+        _build_sparse_servable(),
+        name=f"restart-smoke-sparse-{incarnation}",
+        serving_config=ServingConfig(max_batch_size=8, max_delay_ms=0.1),
+        warmup_template=template,
+    )
+    responses = {}
+    for max_nnz in (1, 2, 4):
+        df = DataFrame.from_dict({"features": _sparse_rows(8, max_nnz, seed=max_nnz)})
+        r = server.predict(df)
+        raw = np.asarray(
+            [np.asarray(v, np.float64) for v in r.dataframe.column("raw")]
+        )
+        pred = np.asarray(r.dataframe.column("pred"), np.float64)
+        responses[f"sparse{max_nnz}"] = (raw, pred)
+    compiles = metrics.get(server.scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+    fused = metrics.get(server.scope, MLMetrics.SERVING_FUSED_BATCHES, 0)
+    assert fused == len(responses), (
+        f"sparse leg served {fused} fused batches, expected {len(responses)} — "
+        "sparse traffic fell off the fast path"
+    )
+    assert compiles == 0, f"sparse leg compiled on the serving path: {compiles}"
+    return server, responses
+
+
 def incarnation_1(workdir: str) -> None:
     import numpy as np
 
     _server, responses, stats = _serve_all(workdir, 1)
+    _sserver, sresponses = _serve_sparse(workdir, 1)
+    responses.update(sresponses)
     np.savez(
         os.path.join(workdir, "responses1.npz"),
         **{
@@ -158,6 +247,8 @@ def incarnation_2(workdir: str) -> None:
     planner._compile_lowered = blocked
 
     server, responses, stats = _serve_all(workdir, 2)
+    sserver, sresponses = _serve_sparse(workdir, 2)
+    responses.update(sresponses)
     saved = np.load(os.path.join(workdir, "responses1.npz"))
     for key, (raw, pred) in responses.items():
         assert np.array_equal(saved[f"{key}.raw"], raw), f"bucket {key}: raw differs"
@@ -168,6 +259,7 @@ def incarnation_2(workdir: str) -> None:
     assert pc.get("ml.plancache.quarantined", 0) == 0, pc
     assert pc.get("ml.plancache.hits", 0) > 0, pc
     server.close()
+    sserver.close()
     with open(os.path.join(workdir, "inc2.json"), "w") as f:
         json.dump(stats, f)
     print(

@@ -73,7 +73,7 @@ __all__ = [
 
 #: Bump whenever the shape/semantics of extracted facts change — it is part of
 #: the disk-cache key, so stale caches self-invalidate.
-FACTS_VERSION = 3  # 3: spawn sites, attr accesses, owned-by / serialized marks
+FACTS_VERSION = 4  # 4: sparse_kernel_spec joins the spec-def set; segment_sum prim
 
 KERNELS_MODULE = "flink_ml_tpu.ops.kernels"
 
@@ -91,7 +91,16 @@ REDUCTION_PRIMS = {
     "sum", "dot", "mean", "median", "einsum", "matmul", "tensordot", "vdot",
     "cumsum", "cumprod", "prod", "sort", "argsort", "argmax", "argmin",
     "norm", "std", "var",
+    # The sparse convention's row segment-sum (ops/kernels.segment_sum): a
+    # sequential fold, but a cross-entry accumulation all the same — a
+    # sparse reduction spec must never merge into an elementwise run.
+    "segment_sum",
 }
+
+#: Function names whose bodies define a KernelSpec (the dense protocol and
+#: the sparse-convention hook) — kernel-spec-consistency and
+#: elementwise-claim treat both identically.
+SPEC_DEF_NAMES = ("kernel_spec", "sparse_kernel_spec")
 
 _LOCK_CTORS = {"Lock", "RLock"}
 _TIME_ATTRS = {"time", "perf_counter", "monotonic", "time_ns", "perf_counter_ns"}
@@ -554,7 +563,7 @@ class _Extractor:
             "param_branches": [],  # [line, [param names in value-wise branch test]]
             "scalar_loop_vars": [],
             "reductions": [],  # [prim, line]
-            "is_kernel_spec": fn.name == "kernel_spec",
+            "is_kernel_spec": fn.name in SPEC_DEF_NAMES,
             "spec_trivial": True,
             "spec_refs": [],  # kernel bases referenced inside (kernel_spec only)
             "spec_names": [],  # original imported kernel names referenced inside
@@ -965,7 +974,7 @@ class _Extractor:
             node
             for node in ast.walk(self.tree)
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and node.name == "kernel_spec"
+            and node.name in SPEC_DEF_NAMES
         ]
         spec_nodes: Set[int] = set()
         spec_records = []
