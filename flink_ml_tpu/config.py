@@ -135,6 +135,15 @@ class Options:
         "How often ModelVersionPoller re-scans the model directory for a "
         "newer published version.",
     )
+    SERVING_POLL_BACKOFF_MAX_MS = ConfigOption(
+        "serving.poll.backoff.max.ms",
+        float,
+        30_000.0,
+        "Ceiling of the ModelVersionPoller's jittered exponential backoff on "
+        "consecutive scan failures (an unreadable publish directory must not "
+        "be hammered at full cadence forever); one successful scan resets "
+        "the cadence to serving.poll.interval.ms.",
+    )
     SERVING_FASTPATH = ConfigOption(
         "serving.fastpath",
         _parse_bool,
@@ -524,6 +533,110 @@ class Options:
         "nest inside XLA profiler dumps captured around the traced region "
         "(e.g. benchmark --profile). Only meaningful while a profile is "
         "active; adds per-span overhead, so it is a separate switch.",
+    )
+    FLEET_REPLICAS = ConfigOption(
+        "fleet.replicas",
+        int,
+        3,
+        "Replica count of a ReplicaPool (flink_ml_tpu/fleet) — the serving "
+        "parallelism of one fleet (docs/fleet.md).",
+    )
+    FLEET_ROUTER_POLICY = ConfigOption(
+        "fleet.router.policy",
+        str,
+        "least_loaded",
+        "FleetRouter dispatch policy: 'least_loaded' (fewest in-flight "
+        "requests), 'hash' (rendezvous-hash on the request key — session "
+        "affinity, minimal movement on replica loss), or 'priority' "
+        "(guaranteed traffic least-loaded, sheddable traffic concentrated "
+        "on the busiest replica so sheds hit it first).",
+    )
+    FLEET_RETRY_ATTEMPTS = ConfigOption(
+        "fleet.retry.attempts",
+        int,
+        3,
+        "Total dispatch attempts per request the FleetRouter may spend "
+        "across replicas before surfacing the last typed error.",
+    )
+    FLEET_RETRY_BACKOFF_MS = ConfigOption(
+        "fleet.retry.backoff.ms",
+        float,
+        10.0,
+        "Base retry backoff when an overloaded replica supplies no "
+        "retry_after_ms drain estimate.",
+    )
+    FLEET_RETRY_BACKOFF_MAX_MS = ConfigOption(
+        "fleet.retry.backoff.max.ms",
+        float,
+        1000.0,
+        "Ceiling on one router retry backoff — retry_after_ms is honored "
+        "but never past this bound.",
+    )
+    FLEET_RETRY_JITTER = ConfigOption(
+        "fleet.retry.jitter",
+        float,
+        0.5,
+        "Jitter fraction on router retry backoff (delay *= 1 + jitter*U) so "
+        "a fleet-wide shed does not re-synchronize the retries it shed.",
+    )
+    FLEET_HEDGE_QUANTILE = ConfigOption(
+        "fleet.hedge.quantile",
+        float,
+        0.99,
+        "Latency quantile of the router's observed distribution after which "
+        "a still-pending request is hedged to a second replica (first "
+        "response wins — the p999 tail-cutting protocol). None disables "
+        "hedging.",
+    )
+    FLEET_HEDGE_MIN_MS = ConfigOption(
+        "fleet.hedge.min.ms",
+        float,
+        25.0,
+        "Floor on the hedge trigger delay — with a cold or very fast "
+        "latency window, never hedge earlier than this.",
+    )
+    FLEET_HEALTH_INTERVAL_MS = ConfigOption(
+        "fleet.health.interval.ms",
+        float,
+        250.0,
+        "ReplicaSupervisor /healthz polling cadence per replica.",
+    )
+    FLEET_HEALTH_FAILURES = ConfigOption(
+        "fleet.health.failures",
+        int,
+        3,
+        "Consecutive failed /healthz probes before the supervisor ejects a "
+        "replica from rotation and starts its respawn.",
+    )
+    FLEET_QUORUM = ConfigOption(
+        "fleet.quorum",
+        int,
+        None,
+        "Minimum in-rotation replicas a rolling promotion must preserve. "
+        "Default: a strict majority of the pool (n // 2 + 1).",
+    )
+    FLEET_RESPAWN_TIMEOUT_MS = ConfigOption(
+        "fleet.respawn.timeout.ms",
+        float,
+        120_000.0,
+        "How long one respawn attempt may take to produce a healthy, warmed "
+        "replica before the attempt is counted failed and the restart "
+        "strategy decides on another.",
+    )
+    FLEET_CANARY_SLICE = ConfigOption(
+        "fleet.canary.slice",
+        float,
+        0.25,
+        "Upper bound on the fraction of fleet dispatches a canary version "
+        "may serve while under evaluation — enforced as a hard counter gate "
+        "at the router, so the slice is an invariant, not a target.",
+    )
+    FLEET_CANARY_MIN_SCORES = ConfigOption(
+        "fleet.canary.min.scores",
+        int,
+        3,
+        "Evaluation scores each side (canary and baseline) must accumulate "
+        "before the CanaryController renders a promote/quarantine verdict.",
     )
     NATIVE_DATACACHE_ENABLED = ConfigOption(
         "native.datacache.enabled",

@@ -71,8 +71,13 @@ class RestartsExhaustedError(RuntimeError):
         )
 
 
-class Supervisor:
+class Supervisor:  # graftcheck: serialized
     """Retry loop with Flink restart semantics around a training callable.
+
+    Thread-confined by contract (the ``serialized`` claim): an instance is
+    created, driven and read by one thread at a time — the training main
+    thread, or a fleet supervisor's health loop running one respawn — and
+    never shared across threads mid-``run``.
 
     ``strategy`` defaults to 3 immediate restarts (a CI-friendly
     ``fixedDelayRestart(3, 0)``); ``classifier`` defaults to the built-in

@@ -125,6 +125,25 @@ FAULT_POINTS: Dict[str, str] = {
         "compiled chain keeps serving, and a later cache init sweeps the "
         "orphan."
     ),
+    "fleet.dispatch": (
+        "FleetRouter dispatch seam (fleet/router.py) — fail a request at the "
+        "moment it is routed to a replica (primary or retry); the caller "
+        "sees the typed fault, the chosen replica's in-flight accounting "
+        "stays balanced, and the next dispatch routes normally."
+    ),
+    "fleet.respawn": (
+        "ReplicaSupervisor respawn seam (fleet/supervisor.py) — fail a "
+        "respawn attempt of an ejected replica; the execution.Supervisor "
+        "restart strategy must retry it and the slot must re-admit only "
+        "after a later attempt produces a healthy, warmed replica."
+    ),
+    "fleet.promote": (
+        "CanaryController promotion seam (fleet/canary.py) — kill a "
+        "fleet-wide rolling promotion before any replica has flipped; the "
+        "canary keeps serving its bounded slice, no replica is left on a "
+        "half-promoted version, and a retried promotion completes exactly "
+        "once."
+    ),
     "telemetry.journal": (
         "Flight-recorder journal write (telemetry/journal.py _write_record) — "
         "kill the writer thread mid-record, leaving a torn tail line on "

@@ -1,0 +1,42 @@
+"""Fleet serving: a supervised pool of replicas behind one routing front door.
+
+One ``InferenceServer`` already survives bad versions (rollback), overload
+(typed shed + retry_after) and restarts (plan cache). This package makes a
+*set* of them survive each other (docs/fleet.md):
+
+- :class:`ReplicaPool` — membership, rotation state and the canary slice
+  gate over N process-isolated (or in-process) replicas;
+- :class:`FleetRouter` — consistent-hash / least-loaded / priority dispatch
+  that treats ``ServingOverloadedError.retry_after_ms`` as the backpressure
+  protocol: bounded jittered retries on a *different* replica, hedged
+  requests past a latency quantile, fail-fast when the whole fleet sheds;
+- :class:`ReplicaSupervisor` — /healthz-driven eject → respawn (through
+  ``execution.Supervisor`` restart strategies, plancache making the respawn
+  O(load) not O(XLA)) → health-gated re-admission;
+- :class:`CanaryController` — new versions serve a bounded slice on a
+  canary replica, scored live by ``DriftMonitor``; promoted rolling
+  replica-by-replica (never below quorum) or quarantined via the
+  ``RollbackController`` path.
+
+Every decision is journaled by the flight recorder; ``tools/fleetview.py``
+aggregates the per-replica journals into one fleet timeline.
+"""
+from flink_ml_tpu.fleet.canary import CanaryController
+from flink_ml_tpu.fleet.errors import FleetQuorumError, ReplicaUnavailableError
+from flink_ml_tpu.fleet.pool import FleetConfig, ReplicaPool, ReplicaSlot
+from flink_ml_tpu.fleet.replica import LocalReplica, ProcessReplica
+from flink_ml_tpu.fleet.router import FleetRouter
+from flink_ml_tpu.fleet.supervisor import ReplicaSupervisor
+
+__all__ = [
+    "CanaryController",
+    "FleetConfig",
+    "FleetQuorumError",
+    "FleetRouter",
+    "LocalReplica",
+    "ProcessReplica",
+    "ReplicaPool",
+    "ReplicaSlot",
+    "ReplicaSupervisor",
+    "ReplicaUnavailableError",
+]

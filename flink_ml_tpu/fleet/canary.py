@@ -1,0 +1,291 @@
+"""CanaryController — drift-gated rollout of new model versions across a fleet.
+
+The fleet's promotion protocol (docs/fleet.md): a newly published version
+never goes fleet-wide on faith. It first serves a **bounded traffic slice**
+on one designated canary replica (the pool's counter gate keeps the slice a
+hard invariant — see ``ReplicaPool.canary_allowed``), while labelled tail
+traffic is scored live on both the canary and a baseline replica through the
+real serving path (pinned router dispatches, so the scores measure exactly
+what users would see). The ``DriftMonitor`` renders the verdict:
+
+- **promote** — the canary is not regressed after ``min_scores``
+  observations per side: the version rolls out **one replica at a time**,
+  each step gated on the fleet holding quorum (``FleetQuorumError`` defers,
+  never forces), then becomes the fleet version.
+- **quarantine** — the canary regressed: the ``RollbackController`` path
+  moves the version's published dir aside (``v-N.quarantined`` — the
+  idempotent rename in serving/registry.py, safe under concurrent
+  rollbacks), restores the fleet version on the canary replica, and the
+  version is remembered as failed so it is never re-canaried.
+
+Every start / score / promote-step / promote / quarantine decision is
+journaled with its evidence under the fleet scope — ``tools/fleetview.py``
+reconstructs the full rollout history from these records.
+
+``fleet.promote`` is the chaos seam: it trips before any replica flips, so
+an injected fault leaves nothing half-promoted, and a retried promotion
+completes exactly once (already-flipped replicas are skipped by the
+progress ledger).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional, Set
+
+import numpy as np
+
+import flink_ml_tpu.telemetry as telemetry
+from flink_ml_tpu.faults import faults
+from flink_ml_tpu.fleet.errors import FleetQuorumError
+from flink_ml_tpu.fleet.pool import ReplicaPool
+from flink_ml_tpu.loop.drift import DriftMonitor
+from flink_ml_tpu.loop.loop import default_scorer
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.serving.registry import VERSION_PREFIX, _METADATA_MARKER
+
+__all__ = ["CanaryController"]
+
+
+class CanaryController:
+    """Scan → canary → score → promote-or-quarantine, over one pool."""
+
+    def __init__(
+        self,
+        pool: ReplicaPool,
+        router,
+        publish_dir: str,
+        *,
+        monitor: Optional[DriftMonitor] = None,
+        scorer: Optional[Callable] = None,
+        label_col: str = "label",
+        min_scores: Optional[int] = None,
+        quorum: Optional[int] = None,
+    ):
+        cfg = pool.config
+        self._pool = pool
+        self._router = router
+        self.publish_dir = publish_dir
+        self.scope = pool.scope
+        self.label_col = label_col
+        self.scorer = scorer or default_scorer
+        self.min_scores = int(
+            min_scores if min_scores is not None else cfg.canary_min_scores
+        )
+        self.quorum = int(quorum if quorum is not None else cfg.quorum)
+        self.monitor = monitor or DriftMonitor(
+            scope=self.scope, min_scores=self.min_scores
+        )
+        #: Versions that failed to load or were quarantined — never re-canaried.
+        self._failed: Set[int] = set()
+        #: Per-version slots already flipped, so a retried promotion (the
+        #: fleet.promote seam) completes exactly once.
+        self._promoted: Dict[int, Set[int]] = {}
+
+    # -- start -----------------------------------------------------------------
+    def _version_path(self, version: int) -> str:
+        return os.path.join(self.publish_dir, f"{VERSION_PREFIX}{version}")
+
+    def maybe_start(self) -> Optional[int]:
+        """Designate the newest eligible published version as the canary on
+        one serving replica. No-op while a canary is already running."""
+        pool = self._pool
+        if pool.canary_version is not None:
+            return None
+        from flink_ml_tpu.checkpoint import scan_numbered_dirs
+
+        versions = scan_numbered_dirs(
+            self.publish_dir, VERSION_PREFIX, _METADATA_MARKER
+        )
+        fleet_version = pool.fleet_version
+        candidates = pool.candidates()
+        if len(candidates) < 2:
+            return None  # a 1-replica rotation has no baseline to score against
+        for version in reversed(versions):
+            if fleet_version is not None and version <= fleet_version:
+                break
+            if version in self._failed:
+                continue
+            # Last in-rotation slot by index: deterministic, and keeps slot 0
+            # (the hash policy's densest keyspace) on the baseline side.
+            index, name = candidates[-1][0], candidates[-1][1]
+            replica = candidates[-1][2]
+            try:
+                replica.swap(version, self._version_path(version))
+            except Exception as e:  # noqa: BLE001 — a bad version must not loop
+                self._failed.add(version)
+                telemetry.emit(
+                    "fleet.canary.failed",
+                    self.scope,
+                    {
+                        "version": version,
+                        "replica": name,
+                        "error": type(e).__name__,
+                        "detail": str(e)[:200],
+                    },
+                )
+                continue
+            pool.set_canary(index, version)
+            metrics.counter(self.scope, MLMetrics.FLEET_CANARY_STARTED)
+            telemetry.emit(
+                "fleet.canary.start",
+                self.scope,
+                {
+                    "version": version,
+                    "replica": name,
+                    "slot": index,
+                    "baseline": fleet_version,
+                    "slice": pool.config.canary_slice,
+                },
+            )
+            return version
+        return None
+
+    # -- scoring ---------------------------------------------------------------
+    def observe(self, df) -> Optional[Dict[str, float]]:
+        """Score one labelled tail batch on the canary AND a baseline replica
+        (pinned dispatches — measurement traffic, outside the slice gate)."""
+        pool = self._pool
+        canary_index = pool.canary_slot()
+        canary_version = pool.canary_version
+        if canary_index is None or canary_version is None:
+            return None
+        baselines = [
+            c for c in pool.candidates() if not c[3] and c[0] != canary_index
+        ]
+        if not baselines:
+            return None
+        baseline = min(baselines, key=lambda c: (c[4], c[0]))
+        labels = np.asarray(df.column(self.label_col), np.float64)
+        features = df.drop(self.label_col)
+        canary_resp = self._router.predict(features, pin=canary_index)
+        baseline_resp = self._router.predict(features, pin=baseline[0])
+        canary_score = self.scorer(canary_resp.dataframe, labels)
+        baseline_score = self.scorer(baseline_resp.dataframe, labels)
+        self.monitor.observe(canary_resp.model_version, canary_score)
+        self.monitor.observe(baseline_resp.model_version, baseline_score)
+        telemetry.emit(
+            "fleet.canary.score",
+            self.scope,
+            {
+                "version": canary_resp.model_version,
+                "score": canary_score,
+                "baseline_version": baseline_resp.model_version,
+                "baseline_score": baseline_score,
+                "rows": int(labels.size),
+            },
+        )
+        return {"canary": canary_score, "baseline": baseline_score}
+
+    # -- verdict ---------------------------------------------------------------
+    def verdict(self) -> Optional[str]:
+        """``"promote"`` / ``"quarantine"`` once the evidence suffices, else
+        None. Both sides need ``min_scores`` observations — the drift
+        monitor's no-baseline conservatism must gate *promotion* here too, or
+        a regressed canary could ride out an empty baseline window."""
+        pool = self._pool
+        canary_version = pool.canary_version
+        if canary_version is None:
+            return None
+        if self.monitor.count(canary_version) < self.min_scores:
+            return None
+        fleet_version = pool.fleet_version
+        if fleet_version is not None and self.monitor.count(fleet_version) < self.min_scores:
+            return None
+        if self.monitor.regressed(canary_version, fleet_version):
+            return "quarantine"
+        return "promote"
+
+    # -- promote ---------------------------------------------------------------
+    def promote(self) -> int:  # graftcheck: cold
+        """Roll the canary version across the fleet, one replica at a time,
+        quorum-gated; finishes by making it the fleet version."""
+        pool = self._pool
+        version = pool.canary_version
+        canary_index = pool.canary_slot()
+        if version is None or canary_index is None:
+            raise RuntimeError("no canary to promote")
+        # The seam trips BEFORE any flip: an injected fault here leaves the
+        # fleet exactly as it was, and the retry finds the ledger empty.
+        faults.trip("fleet.promote", version=version, canary=canary_index)
+        done = self._promoted.setdefault(version, {canary_index})
+        path = self._version_path(version)
+        rolled = []
+        for index, name, replica, _canary, _inflight in pool.candidates():
+            if index in done:
+                continue
+            healthy = pool.healthy_count
+            if healthy < self.quorum:
+                raise FleetQuorumError(
+                    f"promotion of v{version} deferred: {healthy} healthy "
+                    f"replicas < quorum {self.quorum}",
+                    healthy=healthy,
+                    quorum=self.quorum,
+                )
+            replica.swap(version, path)
+            done.add(index)
+            rolled.append(name)
+            telemetry.emit(
+                "fleet.promote.step",
+                self.scope,
+                {"version": version, "replica": name, "slot": index},
+            )
+        previous = pool.fleet_version
+        pool.set_fleet_version(version)
+        pool.clear_canary()
+        self._promoted.pop(version, None)
+        metrics.counter(self.scope, MLMetrics.FLEET_CANARY_PROMOTED)
+        telemetry.emit(
+            "fleet.promote",
+            self.scope,
+            {"version": version, "from": previous, "rolled": rolled},
+        )
+        return version
+
+    # -- quarantine ------------------------------------------------------------
+    def quarantine(self) -> Optional[int]:  # graftcheck: cold
+        """Roll the canary replica back and quarantine the bad version's
+        published dir; returns the restored version."""
+        pool = self._pool
+        version = pool.canary_version
+        canary_index = pool.canary_slot()
+        if version is None or canary_index is None:
+            raise RuntimeError("no canary to quarantine")
+        replica = pool.slot(canary_index).replica
+        name = pool.slot(canary_index).name
+        restored = replica.rollback_bad(version)
+        self._failed.add(version)
+        pool.clear_canary()
+        metrics.counter(self.scope, MLMetrics.FLEET_CANARY_QUARANTINED)
+        evidence = {
+            "version": version,
+            "replica": name,
+            "restored": restored,
+            "canary_mean": self.monitor.mean(version),
+            "baseline_mean": (
+                self.monitor.mean(pool.fleet_version)
+                if pool.fleet_version is not None
+                else None
+            ),
+        }
+        telemetry.emit("fleet.quarantine", self.scope, evidence)
+        telemetry.incident("canary-quarantine", self.scope, evidence)
+        return restored
+
+    # -- one turn --------------------------------------------------------------
+    def step(self, eval_df=None) -> Dict[str, object]:
+        """One controller turn: start a canary if one is due, score a tail
+        batch if given, act on the verdict once it lands."""
+        started = self.maybe_start()
+        scores = self.observe(eval_df) if eval_df is not None else None
+        verdict = self.verdict()
+        outcome: Dict[str, object] = {
+            "started": started,
+            "scores": scores,
+            "verdict": verdict,
+            "canary_version": self._pool.canary_version,
+        }
+        if verdict == "promote":
+            outcome["promoted"] = self.promote()
+        elif verdict == "quarantine":
+            outcome["restored"] = self.quarantine()
+        return outcome

@@ -1,0 +1,35 @@
+"""Typed failures of the fleet tier.
+
+Both subclass :class:`~flink_ml_tpu.serving.errors.ServingError` so every
+failure a fleet client can see stays inside the typed-error contract the
+load harness bins exhaustively (loadgen/generator.py) — a replica crash or
+a whole-fleet outage is a routable, typed event, never an untyped surprise.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from flink_ml_tpu.serving.errors import ServingError
+
+__all__ = ["ReplicaUnavailableError", "FleetQuorumError"]
+
+
+class ReplicaUnavailableError(ServingError):
+    """A replica could not be reached (connection refused, hard-killed
+    mid-request, or no replica in rotation at all). The router retries these
+    on a different replica; when none is left the error surfaces to the
+    caller with ``replica=None``."""
+
+    def __init__(self, message: str, *, replica: Optional[str] = None):
+        self.replica = replica
+        super().__init__(message)
+
+
+class FleetQuorumError(ServingError):
+    """A rolling operation (promotion) would drop the in-rotation replica
+    count below the fleet's quorum — deferred, never forced."""
+
+    def __init__(self, message: str, *, healthy: int, quorum: int):
+        self.healthy = healthy
+        self.quorum = quorum
+        super().__init__(message)

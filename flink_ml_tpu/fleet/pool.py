@@ -1,0 +1,353 @@
+"""ReplicaPool — the fleet's membership, rotation and canary bookkeeping.
+
+One pool owns N replica slots. A slot holds a replica handle
+(:mod:`fleet/replica`) plus its rotation state: ``serving`` (dispatchable),
+``ejected`` (out of rotation, being respawned), or ``dead`` (restart budget
+exhausted). The router reads rotation snapshots per dispatch; the supervisor
+moves slots between states; the canary controller designates at most one
+slot as the canary and the pool's **counter gate** enforces the traffic
+slice as a hard invariant: a canary dispatch is admitted only while
+``canary_dispatches + 1 <= slice * (total_dispatches + 1)``, which keeps
+``canary_dispatches <= slice * total_dispatches`` at every instant — the
+bound fleet_smoke asserts, not a best-effort target.
+
+Every membership decision (eject / readmit / dead) is journaled with its
+evidence by the flight recorder under the fleet scope, and mirrored into the
+``ml.fleet.*`` metrics (docs/fleet.md).
+
+Replica construction is a ``factory(slot_index, name, version)`` callable —
+``LocalReplica`` factories give tier-1 tests thread-isolated fleets;
+``ProcessReplica.spawn`` factories give CI real process isolation. The pool
+never cares which.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import flink_ml_tpu.telemetry as telemetry
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.metrics import MLMetrics, metrics
+
+__all__ = ["FleetConfig", "ReplicaSlot", "ReplicaPool"]
+
+
+class FleetConfig:
+    """Resolved fleet knobs — every unset field falls back to the runtime
+    config tier (``fleet.*`` options, docs/configuration.md), mirroring
+    ``ServingConfig``."""
+
+    def __init__(
+        self,
+        replicas: Optional[int] = None,
+        *,
+        policy: Optional[str] = None,
+        retry_attempts: Optional[int] = None,
+        retry_backoff_ms: Optional[float] = None,
+        retry_backoff_max_ms: Optional[float] = None,
+        retry_jitter: Optional[float] = None,
+        hedge_quantile: Optional[float] = None,
+        hedge_min_ms: Optional[float] = None,
+        health_interval_ms: Optional[float] = None,
+        health_failures: Optional[int] = None,
+        quorum: Optional[int] = None,
+        respawn_timeout_ms: Optional[float] = None,
+        canary_slice: Optional[float] = None,
+        canary_min_scores: Optional[int] = None,
+    ):
+        self.replicas = int(
+            replicas if replicas is not None else config.get(Options.FLEET_REPLICAS)
+        )
+        self.policy = str(
+            policy if policy is not None else config.get(Options.FLEET_ROUTER_POLICY)
+        )
+        self.retry_attempts = int(
+            retry_attempts if retry_attempts is not None
+            else config.get(Options.FLEET_RETRY_ATTEMPTS)
+        )
+        self.retry_backoff_ms = float(
+            retry_backoff_ms if retry_backoff_ms is not None
+            else config.get(Options.FLEET_RETRY_BACKOFF_MS)
+        )
+        self.retry_backoff_max_ms = float(
+            retry_backoff_max_ms if retry_backoff_max_ms is not None
+            else config.get(Options.FLEET_RETRY_BACKOFF_MAX_MS)
+        )
+        self.retry_jitter = float(
+            retry_jitter if retry_jitter is not None
+            else config.get(Options.FLEET_RETRY_JITTER)
+        )
+        hq = (
+            hedge_quantile if hedge_quantile is not None
+            else config.get(Options.FLEET_HEDGE_QUANTILE)
+        )
+        self.hedge_quantile = float(hq) if hq is not None else None
+        self.hedge_min_ms = float(
+            hedge_min_ms if hedge_min_ms is not None
+            else config.get(Options.FLEET_HEDGE_MIN_MS)
+        )
+        self.health_interval_ms = float(
+            health_interval_ms if health_interval_ms is not None
+            else config.get(Options.FLEET_HEALTH_INTERVAL_MS)
+        )
+        self.health_failures = int(
+            health_failures if health_failures is not None
+            else config.get(Options.FLEET_HEALTH_FAILURES)
+        )
+        q = quorum if quorum is not None else config.get(Options.FLEET_QUORUM)
+        # Default quorum: a strict majority of the pool.
+        self.quorum = int(q) if q is not None else (self.replicas // 2 + 1)
+        self.respawn_timeout_ms = float(
+            respawn_timeout_ms if respawn_timeout_ms is not None
+            else config.get(Options.FLEET_RESPAWN_TIMEOUT_MS)
+        )
+        self.canary_slice = float(
+            canary_slice if canary_slice is not None
+            else config.get(Options.FLEET_CANARY_SLICE)
+        )
+        self.canary_min_scores = int(
+            canary_min_scores if canary_min_scores is not None
+            else config.get(Options.FLEET_CANARY_MIN_SCORES)
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"FleetConfig(replicas={self.replicas}, policy={self.policy!r}, "
+            f"retry_attempts={self.retry_attempts}, quorum={self.quorum}, "
+            f"canary_slice={self.canary_slice})"
+        )
+
+
+class ReplicaSlot:
+    """One pool position and its rotation state. All fields are guarded by
+    the owning pool's lock — slots are bookkeeping, not handles; the replica
+    object itself is only ever *called* outside the lock."""
+
+    __slots__ = (
+        "index", "name", "replica", "state", "canary", "consecutive_failures",
+        "inflight", "last_error",
+    )
+
+    def __init__(self, index: int, name: str, replica):
+        self.index = index
+        self.name = name
+        self.replica = replica
+        self.state = "serving"  # serving | ejected | dead
+        self.canary = False
+        self.consecutive_failures = 0
+        self.inflight = 0
+        self.last_error: Optional[str] = None
+
+
+class ReplicaPool:
+    """N replicas, one membership ledger, one canary slice gate."""
+
+    def __init__(
+        self,
+        factory: Callable[[int, str, Optional[int]], Any],
+        n: Optional[int] = None,
+        *,
+        name: str = "fleet",
+        fleet_config: Optional[FleetConfig] = None,
+        initial_version: Optional[int] = None,
+    ):
+        self.name = name
+        self.scope = f"{MLMetrics.FLEET_GROUP}[{name}]"
+        self.config = fleet_config or FleetConfig(replicas=n)
+        if n is not None:
+            self.config.replicas = int(n)
+        self.factory = factory
+        self._lock = threading.RLock()
+        self._fleet_version = initial_version
+        self._total_dispatches = 0
+        self._canary_dispatches = 0
+        self._canary_version: Optional[int] = None
+        self._slots: List[ReplicaSlot] = []
+        for i in range(self.config.replicas):
+            replica_name = f"{name}-r{i}"
+            replica = factory(i, replica_name, initial_version)
+            self._slots.append(ReplicaSlot(i, replica_name, replica))
+        metrics.gauge(self.scope, MLMetrics.FLEET_SIZE, len(self._slots))
+        self._refresh_live_gauge()
+
+    # -- reads -----------------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return len(self._slots)
+
+    @property
+    def fleet_version(self) -> Optional[int]:
+        with self._lock:
+            return self._fleet_version
+
+    def set_fleet_version(self, version: int) -> None:
+        with self._lock:
+            self._fleet_version = int(version)
+
+    @property
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots if s.state == "serving")
+
+    def slot(self, index: int) -> ReplicaSlot:
+        return self._slots[index]
+
+    def replica(self, index: int):
+        with self._lock:
+            return self._slots[index].replica
+
+    def candidates(self) -> List[Tuple[int, str, Any, bool, int]]:
+        """Rotation snapshot for one routing decision:
+        ``(index, name, replica, is_canary, inflight)`` per serving slot."""
+        with self._lock:
+            return [
+                (s.index, s.name, s.replica, s.canary, s.inflight)
+                for s in self._slots
+                if s.state == "serving"
+            ]
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return {s.name: s.state for s in self._slots}
+
+    # -- dispatch accounting (router-driven) -----------------------------------
+    def note_dispatch(self, index: int, *, canary: bool, counted: bool = True) -> None:
+        """``counted=False`` is pinned measurement traffic (canary scoring):
+        it holds an in-flight slot but never moves the slice counters."""
+        with self._lock:
+            self._slots[index].inflight += 1
+            if counted:
+                self._total_dispatches += 1
+                if canary:
+                    self._canary_dispatches += 1
+        if counted:
+            metrics.counter(self.scope, MLMetrics.FLEET_DISPATCHES)
+            if canary:
+                metrics.counter(self.scope, MLMetrics.FLEET_CANARY_DISPATCHES)
+
+    def note_resolve(self, index: int) -> None:
+        with self._lock:
+            slot = self._slots[index]
+            if slot.inflight > 0:
+                slot.inflight -= 1
+
+    def canary_allowed(self) -> bool:
+        """The hard slice gate: admit a canary dispatch only if the share
+        stays <= ``canary_slice`` *after* admitting it."""
+        with self._lock:
+            if self._canary_version is None:
+                return False
+            return (self._canary_dispatches + 1) <= self.config.canary_slice * (
+                self._total_dispatches + 1
+            )
+
+    def dispatch_counts(self) -> Tuple[int, int]:
+        """(total, canary) dispatches so far — the slice-invariant evidence."""
+        with self._lock:
+            return self._total_dispatches, self._canary_dispatches
+
+    # -- membership (supervisor-driven) ----------------------------------------
+    def eject(self, index: int, *, reason: str, evidence: Optional[dict] = None) -> None:
+        with self._lock:
+            slot = self._slots[index]
+            name = slot.name
+            was_canary = slot.canary
+            slot.state = "ejected"
+            slot.canary = False
+            if was_canary:
+                self._canary_version = None
+        metrics.counter(self.scope, MLMetrics.FLEET_EJECTS)
+        self._refresh_live_gauge()
+        data = {"replica": name, "slot": index, "reason": reason}
+        data.update(evidence or {})
+        telemetry.emit("fleet.eject", self.scope, data)
+        telemetry.incident("replica-eject", self.scope, data)
+
+    def readmit(self, index: int, replica) -> None:
+        with self._lock:
+            slot = self._slots[index]
+            name = slot.name
+            slot.replica = replica
+            slot.state = "serving"
+            slot.consecutive_failures = 0
+            slot.inflight = 0
+            slot.last_error = None
+        metrics.counter(self.scope, MLMetrics.FLEET_READMITS)
+        self._refresh_live_gauge()
+        telemetry.emit(
+            "fleet.readmit",
+            self.scope,
+            {"replica": name, "slot": index, "version": self.fleet_version},
+        )
+
+    def mark_dead(self, index: int, error: Optional[BaseException] = None) -> None:
+        error_name = type(error).__name__ if error is not None else None
+        with self._lock:
+            slot = self._slots[index]
+            name = slot.name
+            slot.state = "dead"
+            slot.last_error = error_name
+        metrics.counter(self.scope, MLMetrics.FLEET_DEAD)
+        self._refresh_live_gauge()
+        data = {
+            "replica": name,
+            "slot": index,
+            "error": error_name,
+        }
+        telemetry.emit("fleet.dead", self.scope, data)
+        telemetry.incident("replica-dead", self.scope, data)
+
+    def _refresh_live_gauge(self) -> None:
+        metrics.gauge(self.scope, MLMetrics.FLEET_LIVE, self.healthy_count)
+
+    # -- canary designation (controller-driven) --------------------------------
+    def set_canary(self, index: int, version: int) -> None:
+        with self._lock:
+            for s in self._slots:
+                s.canary = False
+            self._slots[index].canary = True
+            self._canary_version = int(version)
+
+    def clear_canary(self) -> None:
+        with self._lock:
+            for s in self._slots:
+                s.canary = False
+            self._canary_version = None
+
+    def canary_slot(self) -> Optional[int]:
+        with self._lock:
+            for s in self._slots:
+                if s.canary:
+                    return s.index
+            return None
+
+    @property
+    def canary_version(self) -> Optional[int]:
+        with self._lock:
+            return self._canary_version
+
+    # -- lifecycle -------------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        with self._lock:
+            replicas = [s.replica for s in self._slots if s.state != "dead"]
+        for replica in replicas:
+            try:
+                replica.close(drain=drain)
+            except Exception as e:  # noqa: BLE001 — best-effort fleet shutdown
+                telemetry.emit(
+                    "fleet.close.error",
+                    self.scope,
+                    {"replica": getattr(replica, "name", None), "error": type(e).__name__},
+                )
+
+    def __enter__(self) -> "ReplicaPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)
+
+    def __repr__(self) -> str:
+        return (
+            f"ReplicaPool({self.name!r}, size={self.size}, "
+            f"healthy={self.healthy_count}, version={self.fleet_version})"
+        )

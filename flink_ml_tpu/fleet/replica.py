@@ -1,0 +1,549 @@
+"""Replica handles: one serving process (or in-process server) behind one API.
+
+Two isolations, one contract (``docs/fleet.md``):
+
+- :class:`ProcessReplica` — a real OS process running ``fleet/worker.py``
+  (its own ``InferenceServer``, /healthz endpoint, flight-recorder journal
+  and plancache-warmed mesh), reached over a ``multiprocessing.connection``
+  socket with one connection per outstanding request. A hard kill surfaces
+  as :class:`~flink_ml_tpu.fleet.errors.ReplicaUnavailableError` on every
+  in-flight and future call — the router's failover signal.
+- :class:`LocalReplica` — the same surface over an in-process
+  ``InferenceServer``, for deterministic fleet tests without process spawn
+  cost; ``kill()`` simulates the hard death (in-flight requests resolve as
+  ``ReplicaUnavailableError``, exactly like a dropped socket).
+
+Both expose: ``submit`` (async; pending supports ``wait(timeout)`` — the
+router's hedging primitive), ``predict``, ``swap``/``rollback`` by published
+version path, ``rollback_bad`` (the RollbackController path for canary
+quarantine), ``health_check``, ``stats``, ``close``, ``kill``.
+
+Cross-process payloads carry columnar data as plain numpy (device arrays are
+pulled host-side before pickling) and serving errors as structured
+descriptors (``encode_error``/``decode_error``) — a replica's typed
+rejection stays the *same type* in the parent, so the whole fleet keeps the
+typed-error contract end to end.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from multiprocessing.connection import Client
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.faults import InjectedFault
+from flink_ml_tpu.fleet.errors import ReplicaUnavailableError
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.serving.errors import (
+    NoModelError,
+    ServingClosedError,
+    ServingDeadlineError,
+    ServingError,
+    ServingOverloadedError,
+)
+
+__all__ = [
+    "LocalReplica",
+    "ProcessReplica",
+    "encode_df",
+    "decode_df",
+    "encode_error",
+    "decode_error",
+]
+
+#: Env var carrying the fleet's connection authkey (hex) to worker processes.
+AUTHKEY_ENV = "FLINK_ML_TPU_FLEET_AUTHKEY"
+
+
+# -- wire helpers (shared with fleet/worker.py) --------------------------------
+def encode_df(df: DataFrame) -> Dict[str, Any]:
+    """A picklable columnar payload: numpy arrays host-side (a response
+    column may be a device array — pull it before it crosses the socket),
+    object columns (sparse vectors, strings) as plain lists."""
+    columns = []
+    for name in df.column_names:
+        col = df.column(name)
+        if isinstance(col, list):
+            columns.append(col)
+        else:
+            columns.append(np.asarray(col))
+    return {"names": df.column_names, "columns": columns}
+
+
+def decode_df(payload: Dict[str, Any]) -> DataFrame:
+    return DataFrame(payload["names"], None, payload["columns"])
+
+
+def encode_error(e: BaseException) -> Dict[str, Any]:
+    """A structured descriptor of a worker-side failure — reconstructable to
+    the same typed exception in the parent (plain pickling loses keyword-only
+    constructor fields like ``retry_after_ms``)."""
+    if isinstance(e, ServingOverloadedError):
+        return {
+            "type": "overloaded",
+            "queued_rows": e.queued_rows,
+            "capacity_rows": e.capacity_rows,
+            "retry_after_ms": e.retry_after_ms,
+            "shed": e.shed,
+            "priority": e.priority,
+        }
+    if isinstance(e, ServingDeadlineError):
+        return {
+            "type": "deadline",
+            "phase": e.phase,
+            "queued_ms": e.queued_ms,
+            "retry_after_ms": e.retry_after_ms,
+        }
+    if isinstance(e, InjectedFault):
+        return {"type": "injected", "point": e.point, "hit": e.hit, "context": e.context}
+    if isinstance(e, ServingClosedError):
+        return {"type": "closed", "message": str(e)}
+    if isinstance(e, NoModelError):
+        return {"type": "no_model", "message": str(e)}
+    if isinstance(e, ServingError):
+        return {"type": "serving", "message": str(e)}
+    return {"type": "unexpected", "error_type": type(e).__name__, "message": str(e)}
+
+
+def decode_error(d: Dict[str, Any]) -> BaseException:
+    kind = d.get("type")
+    if kind == "overloaded":
+        return ServingOverloadedError(
+            d["queued_rows"],
+            d["capacity_rows"],
+            retry_after_ms=d.get("retry_after_ms"),
+            shed=bool(d.get("shed")),
+            priority=d.get("priority"),
+        )
+    if kind == "deadline":
+        return ServingDeadlineError(
+            phase=d.get("phase", "queued"),
+            queued_ms=d.get("queued_ms"),
+            retry_after_ms=d.get("retry_after_ms"),
+        )
+    if kind == "injected":
+        return InjectedFault(d["point"], d["hit"], d.get("context"))
+    if kind == "closed":
+        return ServingClosedError(d.get("message", "server is closed"))
+    if kind == "no_model":
+        return NoModelError(d.get("message", "no model version loaded yet"))
+    if kind == "serving":
+        return ServingError(d.get("message", "serving error"))
+    return RuntimeError(
+        f"replica-side {d.get('error_type', 'error')}: {d.get('message', '')}"
+    )
+
+
+class _ReplicaResponse:
+    """A fleet-side serving response (the ``ServingResponse`` surface
+    reconstructed from the wire payload)."""
+
+    __slots__ = ("dataframe", "model_version", "latency_ms", "bucket")
+
+    def __init__(self, dataframe, model_version, latency_ms, bucket):
+        self.dataframe = dataframe
+        self.model_version = model_version
+        self.latency_ms = latency_ms
+        self.bucket = bucket
+
+
+# -- in-process replica --------------------------------------------------------
+class _LocalPending:
+    """Wraps a server handle behind a ``wait(timeout)``-capable pending: one
+    resolver thread blocks on the inner ``result()`` and publishes the
+    outcome through an Event (the batcher handle has no timed public wait)."""
+
+    def __init__(self, replica: "LocalReplica", inner):
+        self._replica = replica
+        self._done = threading.Event()
+        # Outcome fields cross from the resolver thread to whichever router
+        # thread awaits: lock-guarded (the Event orders them too, but a
+        # consistent lockset is the contract shared-state-guard verifies).
+        self._lock = threading.Lock()
+        self._response = None
+        self._error: Optional[BaseException] = None
+        thread = threading.Thread(
+            target=self._resolve, args=(inner,), daemon=True,
+            name=f"fleet-local-pending[{replica.name}]",
+        )
+        thread.start()
+
+    def _resolve(self, inner) -> None:
+        try:
+            response = inner.result()
+        except BaseException as e:  # noqa: BLE001 — republished via result()
+            # A killed local replica fails its queued requests with
+            # ServingClosedError; a killed *process* replica drops the
+            # socket. Same event, same typed signal to the router.
+            if isinstance(e, ServingClosedError) and self._replica.killed:
+                e = ReplicaUnavailableError(
+                    f"replica {self._replica.name!r} died mid-request",
+                    replica=self._replica.name,
+                )
+            with self._lock:
+                self._error = e
+        else:
+            with self._lock:
+                self._response = response
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self):
+        self._done.wait()
+        with self._lock:
+            error = self._error
+            response = self._response
+        if error is not None:
+            raise error
+        return response
+
+
+class LocalReplica:
+    """The replica contract over an in-process ``InferenceServer``."""
+
+    def __init__(self, name: str, server, *, publish_dir: Optional[str] = None, loader=None):
+        if loader is None:
+            from flink_ml_tpu.servable.api import load_servable
+
+            loader = load_servable
+        self.name = name
+        self.server = server
+        self.publish_dir = publish_dir
+        self.loader = loader
+        self._killed = False
+
+    @property
+    def killed(self) -> bool:
+        return self._killed
+
+    @property
+    def alive(self) -> bool:
+        return not self._killed
+
+    def submit(self, df: DataFrame, timeout_ms: Optional[float] = None, priority: int = 0):
+        if self._killed:
+            raise ReplicaUnavailableError(
+                f"replica {self.name!r} is dead", replica=self.name
+            )
+        inner = self.server.submit(df, timeout_ms=timeout_ms, priority=priority)
+        return _LocalPending(self, inner)
+
+    def predict(self, df: DataFrame, timeout_ms: Optional[float] = None, priority: int = 0):
+        return self.submit(df, timeout_ms=timeout_ms, priority=priority).result()
+
+    def swap(self, version: int, path: str) -> None:
+        self.server.swap(version, self.loader(path))
+
+    def rollback(self, version: int, path: str) -> None:
+        self.server.rollback(version, self.loader(path))
+
+    def rollback_bad(self, bad_version: int) -> int:
+        """Quarantine ``bad_version`` and restore the newest intact older one
+        on this replica — the RollbackController path (loop/rollback.py)."""
+        from flink_ml_tpu.loop.rollback import RollbackController
+
+        if self.publish_dir is None:
+            raise RuntimeError(f"replica {self.name!r} has no publish_dir")
+        controller = RollbackController(
+            self.server, self.publish_dir, loader=self.loader,
+            scope=f"{MLMetrics.FLEET_GROUP}[{self.name}]",
+        )
+        return controller.rollback(bad_version)
+
+    def health_check(self, timeout_s: float = 2.0) -> Tuple[bool, Dict[str, Any]]:
+        if self._killed:
+            return False, {"status": "dead", "name": self.name}
+        return self.server.health()
+
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        return {
+            "serving": _numeric(metrics.scope(self.server.scope)),
+            "plancache": _numeric(metrics.scope(MLMetrics.PLANCACHE_GROUP)),
+        }
+
+    def kill(self) -> None:
+        """Simulated hard death: future submits refuse, queued requests
+        resolve as ``ReplicaUnavailableError`` (see ``_LocalPending``)."""
+        self._killed = True
+        self.server.close(drain=False)
+
+    def close(self, drain: bool = True) -> None:
+        if not self._killed:
+            self.server.close(drain=drain)
+
+    def __repr__(self) -> str:
+        return f"LocalReplica({self.name!r}, alive={self.alive})"
+
+
+def _numeric(scope: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in scope.items() if isinstance(v, (int, float))}
+
+
+# -- process replica -----------------------------------------------------------
+class _ProcessPending:
+    """One in-flight request on its own connection: ``wait`` polls the
+    socket, ``result`` receives exactly one reply. A dropped socket (worker
+    hard-killed) resolves as ``ReplicaUnavailableError``."""
+
+    def __init__(self, replica: "ProcessReplica", conn):
+        self._replica = replica
+        self._conn = conn
+        self._outcome: Optional[Tuple[Optional[object], Optional[BaseException]]] = None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self._outcome is not None:
+            return True
+        try:
+            return self._conn.poll(timeout)
+        except (OSError, EOFError):
+            return True  # dead socket: result() will surface the typed error
+
+    def result(self):
+        if self._outcome is None:
+            try:
+                reply = self._conn.recv()
+            except (EOFError, OSError, ConnectionResetError) as e:
+                self._outcome = (
+                    None,
+                    ReplicaUnavailableError(
+                        f"replica {self._replica.name!r} dropped the connection "
+                        f"mid-request ({type(e).__name__})",
+                        replica=self._replica.name,
+                    ),
+                )
+            else:
+                if reply.get("ok"):
+                    self._outcome = (
+                        _ReplicaResponse(
+                            decode_df(reply["df"]),
+                            reply["model_version"],
+                            reply["latency_ms"],
+                            reply["bucket"],
+                        ),
+                        None,
+                    )
+                else:
+                    self._outcome = (None, decode_error(reply["error"]))
+            finally:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+        response, error = self._outcome
+        if error is not None:
+            raise error
+        return response
+
+
+class ProcessReplica:
+    """The replica contract over a spawned ``fleet/worker.py`` process."""
+
+    def __init__(self, name: str, proc, address, authkey: bytes, info: Dict[str, Any]):
+        self.name = name
+        self._proc = proc
+        self.address = tuple(address)
+        self._authkey = authkey
+        self.info = info
+        self.pid = info.get("pid")
+        self.telemetry_port = info.get("telemetry_port")
+
+    # -- spawn ----------------------------------------------------------------
+    @classmethod
+    def spawn(
+        cls,
+        name: str,
+        workdir: str,
+        *,
+        publish_dir: Optional[str] = None,
+        load_version: Optional[int] = None,
+        template: Optional[DataFrame] = None,
+        env: Optional[Dict[str, str]] = None,
+        ready_timeout_s: float = 180.0,
+    ) -> "ProcessReplica":
+        """Start a worker, wait for its ready file, return the handle.
+
+        ``env`` entries override the inherited environment — the fleet's
+        plancache dir, journal dir and serving knobs ride here as the
+        ``FLINK_ML_TPU_*`` vars the config tier already resolves.
+        """
+        os.makedirs(workdir, exist_ok=True)
+        authkey_hex = os.urandom(16).hex()
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        full_env[AUTHKEY_ENV] = authkey_hex
+        # The worker must import this package even when the parent was
+        # launched from elsewhere.
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        full_env["PYTHONPATH"] = repo_root + (
+            os.pathsep + full_env["PYTHONPATH"] if full_env.get("PYTHONPATH") else ""
+        )
+        cmd = [
+            sys.executable, "-m", "flink_ml_tpu.fleet.worker",
+            "--name", name, "--workdir", workdir,
+        ]
+        if publish_dir is not None:
+            cmd += ["--publish-dir", publish_dir]
+        if load_version is not None:
+            cmd += ["--load-version", str(int(load_version))]
+        if template is not None:
+            template_path = os.path.join(workdir, "template.pkl")
+            with open(template_path, "wb") as f:
+                pickle.dump(encode_df(template), f)
+            cmd += ["--template", template_path]
+        log_path = os.path.join(workdir, "worker.log")
+        log_file = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(cmd, env=full_env, stdout=log_file, stderr=subprocess.STDOUT)
+        finally:
+            log_file.close()
+        ready_path = os.path.join(workdir, "ready.json")
+        deadline = time.monotonic() + ready_timeout_s
+        while not os.path.exists(ready_path):
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica worker {name!r} died before ready "
+                    f"(exit {proc.returncode}); see {log_path}"
+                )
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise TimeoutError(
+                    f"replica worker {name!r} not ready within {ready_timeout_s}s; "
+                    f"see {log_path}"
+                )
+            time.sleep(0.05)
+        with open(ready_path, "r", encoding="utf-8") as f:
+            info = json.load(f)
+        return cls(name, proc, info["address"], bytes.fromhex(authkey_hex), info)
+
+    # -- plumbing -------------------------------------------------------------
+    @property
+    def alive(self) -> bool:
+        return self._proc.poll() is None
+
+    def _connect(self):
+        try:
+            return Client(self.address, authkey=self._authkey)
+        except (ConnectionRefusedError, ConnectionResetError, OSError, EOFError) as e:
+            raise ReplicaUnavailableError(
+                f"replica {self.name!r} unreachable at {self.address} "
+                f"({type(e).__name__})",
+                replica=self.name,
+            ) from e
+
+    def _call(self, payload: Dict[str, Any], timeout_s: float) -> Dict[str, Any]:
+        conn = self._connect()
+        try:
+            try:
+                conn.send(payload)
+                if not conn.poll(timeout_s):
+                    raise ReplicaUnavailableError(
+                        f"replica {self.name!r}: no {payload.get('op')!r} reply "
+                        f"within {timeout_s}s",
+                        replica=self.name,
+                    )
+                reply = conn.recv()
+            except (BrokenPipeError, EOFError, ConnectionResetError, OSError) as e:
+                raise ReplicaUnavailableError(
+                    f"replica {self.name!r} dropped the connection "
+                    f"({type(e).__name__})",
+                    replica=self.name,
+                ) from e
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if not reply.get("ok"):
+            raise decode_error(reply["error"])
+        return reply
+
+    # -- the replica contract -------------------------------------------------
+    def submit(self, df: DataFrame, timeout_ms: Optional[float] = None, priority: int = 0):
+        conn = self._connect()
+        try:
+            conn.send(
+                {
+                    "op": "predict",
+                    "df": encode_df(df),
+                    "timeout_ms": timeout_ms,
+                    "priority": int(priority),
+                }
+            )
+        except (BrokenPipeError, OSError) as e:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ReplicaUnavailableError(
+                f"replica {self.name!r} dropped the connection at submit "
+                f"({type(e).__name__})",
+                replica=self.name,
+            ) from e
+        return _ProcessPending(self, conn)
+
+    def predict(self, df: DataFrame, timeout_ms: Optional[float] = None, priority: int = 0):
+        return self.submit(df, timeout_ms=timeout_ms, priority=priority).result()
+
+    def swap(self, version: int, path: str, timeout_s: float = 300.0) -> None:
+        self._call({"op": "swap", "version": int(version), "path": path}, timeout_s)
+
+    def rollback(self, version: int, path: str, timeout_s: float = 300.0) -> None:
+        self._call({"op": "rollback", "version": int(version), "path": path}, timeout_s)
+
+    def rollback_bad(self, bad_version: int, timeout_s: float = 300.0) -> int:
+        reply = self._call({"op": "rollback_bad", "version": int(bad_version)}, timeout_s)
+        return reply["restored"]
+
+    def health_check(self, timeout_s: float = 2.0) -> Tuple[bool, Dict[str, Any]]:
+        """The /healthz probe — over the worker's HTTP endpoint, exactly what
+        an external load balancer would see (200 = in service, 503 =
+        draining/closed, unreachable = dead)."""
+        url = f"http://127.0.0.1:{self.telemetry_port}/healthz"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+                return True, json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode("utf-8"))
+            except Exception:  # noqa: BLE001 — body is best-effort evidence
+                payload = {}
+            payload.setdefault("status", f"http-{e.code}")
+            return False, payload
+        except Exception as e:  # noqa: BLE001 — any probe failure = unhealthy
+            return False, {"status": "unreachable", "error": type(e).__name__}
+
+    def stats(self, timeout_s: float = 30.0) -> Dict[str, Dict[str, Any]]:
+        return self._call({"op": "stats"}, timeout_s)["stats"]
+
+    def kill(self) -> None:
+        """Hard kill — no drain, no goodbye; the crash the fleet must survive."""
+        if self._proc.poll() is None:
+            self._proc.kill()
+        self._proc.wait(timeout=30)
+
+    def close(self, drain: bool = True) -> None:
+        try:
+            self._call({"op": "close", "drain": bool(drain)}, timeout_s=60.0)
+        except ReplicaUnavailableError:
+            pass  # already gone
+        try:
+            self._proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            self.kill()
+
+    def __repr__(self) -> str:
+        return f"ProcessReplica({self.name!r}, pid={self.pid}, alive={self.alive})"

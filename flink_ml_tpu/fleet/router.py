@@ -1,0 +1,380 @@
+"""FleetRouter — typed-backpressure-aware dispatch over a ReplicaPool.
+
+The router is the fleet's front door and keeps the single-server submit
+contract (``submit(df, timeout_ms=..., priority=...) -> handle`` with a
+blocking, typed ``handle.result()``) so the load harness and any
+InferenceServer client drives a fleet unchanged. Behind it
+(docs/fleet.md):
+
+- **Policies** — ``least_loaded`` (fewest in-flight), ``hash`` (rendezvous
+  hashing on the request key: session affinity, and an ejected replica only
+  moves its own keys), ``priority`` (guaranteed traffic least-loaded,
+  sheddable traffic concentrated on the busiest replica so sheds land there
+  first).
+- **Backpressure protocol** — a replica's ``ServingOverloadedError`` is a
+  routing signal, not a failure: bounded jittered backoff honoring the
+  replica's own ``retry_after_ms`` drain estimate, then a retry on a
+  *different* replica. When every in-rotation replica has shed the same
+  request in one round, the router **fails fast** with the typed overload —
+  blind cross-replica retries under fleet-wide saturation are how a shed
+  becomes a collapse.
+- **Failover** — a dropped connection (``ReplicaUnavailableError``) retries
+  immediately on another replica; each dead replica is excluded for the
+  request's remaining life, so failovers are bounded by the pool size.
+- **Hedging** — once the request has waited past a configured quantile of
+  the router's observed latency window, a duplicate is dispatched to a
+  second replica and the first response wins (the p999 protocol). Hedges
+  are duplicates, never counted as fresh arrivals; the winning side is
+  visible as ``ml.fleet.hedge.wins``.
+- **Canary gate** — dispatches route to the canary slot only while the
+  pool's counter gate admits them (``ReplicaPool.canary_allowed``), keeping
+  the canary's traffic share a hard invariant.
+
+``fleet.dispatch`` is the router's chaos seam: every primary/retry dispatch
+trips it, and an injected fault surfaces typed to the caller with the pool's
+in-flight accounting balanced.
+"""
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+import time
+from typing import Callable, Optional, Set
+
+import flink_ml_tpu.telemetry as telemetry
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.faults import faults
+from flink_ml_tpu.fleet.errors import ReplicaUnavailableError
+from flink_ml_tpu.fleet.pool import ReplicaPool
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.serving.errors import ServingError, ServingOverloadedError
+
+__all__ = ["FleetRouter"]
+
+POLICIES = ("least_loaded", "hash", "priority")
+
+
+class _FailedPending:
+    """A dispatch that failed synchronously (a local replica's admission
+    control raises at submit) — normalized into the pending surface so every
+    typed error flows through one retry path on the collector thread."""
+
+    def __init__(self, error: BaseException):
+        self._error = error
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return True
+
+    def result(self):
+        raise self._error
+
+
+class _FleetHandle:
+    """One fleet request across its dispatches (primary, retries, hedge)."""
+
+    def __init__(self, router: "FleetRouter", df, timeout_ms, priority, key, pin):
+        self._router = router
+        self._df = df
+        self._timeout_ms = timeout_ms
+        self._priority = priority
+        self._key = key
+        self._pin = pin
+        self._t0 = router._clock()
+        #: replicas that shed this request in the current overload round
+        self._shed: Set[str] = set()
+        #: replicas that dropped the connection — excluded for good
+        self._failed: Set[str] = set()
+        self._attempts = 0
+        self.hedged = False  # read by the load harness's hedge accounting
+        self._pending = None
+        self._idx: Optional[int] = None
+        self._name: Optional[str] = None
+
+    def result(self):  # graftcheck: hot-root
+        router = self._router
+        pool = router._pool
+        while True:
+            try:
+                response = router._await(self)
+            except ServingOverloadedError as e:
+                pool.note_resolve(self._idx)
+                router._retry_overload(self, e)  # re-dispatches or raises
+            except ReplicaUnavailableError as e:
+                pool.note_resolve(self._idx)
+                router._failover(self, e)  # re-dispatches or raises
+            except BaseException:
+                pool.note_resolve(self._idx)
+                raise
+            else:
+                pool.note_resolve(self._idx)
+                router._observe_latency(self)
+                return response
+
+
+class FleetRouter:
+    """Routes the submit contract across a :class:`ReplicaPool`."""
+
+    def __init__(
+        self,
+        pool: ReplicaPool,
+        *,
+        policy: Optional[str] = None,
+        retry_attempts: Optional[int] = None,
+        retry_backoff_ms: Optional[float] = None,
+        retry_backoff_max_ms: Optional[float] = None,
+        retry_jitter: Optional[float] = None,
+        hedge_quantile: Optional[float] = None,
+        hedge_after_ms: Optional[float] = None,
+        hedge_min_ms: Optional[float] = None,
+        sheddable_priority: Optional[int] = None,
+        seed: int = 0,
+        clock: Callable[[], float] = time.perf_counter,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        cfg = pool.config
+        self._pool = pool
+        self.scope = pool.scope
+        self.policy = str(policy if policy is not None else cfg.policy)
+        if self.policy not in POLICIES:
+            raise ValueError(f"unknown fleet router policy {self.policy!r}; one of {POLICIES}")
+        self.retry_attempts = int(
+            retry_attempts if retry_attempts is not None else cfg.retry_attempts
+        )
+        self.retry_backoff_ms = float(
+            retry_backoff_ms if retry_backoff_ms is not None else cfg.retry_backoff_ms
+        )
+        self.retry_backoff_max_ms = float(
+            retry_backoff_max_ms if retry_backoff_max_ms is not None
+            else cfg.retry_backoff_max_ms
+        )
+        self.retry_jitter = float(
+            retry_jitter if retry_jitter is not None else cfg.retry_jitter
+        )
+        self.hedge_quantile = (
+            hedge_quantile if hedge_quantile is not None else cfg.hedge_quantile
+        )
+        #: Explicit trigger override (tests / fixed-SLO deployments); None =
+        #: derive from the live latency window at hedge_quantile.
+        self.hedge_after_ms = hedge_after_ms
+        self.hedge_min_ms = float(
+            hedge_min_ms if hedge_min_ms is not None else cfg.hedge_min_ms
+        )
+        self.sheddable_priority = int(
+            sheddable_priority if sheddable_priority is not None
+            else config.get(Options.SERVING_SHED_PRIORITY)
+        )
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self._seq = 0
+        self._latency = metrics.histogram(self.scope, MLMetrics.FLEET_LATENCY_MS)
+
+    # -- client API ------------------------------------------------------------
+    def submit(
+        self,
+        df: DataFrame,
+        timeout_ms: Optional[float] = None,
+        priority: int = 0,
+        *,
+        key=None,
+        pin: Optional[int] = None,
+    ):
+        """Route one request; returns a handle with blocking ``result()``.
+
+        ``key`` is the affinity key for the ``hash`` policy (defaults to a
+        router-wide sequence number). ``pin`` routes to one slot, bypassing
+        policy, slice gate, retries and hedging — the canary controller's
+        measurement path."""
+        handle = _FleetHandle(self, df, timeout_ms, priority, key, pin)
+        if pin is not None:
+            candidates = [c for c in self._pool.candidates() if c[0] == pin]
+            if not candidates:
+                raise ReplicaUnavailableError(
+                    f"pinned slot {pin} is not in rotation", replica=None
+                )
+            self._dispatch(handle, candidates[0], counted=False)
+        else:
+            choice = self._choose(priority, self._key_for(handle))
+            if choice is None:
+                raise ReplicaUnavailableError("no replica in rotation", replica=None)
+            self._dispatch(handle, choice)
+        return handle
+
+    def predict(
+        self, df: DataFrame, timeout_ms: Optional[float] = None, priority: int = 0, **kw
+    ):
+        return self.submit(df, timeout_ms=timeout_ms, priority=priority, **kw).result()
+
+    # -- dispatch --------------------------------------------------------------
+    def _key_for(self, handle: _FleetHandle):
+        if handle._key is not None:
+            return handle._key
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def _dispatch(self, handle: _FleetHandle, choice, *, counted: bool = True, trip: bool = True) -> None:  # graftcheck: hot-root
+        idx, name, replica, canary, _inflight = choice
+        if trip:
+            faults.trip("fleet.dispatch", replica=name, priority=handle._priority)
+        self._pool.note_dispatch(idx, canary=canary and counted, counted=counted)
+        try:
+            pending = replica.submit(
+                handle._df, timeout_ms=handle._timeout_ms, priority=handle._priority
+            )
+        except ServingError as e:
+            # Synchronous admission rejection (local replicas): normalize into
+            # the pending surface so one retry path handles both isolations.
+            pending = _FailedPending(e)
+        handle._pending = pending
+        handle._idx = idx
+        handle._name = name
+        handle._attempts += 1
+
+    def _choose(self, priority: int, key, exclude: Optional[Set[str]] = None):
+        """One routing decision over the current rotation snapshot."""
+        exclude = exclude or set()
+        candidates = [
+            c for c in self._pool.candidates() if c[1] not in exclude
+        ]
+        non_canary = [c for c in candidates if not c[3]]
+        if non_canary:
+            eligible = list(non_canary)
+            if self._pool.canary_allowed():
+                eligible += [c for c in candidates if c[3]]
+        else:
+            # Degenerate rotation (only canary slots left): availability
+            # outranks the slice — with zero baseline replicas there is no
+            # baseline traffic to bound against.
+            eligible = candidates
+        if not eligible:
+            return None
+        if self.policy == "hash":
+            return max(eligible, key=lambda c: self._rendezvous(key, c[1]))
+        if self.policy == "priority" and priority >= self.sheddable_priority:
+            # Sheddable traffic piles onto the busiest replica: its controller
+            # sheds first while guaranteed traffic keeps headroom elsewhere.
+            return max(eligible, key=lambda c: (c[4], -c[0]))
+        return min(eligible, key=lambda c: (c[4], c[0]))
+
+    @staticmethod
+    def _rendezvous(key, name: str) -> int:
+        digest = hashlib.md5(f"{key}|{name}".encode("utf-8")).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    # -- waiting / hedging -----------------------------------------------------
+    def _hedge_trigger_ms(self) -> Optional[float]:
+        if self.hedge_after_ms is not None:
+            return float(self.hedge_after_ms)
+        if self.hedge_quantile is None:
+            return None
+        if self._latency.count < 32:
+            return None  # window too cold to know what "tail" means
+        q = self._latency.quantile(float(self.hedge_quantile))
+        if q is None:
+            return None
+        return max(float(q), self.hedge_min_ms)
+
+    def _await(self, handle: _FleetHandle):
+        """Block on the current pending; once past the hedge trigger, race a
+        duplicate on a second replica — first response wins."""
+        pending = handle._pending
+        trigger_ms = (
+            None if (handle.hedged or handle._pin is not None)
+            else self._hedge_trigger_ms()
+        )
+        if trigger_ms is None:
+            return pending.result()
+        if pending.wait(trigger_ms / 1000.0):
+            return pending.result()
+        choice = self._choose(
+            handle._priority,
+            self._key_for(handle),
+            exclude={handle._name} | handle._failed,
+        )
+        if choice is None:
+            return pending.result()  # nowhere to hedge: keep waiting
+        handle.hedged = True
+        metrics.counter(self.scope, MLMetrics.FLEET_HEDGES)
+        primary_idx, primary_name = handle._idx, handle._name
+        # A hedge is a DUPLICATE of a live request, not a new dispatch
+        # decision — the chaos seam stays on the primary/retry path.
+        self._dispatch(handle, choice, trip=False)
+        hedge_pending, hedge_idx = handle._pending, handle._idx
+        while True:
+            if pending.wait(0.005):
+                # Primary won: the hedge is abandoned (its replica still
+                # finishes server-side; the reply is dropped at the socket).
+                self._pool.note_resolve(hedge_idx)
+                handle._pending, handle._idx, handle._name = (
+                    pending, primary_idx, primary_name,
+                )
+                return pending.result()
+            if hedge_pending.wait(0.0):
+                metrics.counter(self.scope, MLMetrics.FLEET_HEDGE_WINS)
+                self._pool.note_resolve(primary_idx)
+                return hedge_pending.result()
+
+    # -- retry / failover ------------------------------------------------------
+    def _retry_overload(self, handle: _FleetHandle, e: ServingOverloadedError) -> None:
+        """Backoff-and-retry on a different replica, fail fast when the whole
+        fleet sheds; raises when the request is out of road."""
+        if handle._pin is not None:
+            raise e  # pinned measurement traffic never wanders
+        handle._shed.add(handle._name)
+        rotation = {c[1] for c in self._pool.candidates()}
+        if rotation and rotation.issubset(handle._shed):
+            metrics.counter(self.scope, MLMetrics.FLEET_FAILFAST)
+            telemetry.emit(
+                "fleet.failfast",
+                self.scope,
+                {
+                    "shed_by": sorted(handle._shed),
+                    "priority": handle._priority,
+                    "retry_after_ms": e.retry_after_ms,
+                },
+            )
+            raise e
+        if handle._attempts >= self.retry_attempts:
+            raise e
+        base_ms = e.retry_after_ms if e.retry_after_ms is not None else self.retry_backoff_ms
+        delay_ms = min(float(base_ms), self.retry_backoff_max_ms)
+        with self._lock:
+            delay_ms *= 1.0 + self.retry_jitter * self._rng.random()
+        self._sleep(delay_ms / 1000.0)
+        choice = self._choose(
+            handle._priority,
+            self._key_for(handle),
+            exclude=handle._shed | handle._failed,
+        )
+        if choice is None:
+            raise e
+        metrics.counter(self.scope, MLMetrics.FLEET_RETRIES)
+        self._dispatch(handle, choice)
+
+    def _failover(self, handle: _FleetHandle, e: ReplicaUnavailableError) -> None:
+        """Immediate redispatch after a connection loss — the dead replica is
+        excluded for this request's remaining life, so failovers are bounded
+        by the pool size (they never consume the overload retry budget)."""
+        if handle._pin is not None:
+            raise e
+        if handle._name is not None:
+            handle._failed.add(handle._name)
+        choice = self._choose(
+            handle._priority,
+            self._key_for(handle),
+            exclude=handle._failed | handle._shed,
+        )
+        if choice is None:
+            raise ReplicaUnavailableError(
+                f"no replica left in rotation after {sorted(handle._failed)} failed",
+                replica=None,
+            )
+        metrics.counter(self.scope, MLMetrics.FLEET_FAILOVERS)
+        self._dispatch(handle, choice)
+
+    def _observe_latency(self, handle: _FleetHandle) -> None:
+        self._latency.observe((self._clock() - handle._t0) * 1000.0)

@@ -1,0 +1,207 @@
+"""ReplicaSupervisor — health-checks the pool, ejects, respawns, re-admits.
+
+The fleet's failure-handling loop, built on the same restart machinery the
+training tier uses (``execution.Supervisor`` + ``RestartStrategies``): a
+replica that fails ``/healthz`` ``fleet.health.failures`` times in a row is
+**ejected** from rotation (the router stops sending it traffic immediately),
+then respawned through a per-slot restart strategy. Each respawn attempt
+kills the old process, re-invokes the pool's replica factory at the current
+fleet version, and — the re-admission gate — must pass a live health check
+before the slot returns to ``serving``. The shared plan cache makes the
+respawn O(model load), not O(XLA compile): the replacement warms from
+serialized executables, which fleet_smoke proves by asserting zero
+serving-path compiles on the rejoined replica (docs/plancache.md).
+
+When the restart budget is exhausted the slot is marked ``dead`` and the
+fleet keeps serving on the survivors — capacity degrades, correctness does
+not. Every eject / respawn attempt / readmit / dead transition is journaled
+with its evidence (consecutive failure count, last health payload, attempt
+number) via the pool's ledger plus the execution supervisor's own
+``execution.restart`` records.
+
+``fleet.respawn`` is the chaos seam: it trips at the head of every respawn
+attempt, the restart strategy absorbs injected faults (``InjectedFault`` is
+retryable by construction), and a slot is only ever re-admitted after an
+attempt that ran the health gate clean.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import flink_ml_tpu.telemetry as telemetry
+from flink_ml_tpu.execution.classify import ErrorClassifier
+from flink_ml_tpu.execution.restart import RestartStrategy, RestartStrategies
+from flink_ml_tpu.execution.supervisor import Supervisor
+from flink_ml_tpu.faults import faults
+from flink_ml_tpu.fleet.errors import ReplicaUnavailableError
+from flink_ml_tpu.fleet.pool import ReplicaPool
+from flink_ml_tpu.metrics import MLMetrics, metrics
+
+__all__ = ["ReplicaSupervisor"]
+
+
+class ReplicaSupervisor:
+    """Health loop + eject/respawn/readmit state machine over a pool."""
+
+    def __init__(
+        self,
+        pool: ReplicaPool,
+        *,
+        factory: Optional[Callable] = None,
+        interval_ms: Optional[float] = None,
+        fail_threshold: Optional[int] = None,
+        strategy_factory: Optional[Callable[[], RestartStrategy]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        cfg = pool.config
+        self._pool = pool
+        self._factory = factory if factory is not None else pool.factory
+        self.interval_s = float(
+            interval_ms if interval_ms is not None else cfg.health_interval_ms
+        ) / 1000.0
+        self.fail_threshold = int(
+            fail_threshold if fail_threshold is not None else cfg.health_failures
+        )
+        # Per-respawn restart budget: 3 immediate attempts by default, same
+        # CI-friendly default as the training supervisor.
+        self._strategy_factory = strategy_factory or (
+            lambda: RestartStrategies.fixed_delay_restart(3, 0.0)
+        )
+        self._classifier = ErrorClassifier(extra_retryable=(ReplicaUnavailableError,))
+        self._clock = clock
+        self._sleep = sleep
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one sweep -------------------------------------------------------------
+    def check_once(self) -> None:
+        """Probe every serving slot once; eject-and-respawn any slot past the
+        consecutive-failure threshold. Deterministic unit of the health loop —
+        tests drive it directly, the background thread just paces it."""
+        pool = self._pool
+        for index in range(pool.size):
+            slot = pool.slot(index)
+            with pool._lock:
+                if slot.state != "serving":
+                    continue
+                replica = slot.replica
+                name = slot.name
+            try:
+                ok, payload = replica.health_check()
+            except Exception as e:  # noqa: BLE001 — a probe crash IS unhealth
+                ok, payload = False, {"status": "probe-error", "error": type(e).__name__}
+            with pool._lock:
+                if slot.state != "serving":
+                    continue  # membership changed under us; skip this round
+                if ok:
+                    slot.consecutive_failures = 0
+                    continue
+                slot.consecutive_failures += 1
+                failures = slot.consecutive_failures
+                should_eject = failures >= self.fail_threshold
+            if should_eject:
+                self._eject_and_respawn(
+                    index, name, failures=failures, payload=payload
+                )
+
+    def _eject_and_respawn(self, index: int, name: str, *, failures: int, payload) -> bool:  # graftcheck: cold
+        pool = self._pool
+        old = pool.slot(index).replica
+        pool.eject(
+            index,
+            reason="health-check",
+            evidence={
+                "consecutive_failures": failures,
+                "threshold": self.fail_threshold,
+                "health": payload if isinstance(payload, dict) else {"status": str(payload)},
+            },
+        )
+
+        def reap(replica, stage: str) -> None:
+            """Kill a replica that is already being replaced; its failure to
+            die cleanly is evidence, not a new failure mode."""
+            try:
+                replica.kill()
+            except Exception as e:  # noqa: BLE001 — already dead is fine here
+                telemetry.emit(
+                    "fleet.reap.error",
+                    pool.scope,
+                    {"replica": name, "stage": stage, "error": type(e).__name__},
+                )
+
+        def attempt():
+            faults.trip("fleet.respawn", replica=name, slot=index)
+            reap(old, "pre-respawn")  # idempotent; frees the port/pid first
+            metrics.counter(pool.scope, MLMetrics.FLEET_RESPAWNS)
+            replacement = self._factory(index, name, pool.fleet_version)
+            ok, health = replacement.health_check()
+            if not ok:
+                reap(replacement, "failed-readmission")
+                raise ReplicaUnavailableError(
+                    f"respawned replica {name} failed the re-admission health "
+                    f"check: {health}",
+                    replica=name,
+                )
+            return replacement
+
+        supervisor = Supervisor(
+            strategy=self._strategy_factory(),
+            classifier=self._classifier,
+            name=f"fleet-respawn[{name}]",
+            clock=self._clock,
+            sleep=self._sleep,
+        )
+        try:
+            replacement = supervisor.run(attempt)
+        except Exception as e:  # noqa: BLE001 — budget exhausted or fatal
+            pool.mark_dead(index, e)
+            return False
+        pool.readmit(index, replacement)
+        telemetry.emit(
+            "fleet.respawn",
+            pool.scope,
+            {
+                "replica": name,
+                "slot": index,
+                "attempts": supervisor.attempts,
+                "version": pool.fleet_version,
+            },
+        )
+        return True
+
+    # -- background loop -------------------------------------------------------
+    def start(self) -> "ReplicaSupervisor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"fleet-supervisor[{self._pool.name}]"
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check_once()
+            except Exception as e:  # noqa: BLE001 — the health loop must not die
+                telemetry.emit(
+                    "fleet.supervisor.error",
+                    self._pool.scope,
+                    {"error": type(e).__name__, "detail": str(e)[:200]},
+                )
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)
+
+    def __enter__(self) -> "ReplicaSupervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

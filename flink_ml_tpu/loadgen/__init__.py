@@ -33,8 +33,10 @@ from flink_ml_tpu.loadgen.generator import (
     OpenLoopLoadGenerator,
     StepStats,
 )
+from flink_ml_tpu.loadgen.retry import RetryPolicy
 
 __all__ = [
+    "RetryPolicy",
     "Arrival",
     "Schedule",
     "PoissonArrivals",

@@ -56,7 +56,7 @@ class StepStats:
         "step", "offered_rps", "duration_s", "arrivals", "offered_rows",
         "submitted", "completed", "shed", "rejected",
         "deadline_miss_queued", "deadline_miss_dispatch", "injected",
-        "typed_errors", "unexpected", "latencies_ms",
+        "typed_errors", "retries", "hedges", "unexpected", "latencies_ms",
         "first_shed_at_s", "max_lag_s", "by_priority", "_lock",
     )
 
@@ -74,6 +74,11 @@ class StepStats:
         self.deadline_miss_dispatch = 0
         self.injected = 0  # InjectedFault in any seam (tick/admit/dispatch)
         self.typed_errors = 0  # other ServingError (closed, no model, ...)
+        # Client-added load, NEVER arrivals: resubmissions under the retry
+        # policy, and router-duplicated (hedged) requests. Kept out of
+        # ``resolved`` — each arrival still ends in exactly one bin.
+        self.retries = 0
+        self.hedges = 0
         self.unexpected: List[BaseException] = []  # MUST stay empty in chaos runs
         self.latencies_ms: List[float] = []
         self.first_shed_at_s: Optional[float] = None  # step-relative, shed OR reject
@@ -126,6 +131,14 @@ class StepStats:
     def note_injected(self) -> None:
         with self._lock:
             self.injected += 1
+
+    def note_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def note_hedge(self) -> None:
+        with self._lock:
+            self.hedges += 1
 
     def note_typed_error(self) -> None:
         with self._lock:
@@ -183,6 +196,8 @@ class StepStats:
                 "deadline_miss_dispatch": self.deadline_miss_dispatch,
                 "injected": self.injected,
                 "typed_errors": self.typed_errors,
+                "retries": self.retries,
+                "hedges": self.hedges,
                 "unexpected": len(self.unexpected),
                 "latency_p50_ms": _percentile(ordered, 0.5),
                 "latency_p99_ms": _percentile(ordered, 0.99),
@@ -244,6 +259,13 @@ class OpenLoopLoadGenerator:
     ``priority -> ms`` (per-SLO deadlines — tight for best-effort, generous
     for guaranteed traffic). ``clock``/``sleep`` default to the wall clock
     and are injectable for virtual-time replay.
+
+    ``retry`` (a :class:`~flink_ml_tpu.loadgen.retry.RetryPolicy`) makes the
+    harness a well-behaved overloaded client: a typed overload is resubmitted
+    after the replica's ``retry_after_ms`` (jittered, bounded attempts)
+    instead of being binned immediately. Retries run on the collector pool —
+    the driver thread never sleeps a backoff, so the schedule stays open-loop
+    — and are counted in ``StepStats.retries``, never as fresh arrivals.
     """
 
     def __init__(
@@ -253,6 +275,7 @@ class OpenLoopLoadGenerator:
         *,
         timeout_ms=10_000.0,
         collectors: int = 8,
+        retry=None,
         clock: Callable[[], float] = time.perf_counter,
         sleep: Callable[[float], None] = time.sleep,
     ):
@@ -260,6 +283,7 @@ class OpenLoopLoadGenerator:
         self.request_factory = request_factory
         self._timeout_ms = timeout_ms
         self.collectors = max(1, int(collectors))
+        self.retry = retry
         self._clock = clock
         self._sleep = sleep
 
@@ -288,25 +312,85 @@ class OpenLoopLoadGenerator:
             return LoadReport([], 0.0)
         pending: "queue.Queue" = queue.Queue()
 
+        def resolve(arrival, df, handle, attempt, rel_s, last_overload) -> None:
+            """Drive one arrival to its single bin, resubmitting overloads
+            under the retry policy (collector-side, so backoff sleeps never
+            touch the driver's schedule)."""
+            stats: StepStats = steps[arrival.step]
+            while True:
+                if handle is None:
+                    # Retry entry: back off per the replica's hint, resubmit.
+                    self._sleep(
+                        self.retry.delay_s(
+                            attempt, getattr(last_overload, "retry_after_ms", None)
+                        )
+                    )
+                    try:
+                        handle = target.submit(
+                            df,
+                            timeout_ms=self.timeout_ms_for(arrival.priority),
+                            priority=arrival.priority,
+                        )
+                    except ServingOverloadedError as e:
+                        if attempt < self.retry.attempts:
+                            attempt += 1
+                            stats.note_retry()
+                            last_overload = e
+                            handle = None
+                            continue
+                        stats.note_overload(arrival.priority, e, rel_s)
+                        return
+                    except InjectedFault:
+                        stats.note_injected()
+                        return
+                    except ServingError:
+                        stats.note_typed_error()
+                        return
+                    except BaseException as e:  # noqa: BLE001 — the chaos bin
+                        stats.note_unexpected(e)
+                        return
+                    else:
+                        stats.note_submitted()
+                try:
+                    try:
+                        response = handle.result()
+                    finally:
+                        # The router flips ``hedged`` during result() when it
+                        # duplicates the request — count each handle once,
+                        # whatever bin it lands in.
+                        if getattr(handle, "hedged", False):
+                            stats.note_hedge()
+                except ServingOverloadedError as e:
+                    if self.retry is not None and attempt < self.retry.attempts:
+                        attempt += 1
+                        stats.note_retry()
+                        last_overload = e
+                        handle = None
+                        continue
+                    stats.note_overload(arrival.priority, e, rel_s)
+                    return
+                except ServingDeadlineError as e:
+                    stats.note_deadline(arrival.priority, e)
+                    return
+                except InjectedFault:
+                    stats.note_injected()
+                    return
+                except ServingError:
+                    stats.note_typed_error()
+                    return
+                except BaseException as e:  # noqa: BLE001 — the chaos bin
+                    stats.note_unexpected(e)
+                    return
+                else:
+                    stats.note_completed(arrival.priority, response.latency_ms)
+                    return
+
         def collect() -> None:
             while True:
                 item = pending.get()
                 if item is _DONE:
                     return
-                arrival, handle = item
-                stats: StepStats = steps[arrival.step]
-                try:
-                    response = handle.result()
-                except ServingDeadlineError as e:
-                    stats.note_deadline(arrival.priority, e)
-                except InjectedFault:
-                    stats.note_injected()
-                except ServingError:
-                    stats.note_typed_error()
-                except BaseException as e:  # noqa: BLE001 — the chaos bin
-                    stats.note_unexpected(e)
-                else:
-                    stats.note_completed(arrival.priority, response.latency_ms)
+                resolve(*item)
 
         threads = [
             threading.Thread(target=collect, name=f"loadgen-collector-{i}", daemon=True)
@@ -344,7 +428,14 @@ class OpenLoopLoadGenerator:
                     priority=arrival.priority,
                 )
             except ServingOverloadedError as e:
-                stats.note_overload(arrival.priority, e, step_rel_s)
+                if self.retry is not None and self.retry.attempts > 0:
+                    # Hand the arrival to the collector pool for backoff +
+                    # resubmit — the driver must not sleep a backoff, or the
+                    # schedule stops being open-loop.
+                    stats.note_retry()
+                    pending.put((arrival, df, None, 1, step_rel_s, e))
+                else:
+                    stats.note_overload(arrival.priority, e, step_rel_s)
             except InjectedFault:
                 stats.note_injected()
             except ServingError:
@@ -353,7 +444,7 @@ class OpenLoopLoadGenerator:
                 stats.note_unexpected(e)
             else:
                 stats.note_submitted()
-                pending.put((arrival, handle))
+                pending.put((arrival, df, handle, 0, step_rel_s, None))
 
         for _ in threads:
             pending.put(_DONE)

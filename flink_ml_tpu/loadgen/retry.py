@@ -1,0 +1,71 @@
+"""Client-side retry policy for the load harness.
+
+A serving replica's ``ServingOverloadedError`` carries ``retry_after_ms`` —
+its own estimate of when the queue will have drained. A well-behaved client
+honors it: retrying sooner re-offers the same work to the same full queue,
+retrying much later wastes the seat the controller just freed. This policy
+object is that behavior as data: bounded attempts, the replica's
+``retry_after_ms`` (capped) or exponential backoff when absent, and
+positive jitter so a fleet of retrying clients does not re-arrive as one
+synchronized wave.
+
+The harness keeps retries honest in the accounting: a retry is **not** a
+fresh arrival — ``StepStats`` counts ``retries`` (and router ``hedges``)
+separately, so offered load and client-added load never blur
+(docs/loadgen.md).
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+__all__ = ["RetryPolicy"]
+
+
+class RetryPolicy:
+    """Bounded, jittered, ``retry_after_ms``-honoring resubmission policy.
+
+    ``attempts`` is the number of *re*-submissions allowed per arrival (0
+    disables retrying). ``delay_s(attempt, retry_after_ms)`` gives the
+    backoff before retry number ``attempt`` (1-based): the server's hint
+    when present (and ``honor_retry_after``), else ``backoff_ms`` doubling
+    per attempt; capped at ``backoff_max_ms`` before jitter, then stretched
+    by up to ``jitter`` (uniform, seeded — deterministic under test).
+    """
+
+    def __init__(
+        self,
+        attempts: int = 3,
+        *,
+        backoff_ms: float = 10.0,
+        backoff_max_ms: float = 1000.0,
+        jitter: float = 0.5,
+        honor_retry_after: bool = True,
+        seed: int = 0,
+    ):
+        if attempts < 0:
+            raise ValueError(f"attempts must be >= 0, got {attempts}")
+        self.attempts = int(attempts)
+        self.backoff_ms = float(backoff_ms)
+        self.backoff_max_ms = float(backoff_max_ms)
+        self.jitter = float(jitter)
+        self.honor_retry_after = bool(honor_retry_after)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def delay_s(self, attempt: int, retry_after_ms: Optional[float] = None) -> float:
+        if self.honor_retry_after and retry_after_ms is not None:
+            base_ms = float(retry_after_ms)
+        else:
+            base_ms = self.backoff_ms * (2.0 ** max(0, attempt - 1))
+        base_ms = min(base_ms, self.backoff_max_ms)
+        with self._lock:
+            base_ms *= 1.0 + self.jitter * self._rng.random()
+        return base_ms / 1000.0
+
+    def __repr__(self) -> str:
+        return (
+            f"RetryPolicy(attempts={self.attempts}, backoff_ms={self.backoff_ms}, "
+            f"backoff_max_ms={self.backoff_max_ms}, jitter={self.jitter})"
+        )

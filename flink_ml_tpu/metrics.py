@@ -97,6 +97,27 @@ class MLMetrics:
     LOOP_DRIFT_BASELINE = "ml.loop.drift.baseline"  # reference version score, gauge
     LOOP_DRIFT_REGRESSIONS = "ml.loop.drift.regressions"  # threshold trips, counter
 
+    # Fleet serving (flink_ml_tpu/fleet — supervised replica pool + router;
+    # scope = "ml.fleet[<fleet name>]", docs/fleet.md has the table).
+    FLEET_GROUP = "ml.fleet"
+    FLEET_DISPATCHES = "ml.fleet.dispatches"  # requests dispatched to a replica, counter
+    FLEET_RETRIES = "ml.fleet.retries"  # overload retries to a different replica, counter
+    FLEET_FAILOVERS = "ml.fleet.failovers"  # redispatches after a replica connection loss, counter
+    FLEET_HEDGES = "ml.fleet.hedges"  # duplicate tail-latency dispatches, counter
+    FLEET_HEDGE_WINS = "ml.fleet.hedge.wins"  # hedged duplicate answered first, counter
+    FLEET_FAILFAST = "ml.fleet.failfast"  # whole-fleet-shedding fail-fasts, counter
+    FLEET_EJECTS = "ml.fleet.ejects"  # replicas taken out of rotation, counter
+    FLEET_RESPAWNS = "ml.fleet.respawns"  # respawn attempts started, counter
+    FLEET_READMITS = "ml.fleet.readmits"  # respawned replicas back in rotation, counter
+    FLEET_DEAD = "ml.fleet.replicas.dead"  # slots whose restart budget exhausted, counter
+    FLEET_LIVE = "ml.fleet.replicas.live"  # in-rotation replicas, gauge
+    FLEET_SIZE = "ml.fleet.replicas.total"  # pool slots, gauge
+    FLEET_CANARY_STARTED = "ml.fleet.canary.started"  # canary evaluations begun, counter
+    FLEET_CANARY_PROMOTED = "ml.fleet.canary.promoted"  # versions promoted fleet-wide, counter
+    FLEET_CANARY_QUARANTINED = "ml.fleet.canary.quarantined"  # regressed canaries set aside, counter
+    FLEET_CANARY_DISPATCHES = "ml.fleet.canary.dispatches"  # slice-gated canary dispatches, counter
+    FLEET_LATENCY_MS = "ml.fleet.latency.ms"  # router-observed submit->response, histogram
+
     # Goodput attribution (flink_ml_tpu.trace — the ML Productivity Goodput
     # accounting; one gauge set per traced scope, docs/observability.md).
     GOODPUT_GROUP = "ml.goodput"

@@ -418,8 +418,18 @@ def resolve_plan_cache() -> Optional[PlanCache]:
     key = (os.path.abspath(str(directory)), int(config.get(Options.PLANCACHE_MAX_BYTES)))
     with _CACHES_LOCK:
         cache = _CACHES.get(key)
+    if cache is not None:
+        return cache
+    # Construction scans/creates the directory — blocking I/O that must not
+    # run under the registry lock (a slow disk would stall every serving
+    # thread resolving the cache). Build outside, publish inside: a racing
+    # thread may build a second candidate, but exactly one wins the dict and
+    # the loser's object is garbage (its mkdir/scan side effects idempotent).
+    candidate = PlanCache(key[0], key[1])
+    with _CACHES_LOCK:
+        cache = _CACHES.get(key)
         if cache is None:
-            cache = PlanCache(key[0], key[1])
+            cache = candidate
             _CACHES[key] = cache
             _enable_xla_cache_tier(key[0])
         return cache

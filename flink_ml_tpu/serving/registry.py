@@ -28,6 +28,7 @@ not cost availability. The contract here —
 from __future__ import annotations
 
 import os
+import random
 import shutil
 import threading
 import time
@@ -56,18 +57,31 @@ def quarantine_version(directory: str, version: int) -> Optional[str]:
     checkpoint tier's corrupt-snapshot semantics (``ckpt-N.corrupt``): kept for
     forensics, invisible to ``scan_numbered_dirs`` (the suffixed name no longer
     parses), so neither a poller nor a restarted loop can ever reload it.
-    Idempotent: a version already quarantined (or never published) returns
-    None, so a supervised retry that crashed mid-rollback just falls through.
+    Idempotent under concurrency: two rollback controllers racing on the same
+    bad version (a fleet-wide quarantine) must produce exactly ONE
+    ``.quarantined`` dir and one journal record. The rename itself is the
+    arbiter — a bare ``exists``-then-``rename`` would let both threads pass
+    the check and the loser either crash or, worse, rename the winner's
+    ``.quarantined`` dir again. Only the thread whose ``os.rename`` succeeds
+    returns the destination (and journals); every loser sees
+    ``FileNotFoundError`` and returns None, same as a version never
+    published.
     """
     src = os.path.join(directory, f"{VERSION_PREFIX}{version}")
-    if not os.path.exists(src):
-        return None
     dst = src + _QUARANTINE_SUFFIX
     n = 0
     while os.path.exists(dst):
         n += 1
         dst = f"{src}{_QUARANTINE_SUFFIX}.{n}"
-    os.rename(src, dst)
+    try:
+        os.rename(src, dst)
+    except FileNotFoundError:
+        return None  # already quarantined (or never published) — a no-op
+    telemetry.emit(
+        "serving.quarantine",
+        f"{MLMetrics.SERVING_GROUP}[{os.path.basename(directory) or directory}]",
+        {"version": version, "path": dst},
+    )
     return dst
 
 
@@ -171,6 +185,8 @@ class ModelVersionPoller:
         loader: Optional[Callable[[str], object]] = None,
         warmup: Optional[Callable[[object], None]] = None,
         interval_ms: Optional[float] = None,
+        backoff_max_ms: Optional[float] = None,
+        backoff_seed: int = 0,
         on_swap: Optional[Callable[[int, object], None]] = None,
     ):
         if loader is None:
@@ -189,6 +205,18 @@ class ModelVersionPoller:
             if interval_ms is not None
             else config.get(Options.SERVING_POLL_INTERVAL_MS)
         ) / 1000.0
+        self.backoff_max_s = (
+            float(backoff_max_ms)
+            if backoff_max_ms is not None
+            else config.get(Options.SERVING_POLL_BACKOFF_MAX_MS)
+        ) / 1000.0
+        # Scan-failure backoff (a publish dir on flaky network storage must
+        # not be hammered at the poll interval): consecutive errors double the
+        # wait up to the cap, with jitter so a fleet of replicas polling the
+        # same dead share desynchronizes; one clean scan resets to interval_s.
+        self._rng = random.Random(backoff_seed)
+        self._consecutive_errors = 0
+        self._next_wait_s = self.interval_s
         #: Versions that failed to load/warm (with the error) — written by the
         #: poller thread, read by manual pollers (the continuous loop) and
         #: operator introspection, so every access holds ``_lock``.
@@ -228,6 +256,37 @@ class ModelVersionPoller:
     def known_failed(self, version: int) -> bool:
         with self._lock:
             return version in self.failed
+
+    # -- scan-failure backoff --------------------------------------------------
+    def _note_scan_ok(self) -> None:
+        with self._lock:
+            self._consecutive_errors = 0
+            self._next_wait_s = self.interval_s
+
+    def _note_scan_error(self) -> None:
+        with self._lock:
+            self._consecutive_errors += 1
+            base = min(
+                self.interval_s * (2.0 ** (self._consecutive_errors - 1)),
+                self.backoff_max_s,
+            )
+            # Full positive jitter (up to +50%), still capped.
+            self._next_wait_s = min(
+                base * (1.0 + 0.5 * self._rng.random()), self.backoff_max_s
+            )
+
+    def backoff_state(self) -> Dict[str, object]:
+        """The poller's backoff posture — surfaced in the server's /healthz
+        payload so a replica quietly stuck on an unreadable publish dir is
+        visible from the outside."""
+        with self._lock:
+            return {
+                "consecutive_errors": self._consecutive_errors,
+                "next_wait_s": self._next_wait_s,
+                "interval_s": self.interval_s,
+                "backoff_max_s": self.backoff_max_s,
+                "backing_off": self._consecutive_errors > 0,
+            }
 
     # -- one scan -------------------------------------------------------------
     def poll_once(self) -> Optional[int]:
@@ -272,9 +331,16 @@ class ModelVersionPoller:
             except Exception:
                 # A scan error must not kill the poller, but it must not be
                 # invisible either: ml.serving.poll.errors is the alarm for a
-                # publish directory that stopped being readable.
+                # publish directory that stopped being readable — and
+                # consecutive errors back the scan cadence off exponentially
+                # (jittered, capped) instead of hammering the dead directory.
                 metrics.counter(self.registry.scope, MLMetrics.SERVING_POLL_ERRORS)
-            self._stop.wait(self.interval_s)
+                self._note_scan_error()
+            else:
+                self._note_scan_ok()
+            with self._lock:
+                wait_s = self._next_wait_s
+            self._stop.wait(wait_s)
 
     def stop(self) -> None:
         self._stop.set()

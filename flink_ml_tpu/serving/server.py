@@ -216,6 +216,10 @@ class InferenceServer:
         self.registry = ModelRegistry(self.scope)
         self._warmup_template = warmup_template
         self._template_lock = threading.Lock()
+        # Lifecycle state shared between client threads (submit/health — a
+        # fleet worker serves them from per-connection threads) and whoever
+        # drives attach_poller/close: one lock, consistent everywhere.
+        self._state_lock = threading.Lock()
         self._poller: Optional[ModelVersionPoller] = None
         self._closed = False
         # Mesh-sharded serving (serving.mesh > 1, docs/serving.md): one
@@ -375,7 +379,9 @@ class InferenceServer:
         self, df: DataFrame, timeout_ms: Optional[float] = None, priority: int = 0
     ):
         """Async variant of ``predict``: returns a handle with ``.result()``."""
-        if self._closed:
+        with self._state_lock:
+            closed = self._closed
+        if closed:
             raise ServingClosedError("server is closed")
         self._remember_template(df)
         timeout_s = (
@@ -466,18 +472,20 @@ class InferenceServer:
     ) -> ModelVersionPoller:
         """Watch ``directory`` for published versions (see
         ``registry.publish_servable``) and hot-swap them in as they appear."""
-        if self._poller is not None:
-            raise RuntimeError("a poller is already attached")
-        self._poller = ModelVersionPoller(
+        poller = ModelVersionPoller(
             directory,
             self.registry,
             loader=loader,
             warmup=self.warmup,
             interval_ms=interval_ms if interval_ms is not None else self.config.poll_interval_ms,
         )
+        with self._state_lock:
+            if self._poller is not None:
+                raise RuntimeError("a poller is already attached")
+            self._poller = poller
         if start:
-            self._poller.start()
-        return self._poller
+            poller.start()
+        return poller
 
     @property
     def model_version(self) -> Optional[int]:
@@ -490,7 +498,10 @@ class InferenceServer:
         in-flight work finishes). A live server with no model yet reports
         ``status="no-model"`` but stays 200: it is healthy, just unwarmed."""
         draining = self._batcher.draining
-        closed = self._closed or self._batcher.closed
+        with self._state_lock:
+            closed_flag = self._closed
+            poller = self._poller
+        closed = closed_flag or self._batcher.closed
         version = self.registry.version
         payload = {
             "status": (
@@ -512,6 +523,10 @@ class InferenceServer:
             "controller": (
                 self.controller.state() if self.controller is not None else None
             ),
+            # A poller stuck backing off on an unreadable publish dir is a
+            # replica that silently stops taking model updates — /healthz is
+            # where an operator (or the fleet supervisor) sees it.
+            "poller": poller.backoff_state() if poller is not None else None,
         }
         return (not closed and not draining), payload
 
@@ -526,11 +541,13 @@ class InferenceServer:
         """Stop the poller and the batcher. ``drain=True`` (default) serves
         everything already queued before returning — graceful; ``drain=False``
         fails queued requests with ``ServingClosedError``."""
-        if self._closed:
-            return
-        self._closed = True
-        if self._poller is not None:
-            self._poller.stop()
+        with self._state_lock:
+            if self._closed:
+                return
+            self._closed = True
+            poller = self._poller
+        if poller is not None:
+            poller.stop()  # joins the poll thread — must run outside the lock
         self._batcher.close(drain=drain)
         # The endpoint outlives the batcher drain so /healthz answers 503
         # through the whole shutdown window, then stops last.

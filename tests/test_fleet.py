@@ -1,0 +1,695 @@
+"""Fleet serving tests (flink_ml_tpu/fleet/) — docs/fleet.md.
+
+The acceptance contract of the fleet pillar, exercised on deterministic
+in-process replicas (scripted fakes for routing/supervision logic,
+``LocalReplica`` over a real ``InferenceServer`` for the integration proof):
+
+- router: policy choice (least-loaded / rendezvous-hash affinity /
+  priority), typed-backpressure retries honoring ``retry_after_ms``,
+  fail-fast when the whole rotation sheds, immediate failover on a dropped
+  replica, hedged requests past the trigger with first-response-wins;
+- pool: the canary slice counter gate as a hard invariant, in-flight
+  accounting balanced through every error path;
+- supervisor: consecutive-failure eject, respawn through the execution
+  restart strategy, health-gated re-admission, dead after budget exhaustion;
+- canary controller: scan → canary → drift-scored verdict → rolling
+  quorum-gated promotion or quarantine via the rollback path;
+- chaos seams: deterministic injection at ``fleet.dispatch``,
+  ``fleet.respawn`` and ``fleet.promote`` — typed surfacing, balanced
+  accounting, exactly-once completion on retry;
+- fleetview: the merged decision timeline reconstructs membership and
+  rollout history from the journals alone.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import flink_ml_tpu.telemetry as telemetry
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.faults import InjectedFault, faults
+from flink_ml_tpu.fleet import (
+    CanaryController,
+    FleetConfig,
+    FleetQuorumError,
+    FleetRouter,
+    LocalReplica,
+    ReplicaPool,
+    ReplicaSupervisor,
+    ReplicaUnavailableError,
+)
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.serving import (
+    InferenceServer,
+    ServingConfig,
+    ServingOverloadedError,
+)
+from flink_ml_tpu.serving.registry import VERSION_PREFIX, _METADATA_MARKER
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _metric(scope, name):
+    return metrics.scope(scope).get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# scripted fake replicas — deterministic routing/supervision logic, no jax
+# ---------------------------------------------------------------------------
+class _Resp:
+    def __init__(self, df, model_version, latency_ms=1.0):
+        self.dataframe = df
+        self.model_version = model_version
+        self.latency_ms = latency_ms
+        self.bucket = len(df) if df is not None else 1
+
+
+class _ReadyPending:
+    """Resolves immediately — the fake's result (or typed error) is known at
+    submit time."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def wait(self, timeout=None):
+        return True
+
+    def result(self):
+        return self._fn()
+
+
+class _StuckPending:
+    """Never resolves until released — the hedging test's slow primary."""
+
+    def __init__(self):
+        self._done = threading.Event()
+
+    def wait(self, timeout=None):
+        return self._done.wait(timeout if timeout is not None else 0.0)
+
+    def result(self):  # pragma: no cover — the hedge must win first
+        self._done.wait()
+        raise AssertionError("stuck pending was resolved")
+
+
+class FakeReplica:
+    """The replica contract, scripted. ``behavior(replica, df, priority)``
+    returns a :class:`_Resp` or raises a typed serving error; ``score`` maps
+    the replica's current version into its response payload so canary tests
+    can scorer-read which version served."""
+
+    def __init__(self, name, *, version=1, behavior=None, healthy=True):
+        self.name = name
+        self.version = version
+        self.behavior = behavior
+        self.healthy = healthy
+        self.killed = False
+        self.submits = 0
+        self.swaps = []
+        self.rollbacks = []
+
+    def _respond(self, df, priority):
+        if self.behavior is not None:
+            return self.behavior(self, df, priority)
+        score = np.full(max(len(df), 1), float(self.version))
+        return _Resp(DataFrame(["score"], None, [score]), self.version)
+
+    def submit(self, df, timeout_ms=None, priority=0):
+        self.submits += 1
+        if self.killed:
+            raise ReplicaUnavailableError(
+                f"replica {self.name!r} is dead", replica=self.name
+            )
+        # Resolve eagerly: typed errors surface synchronously (the
+        # LocalReplica admission-control shape the router must normalize).
+        outcome = self._respond(df, priority)
+        return _ReadyPending(lambda: outcome)
+
+    def predict(self, df, timeout_ms=None, priority=0):
+        return self.submit(df, timeout_ms=timeout_ms, priority=priority).result()
+
+    def swap(self, version, path):
+        self.swaps.append((version, path))
+        self.version = version
+
+    def rollback_bad(self, bad_version):
+        self.rollbacks.append(bad_version)
+        self.version = 1
+        return 1
+
+    def health_check(self, timeout_s=2.0):
+        if self.killed:
+            return False, {"status": "dead"}
+        return bool(self.healthy), {"status": "ok" if self.healthy else "unhealthy"}
+
+    def stats(self):
+        return {"serving": {}, "plancache": {}}
+
+    def kill(self):
+        self.killed = True
+
+    def close(self, drain=True):
+        self.killed = True
+
+
+def _fake_factory(**kw):
+    def factory(index, name, version):
+        return FakeReplica(name, version=version if version is not None else 1, **kw)
+
+    return factory
+
+
+def _pool(name, n=2, factory=None, **cfg):
+    return ReplicaPool(
+        factory or _fake_factory(),
+        n,
+        name=name,
+        fleet_config=FleetConfig(replicas=n, **cfg),
+        initial_version=1,
+    )
+
+
+def _df(rows=2):
+    return DataFrame.from_dict({"features": np.zeros((rows, 3))})
+
+
+def _overload(retry_after_ms=5.0, shed=True):
+    return ServingOverloadedError(
+        16, 16, retry_after_ms=retry_after_ms, shed=shed, priority=0
+    )
+
+
+# ---------------------------------------------------------------------------
+# router policies
+# ---------------------------------------------------------------------------
+class TestRouterPolicies:
+    def test_least_loaded_avoids_busy_replica(self):
+        pool = _pool("rt-ll")
+        router = FleetRouter(pool, policy="least_loaded", hedge_quantile=None)
+        pool.note_dispatch(0, canary=False)  # slot 0 busy
+        resp = router.predict(_df())
+        assert resp is not None
+        assert pool.replica(1).submits == 1
+        assert pool.replica(0).submits == 0
+        pool.note_resolve(0)
+        # balanced again: tie breaks to the lowest index
+        router.predict(_df())
+        assert pool.replica(0).submits == 1
+
+    def test_hash_policy_is_sticky_and_minimally_disruptive(self):
+        pool = _pool("rt-hash", n=3)
+        router = FleetRouter(pool, policy="hash", hedge_quantile=None)
+        keys = [f"user-{i}" for i in range(32)]
+        before = {k: router._choose(0, k)[1] for k in keys}
+        # affinity: the same key maps to the same replica every time
+        assert before == {k: router._choose(0, k)[1] for k in keys}
+        assert len(set(before.values())) == 3  # rendezvous actually spreads
+        pool.eject(1, reason="test")
+        after = {k: router._choose(0, k)[1] for k in keys}
+        # only the ejected replica's keys moved (the rendezvous property)
+        moved = {k for k in keys if before[k] != after[k]}
+        assert moved == {k for k in keys if before[k] == pool.slot(1).name}
+
+    def test_priority_policy_concentrates_sheddable_on_busiest(self):
+        pool = _pool("rt-prio")
+        router = FleetRouter(
+            pool, policy="priority", sheddable_priority=1, hedge_quantile=None
+        )
+        pool.note_dispatch(1, canary=False)  # slot 1 is the busiest
+        router.predict(_df(), priority=1)  # sheddable -> busiest
+        assert pool.replica(1).submits == 1
+        router.predict(_df(), priority=0)  # guaranteed -> least loaded
+        assert pool.replica(0).submits == 1
+
+    def test_empty_rotation_raises_typed(self):
+        pool = _pool("rt-empty")
+        router = FleetRouter(pool, hedge_quantile=None)
+        pool.eject(0, reason="test")
+        pool.eject(1, reason="test")
+        with pytest.raises(ReplicaUnavailableError):
+            router.submit(_df())
+
+
+# ---------------------------------------------------------------------------
+# backpressure: retry, fail-fast, failover
+# ---------------------------------------------------------------------------
+class TestRouterBackpressure:
+    def test_overload_retries_on_a_different_replica_honoring_retry_after(self):
+        pool = _pool("rt-retry")
+        shed_once = {"done": False}
+
+        def behavior(replica, df, priority):
+            if replica.name.endswith("r0") and not shed_once["done"]:
+                shed_once["done"] = True
+                raise _overload(retry_after_ms=7.0)
+            score = np.full(len(df), float(replica.version))
+            return _Resp(DataFrame(["score"], None, [score]), replica.version)
+
+        for i in range(pool.size):
+            pool.replica(i).behavior = behavior
+        sleeps = []
+        router = FleetRouter(
+            pool,
+            policy="least_loaded",
+            retry_jitter=0.0,
+            hedge_quantile=None,
+            sleep=sleeps.append,
+        )
+        resp = router.predict(_df())
+        assert resp.model_version == 1
+        assert pool.replica(1).submits == 1  # the retry went elsewhere
+        assert sleeps == [pytest.approx(0.007)]  # replica's own drain estimate
+        assert _metric(router.scope, MLMetrics.FLEET_RETRIES) == 1
+        # in-flight fully released through the error path
+        assert all(pool.slot(i).inflight == 0 for i in range(pool.size))
+
+    def test_fleet_wide_shed_fails_fast_with_the_typed_overload(self):
+        pool = _pool("rt-failfast")
+        for i in range(pool.size):
+            pool.replica(i).behavior = lambda r, df, p: (_ for _ in ()).throw(
+                _overload(retry_after_ms=3.0)
+            )
+        router = FleetRouter(
+            pool, retry_jitter=0.0, hedge_quantile=None, sleep=lambda s: None
+        )
+        with pytest.raises(ServingOverloadedError) as ei:
+            router.predict(_df())
+        assert ei.value.retry_after_ms == 3.0
+        # one try per replica, then fail-fast — never a blind retry storm
+        assert pool.replica(0).submits + pool.replica(1).submits == 2
+        assert _metric(router.scope, MLMetrics.FLEET_FAILFAST) == 1
+        assert all(pool.slot(i).inflight == 0 for i in range(pool.size))
+
+    def test_dead_replica_fails_over_without_consuming_retry_budget(self):
+        pool = _pool("rt-failover", n=3)
+        pool.replica(0).kill()
+        pool.replica(1).kill()
+        router = FleetRouter(pool, retry_attempts=1, hedge_quantile=None)
+        resp = router.predict(_df())  # two failovers despite retry_attempts=1
+        assert resp.model_version == 1
+        assert pool.replica(2).submits == 1
+        assert _metric(router.scope, MLMetrics.FLEET_FAILOVERS) == 2
+
+    def test_all_replicas_dead_raises_typed_unavailable(self):
+        pool = _pool("rt-alldead")
+        for i in range(pool.size):
+            pool.replica(i).kill()
+        router = FleetRouter(pool, hedge_quantile=None)
+        with pytest.raises(ReplicaUnavailableError):
+            router.predict(_df())
+        assert all(pool.slot(i).inflight == 0 for i in range(pool.size))
+
+
+# ---------------------------------------------------------------------------
+# hedging
+# ---------------------------------------------------------------------------
+class TestRouterHedging:
+    def test_hedge_fires_past_trigger_and_first_response_wins(self):
+        pool = _pool("rt-hedge")
+        stuck = _StuckPending()
+        slow = pool.replica(0)
+        slow.behavior = None
+        real_submit = slow.submit
+
+        def slow_submit(df, timeout_ms=None, priority=0):
+            slow.submits += 1
+            return stuck
+
+        slow.submit = slow_submit
+        router = FleetRouter(pool, policy="least_loaded", hedge_after_ms=1.0)
+        handle = router.submit(_df())
+        resp = handle.result()
+        assert resp.model_version == 1  # answered by the hedge on replica 1
+        assert handle.hedged is True
+        assert pool.replica(1).submits == 1
+        assert _metric(router.scope, MLMetrics.FLEET_HEDGES) == 1
+        assert _metric(router.scope, MLMetrics.FLEET_HEDGE_WINS) == 1
+        # the loser's in-flight slot was released on the win
+        assert all(pool.slot(i).inflight == 0 for i in range(pool.size))
+        slow.submit = real_submit
+
+    def test_no_hedge_below_trigger_and_cold_window(self):
+        pool = _pool("rt-nohedge")
+        # dynamic trigger with a cold latency window: never hedges
+        router = FleetRouter(pool, hedge_quantile=0.99)
+        handle = router.submit(_df())
+        assert handle.result() is not None
+        assert handle.hedged is False
+        assert _metric(router.scope, MLMetrics.FLEET_HEDGES) == 0
+
+
+# ---------------------------------------------------------------------------
+# pool accounting + the canary slice gate
+# ---------------------------------------------------------------------------
+class TestPoolAccounting:
+    def test_canary_slice_is_a_hard_invariant_under_hash_traffic(self):
+        pool = _pool("pl-slice", canary_slice=0.4)
+        pool.set_canary(1, 2)
+        pool.replica(1).version = 2
+        router = FleetRouter(pool, policy="hash", hedge_quantile=None)
+        for i in range(50):
+            router.predict(_df(1), key=f"k{i}")
+            total, canary = pool.dispatch_counts()
+            assert canary <= 0.4 * total  # holds at every instant
+        total, canary = pool.dispatch_counts()
+        assert total == 50
+        assert canary > 0  # the canary actually took traffic
+
+    def test_pinned_measurement_traffic_stays_outside_the_slice(self):
+        pool = _pool("pl-pin", canary_slice=0.25)
+        pool.set_canary(1, 2)
+        router = FleetRouter(pool, hedge_quantile=None)
+        resp = router.predict(_df(1), pin=1)
+        assert resp is not None
+        assert pool.dispatch_counts() == (0, 0)  # held a slot, moved no counter
+        assert pool.slot(1).inflight == 0
+
+    def test_ejecting_the_canary_clears_the_designation(self):
+        pool = _pool("pl-eject")
+        pool.set_canary(1, 5)
+        pool.eject(1, reason="test")
+        assert pool.canary_version is None
+        assert pool.canary_slot() is None
+        assert pool.healthy_count == 1
+
+
+# ---------------------------------------------------------------------------
+# supervisor: eject / respawn / readmit / dead
+# ---------------------------------------------------------------------------
+class TestReplicaSupervisor:
+    def test_consecutive_failures_eject_respawn_and_readmit(self):
+        pool = _pool("sv-respawn")
+        old = pool.replica(0)
+        old.healthy = False
+        sup = ReplicaSupervisor(pool, fail_threshold=2, sleep=lambda s: None)
+        sup.check_once()
+        assert pool.states()[old.name] == "serving"  # one strike isn't enough
+        sup.check_once()
+        assert pool.states()[old.name] == "serving"  # respawned + readmitted
+        assert pool.replica(0) is not old
+        assert old.killed  # reaped before the replacement came up
+        assert pool.slot(0).consecutive_failures == 0
+        assert _metric(pool.scope, MLMetrics.FLEET_EJECTS) == 1
+        assert _metric(pool.scope, MLMetrics.FLEET_READMITS) == 1
+
+    def test_respawn_budget_exhaustion_marks_the_slot_dead(self):
+        pool = _pool("sv-dead")
+        pool.replica(0).healthy = False
+        sup = ReplicaSupervisor(
+            pool,
+            factory=lambda i, name, v: FakeReplica(name, healthy=False),
+            fail_threshold=1,
+            sleep=lambda s: None,
+        )
+        sup.check_once()
+        assert pool.states()[pool.slot(0).name] == "dead"
+        assert pool.healthy_count == 1  # survivors keep serving
+        # full budget: the initial attempt plus 3 strategy restarts
+        assert _metric(pool.scope, MLMetrics.FLEET_RESPAWNS) == 4
+        assert _metric(pool.scope, MLMetrics.FLEET_DEAD) == 1
+        # the fleet still answers on the remaining replica
+        router = FleetRouter(pool, hedge_quantile=None)
+        assert router.predict(_df()) is not None
+
+    def test_probe_crash_counts_as_unhealth(self):
+        pool = _pool("sv-probe")
+
+        def boom(timeout_s=2.0):
+            raise OSError("probe transport down")
+
+        pool.replica(0).health_check = boom
+        sup = ReplicaSupervisor(pool, fail_threshold=3, sleep=lambda s: None)
+        sup.check_once()
+        assert pool.slot(0).consecutive_failures == 1
+
+
+# ---------------------------------------------------------------------------
+# canary controller: scan -> score -> promote / quarantine
+# ---------------------------------------------------------------------------
+def _publish_marker(publish_dir, version):
+    path = os.path.join(publish_dir, f"{VERSION_PREFIX}{version}")
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, _METADATA_MARKER), "w", encoding="utf-8") as f:
+        f.write("{}")
+    return path
+
+
+def _eval_df(rows=4):
+    return DataFrame.from_dict(
+        {"features": np.zeros((rows, 3)), "label": np.zeros(rows)}
+    )
+
+
+def _controller(pool, router, publish_dir, score_by_version, **kw):
+    # The fakes echo their version in the "score" column; the scorer maps it
+    # through the scripted loss table (lower is better, DriftMonitor default).
+    scorer = lambda df, labels: float(  # noqa: E731
+        score_by_version[int(df.column("score")[0])]
+    )
+    kw.setdefault("min_scores", 2)
+    return CanaryController(pool, router, publish_dir, scorer=scorer, **kw)
+
+
+class TestCanaryController:
+    def test_scan_starts_canary_on_one_replica(self, tmp_path):
+        pool = _pool("cn-start", n=3)
+        router = FleetRouter(pool, hedge_quantile=None)
+        _publish_marker(str(tmp_path), 1)
+        _publish_marker(str(tmp_path), 2)
+        ctl = _controller(pool, router, str(tmp_path), {1: 0.3, 2: 0.3})
+        assert ctl.maybe_start() == 2
+        assert pool.canary_version == 2
+        assert pool.canary_slot() == 2  # the last in-rotation slot
+        assert pool.replica(2).swaps == [(2, os.path.join(str(tmp_path), "v-2"))]
+        assert ctl.maybe_start() is None  # one canary at a time
+
+    def test_healthy_canary_promotes_rolling_to_fleet_version(self, tmp_path):
+        pool = _pool("cn-promote", n=3)
+        router = FleetRouter(pool, hedge_quantile=None)
+        _publish_marker(str(tmp_path), 1)
+        _publish_marker(str(tmp_path), 2)
+        ctl = _controller(pool, router, str(tmp_path), {1: 0.30, 2: 0.29})
+        assert ctl.maybe_start() == 2
+        assert ctl.verdict() is None  # no evidence yet
+        ctl.observe(_eval_df())
+        outcome = ctl.step(_eval_df())  # second scores land -> verdict
+        assert outcome["verdict"] == "promote"
+        assert outcome["promoted"] == 2
+        assert pool.fleet_version == 2
+        assert pool.canary_version is None
+        # every baseline replica flipped exactly once
+        for i in (0, 1):
+            assert [v for v, _ in pool.replica(i).swaps] == [2]
+        assert _metric(pool.scope, MLMetrics.FLEET_CANARY_PROMOTED) == 1
+
+    def test_regressed_canary_quarantines_and_never_returns(self, tmp_path):
+        pool = _pool("cn-quarantine", n=3)
+        router = FleetRouter(pool, hedge_quantile=None)
+        _publish_marker(str(tmp_path), 1)
+        _publish_marker(str(tmp_path), 2)
+        ctl = _controller(pool, router, str(tmp_path), {1: 0.30, 2: 0.90})
+        assert ctl.maybe_start() == 2
+        ctl.observe(_eval_df())
+        outcome = ctl.step(_eval_df())
+        assert outcome["verdict"] == "quarantine"
+        assert outcome["restored"] == 1
+        assert pool.canary_version is None
+        assert pool.fleet_version == 1  # the fleet never moved
+        assert pool.replica(2).rollbacks == [2]
+        assert ctl.maybe_start() is None  # a quarantined version never re-canaries
+        assert _metric(pool.scope, MLMetrics.FLEET_CANARY_QUARANTINED) == 1
+
+    def test_promotion_defers_below_quorum(self, tmp_path):
+        pool = _pool("cn-quorum", n=3)
+        router = FleetRouter(pool, hedge_quantile=None)
+        _publish_marker(str(tmp_path), 1)
+        _publish_marker(str(tmp_path), 2)
+        ctl = _controller(
+            pool, router, str(tmp_path), {1: 0.30, 2: 0.29}, quorum=3
+        )
+        assert ctl.maybe_start() == 2
+        pool.eject(0, reason="test")  # healthy=2 < quorum=3
+        with pytest.raises(FleetQuorumError):
+            ctl.promote()
+        assert pool.fleet_version == 1  # deferred, not forced
+
+
+# ---------------------------------------------------------------------------
+# chaos seams: fleet.dispatch / fleet.respawn / fleet.promote
+# ---------------------------------------------------------------------------
+class TestFleetFaultPoints:
+    def test_fleet_dispatch_fault_surfaces_typed_with_balanced_accounting(self):
+        pool = _pool("ft-dispatch")
+        router = FleetRouter(pool, hedge_quantile=None)
+        faults.arm("fleet.dispatch", at=1)
+        with pytest.raises(InjectedFault):
+            router.submit(_df())
+        assert faults.fires("fleet.dispatch") == 1
+        # the seam trips before any accounting: nothing leaked in-flight
+        assert all(pool.slot(i).inflight == 0 for i in range(pool.size))
+        assert pool.dispatch_counts() == (0, 0)
+        faults.reset()
+        assert router.predict(_df()) is not None  # next dispatch is clean
+
+    def test_fleet_respawn_fault_is_absorbed_by_the_restart_budget(self):
+        pool = _pool("ft-respawn")
+        pool.replica(0).healthy = False
+        sup = ReplicaSupervisor(pool, fail_threshold=1, sleep=lambda s: None)
+        faults.arm("fleet.respawn", at=1)
+        sup.check_once()
+        # attempt 1 hit the injected fault, attempt 2 ran the health gate clean
+        assert faults.fires("fleet.respawn") == 1
+        assert pool.states()[pool.slot(0).name] == "serving"
+        assert _metric(pool.scope, MLMetrics.FLEET_READMITS) == 1
+
+    def test_fleet_promote_fault_then_retry_promotes_exactly_once(self, tmp_path):
+        pool = _pool("ft-promote", n=3)
+        router = FleetRouter(pool, hedge_quantile=None)
+        _publish_marker(str(tmp_path), 1)
+        _publish_marker(str(tmp_path), 2)
+        ctl = _controller(pool, router, str(tmp_path), {1: 0.30, 2: 0.29})
+        assert ctl.maybe_start() == 2
+        baseline_swaps = {i: len(pool.replica(i).swaps) for i in (0, 1)}
+        faults.arm("fleet.promote", at=1)
+        with pytest.raises(InjectedFault):
+            ctl.promote()
+        # the seam trips before any flip: nothing is half-promoted
+        for i in (0, 1):
+            assert len(pool.replica(i).swaps) == baseline_swaps[i]
+        assert pool.fleet_version == 1
+        assert ctl.promote() == 2  # the retry completes, exactly once per replica
+        for i in (0, 1):
+            assert len(pool.replica(i).swaps) == baseline_swaps[i] + 1
+        assert pool.fleet_version == 2
+
+
+# ---------------------------------------------------------------------------
+# integration: LocalReplica fleets over real InferenceServers
+# ---------------------------------------------------------------------------
+class _Echo:
+    """Minimal servable — clones its input (no model, no compile)."""
+
+    def transform(self, df):
+        return df.clone()
+
+
+def _local_pool(name, n=2):
+    def factory(index, rname, version):
+        server = InferenceServer(
+            _Echo(),
+            name=rname,
+            serving_config=ServingConfig(max_batch_size=8, max_delay_ms=0.5),
+        )
+        return LocalReplica(rname, server)
+
+    return ReplicaPool(
+        factory, n, name=name, fleet_config=FleetConfig(replicas=n), initial_version=1
+    )
+
+
+class TestLocalReplicaIntegration:
+    def test_killed_replica_fails_over_through_real_servers(self):
+        pool = _local_pool("it-failover")
+        try:
+            router = FleetRouter(pool, hedge_quantile=None)
+            pool.replica(0).kill()
+            resp = router.predict(_df(3))
+            assert len(resp.dataframe) == 3
+            assert _metric(router.scope, MLMetrics.FLEET_FAILOVERS) == 1
+        finally:
+            pool.close()
+
+    def test_kill_mid_flight_resolves_every_request(self):
+        pool = _local_pool("it-midflight")
+        try:
+            router = FleetRouter(pool, hedge_quantile=None)
+            handles = [router.submit(_df(1)) for _ in range(4)]
+            pool.replica(0).kill()
+            # every handle resolves — completed on a survivor or typed; the
+            # local pending converts the mid-death close into the failover
+            # signal, so none of these may raise untyped.
+            for h in handles:
+                resp = h.result()
+                assert resp is not None
+            assert all(pool.slot(i).inflight == 0 for i in range(pool.size))
+        finally:
+            pool.close()
+
+    def test_supervisor_readmits_a_dead_local_replica(self):
+        pool = _local_pool("it-respawn")
+        try:
+
+            def factory(index, rname, version):
+                server = InferenceServer(
+                    _Echo(),
+                    name=f"{rname}-respawn",
+                    serving_config=ServingConfig(max_batch_size=8, max_delay_ms=0.5),
+                )
+                return LocalReplica(rname, server)
+
+            sup = ReplicaSupervisor(
+                pool, factory=factory, fail_threshold=1, sleep=lambda s: None
+            )
+            pool.replica(0).kill()
+            sup.check_once()
+            assert pool.states()[pool.slot(0).name] == "serving"
+            router = FleetRouter(pool, hedge_quantile=None)
+            assert router.predict(_df(2)) is not None
+        finally:
+            pool.close()
+
+
+# ---------------------------------------------------------------------------
+# fleetview: the merged decision timeline
+# ---------------------------------------------------------------------------
+class TestFleetview:
+    def test_aggregate_reconstructs_decisions_across_journals(self, tmp_path):
+        import tools.fleetview as fleetview
+
+        workdir = tmp_path / "fleet"
+        rec = telemetry.configure(str(workdir / "journal"))
+        try:
+            pool = _pool("fv-pool", n=3)
+            pool.eject(1, reason="health-check", evidence={"consecutive_failures": 3})
+            pool.readmit(1, FakeReplica(pool.slot(1).name))
+            pool.set_canary(2, 2)
+            telemetry.emit(
+                "fleet.canary.start", pool.scope, {"version": 2, "slot": 2}
+            )
+            pool.mark_dead(0, RuntimeError("budget exhausted"))
+            rec.flush()
+        finally:
+            telemetry.configure(None)
+        # one replica-side journal, as the worker would have written it
+        replica_journal = workdir / "fv-pool-r1" / "journal"
+        replica_journal.mkdir(parents=True)
+        (replica_journal / "journal-000001-0001.jsonl").write_text(
+            '{"seq": 1, "kind": "serving.swap", "wall": 1.0, "data": {"version": 2}}\n'
+            '{"seq": 2, "kind": "loop.noise", "wall": 2.0}\n'
+            '{"torn line'
+        )
+        summary = fleetview.aggregate(str(workdir))
+        assert set(summary["journals"]) == {"fleet", "fv-pool-r1"}
+        kinds = summary["by_kind"]
+        for kind in ("fleet.eject", "fleet.readmit", "fleet.canary.start",
+                     "fleet.dead", "serving.swap", "incident"):
+            assert kinds.get(kind, 0) >= 1, kinds
+        assert "loop.noise" not in kinds  # decisions only by default
+        assert summary["by_source"]["fv-pool-r1"] == 1
+        # timeline is wall-ordered and source-tagged
+        walls = [r.get("wall") or r.get("ts") or 0.0 for r in summary["timeline"]]
+        assert walls == sorted(walls)
+        text = fleetview.render(summary, tail=5)
+        assert "fleet.eject" in text
+
+    def test_cli_exits_2_on_empty_dir(self, tmp_path):
+        import tools.fleetview as fleetview
+
+        assert fleetview.main([str(tmp_path)]) == 2

@@ -1,0 +1,162 @@
+"""Client-side retry policy tests (flink_ml_tpu/loadgen/retry.py).
+
+The well-behaved-overloaded-client contract: a typed overload is resubmitted
+after the replica's own ``retry_after_ms`` drain estimate (jittered, capped,
+bounded attempts), retries and hedges are counted as client-added load —
+never as fresh arrivals — and the exhaustive-accounting invariant
+(``fully_resolved``) survives every retry path.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.loadgen import (
+    Arrival,
+    OpenLoopLoadGenerator,
+    RetryPolicy,
+    Schedule,
+)
+from flink_ml_tpu.serving.errors import ServingOverloadedError
+
+
+def _overload(retry_after_ms=2.0, shed=True):
+    return ServingOverloadedError(8, 8, retry_after_ms=retry_after_ms, shed=shed)
+
+
+class TestRetryPolicy:
+    def test_honors_retry_after_over_its_own_backoff(self):
+        policy = RetryPolicy(3, backoff_ms=10.0, jitter=0.0)
+        assert policy.delay_s(1, 50.0) == pytest.approx(0.050)
+        assert policy.delay_s(1, None) == pytest.approx(0.010)
+
+    def test_exponential_backoff_with_cap(self):
+        policy = RetryPolicy(5, backoff_ms=10.0, backoff_max_ms=25.0, jitter=0.0)
+        assert policy.delay_s(1, None) == pytest.approx(0.010)
+        assert policy.delay_s(2, None) == pytest.approx(0.020)
+        assert policy.delay_s(3, None) == pytest.approx(0.025)  # capped
+        assert policy.delay_s(4, 1000.0) == pytest.approx(0.025)  # hint capped too
+
+    def test_jitter_bounds(self):
+        policy = RetryPolicy(3, backoff_ms=10.0, jitter=0.5, seed=7)
+        for attempt in (1, 2, 3):
+            d = policy.delay_s(attempt, 10.0)
+            assert 0.010 <= d <= 0.015
+
+    def test_ignores_hint_when_not_honoring(self):
+        policy = RetryPolicy(3, backoff_ms=10.0, jitter=0.0, honor_retry_after=False)
+        assert policy.delay_s(1, 500.0) == pytest.approx(0.010)
+
+
+# ---------------------------------------------------------------------------
+# generator integration: retries are client-added load, never arrivals
+# ---------------------------------------------------------------------------
+def _schedule(n=6, gap_s=0.001):
+    entries = [Arrival(i * gap_s, 1, 0, 0) for i in range(n)]
+    return Schedule(entries, meta={"steps": [(n / max(n * gap_s, 1e-9), n * gap_s)]})
+
+
+class _Resp:
+    def __init__(self):
+        self.latency_ms = 1.0
+
+
+class _Handle:
+    def __init__(self, error=None):
+        self._error = error
+
+    def result(self):
+        if self._error is not None:
+            raise self._error
+        return _Resp()
+
+
+class _FlakyTarget:
+    """Sheds the first ``shed_first`` attempts of every request (counting
+    submit-time rejections), then serves. ``at_result`` moves the overload
+    from submit time to ``result()`` — the async-replica shape."""
+
+    def __init__(self, shed_first=1, at_result=False):
+        self.shed_first = shed_first
+        self.at_result = at_result
+        self._lock = threading.Lock()
+        self._attempts = {}
+        self.submits = 0
+
+    def submit(self, df, timeout_ms=None, priority=0):
+        key = id(df)
+        with self._lock:
+            self.submits += 1
+            n = self._attempts.get(key, 0)
+            self._attempts[key] = n + 1
+        if n < self.shed_first:
+            if self.at_result:
+                return _Handle(error=_overload())
+            raise _overload()
+        return _Handle()
+
+
+def _run(target, *, attempts=3, n=6):
+    gen = OpenLoopLoadGenerator(
+        _schedule(n),
+        lambda rows: DataFrame.from_dict({"features": np.zeros((rows, 2))}),
+        collectors=2,
+        retry=RetryPolicy(attempts, backoff_ms=0.1, jitter=0.0),
+    )
+    return gen.run(target)
+
+
+class TestGeneratorRetries:
+    def test_submit_time_sheds_are_retried_not_binned(self):
+        n = 6
+        target = _FlakyTarget(shed_first=1)
+        report = _run(target, n=n)
+        step = report.step(0)
+        assert report.fully_resolved()
+        assert step.arrivals == n  # retries never inflate arrivals
+        assert step.completed == n
+        assert step.retries == n  # one resubmission per arrival
+        assert step.shed == 0 and step.rejected == 0
+        assert target.submits == 2 * n
+
+    def test_result_time_sheds_are_retried_on_the_collector(self):
+        n = 4
+        target = _FlakyTarget(shed_first=1, at_result=True)
+        report = _run(target, n=n)
+        step = report.step(0)
+        assert report.fully_resolved()
+        assert step.completed == n
+        assert step.retries == n
+
+    def test_exhausted_retries_bin_as_the_typed_overload(self):
+        n = 3
+        attempts = 2
+        target = _FlakyTarget(shed_first=10)  # never recovers
+        report = _run(target, attempts=attempts, n=n)
+        step = report.step(0)
+        assert report.fully_resolved()
+        assert step.completed == 0
+        assert step.shed == n  # final typed overload lands in its bin
+        assert step.retries == attempts * n  # bounded attempts per arrival
+        assert not step.unexpected
+
+    def test_no_policy_keeps_the_old_immediate_binning(self):
+        n = 3
+        target = _FlakyTarget(shed_first=10)
+        gen = OpenLoopLoadGenerator(
+            _schedule(n),
+            lambda rows: DataFrame.from_dict({"features": np.zeros((rows, 2))}),
+            collectors=2,
+        )
+        report = gen.run(target)
+        step = report.step(0)
+        assert report.fully_resolved()
+        assert step.shed == n
+        assert step.retries == 0
+
+    def test_stats_dict_carries_retry_and_hedge_bins(self):
+        report = _run(_FlakyTarget(shed_first=1), n=2)
+        d = report.step(0).as_dict()
+        assert "retries" in d and "hedges" in d
+        assert d["retries"] == 2

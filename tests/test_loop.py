@@ -437,6 +437,31 @@ class TestTrainerCadence:
         assert quarantine_version(d, 3) is None  # already gone
         assert os.path.isdir(os.path.join(d, "v-3.quarantined"))
 
+    def test_quarantine_version_concurrent_rollbacks_one_winner(self, tmp_path):
+        """Two rollback controllers racing on the same bad version (a
+        fleet-wide quarantine) must produce exactly ONE ``.quarantined`` dir —
+        the rename is the arbiter; losers see None, never an error and never
+        a double-rename of the winner's dir."""
+        import threading
+
+        d = str(tmp_path)
+        os.makedirs(os.path.join(d, "v-7"))
+        results, barrier = [], threading.Barrier(8)
+
+        def race():
+            barrier.wait()
+            results.append(quarantine_version(d, 7))
+
+        threads = [threading.Thread(target=race) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        winners = [r for r in results if r is not None]
+        assert len(winners) == 1
+        assert winners[0].endswith("v-7.quarantined")
+        assert sorted(os.listdir(d)) == ["v-7.quarantined"]  # exactly one dir
+
     def test_rollback_impossible_without_older_version(self, tmp_path):
         name = "t-loop-noroll"
         loop, trainer, server, stream = _make_loop(

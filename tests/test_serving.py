@@ -389,6 +389,75 @@ class TestHotSwap:
 
 
 # ---------------------------------------------------------------------------
+# poller scan-failure backoff (fleet satellite: a replica must not hammer a
+# dead publish dir, and its backoff posture must be visible from /healthz)
+# ---------------------------------------------------------------------------
+class TestPollerScanBackoff:
+    def test_consecutive_errors_back_off_exponentially_capped(self, tmp_path):
+        registry = ModelRegistry("ml.serving[t-backoff]")
+        poller = ModelVersionPoller(
+            str(tmp_path), registry, interval_ms=10, backoff_max_ms=35, backoff_seed=3
+        )
+        assert poller.backoff_state()["backing_off"] is False
+        waits = []
+        for _ in range(5):
+            poller._note_scan_error()
+            waits.append(poller.backoff_state()["next_wait_s"])
+        # jittered-exponential: each wait in [base, min(1.5*base, cap)]
+        for i, w in enumerate(waits):
+            base = min(0.010 * 2**i, 0.035)
+            assert base <= w <= 0.035 + 1e-9
+        assert waits[-1] == pytest.approx(0.035)  # pinned at the cap
+        state = poller.backoff_state()
+        assert state["consecutive_errors"] == 5 and state["backing_off"] is True
+        poller._note_scan_ok()  # one clean scan resets fully
+        state = poller.backoff_state()
+        assert state["consecutive_errors"] == 0
+        assert state["next_wait_s"] == pytest.approx(0.010)
+
+    def test_loop_backs_off_on_scan_errors_and_recovers(self, tmp_path):
+        registry = ModelRegistry("ml.serving[t-backoff-loop]")
+        poller = ModelVersionPoller(str(tmp_path), registry, interval_ms=1)
+        healthy_poll = poller.poll_once
+
+        def broken_poll():
+            raise OSError("publish dir unreadable")
+
+        poller.poll_once = broken_poll
+        errors_before = metrics.get(registry.scope, MLMetrics.SERVING_POLL_ERRORS, 0)
+        poller.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while (
+                poller.backoff_state()["consecutive_errors"] < 3
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.005)
+            assert poller.backoff_state()["consecutive_errors"] >= 3
+            assert metrics.get(registry.scope, MLMetrics.SERVING_POLL_ERRORS, 0) > errors_before
+            poller.poll_once = healthy_poll  # the dir comes back
+            deadline = time.monotonic() + 5.0
+            while (
+                poller.backoff_state()["backing_off"]
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.005)
+            assert poller.backoff_state()["backing_off"] is False
+        finally:
+            poller.stop()
+
+    def test_backoff_state_surfaces_in_healthz_payload(self, tmp_path):
+        with InferenceServer(_SlowEcho(), name="t-backoff-hz") as server:
+            ok, payload = server.health()
+            assert payload["poller"] is None  # no poller attached yet
+            poller = server.attach_poller(str(tmp_path), interval_ms=5, start=False)
+            poller._note_scan_error()
+            ok, payload = server.health()
+            assert payload["poller"]["consecutive_errors"] == 1
+            assert payload["poller"]["backing_off"] is True
+
+
+# ---------------------------------------------------------------------------
 # the soak: concurrent traffic + hot swap mid-run
 # ---------------------------------------------------------------------------
 class TestConcurrentSoak:

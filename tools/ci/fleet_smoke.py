@@ -1,0 +1,301 @@
+#!/usr/bin/env python
+"""fleet_smoke — a 3-replica process fleet survives a hard kill mid-ramp and
+quarantines a regressed canary, end to end (docs/fleet.md).
+
+The scenario:
+
+1. Publish v-1 (a trained logistic head) and v-2 (DELIBERATELY regressed —
+   trained on flipped labels) into one publish dir.
+2. Spawn three ``ProcessReplica`` workers over a shared plan-cache dir, each
+   with its own journal and /healthz endpoint; front them with a
+   ``FleetRouter`` (client-side ``RetryPolicy`` on the load harness) and a
+   running ``ReplicaSupervisor``.
+3. Drive an open-loop ramp (pre-kill / kill / recovery steps) and hard-kill
+   one replica mid-ramp (``SIGKILL``, no drain — the crash the fleet must
+   survive).
+4. Assert: every arrival resolved exactly once, the untyped-error bin EMPTY,
+   goodput and p999 movement bounded across the kill;
+5. the supervisor ejects, respawns and re-admits the killed slot, and the
+   respawned worker reports ZERO serving-path compiles and ZERO plan-cache
+   misses — the O(load)-not-O(XLA) respawn contract (docs/plancache.md);
+6. the canary controller runs v-2 on a bounded slice (the counter-gate
+   invariant ``canary <= slice * total`` checked against live counts), scores
+   it on labelled tail traffic through pinned router dispatches, and
+   QUARANTINES it (``v-2.quarantined``) with the fleet version untouched;
+7. ``tools/fleetview.py`` reconstructs every decision — eject, respawn,
+   readmit, canary start, quarantine — from the merged journals alone.
+
+Run: ``python tools/ci/fleet_smoke.py`` (wired into tools/ci/run_tests.sh).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+DIM = 8
+REPLICAS = 3
+KILL_SLOT = 1  # middle slot: the canary designation (last slot) stays clean
+STEP_S = 2.0
+RATE_RPS = 25.0
+READMIT_DEADLINE_S = 300.0
+
+
+def _true_weights():
+    import numpy as np
+
+    return np.linspace(1.0, -1.0, DIM)
+
+
+def _labelled(n, seed, flip=False):
+    import numpy as np
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, DIM))
+    y = (X @ _true_weights() > 0).astype(np.float64)
+    if flip:
+        y = 1.0 - y
+    return DataFrame.from_dict({"features": X, "label": y})
+
+
+def _fit(df):
+    from flink_ml_tpu.models.classification.logistic_regression import (
+        LogisticRegression,
+    )
+
+    return LogisticRegression().set_max_iter(10).set_global_batch_size(128).fit(df)
+
+
+def _publish_versions(publish_dir):
+    """v-1: a good head. v-2: trained on FLIPPED labels — confidently wrong,
+    so its live logloss regresses hard against the v-1 baseline."""
+    from flink_ml_tpu.serving import publish_servable
+
+    publish_servable(_fit(_labelled(128, seed=1)), publish_dir, version=1)
+    publish_servable(_fit(_labelled(128, seed=1, flip=True)), publish_dir, version=2)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    import flink_ml_tpu.telemetry as telemetry
+    import tools.fleetview as fleetview
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.fleet import (
+        CanaryController,
+        FleetConfig,
+        FleetRouter,
+        ProcessReplica,
+        ReplicaPool,
+        ReplicaSupervisor,
+    )
+    from flink_ml_tpu.loadgen import (
+        FixedSizes,
+        OpenLoopLoadGenerator,
+        RetryPolicy,
+        ramp_schedule,
+    )
+    from flink_ml_tpu.metrics import MLMetrics
+
+    workdir = tempfile.mkdtemp(prefix="fleet-smoke-")
+    publish_dir = os.path.join(workdir, "publish")
+    rec = telemetry.configure(os.path.join(workdir, "journal"))
+    worker_env = {
+        "JAX_PLATFORMS": "cpu",
+        "FLINK_ML_TPU_PLANCACHE_DIR": os.path.join(workdir, "plancache"),
+        # Small bucket ladder: the smoke proves zero-compile respawn, not
+        # warmup breadth — 4 buckets keep each worker's first boot short.
+        "FLINK_ML_TPU_SERVING_MAX_BATCH_SIZE": "8",
+        "FLINK_ML_TPU_SERVING_MAX_DELAY_MS": "0.5",
+    }
+    rng = np.random.default_rng(23)
+    template = DataFrame.from_dict({"features": rng.normal(size=(1, DIM))})
+
+    def factory(index, name, version):
+        rep_dir = os.path.join(workdir, name)
+        ready = os.path.join(rep_dir, "ready.json")
+        if os.path.exists(ready):
+            os.remove(ready)  # a respawn must wait for the NEW worker's barrier
+        return ProcessReplica.spawn(
+            name,
+            rep_dir,
+            publish_dir=publish_dir,
+            load_version=version if version is not None else 1,
+            template=template,
+            env=worker_env,
+        )
+
+    print("=== fleet_smoke: publishing v-1 (good) + v-2 (regressed) ===", flush=True)
+    _publish_versions(publish_dir)
+
+    print(f"=== spawning {REPLICAS} process replicas (shared plan cache) ===", flush=True)
+    t0 = time.perf_counter()
+    pool = ReplicaPool(
+        factory,
+        REPLICAS,
+        name="smoke",
+        fleet_config=FleetConfig(
+            replicas=REPLICAS,
+            canary_slice=0.25,
+            canary_min_scores=2,
+            health_interval_ms=100.0,
+            health_failures=2,
+        ),
+        initial_version=1,
+    )
+    print(f"fleet up in {time.perf_counter() - t0:.1f}s", flush=True)
+
+    supervisor = ReplicaSupervisor(pool)
+    router = FleetRouter(pool, policy="least_loaded")
+    killed_name = pool.slot(KILL_SLOT).name
+    old_replica = pool.replica(KILL_SLOT)
+    failed = []
+
+    try:
+        supervisor.start()
+
+        # -- the ramp, with a hard kill mid-step-2 ----------------------------
+        sched = ramp_schedule(
+            [(RATE_RPS, STEP_S)] * 3, sizes=FixedSizes(2), seed=11
+        )
+        gen = OpenLoopLoadGenerator(
+            sched,
+            lambda rows: DataFrame.from_dict(
+                {"features": rng.normal(size=(rows, DIM))}
+            ),
+            collectors=8,
+            retry=RetryPolicy(3, backoff_ms=5.0),
+        )
+        killer = threading.Timer(1.5 * STEP_S, old_replica.kill)
+        killer.start()
+        print(f"=== ramp: 3x {STEP_S}s @ {RATE_RPS} rps, killing "
+              f"{killed_name} at {1.5 * STEP_S:.1f}s ===", flush=True)
+        report = gen.run(router)
+        killer.cancel()
+
+        def check(ok, msg):
+            print(("  OK  " if ok else "  FAIL") + f" {msg}", flush=True)
+            if not ok:
+                failed.append(msg)
+
+        check(report.fully_resolved(),
+              f"every arrival resolved exactly once "
+              f"({report.total_resolved}/{report.total_arrivals})")
+        check(not report.unexpected,
+              f"untyped-error bin empty ({[type(e).__name__ for e in report.unexpected][:5]})")
+        pre, kill, rec_step = report.step(0), report.step(1), report.step(2)
+        goodputs = [
+            (s.completed / s.arrivals) if s.arrivals else 0.0
+            for s in (pre, kill, rec_step)
+        ]
+        p999s = [s.latency_ms(0.999) or 0.0 for s in (pre, kill, rec_step)]
+        print(f"  goodput pre/kill/recovery: "
+              f"{goodputs[0]:.3f}/{goodputs[1]:.3f}/{goodputs[2]:.3f}; "
+              f"p999 {p999s[0]:.1f}/{p999s[1]:.1f}/{p999s[2]:.1f} ms; "
+              f"retries {sum(s.retries for s in report.steps)}, "
+              f"failovers routed typed", flush=True)
+        check(all(g >= 0.95 for g in goodputs),
+              f"goodput movement bounded across the kill ({goodputs})")
+        check(p999s[1] <= 2000.0 and p999s[2] <= max(10.0 * p999s[0], 250.0),
+              f"p999 movement bounded across the kill ({p999s})")
+
+        # -- respawn: re-admitted with zero serving-path compiles -------------
+        print("=== waiting for eject -> respawn -> readmit of "
+              f"{killed_name} ===", flush=True)
+        deadline = time.monotonic() + READMIT_DEADLINE_S
+        while time.monotonic() < deadline:
+            if (pool.states()[killed_name] == "serving"
+                    and pool.replica(KILL_SLOT) is not old_replica):
+                break
+            time.sleep(0.25)
+        readmitted = (pool.states()[killed_name] == "serving"
+                      and pool.replica(KILL_SLOT) is not old_replica)
+        check(readmitted, f"killed replica re-admitted within {READMIT_DEADLINE_S:.0f}s")
+        if readmitted:
+            resp = router.predict(template, pin=KILL_SLOT)
+            check(resp.model_version == 1, "respawned replica serves the fleet version")
+            stats = pool.replica(KILL_SLOT).stats()
+            compiles = stats["serving"].get(MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+            misses = stats["plancache"].get("ml.plancache.misses", 0)
+            hits = stats["plancache"].get("ml.plancache.hits", 0)
+            check(compiles == 0, f"zero serving-path compiles on respawn ({compiles})")
+            check(misses == 0 and hits > 0,
+                  f"respawn warmed purely from the plan cache "
+                  f"(misses={misses}, hits={hits})")
+
+        # -- canary: regressed v-2 on a bounded slice, then quarantine --------
+        print("=== canary: v-2 on a 25% slice, drift-scored live ===", flush=True)
+        ctl = CanaryController(pool, router, publish_dir, min_scores=2)
+        started = ctl.maybe_start()
+        check(started == 2, f"canary started on v-2 (got {started})")
+        hash_router = FleetRouter(pool, policy="hash")
+        slice_ok = True
+        canary_seen = 0
+        for i in range(120):
+            hash_router.predict(
+                DataFrame.from_dict({"features": rng.normal(size=(1, DIM))}),
+                key=f"slice-{i}",
+            )
+            total, canary = pool.dispatch_counts()
+            slice_ok = slice_ok and canary <= 0.25 * total
+        total, canary_seen = pool.dispatch_counts()
+        check(slice_ok and canary_seen > 0,
+              f"canary stayed inside its slice at every instant "
+              f"({canary_seen}/{total} <= 25%)")
+        for round_ in range(2):
+            # Eval batches must fit the workers' bucket ladder (max batch 8).
+            ctl.observe(_labelled(8, seed=100 + round_))
+        verdict = ctl.verdict()
+        check(verdict == "quarantine", f"regressed canary verdict ({verdict})")
+        if verdict == "quarantine":
+            restored = ctl.quarantine()
+            check(restored == 1, f"canary replica rolled back to v-1 (got {restored})")
+        check(os.path.isdir(os.path.join(publish_dir, "v-2.quarantined")),
+              "v-2 quarantined on disk")
+        check(pool.fleet_version == 1 and pool.canary_version is None,
+              "fleet version untouched by the bad canary")
+        final_total, final_canary = pool.dispatch_counts()
+        check(final_canary <= 0.25 * final_total,
+              f"slice invariant holds at the end ({final_canary}/{final_total})")
+
+        # -- fleetview: the merged decision timeline --------------------------
+        supervisor.stop()
+        rec.flush()
+        summary = fleetview.aggregate(workdir)
+        kinds = summary["by_kind"]
+        for kind in ("fleet.eject", "fleet.respawn", "fleet.readmit",
+                     "fleet.canary.start", "fleet.canary.score",
+                     "fleet.quarantine"):
+            check(kinds.get(kind, 0) >= 1, f"fleetview reconstructs {kind}")
+        check(len(summary["journals"]) >= 1 + REPLICAS,
+              f"fleetview merged parent + replica journals "
+              f"({sorted(summary['journals'])})")
+        print(fleetview.render(summary, tail=12), flush=True)
+    finally:
+        supervisor.stop()
+        pool.close()
+        telemetry.configure(None)
+
+    if failed:
+        print(f"fleet_smoke FAIL ({len(failed)} assertion(s)); workdir kept at "
+              f"{workdir}")
+        return 1
+    shutil.rmtree(workdir, ignore_errors=True)
+    print("fleet_smoke OK: kill survived typed-only, zero-compile respawn, "
+          "canary bounded + quarantined, decisions reconstructed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

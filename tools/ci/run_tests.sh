@@ -74,6 +74,18 @@ python tools/ci/chaos_smoke.py
 echo "=== restart smoke (hard-kill -> zero-compile resume from plan cache) ==="
 python tools/ci/restart_smoke.py
 
+# Fleet smoke: 3 process-isolated replicas behind the retrying router with
+# a running supervisor; one replica hard-killed mid-ramp — every arrival
+# resolved exactly once with typed errors only and bounded goodput/p999
+# movement, the killed slot respawned and re-admitted with ZERO serving-path
+# compiles (plan-cache-warmed — O(load) not O(XLA)), a deliberately
+# regressed canary held inside its hard traffic slice and quarantined by the
+# live drift score, and the full eject/respawn/readmit/canary decision
+# timeline reconstructed from the merged journals by tools/fleetview.py
+# (docs/fleet.md).
+echo "=== fleet smoke (replica kill -> respawn -> canary quarantine) ==="
+python tools/ci/fleet_smoke.py
+
 # Bench trend (informational): diff the two newest BENCH_r*.json rounds and
 # warn on >10% p50 / rows-per-second movement — directional on shared CI
 # boxes, so the step never fails the build (tools/bench_trend.py --strict

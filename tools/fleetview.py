@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""fleetview — one timeline over a fleet's many flight recorders.
+
+A fleet run leaves decision records in several journals: the parent process
+(pool membership — ejects, readmits, deaths; router failfasts; canary
+start/score/promote/quarantine; respawns) and one journal per replica worker
+(``<workdir>/<replica>/journal`` — swaps, rollbacks, shed windows, its own
+up/down markers). Each journal is consistent on its own; the *fleet's* story
+only exists merged. This tool walks a fleet workdir, reads every journal
+(``flink_ml_tpu.telemetry.read_journal`` — torn tails tolerated), tags each
+record with its source, merges on wall-clock timestamp, and renders the
+decision timeline plus a per-kind summary — the "every eject / respawn /
+canary / promote / quarantine decision is reconstructible" contract of
+docs/fleet.md.
+
+Usage:
+    python tools/fleetview.py <fleet-workdir> [--all] [--json] [--tail N]
+
+``--all`` includes every record (per-request noise and all); the default
+keeps decision kinds only. ``--json`` emits the merged timeline
+machine-readable so CI can assert on it (tools/ci/fleet_smoke.py does).
+
+Exit codes: 0 = journals found and merged, 2 = no journal records under the
+given directory.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from flink_ml_tpu.telemetry import read_journal  # noqa: E402
+
+__all__ = ["collect_journals", "aggregate", "render", "main"]
+
+#: Record kinds that are fleet/serving *decisions* (the default filter).
+#: Prefix match — "fleet." covers eject/readmit/dead/respawn/canary.*/
+#: promote/quarantine/failfast and the replica up/down markers.
+DECISION_PREFIXES = (
+    "fleet.",
+    "serving.swap",
+    "serving.rollback",
+    "serving.quarantine",
+    "loop.rollback",
+    "loop.quarantine",
+    "execution.restart",
+    "execution.exhausted",
+    "incident",
+)
+
+
+def collect_journals(workdir: str) -> Dict[str, str]:
+    """``{source_name: journal_dir}`` for every journal under ``workdir``:
+    the top-level one (source ``fleet``) plus any ``<sub>/journal`` dir one
+    level down (source = the subdirectory, i.e. the replica name)."""
+    journals: Dict[str, str] = {}
+    top = os.path.join(workdir, "journal")
+    if os.path.isdir(top):
+        journals["fleet"] = top
+    try:
+        entries = sorted(os.listdir(workdir))
+    except OSError:
+        return journals
+    for entry in entries:
+        sub = os.path.join(workdir, entry, "journal")
+        if os.path.isdir(sub):
+            journals[entry] = sub
+    # A workdir may itself BE a journal dir (journal-*.jsonl files directly).
+    if not journals and read_journal(workdir):
+        journals["fleet"] = workdir
+    return journals
+
+
+def aggregate(workdir: str, *, decisions_only: bool = True) -> Dict[str, Any]:
+    """Merge every journal under ``workdir`` into one timeline (sorted by
+    wall timestamp, source-tagged) with per-kind and per-source counts."""
+    journals = collect_journals(workdir)
+    timeline: List[Dict[str, Any]] = []
+    for source, directory in journals.items():
+        for rec in read_journal(directory):
+            kind = str(rec.get("kind", ""))
+            if decisions_only and not kind.startswith(DECISION_PREFIXES):
+                continue
+            tagged = dict(rec)
+            tagged["source"] = source
+            timeline.append(tagged)
+    timeline.sort(key=lambda r: (r.get("wall") or r.get("ts") or 0.0, r.get("seq", 0)))
+    by_kind: Dict[str, int] = {}
+    by_source: Dict[str, int] = {}
+    for rec in timeline:
+        by_kind[rec.get("kind", "?")] = by_kind.get(rec.get("kind", "?"), 0) + 1
+        by_source[rec["source"]] = by_source.get(rec["source"], 0) + 1
+    return {
+        "workdir": workdir,
+        "journals": journals,
+        "records": len(timeline),
+        "by_kind": dict(sorted(by_kind.items())),
+        "by_source": dict(sorted(by_source.items())),
+        "timeline": timeline,
+    }
+
+
+def render(summary: Dict[str, Any], tail: int = 0) -> str:
+    lines: List[str] = []
+    lines.append(f"fleetview: {summary['workdir']}")
+    lines.append(
+        f"  {len(summary['journals'])} journal(s), {summary['records']} decision record(s)"
+    )
+    lines.append("  by kind:")
+    for kind, count in summary["by_kind"].items():
+        lines.append(f"    {kind:<28} {count}")
+    lines.append("  by source:")
+    for source, count in summary["by_source"].items():
+        lines.append(f"    {source:<28} {count}")
+    timeline = summary["timeline"]
+    if tail:
+        timeline = timeline[-tail:]
+    lines.append("  timeline:")
+    for rec in timeline:
+        wall = rec.get("wall") or rec.get("ts") or 0.0
+        data = rec.get("data") or {}
+        detail = ", ".join(f"{k}={v}" for k, v in list(data.items())[:6])
+        lines.append(
+            f"    [{wall:>16.6f}] {rec.get('source', '?'):<12} "
+            f"{rec.get('kind', '?'):<24} {detail}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="merge a fleet's journals into one timeline")
+    parser.add_argument("workdir", help="fleet workdir (parent journal + <replica>/journal)")
+    parser.add_argument("--all", action="store_true", help="include non-decision records")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument("--tail", type=int, default=0, help="only the newest N timeline rows (text mode)")
+    args = parser.parse_args(argv)
+    summary = aggregate(args.workdir, decisions_only=not args.all)
+    if summary["records"] == 0:
+        print(f"fleetview: no journal records under {args.workdir}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print(render(summary, tail=args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

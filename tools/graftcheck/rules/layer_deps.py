@@ -85,6 +85,12 @@ PACKAGE_LAYERS = {
     # and keep their runtime-free guarantee; the loop is the one place the
     # two halves are allowed to meet (docs/continuous.md).
     "loop": 3,
+    # Fleet serving composes L1 serving replicas with the L2 execution
+    # supervisor's restart strategies and the L3 loop's drift/rollback
+    # machinery (canary verdicts), so it sits at the library layer with
+    # loop/loadgen — a single replica never knows it is part of a fleet,
+    # and nothing below L3 may import the fleet tier (docs/fleet.md).
+    "fleet": 3,
     # the root package surface (flink_ml_tpu/__init__.py) re-exports the API
     "": 3,
 }
