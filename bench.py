@@ -2736,6 +2736,164 @@ def bench_mlp_forward(peak_flops):
     }
 
 
+def bench_retrieval_topk():
+    """Retrieval tier (docs/retrieval.md): top-K serving latency at catalog
+    scale under OPEN-LOOP load — the p99 a capacity plan is made of, at the
+    candidate counts the recsys family actually carries (10^5 and 10^6).
+
+    Per (candidates, K) cell: a swing ``CandidateIndex`` is synthesized at
+    scale (ELL neighbor table, 16 slots/row), served through
+    ``InferenceServer`` with the sparse nnz ladder x K rung warmed up front,
+    then driven with seeded Poisson single-row arrivals (every request: an
+    8-item history + its own ``k``) at ~0.6x of a measured saturation burst.
+    Recorded: achieved qps, p50/p99 latency, zero post-warmup compiles.
+    1-core CPU box: absolute numbers are directional (XLA-CPU top_k over
+    [batch, C]); the contract under test is the SHAPE of the path — fused,
+    compile-free, p99 bounded while C grows 10x.
+    """
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.config import Options, config
+    from flink_ml_tpu.linalg.vectors import SparseVector
+    from flink_ml_tpu.loadgen import FixedSizes, OpenLoopLoadGenerator, ramp_schedule
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.retrieval import CandidateIndex
+
+    NNZ = 8  # history items per request — one warmed nnz cap
+    NBRS = 16  # ELL similarity slots per candidate row
+
+    def make_index(C, seed):
+        rng = np.random.default_rng(seed)
+        sim_ids = rng.integers(0, C, (C, NBRS)).astype(np.int32)
+        sim_ids.sort(axis=1)  # the sorted-per-row scatter invariant
+        sim_values = rng.random((C, NBRS), np.float32) + np.float32(0.01)
+        idx = CandidateIndex(
+            {
+                "item_ids": np.arange(C, dtype=np.int64),
+                "sim_values": sim_values,
+                "sim_ids": sim_ids,
+            }
+        )
+        idx.set_output_col("rec")
+        return idx
+
+    rows = []
+    for C in (100_000, 1_000_000):
+        idx = make_index(C, seed=C)
+        rng = np.random.default_rng(17)
+        # pre-drawn request pool: arrival threads must not pay rng/pack cost
+        pool = [
+            DataFrame(
+                ["history", "k"],
+                None,
+                [
+                    [
+                        SparseVector(
+                            C,
+                            np.sort(
+                                rng.choice(C, size=NNZ, replace=False)
+                            ).astype(np.int64),
+                            np.ones(NNZ),
+                        )
+                    ],
+                    np.asarray([0], np.int64),  # k patched per cell below
+                ],
+            )
+            for _ in range(64)
+        ]
+        for K in (10, 100):
+            from flink_ml_tpu.serving import InferenceServer, ServingConfig
+
+            config.set(Options.SPARSE_WARMUP_CAPS, str(NNZ))
+            config.set(Options.SPARSE_NNZ_CAP_MAX, NNZ)
+            config.set(Options.RETRIEVAL_WARMUP_KS, str(K))
+            config.set(Options.RETRIEVAL_K_CAP_MAX, 128)
+            reqs = [
+                DataFrame(
+                    df.column_names, None, [df.column("history"), np.asarray([K], np.int64)]
+                )
+                for df in pool
+            ]
+            req_i = [0]
+
+            def request(_rows):
+                req_i[0] = (req_i[0] + 1) % len(reqs)
+                return reqs[req_i[0]]
+
+            name = f"bench-ret-{C}-{K}"
+            scope = f"ml.serving[{name}]"
+            template = reqs[0]
+            server = InferenceServer(
+                idx.servable(),
+                name=name,
+                serving_config=ServingConfig(
+                    max_batch_size=8,
+                    max_delay_ms=1.0,
+                    queue_capacity_rows=256,
+                    default_timeout_ms=60_000,
+                ),
+                warmup_template=template,
+            )
+            try:
+                compiles0 = metrics.get(
+                    scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0
+                )
+                # saturation estimate: a short deliberately-overloaded burst
+                cal = OpenLoopLoadGenerator(
+                    ramp_schedule([(400.0, 1.0)], sizes=FixedSizes(1), seed=1),
+                    request,
+                    timeout_ms=60_000.0,
+                ).run(server)
+                sat_qps = max(cal.total_resolved / cal.wall_s, 1.0)
+                rate = 0.6 * sat_qps
+                report = OpenLoopLoadGenerator(
+                    ramp_schedule([(rate, 4.0)], sizes=FixedSizes(1), seed=2),
+                    request,
+                    timeout_ms=60_000.0,
+                ).run(server)
+                step = report.steps[0]
+                compiles = (
+                    metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+                    - compiles0
+                )
+                rows.append(
+                    {
+                        "candidates": C,
+                        "k": K,
+                        "k_rung": 16 if K == 10 else 128,
+                        "saturation_qps": round(sat_qps, 1),
+                        "offered_qps": round(rate, 1),
+                        "achieved_qps": round(
+                            step.completed / max(step.duration_s, 1e-9), 1
+                        ),
+                        "p50_ms": round(step.latency_ms(0.5) or 0.0, 2),
+                        "p99_ms": round(step.latency_ms(0.99) or 0.0, 2),
+                        "fully_resolved": report.fully_resolved(),
+                        "post_warmup_compiles": compiles,
+                    }
+                )
+            finally:
+                server.close()
+                for opt in (
+                    Options.SPARSE_WARMUP_CAPS,
+                    Options.SPARSE_NNZ_CAP_MAX,
+                    Options.RETRIEVAL_WARMUP_KS,
+                    Options.RETRIEVAL_K_CAP_MAX,
+                ):
+                    config.unset(opt)
+    return {
+        "name": "retrieval_topk_open_loop",
+        "chain": "8-item history -> fused segment-reduce swing scores -> "
+        "lax.top_k, served single-row open-loop @ 0.6x saturation",
+        "sweep": rows,
+        "note": "device-resident swing index (16 ELL slots/row); every cell "
+        "fused with zero post-warmup compiles. 1-core XLA-CPU box: "
+        "absolute qps/latency directional only — the recorded contract "
+        "is p99 boundedness as C grows 10x and K 10x on the rung "
+        "ladder, and the compile-free fast path holding under "
+        "open-loop arrivals.",
+    }
+
+
 def main() -> None:
     import jax
 
@@ -2800,4 +2958,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--sharded-child" in sys.argv[1:]:
         sys.exit(_sharded_child())
+    if "retrieval_topk" in sys.argv[1:]:
+        print(json.dumps(bench_retrieval_topk(), indent=2))
+        sys.exit(0)
     sys.exit(main())

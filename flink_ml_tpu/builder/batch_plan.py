@@ -236,7 +236,15 @@ class CompiledBatchPlan:
             nnz_cap = 0
             cap_max = resolve_nnz_cap_max()
             for name in segment.external_inputs:
-                if segment.input_kind(name) in ("sparse", "entries"):
+                kind = segment.input_kind(name)
+                if kind == "shape":
+                    # Per-request output-shape columns (retrieval top-K) need
+                    # the serving ingest's K ladder; the offline builder has
+                    # none — the per-stage path owns these stages.
+                    raise IneligibleBatch(
+                        f"column {name!r} rides the shape kind", reason="shape_kind"
+                    )
+                if kind in ("sparse", "entries"):
                     arrays, col_cap, _col_nnz = segment.gather_sparse(
                         df, name, cap_max=cap_max
                     )

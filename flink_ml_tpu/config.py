@@ -358,6 +358,40 @@ class Options:
         "the full power-of-two ladder up to sparse.nnz.cap.max — zero "
         "post-warmup compiles for every on-ladder batch.",
     )
+    RETRIEVAL_K_CAP_MAX = ConfigOption(
+        "retrieval.k.cap.max",
+        int,
+        128,
+        "Top rung of the retrieval top-K output-width ladder (docs/"
+        "retrieval.md). A per-request K rounds up to the next power of two "
+        "(the K rung joins the compiled-plan key next to the row bucket and "
+        "the nnz cap); a batch asking for more than this serves through the "
+        "per-stage fallback (counted under the 'off_ladder' fallback reason) "
+        "instead of compiling an unbounded executable set.",
+    )
+    RETRIEVAL_WARMUP_KS = ConfigOption(
+        "retrieval.warmup.ks",
+        str,
+        None,
+        "Comma-separated per-request K values the serving warmup AOT-compiles "
+        "per (bucket, nnz cap) for retrieval segments (each rounds up to its "
+        "ladder rung). Default: the full power-of-two ladder up to "
+        "retrieval.k.cap.max — zero post-warmup compiles for every on-ladder "
+        "K. Deployments serving only a couple of Ks narrow this to cut "
+        "warmup wall time.",
+    )
+    RETRIEVAL_LSH_PRUNE_CAP = ConfigOption(
+        "retrieval.lsh.prune.cap",
+        int,
+        1024,
+        "Static candidate count the LSH bucket-prune phase hands to the exact "
+        "1-Jaccard rank phase (the two-phase retrieve-then-rank plan, docs/"
+        "retrieval.md). Queries whose bucket-sharing candidate set exceeds "
+        "this are approximated: only the cap candidates with the most shared "
+        "hash tables reach the exact rank. Raising it trades device FLOPs "
+        "for recall; parity with the host reference holds whenever the true "
+        "candidate set fits the cap.",
+    )
     BATCH_FASTPATH = ConfigOption(
         "batch.fastpath",
         _parse_bool,

@@ -24,9 +24,12 @@ from flink_ml_tpu.models.common import ModelArraysMixin
 from flink_ml_tpu.params.param import IntParam, ParamValidators, update_existing_params
 from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol, HasSeed
 
-__all__ = ["MinHashLSH", "MinHashLSHModel"]
+# The affine-family modulus lives with the serving tier (L1) so the fused
+# retrieval head and this training-side model can never drift apart; re-export
+# keeps this module's historical name working.
+from flink_ml_tpu.servable.retrieval import HASH_PRIME
 
-HASH_PRIME = 2038074743
+__all__ = ["HASH_PRIME", "MinHashLSH", "MinHashLSHModel"]
 
 
 class JavaRandom:
@@ -112,6 +115,15 @@ class MinHashLSHModel(ModelArraysMixin, Model, _LshParams):
         return 1.0 - len(xi & yi) / len(xi | yi)
 
     # --- Model API -----------------------------------------------------------
+    @classmethod
+    def load_servable(cls, path: str):
+        """Load a published retrieval index built under this model's hash
+        family (``CandidateIndex.from_lsh_model`` → ``publish_servable``) as
+        its runtime-free two-phase serving head (docs/retrieval.md)."""
+        from flink_ml_tpu.servable.retrieval import LSHTopKServable
+
+        return LSHTopKServable.load_servable(path)
+
     def transform(self, *inputs):
         (df,) = inputs
         col = df.column(self.get_input_col())
@@ -129,14 +141,19 @@ class MinHashLSHModel(ModelArraysMixin, Model, _LshParams):
         col = dataset.column(self.get_input_col())
         candidates = []
         for i, v in enumerate(col):
+            if _to_indices(v).size == 0:
+                continue  # all-zero row: hashes to no bucket, never a candidate
             h = self.hash_function(v)
             if (h == key_hash).all(axis=1).any():  # shares at least one full bucket
                 candidates.append(i)
         dists = [(i, self.key_distance(key, col[i])) for i in candidates]
-        dists.sort(key=lambda t: t[1])
+        dists.sort(key=lambda t: t[1])  # stable: distance ties keep row order
         top = dists[:k]
+        # No bucket-sharing candidates is a typed empty result, not an error.
         subset = dataset.take(np.asarray([i for i, _ in top], np.int64))
-        subset.add_column(dist_col, DataTypes.DOUBLE, np.asarray([d for _, d in top]))
+        subset.add_column(
+            dist_col, DataTypes.DOUBLE, np.asarray([d for _, d in top], np.float64)
+        )
         return subset
 
     def approx_similarity_join(

@@ -39,10 +39,17 @@ import numpy as np
 
 from flink_ml_tpu.api.core import AlgoOperator
 from flink_ml_tpu.api.dataframe import DataFrame
-from flink_ml_tpu.params.param import FloatParam, IntParam, ParamValidators, StringParam
+from flink_ml_tpu.api.types import BasicType, DataTypes
+from flink_ml_tpu.params.param import (
+    BoolParam,
+    FloatParam,
+    IntParam,
+    ParamValidators,
+    StringParam,
+)
 from flink_ml_tpu.params.shared import HasOutputCol, HasSeed
 
-__all__ = ["Swing", "encode_topk"]
+__all__ = ["Swing", "encode_topk", "structured_topk"]
 
 
 def encode_topk(i_ids: np.ndarray, vals: np.ndarray, inds: np.ndarray):
@@ -74,6 +81,24 @@ def encode_topk(i_ids: np.ndarray, vals: np.ndarray, inds: np.ndarray):
         ";".join(pair[a:b]) for a, b in zip(bounds[:-1], bounds[1:])
     ]
     return np.asarray(i_ids, np.int64)[rows], strs
+
+
+def structured_topk(i_ids: np.ndarray, vals: np.ndarray, inds: np.ndarray):
+    """Typed companion of :func:`encode_topk` — same kept rows, same order,
+    but the top-k as ``[M, k]`` matrices instead of an encoded string:
+    neighbor item ids (int64, padded −1 past a row's positive neighbors) and
+    scores (f64, padded 0). Row m here describes the same item as row m of
+    ``encode_topk``'s output, so the two encodings can ride one DataFrame.
+    Returns ``(ids_mat [M, k] int64, scores_mat [M, k] f64)``."""
+    pos = vals > 0.0
+    rows = np.flatnonzero(pos.any(axis=1))
+    k = vals.shape[1] if vals.ndim == 2 else 0
+    ids_mat = np.full((rows.size, k), -1, np.int64)
+    scores_mat = np.zeros((rows.size, k), np.float64)
+    keep = pos[rows]
+    ids_mat[keep] = np.asarray(i_ids, np.int64)[inds[rows][keep]]
+    scores_mat[keep] = vals[rows][keep]
+    return ids_mat, scores_mat
 
 
 _SWING_CACHE: dict = {}
@@ -171,6 +196,13 @@ class Swing(AlgoOperator, HasOutputCol, HasSeed):
     BETA = FloatParam(
         "beta", "Decay factor for the user weight.", 0.3, ParamValidators.gt_eq(0)
     )
+    STRUCTURED_OUTPUT = BoolParam(
+        "structuredOutput",
+        "Also emit the typed top-K columns <outputCol>_ids / <outputCol>_scores "
+        "alongside the reference's string encoding (the retrieval-index input "
+        "format, docs/retrieval.md).",
+        False,
+    )
 
     def get_user_col(self) -> str:
         return self.get(self.USER_COL)
@@ -226,6 +258,44 @@ class Swing(AlgoOperator, HasOutputCol, HasSeed):
     def set_beta(self, value: float):
         return self.set(self.BETA, value)
 
+    def get_structured_output(self) -> bool:
+        return self.get(self.STRUCTURED_OUTPUT)
+
+    def set_structured_output(self, value: bool):
+        return self.set(self.STRUCTURED_OUTPUT, value)
+
+    @classmethod
+    def load_servable(cls, path: str):
+        """Load a published retrieval index distilled from this operator's
+        output as its serving head (``CandidateIndex.from_swing_output`` →
+        ``publish_servable``); the training stack stays unimported on the
+        serving side — this hook is for symmetry with model classes."""
+        from flink_ml_tpu.servable.retrieval import SwingTopKServable
+
+        return SwingTopKServable.load_servable(path)
+
+    def _output_frame(self, out_items, out_strs, vals=None, inds=None, i_ids=None):
+        """The output DataFrame in the configured encoding(s): the reference
+        string column always; when ``structuredOutput`` the typed
+        ``_ids``/``_scores`` matrices for the same kept rows ride along."""
+        names = [self.get_item_col(), self.get_output_col()]
+        cols = [out_items, out_strs]
+        if self.get_structured_output():
+            out = self.get_output_col()
+            if vals is None:  # the empty early-returns
+                k = self.get_k()
+                ids_mat = np.empty((0, k), np.int64)
+                scores_mat = np.empty((0, k), np.float64)
+            else:
+                ids_mat, scores_mat = structured_topk(i_ids, vals, inds)
+            df = DataFrame(names, None, cols)
+            df.add_column(f"{out}_ids", DataTypes.vector(BasicType.LONG), ids_mat)
+            df.add_column(
+                f"{out}_scores", DataTypes.vector(BasicType.DOUBLE), scores_mat
+            )
+            return df
+        return DataFrame(names, None, cols)
+
     def transform(self, *inputs):
         from flink_ml_tpu.parallel.mesh import get_mesh_context
 
@@ -236,11 +306,7 @@ class Swing(AlgoOperator, HasOutputCol, HasSeed):
             )
         users = np.asarray(df.column(self.get_user_col()), np.int64)
         items = np.asarray(df.column(self.get_item_col()), np.int64)
-        empty = DataFrame(
-            [self.get_item_col(), self.get_output_col()],
-            None,
-            [np.asarray([], np.int64), []],
-        )
+        empty = self._output_frame(np.asarray([], np.int64), [])
         if users.size == 0:
             return empty
 
@@ -317,8 +383,4 @@ class Swing(AlgoOperator, HasOutputCol, HasSeed):
 
         # --- host: decode + format (Swing.java:344-361 string encoding) -------
         out_items, out_strs = encode_topk(i_ids, vals, inds)
-        return DataFrame(
-            [self.get_item_col(), self.get_output_col()],
-            None,
-            [out_items, out_strs],
-        )
+        return self._output_frame(out_items, out_strs, vals=vals, inds=inds, i_ids=i_ids)

@@ -75,6 +75,15 @@ __all__ = [
     "sparse_to_dense_kernel",
     "sparse_interaction_fn",
     "sparse_interaction_kernel",
+    # retrieval top-K bodies (device-resident candidate scoring, docs/retrieval.md)
+    "swing_score_fn",
+    "swing_topk_fn",
+    "swing_topk_kernel",
+    "lsh_share_fn",
+    "lsh_jaccard_fn",
+    "lsh_topk_fn",
+    "lsh_topk_kernel",
+    "topk_pad_fn",
 ]
 
 
@@ -633,4 +642,175 @@ def sparse_interaction_kernel(dim_b: int):
     """Jitted ``sparse_interaction_fn`` at a fixed right-side width."""
     return jax.jit(
         lambda av, ai, an, bv, bi, bn: sparse_interaction_fn(av, ai, an, bv, bi, bn, dim_b)
+    )
+
+
+# -- retrieval top-K bodies (docs/retrieval.md) -------------------------------
+
+
+def swing_score_fn(values, ids, nnz, sim_values, sim_ids):
+    """Dense candidate scores from a sparse user history (the Swing full-score
+    phase): ``score[r, c] = Σ_h w_h · sim[h][c]`` over the history's real
+    slots, where ``sim`` is the candidate index's ELL neighbor table
+    (``sim_ids/sim_values [C, M]``, padding slots id 0 / value 0).
+
+    The history-slot axis folds STRICTLY SEQUENTIALLY (``lax.scan``, the
+    ``segment_sum`` discipline): appending padding slots (id 0 / weight 0)
+    appends exact-identity scatter-adds, so a row's scores are bit-identical
+    at every nnz cap on the ladder — the fused path (batch-shared cap) and
+    the per-stage reference (natural cap) agree bit for bit. Within one slot
+    the scattered columns are the neighbor list's ids, sorted-unique by the
+    index build, so no two real contributions collide and the scatter order
+    inside a step cannot reorder a float sum. Alongside the scores the fold
+    accumulates a history-hit mask; already-consumed candidates leave with
+    score −inf (a request's own history is never recommended back to it).
+    """
+    import jax.lax as lax
+
+    n = values.shape[0]
+    C = sim_values.shape[0]
+    rowsel = jnp.arange(n)
+    valid = _valid_slots(ids, nnz).astype(jnp.float32)  # [n, K] 1.0 real slots
+
+    def step(carry, slot):
+        scores, hits = carry
+        w, h, ok = slot  # [n] weight, history candidate row, validity
+        contrib = (w * ok)[:, None] * sim_values[h]  # [n, M]; pad slots add 0
+        scores = scores.at[rowsel[:, None], sim_ids[h]].add(contrib)
+        hits = hits.at[rowsel, h].add(ok)
+        return (scores, hits), None
+
+    init = (jnp.zeros((n, C), jnp.float32), jnp.zeros((n, C), jnp.float32))
+    (scores, hits), _ = lax.scan(
+        step, init, (values.T, ids.T, valid.T)
+    )
+    return jnp.where(hits > 0, -jnp.inf, scores)
+
+
+def topk_pad_fn(scores, rung: int, descending: bool = True):
+    """``jax.lax.top_k`` at a ladder rung wider than the candidate axis:
+    take the full top-C and pad the tail slots with row −1 / score ±inf (the
+    typed "no candidate" slots the retrieval client trims away). Prefix
+    stability of ``top_k`` (descending, ties to the lowest index) makes the
+    rung padding exact: the top-10 of a row is the first 10 entries of its
+    top-16."""
+    C = scores.shape[1]
+    kk = min(int(rung), C)
+    vals, idx = jax.lax.top_k(scores if descending else -scores, kk)
+    if not descending:
+        vals = -vals
+    pad = int(rung) - kk
+    if pad:
+        fill = -jnp.inf if descending else jnp.inf
+        vals = jnp.pad(vals, ((0, 0), (0, pad)), constant_values=fill)
+        idx = jnp.pad(idx, ((0, 0), (0, pad)), constant_values=-1)
+    empty = jnp.isinf(vals)
+    return vals, jnp.where(empty, -1, idx)
+
+
+def swing_topk_fn(values, ids, nnz, sim_values, sim_ids, rung: int):
+    """The fused Swing retrieval head: full-score then ``top_k`` at the K
+    ladder rung. Returns ``(rows [n, rung] i32, scores [n, rung] f32)`` sorted
+    best-first; slots past a row's scoreable candidates carry row −1 /
+    score −inf."""
+    scores = swing_score_fn(values, ids, nnz, sim_values, sim_ids)
+    vals, idx = topk_pad_fn(scores, rung, descending=True)
+    empty = (nnz <= 0)[:, None]  # no history: typed empty row, not zero-scores
+    return jnp.where(empty, -1, idx), jnp.where(empty, -jnp.inf, vals)
+
+
+def lsh_share_fn(q_lanes, cand_lanes, tables: int):
+    """Bucket-share counts of the LSH prune phase: how many of the ``T`` hash
+    tables each (query, candidate) pair fully agrees on. Hash values travel as
+    2 exact f32 lanes each (hi/lo 16-bit split — a MinHash value < 2^31 does
+    not fit f32's 24-bit mantissa, the split restores exact equality);
+    ``q_lanes [n, T·F·2]``, ``cand_lanes [C, T·F·2]``. A query lane of −1 (the
+    empty-feature sentinel) matches nothing."""
+    n = q_lanes.shape[0]
+    C = cand_lanes.shape[0]
+    q = q_lanes.reshape(n, tables, -1)  # [n, T, F·2]
+    c = cand_lanes.reshape(C, tables, -1)
+    eq = (q[:, None] == c[None]).all(axis=3)  # [n, C, T] full-table agreement
+    return eq.sum(axis=2).astype(jnp.int32)  # [n, C]
+
+
+def lsh_jaccard_fn(q_ids, q_nnz, cand_ids, cand_nnz):
+    """Exact 1 − Jaccard distances of the rank phase, over gathered candidate
+    ELL index sets: ``q_ids [n, Kq]`` (validity ``q_nnz``) against
+    ``cand_ids [n, P, M]`` (validity ``cand_nnz [n, P]``). Both sides carry
+    sorted-unique ids, so every pair matches at most once and the
+    intersection count is an exact integer."""
+    qv = _valid_slots(q_ids, q_nnz)  # [n, Kq]
+    slot = jnp.arange(cand_ids.shape[2])[None, None, :]
+    cvalid = slot < cand_nnz[:, :, None]  # [n, P, M]
+    eq = (
+        (q_ids[:, None, :, None] == cand_ids[:, :, None, :])
+        & qv[:, None, :, None]
+        & cvalid[:, :, None, :]
+    )  # [n, P, Kq, M]
+    inter = eq.sum(axis=(2, 3)).astype(jnp.float32)  # [n, P]
+    union = q_nnz[:, None].astype(jnp.float32) + cand_nnz.astype(jnp.float32) - inter
+    union = jnp.maximum(union, 1.0)
+    return 1.0 - inter / union
+
+
+def lsh_topk_fn(
+    q_lanes, q_ids, q_nnz, cand_lanes, cand_ids, cand_nnz, tables: int,
+    prune_cap: int, rung: int,
+):
+    """The fused two-phase LSH retrieval head (bucket-prune → exact rank):
+
+    1. **Prune**: ``top_k`` over the bucket-share counts keeps the
+       ``prune_cap`` candidates sharing the most hash tables (ties to the
+       lowest candidate row — the host reference's stable order). Candidates
+       sharing zero buckets are non-candidates per the reference semantics.
+    2. **Rank**: exact 1 − Jaccard on the pruned set only, then ``top_k``
+       ascending at the K ladder rung.
+
+    Returns ``(rows [n, rung] i32, distances [n, rung] f32)`` sorted
+    nearest-first; slots past a row's true candidate set carry row −1 /
+    distance +inf (the typed empty-result convention — a query sharing no
+    bucket with any candidate yields a fully −1 row instead of erroring).
+    Parity with the host reference is exact whenever a query's bucket-sharing
+    candidate count fits ``prune_cap`` (docs/retrieval.md)."""
+    C = cand_lanes.shape[0]
+    share = lsh_share_fn(q_lanes, cand_lanes, tables)  # [n, C]
+    P = min(int(prune_cap), C)
+    share_top, pruned = jax.lax.top_k(share.astype(jnp.float32), P)  # [n, P]
+    # Re-sort the kept set by candidate row (zero-share rows masked to C, past
+    # every real row): the rank phase's top_k then breaks distance ties toward
+    # the LOWEST candidate row — the host reference's stable ascending order —
+    # instead of toward the higher bucket-share count the prune order carries.
+    pruned = jnp.sort(jnp.where(share_top > 0, pruned, C), axis=1)
+    valid = pruned < C
+    rows_for_rank = jnp.where(valid, pruned, 0)
+    dist = lsh_jaccard_fn(q_ids, q_nnz, cand_ids[rows_for_rank], cand_nnz[rows_for_rank])
+    dist = jnp.where(valid, dist, jnp.inf)  # zero-share: not a candidate
+    kk = min(int(rung), P)
+    neg, pos = jax.lax.top_k(-dist, kk)
+    out_dist = -neg
+    rows = jnp.take_along_axis(pruned, pos, axis=1)
+    pad = int(rung) - kk
+    if pad:
+        out_dist = jnp.pad(out_dist, ((0, 0), (0, pad)), constant_values=jnp.inf)
+        rows = jnp.pad(rows, ((0, 0), (0, pad)), constant_values=-1)
+    return jnp.where(jnp.isinf(out_dist), -1, rows), out_dist
+
+
+@functools.cache
+def swing_topk_kernel(rung: int):
+    """Jitted ``swing_topk_fn`` at a fixed K ladder rung (the per-stage path —
+    same op graph as the fused head, so fallback results match bit for bit)."""
+    return jax.jit(
+        lambda v, i, z, sv, si: swing_topk_fn(v, i, z, sv, si, rung)
+    )
+
+
+@functools.cache
+def lsh_topk_kernel(tables: int, prune_cap: int, rung: int):
+    """Jitted ``lsh_topk_fn`` at fixed table count / prune cap / K rung."""
+    return jax.jit(
+        lambda ql, qi, qz, cl, ci, cz: lsh_topk_fn(
+            ql, qi, qz, cl, ci, cz, tables, prune_cap, rung
+        )
     )

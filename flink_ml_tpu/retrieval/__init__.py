@@ -1,0 +1,17 @@
+"""Retrieval tier — device-resident top-K serving for the recommendation
+workload family (docs/retrieval.md).
+
+- :class:`~flink_ml_tpu.retrieval.index.CandidateIndex` — the publishable,
+  versioned index artifact: candidate score/neighbor matrices (Swing) or LSH
+  hash tables + index sets, hot-swapped through the same registry/poller
+  machinery model versions use.
+- :class:`~flink_ml_tpu.retrieval.client.RetrievalClient` — the request-side
+  wrapper: item-id ↔ candidate-row translation, per-request K, rung trimming.
+
+The package imports only L0/L1 (api, linalg, servable, utils) — a serving
+process loads a published index without the training stack.
+"""
+from flink_ml_tpu.retrieval.client import RetrievalClient
+from flink_ml_tpu.retrieval.index import CandidateIndex
+
+__all__ = ["CandidateIndex", "RetrievalClient"]

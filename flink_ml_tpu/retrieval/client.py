@@ -1,0 +1,146 @@
+"""RetrievalClient — the request-side wrapper around a served candidate index.
+
+The serving heads speak candidate ROWS and ladder-rung-wide result slabs
+(``servable/retrieval.py``); callers speak item ids and exact per-request K.
+This wrapper owns the translation in both directions:
+
+- **swing** queries are ``(item_id, weight)`` histories; the client maps item
+  ids onto the index's candidate rows (unknown ids are dropped — they can
+  neither contribute signal nor be recommended) and packs a
+  ``SparseVector(C, rows, weights)`` per request.
+- **lsh** queries are feature vectors, passed through unchanged.
+- Requests carry their true K in the ``kCol`` scalar column; the batch
+  compiles at the max-K ladder rung, and the client trims each reply back to
+  its request's K, drops the typed-empty slots (row −1) and translates rows
+  to item ids against the index's ``item_ids``.
+- When the backend's ``predict`` takes a ``shape_key`` parameter
+  (``InferenceServer`` does), the client passes ``"k<rung>"`` so the batcher
+  only coalesces requests headed for the same compiled rung. The fleet
+  router doesn't take one — that's fine, the key is purely an optimization
+  (a mixed batch still answers correctly at the wider rung).
+
+The module imports only L0/L1 — it runs in a pure serving process.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.servable.shapes import k_rung
+
+__all__ = ["RetrievalClient"]
+
+#: One request's answer: (item ids best-first, scores) — Swing similarity
+#: descending or LSH 1 − Jaccard distance ascending.
+Result = Tuple[np.ndarray, np.ndarray]
+
+
+class RetrievalClient:
+    """Query a served :class:`~flink_ml_tpu.retrieval.index.CandidateIndex`.
+
+    ``backend`` is anything with ``predict(df, ...)`` (``InferenceServer``,
+    ``FleetRouter``) or, failing that, ``transform(df)`` (a bare servable —
+    tests, offline scoring). ``index`` is duck-typed: it provides the kind,
+    the column params and ``item_ids`` (a ``CandidateIndex`` or either
+    servable head works)."""
+
+    def __init__(self, backend, index):
+        self._backend = backend
+        self._kind = (
+            index.get_index_kind()
+            if hasattr(index, "get_index_kind")
+            else ("swing" if hasattr(index, "get_history_col") else "lsh")
+        )
+        self._item_ids = np.asarray(index.item_ids, np.int64)
+        self._row_of = {int(v): r for r, v in enumerate(self._item_ids)}
+        self._k_col = index.get_k_col()
+        out = index.get_output_col()
+        self._rows_col, self._scores_col = f"{out}_rows", f"{out}_scores"
+        if self._kind == "swing":
+            self._query_col = index.get_history_col()
+        else:
+            self._query_col = index.get_input_col()
+        predict = getattr(backend, "predict", None)
+        self._predict = predict if callable(predict) else None
+        # Explicit-parameter check, not **kwargs acceptance: the fleet
+        # router's predict(**kw) forwards into submit(), which would
+        # TypeError on an unknown shape_key.
+        self._accepts_shape_key = self._predict is not None and (
+            "shape_key" in inspect.signature(self._predict).parameters
+        )
+
+    @property
+    def candidate_count(self) -> int:
+        return int(self._item_ids.shape[0])
+
+    # --- query building -------------------------------------------------------
+    def history_vector(self, history) -> SparseVector:
+        """One swing query: ``(item_id, weight)`` pairs (or a mapping) →
+        ``SparseVector`` over candidate rows, weights summed per row,
+        unknown item ids dropped."""
+        pairs = history.items() if hasattr(history, "items") else history
+        weights: dict = {}
+        for item, w in pairs:
+            row = self._row_of.get(int(item))
+            if row is not None:
+                weights[row] = weights.get(row, 0.0) + float(w)
+        rows = np.asarray(sorted(weights), np.int64)
+        vals = np.asarray([weights[int(r)] for r in rows], np.float64)
+        return SparseVector(self.candidate_count, rows, vals)
+
+    def _request_frame(self, queries: Sequence, ks: np.ndarray) -> DataFrame:
+        if self._kind == "swing":
+            col = [
+                q if isinstance(q, SparseVector) else self.history_vector(q)
+                for q in queries
+            ]
+        else:
+            col = list(queries)
+        return DataFrame(
+            [self._query_col, self._k_col], None, [col, ks.astype(np.int64)]
+        )
+
+    # --- the round trip -------------------------------------------------------
+    def query(
+        self,
+        queries: Sequence,
+        k: Union[int, Sequence[int]],
+        **predict_kwargs,
+    ) -> List[Result]:
+        """Answer a batch of retrieval queries: swing histories or LSH feature
+        vectors per the index kind. ``k`` is one int for all requests or one
+        per request. Extra kwargs (``timeout_ms``, ``priority``) pass through
+        to the backend's ``predict``. Returns per request ``(item_ids,
+        scores)`` best-first, each exactly ``min(k, hits)`` long."""
+        n = len(queries)
+        ks = np.broadcast_to(np.asarray(k, np.int64), (n,)).copy()
+        if n and int(ks.min()) < 1:
+            raise ValueError("k must be >= 1")
+        df = self._request_frame(queries, ks)
+        if self._predict is not None:
+            if self._accepts_shape_key and n:
+                predict_kwargs.setdefault(
+                    "shape_key", f"k{k_rung(int(ks.max()))}"
+                )
+            out = self._predict(df, **predict_kwargs)
+        else:
+            out = self._backend.transform(df)
+        # InferenceServer/FleetRouter wrap the frame in a ServingResponse.
+        out = getattr(out, "dataframe", out)
+        return self._trim(out, ks)
+
+    def _trim(self, out: DataFrame, ks: np.ndarray) -> List[Result]:
+        rows_mat = np.asarray(out.column(self._rows_col), np.int64)
+        score_mat = np.asarray(out.column(self._scores_col), np.float64)
+        results: List[Result] = []
+        for rows, scores, k in zip(rows_mat, score_mat, ks):
+            head = rows[: int(k)]
+            keep = head >= 0  # typed-empty slots carry row −1
+            results.append(
+                (self._item_ids[head[keep]], scores[: int(k)][keep])
+            )
+        return results

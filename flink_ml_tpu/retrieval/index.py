@@ -1,0 +1,301 @@
+"""CandidateIndex — the publishable, versioned retrieval index artifact.
+
+An index is model data with a different provenance: instead of fitted
+coefficients it holds a candidate catalog (``item_ids``) plus the
+device-resident structures one of the two retrieval heads scores against —
+
+- **swing** — the ELL neighbor table (``sim_ids``/``sim_values [C, M]``,
+  padding slots id 0 / value 0) distilled from a Swing run's item-item
+  similarity output; served by
+  :class:`~flink_ml_tpu.servable.retrieval.SwingTopKServable`.
+- **lsh** — MinHash hash-table lanes (``cand_lanes [C, 2·T·F]``), exact
+  candidate index sets (``cand_ids``/``cand_nnz``) and the hash family's
+  coefficients; served by
+  :class:`~flink_ml_tpu.servable.retrieval.LSHTopKServable`.
+
+Because the artifact rides the framework's stage persistence (metadata JSON +
+``data/model_data.npz``), everything built for model versions works on
+indices unchanged: ``publish_servable`` writes ``v-<N>`` atomically, the
+``ModelVersionPoller`` discovers + loads + WARMS a new index off the serving
+path, ``ModelRegistry.swap`` flips it in atomically, rollback quarantines it
+— an index version and a model version are the same lifecycle
+(docs/retrieval.md, docs/serving.md).
+
+The module is L3 but imports only L0/L1 — a published index loads in a
+serving process with no training stack present. In particular the builders
+take the *output DataFrame* of a Swing run (string or structured encoding)
+and a duck-typed fitted MinHashLSH model, never the model classes.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from flink_ml_tpu.api.core import Stage
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.params.param import (
+    IntParam,
+    ParamValidators,
+    StringParam,
+    update_existing_params,
+)
+from flink_ml_tpu.params.shared import HasInputCol, HasOutputCol
+from flink_ml_tpu.servable.retrieval import (
+    HasKCol,
+    LSHTopKServable,
+    SwingTopKServable,
+    index_sets,
+    minhash_lanes,
+)
+from flink_ml_tpu.utils import read_write as rw
+
+__all__ = ["CandidateIndex", "KIND_LSH", "KIND_SWING"]
+
+KIND_SWING = "swing"
+KIND_LSH = "lsh"
+
+#: Which servable class serves each index kind (the ``load_servable``
+#: dispatch table).
+_SERVABLES = {KIND_SWING: SwingTopKServable, KIND_LSH: LSHTopKServable}
+
+#: Model-array names each kind must carry (validated at save).
+_ARRAY_NAMES = {
+    KIND_SWING: ("item_ids", "sim_values", "sim_ids"),
+    KIND_LSH: ("item_ids", "cand_lanes", "cand_ids", "cand_nnz", "coeff_a", "coeff_b"),
+}
+
+
+class CandidateIndex(Stage, HasInputCol, HasOutputCol, HasKCol):
+    """Device-resident candidate index; see module docstring.
+
+    The params mirror the serving head's params by NAME (``historyCol``,
+    ``kCol``, ``outputCol``, ``inputCol``, ``numHashTables``, …) so a
+    published index's metadata configures the loaded servable directly —
+    ``load_servable`` is a pure class dispatch on ``indexKind``, no param
+    translation layer."""
+
+    INDEX_KIND = StringParam(
+        "indexKind",
+        "Which retrieval head serves this index.",
+        KIND_SWING,
+        ParamValidators.in_array([KIND_SWING, KIND_LSH]),
+    )
+    HISTORY_COL = StringParam(
+        "historyCol",
+        "Sparse request column of consumed-candidate weights over the "
+        "candidate-row space (swing kind).",
+        "history",
+        ParamValidators.not_null(),
+    )
+    NUM_HASH_TABLES = IntParam(
+        "numHashTables", "Number of hash tables (lsh kind).", 1, ParamValidators.gt_eq(1)
+    )
+    NUM_HASH_FUNCTIONS_PER_TABLE = IntParam(
+        "numHashFunctionsPerTable",
+        "Number of hash functions per hash table (lsh kind).",
+        1,
+        ParamValidators.gt_eq(1),
+    )
+
+    def __init__(self, arrays: Optional[Dict[str, np.ndarray]] = None):
+        super().__init__()
+        self.arrays: Dict[str, np.ndarray] = {
+            k: np.asarray(v) for k, v in (arrays or {}).items()
+        }
+
+    # --- params ---------------------------------------------------------------
+    def get_index_kind(self) -> str:
+        return self.get(self.INDEX_KIND)
+
+    def set_index_kind(self, value: str):
+        return self.set(self.INDEX_KIND, value)
+
+    def get_history_col(self) -> str:
+        return self.get(self.HISTORY_COL)
+
+    def set_history_col(self, value: str):
+        return self.set(self.HISTORY_COL, value)
+
+    def get_num_hash_tables(self) -> int:
+        return self.get(self.NUM_HASH_TABLES)
+
+    def set_num_hash_tables(self, value: int):
+        return self.set(self.NUM_HASH_TABLES, value)
+
+    def get_num_hash_functions_per_table(self) -> int:
+        return self.get(self.NUM_HASH_FUNCTIONS_PER_TABLE)
+
+    def set_num_hash_functions_per_table(self, value: int):
+        return self.set(self.NUM_HASH_FUNCTIONS_PER_TABLE, value)
+
+    # --- introspection --------------------------------------------------------
+    @property
+    def item_ids(self) -> np.ndarray:
+        return np.asarray(self.arrays["item_ids"], np.int64)
+
+    @property
+    def candidate_count(self) -> int:
+        return int(self.item_ids.shape[0])
+
+    def _check_arrays(self) -> None:
+        required = _ARRAY_NAMES[self.get_index_kind()]
+        missing = [n for n in required if n not in self.arrays]
+        if missing:
+            raise RuntimeError(
+                f"{self.get_index_kind()!r} index has no data yet (missing {missing}); "
+                "build it with from_swing_output/from_lsh_model first"
+            )
+
+    # --- persistence (the model-version save layout, utils/read_write.py) ----
+    def save(self, path: str) -> None:
+        self._check_arrays()
+        rw.save_metadata(self, path)
+        rw.save_model_arrays(path, self.arrays)
+
+    @classmethod
+    def load(cls, path: str) -> "CandidateIndex":
+        metadata = rw.load_metadata(path, rw.stage_class_name(cls))
+        index = cls()
+        index.load_param_map_from_json(metadata["paramMap"])
+        index.arrays = rw.load_model_arrays(path)
+        return index
+
+    @classmethod
+    def load_servable(cls, path: str):
+        """The serving-side loader (``servable.api.load_servable`` dispatches
+        here via the saved className): returns the runtime-free top-K head
+        for the saved kind, params restored from the index metadata, arrays
+        from its npz — the training stack is never imported."""
+        metadata = rw.load_metadata(path)
+        probe = cls()
+        known = {p.name for p in probe.get_param_map()}
+        probe.load_param_map_from_json(
+            {k: v for k, v in metadata["paramMap"].items() if k in known}
+        )
+        return _SERVABLES[probe.get_index_kind()].load_servable(path)
+
+    def servable(self):
+        """The in-process servable of this index (no save/load round trip —
+        tests and single-process serving)."""
+        self._check_arrays()
+        head = _SERVABLES[self.get_index_kind()]()
+        update_existing_params(head, self)
+        head._apply_model_arrays(self.arrays)
+        return head
+
+    # --- builders -------------------------------------------------------------
+    @classmethod
+    def from_swing_output(
+        cls,
+        df: DataFrame,
+        *,
+        item_col: str = "item",
+        output_col: str = "output",
+        **params,
+    ) -> "CandidateIndex":
+        """Distill a Swing run's item-item similarity output into a swing
+        index. Accepts either encoding Swing emits: the reference's
+        ``"item,score;…"`` strings in ``output_col``, or the structured
+        ``<output_col>_ids`` / ``<output_col>_scores`` columns when present
+        (``Swing.structuredOutput``). The candidate space is the sorted
+        unique union of source items and their neighbors, so every id a
+        history can mention has a candidate row; neighbor lists land in the
+        ELL layout with per-row ids sorted ascending (the no-collision
+        scatter invariant ``swing_score_fn`` relies on) and padding slots
+        id 0 / value 0 (exact-identity adds)."""
+        items = np.asarray(df.column(item_col), np.int64)
+        ids_col, scores_col = f"{output_col}_ids", f"{output_col}_scores"
+        names = set(df.column_names)
+        neighbors = []  # per source item: (neighbor ids int64, scores f64)
+        if ids_col in names and scores_col in names:
+            nid_mat = np.asarray(df.column(ids_col), np.int64)
+            sc_mat = np.asarray(df.column(scores_col), np.float64)
+            for nid, sc in zip(nid_mat, sc_mat):
+                keep = (nid >= 0) & (sc > 0.0)
+                neighbors.append((nid[keep], sc[keep]))
+        else:
+            for enc in df.column(output_col):
+                pairs = [p.split(",") for p in str(enc).split(";") if p]
+                neighbors.append(
+                    (
+                        np.asarray([int(i) for i, _ in pairs], np.int64),
+                        np.asarray([float(s) for _, s in pairs], np.float64),
+                    )
+                )
+        vocab = np.unique(
+            np.concatenate([items] + [nid for nid, _ in neighbors])
+            if len(items)
+            else np.empty(0, np.int64)
+        )
+        if vocab.size == 0:
+            raise ValueError("empty Swing output — nothing to index")
+        C = int(vocab.size)
+        M = max(1, max((len(nid) for nid, _ in neighbors), default=1))
+        sim_ids = np.zeros((C, M), np.int32)
+        sim_values = np.zeros((C, M), np.float32)
+        row_of = {int(v): r for r, v in enumerate(vocab)}
+        for item, (nid, sc) in zip(items, neighbors):
+            r = row_of[int(item)]
+            rows = np.asarray([row_of[int(i)] for i in nid], np.int32)
+            order = np.argsort(rows, kind="stable")  # sorted-unique per slot
+            sim_ids[r, : len(rows)] = rows[order]
+            sim_values[r, : len(rows)] = sc[order]
+        index = cls(
+            {"item_ids": vocab, "sim_values": sim_values, "sim_ids": sim_ids}
+        )
+        index.set_index_kind(KIND_SWING)
+        for name, value in params.items():
+            index.set(index.get_param(name), value)
+        return index
+
+    @classmethod
+    def from_lsh_model(
+        cls,
+        model,
+        df: DataFrame,
+        *,
+        id_col: str,
+        vector_col: Optional[str] = None,
+        **params,
+    ) -> "CandidateIndex":
+        """Index a candidate dataset under a fitted MinHashLSH model's hash
+        family. ``model`` is duck-typed (``coeff_a``/``coeff_b`` +
+        ``get_num_hash_tables``/``get_num_hash_functions_per_table``/
+        ``get_input_col``) so this module never imports the training stack.
+        Candidate hash values are computed host-exact (int64) and stored as
+        the hi/lo f32 lane split alongside each candidate's exact index set
+        (the two phases of ``lsh_topk_fn``)."""
+        vector_col = vector_col or model.get_input_col()
+        sets = index_sets(df.column(vector_col))
+        coeff_a = np.asarray(model.coeff_a, np.int64)
+        coeff_b = np.asarray(model.coeff_b, np.int64)
+        cand_lanes = minhash_lanes(sets, coeff_a, coeff_b)
+        C = len(sets)
+        if C == 0:
+            raise ValueError("empty candidate dataset — nothing to index")
+        M = max(1, max((len(s) for s in sets), default=1))
+        cand_ids = np.zeros((C, M), np.int32)
+        cand_nnz = np.zeros(C, np.int32)
+        for r, s in enumerate(sets):
+            cand_ids[r, : len(s)] = s
+            cand_nnz[r] = len(s)
+        index = cls(
+            {
+                "item_ids": np.asarray(df.column(id_col), np.int64),
+                "cand_lanes": cand_lanes,
+                "cand_ids": cand_ids,
+                "cand_nnz": cand_nnz,
+                "coeff_a": coeff_a,
+                "coeff_b": coeff_b,
+            }
+        )
+        index.set_index_kind(KIND_LSH)
+        index.set_input_col(vector_col)
+        index.set_num_hash_tables(model.get_num_hash_tables())
+        index.set_num_hash_functions_per_table(
+            model.get_num_hash_functions_per_table()
+        )
+        for name, value in params.items():
+            index.set(index.get_param(name), value)
+        return index

@@ -28,6 +28,12 @@ describe itself as a :class:`KernelSpec`:
     lookup): the spec's ``host_ingests[col]`` callable builds the quadruple
     (``!values``/``!ids``/``!nnz``/``!len``) on the host at ingest time;
     the device kernel owns the segment reduce (duplicate combine).
+  * ``"shape"`` — a per-request output-width column (the retrieval top-K
+    convention, ``servable/shapes.py``): the scalar column carries each
+    request's true K on the host; the program receives only a zero-filled
+    ``col!shape`` carrier whose static width is the batch's K ladder rung
+    (``kernel_fn`` reads ``cols[shape_name(col)].shape[1]`` at trace time).
+    The rung joins the compiled-plan key next to the bucket and the nnz cap.
 
   A sparse column arriving where the spec expects a dense kind still raises
   the planner's ineligibility signal and the whole segment falls back to
@@ -80,11 +86,12 @@ from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
+from flink_ml_tpu.servable.shapes import shape_name
 from flink_ml_tpu.servable.sparse import entries_names, sparse_names
 
 __all__ = ["KernelSpec"]
 
-_VALID_KINDS = ("vector", "scalar", "dense", "sparse", "entries")
+_VALID_KINDS = ("vector", "scalar", "dense", "sparse", "entries", "shape")
 
 #: Input kinds that ride the sparse calling convention (docs/sparse.md):
 #: ``"sparse"`` — a SparseVector column packed to the values/ids/nnz triple
@@ -196,6 +203,8 @@ class KernelSpec:
             return sparse_names(col)
         if kind == "entries":
             return entries_names(col)
+        if kind == "shape":
+            return (shape_name(col),)
         return (col,)
 
     def program_output_names(self, col: str) -> Tuple[str, ...]:

@@ -73,6 +73,7 @@ import numpy as np
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.api.types import BasicType, DataTypes
 from flink_ml_tpu.servable.fusion import chain_score
+from flink_ml_tpu.servable.shapes import k_rung, shape_array, shape_name
 from flink_ml_tpu.servable.sparse import (
     SPARSE_MARK,
     OffLadderError,
@@ -235,7 +236,7 @@ class FusedSegment:
     __slots__ = (
         "stages", "specs", "external_inputs", "device_models", "programs",
         "compiled", "signatures", "sharding", "fusion", "mega", "plan_kinds",
-        "sparse_outputs", "has_sparse_inputs",
+        "sparse_outputs", "has_sparse_inputs", "has_shape_inputs",
     )
 
     def __init__(
@@ -270,6 +271,13 @@ class FusedSegment:
         self.has_sparse_inputs = any(
             self.input_kind(name) in ("sparse", "entries")
             for name in self.external_inputs
+        )
+        #: Whether any external input is a per-request output-width column
+        #: (the retrieval top-K convention, ``servable/shapes.py``) — such
+        #: segments extend their compiled key with the K ladder rung and the
+        #: serving warmup covers the configured K ladder.
+        self.has_shape_inputs = any(
+            self.input_kind(name) == "shape" for name in self.external_inputs
         )
         # One upload per model array, at construction — the committed buffers
         # the hot path closes over. On a mesh this is the per-shard weight
@@ -406,6 +414,44 @@ class FusedSegment:
             ) from e
         except Exception as e:
             raise IneligibleBatch(f"column {name!r} not packable: {e}") from e
+
+    def gather_shape(
+        self,
+        df: DataFrame,
+        names: Sequence[str],
+        *,
+        rung: Optional[int] = None,
+        cap_max: Optional[int] = None,
+    ) -> Tuple[Dict[str, np.ndarray], int]:
+        """One host-side read of the segment's ``"shape"``-kind columns (the
+        per-request top-K widths): the batch's K ladder rung is the max true
+        K across every shape column, rounded up to a power of two — or the
+        forced ``rung`` (warmup walks the configured K ladder). Returns the
+        ``({col!shape: zeros [n, rung]}, rung)`` carrier arrays the programs
+        key their static output width on. Raises :class:`IneligibleBatch`
+        (``off_ladder``) when the batch asks for more than ``cap_max``."""
+        kmax = 1
+        if rung is None:
+            for name in names:
+                try:
+                    ks = df.scalars(name)
+                except Exception as e:
+                    raise IneligibleBatch(
+                        f"column {name!r} not usable as a top-K width: {e}"
+                    ) from e
+                if len(ks):
+                    kmax = max(kmax, int(np.max(ks)))
+            rung = k_rung(kmax)
+            if cap_max is not None and rung > cap_max:
+                raise IneligibleBatch(
+                    f"per-request K {kmax} — ladder rung {rung} exceeds "
+                    f"retrieval.k.cap.max={cap_max}",
+                    reason="off_ladder",
+                )
+        return (
+            {shape_name(name): shape_array(len(df), rung) for name in names},
+            rung,
+        )
 
     @property
     def outputs(self) -> List[Tuple[str, Any]]:

@@ -1,0 +1,90 @@
+"""Per-request output-shape ladder — the retrieval top-K width convention.
+
+Row-wise transforms have one output shape: a row in, a row out, and the
+compiled-plan key is the padded row bucket (plus the nnz cap for sparse
+ingest). The retrieval serving shape (docs/retrieval.md) is different: a
+request asks for its own ``K`` candidates, so the *output width* of the
+program varies per request while XLA still needs it static. This module is
+the convention that keeps the executable set bounded anyway, mirroring the
+sparse nnz-cap ladder (``servable/sparse.py``) exactly:
+
+**Ladder.** A per-request K never compiles at its natural value: it rounds up
+to a power-of-two **K rung** (``linalg.sparse_batch.ladder_cap`` — the same
+ladder function the nnz caps use), so every requested width compiles to ≤ 1
+executable per (row bucket, nnz cap, K rung) and the serving tier can
+AOT-warm the whole ladder. A batch whose max K exceeds
+``retrieval.k.cap.max`` is **off-ladder** and falls back per-stage.
+
+**Prefix stability.** Rung padding is exact, not approximate:
+``jax.lax.top_k`` returns results sorted descending with ties broken toward
+the lowest index, so the top-10 of a row is bit-for-bit the first 10 entries
+of its top-16 — trimming a rung-wide result to the requested K (the
+retrieval client's job) reproduces the K-exact answer.
+
+**Wire form.** A ``"shape"``-kind input column (``servable/kernel_spec.py``)
+does not carry data into the program at all — the scalar column holds each
+request's true K, and the ingest turns the batch's rung into a zero-filled
+``[rows, rung]`` carrier array under the ``col!shape`` program name. The
+kernel reads the static width from ``cols[shape_name(col)].shape[1]`` at
+trace time; the array contents are never consumed. Keeping the carrier
+row-aligned means mesh sharding, the signature check, and the plan-cache
+digest all treat it like any other dense input — no special cases anywhere
+downstream of the ingest.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.linalg.sparse_batch import ladder_cap
+
+__all__ = [
+    "k_rung",
+    "resolve_k_cap_max",
+    "resolve_warm_ks",
+    "shape_array",
+    "shape_name",
+]
+
+
+def shape_name(col: str) -> str:
+    """Program-level name of a ``"shape"``-kind column's carrier array."""
+    return f"{col}!shape"
+
+
+def k_rung(k: int) -> int:
+    """The K ladder rung a requested width compiles at (power of two, floor 1;
+    ``ladder_cap`` owns the host-int coercion)."""
+    return ladder_cap(k)
+
+
+def resolve_k_cap_max() -> int:
+    """Top rung of the top-K width ladder (``retrieval.k.cap.max``)."""
+    return max(1, int(config.get(Options.RETRIEVAL_K_CAP_MAX)))
+
+
+def resolve_warm_ks() -> Tuple[int, ...]:
+    """The K rungs serving warmup AOT-compiles per (bucket, nnz cap):
+    ``retrieval.warmup.ks`` when set (comma-separated, each rounded up to its
+    rung), else the full power-of-two ladder up to ``retrieval.k.cap.max`` —
+    zero post-warmup compiles then holds for every on-ladder K."""
+    raw = config.get(Options.RETRIEVAL_WARMUP_KS)
+    cap_max = resolve_k_cap_max()
+    if raw:
+        rungs = sorted({k_rung(int(k)) for k in str(raw).split(",") if str(k).strip()})
+        return tuple(r for r in rungs if r <= cap_max) or (cap_max,)
+    rungs, r = [], 1
+    while r <= cap_max:
+        rungs.append(r)
+        r *= 2
+    return tuple(rungs)
+
+
+def shape_array(rows: int, rung: int) -> np.ndarray:
+    """The zero-filled ``[rows, rung]`` carrier a shape column ingests as —
+    row-aligned so sharding/signature/plan-cache machinery treats it like any
+    dense input; only its static width is ever read (at trace time). Both
+    arguments are host ints by contract (row count / ladder rung)."""
+    return np.zeros((rows, rung), np.float32)

@@ -117,11 +117,13 @@ class PendingRequest:
     batcher-side state machine."""
 
     __slots__ = (
-        "df", "rows", "enqueued_at", "deadline", "priority",
+        "df", "rows", "enqueued_at", "deadline", "priority", "shape_key",
         "_event", "_state", "response", "error", "_abandon_cb", "trace",
     )
 
-    def __init__(self, df: DataFrame, deadline: float, priority: int = 0):
+    def __init__(
+        self, df: DataFrame, deadline: float, priority: int = 0, shape_key=None
+    ):
         self.df = df
         self.rows = len(df)
         self.enqueued_at = time.perf_counter()
@@ -129,6 +131,14 @@ class PendingRequest:
         #: 0 = most important (the default). The adaptive controller sheds
         #: priorities >= ``serving.shed.priority`` under sustained overload.
         self.priority = priority
+        #: Optional batch-affinity hint (the retrieval tier passes the
+        #: request's top-K ladder rung): requests with different keys never
+        #: coalesce into one batch, so a K=10 burst is not widened to a
+        #: concurrent K=100 request's rung. Purely an optimization — a mixed
+        #: batch would still be correct (the batch compiles at its max rung
+        #: and every client trims to its own K); None (the default) groups
+        #: with everything.
+        self.shape_key = shape_key
         self._event = threading.Event()
         self._state = _PENDING
         self.response = None
@@ -243,7 +253,9 @@ class MicroBatcher:
             return self._closed
 
     # -- client side ----------------------------------------------------------
-    def submit(self, df: DataFrame, timeout_s: float, priority: int = 0) -> PendingRequest:
+    def submit(
+        self, df: DataFrame, timeout_s: float, priority: int = 0, shape_key=None
+    ) -> PendingRequest:
         rows = len(df)
         if rows == 0:
             raise ValueError("cannot serve an empty request")
@@ -263,7 +275,12 @@ class MicroBatcher:
             req_span = tracer.begin("serving.request", CAT_PRODUCTIVE, scope=self.scope)
             if req_span is not None:
                 req_span.set_attr("rows", rows)
-        req = PendingRequest(df, deadline=time.perf_counter() + timeout_s, priority=priority)
+        req = PendingRequest(
+            df,
+            deadline=time.perf_counter() + timeout_s,
+            priority=priority,
+            shape_key=shape_key,
+        )
         req.trace = req_span
         try:
             with self._cond:
@@ -367,14 +384,23 @@ class MicroBatcher:
                 if cap is not None and cap < cap_rows:
                     cap_rows = max(cap, head.rows)
             downshifted = False
+            batch_key = None
             while i < len(self._queue):
                 req = self._queue[i]
+                if claimed and req.shape_key != batch_key:
+                    # Different batch-affinity group (a retrieval request at
+                    # another K rung): leave it queued, in place, for its own
+                    # batch — FIFO order within each group is preserved.
+                    i += 1
+                    continue
                 if rows + req.rows > cap_rows:
                     downshifted = cap_rows < self.max_batch_size
                     break
                 self._queue.pop(i)
                 self._queued_rows -= req.rows
                 req._state = _CLAIMED
+                if not claimed:
+                    batch_key = req.shape_key
                 claimed.append(req)
                 rows += req.rows
             if downshifted and claimed:

@@ -60,6 +60,7 @@ from flink_ml_tpu.servable.planner import (
     build_segments,
     run_segment,
 )
+from flink_ml_tpu.servable.shapes import resolve_k_cap_max, resolve_warm_ks
 from flink_ml_tpu.servable.sparse import resolve_nnz_cap_max, resolve_warm_caps
 from flink_ml_tpu.serving.batcher import pad_to
 from flink_ml_tpu.trace import CAT_COMPILE, CAT_SWAP, tracer
@@ -175,73 +176,87 @@ class CompiledServingPlan:
             isinstance(s, FusedSegment) and s.has_sparse_inputs for s in self.segments
         ):
             warm_caps = resolve_warm_caps()
+        # Retrieval segments key executables by (bucket[, cap], K rung): warm
+        # the configured K ladder too, so zero-post-warmup-compiles holds for
+        # every on-ladder per-request K (docs/retrieval.md).
+        warm_ks: Tuple[Optional[int], ...] = (None,)
+        if any(
+            isinstance(s, FusedSegment) and s.has_shape_inputs for s in self.segments
+        ):
+            warm_ks = resolve_warm_ks()
         for bucket in buckets:
             for cap in warm_caps:
-                with tracer.span("serving.plan.warmup", CAT_COMPILE, scope=self.scope) as sp:
-                    sp.set_attr("bucket", bucket)
-                    sp.set_attr("fusion", self.fusion.mode)
-                    if cap is not None:
-                        sp.set_attr("nnz_cap", cap)
-                    if self.sharding is not None:
-                        sp.set_attr("shards", self.sharding.n_data)
-                    bucket_cache = {"hits": 0, "misses": 0}
+                for krung in warm_ks:
+                    with tracer.span("serving.plan.warmup", CAT_COMPILE, scope=self.scope) as sp:
+                        sp.set_attr("bucket", bucket)
+                        sp.set_attr("fusion", self.fusion.mode)
+                        if cap is not None:
+                            sp.set_attr("nnz_cap", cap)
+                        if krung is not None:
+                            sp.set_attr("k_rung", krung)
+                        if self.sharding is not None:
+                            sp.set_attr("shards", self.sharding.n_data)
+                        bucket_cache = {"hits": 0, "misses": 0}
 
-                    def on_cache(outcome: str, ms: float, _b=bucket_cache) -> None:
-                        _b["hits" if outcome == "hit" else "misses"] += 1
-                        totals["hits" if outcome == "hit" else "misses"] += 1
-                        if outcome == "hit":
-                            totals["load_ms"] += ms
+                        def on_cache(outcome: str, ms: float, _b=bucket_cache) -> None:
+                            _b["hits" if outcome == "hit" else "misses"] += 1
+                            totals["hits" if outcome == "hit" else "misses"] += 1
+                            if outcome == "hit":
+                                totals["load_ms"] += ms
 
-                    df = pad_to(template, bucket)
-                    for segment in self.segments:
-                        if isinstance(segment, FallbackStage):
-                            df = segment.stage.transform(df)
-                            continue
-                        try:
-                            inputs, key, _cap, _nnz = self._ingest(
+                        df = pad_to(template, bucket)
+                        for segment in self.segments:
+                            if isinstance(segment, FallbackStage):
+                                df = segment.stage.transform(df)
+                                continue
+                            try:
+                                inputs, key, _cap, _nnz = self._ingest(
+                                    segment,
+                                    df,
+                                    bucket,
+                                    cap=cap if segment.has_sparse_inputs else None,
+                                    warm=True,
+                                    k_rung=krung if segment.has_shape_inputs else None,
+                                )
+                            except IneligibleBatch:
+                                # e.g. a sparse features template where the
+                                # spec expects dense: this segment will serve
+                                # through the per-stage path (as dispatch
+                                # falls back), so warm the stages' own jit
+                                # kernels instead of compiling a fused chain
+                                # the traffic can never hit.
+                                for stage in segment.stages:
+                                    df = stage.transform(df)
+                                continue
+                            outputs = run_segment(
                                 segment,
-                                df,
-                                bucket,
-                                cap=cap if segment.has_sparse_inputs else None,
-                                warm=True,
+                                key,
+                                inputs,
+                                on_plan=self._on_plan,
+                                cache=self.plancache,
+                                on_cache=on_cache if self.plancache is not None else None,
                             )
-                        except IneligibleBatch:
-                            # e.g. a sparse features template where the spec
-                            # expects dense: this segment will serve through
-                            # the per-stage path (as dispatch falls back), so
-                            # warm the stages' own jit kernels instead of
-                            # compiling a fused chain the traffic can never hit.
-                            for stage in segment.stages:
-                                df = stage.transform(df)
-                            continue
-                        outputs = run_segment(
-                            segment,
-                            key,
-                            inputs,
-                            on_plan=self._on_plan,
-                            cache=self.plancache,
-                            on_cache=on_cache if self.plancache is not None else None,
-                        )
-                        # The cost model's per-bucket choice (may be
-                        # "fast+mega") — goodput attribution splits compile
-                        # time by tier.
-                        sp.set_attr("fusion", segment.plan_label(key))
-                        df = self._materialize(df, segment.pending(outputs))
-                    if self.plancache is not None:
-                        sp.set_attr(
-                            "plancache",
-                            f"{bucket_cache['hits']}h/{bucket_cache['misses']}m",
-                        )
-                        if (
-                            bucket_cache["hits"]
-                            and not bucket_cache["misses"]
-                            and hasattr(sp, "category")  # tracing-off: _NoopSpan
-                        ):
-                            # Every chain program of this bucket loaded from
-                            # disk: the span's time is version-lifecycle work,
-                            # not XLA compilation — keep the compile goodput
-                            # category honest for the zero-compile-resume story.
-                            sp.category = CAT_SWAP
+                            # The cost model's per-bucket choice (may be
+                            # "fast+mega") — goodput attribution splits
+                            # compile time by tier.
+                            sp.set_attr("fusion", segment.plan_label(key))
+                            df = self._materialize(df, segment.pending(outputs))
+                        if self.plancache is not None:
+                            sp.set_attr(
+                                "plancache",
+                                f"{bucket_cache['hits']}h/{bucket_cache['misses']}m",
+                            )
+                            if (
+                                bucket_cache["hits"]
+                                and not bucket_cache["misses"]
+                                and hasattr(sp, "category")  # tracing-off: _NoopSpan
+                            ):
+                                # Every chain program of this bucket loaded
+                                # from disk: the span's time is version-
+                                # lifecycle work, not XLA compilation — keep
+                                # the compile goodput category honest for the
+                                # zero-compile-resume story.
+                                sp.category = CAT_SWAP
         wall_ms = (time.perf_counter() - t0) * 1000.0
         cache_ms = totals["load_ms"]
         metrics.gauge(
@@ -283,16 +298,18 @@ class CompiledServingPlan:
         bucket: int,
         cap: Optional[int] = None,
         warm: bool = False,
+        k_rung: Optional[int] = None,
     ) -> Tuple[Dict[str, np.ndarray], Any, int, int]:
         """One host-side gather of the segment's input columns, exactly the
         way each stage's ``transform`` would read them (dense f32; sparse
-        columns as the convention triple on the nnz-cap ladder), checked
-        against the compiled signature. Returns ``(inputs, key, nnz_cap,
-        true_nnz)`` — the key is the padded bucket, extended with the shared
-        nnz cap when the segment has sparse inputs, so the executable set is
-        ≤ 1 per (bucket, cap) rung. ``cap`` forces the rung (warmup walks the
-        configured ladder; ``warm`` packs shape-only, truncating rows a small
-        rung cannot hold)."""
+        columns as the convention triple on the nnz-cap ladder; shape columns
+        as the top-K rung carrier), checked against the compiled signature.
+        Returns ``(inputs, key, nnz_cap, true_nnz)`` — the key is the padded
+        bucket, extended with the shared nnz cap when the segment has sparse
+        inputs and with the K ladder rung when it has shape inputs, so the
+        executable set is ≤ 1 per (bucket, cap, rung). ``cap`` / ``k_rung``
+        force the rungs (warmup walks the configured ladders; ``warm`` packs
+        shape-only, truncating rows a small rung cannot hold)."""
         if self.sharding is not None and bucket % self.sharding.row_multiple:
             # A bucket off the mesh ladder cannot shard bit-exactly (local
             # shapes would gain remainder rows) — only reachable when a
@@ -305,19 +322,35 @@ class CompiledServingPlan:
             )
         inputs: Dict[str, np.ndarray] = {}
         sparse_packed: Dict[str, Dict[str, np.ndarray]] = {}
+        shape_cols: List[str] = []
         shared_cap = cap if cap is not None else 0  # forced rung is an int
         true_nnz = 0
         cap_max = resolve_nnz_cap_max()
         for name in segment.external_inputs:
-            if segment.input_kind(name) in ("sparse", "entries"):
+            kind = segment.input_kind(name)
+            if kind in ("sparse", "entries"):
                 arrays, col_cap, col_nnz = segment.gather_sparse(
                     df, name, cap=cap, cap_max=cap_max, truncate=warm
                 )
                 sparse_packed[name] = arrays
                 shared_cap = max(shared_cap, col_cap)
                 true_nnz += col_nnz
+            elif kind == "shape":
+                shape_cols.append(name)
             else:
                 inputs[name] = segment.gather(df, name)
+        shape_rung = None
+        if shape_cols:
+            # Per-request output width (the retrieval top-K convention): one
+            # rung for the whole batch — the max requested K across the shape
+            # columns, on the power-of-two K ladder (servable/shapes.py).
+            arrays, shape_rung = segment.gather_shape(
+                df,
+                shape_cols,
+                rung=k_rung,
+                cap_max=resolve_k_cap_max() if k_rung is None else None,
+            )
+            inputs.update(arrays)
         for arrays in sparse_packed.values():
             for pname, arr in arrays.items():
                 if arr.ndim == 2 and arr.shape[1] < shared_cap:
@@ -328,6 +361,11 @@ class CompiledServingPlan:
                     arr = np.pad(arr, ((0, 0), (0, shared_cap - arr.shape[1])))
                 inputs[pname] = arr
         key: Any = (bucket, shared_cap) if sparse_packed else bucket
+        if shape_rung is not None:
+            # The K rung joins the key (like the nnz cap): one executable per
+            # (bucket[, cap], rung), with the rung tagged so a rung can never
+            # collide with a sparse cap in the key space.
+            key = (key, f"k{shape_rung}")
         signature = segment.signatures.get(key)
         if signature is not None:
             for name, arr in inputs.items():

@@ -358,7 +358,11 @@ class InferenceServer:
 
     # -- client API ------------------------------------------------------------
     def predict(
-        self, df: DataFrame, timeout_ms: Optional[float] = None, priority: int = 0
+        self,
+        df: DataFrame,
+        timeout_ms: Optional[float] = None,
+        priority: int = 0,
+        shape_key=None,
     ) -> ServingResponse:
         """Serve ``df`` (1..max_batch_size rows), blocking until the response.
 
@@ -373,12 +377,21 @@ class InferenceServer:
         (after close), or ``NoModelError`` via the batch when no version is
         loaded.
         """
-        return self.submit(df, timeout_ms, priority=priority).result()
+        return self.submit(df, timeout_ms, priority=priority, shape_key=shape_key).result()
 
     def submit(
-        self, df: DataFrame, timeout_ms: Optional[float] = None, priority: int = 0
+        self,
+        df: DataFrame,
+        timeout_ms: Optional[float] = None,
+        priority: int = 0,
+        shape_key=None,
     ):
-        """Async variant of ``predict``: returns a handle with ``.result()``."""
+        """Async variant of ``predict``: returns a handle with ``.result()``.
+
+        ``shape_key`` is the optional batch-affinity hint (the retrieval
+        client passes the request's top-K ladder rung): requests with
+        different keys never coalesce into one batch. Grouping only — a mixed
+        batch would still be correct."""
         with self._state_lock:
             closed = self._closed
         if closed:
@@ -387,7 +400,7 @@ class InferenceServer:
         timeout_s = (
             timeout_ms if timeout_ms is not None else self.config.default_timeout_ms
         ) / 1000.0
-        return self._batcher.submit(df, timeout_s, priority=priority)
+        return self._batcher.submit(df, timeout_s, priority=priority, shape_key=shape_key)
 
     def _remember_template(self, df: DataFrame) -> None:
         """First request doubles as the warmup template for later swaps when

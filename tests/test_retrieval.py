@@ -1,0 +1,691 @@
+"""Retrieval tier (docs/retrieval.md) — device-resident top-K serving.
+
+The acceptance contract:
+
+- **parity**: the fused top-K head reproduces a plain-numpy reference scorer
+  exactly — ids AND scores — at K=10 and K=100, and the LSH head reproduces
+  ``MinHashLSHModel.approx_nearest_neighbors`` (bucket-share prune → exact
+  1 − Jaccard rank, stable ascending ties) row for row;
+- **ladder**: per-request K compiles at power-of-two rungs, off-ladder K
+  falls back per-stage reason-labelled, and a rung-wide result trimmed to a
+  smaller K is bit-identical to the smaller rung's answer (prefix stability);
+- **lifecycle**: an index publishes/loads/swaps through the same
+  registry/poller machinery model versions use; serving across a hot index
+  swap is bit-exact per version with zero post-warmup compiles;
+- **sharding**: mesh widths 1/2/4 produce bit-identical rankings;
+- **typed empties**: empty histories, unknown items, bucket-less LSH queries
+  and empty candidate sets all produce typed empty results, never errors.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.retrieval import CandidateIndex, RetrievalClient
+from flink_ml_tpu.servable.api import load_servable
+from flink_ml_tpu.servable.planner import IneligibleBatch
+from flink_ml_tpu.servable.retrieval import (
+    HASH_PRIME,
+    LSHTopKServable,
+    SwingTopKServable,
+    minhash_values,
+)
+from flink_ml_tpu.servable.shapes import k_rung, resolve_warm_ks, shape_name
+from flink_ml_tpu.serving import InferenceServer, ServingConfig, publish_servable
+from flink_ml_tpu.serving.batcher import pad_to
+from flink_ml_tpu.serving.plan import CompiledServingPlan
+
+RNG = np.random.default_rng(171)
+
+
+@pytest.fixture(autouse=True)
+def _reset_retrieval_config():
+    yield
+    for opt in (
+        Options.RETRIEVAL_K_CAP_MAX,
+        Options.RETRIEVAL_WARMUP_KS,
+        Options.RETRIEVAL_LSH_PRUNE_CAP,
+        Options.SPARSE_WARMUP_CAPS,
+        Options.SPARSE_NNZ_CAP_MAX,
+    ):
+        config.unset(opt)
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+def _swing_index(n_items=60, base=100, seed=21, max_nbrs=8, output_col="rec"):
+    """A swing CandidateIndex distilled from a synthetic similarity table."""
+    rng = np.random.default_rng(seed)
+    items = np.arange(base, base + n_items, dtype=np.int64)
+    encs = []
+    for it in items:
+        nbrs = rng.choice(
+            np.setdiff1d(items, [it]), size=rng.integers(2, max_nbrs + 1), replace=False
+        )
+        scores = rng.random(len(nbrs)).round(4)
+        encs.append(";".join(f"{n},{s}" for n, s in zip(nbrs, scores)))
+    df = DataFrame(["item", "output"], None, [items, encs])
+    idx = CandidateIndex.from_swing_output(df, item_col="item", output_col="output")
+    idx.set_output_col(output_col)
+    return idx
+
+
+def _histories(idx, n, seed, max_len=5):
+    rng = np.random.default_rng(seed)
+    items = idx.item_ids
+    return [
+        [
+            (int(items[rng.integers(0, len(items))]), float(rng.random()) + 0.1)
+            for _ in range(rng.integers(1, max_len))
+        ]
+        for _ in range(n)
+    ]
+
+
+def numpy_swing_reference(idx, history, k):
+    """The plain-numpy reference scorer the fused head must reproduce
+    EXACTLY: f32 scatter-add over each history row's neighbor list in slot
+    order, consumed candidates masked, stable descending argsort."""
+    vocab = idx.item_ids
+    simv = np.asarray(idx.arrays["sim_values"], np.float32)
+    simi = np.asarray(idx.arrays["sim_ids"], np.int64)
+    row_of = {int(v): r for r, v in enumerate(vocab)}
+    C = len(vocab)
+    scores = np.zeros(C, np.float32)
+    hit = np.zeros(C, bool)
+    agg = {}
+    for item, w in history:
+        r = row_of.get(int(item))
+        if r is not None:
+            agg[r] = agg.get(r, 0.0) + w
+    for r in sorted(agg):  # slot order == sorted candidate rows
+        hit[r] = True
+        for j in range(simv.shape[1]):
+            if simv[r, j] != 0.0:
+                scores[simi[r, j]] += np.float32(np.float32(agg[r]) * simv[r, j])
+    if not agg:
+        return np.empty(0, np.int64), np.empty(0, np.float64)
+    out = scores.astype(np.float64)
+    out[hit] = -np.inf
+    order = np.argsort(-out, kind="stable")[:k]
+    keep = np.isfinite(out[order])
+    return vocab[order[keep]], out[order[keep]]
+
+
+def _lsh_fixture(D=40, C=30, T=3, F=2, seed=7):
+    """A fitted-model stand-in + candidate frame + CandidateIndex."""
+    rng = np.random.default_rng(seed)
+
+    class _Fam:
+        coeff_a = rng.integers(1, 10_000, T * F).astype(np.int64)
+        coeff_b = rng.integers(0, 10_000, T * F).astype(np.int64)
+
+        def get_num_hash_tables(self):
+            return T
+
+        def get_num_hash_functions_per_table(self):
+            return F
+
+        def get_input_col(self):
+            return "vec"
+
+    cands = []
+    for _ in range(C):
+        nz = np.sort(rng.choice(D, size=rng.integers(1, 8), replace=False))
+        cands.append(SparseVector(D, nz.astype(np.int64), np.ones(len(nz))))
+    cdf = DataFrame(
+        ["id", "vec"], None, [np.arange(500, 500 + C, dtype=np.int64), cands]
+    )
+    idx = CandidateIndex.from_lsh_model(_Fam(), cdf, id_col="id")
+    idx.set_output_col("nn")
+    return _Fam(), cdf, idx, rng
+
+
+def numpy_lsh_reference(idx, query, k, T, F):
+    """Reference two-phase retrieval: full-bucket share prune, exact
+    1 − Jaccard rank, stable ascending (ties to the lowest candidate row)."""
+    coeff_a = np.asarray(idx.arrays["coeff_a"], np.int64)
+    coeff_b = np.asarray(idx.arrays["coeff_b"], np.int64)
+    cand_ids = np.asarray(idx.arrays["cand_ids"], np.int64)
+    cand_nnz = np.asarray(idx.arrays["cand_nnz"], np.int64)
+    qs = np.asarray(query.indices, np.int64)
+    if qs.size == 0:
+        return np.empty(0, np.int64), np.empty(0, np.float64)
+    qh = minhash_values(qs, coeff_a, coeff_b).reshape(T, F)
+    rows, dists = [], []
+    for r in range(cand_ids.shape[0]):
+        cs = cand_ids[r, : cand_nnz[r]]
+        ch = minhash_values(cs, coeff_a, coeff_b).reshape(T, F)
+        if not (qh == ch).all(axis=1).any():
+            continue
+        inter = len(np.intersect1d(qs, cs))
+        union = len(np.union1d(qs, cs))
+        rows.append(r)
+        dists.append(1.0 - inter / max(union, 1))
+    order = np.argsort(np.asarray(dists), kind="stable")[:k]
+    rows = np.asarray(rows, np.int64)[order] if rows else np.empty(0, np.int64)
+    return idx.item_ids[rows], np.asarray(dists, np.float64)[order]
+
+
+# ---------------------------------------------------------------------------
+# the K ladder
+# ---------------------------------------------------------------------------
+class TestKLadder:
+    def test_k_rung_rounds_to_powers_of_two(self):
+        assert [k_rung(k) for k in (1, 2, 3, 10, 16, 100)] == [1, 2, 4, 16, 16, 128]
+
+    def test_warm_ks_default_ladder_and_override(self):
+        config.set(Options.RETRIEVAL_K_CAP_MAX, 16)
+        assert resolve_warm_ks() == (1, 2, 4, 8, 16)
+        config.set(Options.RETRIEVAL_WARMUP_KS, "10,16")
+        assert resolve_warm_ks() == (16,)  # 10 rounds up to its rung
+
+    def test_off_ladder_k_is_ineligible(self):
+        idx = _swing_index(n_items=20)
+        config.set(Options.RETRIEVAL_K_CAP_MAX, 8)
+        plan = CompiledServingPlan.build(
+            idx.servable(), scope="t-ret-offladder",
+            sparse={"history": idx.candidate_count},
+        )
+        seg = plan.segments[0]
+        df = DataFrame(["k"], None, [np.asarray([64], np.int64)])
+        with pytest.raises(IneligibleBatch) as ei:
+            seg.gather_shape(df, ["k"], cap_max=8)
+        assert ei.value.reason == "off_ladder"
+
+    def test_prefix_stability_across_rungs(self):
+        """The top-10 of a row is bit-for-bit the first 10 of its top-16 —
+        what lets the client trim a rung-wide result to the requested K."""
+        idx = _swing_index(n_items=40, seed=33)
+        head = idx.servable()
+        hist = RetrievalClient(head, idx).history_vector(
+            _histories(idx, 1, seed=34)[0]
+        )
+        lo = head.transform(
+            DataFrame(["history", "k"], None, [[hist], np.asarray([10], np.int64)])
+        )
+        hi = head.transform(
+            DataFrame(["history", "k"], None, [[hist], np.asarray([16], np.int64)])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(lo.column("rec_rows"))[0][:10],
+            np.asarray(hi.column("rec_rows"))[0][:10],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(lo.column("rec_scores"))[0][:10],
+            np.asarray(hi.column("rec_scores"))[0][:10],
+        )
+
+
+# ---------------------------------------------------------------------------
+# swing parity vs the numpy reference
+# ---------------------------------------------------------------------------
+class TestSwingParity:
+    @pytest.mark.parametrize("k", [10, 100])
+    def test_fused_matches_numpy_reference(self, k):
+        idx = _swing_index(n_items=150, seed=41)
+        head = idx.servable()
+        client = RetrievalClient(head, idx)
+        histories = _histories(idx, 12, seed=42)
+        for hist, (ids, scores) in zip(histories, client.query(histories, k)):
+            rid, rsc = numpy_swing_reference(idx, hist, k)
+            np.testing.assert_array_equal(ids, rid)
+            np.testing.assert_array_equal(scores, rsc)
+
+    def test_empty_and_unknown_histories_are_typed_empty(self):
+        idx = _swing_index(n_items=20, seed=43)
+        client = RetrievalClient(idx.servable(), idx)
+        res = client.query([[], [(999_999, 1.0)]], 5)
+        for ids, scores in res:
+            assert ids.dtype == np.int64 and len(ids) == 0
+            assert scores.dtype == np.float64 and len(scores) == 0
+
+    def test_consumed_candidates_never_recommended(self):
+        idx = _swing_index(n_items=30, seed=44)
+        client = RetrievalClient(idx.servable(), idx)
+        histories = _histories(idx, 8, seed=45)
+        for hist, (ids, _) in zip(histories, client.query(histories, 30)):
+            assert not set(int(i) for i, _ in hist) & set(ids.tolist())
+
+
+# ---------------------------------------------------------------------------
+# LSH parity vs the reference prune→rank semantics
+# ---------------------------------------------------------------------------
+class TestLSHParity:
+    def test_fused_matches_reference_prune_rank(self):
+        fam, cdf, idx, rng = _lsh_fixture()
+        client = RetrievalClient(idx.servable(), idx)
+        D = 40
+        queries = []
+        for _ in range(10):
+            nz = np.sort(rng.choice(D, size=rng.integers(1, 6), replace=False))
+            queries.append(SparseVector(D, nz.astype(np.int64), np.ones(len(nz))))
+        for q, (ids, dist) in zip(queries, client.query(queries, 5)):
+            rid, rdist = numpy_lsh_reference(idx, q, 5, T=3, F=2)
+            np.testing.assert_array_equal(ids, rid)
+            np.testing.assert_allclose(dist, rdist, rtol=0, atol=1e-6)
+
+    def test_matches_model_approx_nearest_neighbors(self):
+        """The served head and the reference-semantics host path agree row
+        for row — including distance ties (stable, lowest row first)."""
+        from flink_ml_tpu.models.feature.lsh import MinHashLSH
+
+        D, C = 30, 20
+        rng = np.random.default_rng(5)
+        vecs = []
+        for _ in range(C):
+            nz = np.sort(rng.choice(D, size=rng.integers(1, 6), replace=False))
+            vecs.append(SparseVector(D, nz.astype(np.int64), np.ones(len(nz))))
+        df = DataFrame(["id", "vec"], None, [np.arange(C, dtype=np.int64), vecs])
+        model = (
+            MinHashLSH()
+            .set_input_col("vec")
+            .set_output_col("h")
+            .set_num_hash_tables(2)
+            .set_num_hash_functions_per_table(2)
+            .set_seed(11)
+            .fit(df)
+        )
+        idx = CandidateIndex.from_lsh_model(model, df, id_col="id")
+        idx.set_output_col("nn")
+        client = RetrievalClient(idx.servable(), idx)
+        key = SparseVector(D, np.asarray([1, 5, 9], np.int64), np.ones(3))
+        ids, dist = client.query([key], 5)[0]
+        ref = model.approx_nearest_neighbors(df, key, 5)
+        np.testing.assert_array_equal(ids, np.asarray(ref.column("id"), np.int64))
+        np.testing.assert_allclose(dist, np.asarray(ref.column("distCol")), atol=1e-6)
+
+    def test_empty_query_and_no_bucket_share_are_typed_empty(self):
+        _, _, idx, _ = _lsh_fixture()
+        client = RetrievalClient(idx.servable(), idx)
+        D = 40
+        empty = SparseVector(D, np.asarray([], np.int64), np.asarray([], np.float64))
+        res = client.query([empty], 5)
+        assert len(res[0][0]) == 0 and len(res[0][1]) == 0
+
+    def test_approx_nearest_neighbors_skips_unhashable_rows(self):
+        """Satellite fix: all-zero candidate rows are skipped (the reference
+        raised) and an empty candidate set returns typed empty results."""
+        from flink_ml_tpu.models.feature.lsh import MinHashLSH
+
+        D = 20
+        vecs = [
+            SparseVector(D, np.asarray([1, 3], np.int64), np.ones(2)),
+            SparseVector(D, np.asarray([], np.int64), np.asarray([], np.float64)),
+        ]
+        df = DataFrame(["id", "vec"], None, [np.arange(2, dtype=np.int64), vecs])
+        model = (
+            MinHashLSH().set_input_col("vec").set_output_col("h").set_seed(3).fit(df)
+        )
+        key = SparseVector(D, np.asarray([1, 3], np.int64), np.ones(2))
+        out = model.approx_nearest_neighbors(df, key, 3)
+        assert np.asarray(out.column("id")).tolist() == [0]
+        # all-empty dataset → typed empty frame, distCol present
+        empties = DataFrame(["id", "vec"], None, [np.asarray([7], np.int64), [vecs[1]]])
+        out2 = model.approx_nearest_neighbors(empties, key, 3)
+        assert len(out2) == 0 and "distCol" in out2.column_names
+
+    def test_hash_prime_single_source(self):
+        from flink_ml_tpu.models.feature import lsh as lsh_mod
+
+        assert lsh_mod.HASH_PRIME is HASH_PRIME
+
+
+# ---------------------------------------------------------------------------
+# index lifecycle: save / load / publish / load_servable hooks
+# ---------------------------------------------------------------------------
+class TestIndexLifecycle:
+    def test_save_load_servable_round_trip_bit_exact(self, tmp_path):
+        idx = _swing_index(seed=51)
+        path = str(tmp_path / "idx")
+        idx.save(path)
+        head = load_servable(path)  # className dispatch from metadata
+        assert isinstance(head, SwingTopKServable)
+        assert head.get_output_col() == "rec"
+        client_a = RetrievalClient(head, idx)
+        client_b = RetrievalClient(idx.servable(), idx)
+        hist = _histories(idx, 3, seed=52)
+        for (ia, sa), (ib, sb) in zip(client_a.query(hist, 7), client_b.query(hist, 7)):
+            np.testing.assert_array_equal(ia, ib)
+            np.testing.assert_array_equal(sa, sb)
+
+    def test_model_class_hooks_load_the_heads(self, tmp_path):
+        from flink_ml_tpu.models.feature.lsh import MinHashLSHModel
+        from flink_ml_tpu.models.recommendation.swing import Swing
+
+        sw_idx = _swing_index(seed=53)
+        p1 = str(tmp_path / "sw")
+        sw_idx.save(p1)
+        assert isinstance(Swing.load_servable(p1), SwingTopKServable)
+        _, _, lsh_idx, _ = _lsh_fixture(seed=54)
+        p2 = str(tmp_path / "lsh")
+        lsh_idx.save(p2)
+        assert isinstance(MinHashLSHModel.load_servable(p2), LSHTopKServable)
+
+    def test_publish_through_registry_machinery(self, tmp_path):
+        idx = _swing_index(seed=55)
+        root = str(tmp_path / "versions")
+        vpath = publish_servable(idx, root)
+        assert vpath == os.path.join(root, "v-1")
+        head = load_servable(vpath)
+        assert isinstance(head, SwingTopKServable)
+        assert head.candidate_count == idx.candidate_count
+
+    def test_index_load_round_trip(self, tmp_path):
+        idx = _swing_index(seed=56)
+        path = str(tmp_path / "idx")
+        idx.save(path)
+        idx2 = CandidateIndex.load(path)
+        assert idx2.get_index_kind() == "swing"
+        np.testing.assert_array_equal(idx2.item_ids, idx.item_ids)
+        np.testing.assert_array_equal(
+            idx2.arrays["sim_values"], idx.arrays["sim_values"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Swing structured output (satellite)
+# ---------------------------------------------------------------------------
+class TestSwingStructuredOutput:
+    def _train_frame(self, seed=3, n_users=40, n_items=15, n_rows=600):
+        rng = np.random.default_rng(seed)
+        return DataFrame(
+            ["user", "item"],
+            None,
+            [
+                rng.integers(0, n_users, n_rows).astype(np.int64),
+                rng.integers(0, n_items, n_rows).astype(np.int64),
+            ],
+        )
+
+    def test_structured_columns_agree_with_string_encoding(self):
+        from flink_ml_tpu.models.recommendation.swing import Swing
+
+        out = (
+            Swing()
+            .set_min_user_behavior(3)
+            .set_max_user_behavior(100)
+            .set_k(5)
+            .set_structured_output(True)
+            .transform(self._train_frame())
+        )
+        assert set(out.column_names) >= {"output", "output_ids", "output_scores"}
+        ids_mat = np.asarray(out.column("output_ids"))
+        sc_mat = np.asarray(out.column("output_scores"))
+        for s, nid, sc in zip(out.column("output"), ids_mat, sc_mat):
+            pairs = [p.split(",") for p in s.split(";") if p]
+            keep = nid >= 0
+            np.testing.assert_array_equal(
+                np.asarray([int(i) for i, _ in pairs], np.int64), nid[keep]
+            )
+            np.testing.assert_allclose(
+                np.asarray([float(v) for _, v in pairs]), sc[keep]
+            )
+
+    def test_index_identical_from_either_encoding(self):
+        from flink_ml_tpu.models.recommendation.swing import Swing
+
+        out = (
+            Swing()
+            .set_min_user_behavior(3)
+            .set_max_user_behavior(100)
+            .set_k(5)
+            .set_structured_output(True)
+            .transform(self._train_frame(seed=9))
+        )
+        idx_struct = CandidateIndex.from_swing_output(out)
+        idx_str = CandidateIndex.from_swing_output(out.select(["item", "output"]))
+        np.testing.assert_array_equal(idx_struct.item_ids, idx_str.item_ids)
+        np.testing.assert_array_equal(
+            idx_struct.arrays["sim_ids"], idx_str.arrays["sim_ids"]
+        )
+        np.testing.assert_allclose(
+            idx_struct.arrays["sim_values"], idx_str.arrays["sim_values"]
+        )
+
+    def test_empty_output_carries_structured_columns(self):
+        from flink_ml_tpu.models.recommendation.swing import Swing
+
+        empty_in = DataFrame(
+            ["user", "item"],
+            None,
+            [np.asarray([], np.int64), np.asarray([], np.int64)],
+        )
+        out = Swing().set_structured_output(True).transform(empty_in)
+        assert set(out.column_names) >= {"output_ids", "output_scores"}
+        assert len(out) == 0
+
+
+# ---------------------------------------------------------------------------
+# the served path: fused plan, zero compiles, hot swap, shape-key affinity
+# ---------------------------------------------------------------------------
+class TestServedPath:
+    def _server_config(self):
+        config.set(Options.SPARSE_WARMUP_CAPS, "4")
+        config.set(Options.SPARSE_NNZ_CAP_MAX, 8)
+        config.set(Options.RETRIEVAL_WARMUP_KS, "16")
+        config.set(Options.RETRIEVAL_K_CAP_MAX, 16)
+
+    def _template(self, idx):
+        hist = SparseVector(
+            idx.candidate_count,
+            np.asarray([0, 3], np.int64),
+            np.asarray([1.0, 2.0]),
+        )
+        return DataFrame(["history", "k"], None, [[hist], np.asarray([10], np.int64)])
+
+    def test_served_fused_zero_postwarmup_compiles(self, monkeypatch):
+        self._server_config()
+        idx = _swing_index(seed=61)
+        cfg = ServingConfig(max_batch_size=8, max_delay_ms=0.0)
+        with InferenceServer(
+            idx.servable(),
+            name="t-ret-zc",
+            serving_config=cfg,
+            warmup_template=self._template(idx),
+        ) as server:
+            scope = "ml.serving[t-ret-zc]"
+            client = RetrievalClient(server, idx)
+            histories = _histories(idx, 6, seed=62)
+            fused0 = metrics.get(scope, MLMetrics.SERVING_FUSED_BATCHES, 0)
+            compiles0 = metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+            import flink_ml_tpu.servable.planner as planner_mod
+
+            def poisoned(lowered):
+                raise AssertionError("XLA compile after warmup")
+
+            monkeypatch.setattr(planner_mod, "_compile_lowered", poisoned)
+            res = client.query(histories, 10)
+            assert metrics.get(scope, MLMetrics.SERVING_FUSED_BATCHES, 0) > fused0
+            assert (
+                metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0) == compiles0
+            )
+            for hist, (ids, scores) in zip(histories, res):
+                rid, rsc = numpy_swing_reference(idx, hist, 10)
+                np.testing.assert_array_equal(ids, rid)
+                np.testing.assert_array_equal(scores, rsc)
+
+    def test_per_request_k_trimmed_exactly(self):
+        self._server_config()
+        idx = _swing_index(seed=63)
+        cfg = ServingConfig(max_batch_size=8, max_delay_ms=5.0)
+        with InferenceServer(
+            idx.servable(),
+            name="t-ret-k",
+            serving_config=cfg,
+            warmup_template=self._template(idx),
+        ) as server:
+            client = RetrievalClient(server, idx)
+            histories = _histories(idx, 4, seed=64)
+            ks = [3, 7, 10, 16]
+            for (ids, scores), k, hist in zip(
+                client.query(histories, ks), ks, histories
+            ):
+                rid, rsc = numpy_swing_reference(idx, hist, k)
+                assert len(ids) <= k
+                np.testing.assert_array_equal(ids, rid)
+                np.testing.assert_array_equal(scores, rsc)
+
+    def test_hot_index_swap_bit_exact_per_version(self, monkeypatch):
+        self._server_config()
+        v1 = _swing_index(seed=65)
+        v2 = _swing_index(seed=66)  # same catalog shape, different similarities
+        cfg = ServingConfig(max_batch_size=8, max_delay_ms=0.0)
+        with InferenceServer(
+            v1.servable(),
+            name="t-ret-swap",
+            serving_config=cfg,
+            warmup_template=self._template(v1),
+        ) as server:
+            scope = "ml.serving[t-ret-swap]"
+            histories = _histories(v1, 4, seed=67)
+            client = RetrievalClient(server, v1)
+            res1 = client.query(histories, 10)
+            server.swap(2, v2.servable())
+            compiles0 = metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+            import flink_ml_tpu.servable.planner as planner_mod
+
+            monkeypatch.setattr(
+                planner_mod,
+                "_compile_lowered",
+                lambda lowered: (_ for _ in ()).throw(
+                    AssertionError("compile across hot swap")
+                ),
+            )
+            res2 = RetrievalClient(server, v2).query(histories, 10)
+            assert (
+                metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0) == compiles0
+            )
+            for hist, (i1, s1), (i2, s2) in zip(histories, res1, res2):
+                r1 = numpy_swing_reference(v1, hist, 10)
+                r2 = numpy_swing_reference(v2, hist, 10)
+                np.testing.assert_array_equal(i1, r1[0])
+                np.testing.assert_array_equal(s1, r1[1])
+                np.testing.assert_array_equal(i2, r2[0])
+                np.testing.assert_array_equal(s2, r2[1])
+
+    def test_shape_key_affinity_never_mixes_rungs(self):
+        """Requests headed for different K rungs never coalesce into one
+        batch (purely an optimization — checked at the batcher seam)."""
+        import threading
+
+        from flink_ml_tpu.serving.batcher import MicroBatcher
+
+        seen = []
+
+        def execute(df):
+            seen.append(sorted(set(np.asarray(df.column("k"), np.int64).tolist())))
+            out = df.clone()
+            return out, 1
+
+        class _Resp:
+            def __init__(self, df, version, latency_ms, bucket):
+                self.dataframe = df
+
+        batcher = MicroBatcher(
+            execute,
+            max_batch_size=8,
+            max_delay_ms=60.0,
+            queue_capacity_rows=64,
+            scope="t-ret-affinity",
+            response_factory=_Resp,
+        )
+        try:
+            frames = []
+            for k in (4, 64, 4, 64):
+                frames.append(
+                    DataFrame(["k"], None, [np.asarray([k], np.int64)])
+                )
+            handles = [
+                batcher.submit(df, timeout_s=5.0, shape_key=f"k{k_rung(int(df.column('k')[0]))}")
+                for df in frames
+            ]
+            for h in handles:
+                h.result()
+        finally:
+            batcher.close()
+        for ks in seen:
+            rungs = {k_rung(int(k)) for k in ks}
+            assert len(rungs) == 1, f"mixed K rungs in one batch: {ks}"
+
+
+# ---------------------------------------------------------------------------
+# mesh sharding: widths 1/2/4 bit-identical
+# ---------------------------------------------------------------------------
+class TestShardedRetrieval:
+    @pytest.mark.parametrize("mesh", [2, 4])
+    def test_mesh_width_bit_stable(self, mesh):
+        import jax
+
+        from flink_ml_tpu.servable.sharding import PlanSharding
+
+        if mesh > len(jax.devices()):
+            pytest.skip(f"needs {mesh} devices, host exposes {len(jax.devices())}")
+        config.set(Options.SPARSE_WARMUP_CAPS, "4")
+        config.set(Options.RETRIEVAL_WARMUP_KS, "16")
+        idx = _swing_index(seed=71)
+        C = idx.candidate_count
+        rows = mesh * 4
+        client = RetrievalClient(idx.servable(), idx)
+        hists = [client.history_vector(h) for h in _histories(idx, rows, seed=72)]
+        df = DataFrame(
+            ["history", "k"],
+            None,
+            [hists, np.full(rows, 10, np.int64)],
+        )
+        single = CompiledServingPlan.build(
+            idx.servable(), scope=f"t-ret-m1-{mesh}", sparse={"history": C}
+        )
+        sharded = CompiledServingPlan.build(
+            idx.servable(),
+            scope=f"t-ret-mN-{mesh}",
+            sharding=PlanSharding(mesh),
+            sparse={"history": C},
+        )
+        out1 = single.execute(pad_to(df, rows))
+        outN = sharded.execute(pad_to(df, rows))
+        np.testing.assert_array_equal(
+            np.asarray(out1.column("rec_rows")), np.asarray(outN.column("rec_rows"))
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out1.column("rec_scores")).view(np.int64),
+            np.asarray(outN.column("rec_scores")).view(np.int64),
+        )
+
+
+# ---------------------------------------------------------------------------
+# offline batch tier: shape-kind columns fall back per-stage
+# ---------------------------------------------------------------------------
+class TestBatchTierGuard:
+    def test_shape_kind_falls_back_reason_labelled(self):
+        from flink_ml_tpu.builder.batch_plan import CompiledBatchPlan
+
+        idx = _swing_index(n_items=20, seed=73)
+        C = idx.candidate_count
+        head = idx.servable()
+        plan = CompiledBatchPlan.build(
+            [head], scope="retguard", sparse={"history": C}
+        )
+        if plan is None:
+            pytest.skip("no fused segment built for a lone retrieval head")
+        client = RetrievalClient(head, idx)
+        hists = [client.history_vector(h) for h in _histories(idx, 4, seed=74)]
+        df = DataFrame(["history", "k"], None, [hists, np.full(4, 5, np.int64)])
+        scope = plan.scope
+        reason = MLMetrics.fallback_reason("batch", "shape_kind")
+        before = metrics.get(scope, reason, 0)
+        out = plan.transform(df)
+        assert metrics.get(scope, reason, 0) == before + 1
+        # the per-stage fallback still answers correctly
+        for hist, rows in zip(
+            _histories(idx, 4, seed=74), np.asarray(out.column("rec_rows"), np.int64)
+        ):
+            rid, _ = numpy_swing_reference(idx, hist, 5)
+            got = idx.item_ids[rows[:5][rows[:5] >= 0]]
+            np.testing.assert_array_equal(got, rid)

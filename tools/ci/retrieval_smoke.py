@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""retrieval_smoke — the retrieval tier end to end (docs/retrieval.md).
+
+The scenario:
+
+1. Distill two swing ``CandidateIndex`` versions (same catalog, different
+   similarity tables) and publish both through the standard registry
+   (``publish_servable`` → ``v-1``/``v-2`` — the model-version machinery,
+   unchanged).
+2. ``load_servable(v-1)`` → ``InferenceServer`` with a retrieval warmup
+   template: the sparse nnz ladder × the K rung ladder AOT-warms up front.
+3. Drive a concurrent top-K burst through ``RetrievalClient`` with mixed
+   per-request K, and hot-swap to v-2 **mid-burst**.
+4. Assert: every request resolved exactly once, each answer is bit-exact
+   (ids AND scores) against a plain-numpy reference for whichever index
+   version served it, every answer respects its request's K, and traffic
+   never XLA-compiles — zero fast-path compiles outside the two warmup
+   windows (boot and swap).
+
+Run: ``python tools/ci/retrieval_smoke.py`` (wired into tools/ci/run_tests.sh).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import threading
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+N_ITEMS = 120
+BURST_THREADS = 4
+QUERIES_PER_THREAD = 8
+KS = (3, 10, 16)  # mixed per-request K: rungs 4 and 16, both warmed
+
+
+def _swing_index(seed):
+    import numpy as np
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.retrieval import CandidateIndex
+
+    rng = np.random.default_rng(seed)
+    items = np.arange(1000, 1000 + N_ITEMS, dtype=np.int64)
+    encs = []
+    for it in items:
+        nbrs = rng.choice(np.setdiff1d(items, [it]), size=6, replace=False)
+        scores = rng.random(6).round(4)
+        encs.append(";".join(f"{n},{s}" for n, s in zip(nbrs, scores)))
+    idx = CandidateIndex.from_swing_output(
+        DataFrame(["item", "output"], None, [items, encs]),
+        item_col="item",
+        output_col="output",
+    )
+    idx.set_output_col("rec")
+    return idx
+
+
+def _histories(idx, n, seed):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [
+        [
+            (int(idx.item_ids[rng.integers(0, N_ITEMS)]), float(rng.random()) + 0.1)
+            for _ in range(rng.integers(1, 5))
+        ]
+        for _ in range(n)
+    ]
+
+
+def _reference(idx, history, k):
+    """Plain-numpy mirror of the fused swing kernel: f32 scatter-add in
+    sorted-row slot order, consumed rows masked, stable descending sort."""
+    import numpy as np
+
+    vocab = idx.item_ids
+    simv = np.asarray(idx.arrays["sim_values"], np.float32)
+    simi = np.asarray(idx.arrays["sim_ids"], np.int64)
+    row_of = {int(v): r for r, v in enumerate(vocab)}
+    scores = np.zeros(len(vocab), np.float32)
+    hit = np.zeros(len(vocab), bool)
+    agg = {}
+    for item, w in history:
+        r = row_of.get(int(item))
+        if r is not None:
+            agg[r] = agg.get(r, 0.0) + w
+    for r in sorted(agg):
+        hit[r] = True
+        for j in range(simv.shape[1]):
+            if simv[r, j] != 0.0:
+                scores[simi[r, j]] += np.float32(np.float32(agg[r]) * simv[r, j])
+    out = scores.astype(np.float64)
+    out[hit] = -np.inf
+    order = np.argsort(-out, kind="stable")[:k]
+    keep = np.isfinite(out[order])
+    return vocab[order[keep]], out[order[keep]]
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.config import Options, config
+    from flink_ml_tpu.linalg.vectors import SparseVector
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.retrieval import CandidateIndex, RetrievalClient
+    from flink_ml_tpu.servable.api import load_servable
+    from flink_ml_tpu.servable.shapes import k_rung
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig, publish_servable
+
+    failed = []
+
+    def check(ok, msg):
+        print(("  OK  " if ok else "  FAIL") + f" {msg}", flush=True)
+        if not ok:
+            failed.append(msg)
+
+    workdir = tempfile.mkdtemp(prefix="retrieval-smoke-")
+    publish_dir = os.path.join(workdir, "publish")
+    # Executables key on (bucket, nnz cap, K rung); traffic is single-row
+    # requests with 1-4 history items, so warm the FULL cap ladder (unset
+    # warmup.caps = every power of two up to the max) and both K rungs.
+    config.set(Options.SPARSE_NNZ_CAP_MAX, 4)
+    config.set(Options.RETRIEVAL_WARMUP_KS, "4,16")
+    config.set(Options.RETRIEVAL_K_CAP_MAX, 16)
+
+    print("=== retrieval_smoke: publishing index v-1 and v-2 ===", flush=True)
+    v1_idx, v2_idx = _swing_index(seed=1), _swing_index(seed=2)
+    p1 = publish_servable(v1_idx, publish_dir)
+    p2 = publish_servable(v2_idx, publish_dir)
+    check(
+        os.path.basename(p1) == "v-1" and os.path.basename(p2) == "v-2",
+        f"indices published through the registry ({p1}, {p2})",
+    )
+    indices = {1: v1_idx, 2: v2_idx}
+
+    template = DataFrame(
+        ["history", "k"],
+        None,
+        [
+            [
+                SparseVector(
+                    N_ITEMS, np.asarray([0, 3], np.int64), np.asarray([1.0, 2.0])
+                )
+            ],
+            np.asarray([10], np.int64),
+        ],
+    )
+    scope = "ml.serving[retrieval-smoke]"
+    cfg = ServingConfig(max_batch_size=8, max_delay_ms=0.5)
+    print("=== serving v-1: warmup = nnz ladder x K rung ladder ===", flush=True)
+    with InferenceServer(
+        load_servable(p1),
+        name="retrieval-smoke",
+        serving_config=cfg,
+        warmup_template=template,
+    ) as server:
+
+        class _Recorder:
+            """predict() shim that pins each reply to the version it rode."""
+
+            def __init__(self):
+                self.lock = threading.Lock()
+
+            def predict(self, df, shape_key=None, **kw):
+                return server.predict(df, shape_key=shape_key, **kw)
+
+        recorder = _Recorder()
+        results = []  # (history, k, version, ids, scores)
+        errors = []
+        results_lock = threading.Lock()
+        swap_gate = threading.Barrier(BURST_THREADS + 1)
+
+        def burst(tid):
+            client = RetrievalClient(recorder, v1_idx)
+            histories = _histories(v1_idx, QUERIES_PER_THREAD, seed=100 + tid)
+            try:
+                for qi, hist in enumerate(histories):
+                    if qi == QUERIES_PER_THREAD // 2:
+                        swap_gate.wait()  # let the swap land mid-burst
+                        swap_gate.wait()
+                    k = KS[(tid + qi) % len(KS)]
+                    df = client._request_frame([client.history_vector(hist)],
+                                               np.asarray([k], np.int64))
+                    resp = recorder.predict(df, shape_key=f"k{k_rung(k)}")
+                    (ids, scores), = client._trim(resp.dataframe,
+                                                  np.asarray([k], np.int64))
+                    with results_lock:
+                        results.append((hist, k, resp.model_version, ids, scores))
+            except Exception as exc:  # noqa: BLE001 — smoke surfaces everything
+                with results_lock:
+                    errors.append(exc)
+                # don't deadlock the swap gate on failure
+                swap_gate.abort()
+
+        compiles_boot = metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+        threads = [
+            threading.Thread(target=burst, args=(t,)) for t in range(BURST_THREADS)
+        ]
+        print(
+            f"=== burst: {BURST_THREADS} threads x {QUERIES_PER_THREAD} queries, "
+            f"K in {KS}, swap to v-2 mid-burst ===",
+            flush=True,
+        )
+        for t in threads:
+            t.start()
+        try:
+            swap_gate.wait()  # all threads paused at their midpoint
+            compiles_pre_swap = metrics.get(
+                scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0
+            )
+            server.swap(2, load_servable(p2))
+            compiles_post_swap = metrics.get(
+                scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0
+            )
+            swap_gate.wait()  # release the second half of the burst
+        except threading.BrokenBarrierError:
+            pass
+        for t in threads:
+            t.join()
+        compiles_end = metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+
+        check(not errors, f"every request resolved typed ({errors[:3]})")
+        expected_n = BURST_THREADS * QUERIES_PER_THREAD
+        check(
+            len(results) == expected_n,
+            f"every request resolved exactly once ({len(results)}/{expected_n})",
+        )
+        versions = sorted({v for _, _, v, _, _ in results})
+        check(versions == [1, 2], f"both versions served across the swap ({versions})")
+
+        mismatches = 0
+        over_k = 0
+        for hist, k, version, ids, scores in results:
+            rid, rsc = _reference(indices[version], hist, k)
+            if len(ids) > k:
+                over_k += 1
+            if not (
+                np.array_equal(ids, rid)
+                and np.array_equal(
+                    np.asarray(scores).view(np.int64),
+                    np.asarray(rsc).view(np.int64),
+                )
+            ):
+                mismatches += 1
+        check(over_k == 0, f"every answer respects its request's K ({over_k} over)")
+        check(
+            mismatches == 0,
+            f"bit-exact ids+scores vs the numpy reference, per served version "
+            f"({mismatches}/{len(results)} mismatched)",
+        )
+        check(
+            compiles_pre_swap == compiles_boot,
+            f"zero compiles between warmup and swap "
+            f"({compiles_pre_swap - compiles_boot})",
+        )
+        check(
+            compiles_end == compiles_post_swap,
+            f"zero compiles on post-swap traffic "
+            f"({compiles_end - compiles_post_swap})",
+        )
+        fused = metrics.get(scope, MLMetrics.SERVING_FUSED_BATCHES, 0)
+        check(fused > 0, f"traffic rode the fused fast path ({fused} fused batches)")
+
+    for opt in (
+        Options.SPARSE_WARMUP_CAPS,
+        Options.SPARSE_NNZ_CAP_MAX,
+        Options.RETRIEVAL_WARMUP_KS,
+        Options.RETRIEVAL_K_CAP_MAX,
+    ):
+        config.unset(opt)
+
+    if failed:
+        print(
+            f"retrieval_smoke FAIL ({len(failed)} assertion(s)); workdir kept at "
+            f"{workdir}"
+        )
+        return 1
+    shutil.rmtree(workdir, ignore_errors=True)
+    print(
+        "retrieval_smoke OK: registry-published index served fused, hot-swapped "
+        "mid-burst, bit-exact per version, per-request K honored, zero "
+        "post-warmup compiles"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

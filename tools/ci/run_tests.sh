@@ -86,6 +86,15 @@ python tools/ci/restart_smoke.py
 echo "=== fleet smoke (replica kill -> respawn -> canary quarantine) ==="
 python tools/ci/fleet_smoke.py
 
+# Retrieval smoke: a registry-published CandidateIndex served as a fused
+# top-K head — concurrent mixed-K burst, hot swap to v-2 mid-burst, every
+# request resolved exactly once and bit-exact (ids + scores) against the
+# numpy reference for whichever index version served it, per-request K
+# honored, and zero fast-path compiles outside the boot/swap warmup windows
+# (docs/retrieval.md).
+echo "=== retrieval smoke (index hot swap mid-burst, zero-compile top-K) ==="
+python tools/ci/retrieval_smoke.py
+
 # Bench trend (informational): diff the two newest BENCH_r*.json rounds and
 # warn on >10% p50 / rows-per-second movement — directional on shared CI
 # boxes, so the step never fails the build (tools/bench_trend.py --strict

@@ -91,6 +91,11 @@ PACKAGE_LAYERS = {
     # loop/loadgen — a single replica never knows it is part of a fleet,
     # and nothing below L3 may import the fleet tier (docs/fleet.md).
     "fleet": 3,
+    # The retrieval tier (CandidateIndex + RetrievalClient) sits at the
+    # library layer with models/fleet, but by contract imports only L0/L1
+    # (api, linalg, params, servable, utils) — a published index loads in a
+    # serving process with no training stack present (docs/retrieval.md).
+    "retrieval": 3,
     # the root package surface (flink_ml_tpu/__init__.py) re-exports the API
     "": 3,
 }
@@ -115,6 +120,12 @@ MODULE_LAYERS = {
     # Its load/store surfaces are `# graftcheck: cold` and the host-sync
     # rule's file-I/O scope proves no hot root can reach cache disk I/O.
     "servable.plancache": 1,
+    # The runtime-free retrieval serving heads (top-K over a published
+    # CandidateIndex): L1 like the rest of servable — they import only L0
+    # plus same-layer servable/ops/api/linalg/params modules. Registered
+    # explicitly because the training-side models/feature/lsh.py imports
+    # HASH_PRIME *from* here (L3 → L1, allowed), never the reverse.
+    "servable.retrieval": 1,
 }
 
 #: The absorbed check_servable_imports.py contract (see module docstring).
@@ -174,7 +185,7 @@ class LayerDepsRule(Rule):
     name = "layer-deps"
     severity = "error"
     granularity = "file"
-    cache_version = 5  # v5: servable.plancache registered (plan cache, L1)
+    cache_version = 6  # v6: retrieval tier registered (retrieval L3, servable.retrieval L1)
     description = (
         "imports within flink_ml_tpu must not point at a higher layer "
         "(foundation < compute/servable < runtime < library)"
