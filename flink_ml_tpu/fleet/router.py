@@ -61,7 +61,7 @@ class _FailedPending:
     control raises at submit) — normalized into the pending surface so every
     typed error flows through one retry path on the collector thread."""
 
-    def __init__(self, error: BaseException):
+    def __init__(self, error: ServingError):
         self._error = error
 
     def wait(self, timeout: Optional[float] = None) -> bool:

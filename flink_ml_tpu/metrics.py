@@ -23,7 +23,6 @@ class MLMetrics:
     counters (restart strategies / checkpoint failover — docs/fault_tolerance.md)."""
 
     ML_GROUP = "ml"
-    ML_MODEL_GROUP = "ml.model"
     TIMESTAMP = "ml.model.timestamp"
     VERSION = "ml.model.version"
 
@@ -145,7 +144,6 @@ class MLMetrics:
 
     # Batch transform fast path (builder/batch_plan.py — fused chunked plans;
     # scope = "ml.batch[plan]" unless the caller names its own).
-    BATCH_GROUP = "ml.batch"
     BATCH_FUSED_STAGES = "ml.batch.fastpath.fused.stages"  # stages fused, gauge
     BATCH_FALLBACK_STAGES = "ml.batch.fastpath.fallback.stages"  # per-stage, gauge
     BATCH_FUSED_CHUNKS = "ml.batch.fastpath.fused.chunks"  # chunk executions, counter
@@ -157,7 +155,6 @@ class MLMetrics:
 
     # Fusion tier of the compiled plans (fusion.mode — docs/fusion.md).
     # Published under the owning plan's scope, like the fastpath metrics.
-    FUSION_GROUP = "ml.fusion"
     FUSION_MODE = "ml.fusion.mode"  # 0 = exact, 1 = fast (the plan's tier), gauge
     FUSION_PROGRAMS_EXACT = "ml.fusion.programs.exact"  # exact-partition program compiles, counter
     FUSION_PROGRAMS_FUSED = "ml.fusion.programs.fused"  # cross-reduction XLA program compiles, counter

@@ -54,12 +54,14 @@ import numpy as np
 
 import flink_ml_tpu.telemetry as telemetry
 from flink_ml_tpu.api.dataframe import DataFrame
-from flink_ml_tpu.faults import faults
+from flink_ml_tpu.faults import InjectedFault, faults
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.serving.controller import AdaptiveController
 from flink_ml_tpu.serving.errors import (
     ServingClosedError,
     ServingDeadlineError,
+    ServingError,
+    ServingExecutionError,
     ServingOverloadedError,
 )
 from flink_ml_tpu.trace import (
@@ -455,6 +457,13 @@ class MicroBatcher:
     def _deliver_error(
         self, claimed: List[PendingRequest], e: BaseException, batch_span=None,
     ) -> None:
+        # The typed-error contract seam (docs/serving.md): typed errors and
+        # chaos-injected faults pass through; anything else is wrapped so
+        # clients never see an untyped exception cross the rendezvous.
+        if not isinstance(e, (ServingError, InjectedFault)):
+            e = ServingExecutionError(
+                f"batch execution failed: {type(e).__name__}: {e}", cause=e,
+            )
         for req in claimed:
             req.error = e
             req._state = _DONE

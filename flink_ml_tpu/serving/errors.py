@@ -21,6 +21,7 @@ __all__ = [
     "ServingOverloadedError",
     "ServingDeadlineError",
     "ServingClosedError",
+    "ServingExecutionError",
     "NoModelError",
 ]
 
@@ -112,3 +113,22 @@ class ServingClosedError(ServingError):
 
 class NoModelError(ServingError):
     """No model version has been swapped in yet — the server is not ready."""
+
+
+class ServingExecutionError(ServingError):
+    """Batch execution failed with an unexpected (untyped) exception.
+
+    The batcher delivers exactly one error object to every waiter of a
+    failed batch. Typed errors and chaos-injected faults pass through
+    unchanged; anything else — a device error out of the compiled
+    executable, a bug in a transform — is wrapped here at the single
+    ``_deliver_error`` seam so clients never see a raw ``RuntimeError``
+    cross the thread rendezvous. The original exception stays attached as
+    ``__cause__`` (and ``cause`` for wire encoding).
+    """
+
+    def __init__(self, message: str, *, cause: Optional[BaseException] = None):
+        super().__init__(message)
+        self.cause = cause
+        if cause is not None:
+            self.__cause__ = cause

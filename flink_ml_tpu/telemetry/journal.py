@@ -303,6 +303,7 @@ class FlightRecorder:
             last = self._last_incident.get(kind)
             if last is not None and now - last < self.incident_min_interval_s:
                 self._incidents_suppressed += 1
+                metrics.counter(self.scope, MLMetrics.TELEMETRY_INCIDENTS_SUPPRESSED)
                 return False
             self._last_incident[kind] = now
             # Incidents are rare and precious: they enqueue even past the

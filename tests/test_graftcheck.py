@@ -42,8 +42,11 @@ ALL_RULES = (
     "kernel-spec-consistency",
     "layer-deps",
     "lock-order",
+    "plan-key-completeness",
     "recompile-hazard",
+    "registry-consistency",
     "shared-state-guard",
+    "typed-error-escape",
 )
 
 
@@ -769,9 +772,12 @@ def test_json_output_schema(tmp_path):
     assert payload["version"] == JSON_SCHEMA_VERSION
     assert {r["name"] for r in payload["rules"]} == set(ALL_RULES)
     for rule in payload["rules"]:
-        assert set(rule) == {"name", "severity", "description"}
+        assert set(rule) == {"name", "severity", "granularity", "description"}
         assert rule["severity"] in ("error", "warning")
+        assert rule["granularity"] in ("project", "file")
     assert payload["summary"]["files_checked"] >= 1
+    assert set(payload["summary"]["rule_times_ms"]) == set(ALL_RULES)
+    assert all(t >= 0 for t in payload["summary"]["rule_times_ms"].values())
     assert payload["summary"]["findings"] == len(payload["findings"]) == 1
     assert payload["summary"]["by_rule"] == {"layer-deps": 1}
     (f,) = payload["findings"]

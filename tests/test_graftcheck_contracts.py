@@ -1,0 +1,504 @@
+"""graftcheck v4 — the contract-dataflow rule family.
+
+Covers the three interprocedural rules built on the v5 facts
+(plan-key-completeness, typed-error-escape, registry-consistency) the same
+way the races suite covers v3: fact-extraction unit tests, dirty + clean
+fixture trees per rule, and the anchoring property that makes
+``--changed-only`` useful — a plan-key finding lands on the offending
+option-read site even when the digest lives in another file.
+
+The rule tables (plan roots, key surfaces, request surfaces, allowlists) are
+class attributes precisely so these tests can exercise the dataflow engine
+against small fixture trees without dragging in the shipped tree's contract
+surface; the shipped tables themselves are gated by
+``test_graftcheck.test_shipped_tree_is_clean``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.graftcheck import REGISTRY, Project, run_rules  # noqa: E402
+from tools.graftcheck.rules.plan_key import PlanKeyCompletenessRule  # noqa: E402
+from tools.graftcheck.rules.typed_error_escape import TypedErrorEscapeRule  # noqa: E402
+from tests.test_graftcheck import write_tree, run_on  # noqa: E402
+
+
+def _project(root, files):
+    write_tree(root, files)
+    project = Project(str(root), ["flink_ml_tpu"])
+    project.facts()
+    return project
+
+
+# -----------------------------------------------------------------------------
+# v5 facts: config reads, raise sites, registry extraction
+# -----------------------------------------------------------------------------
+
+CONFIG_FIXTURE = """
+    class ConfigOption:
+        def __init__(self, key, typ, default, doc):
+            self.key = key
+
+    class Options:
+        ALPHA = ConfigOption("alpha.key", int, 1, "")
+        BETA = ConfigOption("beta.key", int, 2, "")
+
+    class _Config:
+        def get(self, opt):
+            return 0
+
+    config = _Config()
+"""
+
+
+def test_facts_record_config_reads_and_declarations(tmp_path):
+    project = _project(tmp_path, {
+        "flink_ml_tpu/config.py": CONFIG_FIXTURE,
+        "flink_ml_tpu/user.py": """
+            from flink_ml_tpu.config import Options, config
+
+            def consume():
+                return config.get(Options.ALPHA)
+        """,
+    })
+    cfg = project.facts()["flink_ml_tpu/config.py"]
+    assert [(a, k) for a, k, _line in cfg["config_options"]] == [
+        ("ALPHA", "alpha.key"), ("BETA", "beta.key"),
+    ]
+    user = project.facts()["flink_ml_tpu/user.py"]
+    (read,) = user["functions"]["consume"]["config_reads"]
+    assert read[0] == "ALPHA"
+    assert ("ALPHA", 4) in [tuple(r) for r in user["option_refs"]]
+
+
+def test_facts_record_raises_with_lexical_catchers(tmp_path):
+    project = _project(tmp_path, {
+        "flink_ml_tpu/r.py": """
+            def bare():
+                raise ValueError("x")
+
+            def guarded():
+                try:
+                    raise KeyError("y")
+                except KeyError:
+                    return None
+
+            def transparent():
+                try:
+                    raise RuntimeError("z")
+                except Exception:
+                    raise
+
+            def annotated(e: ValueError):
+                raise e
+        """,
+    })
+    fns = project.facts()["flink_ml_tpu/r.py"]["functions"]
+    (r,) = fns["bare"]["raises"]
+    assert r[0] == "ValueError" and r[2] == []
+    (r,) = fns["guarded"]["raises"]
+    assert r[0] == "KeyError" and "KeyError" in r[2]
+    # A handler that only re-raises is transparent: it must NOT count as a
+    # catcher for the body's raise (and its own bare re-raise is not a new
+    # raise site).
+    (r,) = fns["transparent"]["raises"]
+    assert r[0] == "RuntimeError" and r[2] == []
+    # `raise e` of an annotated parameter resolves through local types.
+    (r,) = fns["annotated"]["raises"]
+    assert r[0] == "ValueError"
+
+
+def test_facts_record_metric_registry_and_literals(tmp_path):
+    project = _project(tmp_path, {
+        "flink_ml_tpu/metrics.py": """
+            class MLMetrics:
+                USED = "ml.serving.used"
+                DEAD = "ml.serving.dead"
+        """,
+        "flink_ml_tpu/emit.py": """
+            from flink_ml_tpu.metrics import MLMetrics
+
+            def emit(registry):
+                registry.counter("ml.serving", MLMetrics.USED)
+                registry.counter("ml.serving", "ml.rogue.name")
+        """,
+    })
+    mf = project.facts()["flink_ml_tpu/metrics.py"]
+    assert [(a, v) for a, v, _line in mf["metric_consts"]] == [
+        ("USED", "ml.serving.used"), ("DEAD", "ml.serving.dead"),
+    ]
+    ef = project.facts()["flink_ml_tpu/emit.py"]
+    assert [a for a, _line in ef["metric_refs"]] == ["USED"]
+    # one literal fact per occurrence: the scope token twice, the rogue once
+    assert [v for v, _line in ef["metric_literals"]] == [
+        "ml.serving", "ml.serving", "ml.rogue.name",
+    ]
+
+
+# -----------------------------------------------------------------------------
+# plan-key-completeness
+# -----------------------------------------------------------------------------
+
+
+class _FixturePlanKey(PlanKeyCompletenessRule):
+    """The shipped rule's dataflow against a two-surface fixture contract."""
+
+    PLAN_BUILD_ROOTS = ("flink_ml_tpu.planner:build_plan",)
+    KEY_CAPTURE_ROOTS = {"digest": ("flink_ml_tpu.planner:digest",)}
+    PLAN_KEY_OPTIONS = {"ALPHA": ("digest",)}
+    PLAN_NEUTRAL = {}
+
+
+PLANNER_DIRTY = {
+    "flink_ml_tpu/config.py": CONFIG_FIXTURE,
+    "flink_ml_tpu/planner.py": """
+        from flink_ml_tpu.config import Options, config
+        from flink_ml_tpu.helpers import load_extra
+
+        def digest():
+            return config.get(Options.ALPHA)
+
+        def build_plan():
+            digest()
+            return load_extra()
+    """,
+    # The offending read lives two edges away from the root and in a
+    # different file than both the digest and the declaration.
+    "flink_ml_tpu/helpers.py": """
+        from flink_ml_tpu.config import Options, config
+
+        def load_extra():
+            return config.get(Options.BETA)
+    """,
+}
+
+
+def test_plan_key_flags_uncaptured_read_at_the_read_site(tmp_path):
+    project = _project(tmp_path, PLANNER_DIRTY)
+    (f,) = _FixturePlanKey().run(project)
+    # Anchored at the read site in helpers.py — not at config.py, not at the
+    # digest — so --changed-only reporting lands on the seeded edit.
+    assert f.path == "flink_ml_tpu/helpers.py" and f.line == 4
+    assert "beta.key" in f.message and "BETA" in f.message
+    assert "rebuild key" in f.message
+
+
+def test_plan_key_clean_when_read_is_captured_or_declared_neutral(tmp_path):
+    captured = dict(PLANNER_DIRTY)
+    captured["flink_ml_tpu/planner.py"] = """
+        from flink_ml_tpu.config import Options, config
+        from flink_ml_tpu.helpers import load_extra
+
+        def digest():
+            load_extra()
+            return config.get(Options.ALPHA)
+
+        def build_plan():
+            digest()
+            return load_extra()
+    """
+    assert _FixturePlanKey().run(_project(tmp_path / "captured", captured)) == []
+
+    class Neutral(_FixturePlanKey):
+        PLAN_NEUTRAL = {"BETA": "spill placement only"}
+
+    assert Neutral().run(_project(tmp_path / "neutral", PLANNER_DIRTY)) == []
+
+
+def test_plan_key_honesty_checks_catch_stale_tables(tmp_path):
+    # A claimed (option, surface) pair with no reachable read is an error at
+    # the declaration; so is a PLAN_NEUTRAL entry nothing reads under plan
+    # build; so is a renamed root (which would otherwise disable the gate).
+    class Stale(_FixturePlanKey):
+        PLAN_KEY_OPTIONS = {"ALPHA": ("digest",), "BETA": ("digest",)}
+        PLAN_NEUTRAL = {"GAMMA": "obsolete rationale"}
+
+    project = _project(tmp_path, PLANNER_DIRTY)
+    messages = [f.message for f in Stale().run(project)]
+    assert any("declared plan-key for digest" in m and "beta.key" in m for m in messages)
+    assert any("no longer read under plan build" in m and "GAMMA" in m for m in messages)
+
+    class Renamed(_FixturePlanKey):
+        PLAN_BUILD_ROOTS = ("flink_ml_tpu.planner:gone",)
+
+    messages = [f.message for f in Renamed().run(project)]
+    assert any("flink_ml_tpu.planner:gone not found" in m for m in messages)
+
+
+def test_plan_key_skips_trees_without_the_config_registry(tmp_path):
+    project = _project(tmp_path, {"flink_ml_tpu/x.py": "VALUE = 1\n"})
+    assert _FixturePlanKey().run(project) == []
+
+
+def test_changed_only_view_keeps_the_plan_key_read_site(tmp_path, monkeypatch):
+    """End to end through run_rules: the --changed-only view (restricted_to)
+    keeps a plan-key finding when only the reader file is touched, because
+    the finding is anchored there rather than at the digest/declaration."""
+    rule = REGISTRY["plan-key-completeness"]
+    for attr in ("PLAN_BUILD_ROOTS", "KEY_CAPTURE_ROOTS", "PLAN_KEY_OPTIONS", "PLAN_NEUTRAL"):
+        monkeypatch.setattr(rule, attr, getattr(_FixturePlanKey, attr))
+    write_tree(tmp_path, PLANNER_DIRTY)
+    result = run_rules(
+        Project(str(tmp_path), ["flink_ml_tpu"]), rules=["plan-key-completeness"]
+    )
+    narrowed = result.restricted_to({"flink_ml_tpu/helpers.py"})
+    assert [f.path for f in narrowed.findings] == ["flink_ml_tpu/helpers.py"]
+    assert result.restricted_to({"flink_ml_tpu/config.py"}).findings == []
+
+
+# -----------------------------------------------------------------------------
+# typed-error-escape
+# -----------------------------------------------------------------------------
+
+
+class _FixtureEscape(TypedErrorEscapeRule):
+    REQUEST_SURFACES = ("flink_ml_tpu.srv:Server.submit",)
+    BACKGROUND_SURFACES = ()
+    SITE_ALLOWLIST = {}
+    RENDEZVOUS_SEAMS = set()
+
+
+ERRORS_MODULE = """
+    class ServingError(RuntimeError):
+        pass
+
+    class ServingQueueError(ServingError):
+        pass
+"""
+
+
+def test_escape_flags_cross_module_untyped_raise_at_the_raise_site(tmp_path):
+    project = _project(tmp_path, {
+        "flink_ml_tpu/errors.py": ERRORS_MODULE,
+        "flink_ml_tpu/inner.py": """
+            def risky():
+                raise RuntimeError("boom")
+        """,
+        "flink_ml_tpu/srv.py": """
+            from flink_ml_tpu.inner import risky
+
+            class Server:
+                def submit(self):
+                    return risky()
+        """,
+    })
+    (f,) = _FixtureEscape().run(project)
+    assert f.path == "flink_ml_tpu/inner.py" and f.line == 2
+    assert "RuntimeError" in f.message and "submit" in f.message
+
+
+def test_escape_clean_for_typed_subclasses_and_documented_system(tmp_path):
+    project = _project(tmp_path, {
+        "flink_ml_tpu/errors.py": ERRORS_MODULE,
+        "flink_ml_tpu/srv.py": """
+            from flink_ml_tpu.errors import ServingQueueError
+
+            def _validate(rows):
+                if rows <= 0:
+                    raise ValueError("empty request")
+
+            class Server:
+                def submit(self, rows):
+                    _validate(rows)
+                    raise ServingQueueError("full")
+        """,
+    })
+    assert _FixtureEscape().run(project) == []
+
+
+def test_escape_honors_call_site_guards_subclass_aware(tmp_path):
+    tree = {
+        "flink_ml_tpu/errors.py": ERRORS_MODULE,
+        "flink_ml_tpu/inner.py": """
+            from flink_ml_tpu.errors import ServingQueueError
+
+            def risky():
+                raise KeyError("missing")
+        """,
+        "flink_ml_tpu/srv.py": """
+            from flink_ml_tpu.inner import risky
+
+            class Server:
+                def submit(self):
+                    try:
+                        return risky()
+                    except LookupError:
+                        return None
+        """,
+    }
+    # except LookupError catches the callee's KeyError (builtin hierarchy).
+    assert _FixtureEscape().run(_project(tmp_path / "caught", tree)) == []
+    # A transparent re-raise handler does NOT swallow it.
+    tree["flink_ml_tpu/srv.py"] = """
+        from flink_ml_tpu.inner import risky
+
+        class Server:
+            def submit(self):
+                try:
+                    return risky()
+                except LookupError:
+                    raise
+    """
+    (f,) = _FixtureEscape().run(_project(tmp_path / "reraise", tree))
+    assert f.path == "flink_ml_tpu/inner.py" and "KeyError" in f.message
+
+
+def test_escape_site_allowlist_and_rendezvous_seams(tmp_path):
+    tree = {
+        "flink_ml_tpu/srv.py": """
+            class Server:
+                def __init__(self):
+                    self.error = None
+
+                def submit(self):
+                    if self.error is not None:
+                        raise self.error
+                    raise LookupError("no handler registered")
+        """,
+    }
+
+    class Allowed(_FixtureEscape):
+        SITE_ALLOWLIST = {("flink_ml_tpu/srv.py", "LookupError"): "proven dead"}
+        RENDEZVOUS_SEAMS = {"flink_ml_tpu.srv:Server.submit"}
+
+    assert Allowed().run(_project(tmp_path / "allowed", tree)) == []
+    # Without the tables both the dynamic re-raise and the LookupError flag.
+    findings = _FixtureEscape().run(_project(tmp_path / "bare", tree))
+    assert len(findings) == 2
+    assert any("self.error" in f.message for f in findings)
+    assert any("LookupError" in f.message for f in findings)
+
+
+def test_escape_skips_trees_without_the_surfaces(tmp_path):
+    project = _project(tmp_path, {"flink_ml_tpu/x.py": "VALUE = 1\n"})
+    assert _FixtureEscape().run(project) == []
+
+
+# -----------------------------------------------------------------------------
+# registry-consistency
+# -----------------------------------------------------------------------------
+
+REGISTRY_DIRTY = {
+    "flink_ml_tpu/config.py": """
+        class ConfigOption:
+            def __init__(self, key, typ, default, doc):
+                self.key = key
+
+        class Options:
+            ALPHA = ConfigOption("alpha.key", int, 1, "")
+            BETA = ConfigOption("beta.key", int, 2, "")
+            DEAD = ConfigOption("dead.key", int, 3, "")
+
+        class _Config:
+            def get(self, opt):
+                return 0
+
+        config = _Config()
+    """,
+    "flink_ml_tpu/metrics.py": """
+        class MLMetrics:
+            USED = "ml.serving.used"
+            UNDOC = "ml.serving.undoc"
+            DEAD = "ml.serving.dead"
+    """,
+    "flink_ml_tpu/user.py": """
+        from flink_ml_tpu.config import Options, config
+        from flink_ml_tpu.metrics import MLMetrics
+
+        def consume(registry):
+            config.get(Options.ALPHA)
+            config.get(Options.BETA)
+            registry.counter("ml.serving", MLMetrics.USED)
+            registry.counter("ml.serving", MLMetrics.UNDOC)
+            registry.counter("ml.serving", "ml.rogue.name")
+    """,
+    "docs/configuration.md": """
+        | Key | Type | Default | Consumed by |
+        |---|---|---|---|
+        | `alpha.key` | int | 1 | user |
+        | `ghost.key` | int | 0 | nothing |
+    """,
+    "docs/observability.md": """
+        | Name | Kind | Meaning |
+        |---|---|---|
+        | `ml.serving.used` | counter | used |
+        | `ml.ghost.row` | counter | gone |
+        | `ml.goodput.<category>.ms` | gauge | dynamic family row |
+    """,
+}
+
+
+def test_registry_consistency_flags_all_seven_drift_classes(tmp_path):
+    result = run_on(tmp_path, REGISTRY_DIRTY, rules=["registry-consistency"])
+    messages = [f.message for f in result.findings]
+    assert len(result.findings) == 7, messages
+    assert any("'dead.key'" in m and "never referenced" in m for m in messages)
+    assert any("'beta.key'" in m and "no row" in m for m in messages)
+    assert any("'ghost.key'" in m and "stale row" in m for m in messages)
+    assert any("'ml.serving.dead'" in m and "never referenced" in m for m in messages)
+    assert any("'ml.serving.undoc'" in m and "no row" in m for m in messages)
+    assert any("'ml.ghost.row'" in m for m in messages)
+    assert any("'ml.rogue.name'" in m and "not a registered" in m for m in messages)
+    # the scope literal "ml.serving" is not an unregistered-literal finding
+    assert not any("'ml.serving'" in m for m in messages)
+    # drift findings anchor at declarations / doc rows; only the inline
+    # literal (the one defect that IS a use-site defect) anchors in user.py
+    assert [f.path for f in result.findings if f.path == "flink_ml_tpu/user.py"] == [
+        "flink_ml_tpu/user.py"
+    ]
+
+
+def test_registry_consistency_flags_inline_metric_literal(tmp_path):
+    tree = dict(REGISTRY_DIRTY)
+    tree["docs/observability.md"] = """
+        | Name | Kind | Meaning |
+        |---|---|---|
+        | `ml.serving.used` | counter | used |
+        | `ml.serving.undoc` | counter | now documented |
+    """
+    result = run_on(tmp_path, tree, rules=["registry-consistency"])
+    lit = [f for f in result.findings if "ml.rogue.name" in f.message]
+    assert len(lit) == 1 and lit[0].path == "flink_ml_tpu/user.py"
+    assert "not a registered MLMetrics name" in lit[0].message
+
+
+def test_registry_consistency_clean_fixture(tmp_path):
+    clean = dict(REGISTRY_DIRTY)
+    clean["flink_ml_tpu/config.py"] = REGISTRY_DIRTY["flink_ml_tpu/config.py"].replace(
+        '    DEAD = ConfigOption("dead.key", int, 3, "")\n', "")
+    clean["flink_ml_tpu/metrics.py"] = """
+        class MLMetrics:
+            USED = "ml.serving.used"
+            UNDOC = "ml.serving.undoc"
+    """
+    clean["flink_ml_tpu/user.py"] = REGISTRY_DIRTY["flink_ml_tpu/user.py"].replace(
+        '    registry.counter("ml.serving", "ml.rogue.name")\n', "")
+    clean["docs/configuration.md"] = """
+        | Key | Type | Default | Consumed by |
+        |---|---|---|---|
+        | `alpha.key` | int | 1 | user |
+        | `beta.key` | int | 2 | user |
+    """
+    clean["docs/observability.md"] = """
+        | Name | Kind | Meaning |
+        |---|---|---|
+        | `ml.serving.used` | counter | used |
+        | `ml.serving.undoc` | counter | documented |
+    """
+    result = run_on(tmp_path, clean, rules=["registry-consistency"])
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+def test_registry_consistency_doc_legs_skip_without_doc_files(tmp_path):
+    # Fixture trees without the doc tables only run the dead-declaration
+    # legs — the rule stays hermetic for unit fixtures.
+    tree = {k: v for k, v in REGISTRY_DIRTY.items() if not k.startswith("docs/")}
+    result = run_on(tmp_path, tree, rules=["registry-consistency"])
+    messages = [f.message for f in result.findings]
+    assert len(messages) == 3  # dead option, dead metric, rogue literal
+    assert not any("no row" in m for m in messages)

@@ -1,0 +1,116 @@
+"""The typed-error contract at the batcher's delivery seam.
+
+``typed-error-escape`` (tools/graftcheck) proves statically that every raise
+lexically reachable from a request surface is typed — but errors carried
+across the batcher's thread rendezvous (``req.error`` → ``result()``) are
+invisible to the call graph. These tests pin the runtime half of that
+contract: ``MicroBatcher._deliver_error`` is the single seam where every
+batch failure lands, and it must hand clients either the original typed
+error, the original injected fault, or a ``ServingExecutionError`` wrapping
+anything else — never a raw untyped exception.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.faults import InjectedFault
+from flink_ml_tpu.serving.batcher import _CLAIMED, MicroBatcher, PendingRequest
+from flink_ml_tpu.serving.errors import (
+    ServingError,
+    ServingExecutionError,
+    ServingOverloadedError,
+)
+
+
+def _req(rows=1):
+    return DataFrame.from_dict({"x": np.ones((rows, 2), np.float32)})
+
+
+class _Resp:
+    def __init__(self, df, version, latency_ms, bucket):
+        self.dataframe = df
+        self.model_version = version
+        self.latency_ms = latency_ms
+        self.bucket = bucket
+
+
+def _batcher(execute):
+    return MicroBatcher(
+        execute,
+        max_batch_size=4,
+        max_delay_ms=0.0,
+        queue_capacity_rows=64,
+        scope="ml.serving[t-errors]",
+        response_factory=_Resp,
+    )
+
+
+def _run_failing_batch(error):
+    """Run one batch whose execute raises ``error``; return what the client
+    sees at the ``result()`` rendezvous."""
+
+    def execute(padded):
+        raise error
+
+    batcher = _batcher(execute)
+    req = PendingRequest(_req(1), deadline=time.perf_counter() + 30.0)
+    req._state = _CLAIMED
+    batcher._install_abandon(req)
+    batcher._run_batch([req])
+    return req
+
+
+def test_untyped_execute_failure_is_wrapped_serving_execution_error():
+    boom = RuntimeError("device fell over")
+    req = _run_failing_batch(boom)
+    with pytest.raises(ServingExecutionError) as exc_info:
+        req.result()
+    err = exc_info.value
+    assert isinstance(err, ServingError)  # the blanket client contract
+    assert err.__cause__ is boom and err.cause is boom
+    assert "RuntimeError" in str(err) and "device fell over" in str(err)
+
+
+def test_typed_errors_pass_through_the_seam_unwrapped():
+    typed = ServingOverloadedError(8, 8)
+    req = _run_failing_batch(typed)
+    with pytest.raises(ServingOverloadedError) as exc_info:
+        req.result()
+    assert exc_info.value is typed  # same object: no double wrapping
+
+
+def test_injected_faults_pass_through_for_the_chaos_bin():
+    # loadgen counts InjectedFault in its own bin (generator.py); wrapping
+    # it would misfile chaos-armed faults as unexpected typed errors.
+    fault = InjectedFault("serving.exec", hit=1)
+    req = _run_failing_batch(fault)
+    with pytest.raises(InjectedFault) as exc_info:
+        req.result()
+    assert exc_info.value is fault
+
+
+def test_every_waiter_of_a_failed_batch_gets_the_wrapped_error():
+    def execute(padded):
+        raise KeyError("missing column")
+
+    batcher = _batcher(execute)
+    reqs = [PendingRequest(_req(1), deadline=time.perf_counter() + 30.0) for _ in range(3)]
+    for r in reqs:
+        r._state = _CLAIMED
+        batcher._install_abandon(r)
+    batcher._run_batch(reqs)
+    for r in reqs:
+        assert isinstance(r.error, ServingExecutionError)
+        assert isinstance(r.error.__cause__, KeyError)
+
+
+def test_serving_execution_error_shape():
+    cause = ValueError("bad")
+    err = ServingExecutionError("batch execution failed", cause=cause)
+    assert isinstance(err, ServingError)
+    assert err.cause is cause and err.__cause__ is cause
+    assert ServingExecutionError("no cause").cause is None

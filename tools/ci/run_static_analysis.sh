@@ -1,10 +1,13 @@
 #!/usr/bin/env bash
 # Static-analysis gate: graftcheck over the library tree, failing fast with
 # the human-readable report before any test process spins up a device mesh.
-# Runs every registered rule — including the v3 concurrency suite
-# (shared-state-guard, check-then-act, and the whole-program lock-order /
-# blocking-under-lock re-scope over the inferred thread topology) — and the
-# SARIF artifact carries their findings like any other rule's.
+# Runs every registered rule — the v3 concurrency suite (shared-state-guard,
+# check-then-act, lock-order, blocking-under-lock) and the v4 contract
+# dataflow suite (plan-key-completeness, typed-error-escape,
+# registry-consistency) — and the SARIF artifact carries their findings like
+# any other rule's. The per-rule wall-time breakdown (--timings) prints after
+# the report so a rule that starts eating the CI budget is visible the day it
+# happens, not when the gate turns slow.
 # See docs/static_analysis.md for the rule catalogue and suppression policy.
 #
 # The FULL-TREE run is (and stays) the CI gate. For the local pre-commit
@@ -28,7 +31,7 @@ if [[ "${GRAFTCHECK_CHANGED_ONLY:-0}" == "1" ]]; then
 fi
 
 echo "=== graftcheck static analysis ==="
-python -m tools.graftcheck "${extra_args[@]}" "$@"
+python -m tools.graftcheck --timings "${extra_args[@]}" "$@"
 
 if [[ -n "${GRAFTCHECK_SARIF:-}" ]]; then
     python -m tools.graftcheck --format sarif "${extra_args[@]}" "$@" \

@@ -54,6 +54,12 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--list-rules", action="store_true", help="print the rule catalogue")
     p.add_argument(
+        "--timings",
+        action="store_true",
+        help="print the per-rule wall-time breakdown after the findings "
+        "(human format; json always carries rule_times_ms)",
+    )
+    p.add_argument(
         "--changed-only",
         action="store_true",
         help="report (and gate) only findings in files changed per git status; "
@@ -110,7 +116,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_rules:
         for name in sorted(REGISTRY):
             rule = REGISTRY[name]
-            print(f"{name:24s} [{rule.severity}] {rule.description}")
+            print(
+                f"{name:28s} [{rule.severity}/{rule.granularity}] {rule.description}"
+            )
         return 0
 
     rules = None
@@ -157,6 +165,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(to_sarif(result, REGISTRY), indent=2, sort_keys=True))
     else:
         print(result.render_human())
+        if args.timings:
+            print(result.render_timings())
     if result.errors:
         return 1
     if args.strict and result.findings:

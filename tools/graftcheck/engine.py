@@ -27,6 +27,7 @@ from __future__ import annotations
 import ast
 import os
 import re
+import time
 from dataclasses import asdict, dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set
 
@@ -43,7 +44,7 @@ __all__ = [
     "JSON_SCHEMA_VERSION",
 ]
 
-JSON_SCHEMA_VERSION = 2  # v2: adds index/cache stats to the summary
+JSON_SCHEMA_VERSION = 3  # v3: per-rule wall times + rule granularity; v2: index/cache stats
 
 SEVERITIES = ("error", "warning")
 
@@ -275,6 +276,9 @@ class RunResult:
     rules_run: List[str]
     cache_hits: int = 0
     cache_misses: int = 0
+    #: rule name -> wall seconds spent in that rule (file rules: summed over
+    #: files, cache hits included — the honest CI number).
+    rule_times: Dict[str, float] = field(default_factory=dict)
 
     @property
     def errors(self) -> List[Finding]:
@@ -294,7 +298,18 @@ class RunResult:
             rules_run=self.rules_run,
             cache_hits=self.cache_hits,
             cache_misses=self.cache_misses,
+            rule_times=self.rule_times,
         )
+
+    def render_timings(self) -> str:
+        """Per-rule wall-time breakdown, slowest first (the CI budget view)."""
+        total = sum(self.rule_times.values())
+        lines = ["rule                              time     share"]
+        for name, secs in sorted(self.rule_times.items(), key=lambda kv: -kv[1]):
+            share = (secs / total * 100.0) if total else 0.0
+            lines.append(f"{name:<32} {secs * 1000.0:7.1f}ms {share:5.1f}%")
+        lines.append(f"{'total':<32} {total * 1000.0:7.1f}ms")
+        return "\n".join(lines)
 
     def to_json(self) -> dict:
         by_rule: Dict[str, int] = {}
@@ -306,6 +321,7 @@ class RunResult:
                 {
                     "name": REGISTRY[name].name,
                     "severity": REGISTRY[name].severity,
+                    "granularity": REGISTRY[name].granularity,
                     "description": REGISTRY[name].description,
                 }
                 for name in self.rules_run
@@ -319,6 +335,10 @@ class RunResult:
                 "suppressed": len(self.suppressed),
                 "by_rule": by_rule,
                 "cache": {"hits": self.cache_hits, "misses": self.cache_misses},
+                "rule_times_ms": {
+                    name: round(secs * 1000.0, 3)
+                    for name, secs in sorted(self.rule_times.items())
+                },
             },
         }
 
@@ -365,13 +385,16 @@ def run_rules(
 
     project.facts()  # materialize the index facts (and parse errors) once
     raw: List[Finding] = list(project.parse_errors)
+    rule_times: Dict[str, float] = {}
     for name in names:
         rule = REGISTRY[name]
+        t0 = time.perf_counter()
         if rule.granularity == "file":
             for sf in project.files:
                 raw.extend(_run_file_rule(project, rule, sf))
         else:
             raw.extend(rule.run(project))
+        rule_times[name] = time.perf_counter() - t0
 
     processed: List[Finding] = []
     for f in raw:
@@ -398,4 +421,5 @@ def run_rules(
         rules_run=names,
         cache_hits=cache.hits if cache else 0,
         cache_misses=cache.misses if cache else 0,
+        rule_times=rule_times,
     )

@@ -73,7 +73,7 @@ __all__ = [
 
 #: Bump whenever the shape/semantics of extracted facts change — it is part of
 #: the disk-cache key, so stale caches self-invalidate.
-FACTS_VERSION = 4  # 4: sparse_kernel_spec joins the spec-def set; segment_sum prim
+FACTS_VERSION = 5  # 5: contract dataflow — config reads, raise sites, metric names
 
 KERNELS_MODULE = "flink_ml_tpu.ops.kernels"
 
@@ -189,6 +189,13 @@ def _empty_facts(rel: str, module: str) -> Dict[str, Any]:
         "kspec_ctors": [],
         "trip_sites": [],  # [point name, line]
         "pool_name_prefixes": [],  # ThreadPoolExecutor thread_name_prefix literals
+        # contract-registry facts (v5): declarations and references of the two
+        # string-keyed registries — config options and ml.* metric names.
+        "config_options": [],  # [attr, literal key, line]  (X = ConfigOption("key"))
+        "option_refs": [],  # [attr, line]  (every Options.X reference, any context)
+        "metric_consts": [],  # [attr, value, line]  (class-body X = "ml...")
+        "metric_refs": [],  # [attr, line]  (every MLMetrics.X reference)
+        "metric_literals": [],  # [value, line]  (inline "ml.*" string constants)
     }
 
 
@@ -196,6 +203,38 @@ def _ctor_class_name(call: ast.Call) -> Optional[str]:
     if isinstance(call.func, ast.Name):
         return call.func.id
     return None
+
+
+def _handler_class_names(type_expr: Optional[ast.AST]) -> List[str]:
+    """Class names an ``except`` clause catches; ``"*"`` for a bare except."""
+    if type_expr is None:
+        return ["*"]
+    if isinstance(type_expr, ast.Name):
+        return [type_expr.id]
+    if isinstance(type_expr, ast.Attribute):
+        return [type_expr.attr]
+    if isinstance(type_expr, ast.Tuple):
+        out: List[str] = []
+        for elt in type_expr.elts:
+            out.extend(_handler_class_names(elt))
+        return out
+    return ["*"]  # dynamic handler expression: assume it catches
+
+
+def _handler_reraises(h: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises the caught exception (bare ``raise``
+    or ``raise e`` of its alias) somewhere in its body — the observe-and-
+    rethrow idiom. Such a handler is *transparent* for escape purposes: it
+    never swallows, so its classes must not join the lexical catcher set.
+    A conditionally-swallowing handler still counts as transparent; that errs
+    toward reporting, never toward hiding an escape."""
+    for sub in ast.walk(h):
+        if isinstance(sub, ast.Raise):
+            if sub.exc is None:
+                return True
+            if isinstance(sub.exc, ast.Name) and h.name and sub.exc.id == h.name:
+                return True
+    return False
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:
@@ -461,7 +500,54 @@ class _Extractor:
         for stmt in self.tree.body:
             self._walk_toplevel(stmt, cls=None)
         self._second_pass_jitted()
+        self._registry_pass()
         return self.facts
+
+    def _registry_pass(self) -> None:
+        """Flat sweep for the contract registries: ``ConfigOption``/``"ml.*"``
+        declarations in class bodies, and every ``Options.X`` /
+        ``MLMetrics.X`` / inline ``"ml.*"`` reference anywhere in the module
+        (module level included — a read at import time is still a read)."""
+        f = self.facts
+        const_lines: Set[int] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if not (
+                        isinstance(item, ast.Assign)
+                        and len(item.targets) == 1
+                        and isinstance(item.targets[0], ast.Name)
+                    ):
+                        continue
+                    attr, val = item.targets[0].id, item.value
+                    if (
+                        isinstance(val, ast.Call)
+                        and _ctor_class_name(val) == "ConfigOption"
+                        and val.args
+                        and isinstance(val.args[0], ast.Constant)
+                        and isinstance(val.args[0].value, str)
+                    ):
+                        f["config_options"].append([attr, val.args[0].value, item.lineno])
+                    elif (
+                        isinstance(val, ast.Constant)
+                        and isinstance(val.value, str)
+                        and val.value.startswith("ml.")
+                    ):
+                        f["metric_consts"].append([attr, val.value, item.lineno])
+                        const_lines.add(item.lineno)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+                if node.value.id == "Options":
+                    f["option_refs"].append([node.attr, node.lineno])
+                elif node.value.id == "MLMetrics":
+                    f["metric_refs"].append([node.attr, node.lineno])
+            elif (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value.startswith("ml.")
+                and node.lineno not in const_lines
+            ):
+                f["metric_literals"].append([node.value, node.lineno])
 
     def _walk_toplevel(self, node: ast.AST, cls: Optional[str]) -> None:
         if isinstance(node, ast.ClassDef):
@@ -567,6 +653,8 @@ class _Extractor:
             "spec_trivial": True,
             "spec_refs": [],  # kernel bases referenced inside (kernel_spec only)
             "spec_names": [],  # original imported kernel names referenced inside
+            "config_reads": [],  # [Options attr, line]  (.get(Options.X) sites)
+            "raises": [],  # [class name or None, line, [lexical catcher names], detail]
             "spawns": [],  # [kind, line, target ref or None, name hint or None, multi]
             "attr_accesses": [],  # [attr, "r"|"w"|"m", line, [held], [regions]]
             "local_types": {},  # annotated locals: `x: Cls = ...` -> {"x": "Cls"}
@@ -609,12 +697,36 @@ class _Extractor:
         loop: int,
         returns: List[Optional[str]],
     ) -> None:
-        def walk(node: ast.AST, held: List[str], regions: List[str], loop: int, comp: int) -> None:
+        def walk(
+            node: ast.AST,
+            held: List[str],
+            regions: List[str],
+            loop: int,
+            comp: int,
+            guards: List[str],
+            handler,
+        ) -> None:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._extract_function(node, cls=ff["cls"], parent=qual)
                 return
             if isinstance(node, ast.Lambda):
                 return
+            if isinstance(node, ast.Try):
+                catchers: List[str] = []
+                for h in node.handlers:
+                    if not _handler_reraises(h):
+                        catchers.extend(_handler_class_names(h.type))
+                for stmt in node.body:
+                    walk(stmt, held, regions, loop, comp, guards + catchers, handler)
+                for h in node.handlers:
+                    hcls = _handler_class_names(h.type)
+                    for stmt in h.body:
+                        walk(stmt, held, regions, loop, comp, guards, (hcls, h.name))
+                for stmt in node.orelse + node.finalbody:
+                    walk(stmt, held, regions, loop, comp, guards, handler)
+                return
+            if isinstance(node, ast.Raise):
+                self._record_raise(node, ff, guards, handler)
             if isinstance(node, ast.Return):
                 val = node.value
                 returns.append(
@@ -632,26 +744,26 @@ class _Extractor:
                         acquired.append(token)
                         acquired_regions.append(f"{token}@{node.lineno}")
                     else:
-                        walk(item.context_expr, held, regions, loop, comp)
+                        walk(item.context_expr, held, regions, loop, comp, guards, handler)
                 for stmt in node.body:
-                    walk(stmt, held + acquired, regions + acquired_regions, loop, comp)
+                    walk(stmt, held + acquired, regions + acquired_regions, loop, comp, guards, handler)
                 return
             if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
                 if isinstance(node, ast.For):
                     self._note_scalar_loop_var(node, ff)
-                    walk(node.iter, held, regions, loop, comp)
-                    walk(node.target, held, regions, loop, comp)
+                    walk(node.iter, held, regions, loop, comp, guards, handler)
+                    walk(node.target, held, regions, loop, comp, guards, handler)
                 elif isinstance(node, ast.While):
-                    walk(node.test, held, regions, loop, comp)
+                    walk(node.test, held, regions, loop, comp, guards, handler)
                 for stmt in node.body + node.orelse:
-                    walk(stmt, held, regions, loop + 1, comp)
+                    walk(stmt, held, regions, loop + 1, comp, guards, handler)
                 return
             if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
                 # Comprehensions iterate like loops, but only spawn-site
                 # multiplicity cares — the jit-construction loop counter
                 # keeps its original (statement-loop) semantics.
                 for child in ast.iter_child_nodes(node):
-                    walk(child, held, regions, loop, comp + 1)
+                    walk(child, held, regions, loop, comp + 1, guards, handler)
                 return
             if isinstance(node, (ast.If, ast.IfExp)):
                 self._note_param_branch(node.test, ff)
@@ -692,12 +804,12 @@ class _Extractor:
                         [attr, "m", node.lineno, list(held), list(regions)]
                     )
             if isinstance(node, ast.Call):
-                self._record_call(node, ff, ci, held, regions, loop, comp)
+                self._record_call(node, ff, ci, held, regions, loop, comp, guards)
             for child in ast.iter_child_nodes(node):
-                walk(child, held, regions, loop, comp)
+                walk(child, held, regions, loop, comp, guards, handler)
 
         for stmt in fn.body:
-            walk(stmt, list(held), [], loop, 0)
+            walk(stmt, list(held), [], loop, 0, [], None)
 
     def _note_scalar_loop_var(self, node: ast.For, ff: Dict[str, Any]) -> None:
         """Loop variables that are definitely Python scalars: ``for i in
@@ -737,6 +849,43 @@ class _Extractor:
             ff["param_branches"].append([test.lineno, sorted(hits)])
 
     # -- per-call classification ----------------------------------------------
+    def _record_raise(
+        self, node: ast.Raise, ff: Dict[str, Any], guards: List[str], handler
+    ) -> None:
+        """Raise-site fact: resolved class name (or None when dynamic), the
+        lexically enclosing catcher names, and a detail string for diagnostics.
+        A bare ``raise``/``raise e`` inside an except clause re-raises the
+        handler's own classes (not re-caught by that same try)."""
+        exc = node.exc
+        line = node.lineno
+        if exc is None or (
+            isinstance(exc, ast.Name) and handler is not None and exc.id == handler[1]
+        ):
+            # Re-raise of the caught exception: the original raise sites (and
+            # callee escapes) already carry through, because a re-raising
+            # handler is transparent — recording it again would only lose the
+            # resolved class. A bare ``raise`` outside any handler is dynamic.
+            if handler is None:
+                ff["raises"].append([None, line, list(guards), "bare raise"])
+            return
+        if isinstance(exc, ast.Call):
+            func = exc.func
+            name = (
+                func.id
+                if isinstance(func, ast.Name)
+                else (func.attr if isinstance(func, ast.Attribute) else None)
+            )
+            ff["raises"].append([name, line, list(guards), ""])
+        elif isinstance(exc, ast.Name):
+            typed = ff["local_types"].get(exc.id)
+            if typed is not None:  # annotated param/local: `e: ServingError`
+                ff["raises"].append([typed, line, list(guards), ""])
+            else:
+                ff["raises"].append([exc.id, line, list(guards), "name"])
+        else:
+            detail = ast.unparse(exc) if hasattr(ast, "unparse") else "dynamic"
+            ff["raises"].append([None, line, list(guards), detail])
+
     def _record_call(
         self,
         call: ast.Call,
@@ -746,11 +895,23 @@ class _Extractor:
         regions: List[str],
         loop: int,
         comp: int,
+        guards: List[str],
     ) -> None:
         func = call.func
         ref = _call_ref(func)
         if ref is not None:
-            ff["calls"].append([ref, call.lineno, list(held)])
+            ff["calls"].append([ref, call.lineno, list(held), list(guards)])
+        # config-option read site: any ``.get(Options.X)`` (the uniform read
+        # idiom — ``config.get`` and wrapped configurations alike).
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "get"
+            and call.args
+            and isinstance(call.args[0], ast.Attribute)
+            and isinstance(call.args[0].value, ast.Name)
+            and call.args[0].value.id == "Options"
+        ):
+            ff["config_reads"].append([call.args[0].attr, call.lineno])
 
         # thread spawn sites + container-mutator writes
         self._classify_spawn(call, ff, loop, comp)
@@ -1138,7 +1299,7 @@ class ProjectIndex:
                 if ff["parent"]:
                     self.children.setdefault(f"{module}:{ff['parent']}", []).append(node)
                 out: List[Tuple[str, int]] = []
-                for ref, line, _held in ff["calls"]:
+                for ref, line, _held, _guards in ff["calls"]:
                     tgt = self.resolve_ref(module, ff["cls"], qual, ref)
                     if tgt is not None:
                         out.append((tgt, line))

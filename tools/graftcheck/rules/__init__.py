@@ -14,6 +14,9 @@ from tools.graftcheck.rules import (  # noqa: F401  (imported for registration)
     kernel_spec_consistency,
     layer_deps,
     lock_order,
+    plan_key,
     recompile_hazard,
+    registry_consistency,
     shared_state_guard,
+    typed_error_escape,
 )

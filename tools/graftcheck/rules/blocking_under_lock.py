@@ -126,7 +126,7 @@ class BlockingUnderLockRule(Rule):
                         )
                     )
                 seen: Set[tuple] = set()
-                for ref, line, held in ff["calls"]:
+                for ref, line, held, _guards in ff["calls"]:
                     lock = self._contended_innermost(module, ff, held, contended)
                     if lock is None:
                         continue

@@ -130,7 +130,7 @@ def build_lock_graph(project: Project, scope: Optional[Sequence[str]] = None) ->
                 b = _lock_id(module, ff["cls"], inner)
                 edges.setdefault((a, b), (rel, line, f"nested `with` in {where}"))
             seen_calls: Set[Tuple[str, str]] = set()
-            for ref, line, held in ff["calls"]:
+            for ref, line, held, _guards in ff["calls"]:
                 if not held:
                     continue
                 callee = index.resolve_ref(module, ff["cls"], qual, ref)

@@ -1,0 +1,209 @@
+"""plan-key-completeness: every plan-affecting config read joins a rebuild key.
+
+The PR 9/10 bug class, made a tier-1 gate: a config option read somewhere
+under plan build changes what gets compiled, but if no rebuild key carries it,
+flipping the option mid-process silently keeps serving the old plan. ROADMAP
+item 2 (the precision tier) lands straight on top of this invariant.
+
+The contract, checked whole-program over the v5 dataflow facts:
+
+1. **Completeness** — every ``config.get(Options.X)`` site reachable through
+   the resolved call graph from the plan-build surfaces (``PLAN_BUILD_ROOTS``)
+   must name an option that is either *key-captured* (some read of it sits
+   inside the transitive reach of a key-composition function) or declared
+   plan-neutral in ``PLAN_NEUTRAL`` with a rationale. Anything else is an
+   error at the offending read site — which is where ``--changed-only`` will
+   anchor it, even when the digest lives in another file.
+2. **Declaration honesty** — the declarative tables cannot rot silently:
+   every ``PLAN_KEY_OPTIONS`` entry must actually be read within the capture
+   reach of each key surface it claims, every ``PLAN_NEUTRAL`` entry must
+   still be plan-reachable, and every named root/capture function must still
+   exist in the index (a rename must not quietly disable the rule).
+
+Key surfaces and their capture roots (a read is "captured by" a surface when
+its function is reachable from one of these — their return values compose
+into that surface's key):
+
+- ``batch-fingerprint`` — ``PipelineModel._fingerprint`` (+ sparse hints);
+  compared by ``_batch_plan`` before reusing a CompiledBatchPlan.
+- ``serving-rebuild`` — the resolvers producing the keys ``_plan_for``
+  compares (``resolve_plan_sharding`` / ``resolve_fusion_tier`` /
+  ``resolve_sparse_hints``) plus ``ServingConfig.__init__`` which feeds them.
+- ``plancache-digest`` — ``program_digest`` plus the same key resolvers; the
+  digest additionally hashes the lowered StableHLO text, so trace-time
+  constants are captured by construction (a blind spot this rule does not
+  rely on — see docs/static_analysis.md).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from tools.graftcheck.engine import Finding, Project, Rule, register
+
+CONFIG_REL = "flink_ml_tpu/config.py"
+
+def _option_keys(project: Project) -> Dict[str, Tuple[str, int]]:
+    """Options attr -> (literal key, declaration line) from config.py facts."""
+    facts = project.facts().get(CONFIG_REL)
+    if not facts:
+        return {}
+    return {attr: (key, line) for attr, key, line in facts["config_options"]}
+
+
+@register
+class PlanKeyCompletenessRule(Rule):
+    name = "plan-key-completeness"
+    severity = "error"
+    granularity = "project"
+    cache_version = 1
+    description = (
+        "config reads reachable from plan build must be carried by the "
+        "plancache digest, batch fingerprint and serving rebuild key"
+    )
+
+    #: Call-graph roots of plan build/compile — the surfaces the ISSUE contract
+    #: names. Reads reachable from here decide what gets compiled.
+    PLAN_BUILD_ROOTS = (
+        "flink_ml_tpu.servable.planner:build_segments",
+        "flink_ml_tpu.servable.planner:run_segment",
+        "flink_ml_tpu.servable.plancache:program_digest",
+        "flink_ml_tpu.builder.pipeline:PipelineModel._fingerprint",
+        "flink_ml_tpu.builder.pipeline:PipelineModel._batch_plan",
+        "flink_ml_tpu.builder.batch_plan:CompiledBatchPlan.build",
+        "flink_ml_tpu.serving.server:InferenceServer._plan_for",
+        "flink_ml_tpu.serving.plan:CompiledServingPlan.build",
+        "flink_ml_tpu.servable.fusion:resolve_fusion_tier",
+    )
+
+    #: Key-composition functions per rebuild-key surface: an option read inside
+    #: the transitive reach of one of these is carried by that surface's key.
+    KEY_CAPTURE_ROOTS: Dict[str, Tuple[str, ...]] = {
+        "batch-fingerprint": (
+            "flink_ml_tpu.builder.pipeline:PipelineModel._fingerprint",
+            "flink_ml_tpu.servable.sparse:resolve_sparse_hints",
+        ),
+        "serving-rebuild": (
+            "flink_ml_tpu.servable.sharding:resolve_plan_sharding",
+            "flink_ml_tpu.servable.fusion:resolve_fusion_tier",
+            "flink_ml_tpu.servable.sparse:resolve_sparse_hints",
+            "flink_ml_tpu.serving.server:ServingConfig.__init__",
+        ),
+        "plancache-digest": (
+            "flink_ml_tpu.servable.plancache:program_digest",
+            "flink_ml_tpu.servable.sharding:resolve_plan_sharding",
+            "flink_ml_tpu.servable.fusion:resolve_fusion_tier",
+            "flink_ml_tpu.servable.sparse:resolve_sparse_hints",
+        ),
+    }
+
+    #: Options asserted to be key-captured, with the surfaces that carry them.
+    #: Direction 2 verifies each claim against the call graph every run.
+    PLAN_KEY_OPTIONS: Dict[str, Tuple[str, ...]] = {
+        "BATCH_MESH": ("batch-fingerprint",),
+        "BATCH_MESH_MODEL": ("batch-fingerprint",),
+        "SERVING_MESH": ("serving-rebuild",),
+        "SERVING_MESH_MODEL": ("serving-rebuild",),
+        "FUSION_MODE": ("batch-fingerprint", "serving-rebuild", "plancache-digest"),
+        "FUSION_MEGAKERNEL": ("batch-fingerprint", "serving-rebuild", "plancache-digest"),
+        "FUSION_MEGAKERNEL_MIN_SCORE": (
+            "batch-fingerprint", "serving-rebuild", "plancache-digest",
+        ),
+        # Gates whether sparse hints exist at all; hints feed the sparse_key leg
+        # of all three surfaces, so a flip rebuilds everywhere.
+        "SPARSE_FASTPATH": (
+            "batch-fingerprint", "serving-rebuild", "plancache-digest",
+        ),
+    }
+
+    #: Options read under plan build that are genuinely plan-neutral — each entry
+    #: carries its rationale and is itself checked (a stale entry is an error).
+    PLAN_NEUTRAL: Dict[str, str] = {
+        # Where compiled executables are persisted, never which program a key
+        # maps to; the cache fails open and digests are content-addressed.
+        "PLANCACHE_ENABLED": "cache placement only; digest identity is unaffected",
+        "PLANCACHE_DIR": "cache placement only; digest identity is unaffected",
+        "PLANCACHE_MAX_BYTES": "cache eviction budget only; never plan identity",
+        # MeshContext defaults: plan paths always pass explicit axis sizes
+        # resolved from the per-tier mesh options (batch.mesh / serving.mesh),
+        # which ARE key-captured; the global axis options only seed training-side
+        # mesh contexts constructed without arguments.
+        "MESH_DATA_AXIS_SIZE": "default shadowed by key-captured per-tier mesh options",
+        "MESH_MODEL_AXIS_SIZE": "default shadowed by key-captured per-tier mesh options",
+    }
+
+    def run(self, project: Project) -> List[Finding]:
+        index = project.index
+        findings: List[Finding] = []
+        rel_of = {f["module"]: rel for rel, f in index.files.items()}
+        decls = _option_keys(project)
+        if not decls:
+            return []  # not a tree with the config registry (fixture trees)
+
+        def reads_in(roots) -> Dict[str, List[Tuple[str, int]]]:
+            out: Dict[str, List[Tuple[str, int]]] = {}
+            for node in index.reachable(list(roots), stop_marks=()):
+                ff = index.function(node)
+                if ff is None:
+                    continue
+                module = node.partition(":")[0]
+                rel = rel_of.get(module, module)
+                for attr, line in ff.get("config_reads", ()):
+                    out.setdefault(attr, []).append((rel, line))
+            return out
+
+        # Roots that vanished (renamed/deleted) would silently disable the
+        # gate — surface that loudly instead.
+        for node in self.PLAN_BUILD_ROOTS + tuple(
+            r for roots in self.KEY_CAPTURE_ROOTS.values() for r in roots
+        ):
+            if index.function(node) is None:
+                findings.append(self.finding(
+                    CONFIG_REL, 1,
+                    f"plan-key surface {node} not found in the index — "
+                    "update tools/graftcheck/rules/plan_key.py after the rename",
+                ))
+
+        plan_reads = reads_in(self.PLAN_BUILD_ROOTS)
+        captured_by: Dict[str, Set[str]] = {}
+        for surface, roots in self.KEY_CAPTURE_ROOTS.items():
+            for attr in reads_in(roots):
+                captured_by.setdefault(attr, set()).add(surface)
+
+        # 1. completeness: plan-reachable read -> captured or declared neutral
+        for attr, sites in sorted(plan_reads.items()):
+            if attr in self.PLAN_NEUTRAL or attr in self.PLAN_KEY_OPTIONS or captured_by.get(attr):
+                continue
+            key = decls.get(attr, (attr, 0))[0]
+            for rel, line in sites:
+                findings.append(self.finding(
+                    rel, line,
+                    f"option {key!r} ({attr}) is read under plan build but "
+                    "joins no rebuild key (plancache digest / batch "
+                    "fingerprint / serving rebuild); add it to the key "
+                    "composition or declare it in PLAN_NEUTRAL with a "
+                    "rationale (rules/plan_key.py)",
+                ))
+
+        # 2a. every claimed (option, surface) pair must really be captured
+        for attr, surfaces in sorted(self.PLAN_KEY_OPTIONS.items()):
+            key, line = decls.get(attr, (attr, 1))
+            for surface in surfaces:
+                if surface not in captured_by.get(attr, set()):
+                    findings.append(self.finding(
+                        CONFIG_REL, line,
+                        f"option {key!r} ({attr}) is declared plan-key for "
+                        f"{surface} but no read of it is reachable from that "
+                        "surface's key-composition functions",
+                    ))
+
+        # 2b. a neutral entry nobody reads under plan build is stale
+        for attr, why in sorted(self.PLAN_NEUTRAL.items()):
+            if attr not in plan_reads:
+                key, line = decls.get(attr, (attr, 1))
+                findings.append(self.finding(
+                    CONFIG_REL, line,
+                    f"PLAN_NEUTRAL entry {key!r} ({attr}) is no longer read "
+                    "under plan build — remove the stale allowlist entry "
+                    f"(rationale was: {why})",
+                ))
+        return findings

@@ -1,0 +1,198 @@
+"""registry-consistency: the string-keyed contract registries cannot drift.
+
+Two registries hold the system's operational contract — the config options
+(``config.py`` ``Options``) and the ``ml.*`` metric names (``metrics.py``
+``MLMetrics``) — and both are documented in tables that nothing previously
+kept honest. This rule runs the three-way diffs every time:
+
+Config options (``config.py`` vs code vs ``docs/configuration.md``):
+
+- **dead option** — declared but no ``Options.X`` reference anywhere in the
+  tree (reads inside config.py itself count: ``resolve_cache_config`` is a
+  legitimate consumer). Anchored at the declaration.
+- **undocumented option** — declared and referenced, but no row in the
+  configuration.md table. Anchored at the declaration.
+- **ghost row** — a documented key no ``ConfigOption`` declares. Anchored at
+  the doc row.
+
+Metric names (``MLMetrics`` vs code vs ``docs/observability.md``):
+
+- **dead metric** — a non-``_GROUP`` constant nothing references. ``_GROUP``
+  constants are scope prefixes, not metric names; an unreferenced one is
+  still dead weight and flagged the same way.
+- **undocumented metric** — a referenced constant with no row in the
+  observability.md metric-name registry table.
+- **ghost row** — an observability.md row naming neither a declared constant
+  nor a dynamic family (``DYNAMIC_FAMILIES`` — names built by
+  ``goodput_ms``/``fallback_reason`` style helpers, documented with
+  ``<placeholder>`` segments).
+- **unregistered literal** — an inline ``"ml.*"`` string in code (outside
+  metrics.py) that is neither a declared metric value nor a scope token
+  (``ml.<group>`` with an optional ``[qualifier]``) — new metric names must
+  enter through the MLMetrics registry, not ad hoc literals.
+
+The doc files are read from the analyzed tree's own root (fixture trees
+without them simply skip the doc legs), so the rule stays hermetic.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.graftcheck.engine import Finding, Project, Rule, register
+
+CONFIG_REL = "flink_ml_tpu/config.py"
+METRICS_REL = "flink_ml_tpu/metrics.py"
+CONFIG_DOC_REL = "docs/configuration.md"
+METRICS_DOC_REL = "docs/observability.md"
+
+#: Metric-name families produced by the MLMetrics helper methods — their
+#: doc rows use <placeholder> segments. Each entry: (helper attr, row regex).
+DYNAMIC_FAMILIES: Tuple[Tuple[str, str], ...] = (
+    ("goodput_ms", r"ml\.goodput\.<[a-z_]+>\.ms"),
+    ("fallback_reason", r"ml\.<[a-z_]+>\.fastpath\.fallback\.<[a-z_]+>"),
+)
+
+#: Inline scope tokens: a group prefix with an optional plan/bounded-style
+#: qualifier (``"ml.batch[plan]"``, ``"ml.iteration"``) — scopes, not names.
+_SCOPE_RE = re.compile(r"^ml\.[a-z_]+(\[[a-z_]+\])?$")
+
+_DOC_ROW_RE = re.compile(r"^\|\s*`(ml\.[a-z0-9_.<>]+)`")
+_CONFIG_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_.\-]+)`\s*\|")
+
+
+def _doc_rows(project: Project, rel: str, pattern: re.Pattern) -> List[Tuple[str, int]]:
+    path = os.path.join(project.repo_root, rel)
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return []
+    out: List[Tuple[str, int]] = []
+    for i, line in enumerate(lines, 1):
+        m = pattern.match(line)
+        if m:
+            out.append((m.group(1), i))
+    return out
+
+
+@register
+class RegistryConsistencyRule(Rule):
+    name = "registry-consistency"
+    severity = "error"
+    granularity = "project"
+    cache_version = 1
+    description = (
+        "config options and ml.* metric names must agree across declaration, "
+        "use, and the configuration.md/observability.md tables"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        facts = project.facts()
+        findings: List[Finding] = []
+        findings += self._config_leg(project, facts)
+        findings += self._metrics_leg(project, facts)
+        return findings
+
+    # -- config options --------------------------------------------------------
+    def _config_leg(self, project: Project, facts) -> List[Finding]:
+        cf = facts.get(CONFIG_REL)
+        if not cf or not cf["config_options"]:
+            return []
+        declared: Dict[str, Tuple[str, int]] = {
+            attr: (key, line) for attr, key, line in cf["config_options"]
+        }
+        referenced: Set[str] = set()
+        for f in facts.values():
+            for attr, _line in f.get("option_refs", ()):
+                referenced.add(attr)
+        doc = _doc_rows(project, CONFIG_DOC_REL, _CONFIG_ROW_RE)
+        doc_keys = {key for key, _ in doc}
+        have_doc = bool(doc)
+
+        out: List[Finding] = []
+        for attr, (key, line) in sorted(declared.items()):
+            if attr not in referenced:
+                out.append(self.finding(
+                    CONFIG_REL, line,
+                    f"option {key!r} ({attr}) is declared but never "
+                    "referenced — remove it or wire the consumer",
+                ))
+            elif have_doc and key not in doc_keys:
+                out.append(self.finding(
+                    CONFIG_REL, line,
+                    f"option {key!r} ({attr}) has no row in "
+                    f"{CONFIG_DOC_REL} — document it",
+                ))
+        declared_keys = {key for key, _ in declared.values()}
+        for key, line in doc:
+            if key not in declared_keys:
+                out.append(self.finding(
+                    CONFIG_DOC_REL, line,
+                    f"{CONFIG_DOC_REL} documents {key!r} but no ConfigOption "
+                    "declares that key — delete the stale row",
+                ))
+        return out
+
+    # -- metric names ----------------------------------------------------------
+    def _metrics_leg(self, project: Project, facts) -> List[Finding]:
+        mf = facts.get(METRICS_REL)
+        if not mf or not mf["metric_consts"]:
+            return []
+        declared: Dict[str, Tuple[str, int]] = {
+            attr: (value, line) for attr, value, line in mf["metric_consts"]
+        }
+        values = {value for value, _ in declared.values()}
+        referenced: Set[str] = set()
+        for f in facts.values():
+            for attr, _line in f.get("metric_refs", ()):
+                referenced.add(attr)
+        doc = _doc_rows(project, METRICS_DOC_REL, _DOC_ROW_RE)
+        doc_names = {name for name, _ in doc}
+        have_doc = bool(doc)
+        family_res = [re.compile(pat + r"$") for _, pat in DYNAMIC_FAMILIES]
+
+        out: List[Finding] = []
+        for attr, (value, line) in sorted(declared.items()):
+            if attr not in referenced:
+                out.append(self.finding(
+                    METRICS_REL, line,
+                    f"metric constant {attr} = {value!r} is never referenced "
+                    "— remove it or wire the emitter",
+                ))
+            elif (
+                have_doc
+                and not attr.endswith("_GROUP")  # scopes have no metric row
+                and value not in doc_names
+            ):
+                out.append(self.finding(
+                    METRICS_REL, line,
+                    f"metric {value!r} ({attr}) is emitted but has no row in "
+                    f"the {METRICS_DOC_REL} registry table — document it",
+                ))
+        for name, line in doc:
+            if name in values:
+                continue
+            if "<" in name and any(r.fullmatch(name) for r in family_res):
+                continue
+            out.append(self.finding(
+                METRICS_DOC_REL, line,
+                f"{METRICS_DOC_REL} documents {name!r} but no MLMetrics "
+                "constant or dynamic family produces that name — delete or "
+                "fix the row",
+            ))
+        # inline literals outside the registry module
+        for rel, f in sorted(facts.items()):
+            if rel == METRICS_REL:
+                continue
+            for value, line in f.get("metric_literals", ()):
+                if value in values or _SCOPE_RE.match(value):
+                    continue
+                out.append(self.finding(
+                    rel, line,
+                    f"inline metric literal {value!r} is not a registered "
+                    "MLMetrics name — declare it in metrics.py and use the "
+                    "constant",
+                ))
+        return out

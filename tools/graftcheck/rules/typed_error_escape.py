@@ -1,0 +1,259 @@
+"""typed-error-escape: the serving/fleet request surfaces fail typed, always.
+
+chaos_smoke and fleet_smoke prove the "untyped-error bin empty" contract on
+the paths they happen to exercise; this rule generalizes it statically to
+every path: an interprocedural exception escape analysis over the resolved
+call graph, proving each ``raise`` reachable from a request surface resolves
+to a typed ``ServingError`` subclass or a documented system exception.
+
+Mechanics (v5 facts): every raise site carries its resolved class name and
+the lexically enclosing catcher names; every call site carries the catcher
+names guarding it. Escapes propagate by fixpoint — a function's escape set is
+its own uncaught raises plus each callee's escapes that survive the call
+site's guards — with subclass-aware catching (``except ServingError`` catches
+``ServingOverloadedError``; a handler that only re-raises is transparent and
+never swallows, see index._handler_reraises). Each escaping class keeps one
+witness raise site for anchoring, so ``--changed-only`` lands on the raise
+that needs wrapping, not on the surface.
+
+Allowed escapes:
+
+- ``ServingError`` and subclasses (resolved transitively via class bases) —
+  the typed contract of docs/serving.md.
+- ``InjectedFault`` — chaos-armed test faults, counted in their own loadgen
+  bin by design.
+- ``DOCUMENTED_SYSTEM`` — argument-contract violations raised synchronously
+  at the call boundary (caller bugs, not runtime failures), documented in
+  docs/serving.md's error-contract table.
+- ``RAISE_FACTORIES`` — functions whose return value is raised and is
+  guaranteed typed (e.g. ``decode_error`` reconstructs the typed class
+  carried over the replica wire protocol).
+
+Blind spots (docs/static_analysis.md): raises stored on an object and
+re-raised across a thread rendezvous (``req.error`` → ``Request.result``) are
+invisible to the lexical call graph — the batcher wraps those typed at the
+single ``_deliver_error`` seam, and the runtime smokes cover the handoff.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftcheck.engine import Finding, Project, Rule, register
+
+#: Typed contract roots: anything whose ancestry reaches one of these names
+#: is an allowed escape.
+TYPED_BASES = {"ServingError", "InjectedFault"}
+
+#: Documented system exceptions: synchronous argument-contract violations —
+#: see the error-contract table in docs/serving.md.
+DOCUMENTED_SYSTEM = {"ValueError", "TypeError", "IndexError"}
+
+#: Functions whose *return value* is raised and guaranteed typed.
+RAISE_FACTORIES = {"decode_error"}
+
+#: Builtin exception hierarchy (the slice this tree raises/catches).
+_BUILTIN_BASES: Dict[str, str] = {
+    "ServingDeadlineError": "TimeoutError",  # also ServingError via class_table
+    "TimeoutError": "OSError",
+    "FileNotFoundError": "OSError",
+    "FileExistsError": "OSError",
+    "ConnectionError": "OSError",
+    "BrokenPipeError": "ConnectionError",
+    "ConnectionResetError": "ConnectionError",
+    "ConnectionRefusedError": "ConnectionError",
+    "OSError": "Exception",
+    "IOError": "OSError",
+    "IndexError": "LookupError",
+    "KeyError": "LookupError",
+    "LookupError": "Exception",
+    "ZeroDivisionError": "ArithmeticError",
+    "OverflowError": "ArithmeticError",
+    "ArithmeticError": "Exception",
+    "NotImplementedError": "RuntimeError",
+    "RecursionError": "RuntimeError",
+    "RuntimeError": "Exception",
+    "ValueError": "Exception",
+    "TypeError": "Exception",
+    "AttributeError": "Exception",
+    "ImportError": "Exception",
+    "StopIteration": "Exception",
+    "AssertionError": "Exception",
+    "Exception": "BaseException",
+    "SystemExit": "BaseException",
+    "KeyboardInterrupt": "BaseException",
+}
+
+_CATCH_ALL = {"*", "BaseException", "Exception"}
+
+
+def _ancestors(index, name: str) -> Set[str]:
+    """All ancestor class names of ``name`` (project classes + builtins)."""
+    out: Set[str] = set()
+    work = [name]
+    while work:
+        cur = work.pop()
+        if cur in out:
+            continue
+        out.add(cur)
+        hit = index.resolve_class(cur)
+        if hit is not None:
+            work.extend(hit[1].get("bases", ()))
+        if cur in _BUILTIN_BASES:
+            work.append(_BUILTIN_BASES[cur])
+    return out
+
+
+def _caught(index, cls: Optional[str], guards) -> bool:
+    """Would a raise of ``cls`` be swallowed by these lexical catchers?
+    Unknown classes are only caught by catch-alls (err toward reporting)."""
+    if not guards:
+        return False
+    gset = set(guards)
+    if gset & _CATCH_ALL:
+        return True
+    if cls is None:
+        return False
+    return bool(_ancestors(index, cls) & gset)
+
+
+@register
+class TypedErrorEscapeRule(Rule):
+    name = "typed-error-escape"
+    severity = "error"
+    granularity = "project"
+    cache_version = 1
+    description = (
+        "every raise reachable from the serving/fleet request surfaces must "
+        "resolve to a typed ServingError subclass or a documented exception"
+    )
+
+    #: Client-facing request surfaces: submit/predict entries, the result
+    #: rendezvous objects, the fleet router and retrieval client.
+    REQUEST_SURFACES = (
+        "flink_ml_tpu.serving.server:InferenceServer.submit",
+        "flink_ml_tpu.serving.server:InferenceServer.predict",
+        "flink_ml_tpu.serving.batcher:MicroBatcher.submit",
+        "flink_ml_tpu.serving.batcher:PendingRequest.result",
+        "flink_ml_tpu.fleet.router:FleetRouter.submit",
+        "flink_ml_tpu.fleet.router:FleetRouter.predict",
+        "flink_ml_tpu.fleet.router:_FleetHandle.result",
+        "flink_ml_tpu.fleet.router:_FailedPending.result",
+        "flink_ml_tpu.retrieval.client:RetrievalClient.query",
+    )
+
+    #: Background thread entries: an untyped raise escaping one of these kills
+    #: the loop thread instead of failing one request — same contract, worse
+    #: blast radius. Deliberately NOT every hot-root-marked function: dispatch
+    #: seams like CompiledServingPlan.dispatch raise typed control-flow
+    #: exceptions (IneligibleBatch) their direct caller handles; only the
+    #: outermost thread targets belong here.
+    BACKGROUND_SURFACES = (
+        "flink_ml_tpu.serving.batcher:MicroBatcher._loop",
+    )
+
+    #: Raise sites allowlisted by (witness file, class): statically-verified
+    #: invariant violations that cannot fire on a clean tree. Each entry carries
+    #: the proof obligation that replaces wrapping.
+    SITE_ALLOWLIST: Dict[Tuple[str, str], str] = {
+        # trip()/arm() on an unregistered fault-point name. Dead by
+        # construction: the fault-points rule (error severity, tier-1 gated)
+        # proves every trip/arm site names a registered point, and the tests
+        # pin LookupError as the registry's misuse contract.
+        ("flink_ml_tpu/faults.py", "LookupError"):
+            "fault-point registry misuse, statically proven unreachable",
+    }
+
+    #: Thread-rendezvous seams: functions that re-raise an error object carried
+    #: across the batcher/router thread boundary (``raise self.error``). The
+    #: lexical call graph cannot see what was stored, so their *dynamic* raises
+    #: are excused here — the runtime guarantee lives at the single fill seams
+    #: (``MicroBatcher._deliver_error`` wraps non-typed errors in
+    #: ``ServingExecutionError``; ``_FailedPending`` is filled only from an
+    #: ``except ServingError`` handler) and is regression-tested in
+    #: tests/test_serving_errors.py.
+    RENDEZVOUS_SEAMS = {
+        "flink_ml_tpu.serving.batcher:PendingRequest.result",
+        "flink_ml_tpu.fleet.router:_FailedPending.result",
+    }
+
+    def run(self, project: Project) -> List[Finding]:
+        index = project.index
+        roots = [
+            r for r in self.REQUEST_SURFACES + self.BACKGROUND_SURFACES
+            if index.function(r) is not None
+        ]
+        if not roots:
+            return []  # fixture tree without serving surfaces
+
+        # escapes[node]: class name (or witness key for unresolved raises)
+        #   -> (witness rel, line, display name)
+        escapes: Dict[str, Dict[str, Tuple[str, int, str]]] = {}
+        guarded_edges: Dict[str, List[Tuple[str, List[str]]]] = {}
+        for rel in sorted(index.files):
+            f = index.files[rel]
+            module = f["module"]
+            for qual, ff in f["functions"].items():
+                node = f"{module}:{qual}"
+                mine: Dict[str, Tuple[str, int, str]] = {}
+                for cls, line, guards, detail in ff.get("raises", ()):
+                    if _caught(index, cls, guards):
+                        continue
+                    if node in self.RENDEZVOUS_SEAMS and cls is None:
+                        continue  # thread-rendezvous re-raise, see above
+                    if cls is None:
+                        shown = detail or "dynamic raise"
+                        mine.setdefault(f"?{rel}:{line}", (rel, line, shown))
+                    else:
+                        mine.setdefault(cls, (rel, line, cls))
+                if mine:
+                    escapes[node] = mine
+                edges: List[Tuple[str, List[str]]] = []
+                for ref, line, _held, guards in ff.get("calls", ()):
+                    tgt = index.resolve_ref(module, ff["cls"], qual, ref)
+                    if tgt is not None:
+                        edges.append((tgt, guards))
+                if edges:
+                    guarded_edges[node] = edges
+
+        changed = True
+        while changed:
+            changed = False
+            for node, edges in guarded_edges.items():
+                mine = escapes.setdefault(node, {})
+                for tgt, guards in edges:
+                    for key, witness in escapes.get(tgt, {}).items():
+                        if key in mine:
+                            continue
+                        cls = None if key.startswith("?") else key
+                        if _caught(index, cls, guards):
+                            continue
+                        mine[key] = witness
+                        changed = True
+
+        findings: List[Finding] = []
+        reported: Dict[Tuple[str, int, str], Set[str]] = {}
+        for root in roots:
+            for key, (rel, line, shown) in escapes.get(root, {}).items():
+                cls = None if key.startswith("?") else key
+                if cls is not None:
+                    if cls in RAISE_FACTORIES:
+                        continue
+                    if (rel, cls) in self.SITE_ALLOWLIST:
+                        continue
+                    anc = _ancestors(index, cls)
+                    if anc & TYPED_BASES:
+                        continue
+                    # a documented ancestor covers subclasses (OffLadderError
+                    # is a ValueError: same argument-contract bucket)
+                    if anc & DOCUMENTED_SYSTEM:
+                        continue
+                reported.setdefault((rel, line, shown), set()).add(root)
+        for (rel, line, shown), surfaces in sorted(reported.items()):
+            names = ", ".join(sorted(s.split(":")[-1] for s in surfaces))
+            findings.append(self.finding(
+                rel, line,
+                f"raise of {shown} can escape untyped to request surface(s) "
+                f"{names}; wrap it in a ServingError subclass or catch it on "
+                "the way out (typed-error contract, docs/serving.md)",
+            ))
+        return findings

@@ -210,7 +210,7 @@ def lock_context(index: ProjectIndex, lock_id) -> Dict[str, Set[str]]:
         module = f["module"]
         for qual, ff in f["functions"].items():
             caller = f"{module}:{qual}"
-            for ref, _line, held in ff["calls"]:
+            for ref, _line, held, _guards in ff["calls"]:
                 callee = index.resolve_ref(module, ff["cls"], qual, ref)
                 if callee is None:
                     continue
