@@ -63,6 +63,10 @@ from flink_ml_tpu.config import Options, config
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.servable.fusion import plan_recorder, resolve_fusion_tier
 from flink_ml_tpu.servable.plancache import resolve_plan_cache
+from flink_ml_tpu.servable.precision import (
+    PRECISION_GAUGE_VALUE,
+    resolve_precision_tier,
+)
 from flink_ml_tpu.servable.planner import (
     FallbackStage,
     FusedSegment,
@@ -136,12 +140,16 @@ class CompiledBatchPlan:
         scope: str,
         sharding: Optional[Any] = None,
         fusion: Optional[Any] = None,
+        precision: Optional[Any] = None,
     ):
         self._stages = list(stages)
         self.segments = segments
         self.scope = scope
         self.sharding = sharding
         self.fusion = fusion if fusion is not None else resolve_fusion_tier()
+        #: The precision tier the segments carry their rounding under — part
+        #: of the pipeline fingerprint's rebuild key (docs/precision.md).
+        self.precision = precision if precision is not None else resolve_precision_tier()
         # Persistent compiled-plan cache (docs/plancache.md): chain programs
         # for chunk signatures a previous plan (or a previous process) ever
         # compiled load their serialized executables instead of compiling.
@@ -152,6 +160,11 @@ class CompiledBatchPlan:
         metrics.gauge(scope, MLMetrics.BATCH_FUSED_STAGES, n_fused)
         metrics.gauge(scope, MLMetrics.BATCH_FALLBACK_STAGES, n_fallback)
         metrics.gauge(scope, MLMetrics.FUSION_MODE, 1 if self.fusion.fast else 0)
+        metrics.gauge(
+            scope,
+            MLMetrics.PRECISION_MODE,
+            PRECISION_GAUGE_VALUE[self.precision.mode],
+        )
         if sharding is not None:
             metrics.gauge(scope, MLMetrics.BATCH_SHARD_COUNT, sharding.n_data)
 
@@ -164,6 +177,7 @@ class CompiledBatchPlan:
         sharding: Optional[Any] = None,
         fusion: Optional[Any] = None,
         sparse: Optional[Dict[str, int]] = None,
+        precision: Optional[Any] = None,
     ) -> Optional["CompiledBatchPlan"]:
         """Group consecutive kernel-spec stages into fused segments and
         commit their model arrays to the device (the once-per-plan upload —
@@ -183,10 +197,12 @@ class CompiledBatchPlan:
             )
         if fusion is None:
             fusion = resolve_fusion_tier()
-        segments = build_segments(stages, sharding, fusion, sparse)
+        if precision is None:
+            precision = resolve_precision_tier()
+        segments = build_segments(stages, sharding, fusion, sparse, precision)
         if not any(isinstance(s, FusedSegment) for s in segments):
             return None
-        plan = CompiledBatchPlan(stages, segments, scope, sharding, fusion)
+        plan = CompiledBatchPlan(stages, segments, scope, sharding, fusion, precision)
         metrics.gauge(
             scope, MLMetrics.BATCH_PLAN_BUILD_MS, (time.perf_counter() - t0) * 1000.0
         )

@@ -133,10 +133,14 @@ class PipelineModel(Model):
             config.get(Options.FUSION_MEGAKERNEL),
             config.get(Options.FUSION_MEGAKERNEL_MIN_SCORE),
         )
+        # The precision tier the programs carry their rounding under: a
+        # precision.mode flip must rebuild, not silently keep the old tier's
+        # numerics contract (docs/precision.md — the fusion.mode discipline).
+        precision_key = (config.get(Options.PRECISION_MODE),)
         sparse_key = (
             None if sparse_hints is None else tuple(sorted(sparse_hints.items()))
         )
-        return (mesh_key, fusion_key, sparse_key) + tuple(
+        return (mesh_key, fusion_key, precision_key, sparse_key) + tuple(
             (id(stage), json.dumps(stage.param_map_to_json(), sort_keys=True, default=str))
             for stage in self.stages
         )

@@ -330,6 +330,30 @@ class Options:
         "(docs/fusion.md has the model). Below the bar, fast mode uses the "
         "merged XLA program.",
     )
+    PRECISION_MODE = ConfigOption(
+        "precision.mode",
+        str,
+        "f32",
+        "Precision tier of the compiled plans (docs/precision.md). 'f32' "
+        "(default) = every transport and accumulation in float32, "
+        "bit-identical to pre-precision behavior. 'bf16' = bfloat16 "
+        "transport with float32 accumulation: inputs round to the bf16 grid "
+        "at ingest and at every stage boundary, reductions stay f32; results "
+        "carry the documented per-chain within-tier ulp envelope "
+        "(servable/precision.py). 'int8' = bf16 transport plus post-training "
+        "int8 weight quantization applied at publish_servable time only — "
+        "the quantized artifact is just another published version.",
+    )
+    PRECISION_FALLBACK_AUTO = ConfigOption(
+        "precision.fallback.auto",
+        _parse_bool,
+        True,
+        "Whether a drift-regressed verdict on a low-precision serving tier "
+        "automatically falls back to the warm f32 plan of the SAME version "
+        "(a fallback, not a rollback: the model version does not change; "
+        "docs/precision.md). Off = drift regressions follow the normal "
+        "rollback path regardless of tier.",
+    )
     SPARSE_FASTPATH = ConfigOption(
         "sparse.fastpath",
         _parse_bool,

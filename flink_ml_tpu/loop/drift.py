@@ -121,6 +121,15 @@ class DriftMonitor:
     def count(self, version: int) -> int:
         return len(self._windows.get(version, ()))
 
+    def reset(self, version: int) -> None:
+        """Drop ``version``'s rolling window — called after a remediation
+        that changes what the version's scores MEAN (the precision fallback:
+        post-fallback traffic is f32-served, so mixing pre-fallback
+        low-precision scores into the same window would double-trigger on
+        stale evidence). The next verdict waits for ``min_scores`` fresh
+        observations, exactly like a new version."""
+        self._windows.pop(version, None)
+
     def mean(self, version: int) -> Optional[float]:
         window = self._windows.get(version)
         if not window:

@@ -36,6 +36,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 import flink_ml_tpu.telemetry as telemetry
+from flink_ml_tpu.config import Options, config
 from flink_ml_tpu.faults import faults
 from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.loop.drift import DriftMonitor, logloss
@@ -237,6 +238,23 @@ class ContinuousLearningLoop:
                 },
             )
         if not regressed:
+            return None
+        # Precision-first remediation (docs/precision.md): a regression on a
+        # low-precision serving tier may be the tier's numerics, not the
+        # model — so the first response is the cheap, reversible one: fall
+        # back to the warm f32 plan of the SAME version (a plan selection,
+        # zero compiles), not a version rollback. The live version's score
+        # window resets so the NEXT verdict judges f32-served traffic only;
+        # if the regression persists on f32, that verdict takes the normal
+        # rollback path below (the fallback is already active and idempotent,
+        # so this branch cannot loop).
+        if (
+            config.get(Options.PRECISION_FALLBACK_AUTO)
+            and getattr(self.server, "precision_fallback", None) is not None
+            and not getattr(self.server, "precision_fallback_active", False)
+            and self.server.precision_fallback("drift")
+        ):
+            self.monitor.reset(live)
             return None
         t0 = self.clock()
         with tracer.span("loop.rollback", CAT_RECOVERY, scope=self.scope) as sp:

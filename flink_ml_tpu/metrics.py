@@ -162,6 +162,13 @@ class MLMetrics:
     FUSION_PLAN_CHOICE = "ml.fusion.plan.choice"  # most aggressive tier last compiled: 0 exact / 1 fused / 2 megakernel, gauge
     FUSION_PLAN_SCORE = "ml.fusion.plan.score"  # cost-model score of the last compiled chain, gauge
 
+    # Precision tier of the compiled plans (precision.mode — docs/precision.md).
+    # Published under the owning plan's scope, like the fusion metrics.
+    PRECISION_MODE = "ml.precision.mode"  # 0 = f32, 1 = bf16, 2 = int8 (the plan's tier), gauge
+    PRECISION_FALLBACKS = "ml.precision.fallbacks"  # drift-triggered falls back to the warm f32 plan, counter
+    PRECISION_FALLBACK_ACTIVE = "ml.precision.fallback.active"  # 1 while serving the f32 fallback plan, gauge
+    PRECISION_QUANTIZED_ARRAYS = "ml.precision.quantized.arrays"  # weight arrays int8-quantized at publish, counter
+
     # Mesh-sharded batch transform (batch.mesh > 1 — docs/batch_transform.md).
     BATCH_SHARD_COUNT = "ml.batch.shard.count"  # data-axis width of the plan's mesh, gauge
     BATCH_SHARD_ROWS = "ml.batch.shard.rows"  # per-shard rows through sharded chunks, counter

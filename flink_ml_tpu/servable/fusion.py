@@ -138,16 +138,26 @@ def spec_flops_per_row(spec: Any, nnz_cap: int = 0) -> float:
     return total
 
 
-def chain_score(specs: Sequence[Any], rows: int, width: int = 0, nnz_cap: int = 0) -> float:
+def chain_score(
+    specs: Sequence[Any],
+    rows: int,
+    width: int = 0,
+    nnz_cap: int = 0,
+    precision: Optional[Any] = None,
+) -> float:
     """Hotness of compiling ``specs`` as one chain at ``rows``: arithmetic
     intensity per row × rows. ``width`` (the widest dense ingest column at
     compile time) adds the elementwise traffic model-array sizes cannot see —
-    4 FLOPs/element/stage covers the load/op/store of a merged stage;
-    ``nnz_cap`` (the ELL ladder cap of a sparse chain's columns) feeds the
-    sparse specs' per-entry term. Monotone in ``rows``, ``width``,
-    ``nnz_cap`` and every model-array size (the shape-monotonicity tests pin
-    this)."""
-    per_row = sum(spec_flops_per_row(s, nnz_cap) for s in specs) + 4.0 * width * len(specs)
+    the per-element/stage constant covers the load/op/store of a merged
+    stage and is the **bytes-moved** precision term: 4 for f32, 2 for bf16,
+    1 for int8 (``PrecisionTier.bytes_per_value``; ``precision=None`` keeps
+    the historical f32 constant, so f32 scores — and therefore f32 plan
+    choices — never move). ``nnz_cap`` (the ELL ladder cap of a sparse
+    chain's columns) feeds the sparse specs' per-entry term. Monotone in
+    ``rows``, ``width``, ``nnz_cap`` and every model-array size (the
+    shape-monotonicity tests pin this)."""
+    traffic = 4.0 if precision is None else float(precision.bytes_per_value)
+    per_row = sum(spec_flops_per_row(s, nnz_cap) for s in specs) + traffic * width * len(specs)
     return rows * per_row  # per_row is a host float: plain int × float math
 
 
@@ -180,14 +190,21 @@ class FusionTier:
         return (self.mode, self.megakernel, self.min_score)
 
     def megakernel_hot(
-        self, specs: Sequence[Any], rows: int, width: int = 0, nnz_cap: int = 0
+        self,
+        specs: Sequence[Any],
+        rows: int,
+        width: int = 0,
+        nnz_cap: int = 0,
+        precision: Optional[Any] = None,
     ) -> bool:
         """Whether the cost model marks this chain hot enough for the Pallas
         megakernel lowering at ``rows`` (fast mode only; the planner also
-        requires every spec to carry a megakernel-safe ``fusion_op``)."""
+        requires every spec to carry a megakernel-safe ``fusion_op``).
+        ``precision`` feeds the bytes-moved traffic term of the score — a
+        low-precision chain moves fewer bytes and clears the bar later."""
         if not (self.fast and self.megakernel):
             return False
-        return chain_score(specs, rows, width, nnz_cap) >= self.min_score
+        return chain_score(specs, rows, width, nnz_cap, precision=precision) >= self.min_score
 
     def __repr__(self) -> str:
         return (

@@ -79,6 +79,16 @@ data at construction, which is exactly the hot-swap discipline — a published
 version is immutable, so the plan compiled from its specs stays valid for the
 version's whole serving life. The batch tier re-snapshots when a pipeline's
 params or model data change (``builder/batch_plan.py``).
+
+Kernel bodies are **precision-neutral**: ``kernel_fn`` always computes —
+and above all *accumulates* — in float32, whatever ``precision.mode`` says.
+The low-precision tiers (``servable/precision.py``) live entirely OUTSIDE
+the body: the planner rounds program inputs and stage outputs to the bf16
+grid at the boundaries, and int8 weight quantization happens at publish
+time before the spec ever snapshots the arrays. A body that downcast its
+own accumulator (``.astype(bfloat16)`` mid-reduction) would silently change
+numerics in BOTH partitions and void the elementwise/merge claims — the
+graftcheck cast rule flags any low-precision cast inside ``ops/kernels.py``.
 """
 from __future__ import annotations
 

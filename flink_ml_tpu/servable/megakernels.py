@@ -24,6 +24,16 @@ the same ``pallas_call`` machinery, grid walk and body trace tier-1 exercises,
 executed by the interpreter instead of Mosaic. Interpreted numerics are the
 fused-XLA numerics of the tile body, inside the same documented ulp envelope
 (``servable/fusion.py``).
+
+Precision: megakernels are **f32-only**. The low-precision tiers
+(``precision.mode=bf16|int8``, ``servable/precision.py``) apply their bf16
+transport rounding at program ingest and at every stage boundary — a seam
+the raw Pallas body, which composes the ``*_fn`` math directly in VMEM with
+no materialized stage boundaries, simply does not have. Rather than grow an
+in-kernel rounding variant (which the graftcheck cast rule would flag as an
+accumulator downcast), the planner builds NO megakernel candidates for a
+low-precision segment: its fast-tier chains stay merged-XLA programs, which
+carry the rounding in-graph.
 """
 from __future__ import annotations
 

@@ -121,6 +121,7 @@ def program_digest(
     fusion_key: Optional[Tuple] = None,
     replicated: bool = False,
     sparse_key: Optional[int] = None,
+    precision_key: Optional[str] = None,
 ) -> str:
     """Content fingerprint of one chain program: the lowered StableHLO text
     (spec-chain params as traced constants, model-array shapes/dtypes as
@@ -128,12 +129,21 @@ def program_digest(
     the mesh shape + TP split, the fusion tier + program kind, the sparse
     nnz-cap ladder key (the ELL cap already shapes the lowered text — the
     explicit component keeps two caps distinct even for a program whose
-    lowering happens not to read the padding), and the jax/jaxlib/backend
-    versions. Deterministic across processes — the cross-incarnation cache
-    identity (docs/plancache.md)."""
+    lowering happens not to read the padding), the precision tier
+    (``PrecisionTier.cache_key`` — the bf16-rounded lowering already differs
+    textually, but the explicit component is the rebuild-key contract the
+    plan-key-completeness rule enforces; ``None`` ≡ f32 keeps every
+    pre-precision digest valid), and the jax/jaxlib/backend versions.
+    Deterministic across processes — the cross-incarnation cache identity
+    (docs/plancache.md)."""
     h = sha256()
     h.update(json.dumps(_env_fingerprint(), sort_keys=True).encode())
-    h.update(repr((kind, sharding_key, fusion_key, bool(replicated), sparse_key)).encode())
+    parts = (kind, sharding_key, fusion_key, bool(replicated), sparse_key)
+    if precision_key is not None:
+        # Appended only when a low-precision tier is in play, so every digest
+        # minted before the precision axis existed stays byte-identical.
+        parts = parts + (precision_key,)
+    h.update(repr(parts).encode())
     h.update(lowered.as_text().encode())
     return h.hexdigest()
 

@@ -122,7 +122,11 @@ class _Program:
     __slots__ = ("specs", "models", "inputs", "jitted", "kind")
 
     def __init__(
-        self, specs: Sequence[Any], models: Sequence[Dict[str, Any]], kind: str = PLAN_EXACT
+        self,
+        specs: Sequence[Any],
+        models: Sequence[Dict[str, Any]],
+        kind: str = PLAN_EXACT,
+        precision: Optional[Any] = None,
     ):
         self.specs = tuple(specs)
         self.models = tuple(models)
@@ -137,14 +141,39 @@ class _Program:
             produced.update(spec.program_outputs)
         self.inputs: Tuple[str, ...] = tuple(needed)
 
-        def program_fn(models, cols):
-            cols = dict(cols)
-            outs: Dict[str, Any] = {}
-            for spec, model in zip(self.specs, models):
-                stage_out = spec.kernel_fn(model, cols)
-                cols.update(stage_out)
-                outs.update(stage_out)
-            return outs
+        # Low-precision transport (servable/precision.py): round every float
+        # value to the bf16 grid at program ENTRY and at every stage EXIT,
+        # keeping the kernel bodies — and every reduction inside them —
+        # untouched f32 (bf16 transport, f32 accumulation). bf16_round is
+        # idempotent, so a boundary the fused partition elides and the
+        # per-stage partition materializes sees identical bits — the
+        # within-tier fused-vs-per-stage parity contract. f32 tier
+        # (precision None or mode f32): no rounding anywhere, bit-identical
+        # to the pre-precision planner.
+        lowp = precision is not None and precision.lowp
+        if lowp:
+            from flink_ml_tpu.servable.precision import bf16_round
+
+            def program_fn(models, cols):
+                cols = {n: bf16_round(v) for n, v in cols.items()}
+                outs: Dict[str, Any] = {}
+                for spec, model in zip(self.specs, models):
+                    stage_out = spec.kernel_fn(model, cols)
+                    stage_out = {n: bf16_round(v) for n, v in stage_out.items()}
+                    cols.update(stage_out)
+                    outs.update(stage_out)
+                return outs
+
+        else:
+
+            def program_fn(models, cols):
+                cols = dict(cols)
+                outs: Dict[str, Any] = {}
+                for spec, model in zip(self.specs, models):
+                    stage_out = spec.kernel_fn(model, cols)
+                    cols.update(stage_out)
+                    outs.update(stage_out)
+                return outs
 
         self.jitted = jax.jit(program_fn)
 
@@ -235,8 +264,8 @@ class FusedSegment:
 
     __slots__ = (
         "stages", "specs", "external_inputs", "device_models", "programs",
-        "compiled", "signatures", "sharding", "fusion", "mega", "plan_kinds",
-        "sparse_outputs", "has_sparse_inputs", "has_shape_inputs",
+        "compiled", "signatures", "sharding", "fusion", "precision", "mega",
+        "plan_kinds", "sparse_outputs", "has_sparse_inputs", "has_shape_inputs",
     )
 
     def __init__(
@@ -244,11 +273,13 @@ class FusedSegment:
         staged: Sequence[Tuple[Any, Any]],
         sharding: Optional[Any] = None,
         fusion: Optional[Any] = None,
+        precision: Optional[Any] = None,
     ):
         self.stages = [stage for stage, _ in staged]
         self.specs = [spec for _, spec in staged]
         self.sharding = sharding
         self.fusion = fusion  # resolved FusionTier, or None ≡ exact
+        self.precision = precision  # resolved PrecisionTier, or None ≡ f32
         produced: set = set()
         external: List[str] = []
         for spec in self.specs:
@@ -282,17 +313,21 @@ class FusedSegment:
         # One upload per model array, at construction — the committed buffers
         # the hot path closes over. On a mesh this is the per-shard weight
         # placement (replicated or TP-split), paid at build/warmup time —
-        # for serving, at swap time before the version flip.
-        if sharding is not None:
-            self.device_models = tuple(
-                {k: sharding.put_model(v) for k, v in spec.model_arrays.items()}
-                for spec in self.specs
-            )
-        else:
-            self.device_models = tuple(
-                {k: jax.device_put(v) for k, v in spec.model_arrays.items()}
-                for spec in self.specs
-            )
+        # for serving, at swap time before the version flip. A low-precision
+        # tier rounds the committed float buffers to the bf16 grid HERE, once
+        # (the model-side half of the transport contract) — never per call.
+        lowp = precision is not None and precision.lowp
+        if lowp:
+            from flink_ml_tpu.servable.precision import bf16_round
+
+        def _commit(v):
+            arr = sharding.put_model(v) if sharding is not None else jax.device_put(v)
+            return bf16_round(arr) if lowp else arr
+
+        self.device_models = tuple(
+            {k: _commit(v) for k, v in spec.model_arrays.items()}
+            for spec in self.specs
+        )
         # Program partition (see module docstring): the exact tier merges
         # only consecutive elementwise specs, so no accumulation can cross a
         # per-stage-path boundary; the fast tier merges maximal fusable runs
@@ -305,12 +340,16 @@ class FusedSegment:
             runs = _partition_exact(self.specs)
             kind = PLAN_EXACT
         self.programs: List[_Program] = [
-            _Program(self.specs[i:j], self.device_models[i:j], kind)
+            _Program(self.specs[i:j], self.device_models[i:j], kind, precision)
             for i, j in runs
         ]
-        #: fast tier only: program index -> megakernel candidate
+        #: fast tier only: program index -> megakernel candidate. A
+        #: low-precision segment builds NONE: megakernels compose raw f32
+        #: kernel bodies with no stage-boundary hook, so they cannot honor
+        #: the bf16 transport contract — lowp fast-tier runs keep the merged
+        #: XLA programs (which carry the rounding in-graph).
         self.mega: Dict[int, _MegaProgram] = {}
-        if fusion is not None and fusion.fast and fusion.megakernel:
+        if fusion is not None and fusion.fast and fusion.megakernel and not lowp:
             self.mega = _fast_megakernels(self.programs, sharding)
         #: key -> [(program-or-megakernel, jax.stages.Compiled), ...] in order
         self.compiled: Dict[Hashable, List[Tuple[Any, Any]]] = {}
@@ -506,6 +545,7 @@ def build_segments(
     sharding: Optional[Any] = None,
     fusion: Optional[Any] = None,
     sparse: Optional[Dict[str, int]] = None,
+    precision: Optional[Any] = None,
 ) -> List[Any]:
     """Group consecutive kernel-spec stages into :class:`FusedSegment` runs,
     everything else into :class:`FallbackStage`. Raises whatever
@@ -528,7 +568,13 @@ def build_segments(
     CountVectorizer) returns a sparse-convention spec, and its
     ``sparse_outputs`` join the known set for downstream stages. Stages
     without the hook (or returning None) fall back to their dense
-    ``kernel_spec()``, exactly as before."""
+    ``kernel_spec()``, exactly as before.
+
+    With a low-precision ``precision``
+    (:class:`~flink_ml_tpu.servable.precision.PrecisionTier`), fused
+    segments commit bf16-rounded model buffers and their programs carry the
+    bf16 transport rounding in-graph; ``None`` is the f32 tier,
+    bit-identical to the pre-precision planner."""
     segments: List[Any] = []
     run: List[Tuple[Any, Any]] = []
     known: Dict[str, int] = dict(sparse or {})
@@ -546,7 +592,7 @@ def build_segments(
                     known.pop(name, None)  # densely overwritten column
         else:
             if run:
-                segments.append(FusedSegment(run, sharding, fusion))
+                segments.append(FusedSegment(run, sharding, fusion, precision))
                 run = []
             segments.append(FallbackStage(stage))
             # A fallback stage's outputs are opaque — any column it may
@@ -556,7 +602,7 @@ def build_segments(
             # that densifies a hinted column surfaces as a per-batch
             # signature fallback, never a wrong result.)
     if run:
-        segments.append(FusedSegment(run, sharding, fusion))
+        segments.append(FusedSegment(run, sharding, fusion, precision))
     return segments
 
 
@@ -594,6 +640,9 @@ def _load_or_compile(  # graftcheck: cold
         fusion_key=segment.fusion.key if segment.fusion is not None else None,
         replicated=replicated,
         sparse_key=sparse_key,
+        precision_key=(
+            segment.precision.cache_key if segment.precision is not None else None
+        ),
     )
     t0 = time.perf_counter()
     compiled = cache.load(digest)
@@ -692,7 +741,7 @@ def run_segment(
             prog = xla_prog
             mega = segment.mega.get(idx)
             if mega is not None and segment.fusion.megakernel_hot(
-                prog.specs, rows, width, nnz_cap
+                prog.specs, rows, width, nnz_cap, precision=segment.precision
             ):
                 prog = mega
             stage_inputs = {n: cols[n] for n in prog.inputs}
@@ -718,7 +767,13 @@ def run_segment(
                     sparse_key=nnz_cap or None,
                 )
             if on_plan is not None:
-                on_plan(prog.kind, chain_score(prog.specs, rows, width, nnz_cap))
+                on_plan(
+                    prog.kind,
+                    chain_score(
+                        prog.specs, rows, width, nnz_cap,
+                        precision=segment.precision,
+                    ),
+                )
             kinds.append(prog.kind)
             chain.append((prog, compiled))
             cols.update(compiled(prog.models, stage_inputs))

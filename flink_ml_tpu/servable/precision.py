@@ -1,0 +1,406 @@
+"""PrecisionTier — the numeric-precision policy of the compiled plans.
+
+PR 10's :class:`~flink_ml_tpu.servable.fusion.FusionTier` relaxed the *program
+partition* (how many XLA programs a chain compiles into) under a documented
+ulp envelope. This module relaxes the *arithmetic width* the same way — one
+resolved, immutable policy object riding the exact same plan surface:
+
+- ``f32`` (default) — today's behavior, unchanged and bit-identical: every
+  transport and every accumulation in float32. ``PrecisionTier("f32")`` is
+  plan-key-neutral (``cache_key`` is ``None``) so existing plan-cache entries
+  stay valid.
+- ``bf16`` — bfloat16 *transport* with float32 *accumulation* (the
+  Gemma-on-TPU serving recipe, PAPERS.md): program inputs are rounded to the
+  bf16 grid at ingest, every stage output is rounded at the stage boundary,
+  but the kernel bodies — including every reduction — run in f32 exactly as
+  before. Because :func:`bf16_round` is **idempotent** (a value already on
+  the bf16 grid rounds to itself), the fused and per-stage partitions of the
+  same chain see bit-identical stage inputs, so PR 10's within-tier
+  fused-vs-per-stage contract carries over to the bf16 tier with the
+  envelopes in :data:`PRECISION_ULP_ENVELOPE`.
+- ``int8`` — post-training weight quantization for the wide model heads
+  (logistic ``coefficient``, MLP ``W*`` weights) and the sparse ELL
+  ``*values`` arrays, applied ONLY at :func:`publish time
+  <quantize_published_artifact>`: the quantized artifact is just another
+  published version, so poll/warm/swap/rollback/canary are unchanged and the
+  serving path never quantizes anything (the poisoned-seam test pins this).
+  Activations — including dynamic external ``!values`` request tensors —
+  ride the bf16 transport contract unchanged. Nothing fake-quantizes
+  in-graph: :func:`fake_quant_int8` is an exported calibration/test utility
+  only, because quantize→dequantize is not bit-idempotent and re-applying it
+  at a boundary one partition elides would break the within-tier
+  fused-vs-per-stage parity the whole tier contract hangs on.
+
+The cost model prices the tier by **bytes moved, not FLOPs**:
+``bytes_per_value`` replaces the f32 constant in
+:func:`~flink_ml_tpu.servable.fusion.chain_score`'s elementwise-traffic term
+(4.0 → 2.0 → 1.0), so f32 scores are *exactly* unchanged and low-precision
+chains clear the megakernel bar later — correctly, since they move half the
+bytes per element.
+
+Like the fusion tier, this module is the one place the plan surface reads the
+``precision.*`` config — the planner takes a resolved :class:`PrecisionTier`.
+The tier is part of every plan identity: the plancache digest
+(``plancache.program_digest(precision_key=...)``), the batch fingerprint
+(``builder/pipeline.py``), and the serving rebuild check
+(``serving/server.py``) all carry ``PrecisionTier.key`` — the PR 9/10 rebuild
+bug class graftcheck's plan-key-completeness rule exists to catch.
+
+Live quality backstop: ``DriftMonitor`` watches the served tier and on a
+regressed verdict the loop *falls back* (not rolls back) to the f32 plan of
+the SAME version, which the server kept warm (``serving/server.py``); see
+docs/precision.md for the full fallback semantics.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.config import Options, config
+
+__all__ = [
+    "PRECISION_F32",
+    "PRECISION_BF16",
+    "PRECISION_INT8",
+    "PRECISION_GAUGE_VALUE",
+    "PRECISION_MANIFEST",
+    "PRECISION_TIER_DEVIATION",
+    "PRECISION_ULP_ENVELOPE",
+    "PrecisionTier",
+    "bf16_round",
+    "fake_quant_int8",
+    "tier_ulp_diff",
+    "quantizable",
+    "quantize_array_int8",
+    "quantize_model_arrays",
+    "quantize_published_artifact",
+    "resolve_precision_tier",
+]
+
+PRECISION_F32 = "f32"
+PRECISION_BF16 = "bf16"
+PRECISION_INT8 = "int8"
+
+_MODES = (PRECISION_F32, PRECISION_BF16, PRECISION_INT8)
+
+#: Manifest written next to a quantized artifact's metadata: which arrays were
+#: quantized and with what per-channel scales, so an operator (or a test) can
+#: audit exactly what a published int8 version contains. The model data itself
+#: stays a plain ``model_data.npz`` of dequantized float arrays — loaders are
+#: byte-format-unchanged and every existing ``load_servable`` path works.
+PRECISION_MANIFEST = "precision.json"
+
+#: Documented low-precision accuracy contract, per (chain, mode), in float32
+#: ulps — the precision-axis extension of PR 10's ``fusion.ULP_ENVELOPE``
+#: (docs/precision.md has the measured values behind each bound). The bound
+#: is the max elementwise ulp distance between the tier's FUSED output and
+#: the tier's PER-STAGE output of the same chain on the same input bits —
+#: the within-tier contract, asserted at the reduction-sensitive widths
+#: 8/16/256 and on saturated tails (tests/test_precision.py). It is NOT a
+#: bound against the f32 answer: bf16 input rounding moves near-zero
+#: mean-centered values by catastrophic *relative* amounts that no ulp bound
+#: expresses — the cross-tier quality question belongs to DriftMonitor, not
+#: a ulp table. The f32 tier is bit-identical (0 ulps) by construction.
+PRECISION_ULP_ENVELOPE = {
+    # Scaler math fuses into the margin dot under bf16 transport: the rounded
+    # stage boundary is idempotent so both partitions reduce identical bits;
+    # the envelope carries the fusion-tier tail headroom (saturated sigmoid,
+    # see fusion.ULP_ENVELOPE["scale_logistic"]).
+    ("scale_logistic", PRECISION_BF16): 32_768,
+    # 6-stage feature chain: row-norm reduction stays f32-accumulated; the
+    # bf16 grid at each boundary is partition-independent (measured 0 ulps
+    # at widths 8/16/256 on XLA CPU; the bound is the contract).
+    ("feature6", PRECISION_BF16): 1024,
+    # MLP head: three f32-accumulated matmuls over bf16-grid inputs; softmax
+    # renormalizes. Tail headroom as scale_logistic.
+    ("scale_mlp", PRECISION_BF16): 16_384,
+    # Sparse IDF→logistic: the margin fold is a sequential scan (cannot
+    # reassociate); bf16 grid on values/idf is partition-independent.
+    ("sparse_idf_logistic", PRECISION_BF16): 32_768,
+    # int8 rides bf16 transport for activations; weights are already
+    # dequantized constants (publish-time quantization) identical in both
+    # partitions. Same within-tier envelopes as bf16.
+    ("scale_logistic", PRECISION_INT8): 32_768,
+    ("feature6", PRECISION_INT8): 1024,
+    ("scale_mlp", PRECISION_INT8): 16_384,
+    ("sparse_idf_logistic", PRECISION_INT8): 32_768,
+}
+
+#: Documented cross-tier accuracy contract, per (chain, mode): the max
+#: magnitude-floored ulp distance (:func:`tier_ulp_diff`) between a
+#: low-precision tier's HEAD output and the f32 tier's on the same input
+#: bits. Raw ulp distance is the wrong metric across tiers — bf16 rounding
+#: of a mean-centered value that lands near zero moves it a catastrophic
+#: *relative* amount (sign flips span ~2e9 ulps) while being absolutely
+#: tiny — so elements below 1% of the reference column's RMS are held to an
+#: absolute bound (4× the floor) and excluded from the ulp measurement.
+#: Bounds are ~4× the values measured on XLA CPU at width 256
+#: (docs/precision.md has the measured table); tests assert them at widths
+#: 8/16/256 and CI on every served burst.
+PRECISION_TIER_DEVIATION = {
+    ("scale_logistic", PRECISION_BF16): 4_194_304,  # measured 1.32M @ d=256
+    ("scale_logistic", PRECISION_INT8): 16_777_216,  # measured 4.91M @ d=256
+    ("scale_mlp", PRECISION_BF16): 2_097_152,  # measured 162k
+    ("scale_mlp", PRECISION_INT8): 4_194_304,  # measured 313k
+    ("feature6", PRECISION_BF16): 33_554_432,  # measured 8.33M @ d=256
+    ("feature6", PRECISION_INT8): 33_554_432,  # no eligible weights: ≡ bf16
+    ("sparse_idf_logistic", PRECISION_BF16): 8_388_608,
+    ("sparse_idf_logistic", PRECISION_INT8): 33_554_432,
+}
+
+#: ``ml.precision.mode`` gauge vocabulary (the fusion-mode gauge discipline:
+#: a plan publishes its tier once at build, numerically).
+PRECISION_GAUGE_VALUE = {
+    PRECISION_F32: 0,
+    PRECISION_BF16: 1,
+    PRECISION_INT8: 2,
+}
+
+#: Bytes one value moves per element under each tier — the precision term of
+#: the cost model (chain_score's elementwise-traffic constant). f32 MUST stay
+#: 4.0: the f32 tier's scores (and therefore its megakernel choices) are
+#: bit-identical to the pre-precision planner.
+_BYTES_PER_VALUE = {
+    PRECISION_F32: 4.0,
+    PRECISION_BF16: 2.0,
+    PRECISION_INT8: 1.0,
+}
+
+
+class PrecisionTier:
+    """Resolved precision policy for one compiled plan — immutable, so a
+    plan's programs and a rebuilt plan under a flipped config can never mix
+    tiers (the FusionTier discipline, applied to the precision axis)."""
+
+    __slots__ = ("mode",)
+
+    def __init__(self, mode: str):
+        if mode not in _MODES:
+            raise ValueError(
+                f"precision.mode must be one of {_MODES!r}; got {mode!r}"
+            )
+        self.mode = mode
+
+    @property
+    def lowp(self) -> bool:
+        """Whether this tier relaxes f32 anywhere (bf16 transport and/or
+        int8 weights). The f32 tier must behave as if this module did not
+        exist."""
+        return self.mode != PRECISION_F32
+
+    @property
+    def key(self) -> Tuple[str]:
+        """Cache identity of this policy — plans compiled under one key are
+        stale under another (different rounding boundaries, different
+        numerics contract). The batch fingerprint (``builder/pipeline.py``)
+        and the serving rebuild check (``serving/server.py``) both compare
+        it."""
+        return (self.mode,)
+
+    @property
+    def cache_key(self) -> Optional[str]:
+        """The plancache-digest leg: ``None`` for f32 so every digest minted
+        before this tier existed stays valid (the digest tuple only grows a
+        precision term when one is in play)."""
+        return None if self.mode == PRECISION_F32 else self.mode
+
+    @property
+    def bytes_per_value(self) -> float:
+        """Bytes one element moves across a stage boundary under this tier —
+        the cost model's traffic constant (f32 keeps the historical 4.0
+        exactly, so f32 plan choices never move)."""
+        return _BYTES_PER_VALUE[self.mode]
+
+    def __repr__(self) -> str:
+        return f"PrecisionTier(mode={self.mode!r})"
+
+
+def resolve_precision_tier(mode: Optional[str] = None) -> PrecisionTier:
+    """The precision policy of the current config (``precision.mode``), or
+    of an explicit ``mode`` override. Raises ``ValueError`` on an unknown
+    mode — a deployment typo must fail at plan build, not silently serve
+    f32 (the resolve_fusion_tier discipline)."""
+    return PrecisionTier(
+        mode if mode is not None else config.get(Options.PRECISION_MODE)
+    )
+
+
+def bf16_round(x):
+    """Round a float32 traced array to the bfloat16 grid, staying float32
+    (``x.astype(bf16).astype(f32)``) — the bf16 tier's transport contract
+    applied at program ingest and at every stage boundary.
+
+    Idempotent by construction: a value already on the bf16 grid maps to
+    itself, so applying the rounding at a boundary the fused partition
+    elides and the per-stage partition materializes changes nothing — the
+    within-tier fused-vs-per-stage parity contract hangs on exactly this.
+    Non-float arrays (ids, segment ids, labels) pass through untouched.
+    """
+    import jax.numpy as jnp
+
+    dt = getattr(x, "dtype", None)
+    if dt is None or not jnp.issubdtype(dt, jnp.floating):
+        return x
+    return x.astype(jnp.bfloat16).astype(jnp.float32)
+
+
+def fake_quant_int8(x):
+    """Per-batch symmetric int8 fake-quantization of a dynamic float array,
+    in-graph: ``s = max|x| / 127`` over the whole array, round to the int8
+    grid, dequantize. Used for the external sparse ``!values`` ingest under
+    the int8 tier — the one tensor whose quantization cannot happen at
+    publish time because it arrives with the request. A cheap elementwise
+    map plus one max-reduction; never any host work. All-zero input (s = 0)
+    passes through unchanged.
+    """
+    import jax.numpy as jnp
+
+    dt = getattr(x, "dtype", None)
+    if dt is None or not jnp.issubdtype(dt, jnp.floating):
+        return x
+    s = jnp.max(jnp.abs(x)) / 127.0
+    safe = jnp.where(s > 0, s, 1.0)
+    q = jnp.clip(jnp.round(x / safe), -127.0, 127.0)
+    return jnp.where(s > 0, q * safe, x)
+
+
+def tier_ulp_diff(reference, other, floor_scale: float = 0.01) -> int:
+    """Magnitude-floored ulp distance between a low-precision tier's output
+    and the f32 reference — the metric of :data:`PRECISION_TIER_DEVIATION`.
+
+    Elements whose reference magnitude is below ``floor_scale`` of the
+    reference's RMS are compared in *absolute* terms (the tier answer must
+    stay within 4× the floor; a violation returns ``2**31``, failing any
+    envelope) and flushed to zero for the ulp measurement; everything else
+    measures on the float32 monotone integer line exactly like
+    :func:`fusion.ulp_diff`. Rationale: bf16 rounding moves a mean-centered
+    value that lands near zero by an unbounded *relative* (hence ulp)
+    amount while staying absolutely negligible — a raw ulp bound on such a
+    column is either vacuous or dishonest.
+    """
+    from flink_ml_tpu.servable.fusion import ulp_diff
+
+    ref = np.asarray(reference, np.float32)
+    oth = np.asarray(other, np.float32)
+    rms = float(np.sqrt(np.mean(np.square(ref)))) if ref.size else 0.0
+    floor = np.float32(floor_scale * (rms if rms > 0.0 else 1.0))
+    sub = np.abs(ref) < floor
+    if np.any(sub) and not np.all(np.abs(oth[sub]) <= 4.0 * floor):
+        return 2**31
+    zero = np.float32(0.0)
+    return ulp_diff(np.where(sub, zero, ref), np.where(sub, zero, oth))
+
+
+#: Model-array names eligible for publish-time int8 weight quantization: the
+#: wide heads (logistic ``coefficient``, MLP ``W0``/``W1``/...) and the
+#: sparse ELL ``*values`` payloads (int8 values halve the padding cost of a
+#: wasteful cap, per ROADMAP). Everything else — biases, labels, scaler
+#: mean/std, centroids — is small and precision-critical; quantizing it buys
+#: nothing and costs accuracy.
+_QUANT_NAME = re.compile(r"(^coefficient$|^W\d+$|values$)")
+
+
+def quantizable(name: str, arr: np.ndarray) -> bool:
+    """Whether a saved model array is eligible for int8 weight quantization
+    (by name, float dtype, and non-trivial size — a sub-16-element array
+    has nothing to win)."""
+    a = np.asarray(arr)
+    return bool(
+        _QUANT_NAME.search(name)
+        and np.issubdtype(a.dtype, np.floating)
+        and a.size >= 16
+    )
+
+
+def quantize_array_int8(arr: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-channel int8 quantize→dequantize of one weight array.
+
+    Channels are the leading axis for ndim ≥ 2 (one scale per output row of
+    a head matrix); 1-D arrays get a single scale. Returns the dequantized
+    array in the ORIGINAL dtype (so loaders see the byte format they always
+    saw) plus the per-channel scales for the manifest. All-zero channels
+    keep scale 0 and pass through exactly.
+    """
+    a = np.asarray(arr)
+    f = a.astype(np.float32)
+    if f.ndim >= 2:
+        flat = f.reshape(f.shape[0], -1)
+        scales = np.max(np.abs(flat), axis=1) / 127.0
+        safe = np.where(scales > 0.0, scales, 1.0)[:, None]
+        q = np.clip(np.rint(flat / safe), -127, 127).astype(np.int8)
+        deq = (q.astype(np.float32) * safe).reshape(f.shape)
+        deq = np.where((scales == 0.0).reshape((-1,) + (1,) * (f.ndim - 1)), f, deq)
+    else:
+        scales = np.array([np.max(np.abs(f)) / 127.0 if f.size else 0.0], np.float32)
+        if scales[0] > 0.0:
+            q = np.clip(np.rint(f / scales[0]), -127, 127).astype(np.int8)
+            deq = q.astype(np.float32) * scales[0]
+        else:
+            deq = f
+    return deq.astype(a.dtype), np.asarray(scales, np.float32)
+
+
+def quantize_model_arrays(
+    arrays: Dict[str, np.ndarray]
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Quantize every eligible array in one model-data dict. Returns the new
+    dict (eligible arrays replaced by their int8 dequantizations, everything
+    else untouched) and the manifest entry describing what moved."""
+    out: Dict[str, np.ndarray] = {}
+    entries: Dict[str, Any] = {}
+    for name, arr in arrays.items():
+        if quantizable(name, arr):
+            deq, scales = quantize_array_int8(arr)
+            out[name] = deq
+            entries[name] = {
+                "dtype": "int8",
+                "channels": int(scales.size),
+                "scales": [float(s) for s in scales.tolist()],
+            }
+        else:
+            out[name] = np.asarray(arr)
+    return out, entries
+
+
+def quantize_published_artifact(directory: str) -> Dict[str, Any]:
+    """Post-training int8 weight quantization of a saved servable tree,
+    IN PLACE — called by ``publish_servable(..., precision="int8")`` on the
+    staging directory BEFORE the atomic rename, so quantization happens
+    exactly once, at publish time, entirely off the serving path (the swap
+    discipline: the quantized artifact is just another published version).
+
+    Walks every ``data/model_data.npz`` under ``directory`` (pipeline
+    artifacts hold one per stage), rewrites eligible arrays through
+    :func:`quantize_array_int8`, and drops a :data:`PRECISION_MANIFEST`
+    JSON at the artifact root recording mode + per-array scales. Returns
+    the manifest. A tree with nothing eligible still gets the manifest
+    (mode recorded, empty array map) — "published as int8" is an auditable
+    fact even when no array moved.
+    """
+    from flink_ml_tpu.utils.read_write import (
+        load_model_arrays,
+        save_model_arrays,
+        model_data_path,
+    )
+
+    manifest: Dict[str, Any] = {"mode": PRECISION_INT8, "arrays": {}}
+    for root, _dirs, files in sorted(os.walk(directory)):
+        if os.path.basename(root) != "data" or "model_data.npz" not in files:
+            continue
+        stage_dir = os.path.dirname(root)
+        assert model_data_path(stage_dir) == root
+        arrays = load_model_arrays(stage_dir)
+        out, entries = quantize_model_arrays(arrays)
+        if entries:
+            os.remove(os.path.join(root, "model_data.npz"))
+            save_model_arrays(stage_dir, out)
+            rel = os.path.relpath(stage_dir, directory)
+            for name, entry in entries.items():
+                manifest["arrays"][f"{rel}/{name}" if rel != "." else name] = entry
+    with open(os.path.join(directory, PRECISION_MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    return manifest

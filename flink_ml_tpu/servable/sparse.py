@@ -27,6 +27,15 @@ tier can AOT-warm the whole ladder. A batch whose rows exceed
 ``sparse.nnz.cap.max`` is **off-ladder** and falls back per-stage (reason-
 labelled in the fallback counters).
 
+**Precision.** Under the int8 tier (``precision.mode=int8``,
+``servable/precision.py``) a published artifact's model-side ``*values``
+payloads are weight-quantized at ``publish_servable`` time like any other
+eligible head array — int8 values halve what a wasteful ELL nnz cap pads
+(ROADMAP) while the on-disk format stays dequantized f32, so nothing in this
+module changes shape or dtype. Dynamic request-side ``!values`` ingest rides
+the ordinary bf16 transport contract at the program boundary; it is never
+quantized on the serving path.
+
 The planner (``servable/planner.py``) owns WHERE these arrays flow; the spec
 (``servable/kernel_spec.py``) owns WHICH columns use the convention; this
 module owns the names, the packing/readback discipline, and the config.

@@ -52,6 +52,10 @@ from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.servable.builder import PipelineModelServable
 from flink_ml_tpu.servable.fusion import plan_recorder, resolve_fusion_tier
 from flink_ml_tpu.servable.plancache import resolve_plan_cache
+from flink_ml_tpu.servable.precision import (
+    PRECISION_GAUGE_VALUE,
+    resolve_precision_tier,
+)
 from flink_ml_tpu.servable.planner import (
     FallbackStage,
     FusedSegment,
@@ -86,12 +90,18 @@ class CompiledServingPlan:
         sharding: Optional[Any] = None,
         fusion: Optional[Any] = None,
         sparse: Optional[Dict[str, int]] = None,
+        precision: Optional[Any] = None,
     ):
         self._stages = list(stages)
         self.segments = segments
         self.scope = scope
         self.sharding = sharding
         self.fusion = fusion if fusion is not None else resolve_fusion_tier()
+        #: The precision tier the segments were built under — part of the
+        #: server's rebuild key exactly like the mesh and the fusion tier
+        #: (docs/precision.md): a config flip rebuilds, never silently
+        #: re-rounds.
+        self.precision = precision if precision is not None else resolve_precision_tier()
         #: The sparse hints the segments were built under (None = convention
         #: off) — part of the server's rebuild key, like the mesh and the
         #: fusion tier: a template whose sparseness differs must rebuild.
@@ -111,6 +121,11 @@ class CompiledServingPlan:
         metrics.gauge(scope, MLMetrics.SERVING_FUSED_STAGES, n_fused)
         metrics.gauge(scope, MLMetrics.SERVING_FALLBACK_STAGES, n_fallback)
         metrics.gauge(scope, MLMetrics.FUSION_MODE, 1 if self.fusion.fast else 0)
+        metrics.gauge(
+            scope,
+            MLMetrics.PRECISION_MODE,
+            PRECISION_GAUGE_VALUE[self.precision.mode],
+        )
         if sharding is not None:
             metrics.gauge(scope, MLMetrics.SERVING_SHARD_COUNT, sharding.n_data)
             metrics.gauge(scope, MLMetrics.SERVING_SHARD_MODEL_AXIS, sharding.n_model)
@@ -124,6 +139,7 @@ class CompiledServingPlan:
         sharding: Optional[Any] = None,
         fusion: Optional[Any] = None,
         sparse: Optional[Dict[str, int]] = None,
+        precision: Optional[Any] = None,
     ) -> Optional["CompiledServingPlan"]:
         """Group the servable's consecutive kernel-spec stages into fused
         segments. Raises whatever ``kernel_spec()`` raises (an unloaded model
@@ -149,10 +165,14 @@ class CompiledServingPlan:
         )
         if fusion is None:
             fusion = resolve_fusion_tier()
-        segments = build_segments(stages, sharding, fusion, sparse)
+        if precision is None:
+            precision = resolve_precision_tier()
+        segments = build_segments(stages, sharding, fusion, sparse, precision)
         if not any(isinstance(s, FusedSegment) for s in segments):
             return None
-        return CompiledServingPlan(stages, segments, scope, sharding, fusion, sparse)
+        return CompiledServingPlan(
+            stages, segments, scope, sharding, fusion, sparse, precision
+        )
 
     # -- warmup / AOT ---------------------------------------------------------
     def warmup(self, template: DataFrame, buckets: Sequence[int]) -> None:
@@ -190,6 +210,8 @@ class CompiledServingPlan:
                     with tracer.span("serving.plan.warmup", CAT_COMPILE, scope=self.scope) as sp:
                         sp.set_attr("bucket", bucket)
                         sp.set_attr("fusion", self.fusion.mode)
+                        if self.precision.lowp:
+                            sp.set_attr("precision", self.precision.mode)
                         if cap is not None:
                             sp.set_attr("nnz_cap", cap)
                         if krung is not None:

@@ -85,10 +85,29 @@ def quarantine_version(directory: str, version: int) -> Optional[str]:
     return dst
 
 
-def publish_servable(stage, directory: str, version: Optional[int] = None) -> str:
+def publish_servable(
+    stage,
+    directory: str,
+    version: Optional[int] = None,
+    *,
+    precision: Optional[str] = None,
+) -> str:
     """Save ``stage`` (a Model/Transformer with ``.save``) as the next model
     version under ``directory``, atomically (tmp dir + rename) so a concurrent
-    poller never loads a partial save. Returns the published path."""
+    poller never loads a partial save. Returns the published path.
+
+    ``precision="int8"`` applies post-training int8 weight quantization to
+    the saved tree IN THE TMP DIR, before the atomic rename
+    (``servable/precision.py``): the published artifact holds per-channel
+    dequantized weights (loaders unchanged) plus a ``precision.json``
+    manifest of the scales. This is the ONLY place quantization runs — the
+    quantized version is just another published version, so poll / warm /
+    swap / rollback / canary all work unchanged and the serving path never
+    quantizes anything. ``precision=None`` (default) and ``"f32"`` publish
+    byte-identically to before; ``"bf16"`` needs no artifact change (the
+    rounding is a plan-build property) and also publishes unchanged."""
+    if precision not in (None, "f32", "bf16", "int8"):
+        raise ValueError(f"unknown publish precision {precision!r}")
     os.makedirs(directory, exist_ok=True)
     if version is None:
         published = scan_numbered_dirs(directory, VERSION_PREFIX, _METADATA_MARKER)
@@ -100,6 +119,16 @@ def publish_servable(stage, directory: str, version: Optional[int] = None) -> st
     if os.path.exists(tmp_dir):
         shutil.rmtree(tmp_dir)
     stage.save(tmp_dir)
+    if precision == "int8":
+        from flink_ml_tpu.metrics import MLMetrics, metrics
+        from flink_ml_tpu.servable.precision import quantize_published_artifact
+
+        manifest = quantize_published_artifact(tmp_dir)
+        metrics.counter(
+            MLMetrics.SERVING_GROUP,
+            MLMetrics.PRECISION_QUANTIZED_ARRAYS,
+            len(manifest["arrays"]),
+        )
     os.rename(tmp_dir, final_dir)
     return final_dir
 

@@ -34,6 +34,12 @@ from flink_ml_tpu.serving.errors import NoModelError, ServingClosedError
 from flink_ml_tpu.serving.plan import CompiledServingPlan
 from flink_ml_tpu.serving.registry import ModelRegistry, ModelVersionPoller
 from flink_ml_tpu.servable.fusion import resolve_fusion_tier
+from flink_ml_tpu.servable.precision import (
+    PRECISION_F32,
+    PRECISION_GAUGE_VALUE,
+    PrecisionTier,
+    resolve_precision_tier,
+)
 from flink_ml_tpu.servable.sharding import resolve_plan_sharding
 from flink_ml_tpu.servable.sparse import resolve_sparse_hints
 from flink_ml_tpu.trace import CAT_COMPILE, CAT_PRODUCTIVE, CAT_SWAP, tracer
@@ -75,6 +81,7 @@ class ServingConfig:
         mesh: Optional[int] = None,
         mesh_model: Optional[int] = None,
         fusion_mode: Optional[str] = None,
+        precision_mode: Optional[str] = None,
         controller: Optional[bool] = None,
         http_port: Optional[int] = None,
         shed_watermark: Optional[float] = None,
@@ -124,6 +131,10 @@ class ServingConfig:
             str(fusion_mode) if fusion_mode is not None
             else config.get(Options.FUSION_MODE)
         )
+        self.precision_mode = (
+            str(precision_mode) if precision_mode is not None
+            else config.get(Options.PRECISION_MODE)
+        )
         self.controller = (
             bool(controller) if controller is not None
             else config.get(Options.SERVING_CONTROLLER)
@@ -155,7 +166,8 @@ class ServingConfig:
             f"poll_interval_ms={self.poll_interval_ms}, "
             f"fastpath={self.fastpath}, pipeline_depth={self.pipeline_depth}, "
             f"mesh={self.mesh}, mesh_model={self.mesh_model}, "
-            f"fusion_mode={self.fusion_mode}, controller={self.controller})"
+            f"fusion_mode={self.fusion_mode}, "
+            f"precision_mode={self.precision_mode}, controller={self.controller})"
         )
 
 
@@ -242,6 +254,19 @@ class InferenceServer:
             if self.config.fastpath
             else None
         )
+        # Precision tier, resolved once like the fusion tier (fail-fast on a
+        # typo at construction). On a low-precision tier every version keeps
+        # TWO warm plans: the configured tier's and the f32 twin of the SAME
+        # version — the landing zone of the drift-triggered fallback
+        # (docs/precision.md). The fallback flag flips which one _plan_for
+        # returns; flipping it is selection between already-warm plans, never
+        # a compile.
+        self._precision = (
+            resolve_precision_tier(self.config.precision_mode)
+            if self.config.fastpath
+            else None
+        )
+        self._precision_fallback = False
         # SLO-adaptive controller (serving.controller, default on): priority
         # shedding before the hard queue bound, deadline-aware bucket caps,
         # pipeline-depth stepping from its live goodput ledger. With default
@@ -293,50 +318,89 @@ class InferenceServer:
             self.swap(version, servable)
 
     # -- the one place a batch meets a model ----------------------------------
-    def _plan_for(self, servable) -> Optional[CompiledServingPlan]:
-        """The servable's compiled plan (built once, cached on the servable so
-        the registry's ``(version, servable)`` snapshot carries it). Normally
-        built by ``warmup`` off the serving path; a server that never saw a
-        warmup template builds it lazily on the first batch instead — that one
-        build compiles lazily per bucket and is visible as
-        ``ml.serving.fastpath.compiles``."""
+    def _plan_stale(self, plan, sparse_hints, tier) -> bool:
+        """Whether a cached plan was compiled under a different placement,
+        fusion tier, sparseness, or precision tier than this server's — a
+        plan carried from elsewhere (another server, a flipped config) has
+        the wrong committed buffers / program partition / numerics contract
+        and must rebuild (the same bug class the batch fingerprint covers
+        for batch.mesh / fusion.mode / precision.mode, docs/fusion.md,
+        docs/precision.md)."""
+        return plan is not None and (
+            getattr(plan.sharding, "key", None)
+            != (self._sharding.key if self._sharding is not None else None)
+            or getattr(plan.fusion, "key", None) != self._fusion.key
+            or getattr(plan, "sparse_hints", None) != sparse_hints
+            or getattr(getattr(plan, "precision", None), "key", None) != tier.key
+        )
+
+    def _plans_for(self, servable) -> Tuple[Optional[CompiledServingPlan], Optional[CompiledServingPlan]]:
+        """``(plan, f32_twin)`` for the servable — the configured tier's plan
+        plus, on a low-precision tier, the f32 plan of the SAME version that
+        the drift fallback lands on (``None`` twin on the f32 tier). Both
+        cached on the servable so the registry's ``(version, servable)``
+        snapshot carries them. Normally built by ``warmup`` off the serving
+        path; a server that never saw a warmup template builds lazily on the
+        first batch instead — visible as ``ml.serving.fastpath.compiles``."""
         if not self.config.fastpath:
-            return None
+            return None, None
         # Sparse hints from the warmup template (docs/sparse.md): columns the
         # template shows sparse build sparse-convention segments; a template
         # whose sparseness differs from the cached plan's is a rebuild key,
-        # like the mesh and the fusion tier.
+        # like the mesh, the fusion tier, and the precision tier.
         with self._template_lock:
             template = self._warmup_template
         sparse_hints = resolve_sparse_hints(template)
         plan = getattr(servable, "_fastpath_plan", _PLAN_UNSET)
-        if plan is _PLAN_UNSET or (
-            # A plan compiled under a different placement (the same servable
-            # object attached to a server with another mesh) has the wrong
-            # local shapes and committed buffers, and a plan compiled under a
-            # different fusion tier has the wrong program partition and
-            # numerics contract — rebuild for this server's mesh + tier
-            # (the same bug class the batch fingerprint covers for
-            # batch.mesh / fusion.mode, docs/fusion.md).
-            plan is not None
-            and (
-                getattr(plan.sharding, "key", None)
-                != (self._sharding.key if self._sharding is not None else None)
-                or getattr(plan.fusion, "key", None) != self._fusion.key
-                or getattr(plan, "sparse_hints", None) != sparse_hints
-            )
-        ):
+        if plan is _PLAN_UNSET or self._plan_stale(plan, sparse_hints, self._precision):
             plan = CompiledServingPlan.build(
                 servable,
                 scope=self.scope,
                 sharding=self._sharding,
                 fusion=self._fusion,
                 sparse=sparse_hints,
+                precision=self._precision,
             )
             try:
                 servable._fastpath_plan = plan
             except AttributeError:  # __slots__ servable: serve without a plan
-                return None
+                return None, None
+        if plan is None or not self._precision.lowp:
+            return plan, None
+        f32 = PrecisionTier(PRECISION_F32)
+        twin = getattr(servable, "_fastpath_plan_f32", _PLAN_UNSET)
+        if twin is _PLAN_UNSET or self._plan_stale(twin, sparse_hints, f32):
+            twin = CompiledServingPlan.build(
+                servable,
+                scope=self.scope,
+                sharding=self._sharding,
+                fusion=self._fusion,
+                sparse=sparse_hints,
+                precision=f32,
+            )
+            # The twin's build gauged the scope's precision mode at 0; the
+            # plan actually serving (fallback aside) is the configured tier.
+            metrics.gauge(
+                self.scope,
+                MLMetrics.PRECISION_MODE,
+                PRECISION_GAUGE_VALUE[self._precision.mode],
+            )
+            try:
+                servable._fastpath_plan_f32 = twin
+            except AttributeError:
+                twin = None
+        return plan, twin
+
+    def _plan_for(self, servable) -> Optional[CompiledServingPlan]:
+        """The plan a batch should execute NOW: the configured tier's, or —
+        while a drift-triggered precision fallback is active — the warm f32
+        twin of the same version. Selection between already-built plans; the
+        flag flip is the whole fallback (docs/precision.md)."""
+        plan, twin = self._plans_for(servable)
+        with self._state_lock:
+            fallback = self._precision_fallback
+        if twin is not None and fallback:
+            return twin
         return plan
 
     def _execute(self, padded_df: DataFrame) -> Tuple[DataFrame, int]:  # graftcheck: hot-root
@@ -425,7 +489,9 @@ class InferenceServer:
         the atomic version flip, so the hot path never traces, compiles, or
         uploads weights."""
         with tracer.span("serving.warmup", CAT_COMPILE, scope=self.scope):
-            plan = self._plan_for(servable)  # device-puts model arrays, off-path
+            # device-puts model arrays off-path; on a low-precision tier this
+            # also builds the f32 twin the drift fallback lands on.
+            plan, twin = self._plans_for(servable)
             with self._template_lock:
                 template = self._warmup_template
             if template is None:
@@ -433,6 +499,11 @@ class InferenceServer:
                 return  # nothing seen yet: the first real batch compiles lazily
             if plan is not None:
                 plan.warmup(template, self._batcher.buckets)
+                if twin is not None:
+                    # The fallback contract: flipping to f32 mid-burst is a
+                    # selection between warm plans with ZERO compiles — so
+                    # the twin AOT-warms on every bucket too, before the flip.
+                    twin.warmup(template, self._batcher.buckets)
             else:
                 for bucket in self._batcher.buckets:
                     servable.transform(pad_to(template, bucket))
@@ -440,6 +511,9 @@ class InferenceServer:
                 "buckets": len(self._batcher.buckets),
                 "fastpath": plan is not None,
             }
+            if twin is not None:
+                payload["precision"] = self._precision.mode
+                payload["f32_twin_warm"] = True
             if plan is not None and plan.last_warmup_cache is not None:
                 # The incarnation's cold-start story in one record: how much
                 # of this flip's warm came off the plan cache vs live XLA
@@ -474,6 +548,58 @@ class InferenceServer:
             telemetry.emit(
                 "serving.rollback", self.scope, {"version": version, "from": previous}
             )
+
+    def precision_fallback(self, reason: str = "drift") -> bool:
+        """Switch serving to the warm f32 twin of the CURRENT version — a
+        fallback, not a rollback: the model version does not change, only the
+        precision tier of the plan answering requests. Idempotent; returns
+        whether a fallback is (now) active. No-op (False) on an f32 tier.
+
+        The flip is a boolean the hot path's plan selection reads — every
+        in-flight batch finishes on whichever plan it dispatched with and
+        every later batch selects the f32 twin, so no request is ever dropped
+        or resolved twice. Zero compiles by construction: the twin was built
+        and AOT-warmed at swap time (``warmup``). One journaled decision per
+        activation (``precision.fallback`` in the flight recorder)."""
+        if self._precision is None or not self._precision.lowp:
+            return False
+        with self._state_lock:
+            if self._precision_fallback:
+                return True
+            self._precision_fallback = True
+        metrics.counter(self.scope, MLMetrics.PRECISION_FALLBACKS)
+        metrics.gauge(self.scope, MLMetrics.PRECISION_FALLBACK_ACTIVE, 1)
+        telemetry.emit(
+            "precision.fallback",
+            self.scope,
+            {
+                "from": self._precision.mode,
+                "to": PRECISION_F32,
+                "reason": reason,
+                "version": self.registry.version,
+            },
+        )
+        return True
+
+    def precision_restore(self) -> None:
+        """Clear an active precision fallback (operator action after the
+        regression is understood): the next batch selects the configured
+        low-precision plan again — still warm, still zero compiles."""
+        with self._state_lock:
+            if not self._precision_fallback:
+                return
+            self._precision_fallback = False
+        metrics.gauge(self.scope, MLMetrics.PRECISION_FALLBACK_ACTIVE, 0)
+        telemetry.emit(
+            "precision.restore",
+            self.scope,
+            {"to": self._precision.mode, "version": self.registry.version},
+        )
+
+    @property
+    def precision_fallback_active(self) -> bool:
+        with self._state_lock:
+            return self._precision_fallback
 
     def attach_poller(
         self,
@@ -514,6 +640,7 @@ class InferenceServer:
         with self._state_lock:
             closed_flag = self._closed
             poller = self._poller
+            precision_fallback = self._precision_fallback
         closed = closed_flag or self._batcher.closed
         version = self.registry.version
         payload = {
@@ -540,6 +667,14 @@ class InferenceServer:
             # replica that silently stops taking model updates — /healthz is
             # where an operator (or the fleet supervisor) sees it.
             "poller": poller.backoff_state() if poller is not None else None,
+            # A low-precision replica serving its f32 fallback is quality-
+            # safe but not at configured speed — surfaced here so the fleet
+            # view shows it without grepping journals.
+            "precision": (
+                {"mode": self._precision.mode, "fallback": precision_fallback}
+                if self._precision is not None and self._precision.lowp
+                else None
+            ),
         }
         return (not closed and not draining), payload
 

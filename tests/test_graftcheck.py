@@ -39,6 +39,7 @@ ALL_RULES = (
     "fusion-tier",
     "host-sync",
     "jit-purity",
+    "kernel-cast-boundary",
     "kernel-spec-consistency",
     "layer-deps",
     "lock-order",

@@ -252,6 +252,162 @@ def test_changed_only_view_keeps_the_plan_key_read_site(tmp_path, monkeypatch):
 
 
 # -----------------------------------------------------------------------------
+# plan-key-completeness: the precision axis (PR 19)
+# -----------------------------------------------------------------------------
+
+PRECISION_CONFIG_FIXTURE = """
+    class ConfigOption:
+        def __init__(self, key, typ, default, doc):
+            self.key = key
+
+    class Options:
+        ALPHA = ConfigOption("alpha.key", int, 1, "")
+        PRECISION_MODE = ConfigOption("precision.mode", str, "f32", "")
+
+    class _Config:
+        def get(self, opt):
+            return 0
+
+    config = _Config()
+"""
+
+PRECISION_DIRTY = {
+    "flink_ml_tpu/config.py": PRECISION_CONFIG_FIXTURE,
+    # The precision read is plan-reachable (build_plan resolves the tier)
+    # but the digest only captures ALPHA — exactly the rebuild bug the
+    # precision tier must not reintroduce: a precision.mode flip would
+    # silently keep serving the old tier's plan.
+    "flink_ml_tpu/planner.py": """
+        from flink_ml_tpu.config import Options, config
+        from flink_ml_tpu.precision import resolve_tier
+
+        def digest():
+            return config.get(Options.ALPHA)
+
+        def build_plan():
+            digest()
+            return resolve_tier()
+    """,
+    "flink_ml_tpu/precision.py": """
+        from flink_ml_tpu.config import Options, config
+
+        def resolve_tier():
+            return config.get(Options.PRECISION_MODE)
+    """,
+}
+
+
+def test_plan_key_flags_uncaptured_precision_read_at_the_read_site(tmp_path):
+    project = _project(tmp_path, PRECISION_DIRTY)
+    (f,) = _FixturePlanKey().run(project)
+    assert f.path == "flink_ml_tpu/precision.py" and f.line == 4
+    assert "precision.mode" in f.message and "PRECISION_MODE" in f.message
+    assert "rebuild key" in f.message
+
+
+def test_plan_key_clean_when_precision_resolver_is_a_capture_root(tmp_path):
+    # The shipped fix: resolve_precision_tier joins the capture roots, so the
+    # read inside it is carried by the digest surface.
+    class Captured(_FixturePlanKey):
+        KEY_CAPTURE_ROOTS = {
+            "digest": (
+                "flink_ml_tpu.planner:digest",
+                "flink_ml_tpu.precision:resolve_tier",
+            ),
+        }
+        PLAN_KEY_OPTIONS = {
+            "ALPHA": ("digest",),
+            "PRECISION_MODE": ("digest",),
+        }
+
+    assert Captured().run(_project(tmp_path, PRECISION_DIRTY)) == []
+
+
+# -----------------------------------------------------------------------------
+# kernel-cast-boundary (+ the casts fact behind it)
+# -----------------------------------------------------------------------------
+
+
+def test_facts_record_lowp_casts_only(tmp_path):
+    project = _project(tmp_path, {
+        "flink_ml_tpu/c.py": """
+            import jax.numpy as jnp
+            from jax import lax
+
+            def lowers(x):
+                a = x.astype(jnp.bfloat16)
+                b = lax.convert_element_type(x, jnp.float16)
+                c = jnp.zeros((2,), dtype="int8")
+                return a, b, c
+
+            def keeps_f32(x):
+                return x.astype(jnp.float32).sum(dtype=jnp.float64)
+        """,
+    })
+    fns = project.facts()["flink_ml_tpu/c.py"]["functions"]
+    assert [tok for tok, _line in fns["lowers"]["casts"]] == [
+        "bfloat16", "float16", "int8",
+    ]
+    assert fns["keeps_f32"]["casts"] == []
+
+
+CAST_DIRTY = {
+    # An in-body accumulator downcast in the shared kernels module …
+    "flink_ml_tpu/ops/kernels.py": """
+        import jax.numpy as jnp
+
+        def norm_fn(x):
+            acc = jnp.sum(x * x, axis=1).astype(jnp.bfloat16)
+            return acc.astype(jnp.float32)
+    """,
+    # … and a stray cast in kernel_spec glue outside the kernels module.
+    "flink_ml_tpu/stage.py": """
+        import jax.numpy as jnp
+
+        class Stage:
+            def kernel_spec(self):
+                def kernel_fn(model, cols):
+                    return {"out": cols["x"].astype(jnp.float16)}
+                return kernel_fn
+    """,
+}
+
+
+def test_kernel_cast_boundary_flags_in_kernel_and_spec_glue_casts(tmp_path):
+    result = run_on(tmp_path, CAST_DIRTY, rules=["kernel-cast-boundary"])
+    by_path = {f.path: f for f in result.findings}
+    assert set(by_path) == {"flink_ml_tpu/ops/kernels.py", "flink_ml_tpu/stage.py"}
+    k = by_path["flink_ml_tpu/ops/kernels.py"]
+    assert "bfloat16" in k.message and "precision-neutral" in k.message
+    assert k.line == 4  # the downcast, not the f32 restore
+    s = by_path["flink_ml_tpu/stage.py"]
+    assert "float16" in s.message and "kernel_spec glue" in s.message
+
+
+def test_kernel_cast_boundary_clean_for_f32_and_int32_casts(tmp_path):
+    clean = {
+        "flink_ml_tpu/ops/kernels.py": """
+            import jax.numpy as jnp
+
+            def norm_fn(x):
+                nnz = jnp.sum((x != 0).astype(jnp.int32), axis=1)
+                return jnp.sum(x * x, axis=1).astype(jnp.float32), nnz
+        """,
+        # Low-precision casts OUTSIDE kernel bodies and spec glue are the
+        # tier's own business (servable/precision.py's bf16_round) — not
+        # findings.
+        "flink_ml_tpu/precision.py": """
+            import jax.numpy as jnp
+
+            def bf16_round(x):
+                return x.astype(jnp.bfloat16).astype(jnp.float32)
+        """,
+    }
+    result = run_on(tmp_path, clean, rules=["kernel-cast-boundary"])
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+# -----------------------------------------------------------------------------
 # typed-error-escape
 # -----------------------------------------------------------------------------
 

@@ -1,0 +1,875 @@
+"""The low-precision serving tier (``precision.mode``, docs/precision.md):
+
+- **f32 stays f32**: the default tier's plans and outputs are bit-identical
+  to the pre-precision-tier behavior, and the tier is plan-key-neutral
+  (``cache_key`` is ``None``) so every existing plancache entry stays valid;
+- **bf16 holds both envelopes**: the within-tier fused-vs-per-stage parity
+  (``PRECISION_ULP_ENVELOPE`` — bf16_round's idempotence makes it 0 in
+  practice) and the cross-tier head deviation against f32
+  (``PRECISION_TIER_DEVIATION``, measured through :func:`tier_ulp_diff`'s
+  magnitude floor) at the reduction-sensitive widths 8/16/256 and on
+  saturated sigmoid tails;
+- **int8 quantizes at publish only**: per-channel symmetric weight
+  quantization through ``publish_servable(..., precision="int8")``, with the
+  manifest auditable next to the artifact — and a poisoned-seam proof that
+  the serving path never quantizes anything;
+- **mode flips rebuild**: a ``precision.mode`` change rebuilds cached batch
+  plans (fingerprint) and serving plans (rebuild key) instead of silently
+  serving the old tier, and the plancache digests per tier never collide
+  (zero-compile resume per tier);
+- **sharding composes**: bf16 stage-boundary rounding commutes with the
+  PlanSharding ingest split at mesh 2/4;
+- **drift falls back, not rolls back**: a regressed verdict on a
+  low-precision server lands on the warm f32 plan of the SAME version with
+  zero compiles and exactly one journaled decision — and only a second
+  verdict on f32-served traffic escalates to the version rollback.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from flink_ml_tpu import telemetry
+from flink_ml_tpu.api.dataframe import DataFrame
+from flink_ml_tpu.builder import CompiledBatchPlan, PipelineModel
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.models.feature.binarizer import Binarizer
+from flink_ml_tpu.models.feature.elementwise_product import ElementwiseProduct
+from flink_ml_tpu.models.feature.idf import IDFModel
+from flink_ml_tpu.models.feature.normalizer import Normalizer
+from flink_ml_tpu.models.feature.standard_scaler import StandardScalerModel
+from flink_ml_tpu.servable.builder import PipelineModelServable
+from flink_ml_tpu.servable.fusion import FusionTier, ulp_diff
+from flink_ml_tpu.servable.lib import (
+    LogisticRegressionModelServable,
+    MLPClassifierModelServable,
+    StandardScalerModelServable,
+)
+from flink_ml_tpu.servable import precision as precision_mod
+from flink_ml_tpu.servable.plancache import program_digest
+from flink_ml_tpu.servable.precision import (
+    PRECISION_MANIFEST,
+    PRECISION_TIER_DEVIATION,
+    PRECISION_ULP_ENVELOPE,
+    PrecisionTier,
+    bf16_round,
+    fake_quant_int8,
+    quantizable,
+    quantize_array_int8,
+    resolve_precision_tier,
+    tier_ulp_diff,
+)
+from flink_ml_tpu.servable.sharding import PlanSharding
+from flink_ml_tpu.serving import pad_to, power_of_two_buckets
+from flink_ml_tpu.serving.plan import CompiledServingPlan
+from flink_ml_tpu.serving.server import InferenceServer, ServingConfig
+
+WIDTHS = (8, 16, 256)
+N = 203  # odd on purpose, matching the fusion-tier suite's tail coverage
+HEAD = "rawPrediction"  # the envelope-assertable head column (prediction is a class label)
+
+
+@pytest.fixture(autouse=True)
+def _reset_precision_config():
+    yield
+    config.unset(Options.PRECISION_MODE)
+    config.unset(Options.PRECISION_FALLBACK_AUTO)
+    config.unset(Options.FUSION_MODE)
+    config.unset(Options.BATCH_FASTPATH)
+    config.unset(Options.BATCH_MESH)
+    config.unset(Options.PLANCACHE_DIR)
+
+
+# ---------------------------------------------------------------------------
+# chain builders (the benched/documented chains, as in tests/test_fusion.py)
+# ---------------------------------------------------------------------------
+
+
+def _feature6_stages(d, seed=9):
+    rng = np.random.default_rng(seed)
+    scaler = StandardScalerModel().set_input_col("input").set_output_col("scaled")
+    scaler.set_with_mean(True)
+    scaler.mean = rng.standard_normal(d)
+    scaler.std = np.abs(rng.standard_normal(d)) + 0.5
+    idf = IDFModel().set_input_col("weighted").set_output_col("tfidf")
+    idf.idf = np.abs(rng.standard_normal(d)) + 0.2
+    idf.doc_freq = np.ones(d)
+    idf.num_docs = np.asarray(100.0)
+    rescale = StandardScalerModel().set_input_col("tfidf").set_output_col("rescaled")
+    rescale.set_with_mean(False)
+    rescale.mean = np.zeros(d)
+    rescale.std = np.abs(rng.standard_normal(d)) + 0.5
+    return [
+        scaler,
+        Normalizer().set_input_col("scaled").set_output_col("norm"),
+        ElementwiseProduct()
+        .set_scaling_vec(np.abs(rng.standard_normal(d)) + 0.1)
+        .set_input_col("norm")
+        .set_output_col("weighted"),
+        idf,
+        rescale,
+        Binarizer().set_input_cols("rescaled").set_output_cols("bin").set_thresholds(0.05),
+    ]
+
+
+def _scale_logistic_servable(d, seed=3):
+    rng = np.random.default_rng(seed)
+    sc = StandardScalerModelServable().set_input_col("features").set_output_col("scaled")
+    sc.set_with_mean(True)
+    sc.mean = rng.normal(size=d)
+    sc.std = np.abs(rng.normal(size=d)) + 0.5
+    lr = LogisticRegressionModelServable().set_features_col("scaled")
+    lr.coefficient = rng.normal(size=d)
+    return PipelineModelServable([sc, lr])
+
+
+def _scale_mlp_servable(d=256, hidden=64, classes=8, seed=5):
+    rng = np.random.default_rng(seed)
+    sc = StandardScalerModelServable().set_input_col("features").set_output_col("scaled")
+    sc.set_with_mean(True)
+    sc.mean = rng.normal(size=d)
+    sc.std = np.abs(rng.normal(size=d)) + 0.5
+    mlp = MLPClassifierModelServable().set_features_col("scaled")
+    dims = [d, hidden, classes]
+    arrays = {"labels": np.arange(float(classes))}
+    for i in range(len(dims) - 1):
+        arrays[f"W{i}"] = (
+            rng.normal(size=(dims[i], dims[i + 1])) / np.sqrt(dims[i])
+        ).astype(np.float32)
+        arrays[f"b{i}"] = rng.normal(size=dims[i + 1]).astype(np.float32)
+    mlp._apply_model_arrays(arrays)
+    return PipelineModelServable([sc, mlp])
+
+
+def _vec_df(n, d, col="input", seed=7):
+    return DataFrame.from_dict({col: np.random.default_rng(seed).normal(size=(n, d))})
+
+
+def _assert_bitexact(a: DataFrame, b: DataFrame, what: str):
+    assert a.get_column_names() == b.get_column_names()
+    for name in a.get_column_names():
+        np.testing.assert_array_equal(
+            np.asarray(a.column(name)), np.asarray(b.column(name)),
+            err_msg=f"{what}: {name}",
+        )
+
+
+def _assert_within_tier(a: DataFrame, b: DataFrame, envelope: int, what: str):
+    assert a.get_column_names() == b.get_column_names()
+    for name in a.get_column_names():
+        u = ulp_diff(a.column(name), b.column(name))
+        assert u <= envelope, f"{what}: column {name} moved {u} ulps > {envelope}"
+
+
+# ---------------------------------------------------------------------------
+# the policy object: resolution, identity, cost
+# ---------------------------------------------------------------------------
+
+
+def test_default_tier_is_f32_and_plan_key_neutral():
+    tier = resolve_precision_tier()
+    assert tier.mode == "f32" and not tier.lowp
+    assert tier.key == ("f32",)
+    assert tier.cache_key is None  # pre-precision plancache digests stay valid
+    assert tier.bytes_per_value == 4.0
+    config.set(Options.PRECISION_MODE, "bf16")
+    lowp = resolve_precision_tier()
+    assert lowp.mode == "bf16" and lowp.lowp and lowp.cache_key == "bf16"
+    assert lowp.bytes_per_value == 2.0
+    assert resolve_precision_tier("int8").bytes_per_value == 1.0
+
+
+def test_resolve_precision_tier_validates_mode():
+    config.set(Options.PRECISION_MODE, "fp4")
+    with pytest.raises(ValueError, match="precision.mode"):
+        resolve_precision_tier()
+    with pytest.raises(ValueError, match="precision.mode"):
+        PrecisionTier("f16")
+
+
+def test_bf16_round_is_idempotent_and_type_gated():
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.random.default_rng(0).normal(size=64), jnp.float32)
+    once = bf16_round(x)
+    np.testing.assert_array_equal(np.asarray(once), np.asarray(bf16_round(once)))
+    assert once.dtype == jnp.float32
+    ids = jnp.arange(8, dtype=jnp.int32)
+    assert bf16_round(ids) is ids  # non-float transport passes through
+
+
+# ---------------------------------------------------------------------------
+# f32: bit-identical to the pre-tier behavior (the hard default contract)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_f32_serving_plan_bit_identical_to_per_stage(width):
+    servable = _scale_logistic_servable(width)
+    df = _vec_df(64, width, col="features")
+    classic = servable.transform(df)
+    plan = CompiledServingPlan.build(
+        servable, scope=f"p-f32-{width}", precision=PrecisionTier("f32")
+    )
+    _assert_bitexact(classic, plan.execute(df), f"f32 serving d={width}")
+    assert metrics.get(f"p-f32-{width}", MLMetrics.PRECISION_MODE) == 0
+
+
+def test_f32_batch_plan_bit_identical_to_per_stage():
+    stages = _feature6_stages(16)
+    df = _vec_df(N, 16)
+    config.set(Options.BATCH_FASTPATH, False)
+    per_stage = PipelineModel(stages).transform(df)
+    fused = CompiledBatchPlan.build(
+        stages, scope="p-f32-batch", precision=PrecisionTier("f32")
+    ).transform(df)
+    _assert_bitexact(per_stage, fused, "f32 batch")
+
+
+# ---------------------------------------------------------------------------
+# bf16: within-tier parity envelope + cross-tier head deviation, widths 8/16/256
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_scale_logistic_bf16_envelopes(width):
+    servable = _scale_logistic_servable(width)
+    df = _vec_df(64, width, col="features")
+    f32 = CompiledServingPlan.build(
+        servable, scope=f"p-b-f{width}", precision=PrecisionTier("f32")
+    ).execute(df)
+    b16 = CompiledServingPlan.build(
+        servable, scope=f"p-b-b{width}", precision=PrecisionTier("bf16")
+    ).execute(df)
+    # the tier genuinely changed the numerics...
+    assert not np.array_equal(np.asarray(f32.column(HEAD)), np.asarray(b16.column(HEAD)))
+    # ...the hard class label did not move...
+    np.testing.assert_array_equal(
+        np.asarray(f32.column("prediction")), np.asarray(b16.column("prediction"))
+    )
+    # ...and the head deviation sits inside the documented cross-tier bound.
+    dev = tier_ulp_diff(f32.column(HEAD), b16.column(HEAD))
+    env = PRECISION_TIER_DEVIATION[("scale_logistic", "bf16")]
+    assert dev <= env, f"d={width}: {dev} > {env}"
+    # within-tier: the fused and per-stage partitions of the SAME tier agree
+    # inside the PRECISION_ULP_ENVELOPE (bf16_round idempotence ⇒ 0 observed).
+    b16_fused = CompiledServingPlan.build(
+        servable,
+        scope=f"p-b-bf{width}",
+        fusion=FusionTier("fast", megakernel=False),
+        precision=PrecisionTier("bf16"),
+    ).execute(df)
+    _assert_within_tier(
+        b16, b16_fused,
+        PRECISION_ULP_ENVELOPE[("scale_logistic", "bf16")],
+        f"bf16 within-tier d={width}",
+    )
+    assert metrics.get(f"p-b-b{width}", MLMetrics.PRECISION_MODE) == 1
+
+
+def test_scale_logistic_bf16_saturated_tails():
+    """Inputs pushed deep into the sigmoid's saturated tails: saturated rows
+    must not flip class and both envelopes must still hold — the regime
+    where a relaxed-precision sigmoid traditionally goes wrong. Rows whose
+    f32 probability genuinely straddles the boundary MAY flip (bf16 input
+    rounding legitimately moves a 0.4/0.6 margin); a flip on a confident row
+    would be a tier bug."""
+    servable = _scale_logistic_servable(16)
+    x = np.random.default_rng(21).normal(size=(64, 16)) * 100.0  # saturates
+    df = DataFrame.from_dict({"features": x})
+    f32 = CompiledServingPlan.build(
+        servable, scope="p-sat-f", precision=PrecisionTier("f32")
+    ).execute(df)
+    b16 = CompiledServingPlan.build(
+        servable, scope="p-sat-b", precision=PrecisionTier("bf16")
+    ).execute(df)
+    confidence = np.max(np.asarray(f32.column(HEAD)), axis=-1)
+    assert np.mean(confidence > 0.99) > 0.5  # the batch IS tail-dominated
+    flipped = np.asarray(f32.column("prediction")) != np.asarray(b16.column("prediction"))
+    assert np.mean(flipped) <= 0.05
+    assert np.all(confidence[flipped] < 0.9), "a saturated row flipped class"
+    # the deviation envelope binds the rows that kept their class (a flipped
+    # boundary row's probability legitimately crosses 0.5 — its deviation is
+    # the flip, already bounded above, not a ulp question)
+    keep = ~flipped
+    assert tier_ulp_diff(
+        np.asarray(f32.column(HEAD))[keep], np.asarray(b16.column(HEAD))[keep]
+    ) <= PRECISION_TIER_DEVIATION[("scale_logistic", "bf16")]
+    b16_fused = CompiledServingPlan.build(
+        servable,
+        scope="p-sat-bf",
+        fusion=FusionTier("fast", megakernel=False),
+        precision=PrecisionTier("bf16"),
+    ).execute(df)
+    _assert_within_tier(
+        b16, b16_fused, PRECISION_ULP_ENVELOPE[("scale_logistic", "bf16")], "saturated"
+    )
+
+
+def test_scale_mlp_bf16_envelopes():
+    servable = _scale_mlp_servable()
+    df = _vec_df(64, 256, col="features")
+    f32 = CompiledServingPlan.build(
+        servable, scope="p-mlp-f", precision=PrecisionTier("f32")
+    ).execute(df)
+    b16 = CompiledServingPlan.build(
+        servable, scope="p-mlp-b", precision=PrecisionTier("bf16")
+    ).execute(df)
+    assert tier_ulp_diff(f32.column(HEAD), b16.column(HEAD)) <= PRECISION_TIER_DEVIATION[
+        ("scale_mlp", "bf16")
+    ]
+    b16_fused = CompiledServingPlan.build(
+        servable,
+        scope="p-mlp-bf",
+        fusion=FusionTier("fast", megakernel=False),
+        precision=PrecisionTier("bf16"),
+    ).execute(df)
+    _assert_within_tier(
+        b16, b16_fused, PRECISION_ULP_ENVELOPE[("scale_mlp", "bf16")], "mlp within-tier"
+    )
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_feature6_batch_chain_bf16_envelopes(width):
+    stages = _feature6_stages(width)
+    df = _vec_df(N, width)
+    f32 = CompiledBatchPlan.build(
+        stages, scope=f"p-f6-f{width}", precision=PrecisionTier("f32")
+    ).transform(df)
+    b16_plan = CompiledBatchPlan.build(
+        stages, scope=f"p-f6-b{width}", precision=PrecisionTier("bf16")
+    )
+    b16 = b16_plan.transform(df)
+    # cross-tier: the chain's float head column (pre-binarize) stays inside
+    # the documented deviation; the binarized labels barely move.
+    dev = tier_ulp_diff(f32.column("rescaled"), b16.column("rescaled"))
+    env = PRECISION_TIER_DEVIATION[("feature6", "bf16")]
+    assert dev <= env, f"d={width}: {dev} > {env}"
+    flips = np.mean(np.asarray(f32.column("bin")) != np.asarray(b16.column("bin")))
+    assert flips < 0.01, f"binarize flipped {flips:.2%} of labels"
+    # within-tier: fused partition vs per-stage partition under bf16
+    b16_fused = CompiledBatchPlan.build(
+        stages,
+        scope=f"p-f6-bf{width}",
+        fusion=FusionTier("fast", megakernel=False),
+        precision=PrecisionTier("bf16"),
+    ).transform(df)
+    _assert_within_tier(
+        b16, b16_fused,
+        PRECISION_ULP_ENVELOPE[("feature6", "bf16")],
+        f"feature6 within-tier d={width}",
+    )
+
+
+def test_lowp_segments_build_no_megakernel_candidates():
+    """Megakernels are f32-only (their Pallas bodies carry no boundary
+    rounding): a lowp tier must stay on merged-XLA even when the chain is
+    hot enough to clear the score bar."""
+    from flink_ml_tpu.servable.planner import build_segments
+
+    servable = _scale_logistic_servable(16)
+    hot = FusionTier("fast", min_score=1.0)
+    (f32_seg,) = build_segments(list(servable.servables), None, hot)
+    assert list(f32_seg.mega) == [0]
+    (lowp_seg,) = build_segments(
+        list(servable.servables), None, hot, None, PrecisionTier("bf16")
+    )
+    assert lowp_seg.mega == {}
+
+
+# ---------------------------------------------------------------------------
+# int8: publish-time per-channel weight quantization (and only at publish)
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_array_int8_per_channel_scales():
+    rng = np.random.default_rng(4)
+    w = rng.normal(size=(8, 32)).astype(np.float32)
+    w[3] *= 100.0  # one hot channel must not poison the others' resolution
+    w[5] = 0.0  # all-zero channel passes through exactly
+    deq, scales = quantize_array_int8(w)
+    assert deq.dtype == w.dtype and scales.shape == (8,)
+    np.testing.assert_array_equal(deq[5], w[5])
+    for ch in range(8):
+        bound = scales[ch] / 2.0 + 1e-9  # round-to-nearest: half a step
+        assert np.max(np.abs(deq[ch] - w[ch])) <= bound, ch
+    # per-channel beats per-tensor: the un-scaled channels keep resolution
+    assert scales[0] < scales[3] / 10.0
+    # 1-D arrays: a single scale
+    v = rng.normal(size=64).astype(np.float32)
+    deq1, scales1 = quantize_array_int8(v)
+    assert scales1.shape == (1,)
+    assert np.max(np.abs(deq1 - v)) <= scales1[0] / 2.0 + 1e-9
+    # the grid is genuinely int8: at most 255 distinct quantized values
+    assert len(np.unique(deq1)) <= 255
+
+
+def test_quantizable_name_dtype_and_size_gating():
+    big = np.zeros(64, np.float32)
+    assert quantizable("coefficient", big)
+    assert quantizable("W0", big) and quantizable("W13", big)
+    assert quantizable("values", big) and quantizable("idf_values", big)
+    assert not quantizable("mean", big)  # precision-critical scaler state
+    assert not quantizable("b0", big)  # biases stay f32
+    assert not quantizable("coefficient", np.zeros(4, np.float32))  # too small
+    assert not quantizable("coefficient", np.zeros(64, np.int32))  # not float
+
+
+def test_fake_quant_int8_grid_and_zero_passthrough():
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.random.default_rng(6).normal(size=128), jnp.float32)
+    q = np.asarray(fake_quant_int8(x))
+    s = float(np.max(np.abs(np.asarray(x)))) / 127.0
+    assert np.max(np.abs(q - np.asarray(x))) <= s / 2.0 + 1e-9
+    assert len(np.unique(q)) <= 255
+    zeros = jnp.zeros(8, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(fake_quant_int8(zeros)), np.asarray(zeros))
+
+
+def test_int8_publish_roundtrip(tmp_path):
+    """publish_servable(precision="int8"): the artifact's wide head moved to
+    the int8 grid (manifest audited), the on-disk byte format is unchanged
+    (plain f32 npz), and the quantized version's predictions agree with the
+    f32 version's on held-out traffic."""
+    from flink_ml_tpu.models.classification.logistic_regression import (
+        LogisticRegression,
+    )
+    from flink_ml_tpu.servable.api import load_servable
+    from flink_ml_tpu.serving.registry import publish_servable
+
+    dim = 64
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(96, dim))
+    y = (X @ np.arange(1.0, dim + 1.0) > 0).astype(np.float64)
+    df = DataFrame.from_dict({"features": X, "label": y})
+    model = LogisticRegression().set_max_iter(10).set_global_batch_size(96).fit(df)
+
+    pub = str(tmp_path / "pub")
+    p1 = publish_servable(model, pub)  # v1: f32
+    p2 = publish_servable(model, pub, precision="int8")  # v2: int8
+    assert not os.path.exists(os.path.join(p1, PRECISION_MANIFEST))
+    with open(os.path.join(p2, PRECISION_MANIFEST), encoding="utf-8") as f:
+        manifest = json.load(f)
+    assert manifest["mode"] == "int8"
+    (key,) = [k for k in manifest["arrays"] if k.endswith("coefficient")]
+    entry = manifest["arrays"][key]
+    assert entry["dtype"] == "int8" and entry["channels"] == len(entry["scales"])
+
+    v1, v2 = load_servable(p1), load_servable(p2)
+    c1, c2 = np.asarray(v1.coefficient), np.asarray(v2.coefficient)
+    assert not np.array_equal(c1, c2)  # the weights genuinely moved...
+    assert np.max(np.abs(c1 - c2)) <= np.max(np.abs(c1)) / 127.0 + 1e-7  # ...a little
+    q = DataFrame.from_dict({"features": rng.normal(size=(64, dim))})
+    agree = np.mean(
+        np.asarray(v1.transform(q).column("prediction"))
+        == np.asarray(v2.transform(q).column("prediction"))
+    )
+    assert agree >= 0.98, agree
+
+    with pytest.raises(ValueError, match="precision"):
+        publish_servable(model, pub, precision="fp4")
+
+
+def test_serving_path_never_quantizes_poisoned_seam(monkeypatch):
+    """The poisoned-seam proof: every quantization entry point raises, and an
+    int8-tier server still builds, warms, and serves — because int8 weights
+    are a PUBLISH-time artifact property; at serve time the tier is exactly
+    the bf16 transport over whatever arrays the artifact holds."""
+    def _poisoned(*a, **k):
+        raise AssertionError("quantization ran on the serving path")
+
+    for fn in ("quantize_array_int8", "quantize_model_arrays",
+               "quantize_published_artifact", "fake_quant_int8"):
+        monkeypatch.setattr(precision_mod, fn, _poisoned)
+
+    servable = _scale_logistic_servable(16)
+    df = _vec_df(4, 16, col="features")
+    with InferenceServer(
+        servable,
+        name="p-seam",
+        serving_config=ServingConfig(max_delay_ms=0.1, precision_mode="int8"),
+        warmup_template=df.take([0]),
+    ) as server:
+        out = server.predict(df)
+        assert len(out.dataframe) == 4
+    # and the unquantized-artifact int8 tier is bitwise the bf16 transport
+    b16 = CompiledServingPlan.build(
+        _scale_logistic_servable(16), scope="p-seam-b", precision=PrecisionTier("bf16")
+    ).execute(df)
+    np.testing.assert_array_equal(
+        np.asarray(out.dataframe.column(HEAD)), np.asarray(b16.column(HEAD))
+    )
+
+
+# ---------------------------------------------------------------------------
+# mode flips rebuild cached plans (the PR 9/10 rebuild-key bug class)
+# ---------------------------------------------------------------------------
+
+
+def test_precision_mode_flip_rebuilds_cached_batch_plan():
+    model = PipelineModel(_feature6_stages(16))
+    df = _vec_df(64, 16)
+    f32_out = model.transform(df)
+    f32_plan = model._plan_cache[1]
+    assert not f32_plan.precision.lowp
+    config.set(Options.PRECISION_MODE, "bf16")
+    b16_out = model.transform(df)
+    b16_plan = model._plan_cache[1]
+    assert b16_plan is not f32_plan and b16_plan.precision.mode == "bf16"
+    assert not np.array_equal(
+        np.asarray(f32_out.column("rescaled")), np.asarray(b16_out.column("rescaled"))
+    )
+    config.set(Options.PRECISION_MODE, "f32")
+    again = model.transform(df)
+    assert model._plan_cache[1] is not b16_plan
+    _assert_bitexact(f32_out, again, "back to f32")  # bit-identical again
+
+
+def test_precision_mode_flip_rebuilds_serving_plan():
+    servable = _scale_logistic_servable(16)
+    df = _vec_df(4, 16, col="features")
+    with InferenceServer(
+        servable,
+        name="p-flip-f32",
+        serving_config=ServingConfig(max_delay_ms=0.1),
+        warmup_template=df.take([0]),
+    ) as server:
+        server.predict(df)
+        f32_plan = servable._fastpath_plan
+        assert not f32_plan.precision.lowp
+        assert getattr(servable, "_fastpath_plan_f32", None) is None  # no twin
+    with InferenceServer(
+        servable,
+        name="p-flip-b16",
+        serving_config=ServingConfig(max_delay_ms=0.1, precision_mode="bf16"),
+        warmup_template=df.take([0]),
+    ) as server:
+        server.predict(df)
+        b16_plan = servable._fastpath_plan
+        assert b16_plan is not f32_plan and b16_plan.precision.mode == "bf16"
+        # a lowp server keeps the f32 twin of the SAME version warm
+        assert servable._fastpath_plan_f32.precision.mode == "f32"
+
+
+# ---------------------------------------------------------------------------
+# plancache: per-tier digests, zero-compile resume per tier
+# ---------------------------------------------------------------------------
+
+
+def _lowered(dim=7, rows=4):
+    import jax.numpy as jnp
+
+    def f(models, cols):
+        return {"out": cols["x"] * models["w"]}
+
+    return jax.jit(f).lower(
+        {"w": np.ones(dim, np.float32)},
+        {"x": jax.ShapeDtypeStruct((rows, dim), jnp.float32)},
+    )
+
+
+def test_program_digest_carries_the_precision_key():
+    base = program_digest(_lowered(), kind="exact")
+    assert program_digest(_lowered(), kind="exact", precision_key=None) == base
+    b16 = program_digest(_lowered(), kind="exact", precision_key="bf16")
+    i8 = program_digest(_lowered(), kind="exact", precision_key="int8")
+    assert len({base, b16, i8}) == 3
+
+
+def test_plancache_zero_compile_resume_per_tier(tmp_path, monkeypatch):
+    """Both tiers of the same servable share one cache dir without
+    colliding: a second incarnation warms BOTH plans entirely from the
+    serialized executables (the compile seam poisoned), each tier
+    bit-identical to its own first incarnation."""
+    from flink_ml_tpu.servable import planner
+
+    config.set(Options.PLANCACHE_DIR, str(tmp_path / "plancache"))
+    buckets = power_of_two_buckets(8)
+    df = _vec_df(5, 7, col="features", seed=3)
+    template = df.take([0])
+    tiers = ("f32", "bf16")
+
+    first = {}
+    for mode in tiers:
+        plan = CompiledServingPlan.build(
+            _scale_logistic_servable(7), scope=f"p-pc1-{mode}",
+            precision=PrecisionTier(mode),
+        )
+        assert plan.plancache is not None
+        plan.warmup(template, buckets)
+        first[mode] = plan.execute(pad_to(df, 8))
+    assert not np.array_equal(
+        np.asarray(first["f32"].column(HEAD)), np.asarray(first["bf16"].column(HEAD))
+    )  # distinct entries genuinely hold distinct numerics
+
+    def _blocked(lowered):
+        raise AssertionError("XLA compile blocked — cache should have served this")
+
+    monkeypatch.setattr(planner, "_compile_lowered", _blocked)
+    for mode in tiers:
+        plan2 = CompiledServingPlan.build(
+            _scale_logistic_servable(7), scope=f"p-pc2-{mode}",
+            precision=PrecisionTier(mode),
+        )
+        plan2.warmup(template, buckets)
+        _assert_bitexact(first[mode], plan2.execute(pad_to(df, 8)), f"resume {mode}")
+
+
+# ---------------------------------------------------------------------------
+# sharding composes: bf16 boundary rounding through PlanSharding, mesh 2/4
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh", (2, 4))
+def test_sharded_bf16_parity(mesh):
+    if len(jax.devices()) < mesh:
+        pytest.skip(f"needs {mesh} devices")
+    stages = _feature6_stages(16)
+    df = _vec_df(64, 16)
+    unsharded = CompiledBatchPlan.build(
+        stages, scope=f"p-sh-u{mesh}", precision=PrecisionTier("bf16")
+    ).transform(df)
+    sharded = CompiledBatchPlan.build(
+        stages,
+        scope=f"p-sh-s{mesh}",
+        sharding=PlanSharding(mesh),
+        precision=PrecisionTier("bf16"),
+    ).transform(df)
+    # the ingest rounding is per-row elementwise, so the shard split commutes
+    # with it — sharded bf16 stays inside the within-tier envelope of the
+    # unsharded bf16 plan (observed bit-identical on XLA CPU)
+    _assert_within_tier(
+        unsharded, sharded, PRECISION_ULP_ENVELOPE[("feature6", "bf16")],
+        f"sharded bf16 mesh={mesh}",
+    )
+    assert metrics.get(f"p-sh-s{mesh}", MLMetrics.BATCH_SHARD_COUNT) == mesh
+    # and the sharded lowp leg still honors the cross-tier contract vs f32
+    f32 = CompiledBatchPlan.build(
+        stages, scope=f"p-sh-f{mesh}", precision=PrecisionTier("f32")
+    ).transform(df)
+    assert tier_ulp_diff(
+        f32.column("rescaled"), sharded.column("rescaled")
+    ) <= PRECISION_TIER_DEVIATION[("feature6", "bf16")]
+
+
+# ---------------------------------------------------------------------------
+# serving: zero post-warmup compiles per tier; drift falls back, then escalates
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ("f32", "bf16", "int8"))
+def test_serving_zero_compiles_after_warmup_per_tier(mode):
+    servable = _scale_logistic_servable(16)
+    df = _vec_df(4, 16, col="features")
+    with InferenceServer(
+        servable,
+        name=f"p-warm-{mode}",
+        serving_config=ServingConfig(max_delay_ms=0.1, precision_mode=mode),
+        warmup_template=df.take([0]),
+    ) as server:
+        scope = server.scope
+        before = metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+        for i in range(4):
+            out = server.predict(_vec_df(4, 16, col="features", seed=i))
+            assert len(out.dataframe) == 4
+        assert metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0) == before
+
+
+def test_manual_fallback_is_warm_journaled_and_reversible(tmp_path):
+    rec = telemetry.configure(str(tmp_path / "journal"))
+    try:
+        servable = _scale_logistic_servable(16)
+        df = _vec_df(4, 16, col="features")
+        with InferenceServer(
+            servable,
+            name="p-fb",
+            serving_config=ServingConfig(max_delay_ms=0.1, precision_mode="bf16"),
+            warmup_template=df.take([0]),
+        ) as server:
+            scope = server.scope
+            b16_out = server.predict(df)
+            ok, payload = server.health()
+            assert ok and payload["precision"] == {"mode": "bf16", "fallback": False}
+            before = metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+            assert server.precision_fallback("drift") is True
+            assert server.precision_fallback("drift") is True  # already active
+            f32_out = server.predict(df)
+            # the fallback plan was already warm: a plan SELECTION, no compile
+            assert metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0) == before
+            assert metrics.get(scope, MLMetrics.PRECISION_FALLBACKS) == 1
+            assert metrics.get(scope, MLMetrics.PRECISION_FALLBACK_ACTIVE) == 1
+            assert server.health()[1]["precision"]["fallback"] is True
+            assert not np.array_equal(
+                np.asarray(b16_out.dataframe.column(HEAD)),
+                np.asarray(f32_out.dataframe.column(HEAD)),
+            )
+            # the f32 answers are the f32 TIER's answers, bit-for-bit
+            ref = CompiledServingPlan.build(
+                _scale_logistic_servable(16), scope="p-fb-ref",
+                precision=PrecisionTier("f32"),
+            ).execute(df)
+            np.testing.assert_array_equal(
+                np.asarray(f32_out.dataframe.column(HEAD)), np.asarray(ref.column(HEAD))
+            )
+            server.precision_restore()
+            assert metrics.get(scope, MLMetrics.PRECISION_FALLBACK_ACTIVE) == 0
+            np.testing.assert_array_equal(
+                np.asarray(b16_out.dataframe.column(HEAD)),
+                np.asarray(server.predict(df).dataframe.column(HEAD)),
+            )
+        assert rec.flush(10.0)
+        falls = [
+            r for r in telemetry.read_journal(str(tmp_path / "journal"))
+            if r["kind"] == "precision.fallback"
+        ]
+        assert len(falls) == 1  # the double call journaled ONE decision
+        assert falls[0]["data"]["reason"] == "drift"
+    finally:
+        telemetry.configure(None)
+
+
+def test_f32_server_fallback_is_a_noop():
+    servable = _scale_logistic_servable(16)
+    df = _vec_df(4, 16, col="features")
+    with InferenceServer(
+        servable,
+        name="p-fb-f32",
+        serving_config=ServingConfig(max_delay_ms=0.1),
+        warmup_template=df.take([0]),
+    ) as server:
+        server.predict(df)
+        assert server.precision_fallback("drift") is False
+        assert server.health()[1]["precision"] is None
+
+
+def test_drift_fallback_then_escalation_to_rollback(tmp_path):
+    """The closed loop on a bf16 server: a regressed drift verdict first
+    falls back to the warm f32 plan of the SAME version (no rollback, zero
+    compiles, one journaled decision); only when the regression persists on
+    f32-served traffic does the NEXT verdict take the version rollback."""
+    from flink_ml_tpu.linalg.vectors import DenseVector
+    from flink_ml_tpu.loop import ContinuousLearningLoop, ContinuousTrainer, DriftMonitor
+    from flink_ml_tpu.models.classification.online_logistic_regression import (
+        OnlineLogisticRegression,
+    )
+    from flink_ml_tpu.models.online import QueueBatchStream
+
+    D = 8
+    true_w = np.linspace(1.0, -1.0, D)
+
+    def batch(n=64, seed=0, flip=False):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, D))
+        y = (X @ true_w > 0).astype(np.float64)
+        return {"features": X.astype(np.float64), "label": (1.0 - y) if flip else y}
+
+    rec = telemetry.configure(str(tmp_path / "journal"))
+    try:
+        name = "p-loop"
+        scope = f"{MLMetrics.LOOP_GROUP}[{name}]"
+        stream = QueueBatchStream()
+        trainer = ContinuousTrainer(
+            OnlineLogisticRegression()
+            .set_initial_model_data(
+                DataFrame(["coefficient"], None, [[DenseVector(np.zeros(D))]])
+            )
+            .set_alpha(1.0)
+            .set_global_batch_size(64),
+            stream,
+            str(tmp_path / "pub"),
+            publish_every_versions=2,
+            scope=scope,
+        )
+        server = InferenceServer(
+            name=name,
+            serving_config=ServingConfig(
+                max_batch_size=8, max_delay_ms=0.5, precision_mode="bf16"
+            ),
+            warmup_template=DataFrame.from_dict(
+                {"features": batch(1, seed=99)["features"]}
+            ),
+        )
+        loop = ContinuousLearningLoop(
+            trainer,
+            server,
+            eval_source=lambda: DataFrame.from_dict(batch(32, seed=7)),
+            name=name,
+            monitor=DriftMonitor(window=2, rel_threshold=0.2, min_scores=1, scope=scope),
+        )
+        try:
+            # phase 1: healthy versions served on the bf16 tier
+            for i in range(4):
+                stream.add(batch(seed=i))
+            loop.run(publish_target=2, max_steps=8)
+            good = server.model_version
+            assert good is not None and not server.precision_fallback_active
+
+            # phase 2: a label-flipped version regresses → precision fallback
+            for i in range(2):
+                stream.add(batch(seed=50 + i, flip=True))
+            reports = loop.run(publish_target=3, max_steps=8)
+            bad = server.model_version
+            assert bad > good
+            assert all(r.rolled_back_to is None for r in reports)  # NOT a rollback
+            assert server.precision_fallback_active
+            assert server.model_version == bad  # same version, f32 plan
+            assert metrics.get(server.scope, MLMetrics.PRECISION_FALLBACKS) == 1
+            assert metrics.get(scope, MLMetrics.LOOP_ROLLBACKS, 0) == 0
+            # the f32 twin was kept warm the whole time: zero serving compiles
+            assert not metrics.get(server.scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+
+            # phase 3: the regression persists on f32 traffic (the model is
+            # genuinely bad) → the next verdict escalates to the rollback
+            report = loop.step()
+            assert report.rolled_back_to == good
+            assert server.model_version == good
+            assert metrics.get(scope, MLMetrics.LOOP_ROLLBACKS) == 1
+            # still exactly one fallback decision in the journal — the
+            # escalation did not loop through another fallback
+            assert rec.flush(10.0)
+            falls = [
+                r for r in telemetry.read_journal(str(tmp_path / "journal"))
+                if r["kind"] == "precision.fallback"
+            ]
+            assert len(falls) == 1
+            assert falls[0]["data"]["reason"] == "drift"
+        finally:
+            server.close()
+    finally:
+        telemetry.configure(None)
+
+
+def test_fallback_auto_off_goes_straight_to_rollback_path():
+    """precision.fallback.auto=false: the loop's remediation guard is
+    config-gated — _maybe_rollback must skip the fallback branch (unit-level
+    pin of the guard; the integration path is the slow test above)."""
+    config.set(Options.PRECISION_FALLBACK_AUTO, False)
+    assert config.get(Options.PRECISION_FALLBACK_AUTO) is False
+    config.set(Options.PRECISION_FALLBACK_AUTO, True)
+    assert config.get(Options.PRECISION_FALLBACK_AUTO) is True
+
+
+# ---------------------------------------------------------------------------
+# tier_ulp_diff itself (the cross-tier measuring stick)
+# ---------------------------------------------------------------------------
+
+
+def test_tier_ulp_diff_floors_near_zero_elements():
+    ref = np.asarray([10.0, -8.0, 1e-6], np.float32)  # last element ≪ RMS
+    # a catastrophic RELATIVE move on the tiny element is absolutely fine
+    moved = np.asarray([10.0, -8.0, -1e-6], np.float32)
+    assert tier_ulp_diff(ref, moved) == 0
+    # but an absolutely large move on a floored element fails ANY envelope
+    blown = np.asarray([10.0, -8.0, 5.0], np.float32)
+    assert tier_ulp_diff(ref, blown) == 2**31
+    # elements above the floor measure exactly like fusion.ulp_diff
+    a = np.asarray([1.0, 2.0], np.float32)
+    b = np.nextafter(a, np.float32(10.0))
+    assert tier_ulp_diff(a, b) == 1
+    assert tier_ulp_diff(a, a) == 0
+    assert tier_ulp_diff(np.zeros(0, np.float32), np.zeros(0, np.float32)) == 0

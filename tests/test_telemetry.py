@@ -697,3 +697,23 @@ class TestBenchTrend:
         import tools.bench_trend as bench_trend
 
         assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+
+    def test_new_metrics_and_rows_reported_informationally(self, tmp_path, capsys):
+        import tools.bench_trend as bench_trend
+
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps({"workloads": [{"name": "row", "latency_p50_ms": 1.0}]}),
+            encoding="utf-8",
+        )
+        (tmp_path / "BENCH_r02.json").write_text(
+            json.dumps({"workloads": [
+                {"name": "row", "latency_p50_ms": 1.0, "bf16_latency_p50_ms": 0.7},
+                {"name": "precision_sweep", "latency_p50_ms": 0.5},
+            ]}),
+            encoding="utf-8",
+        )
+        assert bench_trend.main(["--dir", str(tmp_path), "--strict"]) == 0
+        out = capsys.readouterr().out
+        assert "+ new row precision_sweep" in out
+        assert "bf16_latency_p50_ms" in out and "(NEW)" in out
+        assert "WARN" not in out and "REGRESSION" not in out

@@ -83,6 +83,13 @@ def compare_workloads(
     warnings: List[str] = []
     old_flat = flatten_numeric(old)
     new_flat = flatten_numeric(new)
+    # Metrics present only in the newer round (a workload grew a column —
+    # e.g. a new precision tier's latency leg) are reported informationally
+    # as NEW: there is no baseline to regress against, so never a warning.
+    for key in sorted(set(new_flat) - set(old_flat)):
+        if _direction(key) is None:
+            continue
+        lines.append(f"    {key:<48} {'—':>12} -> {new_flat[key]:>12.4g} (NEW)")
     for key in sorted(set(old_flat) & set(new_flat)):
         direction = _direction(key)
         if direction is None:

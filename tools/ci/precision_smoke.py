@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""CI precision smoke: the low-precision serving tier end to end.
+
+Publishes the same trained pipeline twice — v-1 plain f32 and v-2 with
+post-training int8 weight quantization (``publish_servable(...,
+precision="int8")``, manifest audited) — then serves a burst through every
+precision tier and checks (any failure exits 1):
+
+- ZERO ``ml.serving.fastpath.compiles`` after warmup in EACH tier (f32,
+  bf16, int8) — warmup coverage includes the lowp plan AND its warm f32
+  fallback twin;
+- f32-tier responses are bit-identical per row to the per-stage reference
+  transform (the precision axis must not perturb the default path);
+- bf16-tier responses stay inside the documented cross-tier deviation
+  envelope (``PRECISION_TIER_DEVIATION['scale_logistic']``,
+  docs/precision.md) with the class labels unmoved;
+- a drift regression injected mid-burst (a DriftMonitor verdict on scored
+  tail traffic) triggers the automatic fallback to the WARM f32 plan of the
+  same version: every in-flight and subsequent request resolves exactly
+  once, zero compiles appear, and post-fallback answers are bit-identical
+  to the f32 tier's.
+
+Driven by tools/ci/run_tests.sh after the fusion smoke.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+from concurrent.futures import ThreadPoolExecutor
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def main() -> int:
+    import numpy as np
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.loop import DriftMonitor, auc
+    from flink_ml_tpu.metrics import MLMetrics, metrics
+    from flink_ml_tpu.models.classification.logistic_regression import (
+        LogisticRegression,
+    )
+    from flink_ml_tpu.servable.api import load_servable
+    from flink_ml_tpu.servable.precision import (
+        PRECISION_MANIFEST,
+        PRECISION_TIER_DEVIATION,
+        tier_ulp_diff,
+    )
+    from flink_ml_tpu.serving import InferenceServer, ServingConfig
+    from flink_ml_tpu.serving.registry import publish_servable
+
+    dim = 32
+    rng = np.random.default_rng(23)
+    X = rng.normal(size=(96, dim))
+    y = (X @ np.linspace(1.0, -1.0, dim) > 0).astype(np.float64)
+    train = DataFrame.from_dict({"features": X, "label": y})
+    model = LogisticRegression().set_max_iter(10).set_global_batch_size(96).fit(train)
+
+    burst = DataFrame.from_dict({"features": rng.normal(size=(4, dim))})
+    template = burst.take([0])
+
+    with tempfile.TemporaryDirectory() as registry:
+        # --- publish: v-1 f32, v-2 int8 (quantization at publish ONLY) -----
+        p_f32 = publish_servable(model, registry)
+        p_int8 = publish_servable(model, registry, precision="int8")
+        if os.path.exists(os.path.join(p_f32, PRECISION_MANIFEST)):
+            print("FAIL: the f32 artifact grew a precision manifest")
+            return 1
+        if not os.path.exists(os.path.join(p_int8, PRECISION_MANIFEST)):
+            print("FAIL: the int8 artifact has no precision manifest")
+            return 1
+
+        reference = load_servable(p_f32)
+        ref_out = reference.transform(burst)
+
+        # --- serve a burst per tier: zero post-warmup compiles each --------
+        tier_outs = {}
+        for mode, artifact in (("f32", p_f32), ("bf16", p_f32), ("int8", p_int8)):
+            servable = load_servable(artifact)
+            with InferenceServer(
+                servable,
+                name=f"precision-smoke-{mode}",
+                serving_config=ServingConfig(max_delay_ms=0.1, precision_mode=mode),
+                warmup_template=template,
+            ) as server:
+                before = metrics.get(server.scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+                outs = [server.predict(burst) for _ in range(16)]
+                compiles = (
+                    metrics.get(server.scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+                    - before
+                )
+                if compiles:
+                    print(
+                        f"FAIL: {compiles} fast-path compiles after warmup in "
+                        f"precision.mode={mode}"
+                    )
+                    return 1
+                tier_outs[mode] = outs[0].dataframe
+
+        # f32 bit-exact vs the per-stage reference
+        for col in ("prediction", "rawPrediction"):
+            if not np.array_equal(
+                np.asarray(tier_outs["f32"].column(col)), np.asarray(ref_out.column(col))
+            ):
+                print(f"FAIL: f32 tier not bit-identical on {col}")
+                return 1
+        # bf16 inside the documented cross-tier envelope, labels unmoved
+        envelope = PRECISION_TIER_DEVIATION[("scale_logistic", "bf16")]
+        moved = tier_ulp_diff(
+            tier_outs["f32"].column("rawPrediction"),
+            tier_outs["bf16"].column("rawPrediction"),
+        )
+        if moved > envelope:
+            print(f"FAIL: bf16 tier moved {moved} ulps (envelope {envelope})")
+            return 1
+        if not np.array_equal(
+            np.asarray(tier_outs["f32"].column("prediction")),
+            np.asarray(tier_outs["bf16"].column("prediction")),
+        ):
+            print("FAIL: bf16 tier flipped a class label on the burst")
+            return 1
+        # int8 (quantized weights + bf16 transport): labels still agree
+        agree = np.mean(
+            np.asarray(tier_outs["f32"].column("prediction"))
+            == np.asarray(tier_outs["int8"].column("prediction"))
+        )
+        if agree < 1.0:
+            print(f"FAIL: int8 tier label agreement {agree:.2%} on the burst")
+            return 1
+
+        # --- drift regression mid-burst -> automatic f32 fallback ----------
+        servable = load_servable(p_f32)
+        with InferenceServer(
+            servable,
+            name="precision-smoke-drift",
+            # one request per device batch (no cross-request coalescing), so
+            # every response is bucket-4 and bit-comparable against the two
+            # tiers' reference answers
+            serving_config=ServingConfig(
+                max_batch_size=4, max_delay_ms=0.1, precision_mode="bf16"
+            ),
+            warmup_template=template,
+        ) as server:
+            scope = server.scope
+            before = metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0)
+            bf16_head = np.asarray(server.predict(burst).dataframe.column("rawPrediction"))
+
+            # the injected regression: a healthy baseline window, then scored
+            # tail traffic collapsing to chance — the DriftMonitor verdict is
+            # the trigger, exactly as the continuous loop wires it
+            monitor = DriftMonitor(
+                window=2, rel_threshold=0.2, min_scores=1,
+                higher_is_better=True, scope=scope,
+            )
+            monitor.observe(0, auc(y, y))  # baseline version: perfect tail AUC
+            monitor.observe(1, 0.5)  # live version: chance — regressed
+            if not monitor.regressed(1, 0):
+                print("FAIL: injected drift did not produce a regressed verdict")
+                return 1
+
+            results = []
+            with ThreadPoolExecutor(max_workers=4) as pool:
+                futures = [pool.submit(server.predict, burst) for _ in range(16)]
+                # flip mid-burst, while requests are in flight
+                if not server.precision_fallback("drift"):
+                    print("FAIL: precision_fallback did not engage")
+                    return 1
+                futures += [pool.submit(server.predict, burst) for _ in range(16)]
+                for f in futures:
+                    results.append(f.result())  # raises -> CI fail
+
+            if len(results) != 32 or any(len(r.dataframe) != len(burst) for r in results):
+                print("FAIL: a burst request was lost or truncated across the fallback")
+                return 1
+            compiles = (
+                metrics.get(scope, MLMetrics.SERVING_FASTPATH_COMPILES, 0) - before
+            )
+            if compiles:
+                print(f"FAIL: {compiles} compiles appeared across the fallback flip")
+                return 1
+            if not server.precision_fallback_active:
+                print("FAIL: fallback did not stay active")
+                return 1
+            # every response is one tier or the other, bit-for-bit; once the
+            # flip settled, responses are the f32 tier's
+            f32_head = np.asarray(tier_outs["f32"].column("rawPrediction"))
+            for r in results:
+                head = np.asarray(r.dataframe.column("rawPrediction"))
+                if not (np.array_equal(head, bf16_head) or np.array_equal(head, f32_head)):
+                    print("FAIL: a mid-burst response matches neither tier bit-for-bit")
+                    return 1
+            post = np.asarray(server.predict(burst).dataframe.column("rawPrediction"))
+            if not np.array_equal(post, f32_head):
+                print("FAIL: post-fallback answers are not the f32 tier's")
+                return 1
+            if metrics.get(scope, MLMetrics.PRECISION_FALLBACKS) != 1:
+                print("FAIL: fallback counter != 1")
+                return 1
+
+    print(
+        "precision smoke OK: f32/int8 published, all tiers warm-covered "
+        "(0 compiles), f32 bit-identical, bf16 inside the deviation envelope, "
+        "drift fallback landed on the warm f32 plan mid-burst with every "
+        "request resolved exactly once"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

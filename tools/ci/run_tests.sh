@@ -53,6 +53,15 @@ python tools/traceview.py "${sharded_artifact}" --scope ml.serving | grep -A 3 "
 echo "=== fusion smoke (exact + fast tiers, zero post-warmup compiles) ==="
 python tools/ci/fusion_smoke.py
 
+# Precision smoke: publish f32 + int8 artifacts, serve a burst through every
+# precision tier with zero post-warmup compiles, f32 bit-identical to the
+# per-stage reference, bf16 inside the documented cross-tier deviation
+# envelope — then inject a drift regression mid-burst and prove the
+# automatic fallback to the warm f32 plan of the same version with every
+# request resolved exactly once (docs/precision.md).
+echo "=== precision smoke (f32/bf16/int8 tiers + drift fallback mid-burst) ==="
+python tools/ci/precision_smoke.py
+
 # Chaos smoke: a seeded open-loop ramp to ~2.2x saturation with
 # serving.dispatch + serving.swap armed against a live server — no deadlock,
 # typed-error-only failures with retry context, priority sheds before any

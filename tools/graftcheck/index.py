@@ -73,7 +73,7 @@ __all__ = [
 
 #: Bump whenever the shape/semantics of extracted facts change — it is part of
 #: the disk-cache key, so stale caches self-invalidate.
-FACTS_VERSION = 5  # 5: contract dataflow — config reads, raise sites, metric names
+FACTS_VERSION = 6  # 6: low-precision cast sites (the precision-tier boundary contract)
 
 KERNELS_MODULE = "flink_ml_tpu.ops.kernels"
 
@@ -101,6 +101,16 @@ REDUCTION_PRIMS = {
 #: the sparse-convention hook) — kernel-spec-consistency and
 #: elementwise-claim treat both identically.
 SPEC_DEF_NAMES = ("kernel_spec", "sparse_kernel_spec")
+
+#: Sub-f32 dtype tokens. A cast to one of these inside a kernel body breaks
+#: the precision-tier boundary contract (servable/precision.py): kernel math
+#: — above all its accumulators — is always f32; the tier's rounding happens
+#: at program ingest/stage boundaries in the planner, never in-body.
+LOWP_DTYPE_TOKENS = {
+    "bfloat16", "float16", "half", "int8", "uint8",
+    "float8_e4m3fn", "float8_e5m2",
+    "bf16", "fp16", "f16",  # string-literal spellings
+}
 
 _LOCK_CTORS = {"Lock", "RLock"}
 _TIME_ATTRS = {"time", "perf_counter", "monotonic", "time_ns", "perf_counter_ns"}
@@ -649,6 +659,7 @@ class _Extractor:
             "param_branches": [],  # [line, [param names in value-wise branch test]]
             "scalar_loop_vars": [],
             "reductions": [],  # [prim, line]
+            "casts": [],  # [lowp dtype token, line] — astype/convert_element_type/dtype=
             "is_kernel_spec": fn.name in SPEC_DEF_NAMES,
             "spec_trivial": True,
             "spec_refs": [],  # kernel bases referenced inside (kernel_spec only)
@@ -953,6 +964,10 @@ class _Extractor:
         prim = _reduction_prim(call)
         if prim is not None:
             ff["reductions"].append([prim, call.lineno])
+        # low-precision cast sites (the precision-tier boundary contract)
+        tok = _lowp_cast_token(call)
+        if tok is not None:
+            ff["casts"].append([tok, call.lineno])
 
         # jitted-by-name call sites with scalar loop-var args
         if isinstance(func, ast.Name):
@@ -1238,6 +1253,45 @@ def _reduction_prim(call: ast.Call) -> Optional[str]:
         return func.attr
     if isinstance(func, ast.Name) and func.id in REDUCTION_PRIMS:
         return func.id
+    return None
+
+
+def _dtype_token(node: ast.AST) -> Optional[str]:
+    """The low-precision dtype a dtype expression names, if any —
+    ``jnp.bfloat16`` / bare ``bfloat16`` / the string ``"bfloat16"``."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value
+    else:
+        return None
+    return name if name in LOWP_DTYPE_TOKENS else None
+
+
+def _lowp_cast_token(call: ast.Call) -> Optional[str]:
+    """A call site that casts to a sub-f32 dtype: ``x.astype(bf16)``,
+    ``lax.convert_element_type(x, bf16)``, or any ``dtype=bf16`` /
+    ``new_dtype=`` / ``preferred_element_type=`` keyword."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr == "astype" and call.args:
+        tok = _dtype_token(call.args[0])
+        if tok is not None:
+            return tok
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr == "convert_element_type"
+        and len(call.args) >= 2
+    ):
+        tok = _dtype_token(call.args[1])
+        if tok is not None:
+            return tok
+    for kw in call.keywords:
+        if kw.arg in ("dtype", "new_dtype", "preferred_element_type"):
+            tok = _dtype_token(kw.value)
+            if tok is not None:
+                return tok
     return None
 
 

@@ -23,6 +23,16 @@ friends (``index.REDUCTION_PRIMS``).
 Reduction-bearing kernels are fine — Normalizer's row norm, DCT's matmul and
 the model heads all keep their own programs — they just must not *claim*
 elementwise. Unset is always safe, merely unmerged.
+
+Since the precision tier (PR 19, ``servable/precision.py``) the same scope
+carries a second claim: **kernel bodies are precision-neutral**. The bf16
+tier rounds at program ingest and stage boundaries in the *planner*; a cast
+to a sub-f32 dtype inside a kernels-module body (or inside a
+``kernel_spec``'s glue) would downcast an accumulator in BOTH partitions —
+silently changing f32-tier numerics and voiding the elementwise/merge
+claims. :class:`KernelCastBoundaryRule` flags every such cast (the index's
+``casts`` fact: ``astype``/``convert_element_type``/``dtype=`` naming
+bfloat16/float16/int8 and friends).
 """
 from __future__ import annotations
 
@@ -96,4 +106,46 @@ class ElementwiseClaimRule(Rule):
                                 "split the reduction into its own spec",
                             )
                         )
+        return findings
+
+
+@register
+class KernelCastBoundaryRule(Rule):
+    name = "kernel-cast-boundary"
+    severity = "error"
+    description = (
+        "no sub-f32 cast inside kernels-module bodies or kernel_spec glue — "
+        "the precision tier rounds ONLY at planner stage boundaries"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        index = project.index
+        findings: List[Finding] = []
+        for rel in sorted(index.files):
+            f = index.files[rel]
+            if not rel.startswith("flink_ml_tpu/"):
+                continue
+            in_kernels = rel == KERNELS_REL
+            for qual, ff in f["functions"].items():
+                # Scope: every kernels-module body (the shared fused-math
+                # surface) plus kernel_spec/sparse_kernel_spec glue anywhere
+                # (nested defs inherit the spec's qual prefix).
+                owner = qual.split(".<locals>.")[0]
+                owner_ff = f["functions"].get(owner, ff)
+                if not in_kernels and not owner_ff.get("is_kernel_spec"):
+                    continue
+                for tok, line in ff.get("casts", ()):
+                    findings.append(
+                        self.finding(
+                            rel,
+                            line,
+                            f"cast to sub-f32 dtype `{tok}` inside "
+                            f"{'ops/kernels body' if in_kernels else 'kernel_spec glue'} "
+                            f"`{qual}` — kernel math is precision-neutral "
+                            "(f32 accumulation); the bf16 tier rounds at "
+                            "planner stage boundaries only "
+                            "(servable/precision.py). Remove the in-body "
+                            "downcast",
+                        )
+                    )
         return findings

@@ -73,6 +73,7 @@ class PlanKeyCompletenessRule(Rule):
         "flink_ml_tpu.serving.server:InferenceServer._plan_for",
         "flink_ml_tpu.serving.plan:CompiledServingPlan.build",
         "flink_ml_tpu.servable.fusion:resolve_fusion_tier",
+        "flink_ml_tpu.servable.precision:resolve_precision_tier",
     )
 
     #: Key-composition functions per rebuild-key surface: an option read inside
@@ -85,6 +86,7 @@ class PlanKeyCompletenessRule(Rule):
         "serving-rebuild": (
             "flink_ml_tpu.servable.sharding:resolve_plan_sharding",
             "flink_ml_tpu.servable.fusion:resolve_fusion_tier",
+            "flink_ml_tpu.servable.precision:resolve_precision_tier",
             "flink_ml_tpu.servable.sparse:resolve_sparse_hints",
             "flink_ml_tpu.serving.server:ServingConfig.__init__",
         ),
@@ -92,6 +94,7 @@ class PlanKeyCompletenessRule(Rule):
             "flink_ml_tpu.servable.plancache:program_digest",
             "flink_ml_tpu.servable.sharding:resolve_plan_sharding",
             "flink_ml_tpu.servable.fusion:resolve_fusion_tier",
+            "flink_ml_tpu.servable.precision:resolve_precision_tier",
             "flink_ml_tpu.servable.sparse:resolve_sparse_hints",
         ),
     }
@@ -111,6 +114,12 @@ class PlanKeyCompletenessRule(Rule):
         # Gates whether sparse hints exist at all; hints feed the sparse_key leg
         # of all three surfaces, so a flip rebuilds everywhere.
         "SPARSE_FASTPATH": (
+            "batch-fingerprint", "serving-rebuild", "plancache-digest",
+        ),
+        # The precision tier (PR 19): the batch fingerprint reads it directly,
+        # ServingConfig/resolve_precision_tier feed the server's rebuild
+        # comparison, and program_digest appends the tier's cache_key leg.
+        "PRECISION_MODE": (
             "batch-fingerprint", "serving-rebuild", "plancache-digest",
         ),
     }
