@@ -1092,6 +1092,94 @@ def bench_kmeans(peak_gbps):
     return out
 
 
+def bench_training_weak_scaling():
+    """Weak-scaling sweep of the sharded training tier
+    (docs/distributed_training.md): per-shard work held FIXED while
+    ``train.mesh`` sweeps 1/2/4/8, so ideal scaling is flat epoch time and
+    linearly growing rows/s. Two legs: the sharded KMeans epoch (mapreduce
+    centroid update) and the deterministic-tier SGD step.
+
+    Honest-1-core-box note: on the CI host the 8 "devices" are XLA virtual
+    CPU devices time-sharing one core, so epoch time grows ~linearly with
+    width instead of holding flat — the sweep here is an overhead/regression
+    gate (deal + collective cost at each width, bit-identity priced in),
+    not a scaling demonstration; the flat-epoch claim needs >= width cores
+    or real chips.
+    """
+    import jax
+
+    from flink_ml_tpu.api.dataframe import DataFrame
+    from flink_ml_tpu.config import Options, config
+    from flink_ml_tpu.models.clustering.kmeans import KMeans
+    from flink_ml_tpu.ops import SGD, BinaryLogisticLoss
+    from flink_ml_tpu.parallel import TrainSharding
+
+    widths = [w for w in (1, 2, 4, 8) if w <= len(jax.devices())]
+    rng = np.random.default_rng(5)
+    rows_per_shard, dim = 8_192, 8
+    i1, i2 = 3, 23
+
+    out = {
+        "name": "training_weak_scaling",
+        "rows_per_shard": rows_per_shard,
+        "dim": dim,
+        "note": (
+            "weak scaling: per-shard rows fixed, total rows = width x "
+            "per-shard; measured on XLA virtual CPU devices time-sharing "
+            "one core, so per-epoch time is an overhead gate, not a "
+            "scaling demo (see docstring)"
+        ),
+        "kmeans_epoch": {},
+        "sgd_step": {},
+    }
+    for w in widths:
+        n = rows_per_shard * w
+        df = DataFrame.from_dict({"features": rng.random((n, dim))})
+        config.set(Options.TRAIN_MESH, w)
+        try:
+            def fit(iters):
+                KMeans().set_seed(2).set_k(4).set_max_iter(iters).fit(df)
+
+            t1 = _median_time(lambda: fit(i1), repeats=3)
+            t2 = _median_time(lambda: fit(i2), repeats=3)
+            epoch_s = (t2 - t1) / (i2 - i1) if t2 > t1 else None
+        finally:
+            config.unset(Options.TRAIN_MESH)
+        out["kmeans_epoch"][f"mesh_{w}"] = {
+            "total_rows": n,
+            "epoch_p50_ms": None if epoch_s is None else round(epoch_s * 1e3, 3),
+            "rows_per_sec": None if epoch_s is None else round(n / epoch_s, 1),
+        }
+
+    sgd_batch = 64 * 8  # one quantum multiple at every width
+    for w in widths:
+        n = rows_per_shard * w
+        X = rng.normal(size=(n, dim)).astype(np.float32)
+        y = (X.sum(axis=1) > 0).astype(np.float32)
+        data = {"features": X, "labels": y}
+        ts = TrainSharding(w)
+
+        def opt(iters):
+            SGD(
+                max_iter=iters,
+                learning_rate=0.1,
+                global_batch_size=sgd_batch,
+                tol=0.0,
+                sharding=ts,
+            ).optimize(np.zeros(dim), data, BinaryLogisticLoss.INSTANCE)
+
+        t1 = _median_time(lambda: opt(i1), repeats=3)
+        t2 = _median_time(lambda: opt(i2), repeats=3)
+        step_s = (t2 - t1) / (i2 - i1) if t2 > t1 else None
+        out["sgd_step"][f"mesh_{w}"] = {
+            "total_rows": n,
+            "global_batch": sgd_batch,
+            "step_p50_ms": None if step_s is None else round(step_s * 1e3, 3),
+            "rows_per_sec": None if step_s is None else round(sgd_batch / step_s, 1),
+        }
+    return out
+
+
 def bench_serving():
     """Offered-load sweep over the online serving runtime (docs/serving.md).
 
@@ -3228,5 +3316,8 @@ if __name__ == "__main__":
         sys.exit(0)
     if "precision_sweep" in sys.argv[1:]:
         print(json.dumps(bench_precision_sweep(), indent=2))
+        sys.exit(0)
+    if "training_weak_scaling" in sys.argv[1:]:
+        print(json.dumps(bench_training_weak_scaling(), indent=2))
         sys.exit(0)
     sys.exit(main())

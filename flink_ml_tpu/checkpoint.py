@@ -42,8 +42,10 @@ from flink_ml_tpu.metrics import MLMetrics, metrics
 
 __all__ = [
     "CheckpointManager",
+    "ShardedCheckpointManager",
     "CheckpointCorruptError",
     "FingerprintMismatchError",
+    "MeshMismatchError",
     "scan_numbered_dirs",
 ]
 
@@ -102,12 +104,27 @@ class FingerprintMismatchError(ValueError):
     """
 
 
+class MeshMismatchError(ValueError):
+    """A snapshot with per-shard leaves was saved on a different mesh shape.
+
+    Fatal like ``FingerprintMismatchError`` (and for the same reason): falling
+    back to an older snapshot cannot fix a job resuming sharded training state
+    onto an incompatible mesh — the operator must restart on the saved mesh
+    shape or point the job at a fresh directory. Snapshots whose leaves are
+    all replicated never raise this: they restore on any mesh.
+    """
+
+
 def _fsync_path(path: str) -> None:
     fd = os.open(path, os.O_RDONLY)
     try:
         os.fsync(fd)
     finally:
         os.close(fd)
+
+
+def _crc(arr) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
 class CheckpointManager:
@@ -171,32 +188,33 @@ class CheckpointManager:
         faults.trip("checkpoint.save", step=step)
         leaves, treedef = jax.tree_util.tree_flatten(state)
         host_leaves = [np.asarray(jax.device_get(leaf)) for leaf in leaves]
+        meta = {
+            "step": step,
+            "num_leaves": len(host_leaves),
+            "fingerprint": self.fingerprint,
+            "crc32s": [_crc(leaf) for leaf in host_leaves],
+        }
+        return self._write_snapshot(
+            step, {f"leaf_{i}": leaf for i, leaf in enumerate(host_leaves)},
+            treedef, meta,
+        )
+
+    def _write_snapshot(self, step: int, entries: dict, treedef, meta: dict) -> str:
+        """The atomic + durable write every snapshot layout shares (flat
+        leaves here, per-shard pieces in ``ShardedCheckpointManager``):
+        tmp dir + per-file fsync + rename + dir fsync, META.json last."""
         final_dir = os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
         tmp_dir = final_dir + ".tmp"
         if os.path.exists(tmp_dir):
             shutil.rmtree(tmp_dir)
         os.makedirs(tmp_dir)
-        np.savez(
-            os.path.join(tmp_dir, "arrays.npz"),
-            **{f"leaf_{i}": leaf for i, leaf in enumerate(host_leaves)},
-        )
+        np.savez(os.path.join(tmp_dir, "arrays.npz"), **entries)
         with open(os.path.join(tmp_dir, "treedef.pkl"), "wb") as f:
             pickle.dump(treedef, f)
             f.flush()
             os.fsync(f.fileno())
         with open(os.path.join(tmp_dir, "META.json"), "w") as f:
-            json.dump(
-                {
-                    "step": step,
-                    "num_leaves": len(host_leaves),
-                    "fingerprint": self.fingerprint,
-                    "crc32s": [
-                        zlib.crc32(np.ascontiguousarray(leaf).tobytes()) & 0xFFFFFFFF
-                        for leaf in host_leaves
-                    ],
-                },
-                f,
-            )
+            json.dump(meta, f)
             f.flush()
             os.fsync(f.fileno())
         _fsync_path(os.path.join(tmp_dir, "arrays.npz"))
@@ -322,3 +340,175 @@ class CheckpointManager:
         steps = self.all_steps()
         for step in steps[: -self.max_to_keep] if self.max_to_keep else []:
             shutil.rmtree(self._step_dir(step))
+
+
+class ShardedCheckpointManager(CheckpointManager):
+    """Per-shard snapshots of mesh-resident training state (train.mesh tier).
+
+    Drop-in for ``CheckpointManager`` everywhere the iteration drivers accept
+    one — same ``save``/``restore_latest`` contract, same atomicity, CRC32,
+    quarantine and fallback discipline. The difference is the leaf layout: a
+    device array whose sharding is NOT fully replicated is written as one
+    ``leaf_{i}_piece_{j}`` entry per distinct shard index (shard-local D2H —
+    the host never materializes the gathered global array at save time), and
+    META.json records the mesh shape plus each piece's global placement.
+    ``restore`` reassembles global host arrays (placement back onto the mesh
+    is the resuming fit's job) and raises the typed — and, like a fingerprint
+    mismatch, fatal — ``MeshMismatchError`` when per-shard pieces meet a
+    manager configured for a different mesh shape. Snapshots holding only
+    replicated/host leaves restore on ANY mesh (width-portable: e.g. KMeans
+    centroids killed at mesh=2 resume at mesh=4).
+
+    ``sharding``: a ``TrainSharding``/``MeshContext``-shaped object (duck
+    typed ``n_data``/``n_model`` — this module stays importable below the
+    parallel tier) or an ``(n_data, n_model)`` tuple; None skips the mesh
+    compatibility check.
+    """
+
+    _FORMAT = "sharded-v1"
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 2,
+        fingerprint: Optional[str] = None,
+        sharding=None,
+    ):
+        super().__init__(directory, max_to_keep=max_to_keep, fingerprint=fingerprint)
+        if sharding is None:
+            self.mesh_shape: Optional[Tuple[int, int]] = None
+        elif isinstance(sharding, tuple):
+            self.mesh_shape = (int(sharding[0]), int(sharding[1]))
+        else:
+            self.mesh_shape = (int(sharding.n_data), int(sharding.n_model))
+
+    @staticmethod
+    def _leaf_pieces(leaf):
+        """None for host/replicated leaves; else the deduped per-shard pieces
+        ``[(bounds, host_piece), ...]`` sorted by position, where ``bounds``
+        is ``((start, stop), ...)`` per dim. Replica copies (e.g. the model
+        axis of a data-sharded leaf) are skipped — one piece per distinct
+        index, so the snapshot stores each element exactly once."""
+        if not isinstance(leaf, jax.Array):
+            return None
+        try:
+            if leaf.sharding.is_fully_replicated:
+                return None
+        except AttributeError:
+            return None
+        seen = {}
+        for shard in leaf.addressable_shards:
+            bounds = tuple(
+                (
+                    0 if s.start is None else int(s.start),
+                    int(leaf.shape[d]) if s.stop is None else int(s.stop),
+                )
+                for d, s in enumerate(shard.index)
+            )
+            if bounds not in seen:
+                seen[bounds] = np.asarray(jax.device_get(shard.data))
+        return sorted(seen.items())
+
+    def save(self, step: int, state: Any) -> str:
+        faults.trip("checkpoint.save", step=step)
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        entries: dict = {}
+        descs: List[Optional[dict]] = []
+        crcs: dict = {}
+        n_pieces = 0
+        for i, leaf in enumerate(leaves):
+            pieces = self._leaf_pieces(leaf)
+            if pieces is None:
+                host = np.asarray(jax.device_get(leaf))
+                entries[f"leaf_{i}"] = host
+                crcs[f"leaf_{i}"] = _crc(host)
+                descs.append(None)
+                continue
+            descs.append(
+                {
+                    "shape": [int(x) for x in leaf.shape],
+                    "dtype": np.dtype(leaf.dtype).name,
+                    "pieces": [[list(b) for b in bounds] for bounds, _ in pieces],
+                }
+            )
+            for j, (_bounds, piece) in enumerate(pieces):
+                name = f"leaf_{i}_piece_{j}"
+                entries[name] = piece
+                crcs[name] = _crc(piece)
+                n_pieces += 1
+        if n_pieces:
+            metrics.counter(
+                MLMetrics.CHECKPOINT_GROUP,
+                MLMetrics.CHECKPOINT_SHARD_PIECES,
+                n_pieces,
+            )
+        meta = {
+            "format": self._FORMAT,
+            "step": step,
+            "num_leaves": len(leaves),
+            "fingerprint": self.fingerprint,
+            "mesh": list(self.mesh_shape) if self.mesh_shape else None,
+            "leaves": descs,
+            "crc32s": crcs,
+        }
+        return self._write_snapshot(step, entries, treedef, meta)
+
+    def restore(self, step: int) -> Any:
+        ckpt_dir = self._step_dir(step)
+        meta = self._read_meta(step)
+        if meta.get("format") != self._FORMAT:
+            # A plain snapshot in this directory (e.g. the run started on the
+            # flat manager before the mesh tier was enabled): read it as-is.
+            return super().restore(step)
+        try:
+            with open(os.path.join(ckpt_dir, "treedef.pkl"), "rb") as f:
+                treedef = pickle.load(f)
+            with np.load(os.path.join(ckpt_dir, "arrays.npz")) as z:
+                data = {name: z[name] for name in z.files}
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:  # OSError, KeyError, BadZipFile, UnpicklingError, ...
+            raise CheckpointCorruptError(step, ckpt_dir, f"snapshot unreadable: {e!r}")
+        for name, crc in (meta.get("crc32s") or {}).items():
+            if name not in data:
+                raise CheckpointCorruptError(step, ckpt_dir, f"{name} missing")
+            actual = _crc(data[name])
+            if actual != crc:
+                raise CheckpointCorruptError(
+                    step,
+                    ckpt_dir,
+                    f"{name} checksum mismatch (crc32 {actual:#x} != recorded {crc:#x})",
+                )
+        descs = meta.get("leaves")
+        if descs is None or len(descs) != meta.get("num_leaves"):
+            raise CheckpointCorruptError(
+                step, ckpt_dir, "leaf descriptor table missing or truncated"
+            )
+        saved_mesh = meta.get("mesh")
+        if (
+            any(d is not None for d in descs)
+            and self.mesh_shape is not None
+            and saved_mesh is not None
+            and tuple(saved_mesh) != self.mesh_shape
+        ):
+            raise MeshMismatchError(
+                f"checkpoint step {step} holds per-shard leaves saved on mesh "
+                f"{tuple(saved_mesh)}, but this run's train mesh is "
+                f"{self.mesh_shape}; resume on the saved mesh shape or start "
+                "from a fresh directory"
+            )
+        leaves = []
+        for i, desc in enumerate(descs):
+            if desc is None:
+                if f"leaf_{i}" not in data:
+                    raise CheckpointCorruptError(step, ckpt_dir, f"leaf_{i} missing")
+                leaves.append(data[f"leaf_{i}"])
+                continue
+            out = np.zeros(tuple(desc["shape"]), np.dtype(desc["dtype"]))
+            for j, bounds in enumerate(desc["pieces"]):
+                name = f"leaf_{i}_piece_{j}"
+                if name not in data:
+                    raise CheckpointCorruptError(step, ckpt_dir, f"{name} missing")
+                out[tuple(slice(a, b) for a, b in bounds)] = data[name]
+            leaves.append(out)
+        return jax.tree_util.tree_unflatten(treedef, leaves)

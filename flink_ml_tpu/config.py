@@ -79,6 +79,32 @@ class Options:
         65_536,
         "Per-shard HBM window size (rows) for streamed larger-than-HBM training.",
     )
+    TRAIN_MESH = ConfigOption(
+        "train.mesh",
+        int,
+        None,
+        "Data-axis width of the sharded TRAINING mesh "
+        "(parallel/train_sharding.py). Unset: legacy single-mesh training. "
+        "Set — including 1 — training runs the deterministic sharded tier: "
+        "block-cyclic data deal, mapreduce collectives, epochs bit-identical "
+        "across mesh widths (docs/distributed_training.md).",
+    )
+    TRAIN_MESH_MODEL = ConfigOption(
+        "train.mesh.model",
+        int,
+        1,
+        "Model-axis width of the sharded training mesh (tensor parallelism "
+        "for wide coefficients; rides the non-deterministic psum seam).",
+    )
+    TRAIN_MESH_HOSTS = ConfigOption(
+        "train.mesh.hosts",
+        int,
+        1,
+        "Host count of a multi-host training run. >1 arms the one guarded "
+        "jax.distributed.initialize() call (coordinator/process env per the "
+        "standard JAX contract); 1 — the default — never touches the "
+        "distributed runtime.",
+    )
     MESH_DATA_AXIS_SIZE = ConfigOption(
         "mesh.data.axis.size",
         int,

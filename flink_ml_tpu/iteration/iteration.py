@@ -67,7 +67,11 @@ class IterationConfig:
     operator_life_cycle: OperatorLifeCycle = OperatorLifeCycle.ALL_ROUND
     max_epochs: Optional[int] = None  # hard safety bound on top of criteria
     checkpoint_interval: int = 0  # epochs between state snapshots; 0 = off
-    checkpoint_manager: Any = None  # flink_ml_tpu.checkpoint.CheckpointManager
+    #: ``flink_ml_tpu.checkpoint.CheckpointManager`` — or its
+    #: ``ShardedCheckpointManager`` subclass when the variables are train-mesh
+    #: resident (same save/restore_latest contract, per-shard leaf layout);
+    #: the drivers never inspect which.
+    checkpoint_manager: Any = None
     pipeline_depth: Optional[int] = None
 
 

@@ -145,7 +145,15 @@ class ContinuousTrainer:
         telemetry.emit(
             "loop.publish",
             self.scope,
-            {"version": version, "adopted": path is None},
+            {
+                "version": version,
+                "adopted": path is None,
+                # Provenance of the published weights: the train-mesh width
+                # that produced them (0 = legacy single-device trainer). Lets
+                # the loop dashboards correlate serving regressions with
+                # trainer-topology changes.
+                "train_mesh": int(config.get(Options.TRAIN_MESH) or 0),
+            },
         )
         return path
 

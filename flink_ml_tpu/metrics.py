@@ -39,6 +39,14 @@ class MLMetrics:
     CHECKPOINT_QUARANTINED = "ml.checkpoint.quarantined"
     CHECKPOINT_FALLBACKS = "ml.checkpoint.fallbacks"
     CHECKPOINT_TMP_SWEPT = "ml.checkpoint.tmp.swept"
+    CHECKPOINT_SHARD_PIECES = "ml.checkpoint.shard.pieces"  # per-shard leaves written, counter
+
+    # Sharded-training counters (scope = TRAIN_GROUP, process-global —
+    # parallel/train_sharding.py, docs/distributed_training.md).
+    TRAIN_GROUP = "ml.train"
+    TRAIN_SHARD_INGEST_ROWS = "ml.train.shard.ingest.rows"  # rows dealt onto the mesh, counter
+    TRAIN_SHARD_PAD_ROWS = "ml.train.shard.pad.rows"  # zero-mask padding rows, counter
+    TRAIN_SHARDED_FITS = "ml.train.sharded.fits"  # fits run on the deterministic tier, counter
 
     # Online-serving runtime (scope = "ml.serving[<server name>]" — see
     # docs/serving.md for the full table).

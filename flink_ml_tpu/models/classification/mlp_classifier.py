@@ -50,7 +50,9 @@ from flink_ml_tpu.params.shared import (
     HasSeed,
     HasTol,
 )
+from flink_ml_tpu.metrics import MLMetrics, metrics
 from flink_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext, get_mesh_context
+from flink_ml_tpu.parallel.train_sharding import resolve_train_sharding
 from flink_ml_tpu.utils import read_write as rw
 
 __all__ = ["MLPClassifier", "MLPClassifierModel"]
@@ -320,13 +322,21 @@ class MLPClassifier(Estimator, _MlpParams):
         labels = np.unique(data["labels"])
         label_to_idx = {v: i for i, v in enumerate(labels)}
         y_idx = np.asarray([label_to_idx[v] for v in data["labels"]], np.float32)
-        ctx = get_mesh_context()
+        # train.mesh drives the MLP's data parallelism too: the resolved
+        # TrainSharding supplies the mesh and the replicated layer placement
+        # (this fit keeps its psum reduction — the bit-stability contract
+        # covers SGD/KMeans; here the mesh width is a throughput knob).
+        ts = resolve_train_sharding()
+        ctx = ts.ctx if ts is not None else get_mesh_context()
         cache = DeviceDataCache(
             {"x": data["features"], "y": y_idx, "w": data["weights"]}, ctx=ctx
         )
         dims = [data["features"].shape[1], *[int(h) for h in self.get_hidden_layers()], len(labels)]
         rng = np.random.default_rng(self.get_seed())
         params = [tuple(jnp.asarray(a) for a in layer) for layer in _init_params(rng, dims)]
+        if ts is not None:
+            params = ts.place_state(params)
+            metrics.counter(MLMetrics.TRAIN_GROUP, MLMetrics.TRAIN_SHARDED_FITS)
         optimizer = optax.adam(self.get_learning_rate())
         opt_state = optimizer.init(params)
 
@@ -389,7 +399,8 @@ class MLPClassifier(Estimator, _MlpParams):
             from flink_ml_tpu.config import Options, config
 
             window_rows = config.get(Options.TRAIN_STREAM_WINDOW_ROWS)
-        ctx = get_mesh_context()
+        ts = resolve_train_sharding()
+        ctx = ts.ctx if ts is not None else get_mesh_context()
         if classes is None:
             uniq: set = set()
             for chunk in cache.iter_rows():
@@ -426,6 +437,9 @@ class MLPClassifier(Estimator, _MlpParams):
         )
         rng = np.random.default_rng(self.get_seed())
         params = [tuple(jnp.asarray(a) for a in layer) for layer in _init_params(rng, dims)]
+        if ts is not None:
+            params = ts.place_state(params)
+            metrics.counter(MLMetrics.TRAIN_GROUP, MLMetrics.TRAIN_SHARDED_FITS)
         optimizer = optax.adam(self.get_learning_rate())
         fused = self._build_fused(
             ctx, optimizer, local_batch, sched.chunk_len,

@@ -21,10 +21,12 @@ standard fix and never changes results when all clusters stay populated).
 from __future__ import annotations
 
 import functools
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from flink_ml_tpu.api.core import Estimator, Model
 from flink_ml_tpu.api.types import DataTypes
@@ -40,7 +42,9 @@ from flink_ml_tpu.params.shared import (
     HasPredictionCol,
     HasSeed,
 )
+from flink_ml_tpu.parallel.collectives import mapreduce_sum
 from flink_ml_tpu.parallel.mesh import get_mesh_context
+from flink_ml_tpu.parallel.train_sharding import TrainSharding, resolve_train_sharding
 
 __all__ = ["KMeans", "KMeansModel", "HasK"]
 
@@ -79,6 +83,95 @@ def _partial_step(measure_name: str, k: int):
     return jax.jit(
         lambda centroids, X, mask: _assign_partials(measure, k, centroids, X, mask)
     )
+
+
+def _sharded_epoch_tot(measure, k: int, centroids, X, mask, axis_name, n_data):
+    """Per-shard deterministic epoch reduction: per-row ``[k, d+1]``
+    assignment contributions (``[one_hot·x | one_hot]`` — sums and counts in
+    one tensor) folded with ``collectives.mapreduce_sum``'s width-invariant
+    block/tree association instead of the matmul+psum. Returns the replicated
+    totals ``tot [k, d+1]`` (``tot[:, :-1]`` sums, ``tot[:, -1]`` counts) —
+    bit-identical at every mesh width for the same global point order
+    (docs/distributed_training.md). Costs a transient ``[B_local, k, d+1]``
+    contribution tensor, so streamed callers keep chunks modest."""
+    assign = measure.find_closest(X, centroids)
+    hot = jax.nn.one_hot(assign, k, dtype=X.dtype) * mask[:, None]
+    aug = jnp.concatenate([X, jnp.ones((X.shape[0], 1), X.dtype)], axis=1)
+    contrib = hot[:, :, None] * aug[:, None, :]  # [B_local, k, d+1]
+    return mapreduce_sum(contrib, axis_name if n_data > 1 else None, n_data)
+
+
+def _tot_update(tot, centroids):
+    """Centroid update from replicated totals — the same zero-count-keeps-
+    centroid rule as ``_epoch_update``."""
+    sums, counts = tot[:, :-1], tot[:, -1]
+    safe = jnp.maximum(counts, 1.0)[:, None]
+    return jnp.where(counts[:, None] > 0, sums / safe, centroids), counts
+
+
+# Keyed on the mesh (hashable) rather than the TrainSharding instance so two
+# equal-width shardings share compiled programs, like _FUSED_CACHE in ops/.
+_SHARDED_PROGRAMS: Dict[tuple, object] = {}
+
+
+def _sharded_train_loop(measure_name: str, k: int, n_epochs: int, ts: TrainSharding):
+    """The deterministic (train.mesh) analogue of ``_train_loop``: the whole
+    fused fit as one shard_map'd scan, reducing through the width-invariant
+    mapreduce tier so the fit is bit-identical across mesh widths."""
+    key = ("loop", measure_name, k, n_epochs, ts.mesh)
+    prog = _SHARDED_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    measure = DistanceMeasure.get_instance(measure_name)
+    axis, n_data = ts.data_axes, ts.n_data
+
+    def per_shard(centroids, X, mask):  # graftcheck: hot-root
+        def epoch(carry, _):
+            c, _counts = carry
+            tot = _sharded_epoch_tot(measure, k, c, X, mask, axis, n_data)
+            return _tot_update(tot, c), None
+
+        init = (centroids, jnp.zeros((k,), X.dtype))
+        (c, counts), _ = jax.lax.scan(epoch, init, None, length=n_epochs)
+        return c, counts
+
+    prog = jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=ts.mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=(P(), P()),
+        )
+    )
+    _SHARDED_PROGRAMS[key] = prog
+    return prog
+
+
+def _sharded_partial(measure_name: str, k: int, ts: TrainSharding):
+    """Per-chunk deterministic totals for the streamed fit — the
+    CentroidsUpdateAccumulator role, but the chunk's cross-shard reduce
+    happens ON DEVICE (replicated ``tot``), so the epoch accumulates chunk
+    totals with device adds and syncs the host exactly once per epoch."""
+    key = ("partial", measure_name, k, ts.mesh)
+    prog = _SHARDED_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    measure = DistanceMeasure.get_instance(measure_name)
+    axis, n_data = ts.data_axes, ts.n_data
+
+    def per_shard(centroids, X, mask):  # graftcheck: hot-root
+        return _sharded_epoch_tot(measure, k, centroids, X, mask, axis, n_data)
+
+    prog = jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=ts.mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=P(),
+        )
+    )
+    _SHARDED_PROGRAMS[key] = prog
+    return prog
 
 
 @functools.cache
@@ -167,13 +260,28 @@ class KMeans(
         rng = np.random.default_rng(self.get_seed())
         init = X[rng.choice(X.shape[0], size=k, replace=False)]
 
-        ctx = get_mesh_context()
-        cache = DeviceDataCache({"x": X}, ctx=ctx)
-        # TerminateOnMaxIter is a pure epoch count, so the whole loop fuses into
-        # one scan program — the host-loop driver (iterate_bounded_until_termination)
-        # is only needed when a criteria requires a host scalar between epochs.
-        loop = _train_loop(self.get_distance_measure(), k, self.get_max_iter())
-        centroids, counts = loop(ctx.replicate(init), cache["x"], cache.mask)
+        ts = resolve_train_sharding()
+        if ts is not None and ts.n_model == 1:
+            # The deterministic sharded tier (train.mesh): block-cyclic deal
+            # ingest + width-invariant mapreduce — the fit is bit-identical
+            # at every mesh width (docs/distributed_training.md).
+            from flink_ml_tpu.metrics import MLMetrics, metrics
+
+            cache = ts.deal_cache({"x": X})
+            loop = _sharded_train_loop(
+                self.get_distance_measure(), k, self.get_max_iter(), ts
+            )
+            centroids, counts = loop(ts.replicate(init), cache["x"], cache.mask)
+            metrics.counter(MLMetrics.TRAIN_GROUP, MLMetrics.TRAIN_SHARDED_FITS)
+        else:
+            ctx = get_mesh_context()
+            cache = DeviceDataCache({"x": X}, ctx=ctx)
+            # TerminateOnMaxIter is a pure epoch count, so the whole loop fuses
+            # into one scan program — the host-loop driver
+            # (iterate_bounded_until_termination) is only needed when a criteria
+            # requires a host scalar between epochs.
+            loop = _train_loop(self.get_distance_measure(), k, self.get_max_iter())
+            centroids, counts = loop(ctx.replicate(init), cache["x"], cache.mask)
         model = KMeansModel()
         update_existing_params(model, self)
         model.centroids = np.asarray(jax.device_get(centroids), np.float64)
@@ -211,6 +319,9 @@ class KMeans(
         from flink_ml_tpu.iteration.stream import rebatch
 
         ctx = get_mesh_context()
+        ts = resolve_train_sharding()
+        if ts is not None and ts.n_model != 1:
+            ts = None  # the deterministic tier is data-parallel only
         k = self.get_k()
         n = int(cache.num_rows)
         if n < k:
@@ -224,29 +335,65 @@ class KMeans(
             import hashlib
             import json as _json
 
+            sig = {
+                "algo": "KMeans.fit_stream",
+                "k": k,
+                "seed": self.get_seed(),
+                "max_iter": self.get_max_iter(),
+                "distance": self.get_distance_measure(),
+                "rows": n,
+                "dim": int(init.shape[1]),
+            }
+            if ts is not None:
+                # The deterministic tier's epoch math is width-invariant, so
+                # the fingerprint records the TIER, not the width: a run
+                # killed at mesh=2 resumes on mesh=4 and lands on the
+                # identical model. Legacy host-fold runs keep their hash.
+                sig["tier"] = "deterministic"
             checkpoint_manager.set_fingerprint(
                 hashlib.sha256(
-                    _json.dumps(
-                        {
-                            "algo": "KMeans.fit_stream",
-                            "k": k,
-                            "seed": self.get_seed(),
-                            "max_iter": self.get_max_iter(),
-                            "distance": self.get_distance_measure(),
-                            "rows": n,
-                            "dim": int(init.shape[1]),
-                        },
-                        sort_keys=True,
-                    ).encode()
+                    _json.dumps(sig, sort_keys=True).encode()
                 ).hexdigest()[:16]
             )
         partial = _partial_step(self.get_distance_measure(), k)
+        sharded = (
+            _sharded_partial(self.get_distance_measure(), k, ts)
+            if ts is not None
+            else None
+        )
         data = ReplayableDataStreamList(replay={"points": cache})
         final_counts = np.zeros(k, np.float32)
+
+        def _sharded_body(centroids, points):
+            """One deterministic epoch: per-chunk replicated [k, d+1] totals
+            accumulate ON DEVICE in fixed chunk order — no host sync per
+            chunk (dispatches pipeline behind each chunk's H2D deal); the
+            host reads the epoch's totals exactly once. Chunk boundaries are
+            host-side and width-invariant, so the epoch is bit-identical
+            across mesh widths."""
+            c_dev = ts.replicate(np.asarray(centroids, np.float32))
+            total = None
+            for chunk in rebatch(points, chunk_rows):
+                window = ts.deal_cache(
+                    {"x": np.asarray(chunk["features"], np.float32)}
+                )
+                tot = sharded(c_dev, window["x"], window.mask)
+                total = tot if total is None else total + tot
+            return np.asarray(jax.device_get(total), np.float32)
 
         def body(variables, epoch, streams):
             nonlocal final_counts
             (centroids,) = variables
+            if ts is not None:
+                tot = _sharded_body(centroids, streams["points"])
+                sums, counts = tot[:, :-1], tot[:, -1]
+                new = np.where(
+                    counts[:, None] > 0,
+                    sums / np.maximum(counts, 1.0)[:, None],
+                    np.asarray(centroids, np.float32),
+                ).astype(np.float32)
+                final_counts = counts.astype(np.float64)
+                return IterationBodyResult([new], outputs=[new])
             c_dev = ctx.replicate(np.asarray(centroids, np.float32))
             sums = np.zeros((k, init.shape[1]), np.float64)
             counts = np.zeros(k, np.float64)
@@ -294,15 +441,23 @@ class KMeans(
             # snapshot IS the final model; recompute assignment counts with
             # the final centroids (one streamed pass, no centroid update).
             _, (centroids,) = checkpoint_manager.restore_latest()
-            sums = np.zeros(k, np.float64)
-            c_dev = ctx.replicate(np.asarray(centroids, np.float32))
-            for chunk in rebatch(cache.iter_rows(), chunk_rows):
-                window = DeviceDataCache(
-                    {"x": np.asarray(chunk["features"], np.float32)}, ctx=ctx
-                )
-                _, counts = partial(c_dev, window["x"], window.mask)
-                sums += np.asarray(jax.device_get(counts), np.float64)
-            final_counts = sums
+            if ts is not None:
+                tot = _sharded_body(centroids, cache.iter_rows())
+                final_counts = tot[:, -1].astype(np.float64)
+            else:
+                sums = np.zeros(k, np.float64)
+                c_dev = ctx.replicate(np.asarray(centroids, np.float32))
+                for chunk in rebatch(cache.iter_rows(), chunk_rows):
+                    window = DeviceDataCache(
+                        {"x": np.asarray(chunk["features"], np.float32)}, ctx=ctx
+                    )
+                    _, counts = partial(c_dev, window["x"], window.mask)
+                    sums += np.asarray(jax.device_get(counts), np.float64)
+                final_counts = sums
+        if ts is not None:
+            from flink_ml_tpu.metrics import MLMetrics, metrics
+
+            metrics.counter(MLMetrics.TRAIN_GROUP, MLMetrics.TRAIN_SHARDED_FITS)
         model = KMeansModel()
         update_existing_params(model, self)
         model.centroids = np.asarray(centroids, np.float64)

@@ -26,7 +26,8 @@ import numpy as np
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.api.dataframe import DataFrame
 from flink_ml_tpu.api.types import DataTypes
-from flink_ml_tpu.models.clustering.kmeans import HasK, _predict_step
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.models.clustering.kmeans import HasK, _predict_step, _sharded_partial
 from flink_ml_tpu.models.online import (
     HasCheckpointing,
     OnlineModelBase,
@@ -34,6 +35,7 @@ from flink_ml_tpu.models.online import (
     as_batch_stream,
 )
 from flink_ml_tpu.ops.distance import DistanceMeasure
+from flink_ml_tpu.parallel.train_sharding import resolve_train_sharding
 from flink_ml_tpu.params.param import update_existing_params
 from flink_ml_tpu.params.shared import (
     HasBatchStrategy,
@@ -67,6 +69,32 @@ def _update_step(measure_name: str, k: int, decay: float):
         return new_centroids, new_weights
 
     return step
+
+
+@functools.cache
+def _blend_step(k: int, decay: float):
+    """Decay/blend applied to the mapreduced ``tot`` of one global batch.
+
+    The elementwise half of the online update, split out so the sharded tier
+    can feed it the deterministic ``_sharded_partial`` reduction: all inputs
+    and outputs are replicated on the train mesh, so the program is identical
+    at every mesh width — bit-stability of the online trajectory reduces to
+    bit-stability of ``tot``, which the collectives tier guarantees.
+    """
+
+    @jax.jit
+    def blend(centroids, weights, tot):
+        counts = tot[:, -1]
+        sums = tot[:, :-1]
+        decayed = weights * decay
+        new_weights = decayed + counts
+        lam = jnp.where(new_weights > 0, counts / jnp.maximum(new_weights, 1e-16), 0.0)
+        means = sums / jnp.maximum(counts, 1.0)[:, None]
+        blended = (1.0 - lam[:, None]) * centroids + lam[:, None] * means
+        new_centroids = jnp.where(counts[:, None] > 0, blended, centroids)
+        return new_centroids, new_weights
+
+    return blend
 
 
 class OnlineKMeansModel(
@@ -137,20 +165,61 @@ class OnlineKMeans(
         centroids0, weights0 = self._initial_model
         if centroids0.shape[0] != k:
             raise ValueError(f"initial model has {centroids0.shape[0]} centroids, k={k}")
-        step = _update_step(self.get_distance_measure(), k, self.get_decay_factor())
         features_col = self.get_features_col()
         stream, bounded = as_batch_stream(data, self.get_global_batch_size())
 
-        def train_step(state, batch):
-            centroids, weights = state
-            X = jnp.asarray(np.asarray(batch[features_col], np.float32))
-            centroids, weights = step(centroids, weights, X)
-            return (centroids, weights), (np.asarray(centroids), np.asarray(weights))
+        ts = resolve_train_sharding()
+        if ts is not None and ts.n_model != 1:
+            ts = None  # deterministic tier covers the data-parallel layout only
+        if ts is not None:
+            # Sharded per-batch update: the deterministic chunk reduction
+            # batch KMeans streams through, followed by the replicated
+            # decay/blend — state stays mesh-resident between batches, and the
+            # published (host) snapshot per batch is the same readback the
+            # legacy path pays.
+            sharded = _sharded_partial(self.get_distance_measure(), k, ts)
+            blend = _blend_step(k, self.get_decay_factor())
+
+            def train_step(state, batch):
+                centroids, weights = state
+                window = ts.deal_cache(
+                    {"x": np.asarray(batch[features_col], np.float32)}
+                )
+                tot = sharded(centroids, window["x"], window.mask)
+                centroids, weights = blend(centroids, weights, tot)
+                return (centroids, weights), (
+                    np.asarray(centroids),
+                    np.asarray(weights),
+                )
+
+            state0 = (
+                ts.replicate(np.asarray(centroids0, np.float32)),
+                ts.replicate(np.asarray(weights0, np.float32)),
+            )
+            metrics.counter(MLMetrics.TRAIN_GROUP, MLMetrics.TRAIN_SHARDED_FITS)
+        else:
+            step = _update_step(
+                self.get_distance_measure(), k, self.get_decay_factor()
+            )
+
+            def train_step(state, batch):
+                centroids, weights = state
+                X = jnp.asarray(np.asarray(batch[features_col], np.float32))
+                centroids, weights = step(centroids, weights, X)
+                return (centroids, weights), (
+                    np.asarray(centroids),
+                    np.asarray(weights),
+                )
+
+            state0 = (
+                jnp.asarray(centroids0, jnp.float32),
+                jnp.asarray(weights0, jnp.float32),
+            )
 
         driver = self._snapshot_driver(
             stream,
             train_step,
-            (jnp.asarray(centroids0, jnp.float32), jnp.asarray(weights0, jnp.float32)),
+            state0,
             payload_from_state=lambda s: (np.asarray(s[0]), np.asarray(s[1])),
             dim=int(centroids0.shape[1]),
             init=array_digest(centroids0, weights0),

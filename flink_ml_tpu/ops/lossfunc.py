@@ -51,6 +51,21 @@ class LossFunc:
             "for sparse (padded-CSR) training"
         )
 
+    def row_loss_and_mult(self, dot, y, w):
+        """(per-row loss [n], per-row ∂loss/∂dot [n]) — UNreduced.
+
+        The deterministic sharded tier (parallel/collectives.py mapreduce)
+        needs the per-row terms so the reduction order is fixed by the fold,
+        not by ``jnp.sum``'s shape-dependent lowering. ``loss_and_mult`` is
+        exactly ``(jnp.sum(row_loss), mult)``; the three reference losses
+        implement both from one margin formula.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement row_loss_and_mult; "
+            "required for the deterministic sharded training tier "
+            "(train.mesh — docs/distributed_training.md)"
+        )
+
 
 class BinaryLogisticLoss(LossFunc):
     """Ref BinaryLogisticLoss.java: loss = w·log(1 + exp(−dot·ys));
@@ -69,11 +84,14 @@ class BinaryLogisticLoss(LossFunc):
         return loss, X.T @ multiplier
 
     def loss_and_mult(self, dot, y, w):
+        row_loss, mult = self.row_loss_and_mult(dot, y, w)
+        return jnp.sum(row_loss), mult
+
+    def row_loss_and_mult(self, dot, y, w):
         ys = 2.0 * y - 1.0
         z = dot * ys
-        loss = jnp.sum(w * jax.nn.softplus(-z))
         # -ys/(exp(z)+1) = -ys * sigmoid(-z)
-        return loss, w * (-ys * jax.nn.sigmoid(-z))
+        return w * jax.nn.softplus(-z), w * (-ys * jax.nn.sigmoid(-z))
 
 
 class HingeLoss(LossFunc):
@@ -92,10 +110,13 @@ class HingeLoss(LossFunc):
         return loss, X.T @ multiplier
 
     def loss_and_mult(self, dot, y, w):
+        row_loss, mult = self.row_loss_and_mult(dot, y, w)
+        return jnp.sum(row_loss), mult
+
+    def row_loss_and_mult(self, dot, y, w):
         ys = 2.0 * y - 1.0
         margin = 1.0 - ys * dot
-        loss = jnp.sum(w * jnp.maximum(margin, 0.0))
-        return loss, jnp.where(margin > 0.0, -ys * w, 0.0)
+        return w * jnp.maximum(margin, 0.0), jnp.where(margin > 0.0, -ys * w, 0.0)
 
 
 class LeastSquareLoss(LossFunc):
@@ -113,9 +134,12 @@ class LeastSquareLoss(LossFunc):
         return loss, X.T @ multiplier
 
     def loss_and_mult(self, dot, y, w):
+        row_loss, mult = self.row_loss_and_mult(dot, y, w)
+        return jnp.sum(row_loss), mult
+
+    def row_loss_and_mult(self, dot, y, w):
         err = dot - y
-        loss = jnp.sum(w * 0.5 * err * err)
-        return loss, w * err
+        return w * 0.5 * err * err, w * err
 
 
 BinaryLogisticLoss.INSTANCE = BinaryLogisticLoss()

@@ -61,12 +61,17 @@ from flink_ml_tpu.ops.lossfunc import LossFunc
 # the schedules themselves live at the compute tier so linalg can plan
 # windows without importing this runtime-coupled module.
 from flink_ml_tpu.ops.schedule import chunked_schedule, offset_schedule
+from flink_ml_tpu.parallel.collectives import mapreduce_sum
 from flink_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     MeshContext,
     get_mesh_context,
     is_tpu_backend,
+)
+from flink_ml_tpu.parallel.train_sharding import (
+    TrainSharding,
+    resolve_train_sharding,
 )
 
 __all__ = ["Optimizer", "SGD", "regularize"]
@@ -118,6 +123,8 @@ def _sgd_epoch_math(
     dtype,
     model_sharded: bool = False,
     data_axes=DATA_AXIS,
+    deterministic: bool = False,
+    n_data: int = 1,
 ):
     """One epoch of the per-shard SGD update (shared by the host-loop step and the
     fused whole-run program). ``start`` is the clamped slice start and ``offset``
@@ -125,7 +132,20 @@ def _sgd_epoch_math(
     supplied by the caller so the fused path can feed a *precomputed* schedule.
     ``feats`` is either a dense [m, d] array or a padded-CSR
     ``(indices [m, K], values [m, K])`` pair (linalg/sparse_batch.py).
-    Returns (new_coef, mean_loss)."""
+    Returns (new_coef, mean_loss).
+
+    ``deterministic`` (dense data-parallel only — the train.mesh tier) swaps
+    the psum/jnp.sum reduction for ``collectives.mapreduce_sum``'s
+    width-invariant block/tree fold over per-row contributions: the update is
+    bit-identical at every mesh width for the same global schedule
+    (docs/distributed_training.md). Requires ``local_batch`` a multiple of
+    8·``n_data`` (TrainSharding.round_batch) and a block-cyclically dealt
+    batch (ShardedTrainCache)."""
+    if deterministic and (model_sharded or isinstance(feats, tuple)):
+        raise ValueError(
+            "deterministic reduction covers the dense data-parallel layout "
+            "only (train.mesh with train.mesh.model == 1, dense features)"
+        )
     # The minibatch is a *contiguous* window, so a dynamic_slice (cheap on TPU)
     # instead of a row gather (slow scatter/gather path). At the cache tail the
     # slice start clamps to m - local_batch; rows before ``offset`` in the clamped
@@ -183,9 +203,27 @@ def _sgd_epoch_math(
             dot = jax.lax.psum(Xb @ coef, MODEL_AXIS)
             loss_sum, mult = loss_func.loss_and_mult(dot, yb, wb)
             grad_sum = Xb.T @ mult
+        elif deterministic:
+            # Per-row contributions [mult·x | w | loss] reduced with the
+            # width-invariant block/tree fold: same 8-row blocks, same global
+            # block order (all_gather unpermute), same pairwise tree at every
+            # mesh width — so grad, weight and loss are bit-identical to the
+            # mesh=1 fold by construction, unlike X.T@mult + psum whose
+            # association varies with the local batch and the ring.
+            dot = Xb @ coef
+            row_loss, mult = loss_func.row_loss_and_mult(dot, yb, wb)
+            contrib = jnp.concatenate(
+                [mult[:, None] * Xb, wb[:, None], row_loss[:, None]], axis=1
+            )
+            packed = mapreduce_sum(
+                contrib, data_axes if n_data > 1 else None, n_data
+            )
+            grad, weight_sum, loss_sum = packed[:-2], packed[-2], packed[-1]
         else:
             loss_sum, grad_sum = loss_func.loss_and_grad_sum(coef, Xb, yb, wb)
-    if model_sharded:
+    if deterministic:
+        pass  # reduced width-invariantly above; no psum on this path
+    elif model_sharded:
         # The grad shard varies over the model axis while the scalar stats are
         # replicated across it — keep their psums separate so the replication
         # stays statically visible to shard_map (and the loss/done plumbing).
@@ -307,6 +345,16 @@ def _cache_put(cache: Dict[tuple, object], key: tuple, value) -> None:
     cache[key] = value
 
 
+def _drain_losses(losses, n_exec) -> List[float]:  # graftcheck: readback
+    """The chunk-boundary loss fetch every fused loop funnels through — the
+    ONE designated host sync per dispatched chunk (never per epoch). The
+    losses buffer rides back with the chunk anyway, so this costs a single
+    device_get pair at a point where the host must observe ``done``."""
+    n = int(jax.device_get(n_exec))
+    chunk_losses = np.asarray(jax.device_get(losses), np.float64)
+    return [float(x) for x in chunk_losses[:n]]
+
+
 def _fused_sgd_program(
     ctx: MeshContext,
     loss_func: LossFunc,
@@ -319,6 +367,7 @@ def _fused_sgd_program(
     dtype,
     sparse: bool = False,
     model_sharded: bool = False,
+    deterministic: bool = False,
 ):
     """A chunk of ``chunk_len`` SGD epochs as ONE jit'd SPMD program.
 
@@ -350,7 +399,15 @@ def _fused_sgd_program(
     Dense + ``model_sharded``: the features arrive 2D-sharded
     ``P(data, model)`` (column slices per model shard) and the margin
     assembles with a psum over the model axis.
+
+    ``deterministic`` (dense data-parallel only, single-slice): the epoch
+    math reduces through ``collectives.mapreduce_sum`` instead of psum —
+    the train.mesh bit-stability tier (``_sgd_epoch_math``).
     """
+    if deterministic and (sparse or model_sharded):
+        raise ValueError(
+            "deterministic fused SGD covers the dense data-parallel layout only"
+        )
     key = (
         ctx.mesh,
         loss_func,  # the instance: custom losses may carry parameters (e.g. Huber delta)
@@ -363,14 +420,20 @@ def _fused_sgd_program(
         jnp.dtype(dtype).name,
         sparse,
         model_sharded,
+        deterministic,
     )
     cached = _FUSED_CACHE.get(key)
     if cached is not None:
         return cached
 
     data_axes = ctx.data_axes
+    if deterministic and not isinstance(data_axes, str):
+        raise ValueError(
+            "the deterministic train.mesh tier is single-slice; a multi-slice "
+            "mesh reduces hierarchically through the psum paths"
+        )
 
-    def per_shard(coef, done, starts, offsets, active, *data):
+    def per_shard(coef, done, starts, offsets, active, *data):  # graftcheck: hot-root
         feats = (data[0], data[1]) if sparse else data[0]
         y, w, mask = data[2:5] if sparse else data[1:4]
 
@@ -380,7 +443,8 @@ def _fused_sgd_program(
             new_c, mean_loss = _sgd_epoch_math(
                 c, start, offset, feats, y, w, mask, loss_func, local_batch, lr,
                 reg, elastic_net, dtype, model_sharded=model_sharded,
-                data_axes=data_axes,
+                data_axes=data_axes, deterministic=deterministic,
+                n_data=ctx.n_data,
             )
             executed = ~done & act
             new_c = jnp.where(executed, new_c, c)
@@ -762,6 +826,7 @@ class SGD(Optimizer):
         stream_window_rows: Optional[int] = None,
         sparse_kernel: str = "auto",
         onehot_premat: str = "auto",
+        sharding: Optional[TrainSharding] = None,
     ):
         if sparse_kernel not in ("auto", "onehot", "scatter"):
             raise ValueError(
@@ -782,6 +847,12 @@ class SGD(Optimizer):
         self.elastic_net = elastic_net
         self.dtype = dtype
         self.ctx = ctx
+        # The deterministic train.mesh tier: an explicit TrainSharding, or
+        # (when neither it nor ctx is given) whatever ``train.mesh`` resolves
+        # per fit. Mutually exclusive with ctx — one mesh authority per run.
+        if sharding is not None and ctx is not None:
+            raise ValueError("pass ctx or sharding, not both")
+        self.sharding = sharding
         if stream_window_rows is None:  # runtime config tier decides
             from flink_ml_tpu.config import Options, config
 
@@ -911,7 +982,10 @@ class SGD(Optimizer):
         padded-CSR ``indices``/``values`` [n, K] (SparseBatch layout — the
         SparseVector.java training path without densifying).
         """
-        ctx = self.ctx or get_mesh_context()
+        ts = self.sharding
+        if ts is None and self.ctx is None:
+            ts = resolve_train_sharding()
+        ctx = self.ctx or (ts.ctx if ts is not None else get_mesh_context())
         from flink_ml_tpu.iteration.streaming import is_host_cache
 
         self.onehot_premat_active = False  # set by _optimize_onehot when used
@@ -928,6 +1002,19 @@ class SGD(Optimizer):
                 )
             if "weights" not in cols:
                 cols["weights"] = np.ones(np.asarray(cols["labels"]).shape[0])
+            if (
+                ts is not None
+                and ts.n_model == 1
+                and "features" in cols
+                and self.checkpoint_manager is None
+                and not self.checkpoint_interval
+                and not self.listeners
+            ):
+                # The deterministic sharded tier: dense fused data-parallel
+                # fits ingest under the block-cyclic deal and reduce width-
+                # invariantly. Sparse / TP / checkpointed / listener fits run
+                # the standard psum paths below on the SAME ts mesh (ctx).
+                return self._optimize_deterministic(init_model, cols, loss_func, ts)
             # On a TP mesh, dense features ingest directly in their training
             # layout P(data, model) — no row-only duplicate ever lands in HBM.
             specs = (
@@ -1020,10 +1107,9 @@ class SGD(Optimizer):
                 # streams loss through the feedback edge (SGD.java:137-143), tol
                 # or not. The losses buffer already comes back with the chunk, so
                 # this costs one fetch per chunk boundary.
-                n = int(jax.device_get(n_exec))
-                chunk_losses = np.asarray(jax.device_get(losses), np.float64)
-                self.loss_history.extend(float(x) for x in chunk_losses[:n])
-                if check_loss and n < n_active:  # done flipped mid-chunk
+                got = _drain_losses(losses, n_exec)
+                self.loss_history.extend(got)
+                if check_loss and len(got) < n_active:  # done flipped mid-chunk
                     break
             final = np.asarray(jax.device_get(coef))
             return final[:dim] if model_sharded else final
@@ -1040,6 +1126,77 @@ class SGD(Optimizer):
             init_model, train_data, loss_func, ctx, step, local_batch,
             check_loss, dim, sparse, model_sharded, data_args,
         )
+
+    # -- the deterministic sharded tier (train.mesh) --------------------------
+
+    def _optimize_deterministic(
+        self, init_model, cols, loss_func, ts: TrainSharding
+    ) -> np.ndarray:
+        """Dense fused SGD on the deterministic sharded tier.
+
+        Rows ingest once under the block-cyclic deal (ShardedTrainCache) and
+        every epoch reduces through ``collectives.mapreduce_sum`` — so for a
+        fixed rounded global batch B the fit is *bit-identical* at every mesh
+        width (the 8·N row-remainder discipline rounds B up to the mesh's
+        quantum; pick B a multiple of 8·N_max to compare widths directly).
+        The schedule is global: epoch e consumes window [e·B mod n', +B) of
+        the padded set, which the deal makes a contiguous local window on
+        every shard — same dynamic_slice minibatching as the legacy path,
+        same compiled program shape, one extra all_gather per epoch.
+        """
+        from flink_ml_tpu.metrics import MLMetrics, metrics
+
+        ctx = ts.ctx
+        dim = int(np.asarray(init_model).shape[0])
+        n = int(np.asarray(cols["labels"]).shape[0])
+        B = ts.round_batch(min(self.global_batch_size, max(n, 1)))
+        cache = ts.deal_cache(
+            {k: np.asarray(v, self.dtype) for k, v in cols.items()},
+            global_batch=B,
+            dtype=self.dtype,
+        )
+        local_batch = cache.local_batch
+        check_loss = np.isfinite(self.tol) and self.tol > 0
+        chunk = fused_chunk_len(self.max_iter, check_loss)
+        program = _fused_sgd_program(
+            ctx,
+            loss_func,
+            local_batch,
+            chunk,
+            self.learning_rate,
+            self.reg,
+            self.elastic_net,
+            self.tol if check_loss else None,
+            self.dtype,
+            deterministic=True,
+        )
+        # n' is a multiple of B, so the window never wraps or clamps:
+        # starts == offsets and the tail-batch gating is inert.
+        global_starts = (
+            np.arange(self.max_iter, dtype=np.int64) * B
+        ) % cache.n_padded
+        starts = (global_starts // ts.n_data).astype(np.int32)
+        data_args = (
+            cache["features"],
+            cache["labels"],
+            cache["weights"],
+            cache.mask.astype(self.dtype),
+        )
+        coef = ts.replicate(np.asarray(init_model, self.dtype))
+        done = ctx.replicate(np.asarray(False))
+        self.loss_history = []
+        for starts_c, offsets_c, active_c, n_active in chunked_schedule(
+            starts, starts, self.max_iter, chunk
+        ):
+            coef, done, losses, n_exec = program(
+                coef, done, starts_c, offsets_c, active_c, *data_args
+            )
+            got = _drain_losses(losses, n_exec)
+            self.loss_history.extend(got)
+            if check_loss and len(got) < n_active:
+                break
+        metrics.counter(MLMetrics.TRAIN_GROUP, MLMetrics.TRAIN_SHARDED_FITS)
+        return np.asarray(jax.device_get(coef))
 
     # -- one-hot matmul sparse path ------------------------------------------
 
@@ -1268,10 +1425,9 @@ class SGD(Optimizer):
                 coef, done, win_c, offsets_c, active_c, *stacks, *oh_stacks,
                 y, w, mask
             )
-            n = int(jax.device_get(n_exec))
-            chunk_losses = np.asarray(jax.device_get(losses), np.float64)
-            self.loss_history.extend(float(x) for x in chunk_losses[:n])
-            if check_loss and n < n_active:
+            got = _drain_losses(losses, n_exec)
+            self.loss_history.extend(got)
+            if check_loss and len(got) < n_active:
                 break
         # Same caller-visible dtype as the scatter fused path (self.dtype —
         # f32 here, the only dtype this kernel admits): auto-selection must
@@ -1408,10 +1564,9 @@ class SGD(Optimizer):
             def observe():
                 stop = False
                 if check_loss:
-                    n = int(jax.device_get(n_exec))
-                    chunk_losses = np.asarray(jax.device_get(losses), np.float64)
-                    self.loss_history.extend(float(x) for x in chunk_losses[:n])
-                    stop = n < n_active
+                    got = _drain_losses(losses, n_exec)
+                    self.loss_history.extend(got)
+                    stop = len(got) < n_active
                 else:
                     pending_losses.append((losses, n_exec))
                 if mgr is not None and self.checkpoint_interval > 0:
@@ -1438,9 +1593,7 @@ class SGD(Optimizer):
 
         run_windows(stream, sched, dispatch, start_run=start_run)
         for losses, n_exec in pending_losses:
-            n = int(jax.device_get(n_exec))
-            chunk_losses = np.asarray(jax.device_get(losses), np.float64)
-            self.loss_history.extend(float(x) for x in chunk_losses[:n])
+            self.loss_history.extend(_drain_losses(losses, n_exec))
         return plan.unpermute_coef(np.asarray(jax.device_get(state["coef"])))
 
     def _optimize_host_loop(
@@ -1638,10 +1791,9 @@ class SGD(Optimizer):
             def observe():
                 stop = False
                 if check_loss:
-                    n = int(jax.device_get(n_exec))
-                    chunk_losses = np.asarray(jax.device_get(losses), np.float64)
-                    self.loss_history.extend(float(x) for x in chunk_losses[:n])
-                    stop = n < n_active  # done flipped mid-chunk
+                    got = _drain_losses(losses, n_exec)
+                    self.loss_history.extend(got)
+                    stop = len(got) < n_active  # done flipped mid-chunk
                 else:
                     pending_losses.append((losses, n_exec))
                 if mgr is not None and self.checkpoint_interval > 0:
@@ -1668,8 +1820,6 @@ class SGD(Optimizer):
             # One sync over already-finished buffers: the reference always
             # streams loss through the feedback edge (SGD.java:137-143), so
             # maxIter-only runs get a full history too.
-            n = int(jax.device_get(n_exec))
-            chunk_losses = np.asarray(jax.device_get(losses), np.float64)
-            self.loss_history.extend(float(x) for x in chunk_losses[:n])
+            self.loss_history.extend(_drain_losses(losses, n_exec))
         final = np.asarray(jax.device_get(state["coef"]))
         return final[:dim] if model_sharded else final

@@ -14,10 +14,20 @@ from flink_ml_tpu.parallel.mesh import (
     mesh_context,
 )
 from flink_ml_tpu.parallel.collectives import (
+    BLOCK_ROWS,
     all_reduce_sum,
     all_reduce_mean,
+    block_partials,
+    mapreduce_sum,
     psum_tree,
     shard_batch_spec,
+    tree_fold_sum,
+)
+from flink_ml_tpu.parallel.train_sharding import (
+    ShardedTrainCache,
+    TrainSharding,
+    ensure_distributed,
+    resolve_train_sharding,
 )
 from flink_ml_tpu.parallel.quantile import QuantileSummary
 from flink_ml_tpu.parallel.ring import ring_attention, ring_attention_sharded
@@ -50,6 +60,14 @@ __all__ = [
     "all_reduce_mean",
     "psum_tree",
     "shard_batch_spec",
+    "BLOCK_ROWS",
+    "block_partials",
+    "mapreduce_sum",
+    "tree_fold_sum",
+    "TrainSharding",
+    "ShardedTrainCache",
+    "resolve_train_sharding",
+    "ensure_distributed",
     "QuantileSummary",
     "aggregate",
     "co_group",

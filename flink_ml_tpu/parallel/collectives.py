@@ -17,11 +17,24 @@ reassembly are XLA's problem. Two usage styles:
 
 ``all_reduce_sum``/``all_reduce_mean`` here are the explicit style packaged to match
 ``DataStreamUtils.allReduceSum`` semantics for host-resident arrays.
+
+Deterministic mapreduce tier (PR 20, DrJAX-style — PAPERS.md): ``psum`` leaves
+the reduction order to XLA, so the same global batch summed at mesh widths 1
+and N can differ in the last ulp. The training tier's bit-stability contract
+(docs/distributed_training.md) instead fixes the reduction *structure* in the
+program itself: per-8-row-block partials folded in row order
+(``block_partials``), an ``all_gather`` that reassembles the partials in
+GLOBAL block order under the block-cyclic data deal
+(``parallel/train_sharding.py``), and a balanced pairwise tree fold whose
+shape depends only on the global block count (``tree_fold_sum``). Every add
+is elementwise with a width-invariant association, so mesh widths 1/2/4/8
+produce bit-identical epoch results by construction. ``mapreduce_sum`` is the
+packaged primitive the sharded trainers call inside ``shard_map``.
 """
 from __future__ import annotations
 
 import functools
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -29,7 +42,24 @@ from jax.sharding import PartitionSpec as P
 
 from flink_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext, get_mesh_context
 
-__all__ = ["psum_tree", "all_reduce_sum", "all_reduce_mean", "shard_batch_spec"]
+__all__ = [
+    "psum_tree",
+    "all_reduce_sum",
+    "all_reduce_mean",
+    "shard_batch_spec",
+    "BLOCK_ROWS",
+    "block_partials",
+    "tree_fold_sum",
+    "mapreduce_sum",
+]
+
+#: Row-block quantum of the deterministic mapreduce tier. Matches
+#: ``servable.sharding.MIN_SHARD_ROWS`` — XLA's CPU gemv row-blocking works in
+#: units of 8, so rows inside complete 8-row blocks are bit-invariant across
+#: batch shapes (the PR 9 measurement the serving tier's remainder discipline
+#: rests on); the training tier reduces in the same units so the per-row math
+#: feeding the fold is itself width-stable.
+BLOCK_ROWS = 8
 
 
 def psum_tree(tree: Any, axis_name: str = DATA_AXIS) -> Any:
@@ -69,3 +99,65 @@ def all_reduce_mean(array, ctx: MeshContext = None):
     n = arr.shape[0]
     x, _ = ctx.shard_batch(arr)
     return _shard_mapped_sum(ctx.mesh)(x) / n
+
+
+# --- deterministic mapreduce tier (see module docstring) ---------------------
+
+
+def block_partials(x):
+    """[rows, ...] → [rows / BLOCK_ROWS, ...] per-block sums, rows in order.
+
+    The fold over each 8-row block is an explicit unrolled left chain —
+    association fixed by the trace, every add elementwise — so a block's
+    partial is a pure function of its 8 rows, independent of how many blocks
+    sit around it. ``rows`` must be a multiple of BLOCK_ROWS (the
+    train-sharding ingest discipline guarantees it).
+    """
+    rows = x.shape[0]
+    if rows % BLOCK_ROWS:
+        raise ValueError(
+            f"deterministic reduce needs rows % {BLOCK_ROWS} == 0, got {rows}"
+        )
+    xb = x.reshape((rows // BLOCK_ROWS, BLOCK_ROWS) + x.shape[1:])
+    acc = xb[:, 0]
+    for r in range(1, BLOCK_ROWS):
+        acc = acc + xb[:, r]
+    return acc
+
+
+def tree_fold_sum(blocks):
+    """[G, ...] → [...] balanced pairwise tree fold over the leading axis.
+
+    The tree's shape depends only on G — the GLOBAL block count, identical at
+    every mesh width — and each level is one vectorized elementwise add
+    (O(log G) ops vs the O(G) sequential chain a ``scan`` fold would issue).
+    Odd levels pad one exact-zero block, which is additively inert bit-for-bit
+    for finite values.
+    """
+    while blocks.shape[0] > 1:
+        if blocks.shape[0] % 2:
+            blocks = jnp.concatenate([blocks, jnp.zeros_like(blocks[:1])], axis=0)
+        blocks = blocks[0::2] + blocks[1::2]
+    return blocks[0]
+
+
+def mapreduce_sum(x, axis_name: Optional[str] = None, axis_size: int = 1):
+    """Deterministic global row-sum of a shard-local [rows, ...] batch.
+
+    Call inside ``shard_map`` with the batch dealt block-cyclically over
+    ``axis_name`` (``TrainSharding.deal_cache``): shard k holds global blocks
+    k, k+N, k+2N, … in local order, so the gathered [N, L, ...] partial array
+    transposes back to global block order with one swapaxes/reshape. The tree
+    fold then runs replicated on every device over the same global sequence —
+    the result is bit-identical across mesh widths, and identical to the
+    width-1 program, by construction. With ``axis_name=None`` (width 1) the
+    local blocks already ARE the global order and the gather is skipped; the
+    fold structure is unchanged.
+    """
+    part = block_partials(x)
+    if axis_name is not None and axis_size > 1:
+        g = jax.lax.all_gather(part, axis_name, axis=0, tiled=False)  # [N, L, ...]
+        # gathered[k, i] is global block k + i·N; swap to [L, N, ...] and
+        # flatten so index i·N + k — the global block number — is restored.
+        part = jnp.swapaxes(g, 0, 1).reshape((-1,) + g.shape[2:])
+    return tree_fold_sum(part)

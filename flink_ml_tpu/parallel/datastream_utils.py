@@ -117,6 +117,7 @@ def reduce(
     fn: Callable[[Columns, Columns], Columns],
     ctx: Optional[MeshContext] = None,
     parallel: Optional[bool] = None,
+    identity: Optional[Columns] = None,
 ) -> Columns:
     """Two-stage reduce (ref DataStreamUtils.reduce:153).
 
@@ -130,15 +131,21 @@ def reduce(
     partition is positional, but the partition boundaries move with the mesh's
     data-axis size.
 
-    Empty input returns the empty columns unchanged; partitions with no rows
-    (more subtasks than rows) contribute no partial, exactly like an empty
-    subtask in the reference.
+    ``identity`` is the reducer's one-row neutral element (e.g. zeros for a
+    sum). With it, an empty partition folds to ``identity`` instead of
+    contributing nothing, and an all-empty input returns ``identity`` — the
+    SAME zero-element semantics the device collective gives a masked-out
+    shard (``collectives.mapreduce_sum`` over all-zero blocks), so a
+    host-belt fold and a mesh-backed fold of the same data agree even when a
+    shard owns no rows. Without it (the legacy default), empty partitions
+    contribute no partial — like an empty subtask in the reference — and
+    all-empty input returns the empty columns unchanged.
     """
 
     def partial(part: Columns) -> Optional[Columns]:
         n = _num_rows(part)
         if n == 0:
-            return None
+            return None if identity is None else dict(identity)
         acc = {k: v[0:1] for k, v in part.items()}
         for i in range(1, n):
             acc = fn(acc, {k: v[i : i + 1] for k, v in part.items()})
@@ -150,6 +157,8 @@ def reduce(
         if p is not None
     ]
     if not partials:
+        if identity is not None:
+            return dict(identity)
         return {k: v[0:0] for k, v in columns.items()}
     acc = partials[0]
     for other in partials[1:]:

@@ -1,0 +1,273 @@
+"""Training-side sharding: model state and mini-batches on the mesh.
+
+The companion of ``servable/sharding.py`` for the OTHER half of the loop
+(ROADMAP item 4): where ``PlanSharding`` places served batches and weights,
+``TrainSharding`` places the iteration drivers' epoch state — SGD
+coefficients, KMeans centroids, MLP layers — as ``NamedSharding``-resident
+device arrays on a ``parallel/mesh.py`` MeshContext, and deals the training
+rows so the deterministic mapreduce tier (``parallel/collectives.py``) can
+reduce them with a width-invariant association.
+
+Bit-stability construction (docs/distributed_training.md):
+
+1. **Block-cyclic deal.** Rows are zero-padded to the batch quantum and their
+   8-row blocks dealt round-robin to the data shards (shard k gets global
+   blocks k, k+N, k+2N, …) — realized host-side as one permutation before a
+   standard contiguous ``device_put``. A global minibatch window [s, s+B)
+   with s and B multiples of 8·N is then a *contiguous local* window
+   [s/N, s/N + B/N) on every shard, so the trainers' cheap ``dynamic_slice``
+   minibatching survives unchanged — and the set of global rows each epoch
+   consumes is the same at every mesh width.
+2. **Deterministic reduce.** Per-8-row-block partials, an all_gather that
+   restores global block order, and a balanced pairwise tree fold replicated
+   on every device (``collectives.mapreduce_sum``). Same blocks, same tree,
+   at every width — epochs are bit-identical to mesh=1 by construction.
+
+Multi-host (``train.mesh.hosts``): ``ensure_distributed`` guards the one
+``jax.distributed.initialize`` call a pod-scale run needs; single-host runs
+never touch it. Resolution (``resolve_train_sharding``) differs deliberately
+from the serving tier's: ``train.mesh=1`` is NOT a no-op — it returns a
+width-1 TrainSharding so mesh=1 runs the *same deterministic program* the
+wider meshes run, which is what makes the bit-stability contract testable.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from flink_ml_tpu.metrics import MLMetrics, metrics
+from flink_ml_tpu.parallel.collectives import BLOCK_ROWS
+from flink_ml_tpu.parallel.mesh import DATA_AXIS, MeshContext
+
+__all__ = [
+    "TrainSharding",
+    "ShardedTrainCache",
+    "resolve_train_sharding",
+    "ensure_distributed",
+]
+
+_distributed_lock = threading.Lock()
+_distributed_initialized = False
+
+
+def ensure_distributed(n_hosts: Optional[int] = None) -> bool:
+    """Initialize ``jax.distributed`` once, iff a multi-host mesh is asked for.
+
+    Reads ``train.mesh.hosts`` when ``n_hosts`` is None. Hosts <= 1 — the
+    entire single-host world, including every test and smoke in this repo —
+    returns False without importing or touching the distributed runtime, so
+    single-host behavior is exactly unchanged. Hosts > 1 calls
+    ``jax.distributed.initialize()`` (coordinator address, process id and
+    count come from the standard JAX_* / cloud TPU environment, the same
+    contract ``jax.distributed`` documents); a second call is a no-op.
+    """
+    global _distributed_initialized
+    if n_hosts is None:
+        from flink_ml_tpu.config import Options, config
+
+        n_hosts = config.get(Options.TRAIN_MESH_HOSTS)
+    if not n_hosts or int(n_hosts) <= 1:
+        return False
+    with _distributed_lock:
+        if not _distributed_initialized:
+            jax.distributed.initialize()
+            _distributed_initialized = True
+    return True
+
+
+class TrainSharding:
+    """Placement + deal discipline for one sharded training run.
+
+    ``n_data`` × ``n_model`` devices (the train mesh is always single-slice;
+    multi-slice training goes through the mesh context's hierarchical psums,
+    not the deterministic tier). Immutable; ``key`` joins run fingerprints and
+    program-cache keys.
+    """
+
+    def __init__(
+        self,
+        n_data: int = 1,
+        n_model: int = 1,
+        devices=None,
+    ):
+        if n_data < 1 or n_model < 1:
+            raise ValueError(f"train mesh axes must be >= 1, got {n_data}x{n_model}")
+        devices = list(devices) if devices is not None else jax.devices()
+        need = n_data * n_model
+        if need > len(devices):
+            raise ValueError(
+                f"train.mesh {n_data}x{n_model} needs {need} devices, "
+                f"only {len(devices)} visible"
+            )
+        self.ctx = MeshContext(devices=devices[:need], n_data=n_data, n_model=n_model)
+        self.n_data = n_data
+        self.n_model = n_model
+
+    @property
+    def key(self):
+        return (self.n_data, self.n_model)
+
+    @property
+    def mesh(self):
+        return self.ctx.mesh
+
+    @property
+    def data_axes(self):
+        return self.ctx.data_axes
+
+    # --- quanta --------------------------------------------------------------
+    @property
+    def row_quantum(self) -> int:
+        """Rows per indivisible unit: one 8-row block per data shard."""
+        return BLOCK_ROWS * self.n_data
+
+    def round_batch(self, global_batch: int) -> int:
+        """Smallest quantum multiple >= ``global_batch`` (the 8·N remainder
+        discipline: every shard's local minibatch is whole 8-row blocks)."""
+        q = self.row_quantum
+        return max(q, ((int(global_batch) + q - 1) // q) * q)
+
+    def padded_rows(self, n: int, global_batch: int) -> int:
+        """Rows after padding: the smallest multiple of ``global_batch`` >= n.
+
+        A function of (n, B) only — never of the mesh width — so the padded
+        row count, and with it every epoch's consumed global window, is
+        width-invariant. Multiples of B keep the offset-cycling schedule
+        clamp-free: each epoch's window [e·B mod n', +B) is quantum-aligned.
+        """
+        b = int(global_batch)
+        if b % self.row_quantum:
+            raise ValueError(
+                f"global batch {b} is not a multiple of the row quantum "
+                f"{self.row_quantum} (use round_batch)"
+            )
+        return max(b, ((int(n) + b - 1) // b) * b)
+
+    def deal_permutation(self, n_padded: int) -> np.ndarray:
+        """Row permutation realizing the block-cyclic deal as contiguous shards.
+
+        Global block g lands on shard g mod N at local position g // N; the
+        permuted array's contiguous shard k therefore holds blocks
+        k, k+N, k+2N, … — what ``mapreduce_sum``'s gather-unpermute inverts.
+        """
+        if n_padded % self.row_quantum:
+            raise ValueError(
+                f"{n_padded} rows not a multiple of the quantum {self.row_quantum}"
+            )
+        n_blocks = n_padded // BLOCK_ROWS
+        order = np.arange(n_blocks).reshape(-1, self.n_data).T.reshape(-1)
+        return (order[:, None] * BLOCK_ROWS + np.arange(BLOCK_ROWS)).reshape(-1)
+
+    # --- placement -----------------------------------------------------------
+    def place_state(self, tree):
+        """Model state (coefficients / centroids / MLP layers) as replicated
+        NamedSharding-resident device arrays — the broadcast-variable layout
+        every epoch program reads without a host round trip."""
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self.ctx.replicated), tree
+        )
+
+    def replicate(self, array) -> jax.Array:  # graftcheck: ingest
+        """The blessed device_put boundary for replicated train state."""
+        return jax.device_put(array, self.ctx.replicated)
+
+    def deal_cache(
+        self,
+        columns: Dict[str, np.ndarray],
+        global_batch: Optional[int] = None,
+        dtype=np.float32,
+    ) -> "ShardedTrainCache":
+        """Ingest host columns under the deal discipline (one permutation +
+        one device_put per column). ``global_batch`` defaults to one quantum;
+        callers round it first (``round_batch``)."""
+        b = self.round_batch(global_batch if global_batch else self.row_quantum)
+        return ShardedTrainCache(columns, self, b, dtype=dtype)
+
+
+class ShardedTrainCache:
+    """Columnar training set resident in HBM under the block-cyclic deal.
+
+    The TrainSharding analogue of ``iteration.DeviceDataCache``: same surface
+    (``cache[name]``, ``mask``, ``local_rows``, ``n_valid``) so the trainers'
+    epoch programs are layout-agnostic — only the ingest (here) and the
+    reduce (``collectives.mapreduce_sum``) know about the deal. Padding rows
+    carry zero data and a zero mask, so they are additively inert in every
+    deterministic fold.
+    """
+
+    def __init__(  # graftcheck: ingest
+        self,
+        columns: Dict[str, np.ndarray],
+        sharding: TrainSharding,
+        global_batch: int,
+        dtype=np.float32,
+    ):
+        self.sharding = sharding
+        self.global_batch = int(global_batch)
+        lengths = {np.asarray(c).shape[0] for c in columns.values()}
+        if len(lengths) != 1:
+            raise ValueError(f"inconsistent column lengths {lengths}")
+        (n,) = lengths
+        self.n_valid = n
+        self.n_padded = sharding.padded_rows(n, self.global_batch)
+        perm = sharding.deal_permutation(self.n_padded)
+        pad = self.n_padded - n
+        batch_sharding = sharding.ctx.batch
+        self.arrays: Dict[str, jax.Array] = {}
+        for name, col in columns.items():
+            col = np.asarray(col)
+            if col.dtype.kind == "f":
+                col = col.astype(dtype)
+            if pad:
+                col = np.concatenate(
+                    [col, np.zeros((pad,) + col.shape[1:], col.dtype)]
+                )
+            # the blessed device_put boundary (8·N row-remainder discipline;
+            # one H2D per column per fit)
+            self.arrays[name] = jax.device_put(col[perm], batch_sharding)
+        mask = np.zeros(self.n_padded, np.float32)
+        mask[:n] = 1.0
+        self.arrays["__mask__"] = jax.device_put(mask[perm], batch_sharding)
+        metrics.counter(
+            MLMetrics.TRAIN_GROUP, MLMetrics.TRAIN_SHARD_INGEST_ROWS, n
+        )
+        metrics.counter(MLMetrics.TRAIN_GROUP, MLMetrics.TRAIN_SHARD_PAD_ROWS, pad)
+
+    @property
+    def local_rows(self) -> int:
+        """Rows per data shard (padded; a multiple of the local batch)."""
+        return self.n_padded // self.sharding.n_data
+
+    @property
+    def local_batch(self) -> int:
+        return self.global_batch // self.sharding.n_data
+
+    def __getitem__(self, name: str) -> jax.Array:
+        return self.arrays[name]
+
+    @property
+    def mask(self) -> jax.Array:
+        return self.arrays["__mask__"]
+
+
+def resolve_train_sharding(devices=None) -> Optional[TrainSharding]:
+    """The config-driven entry: a TrainSharding iff ``train.mesh`` is set.
+
+    Unlike ``resolve_plan_sharding``, an EXPLICIT ``train.mesh=1`` resolves
+    (width-1 deterministic program — the bit-stability reference point);
+    unset/0 returns None and the legacy single-device paths run unchanged.
+    Raises when the requested grid exceeds the visible devices — silently
+    training narrower than asked for would invalidate every checkpoint and
+    throughput assumption downstream.
+    """
+    from flink_ml_tpu.config import Options, config
+
+    n_data = config.get(Options.TRAIN_MESH)
+    if not n_data or int(n_data) < 1:
+        return None
+    n_model = config.get(Options.TRAIN_MESH_MODEL) or 1
+    ensure_distributed()
+    return TrainSharding(int(n_data), int(n_model), devices=devices)

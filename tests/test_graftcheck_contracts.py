@@ -152,6 +152,7 @@ class _FixturePlanKey(PlanKeyCompletenessRule):
     KEY_CAPTURE_ROOTS = {"digest": ("flink_ml_tpu.planner:digest",)}
     PLAN_KEY_OPTIONS = {"ALPHA": ("digest",)}
     PLAN_NEUTRAL = {}
+    TRAIN_NEUTRAL = {}
 
 
 PLANNER_DIRTY = {
@@ -240,7 +241,13 @@ def test_changed_only_view_keeps_the_plan_key_read_site(tmp_path, monkeypatch):
     keeps a plan-key finding when only the reader file is touched, because
     the finding is anchored there rather than at the digest/declaration."""
     rule = REGISTRY["plan-key-completeness"]
-    for attr in ("PLAN_BUILD_ROOTS", "KEY_CAPTURE_ROOTS", "PLAN_KEY_OPTIONS", "PLAN_NEUTRAL"):
+    for attr in (
+        "PLAN_BUILD_ROOTS",
+        "KEY_CAPTURE_ROOTS",
+        "PLAN_KEY_OPTIONS",
+        "PLAN_NEUTRAL",
+        "TRAIN_NEUTRAL",
+    ):
         monkeypatch.setattr(rule, attr, getattr(_FixturePlanKey, attr))
     write_tree(tmp_path, PLANNER_DIRTY)
     result = run_rules(

@@ -1,0 +1,522 @@
+"""Pod-scale sharded training tests (docs/distributed_training.md).
+
+The ROADMAP item 4 contract: training epochs sharded over the device mesh
+through the deterministic mapreduce tier must be BIT-identical across mesh
+widths 1/2/4/8 (same blocks, same fold tree at every width), sharded epoch
+state must kill/resume through per-shard checkpoints, and a sharded trainer
+must publish straight into serving with no extra serving-path work.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flink_ml_tpu.checkpoint import (
+    CheckpointManager,
+    MeshMismatchError,
+    ShardedCheckpointManager,
+)
+from flink_ml_tpu.config import Options, config
+from flink_ml_tpu.ops import SGD, BinaryLogisticLoss, LeastSquareLoss
+from flink_ml_tpu.parallel import (
+    BLOCK_ROWS,
+    ShardedTrainCache,
+    TrainSharding,
+    mapreduce_sum,
+    resolve_train_sharding,
+    tree_fold_sum,
+)
+
+WIDTHS = (1, 2, 4, 8)
+
+
+@pytest.fixture
+def train_mesh():
+    """Set train.mesh for the test body, always unset afterwards."""
+
+    def _set(width):
+        config.set(Options.TRAIN_MESH, width)
+
+    yield _set
+    config.unset(Options.TRAIN_MESH)
+    config.unset(Options.TRAIN_MESH_MODEL)
+
+
+def _sgd_data(n=300, d=5, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = np.linspace(1.0, -1.0, d).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    return {"features": X, "labels": y}
+
+
+class TestCollectives:
+    def test_mapreduce_matches_numpy_sum(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 3)).astype(np.float32)
+        got = jax.jit(lambda a: mapreduce_sum(a))(x)
+        np.testing.assert_allclose(np.asarray(got), x.sum(axis=0), rtol=1e-5)
+
+    def test_tree_fold_trailing_zero_blocks_are_inert(self):
+        """The width-invariance lemma: zero pad blocks (a wider mesh pads the
+        same rows to a larger quantum) never change the fold result — zeros
+        stay exactly zero at every fold level and x + 0.0 == x."""
+        rng = np.random.default_rng(1)
+        blocks = jnp.asarray(rng.normal(size=(5, 4)).astype(np.float32))
+        base = np.asarray(tree_fold_sum(blocks))
+        for pad in (1, 3, 11):
+            padded = jnp.concatenate([blocks, jnp.zeros((pad, 4), jnp.float32)])
+            np.testing.assert_array_equal(np.asarray(tree_fold_sum(padded)), base)
+
+    @pytest.mark.parametrize("width", WIDTHS)
+    def test_sharded_reduce_bit_equals_width_one(self, width):
+        """mapreduce_sum under the block-cyclic deal == the width-1 fold of
+        the same rows, bitwise, at every mesh width."""
+        rng = np.random.default_rng(2)
+        rows = rng.normal(size=(16 * BLOCK_ROWS, 3)).astype(np.float32)
+        ref = np.asarray(
+            jax.jit(lambda a: mapreduce_sum(a))(rows)
+        )
+        ts = TrainSharding(width)
+        cache = ts.deal_cache({"x": rows})
+        from jax.sharding import PartitionSpec as P
+
+        prog = jax.jit(
+            jax.shard_map(
+                lambda a: mapreduce_sum(a, ts.data_axes, ts.n_data),
+                mesh=ts.mesh,
+                in_specs=(P(ts.data_axes),),
+                out_specs=P(),
+            )
+        )
+        np.testing.assert_array_equal(np.asarray(prog(cache["x"])), ref)
+
+    def test_empty_shard_contributes_zero_identity(self):
+        """A shard whose rows are all padding (mask 0) folds to exactly the
+        zero identity — the semantics the host reduce's ``identity`` kwarg
+        now mirrors."""
+        ts = TrainSharding(4)
+        rows = np.ones((BLOCK_ROWS, 2), np.float32)  # one real block, 3 shards padded
+        cache = ts.deal_cache({"x": rows})
+        from jax.sharding import PartitionSpec as P
+
+        prog = jax.jit(
+            jax.shard_map(
+                lambda a, m: mapreduce_sum(a * m[:, None], ts.data_axes, ts.n_data),
+                mesh=ts.mesh,
+                in_specs=(P(ts.data_axes), P(ts.data_axes)),
+                out_specs=P(),
+            )
+        )
+        got = np.asarray(prog(cache["x"], cache.mask))
+        np.testing.assert_array_equal(got, np.full(2, BLOCK_ROWS, np.float32))
+
+    def test_host_reduce_identity_matches_collective_on_empty(self):
+        """Satellite regression: the thread-belt reduce with ``identity`` and
+        the device collective agree on the empty-partition identity."""
+        from flink_ml_tpu.parallel import reduce as ds_reduce
+        from flink_ml_tpu.parallel.mesh import MeshContext
+
+        ctx = MeshContext(n_data=4)  # 4 partitions over fewer rows
+        cols = {"v": np.asarray([[1.0, 2.0]], np.float64)}  # 1 row, 3 empty parts
+        fn = lambda a, b: {"v": a["v"] + b["v"]}
+        identity = {"v": np.zeros((1, 2), np.float64)}
+        got = ds_reduce(cols, fn, ctx=ctx, identity=identity)
+        np.testing.assert_array_equal(got["v"], cols["v"])
+        # all-empty input returns the identity itself, like a fully masked mesh
+        empty = {"v": np.zeros((0, 2), np.float64)}
+        got = ds_reduce(empty, fn, ctx=ctx, identity=identity)
+        np.testing.assert_array_equal(got["v"], identity["v"])
+        # legacy default (no identity) keeps the empty-columns contract
+        got = ds_reduce(empty, fn, ctx=ctx)
+        assert got["v"].shape == (0, 2)
+
+
+class TestTrainShardingSurface:
+    def test_resolution(self, train_mesh):
+        assert resolve_train_sharding() is None  # unset -> legacy paths
+        train_mesh(2)
+        ts = resolve_train_sharding()
+        assert ts is not None and ts.key == (2, 1)
+        config.set(Options.TRAIN_MESH, 0)
+        assert resolve_train_sharding() is None  # 0 = explicit off
+        config.set(Options.TRAIN_MESH, 99)
+        with pytest.raises(ValueError, match="devices"):
+            resolve_train_sharding()
+
+    def test_deal_round_trips_rows(self):
+        """The block-cyclic deal is a permutation: gather-unpermute restores
+        the original global row order (what mapreduce_sum relies on)."""
+        ts = TrainSharding(4)
+        n = 4 * BLOCK_ROWS * 3
+        perm = ts.deal_permutation(n)
+        assert sorted(perm.tolist()) == list(range(n))
+        rows = np.arange(n, dtype=np.float32)[:, None]
+        cache = ts.deal_cache({"x": rows})
+        assert cache.n_padded == n and cache.local_rows == n // 4
+        # global window [s, s+B) lands contiguous-local on every shard
+        B = ts.round_batch(64)
+        assert B % ts.row_quantum == 0
+
+    def test_cache_rejects_ragged_columns(self):
+        ts = TrainSharding(2)
+        with pytest.raises(ValueError, match="inconsistent"):
+            ShardedTrainCache(
+                {"a": np.zeros(8), "b": np.zeros(9)}, ts, ts.row_quantum
+            )
+
+    def test_batch_quantum_enforced(self):
+        ts = TrainSharding(4)
+        assert ts.round_batch(1) == ts.row_quantum
+        assert ts.round_batch(33) == 2 * ts.row_quantum
+        with pytest.raises(ValueError, match="quantum"):
+            ts.padded_rows(100, 7)
+
+
+class TestBitIdentityAcrossWidths:
+    def test_sgd_epochs_bit_stable(self):
+        """SGD fits are bit-identical across mesh widths 1/2/4/8 under the
+        8·N row-remainder discipline (global batch a multiple of 8·8)."""
+        data = _sgd_data()
+        outs = {}
+        for w in WIDTHS:
+            coef = SGD(
+                max_iter=23,
+                learning_rate=0.1,
+                global_batch_size=64,
+                tol=0.0,
+                reg=0.01,
+                elastic_net=0.3,
+                sharding=TrainSharding(w),
+            ).optimize(np.zeros(5), data, BinaryLogisticLoss.INSTANCE)
+            outs[w] = np.asarray(coef)
+        for w in WIDTHS[1:]:
+            np.testing.assert_array_equal(outs[w], outs[1])
+
+    def test_sgd_deterministic_close_to_legacy(self):
+        """Same data, legacy vs deterministic tier: different (but both
+        correct) minibatch schedules — trajectories agree loosely."""
+        data = _sgd_data()
+        legacy = np.asarray(
+            SGD(max_iter=23, learning_rate=0.1, global_batch_size=64, tol=0.0)
+            .optimize(np.zeros(5), data, BinaryLogisticLoss.INSTANCE)
+        )
+        det = np.asarray(
+            SGD(
+                max_iter=23,
+                learning_rate=0.1,
+                global_batch_size=64,
+                tol=0.0,
+                sharding=TrainSharding(2),
+            ).optimize(np.zeros(5), data, BinaryLogisticLoss.INSTANCE)
+        )
+        np.testing.assert_allclose(det, legacy, atol=0.1)
+
+    def test_sgd_rejects_ctx_and_sharding(self):
+        from flink_ml_tpu.parallel.mesh import MeshContext
+
+        with pytest.raises(ValueError, match="not both"):
+            SGD(ctx=MeshContext(n_data=1), sharding=TrainSharding(1))
+
+    def test_kmeans_fit_bit_stable(self, train_mesh):
+        from flink_ml_tpu.api.dataframe import DataFrame
+        from flink_ml_tpu.models.clustering.kmeans import KMeans
+
+        rng = np.random.default_rng(7)
+        pts = np.concatenate(
+            [rng.normal(c, 0.5, (47, 3)) for c in (-2.0, 2.0)]
+        )
+        df = DataFrame.from_dict({"features": list(pts)})
+        outs = {}
+        for w in WIDTHS:
+            train_mesh(w)
+            model = KMeans().set_k(2).set_seed(5).set_max_iter(9).fit(df)
+            outs[w] = (np.asarray(model.centroids), np.asarray(model.weights))
+        for w in WIDTHS[1:]:
+            np.testing.assert_array_equal(outs[w][0], outs[1][0])
+            np.testing.assert_array_equal(outs[w][1], outs[1][1])
+
+    def test_kmeans_fit_stream_bit_stable(self, train_mesh):
+        from flink_ml_tpu.iteration.datacache import HostDataCache
+        from flink_ml_tpu.models.clustering.kmeans import KMeans
+
+        rng = np.random.default_rng(8)
+        pts = np.concatenate(
+            [rng.normal(c, 0.5, (61, 2)) for c in (-3.0, 0.0, 3.0)]
+        ).astype(np.float32)
+
+        def run(w):
+            train_mesh(w)
+            cache = HostDataCache()
+            cache.append({"features": pts})
+            cache.finish()
+            model = (
+                KMeans().set_k(3).set_seed(2).set_max_iter(7)
+                .fit_stream(cache, chunk_rows=48)
+            )
+            return np.asarray(model.centroids), np.asarray(model.weights)
+
+        outs = {w: run(w) for w in WIDTHS}
+        for w in WIDTHS[1:]:
+            np.testing.assert_array_equal(outs[w][0], outs[1][0])
+            np.testing.assert_array_equal(outs[w][1], outs[1][1])
+
+    def test_online_kmeans_bit_stable(self, train_mesh):
+        from flink_ml_tpu.api.dataframe import DataFrame
+        from flink_ml_tpu.models.clustering.online_kmeans import OnlineKMeans
+
+        rng = np.random.default_rng(9)
+        pts = rng.normal(size=(96, 2)).astype(np.float64)
+        df = DataFrame.from_dict({"features": list(pts)})
+
+        def run(w):
+            train_mesh(w)
+            model = (
+                OnlineKMeans()
+                .set_k(2)
+                .set_seed(4)
+                .set_global_batch_size(32)
+                .set_decay_factor(0.6)
+                .set_random_initial_model_data(2)
+                .fit(df)
+            )
+            return np.asarray(model.centroids), np.asarray(model.weights)
+
+        outs = {w: run(w) for w in WIDTHS}
+        for w in WIDTHS[1:]:
+            np.testing.assert_array_equal(outs[w][0], outs[1][0])
+            np.testing.assert_array_equal(outs[w][1], outs[1][1])
+
+    def test_mlp_trains_on_train_mesh(self, train_mesh):
+        """MLP rides train.mesh as a topology knob (psum reduction — outside
+        the bit-stability contract, but the fit must work and count)."""
+        from flink_ml_tpu.api.dataframe import DataFrame
+        from flink_ml_tpu.metrics import MLMetrics, metrics
+        from flink_ml_tpu.models.classification.mlp_classifier import MLPClassifier
+
+        train_mesh(2)
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(64, 3))
+        y = (X.sum(axis=1) > 0).astype(np.float64)
+        df = DataFrame.from_dict({"features": list(X), "label": y})
+        before = metrics.get(MLMetrics.TRAIN_GROUP, MLMetrics.TRAIN_SHARDED_FITS) or 0
+        model = (
+            MLPClassifier()
+            .set_hidden_layers(8)
+            .set_max_iter(5)
+            .set_seed(1)
+            .fit(df)
+        )
+        assert model.params
+        after = metrics.get(MLMetrics.TRAIN_GROUP, MLMetrics.TRAIN_SHARDED_FITS)
+        assert after == before + 1
+
+
+class TestShardedCheckpoint:
+    def _state(self, ts):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(16, 6)).astype(np.float32)
+        return {
+            "w": jax.device_put(w, ts.ctx.sharding(None, "model")),
+            "cent": ts.replicate(rng.normal(size=(4, 3)).astype(np.float32)),
+            "epoch": np.int64(7),
+        }, w
+
+    def test_round_trip_model_sharded_leaves(self, tmp_path):
+        ts = TrainSharding(4, 2)
+        state, w_host = self._state(ts)
+        mgr = ShardedCheckpointManager(str(tmp_path), sharding=ts, fingerprint="fp")
+        mgr.save(3, state)
+        step, got = mgr.restore_latest()
+        assert step == 3
+        np.testing.assert_array_equal(np.asarray(got["w"]), w_host)
+        np.testing.assert_array_equal(
+            np.asarray(got["cent"]), np.asarray(state["cent"])
+        )
+        # per-shard pieces on disk, deduped to distinct shard indices
+        import json
+
+        meta = json.load(open(tmp_path / "ckpt-3" / "META.json"))
+        descs = [d for d in meta["leaves"] if d is not None]
+        assert len(descs) == 1 and len(descs[0]["pieces"]) == 2
+
+    def test_mesh_mismatch_is_fatal(self, tmp_path):
+        ts = TrainSharding(4, 2)
+        state, _ = self._state(ts)
+        ShardedCheckpointManager(
+            str(tmp_path), sharding=ts, fingerprint="fp"
+        ).save(1, state)
+        other = ShardedCheckpointManager(
+            str(tmp_path), sharding=(2, 4), fingerprint="fp"
+        )
+        with pytest.raises(MeshMismatchError):
+            other.restore_latest()  # fatal, never quarantined
+
+    def test_replicated_snapshot_restores_on_any_mesh(self, tmp_path):
+        ts = TrainSharding(2)
+        cent = np.arange(12, dtype=np.float32).reshape(4, 3)
+        ShardedCheckpointManager(
+            str(tmp_path), sharding=ts, fingerprint="fp"
+        ).save(1, {"cent": ts.replicate(cent), "epoch": np.int64(2)})
+        wider = ShardedCheckpointManager(
+            str(tmp_path), sharding=(8, 1), fingerprint="fp"
+        )
+        step, got = wider.restore_latest()
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(got["cent"]), cent)
+
+    def test_corrupt_piece_quarantines_and_falls_back(self, tmp_path):
+        ts = TrainSharding(4, 2)
+        state, _ = self._state(ts)
+        mgr = ShardedCheckpointManager(str(tmp_path), sharding=ts, fingerprint="fp")
+        mgr.save(1, state)
+        mgr.save(2, state)
+        npz = tmp_path / "ckpt-2" / "arrays.npz"
+        raw = bytearray(npz.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        npz.write_bytes(bytes(raw))
+        step, _ = mgr.restore_latest()
+        assert step == 1
+        assert (tmp_path / "ckpt-2.corrupt").exists()
+
+    def test_reads_plain_format_snapshots(self, tmp_path):
+        """A directory that started on the flat manager stays restorable."""
+        plain = CheckpointManager(str(tmp_path), fingerprint="fp")
+        plain.save(9, {"a": np.ones(3)})
+        sharded = ShardedCheckpointManager(
+            str(tmp_path), sharding=TrainSharding(2), fingerprint="fp"
+        )
+        step, got = sharded.restore_latest()
+        assert step == 9
+        np.testing.assert_array_equal(got["a"], np.ones(3))
+
+
+class TestKillResume:
+    def _supervisor(self, name):
+        from flink_ml_tpu.execution import FixedDelayRestartStrategy, Supervisor
+
+        return Supervisor(
+            FixedDelayRestartStrategy(3, 0.0), name=name, sleep=lambda s: None
+        )
+
+    def _pts(self):
+        rng = np.random.default_rng(13)
+        return np.concatenate(
+            [rng.normal(c, 0.5, (53, 2)) for c in (-3.0, 3.0)]
+        ).astype(np.float32)
+
+    def _fit(self, pts, mgr=None):
+        from flink_ml_tpu.iteration.datacache import HostDataCache
+        from flink_ml_tpu.models.clustering.kmeans import KMeans
+
+        cache = HostDataCache()
+        cache.append({"features": pts})
+        cache.finish()
+        kw = (
+            {"checkpoint_manager": mgr, "checkpoint_interval": 1}
+            if mgr is not None
+            else {}
+        )
+        return (
+            KMeans().set_k(2).set_seed(3).set_max_iter(8)
+            .fit_stream(cache, chunk_rows=32, **kw)
+        )
+
+    def test_sharded_epoch_kill_and_resume(self, tmp_path, train_mesh):
+        """A sharded fit killed mid-epoch resumes from the sharded-manager
+        checkpoint in a supervised rerun and lands on the identical model."""
+        from flink_ml_tpu.faults import faults
+
+        train_mesh(2)
+        pts = self._pts()
+        clean = self._fit(pts)
+        mgr = ShardedCheckpointManager(
+            str(tmp_path / "ck"), sharding=TrainSharding(2)
+        )
+        faults.arm("iteration.epoch", at=5)
+        try:
+            sup = self._supervisor("sharded-km")
+            model = sup.run(lambda: self._fit(pts, mgr))
+        finally:
+            faults.reset()
+        assert sup.restarts == 1
+        np.testing.assert_array_equal(model.centroids, clean.centroids)
+        np.testing.assert_array_equal(model.weights, clean.weights)
+
+    def test_kill_on_width_2_resume_on_width_4(self, tmp_path, train_mesh):
+        """The tier fingerprint is width-invariant: a run killed at mesh=2
+        restores its (replicated) snapshot at mesh=4 and — epochs being
+        bit-identical across widths — lands on the identical model."""
+        from flink_ml_tpu.faults import faults
+
+        pts = self._pts()
+        train_mesh(2)
+        clean = self._fit(pts)
+        mgr = ShardedCheckpointManager(str(tmp_path / "ck"))
+        faults.arm("iteration.epoch", at=4)
+        try:
+            with pytest.raises(Exception):
+                self._fit(pts, mgr)
+        finally:
+            faults.reset()
+        assert mgr.all_steps()
+        train_mesh(4)
+        model = self._fit(pts, mgr)
+        np.testing.assert_array_equal(model.centroids, clean.centroids)
+        np.testing.assert_array_equal(model.weights, clean.weights)
+
+
+class TestContinuousPublishFromShardedTrainer:
+    def test_publish_zero_serving_path_work(self, tmp_path, train_mesh):
+        """Tentpole (e): a sharded OnlineKMeans inside ContinuousTrainer
+        publishes versions with ZERO serving-path compiles — the publish is
+        host arrays out of mesh-resident state, never a serving-tier build.
+        The publish telemetry carries the train-mesh provenance."""
+        import flink_ml_tpu.telemetry as telemetry
+        from flink_ml_tpu.loop import ContinuousTrainer
+        from flink_ml_tpu.metrics import MLMetrics, metrics
+        from flink_ml_tpu.models.clustering.online_kmeans import OnlineKMeans
+        from flink_ml_tpu.models.online import QueueBatchStream
+
+        train_mesh(4)
+        rng = np.random.default_rng(21)
+        stream = QueueBatchStream()
+        for _ in range(4):
+            stream.add({"features": rng.normal(size=(32, 2))})
+        stream.close()
+
+        est = (
+            OnlineKMeans()
+            .set_k(2)
+            .set_seed(6)
+            .set_global_batch_size(32)
+            .set_random_initial_model_data(2)
+        )
+        scope = f"{MLMetrics.LOOP_GROUP}[sharded-pub]"
+        trainer = ContinuousTrainer(
+            est, stream, str(tmp_path / "pub"),
+            publish_every_versions=2, scope=scope,
+        )
+        compiles_before = metrics.get(
+            MLMetrics.SERVING_GROUP, MLMetrics.SERVING_FASTPATH_COMPILES, 0
+        )
+        rec = telemetry.configure(str(tmp_path / "journal"))
+        try:
+            trainer.start()
+            trained, published = trainer.process()
+            rec.flush(10.0)
+            records = telemetry.read_journal(str(tmp_path / "journal"))
+        finally:
+            telemetry.configure(None)
+        assert trained == 4 and published == [2, 4]
+        compiles_after = metrics.get(
+            MLMetrics.SERVING_GROUP, MLMetrics.SERVING_FASTPATH_COMPILES, 0
+        )
+        assert compiles_after == compiles_before
+        publishes = [r for r in records if r["kind"] == "loop.publish"]
+        assert publishes and all(
+            r["data"]["train_mesh"] == 4 for r in publishes
+        )

@@ -104,6 +104,14 @@ python tools/ci/fleet_smoke.py
 echo "=== retrieval smoke (index hot swap mid-burst, zero-compile top-K) ==="
 python tools/ci/retrieval_smoke.py
 
+# Train smoke: sharded-training kill → resume across a real process
+# boundary — a sharded KMeans fit_stream at train.mesh=2 hard-killed
+# (os._exit) mid-epoch by an armed fault, then resumed at train.mesh=4 from
+# the per-shard snapshots and required to land BIT-identical to a clean run
+# — the width-invariant resume contract (docs/distributed_training.md).
+echo "=== train smoke (sharded fit hard-kill -> cross-width resume) ==="
+python tools/ci/train_smoke.py
+
 # Bench trend (informational): diff the two newest BENCH_r*.json rounds and
 # warn on >10% p50 / rows-per-second movement — directional on shared CI
 # boxes, so the step never fails the build (tools/bench_trend.py --strict

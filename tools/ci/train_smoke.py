@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""train_smoke — sharded training kill → resume across a REAL process
+boundary (docs/distributed_training.md).
+
+Three legs, each its own subprocess on a forced 8-device host platform
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``):
+
+1. **clean** — a sharded KMeans ``fit_stream`` at ``train.mesh=2`` runs to
+   completion; the model (centroids + weights) is recorded.
+2. **kill** — the same fit with a ``ShardedCheckpointManager`` and a
+   deterministic fault armed at epoch 5 dies by ``os._exit(1)`` (a hard
+   kill: no atexit, no graceful close), leaving per-shard snapshots behind.
+3. **resume** — the same fit over the same checkpoint directory at
+   ``train.mesh=4`` (the deterministic tier's fingerprint is
+   width-invariant) restores the newest snapshot, finishes the remaining
+   epochs, and must land BIT-identical to the clean leg — the
+   bit-identity-across-widths contract, through a crash.
+
+Run: ``python tools/ci/train_smoke.py`` (wired into tools/ci/run_tests.sh).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+N_POINTS = 53
+K = 2
+MAX_ITER = 8
+KILL_AT_EPOCH = 5
+CHUNK_ROWS = 32
+
+
+def _points():
+    import numpy as np
+
+    rng = np.random.default_rng(13)
+    return np.concatenate(
+        [rng.normal(c, 0.5, (N_POINTS, 2)) for c in (-3.0, 3.0)]
+    ).astype(np.float32)
+
+
+def _fit(workdir: str, mesh: int, with_manager: bool):
+    from flink_ml_tpu.checkpoint import ShardedCheckpointManager
+    from flink_ml_tpu.config import Options, config
+    from flink_ml_tpu.iteration.datacache import HostDataCache
+    from flink_ml_tpu.models.clustering.kmeans import KMeans
+
+    config.set(Options.TRAIN_MESH, mesh)
+    cache = HostDataCache()
+    cache.append({"features": _points()})
+    cache.finish()
+    kw = {}
+    if with_manager:
+        kw = {
+            "checkpoint_manager": ShardedCheckpointManager(
+                os.path.join(workdir, "ck")
+            ),
+            "checkpoint_interval": 1,
+        }
+    return (
+        KMeans().set_k(K).set_seed(3).set_max_iter(MAX_ITER)
+        .fit_stream(cache, chunk_rows=CHUNK_ROWS, **kw)
+    )
+
+
+def _save(workdir: str, name: str, model) -> None:
+    import numpy as np
+
+    np.savez(
+        os.path.join(workdir, name),
+        centroids=np.asarray(model.centroids),
+        weights=np.asarray(model.weights),
+    )
+
+
+def leg_clean(workdir: str) -> None:
+    _save(workdir, "clean.npz", _fit(workdir, mesh=2, with_manager=False))
+
+
+def leg_kill(workdir: str) -> None:
+    from flink_ml_tpu.faults import faults
+
+    faults.arm("iteration.epoch", at=KILL_AT_EPOCH)
+    try:
+        _fit(workdir, mesh=2, with_manager=True)
+    except Exception:
+        os._exit(1)  # hard kill mid-fit; snapshots already fsync'd
+    print("FAIL: the armed fault never fired")
+    os._exit(2)
+
+
+def leg_resume(workdir: str) -> None:
+    _save(workdir, "resumed.npz", _fit(workdir, mesh=4, with_manager=True))
+
+
+def main() -> int:
+    import tempfile
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run_leg(leg: str) -> int:
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--leg", leg, workdir],
+            env=env,
+            timeout=600,
+        ).returncode
+
+    with tempfile.TemporaryDirectory(prefix="train-smoke-") as workdir:
+        print(f"=== leg 1: clean sharded fit_stream (train.mesh=2, {MAX_ITER} epochs) ===")
+        if run_leg("clean") != 0:
+            print("FAIL: clean leg did not complete")
+            return 1
+        print(f"=== leg 2: kill — fault at epoch {KILL_AT_EPOCH}, os._exit(1) ===")
+        if run_leg("kill") != 1:
+            print("FAIL: kill leg did not hard-kill (expected rc 1)")
+            return 1
+        snaps = [d for d in os.listdir(os.path.join(workdir, "ck")) if d.startswith("ckpt-")]
+        if not snaps:
+            print("FAIL: the killed fit left no sharded snapshots behind")
+            return 1
+        print(f"=== leg 3: resume at train.mesh=4 from {sorted(snaps)} ===")
+        t0 = time.perf_counter()
+        if run_leg("resume") != 0:
+            print("FAIL: resume leg did not complete")
+            return 1
+        resume_wall = time.perf_counter() - t0
+
+        import numpy as np
+
+        clean = np.load(os.path.join(workdir, "clean.npz"))
+        resumed = np.load(os.path.join(workdir, "resumed.npz"))
+        for key in ("centroids", "weights"):
+            if not np.array_equal(clean[key], resumed[key]):
+                print(f"FAIL: resumed {key} differ from the clean run (not bit-identical)")
+                return 1
+        print(
+            f"train_smoke OK: kill@epoch{KILL_AT_EPOCH} mesh=2 -> resume mesh=4 "
+            f"bit-identical to clean mesh=2 run "
+            f"({len(snaps)} snapshots; resume wall {resume_wall:.1f}s)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    if "--leg" in sys.argv:
+        idx = sys.argv.index("--leg")
+        leg, workdir = sys.argv[idx + 1], sys.argv[idx + 2]
+        {"clean": leg_clean, "kill": leg_kill, "resume": leg_resume}[leg](workdir)
+        sys.exit(0)
+    sys.exit(main())

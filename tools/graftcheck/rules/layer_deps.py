@@ -126,6 +126,13 @@ MODULE_LAYERS = {
     # explicitly because the training-side models/feature/lsh.py imports
     # HASH_PRIME *from* here (L3 → L1, allowed), never the reverse.
     "servable.retrieval": 1,
+    # Training-side mesh placement (the TrainSharding companion of
+    # servable.sharding): L1 like the rest of parallel — it imports only L0
+    # (config lazily, metrics) plus same-package mesh/collectives, and the
+    # trainers that consume it (ops.optimizer L2, models L3) import DOWN into
+    # it. Registered explicitly so the deterministic training tier's
+    # dependency story is auditable next to its serving twin.
+    "parallel.train_sharding": 1,
 }
 
 #: The absorbed check_servable_imports.py contract (see module docstring).
@@ -185,7 +192,7 @@ class LayerDepsRule(Rule):
     name = "layer-deps"
     severity = "error"
     granularity = "file"
-    cache_version = 6  # v6: retrieval tier registered (retrieval L3, servable.retrieval L1)
+    cache_version = 7  # v7: training-sharding tier registered (parallel.train_sharding L1)
     description = (
         "imports within flink_ml_tpu must not point at a higher layer "
         "(foundation < compute/servable < runtime < library)"

@@ -55,7 +55,7 @@ class PlanKeyCompletenessRule(Rule):
     name = "plan-key-completeness"
     severity = "error"
     granularity = "project"
-    cache_version = 1
+    cache_version = 2  # v2: TRAIN_NEUTRAL (train.mesh* asserted not plan-reachable)
     description = (
         "config reads reachable from plan build must be carried by the "
         "plancache digest, batch fingerprint and serving rebuild key"
@@ -140,6 +140,25 @@ class PlanKeyCompletenessRule(Rule):
         "MESH_MODEL_AXIS_SIZE": "default shadowed by key-captured per-tier mesh options",
     }
 
+    #: Training-tier options asserted NEVER to be read under plan build — the
+    #: inverse of PLAN_NEUTRAL (which allowlists *plan-reachable* reads, and
+    #: whose rule 2b errors on entries nobody reads under plan build). These
+    #: are checked the other way round: a read of one of these that becomes
+    #: reachable from PLAN_BUILD_ROOTS is an error — at that point the option
+    #: has started affecting compiled serving artifacts and must be
+    #: key-captured (PLAN_KEY_OPTIONS) or justified in PLAN_NEUTRAL instead.
+    TRAIN_NEUTRAL: Dict[str, str] = {
+        # train.mesh* select the TRAINING mesh (parallel/train_sharding.py);
+        # published servables are plain host arrays whatever mesh trained
+        # them, so plan identity never depends on these. The sharded-vs-legacy
+        # trainer split is carried by the model *fingerprint* tier instead
+        # (KMeans.fit_stream stamps tier="deterministic") — a checkpoint
+        # concern, not a plan-key concern.
+        "TRAIN_MESH": "training topology only; servables are mesh-agnostic host arrays",
+        "TRAIN_MESH_MODEL": "training topology only; servables are mesh-agnostic host arrays",
+        "TRAIN_MESH_HOSTS": "jax.distributed bootstrap only; never plan identity",
+    }
+
     def run(self, project: Project) -> List[Finding]:
         index = project.index
         findings: List[Finding] = []
@@ -214,5 +233,27 @@ class PlanKeyCompletenessRule(Rule):
                     f"PLAN_NEUTRAL entry {key!r} ({attr}) is no longer read "
                     "under plan build — remove the stale allowlist entry "
                     f"(rationale was: {why})",
+                ))
+
+        # 2c. TRAIN_NEUTRAL honesty, both directions: an entry that IS read
+        # under plan build has outgrown its declaration; an entry whose option
+        # no longer exists in the registry is stale.
+        for attr, why in sorted(self.TRAIN_NEUTRAL.items()):
+            if attr not in decls:
+                findings.append(self.finding(
+                    CONFIG_REL, 1,
+                    f"TRAIN_NEUTRAL entry {attr} names no option in the config "
+                    "registry — remove the stale entry "
+                    f"(rationale was: {why})",
+                ))
+                continue
+            for rel, line in plan_reads.get(attr, ()):
+                key = decls[attr][0]
+                findings.append(self.finding(
+                    rel, line,
+                    f"option {key!r} ({attr}) is declared train-only "
+                    "(TRAIN_NEUTRAL) but is read under plan build here — "
+                    "key-capture it (PLAN_KEY_OPTIONS) or justify it in "
+                    "PLAN_NEUTRAL (rules/plan_key.py)",
                 ))
         return findings
